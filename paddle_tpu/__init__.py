@@ -1,0 +1,17 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities
+of 2018-era PaddlePaddle (reference at /root/reference, blueprint in
+SURVEY.md).
+
+Layout:
+  fluid/     Fluid-compatible frontend: Program IR, layers, optimizers,
+             executor that lowers whole blocks to fused XLA computations
+  parallel/  device mesh, data/tensor parallel training over ICI (pjit)
+  models/    reference model zoo (LeNet, ResNet, VGG, RNNs, ...)
+  reader/    composable data readers (v2 reader decorator parity)
+  ops/       pallas kernels for ops XLA cannot express well
+  utils/     flags, logging, timers (N12 parity)
+"""
+
+__version__ = "0.1.0"
+
+from . import fluid  # noqa: F401

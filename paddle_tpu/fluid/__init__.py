@@ -1,0 +1,99 @@
+"""paddle_tpu.fluid — the Fluid-compatible Python frontend of the
+TPU-native framework (API parity: reference python/paddle/v2/fluid/__init__.py)."""
+
+from . import core
+from . import framework
+from . import layers
+from . import nets
+from . import optimizer
+from . import backward
+from . import regularizer
+from . import initializer
+from . import clip
+from . import evaluator
+from . import io
+from . import profiler
+from . import learning_rate_decay
+
+from .framework import (
+    Program,
+    Variable,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    get_var,
+)
+from .core import CPUPlace, CUDAPlace, TPUPlace
+from .executor import (
+    Executor,
+    Scope,
+    global_scope,
+    scope_guard,
+    switch_scope,
+    fetch_var,
+    as_numpy,
+)
+from .data_feeder import DataFeeder
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .initializer import Constant, Normal, TruncatedNormal, Uniform, Xavier, MSRA
+from .optimizer import (
+    SGD,
+    Momentum,
+    Adagrad,
+    Adam,
+    Adamax,
+    DecayedAdagrad,
+    RMSProp,
+    Adadelta,
+    Ftrl,
+    SGDOptimizer,
+    MomentumOptimizer,
+    AdagradOptimizer,
+    AdamOptimizer,
+    AdamaxOptimizer,
+    DecayedAdagradOptimizer,
+    RMSPropOptimizer,
+    AdadeltaOptimizer,
+    FtrlOptimizer,
+)
+from .backward import append_backward
+from .regularizer import L1Decay, L2Decay, L1DecayRegularizer, L2DecayRegularizer
+from .clip import (
+    ErrorClipByValue,
+    GradientClipByValue,
+    GradientClipByNorm,
+    GradientClipByGlobalNorm,
+)
+
+__all__ = framework.__dict__.keys() if False else [
+    "io",
+    "initializer",
+    "layers",
+    "nets",
+    "optimizer",
+    "learning_rate_decay",
+    "backward",
+    "regularizer",
+    "profiler",
+    "clip",
+    "evaluator",
+    "Program",
+    "Variable",
+    "Parameter",
+    "default_main_program",
+    "default_startup_program",
+    "program_guard",
+    "Executor",
+    "Scope",
+    "global_scope",
+    "scope_guard",
+    "fetch_var",
+    "DataFeeder",
+    "ParamAttr",
+    "WeightNormParamAttr",
+    "CPUPlace",
+    "CUDAPlace",
+    "TPUPlace",
+    "append_backward",
+]

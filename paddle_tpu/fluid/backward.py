@@ -1,0 +1,65 @@
+"""append_backward: mark the program for gradient computation.
+
+Reference parity: python/paddle/v2/fluid/backward.py + C++
+framework/backward.cc:523 (AppendBackward). The reference appends one grad
+op per forward op via a registry of GradOpDescMakers; here we instead
+append a single `autodiff` marker op recording (loss, params, grad names).
+At lowering time the marker becomes one `jax.vjp` over the forward region
+(core/lowering.py), which is both exact and faster on TPU: XLA sees the
+entire forward+backward+update as one computation and fuses across the
+boundary, where the reference pays an interpreter step per grad op.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .core.lowering import AUTODIFF_OP
+from .core.program import Parameter, Program, Variable, grad_var_name
+
+__all__ = ["append_backward"]
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[List[str]] = None,
+    no_grad_set=None,
+    callbacks=None,
+) -> List[Tuple[Variable, Variable]]:
+    program = loss.block.program
+    block = program.global_block()
+
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p for p in parameter_list]
+    else:
+        params = [p for p in block.all_parameters() if getattr(p, "trainable", True)]
+    no_grad = set()
+    if no_grad_set:
+        no_grad = {v.name if isinstance(v, Variable) else str(v) for v in no_grad_set}
+    params = [p for p in params if p.name not in no_grad]
+
+    params_and_grads: List[Tuple[Variable, Variable]] = []
+    grad_names = []
+    for p in params:
+        g_name = grad_var_name(p.name)
+        if g_name in block.vars:
+            g = block.vars[g_name]
+        else:
+            g = block.create_var(
+                name=g_name, shape=p.shape, dtype=p.dtype, persistable=False
+            )
+        g.stop_gradient = True
+        params_and_grads.append((p, g))
+        grad_names.append(g_name)
+
+    block.append_op(
+        type=AUTODIFF_OP,
+        inputs={},
+        outputs={"Grads": grad_names},
+        attrs={
+            "loss_name": loss.name,
+            "param_names": [p.name for p in params],
+            "grad_names": grad_names,
+        },
+    )
+    return params_and_grads

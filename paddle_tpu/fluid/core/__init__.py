@@ -1,0 +1,62 @@
+"""Core of the TPU-native framework: IR, op kernels, lowering.
+
+The C++ core of the reference (paddle/fluid/framework + operators) maps
+here to: program.py (IR object model), registry.py + kernels_*.py (op set
+as JAX-traceable kernels), lowering.py (block -> single fused XLA
+computation). Device placement is a non-concept: XLA owns the chip.
+"""
+
+from . import program as _program
+from .program import (
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    grad_var_name,
+    program_guard,
+    switch_main_program,
+    switch_startup_program,
+    unique_name,
+)
+from .registry import LoweringContext, get_kernel, has_kernel, register_op, registered_ops
+
+# importing the kernel modules populates the registry
+from . import kernels_math  # noqa: F401
+from . import kernels_nn  # noqa: F401
+from . import kernels_tensor  # noqa: F401
+from . import kernels_optim  # noqa: F401
+from . import kernels_sequence  # noqa: F401
+from .lowering import AUTODIFF_OP, build_step_fn, lower_block
+
+
+class CPUPlace(object):
+    """Device placement is vestigial on TPU (XLA owns placement); Place
+    classes exist for API parity with reference platform/place.h:53."""
+
+    def __repr__(self):
+        return "CPUPlace"
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+
+class TPUPlace(CPUPlace):
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return "TPUPlace(%d)" % self.device_id
+
+
+class CUDAPlace(TPUPlace):
+    """Alias kept so reference scripts that say CUDAPlace(0) run unchanged;
+    on this framework it means 'the accelerator', i.e. the TPU chip."""
+
+    def __repr__(self):
+        return "CUDAPlace(%d)->TPU" % self.device_id

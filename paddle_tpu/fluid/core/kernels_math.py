@@ -1,0 +1,262 @@
+"""Math op kernels: elementwise family, mul/matmul, reductions, comparisons.
+
+Capability parity with reference paddle/fluid/operators elementwise_*,
+mul_op, matmul_op, reduce_op, scale_op, sum_op, clip ops, compare ops —
+re-expressed as jnp/lax so XLA fuses them into neighbouring matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+
+def _bcast_y(x, y, axis):
+    """Fluid elementwise broadcast: align y's shape with x starting at `axis`
+    (reference operators/elementwise_op_function.h trim-and-broadcast rule)."""
+    if x.ndim == y.ndim:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        new_shape[axis + i] = s
+    return y.reshape(new_shape)
+
+
+def _elementwise(fn):
+    def kern(ctx, ins, attrs):
+        x = ins["X"][0]
+        y = _bcast_y(x, ins["Y"][0], attrs.get("axis", -1))
+        out = fn(x, y)
+        return {"Out": out}
+
+    return kern
+
+
+register_op("elementwise_add")(_elementwise(jnp.add))
+register_op("elementwise_sub")(_elementwise(jnp.subtract))
+register_op("elementwise_mul")(_elementwise(jnp.multiply))
+register_op("elementwise_div")(_elementwise(jnp.divide))
+register_op("elementwise_max")(_elementwise(jnp.maximum))
+register_op("elementwise_min")(_elementwise(jnp.minimum))
+register_op("elementwise_pow")(_elementwise(jnp.power))
+
+
+def _compare(fn):
+    def kern(ctx, ins, attrs):
+        x = ins["X"][0]
+        y = _bcast_y(x, ins["Y"][0], attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+
+    return kern
+
+
+register_op("less_than")(_compare(jnp.less))
+register_op("less_equal")(_compare(jnp.less_equal))
+register_op("greater_than")(_compare(jnp.greater))
+register_op("greater_equal")(_compare(jnp.greater_equal))
+register_op("equal")(_compare(jnp.equal))
+register_op("not_equal")(_compare(jnp.not_equal))
+
+
+def _logical2(fn):
+    def kern(ctx, ins, attrs):
+        return {"Out": fn(ins["X"][0], ins["Y"][0])}
+
+    return kern
+
+
+register_op("logical_and")(_logical2(jnp.logical_and))
+register_op("logical_or")(_logical2(jnp.logical_or))
+register_op("logical_xor")(_logical2(jnp.logical_xor))
+
+
+@register_op("logical_not")
+def _logical_not(ctx, ins, attrs):
+    return {"Out": jnp.logical_not(ins["X"][0])}
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """Reference mul_op: flatten X by x_num_col_dims / Y by y_num_col_dims,
+    2-D matmul, reshape back (operators/mul_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    x2 = x.reshape((int(np.prod(x.shape[:xn])), -1)) if x.ndim > 2 or xn != 1 else x
+    y2 = y.reshape((int(np.prod(y.shape[:yn])), -1)) if y.ndim > 2 or yn != 1 else y
+    out = x2 @ y2
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    return {"Out": out.reshape(out_shape)}
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    if x.ndim == 1:
+        x = x.reshape(1, -1)
+    if y.ndim == 1:
+        y = y.reshape(-1, 1)
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": x * scale + bias}
+    return {"Out": (x + bias) * scale}
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    # reference mean_op produces a [1] tensor
+    return {"Out": jnp.mean(ins["X"][0]).reshape((1,))}
+
+
+def _reduce(fn):
+    def kern(ctx, ins, attrs):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            out = fn(x)
+            if attrs.get("keep_dim", False):
+                out = out.reshape((1,) * x.ndim)
+            else:
+                out = out.reshape((1,))
+            return {"Out": out}
+        dim = attrs.get("dim", 0)
+        dims = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+        return {"Out": fn(x, axis=dims, keepdims=attrs.get("keep_dim", False))}
+
+    return kern
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+
+
+def _unary(fn):
+    def kern(ctx, ins, attrs):
+        return {"Out": fn(ins["X"][0])}
+
+    return kern
+
+
+register_op("square")(_unary(jnp.square))
+register_op("sqrt")(_unary(jnp.sqrt))
+register_op("rsqrt")(_unary(lambda x: jax.lax.rsqrt(x)))
+register_op("exp")(_unary(jnp.exp))
+register_op("log")(_unary(jnp.log))
+register_op("abs")(_unary(jnp.abs))
+register_op("ceil")(_unary(jnp.ceil))
+register_op("floor")(_unary(jnp.floor))
+register_op("round")(_unary(jnp.round))
+register_op("reciprocal")(_unary(lambda x: 1.0 / x))
+register_op("sin")(_unary(jnp.sin))
+register_op("cos")(_unary(jnp.cos))
+register_op("sign")(_unary(jnp.sign))
+
+
+@register_op("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": jnp.power(ins["X"][0], attrs.get("factor", 1.0))}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": jnp.clip(ins["X"][0], attrs["min"], attrs["max"])}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": jnp.sum(jnp.square(ins["X"][0])).reshape((1,))}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    return {
+        "sub_result": sub,
+        "Out": jnp.sum(jnp.square(sub), axis=-1, keepdims=True),
+    }
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xnorm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    ynorm = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xnorm * ynorm + 1e-12)
+    return {"Out": out, "XNorm": xnorm, "YNorm": ynorm}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    return {"Out": ins["X"][0] + attrs.get("step", 1.0)}
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    return {"Out": ins["X"][0].astype(attrs["out_dtype"])}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    norm = jnp.maximum(norm, eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+@register_op("isfinite")
+def _isfinite(ctx, ins, attrs):
+    flat = jnp.concatenate([jnp.ravel(jnp.isfinite(x)) for x in ins["X"]])
+    return {"Out": jnp.all(flat).reshape((1,))}

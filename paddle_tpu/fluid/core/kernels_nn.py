@@ -1,0 +1,490 @@
+"""NN op kernels: conv/pool/norm, activations, losses, dropout, metrics.
+
+Parity targets: reference operators/conv_op.*, pool_op.*, batch_norm_op.*,
+layer_norm_op.*, softmax/cross_entropy family, dropout_op, accuracy/top_k,
+lrn_op — all expressed on NCHW layouts like the reference API, lowered to
+`lax.conv_general_dilated` / `lax.reduce_window` so XLA tiles them onto the
+MXU directly (no im2col: that is a GPU-ism the TPU backend does not need).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+@register_op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    x = ins["Input"][0]  # NCHW
+    w = ins["Filter"][0]  # OIHW
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    attrs = dict(attrs)
+    attrs["groups"] = ins["Input"][0].shape[1]
+    return _conv2d(ctx, ins, attrs)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x = ins["Input"][0]  # NCHW
+    w = ins["Filter"][0]  # IOHW in reference conv2d_transpose
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    # Paddle's conv2d_transpose == conv2d's input-gradient (IOHW filter):
+    # dilate the input by `stride`, pad by d*(k-1)-p, run a stride-1 conv
+    # with the spatially-flipped, channel-swapped kernel. Output size is
+    # (i-1)*s - 2p + d*(k-1) + 1, matching conv2d_transpose_op.cc.
+    w = jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1]  # IOHW -> OIHW, flipped
+    kh = dil[0] * (w.shape[2] - 1)
+    kw = dil[1] * (w.shape[3] - 1)
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1, 1),
+        padding=[(kh - pads[0], kh - pads[0]), (kw - pads[1], kw - pads[1])],
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return {"Output": out}
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x = ins["Input"][0]  # NCDHW
+    w = ins["Filter"][0]  # OIDHW
+    def _triple(v):
+        return tuple(int(a) for a in v) if isinstance(v, (list, tuple)) else (int(v),) * 3
+    strides = _triple(attrs.get("strides", [1, 1, 1]))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    dil = _triple(attrs.get("dilations", [1, 1, 1]))
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dil,
+        feature_group_count=int(attrs.get("groups", 1) or 1),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    return {"Output": out}
+
+
+def _pool(x, pooling_type, ksize, strides, pads, global_pooling, ceil_mode=False,
+          exclusive=True, nd=2):
+    if global_pooling:
+        ksize = x.shape[-nd:]
+        pads = (0,) * nd
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ceil_mode:
+        # extend the upper pad so the last partial window is kept
+        padding = list(padding)
+        for i in range(nd):
+            size = x.shape[2 + i] + 2 * pads[i]
+            rem = (size - ksize[i]) % strides[i]
+            extra = (strides[i] - rem) % strides[i] if rem else 0
+            padding[2 + i] = (pads[i], pads[i] + extra)
+        padding = tuple(padding)
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, stride, padding)
+    # avg pooling: exclusive counts only un-padded elements per window
+    summed = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window, stride, padding)
+    if exclusive and any(p[0] or p[1] for p in padding):
+        ones = jnp.ones(x.shape, jnp.float32)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, stride, padding)
+        out = summed / counts
+    else:
+        out = summed / float(np.prod(ksize))
+    return out.astype(x.dtype)
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _pool(
+        x,
+        attrs.get("pooling_type", "max"),
+        _pair(attrs.get("ksize", [1, 1])),
+        _pair(attrs.get("strides", [1, 1])),
+        _pair(attrs.get("paddings", [0, 0])),
+        attrs.get("global_pooling", False),
+        attrs.get("ceil_mode", False),
+        attrs.get("exclusive", True),
+        nd=2,
+    )
+    return {"Out": out}
+
+
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    def _triple(v):
+        return tuple(int(a) for a in v) if isinstance(v, (list, tuple)) else (int(v),) * 3
+    x = ins["X"][0]
+    out = _pool(
+        x,
+        attrs.get("pooling_type", "max"),
+        _triple(attrs.get("ksize", [1, 1, 1])),
+        _triple(attrs.get("strides", [1, 1, 1])),
+        _triple(attrs.get("paddings", [0, 0, 0])),
+        attrs.get("global_pooling", False),
+        attrs.get("ceil_mode", False),
+        attrs.get("exclusive", True),
+        nd=3,
+    )
+    return {"Out": out}
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    """Reference operators/batch_norm_op.cc: NCHW, per-channel affine,
+    running stats updated in train mode with `momentum` EMA."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    bias = ins["Bias"][0]
+    mean_in = ins["Mean"][0]
+    var_in = ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        mean, var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+        saved_mean = mean_in
+        saved_var = 1.0 / jnp.sqrt(var_in + eps)
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        mean_out = mean_in * momentum + mean * (1.0 - momentum)
+        var_out = var_in * momentum + var * (1.0 - momentum)
+        saved_mean = mean
+        saved_var = 1.0 / jnp.sqrt(var + eps)
+    # running-stat EMA must not leak gradients into scale/bias updates
+    mean = lax.stop_gradient(mean) if is_test else mean
+    inv = 1.0 / jnp.sqrt(var + eps)
+    y = (x - mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": y,
+        "MeanOut": lax.stop_gradient(mean_out),
+        "VarianceOut": lax.stop_gradient(var_out),
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape((1,) * begin + x.shape[begin:])
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape((1,) * begin + x.shape[begin:])
+    return {"Y": y, "Mean": mean.reshape(x.shape[:begin]), "Variance": var.reshape(x.shape[:begin])}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    acc = lax.reduce_window(
+        sq, 0.0, lax.add, (1, n, 1, 1), (1, 1, 1, 1), ((0, 0), (half, n - 1 - half), (0, 0), (0, 0))
+    )
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+# --- activations --------------------------------------------------------
+
+def _act(fn):
+    def kern(ctx, ins, attrs):
+        return {"Out": fn(ins["X"][0])}
+
+    return kern
+
+
+register_op("relu")(_act(jax.nn.relu))
+register_op("sigmoid")(_act(jax.nn.sigmoid))
+register_op("tanh")(_act(jnp.tanh))
+register_op("softsign")(_act(jax.nn.soft_sign))
+register_op("softplus")(_act(jax.nn.softplus))
+register_op("relu6")(_act(lambda x: jnp.clip(x, 0.0, 6.0)))
+register_op("gelu")(_act(jax.nn.gelu))
+register_op("elu")(_act(jax.nn.elu))
+register_op("silu")(_act(jax.nn.silu))
+register_op("logsigmoid")(_act(jax.nn.log_sigmoid))
+register_op("tanh_shrink")(_act(lambda x: x - jnp.tanh(x)))
+register_op("softshrink")(
+    lambda ctx, ins, attrs: {
+        "Out": jnp.sign(ins["X"][0])
+        * jnp.maximum(jnp.abs(ins["X"][0]) - attrs.get("lambda", 0.5), 0.0)
+    }
+)
+register_op("hard_shrink")(
+    lambda ctx, ins, attrs: {
+        "Out": jnp.where(
+            jnp.abs(ins["X"][0]) > attrs.get("threshold", 0.5), ins["X"][0], 0.0
+        )
+    }
+)
+register_op("thresholded_relu")(
+    lambda ctx, ins, attrs: {
+        "Out": jnp.where(ins["X"][0] > attrs.get("threshold", 1.0), ins["X"][0], 0.0)
+    }
+)
+register_op("hard_sigmoid")(
+    lambda ctx, ins, attrs: {
+        "Out": jnp.clip(
+            ins["X"][0] * attrs.get("slope", 0.2) + attrs.get("offset", 0.5), 0.0, 1.0
+        )
+    }
+)
+register_op("leaky_relu")(
+    lambda ctx, ins, attrs: {
+        "Out": jax.nn.leaky_relu(ins["X"][0], attrs.get("alpha", 0.02))
+    }
+)
+register_op("brelu")(
+    lambda ctx, ins, attrs: {
+        "Out": jnp.clip(ins["X"][0], attrs.get("t_min", 0.0), attrs.get("t_max", 24.0))
+    }
+)
+register_op("stanh")(
+    lambda ctx, ins, attrs: {
+        "Out": attrs.get("scale_b", 1.7159)
+        * jnp.tanh(ins["X"][0] * attrs.get("scale_a", 2.0 / 3.0))
+    }
+)
+register_op("swish")(
+    lambda ctx, ins, attrs: {
+        "Out": ins["X"][0] * jax.nn.sigmoid(attrs.get("beta", 1.0) * ins["X"][0])
+    }
+)
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs):
+    x = ins["X"][0]
+    alpha = ins["Alpha"][0]
+    if alpha.size > 1 and x.ndim >= 2:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.softmax(ins["X"][0], axis=-1)}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.log_softmax(ins["X"][0], axis=-1)}
+
+
+# --- losses -------------------------------------------------------------
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    """Reference operators/cross_entropy_op.cc: hard labels are int64 [N,1],
+    soft labels are a distribution with X's shape."""
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[0]).astype(jnp.int32)
+        picked = jnp.take_along_axis(x, lbl[:, None], axis=-1)
+        loss = -jnp.log(picked + eps)
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    logits = ins["Logits"][0]
+    label = ins["Label"][0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[0]).astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+    return {"Softmax": jnp.exp(logp), "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, ins, attrs):
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": loss}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits = ins["Logits"][0]
+    labels = ins["Labels"][0].astype(logits.dtype)
+    return {"Loss": jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    absr = jnp.abs(r)
+    loss = jnp.where(absr <= delta, 0.5 * r * r, delta * (absr - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, ins, attrs):
+    p = ins["Predicted"][0]
+    l = ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": -l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    a = jnp.abs(diff)
+    val = jnp.where(a < 1.0 / s2, 0.5 * s2 * diff * diff, a - 0.5 / s2)
+    if ins.get("OutsideWeight"):
+        val = val * ins["OutsideWeight"][0]
+    out = jnp.sum(val.reshape(val.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": out, "Diff": diff}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    label = ins["Label"][0]
+    margin = attrs.get("margin", 0.0)
+    act = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": act, "Activated": (act > 0).astype(x1.dtype)}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": jnp.log1p(jnp.exp(d)) - label * d}
+
+
+# --- dropout / noise ----------------------------------------------------
+
+@register_op("dropout")
+def _dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    if attrs.get("is_test", False) or ctx.is_test:
+        # reference downscales at inference (dropout_op.cc upscale_in_train=False default)
+        return {"Out": x * (1.0 - p), "Mask": jnp.ones_like(x)}
+    key = ctx.next_key()
+    mask = jax.random.bernoulli(key, 1.0 - p, x.shape).astype(x.dtype)
+    return {"Out": x * mask, "Mask": mask}
+
+
+@register_op("gaussian_random_noise")
+def _gaussian_noise(ctx, ins, attrs):
+    x = ins["X"][0]
+    key = ctx.next_key()
+    return {"Out": x + jax.random.normal(key, x.shape, x.dtype) * attrs.get("std", 1.0)}
+
+
+# --- metrics ------------------------------------------------------------
+
+@register_op("top_k")
+def _top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    vals, idx = lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int32)}
+
+
+@register_op("accuracy")
+def _accuracy(ctx, ins, attrs):
+    indices = ins["Indices"][0]
+    label = ins["Label"][0]
+    lbl = label.reshape(label.shape[0], 1).astype(indices.dtype)
+    correct = jnp.any(indices == lbl, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.asarray(label.shape[0], jnp.int32)
+    acc = num_correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {
+        "Accuracy": acc.reshape((1,)),
+        "Correct": num_correct.reshape((1,)),
+        "Total": total.reshape((1,)),
+    }
+
+
+@register_op("auc")
+def _auc(ctx, ins, attrs):
+    """Batch-local AUC by threshold bucketing (reference auc_op.cc uses the
+    trapezoidal rule over score thresholds)."""
+    pred = ins["Out"][0]
+    label = ins["Label"][0].reshape(-1)
+    score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 else pred.reshape(-1)
+    num_thresholds = attrs.get("num_thresholds", 200)
+    thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
+    pos = (label > 0).astype(jnp.float32)
+    neg = 1.0 - pos
+    above = score[None, :] >= thresholds[:, None]
+    tp = jnp.sum(above * pos[None, :], axis=1)
+    fp = jnp.sum(above * neg[None, :], axis=1)
+    tpr = tp / jnp.maximum(jnp.sum(pos), 1.0)
+    fpr = fp / jnp.maximum(jnp.sum(neg), 1.0)
+    auc = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": auc.reshape((1,))}

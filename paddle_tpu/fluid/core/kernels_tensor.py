@@ -1,0 +1,270 @@
+"""Tensor / data-movement op kernels: fills, random init, reshape family,
+concat/split, embedding lookup, one-hot, gather/scatter.
+
+Parity: reference operators/fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, concat_op, split_op, reshape_op, transpose_op,
+lookup_table_op (the dense path of N16's sparse embedding), expand_op,
+gather/scatter, sequence_mask.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+
+def _np_dtype(s):
+    return jnp.dtype(s) if not isinstance(s, str) else jnp.dtype(
+        {"int64": "int32"}.get(s, s)  # 64-bit ints run as 32-bit on TPU
+    )
+
+
+@register_op("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), _np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0), _np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": jnp.zeros_like(ins["X"][0])}
+
+
+@register_op("uniform_random")
+def _uniform_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    key = ctx.next_key()
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    return {"Out": jax.random.uniform(key, shape, _np_dtype(attrs.get("dtype", "float32")), lo, hi)}
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    key = ctx.next_key()
+    dt = _np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(key, shape, dt)}
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    key = ctx.next_key()
+    dt = _np_dtype(attrs.get("dtype", "float32"))
+    std = attrs.get("std", 1.0)
+    return {
+        "Out": attrs.get("mean", 0.0)
+        + std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dt)
+    }
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("assign_value")
+def _assign_value(ctx, ins, attrs):
+    values = np.asarray(attrs["values"], dtype=_np_dtype(attrs.get("dtype", "float32")))
+    return {"Out": jnp.asarray(values.reshape(tuple(attrs["shape"])))}
+
+
+@register_op("shape")
+def _shape(ctx, ins, attrs):
+    return {"Out": jnp.asarray(ins["Input"][0].shape, jnp.int32)}
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    sections = attrs.get("sections", [])
+    num = attrs.get("num", 0)
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("reshape")
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    # reference reshape_op: 0 means "copy this dim from input", -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": x.reshape(tuple(shape))}
+
+
+@register_op("squeeze")
+def _squeeze(ctx, ins, attrs):
+    axes = attrs.get("axes", [])
+    x = ins["X"][0]
+    if axes:
+        return {"Out": jnp.squeeze(x, axis=tuple(axes))}
+    return {"Out": jnp.squeeze(x)}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    return {"Out": jnp.expand_dims(ins["X"][0], axis=tuple(attrs["axes"]))}
+
+
+@register_op("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": jnp.transpose(ins["X"][0], axes=tuple(attrs["axis"]))}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": jnp.tile(x, tuple(times))}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    paddings = attrs["paddings"]  # flat [before0, after0, before1, after1, ...]
+    pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("crop")
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    if ins.get("Y"):
+        shape = ins["Y"][0].shape
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": x[idx]}
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    """Embedding gather (reference operators/lookup_table_op.cc). Ids come in
+    as [N, 1] int; padding_idx rows read as zeros."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    flat = ids.reshape(-1).astype(jnp.int32)
+    out = jnp.take(w, flat, axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((flat == padding_idx)[:, None], 0.0, out)
+    out_shape = tuple(ids.shape[:-1]) + (w.shape[1],) if ids.shape[-1] == 1 else tuple(ids.shape) + (w.shape[1],)
+    return {"Out": out.reshape(out_shape)}
+
+
+@register_op("one_hot")
+def _one_hot(ctx, ins, attrs):
+    ids = ins["X"][0].reshape(-1).astype(jnp.int32)
+    depth = attrs["depth"]
+    return {"Out": jax.nn.one_hot(ids, depth, dtype=jnp.float32)}
+
+
+@register_op("gather")
+def _gather(ctx, ins, attrs):
+    return {"Out": jnp.take(ins["X"][0], ins["Index"][0].reshape(-1).astype(jnp.int32), axis=0)}
+
+
+@register_op("scatter")
+def _scatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    idx = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    upd = ins["Updates"][0]
+    return {"Out": x.at[idx].set(upd)}
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ctx, ins, attrs):
+    lengths = ins["X"][0].reshape(-1)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError("sequence_mask on TPU requires a static maxlen attr")
+    mask = jnp.arange(maxlen)[None, :] < lengths[:, None]
+    dt = attrs.get("out_dtype", "int64")
+    return {"Y": mask.astype(_np_dtype(dt))}
+
+
+@register_op("range")
+def _range(ctx, ins, attrs):
+    return {
+        "Out": jnp.arange(attrs["start"], attrs["end"], attrs.get("step", 1)).astype(
+            _np_dtype(attrs.get("dtype", "int32"))
+        )
+    }
+
+
+@register_op("multiplex")
+def _multiplex(ctx, ins, attrs):
+    index = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)  # [num_candidates, N, D]
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": stacked[index, rows]}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (reference operators/row_conv_op.cc) on the
+    dense [N, T, D] layout; each step mixes `future_context` future frames."""
+    x = ins["X"][0]
+    filt = ins["Filter"][0]  # [future_context+1, D]
+    ctx_len = filt.shape[0]
+    out = jnp.zeros_like(x)
+    for k in range(ctx_len):
+        shifted = jnp.pad(x[:, k:, :], ((0, 0), (0, k), (0, 0)))
+        out = out + shifted * filt[k][None, None, :]
+    return {"Out": out}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    """Reference operators/im2sequence_op.cc: sliding blocks -> rows."""
+    x = ins["X"][0]  # NCHW
+    kh, kw = attrs.get("kernels", [1, 1])
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )  # [N, C*kh*kw, oh, ow]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": out}

@@ -1,0 +1,88 @@
+"""Op kernel registry + lowering context.
+
+The reference dispatches per-op kernels by (place, layout, dtype, library)
+at runtime (paddle/fluid/framework/operator.cc:508, op_registry.h). On TPU
+there is exactly one backend — XLA — so an "op kernel" here is a pure
+JAX-traceable function; the Executor calls kernels sequentially while
+tracing, producing one fused HLO computation per block. Kernels therefore
+never see devices or memory: they map named input arrays to named output
+arrays.
+
+Kernel signature::
+
+    fn(ctx: LoweringContext,
+       ins: Dict[slot, List[Array]],
+       attrs: Dict[str, Any]) -> Dict[slot, List[Array] | Array]
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+__all__ = ["register_op", "get_kernel", "has_kernel", "LoweringContext", "registered_ops"]
+
+_KERNELS: Dict[str, Callable] = {}
+
+
+def register_op(op_type: str):
+    def deco(fn):
+        if op_type in _KERNELS:
+            raise ValueError("op %r registered twice" % op_type)
+        _KERNELS[op_type] = fn
+        return fn
+
+    return deco
+
+
+def get_kernel(op_type: str) -> Callable:
+    try:
+        return _KERNELS[op_type]
+    except KeyError:
+        raise NotImplementedError(
+            "no TPU kernel registered for op type %r (have %d ops)"
+            % (op_type, len(_KERNELS))
+        )
+
+
+def has_kernel(op_type: str) -> bool:
+    return op_type in _KERNELS
+
+
+def registered_ops() -> List[str]:
+    return sorted(_KERNELS)
+
+
+class LoweringContext(object):
+    """Per-trace state shared by kernels: RNG derivation and var metadata.
+
+    Deterministic RNG: every random op folds a fresh counter into the step's
+    base key, so a given (program, step-key) pair is reproducible and safe to
+    replay under jax.vjp.
+    """
+
+    def __init__(self, block, base_key, is_test: bool = False):
+        self.block = block
+        self._base_key = base_key
+        self._rng_counter = 0
+        self.is_test = is_test
+        # set per-op by lowering.run_op; lets sequence kernels reach LoD
+        # side-band entries without polluting every kernel signature
+        self.op = None
+        self.env: dict = {}
+
+    def next_key(self):
+        if self._base_key is None:
+            raise RuntimeError("this execution was built without an RNG key")
+        self._rng_counter += 1
+        return jax.random.fold_in(self._base_key, self._rng_counter)
+
+    def var(self, name: str):
+        return self.block.var(name)
+
+    def var_shape(self, name: str):
+        return self.block.var(name).shape
+
+    def var_dtype(self, name: str):
+        return self.block.var(name).dtype

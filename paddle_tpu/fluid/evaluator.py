@@ -1,0 +1,83 @@
+"""Evaluators: metric accumulation across minibatches (reference
+python/paddle/v2/fluid/evaluator.py + legacy paddle/gserver/evaluators/).
+
+State vars are persistable scope arrays updated by ops inside the fused
+train step; `eval()` reads them host-side."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+from .core.program import Program, Variable, unique_name
+from .executor import global_scope
+from .initializer import Constant
+from .layer_helper import LayerHelper
+
+__all__ = ["Accuracy", "ChunkEvaluator", "Evaluator"]
+
+
+class Evaluator(object):
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        scope = global_scope()
+        for var in self.states:
+            scope.set(var.name, np.zeros(var.shape, var.dtype))
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+    def create_state(self, suffix, dtype, shape):
+        state = self.helper.create_global_variable(
+            name=unique_name(self.helper.name + "_" + suffix),
+            persistable=True,
+            dtype=dtype,
+            shape=shape,
+        )
+        self.helper.set_variable_initializer(state, Constant(0.0))
+        self.states.append(state)
+        return state
+
+
+class Accuracy(Evaluator):
+    """Streaming accuracy (reference evaluator.py Accuracy)."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super().__init__("accuracy", **kwargs)
+        main_program = self.helper.main_program
+        if main_program.current_block_idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.total = self.create_state(dtype="int64", shape=[1], suffix="total")
+        self.correct = self.create_state(dtype="int64", shape=[1], suffix="correct")
+        total = self.helper.create_tmp_variable(dtype="int64")
+        correct = self.helper.create_tmp_variable(dtype="int64")
+        acc = layers.accuracy(input=input, label=label, k=k, correct=correct, total=total)
+        self.helper.append_op(
+            type="sum",
+            inputs={"X": [self.total, total]},
+            outputs={"Out": [self.total]},
+        )
+        self.helper.append_op(
+            type="sum",
+            inputs={"X": [self.correct, correct]},
+            outputs={"Out": [self.correct]},
+        )
+        self.metrics.append(acc)
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        total = float(np.asarray(scope.get(self.total.name))[0])
+        correct = float(np.asarray(scope.get(self.correct.name))[0])
+        return np.array(correct / total if total else 0.0, dtype=np.float32)
+
+
+class ChunkEvaluator(Evaluator):
+    def __init__(self, input, label, chunk_scheme, num_chunk_types, excluded_chunk_types=None):
+        raise NotImplementedError(
+            "ChunkEvaluator lands with the sequence-labeling (CRF) milestone"
+        )

@@ -1,0 +1,72 @@
+"""Profiler (reference python/paddle/v2/fluid/profiler.py:33 cuda_profiler,
+:76 profiler; C++ platform/profiler.cc RecordEvent/EnableProfiler).
+
+On TPU the per-op CUDA-event machinery is replaced by (a) XLA traces via
+jax.profiler (viewable in TensorBoard/XProf) and (b) a host-side wall-clock
+table per executor run, since a fused XLA step has no per-op boundary on
+device. The context-manager API is kept."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import jax
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler"]
+
+_events = []
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Kept for API parity; records an XLA trace to the given directory."""
+    with profiler("All", profile_path=output_file):
+        yield
+
+
+def reset_profiler():
+    _events.clear()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    if state not in ["CPU", "GPU", "All", "TPU"]:
+        raise ValueError("state must be 'CPU', 'GPU', 'TPU' or 'All'")
+    trace_dir = profile_path if os.path.isdir(profile_path) else os.path.dirname(profile_path) or "/tmp"
+    started = False
+    try:
+        jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception:
+        pass  # a trace may already be running
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        elapsed = time.time() - t0
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        _events.append(("profiler_span", elapsed))
+        print(
+            "[paddle_tpu.profiler] span=%.4fs trace_dir=%s (open with "
+            "TensorBoard / xprof)" % (elapsed, trace_dir)
+        )
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """RAII timing (reference platform/profiler.h RecordEvent)."""
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        _events.append((name, time.time() - t0))
+
+
+def get_events():
+    return list(_events)

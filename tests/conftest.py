@@ -1,0 +1,43 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh so the
+distributed paths are CI-testable without TPU hardware (SURVEY.md §4.4
+lesson: the reference's multi-process distributed tests were excluded from
+CI; we make ours single-process)."""
+
+import os
+
+# must happen before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# kernels run at the platform's fast default precision (bf16 passes on the
+# TPU MXU); numeric comparison tests need full f32 accumulation
+jax.config.update("jax_default_matmul_precision", "float32")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs and a fresh scope."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.core.program import (
+        Program,
+        switch_main_program,
+        switch_startup_program,
+    )
+    from paddle_tpu.fluid.executor import Scope, switch_scope
+
+    prev_main = switch_main_program(Program())
+    prev_startup = switch_startup_program(Program())
+    prev_scope = switch_scope(Scope())
+    yield
+    switch_main_program(prev_main)
+    switch_startup_program(prev_startup)
+    switch_scope(prev_scope)

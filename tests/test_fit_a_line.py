@@ -1,0 +1,67 @@
+"""End-to-end linear regression — the reference's first book test
+(python/paddle/v2/fluid/tests/book/test_fit_a_line.py). Trains y = Wx + b
+on synthetic data and asserts convergence."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def make_data(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, size=(n, 13)).astype(np.float32)
+    true_w = rng.uniform(-2, 2, size=(13, 1)).astype(np.float32)
+    y = x @ true_w + 0.5
+    return x, y
+
+
+def test_fit_a_line_converges():
+    x_data, y_data = make_data()
+
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(x=cost)
+
+    sgd_optimizer = fluid.optimizer.SGD(learning_rate=0.1)
+    sgd_optimizer.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    batch = 64
+    losses = []
+    for epoch in range(30):
+        for i in range(0, len(x_data), batch):
+            out = exe.run(
+                fluid.default_main_program(),
+                feed={"x": x_data[i : i + batch], "y": y_data[i : i + batch]},
+                fetch_list=[avg_cost],
+            )
+        losses.append(float(out[0][0]))
+    assert losses[-1] < 0.1, "did not converge: %s" % losses[-5:]
+    assert losses[-1] < losses[0]
+
+
+def test_fit_a_line_infer_matches_train_params():
+    x_data, y_data = make_data()
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    out = exe.run(
+        fluid.default_main_program(),
+        feed={"x": x_data[:8]},
+        fetch_list=[y_predict],
+    )
+    # manual matmul from scope params
+    block = fluid.default_main_program().global_block()
+    params = [v for v in block.vars.values() if isinstance(v, fluid.Parameter)]
+    w = next(np.asarray(fluid.global_scope().get(p.name)) for p in params if "w" in p.name)
+    b = next(np.asarray(fluid.global_scope().get(p.name)) for p in params if "b" in p.name)
+    ref = x_data[:8] @ w + b
+    np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
