@@ -1,0 +1,87 @@
+"""Benchmark: ResNet-50 training throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: BASELINE.json north star, 1500 images/sec/chip (v5e).
+Workload parity: benchmark/paddle/image/resnet.py with --job=time
+(batch data-parallel train step, cross-entropy + momentum).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 1500.0
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "100"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+
+    # standard TPU mixed precision: f32 state, single-pass bf16 on the MXU
+    os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "bfloat16")
+
+    import jax
+
+    jax.config.update(
+        "jax_default_matmul_precision",
+        os.environ["JAX_DEFAULT_MATMUL_PRECISION"],
+    )
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        image = fluid.layers.data(name="image", shape=[3, 224, 224], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        predict = resnet_imagenet(image, class_dim=1000, depth=50)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(x=cost)
+        opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        opt.minimize(avg_cost)
+    # mixed precision: bf16 forward/backward, f32 master weights
+    main_prog.amp = os.environ.get("BENCH_AMP", "1") == "1"
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    lbl = rng.randint(0, 1000, (batch, 1)).astype(np.int64)
+    feed = {"image": img, "label": lbl}
+
+    # multi-step execution: `steps` train iterations inside one compiled
+    # computation (host and data transfers out of the loop). The first
+    # call compiles; timed calls replay the cached executable.
+    out = exe.run_repeated(main_prog, feed=feed, fetch_list=[avg_cost], steps=steps)
+    assert np.isfinite(out[0]).all(), "non-finite loss in warmup: %r" % out[0]
+
+    reps = max(1, warmup)
+    t0 = time.time()
+    for _ in range(reps):
+        out = exe.run_repeated(main_prog, feed=feed, fetch_list=[avg_cost], steps=steps)
+        final_loss = float(np.ravel(out[0])[-1])  # forces full sync
+    dt = time.time() - t0
+
+    img_per_sec = batch * steps * reps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(img_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -179,24 +179,54 @@ def _lower_ops(
     loss_name = ad_op.attrs["loss_name"]
     param_names = [p for p in ad_op.attrs["param_names"] if p in env]
     grad_names = dict(zip(ad_op.attrs["param_names"], ad_op.attrs["grad_names"]))
+    amp = bool(getattr(block.program, "amp", False))
 
     base_env = dict(env)
+    if amp:
+        # mixed precision: cast ONLY what the forward region reads (feeds,
+        # params, BN state) to bf16 — optimizer state and scalar
+        # hyper-accumulators stay f32. A blanket cast would e.g. round
+        # Adam's beta2^t accumulator 0.999 -> 1.0 in bf16 and zero the
+        # update entirely.
+        fwd_inputs = set()
+        for op in fwd_ops:
+            fwd_inputs |= set(op.input_arg_names)
+        for k in fwd_inputs:
+            v = base_env.get(k)
+            if v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32:
+                base_env[k] = v.astype(jnp.bfloat16)
 
     def fwd(pvals: Dict[str, Any]):
         fenv = dict(base_env)
+        if amp:
+            pvals = {
+                k: v.astype(jnp.bfloat16)
+                if hasattr(v, "dtype") and v.dtype == jnp.float32
+                else v
+                for k, v in pvals.items()
+            }
         fenv.update(pvals)
         run_ops(ctx, fwd_ops, fenv)
-        loss = fenv[loss_name]
+        loss = fenv[loss_name].astype(jnp.float32)
         return loss, fenv
 
     primal_params = {p: env[p] for p in param_names}
     loss_val, pullback, fenv = jax.vjp(fwd, primal_params, has_aux=True)
     (grads,) = pullback(jnp.ones_like(loss_val))
 
+    # forward-region env entries win, but persistables that the forward did
+    # NOT touch (optimizer state, master copies) keep their f32 originals
+    saved = {
+        k: v
+        for k, v in env.items()
+        if k not in fenv or (amp and k in param_names)
+    }
     env.clear()
     env.update(fenv)
+    env.update(saved)
     for p in param_names:
-        env[grad_names[p]] = grads[p]
+        g = grads[p]
+        env[grad_names[p]] = g.astype(jnp.float32) if amp else g
 
     run_ops(ctx, tail_ops, env)
     return env
@@ -208,18 +238,28 @@ def build_step_fn(
     fetch_names: Sequence[str],
     persist_names: Sequence[str],
     is_test: bool = False,
+    persist_in: Optional[Sequence[str]] = None,
 ):
     """Build the pure step function over (persistables, feeds, rng-key).
 
-    Returned fn: (persist: dict, feeds: dict, key) ->
-                 (fetches: list, new_persist: dict)
-    Pure and jittable; the Executor wraps it in jax.jit with the persist
-    dict donated.
+    Returns (fn, persist_out) where
+      fn: (persist: dict, feeds: dict, key) -> (fetches: list, new_persist)
+    and persist_out is the static key list of new_persist. Pure and
+    jittable; the Executor wraps it in jax.jit with the persist dict
+    donated.
     """
     block = program.global_block()
     persist_names = list(persist_names)
     fetch_names = list(fetch_names)
+    persist_in = list(persist_in or [])
     pruned_ops = _backward_slice(block, fetch_names, set(persist_names))
+
+    # static set of persistables the step returns: those passed in plus
+    # those produced by a kept op (startup programs create params fresh)
+    produced = set()
+    for op in pruned_ops:
+        produced |= set(op.output_arg_names)
+    persist_out = sorted(set(persist_in) | (produced & set(persist_names)))
 
     def step(persist: Dict[str, Any], feeds: Dict[str, Any], key):
         env: Dict[str, Any] = {}
@@ -227,7 +267,70 @@ def build_step_fn(
         env.update(feeds)
         env = _lower_ops(block, pruned_ops, env, base_key=key, is_test=is_test)
         fetches = [env[n] for n in fetch_names]
-        new_persist = {n: env[n] for n in persist_names if n in env}
+        new_persist = {}
+        for n in persist_out:
+            v = env[n]
+            # under AMP the forward may have produced bf16 values (e.g. BN
+            # running stats); persisted state keeps its original dtype so
+            # scope dtypes are stable across steps (no recompiles)
+            if n in persist and hasattr(v, "dtype") and v.dtype != persist[n].dtype:
+                v = v.astype(persist[n].dtype)
+            new_persist[n] = v
         return fetches, new_persist
 
-    return step
+    return step, persist_out
+
+
+def build_multi_step_fn(
+    program,
+    feed_names: Sequence[str],
+    fetch_names: Sequence[str],
+    persist_names: Sequence[str],
+    steps: int,
+    is_test: bool = False,
+    persist_in: Optional[Sequence[str]] = None,
+    scanned_feeds: Optional[Sequence[str]] = None,
+):
+    """K training steps inside ONE compiled computation via lax.scan.
+
+    The reference pays an interpreter pass + kernel launches per batch
+    (executor.cc hot loop); on TPU the host should not sit in the step
+    loop at all — especially through a remote runtime where every buffer
+    handle costs a round trip. Feeds named in `scanned_feeds` must carry a
+    leading [steps] dim and are sliced per iteration; other feeds are
+    reused each step. Fetches come back stacked [steps, ...].
+    """
+    from jax import lax
+
+    step, persist_out = build_step_fn(
+        program,
+        feed_names,
+        fetch_names,
+        persist_names,
+        is_test=is_test,
+        persist_in=persist_in,
+    )
+    if set(persist_out) != set(persist_in or []):
+        raise ValueError(
+            "multi-step execution requires the program to update (not "
+            "create) persistables; missing from scope: %r"
+            % sorted(set(persist_out) - set(persist_in or []))
+        )
+    scanned = set(scanned_feeds or [])
+
+    def multi(persist, feeds, key):
+        bcast = {n: v for n, v in feeds.items() if n not in scanned}
+        xs_feeds = {n: v for n, v in feeds.items() if n in scanned}
+
+        def body(carry, xs):
+            i, per_step = xs
+            f = dict(bcast)
+            f.update(per_step)
+            fetches, newp = step(carry, f, jax.random.fold_in(key, i))
+            return newp, fetches
+
+        idx = jnp.arange(steps)
+        new_persist, fetch_stack = lax.scan(body, dict(persist), (idx, xs_feeds))
+        return fetch_stack, new_persist
+
+    return multi, persist_out

@@ -356,6 +356,8 @@ class Program(object):
         self._seed = 0
         # name -> sharding spec (set by the distributed transpiler / pjit glue)
         self.shardings: Dict[str, Any] = {}
+        # mixed precision: forward/backward in bf16, f32 master params
+        self.amp = False
 
     def _bump_version(self):
         self.version += 1
@@ -407,6 +409,7 @@ class Program(object):
         p.version = self.version
         p._seed = self._seed
         p.shardings = dict(self.shardings)
+        p.amp = self.amp
         for blk in self.blocks:
             nb = Block(p, blk.idx, blk.parent_idx)
             for name, v in blk.vars.items():

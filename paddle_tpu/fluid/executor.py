@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import core
-from .core.kernels_sequence import lod_key
+from .core.kernels_sequence import LOD_SUFFIX, lod_key
 from .core.lowering import build_step_fn
 from .core.program import Program, Variable
 
@@ -119,12 +119,28 @@ def _feed_name(f):
 
 
 class Executor(object):
-    def __init__(self, places=None):
+    """Single-chip by default. Pass `mesh=jax.sharding.Mesh(...)` (or set a
+    default via paddle_tpu.parallel.set_default_mesh) to run data/tensor-
+    parallel: feeds shard on the mesh 'data' axis, params place per
+    program.shardings (replicated unless annotated), and XLA SPMD inserts
+    the gradient allreduce over ICI — replacing the reference's
+    MultiGradientMachine / NCCL / pserver paths with identical global-batch
+    semantics."""
+
+    def __init__(self, places=None, mesh=None):
         if isinstance(places, (list, tuple)):
             places = places[0] if places else None
         self.place = places
+        self.mesh = mesh
         self._cache: Dict[Any, Any] = {}
         self._run_counter = 0
+
+    def _resolve_mesh(self):
+        if self.mesh is not None:
+            return self.mesh
+        from ..parallel.mesh import get_default_mesh
+
+        return get_default_mesh()
 
     # ------------------------------------------------------------------
     def run(
@@ -138,6 +154,46 @@ class Executor(object):
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ):
+        return self._execute(
+            program, feed, fetch_list, scope, return_numpy,
+            use_cache=use_program_cache, steps=None, scan_feeds=False,
+        )
+
+    def run_repeated(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[List[Any]] = None,
+        steps: int = 1,
+        scan_feeds: bool = False,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+    ):
+        """Run `steps` training iterations in ONE compiled computation
+        (lax.scan) — the host leaves the step loop entirely. With
+        scan_feeds=True every feed must carry a leading [steps] dim holding
+        per-step batches (LoD side-bands are always broadcast); otherwise
+        the same feed is reused each step. Fetches return stacked
+        [steps, ...]."""
+        return self._execute(
+            program, feed, fetch_list, scope, return_numpy,
+            use_cache=True, steps=int(steps), scan_feeds=scan_feeds,
+        )
+
+    # ------------------------------------------------------------------
+    def _execute(
+        self,
+        program,
+        feed,
+        fetch_list,
+        scope,
+        return_numpy,
+        use_cache: bool,
+        steps: Optional[int],
+        scan_feeds: bool,
+    ):
+        from .core.lowering import build_multi_step_fn
+
         if program is None:
             program = core.default_main_program()
         feed = feed or {}
@@ -146,41 +202,90 @@ class Executor(object):
 
         block = program.global_block()
         fetch_names = [_feed_name(f) for f in fetch_list]
-        persist_names = sorted(
-            v.name for v in program.list_vars() if v.persistable
-        )
+        persist_names = sorted(v.name for v in program.list_vars() if v.persistable)
 
         feed_arrays: Dict[str, Any] = {}
         for name, value in feed.items():
             var = block.var(name) if block.has_var(name) else None
             data, lod = _split_lod_feed(value)
-            arr = _to_device_dtype(data, var)
-            feed_arrays[name] = arr
+            feed_arrays[name] = _to_device_dtype(data, var)
             if lod is not None:
                 feed_arrays[lod_key(name)] = np.asarray(lod, np.int32)
+        # LoD side-band offsets are never scanned: their leading dim is the
+        # offset count, not steps
+        scanned = (
+            set(n for n in feed_arrays if not n.endswith(LOD_SUFFIX))
+            if scan_feeds
+            else set()
+        )
 
         feed_sig = tuple(
             (n, tuple(a.shape), str(a.dtype)) for n, a in sorted(feed_arrays.items())
         )
         persist_in = {n: scope.get(n) for n in persist_names if n in scope}
-        # LoD side-band entries of persistables (rare) ride along
+        mesh = self._resolve_mesh()
+        if mesh is not None:
+            # place persistables on their target shardings up-front (no-op
+            # when already placed; once after startup for TP params created
+            # replicated by a startup program that has no annotations)
+            from jax.sharding import NamedSharding
+
+            from ..parallel.mesh import replicated
+
+            rep = replicated(mesh)
+            for n in list(persist_in.keys()):
+                spec = program.shardings.get(n)
+                target = NamedSharding(mesh, spec) if spec is not None else rep
+                arr = persist_in[n]
+                if getattr(arr, "sharding", None) != target:
+                    persist_in[n] = jax.device_put(arr, target)
+        # sharding annotations are part of the compiled artifact: fingerprint
+        # them so shard_parameter() after a run is not silently ignored
+        shard_fp = tuple(sorted((k, str(v)) for k, v in program.shardings.items()))
         key = (
             id(program),
             program.version,
+            program.amp,
             feed_sig,
             tuple(fetch_names),
             tuple(sorted(persist_in.keys())),
-        )
-        entry = self._cache.get(key) if use_program_cache else None
+            steps,
+            scan_feeds,
+            shard_fp,
+        ) + ((id(mesh),) if mesh is not None else ())
+        entry = self._cache.get(key) if use_cache else None
         if entry is None:
-            step = build_step_fn(
-                program,
-                feed_names=list(feed_arrays.keys()),
-                fetch_names=fetch_names,
-                persist_names=persist_names,
-            )
-            entry = jax.jit(step, donate_argnums=(0,))
-            if use_program_cache:
+            if steps is None:
+                fn, persist_out = build_step_fn(
+                    program,
+                    feed_names=list(feed_arrays.keys()),
+                    fetch_names=fetch_names,
+                    persist_names=persist_names,
+                    persist_in=list(persist_in.keys()),
+                )
+            else:
+                fn, persist_out = build_multi_step_fn(
+                    program,
+                    feed_names=list(feed_arrays.keys()),
+                    fetch_names=fetch_names,
+                    persist_names=persist_names,
+                    steps=steps,
+                    persist_in=list(persist_in.keys()),
+                    scanned_feeds=scanned,
+                )
+            jit_kwargs = {}
+            if mesh is not None:
+                jit_kwargs = _mesh_jit_kwargs(
+                    mesh,
+                    program,
+                    feed_arrays,
+                    list(persist_in.keys()),
+                    persist_out,
+                    fetch_names,
+                    scanned_feeds=scanned,
+                )
+            entry = jax.jit(fn, donate_argnums=(0,), **jit_kwargs)
+            if use_cache:
                 self._cache[key] = entry
 
         self._run_counter += 1
@@ -219,12 +324,71 @@ def _flatten_lod(lod):
     return np.asarray(lod, np.int32)
 
 
+def _mesh_jit_kwargs(
+    mesh, program, feed_arrays, persist_in_keys, persist_out, fetch_names,
+    scanned_feeds=(),
+):
+    """Build in/out shardings for the step function under a mesh.
+
+    Feeds: batch dim over 'data' (replicated if not divisible or 0-d).
+    Persistables: program.shardings[name] if annotated (TP), else
+    replicated. Fetches: replicated (they are scalars/metrics in practice).
+    LoD offset side-bands are replicated.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import replicated
+
+    rep = replicated(mesh)
+    n_data = mesh.shape.get("data", 1)
+
+    def feed_shard(name, arr):
+        if name.endswith("@LOD0"):
+            return rep
+        # scanned feeds carry a leading [steps] dim; the batch is axis 1
+        batch_axis = 1 if name in scanned_feeds else 0
+        if (
+            arr.ndim > batch_axis
+            and arr.shape[batch_axis] > 0
+            and arr.shape[batch_axis] % n_data == 0
+        ):
+            spec = [None] * arr.ndim
+            spec[batch_axis] = "data"
+            return NamedSharding(mesh, PartitionSpec(*spec))
+        return rep
+
+    def persist_shard(name):
+        spec = program.shardings.get(name)
+        if spec is None:
+            return rep
+        return NamedSharding(mesh, spec)
+
+    in_shardings = (
+        {n: persist_shard(n) for n in persist_in_keys},
+        {n: feed_shard(n, a) for n, a in feed_arrays.items()},
+        rep,
+    )
+    out_shardings = (
+        [rep for _ in fetch_names],
+        {n: persist_shard(n) for n in persist_out},
+    )
+    return {"in_shardings": in_shardings, "out_shardings": out_shardings}
+
+
 _DTYPE_MAP = {"float64": "float32", "int64": "int32"}
 
 
-def _to_device_dtype(arr: np.ndarray, var: Optional[Variable]):
+def _to_device_dtype(arr, var: Optional[Variable]):
     """Feeds are normalised to TPU-friendly dtypes: f64->f32, i64->i32
-    (the TPU has no 64-bit compute path worth using)."""
+    (the TPU has no 64-bit compute path worth using). Device-resident
+    arrays of the right dtype pass through untouched — no host round-trip."""
+    if isinstance(arr, jax.Array):
+        want = None
+        if var is not None and var.dtype is not None:
+            want = _DTYPE_MAP.get(var.dtype, var.dtype)
+        if want is None or str(arr.dtype) == want:
+            return arr
+        return arr.astype(want)
     arr = np.asarray(arr)
     if var is not None and var.dtype is not None:
         want = _DTYPE_MAP.get(var.dtype, var.dtype)

@@ -1,0 +1,10 @@
+"""Reference model zoo, built on the fluid layer API.
+
+Parity targets: benchmark/paddle/image/{resnet,vgg,alexnet,googlenet}.py,
+the book tests' models (python/paddle/v2/fluid/tests/book/), and
+benchmark/cluster/vgg16/vgg16_fluid.py.
+"""
+
+from . import lenet, resnet, vgg, alexnet
+
+__all__ = ["lenet", "resnet", "vgg", "alexnet"]

@@ -1,0 +1,36 @@
+"""Distributed training over the device mesh.
+
+This module replaces four reference communication stacks with one
+XLA-collectives layer (SURVEY.md §2.3):
+  - MultiGradientMachine ring allreduce (MultiGradientMachine.h:61-84)
+  - NCCL ops (fluid/operators/nccl_op.cu.cc)
+  - C++ sync pserver (paddle/pserver/ParameterServer2.h)
+  - fluid gRPC send/recv + DistributeTranspiler
+
+Design: programs keep *global-batch* semantics. The executor jits the
+step with `in_shardings` — feeds split on the mesh 'data' axis, params
+placed per `program.shardings` (replicated by default; any PartitionSpec
+for tensor parallelism) — and XLA's SPMD partitioner inserts the psum /
+all-gather collectives over ICI. Gradients are therefore *exactly* the
+global-batch gradients, unlike the reference's per-worker average.
+"""
+
+from .mesh import (
+    DistributedContext,
+    data_sharding,
+    get_default_mesh,
+    make_mesh,
+    replicated,
+    set_default_mesh,
+    shard_parameter,
+)
+
+__all__ = [
+    "make_mesh",
+    "get_default_mesh",
+    "set_default_mesh",
+    "shard_parameter",
+    "data_sharding",
+    "replicated",
+    "DistributedContext",
+]

@@ -5,18 +5,23 @@ CI; we make ours single-process)."""
 
 import os
 
-# must happen before jax import
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS must be set before backend init
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 import pytest
 
 import jax
+
+# the ambient sitecustomize imports jax at interpreter boot with
+# JAX_PLATFORMS=axon latched; config.update re-selects cpu before the
+# (lazy) backend initialization happens
+jax.config.update("jax_platforms", "cpu")
 
 # kernels run at the platform's fast default precision (bf16 passes on the
 # TPU MXU); numeric comparison tests need full f32 accumulation

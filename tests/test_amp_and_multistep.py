@@ -1,0 +1,77 @@
+"""Mixed precision (program.amp) and multi-step scan execution."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def build_mlp_classifier(seed=11):
+    rng = np.random.RandomState(seed)
+    n, d, c = 256, 16, 3
+    x_data = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, c).astype(np.float32)
+    y_data = np.argmax(x_data @ w, axis=1).astype(np.int64)[:, None]
+
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=32, act="relu")
+    p = fluid.layers.fc(input=h, size=3, act="softmax")
+    loss = fluid.layers.mean(x=fluid.layers.cross_entropy(input=p, label=y))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    return x_data, y_data, loss
+
+
+def test_amp_training_converges():
+    x_data, y_data, loss = build_mlp_classifier()
+    prog = fluid.default_main_program()
+    prog.amp = True
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(60):
+        out = exe.run(feed={"x": x_data, "y": y_data}, fetch_list=[loss])
+        losses.append(float(out[0][0]))
+    assert losses[-1] < 0.25 * losses[0], losses[::10]
+    # master params stay f32
+    blk = prog.global_block()
+    for p in blk.all_parameters():
+        assert str(np.asarray(fluid.global_scope().get(p.name)).dtype) == "float32"
+
+
+def test_run_repeated_matches_sequential():
+    x_data, y_data, loss = build_mlp_classifier()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    seq_losses = [
+        float(exe.run(feed={"x": x_data, "y": y_data}, fetch_list=[loss])[0][0])
+        for _ in range(6)
+    ]
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        with fluid.scope_guard(fluid.Scope()):
+            x2, y2, loss2 = build_mlp_classifier()
+            exe2 = fluid.Executor()
+            exe2.run(fluid.default_startup_program())
+            stacked = exe2.run_repeated(
+                feed={"x": x2, "y": y2}, fetch_list=[loss2], steps=6
+            )
+    multi_losses = [float(v) for v in np.ravel(stacked[0])]
+    np.testing.assert_allclose(seq_losses, multi_losses, rtol=2e-4)
+
+
+def test_run_repeated_scan_feeds():
+    """Per-step batches via a leading [steps] dim."""
+    x_data, y_data, loss = build_mlp_classifier()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    steps = 4
+    xs = np.stack([x_data[i::steps][:64] for i in range(steps)])  # [4,64,16]
+    ys = np.stack([y_data[i::steps][:64] for i in range(steps)])
+    out = exe.run_repeated(
+        feed={"x": xs, "y": ys}, fetch_list=[loss], steps=steps, scan_feeds=True
+    )
+    vals = np.ravel(out[0])
+    assert vals.shape[0] == steps
+    assert np.isfinite(vals).all()

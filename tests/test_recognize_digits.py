@@ -1,0 +1,49 @@
+"""MNIST-style conv + MLP convergence (reference book test
+test_recognize_digits.py) on synthetic separable digit data."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import lenet
+
+
+def synth_digits(n=512, seed=0):
+    """10 random prototype images + noise — linearly separable."""
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(10, 1, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, n)
+    imgs = protos[labels] + 0.15 * rng.randn(n, 1, 28, 28).astype(np.float32)
+    return imgs.astype(np.float32), labels.astype(np.int64)[:, None]
+
+
+@pytest.mark.parametrize("net", ["mlp", "conv"])
+def test_recognize_digits(net):
+    imgs, labels = synth_digits()
+
+    image = fluid.layers.data(name="image", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    if net == "mlp":
+        predict = lenet.mlp(image)
+    else:
+        predict = lenet.lenet(image)
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=predict, label=label)
+
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    bs = 64
+    acc_val = 0.0
+    for epoch in range(4 if net == "mlp" else 12):
+        for i in range(0, len(imgs), bs):
+            loss_val, acc_val = exe.run(
+                feed={"image": imgs[i : i + bs], "label": labels[i : i + bs]},
+                fetch_list=[avg_cost, acc],
+            )
+    assert float(acc_val[0]) > 0.9, "final batch acc %s" % acc_val
+    assert np.isfinite(loss_val).all()
