@@ -23,7 +23,8 @@ BASELINE_IMG_PER_SEC = 1500.0
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "1"))
+    reps = int(os.environ.get("BENCH_REPS", "2"))
 
     # standard TPU mixed precision: f32 state, single-pass bf16 on the MXU
     os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "bfloat16")
@@ -60,10 +61,11 @@ def main():
     # multi-step execution: `steps` train iterations inside one compiled
     # computation (host and data transfers out of the loop). The first
     # call compiles; timed calls replay the cached executable.
-    out = exe.run_repeated(main_prog, feed=feed, fetch_list=[avg_cost], steps=steps)
-    assert np.isfinite(out[0]).all(), "non-finite loss in warmup: %r" % out[0]
+    for _ in range(max(1, warmup)):
+        out = exe.run_repeated(main_prog, feed=feed, fetch_list=[avg_cost], steps=steps)
+        assert np.isfinite(out[0]).all(), "non-finite loss in warmup: %r" % out[0]
 
-    reps = max(1, warmup)
+    reps = max(1, reps)
     t0 = time.time()
     for _ in range(reps):
         out = exe.run_repeated(main_prog, feed=feed, fetch_list=[avg_cost], steps=steps)

@@ -1,0 +1,253 @@
+"""Recurrent-network kernels: dynamic LSTM/GRU over ragged batches.
+
+The reference implements dynamic RNNs by re-ordering a ragged (LoD) batch
+into per-timestep dense slices on the fly (gserver/layers/SequenceToBatch.cpp,
+fluid operators/math/sequence2batch.*, lstm via operators/math/lstm_compute)
+and looping timesteps on the host. TPU-first re-design: the packed batch is
+gathered once into a padded ``[batch, T_bucket, ...]`` block (T_bucket is a
+static power-of-two bucket of the true max length, chosen by the Executor at
+feed time so XLA compiles once per bucket, not per batch), the recurrence is
+a single ``lax.scan`` over time-major data — each step is one dense GEMM on
+the MXU over the whole batch — and the result is scattered back to packed
+layout. Finished sequences carry their state forward unchanged under a mask,
+which reproduces the reference's "shrinking active batch" semantics without
+dynamic shapes.
+
+Parity targets: operators/lstm_op.{cc,h}, operators/gru_op.{cc,h},
+operators/lstm_unit_op, operators/gru_unit_op, operators/sequence_conv_op,
+gserver/layers/LstmLayer.cpp, GruLayer.cpp, SequenceConvLayer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from .kernels_sequence import lod_key, seg_ids, seg_lengths
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACTS[name]
+
+
+def _seq_T(ctx, total):
+    """Static time extent for padded RNN compute: the Executor's bucketed
+    max sequence length when available, else the packed total (correct for
+    any batch, just wasteful — only hit on direct build_step_fn uses)."""
+    T = getattr(ctx, "seq_maxlen", None)
+    return int(T) if T else int(total)
+
+
+def packed_to_padded(x, offsets, T, reverse=False):
+    """[total, ...] packed -> ([n, T, ...] padded, [n, T] bool mask).
+
+    With reverse=True each sequence is time-flipped into the padded block
+    (so a forward scan implements the reference's is_reverse=True)."""
+    lens = seg_lengths(offsets)  # [n]
+    t = jnp.arange(T, dtype=offsets.dtype)
+    if reverse:
+        rel = lens[:, None] - 1 - t[None, :]
+    else:
+        rel = jnp.broadcast_to(t[None, :], (lens.shape[0], T))
+    mask = (t[None, :] < lens[:, None]) if not reverse else (rel >= 0)
+    idx = offsets[:-1, None] + jnp.clip(rel, 0, None)
+    idx = jnp.clip(idx, 0, x.shape[0] - 1)
+    return x[idx], mask
+
+
+def padded_to_packed(h, offsets, total, reverse=False):
+    """[n, T, ...] padded -> [total, ...] packed (inverse of the above)."""
+    s = seg_ids(offsets, total)  # [total]
+    t = jnp.arange(total, dtype=offsets.dtype) - offsets[s]
+    if reverse:
+        t = seg_lengths(offsets)[s] - 1 - t
+    return h[s, jnp.clip(t, 0, h.shape[1] - 1)]
+
+
+# ---------------------------------------------------------------------------
+# dynamic_lstm — operators/lstm_op.h LSTMKernel; gate layout [i, f, c̃, o]
+# ---------------------------------------------------------------------------
+
+
+@register_op("lstm")
+def _lstm(ctx, ins, attrs):
+    x = ins["Input"][0]           # [total, 4H] (pre-projected by the fc)
+    w = ins["Weight"][0]          # [H, 4H] recurrent weight
+    bias = ins["Bias"][0] if ins.get("Bias") else None  # [1, 4H] or [1, 7H]
+    offsets = ctx.env[lod_key(ctx.op.inputs["Input"][0])]
+    n = offsets.shape[0] - 1
+    H = w.shape[0]
+    total = x.shape[0]
+    reverse = bool(attrs.get("is_reverse", False))
+    peephole = bool(attrs.get("use_peepholes", True))
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+
+    if bias is not None:
+        x = x + bias[:, : 4 * H]
+    if peephole and bias is not None:
+        w_ic = bias[0, 4 * H : 5 * H]
+        w_fc = bias[0, 5 * H : 6 * H]
+        w_oc = bias[0, 6 * H : 7 * H]
+    else:
+        w_ic = w_fc = w_oc = None
+
+    T = _seq_T(ctx, total)
+    xp, mask = packed_to_padded(x, offsets, T, reverse=reverse)  # [n,T,4H]
+    xp = jnp.swapaxes(xp, 0, 1)          # [T, n, 4H] time-major
+    mask_t = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)  # [T,n,1]
+
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((n, H), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((n, H), x.dtype)
+
+    def step(carry, xm):
+        h, c = carry
+        xt, m = xm
+        g = xt + h @ w                              # [n, 4H] — MXU GEMM
+        gi, gf, gc, go = jnp.split(g, 4, axis=1)
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        h_new = m * h_new + (1 - m) * h
+        c_new = m * c_new + (1 - m) * c
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), (xp, mask_t))
+    hs = jnp.swapaxes(hs, 0, 1)  # [n, T, H]
+    cs = jnp.swapaxes(cs, 0, 1)
+    hidden = padded_to_packed(hs, offsets, total, reverse=reverse)
+    cell = padded_to_packed(cs, offsets, total, reverse=reverse)
+    return {"Hidden": hidden, "Cell": cell}
+
+
+# ---------------------------------------------------------------------------
+# dynamic_gru — operators/gru_op.h; weight [H, 3H]: [:, :2H]=update|reset,
+# [:, 2H:]=candidate. h' = (1-u)*h + u*c̃ (reference gru_compute convention,
+# operators/math/detail/gru_kernel.h:62, gru_unit_op.cc:122).
+# ---------------------------------------------------------------------------
+
+
+@register_op("gru")
+def _gru(ctx, ins, attrs):
+    x = ins["Input"][0]            # [total, 3H]
+    w = ins["Weight"][0]           # [H, 3H]
+    bias = ins["Bias"][0] if ins.get("Bias") else None  # [1, 3H]
+    offsets = ctx.env[lod_key(ctx.op.inputs["Input"][0])]
+    n = offsets.shape[0] - 1
+    H = w.shape[0]
+    total = x.shape[0]
+    reverse = bool(attrs.get("is_reverse", False))
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+
+    if bias is not None:
+        x = x + bias
+    w_ur = w[:, : 2 * H]   # update|reset
+    w_c = w[:, 2 * H :]    # candidate
+
+    T = _seq_T(ctx, total)
+    xp, mask = packed_to_padded(x, offsets, T, reverse=reverse)
+    xp = jnp.swapaxes(xp, 0, 1)
+    mask_t = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((n, H), x.dtype)
+
+    def step(h, xm):
+        xt, m = xm
+        xu, xr, xc = jnp.split(xt, 3, axis=1)
+        ur = gate_act(jnp.concatenate([xu, xr], 1) + h @ w_ur)
+        u, r = jnp.split(ur, 2, axis=1)
+        c = cand_act(xc + (r * h) @ w_c)
+        h_new = (1.0 - u) * h + u * c
+        h_new = m * h_new + (1 - m) * h
+        return h_new, h_new
+
+    _, hs = lax.scan(step, h0, (xp, mask_t))
+    hs = jnp.swapaxes(hs, 0, 1)
+    hidden = padded_to_packed(hs, offsets, total, reverse=reverse)
+    return {"Hidden": hidden}
+
+
+# ---------------------------------------------------------------------------
+# single-step cells (operators/lstm_unit_op.cc, gru_unit_op.cc) — dense,
+# used by DynamicRNN-style user loops
+# ---------------------------------------------------------------------------
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    x = ins["X"][0]          # [n, 4H] pre-activations
+    c_prev = ins["C_prev"][0]
+    forget_bias = float(attrs.get("forget_bias", 0.0))
+    H = c_prev.shape[-1]
+    gi, gf, gc, go = jnp.split(x, 4, axis=1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    x = ins["Input"][0]              # [n, 3H]
+    h_prev = ins["HiddenPrev"][0]    # [n, H]
+    w = ins["Weight"][0]             # [H, 3H]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    H = h_prev.shape[-1]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    if bias is not None:
+        x = x + bias
+    xu, xr, xc = jnp.split(x, 3, axis=1)
+    ur = gate_act(jnp.concatenate([xu, xr], 1) + h_prev @ w[:, : 2 * H])
+    u, r = jnp.split(ur, 2, axis=1)
+    reset_h = r * h_prev
+    c = cand_act(xc + reset_h @ w[:, 2 * H :])
+    h = (1.0 - u) * h_prev + u * c
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return {"Gate": gate, "ResetHiddenPrev": reset_h, "Hidden": h}
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv — operators/sequence_conv_op; context window gather + GEMM
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    x = ins["X"][0]              # [total, D]
+    filt = ins["Filter"][0]      # [context_length * D, M]
+    offsets = ctx.env[lod_key(ctx.op.inputs["X"][0])]
+    total, D = x.shape
+    cl = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    cs = int(attrs.get("contextStart", attrs.get("context_start", -(cl // 2))))
+
+    # context window per packed row, zero beyond sequence bounds
+    s = seg_ids(offsets, total)                          # [total]
+    pos = jnp.arange(total, dtype=offsets.dtype)
+    cols = []
+    for j in range(cl):
+        src = pos + cs + j
+        valid = (src >= offsets[s]) & (src < offsets[s + 1])
+        src_c = jnp.clip(src, 0, total - 1)
+        cols.append(jnp.where(valid[:, None], x[src_c], 0.0))
+    ctxmat = jnp.concatenate(cols, axis=1)               # [total, cl*D]
+    return {"Out": ctxmat @ filt}

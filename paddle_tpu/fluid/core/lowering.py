@@ -156,10 +156,14 @@ def lower_block(
     env: Dict[str, Any],
     base_key=None,
     is_test: bool = False,
+    seq_maxlen=None,
 ) -> Dict[str, Any]:
     """Symbolically execute a whole block (including an autodiff marker if
     present) over `env` and return the final environment."""
-    return _lower_ops(block, block.ops, env, base_key=base_key, is_test=is_test)
+    return _lower_ops(
+        block, block.ops, env, base_key=base_key, is_test=is_test,
+        seq_maxlen=seq_maxlen,
+    )
 
 
 def _lower_ops(
@@ -168,8 +172,9 @@ def _lower_ops(
     env: Dict[str, Any],
     base_key=None,
     is_test: bool = False,
+    seq_maxlen=None,
 ) -> Dict[str, Any]:
-    ctx = LoweringContext(block, base_key, is_test=is_test)
+    ctx = LoweringContext(block, base_key, is_test=is_test, seq_maxlen=seq_maxlen)
     fwd_ops, ad_op, tail_ops = _split_at_autodiff(ops)
 
     if ad_op is None:
@@ -239,6 +244,7 @@ def build_step_fn(
     persist_names: Sequence[str],
     is_test: bool = False,
     persist_in: Optional[Sequence[str]] = None,
+    seq_maxlen: Optional[int] = None,
 ):
     """Build the pure step function over (persistables, feeds, rng-key).
 
@@ -265,7 +271,10 @@ def build_step_fn(
         env: Dict[str, Any] = {}
         env.update(persist)
         env.update(feeds)
-        env = _lower_ops(block, pruned_ops, env, base_key=key, is_test=is_test)
+        env = _lower_ops(
+            block, pruned_ops, env, base_key=key, is_test=is_test,
+            seq_maxlen=seq_maxlen,
+        )
         fetches = [env[n] for n in fetch_names]
         new_persist = {}
         for n in persist_out:
@@ -290,6 +299,7 @@ def build_multi_step_fn(
     is_test: bool = False,
     persist_in: Optional[Sequence[str]] = None,
     scanned_feeds: Optional[Sequence[str]] = None,
+    seq_maxlen: Optional[int] = None,
 ):
     """K training steps inside ONE compiled computation via lax.scan.
 
@@ -309,6 +319,7 @@ def build_multi_step_fn(
         persist_names,
         is_test=is_test,
         persist_in=persist_in,
+        seq_maxlen=seq_maxlen,
     )
     if set(persist_out) != set(persist_in or []):
         raise ValueError(

@@ -62,11 +62,14 @@ class LoweringContext(object):
     replay under jax.vjp.
     """
 
-    def __init__(self, block, base_key, is_test: bool = False):
+    def __init__(self, block, base_key, is_test: bool = False, seq_maxlen=None):
         self.block = block
         self._base_key = base_key
         self._rng_counter = 0
         self.is_test = is_test
+        # static bucketed max sequence length for this trace (set by the
+        # Executor from the fed LoD offsets); RNN kernels pad to this
+        self.seq_maxlen = seq_maxlen
         # set per-op by lowering.run_op; lets sequence kernels reach LoD
         # side-band entries without polluting every kernel signature
         self.op = None

@@ -222,6 +222,10 @@ class Executor(object):
         feed_sig = tuple(
             (n, tuple(a.shape), str(a.dtype)) for n, a in sorted(feed_arrays.items())
         )
+        # static time extent for RNN padding: bucket the batch's true max
+        # sequence length to a power of two so recompiles happen per bucket,
+        # not per batch composition (kernels_rnn.py docstring)
+        seq_maxlen = _lod_bucket(feed_arrays)
         persist_in = {n: scope.get(n) for n in persist_names if n in scope}
         mesh = self._resolve_mesh()
         if mesh is not None:
@@ -252,6 +256,7 @@ class Executor(object):
             steps,
             scan_feeds,
             shard_fp,
+            seq_maxlen,
         ) + ((id(mesh),) if mesh is not None else ())
         entry = self._cache.get(key) if use_cache else None
         if entry is None:
@@ -262,6 +267,7 @@ class Executor(object):
                     fetch_names=fetch_names,
                     persist_names=persist_names,
                     persist_in=list(persist_in.keys()),
+                    seq_maxlen=seq_maxlen,
                 )
             else:
                 fn, persist_out = build_multi_step_fn(
@@ -272,6 +278,7 @@ class Executor(object):
                     steps=steps,
                     persist_in=list(persist_in.keys()),
                     scanned_feeds=scanned,
+                    seq_maxlen=seq_maxlen,
                 )
             jit_kwargs = {}
             if mesh is not None:
@@ -302,6 +309,23 @@ class Executor(object):
     # convenience used by inference/serving paths ----------------------
     def close(self):
         self._cache.clear()
+
+
+def _lod_bucket(feed_arrays):
+    """Max sequence length over all fed LoD offset vectors, rounded up to
+    the next power of two (min 8). None when nothing ragged is fed."""
+    m = 0
+    for n, a in feed_arrays.items():
+        if n.endswith(LOD_SUFFIX):
+            d = np.diff(np.asarray(a))
+            if d.size:
+                m = max(m, int(d.max()))
+    if m == 0:
+        return None
+    b = 8
+    while b < m:
+        b *= 2
+    return b
 
 
 def _split_lod_feed(value):
@@ -343,7 +367,7 @@ def _mesh_jit_kwargs(
     n_data = mesh.shape.get("data", 1)
 
     def feed_shard(name, arr):
-        if name.endswith("@LOD0"):
+        if name.endswith(LOD_SUFFIX):
             return rep
         # scanned feeds carry a leading [steps] dim; the batch is axis 1
         batch_axis = 1 if name in scanned_feeds else 0

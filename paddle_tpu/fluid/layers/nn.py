@@ -16,6 +16,7 @@ from ..core.program import Variable
 from ..initializer import Constant, Normal, Xavier
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
+from . import tensor as tensor_layers
 
 __all__ = [
     "fc",
@@ -49,6 +50,10 @@ __all__ = [
     "cos_sim",
     "dropout",
     "one_hot",
+    "dynamic_lstm",
+    "dynamic_gru",
+    "lstm_unit",
+    "gru_unit",
     "sequence_conv",
     "sequence_pool",
     "sequence_first_step",
@@ -697,15 +702,190 @@ def one_hot(input, depth, **kwargs):
 
 # --- sequence layers ----------------------------------------------------
 
+def dynamic_lstm(
+    input,
+    size,
+    param_attr=None,
+    bias_attr=None,
+    use_peepholes=True,
+    is_reverse=False,
+    gate_activation="sigmoid",
+    cell_activation="tanh",
+    candidate_activation="tanh",
+    dtype="float32",
+    name=None,
+    **kwargs,
+):
+    """LSTM over a ragged batch (reference nn.py:252 dynamic_lstm,
+    operators/lstm_op). `size` is 4*hidden (paddle convention); `input`
+    must already be the 4H-wide projection (an fc ahead of this layer).
+    Returns (hidden, cell), both LoD-shaped like the input."""
+    helper = LayerHelper("dynamic_lstm", name=name, **kwargs)
+    hidden_size = size // 4
+    weight = helper.create_parameter(
+        attr=ParamAttr.to_attr(param_attr),
+        shape=[hidden_size, 4 * hidden_size],
+        dtype=dtype,
+    )
+    bias_size = [1, 7 * hidden_size] if use_peepholes else [1, 4 * hidden_size]
+    bias = helper.create_parameter(
+        attr=ParamAttr.to_attr(bias_attr),
+        shape=bias_size,
+        dtype=dtype,
+        is_bias=True,
+    )
+    hidden = helper.create_tmp_variable(dtype, shape=(-1, hidden_size), lod_level=1)
+    cell = helper.create_tmp_variable(dtype, shape=(-1, hidden_size), lod_level=1)
+    helper.append_op(
+        type="lstm",
+        inputs={"Input": [input], "Weight": [weight], "Bias": [bias]},
+        outputs={"Hidden": [hidden], "Cell": [cell]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    return hidden, cell
+
+
+def dynamic_gru(
+    input,
+    size,
+    param_attr=None,
+    bias_attr=None,
+    is_reverse=False,
+    gate_activation="sigmoid",
+    candidate_activation="tanh",
+    h_0=None,
+    dtype="float32",
+    **kwargs,
+):
+    """GRU over a ragged batch (reference nn.py dynamic_gru, operators/
+    gru_op). `size` is the hidden width; `input` must be the 3H-wide
+    projection. Returns the LoD-shaped hidden sequence."""
+    helper = LayerHelper("dynamic_gru", **kwargs)
+    weight = helper.create_parameter(
+        attr=ParamAttr.to_attr(param_attr), shape=[size, 3 * size], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=ParamAttr.to_attr(bias_attr), shape=[1, 3 * size], dtype=dtype,
+        is_bias=True,
+    )
+    hidden = helper.create_tmp_variable(dtype, shape=(-1, size), lod_level=1)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(
+        type="gru",
+        inputs=inputs,
+        outputs={"Hidden": [hidden]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    return hidden
+
+
+def lstm_unit(
+    x_t,
+    hidden_t_prev,
+    cell_t_prev,
+    forget_bias=0.0,
+    param_attr=None,
+    bias_attr=None,
+    **kwargs,
+):
+    """One dense LSTM step (reference nn.py lstm_unit:2194): fc over
+    [x_t, h_prev] to 4H gates, then the cell update. Returns (h, c)."""
+    helper = LayerHelper("lstm_unit", **kwargs)
+    size = cell_t_prev.shape[-1]
+    concat_out = tensor_layers.concat(input=[x_t, hidden_t_prev], axis=1)
+    fc_out = fc(
+        input=concat_out, size=4 * size, param_attr=param_attr,
+        bias_attr=bias_attr,
+    )
+    dtype = x_t.dtype
+    c = helper.create_tmp_variable(dtype, shape=(-1, size))
+    h = helper.create_tmp_variable(dtype, shape=(-1, size))
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [fc_out], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": forget_bias},
+    )
+    return h, c
+
+
+def gru_unit(
+    input,
+    hidden,
+    size,
+    param_attr=None,
+    bias_attr=None,
+    activation="tanh",
+    gate_activation="sigmoid",
+    **kwargs,
+):
+    """One dense GRU step (reference nn.py gru_unit). `size` is 3*hidden
+    (paddle convention). Returns (hidden, reset_hidden_prev, gate)."""
+    helper = LayerHelper("gru_unit", **kwargs)
+    dtype = input.dtype
+    H = size // 3
+    weight = helper.create_parameter(
+        attr=ParamAttr.to_attr(param_attr), shape=[H, 3 * H], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=ParamAttr.to_attr(bias_attr), shape=[1, 3 * H], dtype=dtype,
+        is_bias=True,
+    )
+    gate = helper.create_tmp_variable(dtype, shape=(-1, 3 * H))
+    reset_hidden_prev = helper.create_tmp_variable(dtype, shape=(-1, H))
+    updated_hidden = helper.create_tmp_variable(dtype, shape=(-1, H))
+    helper.append_op(
+        type="gru_unit",
+        inputs={"Input": [input], "HiddenPrev": [hidden], "Weight": [weight],
+                "Bias": [bias]},
+        outputs={"Gate": [gate], "ResetHiddenPrev": [reset_hidden_prev],
+                 "Hidden": [updated_hidden]},
+        attrs={"activation": activation, "gate_activation": gate_activation},
+    )
+    return updated_hidden, reset_hidden_prev, gate
+
+
 def sequence_conv(
     input, num_filters, filter_size=3, filter_stride=1, padding=None,
     bias_attr=None, param_attr=None, act=None, **kwargs
 ):
-    """Context-window conv over a packed ragged batch (reference nn.py:1095).
-    Lowered as im2col-over-sequence + mul once the RNN milestone lands; the
-    present form handles the common filter_stride=1 case via row_conv-style
-    shifts inside one dense GEMM."""
-    raise NotImplementedError("sequence_conv lands with the RNN milestone")
+    """Context-window conv over a packed ragged batch (reference nn.py:1095,
+    operators/sequence_conv_op): each token's window of `filter_size`
+    neighbours is gathered (zero beyond sequence bounds) and hit with one
+    GEMM."""
+    helper = LayerHelper("sequence_conv", **kwargs)
+    dtype = input.dtype
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(
+        attr=ParamAttr.to_attr(param_attr), shape=filter_shape, dtype=dtype
+    )
+    pre_bias = helper.create_tmp_variable(dtype, shape=(-1, num_filters), lod_level=1)
+    helper.append_op(
+        type="sequence_conv",
+        inputs={"X": [input], "Filter": [filter_param]},
+        outputs={"Out": [pre_bias]},
+        attrs={
+            "contextStride": filter_stride,
+            "contextStart": -int(filter_size // 2),
+            "contextLength": filter_size,
+        },
+    )
+    helper.kwargs["bias_attr"] = bias_attr
+    helper.kwargs["act"] = act
+    pre_act = helper.append_bias_op(pre_bias)
+    return helper.append_activation(pre_act)
 
 
 def sequence_pool(input, pool_type, **kwargs):

@@ -1,0 +1,209 @@
+"""Numeric checks for the dynamic RNN kernels against numpy references.
+
+Mirrors the reference's OpTest pattern (python/paddle/v2/fluid/tests/
+test_lstm_op.py, test_gru_op.py, test_seq_conv.py): run the op through the
+framework, recompute with plain numpy on the host, compare.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _make_ragged(rng, lens, width):
+    total = sum(lens)
+    data = rng.randn(total, width).astype(np.float32)
+    lod = np.cumsum([0] + list(lens)).astype(np.int32)
+    return data, lod
+
+
+def np_lstm(x, lod, w, b, peephole, reverse=False):
+    """Gate order [i, f, c~, o]; bias layout [4H | w_ic w_fc w_oc]."""
+    H = w.shape[0]
+    hidden = np.zeros((x.shape[0], H), np.float32)
+    cell = np.zeros((x.shape[0], H), np.float32)
+    for s in range(len(lod) - 1):
+        lo, hi = lod[s], lod[s + 1]
+        idx = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
+        h = np.zeros(H, np.float32)
+        c = np.zeros(H, np.float32)
+        for t in idx:
+            g = x[t] + b[0, : 4 * H] + h @ w
+            gi, gf, gc, go = np.split(g, 4)
+            if peephole:
+                gi = gi + c * b[0, 4 * H : 5 * H]
+                gf = gf + c * b[0, 5 * H : 6 * H]
+            i, f = _sigmoid(gi), _sigmoid(gf)
+            c = f * c + i * np.tanh(gc)
+            if peephole:
+                go = go + c * b[0, 6 * H : 7 * H]
+            h = _sigmoid(go) * np.tanh(c)
+            hidden[t], cell[t] = h, c
+    return hidden, cell
+
+
+@pytest.mark.parametrize("peephole,reverse", [(False, False), (True, False), (False, True)])
+def test_dynamic_lstm_matches_numpy(peephole, reverse):
+    rng = np.random.RandomState(7)
+    H = 6
+    lens = [3, 1, 5, 2]
+    x_np, lod = _make_ragged(rng, lens, 4 * H)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4 * H], dtype="float32", lod_level=1)
+        hidden, cell = fluid.layers.dynamic_lstm(
+            input=x, size=4 * H, use_peepholes=peephole, is_reverse=reverse
+        )
+    params = main.global_block().all_parameters()
+    w_name = [p.name for p in params if p.shape == (H, 4 * H)][0]
+    b_name = [p.name for p in params if p.name != w_name][0]
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        w = rng.randn(H, 4 * H).astype(np.float32) * 0.3
+        b = rng.randn(1, 7 * H if peephole else 4 * H).astype(np.float32) * 0.3
+        scope.set(w_name, w)
+        scope.set(b_name, b)
+        out_h, out_c = exe.run(
+            main, feed={"x": (x_np, [lod])}, fetch_list=[hidden, cell]
+        )
+
+    ref_h, ref_c = np_lstm(x_np, lod, w, b, peephole, reverse)
+    np.testing.assert_allclose(out_h, ref_h, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out_c, ref_c, rtol=2e-4, atol=2e-4)
+
+
+def np_gru(x, lod, w, b, reverse=False):
+    H = w.shape[0]
+    hidden = np.zeros((x.shape[0], H), np.float32)
+    for s in range(len(lod) - 1):
+        lo, hi = lod[s], lod[s + 1]
+        idx = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
+        h = np.zeros(H, np.float32)
+        for t in idx:
+            g = x[t] + b[0]
+            xu, xr, xc = np.split(g, 3)
+            ur = _sigmoid(np.concatenate([xu, xr]) + h @ w[:, : 2 * H])
+            u, r = np.split(ur, 2)
+            c = np.tanh(xc + (r * h) @ w[:, 2 * H :])
+            h = (1.0 - u) * h + u * c
+            hidden[t] = h
+    return hidden
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_dynamic_gru_matches_numpy(reverse):
+    rng = np.random.RandomState(3)
+    H = 5
+    lens = [2, 4, 1]
+    x_np, lod = _make_ragged(rng, lens, 3 * H)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3 * H], dtype="float32", lod_level=1)
+        hidden = fluid.layers.dynamic_gru(input=x, size=H, is_reverse=reverse)
+    params = main.global_block().all_parameters()
+    w_name = [p.name for p in params if p.shape == (H, 3 * H)][0]
+    b_name = [p.name for p in params if p.shape == (1, 3 * H)][0]
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        w = rng.randn(H, 3 * H).astype(np.float32) * 0.3
+        b = rng.randn(1, 3 * H).astype(np.float32) * 0.3
+        scope.set(w_name, w)
+        scope.set(b_name, b)
+        (out_h,) = exe.run(main, feed={"x": (x_np, [lod])}, fetch_list=[hidden])
+
+    ref_h = np_gru(x_np, lod, w, b, reverse)
+    np.testing.assert_allclose(out_h, ref_h, rtol=2e-4, atol=2e-4)
+
+
+def test_sequence_conv_matches_numpy():
+    rng = np.random.RandomState(11)
+    D, M, cl = 4, 7, 3
+    lens = [3, 5, 1]
+    x_np, lod = _make_ragged(rng, lens, D)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_conv(
+            input=x, num_filters=M, filter_size=cl, bias_attr=False
+        )
+    params = main.global_block().all_parameters()
+    f_name = params[0].name
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        filt = rng.randn(cl * D, M).astype(np.float32)
+        scope.set(f_name, filt)
+        (got,) = exe.run(main, feed={"x": (x_np, [lod])}, fetch_list=[out])
+
+    cs = -(cl // 2)
+    ref = np.zeros((x_np.shape[0], M), np.float32)
+    for s in range(len(lod) - 1):
+        lo, hi = lod[s], lod[s + 1]
+        for t in range(lo, hi):
+            ctx_rows = []
+            for j in range(cl):
+                src = t + cs + j
+                ctx_rows.append(x_np[src] if lo <= src < hi else np.zeros(D, np.float32))
+            ref[t] = np.concatenate(ctx_rows) @ filt
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_lstm_gradients_flow():
+    """Train a tiny ragged LSTM classifier a few steps; loss must drop
+    (grad correctness smoke via actual optimisation)."""
+    rng = np.random.RandomState(0)
+    H, V, classes = 8, 30, 2
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64", lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(input=words, size=[V, H])
+        proj = fluid.layers.fc(input=emb, size=4 * H)
+        h, c = fluid.layers.dynamic_lstm(input=proj, size=4 * H, use_peepholes=False)
+        pooled = fluid.layers.sequence_pool(input=h, pool_type="max")
+        logits = fluid.layers.fc(input=pooled, size=classes, act="softmax")
+        cost = fluid.layers.cross_entropy(input=logits, label=label)
+        avg = fluid.layers.mean(x=cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg)
+
+    def batch():
+        lens = rng.randint(1, 8, size=8)
+        lod = np.cumsum([0] + list(lens)).astype(np.int32)
+        labels = rng.randint(0, classes, (8, 1)).astype(np.int64)
+        toks = []
+        for l, lab in zip(lens, labels[:, 0]):
+            lo = 0 if lab == 0 else V // 2
+            toks.append(rng.randint(lo, lo + V // 2, (l, 1)))
+        return np.concatenate(toks).astype(np.int64), lod, labels
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            toks, lod, labels = batch()
+            (loss,) = exe.run(
+                main,
+                feed={"words": (toks, [lod]), "label": labels},
+                fetch_list=[avg],
+            )
+            losses.append(float(np.ravel(loss)[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, losses

@@ -1,0 +1,114 @@
+"""Book test: sentiment classification over ragged token sequences.
+
+Parity with reference python/paddle/v2/fluid/tests/book/
+test_understand_sentiment_conv.py and ..._dynamic_lstm.py (SURVEY.md §4.3:
+the book tests are the capability acceptance suite). The imdb dataset is
+replaced by a synthetic separable corpus so the test is hermetic; the model
+topologies are the book's: conv = double sequence_conv+pool towers,
+stacked_lstm = fc+lstm stack with max-pool heads.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+VOCAB = 120
+CLASSES = 2
+BATCH = 16
+
+
+def synthetic_imdb(rng):
+    """Label-separable ragged batch: class-1 sequences draw from the upper
+    half of the vocab, class-0 from the lower half."""
+    lens = rng.randint(3, 20, size=BATCH)
+    labels = rng.randint(0, CLASSES, (BATCH, 1)).astype(np.int64)
+    toks = []
+    for l, lab in zip(lens, labels[:, 0]):
+        lo = 2 if lab == 0 else VOCAB // 2
+        toks.append(rng.randint(lo, lo + VOCAB // 2 - 2, (l, 1)))
+    lod = np.cumsum([0] + list(lens)).astype(np.int32)
+    return np.concatenate(toks).astype(np.int64), lod, labels
+
+
+def convolution_net(data, label, input_dim, class_dim=2, emb_dim=32, hid_dim=32):
+    """reference book: nets.sequence_conv_pool twin towers."""
+    emb = fluid.layers.embedding(input=data, size=[input_dim, emb_dim])
+    conv_3 = fluid.nets.sequence_conv_pool(
+        input=emb, num_filters=hid_dim, filter_size=3, act="tanh", pool_type="sqrt"
+    )
+    conv_4 = fluid.nets.sequence_conv_pool(
+        input=emb, num_filters=hid_dim, filter_size=4, act="tanh", pool_type="sqrt"
+    )
+    prediction = fluid.layers.fc(
+        input=[conv_3, conv_4], size=class_dim, act="softmax"
+    )
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc, prediction
+
+
+def stacked_lstm_net(
+    data, label, input_dim, class_dim=2, emb_dim=32, hid_dim=32, stacked_num=3
+):
+    """reference book test_understand_sentiment_dynamic_lstm.stacked_lstm_net."""
+    emb = fluid.layers.embedding(input=data, size=[input_dim, emb_dim])
+    fc1 = fluid.layers.fc(input=emb, size=hid_dim)
+    lstm1, cell1 = fluid.layers.dynamic_lstm(input=fc1, size=hid_dim)
+
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fluid.layers.fc(input=inputs, size=hid_dim)
+        lstm, cell = fluid.layers.dynamic_lstm(
+            input=fc, size=hid_dim, is_reverse=(i % 2) == 0
+        )
+        inputs = [fc, lstm]
+
+    fc_last = fluid.layers.sequence_pool(input=inputs[0], pool_type="max")
+    lstm_last = fluid.layers.sequence_pool(input=inputs[1], pool_type="max")
+    prediction = fluid.layers.fc(
+        input=[fc_last, lstm_last], size=class_dim, act="softmax"
+    )
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc, prediction
+
+
+def _train(net_fn, steps=40, lr=0.002, **net_kwargs):
+    rng = np.random.RandomState(5)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name="words", shape=[1], dtype="int64", lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        avg_cost, acc, _ = net_fn(data, label, input_dim=VOCAB, **net_kwargs)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses, accs = [], []
+        for _ in range(steps):
+            toks, lod, labels = synthetic_imdb(rng)
+            loss, a = exe.run(
+                main,
+                feed={"words": (toks, [lod]), "label": labels},
+                fetch_list=[avg_cost, acc],
+            )
+            losses.append(float(np.ravel(loss)[0]))
+            accs.append(float(np.ravel(a)[0]))
+    return losses, accs
+
+
+def test_understand_sentiment_conv():
+    losses, accs = _train(convolution_net)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses
+    assert np.mean(accs[-5:]) > 0.8, accs
+
+
+def test_understand_sentiment_stacked_lstm():
+    losses, accs = _train(stacked_lstm_net, stacked_num=3)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses
+    assert np.mean(accs[-5:]) > 0.8, accs
