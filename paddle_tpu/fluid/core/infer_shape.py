@@ -360,3 +360,48 @@ def _gather(op, block):
 @register_infer("autodiff")
 def _autodiff(op, block):
     pass  # grad var shapes were set by append_backward
+
+
+def _compare_rule(op, block):
+    x = _shape(block, op.inputs["X"][0])
+    _set(block, op.outputs["Out"][0], x, "bool")
+
+
+for _t in ("less_than", "less_equal", "greater_than", "greater_equal",
+           "equal", "not_equal"):
+    register_infer(_t)(_compare_rule)
+
+
+def _noop_rule(op, block):
+    pass
+
+
+# control-flow ops manage their own vars; the default mirror rule would
+# clobber e.g. a bool condition's dtype
+for _t in ("while", "dynamic_rnn", "array_length", "beam_search_decode"):
+    register_infer(_t)(_noop_rule)
+
+
+@register_infer("array_write")
+def _array_write_rule(op, block):
+    # remember the element shape on the array var so array_read can
+    # propagate it (build-time only; values live in the trace env)
+    x = block.var(op.inputs["X"][0])
+    arr = block.var(op.outputs["Out"][0])
+    if getattr(arr, "elem_shape", None) is None and x.shape is not None:
+        arr.elem_shape = (-1,) + tuple(x.shape[1:])
+        arr.dtype = x.dtype
+
+
+@register_infer("array_read")
+def _array_read_rule(op, block):
+    arr = block.var(op.inputs["X"][0])
+    shape = getattr(arr, "elem_shape", None)
+    if shape is not None:
+        _set(block, op.outputs["Out"][0], shape, arr.dtype)
+
+
+@register_infer("beam_search")
+def _beam_search_rule(op, block):
+    _set(block, op.outputs["selected_ids"][0], (-1, 1), "int64")
+    _set(block, op.outputs["selected_scores"][0], (-1, 1), "float32")

@@ -1,0 +1,398 @@
+"""Control-flow kernels: While, LoDTensorArray ops, DynamicRNN, beam search.
+
+TPU-first re-design of the reference's control-flow machinery
+(operators/while_op.cc, operators/tensor_array_read_write_op.cc,
+operators/beam_search_op.cc, operators/beam_search_decode_op.cc,
+python/paddle/v2/fluid/layers/control_flow.py):
+
+* Loop counters built from `fill_constant`/`zeros` are *concrete* values
+  during tracing (jnp on non-tracer operands executes eagerly), so a
+  `While` whose condition depends only on counters unrolls at trace time —
+  each unrolled iteration may have different shapes, which is exactly what
+  beam-search generation needs (step 0 has batch rows, later steps
+  batch*beam). XLA sees one flat graph; there is no host loop at runtime.
+* `LoDTensorArray` is a trace-time Python list; `array_write`/`array_read`
+  move values *and* their LoD / beam side-bands through it.
+* Beam search keeps beams FULL-WIDTH (exactly `beam_size` live-or-frozen
+  candidates per source every step) so every iteration has a static shape;
+  finished prefixes are frozen (re-emit end_id with their frozen score)
+  instead of being dropped the way the reference's dynamic-shape
+  PruneEndidCandidates does (beam_search_op.cc:86). Parent pointers travel
+  as a traced side-band (`@BEAM_PARENTS`) instead of the reference's
+  level-1 LoD offsets.
+* `dynamic_rnn` runs its sub-block under one `lax.scan` over bucketed
+  padded time — each step is dense MXU work over the whole batch; finished
+  sequences carry state unchanged under a mask (the reference instead
+  reorders the batch per timestep, sequence2batch.*).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import LoweringContext, register_op
+from .kernels_sequence import lod_key
+from .kernels_rnn import packed_to_padded, padded_to_packed, _seq_T
+
+# side-band suffixes that follow a value through tensor arrays
+BEAM_PARENTS = "@BEAM_PARENTS"
+BEAM_SCORES = "@BEAM_SCORES"
+BEAM_ALIVE = "@BEAM_ALIVE"
+LOD_SRC = "@LOD_SRC"  # outer (source-sentence) level of a 2-level LoD
+BEAM_LENS = "@BEAM_LENS"
+_SIDEBANDS = ("@LOD0", BEAM_PARENTS, BEAM_SCORES, BEAM_ALIVE, LOD_SRC, BEAM_LENS)
+
+MAX_WHILE_ITERS = 10000
+
+
+def get_sidebands(env, name) -> Dict[str, Any]:
+    return {s: env[name + s] for s in _SIDEBANDS if (name + s) in env}
+
+
+def set_sidebands(env, name, bands: Dict[str, Any]):
+    for s, v in bands.items():
+        env[name + s] = v
+
+
+class TensorArray(object):
+    """Trace-time LoDTensorArray: a list of (value, side-bands) items."""
+
+    def __init__(self):
+        self.items: List[Any] = []
+        self.bands: List[Dict[str, Any]] = []
+
+    def write(self, i: int, value, bands):
+        i = int(i)
+        while len(self.items) <= i:
+            self.items.append(None)
+            self.bands.append({})
+        self.items[i] = value
+        self.bands[i] = dict(bands)
+
+    def read(self, i: int):
+        i = int(i)
+        return self.items[i], self.bands[i]
+
+    def __len__(self):
+        return len(self.items)
+
+
+def _concrete_int(v) -> int:
+    """Host-concrete scalar index (raises on tracers, by design: array
+    indices must be loop counters, which stay concrete during tracing)."""
+    if isinstance(v, jax.core.Tracer):
+        raise NotImplementedError(
+            "LoDTensorArray index must be a trace-time-concrete counter "
+            "(build it with fill_constant/zeros + increment); got a traced "
+            "value"
+        )
+    return int(np.asarray(v).reshape(()))
+
+
+@register_op("array_write")
+def _array_write(ctx, ins, attrs):
+    env = ctx.env
+    arr_name = ctx.op.outputs["Out"][0]
+    x_name = ctx.op.inputs["X"][0]
+    i = _concrete_int(env[ctx.op.inputs["I"][0]])
+    arr = env.get(arr_name)
+    if not isinstance(arr, TensorArray):
+        arr = TensorArray()
+    arr.write(i, env[x_name], get_sidebands(env, x_name))
+    env[arr_name] = arr
+    return {}
+
+
+@register_op("array_read")
+def _array_read(ctx, ins, attrs):
+    env = ctx.env
+    arr = env[ctx.op.inputs["X"][0]]
+    i = _concrete_int(env[ctx.op.inputs["I"][0]])
+    out_name = ctx.op.outputs["Out"][0]
+    value, bands = arr.read(i)
+    env[out_name] = value
+    # clear stale side-bands on the out name, then install the item's
+    for s in _SIDEBANDS:
+        env.pop(out_name + s, None)
+    set_sidebands(env, out_name, bands)
+    return {}
+
+
+@register_op("array_length")
+def _array_length(ctx, ins, attrs):
+    arr = ctx.env[ctx.op.inputs["X"][0]]
+    return {"Out": np.asarray([len(arr)], np.int64)}
+
+
+@register_op("while")
+def _while(ctx, ins, attrs):
+    """Trace-time bounded unroll (see module docstring)."""
+    from .lowering import run_ops
+
+    env = ctx.env
+    cond_name = ctx.op.inputs["Condition"][0]
+    sub = ctx.block.program.block(attrs["sub_block"])
+    sub_ctx = LoweringContext(
+        sub, ctx._base_key, is_test=ctx.is_test, seq_maxlen=ctx.seq_maxlen
+    )
+    iters = 0
+    while True:
+        cond = env[cond_name]
+        if isinstance(cond, jax.core.Tracer):
+            # data-dependent While — the fluid-era While is always
+            # counter-bounded, so this indicates a traced value leaked
+            # into the counter chain.
+            raise NotImplementedError(
+                "While condition %r is data-dependent (traced); only "
+                "counter-bounded loops unroll. Keep the condition a pure "
+                "function of fill_constant counters." % cond_name
+            )
+        if not bool(np.asarray(cond).reshape(-1)[0]):
+            break
+        if iters >= attrs.get("max_iters", MAX_WHILE_ITERS):
+            raise RuntimeError("while op exceeded %d iterations" % iters)
+        run_ops(sub_ctx, sub.ops, env)
+        iters += 1
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# dynamic_rnn — sub-block under lax.scan (DynamicRNN layer sugar)
+# ---------------------------------------------------------------------------
+
+
+@register_op("dynamic_rnn")
+def _dynamic_rnn(ctx, ins, attrs):
+    from .lowering import run_ops
+
+    env = ctx.env
+    op = ctx.op
+    sub = ctx.block.program.block(attrs["sub_block"])
+    step_outer = op.inputs.get("StepIn", [])
+    step_inner = attrs["step_inner"]
+    static_outer = op.inputs.get("Static", [])
+    static_inner = attrs.get("static_inner", [])
+    mem_pre = attrs["mem_pre"]  # inner pre-state names
+    mem_update = attrs["mem_update"]  # inner updated-state names
+    mem_init = attrs["mem_init_names"]  # outer init var name or "" per memory
+    mem_shapes = attrs.get("mem_shapes", [])
+    mem_values = attrs.get("mem_values", [])
+    mem_dtypes = attrs.get("mem_dtypes", [])
+    out_inner = attrs["out_inner"]
+    out_outer = op.outputs["Out"]
+
+    x0_name = step_outer[0]
+    offsets = env[lod_key(x0_name)]
+    total = env[x0_name].shape[0]
+    T = _seq_T(ctx, total)
+    B = offsets.shape[0] - 1
+
+    xs_padded = []
+    mask = None
+    for name in step_outer:
+        p, m = packed_to_padded(env[name], offsets, T)  # [B,T,...]
+        xs_padded.append(jnp.moveaxis(p, 1, 0))  # [T,B,...]
+        if mask is None:
+            mask = jnp.moveaxis(m, 1, 0)  # [T,B]
+
+    carry = {}
+    for j, pre in enumerate(mem_pre):
+        if mem_init[j]:
+            carry[pre] = env[mem_init[j]]
+        else:
+            shape = (B,) + tuple(int(s) for s in mem_shapes[j] if int(s) > 0)
+            carry[pre] = jnp.full(shape, mem_values[j], mem_dtypes[j])
+
+    sub_ctx = LoweringContext(
+        sub, ctx._base_key, is_test=ctx.is_test, seq_maxlen=ctx.seq_maxlen
+    )
+    # everything the sub-block reads from outside (weights, static inputs)
+    # is closed over: scan hoists them as loop constants
+    base_env = {
+        k: v for k, v in env.items() if not isinstance(v, TensorArray)
+    }
+    for so, si in zip(static_outer, static_inner):
+        base_env[si] = env[so]
+
+    def body(carry, xs):
+        t_inputs, m_t = xs
+        senv = dict(base_env)
+        for si, v in zip(step_inner, t_inputs):
+            senv[si] = v
+        senv.update(carry)
+        run_ops(sub_ctx, sub.ops, senv)
+        new_carry = {}
+        for pre, upd in zip(mem_pre, mem_update):
+            new = senv[upd]
+            keep = m_t.reshape((-1,) + (1,) * (new.ndim - 1))
+            new_carry[pre] = jnp.where(keep, new, carry[pre])
+        ys = tuple(senv[o] for o in out_inner)
+        return new_carry, ys
+
+    _, ys_stacked = lax.scan(body, carry, (tuple(xs_padded), mask))
+
+    outs = []
+    for y in ys_stacked:  # each [T,B,...]
+        padded = jnp.moveaxis(y, 0, 1)  # [B,T,...]
+        outs.append(padded_to_packed(padded, offsets, total))
+    for name in out_outer:
+        env[lod_key(name)] = offsets
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# beam search (full-width static-shape re-design)
+# ---------------------------------------------------------------------------
+
+_NEG_INF = -1e9
+
+
+@register_op("beam_search")
+def _beam_search(ctx, ins, attrs):
+    env = ctx.env
+    op = ctx.op
+    pre_ids_name = op.inputs["pre_ids"][0]
+    pre_ids = env[pre_ids_name]  # [R, 1] int
+    ids = env[op.inputs["ids"][0]]  # [R, K] int
+    scores = env[op.inputs["scores"][0]]  # [R, K] float
+    B = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+
+    R = pre_ids.shape[0]
+    K = ids.shape[1]
+    pre_bands = get_sidebands(env, pre_ids_name)
+    # rows-per-source (static): the outer LoD level's *shape* gives the
+    # source count even though its values are traced. Uniform widths only —
+    # the full-width design keeps exactly beam_size rows per source after
+    # the first step, and a direct 2-level feed must be uniform too.
+    if LOD_SRC in pre_bands:
+        S = int(pre_bands[LOD_SRC].shape[0]) - 1
+        width = R // S
+    else:
+        # no outer level fed: first step (width 1) unless this is our own
+        # previous full-width output
+        width = B if BEAM_PARENTS in pre_bands else 1
+        S = R // width  # number of source sentences (static)
+
+    pre_score = pre_bands.get(BEAM_SCORES)
+    if pre_score is None:
+        pre_score = jnp.zeros((R,), scores.dtype)
+    alive = pre_bands.get(BEAM_ALIVE)
+    if alive is None:
+        alive = jnp.ones((R,), bool)
+    alive = jnp.logical_and(alive, pre_ids.reshape(-1) != end_id)
+
+    # candidate matrix per source: width*K expansion candidates + width
+    # "frozen" candidates (an ended prefix re-emits end_id at its frozen
+    # score; a live prefix's frozen slot is -inf)
+    exp_scores = jnp.where(alive[:, None], scores, _NEG_INF)  # [R,K]
+    frozen_scores = jnp.where(alive, _NEG_INF, pre_score)  # [R]
+    cand_scores = jnp.concatenate(
+        [exp_scores.reshape(S, width * K), frozen_scores.reshape(S, width)], axis=1
+    )  # [S, width*K + width]
+    cand_ids = jnp.concatenate(
+        [
+            ids.reshape(S, width * K),
+            jnp.full((S, width), end_id, ids.dtype),
+        ],
+        axis=1,
+    )
+    # local parent (row within source) of each candidate
+    local_parent = jnp.concatenate(
+        [
+            jnp.repeat(jnp.arange(width, dtype=jnp.int32), K),
+            jnp.arange(width, dtype=jnp.int32),
+        ]
+    )  # [width*K + width]
+
+    top_scores, top_idx = lax.top_k(cand_scores, B)  # [S, B]
+    sel_ids = jnp.take_along_axis(cand_ids, top_idx, axis=1)  # [S, B]
+    sel_parent = (
+        local_parent[top_idx] + (jnp.arange(S, dtype=jnp.int32) * width)[:, None]
+    )  # [S, B] global row into R
+
+    out_rows = S * B
+    selected_ids = sel_ids.reshape(out_rows, 1)
+    selected_scores = top_scores.reshape(out_rows, 1).astype(scores.dtype)
+    parents = sel_parent.reshape(out_rows)
+    new_alive = selected_ids.reshape(-1) != end_id
+
+    src_offsets = jnp.arange(S + 1, dtype=jnp.int32) * B
+    row_offsets = jnp.arange(out_rows + 1, dtype=jnp.int32)
+    for out_name in (op.outputs["selected_ids"][0], op.outputs["selected_scores"][0]):
+        set_sidebands(
+            env,
+            out_name,
+            {
+                "@LOD0": row_offsets,
+                LOD_SRC: src_offsets,
+                BEAM_PARENTS: parents,
+                BEAM_SCORES: selected_scores.reshape(-1),
+                BEAM_ALIVE: new_alive,
+            },
+        )
+    return {"selected_ids": selected_ids, "selected_scores": selected_scores}
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack the ids/scores TensorArrays into full sentences.
+
+    Reference operators/beam_search_decode_op.cc walks prefix trees built
+    from level-1 LoD; here parent pointers are explicit side-bands and the
+    walk is a trace-time loop over the (concrete-length) array emitting one
+    gather per step. Output: padded [S*beam, T] sentences + length vector,
+    plus packed-LoD offsets so sequence ops can consume the result."""
+    env = ctx.env
+    op = ctx.op
+    ids_arr: TensorArray = env[op.inputs["Ids"][0]]
+    scores_arr: TensorArray = env[op.inputs["Scores"][0]]
+    T = len(ids_arr) - 1  # item 0 is the init (start-token) step
+    if T < 1:
+        raise ValueError("beam_search_decode needs at least one search step")
+    last_v, last_b = ids_arr.read(T)
+    R = last_v.shape[0]  # S * beam
+
+    row = jnp.arange(R, dtype=jnp.int32)
+    toks, tok_scores, alive_flags = [], [], []
+    for t in range(T, 0, -1):
+        v, b = ids_arr.read(t)
+        sv, _ = scores_arr.read(t)
+        toks.append(v.reshape(-1)[row])
+        tok_scores.append(sv.reshape(-1)[row])
+        alive_flags.append(b[BEAM_ALIVE][row])
+        row = b[BEAM_PARENTS][row]
+    v0, _ = ids_arr.read(0)
+    sv0, _ = scores_arr.read(0)
+    toks.append(v0.reshape(-1)[row])
+    tok_scores.append(sv0.reshape(-1)[row])
+    alive_flags.append(jnp.ones((R,), bool))
+
+    ids_mat = jnp.stack(toks[::-1], axis=1)  # [R, T+1]
+    scores_mat = jnp.stack(tok_scores[::-1], axis=1)
+    alive_mat = jnp.stack(alive_flags[::-1], axis=1)  # [R, T+1]
+
+    # length = up to and including the first end token (first not-alive)
+    ended = jnp.logical_not(alive_mat)
+    any_end = jnp.any(ended, axis=1)
+    first_end = jnp.argmax(ended, axis=1)
+    lens = jnp.where(any_end, first_end + 1, T + 1).astype(jnp.int32)
+
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)]
+    )
+    src_off = last_b.get(LOD_SRC)
+    for out_name in (op.outputs["SentenceIds"][0], op.outputs["SentenceScores"][0]):
+        bands = {"@LOD0": offsets, BEAM_LENS: lens}
+        if src_off is not None:
+            bands[LOD_SRC] = src_off
+        set_sidebands(env, out_name, bands)
+    outs = {"SentenceIds": ids_mat, "SentenceScores": scores_mat}
+    if "SentenceLens" in op.outputs:
+        outs["SentenceLens"] = lens
+    return outs

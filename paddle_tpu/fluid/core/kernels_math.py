@@ -50,6 +50,13 @@ def _compare(fn):
     def kern(ctx, ins, attrs):
         x = ins["X"][0]
         y = _bcast_y(x, ins["Y"][0], attrs.get("axis", -1))
+        if isinstance(x, (np.ndarray, np.generic)) and isinstance(
+            y, (np.ndarray, np.generic)
+        ):
+            # both host-concrete (loop counters): compare in numpy so While
+            # conditions stay decidable at trace time (any jnp call would
+            # stage into the trace and return a tracer)
+            return {"Out": getattr(np, fn.__name__)(x, y)}
         return {"Out": fn(x, y)}
 
     return kern

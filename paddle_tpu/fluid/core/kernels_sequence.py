@@ -103,6 +103,14 @@ def _sequence_expand(ctx, ins, attrs):
     """Repeat each row/sequence of X according to Y's LoD
     (operators/sequence_expand_op.cc)."""
     x = ins["X"][0]
+    # beam-search states: Y carries explicit parent pointers (see
+    # kernels_control.py) — each Y row gets its parent's X row
+    pkey = ctx.op.inputs["Y"][0] + "@BEAM_PARENTS"
+    if pkey in ctx.env:
+        parents = ctx.env[pkey]
+        out = x[parents]
+        _set_lod(ctx, "Out", ctx.env[lod_key(ctx.op.inputs["Y"][0])])
+        return {"Out": out}
     y_offsets = _offsets(ctx, "Y")
     y = ins["Y"][0]
     ids = seg_ids(y_offsets, y.shape[0])

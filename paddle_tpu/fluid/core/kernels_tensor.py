@@ -24,8 +24,17 @@ def _np_dtype(s):
 
 @register_op("fill_constant")
 def _fill_constant(ctx, ins, attrs):
+    # host-side numpy, NOT jnp: under jit every jnp call is staged into the
+    # trace, but a fill_constant is a pure constant — keeping it numpy lets
+    # loop counters stay concrete so While/array indices unroll at trace
+    # time (kernels_control.py). As an operand of any traced op it becomes
+    # an XLA constant, identical result either way.
     shape = tuple(int(s) for s in attrs["shape"])
-    return {"Out": jnp.full(shape, attrs.get("value", 0.0), _np_dtype(attrs.get("dtype", "float32")))}
+    return {
+        "Out": np.full(
+            shape, attrs.get("value", 0.0), _np_dtype(attrs.get("dtype", "float32"))
+        )
+    }
 
 
 @register_op("fill_constant_batch_size_like")
@@ -204,7 +213,9 @@ def _gather(ctx, ins, attrs):
 
 @register_op("scatter")
 def _scatter(ctx, ins, attrs):
-    x = ins["X"][0]
+    # jnp.asarray: X may be a host-side numpy constant (fill_constant),
+    # and .at[] indexing exists only on jax arrays
+    x = jnp.asarray(ins["X"][0])
     idx = ins["Ids"][0].reshape(-1).astype(jnp.int32)
     upd = ins["Updates"][0]
     return {"Out": x.at[idx].set(upd)}

@@ -34,13 +34,22 @@ AUTODIFF_OP = "autodiff"
 _SKIP_OPS = frozenset(["feed", "fetch"])
 
 
+# ops that read env directly (tensor arrays, sub-blocks): inputs may be
+# names with no env binding yet (e.g. the first array_write of an array)
+_ENV_OPS = frozenset(
+    ["while", "array_write", "array_read", "array_length", "dynamic_rnn",
+     "beam_search_decode"]
+)
+
+
 def run_op(ctx: LoweringContext, op, env: Dict[str, Any]):
     """Execute one op symbolically: gather named inputs from env, call the
     kernel, bind named outputs back into env."""
     kernel = get_kernel(op.type)
     ins = {}
+    lazy = op.type in _ENV_OPS
     for slot, names in op.inputs.items():
-        ins[slot] = [env[n] for n in names]
+        ins[slot] = [env.get(n) for n in names] if lazy else [env[n] for n in names]
     # sequence kernels read LoD offsets / write output LoD via ctx.env
     ctx.op = op
     ctx.env = env

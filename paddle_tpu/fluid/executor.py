@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import core
+from .core.kernels_control import LOD_SRC
 from .core.kernels_sequence import LOD_SUFFIX, lod_key
 from .core.lowering import build_step_fn
 from .core.program import Program, Variable
@@ -210,11 +211,15 @@ class Executor(object):
             data, lod = _split_lod_feed(value)
             feed_arrays[name] = _to_device_dtype(data, var)
             if lod is not None:
-                feed_arrays[lod_key(name)] = np.asarray(lod, np.int32)
+                # rows are described by the FINEST level; a coarser outer
+                # level (2-level beam-search feeds) rides a second side-band
+                feed_arrays[lod_key(name)] = np.asarray(lod[-1], np.int32)
+                if len(lod) > 1:
+                    feed_arrays[name + LOD_SRC] = np.asarray(lod[0], np.int32)
         # LoD side-band offsets are never scanned: their leading dim is the
         # offset count, not steps
         scanned = (
-            set(n for n in feed_arrays if not n.endswith(LOD_SUFFIX))
+            set(n for n in feed_arrays if "@" not in n)
             if scan_feeds
             else set()
         )
@@ -340,12 +345,13 @@ def _split_lod_feed(value):
 
 
 def _flatten_lod(lod):
+    """Normalise a fed LoD to a list of levels (each an int32 offsets
+    vector). Reference feeds lod as [[..level0..], [..level1..]]."""
     if lod is None:
         return None
-    # reference feeds lod as [[o0, o1, ...]] (list of levels); we keep level 0
     if len(lod) and isinstance(lod[0], (list, tuple, np.ndarray)):
-        return np.asarray(lod[0], np.int32)
-    return np.asarray(lod, np.int32)
+        return [np.asarray(lv, np.int32) for lv in lod]
+    return [np.asarray(lod, np.int32)]
 
 
 def _mesh_jit_kwargs(
@@ -367,7 +373,7 @@ def _mesh_jit_kwargs(
     n_data = mesh.shape.get("data", 1)
 
     def feed_shard(name, arr):
-        if name.endswith(LOD_SUFFIX):
+        if "@" in name:  # LoD / beam side-bands are replicated
             return rep
         # scanned feeds carry a leading [steps] dim; the batch is axis 1
         batch_axis = 1 if name in scanned_feeds else 0

@@ -69,7 +69,15 @@ class LayerHelper(object):
         if len(attr) != 1 and len(attr) != length:
             raise ValueError("parameter number mismatch")
         if len(attr) == 1 and length != 1:
-            attr = [attr[0]] + [copy.deepcopy(attr[0]) for _ in range(length - 1)]
+            extra = []
+            for i in range(length - 1):
+                a = copy.deepcopy(attr[0])
+                # a named attr shared across N inputs would collide: each
+                # copy gets a _i suffix (weight per input, reference fc)
+                if a.name is not None:
+                    a.name = "%s_%d" % (a.name, i)
+                extra.append(a)
+            attr = [attr[0]] + extra
         return attr
 
     def iter_inputs_and_params(self, input_param_name="input"):

@@ -1,0 +1,322 @@
+"""Control-flow layers: While, DynamicRNN, tensor arrays, beam search.
+
+API parity with reference python/paddle/v2/fluid/layers/control_flow.py
+(While, DynamicRNN, array_read/array_write/array_length, create_array,
+increment, less_than) and layers/nn.py beam_search / beam_search_decode.
+Execution model differs by design — see core/kernels_control.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..core.program import unique_name
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "While",
+    "DynamicRNN",
+    "create_array",
+    "array_read",
+    "array_write",
+    "array_length",
+    "increment",
+    "less_than",
+    "beam_search",
+    "beam_search_decode",
+]
+
+
+def increment(x, value=1.0, in_place=True):
+    """x += value (reference control_flow.py increment)."""
+    helper = LayerHelper("increment", **locals())
+    if in_place:
+        out = x
+    else:
+        out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(
+        type="increment",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"step": float(value)},
+    )
+    return out
+
+
+def less_than(x, y, cond=None, **ignored):
+    helper = LayerHelper("less_than", **locals())
+    if cond is None:
+        cond = helper.create_tmp_variable(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(
+        type="less_than", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]}
+    )
+    return cond
+
+
+def create_array(dtype):
+    """A LoDTensorArray variable (reference: LOD_TENSOR_ARRAY var type)."""
+    helper = LayerHelper("array", **locals())
+    arr = helper.main_program.current_block().create_var(
+        name=unique_name("array"), dtype=dtype
+    )
+    arr.is_tensor_array = True
+    arr.stop_gradient = True
+    return arr
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write", **locals())
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(
+        type="array_write",
+        inputs={"X": [x], "I": [i], "Array": [array]},
+        outputs={"Out": [array]},
+    )
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read", **locals())
+    out = helper.create_tmp_variable(dtype=array.dtype)
+    helper.append_op(
+        type="array_read", inputs={"X": [array], "I": [i]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length", **locals())
+    out = helper.create_tmp_variable(dtype="int64")
+    out.stop_gradient = True
+    helper.append_op(
+        type="array_length", inputs={"X": [array]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+class While(object):
+    """Counter-bounded loop; unrolls at trace time (kernels_control.py).
+
+    Usage (reference control_flow.py While):
+        cond = less_than(counter, limit)
+        w = While(cond)
+        with w.block():
+            ... body ops; must update `cond` via less_than(..., cond=cond)
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("while", name=name)
+        if cond.dtype != "bool":
+            raise TypeError("While condition must be a bool variable")
+        self.cond_var = cond
+
+    @contextlib.contextmanager
+    def block(self):
+        main = self.helper.main_program
+        parent = main.current_block()
+        sub = main.create_block()
+        try:
+            yield
+        finally:
+            main.rollback()
+        # compute the op's outer reads/writes for pruning: names the sub-block
+        # reads but does not produce, and names it writes that exist outside
+        produced = set()
+        reads, writes = [], []
+        for op in sub.ops:
+            for n in op.input_arg_names:
+                if n not in produced and n not in reads:
+                    reads.append(n)
+            for n in op.output_arg_names:
+                produced.add(n)
+                outer = parent._find_var_recursive(n)
+                if outer is not None and n not in writes:
+                    writes.append(n)
+        parent.append_op(
+            type="while",
+            inputs={"Condition": [self.cond_var], "X": reads},
+            outputs={"Out": writes},
+            attrs={"sub_block": sub.idx},
+        )
+
+
+class DynamicRNN(object):
+    """Per-timestep sub-network over a ragged batch (reference
+    control_flow.py DynamicRNN, RecurrentGradientMachine in the legacy
+    stack). Lowers to ONE lax.scan over bucketed padded time — no host
+    loop, dense MXU steps (core/kernels_control.py dynamic_rnn)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._step_in = []  # (outer_name, inner_name)
+        self._static_in = []
+        self._mems = []  # dict(init, pre, update, shape, value, dtype)
+        self._outputs = []  # (inner_name, outer_var)
+        self._sub_idx = None
+        self._in_block = False
+        self._closed = False
+
+    @contextlib.contextmanager
+    def block(self):
+        main = self.helper.main_program
+        parent = main.current_block()
+        sub = main.create_block()
+        self._sub_idx = sub.idx
+        self._in_block = True
+        try:
+            yield
+        finally:
+            self._in_block = False
+            main.rollback()
+        for m in self._mems:
+            if m["update"] is None:
+                raise ValueError(
+                    "DynamicRNN memory %r was never update_memory()'d" % m["pre"]
+                )
+        if not self._outputs:
+            raise ValueError("DynamicRNN needs at least one output()")
+        parent.append_op(
+            type="dynamic_rnn",
+            inputs={
+                "StepIn": [n for n, _ in self._step_in],
+                "Static": [n for n, _ in self._static_in],
+                "MemInit": [m["init"] for m in self._mems if m["init"]],
+            },
+            outputs={"Out": [v.name for _, v in self._outputs]},
+            attrs={
+                "sub_block": sub.idx,
+                "step_inner": [i for _, i in self._step_in],
+                "static_inner": [i for _, i in self._static_in],
+                "mem_pre": [m["pre"] for m in self._mems],
+                "mem_update": [m["update"] for m in self._mems],
+                "mem_init_names": [m["init"] or "" for m in self._mems],
+                "mem_shapes": [m["shape"] or [] for m in self._mems],
+                "mem_values": [m["value"] for m in self._mems],
+                "mem_dtypes": [m["dtype"] for m in self._mems],
+                "out_inner": [i for i, _ in self._outputs],
+            },
+        )
+        self._closed = True
+
+    def _require_in_block(self, what):
+        if not self._in_block:
+            raise RuntimeError("%s must be called inside rnn.block()" % what)
+
+    def step_input(self, x):
+        self._require_in_block("step_input")
+        blk = self.helper.main_program.current_block()
+        # per-step value is [n_seqs, ...feature dims]: same rank as the
+        # packed outer var, the ragged axis becomes the (dynamic) batch
+        inner = blk.create_var(
+            name=unique_name(x.name + "@step"),
+            shape=((-1,) + tuple(x.shape[1:])) if x.shape else None,
+            dtype=x.dtype,
+        )
+        self._step_in.append((x.name, inner.name))
+        return inner
+
+    def static_input(self, x):
+        self._require_in_block("static_input")
+        blk = self.helper.main_program.current_block()
+        inner = blk.create_var(
+            name=unique_name(x.name + "@static"), shape=x.shape, dtype=x.dtype
+        )
+        self._static_in.append((x.name, inner.name))
+        return inner
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+        self._require_in_block("memory")
+        blk = self.helper.main_program.current_block()
+        if init is not None:
+            pre = blk.create_var(
+                name=unique_name("mem@pre"), shape=init.shape, dtype=init.dtype
+            )
+            self._mems.append(
+                dict(init=init.name, pre=pre.name, update=None, shape=None,
+                     value=0.0, dtype=str(init.dtype))
+            )
+        else:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            # shape is the per-sequence feature shape; the leading dim is
+            # the (dynamic) live-sequence batch
+            feat = [int(s) for s in shape if int(s) > 0]
+            pre = blk.create_var(
+                name=unique_name("mem@pre"), shape=(-1,) + tuple(feat), dtype=dtype
+            )
+            self._mems.append(
+                dict(init=None, pre=pre.name, update=None,
+                     shape=[int(s) for s in shape], value=float(value),
+                     dtype=dtype)
+            )
+        return pre
+
+    def update_memory(self, ex_mem, new_mem):
+        self._require_in_block("update_memory")
+        for m in self._mems:
+            if m["pre"] == ex_mem.name:
+                m["update"] = new_mem.name
+                return
+        raise ValueError("%r is not a DynamicRNN memory" % ex_mem.name)
+
+    def output(self, *outputs):
+        self._require_in_block("output")
+        parent = self.helper.main_program.block(
+            self.helper.main_program.current_block().parent_idx
+        )
+        for o in outputs:
+            outer = parent.create_var(
+                name=unique_name("dynamic_rnn_out"),
+                shape=o.shape,
+                dtype=o.dtype,
+                lod_level=1,
+            )
+            self._outputs.append((o.name, outer))
+
+    def __call__(self, *args, **kwargs):
+        if not self._closed:
+            raise RuntimeError("call rnn() after the rnn.block() context ends")
+        outs = [v for _, v in self._outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0):
+    """One beam-search step (reference layers beam_search -> operators/
+    beam_search_op.cc; TPU-native full-width redesign in kernels_control)."""
+    helper = LayerHelper("beam_search", **locals())
+    selected_ids = helper.create_tmp_variable(dtype=ids.dtype)
+    selected_scores = helper.create_tmp_variable(dtype=scores.dtype)
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "ids": [ids], "scores": [scores]},
+        outputs={
+            "selected_ids": [selected_ids],
+            "selected_scores": [selected_scores],
+        },
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id), "level": level},
+    )
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores):
+    """Backtrack completed beams into sentences. Returns (sentence_ids,
+    sentence_scores) as padded [n_source*beam, T] arrays; per-row true
+    lengths are fetchable via `sentence_ids.lens_name`."""
+    helper = LayerHelper("beam_search_decode", **locals())
+    sentence_ids = helper.create_tmp_variable(dtype=ids.dtype)
+    sentence_scores = helper.create_tmp_variable(dtype=scores.dtype)
+    lens = helper.create_tmp_variable(dtype="int32")
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores]},
+        outputs={
+            "SentenceIds": [sentence_ids],
+            "SentenceScores": [sentence_scores],
+            "SentenceLens": [lens],
+        },
+    )
+    sentence_ids.lens_name = lens.name
+    sentence_scores.lens_name = lens.name
+    return sentence_ids, sentence_scores

@@ -1,0 +1,411 @@
+"""Control flow: While + LoDTensorArray, DynamicRNN, beam search.
+
+Mirrors the reference's coverage in test_while_op.py, test_dyn_rnn.py,
+test_beam_search_op.py, test_beam_search_decode_op.py (python/paddle/v2/
+fluid/tests/) with numpy oracles.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+pd = fluid.layers
+
+
+def _lod_feed(seqs, dtype):
+    lens = [len(s) for s in seqs]
+    off = np.cumsum([0] + lens).astype(np.int32)
+    flat = np.concatenate([np.asarray(s) for s in seqs]).astype(dtype)
+    if flat.ndim == 1:
+        flat = flat.reshape(-1, 1)
+    return flat, [off]
+
+
+def test_while_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = pd.data(name="x", shape=[3], dtype="float32")
+        limit = pd.fill_constant(shape=[1], dtype="int64", value=5)
+        counter = pd.zeros(shape=[1], dtype="int64", force_cpu=True)
+        arr = pd.create_array("float32")
+        pd.array_write(x, i=counter, array=arr)
+        cond = pd.less_than(x=counter, y=limit)
+        w = pd.While(cond=cond)
+        with w.block():
+            prev = pd.array_read(array=arr, i=counter)
+            nxt = prev + x
+            pd.increment(x=counter, value=1, in_place=True)
+            pd.array_write(nxt, i=counter, array=arr)
+            pd.less_than(x=counter, y=limit, cond=cond)
+        final = pd.array_read(array=arr, i=limit)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (out,) = exe.run(
+        main, feed={"x": np.array([[1.0, 2.0, 3.0]], np.float32)}, fetch_list=[final]
+    )
+    assert np.allclose(out, [[6.0, 12.0, 18.0]])
+
+
+def test_dynamic_rnn_matches_numpy():
+    """DynamicRNN forward == hand-rolled numpy RNN over a ragged batch."""
+    D, H = 4, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = pd.data(name="x", shape=[D], dtype="float32", lod_level=1)
+        rnn = pd.DynamicRNN()
+        with rnn.block():
+            w = rnn.step_input(x)
+            pre = rnn.memory(shape=[H], value=0.0, dtype="float32")
+            h = pd.fc(
+                input=[w, pre],
+                size=H,
+                act="tanh",
+                param_attr=fluid.ParamAttr(name="cell_w"),
+                bias_attr=False,
+            )
+            rnn.update_memory(pre, h)
+            rnn.output(h)
+        out = rnn()
+        last = pd.sequence_last_step(input=out)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(3, D), rng.randn(5, D), rng.randn(1, D)]
+    data, lod = (
+        np.concatenate(seqs).astype(np.float32),
+        [np.cumsum([0] + [len(s) for s in seqs]).astype(np.int32)],
+    )
+    (res,) = exe.run(main, feed={"x": (data, lod)}, fetch_list=[last])
+
+    scope = fluid.global_scope()
+    w0 = np.asarray(scope.get("cell_w"))  # input weight [D, H]
+    w1 = np.asarray(scope.get("cell_w_0"))  # recurrent weight [H, H]
+    expect = []
+    for s in seqs:
+        h = np.zeros(H, np.float32)
+        for t in range(len(s)):
+            h = np.tanh(s[t].astype(np.float32) @ w0 + h @ w1)
+        expect.append(h)
+    assert np.allclose(res, np.stack(expect), atol=1e-4), (res, np.stack(expect))
+
+
+def test_dynamic_rnn_trains():
+    """Gradients flow through the scanned sub-block (loss decreases)."""
+    D, H = 3, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = pd.data(name="x", shape=[D], dtype="float32", lod_level=1)
+        label = pd.data(name="label", shape=[1], dtype="int64")
+        rnn = pd.DynamicRNN()
+        with rnn.block():
+            w = rnn.step_input(x)
+            pre = rnn.memory(shape=[H], value=0.0, dtype="float32")
+            h = pd.fc(input=[w, pre], size=H, act="tanh")
+            rnn.update_memory(pre, h)
+            rnn.output(h)
+        last = pd.sequence_last_step(input=rnn())
+        logits = pd.fc(input=last, size=2, act="softmax")
+        loss = pd.mean(x=pd.cross_entropy(input=logits, label=label))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    seqs = [rng.randn(4, D) + (i % 2) for i in range(6)]
+    data = np.concatenate(seqs).astype(np.float32)
+    lod = [np.arange(0, 4 * 6 + 1, 4).astype(np.int32)]
+    labels = np.array([[i % 2] for i in range(6)], np.int64)
+    losses = []
+    for _ in range(30):
+        (l,) = exe.run(
+            main, feed={"x": (data, lod), "label": labels}, fetch_list=[loss]
+        )
+        losses.append(float(np.ravel(l)[0]))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_beam_search_step():
+    """Single beam_search op step: top beam_size over per-source candidates."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = pd.data(name="pre_ids", shape=[1], dtype="int64", lod_level=2)
+        ids = pd.data(name="ids", shape=[3], dtype="int64")
+        scores = pd.data(name="scores", shape=[3], dtype="float32")
+        sel_ids, sel_scores = pd.beam_search(
+            pre_ids, ids, scores, beam_size=2, end_id=0, level=0
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # two sources, one live prefix each
+    feed = {
+        "pre_ids": (
+            np.array([[1], [2]], np.int64),
+            [[0, 1, 2], [0, 1, 2]],
+        ),
+        "ids": np.array([[4, 2, 5], [3, 5, 2]], np.int64),
+        "scores": np.array([[0.5, 0.3, 0.2], [0.9, 0.05, 0.05]], np.float32),
+    }
+    got_ids, got_scores = exe.run(
+        main, feed=feed, fetch_list=[sel_ids, sel_scores]
+    )
+    # source 0: top-2 of (4:.5, 2:.3, 5:.2) -> ids 4,2; source 1: 3,5
+    assert got_ids.reshape(2, 2).tolist() == [[4, 2], [3, 5]]
+    assert np.allclose(got_scores.reshape(2, 2), [[0.5, 0.3], [0.9, 0.05]])
+
+
+def test_beam_search_generation_matches_greedy():
+    """Full While-loop generation with beam_size=1 == numpy greedy rollout."""
+    V, D, H, T = 7, 4, 5, 4
+    end_id = 0
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        init_state = pd.data(name="init_state", shape=[H], dtype="float32")
+        init_ids = pd.data(name="init_ids", shape=[1], dtype="int64", lod_level=2)
+        init_scores = pd.data(
+            name="init_scores", shape=[1], dtype="float32", lod_level=2
+        )
+        array_len = pd.fill_constant(shape=[1], dtype="int64", value=T)
+        counter = pd.zeros(shape=[1], dtype="int64", force_cpu=True)
+        state_array = pd.create_array("float32")
+        pd.array_write(init_state, array=state_array, i=counter)
+        ids_array = pd.create_array("int64")
+        scores_array = pd.create_array("float32")
+        pd.array_write(init_ids, array=ids_array, i=counter)
+        pd.array_write(init_scores, array=scores_array, i=counter)
+        cond = pd.less_than(x=counter, y=array_len)
+        w = pd.While(cond=cond)
+        with w.block():
+            pre_ids = pd.array_read(array=ids_array, i=counter)
+            pre_state = pd.array_read(array=state_array, i=counter)
+            pre_score = pd.array_read(array=scores_array, i=counter)
+            pre_state_expanded = pd.sequence_expand(pre_state, pre_score)
+            pre_ids_emb = pd.embedding(
+                input=pre_ids,
+                size=[V, D],
+                dtype="float32",
+                param_attr=fluid.ParamAttr(name="emb_w"),
+            )
+            current_state = pd.fc(
+                input=[pre_ids_emb, pre_state_expanded],
+                size=H,
+                act="tanh",
+                param_attr=fluid.ParamAttr(name="dec_w"),
+                bias_attr=False,
+            )
+            current_score = pd.fc(
+                input=current_state,
+                size=V,
+                act="softmax",
+                param_attr=fluid.ParamAttr(name="out_w"),
+                bias_attr=False,
+            )
+            topk_scores, topk_indices = pd.topk(current_score, k=5)
+            sel_ids, sel_scores = pd.beam_search(
+                pre_ids, topk_indices, topk_scores, 1, end_id=end_id, level=0
+            )
+            pd.increment(x=counter, value=1, in_place=True)
+            pd.array_write(current_state, array=state_array, i=counter)
+            pd.array_write(sel_ids, array=ids_array, i=counter)
+            pd.array_write(sel_scores, array=scores_array, i=counter)
+            pd.less_than(x=counter, y=array_len, cond=cond)
+        trans_ids, trans_scores = pd.beam_search_decode(
+            ids=ids_array, scores=scores_array
+        )
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    B = 2  # two source "sentences"
+    rng = np.random.RandomState(3)
+    init_state_np = rng.randn(B, H).astype(np.float32)
+    feed = {
+        "init_state": init_state_np,
+        "init_ids": (np.full((B, 1), 1, np.int64), [list(range(B + 1))] * 2),
+        "init_scores": (np.ones((B, 1), np.float32), [list(range(B + 1))] * 2),
+    }
+    got_ids, got_lens = exe.run(
+        main, feed=feed, fetch_list=[trans_ids, trans_ids.lens_name]
+    )
+
+    scope = fluid.global_scope()
+    emb = np.asarray(scope.get("emb_w"))
+    dec_w = np.asarray(scope.get("dec_w"))
+    dec_u = np.asarray(scope.get("dec_w_0"))
+    out_w = np.asarray(scope.get("out_w"))
+
+    def softmax(z):
+        e = np.exp(z - z.max())
+        return e / e.sum()
+
+    for b in range(B):
+        state = init_state_np[b]
+        tok = 1
+        expect = [1]
+        for _ in range(T):
+            state = np.tanh(emb[tok] @ dec_w + state @ dec_u)
+            probs = softmax(state @ out_w)
+            tok = int(np.argmax(probs))
+            expect.append(tok)
+            if tok == end_id:
+                break
+        got = got_ids[b][: got_lens[b]].tolist()
+        assert got == expect, (b, got, expect)
+
+
+def test_beam_search_multi_prefix_feed():
+    """Direct 2-level feed with >1 live prefix per source: top-k must run
+    per SOURCE across all its prefixes (uniform widths)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = pd.data(name="pre_ids", shape=[1], dtype="int64", lod_level=2)
+        ids = pd.data(name="ids", shape=[2], dtype="int64")
+        scores = pd.data(name="scores", shape=[2], dtype="float32")
+        sel_ids, sel_scores = pd.beam_search(
+            pre_ids, ids, scores, beam_size=2, end_id=0, level=0
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # 2 sources x 2 prefixes each; best two candidates of source 0 both
+    # come from prefix 1
+    feed = {
+        "pre_ids": (
+            np.array([[1], [2], [3], [4]], np.int64),
+            [[0, 2, 4], [0, 1, 2, 3, 4]],
+        ),
+        "ids": np.array([[4, 2], [5, 6], [7, 8], [9, 3]], np.int64),
+        "scores": np.array(
+            [[0.1, 0.2], [0.6, 0.5], [0.3, 0.25], [0.9, 0.1]], np.float32
+        ),
+    }
+    got_ids, got_scores = exe.run(main, feed=feed, fetch_list=[sel_ids, sel_scores])
+    assert got_ids.reshape(2, 2).tolist() == [[5, 6], [9, 7]]
+    assert np.allclose(got_scores.reshape(2, 2), [[0.6, 0.5], [0.9, 0.3]])
+
+
+def _np_beam_rollout(init_states, emb, dec_w, dec_u, out_w, T, beam, end_id):
+    """Numpy oracle of the full-width beam search + decode pipeline."""
+
+    def softmax(z):
+        e = np.exp(z - z.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    B = init_states.shape[0]
+    results = []
+    for b in range(B):
+        # beams: (tokens, state, frozen_score, alive)
+        beams = [([1], init_states[b], 0.0, True)]
+        for _ in range(T):
+            cands = []
+            for pi, (toks, st, fsc, alive) in enumerate(beams):
+                if not alive:
+                    cands.append((fsc, pi, end_id, st))
+                    continue
+                nst = np.tanh(emb[toks[-1]] @ dec_w + st @ dec_u)
+                probs = softmax(nst @ out_w)
+                for v in np.argsort(-probs)[:8]:
+                    cands.append((float(probs[v]), pi, int(v), nst))
+            cands.sort(key=lambda c: -c[0])
+            new_beams = []
+            for sc, pi, v, nst in cands[:beam]:
+                ptoks, _, _, palive = beams[pi]
+                if not palive:
+                    new_beams.append((ptoks, nst, sc, False))
+                else:
+                    new_beams.append((ptoks + [v], nst, sc, v != end_id))
+            beams = new_beams
+        results.append([t for t, _, _, _ in [(b_[0], 0, 0, 0) for b_ in beams]])
+    return results
+
+
+def test_beam_search_width2_matches_numpy_oracle():
+    """beam_size=2 rollout: frozen beams, parent permutation, width 1->2
+    transition — checked token-for-token against a numpy beam search."""
+    V, D, H, T, BEAM = 9, 4, 5, 4, 2
+    end_id = 0
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        init_state = pd.data(name="init_state", shape=[H], dtype="float32")
+        init_ids = pd.data(name="init_ids", shape=[1], dtype="int64", lod_level=2)
+        init_scores = pd.data(
+            name="init_scores", shape=[1], dtype="float32", lod_level=2
+        )
+        array_len = pd.fill_constant(shape=[1], dtype="int64", value=T)
+        counter = pd.zeros(shape=[1], dtype="int64", force_cpu=True)
+        state_array = pd.create_array("float32")
+        pd.array_write(init_state, array=state_array, i=counter)
+        ids_array = pd.create_array("int64")
+        scores_array = pd.create_array("float32")
+        pd.array_write(init_ids, array=ids_array, i=counter)
+        pd.array_write(init_scores, array=scores_array, i=counter)
+        cond = pd.less_than(x=counter, y=array_len)
+        w = pd.While(cond=cond)
+        with w.block():
+            pre_ids = pd.array_read(array=ids_array, i=counter)
+            pre_state = pd.array_read(array=state_array, i=counter)
+            pre_score = pd.array_read(array=scores_array, i=counter)
+            pre_state_expanded = pd.sequence_expand(pre_state, pre_score)
+            pre_ids_emb = pd.embedding(
+                input=pre_ids,
+                size=[V, D],
+                dtype="float32",
+                param_attr=fluid.ParamAttr(name="emb2_w"),
+            )
+            current_state = pd.fc(
+                input=[pre_ids_emb, pre_state_expanded],
+                size=H,
+                act="tanh",
+                param_attr=fluid.ParamAttr(name="dec2_w"),
+                bias_attr=False,
+            )
+            current_score = pd.fc(
+                input=current_state,
+                size=V,
+                act="softmax",
+                param_attr=fluid.ParamAttr(name="out2_w"),
+                bias_attr=False,
+            )
+            topk_scores, topk_indices = pd.topk(current_score, k=8)
+            sel_ids, sel_scores = pd.beam_search(
+                pre_ids, topk_indices, topk_scores, BEAM, end_id=end_id, level=0
+            )
+            pd.increment(x=counter, value=1, in_place=True)
+            pd.array_write(current_state, array=state_array, i=counter)
+            pd.array_write(sel_ids, array=ids_array, i=counter)
+            pd.array_write(sel_scores, array=scores_array, i=counter)
+            pd.less_than(x=counter, y=array_len, cond=cond)
+        trans_ids, trans_scores = pd.beam_search_decode(
+            ids=ids_array, scores=scores_array
+        )
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    B = 3
+    rng = np.random.RandomState(7)
+    init_state_np = (2.0 * rng.randn(B, H)).astype(np.float32)
+    feed = {
+        "init_state": init_state_np,
+        "init_ids": (np.full((B, 1), 1, np.int64), [list(range(B + 1))] * 2),
+        "init_scores": (np.ones((B, 1), np.float32), [list(range(B + 1))] * 2),
+    }
+    got_ids, got_lens = exe.run(
+        main, feed=feed, fetch_list=[trans_ids, trans_ids.lens_name]
+    )
+
+    scope = fluid.global_scope()
+    emb = np.asarray(scope.get("emb2_w"))
+    dec_w = np.asarray(scope.get("dec2_w"))
+    dec_u = np.asarray(scope.get("dec2_w_0"))
+    out_w = np.asarray(scope.get("out2_w"))
+    oracle = _np_beam_rollout(
+        init_state_np, emb, dec_w, dec_u, out_w, T, BEAM, end_id
+    )
+    got = got_ids.reshape(B, BEAM, -1)
+    lens = got_lens.reshape(B, BEAM)
+    for b in range(B):
+        got_set = {tuple(got[b, k][: lens[b, k]].tolist()) for k in range(BEAM)}
+        want_set = {tuple(t) for t in oracle[b]}
+        assert got_set == want_set, (b, got_set, want_set)
