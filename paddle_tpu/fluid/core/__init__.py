@@ -31,6 +31,7 @@ from . import kernels_optim  # noqa: F401
 from . import kernels_sequence  # noqa: F401
 from . import kernels_rnn  # noqa: F401
 from . import kernels_control  # noqa: F401
+from . import kernels_crf  # noqa: F401
 from .lowering import AUTODIFF_OP, build_step_fn, lower_block
 
 

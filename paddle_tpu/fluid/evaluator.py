@@ -77,7 +77,56 @@ class Accuracy(Evaluator):
 
 
 class ChunkEvaluator(Evaluator):
-    def __init__(self, input, label, chunk_scheme, num_chunk_types, excluded_chunk_types=None):
-        raise NotImplementedError(
-            "ChunkEvaluator lands with the sequence-labeling (CRF) milestone"
+    """Streaming chunk precision/recall/F1 (reference evaluator.py
+    ChunkEvaluator; per-batch counts from layers.chunk_eval accumulated in
+    persistable state vars)."""
+
+    def __init__(
+        self, input, label, chunk_scheme, num_chunk_types,
+        excluded_chunk_types=None,
+    ):
+        super().__init__("chunk_eval")
+        main_program = self.helper.main_program
+        if main_program.current_block_idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+        self.num_infer_chunks = self.create_state(
+            dtype="int64", shape=[1], suffix="num_infer_chunks"
         )
+        self.num_label_chunks = self.create_state(
+            dtype="int64", shape=[1], suffix="num_label_chunks"
+        )
+        self.num_correct_chunks = self.create_state(
+            dtype="int64", shape=[1], suffix="num_correct_chunks"
+        )
+        precision, recall, f1, num_infer, num_label, num_correct = (
+            layers.chunk_eval(
+                input=input,
+                label=label,
+                chunk_scheme=chunk_scheme,
+                num_chunk_types=num_chunk_types,
+                excluded_chunk_types=excluded_chunk_types,
+            )
+        )
+        for state, batch in (
+            (self.num_infer_chunks, num_infer),
+            (self.num_label_chunks, num_label),
+            (self.num_correct_chunks, num_correct),
+        ):
+            self.helper.append_op(
+                type="sum", inputs={"X": [state, batch]}, outputs={"Out": [state]}
+            )
+        self.metrics.extend((precision, recall, f1))
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        infer = float(np.asarray(scope.get(self.num_infer_chunks.name))[0])
+        label = float(np.asarray(scope.get(self.num_label_chunks.name))[0])
+        correct = float(np.asarray(scope.get(self.num_correct_chunks.name))[0])
+        precision = correct / infer if infer else 0.0
+        recall = correct / label if label else 0.0
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if correct
+            else 0.0
+        )
+        return np.array([precision, recall, f1], dtype=np.float32)

@@ -121,6 +121,16 @@ class LayerHelper(object):
             dtype=dtype, shape=shape, **attr.to_kwargs()
         )
 
+    def get_parameter(self, name):
+        """Look up an existing Parameter by name (reference layer_helper
+        get_parameter; used by crf_decoding to share the CRF transitions)."""
+        param = self.main_program.global_block().var(name)
+        from .core.program import Parameter
+
+        if not isinstance(param, Parameter):
+            raise ValueError("variable %r is not a Parameter" % name)
+        return param
+
     def create_tmp_variable(self, dtype, stop_gradient=False, shape=None, lod_level=0):
         return self.main_program.current_block().create_var(
             name=unique_name(".".join([self.name, "tmp"])),

@@ -34,6 +34,8 @@ __all__ = [
     "sigmoid_cross_entropy_with_logits",
     "smooth_l1",
     "chunk_eval",
+    "linear_chain_crf",
+    "crf_decoding",
     "auc",
     "topk",
     "matmul",
@@ -544,9 +546,78 @@ def auc(input, label, curve="ROC", num_thresholds=200, **kwargs):
 
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types, excluded_chunk_types=None, **kwargs):
-    raise NotImplementedError(
-        "chunk_eval lands with the sequence-labeling (CRF) milestone"
+    """Chunk-level precision/recall/F1 (reference layers/nn.py chunk_eval ->
+    operators/chunk_eval_op; vectorised kernel in core/kernels_crf.py)."""
+    helper = LayerHelper("chunk_eval", **kwargs)
+    precision = helper.create_tmp_variable(dtype="float32")
+    recall = helper.create_tmp_variable(dtype="float32")
+    f1_score = helper.create_tmp_variable(dtype="float32")
+    num_infer = helper.create_tmp_variable(dtype="int64")
+    num_label = helper.create_tmp_variable(dtype="int64")
+    num_correct = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={
+            "Precision": [precision],
+            "Recall": [recall],
+            "F1-Score": [f1_score],
+            "NumInferChunks": [num_infer],
+            "NumLabelChunks": [num_label],
+            "NumCorrectChunks": [num_correct],
+        },
+        attrs={
+            "chunk_scheme": chunk_scheme,
+            "num_chunk_types": num_chunk_types,
+            "excluded_chunk_types": excluded_chunk_types or [],
+        },
     )
+    return precision, recall, f1_score, num_infer, num_label, num_correct
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """CRF negative log-likelihood over a ragged batch (reference
+    layers/nn.py linear_chain_crf -> operators/linear_chain_crf_op).
+    Transition parameter is [size+2, size]: start row, end row, then the
+    [size, size] transition matrix."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=helper.input_dtype()
+    )
+    alpha = helper.create_tmp_variable(dtype=helper.input_dtype())
+    emission_exps = helper.create_tmp_variable(dtype=helper.input_dtype())
+    transition_exps = helper.create_tmp_variable(dtype=helper.input_dtype())
+    log_likelihood = helper.create_tmp_variable(dtype=helper.input_dtype())
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition], "Label": [label]},
+        outputs={
+            "Alpha": [alpha],
+            "EmissionExps": [emission_exps],
+            "TransitionExps": [transition_exps],
+            "LogLikelihood": [log_likelihood],
+        },
+    )
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode with the trained CRF transitions (reference
+    layers/nn.py crf_decoding -> operators/crf_decoding_op). With `label`,
+    returns per-token correctness instead of the path."""
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.get_parameter(param_attr.name)
+    viterbi_path = helper.create_tmp_variable(dtype="int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(
+        type="crf_decoding",
+        inputs=inputs,
+        outputs={"ViterbiPath": [viterbi_path]},
+    )
+    return viterbi_path
 
 
 def topk(input, k, **kwargs):

@@ -1,0 +1,203 @@
+"""Book test: semantic role labeling — db_lstm + CRF + ChunkEvaluator.
+
+Parity with reference python/paddle/v2/fluid/tests/book/
+test_label_semantic_roles.py: the 8-feature db_lstm stack (embeddings ->
+fc sums -> alternating fwd/rev dynamic_lstm), linear_chain_crf cost with a
+per-param learning rate, exponential_decay LR schedule on a global step,
+crf_decoding and the streaming ChunkEvaluator. conll05 is replaced by a
+synthetic corpus (label depends on word parity and predicate mark) and the
+dims are scaled down for CI."""
+
+import math
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+pd = fluid.layers
+
+WORD_DICT_LEN = 30
+LABEL_DICT_LEN = 5  # B-0 I-0 B-1 I-1 O
+PRED_LEN = 10
+MARK_DICT_LEN = 2
+WORD_DIM = 8
+MARK_DIM = 4
+HIDDEN = 16
+DEPTH = 4
+MIX_HIDDEN_LR = 1e-3
+EMB_NAME = "emb"
+
+
+def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark,
+            **ignored):
+    predicate_embedding = pd.embedding(
+        input=predicate,
+        size=[PRED_LEN, WORD_DIM],
+        dtype="float32",
+        param_attr="vemb",
+    )
+    mark_embedding = pd.embedding(
+        input=mark, size=[MARK_DICT_LEN, MARK_DIM], dtype="float32"
+    )
+    word_input = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    emb_layers = [
+        pd.embedding(
+            size=[WORD_DICT_LEN, WORD_DIM],
+            input=x,
+            param_attr=fluid.ParamAttr(name=EMB_NAME, trainable=False),
+        )
+        for x in word_input
+    ]
+    emb_layers.append(predicate_embedding)
+    emb_layers.append(mark_embedding)
+
+    hidden_0_layers = [pd.fc(input=emb, size=HIDDEN) for emb in emb_layers]
+    hidden_0 = pd.sums(input=hidden_0_layers)
+    lstm_0 = pd.dynamic_lstm(
+        input=hidden_0,
+        size=HIDDEN,
+        candidate_activation="relu",
+        gate_activation="sigmoid",
+        cell_activation="sigmoid",
+    )[0]
+
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, DEPTH):
+        mix_hidden = pd.sums(
+            input=[
+                pd.fc(input=input_tmp[0], size=HIDDEN),
+                pd.fc(input=input_tmp[1], size=HIDDEN),
+            ]
+        )
+        lstm = pd.dynamic_lstm(
+            input=mix_hidden,
+            size=HIDDEN,
+            candidate_activation="relu",
+            gate_activation="sigmoid",
+            cell_activation="sigmoid",
+            is_reverse=((i % 2) == 1),
+        )[0]
+        input_tmp = [mix_hidden, lstm]
+
+    feature_out = pd.sums(
+        input=[
+            pd.fc(input=input_tmp[0], size=LABEL_DICT_LEN),
+            pd.fc(input=input_tmp[1], size=LABEL_DICT_LEN),
+        ]
+    )
+    return feature_out
+
+
+def synthetic_srl(rng, n):
+    """Sentences whose gold labels are derivable: tokens near the marked
+    predicate are chunk type 1, low words are chunk type 0, rest O."""
+    samples = []
+    for _ in range(n):
+        l = int(rng.randint(3, 9))
+        words = rng.randint(2, WORD_DICT_LEN, l)
+        pred_pos = int(rng.randint(0, l))
+        pred = np.full(l, int(rng.randint(0, PRED_LEN)))
+        mark = (np.arange(l) == pred_pos).astype(np.int64)
+        labels = np.full(l, 4)
+        labels[mark == 1] = 2  # B-1 at predicate
+        labels[words < WORD_DICT_LEN // 3] = 0  # B-0
+        ctx = {
+            "n2": np.roll(words, 2),
+            "n1": np.roll(words, 1),
+            "0": words,
+            "p1": np.roll(words, -1),
+            "p2": np.roll(words, -2),
+        }
+        samples.append((words, pred, ctx, mark, labels))
+    return samples
+
+
+def to_feed(samples):
+    lens = [len(s[0]) for s in samples]
+    lod = [np.cumsum([0] + lens).astype(np.int32)]
+
+    def pack(key):
+        return (
+            np.concatenate([key(s) for s in samples]).reshape(-1, 1).astype(np.int64),
+            lod,
+        )
+
+    return {
+        "word_data": pack(lambda s: s[0]),
+        "verb_data": pack(lambda s: s[1]),
+        "ctx_n2_data": pack(lambda s: s[2]["n2"]),
+        "ctx_n1_data": pack(lambda s: s[2]["n1"]),
+        "ctx_0_data": pack(lambda s: s[2]["0"]),
+        "ctx_p1_data": pack(lambda s: s[2]["p1"]),
+        "ctx_p2_data": pack(lambda s: s[2]["p2"]),
+        "mark_data": pack(lambda s: s[3]),
+        "target": pack(lambda s: s[4]),
+    }
+
+
+def test_train():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        word = pd.data(name="word_data", shape=[1], dtype="int64", lod_level=1)
+        predicate = pd.data(name="verb_data", shape=[1], dtype="int64", lod_level=1)
+        ctx_n2 = pd.data(name="ctx_n2_data", shape=[1], dtype="int64", lod_level=1)
+        ctx_n1 = pd.data(name="ctx_n1_data", shape=[1], dtype="int64", lod_level=1)
+        ctx_0 = pd.data(name="ctx_0_data", shape=[1], dtype="int64", lod_level=1)
+        ctx_p1 = pd.data(name="ctx_p1_data", shape=[1], dtype="int64", lod_level=1)
+        ctx_p2 = pd.data(name="ctx_p2_data", shape=[1], dtype="int64", lod_level=1)
+        mark = pd.data(name="mark_data", shape=[1], dtype="int64", lod_level=1)
+        feature_out = db_lstm(**locals())
+        target = pd.data(name="target", shape=[1], dtype="int64", lod_level=1)
+        crf_cost = pd.linear_chain_crf(
+            input=feature_out,
+            label=target,
+            param_attr=fluid.ParamAttr(name="crfw", learning_rate=MIX_HIDDEN_LR),
+        )
+        avg_cost = pd.mean(x=crf_cost)
+
+        global_step = pd.create_global_var(
+            shape=[1], value=0, dtype="float32", force_cpu=True, persistable=True
+        )
+        sgd_optimizer = fluid.optimizer.SGD(
+            learning_rate=fluid.learning_rate_decay.exponential_decay(
+                learning_rate=0.01,
+                global_step=global_step,
+                decay_steps=100000,
+                decay_rate=0.5,
+                staircase=True,
+            ),
+            global_step=global_step,
+        )
+        sgd_optimizer.minimize(avg_cost)
+
+        crf_decode = pd.crf_decoding(
+            input=feature_out, param_attr=fluid.ParamAttr(name="crfw")
+        )
+        chunk_evaluator = fluid.evaluator.ChunkEvaluator(
+            input=crf_decode,
+            label=target,
+            chunk_scheme="IOB",
+            num_chunk_types=int(math.ceil((LABEL_DICT_LEN - 1) / 2.0)),
+        )
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    samples = synthetic_srl(rng, 12)
+    feed = to_feed(samples)
+    chunk_evaluator.reset(exe)
+    losses = []
+    for _ in range(25):
+        cost, precision, recall, f1 = exe.run(
+            main,
+            feed=feed,
+            fetch_list=[avg_cost] + list(chunk_evaluator.metrics),
+        )
+        losses.append(float(np.ravel(cost)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    p, r, f1 = chunk_evaluator.eval(exe)
+    assert 0.0 <= p <= 1.0 and 0.0 <= r <= 1.0
+    # global step advanced once per run
+    assert int(np.asarray(fluid.global_scope().get(global_step.name))[0]) == 25

@@ -1,0 +1,205 @@
+"""Book test: seq2seq machine translation — train + beam-search decode.
+
+Parity with reference python/paddle/v2/fluid/tests/book/
+test_machine_translation.py (encoder = embedding+fc+dynamic_lstm, train
+decoder = DynamicRNN over target tokens, decode = While loop + beam_search
++ beam_search_decode). The wmt14 dataset is replaced by a synthetic
+reverse-copy corpus so the test is hermetic; the topology and the training
+loop are the book's.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+pd = fluid.layers
+
+DICT_SIZE = 40
+WORD_DIM = 16
+HIDDEN = 32
+DECODER_SIZE = HIDDEN
+BATCH = 8
+MAX_LEN = 6
+BEAM = 2
+START_ID = 1
+END_ID = 2
+
+
+def encoder():
+    src_word_id = pd.data(name="src_word_id", shape=[1], dtype="int64", lod_level=1)
+    src_embedding = pd.embedding(
+        input=src_word_id,
+        size=[DICT_SIZE, WORD_DIM],
+        dtype="float32",
+        param_attr=fluid.ParamAttr(name="vemb"),
+    )
+    fc1 = pd.fc(input=src_embedding, size=HIDDEN * 4, act="tanh")
+    lstm_hidden0, lstm_0 = pd.dynamic_lstm(input=fc1, size=HIDDEN * 4)
+    encoder_out = pd.sequence_last_step(input=lstm_hidden0)
+    return encoder_out
+
+
+def decoder_train(context):
+    trg_language_word = pd.data(
+        name="target_language_word", shape=[1], dtype="int64", lod_level=1
+    )
+    trg_embedding = pd.embedding(
+        input=trg_language_word,
+        size=[DICT_SIZE, WORD_DIM],
+        dtype="float32",
+        param_attr=fluid.ParamAttr(name="vemb"),
+    )
+    rnn = pd.DynamicRNN()
+    with rnn.block():
+        current_word = rnn.step_input(trg_embedding)
+        pre_state = rnn.memory(init=context)
+        current_state = pd.fc(
+            input=[current_word, pre_state], size=DECODER_SIZE, act="tanh"
+        )
+        current_score = pd.fc(input=current_state, size=DICT_SIZE, act="softmax")
+        rnn.update_memory(pre_state, current_state)
+        rnn.output(current_score)
+    return rnn()
+
+
+def decoder_decode(context):
+    init_state = context
+    array_len = pd.fill_constant(shape=[1], dtype="int64", value=MAX_LEN)
+    counter = pd.zeros(shape=[1], dtype="int64", force_cpu=True)
+
+    state_array = pd.create_array("float32")
+    pd.array_write(init_state, array=state_array, i=counter)
+    ids_array = pd.create_array("int64")
+    scores_array = pd.create_array("float32")
+
+    init_ids = pd.data(name="init_ids", shape=[1], dtype="int64", lod_level=2)
+    init_scores = pd.data(
+        name="init_scores", shape=[1], dtype="float32", lod_level=2
+    )
+    pd.array_write(init_ids, array=ids_array, i=counter)
+    pd.array_write(init_scores, array=scores_array, i=counter)
+
+    cond = pd.less_than(x=counter, y=array_len)
+    while_op = pd.While(cond=cond)
+    with while_op.block():
+        pre_ids = pd.array_read(array=ids_array, i=counter)
+        pre_state = pd.array_read(array=state_array, i=counter)
+        pre_score = pd.array_read(array=scores_array, i=counter)
+        pre_state_expanded = pd.sequence_expand(pre_state, pre_score)
+        pre_ids_emb = pd.embedding(
+            input=pre_ids,
+            size=[DICT_SIZE, WORD_DIM],
+            dtype="float32",
+            param_attr=fluid.ParamAttr(name="vemb"),
+        )
+        current_state = pd.fc(
+            input=[pre_ids_emb, pre_state_expanded], size=DECODER_SIZE, act="tanh"
+        )
+        current_score = pd.fc(input=current_state, size=DICT_SIZE, act="softmax")
+        topk_scores, topk_indices = pd.topk(current_score, k=10)
+        selected_ids, selected_scores = pd.beam_search(
+            pre_ids, topk_indices, topk_scores, BEAM, end_id=END_ID, level=0
+        )
+        pd.increment(x=counter, value=1, in_place=True)
+        pd.array_write(current_state, array=state_array, i=counter)
+        pd.array_write(selected_ids, array=ids_array, i=counter)
+        pd.array_write(selected_scores, array=scores_array, i=counter)
+        pd.less_than(x=counter, y=array_len, cond=cond)
+
+    translation_ids, translation_scores = pd.beam_search_decode(
+        ids=ids_array, scores=scores_array
+    )
+    return translation_ids, translation_scores
+
+
+def synthetic_wmt(rng, n):
+    """Reverse-copy corpus: target is the reversed source. Triples of
+    (src, trg_input=<s>+rev, trg_next=rev+<e>), ragged lengths."""
+    data = []
+    for _ in range(n):
+        l = rng.randint(2, 5)
+        src = rng.randint(3, DICT_SIZE, size=l)
+        rev = src[::-1]
+        data.append(
+            (
+                src.tolist(),
+                [START_ID] + rev.tolist(),
+                rev.tolist() + [END_ID],
+            )
+        )
+    return data
+
+
+def to_lod_feed(seqs):
+    lens = [len(s) for s in seqs]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    flat = np.concatenate([np.asarray(s) for s in seqs]).reshape(-1, 1)
+    return flat.astype(np.int64), [lod]
+
+
+def test_train_main():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        context = encoder()
+        rnn_out = decoder_train(context)
+        label = pd.data(
+            name="target_language_next_word", shape=[1], dtype="int64", lod_level=1
+        )
+        cost = pd.cross_entropy(input=rnn_out, label=label)
+        avg_cost = pd.mean(x=cost)
+        optimizer = fluid.optimizer.Adagrad(learning_rate=0.2)
+        optimizer.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    data = synthetic_wmt(rng, BATCH)
+    src = to_lod_feed([d[0] for d in data])
+    trg = to_lod_feed([d[1] for d in data])
+    nxt = to_lod_feed([d[2] for d in data])
+    losses = []
+    for _ in range(40):
+        (c,) = exe.run(
+            main,
+            feed={
+                "src_word_id": src,
+                "target_language_word": trg,
+                "target_language_next_word": nxt,
+            },
+            fetch_list=[avg_cost],
+        )
+        losses.append(float(np.ravel(c)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_decode_main():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        context = encoder()
+        translation_ids, translation_scores = decoder_decode(context)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(1)
+    data = synthetic_wmt(rng, BATCH)
+    src = to_lod_feed([d[0] for d in data])
+    init_ids = (
+        np.full((BATCH, 1), START_ID, np.int64),
+        [list(range(BATCH + 1))] * 2,
+    )
+    init_scores = (np.ones((BATCH, 1), np.float32), [list(range(BATCH + 1))] * 2)
+    ids, lens, scores = exe.run(
+        main,
+        feed={"src_word_id": src, "init_ids": init_ids, "init_scores": init_scores},
+        fetch_list=[translation_ids, translation_ids.lens_name, translation_scores],
+    )
+    assert ids.shape == (BATCH * BEAM, MAX_LEN + 1)
+    assert scores.shape == ids.shape
+    assert (ids[:, 0] == START_ID).all()
+    assert ((lens >= 1) & (lens <= MAX_LEN + 1)).all()
+    # every emitted token is a valid vocab id
+    assert ((ids >= 0) & (ids < DICT_SIZE)).all()
