@@ -1,0 +1,142 @@
+"""Book test: CIFAR image classification — VGG16-BN and ResNet towers.
+
+Parity with reference python/paddle/v2/fluid/tests/book/
+test_image_classification.py: vgg16_bn_drop (nets.img_conv_group with
+batchnorm+dropout) and resnet_cifar10 (conv_bn basicblocks with
+elementwise_add shortcuts), trained with Adam, eval via a
+clone(for_test=True) program. CIFAR is replaced by synthetic separable
+images; the resnet depth is reduced for CI speed."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+pd = fluid.layers
+
+CLASSDIM = 10
+DATA_SHAPE = [3, 32, 32]
+
+
+def resnet_cifar10(input, depth=8):
+    def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
+        tmp = pd.conv2d(
+            input=input,
+            filter_size=filter_size,
+            num_filters=ch_out,
+            stride=stride,
+            padding=padding,
+            act=None,
+            bias_attr=False,
+        )
+        return pd.batch_norm(input=tmp, act=act)
+
+    def shortcut(input, ch_in, ch_out, stride):
+        if ch_in != ch_out:
+            return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return input
+
+    def basicblock(input, ch_in, ch_out, stride):
+        tmp = conv_bn_layer(input, ch_out, 3, stride, 1)
+        tmp = conv_bn_layer(tmp, ch_out, 3, 1, 1, act=None)
+        short = shortcut(input, ch_in, ch_out, stride)
+        return pd.elementwise_add(x=tmp, y=short, act="relu")
+
+    def layer_warp(block_func, input, ch_in, ch_out, count, stride):
+        tmp = block_func(input, ch_in, ch_out, stride)
+        for _ in range(1, count):
+            tmp = block_func(tmp, ch_out, ch_out, 1)
+        return tmp
+
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input=input, ch_out=16, filter_size=3, stride=1, padding=1)
+    res1 = layer_warp(basicblock, conv1, 16, 16, n, 1)
+    res2 = layer_warp(basicblock, res1, 16, 32, n, 2)
+    res3 = layer_warp(basicblock, res2, 32, 64, n, 2)
+    pool = pd.pool2d(input=res3, pool_size=8, pool_type="avg", pool_stride=1)
+    return pool
+
+
+def vgg_bn_drop(input):
+    """Book vgg16_bn_drop with fewer filters (same structure) for CI."""
+
+    def conv_block(input, num_filter, groups, dropouts):
+        return fluid.nets.img_conv_group(
+            input=input,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * groups,
+            conv_filter_size=3,
+            conv_act="relu",
+            conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts,
+            pool_type="max",
+        )
+
+    conv1 = conv_block(input, 16, 2, [0.3, 0])
+    conv2 = conv_block(conv1, 32, 2, [0.4, 0])
+    drop = pd.dropout(x=conv2, dropout_prob=0.5)
+    fc1 = pd.fc(input=drop, size=64, act=None)
+    bn = pd.batch_norm(input=fc1, act="relu")
+    drop2 = pd.dropout(x=bn, dropout_prob=0.5)
+    fc2 = pd.fc(input=drop2, size=64, act=None)
+    return fc2
+
+
+def synthetic_cifar(rng, n):
+    """Class-separable images: class k has mean intensity k/CLASSDIM in a
+    class-specific channel pattern."""
+    labels = rng.randint(0, CLASSDIM, (n, 1)).astype(np.int64)
+    imgs = rng.randn(n, *DATA_SHAPE).astype(np.float32) * 0.2
+    for i, lab in enumerate(labels[:, 0]):
+        imgs[i, lab % 3] += (lab + 1) / CLASSDIM
+    return imgs, labels
+
+
+def _run(net_type, steps, batch):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = pd.data(name="pixel", shape=DATA_SHAPE, dtype="float32")
+        label = pd.data(name="label", shape=[1], dtype="int64")
+        if net_type == "vgg":
+            net = vgg_bn_drop(images)
+        else:
+            net = resnet_cifar10(images, 8)
+        predict = pd.fc(input=net, size=CLASSDIM, act="softmax")
+        cost = pd.cross_entropy(input=predict, label=label)
+        avg_cost = pd.mean(x=cost)
+        acc = pd.accuracy(input=predict, label=label)
+        test_program = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    imgs, labels = synthetic_cifar(rng, batch)
+    losses = []
+    for _ in range(steps):
+        c, a = exe.run(
+            main, feed={"pixel": imgs, "label": labels}, fetch_list=[avg_cost, acc]
+        )
+        losses.append(float(np.ravel(c)[0]))
+    assert np.isfinite(losses).all(), losses
+    # eval through the for_test clone (BN uses running stats, dropout off)
+    c1, a1 = exe.run(
+        test_program, feed={"pixel": imgs, "label": labels},
+        fetch_list=[avg_cost, acc],
+    )
+    c2, _ = exe.run(
+        test_program, feed={"pixel": imgs, "label": labels},
+        fetch_list=[avg_cost, acc],
+    )
+    assert np.allclose(c1, c2), "for_test clone must be deterministic"
+    return losses
+
+
+def test_resnet():
+    losses = _run("resnet", steps=12, batch=16)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_vgg():
+    losses = _run("vgg", steps=4, batch=8)

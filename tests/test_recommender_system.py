@@ -1,0 +1,153 @@
+"""Book test: recommender system (movielens-style two-tower model).
+
+Parity with reference python/paddle/v2/fluid/tests/book/
+test_recommender_system.py: user tower (4 embeddings -> fcs -> concat ->
+fc) and movie tower (embedding + ragged category sum-pool + ragged title
+sequence_conv_pool -> concat -> fc), cosine similarity scaled to the 1-5
+rating range, squared-error regression. Movielens is replaced by synthetic
+data with a learnable structure."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+nets = fluid.nets
+
+USR_DICT_SIZE = 20
+USR_GENDER_DICT_SIZE = 2
+USR_AGE_DICT_SIZE = 7
+USR_JOB_DICT_SIZE = 10
+MOV_DICT_SIZE = 30
+CATEGORY_DICT_SIZE = 8
+MOV_TITLE_DICT_SIZE = 40
+BATCH = 16
+
+
+def get_usr_combined_features():
+    uid = layers.data(name="user_id", shape=[1], dtype="int64")
+    usr_emb = layers.embedding(
+        input=uid, dtype="float32", size=[USR_DICT_SIZE, 32],
+        param_attr="user_table",
+    )
+    usr_fc = layers.fc(input=usr_emb, size=32)
+
+    usr_gender_id = layers.data(name="gender_id", shape=[1], dtype="int64")
+    usr_gender_emb = layers.embedding(
+        input=usr_gender_id, size=[USR_GENDER_DICT_SIZE, 16],
+        param_attr="gender_table",
+    )
+    usr_gender_fc = layers.fc(input=usr_gender_emb, size=16)
+
+    usr_age_id = layers.data(name="age_id", shape=[1], dtype="int64")
+    usr_age_emb = layers.embedding(
+        input=usr_age_id, size=[USR_AGE_DICT_SIZE, 16], param_attr="age_table"
+    )
+    usr_age_fc = layers.fc(input=usr_age_emb, size=16)
+
+    usr_job_id = layers.data(name="job_id", shape=[1], dtype="int64")
+    usr_job_emb = layers.embedding(
+        input=usr_job_id, size=[USR_JOB_DICT_SIZE, 16], param_attr="job_table"
+    )
+    usr_job_fc = layers.fc(input=usr_job_emb, size=16)
+
+    concat_embed = layers.concat(
+        input=[usr_fc, usr_gender_fc, usr_age_fc, usr_job_fc], axis=1
+    )
+    return layers.fc(input=concat_embed, size=64, act="tanh")
+
+
+def get_mov_combined_features():
+    mov_id = layers.data(name="movie_id", shape=[1], dtype="int64")
+    mov_emb = layers.embedding(
+        input=mov_id, dtype="float32", size=[MOV_DICT_SIZE, 32],
+        param_attr="movie_table",
+    )
+    mov_fc = layers.fc(input=mov_emb, size=32)
+
+    category_id = layers.data(
+        name="category_id", shape=[1], dtype="int64", lod_level=1
+    )
+    mov_categories_emb = layers.embedding(
+        input=category_id, size=[CATEGORY_DICT_SIZE, 32]
+    )
+    mov_categories_hidden = layers.sequence_pool(
+        input=mov_categories_emb, pool_type="sum"
+    )
+
+    mov_title_id = layers.data(
+        name="movie_title", shape=[1], dtype="int64", lod_level=1
+    )
+    mov_title_emb = layers.embedding(
+        input=mov_title_id, size=[MOV_TITLE_DICT_SIZE, 32]
+    )
+    mov_title_conv = nets.sequence_conv_pool(
+        input=mov_title_emb, num_filters=32, filter_size=3, act="tanh",
+        pool_type="sum",
+    )
+
+    concat_embed = layers.concat(
+        input=[mov_fc, mov_categories_hidden, mov_title_conv], axis=1
+    )
+    return layers.fc(input=concat_embed, size=64, act="tanh")
+
+
+def model():
+    usr = get_usr_combined_features()
+    mov = get_mov_combined_features()
+    inference = layers.cos_sim(X=usr, Y=mov)
+    scale_infer = layers.scale(x=inference, scale=5.0)
+    label = layers.data(name="score", shape=[1], dtype="float32")
+    square_cost = layers.square_error_cost(input=scale_infer, label=label)
+    avg_cost = layers.mean(x=square_cost)
+    return scale_infer, avg_cost
+
+
+def synthetic_batch(rng):
+    uid = rng.randint(0, USR_DICT_SIZE, (BATCH, 1))
+    gender = rng.randint(0, USR_GENDER_DICT_SIZE, (BATCH, 1))
+    age = rng.randint(0, USR_AGE_DICT_SIZE, (BATCH, 1))
+    job = rng.randint(0, USR_JOB_DICT_SIZE, (BATCH, 1))
+    mov = rng.randint(0, MOV_DICT_SIZE, (BATCH, 1))
+    cat_lens = rng.randint(1, 4, BATCH)
+    cats = np.concatenate(
+        [rng.randint(0, CATEGORY_DICT_SIZE, (l, 1)) for l in cat_lens]
+    )
+    cat_lod = np.cumsum([0] + list(cat_lens)).astype(np.int32)
+    title_lens = rng.randint(2, 6, BATCH)
+    titles = np.concatenate(
+        [rng.randint(0, MOV_TITLE_DICT_SIZE, (l, 1)) for l in title_lens]
+    )
+    title_lod = np.cumsum([0] + list(title_lens)).astype(np.int32)
+    # learnable target: high score when user id parity matches movie parity
+    score = (3.0 + 2.0 * ((uid % 2) == (mov % 2))).astype(np.float32)
+    return {
+        "user_id": uid.astype(np.int64),
+        "gender_id": gender.astype(np.int64),
+        "age_id": age.astype(np.int64),
+        "job_id": job.astype(np.int64),
+        "movie_id": mov.astype(np.int64),
+        "category_id": (cats.astype(np.int64), [cat_lod]),
+        "movie_title": (titles.astype(np.int64), [title_lod]),
+        "score": score,
+    }
+
+
+def test_train():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        scale_infer, avg_cost = model()
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = synthetic_batch(rng)
+    losses = []
+    for _ in range(40):
+        (c,) = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        losses.append(float(np.ravel(c)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # predictions live in the scaled [−5, 5] range
+    (pred,) = exe.run(main, feed=feed, fetch_list=[scale_infer])
+    assert (np.abs(pred) <= 5.0 + 1e-5).all()

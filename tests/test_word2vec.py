@@ -1,0 +1,119 @@
+"""Book test: word2vec N-gram model + inference-model round trip.
+
+Parity with reference python/paddle/v2/fluid/tests/book/test_word2vec.py:
+four context-word embeddings (shared 'shared_w' param), concat -> fc ->
+softmax, trained with SGD; then save_inference_model/load_inference_model
+and an inference run. imikolov is replaced by a synthetic corpus."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+pd = fluid.layers
+
+DICT_SIZE = 50
+EMBED_SIZE = 16
+HIDDEN_SIZE = 64
+N = 5
+BATCH = 32
+
+
+def network(words):
+    embs = []
+    for i, w in enumerate(words):
+        embs.append(
+            pd.embedding(
+                input=w,
+                size=[DICT_SIZE, EMBED_SIZE],
+                dtype="float32",
+                param_attr="shared_w",
+            )
+        )
+    concat_embed = pd.concat(input=embs, axis=1)
+    hidden1 = pd.fc(input=concat_embed, size=HIDDEN_SIZE, act="sigmoid")
+    predict_word = pd.fc(input=hidden1, size=DICT_SIZE, act="softmax")
+    return predict_word
+
+
+def synthetic_ngrams(rng, n):
+    """Deterministic structure: next word = (sum of context) % DICT_SIZE."""
+    ctx = rng.randint(0, DICT_SIZE, (n, N - 1))
+    nxt = ctx.sum(axis=1) % DICT_SIZE
+    return ctx.astype(np.int64), nxt.reshape(-1, 1).astype(np.int64)
+
+
+def test_train_and_infer_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        first = pd.data(name="firstw", shape=[1], dtype="int64")
+        second = pd.data(name="secondw", shape=[1], dtype="int64")
+        third = pd.data(name="thirdw", shape=[1], dtype="int64")
+        forth = pd.data(name="forthw", shape=[1], dtype="int64")
+        next_word = pd.data(name="nextw", shape=[1], dtype="int64")
+        predict_word = network([first, second, third, forth])
+        cost = pd.cross_entropy(input=predict_word, label=next_word)
+        avg_cost = pd.mean(x=cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    ctx, nxt = synthetic_ngrams(rng, BATCH)
+    feed = {
+        "firstw": ctx[:, 0:1],
+        "secondw": ctx[:, 1:2],
+        "thirdw": ctx[:, 2:3],
+        "forthw": ctx[:, 3:4],
+        "nextw": nxt,
+    }
+    losses = []
+    for _ in range(30):
+        (c,) = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        losses.append(float(np.ravel(c)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    # ---- save_inference_model / load_inference_model round trip --------
+    with tempfile.TemporaryDirectory() as d:
+        fluid.io.save_inference_model(
+            d,
+            ["firstw", "secondw", "thirdw", "forthw"],
+            [predict_word],
+            exe,
+            main_program=main,
+        )
+        (
+            inference_program,
+            feed_target_names,
+            fetch_targets,
+        ) = fluid.io.load_inference_model(d, exe)
+        assert feed_target_names == ["firstw", "secondw", "thirdw", "forthw"]
+        (probs,) = exe.run(
+            inference_program,
+            feed={
+                feed_target_names[0]: ctx[:1, 0:1],
+                feed_target_names[1]: ctx[:1, 1:2],
+                feed_target_names[2]: ctx[:1, 2:3],
+                feed_target_names[3]: ctx[:1, 3:4],
+            },
+            fetch_list=fetch_targets,
+        )
+        assert probs.shape == (1, DICT_SIZE)
+        assert np.isclose(probs.sum(), 1.0, atol=1e-4)
+
+        # same feed through the training program's forward gives same probs
+        (train_probs,) = exe.run(
+            main,
+            feed={
+                "firstw": ctx[:1, 0:1],
+                "secondw": ctx[:1, 1:2],
+                "thirdw": ctx[:1, 2:3],
+                "forthw": ctx[:1, 3:4],
+                "nextw": nxt[:1],
+            },
+            fetch_list=[predict_word],
+        )
+        assert np.allclose(probs, train_probs, atol=1e-5)
