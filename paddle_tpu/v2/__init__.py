@@ -1,0 +1,45 @@
+"""paddle.v2-compatible API surface (reference python/paddle/v2/__init__.py).
+
+`import paddle_tpu.v2 as paddle` gives the classic v2 workflow:
+
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name='x', type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.fc(input=x, size=1)
+    ...
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=paddle.optimizer.Momentum(...))
+    trainer.train(paddle.batch(paddle.reader.shuffle(...), 128), ...)
+
+The engine underneath is the fluid Program + XLA executor — `init`'s
+use_gpu/trainer_count map to the TPU chip / mesh data axis."""
+
+from . import activation  # noqa: F401
+from . import data_type  # noqa: F401
+from . import dataset  # noqa: F401
+from . import event  # noqa: F401
+from . import layer  # noqa: F401
+from . import minibatch  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import parameters  # noqa: F401
+from . import reader  # noqa: F401
+from . import trainer  # noqa: F401
+from .minibatch import batch  # noqa: F401
+from .trainer import infer  # noqa: F401
+
+# `import paddle.v2.fluid as fluid` parity: the fluid package is shared
+from .. import fluid  # noqa: F401
+
+__all__ = [
+    "init", "batch", "infer", "layer", "activation", "data_type", "dataset",
+    "event", "minibatch", "optimizer", "parameters", "reader", "trainer",
+    "fluid",
+]
+
+
+def init(**kwargs):
+    """Accepted for API parity: use_gpu / trainer_count / log levels. On
+    TPU the device exists from process start (XLA owns it) and
+    trainer_count maps to the mesh data axis configured via
+    paddle_tpu.parallel."""
+    return None

@@ -1,0 +1,25 @@
+"""Dataset reader creators (reference python/paddle/v2/dataset/*).
+
+The reference downloads real corpora into ~/.cache/paddle/dataset; this
+environment has no network egress, so each module serves a deterministic
+synthetic corpus with the exact record shapes, vocab APIs and reader-
+creator signatures of the original. Swap in real data by dropping files
+into the cache dir and extending `common.load_cached` (the synthetic
+generators are the fallback, not the format)."""
+
+from . import (  # noqa: F401
+    cifar,
+    common,
+    conll05,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    uci_housing,
+    wmt14,
+)
+
+__all__ = [
+    "mnist", "cifar", "imdb", "imikolov", "movielens", "uci_housing",
+    "wmt14", "conll05", "common",
+]

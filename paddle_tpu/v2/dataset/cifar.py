@@ -1,0 +1,36 @@
+"""CIFAR reader creators (reference dataset/cifar.py API: train10/test10
+yield (3072 floats, int label); train100/test100 likewise)."""
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader(split, n, classes):
+    def reader():
+        rng = common.rng_for("cifar%d" % classes, split)
+        for _ in range(n):
+            label = int(rng.randint(0, classes))
+            img = rng.randn(3072) * 0.2
+            img[(label % 3) * 1024:(label % 3) * 1024 + 256] += (
+                (label + 1) / classes
+            )
+            yield img.astype("float32"), label
+
+    return reader
+
+
+def train10():
+    return _reader("train", 512, 10)
+
+
+def test10():
+    return _reader("test", 128, 10)
+
+
+def train100():
+    return _reader("train", 512, 100)
+
+
+def test100():
+    return _reader("test", 128, 100)

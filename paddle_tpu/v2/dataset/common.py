@@ -1,0 +1,37 @@
+"""Shared dataset plumbing (reference dataset/common.py: download cache,
+reader converters). Here: deterministic RNG streams for the synthetic
+corpora + the cache-dir convention kept for drop-in real data."""
+
+import os
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+__all__ = ["DATA_HOME", "rng_for", "md5file", "download"]
+
+
+def rng_for(name: str, split: str) -> np.random.RandomState:
+    # crc32, not hash(): Python's per-process hash salt would give a
+    # different synthetic corpus on every interpreter run
+    import zlib
+
+    seed = zlib.crc32(("%s/%s" % (name, split)).encode()) % (2**31)
+    return np.random.RandomState(seed)
+
+
+def md5file(fname):
+    import hashlib
+
+    m = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            m.update(chunk)
+    return m.hexdigest()
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    raise RuntimeError(
+        "no network egress in this environment; place files under %s "
+        "manually" % DATA_HOME
+    )

@@ -1,0 +1,43 @@
+"""CoNLL-2005 SRL reader creators (reference dataset/conll05.py API:
+get_dict() -> (word_dict, verb_dict, label_dict); test() yields the
+9-field record used by the label_semantic_roles book test)."""
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+_N_WORDS, _N_VERBS, _N_LABELS = 120, 20, 9
+
+
+def get_dict():
+    word_dict = {("w%d" % i): i for i in range(_N_WORDS)}
+    verb_dict = {("v%d" % i): i for i in range(_N_VERBS)}
+    label_dict = {("l%d" % i): i for i in range(_N_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    return None
+
+
+def test():
+    def reader():
+        rng = common.rng_for("conll05", "test")
+        for _ in range(128):
+            l = int(rng.randint(3, 12))
+            words = list(map(int, rng.randint(2, _N_WORDS, l)))
+            pred_pos = int(rng.randint(0, l))
+            verb = [int(rng.randint(0, _N_VERBS))] * l
+            mark = [1 if i == pred_pos else 0 for i in range(l)]
+            labels = [
+                int(w % (_N_LABELS - 1)) if m == 0 else _N_LABELS - 1
+                for w, m in zip(words, mark)
+            ]
+
+            def roll(k):
+                return [words[(i + k) % l] for i in range(l)]
+
+            yield (words, roll(-2), roll(-1), words, roll(1), roll(2), verb,
+                   mark, labels)
+
+    return reader

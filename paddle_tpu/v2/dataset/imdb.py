@@ -1,0 +1,35 @@
+"""IMDB sentiment reader creators (reference dataset/imdb.py API:
+word_dict(); train/test(word_idx) yield (word-id list, 0/1 label))."""
+
+from . import common
+
+__all__ = ["train", "test", "word_dict"]
+
+_VOCAB = 400
+
+
+def word_dict():
+    return {("w%d" % i): i for i in range(_VOCAB)}
+
+
+def _reader(split, n, word_idx):
+    v = len(word_idx)
+
+    def reader():
+        rng = common.rng_for("imdb", split)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            l = int(rng.randint(5, 40))
+            lo = 2 if label == 0 else v // 2
+            words = rng.randint(lo, lo + v // 2 - 2, size=l)
+            yield list(map(int, words)), label
+
+    return reader
+
+
+def train(word_idx):
+    return _reader("train", 256, word_idx)
+
+
+def test(word_idx):
+    return _reader("test", 64, word_idx)

@@ -1,0 +1,33 @@
+"""PTB n-gram reader creators (reference dataset/imikolov.py API:
+build_dict(); train/test(word_idx, n) yield n-tuples of word ids)."""
+
+from . import common
+
+__all__ = ["train", "test", "build_dict"]
+
+_VOCAB = 200
+
+
+def build_dict(min_word_freq=50):
+    return {("w%d" % i): i for i in range(_VOCAB)}
+
+
+def _reader(split, n_items, word_idx, n):
+    v = len(word_idx)
+
+    def reader():
+        rng = common.rng_for("imikolov", split)
+        for _ in range(n_items):
+            ctx = rng.randint(0, v, n - 1)
+            nxt = int(ctx.sum() % v)
+            yield tuple(map(int, ctx)) + (nxt,)
+
+    return reader
+
+
+def train(word_idx, n):
+    return _reader("train", 512, word_idx, n)
+
+
+def test(word_idx, n):
+    return _reader("test", 128, word_idx, n)

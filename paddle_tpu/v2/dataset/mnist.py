@@ -1,0 +1,28 @@
+"""MNIST reader creators (reference dataset/mnist.py API: train/test yield
+(784-dim float in [-1,1], int label)). Synthetic separable digits."""
+
+from . import common
+
+__all__ = ["train", "test"]
+
+N_TRAIN, N_TEST = 512, 128
+
+
+def _reader(split, n):
+    def reader():
+        rng = common.rng_for("mnist", split)
+        for _ in range(n):
+            label = int(rng.randint(0, 10))
+            img = rng.randn(784) * 0.3 - 0.5
+            img[label * 70:(label + 1) * 70] += 1.2  # class-separable band
+            yield img.clip(-1, 1).astype("float32"), label
+
+    return reader
+
+
+def train():
+    return _reader("train", N_TRAIN)
+
+
+def test():
+    return _reader("test", N_TEST)

@@ -1,0 +1,60 @@
+"""Movielens reader creators (reference dataset/movielens.py API:
+max_user_id/max_movie_id/max_job_id, age_table, movie_categories,
+get_movie_title_dict; train/test yield the 8-field rating record)."""
+
+from . import common
+
+__all__ = [
+    "train", "test", "max_user_id", "max_movie_id", "max_job_id",
+    "age_table", "movie_categories", "get_movie_title_dict",
+]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_N_USERS, _N_MOVIES, _N_JOBS = 60, 80, 12
+_N_CATS, _N_TITLE_WORDS = 10, 100
+
+
+def max_user_id():
+    return _N_USERS - 1
+
+
+def max_movie_id():
+    return _N_MOVIES - 1
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {("cat%d" % i): i for i in range(_N_CATS)}
+
+
+def get_movie_title_dict():
+    return {("t%d" % i): i for i in range(_N_TITLE_WORDS)}
+
+
+def _reader(split, n):
+    def reader():
+        rng = common.rng_for("movielens", split)
+        for _ in range(n):
+            uid = int(rng.randint(1, _N_USERS))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, len(age_table)))
+            job = int(rng.randint(0, _N_JOBS))
+            mov = int(rng.randint(1, _N_MOVIES))
+            cats = list(map(int, rng.randint(0, _N_CATS, rng.randint(1, 4))))
+            title = list(map(int, rng.randint(0, _N_TITLE_WORDS, rng.randint(2, 6))))
+            score = float(3.0 + 2.0 * ((uid % 2) == (mov % 2)))
+            yield uid, gender, age, job, mov, cats, title, [score]
+
+    return reader
+
+
+def train():
+    return _reader("train", 512)
+
+
+def test():
+    return _reader("test", 128)

@@ -1,0 +1,41 @@
+"""UCI housing reader creators (reference dataset/uci_housing.py API:
+yield (13 features, [price])). Synthetic linear-plus-noise data."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_range"]
+
+_W = None
+UCI_DIM = 13
+
+
+def _w():
+    global _W
+    if _W is None:
+        _W = common.rng_for("uci_housing", "w").randn(UCI_DIM)
+    return _W
+
+
+def _reader(split, n):
+    def reader():
+        rng = common.rng_for("uci_housing", split)
+        for _ in range(n):
+            x = rng.randn(UCI_DIM).astype("float32")
+            y = float(x @ _w() + 0.1 * rng.randn())
+            yield x, np.array([y], "float32")
+
+    return reader
+
+
+def train():
+    return _reader("train", 404)
+
+
+def test():
+    return _reader("test", 102)
+
+
+def feature_range(maximums, minimums):
+    pass

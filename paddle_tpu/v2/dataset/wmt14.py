@@ -1,0 +1,30 @@
+"""WMT14 reader creators (reference dataset/wmt14.py API: train/test(
+dict_size) yield (src ids, trg ids, trg_next ids)). Synthetic reverse-copy
+corpus: the 'translation' is the reversed source."""
+
+from . import common
+
+__all__ = ["train", "test", "N"]
+
+N = 30  # default synthetic dict size cap
+START, END = 0, 1
+
+
+def _reader(split, n_items, dict_size):
+    def reader():
+        rng = common.rng_for("wmt14", split)
+        for _ in range(n_items):
+            l = int(rng.randint(2, 8))
+            src = list(map(int, rng.randint(2, dict_size, l)))
+            rev = src[::-1]
+            yield src, [START] + rev, rev + [END]
+
+    return reader
+
+
+def train(dict_size):
+    return _reader("train", 256, dict_size)
+
+
+def test(dict_size):
+    return _reader("test", 64, dict_size)

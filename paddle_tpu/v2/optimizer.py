@@ -1,0 +1,105 @@
+"""v2 optimizer wrappers mapping onto fluid optimizers (reference
+python/paddle/v2/optimizer.py wraps the C++ ParameterUpdater family;
+SURVEY.md N4/N7 — on TPU every update strategy collapses to the sharded
+in-graph optimizer step)."""
+
+from __future__ import annotations
+
+from .. import fluid
+
+__all__ = ["Momentum", "Adam", "Adamax", "AdaGrad", "DecayedAdaGrad",
+           "AdaDelta", "RMSProp", "SGD"]
+
+
+class Optimizer(object):
+    def _fluid(self):
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=1e-3, **kwargs):
+        self.learning_rate = learning_rate
+
+    def _fluid(self):
+        return fluid.optimizer.SGD(learning_rate=self.learning_rate)
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, learning_rate=1e-3, sparse=False, **kwargs):
+        self.momentum = momentum
+        self.learning_rate = learning_rate
+
+    def _fluid(self):
+        return fluid.optimizer.Momentum(
+            learning_rate=self.learning_rate, momentum=self.momentum
+        )
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 learning_rate=1e-3, **kwargs):
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.learning_rate = learning_rate
+
+    def _fluid(self):
+        return fluid.optimizer.Adam(
+            learning_rate=self.learning_rate, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon,
+        )
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, learning_rate=1e-3, **kwargs):
+        self.beta1, self.beta2 = beta1, beta2
+        self.learning_rate = learning_rate
+
+    def _fluid(self):
+        return fluid.optimizer.Adamax(
+            learning_rate=self.learning_rate, beta1=self.beta1, beta2=self.beta2
+        )
+
+
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=1e-3, epsilon=1e-6, **kwargs):
+        self.learning_rate, self.epsilon = learning_rate, epsilon
+
+    def _fluid(self):
+        return fluid.optimizer.Adagrad(
+            learning_rate=self.learning_rate, epsilon=self.epsilon
+        )
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+        self.rho, self.epsilon = rho, epsilon
+        self.learning_rate = learning_rate
+
+    def _fluid(self):
+        return fluid.optimizer.DecayedAdagrad(
+            learning_rate=self.learning_rate, decay=self.rho,
+            epsilon=self.epsilon,
+        )
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+        self.rho, self.epsilon = rho, epsilon
+        self.learning_rate = learning_rate
+
+    def _fluid(self):
+        return fluid.optimizer.Adadelta(
+            learning_rate=self.learning_rate, rho=self.rho,
+            epsilon=self.epsilon,
+        )
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+        self.rho, self.epsilon = rho, epsilon
+        self.learning_rate = learning_rate
+
+    def _fluid(self):
+        return fluid.optimizer.RMSProp(
+            learning_rate=self.learning_rate, rho=self.rho,
+            epsilon=self.epsilon,
+        )

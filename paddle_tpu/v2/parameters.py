@@ -1,0 +1,97 @@
+"""Parameters: a name -> array pool shared across topologies (reference
+python/paddle/v2/parameters.py backed by SWIG GradientMachine args; here
+backed by a fluid Scope, with tar serialization kept API-compatible)."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from .. import fluid
+from .topology import Topology
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters(object):
+    def __init__(self, topology: Topology):
+        self.topology = topology
+        self.scope = fluid.executor.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.executor.scope_guard(self.scope):
+            exe.run(topology.startup_program)
+        # track ALL persistables, not just Parameters: batch_norm running
+        # mean/variance must survive to_tar/init_from_tar and infer()
+        self._param_names = sorted(
+            v.name
+            for v in topology.main_program.list_vars()
+            if v.persistable and v.name in self.scope
+        )
+
+    # --- dict-ish surface (reference parameters.py) --------------------
+    def keys(self):
+        return list(self._param_names)
+
+    def names(self):
+        return self.keys()
+
+    def has_key(self, key):
+        return key in self._param_names
+
+    def __contains__(self, key):
+        return key in self._param_names
+
+    def __iter__(self):
+        return iter(self._param_names)
+
+    def __getitem__(self, key):
+        return np.asarray(self.scope.get(key))
+
+    def get(self, parameter_name):
+        return self[parameter_name]
+
+    def __setitem__(self, key, value):
+        value = np.asarray(value, np.float32)
+        self.scope.set(key, value)
+
+    def set(self, parameter_name, value):
+        self[parameter_name] = value
+
+    def get_shape(self, key):
+        return tuple(np.asarray(self.scope.get(key)).shape)
+
+    # --- tar round trip -------------------------------------------------
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self._param_names:
+                arr = self[name]
+                buf = io.BytesIO()
+                np.save(buf, arr)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    @staticmethod
+    def from_tar(f):
+        """Returns {name: array}; use init_from_tar to load into an
+        existing Parameters."""
+        out = {}
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for m in tar.getmembers():
+                buf = io.BytesIO(tar.extractfile(m).read())
+                out[m.name] = np.load(buf)
+        return out
+
+    def init_from_tar(self, f):
+        for name, arr in Parameters.from_tar(f).items():
+            if name in self._param_names:
+                self.set(name, arr)
+
+
+def create(*layers):
+    """paddle.parameters.create(cost): build the topology and initialize
+    its parameters."""
+    return Parameters(Topology(list(layers)))

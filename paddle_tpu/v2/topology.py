@@ -1,0 +1,140 @@
+"""Topology: replay a v2 layer DAG into a fluid Program (reference
+python/paddle/v2/topology.py builds a ModelConfig protobuf; here the
+single core is the fluid Program and its XLA executor)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .. import fluid
+from . import data_type as dt
+from .layer import Layer, parse_network
+
+__all__ = ["Topology"]
+
+
+class Topology(object):
+    def __init__(self, layers, extra_layers=None):
+        if not isinstance(layers, (list, tuple)):
+            layers = [layers]
+        extra = list(extra_layers or [])
+        self.output_layers = list(layers)
+        self.order = parse_network(*(list(layers) + extra))
+
+        self.main_program = fluid.Program()
+        self.startup_program = fluid.Program()
+        self.var_of: Dict[str, object] = {}  # layer name -> fluid Variable
+        self._data_layers: List[Layer] = []
+        with fluid.program_guard(self.main_program, self.startup_program):
+            for node in self.order:
+                self.var_of[node.name] = self._emit(node)
+
+    # ------------------------------------------------------------------
+    def _in(self, node, i=0):
+        return self.var_of[node.parents[i].name]
+
+    def _ins(self, node):
+        return [self.var_of[p.name] for p in node.parents]
+
+    def _emit(self, node: Layer):
+        L = fluid.layers
+        a = node.attrs
+        if node.kind == "data":
+            t = a["type"]
+            self._data_layers.append(node)
+            lod = 1 if t.seq_type != 0 else 0
+            if t.type == dt.DataType.Index:
+                shape, dtype = [1], "int64"
+            else:
+                shape, dtype = [t.dim], "float32"
+            return L.data(name=node.name, shape=shape, dtype=dtype,
+                          lod_level=lod)
+        if node.kind == "fc":
+            # deterministic parameter names derived from the layer name
+            # (reference convention "___fc_0__.w0") so Parameters re-bind
+            # across replays of the same DAG
+            attrs = [
+                fluid.ParamAttr(name="%s.w%d" % (node.name, i))
+                for i in range(len(node.parents))
+            ]
+            return L.fc(input=self._ins(node), size=a["size"], act=a["act"],
+                        param_attr=attrs,
+                        bias_attr=fluid.ParamAttr(name=node.name + ".wbias"))
+        if node.kind == "embedding":
+            t = node.parents[0].attrs["type"]
+            return L.embedding(input=self._in(node),
+                               size=[t.dim, a["size"]],
+                               param_attr=fluid.ParamAttr(
+                                   name=node.name + ".w0"))
+        if node.kind == "concat":
+            return L.concat(input=self._ins(node), axis=1)
+        if node.kind == "img_conv":
+            return L.conv2d(
+                input=self._in(node), num_filters=a["num_filters"],
+                filter_size=a["filter_size"], stride=a["stride"],
+                padding=a["padding"], act=a["act"],
+                param_attr=fluid.ParamAttr(name=node.name + ".w0"),
+                bias_attr=fluid.ParamAttr(name=node.name + ".wbias"),
+            )
+        if node.kind == "img_pool":
+            return L.pool2d(
+                input=self._in(node), pool_size=a["pool_size"],
+                pool_stride=a["stride"], pool_padding=a["padding"],
+                pool_type=a["pool_type"],
+            )
+        if node.kind == "batch_norm":
+            return L.batch_norm(input=self._in(node), act=a["act"])
+        if node.kind == "lstmemory":
+            # v2 semantics: `size` is the hidden width H and the input must
+            # be 4H wide (fluid dynamic_lstm's `size` argument is 4H)
+            size = a["size"]
+            if size is None:
+                size = int(self._in(node).shape[1]) // 4
+            hidden, _ = L.dynamic_lstm(
+                input=self._in(node), size=size * 4,
+                is_reverse=a.get("reverse", False),
+                param_attr=fluid.ParamAttr(name=node.name + ".w0"),
+                bias_attr=fluid.ParamAttr(name=node.name + ".wbias"),
+            )
+            return hidden
+        if node.kind == "gru":
+            return L.dynamic_gru(
+                input=self._in(node), size=a["size"],
+                is_reverse=a.get("reverse", False),
+                param_attr=fluid.ParamAttr(name=node.name + ".w0"),
+            )
+        if node.kind == "seq_pool":
+            return L.sequence_pool(input=self._in(node),
+                                   pool_type=a["pool_type"])
+        if node.kind == "last_seq":
+            return L.sequence_last_step(input=self._in(node))
+        if node.kind == "first_seq":
+            return L.sequence_first_step(input=self._in(node))
+        if node.kind == "max_id":
+            _, idx = L.topk(self._in(node), k=1)
+            return idx
+        if node.kind == "classification_cost":
+            pred, label = self._ins(node)
+            # reference classification_cost = softmax output + CE cost; the
+            # DSL's `input` already went through act=Softmax
+            cost = L.cross_entropy(input=pred, label=label)
+            return L.mean(x=cost)
+        if node.kind == "cross_entropy_cost":
+            pred, label = self._ins(node)
+            return L.mean(x=L.cross_entropy(input=pred, label=label))
+        if node.kind == "mse_cost":
+            pred, label = self._ins(node)
+            return L.mean(x=L.square_error_cost(input=pred, label=label))
+        if node.kind == "dropout":
+            return L.dropout(x=self._in(node), dropout_prob=a["rate"])
+        raise NotImplementedError("v2 layer kind %r" % node.kind)
+
+    # ------------------------------------------------------------------
+    def data_layers(self) -> Dict[str, Layer]:
+        return {n.name: n for n in self._data_layers}
+
+    def data_type(self):
+        return [(n.name, n.attrs["type"]) for n in self._data_layers]
+
+    def get_layer_proto(self, name):
+        return None

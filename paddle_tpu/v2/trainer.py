@@ -1,0 +1,171 @@
+"""paddle.v2.trainer.SGD: the v2 train/test loop over the fluid executor
+(reference python/paddle/v2/trainer.py:37 SGD, :137 train — there it
+drives the SWIG GradientMachine + ParameterUpdater; here one fused XLA
+step per batch via the fluid Executor)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import fluid
+from . import event as v2_event
+from . import data_type as dt
+from .parameters import Parameters
+from .topology import Topology
+
+__all__ = ["SGD"]
+
+
+def _convert_feed(batch, data_nodes, feeding):
+    """Batch of instance tuples -> fluid feed dict, per data-layer type
+    (the py_paddle DataProviderConverter's job in the reference)."""
+    names = [n.name for n in data_nodes]
+    if feeding is None:
+        feeding = {name: i for i, name in enumerate(names)}
+    feed = {}
+    for node in data_nodes:
+        idx = feeding[node.name]
+        col = [inst[idx] for inst in batch]
+        t = node.attrs["type"]
+        if t.seq_type == 0:  # plain
+            if t.type == dt.DataType.Index:
+                feed[node.name] = np.asarray(col, np.int64).reshape(-1, 1)
+            elif t.type in (dt.DataType.SparseNonValue, dt.DataType.SparseValue):
+                # sparse instances materialise to dense rows (the TPU path
+                # is dense; reference converts via SparseBinaryScanner)
+                dense = np.zeros((len(col), t.dim), np.float32)
+                for r, inst in enumerate(col):
+                    if t.type == dt.DataType.SparseNonValue:
+                        dense[r, list(inst)] = 1.0
+                    else:
+                        for i, v in inst:
+                            dense[r, int(i)] = float(v)
+                feed[node.name] = dense
+            else:
+                feed[node.name] = np.asarray(col, np.float32).reshape(
+                    len(col), -1
+                )
+        else:  # single-level sequence -> packed + offsets
+            lens = [len(x) for x in col]
+            lod = np.cumsum([0] + lens).astype(np.int32)
+            if t.type == dt.DataType.Index:
+                flat = np.concatenate(
+                    [np.asarray(x, np.int64).reshape(-1) for x in col]
+                ).reshape(-1, 1)
+            else:
+                flat = np.concatenate(
+                    [np.asarray(x, np.float32).reshape(len(x), -1) for x in col]
+                )
+            feed[node.name] = (flat, [lod])
+    return feed
+
+
+class SGD(object):
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, pserver_spec=None, use_etcd=True):
+        if not isinstance(parameters, Parameters):
+            raise TypeError("parameters should be paddle.v2.parameters.create(...)")
+        self.__parameters__ = parameters
+        # reuse the parameters' topology when it covers this cost, so the
+        # trainer updates the same scope arrays in place
+        topo = parameters.topology
+        if not any(l is cost for l in topo.output_layers):
+            topo = Topology([cost], extra_layers=extra_layers)
+        # a topology can host at most one optimizer: a second SGD over the
+        # same Parameters gets a fresh replay of the DAG instead of
+        # appending a second backward pass to the shared program
+        if getattr(topo, "_minimized", False):
+            topo = Topology([cost], extra_layers=extra_layers)
+        self._topology = topo
+        self._cost_var = topo.var_of[cost.name]
+        # snapshot the forward-only program BEFORE minimize appends the
+        # backward+update ops: test() must never touch parameters
+        self._test_program = topo.main_program.clone(for_test=True)
+        self._optimizer = update_equation._fluid()
+        with fluid.program_guard(topo.main_program, topo.startup_program):
+            self._optimizer.minimize(self._cost_var)
+        topo._minimized = True
+        # initialize ONLY vars not already in the parameters' scope (the
+        # optimizer state); re-running the full startup program would
+        # clobber values loaded via Parameters.init_from_tar
+        self._exe = fluid.Executor(fluid.CPUPlace())
+        startup = topo.startup_program.clone()
+        blk = startup.global_block()
+        blk.ops = [
+            op
+            for op in blk.ops
+            if any(n not in parameters.scope for n in op.output_arg_names)
+        ]
+        with fluid.executor.scope_guard(parameters.scope):
+            self._exe.run(startup)
+
+    # ------------------------------------------------------------------
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        if event_handler is None:
+            event_handler = lambda e: None
+        data_nodes = self._topology._data_layers
+        scope = self.__parameters__.scope
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            for batch_id, batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                feed = _convert_feed(batch, data_nodes, feeding)
+                with fluid.executor.scope_guard(scope):
+                    (cost,) = self._exe.run(
+                        self._topology.main_program,
+                        feed=feed,
+                        fetch_list=[self._cost_var],
+                    )
+                event_handler(
+                    v2_event.EndIteration(
+                        pass_id, batch_id, float(np.ravel(cost)[0]),
+                        evaluator={},
+                    )
+                )
+            event_handler(v2_event.EndPass(pass_id))
+
+    # ------------------------------------------------------------------
+    def test(self, reader, feeding=None):
+        data_nodes = self._topology._data_layers
+        scope = self.__parameters__.scope
+        test_prog = self._test_program  # forward-only snapshot, stable id
+        costs, n = [], 0
+        for batch in reader():
+            feed = _convert_feed(batch, data_nodes, feeding)
+            with fluid.executor.scope_guard(scope):
+                (cost,) = self._exe.run(
+                    test_prog, feed=feed, fetch_list=[self._cost_var]
+                )
+            costs.append(float(np.ravel(cost)[0]) * len(batch))
+            n += len(batch)
+        avg = sum(costs) / max(n, 1)
+        return v2_event.TestResult(evaluator={}, cost=avg)
+
+    def save_parameter_to_tar(self, f):
+        self.__parameters__.to_tar(f)
+
+
+def infer(output_layer, parameters, input, feeding=None):
+    """paddle.infer (reference python/paddle/v2/inference.py): forward the
+    prediction sub-graph with the given parameters."""
+    outputs = output_layer if isinstance(output_layer, (list, tuple)) else [
+        output_layer
+    ]
+    topo = Topology(list(outputs))
+    # bind trained parameter values by (deterministic) name
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        for v in topo.main_program.list_vars():
+            if v.persistable and parameters.has_key(v.name):
+                scope.set(v.name, parameters[v.name])
+        feed = _convert_feed(input, topo._data_layers, feeding)
+        fetches = exe.run(
+            topo.main_program,
+            feed=feed,
+            fetch_list=[topo.var_of[o.name] for o in outputs],
+        )
+    return fetches[0] if len(fetches) == 1 else fetches
