@@ -24,6 +24,13 @@ from .mesh import (
     set_default_mesh,
     shard_parameter,
 )
+from .attention import (
+    reference_attention,
+    ring_attention,
+    sequence_parallel_attention,
+    ulysses_attention,
+)
+from .embedding import ShardedEmbedding, sharded_lookup
 
 __all__ = [
     "make_mesh",
@@ -33,4 +40,10 @@ __all__ = [
     "data_sharding",
     "replicated",
     "DistributedContext",
+    "ring_attention",
+    "ulysses_attention",
+    "sequence_parallel_attention",
+    "reference_attention",
+    "sharded_lookup",
+    "ShardedEmbedding",
 ]

@@ -1,0 +1,158 @@
+"""Sequence/context-parallel attention: ring attention + Ulysses.
+
+These are NEW capabilities beyond the 2018 reference (SURVEY.md §2.2: the
+reference's long-sequence story was LoD ragged tensors + chunked RNNs;
+attention-era sequence parallelism did not exist). They are first-class
+here because they shape the core design for long-context models on TPU:
+
+* ring_attention — blockwise-softmax attention where each 'seq' shard
+  holds a [T/n] slice of Q locally and K/V blocks rotate around the mesh
+  axis via `lax.ppermute` (one ICI hop per step, n steps). Memory per chip
+  is O(T/n), compute overlaps the collective, and the online-softmax
+  accumulation makes the result EXACTLY equal to full attention.
+* ulysses_attention — all-to-all alternative: heads are exchanged for
+  sequence (`lax.all_to_all`), each shard computes full-sequence attention
+  for H/n heads, then the transpose all-to-all restores layout. Cheaper
+  when H >= n and T is moderate; ring wins at very long T.
+
+Both run inside `shard_map` over the mesh's 'seq' axis and are fully
+differentiable (ppermute/all_to_all have transpose rules, the ring loop is
+a lax.scan).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "sequence_parallel_attention",
+    "reference_attention",
+]
+
+_NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = False, scale=None):
+    """Plain full attention [B, T, H, D] — the correctness oracle and the
+    single-device fallback."""
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bthd,bshd->bhts", q * scale, k)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, k.shape[1]), bool), k.shape[1] - T)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
+    """Blockwise ring attention; call inside shard_map with q/k/v sharded
+    [B, T/n, H, D] on the sequence axis."""
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    qf = (q * scale).astype(jnp.float32)
+
+    q_pos = me * T + jnp.arange(T)  # global row ids of the local queries
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def _varying(x):
+        # the scan carry must be marked device-varying over the ring axis
+        # (shard_map's vma type system; constants start out unvarying)
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, (axis_name,), to="varying")
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, (axis_name,))
+        return x
+
+    o0 = _varying(jnp.zeros((B, T, H, D), jnp.float32))
+    l0 = _varying(jnp.zeros((B, H, T), jnp.float32))
+    m0 = _varying(jnp.full((B, H, T), _NEG_INF, jnp.float32))
+
+    def step(carry, i):
+        o, l, m, kb, vb = carry
+        src = (me - i) % n  # which shard's K/V block we hold this step
+        k_pos = src * T + jnp.arange(T)
+        s = jnp.einsum("bthd,bshd->bhts", qf, kb.astype(jnp.float32))
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # fully-masked steps keep m_new at -inf; shift by a safe max so
+        # exp never sees inf-inf
+        safe = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - safe[..., None])
+        p = jnp.where(s <= _NEG_INF, 0.0, p)
+        corr = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - safe))
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhts,bshd->bthd", p, vb.astype(jnp.float32)
+        )
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (o, l, m_new, kb, vb), None
+
+    (o, l, _, _, _), _ = lax.scan(step, (o0, l0, m0, k, v), jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism; call
+    inside shard_map with [B, T/n, H, D] shards. Requires H % n == 0."""
+    # exchange: split heads across the axis, gather the full sequence
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    og = reference_attention(qg, kg, vg, causal=causal, scale=scale)
+    return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def sequence_parallel_attention(
+    q, k, v,
+    mesh: Optional[Mesh] = None,
+    axis: str = "seq",
+    impl: str = "ring",
+    causal: bool = False,
+    scale=None,
+):
+    """Global-view entry point: q/k/v are [B, T, H, D] global arrays; the
+    sequence dim is sharded over `axis` of `mesh` and attention runs
+    sequence-parallel. Falls back to plain attention without a mesh."""
+    if mesh is None:
+        from .mesh import get_default_mesh
+
+        mesh = get_default_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if q.shape[1] % mesh.shape[axis] != 0:
+        raise ValueError(
+            "sequence length %d not divisible by mesh axis %r size %d"
+            % (q.shape[1], axis, mesh.shape[axis])
+        )
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    if impl == "ulysses" and q.shape[2] % mesh.shape[axis] != 0:
+        raise ValueError("ulysses needs heads divisible by the seq axis size")
+    spec = P(None, axis, None, None)
+    mapped = shard_map(
+        functools.partial(fn, axis_name=axis, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return mapped(q, k, v)
