@@ -1,0 +1,21 @@
+"""Job-level distributed services.
+
+Replaces the reference's Go cloud layer (SURVEY.md §1.2): the master's
+fault-tolerant data-task queue (go/master/service.go) becomes
+`coordinator.Coordinator`, and the Go pserver's CRC-checksummed atomic
+checkpoints (go/pserver/service.go:120-226) become `checkpoint`. Gradient
+aggregation itself needs no service at all on TPU — it is a psum over ICI
+(see paddle_tpu.parallel); what remains job-level is exactly this: elastic
+data dispatch and durable state.
+"""
+
+from .coordinator import Coordinator, MasterClient, Task
+from .checkpoint import load_checkpoint, save_checkpoint
+
+__all__ = [
+    "Coordinator",
+    "MasterClient",
+    "Task",
+    "save_checkpoint",
+    "load_checkpoint",
+]

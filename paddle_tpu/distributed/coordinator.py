@@ -1,0 +1,215 @@
+"""Coordinator: fault-tolerant data-task dispatch (Go master parity).
+
+Reference behavior being reproduced (go/master/service.go):
+  - SetDataset partitions data into tasks               (service.go:280,106)
+  - GetTask leases a task with a timeout                (service.go:368)
+  - task timeout -> re-queue                            (service.go:341,313)
+  - TaskFailed / failure count > failureMax -> discard  (service.go:455,313)
+  - TaskFinished; pass rollover when todo+pending drain (service.go:411)
+  - full state snapshot after every mutation, recovered
+    on restart                                          (service.go:166,207)
+
+Differences by design: no etcd (snapshots go to a local/NFS path with
+atomic rename — the single-controller JAX runtime makes a distributed
+lock service unnecessary); tasks name data shards (file paths, record
+ranges) rather than RecordIO chunk handles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Task", "Coordinator", "MasterClient"]
+
+
+@dataclass
+class Task:
+    task_id: int
+    payload: Any  # JSON-serializable shard description
+    epoch: int = 0
+    failures: int = 0
+    deadline: float = field(default=0.0, compare=False)
+
+    def to_json(self):
+        return {
+            "task_id": self.task_id,
+            "payload": self.payload,
+            "epoch": self.epoch,
+            "failures": self.failures,
+        }
+
+    @staticmethod
+    def from_json(d):
+        return Task(
+            task_id=d["task_id"], payload=d["payload"], epoch=d["epoch"],
+            failures=d["failures"],
+        )
+
+
+class Coordinator(object):
+    """Single-controller task-lease service (thread-safe; serve over any
+    RPC you like — in-process for tests, matching SURVEY §4.4's lesson to
+    keep distributed paths CI-testable in one process)."""
+
+    def __init__(self, timeout_s: float = 60.0, failure_max: int = 3,
+                 snapshot_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self.timeout_s = timeout_s
+        self.failure_max = failure_max
+        self.snapshot_path = snapshot_path
+        self.todo: List[Task] = []
+        self.pending: Dict[int, Task] = {}
+        self.done: List[Task] = []
+        self.discarded: List[Task] = []
+        self.epoch = 0
+        self._next_id = 0
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+
+    # --- dataset ------------------------------------------------------
+    def set_dataset(self, shards: List[Any]):
+        """Partition `shards` (any JSON-serializable descriptions) into
+        tasks (reference SetDataset / partition)."""
+        with self._lock:
+            if self.todo or self.pending:
+                return  # idempotent, like the reference's once.Do
+            for payload in shards:
+                self.todo.append(Task(task_id=self._next_id, payload=payload))
+                self._next_id += 1
+            self._snapshot()
+
+    # --- lease protocol ----------------------------------------------
+    def get_task(self, epoch_limit: Optional[int] = None) -> Optional[Task]:
+        """Lease a task; None when this epoch's work is fully leased/done
+        (pass end — the reference signals it with ErrPassAfter). Reclaims
+        expired leases first (reference checkTimeoutFunc). Rollover into
+        the next pass happens only when `epoch_limit` allows it, so bare
+        `while get_task()` drain loops always terminate."""
+        with self._lock:
+            reclaimed = self._reclaim_expired()
+            if not self.todo:
+                if not self.pending and (self.done or self.discarded):
+                    if epoch_limit is None or self.epoch + 1 > epoch_limit:
+                        if reclaimed:
+                            self._snapshot()
+                        return None
+                    self._next_epoch()
+                if not self.todo:
+                    if reclaimed:
+                        self._snapshot()
+                    return None
+            task = self.todo.pop(0)
+            task.deadline = time.time() + self.timeout_s
+            self.pending[task.task_id] = task
+            self._snapshot()
+            return task
+
+    def task_finished(self, task_id: int):
+        with self._lock:
+            task = self.pending.pop(task_id, None)
+            if task is not None:
+                self.done.append(task)
+                self._snapshot()
+
+    def task_failed(self, task_id: int):
+        """Failure count + requeue or discard (reference processFailedTask)."""
+        with self._lock:
+            task = self.pending.pop(task_id, None)
+            if task is None:
+                return
+            task.failures += 1
+            if task.failures >= self.failure_max:
+                self.discarded.append(task)
+            else:
+                self.todo.append(task)
+            self._snapshot()
+
+    # --- internals ----------------------------------------------------
+    def _reclaim_expired(self) -> bool:
+        now = time.time()
+        expired = [t for t in self.pending.values() if t.deadline <= now]
+        for t in expired:
+            del self.pending[t.task_id]
+            t.failures += 1
+            if t.failures >= self.failure_max:
+                self.discarded.append(t)
+            else:
+                self.todo.append(t)
+        return bool(expired)
+
+    def _next_epoch(self):
+        self.epoch += 1
+        rollover = self.done + self.discarded
+        rollover.sort(key=lambda t: t.task_id)
+        for t in rollover:
+            t.epoch = self.epoch
+            t.failures = 0
+        self.todo = rollover
+        self.done = []
+        self.discarded = []
+
+    # --- durability (reference snapshot/recover) ----------------------
+    def _snapshot(self):
+        if not self.snapshot_path:
+            return
+        state = {
+            "epoch": self.epoch,
+            "next_id": self._next_id,
+            "todo": [t.to_json() for t in self.todo],
+            "pending": [t.to_json() for t in self.pending.values()],
+            "done": [t.to_json() for t in self.done],
+            "discarded": [t.to_json() for t in self.discarded],
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)  # atomic, like the etcd put
+
+    def _recover(self):
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        self.epoch = state["epoch"]
+        self._next_id = state["next_id"]
+        self.todo = [Task.from_json(d) for d in state["todo"]]
+        # pending leases do not survive a restart: their workers are gone,
+        # so they go straight back to todo (reference re-queues on recover)
+        self.todo += [Task.from_json(d) for d in state["pending"]]
+        self.done = [Task.from_json(d) for d in state["done"]]
+        self.discarded = [Task.from_json(d) for d in state["discarded"]]
+
+
+class MasterClient(object):
+    """Trainer-side iterator over coordinator tasks (reference
+    go/master/client.go NextRecord / python master.client:29).
+
+    `record_fn(payload)` maps a task payload to an iterable of records;
+    records stream out while the lease is held, and the task is marked
+    finished (or failed, on exception) automatically."""
+
+    def __init__(self, coordinator: Coordinator, record_fn):
+        self.coordinator = coordinator
+        self.record_fn = record_fn
+
+    def __iter__(self):
+        # one full pass over the dataset: no rollover into the next epoch
+        # (the training loop drives passes; reference client.go pass_end)
+        while True:
+            task = self.coordinator.get_task()
+            if task is None:
+                return
+            try:
+                for rec in self.record_fn(task.payload):
+                    yield rec
+            except Exception:
+                self.coordinator.task_failed(task.task_id)
+                continue
+            self.coordinator.task_finished(task.task_id)
+
+    def reader(self):
+        """As a v2-style reader creator."""
+        return lambda: iter(self)

@@ -14,6 +14,7 @@ from . import evaluator
 from . import io
 from . import profiler
 from . import learning_rate_decay
+from . import distribute_transpiler
 
 from .framework import (
     Program,
@@ -35,6 +36,11 @@ from .executor import (
     as_numpy,
 )
 from .data_feeder import DataFeeder
+from .distribute_transpiler import (
+    DistributeTranspiler,
+    SimpleDistributeTranspiler,
+    memory_optimize,
+)
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .initializer import Constant, Normal, TruncatedNormal, Uniform, Xavier, MSRA
 from .optimizer import (

@@ -32,6 +32,9 @@ from . import kernels_sequence  # noqa: F401
 from . import kernels_rnn  # noqa: F401
 from . import kernels_control  # noqa: F401
 from . import kernels_crf  # noqa: F401
+from . import kernels_ctc  # noqa: F401
+from . import kernels_sampled  # noqa: F401
+from . import kernels_detection  # noqa: F401
 from .lowering import AUTODIFF_OP, build_step_fn, lower_block
 
 

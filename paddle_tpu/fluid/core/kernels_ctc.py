@@ -1,0 +1,180 @@
+"""CTC loss + edit distance kernels.
+
+Parity: reference warpctc integration (operators/warpctc_op.cc dynloading
+libwarpctc — SURVEY N26) and operators/edit_distance_op. TPU-first
+re-design: instead of a vendored CUDA library, CTC is the standard
+log-space alpha recursion over the extended (blank-interleaved) label
+sequence, vectorised over the padded batch and scanned over time — XLA
+fuses it; the backward pass is jax.vjp of the forward. Edit distance is
+the Levenshtein DP scanned over the hypothesis axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from .kernels_sequence import lod_key, seg_lengths
+from .kernels_rnn import packed_to_padded, _seq_T
+
+_NEG = -1e30
+
+
+def _lod_of(ctx, slot):
+    return ctx.env[lod_key(ctx.op.inputs[slot][0])]
+
+
+def _bucket_of(ctx, slot, total):
+    """Static padded length for THIS input's raggedness: its own per-feed
+    bucket when known (so short CTC labels don't pad to the frame-length
+    bucket), else the global bucket, else the packed total."""
+    name = lod_key(ctx.op.inputs[slot][0])
+    b = ctx.seq_buckets.get(name)
+    if b is not None:
+        return min(int(b), int(total))
+    return _seq_T(ctx, total)
+
+
+@register_op("warpctc")
+def _warpctc(ctx, ins, attrs):
+    """Inputs: Logits packed [total_t, C] (pre-softmax, lod over time),
+    Label packed [total_l, 1] (lod over label length). Output: Loss
+    [n_seq, 1]. attrs: blank (default 0), norm_by_times."""
+    logits = ins["Logits"][0]
+    labels = ins["Label"][0].reshape(-1)
+    t_off = _lod_of(ctx, "Logits")
+    l_off = _lod_of(ctx, "Label")
+    blank = int(attrs.get("blank", 0))
+    C = logits.shape[1]
+    B = t_off.shape[0] - 1
+
+    T = _bucket_of(ctx, "Logits", logits.shape[0])
+    logit_p, t_mask = packed_to_padded(logits, t_off, T)  # [B,T,C]
+    logp = jax.nn.log_softmax(logit_p.astype(jnp.float32), axis=-1)
+    t_lens = seg_lengths(t_off)  # [B]
+
+    lab_p, _ = packed_to_padded(labels, l_off, _bucket_of(ctx, "Label", labels.shape[0]))
+    Lmax = lab_p.shape[1]
+    l_lens = seg_lengths(l_off)  # [B]
+
+    # extended sequence: blank z1 blank z2 ... blank  (S = 2L+1)
+    S = 2 * Lmax + 1
+    s_idx = jnp.arange(S)
+    is_lab = (s_idx % 2) == 1
+    lab_at = jnp.where(is_lab, lab_p[:, jnp.clip((s_idx - 1) // 2, 0, Lmax - 1)], blank)
+    s_valid = s_idx[None, :] < (2 * l_lens[:, None] + 1)  # [B,S]
+    # skip transition allowed where z_s is a label differing from z_{s-2}
+    prev2 = jnp.concatenate(
+        [jnp.full((B, 2), blank, lab_at.dtype), lab_at[:, :-2]], axis=1
+    )
+    can_skip = jnp.logical_and(is_lab[None, :], lab_at != prev2)
+
+    def emit(t):
+        # log p of emitting z_s at time t: [B,S]
+        return jnp.take_along_axis(logp[:, t], lab_at, axis=1)
+
+    a0 = jnp.full((B, S), _NEG)
+    a0 = a0.at[:, 0].set(logp[:, 0, blank])
+    a0 = a0.at[:, 1].set(
+        jnp.where(l_lens > 0, emit(0)[:, 1], _NEG)
+    )
+    a0 = jnp.where(s_valid, a0, _NEG)
+
+    def shift(a, k):
+        return jnp.concatenate([jnp.full((B, k), _NEG), a[:, :-k]], axis=1)
+
+    def step(alpha, t):
+        stay = alpha
+        diag = shift(alpha, 1)
+        skip = jnp.where(can_skip, shift(alpha, 2), _NEG)
+        m = jnp.maximum(jnp.maximum(stay, diag), skip)
+        safe = jnp.where(m <= _NEG, 0.0, m)
+        summed = safe + jnp.log(
+            jnp.exp(jnp.where(stay <= _NEG, _NEG, stay - safe))
+            + jnp.exp(jnp.where(diag <= _NEG, _NEG, diag - safe))
+            + jnp.exp(jnp.where(skip <= _NEG, _NEG, skip - safe))
+            + 1e-45
+        )
+        new = summed + emit(t)
+        new = jnp.where(s_valid, new, _NEG)
+        alive = (t < t_lens)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    alpha, _ = lax.scan(step, a0, jnp.arange(1, T))
+
+    bidx = jnp.arange(B)
+    send = 2 * l_lens  # index of final blank
+    last_blank = alpha[bidx, send]
+    last_lab = jnp.where(
+        l_lens > 0, alpha[bidx, jnp.maximum(send - 1, 0)], _NEG
+    )
+    m = jnp.maximum(last_blank, last_lab)
+    safe = jnp.where(m <= _NEG, 0.0, m)
+    ll = safe + jnp.log(
+        jnp.exp(last_blank - safe) + jnp.exp(jnp.where(last_lab <= _NEG, _NEG, last_lab - safe))
+        + 1e-45
+    )
+    loss = -ll
+    if attrs.get("norm_by_times"):
+        loss = loss / jnp.maximum(t_lens.astype(loss.dtype), 1.0)
+    return {"Loss": loss.reshape(B, 1).astype(logits.dtype),
+            "WarpCTCGrad": jnp.zeros_like(logits)}
+
+
+@register_op("edit_distance")
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per (Hyps_i, Refs_i) sequence pair (reference
+    operators/edit_distance_op.h). Output [n_seq, 1] float; attr
+    `normalized` divides by the reference length."""
+    hyp = ins["Hyps"][0].reshape(-1)
+    ref = ins["Refs"][0].reshape(-1)
+    h_off = _lod_of(ctx, "Hyps")
+    r_off = _lod_of(ctx, "Refs")
+    B = h_off.shape[0] - 1
+
+    Hm = _bucket_of(ctx, "Hyps", hyp.shape[0])
+    Rm = _bucket_of(ctx, "Refs", ref.shape[0])
+    hyp_p, _ = packed_to_padded(hyp, h_off, Hm)  # [B,Hm]
+    ref_p, _ = packed_to_padded(ref, r_off, Rm)  # [B,Rm]
+    h_lens = seg_lengths(h_off)
+    r_lens = seg_lengths(r_off)
+
+    BIG = jnp.float32(1e9)
+    j = jnp.arange(Rm + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(j, (B, Rm + 1))  # distance from empty hyp
+
+    def step(row, i):
+        # row = D[i-1, :]; compute D[i, :]
+        cost_sub = jnp.where(
+            hyp_p[:, i - 1][:, None] == ref_p, 0.0, 1.0
+        )  # [B,Rm]
+        sub = row[:, :-1] + cost_sub
+        dele = row[:, 1:] + 1.0  # delete hyp[i-1]
+        first = row[:, :1] + 1.0  # D[i,0] = i
+
+        def scan_col(carry, xs):
+            s_j, d_j = xs
+            cur = jnp.minimum(jnp.minimum(s_j, d_j), carry + 1.0)
+            return cur, cur
+
+        _, cols = lax.scan(
+            scan_col,
+            first[:, 0],
+            (sub.T, dele.T),
+        )
+        new = jnp.concatenate([first, cols.T], axis=1)
+        # rows beyond the hyp length keep the previous value
+        alive = (i <= h_lens)[:, None]
+        return jnp.where(alive, new, row), None
+
+    row, _ = lax.scan(step, row0, jnp.arange(1, Hm + 1))
+    bidx = jnp.arange(B)
+    # final D[h_len, r_len] — but clamped rows froze at h_len already
+    dist = row[bidx, jnp.clip(r_lens, 0, Rm)]
+    seq_num = jnp.asarray([B], jnp.int64)
+    if attrs.get("normalized"):
+        dist = dist / jnp.maximum(r_lens.astype(dist.dtype), 1.0)
+    return {"Out": dist.reshape(B, 1).astype(jnp.float32),
+            "SequenceNum": seq_num}

@@ -1,0 +1,241 @@
+"""Detection op kernels: prior_box, box_coder, bipartite_match,
+multiclass_nms.
+
+Parity: reference operators/prior_box_op.h, box_coder_op.h,
+bipartite_match_op.cc, multiclass_nms_op.cc (and the legacy gserver
+PriorBox/MultiBoxLoss/DetectionOutput layers). TPU-first re-design:
+everything is static-shape. NMS's data-dependent output count becomes a
+fixed [N*keep_top_k, 6] buffer, valid rows first, with traced per-image
+counts riding the usual LoD side-band — the same convention beam search
+decode uses (kernels_control.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from .kernels_sequence import lod_key
+
+_NEG = -1e30
+
+
+@register_op("prior_box")
+def _prior_box(ctx, ins, attrs):
+    """Anchor generation over a feature map (prior_box_op.h)."""
+    feat = ins["Input"][0]  # [N, C, H, W]
+    image = ins["Image"][0]  # [N, C, Him, Wim]
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []):
+        ars.append(float(ar))
+        if attrs.get("flip", False):
+            ars.append(1.0 / float(ar))
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or float(img_w) / W
+    step_h = float(attrs.get("step_h", 0.0)) or float(img_h) / H
+    offset = float(attrs.get("offset", 0.5))
+
+    wh = []
+    for ms in min_sizes:
+        for ar in ars:
+            wh.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            wh.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+    P = len(wh)
+    whs = jnp.asarray(wh, jnp.float32)  # [P, 2]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    c = jnp.stack([cxg, cyg], axis=-1)[:, :, None, :]  # [H,W,1,2]
+    half = whs[None, None, :, :] / 2.0  # [1,1,P,2]
+    mins = (c - half) / jnp.asarray([img_w, img_h], jnp.float32)
+    maxs = (c + half) / jnp.asarray([img_w, img_h], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)  # [H,W,P,4]
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (H, W, P, 4)
+    )
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("box_coder")
+def _box_coder(ctx, ins, attrs):
+    """Center-size encode/decode (box_coder_op.h)."""
+    prior = ins["PriorBox"][0]  # [M, 4] xyxy
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None  # [M,4]
+    target = ins["TargetBox"][0]
+    code = attrs.get("code_type", "encode_center_size")
+
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+
+    if code == "encode_center_size":
+        # target: [M, 4] gt boxes (broadcast against priors row-wise)
+        tw = target[..., 2] - target[..., 0]
+        th = target[..., 3] - target[..., 1]
+        tcx = target[..., 0] + tw / 2
+        tcy = target[..., 1] + th / 2
+        out = jnp.stack(
+            [
+                (tcx - pcx) / pw / pvar[:, 0],
+                (tcy - pcy) / ph / pvar[:, 1],
+                jnp.log(jnp.maximum(tw / pw, 1e-12)) / pvar[:, 2],
+                jnp.log(jnp.maximum(th / ph, 1e-12)) / pvar[:, 3],
+            ],
+            axis=-1,
+        )
+    else:  # decode_center_size; target [N, M, 4] offsets
+        dcx = target[..., 0] * pvar[:, 0] * pw + pcx
+        dcy = target[..., 1] * pvar[:, 1] * ph + pcy
+        dw = jnp.exp(target[..., 2] * pvar[:, 2]) * pw
+        dh = jnp.exp(target[..., 3] * pvar[:, 3]) * ph
+        out = jnp.stack(
+            [dcx - dw / 2, dcy - dh / 2, dcx + dw / 2, dcy + dh / 2], axis=-1
+        )
+    return {"OutputBox": out}
+
+
+@register_op("bipartite_match")
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching on a [N, M] distance matrix
+    (bipartite_match_op.cc BipartiteMatch): repeatedly take the global
+    max, bind its row to its column."""
+    dist = ins["DistMat"][0]
+    # batched via LoD on rows (one instance per sequence) or a single [N,M]
+    key = lod_key(ctx.op.inputs["DistMat"][0])
+    if key in ctx.env:
+        raise NotImplementedError(
+            "ragged bipartite_match batches: feed one instance per run "
+            "or a dense [N, M] matrix for now"
+        )
+    N, M = dist.shape
+    steps = min(N, M)
+
+    def body(carry, _):
+        d, row_of_col, dist_of_col = carry
+        flat = jnp.argmax(d)
+        i, j = flat // M, flat % M
+        best = d[i, j]
+        valid = best > _NEG
+        row_of_col = jnp.where(
+            valid, row_of_col.at[j].set(i.astype(jnp.int32)), row_of_col
+        )
+        dist_of_col = jnp.where(
+            valid, dist_of_col.at[j].set(best), dist_of_col
+        )
+        d = jnp.where(valid, d.at[i, :].set(_NEG).at[:, j].set(_NEG), d)
+        return (d, row_of_col, dist_of_col), None
+
+    init = (
+        dist.astype(jnp.float32),
+        jnp.full((M,), -1, jnp.int32),
+        jnp.zeros((M,), jnp.float32),
+    )
+    (d, row_of_col, dist_of_col), _ = lax.scan(body, init, None, length=steps)
+    return {
+        "ColToRowMatchIndices": row_of_col.reshape(1, M),
+        "ColToRowMatchDist": dist_of_col.reshape(1, M),
+    }
+
+
+def _iou(boxes):
+    """Pairwise IoU of [M, 4] xyxy boxes -> [M, M]."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * jnp.maximum(
+        boxes[:, 3] - boxes[:, 1], 0
+    )
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    inter = jnp.prod(jnp.maximum(rb - lt, 0), axis=-1)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+def _nms_class(scores, iou, nms_threshold, max_keep):
+    """Greedy NMS for one class: returns kept mask. scores [M] (already
+    score-threshold-masked to -inf), iou [M, M]."""
+    M = scores.shape[0]
+
+    def body(carry, _):
+        remaining, kept = carry
+        i = jnp.argmax(jnp.where(remaining, scores, _NEG))
+        ok = jnp.logical_and(remaining[i], scores[i] > _NEG)
+        kept = jnp.where(ok, kept.at[i].set(True), kept)
+        suppress = iou[i] > nms_threshold
+        remaining = jnp.where(
+            ok, jnp.logical_and(remaining, jnp.logical_not(suppress)), remaining
+        )
+        remaining = remaining.at[i].set(False)
+        return (remaining, kept), None
+
+    init = (scores > _NEG, jnp.zeros((M,), bool))
+    (_, kept), _ = lax.scan(body, init, None, length=min(max_keep, M))
+    return kept
+
+
+@register_op("multiclass_nms")
+def _multiclass_nms(ctx, ins, attrs):
+    """Per-class NMS + cross-class keep_top_k (multiclass_nms_op.cc).
+    Output: [N*keep_top_k, 6] rows = [label, score, x1, y1, x2, y2],
+    valid-first per image, per-image counts in the LoD side-band."""
+    scores = ins["Scores"][0]  # [N, C, M]
+    bboxes = ins["BBoxes"][0]  # [N, M, 4]
+    N, C, M = scores.shape
+    bg = int(attrs.get("background_label", 0))
+    score_thresh = float(attrs.get("score_threshold", 0.01))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    keep_top_k = int(attrs.get("keep_top_k", 200))
+    if keep_top_k < 0:
+        keep_top_k = C * M
+
+    def one_image(sc, bx):
+        iou = _iou(bx)
+
+        def one_class(c_scores):
+            s = jnp.where(c_scores > score_thresh, c_scores, _NEG)
+            kept = _nms_class(s, iou, nms_thresh, min(nms_top_k, M))
+            return jnp.where(kept, c_scores, _NEG)
+
+        per_class = jax.vmap(one_class)(sc)  # [C, M]
+        if 0 <= bg < C:
+            per_class = per_class.at[bg].set(_NEG)
+        flat = per_class.reshape(-1)  # [C*M]
+        k = min(keep_top_k, C * M)
+        top_s, top_i = lax.top_k(flat, k)
+        cls = (top_i // M).astype(jnp.float32)
+        box = bx[top_i % M]
+        valid = top_s > _NEG
+        rows = jnp.concatenate(
+            [cls[:, None], top_s[:, None], box], axis=1
+        )  # [k, 6]
+        rows = jnp.where(valid[:, None], rows, -1.0)
+        return rows, valid.sum().astype(jnp.int32)
+
+    rows, counts = jax.vmap(one_image)(scores, bboxes)  # [N,k,6], [N]
+    k = rows.shape[1]
+    out = rows.reshape(N * k, 6)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)]
+    )
+    # valid rows are already sorted first per image (top_k order); expose
+    # per-image counts as LoD over a *padded* buffer (rows beyond each
+    # count are -1 filler at fixed stride k)
+    out_name = ctx.op.outputs["Out"][0]
+    ctx.env[lod_key(out_name)] = offsets
+    ctx.env[out_name + "@PAD_STRIDE"] = k
+    return {"Out": out}

@@ -1,0 +1,102 @@
+"""Sampled-softmax-family kernels: NCE and hierarchical sigmoid.
+
+Parity: reference operators/nce_op.{h,cc} (uniform negative sampling,
+per-sample logistic loss) and operators/hierarchical_sigmoid_op
+(gserver HierarchicalSigmoidLayer) whose code table is the complete
+binary tree over `num_classes` leaves addressed by (label + num_classes)
+bit paths (framework MatrixBitCodeFunctor semantics).
+
+TPU-first: sampling uses the trace's counter-derived RNG key (determinism
+per step — registry.LoweringContext); everything is dense batched math;
+gradients come from jax.vjp, including the sparse-looking scatter into
+the class embedding matrices (XLA turns it into an efficient scatter).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = []
+
+
+@register_op("nce")
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation loss (reference nce_op.h NCEKernel):
+    one logistic term for each true class + num_neg_samples uniform noise
+    classes per example."""
+    x = ins["Input"][0]  # [N, D]
+    label = ins["Label"][0]  # [N, num_true]
+    w = ins["Weight"][0]  # [V, D]
+    b = ins["Bias"][0] if ins.get("Bias") else None  # [V]
+    num_total = int(attrs["num_total_classes"])
+    k = int(attrs.get("num_neg_samples", 10))
+    N = x.shape[0]
+    num_true = label.shape[1] if label.ndim > 1 else 1
+    label = label.reshape(N, num_true)
+
+    samples = jax.random.randint(
+        ctx.next_key(), (N, k), 0, num_total
+    )  # uniform sampler, reference's default Sampler
+    all_ids = jnp.concatenate([label, samples], axis=1)  # [N, T+k]
+    wj = w[all_ids]  # [N, T+k, D]
+    logits = jnp.einsum("nd,nkd->nk", x, wj)
+    if b is not None:
+        logits = logits + b.reshape(-1)[all_ids]
+
+    # P(noise) uniform = 1/num_total; logit correction log(k * p_noise)
+    log_kp = jnp.log(jnp.asarray(k / num_total, logits.dtype))
+    adj = logits - log_kp
+    lbl_mask = jnp.concatenate(
+        [jnp.ones((N, num_true)), jnp.zeros((N, k))], axis=1
+    ).astype(logits.dtype)
+    # logistic loss: -[y*log σ(adj) + (1-y)*log(1-σ(adj))]
+    loss = jnp.sum(
+        jax.nn.softplus(adj) - lbl_mask * adj, axis=1, keepdims=True
+    ) / num_true
+    if ins.get("SampleWeight"):
+        loss = loss * ins["SampleWeight"][0].reshape(N, 1)
+    return {
+        "Cost": loss.astype(x.dtype),
+        "SampleLogits": logits.astype(x.dtype),
+        "SampleLabels": all_ids,
+    }
+
+
+@register_op("hierarchical_sigmoid")
+def _hsigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over the complete binary tree (reference
+    hierarchical_sigmoid_op.h + MatrixBitCodeFunctor: node ids follow the
+    heap addressing code = label + num_classes, walking down by halving;
+    bit = code & 1 at each level)."""
+    x = ins["X"][0]  # [N, D]
+    w = ins["W"][0]  # [num_classes - 1, D]
+    label = ins["Label"][0].reshape(-1)  # [N]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    C = int(attrs["num_classes"])
+    N, D = x.shape
+    max_depth = max(1, math.ceil(math.log2(C)))
+
+    code = label + C  # heap index of the leaf
+    # walk from the leaf up: levels of (node, bit); node indexing w rows
+    # by heap_index - 1 for internal nodes (root = heap 1 -> row 0)
+    losses = jnp.zeros((N,), jnp.float32)
+    cur = code
+    for _ in range(max_depth):
+        parent = cur // 2
+        bit = (cur & 1).astype(jnp.float32)  # 1 if right child
+        valid = parent >= 1
+        row = jnp.clip(parent - 1, 0, C - 2)
+        logit = jnp.einsum("nd,nd->n", x, w[row])
+        if bias is not None:
+            logit = logit + bias.reshape(-1)[row]
+        # sigmoid cross entropy with target = bit
+        term = jax.nn.softplus(logit) - bit * logit
+        losses = losses + jnp.where(valid, term, 0.0)
+        cur = parent
+    pre_out = jnp.zeros((N, max_depth), x.dtype)  # reference cache output
+    return {"Out": losses.reshape(N, 1).astype(x.dtype), "PreOut": pre_out}

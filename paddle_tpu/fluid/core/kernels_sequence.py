@@ -175,7 +175,30 @@ def _sequence_slice(ctx, ins, attrs):
 
 @register_op("sequence_erase")
 def _sequence_erase(ctx, ins, attrs):
-    raise NotImplementedError(
-        "sequence_erase produces data-dependent shapes; on TPU use masking "
-        "(planned with the CTC milestone)"
+    """Remove listed token values (operators/sequence_erase_op). The output
+    is data-dependent-length; TPU-first representation: the packed buffer
+    keeps its static size, kept tokens are compacted to the front in order,
+    and the (traced) output offsets describe the new ragged layout —
+    consumers read only up to the offsets, the tail is garbage."""
+    x = ins["X"][0]
+    offsets = _offsets(ctx)
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    kept = jnp.ones((total,), bool)
+    for tok in attrs.get("tokens", []):
+        kept = jnp.logical_and(kept, flat != tok)
+    # global stable compaction: sequences stay in order, so per-sequence
+    # contiguity is preserved automatically
+    pos = jnp.cumsum(kept.astype(jnp.int32)) - 1
+    dest = jnp.where(kept, pos, total)  # removed -> spill slot
+    out = jnp.zeros((total + 1,), flat.dtype).at[dest].set(flat)[:total]
+    n = offsets.shape[0] - 1
+    ids = seg_ids(offsets, total)
+    kept_per_seq = jax.ops.segment_sum(
+        kept.astype(jnp.int32), ids, num_segments=n
     )
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(kept_per_seq, dtype=jnp.int32)]
+    )
+    _set_lod(ctx, "Out", new_offsets)
+    return {"Out": out.reshape((total,) + tuple(x.shape[1:]))}

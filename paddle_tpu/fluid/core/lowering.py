@@ -166,12 +166,13 @@ def lower_block(
     base_key=None,
     is_test: bool = False,
     seq_maxlen=None,
+    seq_buckets=None,
 ) -> Dict[str, Any]:
     """Symbolically execute a whole block (including an autodiff marker if
     present) over `env` and return the final environment."""
     return _lower_ops(
         block, block.ops, env, base_key=base_key, is_test=is_test,
-        seq_maxlen=seq_maxlen,
+        seq_maxlen=seq_maxlen, seq_buckets=seq_buckets,
     )
 
 
@@ -182,8 +183,10 @@ def _lower_ops(
     base_key=None,
     is_test: bool = False,
     seq_maxlen=None,
+    seq_buckets=None,
 ) -> Dict[str, Any]:
-    ctx = LoweringContext(block, base_key, is_test=is_test, seq_maxlen=seq_maxlen)
+    ctx = LoweringContext(block, base_key, is_test=is_test, seq_maxlen=seq_maxlen,
+                          seq_buckets=seq_buckets)
     fwd_ops, ad_op, tail_ops = _split_at_autodiff(ops)
 
     if ad_op is None:
@@ -254,6 +257,7 @@ def build_step_fn(
     is_test: bool = False,
     persist_in: Optional[Sequence[str]] = None,
     seq_maxlen: Optional[int] = None,
+    seq_buckets=None,
 ):
     """Build the pure step function over (persistables, feeds, rng-key).
 
@@ -282,7 +286,7 @@ def build_step_fn(
         env.update(feeds)
         env = _lower_ops(
             block, pruned_ops, env, base_key=key, is_test=is_test,
-            seq_maxlen=seq_maxlen,
+            seq_maxlen=seq_maxlen, seq_buckets=seq_buckets,
         )
         fetches = [env[n] for n in fetch_names]
         new_persist = {}
@@ -309,6 +313,7 @@ def build_multi_step_fn(
     persist_in: Optional[Sequence[str]] = None,
     scanned_feeds: Optional[Sequence[str]] = None,
     seq_maxlen: Optional[int] = None,
+    seq_buckets=None,
 ):
     """K training steps inside ONE compiled computation via lax.scan.
 
@@ -329,6 +334,7 @@ def build_multi_step_fn(
         is_test=is_test,
         persist_in=persist_in,
         seq_maxlen=seq_maxlen,
+        seq_buckets=seq_buckets,
     )
     if set(persist_out) != set(persist_in or []):
         raise ValueError(

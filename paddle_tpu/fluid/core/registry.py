@@ -62,7 +62,8 @@ class LoweringContext(object):
     replay under jax.vjp.
     """
 
-    def __init__(self, block, base_key, is_test: bool = False, seq_maxlen=None):
+    def __init__(self, block, base_key, is_test: bool = False, seq_maxlen=None,
+                 seq_buckets=None):
         self.block = block
         self._base_key = base_key
         self._rng_counter = 0
@@ -70,6 +71,10 @@ class LoweringContext(object):
         # static bucketed max sequence length for this trace (set by the
         # Executor from the fed LoD offsets); RNN kernels pad to this
         self.seq_maxlen = seq_maxlen
+        # per-feed buckets keyed by lod side-band name ("x@LOD0") so ops
+        # with inputs of very different raggedness (CTC: frames vs labels)
+        # pad each to its own tight bucket
+        self.seq_buckets = dict(seq_buckets or {})
         # set per-op by lowering.run_op; lets sequence kernels reach LoD
         # side-band entries without polluting every kernel signature
         self.op = None

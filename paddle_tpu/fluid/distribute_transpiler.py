@@ -1,0 +1,75 @@
+"""DistributeTranspiler: the reference's distributed-rewrite API, mapped
+onto mesh data parallelism.
+
+Reference (python/paddle/v2/fluid/distribute_transpiler.py:132): rewrites
+the program into trainer programs (split+send grad blocks) and pserver
+programs (listen_and_serv + optimize blocks) wired over gRPC. On TPU the
+entire mechanism collapses: gradients are aggregated by one `psum` over
+ICI that XLA inserts when the executor runs the UNMODIFIED program over a
+mesh. The API is kept so reference scripts run:
+
+  t = fluid.DistributeTranspiler()
+  t.transpile(trainer_id, pservers=..., trainers=N)
+  exe.run(t.get_trainer_program(), ...)   # data-parallel over the mesh
+
+get_pserver_program returns an empty program — there is no pserver role
+to play; running it is a no-op so pserver-branch scripts exit cleanly.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from .core.program import Program, default_main_program
+
+__all__ = ["DistributeTranspiler", "SimpleDistributeTranspiler",
+           "memory_optimize"]
+
+
+class DistributeTranspiler(object):
+    def __init__(self):
+        self._program = None
+        self._trainers = 1
+
+    def transpile(self, trainer_id=0, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, split_method=None, **kwargs):
+        self._program = program or default_main_program()
+        self._trainers = int(trainers)
+        self._trainer_id = int(trainer_id)
+        self._pservers = pservers.split(",") if isinstance(pservers, str) else list(pservers)
+
+    def get_trainer_program(self) -> Program:
+        """The original program, to be run by an Executor holding a mesh
+        whose 'data' axis plays the role of `trainers`."""
+        import jax
+
+        from ..parallel.mesh import get_default_mesh, make_mesh, set_default_mesh
+
+        if get_default_mesh() is None:
+            n = min(self._trainers, jax.device_count())
+            if n > 1:
+                set_default_mesh(make_mesh({"data": n}))
+            elif self._trainers > 1:
+                warnings.warn(
+                    "transpile(trainers=%d) but only %d device(s) visible; "
+                    "running single-device with identical global-batch math"
+                    % (self._trainers, jax.device_count())
+                )
+        return self._program
+
+    def get_pserver_program(self, endpoint, *args, **kwargs) -> Program:
+        return Program()  # no pserver role on TPU; empty program = no-op
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        return Program()
+
+
+class SimpleDistributeTranspiler(DistributeTranspiler):
+    """reference distribute_transpiler_simple.py — same collapse."""
+
+
+def memory_optimize(input_program, print_log=False, **kwargs):
+    """reference memory_optimization_transpiler.py:270 rewrites var reuse
+    via liveness analysis. XLA's buffer assignment already performs this
+    inside the fused computation, so the API is a validated no-op."""
+    return input_program

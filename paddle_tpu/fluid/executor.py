@@ -229,8 +229,10 @@ class Executor(object):
         )
         # static time extent for RNN padding: bucket the batch's true max
         # sequence length to a power of two so recompiles happen per bucket,
-        # not per batch composition (kernels_rnn.py docstring)
-        seq_maxlen = _lod_bucket(feed_arrays)
+        # not per batch composition (kernels_rnn.py docstring). Per-feed
+        # buckets let ops with very different raggedness (CTC frames vs
+        # labels) each pad tightly.
+        seq_maxlen, seq_buckets = _lod_bucket(feed_arrays)
         persist_in = {n: scope.get(n) for n in persist_names if n in scope}
         mesh = self._resolve_mesh()
         if mesh is not None:
@@ -262,6 +264,7 @@ class Executor(object):
             scan_feeds,
             shard_fp,
             seq_maxlen,
+            tuple(sorted(seq_buckets.items())),
         ) + ((id(mesh),) if mesh is not None else ())
         entry = self._cache.get(key) if use_cache else None
         if entry is None:
@@ -273,6 +276,7 @@ class Executor(object):
                     persist_names=persist_names,
                     persist_in=list(persist_in.keys()),
                     seq_maxlen=seq_maxlen,
+                    seq_buckets=seq_buckets,
                 )
             else:
                 fn, persist_out = build_multi_step_fn(
@@ -284,6 +288,7 @@ class Executor(object):
                     persist_in=list(persist_in.keys()),
                     scanned_feeds=scanned,
                     seq_maxlen=seq_maxlen,
+                    seq_buckets=seq_buckets,
                 )
             jit_kwargs = {}
             if mesh is not None:
@@ -317,20 +322,24 @@ class Executor(object):
 
 
 def _lod_bucket(feed_arrays):
-    """Max sequence length over all fed LoD offset vectors, rounded up to
-    the next power of two (min 8). None when nothing ragged is fed."""
+    """Bucket each fed LoD's max sequence length up to the next power of
+    two (min 8). Returns (global_max_bucket_or_None, {lod_name: bucket})."""
+
+    def bucket(m):
+        b = 8
+        while b < m:
+            b *= 2
+        return b
+
+    per_name = {}
     m = 0
     for n, a in feed_arrays.items():
         if n.endswith(LOD_SUFFIX):
             d = np.diff(np.asarray(a))
-            if d.size:
+            if d.size and int(d.max()) > 0:
+                per_name[n] = bucket(int(d.max()))
                 m = max(m, int(d.max()))
-    if m == 0:
-        return None
-    b = 8
-    while b < m:
-        b *= 2
-    return b
+    return (bucket(m) if m else None), per_name
 
 
 def _split_lod_feed(value):

@@ -1,5 +1,6 @@
-from . import control_flow, io, nn, ops, tensor
+from . import control_flow, detection, io, nn, ops, tensor
 from .control_flow import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
@@ -7,6 +8,7 @@ from .tensor import *  # noqa: F401,F403
 
 __all__ = []
 __all__ += control_flow.__all__
+__all__ += detection.__all__
 __all__ += io.__all__
 __all__ += nn.__all__
 __all__ += ops.__all__

@@ -1,0 +1,118 @@
+"""Detection layers (reference python/paddle/v2/fluid/layers/detection.py
+detection_output:23, plus thin wrappers over the detection op kernels)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "detection_output",
+    "prior_box",
+    "box_coder",
+    "bipartite_match",
+    "multiclass_nms",
+]
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", name=None):
+    helper = LayerHelper("box_coder", **locals())
+    output_box = helper.create_tmp_variable(dtype=target_box.dtype)
+    helper.append_op(
+        type="box_coder",
+        inputs={
+            "PriorBox": [prior_box],
+            "PriorBoxVar": [prior_box_var],
+            "TargetBox": [target_box],
+        },
+        outputs={"OutputBox": [output_box]},
+        attrs={"code_type": code_type},
+    )
+    return output_box
+
+
+def multiclass_nms(scores, bboxes, background_label=0, score_threshold=0.01,
+                   nms_top_k=400, nms_threshold=0.3, keep_top_k=200,
+                   nms_eta=1.0, name=None):
+    helper = LayerHelper("multiclass_nms", **locals())
+    out = helper.create_tmp_variable(dtype=bboxes.dtype)
+    out.lod_level = 1
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"Scores": [scores], "BBoxes": [bboxes]},
+        outputs={"Out": [out]},
+        attrs={
+            "background_label": background_label,
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "nms_threshold": nms_threshold,
+            "keep_top_k": keep_top_k,
+            "nms_eta": nms_eta,
+        },
+    )
+    return out
+
+
+def detection_output(scores, loc, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """Decode predicted offsets against priors, then multiclass NMS
+    (reference detection.py:23). Output rows are
+    [label, confidence, xmin, ymin, xmax, ymax], padded per image with -1
+    rows to keep_top_k; per-image valid counts ride the LoD side-band."""
+    decoded = box_coder(
+        prior_box=prior_box,
+        prior_box_var=prior_box_var,
+        target_box=loc,
+        code_type="decode_center_size",
+    )
+    return multiclass_nms(
+        scores=scores,
+        bboxes=decoded,
+        background_label=background_label,
+        score_threshold=score_threshold,
+        nms_top_k=nms_top_k,
+        nms_threshold=nms_threshold,
+        keep_top_k=keep_top_k,
+        nms_eta=nms_eta,
+    )
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, step_w=0.0, step_h=0.0,
+              offset=0.5, name=None):
+    helper = LayerHelper("prior_box", **locals())
+    boxes = helper.create_tmp_variable(dtype=input.dtype)
+    variances = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios or []),
+            "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+            "flip": flip,
+            "clip": clip,
+            "step_w": step_w,
+            "step_h": step_h,
+            "offset": offset,
+        },
+    )
+    return boxes, variances
+
+
+def bipartite_match(dist_matrix, name=None):
+    helper = LayerHelper("bipartite_match", **locals())
+    match_indices = helper.create_tmp_variable(dtype="int32")
+    match_dist = helper.create_tmp_variable(dtype=dist_matrix.dtype)
+    helper.append_op(
+        type="bipartite_match",
+        inputs={"DistMat": [dist_matrix]},
+        outputs={
+            "ColToRowMatchIndices": [match_indices],
+            "ColToRowMatchDist": [match_dist],
+        },
+    )
+    return match_indices, match_dist

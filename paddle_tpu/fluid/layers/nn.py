@@ -36,6 +36,11 @@ __all__ = [
     "chunk_eval",
     "linear_chain_crf",
     "crf_decoding",
+    "warpctc",
+    "edit_distance",
+    "nce",
+    "hsigmoid",
+    "sequence_erase",
     "auc",
     "topk",
     "matmul",
@@ -1102,5 +1107,116 @@ def gather(input, index):
         type="gather",
         inputs={"X": [input], "Index": [index]},
         outputs={"Out": [out]},
+    )
+    return out
+
+def warpctc(input, label, blank=0, norm_by_times=False, **kwargs):
+    """CTC loss over ragged logits/labels (reference layers/nn.py:2657 ->
+    operators/warpctc_op; TPU-native log-space recursion in
+    core/kernels_ctc.py instead of the dynloaded libwarpctc)."""
+    helper = LayerHelper("warpctc", **kwargs)
+    loss_out = helper.create_tmp_variable(dtype=input.dtype)
+    grad_out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input], "Label": [label]},
+        outputs={"WarpCTCGrad": [grad_out], "Loss": [loss_out]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss_out
+
+
+def sequence_erase(input, tokens):
+    """Remove the given token values from each sequence (reference
+    operators/sequence_erase_op)."""
+    helper = LayerHelper("sequence_erase", **locals())
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="sequence_erase",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"tokens": list(tokens)},
+    )
+    return out
+
+
+def edit_distance(input, label, normalized=False, ignored_tokens=None,
+                  name=None):
+    """Levenshtein distance between hypothesis and reference id sequences
+    (reference layers/nn.py:2492 -> operators/edit_distance_op)."""
+    helper = LayerHelper("edit_distance", **locals())
+    if ignored_tokens is not None and len(ignored_tokens) > 0:
+        input = sequence_erase(input, ignored_tokens)
+        label = sequence_erase(label, ignored_tokens)
+    out = helper.create_tmp_variable(dtype="float32")
+    seq_num = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(
+        type="edit_distance",
+        inputs={"Hyps": [input], "Refs": [label]},
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized},
+    )
+    return out, seq_num
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None):
+    """Noise-contrastive estimation loss (reference layers/nn.py:2767 ->
+    operators/nce_op)."""
+    helper = LayerHelper("nce", **locals())
+    dim = input.shape[1]
+    num_true_class = label.shape[1] if label.shape and len(label.shape) > 1 else 1
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_total_classes, dim],
+        is_bias=False, dtype=input.dtype,
+    )
+    b = helper.create_parameter(
+        attr=helper.bias_attr, shape=[num_total_classes, 1],
+        is_bias=True, dtype=input.dtype,
+    )
+    cost = helper.create_tmp_variable(dtype=input.dtype)
+    sample_logits = helper.create_tmp_variable(dtype=input.dtype)
+    sample_labels = helper.create_tmp_variable(dtype=label.dtype)
+    num_neg_samples = 10 if num_neg_samples is None else int(num_neg_samples)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w], "Bias": [b]}
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    helper.append_op(
+        type="nce",
+        inputs=inputs,
+        outputs={
+            "Cost": [cost],
+            "SampleLogits": [sample_logits],
+            "SampleLabels": [sample_labels],
+        },
+        attrs={
+            "num_total_classes": int(num_total_classes),
+            "num_neg_samples": num_neg_samples,
+        },
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None):
+    """Hierarchical sigmoid loss over the complete binary class tree
+    (reference operators/hierarchical_sigmoid_op, gserver
+    HierarchicalSigmoidLayer)."""
+    helper = LayerHelper("hsigmoid", **locals())
+    dim = input.shape[1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_classes - 1, dim],
+        is_bias=False, dtype=input.dtype,
+    )
+    b = helper.create_parameter(
+        attr=helper.bias_attr, shape=[num_classes - 1, 1],
+        is_bias=True, dtype=input.dtype,
+    )
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    pre_out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs={"X": [input], "W": [w], "Label": [label], "Bias": [b]},
+        outputs={"Out": [out], "PreOut": [pre_out]},
+        attrs={"num_classes": int(num_classes)},
     )
     return out

@@ -1,0 +1,166 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its data plane native (RecordIO chunks for the Go
+master, PyDataProvider2's C++ prefetch queue); this package does the same
+for the TPU framework: `recordio.cc` is compiled on first use with the
+ambient g++ into a shared library (no pybind11 in this environment — the
+C ABI + ctypes is the binding). Pure-Python fallbacks keep the API alive
+on machines without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_build", "librecordio.so")
+_SRC = os.path.join(_HERE, "recordio.cc")
+_lock = threading.Lock()
+_lib = None
+_build_error = None
+
+
+def _build() -> str:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    tmp = _SO + ".tmp.so"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _SO)
+    return _SO
+
+
+def lib():
+    """The loaded shared library, building it on first use. Raises
+    RuntimeError when no toolchain is available."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError("native build failed earlier: %s" % _build_error)
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+            ):
+                _build()
+            L = ctypes.CDLL(_SO)
+        except Exception as e:  # keep the error for later callers
+            _build_error = e
+            raise RuntimeError("cannot build/load native recordio: %s" % e)
+        L.rio_writer_open.restype = ctypes.c_void_p
+        L.rio_writer_open.argtypes = [ctypes.c_char_p]
+        L.rio_write.restype = ctypes.c_int
+        L.rio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        L.rio_writer_close.argtypes = [ctypes.c_void_p]
+        L.rio_open.restype = ctypes.c_void_p
+        L.rio_open.argtypes = [ctypes.c_char_p]
+        L.rio_next.restype = ctypes.c_int64
+        L.rio_next.argtypes = [ctypes.c_void_p]
+        L.rio_data.restype = ctypes.POINTER(ctypes.c_uint8)
+        L.rio_data.argtypes = [ctypes.c_void_p]
+        L.rio_close.argtypes = [ctypes.c_void_p]
+        L.pq_open.restype = ctypes.c_void_p
+        L.pq_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ]
+        L.pq_next.restype = ctypes.c_int64
+        L.pq_next.argtypes = [ctypes.c_void_p]
+        L.pq_data.restype = ctypes.POINTER(ctypes.c_uint8)
+        L.pq_data.argtypes = [ctypes.c_void_p]
+        L.pq_close.argtypes = [ctypes.c_void_p]
+        _lib = L
+        return _lib
+
+
+def available() -> bool:
+    try:
+        lib()
+        return True
+    except RuntimeError:
+        return False
+
+
+# ---------------------------------------------------------------------
+# Python surface
+# ---------------------------------------------------------------------
+
+
+class RecordWriter(object):
+    """Length-prefixed CRC-checked record file writer."""
+
+    def __init__(self, path: str):
+        self._h = lib().rio_writer_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s for writing" % path)
+
+    def write(self, payload: bytes):
+        if lib().rio_write(self._h, payload, len(payload)) != 0:
+            raise IOError("record write failed")
+
+    def close(self):
+        if self._h:
+            lib().rio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def read_records(path: str):
+    """Synchronous record iterator."""
+    L = lib()
+    h = L.rio_open(path.encode())
+    if not h:
+        raise IOError("cannot open %s" % path)
+    try:
+        while True:
+            n = L.rio_next(h)
+            if n <= 0:
+                return
+            yield ctypes.string_at(L.rio_data(h), n)
+    finally:
+        L.rio_close(h)
+
+
+class PrefetchReader(object):
+    """Async prefetch over a list of record files: a native thread streams
+    records into a bounded queue (PyDataProvider2 double-buffer parity);
+    iteration pops from the queue."""
+
+    def __init__(self, paths, capacity: int = 64):
+        L = lib()
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths]
+        )
+        self._h = L.pq_open(arr, len(paths), capacity)
+        self._L = L
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = self._L.pq_next(self._h)
+        if n <= 0:
+            self.close()
+            raise StopIteration
+        return ctypes.string_at(self._L.pq_data(self._h), n)
+
+    def close(self):
+        if self._h:
+            self._L.pq_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -51,7 +51,12 @@ def sharded_lookup(table, ids, mesh: Optional[Mesh] = None, axis: str = "model")
         mesh = get_default_mesh()
     flat = ids.reshape(-1)
     if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] == 1:
-        out = table[flat]
+        # out-of-range ids yield zero rows, matching the sharded path
+        # (where no shard claims them) instead of jax's gather clamping
+        valid = jnp.logical_and(flat >= 0, flat < table.shape[0])
+        out = jnp.where(
+            valid[:, None], table[jnp.clip(flat, 0, table.shape[0] - 1)], 0
+        )
     else:
         if table.shape[0] % mesh.shape[axis] != 0:
             raise ValueError(

@@ -13,7 +13,10 @@ import queue as _queue
 import random
 import threading
 
+from . import creator  # noqa: F401
+
 __all__ = [
+    "creator",
     "map_readers",
     "buffered",
     "compose",

@@ -1,0 +1,51 @@
+"""Reader creators from storage (reference python/paddle/v2/reader/
+creator.py: np_array, text_file, recordio). The recordio variant streams
+through the native C++ prefetch queue (paddle_tpu.native)."""
+
+from __future__ import annotations
+
+import pickle
+
+__all__ = ["np_array", "text_file", "recordio", "pickled_records"]
+
+
+def np_array(x):
+    def reader():
+        for e in x:
+            yield e
+
+    return reader
+
+
+def text_file(path):
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Raw-bytes reader over record files via the native async prefetcher."""
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        from ... import native
+
+        yield from native.PrefetchReader(list(paths), capacity=buf_size)
+
+    return reader
+
+
+def pickled_records(paths, buf_size=100):
+    """recordio + pickle.loads per record (the common case: each record is
+    one training instance tuple)."""
+    base = recordio(paths, buf_size)
+
+    def reader():
+        for raw in base():
+            yield pickle.loads(raw)
+
+    return reader

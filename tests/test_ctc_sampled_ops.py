@@ -1,0 +1,199 @@
+"""CTC, edit distance, NCE, hsigmoid vs numpy oracles (reference
+test_warpctc_op.py, test_edit_distance_op.py, test_nce.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+pd = fluid.layers
+
+
+def _lod(lens):
+    return np.cumsum([0] + list(lens)).astype(np.int32)
+
+
+def _np_ctc_loss(logits, labels, blank):
+    """Brute-force-ish CTC via the standard alpha recursion in prob space
+    (small sizes)."""
+    T, C = logits.shape
+    probs = np.exp(logits - logits.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    z = [blank]
+    for l in labels:
+        z += [l, blank]
+    S = len(z)
+    alpha = np.zeros((T, S))
+    alpha[0, 0] = probs[0, z[0]]
+    if S > 1:
+        alpha[0, 1] = probs[0, z[1]]
+    for t in range(1, T):
+        for s in range(S):
+            a = alpha[t - 1, s]
+            if s >= 1:
+                a += alpha[t - 1, s - 1]
+            if s >= 2 and z[s] != blank and z[s] != z[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * probs[t, z[s]]
+    p = alpha[T - 1, S - 1] + (alpha[T - 1, S - 2] if S > 1 else 0.0)
+    return -np.log(max(p, 1e-300))
+
+
+def test_warpctc_matches_numpy():
+    rng = np.random.RandomState(0)
+    C = 6  # classes incl. blank 0
+    t_lens = [5, 7, 4]
+    l_lens = [2, 3, 1]
+    logits = rng.randn(sum(t_lens), C).astype(np.float32)
+    labels = np.concatenate(
+        [rng.randint(1, C, l) for l in l_lens]
+    ).reshape(-1, 1).astype(np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = pd.data(name="logits", shape=[C], dtype="float32", lod_level=1)
+        lab = pd.data(name="label", shape=[1], dtype="int64", lod_level=1)
+        loss = pd.warpctc(input=x, label=lab, blank=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (got,) = exe.run(
+        main,
+        feed={
+            "logits": (logits, [_lod(t_lens)]),
+            "label": (labels, [_lod(l_lens)]),
+        },
+        fetch_list=[loss],
+    )
+    off_t, off_l = _lod(t_lens), _lod(l_lens)
+    for i in range(3):
+        want = _np_ctc_loss(
+            logits[off_t[i]:off_t[i + 1]],
+            labels[off_l[i]:off_l[i + 1], 0],
+            blank=0,
+        )
+        assert np.allclose(got[i, 0], want, atol=1e-3), (i, got[i, 0], want)
+
+
+def test_warpctc_trains():
+    """CTC loss decreases on a learnable alignment task."""
+    rng = np.random.RandomState(1)
+    C, T, B = 5, 8, 4
+    t_lens = [T] * B
+    l_lens = [3] * B
+    feats = rng.randn(sum(t_lens), 4).astype(np.float32)
+    labels = rng.randint(1, C, (sum(l_lens), 1)).astype(np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = pd.data(name="x", shape=[4], dtype="float32", lod_level=1)
+        lab = pd.data(name="label", shape=[1], dtype="int64", lod_level=1)
+        logits = pd.fc(input=x, size=C)
+        loss = pd.mean(x=pd.warpctc(input=logits, label=lab))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ls = []
+    for _ in range(30):
+        (l,) = exe.run(
+            main,
+            feed={
+                "x": (feats, [_lod(t_lens)]),
+                "label": (labels, [_lod(l_lens)]),
+            },
+            fetch_list=[loss],
+        )
+        ls.append(float(np.ravel(l)[0]))
+    assert ls[-1] < ls[0] * 0.7, (ls[0], ls[-1])
+
+
+def _np_edit(h, r):
+    m, n = len(h), len(r)
+    d = np.zeros((m + 1, n + 1))
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i, j] = min(
+                d[i - 1, j] + 1,
+                d[i, j - 1] + 1,
+                d[i - 1, j - 1] + (h[i - 1] != r[j - 1]),
+            )
+    return d[m, n]
+
+
+def test_edit_distance_matches_numpy():
+    rng = np.random.RandomState(2)
+    h_lens = [4, 6, 1, 5]
+    r_lens = [5, 3, 2, 5]
+    hyp = rng.randint(0, 8, (sum(h_lens), 1)).astype(np.int64)
+    ref = rng.randint(0, 8, (sum(r_lens), 1)).astype(np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = pd.data(name="hyp", shape=[1], dtype="int64", lod_level=1)
+        y = pd.data(name="ref", shape=[1], dtype="int64", lod_level=1)
+        dist, seq_num = pd.edit_distance(input=x, label=y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, n = exe.run(
+        main,
+        feed={"hyp": (hyp, [_lod(h_lens)]), "ref": (ref, [_lod(r_lens)])},
+        fetch_list=[dist, seq_num],
+    )
+    ho, ro = _lod(h_lens), _lod(r_lens)
+    for i in range(4):
+        want = _np_edit(
+            hyp[ho[i]:ho[i + 1], 0].tolist(), ref[ro[i]:ro[i + 1], 0].tolist()
+        )
+        assert got[i, 0] == want, (i, got[i, 0], want)
+    assert int(n[0]) == 4
+
+
+def test_nce_trains():
+    rng = np.random.RandomState(3)
+    V, D, N = 40, 8, 32
+    x = rng.randn(N, D).astype(np.float32)
+    y = (np.abs(x.sum(1)) * 7).astype(np.int64) % V
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = pd.data(name="x", shape=[D], dtype="float32")
+        yv = pd.data(name="y", shape=[1], dtype="int64")
+        cost = pd.nce(
+            input=xv, label=yv, num_total_classes=V, num_neg_samples=8
+        )
+        loss = pd.mean(x=cost)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ls = []
+    for _ in range(40):
+        (l,) = exe.run(
+            main, feed={"x": x, "y": y.reshape(-1, 1)}, fetch_list=[loss]
+        )
+        ls.append(float(np.ravel(l)[0]))
+    assert np.isfinite(ls).all()
+    assert ls[-1] < ls[0] * 0.8, (ls[0], ls[-1])
+
+
+def test_hsigmoid_trains_and_beats_chance():
+    rng = np.random.RandomState(4)
+    C, D, N = 8, 6, 64
+    centers = rng.randn(C, D).astype(np.float32) * 2
+    y = rng.randint(0, C, N)
+    x = centers[y] + 0.1 * rng.randn(N, D).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = pd.data(name="x", shape=[D], dtype="float32")
+        yv = pd.data(name="y", shape=[1], dtype="int64")
+        cost = pd.hsigmoid(input=xv, label=yv, num_classes=C)
+        loss = pd.mean(x=cost)
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ls = []
+    for _ in range(60):
+        (l,) = exe.run(
+            main, feed={"x": x, "y": y.reshape(-1, 1).astype(np.int64)},
+            fetch_list=[loss],
+        )
+        ls.append(float(np.ravel(l)[0]))
+    assert ls[-1] < ls[0] * 0.3, (ls[0], ls[-1])

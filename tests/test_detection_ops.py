@@ -1,0 +1,136 @@
+"""Detection ops vs numpy oracles (reference test_prior_box_op.py,
+test_box_coder_op.py, test_bipartite_match_op.py, test_multiclass_nms_op)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+pd = fluid.layers
+
+
+def test_box_coder_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    M = 12
+    priors = np.sort(rng.rand(M, 4).astype(np.float32), axis=1)
+    pvar = np.full((M, 4), 0.1, np.float32)
+    gt = np.sort(rng.rand(M, 4).astype(np.float32), axis=1)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pb = pd.data(name="pb", shape=[M, 4], dtype="float32", append_batch_size=False)
+        pv = pd.data(name="pv", shape=[M, 4], dtype="float32", append_batch_size=False)
+        tb = pd.data(name="tb", shape=[M, 4], dtype="float32", append_batch_size=False)
+        enc = pd.box_coder(pb, pv, tb, code_type="encode_center_size")
+        dec = pd.box_coder(pb, pv, enc, code_type="decode_center_size")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    enc_v, dec_v = exe.run(
+        main, feed={"pb": priors, "pv": pvar, "tb": gt}, fetch_list=[enc, dec]
+    )
+    np.testing.assert_allclose(dec_v, gt, atol=1e-4)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array(
+        [[0.1, 0.9, 0.3],
+         [0.8, 0.2, 0.7],
+         [0.4, 0.5, 0.6]], np.float32
+    )
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = pd.data(name="d", shape=[3, 3], dtype="float32", append_batch_size=False)
+        idx, mdist = pd.bipartite_match(d)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got_idx, got_dist = exe.run(main, feed={"d": dist}, fetch_list=[idx, mdist])
+    # greedy: (0,1)=0.9 first, then (1,0)=0.8, then (2,2)=0.6
+    assert got_idx.reshape(-1).tolist() == [1, 0, 2]
+    np.testing.assert_allclose(got_dist.reshape(-1), [0.8, 0.9, 0.6], atol=1e-6)
+
+
+def _np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(scores), bool)
+    for i in order:
+        if sup[i] or scores[i] <= 0.01:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or sup[j]:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0]); yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2]); yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / max(a1 + a2 - inter, 1e-12) > thresh:
+                sup[j] = True
+    return keep
+
+
+def test_multiclass_nms_matches_numpy():
+    rng = np.random.RandomState(1)
+    N, C, M = 2, 3, 10
+    centers = rng.rand(M, 2).astype(np.float32)
+    sizes = 0.1 + 0.2 * rng.rand(M, 2).astype(np.float32)
+    boxes = np.concatenate([centers - sizes / 2, centers + sizes / 2], axis=1)
+    bboxes = np.stack([boxes] * N)
+    scores = rng.rand(N, C, M).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        s = pd.data(name="s", shape=[C, M], dtype="float32")
+        b = pd.data(name="b", shape=[M, 4], dtype="float32")
+        out = pd.multiclass_nms(
+            scores=s, bboxes=b, background_label=0, nms_threshold=0.4,
+            keep_top_k=20, score_threshold=0.01,
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (got,) = exe.run(main, feed={"s": scores, "b": bboxes}, fetch_list=[out])
+    stride = got.shape[0] // N
+    for n in range(N):
+        rows = got[n * stride:(n + 1) * stride]
+        valid = rows[rows[:, 0] >= 0]
+        # oracle: per non-background class NMS, then all merged by score
+        want = []
+        for c in range(1, C):
+            for i in _np_nms(boxes, scores[n, c], 0.4):
+                want.append((c, scores[n, c, i], i))
+        want.sort(key=lambda t: -t[1])
+        assert len(valid) == len(want)
+        for row, (c, sc, i) in zip(valid, want):
+            assert int(row[0]) == c
+            assert np.isclose(row[1], sc, atol=1e-5)
+            np.testing.assert_allclose(row[2:], boxes[i], atol=1e-5)
+
+
+def test_prior_box_shapes_and_geometry():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = pd.data(name="feat", shape=[8, 4, 4], dtype="float32")
+        img = pd.data(name="img", shape=[3, 32, 32], dtype="float32")
+        boxes, variances = pd.prior_box(
+            input=feat, image=img, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True,
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    b, v = exe.run(
+        main,
+        feed={
+            "feat": rng.rand(1, 8, 4, 4).astype(np.float32),
+            "img": rng.rand(1, 3, 32, 32).astype(np.float32),
+        },
+        fetch_list=[boxes, variances],
+    )
+    # priors: 1 min_size * (1 + 2 flip ratios) + 1 max_size = 4 per cell
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    assert (b >= 0).all() and (b <= 1).all()  # clipped
+    assert (b[..., 2] >= b[..., 0]).all() and (b[..., 3] >= b[..., 1]).all()
+    # center of cell (0,0) is at offset*step = 4px / 32 = 0.125
+    cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+    assert np.isclose(cx, 0.125, atol=1e-3)

@@ -1,0 +1,221 @@
+"""Distributed job services: coordinator leases, checkpoint/resume,
+transpiler shim. Parity: go/master/service_internal_test.go +
+go/pserver/service_test.go behaviors, in-process (SURVEY §4.4 lesson)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import (
+    Coordinator,
+    MasterClient,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+# ---------------------------------------------------------------------------
+# coordinator (Go master parity)
+# ---------------------------------------------------------------------------
+
+
+def test_task_lease_cycle():
+    c = Coordinator(timeout_s=60)
+    c.set_dataset(["s0", "s1", "s2"])
+    t0 = c.get_task()
+    t1 = c.get_task()
+    assert {t0.payload, t1.payload} == {"s0", "s1"}
+    c.task_finished(t0.task_id)
+    c.task_finished(t1.task_id)
+    t2 = c.get_task()
+    assert t2.payload == "s2"
+    assert c.get_task() is None  # everything leased/done
+    c.task_finished(t2.task_id)
+    assert c.get_task() is None  # pass ended; no silent rollover
+    # explicit next pass: all tasks come back
+    nxt = c.get_task(epoch_limit=1)
+    assert nxt is not None and nxt.epoch == 1
+
+
+def test_lease_timeout_requeues():
+    c = Coordinator(timeout_s=0.05)
+    c.set_dataset(["only"])
+    t = c.get_task()
+    assert t is not None
+    time.sleep(0.1)  # lease expires: worker presumed dead
+    t2 = c.get_task()
+    assert t2 is not None and t2.task_id == t.task_id
+    assert t2.failures == 1
+
+
+def test_failure_max_discards():
+    c = Coordinator(timeout_s=60, failure_max=2)
+    c.set_dataset(["bad", "good"])
+    for _ in range(2):
+        t = next(
+            x for x in [c.get_task(), c.get_task()] if x and x.payload == "bad"
+        )
+        # return the good one if we leased it
+        for p in list(c.pending.values()):
+            if p.payload == "good":
+                c.task_finished(p.task_id)
+        c.task_failed(t.task_id)
+    # 'bad' is discarded; only an explicit next pass brings it back
+    leases = []
+    while True:
+        t = c.get_task()
+        if t is None:
+            break
+        leases.append(t.payload)
+        c.task_finished(t.task_id)
+    assert "bad" not in leases
+
+
+def test_snapshot_recover(tmp_path):
+    snap = str(tmp_path / "master.json")
+    c = Coordinator(timeout_s=60, snapshot_path=snap)
+    c.set_dataset(["a", "b", "c"])
+    t = c.get_task()
+    c.task_finished(t.task_id)
+    leased = c.get_task()  # leased but never finished — worker dies
+    del c
+
+    c2 = Coordinator(timeout_s=60, snapshot_path=snap)
+    # recovered: the unfinished lease is back in todo, done is preserved
+    payloads = []
+    while True:
+        t = c2.get_task()
+        if t is None:
+            break
+        payloads.append(t.payload)
+        c2.task_finished(t.task_id)
+    assert leased.payload in payloads
+    assert len(payloads) == 2  # 'a' was done, 'b'+'c' remained
+
+
+def test_master_client_streams_and_retries():
+    c = Coordinator(timeout_s=60, failure_max=10)
+    c.set_dataset([0, 1, 2, 3])
+    crashed = []
+
+    def record_fn(payload):
+        # shard 2 crashes on its first lease, succeeds on retry
+        if payload == 2 and 2 not in crashed:
+            crashed.append(2)
+            raise IOError("transient read error")
+        for i in range(3):
+            yield payload * 10 + i
+
+    got = sorted(MasterClient(c, record_fn))
+    want = sorted(p * 10 + i for p in range(4) for i in range(3))
+    assert got == want
+    assert crashed == [2]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume (Go pserver parity)
+# ---------------------------------------------------------------------------
+
+
+def _train_some(steps):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, param_attr=fluid.ParamAttr(name="ck_w"))
+    loss = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=pred, label=y)
+    )
+    fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xd = rng.randn(16, 4).astype(np.float32)
+    yd = (xd.sum(axis=1, keepdims=True)).astype(np.float32)
+    for _ in range(steps):
+        (l,) = exe.run(feed={"x": xd, "y": yd}, fetch_list=[loss])
+    return exe, float(np.ravel(l)[0]), {"x": xd, "y": yd}, loss
+
+
+def test_checkpoint_resume_exact(tmp_path):
+    d = str(tmp_path / "ckpt")
+    exe, loss5, feed, loss_var = _train_some(5)
+    scope = fluid.global_scope()
+    meta = save_checkpoint(scope, d, step=5)
+    assert meta["step"] == 5
+    # train 3 more steps -> state diverges
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss_var])
+    after8 = {k: np.asarray(scope.get(k)).copy() for k in scope.keys()}
+
+    # restore: optimizer momentum state comes back too, so re-running 3
+    # steps reproduces the exact same trajectory
+    load_checkpoint(scope, d)
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss_var])
+    for k, v in after8.items():
+        np.testing.assert_allclose(
+            np.asarray(scope.get(k)), v, rtol=1e-6, err_msg=k
+        )
+
+
+def test_checkpoint_detects_corruption(tmp_path):
+    d = str(tmp_path / "ckpt")
+    exe, _, _, _ = _train_some(1)
+    scope = fluid.global_scope()
+    save_checkpoint(scope, d, step=1)
+    # flip bytes in one shard file
+    victim = next(f for f in os.listdir(d) if f.endswith(".npy"))
+    path = os.path.join(d, victim)
+    data = bytearray(open(path, "rb").read())
+    data[-1] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(IOError):
+        load_checkpoint(scope, d)
+
+
+# ---------------------------------------------------------------------------
+# transpiler shim
+# ---------------------------------------------------------------------------
+
+
+def test_distribute_transpiler_api():
+    from paddle_tpu import parallel
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=pred, label=y)
+    )
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, pservers="127.0.0.1:6174,127.0.0.1:6175",
+                trainers=8)
+    prev_mesh = parallel.get_default_mesh()
+    try:
+        parallel.set_default_mesh(None)
+        trainer_prog = t.get_trainer_program()
+        assert trainer_prog is fluid.default_main_program()
+        mesh = parallel.get_default_mesh()
+        assert mesh is not None and mesh.shape["data"] == 8
+        # pserver branch: empty no-op program
+        ps = t.get_pserver_program("127.0.0.1:6174")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(ps)  # must not raise
+
+        # and the trainer program actually trains over the mesh
+        exe2 = fluid.Executor(mesh=mesh)
+        exe2.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        xd = rng.randn(16, 4).astype(np.float32)
+        yd = xd.sum(axis=1, keepdims=True).astype(np.float32)
+        l0 = exe2.run(trainer_prog, feed={"x": xd, "y": yd}, fetch_list=[loss])
+        l1 = exe2.run(trainer_prog, feed={"x": xd, "y": yd}, fetch_list=[loss])
+        assert float(np.ravel(l1[0])[0]) < float(np.ravel(l0[0])[0])
+    finally:
+        parallel.set_default_mesh(prev_mesh)
+
+    assert fluid.memory_optimize(fluid.default_main_program()) is not None

@@ -1,0 +1,81 @@
+"""Native C++ data plane: recordio round trip, prefetch queue, torn-tail
+recovery, coordinator + reader integration (SURVEY N21 data path)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu.native as native
+import paddle_tpu.v2 as paddle
+from paddle_tpu.distributed import Coordinator, MasterClient
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain"
+)
+
+
+def _write(path, items):
+    with native.RecordWriter(path) as w:
+        for it in items:
+            w.write(pickle.dumps(it))
+
+
+def test_roundtrip_and_prefetch(tmp_path):
+    p1 = str(tmp_path / "a.rio")
+    p2 = str(tmp_path / "b.rio")
+    _write(p1, [("x", i) for i in range(200)])
+    _write(p2, [("y", i) for i in range(50)])
+
+    got = [pickle.loads(r) for r in native.read_records(p1)]
+    assert got == [("x", i) for i in range(200)]
+
+    async_got = sorted(
+        pickle.loads(r)[1] for r in native.PrefetchReader([p1, p2], capacity=16)
+    )
+    assert async_got == sorted(list(range(200)) + list(range(50)))
+
+
+def test_torn_tail_recovery(tmp_path):
+    p = str(tmp_path / "t.rio")
+    _write(p, list(range(100)))
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:-5])  # simulate a writer crash mid-record
+    got = [pickle.loads(r) for r in native.read_records(p)]
+    assert got == list(range(99))
+
+
+def test_corrupt_record_stops_before_it(tmp_path):
+    p = str(tmp_path / "c.rio")
+    _write(p, list(range(10)))
+    raw = bytearray(open(p, "rb").read())
+    raw[20] ^= 0xFF  # flip a payload byte in an early record
+    open(p, "wb").write(bytes(raw))
+    got = [pickle.loads(r) for r in native.read_records(p)]
+    assert len(got) < 10  # CRC refuses the damaged record and after
+
+
+def test_reader_creator_and_coordinator(tmp_path):
+    # shard the dataset into record files, dispatch via the coordinator
+    # with lease retry, stream through the v2 reader surface
+    paths = []
+    for s in range(4):
+        p = str(tmp_path / ("shard%d.rio" % s))
+        _write(p, [(s, i) for i in range(25)])
+        paths.append(p)
+
+    r = paddle.reader.creator.pickled_records(paths, buf_size=8)
+    assert sorted(set(x[0] for x in r())) == [0, 1, 2, 3]
+
+    c = Coordinator(timeout_s=60)
+    c.set_dataset(paths)
+    seen = []
+
+    def record_fn(path):
+        return paddle.reader.creator.pickled_records([path])()
+
+    for rec in MasterClient(c, record_fn):
+        seen.append(rec)
+    assert len(seen) == 100
+    assert sorted(set(x[0] for x in seen)) == [0, 1, 2, 3]
