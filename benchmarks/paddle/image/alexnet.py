@@ -1,0 +1,64 @@
+"""AlexNet timing config (counterpart of reference
+benchmark/paddle/image/alexnet.py)."""
+
+height = 227
+width = 227
+num_class = 1000
+batch_size = get_config_arg("batch_size", int, 128)
+gp = get_config_arg("layer_num", int, 1)
+is_infer = get_config_arg("is_infer", bool, False)
+num_samples = get_config_arg("num_samples", int, 2560)
+
+define_py_data_sources2(
+    "train.list" if not is_infer else None,
+    "test.list" if is_infer else None,
+    module="provider",
+    obj="process",
+    args={
+        "height": height,
+        "width": width,
+        "color": True,
+        "num_class": num_class,
+        "is_infer": is_infer,
+        "num_samples": num_samples,
+    },
+)
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.01 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size),
+)
+
+net = data_layer("data", size=height * width * 3)
+
+net = img_conv_layer(input=net, filter_size=11, num_channels=3,
+                     num_filters=96, stride=4, padding=1)
+net = img_cmrnorm_layer(input=net, size=5, scale=0.0001, power=0.75)
+net = img_pool_layer(input=net, pool_size=3, stride=2)
+
+net = img_conv_layer(input=net, filter_size=5, num_filters=256, stride=1,
+                     padding=2, groups=gp)
+net = img_cmrnorm_layer(input=net, size=5, scale=0.0001, power=0.75)
+net = img_pool_layer(input=net, pool_size=3, stride=2)
+
+net = img_conv_layer(input=net, filter_size=3, num_filters=384, stride=1,
+                     padding=1)
+net = img_conv_layer(input=net, filter_size=3, num_filters=384, stride=1,
+                     padding=1, groups=gp)
+net = img_conv_layer(input=net, filter_size=3, num_filters=256, stride=1,
+                     padding=1, groups=gp)
+net = img_pool_layer(input=net, pool_size=3, stride=2)
+
+net = fc_layer(input=net, size=4096, act=ReluActivation())
+net = dropout_layer(input=net, dropout_rate=0.5)
+net = fc_layer(input=net, size=4096, act=ReluActivation())
+net = dropout_layer(input=net, dropout_rate=0.5)
+net = fc_layer(input=net, size=1000, act=SoftmaxActivation())
+
+if is_infer:
+    outputs(net)
+else:
+    lab = data_layer("label", num_class)
+    outputs(cross_entropy(input=net, label=lab))

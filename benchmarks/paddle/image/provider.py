@@ -1,0 +1,36 @@
+"""Synthetic image provider for the timing benchmarks (counterpart of
+reference benchmark/paddle/image/provider.py — which also feeds random
+data; --job=time measures compute, not IO)."""
+
+import numpy as np
+
+from paddle_tpu.trainer.PyDataProvider2 import (
+    CacheType,
+    dense_vector,
+    integer_value,
+    provider,
+)
+
+
+def init_hook(settings, height, width, color, num_class, **kwargs):
+    settings.height = height
+    settings.width = width
+    settings.data_size = height * width * (3 if color else 1)
+    settings.num_class = num_class
+    settings.is_infer = kwargs.get("is_infer", False)
+    settings.num_samples = kwargs.get("num_samples", 2560)
+    if settings.is_infer:
+        settings.slots = [dense_vector(settings.data_size)]
+    else:
+        settings.slots = [dense_vector(settings.data_size), integer_value(num_class)]
+
+
+@provider(init_hook=init_hook, min_pool_size=-1, cache=CacheType.CACHE_PASS_IN_MEM)
+def process(settings, file_list):
+    rng = np.random.RandomState(0)
+    for _ in range(settings.num_samples):
+        img = rng.rand(settings.data_size).astype("float32")
+        if settings.is_infer:
+            yield (img,)
+        else:
+            yield img, int(rng.randint(0, settings.num_class))

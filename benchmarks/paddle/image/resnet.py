@@ -1,0 +1,93 @@
+"""ResNet timing config (counterpart of reference
+benchmark/paddle/image/resnet.py — the north-star workload definition,
+SURVEY §6). Same topology, driven through paddle_tpu.trainer."""
+
+height = 224
+width = 224
+num_class = 1000
+batch_size = get_config_arg("batch_size", int, 64)
+layer_num = get_config_arg("layer_num", int, 50)
+is_infer = get_config_arg("is_infer", bool, False)
+num_samples = get_config_arg("num_samples", int, 2560)
+
+define_py_data_sources2(
+    "train.list" if not is_infer else None,
+    "test.list" if is_infer else None,
+    module="provider",
+    obj="process",
+    args={
+        "height": height,
+        "width": width,
+        "color": True,
+        "num_class": num_class,
+        "is_infer": is_infer,
+        "num_samples": num_samples,
+    },
+)
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.01 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size),
+)
+
+
+def conv_bn(name, input, filter_size, num_filters, stride, padding,
+            channels=None, active_type=ReluActivation()):
+    conv = img_conv_layer(
+        name=name + "_conv",
+        input=input,
+        filter_size=filter_size,
+        num_channels=channels,
+        num_filters=num_filters,
+        stride=stride,
+        padding=padding,
+        act=LinearActivation(),
+        bias_attr=False,
+    )
+    return batch_norm_layer(name=name + "_bn", input=conv, act=active_type)
+
+
+def bottleneck(name, input, num_filters1, num_filters2, stride=1):
+    last_name = name + "_branch2c"
+    mid = conv_bn(name + "_branch2a", input, 1, num_filters1, stride, 0)
+    mid = conv_bn(name + "_branch2b", mid, 3, num_filters1, 1, 1)
+    mid = conv_bn(last_name, mid, 1, num_filters2, 1, 0,
+                  active_type=LinearActivation())
+    if stride != 1 or input.im_shape[0] != num_filters2:
+        shortcut = conv_bn(name + "_branch1", input, 1, num_filters2, stride,
+                           0, active_type=LinearActivation())
+    else:
+        shortcut = input
+    return addto_layer(name=name + "_addto", input=[mid, shortcut],
+                       act=ReluActivation())
+
+
+def res_group(name, input, blocks, num_filters1, num_filters2, stride):
+    out = bottleneck(name + "a", input, num_filters1, num_filters2, stride)
+    for i in range(1, blocks):
+        out = bottleneck("%s%c" % (name, ord('a') + i), out, num_filters1,
+                         num_filters2, 1)
+    return out
+
+
+cfgs = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+n2, n3, n4, n5 = cfgs[layer_num]
+
+img = data_layer(name="image", size=height * width * 3)
+net = conv_bn("conv1", img, 7, 64, 2, 3, channels=3)
+net = img_pool_layer(input=net, pool_size=3, stride=2, padding=1,
+                     pool_type=MaxPooling())
+net = res_group("res2", net, n2, 64, 256, 1)
+net = res_group("res3", net, n3, 128, 512, 2)
+net = res_group("res4", net, n4, 256, 1024, 2)
+net = res_group("res5", net, n5, 512, 2048, 2)
+net = img_pool_layer(input=net, pool_size=7, stride=1, pool_type=AvgPooling())
+net = fc_layer(input=net, size=num_class, act=SoftmaxActivation())
+
+if is_infer:
+    outputs(net)
+else:
+    lbl = data_layer(name="label", size=num_class)
+    outputs(cross_entropy(name="loss", input=net, label=lbl))

@@ -1,0 +1,30 @@
+"""Synthetic ragged-text provider for the RNN timing benchmark
+(counterpart of reference benchmark/paddle/rnn/provider.py)."""
+
+import numpy as np
+
+from paddle_tpu.trainer.PyDataProvider2 import (
+    integer_value,
+    integer_value_sequence,
+    provider,
+)
+
+
+def init_hook(settings, vocab_size, pad_seq, maxlen, **kwargs):
+    settings.vocab_size = vocab_size
+    settings.pad_seq = pad_seq
+    settings.maxlen = maxlen
+    settings.num_samples = kwargs.get("num_samples", 2560)
+    settings.slots = [integer_value_sequence(vocab_size), integer_value(2)]
+
+
+@provider(init_hook=init_hook, min_pool_size=-1)
+def process(settings, file_list):
+    rng = np.random.RandomState(0)
+    for _ in range(settings.num_samples):
+        if settings.pad_seq:
+            l = settings.maxlen
+        else:
+            l = int(rng.randint(10, settings.maxlen + 1))
+        words = rng.randint(0, settings.vocab_size, l).tolist()
+        yield words, int(rng.randint(0, 2))

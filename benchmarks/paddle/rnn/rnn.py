@@ -1,0 +1,40 @@
+"""LSTM text-classification timing config (counterpart of reference
+benchmark/paddle/rnn/rnn.py: embedding -> stacked simple_lstm -> last_seq
+-> softmax; BASELINE 184 ms/batch @ bs=64 h=512 on K40m)."""
+
+num_class = 2
+vocab_size = 30000
+fixedlen = 100
+batch_size = get_config_arg("batch_size", int, 128)
+lstm_num = get_config_arg("lstm_num", int, 1)
+hidden_size = get_config_arg("hidden_size", int, 128)
+pad_seq = get_config_arg("pad_seq", bool, True)
+num_samples = get_config_arg("num_samples", int, 2560)
+
+define_py_data_sources2(
+    "train.list", None, module="provider", obj="process",
+    args={
+        "vocab_size": vocab_size,
+        "pad_seq": pad_seq,
+        "maxlen": fixedlen,
+        "num_samples": num_samples,
+    },
+)
+
+settings(
+    batch_size=batch_size,
+    learning_rate=2e-3,
+    learning_method=AdamOptimizer(),
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25,
+)
+
+net = data_layer("data", size=vocab_size)
+net = embedding_layer(input=net, size=128)
+for _ in range(lstm_num):
+    net = simple_lstm(input=net, size=hidden_size)
+net = last_seq(input=net)
+net = fc_layer(input=net, size=2, act=SoftmaxActivation())
+
+lab = data_layer("label", num_class)
+outputs(classification_cost(input=net, label=lab))

@@ -1,0 +1,197 @@
+"""`paddle train`-style CLI (reference paddle/trainer/TrainerMain.cpp:32 +
+Trainer.cpp): exec a trainer_config_helpers config, build the shared lazy
+layer graph into a fluid Program, and run the train/time/test job.
+
+Usage parity with benchmark/paddle/*/run.sh:
+
+    python -m paddle_tpu.trainer --job=time --config=resnet.py \
+        --use_gpu=True --trainer_count=1 --log_period=10 \
+        --config_args=batch_size=64,layer_num=50
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .. import fluid
+from .. import trainer_config_helpers as tch
+from ..v2.topology import Topology
+from ..v2.trainer import _convert_feed
+
+__all__ = ["main", "run_config"]
+
+
+def _parse_config_args(s: str) -> Dict[str, str]:
+    out = {}
+    for kv in (s or "").split(","):
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _exec_config(path: str, config_args: Dict[str, str]):
+    """Exec the config with the DSL star-imported (the reference runs
+    configs through config_parser inside an embedded interpreter,
+    TrainerConfigHelper.cpp -> PythonUtil)."""
+    tch.reset_config(config_args)
+    g: Dict[str, Any] = {"__name__": "__paddle_config__", "__file__": path}
+    for name in tch.__all__:
+        g[name] = getattr(tch, name)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
+    try:
+        with open(path) as f:
+            code = compile(f.read(), path, "exec")
+        exec(code, g)
+    finally:
+        sys.path.pop(0)
+    return tch.get_config_state()
+
+
+def _load_provider(data_sources, config_dir):
+    spec = importlib.util.spec_from_file_location(
+        data_sources["module"],
+        os.path.join(config_dir, data_sources["module"] + ".py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[data_sources["module"]] = mod
+    spec.loader.exec_module(mod)
+    create = getattr(mod, data_sources["obj"])
+    file_list = []
+    tl = data_sources.get("train_list")
+    if tl and os.path.exists(tl):
+        file_list = [l.strip() for l in open(tl) if l.strip()]
+    return create(file_list, **data_sources["args"])
+
+
+def _batches(reader, slots, data_nodes, batch_size):
+    """Group provider instances into feed dicts (py_paddle
+    DataProviderConverter's role). Provider slot order == data-layer
+    declaration order, the legacy wiring."""
+    for node, slot in zip(data_nodes, slots):
+        node.attrs["type"].seq_type = slot.seq_type
+        node.attrs["type"].type = slot.type
+    buf = []
+    for instance in reader():
+        if not isinstance(instance, tuple):
+            instance = (instance,)
+        buf.append(instance)
+        if len(buf) == batch_size:
+            yield _convert_feed(buf, data_nodes, None)
+            buf = []
+    if buf:
+        yield _convert_feed(buf, data_nodes, None)
+
+
+def run_config(config_path, job="train", config_args=None, trainer_count=1,
+               num_passes=1, log_period=10, use_gpu=None, save_dir=None):
+    """Programmatic entry (also used by tests). Returns summary dict."""
+    state = _exec_config(config_path, config_args or {})
+    if not state["outputs"]:
+        raise ValueError("config did not call outputs(...)")
+    settings = state["settings"]
+    topo = Topology(state["outputs"])
+    cost_var = topo.var_of[state["outputs"][0].name]
+
+    mesh = None
+    if trainer_count > 1:
+        import jax
+
+        from ..parallel.mesh import make_mesh
+
+        n = min(trainer_count, jax.device_count())
+        if n > 1:
+            mesh = make_mesh({"data": n})
+
+    with fluid.program_guard(topo.main_program, topo.startup_program):
+        method = settings.get("learning_method")
+        lr = settings.get("learning_rate", 1e-3)
+        opt = (
+            method.make(lr)
+            if method is not None
+            else fluid.optimizer.SGD(learning_rate=lr)
+        )
+        if job != "test":
+            opt.minimize(cost_var)
+
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace(), mesh=mesh)
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+
+    provider_reader = _load_provider(
+        state["data_sources"], os.path.dirname(os.path.abspath(config_path))
+    )
+    slots = provider_reader.settings.slots
+    batch_size = settings.get("batch_size", 256)
+
+    stats = dict(batches=0, cost=None, ms_per_batch=None, img_per_sec=None)
+    times: List[float] = []
+    with fluid.executor.scope_guard(scope):
+        for pass_id in range(num_passes):
+            for feed in _batches(
+                provider_reader, slots, topo._data_layers, batch_size
+            ):
+                t0 = time.time()
+                (cost,) = exe.run(
+                    topo.main_program, feed=feed, fetch_list=[cost_var]
+                )
+                cost = float(np.ravel(np.asarray(cost))[0])
+                dt = time.time() - t0
+                stats["batches"] += 1
+                stats["cost"] = cost
+                # the first batches include compilation; reference --job=time
+                # also skips a warmup via log_period
+                if stats["batches"] > min(log_period, 5):
+                    times.append(dt)
+                if stats["batches"] % log_period == 0:
+                    print(
+                        "Pass %d, Batch %d, Cost %.4f"
+                        % (pass_id, stats["batches"], cost)
+                    )
+    if times:
+        stats["ms_per_batch"] = 1000.0 * float(np.mean(times))
+        stats["img_per_sec"] = batch_size / float(np.mean(times))
+    if job == "time" and times:
+        print(
+            "Time: %.2f ms/batch (%.1f samples/sec)"
+            % (stats["ms_per_batch"], stats["img_per_sec"])
+        )
+    if save_dir:
+        from ..distributed import save_checkpoint
+
+        save_checkpoint(scope, save_dir, step=stats["batches"])
+    return stats
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.trainer")
+    p.add_argument("command", nargs="?", default="train")
+    p.add_argument("--config", required=True)
+    p.add_argument("--job", default="train", choices=["train", "time", "test"])
+    p.add_argument("--config_args", default="")
+    p.add_argument("--trainer_count", type=int, default=1)
+    p.add_argument("--num_passes", type=int, default=1)
+    p.add_argument("--log_period", type=int, default=10)
+    p.add_argument("--test_period", type=int, default=0)
+    p.add_argument("--use_gpu", default=None)
+    p.add_argument("--save_dir", default=None)
+    args = p.parse_args(argv)
+    run_config(
+        args.config,
+        job=args.job,
+        config_args=_parse_config_args(args.config_args),
+        trainer_count=args.trainer_count,
+        num_passes=args.num_passes,
+        log_period=args.log_period,
+        use_gpu=args.use_gpu,
+        save_dir=args.save_dir,
+    )

@@ -1,0 +1,391 @@
+"""trainer_config_helpers: the legacy config DSL (reference
+python/paddle/trainer_config_helpers/ — 137 layer wrappers feeding
+config_parser.py's ModelConfig protobuf; SURVEY §1.1).
+
+Here the DSL is a thin second surface over the SAME lazy layer graph the
+v2 API uses (paddle_tpu.v2.layer) — both replay into one fluid Program
+(SURVEY §7.1: "two API surfaces, one core"). Configs written for
+`paddle train --config=cfg.py` run via `python -m paddle_tpu.trainer`,
+which execs the config with this module star-imported, then trains the
+recorded outputs with the recorded settings.
+
+Image-layer geometry: the legacy stack carries (channels, height, width)
+through layer configs (config_parser.py); here each DSL node records
+`im_shape`, and the first img_conv on a flat data layer inserts a reshape
+node (square images inferred as sqrt(size/channels), matching
+config_parser's default).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+from ..v2.layer import Layer
+
+__all__ = [
+    # config-level
+    "get_config_arg", "settings", "define_py_data_sources2", "outputs",
+    # layers
+    "data_layer", "fc_layer", "img_conv_layer", "img_pool_layer",
+    "batch_norm_layer", "concat_layer", "addto_layer", "dropout_layer",
+    "embedding_layer", "img_cmrnorm_layer", "simple_lstm", "lstmemory",
+    "grumemory", "last_seq", "first_seq", "max_id",
+    "classification_cost", "cross_entropy", "regression_cost", "mse_cost",
+    # activations
+    "ReluActivation", "SoftmaxActivation", "LinearActivation",
+    "TanhActivation", "SigmoidActivation", "IdentityActivation",
+    # pooling types
+    "MaxPooling", "AvgPooling", "SumPooling",
+    # optimizers / regularization
+    "MomentumOptimizer", "AdamOptimizer", "AdaGradOptimizer",
+    "RMSPropOptimizer", "L2Regularization",
+]
+
+
+# ---------------------------------------------------------------------
+# parse-time config state (reset by the CLI before exec'ing a config)
+# ---------------------------------------------------------------------
+
+_state: Dict[str, Any] = {}
+
+
+def reset_config(config_args: Optional[Dict[str, str]] = None):
+    _state.clear()
+    _state.update(
+        settings={}, outputs=[], data_sources=None,
+        config_args=dict(config_args or {}),
+    )
+
+
+reset_config()
+
+
+def get_config_state() -> Dict[str, Any]:
+    return _state
+
+
+def get_config_arg(name, type_=str, default=None):
+    """CLI --config_args overrides (reference config_parser get_config_arg,
+    used by every benchmark script e.g. benchmark/paddle/image/resnet.py:7)."""
+    v = _state["config_args"].get(name)
+    if v is None:
+        return default
+    if type_ is bool:
+        return str(v) not in ("0", "False", "false", "")
+    return type_(v)
+
+
+def settings(batch_size=256, learning_rate=1e-3, learning_method=None,
+             regularization=None, gradient_clipping_threshold=None, **kwargs):
+    _state["settings"] = dict(
+        batch_size=int(batch_size),
+        learning_rate=float(learning_rate),
+        learning_method=learning_method,
+        regularization=regularization,
+        gradient_clipping_threshold=gradient_clipping_threshold,
+        extra=kwargs,
+    )
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    _state["data_sources"] = dict(
+        train_list=train_list, test_list=test_list, module=module, obj=obj,
+        args=dict(args or {}),
+    )
+
+
+def outputs(*layers):
+    _state["outputs"].extend(layers)
+
+
+# ---------------------------------------------------------------------
+# activations / pooling / optimizers (reference activations.py,
+# poolings.py, optimizers.py)
+# ---------------------------------------------------------------------
+
+
+class _Act(object):
+    name: Optional[str] = None
+
+
+def _mkact(cls_name, act):
+    return type(cls_name, (_Act,), {"name": act})
+
+
+ReluActivation = _mkact("ReluActivation", "relu")
+SoftmaxActivation = _mkact("SoftmaxActivation", "softmax")
+LinearActivation = _mkact("LinearActivation", None)
+IdentityActivation = LinearActivation
+TanhActivation = _mkact("TanhActivation", "tanh")
+SigmoidActivation = _mkact("SigmoidActivation", "sigmoid")
+
+
+class _Pooling(object):
+    name = "max"
+
+
+class MaxPooling(_Pooling):
+    name = "max"
+
+
+class AvgPooling(_Pooling):
+    name = "avg"
+
+
+class SumPooling(_Pooling):
+    name = "sum"
+
+
+class MomentumOptimizer(object):
+    def __init__(self, momentum=0.9, sparse=False):
+        self.momentum = momentum
+
+    def make(self, lr):
+        from .. import fluid
+
+        return fluid.optimizer.Momentum(learning_rate=lr, momentum=self.momentum)
+
+
+class AdamOptimizer(object):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def make(self, lr):
+        from .. import fluid
+
+        return fluid.optimizer.Adam(
+            learning_rate=lr, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon,
+        )
+
+
+class AdaGradOptimizer(object):
+    def make(self, lr):
+        from .. import fluid
+
+        return fluid.optimizer.Adagrad(learning_rate=lr)
+
+
+class RMSPropOptimizer(object):
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def make(self, lr):
+        from .. import fluid
+
+        return fluid.optimizer.RMSProp(
+            learning_rate=lr, rho=self.rho, epsilon=self.epsilon
+        )
+
+
+class L2Regularization(object):
+    def __init__(self, rate):
+        self.rate = float(rate)
+
+
+# ---------------------------------------------------------------------
+# layers — legacy names over the shared lazy node graph
+# ---------------------------------------------------------------------
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, type):
+        act = act()
+    return act.name
+
+
+def data_layer(name, size, height=None, width=None, **kwargs):
+    t = _DataType(size)
+    node = Layer("data", name, [], {"type": t})
+    node.im_shape = None
+    if height and width:
+        node.im_shape = (size // (height * width), height, width)
+    return node
+
+
+class _DataType(object):
+    """Minimal stand-in for v2 data_type: dense flat vector of `dim`
+    (legacy data_layer is untyped; label layers are int by usage)."""
+
+    def __init__(self, dim, seq=0, is_index=False):
+        self.dim = dim
+        self.seq_type = seq
+        self.type = 3 if is_index else 0  # DataType.Index / Dense
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def fc_layer(input, size, act=None, name=None, bias_attr=None, **kwargs):
+    return Layer("fc", name, _as_list(input), {
+        "size": size, "act": _act_name(act), "param_attr": None,
+        "bias_attr": bias_attr,
+    })
+
+
+def _ensure_image(node, num_channels):
+    """Insert a reshape node when the input is still flat (square images,
+    config_parser's inference) and return (input_node, (c, h, w))."""
+    shape = getattr(node, "im_shape", None)
+    if shape is not None:
+        return node, shape
+    if node.kind == "data":
+        size = node.attrs["type"].dim
+        c = num_channels or 3
+        hw = int(round(math.sqrt(size // c)))
+        shape = (c, hw, hw)
+        r = Layer("im_reshape", None, [node], {"shape": list(shape)})
+        r.im_shape = shape
+        return r, shape
+    raise ValueError(
+        "img layer input %r has no image shape; give num_channels on the "
+        "first conv or height/width on the data layer" % node.name
+    )
+
+
+def _conv_out(h, f, s, p):
+    return (h + 2 * p - f) // s + 1
+
+
+def img_conv_layer(input, filter_size, num_filters, num_channels=None,
+                   stride=1, padding=0, groups=1, act=None, bias_attr=None,
+                   name=None, **kwargs):
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], num_channels)
+    node = Layer("img_conv", name, [inp], {
+        "filter_size": filter_size, "num_filters": num_filters,
+        "num_channels": c, "stride": stride, "padding": padding,
+        "groups": groups, "act": _act_name(act),
+        "bias": bias_attr is not False,
+    })
+    node.im_shape = (
+        num_filters,
+        _conv_out(h, filter_size, stride, padding),
+        _conv_out(w, filter_size, stride, padding),
+    )
+    return node
+
+
+def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
+                   name=None, **kwargs):
+    inp = _as_list(input)[0]
+    c, h, w = inp.im_shape
+    ptype = "max"
+    if pool_type is not None:
+        p = pool_type if isinstance(pool_type, _Pooling) else pool_type()
+        ptype = "avg" if p.name in ("avg", "sum") else "max"
+    node = Layer("img_pool", name, [inp], {
+        "pool_size": pool_size, "stride": stride, "padding": padding,
+        "pool_type": ptype,
+    })
+    node.im_shape = (
+        c, _conv_out(h, pool_size, stride, padding),
+        _conv_out(w, pool_size, stride, padding),
+    )
+    return node
+
+
+def batch_norm_layer(input, act=None, name=None, **kwargs):
+    inp = _as_list(input)[0]
+    node = Layer("batch_norm", name, [inp], {"act": _act_name(act)})
+    node.im_shape = getattr(inp, "im_shape", None)
+    return node
+
+
+def img_cmrnorm_layer(input, size=5, scale=0.0001, power=0.75, name=None,
+                      **kwargs):
+    """Cross-map response normalization = LRN (reference img_cmrnorm_layer
+    -> NormLayer; fluid lrn_op)."""
+    inp = _as_list(input)[0]
+    node = Layer("lrn", name, [inp], {
+        "size": size, "scale": scale, "power": power,
+    })
+    node.im_shape = getattr(inp, "im_shape", None)
+    return node
+
+
+def addto_layer(input, act=None, name=None, bias_attr=None, **kwargs):
+    nodes = _as_list(input)
+    node = Layer("addto", name, nodes, {"act": _act_name(act)})
+    node.im_shape = getattr(nodes[0], "im_shape", None)
+    return node
+
+
+def concat_layer(input, name=None, **kwargs):
+    nodes = _as_list(input)
+    node = Layer("concat", name, nodes, {})
+    shapes = [getattr(n, "im_shape", None) for n in nodes]
+    if all(s is not None for s in shapes):
+        node.im_shape = (
+            sum(s[0] for s in shapes), shapes[0][1], shapes[0][2],
+        )
+        node.attrs["concat_images"] = True  # channel concat, not flat
+    return node
+
+
+def dropout_layer(input, dropout_rate, name=None, **kwargs):
+    inp = _as_list(input)[0]
+    node = Layer("dropout", name, [inp], {"rate": dropout_rate})
+    node.im_shape = getattr(inp, "im_shape", None)
+    return node
+
+
+def embedding_layer(input, size, name=None, **kwargs):
+    node = _as_list(input)[0]
+    # legacy: a data layer feeding an embedding is an id sequence
+    t = node.attrs["type"]
+    t.type = 3  # Index
+    t.seq_type = 1
+    return Layer("embedding", name, [node], {"size": size})
+
+
+def lstmemory(input, size=None, reverse=False, act=None, name=None, **kwargs):
+    return Layer("lstmemory", name, _as_list(input), {
+        "size": size, "reverse": reverse,
+    })
+
+
+def simple_lstm(input, size, name=None, **kwargs):
+    f = fc_layer(input=input, size=size * 4)
+    return Layer("lstmemory", name, [f], {"size": size, "reverse": False})
+
+
+def grumemory(input, size=None, reverse=False, name=None, **kwargs):
+    return Layer("gru", name, _as_list(input), {"size": size, "reverse": reverse})
+
+
+def last_seq(input, name=None, **kwargs):
+    return Layer("last_seq", name, _as_list(input), {})
+
+
+def first_seq(input, name=None, **kwargs):
+    return Layer("first_seq", name, _as_list(input), {})
+
+
+def max_id(input, name=None, **kwargs):
+    return Layer("max_id", name, _as_list(input), {})
+
+
+def _label_node(label):
+    t = label.attrs["type"]
+    t.type = 3  # Index; legacy label layers are integer slots sized n_class
+    t.dim = max(t.dim, 1)
+    return label
+
+
+def classification_cost(input, label, name=None, **kwargs):
+    return Layer("classification_cost", name, [input, _label_node(label)], {})
+
+
+def cross_entropy(input, label, name=None, **kwargs):
+    return Layer("cross_entropy_cost", name, [input, _label_node(label)], {})
+
+
+def mse_cost(input, label, name=None, **kwargs):
+    return Layer("mse_cost", name, [input, label], {})
+
+
+regression_cost = mse_cost

@@ -73,9 +73,27 @@ class Topology(object):
                 input=self._in(node), num_filters=a["num_filters"],
                 filter_size=a["filter_size"], stride=a["stride"],
                 padding=a["padding"], act=a["act"],
+                groups=a.get("groups", 1) or 1,
                 param_attr=fluid.ParamAttr(name=node.name + ".w0"),
-                bias_attr=fluid.ParamAttr(name=node.name + ".wbias"),
+                bias_attr=(
+                    False if not a.get("bias", True)
+                    else fluid.ParamAttr(name=node.name + ".wbias")
+                ),
             )
+        if node.kind == "im_reshape":
+            c, h, w = a["shape"]
+            return L.reshape(x=self._in(node), shape=[-1, c, h, w])
+        if node.kind == "lrn":
+            return L.lrn(
+                input=self._in(node), n=a["size"], k=1.0,
+                alpha=a.get("scale", 1e-4), beta=a.get("power", 0.75),
+            )
+        if node.kind == "addto":
+            out = L.sums(input=self._ins(node))
+            act = a.get("act")
+            if act:
+                out = getattr(L, act)(out)
+            return out
         if node.kind == "img_pool":
             return L.pool2d(
                 input=self._in(node), pool_size=a["pool_size"],

@@ -1,0 +1,136 @@
+"""Legacy config DSL + `paddle train` CLI (reference
+trainer_config_helpers + TrainerMain; SURVEY §7.1 surface (b)).
+
+The benchmark configs in benchmarks/paddle/ are the real acceptance
+surface; here a scaled-down config exercises the same path hermetically."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.trainer import run_config
+
+CONFIG = textwrap.dedent(
+    """
+    height = 8
+    width = 8
+    num_class = 5
+    batch_size = get_config_arg('batch_size', int, 8)
+
+    define_py_data_sources2(
+        "train.list", None, module="tiny_provider", obj="process",
+        args={'height': height, 'width': width, 'num_class': num_class,
+              'num_samples': get_config_arg('num_samples', int, 48)})
+
+    settings(
+        batch_size=batch_size,
+        learning_rate=0.05,
+        learning_method=MomentumOptimizer(0.9),
+        regularization=L2Regularization(1e-4))
+
+    img = data_layer(name='image', size=height * width * 3)
+    net = img_conv_layer(input=img, filter_size=3, num_channels=3,
+                         num_filters=8, stride=1, padding=1,
+                         act=LinearActivation(), bias_attr=False)
+    net = batch_norm_layer(input=net, act=ReluActivation())
+    net = img_pool_layer(input=net, pool_size=2, stride=2,
+                         pool_type=MaxPooling())
+    skip = img_conv_layer(input=net, filter_size=1, num_filters=8, stride=1,
+                          padding=0, act=LinearActivation())
+    net = img_conv_layer(input=net, filter_size=3, num_filters=8, stride=1,
+                         padding=1, act=LinearActivation())
+    net = addto_layer(input=[net, skip], act=ReluActivation())
+    net = img_cmrnorm_layer(input=net, size=3)
+    net = fc_layer(input=net, size=num_class, act=SoftmaxActivation())
+    lbl = data_layer(name='label', size=num_class)
+    outputs(cross_entropy(name='loss', input=net, label=lbl))
+    """
+)
+
+PROVIDER = textwrap.dedent(
+    """
+    import numpy as np
+    from paddle_tpu.trainer.PyDataProvider2 import (
+        dense_vector, integer_value, provider)
+
+    def init_hook(settings, height, width, num_class, **kw):
+        settings.data_size = height * width * 3
+        settings.num_class = num_class
+        settings.num_samples = kw.get('num_samples', 48)
+        settings.slots = [dense_vector(settings.data_size),
+                          integer_value(num_class)]
+
+    @provider(init_hook=init_hook)
+    def process(settings, file_list):
+        rng = np.random.RandomState(0)
+        for _ in range(settings.num_samples):
+            lab = int(rng.randint(0, settings.num_class))
+            img = rng.rand(settings.data_size).astype('float32') * 0.1
+            img[lab::settings.num_class] += 0.5
+            yield img, lab
+    """
+)
+
+
+@pytest.fixture
+def config_dir(tmp_path):
+    (tmp_path / "tiny_config.py").write_text(CONFIG)
+    (tmp_path / "tiny_provider.py").write_text(PROVIDER)
+    return tmp_path
+
+
+def test_cli_train_job(config_dir):
+    stats = run_config(
+        str(config_dir / "tiny_config.py"),
+        job="train",
+        config_args={"batch_size": "8", "num_samples": "64"},
+        num_passes=4,
+        log_period=100,
+    )
+    assert stats["batches"] == 4 * 8
+    assert np.isfinite(stats["cost"])
+
+
+def test_cli_time_job_reports_throughput(config_dir, capsys):
+    stats = run_config(
+        str(config_dir / "tiny_config.py"),
+        job="time",
+        config_args={"num_samples": "80"},
+        num_passes=1,
+        log_period=2,
+    )
+    out = capsys.readouterr().out
+    assert "ms/batch" in out
+    assert stats["ms_per_batch"] > 0
+
+
+def test_cli_multitrainer_mesh(config_dir):
+    stats = run_config(
+        str(config_dir / "tiny_config.py"),
+        job="train",
+        config_args={"batch_size": "16", "num_samples": "32"},
+        trainer_count=8,
+        num_passes=2,
+        log_period=100,
+    )
+    assert stats["batches"] == 4
+    assert np.isfinite(stats["cost"])
+
+
+def test_rnn_benchmark_config_scaled_down():
+    """The actual benchmarks/paddle/rnn/rnn.py config, tiny args."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stats = run_config(
+        os.path.join(root, "benchmarks", "paddle", "rnn", "rnn.py"),
+        job="train",
+        config_args={
+            "batch_size": "8", "hidden_size": "16", "num_samples": "24",
+            "pad_seq": "0",
+        },
+        num_passes=1,
+        log_period=100,
+    )
+    assert stats["batches"] == 3
+    assert np.isfinite(stats["cost"])
