@@ -15,4 +15,5 @@ Layout:
 __version__ = "0.1.0"
 
 from . import fluid  # noqa: F401
+from . import utils  # noqa: F401
 from . import v2  # noqa: F401

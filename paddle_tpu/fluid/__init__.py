@@ -15,6 +15,8 @@ from . import io
 from . import profiler
 from . import learning_rate_decay
 from . import distribute_transpiler
+from . import debugger
+from . import debugger as debuger  # reference module name (sic)
 
 from .framework import (
     Program,

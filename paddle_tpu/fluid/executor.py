@@ -312,6 +312,7 @@ class Executor(object):
         fetches, new_persist = entry(persist_in, feed_arrays, rng)
         for n, v in new_persist.items():
             scope.set(n, v)
+        _maybe_check_nan_inf(fetch_names, fetches, new_persist)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -319,6 +320,25 @@ class Executor(object):
     # convenience used by inference/serving paths ----------------------
     def close(self):
         self._cache.clear()
+
+
+def _maybe_check_nan_inf(fetch_names, fetches, new_persist):
+    """FLAGS.check_nan_inf parity (reference executor.cc:30,132-140 scans
+    every op output per step; here the fused step's outputs and updated
+    persistables are scanned after each run)."""
+    from ..utils import FLAGS
+
+    if not FLAGS.check_nan_inf:
+        return
+    bad = []
+    for name, v in list(zip(fetch_names, fetches)) + list(new_persist.items()):
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            bad.append(name)
+    if bad:
+        raise FloatingPointError(
+            "check_nan_inf: non-finite values in %s" % ", ".join(sorted(bad))
+        )
 
 
 def _lod_bucket(feed_arrays):

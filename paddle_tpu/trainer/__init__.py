@@ -91,6 +91,53 @@ def _batches(reader, slots, data_nodes, batch_size):
         yield _convert_feed(buf, data_nodes, None)
 
 
+def check_gradients(topo, cost_var, scope, exe, feed, eps=1e-3,
+                    max_params=3, rtol=5e-2):
+    """--job=checkgrad parity (reference TrainerMain.cpp:55,
+    Trainer::checkGradient Trainer.cpp:303): compare analytic gradients
+    (fetched grad vars) against central finite differences on the loss."""
+    from ..fluid.backward import append_backward
+
+    with fluid.program_guard(topo.main_program, topo.startup_program):
+        params_grads = append_backward(cost_var)
+    # smallest parameters first: cheap to perturb element-wise
+    params_grads = sorted(
+        params_grads, key=lambda pg: int(np.prod(pg[0].shape))
+    )[:max_params]
+
+    results = {}
+    with fluid.executor.scope_guard(scope):
+        for p, g in params_grads:
+            (analytic,) = exe.run(
+                topo.main_program, feed=feed, fetch_list=[g.name]
+            )
+            base = np.asarray(scope.get(p.name)).copy()
+            flat = base.reshape(-1)
+            idxs = np.linspace(0, flat.size - 1, min(4, flat.size)).astype(int)
+            max_rel = 0.0
+            for i in idxs:
+                for sign, store in ((+1, "hi"), (-1, "lo")):
+                    pert = base.copy().reshape(-1)
+                    pert[i] += sign * eps
+                    scope.set(p.name, pert.reshape(base.shape))
+                    (c,) = exe.run(
+                        topo.main_program, feed=feed, fetch_list=[cost_var]
+                    )
+                    if store == "hi":
+                        hi = float(np.ravel(c)[0])
+                    else:
+                        lo = float(np.ravel(c)[0])
+                numeric = (hi - lo) / (2 * eps)
+                a = float(np.asarray(analytic).reshape(-1)[i])
+                denom = max(abs(a), abs(numeric), 1e-6)
+                max_rel = max(max_rel, abs(a - numeric) / denom)
+            scope.set(p.name, base)
+            results[p.name] = max_rel
+            status = "ok" if max_rel < rtol else "FAIL"
+            print("checkgrad %-40s max_rel=%.4g  %s" % (p.name, max_rel, status))
+    return results
+
+
 def run_config(config_path, job="train", config_args=None, trainer_count=1,
                num_passes=1, log_period=10, use_gpu=None, save_dir=None):
     """Programmatic entry (also used by tests). Returns summary dict."""
@@ -119,7 +166,7 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             if method is not None
             else fluid.optimizer.SGD(learning_rate=lr)
         )
-        if job != "test":
+        if job not in ("test", "checkgrad"):
             opt.minimize(cost_var)
 
     scope = fluid.executor.Scope()
@@ -132,6 +179,16 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
     )
     slots = provider_reader.settings.slots
     batch_size = settings.get("batch_size", 256)
+
+    if job == "checkgrad":
+        feed = next(
+            _batches(provider_reader, slots, topo._data_layers, batch_size)
+        )
+        results = check_gradients(topo, cost_var, scope, exe, feed)
+        worst = max(results.values()) if results else 0.0
+        if worst > 5e-2:
+            raise AssertionError("gradient check failed: %r" % results)
+        return {"checkgrad": results}
 
     stats = dict(batches=0, cost=None, ms_per_batch=None, img_per_sec=None)
     times: List[float] = []
@@ -176,7 +233,8 @@ def main(argv=None):
     p = argparse.ArgumentParser(prog="paddle_tpu.trainer")
     p.add_argument("command", nargs="?", default="train")
     p.add_argument("--config", required=True)
-    p.add_argument("--job", default="train", choices=["train", "time", "test"])
+    p.add_argument("--job", default="train",
+                   choices=["train", "time", "test", "checkgrad"])
     p.add_argument("--config_args", default="")
     p.add_argument("--trainer_count", type=int, default=1)
     p.add_argument("--num_passes", type=int, default=1)

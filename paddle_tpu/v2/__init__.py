@@ -19,6 +19,8 @@ from . import data_type  # noqa: F401
 from . import dataset  # noqa: F401
 from . import event  # noqa: F401
 from . import layer  # noqa: F401
+from . import master  # noqa: F401
+from . import plot  # noqa: F401
 from . import minibatch  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import parameters  # noqa: F401
@@ -33,6 +35,7 @@ from .. import fluid  # noqa: F401
 __all__ = [
     "init", "batch", "infer", "layer", "activation", "data_type", "dataset",
     "event", "minibatch", "optimizer", "parameters", "reader", "trainer",
+    "master", "plot",
     "fluid",
 ]
 

@@ -11,15 +11,21 @@ from . import (  # noqa: F401
     cifar,
     common,
     conll05,
+    flowers,
     imdb,
     imikolov,
     mnist,
     movielens,
+    mq2007,
+    sentiment,
     uci_housing,
+    voc2012,
     wmt14,
+    wmt16,
 )
 
 __all__ = [
     "mnist", "cifar", "imdb", "imikolov", "movielens", "uci_housing",
-    "wmt14", "conll05", "common",
+    "wmt14", "wmt16", "conll05", "sentiment", "flowers", "voc2012",
+    "mq2007", "common",
 ]

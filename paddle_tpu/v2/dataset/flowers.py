@@ -1,0 +1,33 @@
+"""Flowers-102 reader creators (reference dataset/flowers.py API).
+Synthetic class-separable images in the reference record shape
+(3x224x224 flattened float vector, int label)."""
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+_DIM = 3 * 224 * 224
+_CLASSES = 102
+
+
+def _reader(split, n):
+    def reader():
+        rng = common.rng_for("flowers", split)
+        for _ in range(n):
+            label = int(rng.randint(0, _CLASSES))
+            img = rng.rand(_DIM).astype("float32")
+            yield img, label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("train", 128)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("test", 32)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("valid", 32)

@@ -1,0 +1,44 @@
+"""MQ2007 learning-to-rank reader creators (reference dataset/mq2007.py
+API: train/test with format= 'pairwise' | 'pointwise' | 'listwise')."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+_FEAT = 46
+
+
+def _query(rng):
+    n_docs = int(rng.randint(2, 6))
+    feats = rng.rand(n_docs, _FEAT).astype("float32")
+    rels = rng.randint(0, 3, n_docs)
+    return feats, rels
+
+
+def _reader(split, n, format):
+    def reader():
+        rng = common.rng_for("mq2007", split)
+        for _ in range(n):
+            feats, rels = _query(rng)
+            if format == "pointwise":
+                for f, r in zip(feats, rels):
+                    yield f, int(r)
+            elif format == "pairwise":
+                for i in range(len(rels)):
+                    for j in range(len(rels)):
+                        if rels[i] > rels[j]:
+                            yield feats[i], feats[j]
+            else:  # listwise
+                yield feats, rels.astype("int64")
+
+    return reader
+
+
+def train(format="pairwise"):
+    return _reader("train", 64, format)
+
+
+def test(format="pairwise"):
+    return _reader("test", 16, format)

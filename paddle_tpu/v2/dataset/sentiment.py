@@ -1,0 +1,33 @@
+"""Movie-review sentiment reader creators (reference dataset/sentiment.py
+API: get_word_dict, train, test). Synthetic separable corpus."""
+
+from . import common
+
+__all__ = ["train", "test", "get_word_dict"]
+
+NUM_TRAINING_INSTANCES = 256
+_VOCAB = 300
+
+
+def get_word_dict():
+    return [("w%d" % i, i) for i in range(_VOCAB)]
+
+
+def _reader(split, n):
+    def reader():
+        rng = common.rng_for("sentiment", split)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            l = int(rng.randint(4, 30))
+            lo = 2 if label == 0 else _VOCAB // 2
+            yield list(map(int, rng.randint(lo, lo + _VOCAB // 2 - 2, l))), label
+
+    return reader
+
+
+def train():
+    return _reader("train", NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _reader("test", 64)

@@ -1,0 +1,34 @@
+"""VOC2012 segmentation reader creators (reference dataset/voc2012.py
+API). Synthetic (image, segmentation-mask) pairs at a small resolution."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+_H = _W = 64
+_CLASSES = 21
+
+
+def _reader(split, n):
+    def reader():
+        rng = common.rng_for("voc2012", split)
+        for _ in range(n):
+            img = rng.rand(3, _H, _W).astype("float32")
+            mask = rng.randint(0, _CLASSES, (_H, _W)).astype("int32")
+            yield img, mask
+
+    return reader
+
+
+def train():
+    return _reader("train", 64)
+
+
+def test():
+    return _reader("test", 16)
+
+
+def val():
+    return _reader("val", 16)

@@ -1,0 +1,23 @@
+"""WMT16 reader creators (reference dataset/wmt16.py API). Same synthetic
+reverse-copy corpus as wmt14, with the get_dict surface."""
+
+from . import common, wmt14
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {("%s_w%d" % (lang, i)): i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14.train(min(src_dict_size, trg_dict_size))
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14.test(min(src_dict_size, trg_dict_size))
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14.test(min(src_dict_size, trg_dict_size))

@@ -1,0 +1,52 @@
+"""paddle.v2.master.client shim (reference python/paddle/v2/master/
+client.py:29 wrapping the Go master via a cgo shim). Backed by the
+in-process Coordinator (paddle_tpu.distributed) — same task-lease
+semantics, no etcd/Go."""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+from ...distributed import Coordinator
+
+__all__ = ["client"]
+
+
+class client(object):
+    """API-shaped like the reference: set_dataset(paths), next_record()."""
+
+    def __init__(self, etcd_endpoints=None, timeout_sec=60, buf_size=32):
+        self._coordinator = Coordinator(timeout_s=timeout_sec)
+        self._iter = None
+
+    def set_dataset(self, paths: List[str]):
+        self._coordinator.set_dataset(list(paths))
+
+    def _records(self):
+        from ..reader import creator
+
+        while True:
+            task = self._coordinator.get_task()
+            if task is None:
+                return
+            try:
+                for rec in creator.recordio([task.payload])():
+                    yield rec
+            except Exception:
+                self._coordinator.task_failed(task.task_id)
+                continue
+            self._coordinator.task_finished(task.task_id)
+
+    def next_record(self) -> Optional[bytes]:
+        """One raw record, None at pass end (reference returns (r, err))."""
+        if self._iter is None:
+            self._iter = self._records()
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = None
+            return None
+
+    def paddle_start_get_records(self, pass_id):
+        self._iter = self._records()

@@ -1,0 +1,123 @@
+"""Aux subsystems: flags/timers/logging, debugger dumps, plot, master
+client shim, check_nan_inf, checkgrad job (SURVEY §5.1-5.6 parity)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.utils as utils
+
+
+def test_flags_and_timers(capsys):
+    assert utils.FLAGS.trainer_count >= 1
+    utils.FLAGS.check_nan_inf = False
+    with utils.timer("forwardBackward"):
+        pass
+    with utils.timer("forwardBackward"):
+        pass
+    s = utils.global_stats().summary()
+    assert "forwardBackward" in s and "calls" in s
+
+
+def test_debugger_dumps():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="dbg_x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2)
+    code = fluid.debugger.program_to_code(main)
+    assert "mul" in code and "dbg_x" in code
+    dot = fluid.debugger.draw_block_graphviz(main.global_block())
+    assert dot.startswith("digraph") and "mul" in dot
+
+
+def test_ploter_records():
+    from paddle_tpu.v2.plot import Ploter
+
+    p = Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    assert p["train"].value == [1.0, 0.5]
+    p.plot()  # no crash, with or without matplotlib
+    p.reset()
+    assert p["train"].value == []
+
+
+def test_master_client_shim(tmp_path):
+    import pickle
+
+    import paddle_tpu.native as native
+    import paddle_tpu.v2 as paddle
+
+    if not native.available():
+        pytest.skip("no toolchain")
+    paths = []
+    for s in range(2):
+        p = str(tmp_path / ("c%d.rio" % s))
+        with native.RecordWriter(p) as w:
+            for i in range(5):
+                w.write(pickle.dumps((s, i)))
+        paths.append(p)
+    c = paddle.master.client(timeout_sec=60)
+    c.set_dataset(paths)
+    got = []
+    while True:
+        r = c.next_record()
+        if r is None:
+            break
+        got.append(pickle.loads(r))
+    assert sorted(got) == [(s, i) for s in range(2) for i in range(5)]
+
+
+def test_check_nan_inf_flag():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="nan_x", shape=[2], dtype="float32")
+        y = fluid.layers.log(x)  # log of negative -> nan
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    utils.FLAGS.check_nan_inf = True
+    try:
+        with pytest.raises(FloatingPointError):
+            exe.run(main, feed={"nan_x": np.array([[-1.0, 1.0]], np.float32)},
+                    fetch_list=[y])
+        # clean inputs pass
+        out, = exe.run(
+            main, feed={"nan_x": np.array([[1.0, 2.0]], np.float32)},
+            fetch_list=[y],
+        )
+        assert np.isfinite(out).all()
+    finally:
+        utils.FLAGS.check_nan_inf = False
+
+
+def test_checkgrad_job(tmp_path):
+    from paddle_tpu.trainer import run_config
+
+    (tmp_path / "cg_config.py").write_text(textwrap.dedent("""
+        settings(batch_size=8, learning_rate=0.1,
+                 learning_method=MomentumOptimizer(0.9))
+        define_py_data_sources2("train.list", None, module="cg_provider",
+                                obj="process", args={})
+        x = data_layer(name='x', size=6)
+        net = fc_layer(input=x, size=4, act=TanhActivation())
+        net = fc_layer(input=net, size=3, act=SoftmaxActivation())
+        lbl = data_layer(name='label', size=3)
+        outputs(classification_cost(input=net, label=lbl))
+    """))
+    (tmp_path / "cg_provider.py").write_text(textwrap.dedent("""
+        import numpy as np
+        from paddle_tpu.trainer.PyDataProvider2 import (
+            dense_vector, integer_value, provider)
+
+        @provider(input_types=[dense_vector(6), integer_value(3)])
+        def process(settings, file_list):
+            rng = np.random.RandomState(0)
+            for _ in range(16):
+                yield rng.rand(6).astype('float32'), int(rng.randint(0, 3))
+    """))
+    res = run_config(str(tmp_path / "cg_config.py"), job="checkgrad")
+    assert res["checkgrad"]
+    assert max(res["checkgrad"].values()) < 5e-2
