@@ -1,0 +1,102 @@
+"""GoogLeNet-v1 timing config (counterpart of reference
+benchmark/paddle/image/googlenet.py; BASELINE 1149 ms/batch bs=128 K40m)."""
+
+height = 224
+width = 224
+num_class = 1000
+batch_size = get_config_arg("batch_size", int, 128)
+is_infer = get_config_arg("is_infer", bool, False)
+num_samples = get_config_arg("num_samples", int, 2560)
+
+define_py_data_sources2(
+    "train.list" if not is_infer else None,
+    "test.list" if is_infer else None,
+    module="provider",
+    obj="process",
+    args={
+        "height": height,
+        "width": width,
+        "color": True,
+        "num_class": num_class,
+        "is_infer": is_infer,
+        "num_samples": num_samples,
+    },
+)
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.01 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size),
+)
+
+
+def inception(name, input, nf1, nf3r, nf3, nf5r, nf5, proj):
+    t1 = img_conv_layer(
+        name=name + "_1x1", input=input, filter_size=1, num_filters=nf1,
+        stride=1, padding=0, act=ReluActivation(),
+    )
+    t3 = img_conv_layer(
+        name=name + "_3x3r", input=input, filter_size=1, num_filters=nf3r,
+        stride=1, padding=0, act=ReluActivation(),
+    )
+    t3 = img_conv_layer(
+        name=name + "_3x3", input=t3, filter_size=3, num_filters=nf3,
+        stride=1, padding=1, act=ReluActivation(),
+    )
+    t5 = img_conv_layer(
+        name=name + "_5x5r", input=input, filter_size=1, num_filters=nf5r,
+        stride=1, padding=0, act=ReluActivation(),
+    )
+    t5 = img_conv_layer(
+        name=name + "_5x5", input=t5, filter_size=5, num_filters=nf5,
+        stride=1, padding=2, act=ReluActivation(),
+    )
+    tp = img_pool_layer(
+        name=name + "_pool", input=input, pool_size=3, stride=1, padding=1,
+        pool_type=MaxPooling(),
+    )
+    tp = img_conv_layer(
+        name=name + "_proj", input=tp, filter_size=1, num_filters=proj,
+        stride=1, padding=0, act=ReluActivation(),
+    )
+    return concat_layer(name=name, input=[t1, t3, t5, tp])
+
+
+img = data_layer(name="image", size=height * width * 3)
+
+net = img_conv_layer(input=img, filter_size=7, num_channels=3,
+                     num_filters=64, stride=2, padding=3,
+                     act=ReluActivation())
+net = img_pool_layer(input=net, pool_size=3, stride=2, padding=1)
+net = img_cmrnorm_layer(input=net, size=5)
+net = img_conv_layer(input=net, filter_size=1, num_filters=64, stride=1,
+                     padding=0, act=ReluActivation())
+net = img_conv_layer(input=net, filter_size=3, num_filters=192, stride=1,
+                     padding=1, act=ReluActivation())
+net = img_cmrnorm_layer(input=net, size=5)
+net = img_pool_layer(input=net, pool_size=3, stride=2, padding=1)
+
+net = inception("ince3a", net, 64, 96, 128, 16, 32, 32)
+net = inception("ince3b", net, 128, 128, 192, 32, 96, 64)
+net = img_pool_layer(input=net, pool_size=3, stride=2, padding=1)
+
+net = inception("ince4a", net, 192, 96, 208, 16, 48, 64)
+net = inception("ince4b", net, 160, 112, 224, 24, 64, 64)
+net = inception("ince4c", net, 128, 128, 256, 24, 64, 64)
+net = inception("ince4d", net, 112, 144, 288, 32, 64, 64)
+net = inception("ince4e", net, 256, 160, 320, 32, 128, 128)
+net = img_pool_layer(input=net, pool_size=3, stride=2, padding=1)
+
+net = inception("ince5a", net, 256, 160, 320, 32, 128, 128)
+net = inception("ince5b", net, 384, 192, 384, 48, 128, 128)
+net = img_pool_layer(input=net, pool_size=7, stride=1, pool_type=AvgPooling())
+
+net = dropout_layer(input=net, dropout_rate=0.4)
+net = fc_layer(input=net, size=num_class, act=SoftmaxActivation())
+
+if is_infer:
+    outputs(net)
+else:
+    lab = data_layer(name="label", size=num_class)
+    outputs(cross_entropy(input=net, label=lab))

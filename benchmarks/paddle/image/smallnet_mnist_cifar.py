@@ -1,0 +1,41 @@
+"""Small CNN timing config (counterpart of reference
+benchmark/paddle/image/smallnet_mnist_cifar.py)."""
+
+height = 32
+width = 32
+num_class = 10
+batch_size = get_config_arg("batch_size", int, 128)
+num_samples = get_config_arg("num_samples", int, 2560)
+
+define_py_data_sources2(
+    "train.list", None, module="provider", obj="process",
+    args={
+        "height": height, "width": width, "color": True,
+        "num_class": num_class, "num_samples": num_samples,
+    },
+)
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.01 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size),
+)
+
+net = data_layer("data", size=height * width * 3)
+net = img_conv_layer(input=net, filter_size=5, num_channels=3,
+                     num_filters=32, stride=1, padding=2)
+net = img_pool_layer(input=net, pool_size=3, stride=2, padding=1)
+net = img_conv_layer(input=net, filter_size=5, num_filters=32, stride=1,
+                     padding=2)
+net = img_pool_layer(input=net, pool_size=3, stride=2, padding=1,
+                     pool_type=AvgPooling())
+net = img_conv_layer(input=net, filter_size=3, num_filters=64, stride=1,
+                     padding=1)
+net = img_pool_layer(input=net, pool_size=3, stride=2, padding=1,
+                     pool_type=AvgPooling())
+net = fc_layer(input=net, size=64, act=ReluActivation())
+net = fc_layer(input=net, size=10, act=SoftmaxActivation())
+
+lab = data_layer("label", num_class)
+outputs(classification_cost(input=net, label=lab))

@@ -1,0 +1,66 @@
+"""VGG timing config (counterpart of reference
+benchmark/paddle/image/vgg.py; layer_num 16/19)."""
+
+height = 224
+width = 224
+num_class = 1000
+batch_size = get_config_arg("batch_size", int, 64)
+layer_num = get_config_arg("layer_num", int, 19)
+is_infer = get_config_arg("is_infer", bool, False)
+num_samples = get_config_arg("num_samples", int, 2560)
+
+define_py_data_sources2(
+    "train.list" if not is_infer else None,
+    "test.list" if is_infer else None,
+    module="provider",
+    obj="process",
+    args={
+        "height": height,
+        "width": width,
+        "color": True,
+        "num_class": num_class,
+        "is_infer": is_infer,
+        "num_samples": num_samples,
+    },
+)
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.001 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size),
+)
+
+img = data_layer(name="image", size=height * width * 3)
+
+vgg_num = {16: 2, 19: 3}[layer_num]
+
+net = img_conv_group(
+    input=img, num_channels=3, conv_num_filter=[64, 64], conv_filter_size=3,
+    conv_padding=1, conv_act=ReluActivation(), pool_size=2, pool_stride=2,
+    pool_type=MaxPooling(),
+)
+net = img_conv_group(
+    input=net, conv_num_filter=[128, 128], conv_filter_size=3,
+    conv_padding=1, conv_act=ReluActivation(), pool_size=2, pool_stride=2,
+    pool_type=MaxPooling(),
+)
+# VGG16: groups of 3 convs (vgg_num=2 -> +1); VGG19: groups of 4
+for channels in (256, 512, 512):
+    net = img_conv_group(
+        input=net, conv_num_filter=[channels] * (vgg_num + 1),
+        conv_filter_size=3, conv_padding=1, conv_act=ReluActivation(),
+        pool_size=2, pool_stride=2, pool_type=MaxPooling(),
+    )
+
+net = fc_layer(input=net, size=4096, act=ReluActivation())
+net = dropout_layer(input=net, dropout_rate=0.5)
+net = fc_layer(input=net, size=4096, act=ReluActivation())
+net = dropout_layer(input=net, dropout_rate=0.5)
+net = fc_layer(input=net, size=num_class, act=SoftmaxActivation())
+
+if is_infer:
+    outputs(net)
+else:
+    lab = data_layer("label", num_class)
+    outputs(classification_cost(input=net, label=lab))

@@ -28,6 +28,7 @@ __all__ = [
     "get_config_arg", "settings", "define_py_data_sources2", "outputs",
     # layers
     "data_layer", "fc_layer", "img_conv_layer", "img_pool_layer",
+    "img_conv_group",
     "batch_norm_layer", "concat_layer", "addto_layer", "dropout_layer",
     "embedding_layer", "img_cmrnorm_layer", "simple_lstm", "lstmemory",
     "grumemory", "last_seq", "first_seq", "max_id",
@@ -389,3 +390,28 @@ def mse_cost(input, label, name=None, **kwargs):
 
 
 regression_cost = mse_cost
+
+
+def img_conv_group(input, conv_num_filter, conv_filter_size=3,
+                   conv_padding=1, conv_act=None, num_channels=None,
+                   pool_size=2, pool_stride=2, pool_type=None,
+                   conv_with_batchnorm=False, name=None, **kwargs):
+    """Stacked convs + one pool (reference trainer_config_helpers/networks
+    img_conv_group, used by the VGG benchmark config)."""
+    tmp = _as_list(input)[0]
+    for i, nf in enumerate(conv_num_filter):
+        tmp = img_conv_layer(
+            input=tmp,
+            filter_size=conv_filter_size,
+            num_filters=nf,
+            num_channels=num_channels if i == 0 else None,
+            stride=1,
+            padding=conv_padding,
+            act=conv_act,
+        )
+        if conv_with_batchnorm:
+            tmp = batch_norm_layer(input=tmp, act=None)
+    return img_pool_layer(
+        input=tmp, pool_size=pool_size, stride=pool_stride,
+        pool_type=pool_type,
+    )
