@@ -275,3 +275,51 @@ def _chunk_eval(ctx, ins, attrs):
         "NumLabelChunks": num_label.reshape(1),
         "NumCorrectChunks": num_correct.reshape(1),
     }
+
+
+@register_op("precision_recall")
+def _precision_recall(ctx, ins, attrs):
+    """Multi-class precision/recall/F1, batch + accumulated (reference
+    operators/precision_recall_op.h: per-class TP/FP/FN states, macro and
+    micro averages over 6 metric slots)."""
+    idx = ins["Indices"][0].reshape(-1)  # predicted class per example
+    labels = ins["Labels"][0].reshape(-1)
+    C = int(attrs["class_number"])
+    weights = (
+        ins["Weights"][0].reshape(-1)
+        if ins.get("Weights")
+        else jnp.ones_like(idx, dtype=jnp.float32)
+    )
+    states_in = (
+        ins["StatesInfo"][0]
+        if ins.get("StatesInfo")
+        else jnp.zeros((C, 4), jnp.float32)
+    )
+
+    correct = (idx == labels).astype(jnp.float32) * weights
+    tp = jax.ops.segment_sum(correct, labels, num_segments=C)
+    pred_count = jax.ops.segment_sum(weights, idx, num_segments=C)
+    label_count = jax.ops.segment_sum(weights, labels, num_segments=C)
+    fp = pred_count - tp
+    fn = label_count - tp
+    tn = jnp.sum(weights) - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # [C,4]
+
+    def metrics(states):
+        tp_, fp_, _, fn_ = (states[:, i] for i in range(4))
+        prec = tp_ / jnp.maximum(tp_ + fp_, 1e-12)
+        rec = tp_ / jnp.maximum(tp_ + fn_, 1e-12)
+        f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        stp, sfp, sfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = stp / jnp.maximum(stp + sfp, 1e-12)
+        mr = stp / jnp.maximum(stp + sfn, 1e-12)
+        mf = 2 * mp * mr / jnp.maximum(mp + mr, 1e-12)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    accum_states = states_in + batch_states
+    return {
+        "BatchMetrics": metrics(batch_states),
+        "AccumMetrics": metrics(accum_states),
+        "AccumStatesInfo": accum_states,
+    }

@@ -41,6 +41,7 @@ __all__ = [
     "nce",
     "hsigmoid",
     "sequence_erase",
+    "precision_recall",
     "auc",
     "topk",
     "matmul",
@@ -1220,3 +1221,34 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None):
         attrs={"num_classes": int(num_classes)},
     )
     return out
+
+
+def precision_recall(input, label, class_number, max_probs=None,
+                     weights=None, states=None, **kwargs):
+    """Multi-class precision/recall metrics (reference
+    operators/precision_recall_op). `input` is the predicted class-index
+    tensor (e.g. topk indices); returns (batch_metrics, accum_metrics,
+    accum_states) where metrics = [macro-P, macro-R, macro-F1, micro-P,
+    micro-R, micro-F1]."""
+    helper = LayerHelper("precision_recall", **kwargs)
+    batch_metrics = helper.create_tmp_variable(dtype="float32")
+    accum_metrics = helper.create_tmp_variable(dtype="float32")
+    accum_states = helper.create_tmp_variable(dtype="float32")
+    inputs = {"Indices": [input], "Labels": [label]}
+    if max_probs is not None:
+        inputs["MaxProbs"] = [max_probs]
+    if weights is not None:
+        inputs["Weights"] = [weights]
+    if states is not None:
+        inputs["StatesInfo"] = [states]
+    helper.append_op(
+        type="precision_recall",
+        inputs=inputs,
+        outputs={
+            "BatchMetrics": [batch_metrics],
+            "AccumMetrics": [accum_metrics],
+            "AccumStatesInfo": [accum_states],
+        },
+        attrs={"class_number": int(class_number)},
+    )
+    return batch_metrics, accum_metrics, accum_states

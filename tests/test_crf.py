@@ -202,3 +202,42 @@ def test_chunk_eval_sequence_boundary():
     # seq 1: B I -> 1 chunk; seq 2: I I -> 1 chunk (I at seq start begins)
     assert int(res[4][0]) == 2  # NumLabelChunks
     assert int(res[5][0]) == 2  # NumCorrectChunks (identical sequences)
+
+
+def test_precision_recall_matches_sklearn_style_oracle():
+    rng = np.random.RandomState(5)
+    C, N = 4, 50
+    preds = rng.randint(0, C, N)
+    labels = rng.randint(0, C, N)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = pd.data(name="p", shape=[1], dtype="int64")
+        l = pd.data(name="l", shape=[1], dtype="int64")
+        batch_m, accum_m, states = pd.precision_recall(
+            input=p, label=l, class_number=C
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bm, am, st = exe.run(
+        main,
+        feed={"p": preds.reshape(-1, 1), "l": labels.reshape(-1, 1)},
+        fetch_list=[batch_m, accum_m, states],
+    )
+    # numpy oracle
+    precs, recs, f1s = [], [], []
+    for c in range(C):
+        tp = ((preds == c) & (labels == c)).sum()
+        fp = ((preds == c) & (labels != c)).sum()
+        fn = ((preds != c) & (labels == c)).sum()
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        precs.append(prec)
+        recs.append(rec)
+        f1s.append(2 * prec * rec / max(prec + rec, 1e-12))
+    assert np.allclose(bm[0], np.mean(precs), atol=1e-5)
+    assert np.allclose(bm[1], np.mean(recs), atol=1e-5)
+    assert np.allclose(bm[2], np.mean(f1s), atol=1e-5)
+    micro = (preds == labels).sum() / N  # micro P == R == acc here
+    assert np.allclose(bm[3], micro, atol=1e-5)
+    assert np.allclose(bm, am)  # no prior states
+    assert st.shape == (C, 4)

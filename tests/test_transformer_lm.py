@@ -1,0 +1,75 @@
+"""Long-context transformer LM over the 3-axis mesh: dp+tp+sp must compute
+exactly the single-device math, and training must learn a synthetic
+pattern."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu import parallel
+from paddle_tpu.models import transformer as tlm
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tlm.TransformerConfig(vocab=32, dim=32, heads=4, layers=2,
+                                 max_len=64)
+
+
+def _tokens(rng, b, t, vocab):
+    # learnable structure: next token = (token + 1) % vocab
+    start = rng.randint(0, vocab, (b, 1))
+    ar = (start + np.arange(t + 1)) % vocab
+    return jnp.asarray(ar.astype(np.int32))
+
+
+def test_seq_parallel_loss_matches_single_device(cfg):
+    rng = np.random.RandomState(0)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    toks = _tokens(rng, 2, 16, cfg.vocab)
+
+    ref = tlm.loss_fn(params, toks, cfg, mesh=None)
+    mesh = parallel.make_mesh({"seq": 8})
+    sp = tlm.loss_fn(params, toks, cfg, mesh=mesh, attn_impl="ring")
+    np.testing.assert_allclose(float(sp), float(ref), rtol=1e-5)
+
+    g_ref = jax.grad(tlm.loss_fn)(params, toks, cfg, mesh=None)
+    g_sp = jax.grad(tlm.loss_fn)(params, toks, cfg, mesh=mesh)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_sp)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-4)
+
+
+def test_tp_sharded_params_match(cfg):
+    rng = np.random.RandomState(1)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(1))
+    toks = _tokens(rng, 2, 16, cfg.vocab)
+    ref = float(tlm.loss_fn(params, toks, cfg, mesh=None))
+
+    mesh = parallel.make_mesh({"data": 2, "model": 4})
+    specs = tlm.param_specs(cfg)
+    sharded = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray, P)),
+    )
+    got = float(jax.jit(
+        lambda pr, tk: tlm.loss_fn(pr, tk, cfg, mesh=mesh)
+    )(sharded, toks))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_lm_trains_on_pattern(cfg):
+    rng = np.random.RandomState(2)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(2))
+    mesh = parallel.make_mesh({"seq": 8})
+    step = jax.jit(tlm.make_train_step(cfg, lr=0.5, mesh=mesh))
+    losses = []
+    for i in range(30):
+        toks = _tokens(rng, 8, 16, cfg.vocab)
+        params, loss = step(params, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
