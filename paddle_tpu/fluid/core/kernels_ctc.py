@@ -51,8 +51,6 @@ def _warpctc(ctx, ins, attrs):
     B = t_off.shape[0] - 1
 
     T = _bucket_of(ctx, "Logits", logits.shape[0])
-    logit_p, t_mask = packed_to_padded(logits, t_off, T)  # [B,T,C]
-    logp = jax.nn.log_softmax(logit_p.astype(jnp.float32), axis=-1)
     t_lens = seg_lengths(t_off)  # [B]
 
     lab_p, _ = packed_to_padded(labels, l_off, _bucket_of(ctx, "Label", labels.shape[0]))
@@ -71,56 +69,68 @@ def _warpctc(ctx, ins, attrs):
     )
     can_skip = jnp.logical_and(is_lab[None, :], lab_at != prev2)
 
-    def emit(t):
-        # log p of emitting z_s at time t: [B,S]
-        return jnp.take_along_axis(logp[:, t], lab_at, axis=1)
+    def ctc_loss(logits_packed):
+        logit_p, _ = packed_to_padded(logits_packed, t_off, T)  # [B,T,C]
+        logp = jax.nn.log_softmax(logit_p.astype(jnp.float32), axis=-1)
 
-    a0 = jnp.full((B, S), _NEG)
-    a0 = a0.at[:, 0].set(logp[:, 0, blank])
-    a0 = a0.at[:, 1].set(
-        jnp.where(l_lens > 0, emit(0)[:, 1], _NEG)
-    )
-    a0 = jnp.where(s_valid, a0, _NEG)
+        def emit(t):
+            # log p of emitting z_s at time t: [B,S]
+            return jnp.take_along_axis(logp[:, t], lab_at, axis=1)
 
-    def shift(a, k):
-        return jnp.concatenate([jnp.full((B, k), _NEG), a[:, :-k]], axis=1)
+        a0 = jnp.full((B, S), _NEG)
+        a0 = a0.at[:, 0].set(logp[:, 0, blank])
+        a0 = a0.at[:, 1].set(
+            jnp.where(l_lens > 0, emit(0)[:, 1], _NEG)
+        )
+        a0 = jnp.where(s_valid, a0, _NEG)
 
-    def step(alpha, t):
-        stay = alpha
-        diag = shift(alpha, 1)
-        skip = jnp.where(can_skip, shift(alpha, 2), _NEG)
-        m = jnp.maximum(jnp.maximum(stay, diag), skip)
+        def shift(a, k):
+            return jnp.concatenate([jnp.full((B, k), _NEG), a[:, :-k]], axis=1)
+
+        def step(alpha, t):
+            stay = alpha
+            diag = shift(alpha, 1)
+            skip = jnp.where(can_skip, shift(alpha, 2), _NEG)
+            m = jnp.maximum(jnp.maximum(stay, diag), skip)
+            safe = jnp.where(m <= _NEG, 0.0, m)
+            summed = safe + jnp.log(
+                jnp.exp(jnp.where(stay <= _NEG, _NEG, stay - safe))
+                + jnp.exp(jnp.where(diag <= _NEG, _NEG, diag - safe))
+                + jnp.exp(jnp.where(skip <= _NEG, _NEG, skip - safe))
+                + 1e-45
+            )
+            new = summed + emit(t)
+            new = jnp.where(s_valid, new, _NEG)
+            alive = (t < t_lens)[:, None]
+            return jnp.where(alive, new, alpha), None
+
+        alpha, _ = lax.scan(step, a0, jnp.arange(1, T))
+
+        bidx = jnp.arange(B)
+        send = 2 * l_lens  # index of final blank
+        last_blank = alpha[bidx, send]
+        last_lab = jnp.where(
+            l_lens > 0, alpha[bidx, jnp.maximum(send - 1, 0)], _NEG
+        )
+        m = jnp.maximum(last_blank, last_lab)
         safe = jnp.where(m <= _NEG, 0.0, m)
-        summed = safe + jnp.log(
-            jnp.exp(jnp.where(stay <= _NEG, _NEG, stay - safe))
-            + jnp.exp(jnp.where(diag <= _NEG, _NEG, diag - safe))
-            + jnp.exp(jnp.where(skip <= _NEG, _NEG, skip - safe))
+        ll = safe + jnp.log(
+            jnp.exp(last_blank - safe)
+            + jnp.exp(jnp.where(last_lab <= _NEG, _NEG, last_lab - safe))
             + 1e-45
         )
-        new = summed + emit(t)
-        new = jnp.where(s_valid, new, _NEG)
-        alive = (t < t_lens)[:, None]
-        return jnp.where(alive, new, alpha), None
+        loss = -ll
+        if attrs.get("norm_by_times"):
+            loss = loss / jnp.maximum(t_lens.astype(loss.dtype), 1.0)
+        return loss
 
-    alpha, _ = lax.scan(step, a0, jnp.arange(1, T))
-
-    bidx = jnp.arange(B)
-    send = 2 * l_lens  # index of final blank
-    last_blank = alpha[bidx, send]
-    last_lab = jnp.where(
-        l_lens > 0, alpha[bidx, jnp.maximum(send - 1, 0)], _NEG
-    )
-    m = jnp.maximum(last_blank, last_lab)
-    safe = jnp.where(m <= _NEG, 0.0, m)
-    ll = safe + jnp.log(
-        jnp.exp(last_blank - safe) + jnp.exp(jnp.where(last_lab <= _NEG, _NEG, last_lab - safe))
-        + 1e-45
-    )
-    loss = -ll
-    if attrs.get("norm_by_times"):
-        loss = loss / jnp.maximum(t_lens.astype(loss.dtype), 1.0)
+    # WarpCTCGrad = d(sum loss)/d logits (reference warpctc_op semantics:
+    # the library hands back the per-frame gradient alongside the loss).
+    # XLA dead-code-eliminates the vjp when the output is never fetched.
+    loss, pullback = jax.vjp(ctc_loss, logits.astype(jnp.float32))
+    (grad,) = pullback(jnp.ones_like(loss))
     return {"Loss": loss.reshape(B, 1).astype(logits.dtype),
-            "WarpCTCGrad": jnp.zeros_like(logits)}
+            "WarpCTCGrad": grad.astype(logits.dtype)}
 
 
 @register_op("edit_distance")

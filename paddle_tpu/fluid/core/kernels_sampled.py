@@ -48,21 +48,31 @@ def _nce(ctx, ins, attrs):
     if b is not None:
         logits = logits + b.reshape(-1)[all_ids]
 
-    # P(noise) uniform = 1/num_total; logit correction log(k * p_noise)
-    log_kp = jnp.log(jnp.asarray(k / num_total, logits.dtype))
-    adj = logits - log_kp
+    # Reference formulation (nce_op.h:93,115-133): o = sigmoid(s),
+    # b = num_neg_samples / num_total_classes; true-class cost
+    # -log(o/(o+b)), sampled-class cost -log(b/(o+b)); summed (NOT
+    # averaged over num_true). Stable forms: -log(o/(o+b)) =
+    # log(o+b) + softplus(-s); -log(b/(o+b)) = log(o+b) - log(b).
+    s = logits.astype(jnp.float32)
+    o = jax.nn.sigmoid(s)
+    noise_b = jnp.float32(k / num_total)
+    log_opb = jnp.log(o + noise_b)
+    true_cost = log_opb + jax.nn.softplus(-s)
+    neg_cost = log_opb - jnp.log(noise_b)
     lbl_mask = jnp.concatenate(
         [jnp.ones((N, num_true)), jnp.zeros((N, k))], axis=1
-    ).astype(logits.dtype)
-    # logistic loss: -[y*log σ(adj) + (1-y)*log(1-σ(adj))]
+    ).astype(jnp.float32)
     loss = jnp.sum(
-        jax.nn.softplus(adj) - lbl_mask * adj, axis=1, keepdims=True
-    ) / num_true
+        lbl_mask * true_cost + (1.0 - lbl_mask) * neg_cost,
+        axis=1,
+        keepdims=True,
+    )
     if ins.get("SampleWeight"):
         loss = loss * ins["SampleWeight"][0].reshape(N, 1)
     return {
         "Cost": loss.astype(x.dtype),
-        "SampleLogits": logits.astype(x.dtype),
+        # reference stores the POST-sigmoid activations here (nce_op.h:115)
+        "SampleLogits": o.astype(x.dtype),
         "SampleLabels": all_ids,
     }
 

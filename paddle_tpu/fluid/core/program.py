@@ -14,6 +14,7 @@ for save/load_inference_model parity.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import re
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -341,15 +342,22 @@ class Block(object):
         return "\n".join(lines)
 
 
+_program_uid_counter = itertools.count()
+
+
 class Program(object):
     """A list of Blocks; block 0 is the global block (framework.py:830).
 
     `version` is bumped on every mutation; the executor uses
-    (id(program), version) as part of its compilation-cache key so that
+    (program.uid, version) as part of its compilation-cache key so that
     appending ops after a run correctly invalidates the cached XLA step.
+    `uid` is monotonic across the process — unlike id(), it can never be
+    recycled by a later allocation, so a dead Program's cache entries can
+    never be replayed for a new one.
     """
 
     def __init__(self):
+        self.uid = next(_program_uid_counter)
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self.version = 0
@@ -404,6 +412,7 @@ class Program(object):
         import copy
 
         p = Program.__new__(Program)
+        p.uid = next(_program_uid_counter)
         p.blocks = []
         p.current_block_idx = self.current_block_idx
         p.version = self.version

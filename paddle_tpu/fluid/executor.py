@@ -254,7 +254,7 @@ class Executor(object):
         # them so shard_parameter() after a run is not silently ignored
         shard_fp = tuple(sorted((k, str(v)) for k, v in program.shardings.items()))
         key = (
-            id(program),
+            program.uid,
             program.version,
             program.amp,
             feed_sig,
@@ -265,7 +265,7 @@ class Executor(object):
             shard_fp,
             seq_maxlen,
             tuple(sorted(seq_buckets.items())),
-        ) + ((id(mesh),) if mesh is not None else ())
+        ) + ((mesh,) if mesh is not None else ())  # Mesh hashes by devices+axes
         entry = self._cache.get(key) if use_cache else None
         if entry is None:
             if steps is None:

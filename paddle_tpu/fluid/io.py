@@ -3,13 +3,18 @@ save/load_vars, save/load_params, save/load_persistables,
 save/load_inference_model; C++ side inference/io.cc).
 
 Persistables are saved one .npy per variable (name-escaped) plus the
-program (pickled IR) for inference models. TPU-side state lives in the
-Scope as device arrays; save pulls to host, load pushes back lazily at the
-next executor run.
+program as a language-neutral JSON IR (core/serialization.py — the
+counterpart of the reference's __model__ ProgramDesc protobuf,
+inference/io.cc:108). The bundle is readable without this codebase: the
+native C inference runner (native/inference.cc) loads and forwards it
+directly, matching capi/gradient_machine.h:36,73. TPU-side state lives in
+the Scope as device arrays; save pulls to host, load pushes back lazily
+at the next executor run.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 
@@ -56,7 +61,13 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
         name = var if isinstance(var, str) else var.name
         if name not in scope:
             continue
-        np.save(os.path.join(dirname, _escape(name) + ".npy"), np.asarray(scope.get(name)))
+        # device arrays can materialise Fortran-ordered (transposed TPU
+        # layouts); the on-disk format is always C-order so the native
+        # loader (inference.cc load_npy) can mmap-read it directly
+        np.save(
+            os.path.join(dirname, _escape(name) + ".npy"),
+            np.ascontiguousarray(np.asarray(scope.get(name))),
+        )
 
 
 def save_params(executor, dirname, main_program=None):
@@ -118,21 +129,40 @@ def save_inference_model(
 
     inference_program = main_program.prune(target_vars).clone(for_test=True)
     fetch_names = [v.name for v in target_vars]
-    meta = {
+
+    from .core.serialization import program_to_dict
+
+    bundle = program_to_dict(inference_program)
+    bundle["meta"] = {
         "feed_names": list(feeded_var_names),
         "fetch_names": fetch_names,
     }
-    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "wb") as f:
-        pickle.dump({"program": inference_program, "meta": meta}, f)
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
+        json.dump(bundle, f)
     save_persistables(executor, dirname, inference_program)
     return fetch_names
 
 
 def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
-    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "rb") as f:
-        bundle = pickle.load(f)
-    program: Program = bundle["program"]
-    meta = bundle["meta"]
+    path = os.path.join(dirname, model_filename or _MODEL_FILE)
+    from .core.serialization import program_from_dict
+
+    with open(path, "rb") as f:
+        head = f.read(1)
+    if head != b"{":  # pre-r2 pickle bundles
+        with open(path, "rb") as f:
+            bundle = pickle.load(f)
+        program: Program = bundle["program"]
+        meta = bundle["meta"]
+        if not hasattr(program, "uid"):  # pickled before Program.uid existed
+            from .core.program import _program_uid_counter
+
+            program.uid = next(_program_uid_counter)
+    else:
+        with open(path, "r") as f:
+            bundle = json.load(f)
+        program = program_from_dict(bundle)
+        meta = bundle["meta"]
     load_persistables(executor, dirname, program)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars

@@ -18,21 +18,29 @@ import threading
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "_build", "librecordio.so")
 _SRC = os.path.join(_HERE, "recordio.cc")
+_INFER_SO = os.path.join(_HERE, "_build", "libptpu_infer.so")
+_INFER_SRC = os.path.join(_HERE, "inference.cc")
 _lock = threading.Lock()
 _lib = None
 _build_error = None
+_infer_lib = None
+_infer_error = None
+
+
+def _compile(src: str, so: str) -> str:
+    os.makedirs(os.path.dirname(so), exist_ok=True)
+    tmp = so + ".tmp.so"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        src, "-o", tmp,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so)
+    return so
 
 
 def _build() -> str:
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    tmp = _SO + ".tmp.so"
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        _SRC, "-o", tmp,
-    ]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(tmp, _SO)
-    return _SO
+    return _compile(_SRC, _SO)
 
 
 def lib():
@@ -86,13 +94,148 @@ def available() -> bool:
         return False
 
 
+def infer_lib_path() -> str:
+    """Build (if needed) and return the path of the native inference
+    runner shared library — usable from ANY language via dlopen; no
+    paddle_tpu import required at load/forward time (capi parity,
+    reference capi/gradient_machine.h:36,73)."""
+    global _infer_error
+    with _lock:
+        if _infer_error is not None:
+            raise RuntimeError(
+                "native inference build failed earlier: %s" % _infer_error
+            )
+        try:
+            if not os.path.exists(_INFER_SO) or (
+                os.path.getmtime(_INFER_SRC) > os.path.getmtime(_INFER_SO)
+            ):
+                _compile(_INFER_SRC, _INFER_SO)
+        except Exception as e:
+            _infer_error = e
+            raise RuntimeError("cannot build native inference: %s" % e)
+        return _INFER_SO
+
+
+def infer_lib():
+    """ctypes handle to the native inference runner with signatures set."""
+    global _infer_lib
+    path = infer_lib_path()
+    with _lock:
+        if _infer_lib is not None:
+            return _infer_lib
+        L = ctypes.CDLL(path)
+        L.ptpu_infer_create.restype = ctypes.c_void_p
+        L.ptpu_infer_create.argtypes = [ctypes.c_char_p]
+        L.ptpu_infer_num_feeds.argtypes = [ctypes.c_void_p]
+        L.ptpu_infer_feed_name.restype = ctypes.c_char_p
+        L.ptpu_infer_feed_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.ptpu_infer_num_fetch.argtypes = [ctypes.c_void_p]
+        L.ptpu_infer_fetch_name.restype = ctypes.c_char_p
+        L.ptpu_infer_fetch_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.ptpu_infer_set_input.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+        L.ptpu_infer_forward.argtypes = [ctypes.c_void_p]
+        L.ptpu_infer_error.restype = ctypes.c_char_p
+        L.ptpu_infer_error.argtypes = [ctypes.c_void_p]
+        L.ptpu_infer_out_rank.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.ptpu_infer_out_shape.restype = ctypes.POINTER(ctypes.c_int64)
+        L.ptpu_infer_out_shape.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.ptpu_infer_out_data.restype = ctypes.POINTER(ctypes.c_float)
+        L.ptpu_infer_out_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.ptpu_infer_destroy.argtypes = [ctypes.c_void_p]
+        _infer_lib = L
+        return _infer_lib
+
+
+class InferenceRunner(object):
+    """Convenience Python wrapper over the C ABI (the C ABI itself is the
+    deliverable; this class just saves ctypes boilerplate in-process)."""
+
+    def __init__(self, dirname: str):
+        import numpy as np
+
+        self._np = np
+        self._L = infer_lib()
+        self._h = self._L.ptpu_infer_create(dirname.encode())
+        if not self._h:
+            raise IOError("cannot load inference bundle at %s" % dirname)
+
+    @property
+    def feed_names(self):
+        L, h = self._L, self._h
+        return [
+            L.ptpu_infer_feed_name(h, i).decode()
+            for i in range(L.ptpu_infer_num_feeds(h))
+        ]
+
+    @property
+    def fetch_names(self):
+        L, h = self._L, self._h
+        return [
+            L.ptpu_infer_fetch_name(h, i).decode()
+            for i in range(L.ptpu_infer_num_fetch(h))
+        ]
+
+    def run(self, feeds: dict):
+        np = self._np
+        L, h = self._L, self._h
+        for name, arr in feeds.items():
+            arr = np.asarray(arr)
+            if arr.dtype.kind in "iu":
+                arr = np.ascontiguousarray(arr, np.int64)
+                code = 1
+            else:
+                arr = np.ascontiguousarray(arr, np.float32)
+                code = 0
+            shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+            L.ptpu_infer_set_input(
+                h, name.encode(),
+                arr.ctypes.data_as(ctypes.c_void_p), code, shape, arr.ndim,
+            )
+        if L.ptpu_infer_forward(h) != 0:
+            raise RuntimeError(
+                "native forward failed: %s"
+                % L.ptpu_infer_error(h).decode()
+            )
+        outs = []
+        for i in range(L.ptpu_infer_num_fetch(h)):
+            rank = L.ptpu_infer_out_rank(h, i)
+            shape = [L.ptpu_infer_out_shape(h, i)[k] for k in range(rank)]
+            n = int(np.prod(shape)) if shape else 1
+            data = np.ctypeslib.as_array(
+                L.ptpu_infer_out_data(h, i), shape=(n,)
+            ).copy()
+            outs.append(data.reshape(shape))
+        return outs
+
+    def close(self):
+        if self._h:
+            self._L.ptpu_infer_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 # ---------------------------------------------------------------------
 # Python surface
 # ---------------------------------------------------------------------
 
 
 class RecordWriter(object):
-    """Length-prefixed CRC-checked record file writer."""
+    """Length-prefixed CRC-checked record file writer.
+
+    NOTE: this is a bespoke on-disk format ([u32 len][u32 crc32][payload]
+    per record, recordio.cc), NOT the reference RecordIO chunk layout
+    (magic + compressed multi-record chunks, recordio library used by the
+    Go master). Files are not interchangeable with reference-produced
+    .recordio data; the capability being reproduced is the native
+    record-stream + prefetch-queue data plane, not the wire format."""
 
     def __init__(self, path: str):
         self._h = lib().rio_writer_open(path.encode())

@@ -17,6 +17,7 @@ use_gpu/trainer_count map to the TPU chip / mesh data axis."""
 from . import activation  # noqa: F401
 from . import data_type  # noqa: F401
 from . import dataset  # noqa: F401
+from . import evaluator  # noqa: F401
 from . import event  # noqa: F401
 from . import layer  # noqa: F401
 from . import master  # noqa: F401
@@ -34,7 +35,8 @@ from .. import fluid  # noqa: F401
 
 __all__ = [
     "init", "batch", "infer", "layer", "activation", "data_type", "dataset",
-    "event", "minibatch", "optimizer", "parameters", "reader", "trainer",
+    "evaluator", "event", "minibatch", "optimizer", "parameters", "reader",
+    "trainer",
     "master", "plot",
     "fluid",
 ]

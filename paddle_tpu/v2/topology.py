@@ -19,6 +19,7 @@ class Topology(object):
             layers = [layers]
         extra = list(extra_layers or [])
         self.output_layers = list(layers)
+        self.extra_layers = extra
         self.order = parse_network(*(list(layers) + extra))
 
         self.main_program = fluid.Program()
@@ -145,6 +146,19 @@ class Topology(object):
             return L.mean(x=L.square_error_cost(input=pred, label=label))
         if node.kind == "dropout":
             return L.dropout(x=self._in(node), dropout_prob=a["rate"])
+        if node.kind == "classification_error_evaluator":
+            pred, label = self._ins(node)
+            acc = L.accuracy(input=pred, label=label,
+                             k=a.get("top_k", 1) or 1)
+            one = L.fill_constant(shape=[1], dtype="float32", value=1.0)
+            return L.elementwise_sub(x=one, y=acc)  # error = 1 - accuracy
+        if node.kind == "auc_evaluator":
+            pred, label = self._ins(node)
+            return L.auc(input=pred, label=label)
+        if node.kind == "sum_evaluator":
+            return L.reduce_sum(self._in(node))
+        if node.kind == "column_sum_evaluator":
+            return L.reduce_sum(self._in(node), dim=0)
         raise NotImplementedError("v2 layer kind %r" % node.kind)
 
     # ------------------------------------------------------------------

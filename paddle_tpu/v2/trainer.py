@@ -62,6 +62,12 @@ def _convert_feed(batch, data_nodes, feeding):
     return feed
 
 
+def _metric_value(m):
+    """Scalars become floats; vector metrics (column_sum) stay arrays."""
+    arr = np.ravel(np.asarray(m))
+    return float(arr[0]) if arr.size == 1 else np.asarray(m)
+
+
 class SGD(object):
     def __init__(self, cost, parameters, update_equation, extra_layers=None,
                  is_local=True, pserver_spec=None, use_etcd=True):
@@ -71,7 +77,9 @@ class SGD(object):
         # reuse the parameters' topology when it covers this cost, so the
         # trainer updates the same scope arrays in place
         topo = parameters.topology
-        if not any(l is cost for l in topo.output_layers):
+        if not any(l is cost for l in topo.output_layers) or any(
+            l.name not in topo.var_of for l in (extra_layers or [])
+        ):
             topo = Topology([cost], extra_layers=extra_layers)
         # a topology can host at most one optimizer: a second SGD over the
         # same Parameters gets a fresh replay of the DAG instead of
@@ -80,6 +88,14 @@ class SGD(object):
             topo = Topology([cost], extra_layers=extra_layers)
         self._topology = topo
         self._cost_var = topo.var_of[cost.name]
+        # metric layers from extra_layers: fetched every batch and handed
+        # to event handlers via the evaluator payload (reference book
+        # handlers read event.evaluator after each iteration)
+        self._metric_fetches = [
+            (l.name, topo.var_of[l.name])
+            for l in getattr(topo, "extra_layers", [])
+            if l.name in topo.var_of
+        ]
         # snapshot the forward-only program BEFORE minimize appends the
         # backward+update ops: test() must never touch parameters
         self._test_program = topo.main_program.clone(for_test=True)
@@ -113,15 +129,17 @@ class SGD(object):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 feed = _convert_feed(batch, data_nodes, feeding)
                 with fluid.executor.scope_guard(scope):
-                    (cost,) = self._exe.run(
+                    fetched = self._exe.run(
                         self._topology.main_program,
                         feed=feed,
-                        fetch_list=[self._cost_var],
+                        fetch_list=[self._cost_var]
+                        + [v for _, v in self._metric_fetches],
                     )
+                cost, metrics = fetched[0], fetched[1:]
                 event_handler(
                     v2_event.EndIteration(
                         pass_id, batch_id, float(np.ravel(cost)[0]),
-                        evaluator={},
+                        evaluator=self._metric_payload(metrics),
                     )
                 )
             event_handler(v2_event.EndPass(pass_id))
@@ -131,17 +149,42 @@ class SGD(object):
         data_nodes = self._topology._data_layers
         scope = self.__parameters__.scope
         test_prog = self._test_program  # forward-only snapshot, stable id
+        # the test program is a pre-minimize clone: metric vars live in it
+        # under the same names
+        metric_vars = [
+            test_prog.global_block().var(v.name)
+            for _, v in self._metric_fetches
+        ]
         costs, n = [], 0
+        metric_sums = [0.0] * len(metric_vars)
         for batch in reader():
             feed = _convert_feed(batch, data_nodes, feeding)
             with fluid.executor.scope_guard(scope):
-                (cost,) = self._exe.run(
-                    test_prog, feed=feed, fetch_list=[self._cost_var]
+                fetched = self._exe.run(
+                    test_prog, feed=feed,
+                    fetch_list=[test_prog.global_block().var(
+                        self._cost_var.name)] + metric_vars,
                 )
-            costs.append(float(np.ravel(cost)[0]) * len(batch))
+            costs.append(float(np.ravel(fetched[0])[0]) * len(batch))
+            for i, m in enumerate(fetched[1:]):
+                # scalar metrics average example-weighted; vector metrics
+                # (column_sum) accumulate element-wise
+                metric_sums[i] = metric_sums[i] + np.asarray(
+                    _metric_value(m)
+                ) * len(batch)
             n += len(batch)
         avg = sum(costs) / max(n, 1)
-        return v2_event.TestResult(evaluator={}, cost=avg)
+        evaluator = {}
+        for i, (name, _) in enumerate(self._metric_fetches):
+            val = np.asarray(metric_sums[i]) / max(n, 1)
+            evaluator[name] = float(val) if val.ndim == 0 else val
+        return v2_event.TestResult(evaluator=evaluator, cost=avg)
+
+    def _metric_payload(self, metrics):
+        return {
+            name: _metric_value(m)
+            for (name, _), m in zip(self._metric_fetches, metrics)
+        }
 
     def save_parameter_to_tar(self, f):
         self.__parameters__.to_tar(f)
