@@ -1,4 +1,4 @@
-"""Durable training checkpoints: CRC-checked, atomic.
+"""Durable training checkpoints: CRC-checked, atomic, shard-aware.
 
 Go pserver parity (go/pserver/service.go:120-226,346): state is written
 with CRC32 sidecars and the metadata commit is one atomic rename, so a
@@ -6,36 +6,70 @@ half-written checkpoint is never visible and a corrupt shard is rejected
 at load. Serves the Fluid save/load_persistables job (fluid/io.py) with
 optimizer state included — resume is exact.
 
-Multi-host: each process writes its own data files and its own
-`checkpoint.meta.p<idx>.json`, and loads only those back. Arrays must be
-fully addressable from their saving process (single-controller or
-per-host-replicated state); saving partially-addressable sharded arrays
-shard-by-shard is future work.
+Multi-host/sharded (round 2): partially-addressable jax.Arrays (tensor-
+parallel weights, FSDP-sharded optimizer state spanning processes) are
+saved shard-by-shard — each process writes only the shards it owns
+(replica 0 of each), with the global index of every shard recorded in its
+per-process meta. Loading merges ALL process metas found in the
+directory and reassembles each entry's global value, so a checkpoint
+taken on N processes restores on ANY process count — the elastic
+resize-on-resume the reference's Go stack gets from etcd-coordinated
+pserver shards (go/pserver/etcd_client.go:70-150).
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import zlib
 
 import jax
 import numpy as np
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step"]
 
-def _meta_name() -> str:
-    return "checkpoint.meta.p%d.json" % jax.process_index()
+
+def _meta_name(pidx=None) -> str:
+    return "checkpoint.meta.p%d.json" % (
+        jax.process_index() if pidx is None else pidx
+    )
 
 
 def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
+def _fname(name: str, pidx: int, shard: int = None) -> str:
+    base = name.replace("/", "__")
+    if shard is None:
+        return "%s.p%d.npy" % (base, pidx)
+    return "%s.p%d.s%d.npy" % (base, pidx, shard)
+
+
+def _atomic_save(dirname: str, fname: str, arr: np.ndarray):
+    tmp = os.path.join(dirname, fname + ".tmp")
+    with open(tmp, "wb") as fh:  # np.save(path) would append ".npy"
+        np.save(fh, np.ascontiguousarray(arr))
+    os.replace(tmp, os.path.join(dirname, fname))
+
+
+def _index_to_json(index, shape):
+    """A shard's global index (tuple of slices) -> [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
 def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None):
     """Write every scope entry (params + optimizer state + BN stats) to
     `dirname`. Safe against interruption: data files land first, then the
-    meta file commits the checkpoint with one atomic rename."""
+    meta file commits the checkpoint with one atomic rename. Sharded
+    arrays: this process saves only its owned (replica-0) shards."""
     os.makedirs(dirname, exist_ok=True)
     pidx = jax.process_index()
     entries = {}
@@ -43,21 +77,57 @@ def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None):
         val = scope.get(name)
         if val is None:
             continue
-        arr = np.asarray(val)
-        fname = "%s.p%d.npy" % (name.replace("/", "__"), pidx)
-        tmp = os.path.join(dirname, fname + ".tmp")
-        with open(tmp, "wb") as fh:  # np.save(path) would append ".npy"
-            np.save(fh, arr)
-        os.replace(tmp, os.path.join(dirname, fname))
-        entries[name] = {
-            "file": fname,
-            "crc32": _crc(arr),
-            "shape": list(arr.shape),
-            "dtype": str(arr.dtype),
-        }
+        if isinstance(val, jax.Array) and not val.is_fully_replicated:
+            # genuinely sharded (TP / FSDP): write shard-by-shard — the
+            # same path whether the shards span processes or not, and no
+            # full-array materialisation for big weights
+            shards_meta = []
+            for k, shard in enumerate(val.addressable_shards):
+                if shard.replica_id != 0:
+                    continue  # another device holds this same shard
+                arr = np.asarray(shard.data)
+                fname = _fname(name, pidx, k)
+                _atomic_save(dirname, fname, arr)
+                shards_meta.append(
+                    {
+                        "file": fname,
+                        "crc32": _crc(arr),
+                        "index": _index_to_json(shard.index, val.shape),
+                    }
+                )
+            if shards_meta:
+                entries[name] = {
+                    "sharded": True,
+                    "global_shape": list(val.shape),
+                    "dtype": str(val.dtype),
+                    "shards": shards_meta,
+                }
+        elif isinstance(val, jax.Array) and not val.is_fully_addressable:
+            # fully replicated across processes: process 0 writes it once
+            if pidx == 0:
+                arr = np.asarray(val)
+                fname = _fname(name, pidx)
+                _atomic_save(dirname, fname, arr)
+                entries[name] = {
+                    "file": fname,
+                    "crc32": _crc(arr),
+                    "shape": list(arr.shape),
+                    "dtype": str(arr.dtype),
+                }
+        else:
+            arr = np.asarray(val)
+            fname = _fname(name, pidx)
+            _atomic_save(dirname, fname, arr)
+            entries[name] = {
+                "file": fname,
+                "crc32": _crc(arr),
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+            }
     meta = {
         "step": int(step),
         "process": pidx,
+        "process_count": jax.process_count(),
         "entries": entries,
         "extra": extra or {},
     }
@@ -68,22 +138,133 @@ def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None):
     return meta
 
 
+def _all_metas(dirname: str):
+    metas = []
+    for path in sorted(glob.glob(os.path.join(dirname, "checkpoint.meta.p*.json"))):
+        m = re.search(r"checkpoint\.meta\.p(\d+)\.json$", path)
+        if not m:
+            continue
+        with open(path) as f:
+            metas.append(json.load(f))
+    return metas
+
+
+def latest_step(dirname: str):
+    """Highest step committed across all process metas, or None."""
+    metas = _all_metas(dirname)
+    return max((m["step"] for m in metas), default=None)
+
+
+def _load_entry(dirname: str, name: str, ent: dict, strict: bool):
+    if ent.get("sharded"):
+        out = np.zeros(ent["global_shape"], ent["dtype"])
+        covered = np.zeros(ent["global_shape"], bool)
+        for sh in ent["shards"]:
+            path = os.path.join(dirname, sh["file"])
+            if not os.path.exists(path):
+                if strict:
+                    raise FileNotFoundError(path)
+                return None
+            arr = np.load(path)
+            if _crc(arr) != sh["crc32"]:
+                raise IOError(
+                    "checkpoint shard %r failed its CRC check (%s)"
+                    % (name, path)
+                )
+            idx = tuple(slice(a, b) for a, b in sh["index"])
+            out[idx] = arr
+            covered[idx] = True
+        if not covered.all():
+            # a writer's meta is missing (non-shared filesystem, lost
+            # file): silent zero-filled regions would be the worst kind
+            # of corruption
+            raise IOError(
+                "checkpoint entry %r is only partially covered by the "
+                "shards on disk (%d of %d elements); a process's shard "
+                "files/meta are missing from %s"
+                % (name, int(covered.sum()), covered.size, dirname)
+            )
+        return out
+    path = os.path.join(dirname, ent["file"])
+    if not os.path.exists(path):
+        if strict:
+            raise FileNotFoundError(path)
+        return None
+    arr = np.load(path)
+    if _crc(arr) != ent["crc32"]:
+        raise IOError(
+            "checkpoint entry %r failed its CRC check (corrupt file %s)"
+            % (name, path)
+        )
+    return arr
+
+
 def load_checkpoint(scope, dirname: str, strict: bool = True) -> dict:
     """Restore a checkpoint into `scope`, verifying every CRC (reference
-    LoadCheckpoint rejects corrupt shards). Returns the meta dict."""
-    with open(os.path.join(dirname, _meta_name())) as f:
-        meta = json.load(f)
-    for name, ent in meta["entries"].items():
-        path = os.path.join(dirname, ent["file"])
-        if not os.path.exists(path):
-            if strict:
-                raise FileNotFoundError(path)
-            continue
-        arr = np.load(path)
-        if _crc(arr) != ent["crc32"]:
-            raise IOError(
-                "checkpoint entry %r failed its CRC check (corrupt file %s)"
-                % (name, path)
-            )
-        scope.set(name, arr)
-    return meta
+    LoadCheckpoint rejects corrupt shards).
+
+    Merges ALL per-process metas in the directory: a sharded entry is
+    reassembled from every process's shard files (requires a shared or
+    gathered filesystem, as the reference's save_dir does). Entries are
+    restored as host numpy values; the executor re-places them onto the
+    current mesh/shardings at the next run — so a checkpoint written on N
+    processes restores on any process count. Returns the merged meta
+    (step = max across processes; entries = union)."""
+    metas = _all_metas(dirname)
+    if not metas:
+        raise FileNotFoundError(
+            "no checkpoint meta found under %s" % dirname
+        )
+    # only metas from the LATEST committed step participate: a resume on
+    # fewer processes overwrites only its own meta files, and mixing a
+    # stale process's older-step meta in would restore stale shard data
+    latest = max(m["step"] for m in metas)
+    metas = [m for m in metas if m["step"] == latest]
+    expected = max(m.get("process_count", 1) for m in metas)
+    if strict and len(metas) < expected:
+        raise IOError(
+            "checkpoint at step %d was written by %d processes but only "
+            "%d meta file(s) are present under %s (incomplete copy?)"
+            % (latest, expected, len(metas), dirname)
+        )
+    merged = {
+        "step": latest,
+        "extra": {},
+        "entries": {},
+    }
+    partial = {}  # sharded entries may span processes: merge shard lists
+    for m in metas:
+        merged["extra"].update(m.get("extra") or {})
+        for name, ent in m["entries"].items():
+            if ent.get("sharded"):
+                agg = partial.setdefault(
+                    name,
+                    {
+                        "sharded": True,
+                        "global_shape": ent["global_shape"],
+                        "dtype": ent["dtype"],
+                        "shards": [],
+                    },
+                )
+                if not agg.get("sharded"):
+                    raise IOError(
+                        "checkpoint entry %r is sharded in one process "
+                        "meta and whole in another — corrupt checkpoint "
+                        "directory" % name
+                    )
+                agg["shards"].extend(ent["shards"])
+            else:
+                prev = partial.get(name)
+                if prev is not None and prev.get("sharded"):
+                    raise IOError(
+                        "checkpoint entry %r is sharded in one process "
+                        "meta and whole in another — corrupt checkpoint "
+                        "directory" % name
+                    )
+                partial[name] = ent
+    for name, ent in partial.items():
+        val = _load_entry(dirname, name, ent, strict)
+        if val is not None:
+            scope.set(name, val)
+            merged["entries"][name] = ent
+    return merged

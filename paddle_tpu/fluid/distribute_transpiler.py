@@ -14,6 +14,26 @@ mesh. The API is kept so reference scripts run:
 
 get_pserver_program returns an empty program — there is no pserver role
 to play; running it is a no-op so pserver-branch scripts exit cleanly.
+
+Multi-PROCESS (DCN) training: call
+`paddle_tpu.parallel.DistributedContext.initialize(...)` in every process
+(TPU pods autodetect; explicit coordinator/num_processes/process_id
+elsewhere), build one global mesh over jax.devices(), and feed each
+process its local batch shard — the executor assembles the global batch
+(executor._globalize_feeds) and XLA SPMD runs one step across the pod.
+tests/test_multihost.py proves train/checkpoint/kill/resume parity with
+the reference multi-node axis (RemoteParameterUpdater.h:55,
+go/pserver/service.go:120-226).
+
+ASYNC SGD stance (reference ParameterServer2.h:127-139 AsyncSGD,
+go/pserver/service.go:285 per-gradient async updates): NOT implemented,
+by design. Async parameter updates exist to hide straggler/network
+latency on loosely-coupled GPU clusters; on a TPU pod the SPMD step is
+globally synchronous by construction (ICI collectives are part of the
+compiled program) and stragglers do not exist at the software level —
+sync data parallelism strictly dominates. `transpile(sync_mode=False)`
+is accepted for script compatibility and warns that it runs
+synchronously with identical convergence-or-better semantics.
 """
 
 from __future__ import annotations
@@ -32,11 +52,17 @@ class DistributeTranspiler(object):
         self._trainers = 1
 
     def transpile(self, trainer_id=0, program=None, pservers="127.0.0.1:6174",
-                  trainers=1, split_method=None, **kwargs):
+                  trainers=1, split_method=None, sync_mode=True, **kwargs):
         self._program = program or default_main_program()
         self._trainers = int(trainers)
         self._trainer_id = int(trainer_id)
         self._pservers = pservers.split(",") if isinstance(pservers, str) else list(pservers)
+        if not sync_mode:
+            warnings.warn(
+                "sync_mode=False (AsyncSGD) requested: TPU SPMD steps are "
+                "globally synchronous by construction; running sync with "
+                "identical global-batch semantics (see module docstring)"
+            )
 
     def get_trainer_program(self) -> Program:
         """The original program, to be run by an Executor holding a mesh

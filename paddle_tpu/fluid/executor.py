@@ -224,6 +224,13 @@ class Executor(object):
             else set()
         )
 
+        mesh = self._resolve_mesh()
+        if mesh is not None:
+            from ..parallel.mesh import spans_processes
+
+            if spans_processes(mesh):
+                feed_arrays = _globalize_feeds(mesh, feed_arrays, scanned)
+
         feed_sig = tuple(
             (n, tuple(a.shape), str(a.dtype)) for n, a in sorted(feed_arrays.items())
         )
@@ -234,7 +241,6 @@ class Executor(object):
         # labels) each pad tightly.
         seq_maxlen, seq_buckets = _lod_bucket(feed_arrays)
         persist_in = {n: scope.get(n) for n in persist_names if n in scope}
-        mesh = self._resolve_mesh()
         if mesh is not None:
             # place persistables on their target shardings up-front (no-op
             # when already placed; once after startup for TP params created
@@ -381,6 +387,61 @@ def _flatten_lod(lod):
     if len(lod) and isinstance(lod[0], (list, tuple, np.ndarray)):
         return [np.asarray(lv, np.int32) for lv in lod]
     return [np.asarray(lod, np.int32)]
+
+
+def _globalize_feeds(mesh, feed_arrays, scanned_feeds=()):
+    """Multi-controller (DCN) path: each process feeds its process-LOCAL
+    batch; assemble the global jax.Array per feed so the jitted SPMD step
+    sees one logical batch spanning the pod (replaces the reference's
+    per-trainer DataProvider split + pserver/NCCL aggregation —
+    RemoteParameterUpdater.h:55, distribute_transpiler.py:132).
+
+    Dense feeds shard their batch dim (axis 0, or axis 1 for scanned
+    multi-step feeds whose leading dim is [steps]) over the 'data' axis.
+    A non-divisible batch is an error, not a silent fallback — replicas
+    built from divergent per-process data would desynchronise training
+    undetectably. On a mesh with NO 'data' axis (pure TP/SP serving),
+    feeds replicate; every process must then feed identical values.
+    Ragged (LoD) feeds are not supported across processes — their
+    per-process shapes diverge, which would desynchronise the SPMD
+    trace."""
+    import jax as _jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    has_data = "data" in mesh.axis_names
+    n_data = mesh.shape.get("data", 1)
+    out = {}
+    for name, arr in feed_arrays.items():
+        if isinstance(arr, _jax.Array) and not arr.is_fully_addressable:
+            out[name] = arr  # caller already built a global array
+            continue
+        if "@" in name:
+            raise NotImplementedError(
+                "LoD/ragged feeds are not supported on a multi-process "
+                "mesh yet (feed %r); pad or bucket on the host first"
+                % name
+            )
+        arr = np.asarray(arr)
+        batch_axis = 1 if name in scanned_feeds else 0
+        if has_data and arr.ndim > batch_axis and arr.shape[batch_axis] > 0:
+            spec = [None] * arr.ndim
+            spec[batch_axis] = "data"
+            sharding = NamedSharding(mesh, PartitionSpec(*spec))
+        else:
+            sharding = NamedSharding(mesh, PartitionSpec())
+        try:
+            out[name] = _jax.make_array_from_process_local_data(sharding, arr)
+        except ValueError as e:
+            # NO silent replicate fallback: replicas assembled from
+            # divergent per-process batches would desynchronise training
+            # undetectably
+            raise ValueError(
+                "feed %r local shape %s does not shard over the mesh "
+                "'data' axis (%d-way, %d processes); pad the batch or "
+                "drop the remainder on the host: %s"
+                % (name, arr.shape, n_data, _jax.process_count(), e)
+            )
+    return out
 
 
 def _mesh_jit_kwargs(

@@ -651,12 +651,17 @@ static bool run_op(Model& m, const OpDesc& op) {
     Tensor& w = m.vars[op.in("W")];
     Tensor& ids = m.vars[op.in("Ids")];
     Tensor* o = named(m, op.out("Out"));
-    int64_t D = w.shape[1], n = ids.numel();
+    int64_t V = w.shape[0], D = w.shape[1], n = ids.numel();
     o->shape = {n, D};
     o->is_int = false;
     o->f.resize(n * D);
     for (int64_t k = 0; k < n; ++k) {
       int64_t id = ids.is_int ? ids.i[k] : (int64_t)ids.f[k];
+      if (id < 0 || id >= V) {  // external feeds are untrusted
+        m.error = "lookup_table id out of range: " + std::to_string(id) +
+                  " (vocab " + std::to_string(V) + ")";
+        return false;
+      }
       memcpy(&o->f[k * D], &w.f[id * D], D * sizeof(float));
     }
     return true;
@@ -693,6 +698,8 @@ static bool run_op(Model& m, const OpDesc& op) {
     Tensor* io = named(m, op.out("Indices"));
     int64_t k = (int64_t)op.attr_num("k", 1);
     int64_t C = x.shape.back(), R = x.numel() / C;
+    if (k > C) k = C;
+    if (k < 1) k = 1;
     vo->shape = {R, k};
     vo->is_int = false;
     vo->f.resize(R * k);

@@ -88,10 +88,53 @@ def shard_parameter(var, spec: PartitionSpec):
 
 class DistributedContext(object):
     """Process-level view of the distributed runtime (replaces the
-    reference's trainer_id/num_gradient_servers flags, Flags.cpp:60-65)."""
+    reference's trainer_id/num_gradient_servers flags, Flags.cpp:60-65,
+    and the multi-node bootstrap the reference does via PSERVERS /
+    TRAINING_ROLE env + etcd registration, notest_dist_fit_a_line.py:30-45
+    and go/pserver/etcd_client.go:70)."""
+
+    _initialized = False
 
     def __init__(self, mesh: Optional[Mesh] = None):
         self.mesh = mesh or get_default_mesh()
+
+    @classmethod
+    def initialize(
+        cls,
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+        local_device_ids: Optional[Sequence[int]] = None,
+    ):
+        """Join the multi-controller runtime (DCN): after this,
+        jax.devices() spans every process and one global Mesh covers the
+        pod — collectives ride ICI within a slice and DCN across.
+
+        Arguments mirror jax.distributed.initialize and fall back to its
+        env/cluster autodetection (TPU pods need no arguments at all; the
+        CPU test fixture passes explicit localhost coordinates the way the
+        reference's tests wired PSERVERS=127.0.0.1 endpoints).
+        Idempotent per process.
+        """
+        if cls._initialized:
+            return
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = int(num_processes)
+        if process_id is not None:
+            kwargs["process_id"] = int(process_id)
+        if local_device_ids is not None:
+            kwargs["local_device_ids"] = list(local_device_ids)
+        jax.distributed.initialize(**kwargs)
+        cls._initialized = True
+
+    @classmethod
+    def shutdown(cls):
+        if cls._initialized:
+            jax.distributed.shutdown()
+            cls._initialized = False
 
     @property
     def world_size(self) -> int:
@@ -108,3 +151,28 @@ class DistributedContext(object):
     @property
     def process_count(self) -> int:
         return jax.process_count()
+
+    # --- per-process data sharding (replaces per-trainer file lists /
+    # master task dispatch for the simple static case) ------------------
+    def shard_reader(self, reader):
+        """Wrap a v2-style reader so each process sees its 1/process_count
+        slice of the stream (round-robin by instance). The global batch
+        assembled by the executor is identical to single-process order-
+        stability aside."""
+        pidx, pcount = self.process_index, self.process_count
+
+        def _sharded():
+            for i, item in enumerate(reader()):
+                if i % pcount == pidx:
+                    yield item
+
+        return _sharded
+
+
+def spans_processes(mesh: Optional[Mesh]) -> bool:
+    """True when the mesh includes devices owned by other processes (the
+    executor must then assemble global arrays from process-local feeds)."""
+    if mesh is None:
+        return False
+    pidx = jax.process_index()
+    return any(d.process_index != pidx for d in mesh.devices.flat)

@@ -1,0 +1,188 @@
+"""Worker process for the multi-host (DCN) train/checkpoint/resume test.
+
+Launched by tests/test_multihost.py in three roles:
+
+  dist   — one of N coordinated processes (jax.distributed over
+           localhost, gloo CPU collectives): trains data-parallel with an
+           FSDP-sharded weight, checkpoints every step, then idles until
+           killed (the test SIGKILLs it mid-"pass").
+  resume — a FRESH single process: restores the merged sharded
+           checkpoint and continues training the same schedule.
+  oracle — a single process running the whole schedule start-to-finish;
+           dist+resume must reproduce its final weights.
+
+Must be runnable with env JAX_PLATFORMS=cpu and
+XLA_FLAGS=--xla_force_host_platform_device_count=<n> set at launch.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import numpy as np  # noqa: E402
+
+GLOBAL_BATCH = 64
+FEATURES = 16
+HIDDEN = 8
+LR = 0.05
+
+
+def build_model():
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[FEATURES], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=HIDDEN, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y)
+        )
+        fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+    return main, startup, loss
+
+
+def shard_fsdp(main):
+    """FSDP-style: the first fc weight's rows shard over the data axis —
+    on 2 processes the array is partially addressable from each, which is
+    exactly what the sharded checkpoint path must handle."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.mesh import shard_parameter
+
+    w = main.global_block().var("fc_0.w_0")
+    shard_parameter(w, P("data", None))
+
+
+def batch_for(step, lo=None, hi=None):
+    """Deterministic synthetic regression batch; [lo:hi) slice for a
+    process-local shard."""
+    rng = np.random.RandomState(1234 + step)
+    xs = rng.randn(GLOBAL_BATCH, FEATURES).astype(np.float32)
+    w_true = np.linspace(-1, 1, FEATURES, dtype=np.float32).reshape(-1, 1)
+    ys = (np.maximum(xs, 0) @ w_true[:FEATURES]).astype(np.float32)
+    if lo is None:
+        return xs, ys
+    return xs[lo:hi], ys[lo:hi]
+
+
+def train_steps(exe, main, loss, first, last, lo=None, hi=None, report=None):
+    import paddle_tpu.fluid as fluid
+
+    losses = []
+    for step in range(first, last):
+        xs, ys = batch_for(step, lo, hi)
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.ravel(lv)[0]))
+        if report:
+            report(step, losses[-1])
+    return losses
+
+
+def main():
+    role = sys.argv[1]
+    out_path = sys.argv[2]
+    ckpt_dir = sys.argv[3]
+
+    result = {"role": role, "losses": []}
+
+    if role == "dist":
+        port, pid, nproc, steps = sys.argv[4:8]
+        from paddle_tpu.parallel.mesh import DistributedContext
+
+        DistributedContext.initialize(
+            coordinator_address="localhost:%s" % port,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.parallel import make_mesh, set_default_mesh
+
+        mesh = make_mesh({"data": jax.device_count()})
+        set_default_mesh(mesh)
+        main_p, startup, loss = build_model()
+        shard_fsdp(main_p)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        ctx = DistributedContext(mesh)
+        per = GLOBAL_BATCH // ctx.process_count
+        lo, hi = int(pid) * per, (int(pid) + 1) * per
+        scope = fluid.global_scope()
+
+        def report(step, lv):
+            ckpt.save_checkpoint(scope, ckpt_dir, step=step)
+            result["losses"].append(lv)
+
+        train_steps(exe, main_p, loss, 0, int(steps), lo, hi, report)
+        # verify the weight really is partially addressable (the test's
+        # premise) before declaring success
+        w = scope.get("fc_0.w_0")
+        result["partially_addressable"] = bool(
+            isinstance(w, jax.Array) and not w.is_fully_addressable
+        )
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+        # idle until the harness kills us (simulates a preempted slice)
+        while True:
+            time.sleep(0.2)
+
+    elif role == "resume":
+        steps_done, total_steps = int(sys.argv[4]), int(sys.argv[5])
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.parallel import make_mesh, set_default_mesh
+
+        mesh = make_mesh({"data": jax.device_count()})
+        set_default_mesh(mesh)
+        main_p, startup, loss = build_model()
+        shard_fsdp(main_p)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)  # then clobbered by the checkpoint values
+        scope = fluid.global_scope()
+        meta = ckpt.load_checkpoint(scope, ckpt_dir)
+        result["resumed_step"] = meta["step"]
+        assert meta["step"] == steps_done - 1, meta["step"]
+        result["losses"] = train_steps(
+            exe, main_p, loss, steps_done, total_steps
+        )
+        result["final_w"] = np.asarray(scope.get("fc_0.w_0")).tolist()
+        result["final_b"] = np.asarray(scope.get("fc_1.b_0")).tolist()
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
+    elif role == "oracle":
+        total_steps = int(sys.argv[4])
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.parallel import make_mesh, set_default_mesh
+
+        mesh = make_mesh({"data": jax.device_count()})
+        set_default_mesh(mesh)
+        main_p, startup, loss = build_model()
+        shard_fsdp(main_p)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.global_scope()
+        result["losses"] = train_steps(exe, main_p, loss, 0, total_steps)
+        result["final_w"] = np.asarray(scope.get("fc_0.w_0")).tolist()
+        result["final_b"] = np.asarray(scope.get("fc_1.b_0")).tolist()
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
+    else:
+        raise SystemExit("unknown role %r" % role)
+
+
+if __name__ == "__main__":
+    main()

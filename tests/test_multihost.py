@@ -1,0 +1,184 @@
+"""Multi-host (DCN) execution + sharded checkpoint/resume.
+
+VERDICT r1 item 1 acceptance: a 2-process CPU fixture trains
+data-parallel across processes (jax.distributed + gloo collectives over
+localhost — the DCN stand-in), checkpoints partially-addressable sharded
+state every step, gets killed mid-"pass", and a fresh process resumes
+from the merged sharded checkpoint and reproduces the single-process
+oracle's final weights — matching the reference Go pserver
+checkpoint/recover semantics (go/pserver/service.go:120-226,346) and the
+multi-node trainer axis (RemoteParameterUpdater.h:55).
+
+These tests spawn their own subprocesses with their own XLA flags, so
+they are independent of the conftest's in-process 8-device mesh.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "distributed_worker.py")
+
+STEPS_BEFORE_KILL = 3
+TOTAL_STEPS = 6
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(args, devices):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=%d" % devices
+    return subprocess.Popen(
+        [sys.executable, WORKER] + [str(a) for a in args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _wait_file(path, proc_list, timeout=300):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if os.path.exists(path):
+            return True
+        for p in proc_list:
+            if p.poll() is not None and p.returncode != 0:
+                _, err = p.communicate()
+                raise AssertionError(
+                    "worker died (rc=%d):\n%s" % (p.returncode, err[-4000:])
+                )
+        time.sleep(0.25)
+    return False
+
+
+def test_two_process_train_kill_resume(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    port = _free_port()
+
+    # --- phase A: 2 coordinated processes, 4 virtual devices each ------
+    outs = [str(tmp_path / ("dist_p%d.json" % i)) for i in range(2)]
+    procs = [
+        _spawn(
+            ["dist", outs[i], ckpt_dir, port, i, 2, STEPS_BEFORE_KILL],
+            devices=4,
+        )
+        for i in range(2)
+    ]
+    try:
+        for o in outs:
+            assert _wait_file(o, procs), "dist worker never reported"
+        results = [json.load(open(o)) for o in outs]
+        # both processes observed the SAME global loss sequence (proof the
+        # step really is one SPMD computation over both processes)
+        np.testing.assert_allclose(
+            results[0]["losses"], results[1]["losses"], rtol=1e-5
+        )
+        assert results[0]["partially_addressable"], (
+            "fc_0.w_0 was fully addressable — the sharded-checkpoint path "
+            "was not exercised"
+        )
+    finally:
+        # the "preemption": SIGKILL, no goodbye
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait()
+
+    # sharded checkpoint files from BOTH processes exist
+    metas = [f for f in os.listdir(ckpt_dir) if f.startswith("checkpoint.meta")]
+    assert sorted(metas) == [
+        "checkpoint.meta.p0.json", "checkpoint.meta.p1.json",
+    ]
+    shard_files = [f for f in os.listdir(ckpt_dir) if ".s" in f]
+    assert any(".p0.s" in f for f in shard_files)
+    assert any(".p1.s" in f for f in shard_files)
+
+    # --- phase B: fresh single process resumes from the merged ckpt ----
+    resume_out = str(tmp_path / "resume.json")
+    p = _spawn(
+        ["resume", resume_out, ckpt_dir, STEPS_BEFORE_KILL, TOTAL_STEPS],
+        devices=8,
+    )
+    rc = p.wait(timeout=600)
+    _, err = p.communicate()
+    assert rc == 0, err[-4000:]
+    resume = json.load(open(resume_out))
+    assert resume["resumed_step"] == STEPS_BEFORE_KILL - 1
+
+    # --- oracle: single process, full schedule -------------------------
+    oracle_out = str(tmp_path / "oracle.json")
+    p = _spawn(["oracle", oracle_out, ckpt_dir, TOTAL_STEPS], devices=8)
+    rc = p.wait(timeout=600)
+    _, err = p.communicate()
+    assert rc == 0, err[-4000:]
+    oracle = json.load(open(oracle_out))
+
+    # dist losses (steps 0..2) + resumed losses (steps 3..5) == oracle's
+    np.testing.assert_allclose(
+        results[0]["losses"] + resume["losses"], oracle["losses"],
+        rtol=1e-4, atol=1e-6,
+    )
+    # and the final weights match: the 2-process run + sharded checkpoint
+    # + topology-changing resume reproduced single-process training
+    np.testing.assert_allclose(
+        resume["final_w"], oracle["final_w"], rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        resume["final_b"], oracle["final_b"], rtol=1e-4, atol=1e-6
+    )
+
+
+def test_sharded_checkpoint_round_trip_in_process():
+    """Single-process slice of the checkpoint layer: sharded (per-device)
+    arrays save shard-by-shard and reassemble exactly, and CRC corruption
+    is detected."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.parallel import make_mesh
+
+    import tempfile
+
+    mesh = make_mesh({"data": 8})
+    scope = fluid.executor.Scope()
+    w = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    sharded = jax.device_put(w, NamedSharding(mesh, P("data", None)))
+    scope.set("w", sharded)
+    scope.set("step_scalar", np.float32(7.0))
+
+    d = tempfile.mkdtemp()
+    meta = ckpt.save_checkpoint(scope, d, step=11)
+    assert meta["entries"]["w"]["sharded"] is True
+    assert len(meta["entries"]["w"]["shards"]) == 8
+    assert ckpt.latest_step(d) == 11
+
+    scope2 = fluid.executor.Scope()
+    got = ckpt.load_checkpoint(scope2, d)
+    assert got["step"] == 11
+    np.testing.assert_array_equal(np.asarray(scope2.get("w")), w)
+
+    # corrupt one shard -> load must fail its CRC
+    shard_file = meta["entries"]["w"]["shards"][0]["file"]
+    path = os.path.join(d, shard_file)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:-4] + b"\x00\x00\x00\x01")
+    with pytest.raises((IOError, ValueError)):
+        ckpt.load_checkpoint(fluid.executor.Scope(), d)
