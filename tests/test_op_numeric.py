@@ -1,0 +1,812 @@
+"""Systematic per-op numeric test harness (VERDICT r1 item 3).
+
+Reference parity: python/paddle/v2/fluid/tests/op_test.py (~190
+test_*_op.py files) — every op's outputs compared against a numpy oracle
+and its analytic gradient against central finite differences
+(op_test.py:97,251,336, delta=0.005).
+
+One spec per op; `pytest -k <op>` runs one. Ops NOT covered here are in
+EXEMPT with the reason (random-mask ops, control flow with dedicated
+tests, assignment-style non-differentiable detection ops).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from op_harness import OpHarness
+
+R = np.random.RandomState
+
+
+def _softmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------------------------------------------------------------
+# spec table: op -> dict(
+#   ins={slot: value|list}, attrs={}, outs=[slots], lods={var: offsets},
+#   oracle=fn(ins, attrs)->{slot: expected}, grad=[slots] or True,
+#   loss=[slots], tol=(rtol, atol), gtol=(rtol, atol), n_outs={slot: n})
+# ---------------------------------------------------------------------
+SPECS = {}
+
+
+def spec(name, **kw):
+    SPECS[name] = kw
+
+
+# --- elementwise ------------------------------------------------------
+_x34 = R(0).uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+_y34 = R(1).uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+
+spec("elementwise_add", ins={"X": _x34, "Y": _y34}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"] + i["Y"]})
+spec("elementwise_sub", ins={"X": _x34, "Y": _y34}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"] - i["Y"]})
+spec("elementwise_mul", ins={"X": _x34, "Y": _y34}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"] * i["Y"]})
+spec("elementwise_div", ins={"X": _x34, "Y": _y34}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"] / i["Y"]})
+spec("elementwise_max",
+     ins={"X": _x34, "Y": _y34 + 0.05}, grad=True,
+     oracle=lambda i, a: {"Out": np.maximum(i["X"], i["Y"])})
+spec("elementwise_min",
+     ins={"X": _x34, "Y": _y34 + 0.05}, grad=True,
+     oracle=lambda i, a: {"Out": np.minimum(i["X"], i["Y"])})
+spec("elementwise_pow", ins={"X": _x34, "Y": _y34}, grad=True,
+     gtol=(8e-2, 1e-3),
+     oracle=lambda i, a: {"Out": np.power(i["X"], i["Y"])})
+
+# broadcast with axis (bias-add pattern)
+spec("elementwise_add_bcast", op="elementwise_add",
+     ins={"X": R(2).randn(2, 3, 4).astype(np.float32),
+          "Y": R(3).randn(3).astype(np.float32)},
+     attrs={"axis": 1}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"] + i["Y"].reshape(1, 3, 1)})
+
+# --- comparison / logical (forward only, no grads) --------------------
+_xi = R(4).randint(0, 3, (3, 4)).astype(np.float32)
+_yi = R(5).randint(0, 3, (3, 4)).astype(np.float32)
+for _op, _fn in [
+    ("less_than", np.less), ("less_equal", np.less_equal),
+    ("greater_than", np.greater), ("greater_equal", np.greater_equal),
+    ("equal", np.equal), ("not_equal", np.not_equal),
+]:
+    spec(_op, ins={"X": _xi, "Y": _yi},
+         oracle=(lambda f: lambda i, a: {"Out": f(i["X"], i["Y"])})(_fn))
+
+_b1 = (R(6).rand(3, 4) > 0.5).astype(np.float32)
+_b2 = (R(7).rand(3, 4) > 0.5).astype(np.float32)
+spec("logical_and", ins={"X": _b1, "Y": _b2},
+     oracle=lambda i, a: {"Out": np.logical_and(i["X"], i["Y"])})
+spec("logical_or", ins={"X": _b1, "Y": _b2},
+     oracle=lambda i, a: {"Out": np.logical_or(i["X"], i["Y"])})
+spec("logical_xor", ins={"X": _b1, "Y": _b2},
+     oracle=lambda i, a: {"Out": np.logical_xor(i["X"], i["Y"])})
+spec("logical_not", ins={"X": _b1},
+     oracle=lambda i, a: {"Out": np.logical_not(i["X"])})
+
+# --- matmul family ----------------------------------------------------
+spec("mul", ins={"X": R(8).randn(3, 4).astype(np.float32),
+                 "Y": R(9).randn(4, 5).astype(np.float32)},
+     grad=True, oracle=lambda i, a: {"Out": i["X"] @ i["Y"]})
+spec("mul_ncd", op="mul",
+     ins={"X": R(10).randn(2, 3, 4).astype(np.float32),
+          "Y": R(11).randn(4, 5).astype(np.float32)},
+     attrs={"x_num_col_dims": 2}, grad=True,
+     oracle=lambda i, a: {
+         "Out": (i["X"].reshape(6, 4) @ i["Y"]).reshape(6, 5)})
+spec("matmul", ins={"X": R(12).randn(3, 4).astype(np.float32),
+                    "Y": R(13).randn(4, 5).astype(np.float32)},
+     grad=True, oracle=lambda i, a: {"Out": i["X"] @ i["Y"]})
+spec("matmul_t", op="matmul",
+     ins={"X": R(14).randn(4, 3).astype(np.float32),
+          "Y": R(15).randn(5, 4).astype(np.float32)},
+     attrs={"transpose_X": True, "transpose_Y": True}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"].T @ i["Y"].T})
+spec("sum", ins={"X": [R(16).randn(3, 4).astype(np.float32),
+                       R(17).randn(3, 4).astype(np.float32),
+                       R(18).randn(3, 4).astype(np.float32)]},
+     grad=True,
+     oracle=lambda i, a: {"Out": i["X"][0] + i["X"][1] + i["X"][2]})
+spec("scale", ins={"X": _x34}, attrs={"scale": 2.5, "bias": 0.5},
+     grad=True, oracle=lambda i, a: {"Out": 2.5 * i["X"] + 0.5})
+spec("mean", ins={"X": _x34}, grad=True,
+     oracle=lambda i, a: {"Out": np.mean(i["X"]).reshape(1)})
+
+# --- reductions -------------------------------------------------------
+spec("reduce_sum", ins={"X": _x34}, attrs={"dim": 1, "keep_dim": False},
+     grad=True, oracle=lambda i, a: {"Out": i["X"].sum(axis=1)})
+spec("reduce_mean", ins={"X": _x34}, attrs={"dim": 0, "keep_dim": True},
+     grad=True,
+     oracle=lambda i, a: {"Out": i["X"].mean(axis=0, keepdims=True)})
+spec("reduce_max", ins={"X": _x34 + np.arange(12).reshape(3, 4) * 0.1},
+     attrs={"dim": 1}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"].max(axis=1)})
+spec("reduce_min", ins={"X": _x34 + np.arange(12).reshape(3, 4) * 0.1},
+     attrs={"dim": 1}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"].min(axis=1)})
+spec("reduce_prod", ins={"X": _x34}, attrs={"dim": 1}, grad=True,
+     gtol=(8e-2, 1e-3),
+     oracle=lambda i, a: {"Out": i["X"].prod(axis=1)})
+
+# --- unary math -------------------------------------------------------
+_pos = R(20).uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+_any = R(21).uniform(-2.0, 2.0, (3, 4)).astype(np.float32)
+_off = _any + np.where(np.abs(_any) < 0.3, 0.5, 0.0)  # away from kinks
+
+spec("square", ins={"X": _any}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"] ** 2})
+spec("sqrt", ins={"X": _pos}, grad=True,
+     oracle=lambda i, a: {"Out": np.sqrt(i["X"])})
+spec("rsqrt", ins={"X": _pos}, grad=True,
+     oracle=lambda i, a: {"Out": 1.0 / np.sqrt(i["X"])})
+spec("exp", ins={"X": _any}, grad=True,
+     oracle=lambda i, a: {"Out": np.exp(i["X"])})
+spec("log", ins={"X": _pos}, grad=True,
+     oracle=lambda i, a: {"Out": np.log(i["X"])})
+spec("abs", ins={"X": _off}, grad=True,
+     oracle=lambda i, a: {"Out": np.abs(i["X"])})
+spec("sin", ins={"X": _any}, grad=True,
+     oracle=lambda i, a: {"Out": np.sin(i["X"])})
+spec("cos", ins={"X": _any}, grad=True,
+     oracle=lambda i, a: {"Out": np.cos(i["X"])})
+spec("reciprocal", ins={"X": _pos}, grad=True,
+     oracle=lambda i, a: {"Out": 1.0 / i["X"]})
+spec("pow", ins={"X": _pos}, attrs={"factor": 2.5}, grad=True,
+     oracle=lambda i, a: {"Out": np.power(i["X"], 2.5)})
+spec("sign", ins={"X": _off},
+     oracle=lambda i, a: {"Out": np.sign(i["X"])})
+spec("ceil", ins={"X": _off + 0.01},
+     oracle=lambda i, a: {"Out": np.ceil(i["X"])})
+spec("floor", ins={"X": _off + 0.01},
+     oracle=lambda i, a: {"Out": np.floor(i["X"])})
+spec("round", ins={"X": _off + 0.01},
+     oracle=lambda i, a: {"Out": np.round(i["X"])})
+spec("isfinite", ins={"X": np.array([[1.0, np.inf], [np.nan, 2.0]],
+                                    np.float32)},
+     oracle=lambda i, a: {"Out": np.array(0.0)}, tol=(0, 0.5))
+spec("clip", ins={"X": _any}, attrs={"min": -1.0, "max": 1.0},
+     grad=["X"],
+     oracle=lambda i, a: {"Out": np.clip(i["X"], -1.0, 1.0)})
+spec("clip_by_norm", ins={"X": _x34}, attrs={"max_norm": 1.0},
+     grad=True,
+     oracle=lambda i, a: {
+         "Out": i["X"] * min(1.0, 1.0 / np.linalg.norm(i["X"]))})
+spec("squared_l2_norm", ins={"X": _x34}, grad=True,
+     oracle=lambda i, a: {"Out": (i["X"] ** 2).sum().reshape(1)})
+spec("squared_l2_distance",
+     ins={"X": _x34, "Y": _y34}, grad=True, loss=["Out"],
+     oracle=lambda i, a: {
+         "Out": ((i["X"] - i["Y"]) ** 2).sum(axis=1, keepdims=True)})
+spec("cos_sim", ins={"X": _x34, "Y": _y34}, grad=True, loss=["Out"],
+     outs=["Out", "XNorm", "YNorm"],
+     oracle=lambda i, a: {"Out": (
+         (i["X"] * i["Y"]).sum(1)
+         / np.linalg.norm(i["X"], axis=1)
+         / np.linalg.norm(i["Y"], axis=1)).reshape(-1, 1)})
+spec("increment", ins={"X": np.array([3.0], np.float32)},
+     attrs={"step": 2.0},
+     oracle=lambda i, a: {"Out": i["X"] + 2.0})
+spec("cast", ins={"X": _x34}, attrs={"out_dtype": "int32"},
+     oracle=lambda i, a: {"Out": i["X"].astype(np.int32)})
+spec("maxout", ins={"X": R(22).randn(2, 6, 4, 4).astype(np.float32)},
+     attrs={"groups": 3}, grad=True,
+     oracle=lambda i, a: {
+         "Out": i["X"].reshape(2, 2, 3, 4, 4).max(axis=2)})
+spec("l2_normalize", ins={"X": _x34}, attrs={"axis": 1}, grad=True,
+     outs=["Out", "Norm"], loss=["Out"],
+     oracle=lambda i, a: {
+         "Out": i["X"] / np.linalg.norm(i["X"], axis=1, keepdims=True)})
+
+# --- activations ------------------------------------------------------
+def _act_spec(name, fn, x=None, grad=True, **kw):
+    spec(name, ins={"X": x if x is not None else _off}, grad=grad,
+         oracle=(lambda f: lambda i, a: {"Out": f(i["X"])})(fn), **kw)
+
+
+_act_spec("relu", lambda x: np.maximum(x, 0))
+_act_spec("sigmoid", lambda x: 1 / (1 + np.exp(-x)))
+_act_spec("tanh", np.tanh)
+_act_spec("softsign", lambda x: x / (1 + np.abs(x)))
+_act_spec("softplus", lambda x: np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0))
+_act_spec("relu6", lambda x: np.clip(x, 0, 6))
+_act_spec("gelu", lambda x: 0.5 * x * (1 + np.vectorize(np.math.erf)(x / np.sqrt(2))),
+          tol=(1e-3, 1e-4))
+_act_spec("elu", lambda x: np.where(x > 0, x, np.exp(x) - 1))
+_act_spec("silu", lambda x: x / (1 + np.exp(-x)))
+_act_spec("logsigmoid", lambda x: -np.log1p(np.exp(-np.abs(x))) + np.minimum(x, 0))
+_act_spec("tanh_shrink", lambda x: x - np.tanh(x))
+_act_spec("softshrink", lambda x: np.where(x > 0.5, x - 0.5,
+                                           np.where(x < -0.5, x + 0.5, 0)),
+          x=_off)
+_act_spec("hard_shrink", lambda x: np.where(np.abs(x) > 0.5, x, 0), x=_off)
+_act_spec("thresholded_relu", lambda x: np.where(x > 1.0, x, 0), x=_off)
+_act_spec("hard_sigmoid", lambda x: np.clip(0.2 * x + 0.5, 0, 1), x=_off)
+_act_spec("leaky_relu", lambda x: np.where(x > 0, x, 0.02 * x), x=_off)
+_act_spec("brelu", lambda x: np.clip(x, 0.0, 24.0), x=_pos)
+_act_spec("stanh", lambda x: 1.7159 * np.tanh(0.66667 * x))
+_act_spec("swish", lambda x: x / (1 + np.exp(-x)))
+spec("prelu", ins={"X": _off, "Alpha": np.array([0.25], np.float32)},
+     grad=True,
+     oracle=lambda i, a: {"Out": np.where(i["X"] > 0, i["X"],
+                                          0.25 * i["X"])})
+spec("softmax", ins={"X": _any}, grad=True,
+     oracle=lambda i, a: {"Out": _softmax(i["X"])})
+spec("log_softmax", ins={"X": _any}, grad=True,
+     oracle=lambda i, a: {"Out": np.log(_softmax(i["X"]))})
+
+# --- losses -----------------------------------------------------------
+_logits = R(30).randn(4, 5).astype(np.float32)
+_plabel = np.ascontiguousarray(
+    R(31).randint(0, 5, (4, 1)).astype(np.int64))
+_soft = _softmax(R(32).randn(4, 5).astype(np.float32))
+_probs = _softmax(_logits)
+
+spec("cross_entropy", ins={"X": _probs, "Label": _plabel},
+     outs=["Y"], grad=["X"], loss=["Y"],
+     oracle=lambda i, a: {"Y": -np.log(
+         i["X"][np.arange(4), i["Label"].ravel()]).reshape(4, 1)})
+spec("cross_entropy_soft", op="cross_entropy",
+     ins={"X": _probs, "Label": _soft}, attrs={"soft_label": True},
+     outs=["Y"], grad=["X"], loss=["Y"],
+     oracle=lambda i, a: {
+         "Y": -(i["Label"] * np.log(i["X"])).sum(1, keepdims=True)})
+spec("softmax_with_cross_entropy",
+     ins={"Logits": _logits, "Label": _plabel},
+     outs=["Loss", "Softmax"], grad=["Logits"], loss=["Loss"],
+     oracle=lambda i, a: {
+         "Loss": -np.log(_softmax(i["Logits"])[
+             np.arange(4), i["Label"].ravel()]).reshape(4, 1),
+         "Softmax": _softmax(i["Logits"])})
+spec("sigmoid_cross_entropy_with_logits",
+     ins={"X": _logits, "Label": (R(33).rand(4, 5) > 0.5).astype(np.float32)},
+     grad=["X"],
+     oracle=lambda i, a: {"Out": np.maximum(i["X"], 0)
+                          - i["X"] * i["Label"]
+                          + np.log1p(np.exp(-np.abs(i["X"])))})
+spec("hinge_loss",
+     ins={"Logits": _off.reshape(12, 1),
+          "Labels": (R(34).rand(12, 1) > 0.5).astype(np.float32)},
+     outs=["Loss"], grad=["Logits"], loss=["Loss"],
+     oracle=lambda i, a: {"Loss": np.maximum(
+         0, 1 - (2 * i["Labels"] - 1) * i["Logits"])})
+spec("huber_loss", ins={"X": _x34[:, :1], "Y": _y34[:, :1] + 2.0},
+     attrs={"delta": 1.0}, outs=["Out", "Residual"], grad=["X"],
+     loss=["Out"],
+     oracle=lambda i, a: {"Out": np.where(
+         np.abs(i["Y"] - i["X"]) <= 1.0,
+         0.5 * (i["Y"] - i["X"]) ** 2,
+         np.abs(i["Y"] - i["X"]) - 0.5)})
+spec("log_loss",
+     ins={"Predicted": R(35).uniform(0.2, 0.8, (6, 1)).astype(np.float32),
+          "Labels": (R(36).rand(6, 1) > 0.5).astype(np.float32)},
+     attrs={"epsilon": 1e-4}, outs=["Loss"], grad=["Predicted"],
+     loss=["Loss"],
+     oracle=lambda i, a: {"Loss": -i["Labels"] * np.log(i["Predicted"] + 1e-4)
+                          - (1 - i["Labels"]) * np.log(1 - i["Predicted"] + 1e-4)})
+spec("smooth_l1_loss", ins={"X": _x34, "Y": _y34 + 1.5},
+     attrs={"sigma": 1.0}, outs=["Out", "Diff"], grad=["X"], loss=["Out"],
+     oracle=lambda i, a: {"Out": np.where(
+         np.abs(i["X"] - i["Y"]) < 1.0,
+         0.5 * (i["X"] - i["Y"]) ** 2,
+         np.abs(i["X"] - i["Y"]) - 0.5).sum(1, keepdims=True)})
+spec("margin_rank_loss",
+     ins={"X1": _x34[:, :1], "X2": _y34[:, :1],
+          "Label": np.sign(R(37).randn(3, 1)).astype(np.float32)},
+     attrs={"margin": 0.1}, outs=["Out"], grad=["X1", "X2"], loss=["Out"],
+     oracle=lambda i, a: {"Out": np.maximum(
+         0, -i["Label"] * (i["X1"] - i["X2"]) + 0.1)})
+spec("rank_loss",
+     ins={"Left": _x34[:, :1], "Right": _y34[:, :1],
+          "Label": (R(38).rand(3, 1) > 0.5).astype(np.float32)},
+     grad=["Left", "Right"],
+     oracle=lambda i, a: {"Out": np.log1p(np.exp(i["Left"] - i["Right"]))
+                          - i["Label"] * (i["Left"] - i["Right"])})
+
+# --- conv / pool / norm ----------------------------------------------
+def _np_conv2d(x, w, stride=1, pad=0):
+    N, C, H, W = x.shape
+    M, _, KH, KW = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    OH = (H + 2 * pad - KH) // stride + 1
+    OW = (W + 2 * pad - KW) // stride + 1
+    out = np.zeros((N, M, OH, OW), np.float64)
+    for n in range(N):
+        for m in range(M):
+            for oh in range(OH):
+                for ow in range(OW):
+                    patch = xp[n, :, oh * stride:oh * stride + KH,
+                               ow * stride:ow * stride + KW]
+                    out[n, m] [oh, ow] = (patch * w[m]).sum()
+    return out
+
+
+spec("conv2d",
+     ins={"Input": R(40).randn(2, 3, 5, 5).astype(np.float32),
+          "Filter": R(41).randn(4, 3, 3, 3).astype(np.float32)},
+     attrs={"strides": [1, 1], "paddings": [1, 1], "groups": 1,
+            "dilations": [1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"],
+     tol=(1e-3, 1e-4),
+     oracle=lambda i, a: {"Output": _np_conv2d(i["Input"], i["Filter"],
+                                               stride=1, pad=1)})
+spec("depthwise_conv2d",
+     ins={"Input": R(42).randn(2, 3, 5, 5).astype(np.float32),
+          "Filter": R(43).randn(3, 1, 3, 3).astype(np.float32)},
+     attrs={"strides": [1, 1], "paddings": [0, 0], "groups": 3,
+            "dilations": [1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"])
+spec("conv2d_transpose",
+     ins={"Input": R(44).randn(2, 3, 4, 4).astype(np.float32),
+          "Filter": R(45).randn(3, 2, 3, 3).astype(np.float32)},
+     attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"])
+spec("conv3d",
+     ins={"Input": R(46).randn(1, 2, 4, 4, 4).astype(np.float32),
+          "Filter": R(47).randn(3, 2, 2, 2, 2).astype(np.float32)},
+     attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0], "groups": 1,
+            "dilations": [1, 1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"])
+spec("pool2d_max", op="pool2d",
+     ins={"X": R(48).randn(2, 2, 4, 4).astype(np.float32)},
+     attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0]},
+     grad=True,
+     oracle=lambda i, a: {"Out": i["X"].reshape(2, 2, 2, 2, 2, 2)
+                          .transpose(0, 1, 2, 4, 3, 5)
+                          .reshape(2, 2, 2, 2, 4).max(-1)})
+spec("pool2d_avg", op="pool2d",
+     ins={"X": R(49).randn(2, 2, 4, 4).astype(np.float32)},
+     attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0]},
+     grad=True,
+     oracle=lambda i, a: {"Out": i["X"].reshape(2, 2, 2, 2, 2, 2)
+                          .transpose(0, 1, 2, 4, 3, 5)
+                          .reshape(2, 2, 2, 2, 4).mean(-1)})
+spec("pool3d",
+     ins={"X": R(50).randn(1, 2, 4, 4, 4).astype(np.float32)},
+     attrs={"pooling_type": "avg", "ksize": [2, 2, 2],
+            "strides": [2, 2, 2], "paddings": [0, 0, 0]},
+     grad=True)
+spec("batch_norm",
+     ins={"X": R(51).randn(4, 3, 3, 3).astype(np.float32),
+          "Scale": R(52).uniform(0.5, 1.5, 3).astype(np.float32),
+          "Bias": R(53).randn(3).astype(np.float32),
+          "Mean": np.zeros(3, np.float32),
+          "Variance": np.ones(3, np.float32)},
+     attrs={"epsilon": 1e-5, "momentum": 0.9, "is_test": False},
+     outs=["Y"], grad=["X", "Scale", "Bias"], loss=["Y"],
+     gtol=(8e-2, 2e-3),
+     oracle=lambda i, a: {"Y": (
+         (i["X"] - i["X"].mean((0, 2, 3), keepdims=True))
+         / np.sqrt(i["X"].var((0, 2, 3), keepdims=True) + 1e-5)
+         * i["Scale"].reshape(1, 3, 1, 1) + i["Bias"].reshape(1, 3, 1, 1))})
+spec("layer_norm",
+     ins={"X": R(54).randn(4, 6).astype(np.float32),
+          "Scale": R(55).uniform(0.5, 1.5, 6).astype(np.float32),
+          "Bias": R(56).randn(6).astype(np.float32)},
+     attrs={"epsilon": 1e-5, "begin_norm_axis": 1},
+     outs=["Y"], grad=["X", "Scale", "Bias"], loss=["Y"],
+     oracle=lambda i, a: {"Y": (
+         (i["X"] - i["X"].mean(1, keepdims=True))
+         / np.sqrt(i["X"].var(1, keepdims=True) + 1e-5)
+         * i["Scale"] + i["Bias"])})
+spec("lrn", ins={"X": R(57).randn(2, 5, 3, 3).astype(np.float32)},
+     attrs={"n": 3, "alpha": 1e-4, "beta": 0.75, "k": 1.0},
+     outs=["Out"], grad=["X"], loss=["Out"])
+spec("dropout_infer", op="dropout",
+     ins={"X": _x34}, attrs={"dropout_prob": 0.35, "is_test": True},
+     outs=["Out"], loss=["Out"],
+     oracle=lambda i, a: {"Out": i["X"] * (1 - 0.35)})
+spec("row_conv",
+     ins={"X": R(58).randn(6, 4).astype(np.float32),
+          "Filter": R(59).randn(3, 4).astype(np.float32)},
+     lods={"row_conv_x_0": [0, 3, 6]},
+     grad=["X", "Filter"])
+spec("im2sequence",
+     ins={"X": R(60).randn(1, 2, 4, 4).astype(np.float32)},
+     attrs={"kernels": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0, 0, 0]},
+     grad=True)
+
+# --- tensor manipulation ---------------------------------------------
+spec("concat", ins={"X": [R(61).randn(2, 3).astype(np.float32),
+                          R(62).randn(2, 2).astype(np.float32)]},
+     attrs={"axis": 1}, grad=True,
+     oracle=lambda i, a: {"Out": np.concatenate(i["X"], axis=1)})
+spec("split", ins={"X": R(63).randn(2, 6).astype(np.float32)},
+     attrs={"axis": 1, "num": 3}, n_outs={"Out": 3}, grad=True,
+     oracle=lambda i, a: {"Out": [i["X"][:, :2], i["X"][:, 2:4],
+                                  i["X"][:, 4:]]})
+spec("reshape", ins={"X": _x34}, attrs={"shape": [2, 6]}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"].reshape(2, 6)})
+spec("squeeze", ins={"X": R(64).randn(3, 1, 4).astype(np.float32)},
+     attrs={"axes": [1]}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"].squeeze(1)})
+spec("unsqueeze", ins={"X": _x34}, attrs={"axes": [1]}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"][:, None, :]})
+spec("transpose", ins={"X": R(65).randn(2, 3, 4).astype(np.float32)},
+     attrs={"axis": [2, 0, 1]}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"].transpose(2, 0, 1)})
+spec("expand", ins={"X": R(66).randn(2, 1, 3).astype(np.float32)},
+     attrs={"expand_times": [1, 4, 1]}, grad=True,
+     oracle=lambda i, a: {"Out": np.tile(i["X"], (1, 4, 1))})
+spec("slice", ins={"Input": R(67).randn(4, 5).astype(np.float32)},
+     attrs={"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]},
+     grad=True,
+     oracle=lambda i, a: {"Out": i["Input"][1:3, 0:4]})
+spec("pad", ins={"X": _x34},
+     attrs={"paddings": [0, 1, 2, 0], "pad_value": 0.5}, grad=True,
+     oracle=lambda i, a: {"Out": np.pad(
+         i["X"], ((0, 1), (2, 0)), constant_values=0.5)})
+spec("crop", ins={"X": R(68).randn(4, 5).astype(np.float32)},
+     attrs={"offsets": [1, 1], "shape": [2, 3]}, grad=["X"],
+     oracle=lambda i, a: {"Out": i["X"][1:3, 1:4]})
+spec("gather", ins={"X": R(69).randn(5, 3).astype(np.float32),
+                    "Index": np.array([0, 2, 4], np.int64)},
+     grad=["X"],
+     oracle=lambda i, a: {"Out": i["X"][[0, 2, 4]]})
+spec("scatter", ins={"X": R(70).randn(5, 3).astype(np.float32),
+                     "Ids": np.array([1, 3], np.int64),
+                     "Updates": R(71).randn(2, 3).astype(np.float32)},
+     grad=["X", "Updates"])
+spec("lookup_table",
+     ins={"W": R(72).randn(7, 4).astype(np.float32),
+          "Ids": np.array([[1], [3], [5]], np.int64)},
+     grad=["W"],
+     oracle=lambda i, a: {"Out": i["W"][[1, 3, 5]]})
+spec("one_hot", ins={"X": np.array([[0], [2], [1]], np.int64)},
+     attrs={"depth": 4},
+     oracle=lambda i, a: {"Out": np.eye(4, dtype=np.float32)[
+         i["X"].ravel()]})
+spec("multiplex",
+     ins={"Ids": np.array([[0], [1], [0]], np.int64),
+          "X": [R(73).randn(3, 4).astype(np.float32),
+                R(74).randn(3, 4).astype(np.float32)]},
+     grad=["X"],
+     oracle=lambda i, a: {"Out": np.stack([
+         i["X"][0][0], i["X"][1][1], i["X"][0][2]])})
+spec("fill_constant", ins={}, attrs={"shape": [2, 3], "value": 1.5,
+                                     "dtype": "float32"},
+     oracle=lambda i, a: {"Out": np.full((2, 3), 1.5, np.float32)})
+spec("fill_constant_batch_size_like",
+     ins={"Input": _x34},
+     attrs={"shape": [-1, 7], "value": 2.0, "dtype": "float32",
+            "input_dim_idx": 0, "output_dim_idx": 0},
+     oracle=lambda i, a: {"Out": np.full((3, 7), 2.0, np.float32)})
+spec("fill_zeros_like", ins={"X": _x34},
+     oracle=lambda i, a: {"Out": np.zeros_like(i["X"])})
+spec("assign", ins={"X": _x34}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"]})
+spec("assign_value", ins={},
+     attrs={"shape": [2, 2], "dtype": "float32",
+            "values": [1.0, 2.0, 3.0, 4.0]},
+     oracle=lambda i, a: {"Out": np.array([[1, 2], [3, 4]], np.float32)})
+spec("shape", ins={"Input": _x34},
+     oracle=lambda i, a: {"Out": np.array([3, 4])})
+spec("range", ins={}, attrs={"start": 1.0, "end": 7.0, "step": 2.0,
+                             "dtype": "float32"},
+     oracle=lambda i, a: {"Out": np.array([1.0, 3.0, 5.0], np.float32)})
+spec("top_k", ins={"X": R(75).randn(3, 6).astype(np.float32)},
+     attrs={"k": 2}, outs=["Out", "Indices"], loss=["Out"],
+     oracle=lambda i, a: {
+         "Out": np.sort(i["X"], axis=1)[:, ::-1][:, :2],
+         "Indices": np.argsort(-i["X"], axis=1)[:, :2]})
+spec("sequence_mask", ins={"X": np.array([2, 4, 1], np.int64)},
+     attrs={"maxlen": 5}, outs=["Y"],
+     oracle=lambda i, a: {"Y": (np.arange(5)[None, :]
+                                < i["X"][:, None]).astype(np.float32)})
+
+# --- metrics ----------------------------------------------------------
+spec("accuracy",
+     ins={"Indices": np.array([[1], [0], [2], [1]], np.int64),
+          "Label": np.array([[1], [1], [2], [0]], np.int64)},
+     outs=["Accuracy"],
+     oracle=lambda i, a: {"Accuracy": np.array([0.5], np.float32)})
+
+# --- sequence (LoD) ---------------------------------------------------
+_seqx = R(80).randn(6, 3).astype(np.float32)
+_lod6 = [0, 2, 6]
+
+spec("sequence_pool_sum", op="sequence_pool",
+     ins={"X": _seqx}, attrs={"pooltype": "SUM"},
+     lods={"sequence_pool_x_0": _lod6}, grad=True,
+     oracle=lambda i, a: {"Out": np.stack([
+         i["X"][0:2].sum(0), i["X"][2:6].sum(0)])})
+spec("sequence_pool_avg", op="sequence_pool",
+     ins={"X": _seqx}, attrs={"pooltype": "AVERAGE"},
+     lods={"sequence_pool_x_0": _lod6}, grad=True,
+     oracle=lambda i, a: {"Out": np.stack([
+         i["X"][0:2].mean(0), i["X"][2:6].mean(0)])})
+spec("sequence_pool_max", op="sequence_pool",
+     ins={"X": _seqx}, attrs={"pooltype": "MAX"},
+     lods={"sequence_pool_x_0": _lod6}, grad=True,
+     oracle=lambda i, a: {"Out": np.stack([
+         i["X"][0:2].max(0), i["X"][2:6].max(0)])})
+spec("sequence_pool_first", op="sequence_pool",
+     ins={"X": _seqx}, attrs={"pooltype": "FIRST"},
+     lods={"sequence_pool_x_0": _lod6}, grad=True,
+     oracle=lambda i, a: {"Out": np.stack([i["X"][0], i["X"][2]])})
+spec("sequence_pool_last", op="sequence_pool",
+     ins={"X": _seqx}, attrs={"pooltype": "LAST"},
+     lods={"sequence_pool_x_0": _lod6}, grad=True,
+     oracle=lambda i, a: {"Out": np.stack([i["X"][1], i["X"][5]])})
+spec("sequence_softmax", ins={"X": R(81).randn(6, 1).astype(np.float32)},
+     lods={"sequence_softmax_x_0": _lod6}, grad=True,
+     gtol=(8e-2, 1e-3),
+     oracle=lambda i, a: {"Out": np.concatenate([
+         _softmax(i["X"][0:2].ravel()), _softmax(i["X"][2:6].ravel())
+     ]).reshape(6, 1)})
+spec("sequence_reshape", ins={"X": R(82).randn(6, 4).astype(np.float32)},
+     attrs={"new_dim": 8},
+     lods={"sequence_reshape_x_0": _lod6}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"].reshape(3, 8)})
+spec("sequence_expand",
+     ins={"X": np.ascontiguousarray(R(83).randn(2, 3).astype(np.float32)),
+          "Y": np.zeros((5, 1), np.float32)},
+     lods={"sequence_expand_y_0": [0, 2, 5]},
+     grad=["X"],
+     oracle=lambda i, a: {"Out": np.concatenate([
+         np.tile(i["X"][0], (2, 1)), np.tile(i["X"][1], (3, 1))])})
+spec("sequence_concat",
+     ins={"X": [_seqx, R(84).randn(6, 3).astype(np.float32)]},
+     lods={"sequence_concat_x_0": _lod6, "sequence_concat_x_1": _lod6},
+     grad=True)
+spec("sequence_conv",
+     ins={"X": _seqx,
+          "Filter": R(85).randn(9, 4).astype(np.float32)},
+     attrs={"contextLength": 3, "contextStart": -1},
+     lods={"sequence_conv_x_0": _lod6},
+     grad=["X", "Filter"])
+spec("sequence_erase", ins={"X": np.array([[1], [0], [2], [0], [3], [2]],
+                                          np.int64)},
+     attrs={"tokens": [0]},
+     lods={"sequence_erase_x_0": _lod6})
+
+# --- RNN cells --------------------------------------------------------
+spec("lstm_unit",
+     ins={"X": R(90).randn(3, 16).astype(np.float32),
+          "C_prev": R(91).randn(3, 4).astype(np.float32)},
+     attrs={"forget_bias": 0.0},
+     outs=["C", "H"], grad=["X", "C_prev"], loss=["C", "H"])
+spec("gru_unit",
+     ins={"Input": R(92).randn(3, 12).astype(np.float32),
+          "HiddenPrev": R(93).randn(3, 4).astype(np.float32),
+          "Weight": R(94).randn(4, 12).astype(np.float32)},
+     outs=["Hidden"], grad=["Input", "HiddenPrev", "Weight"],
+     loss=["Hidden"], gtol=(8e-2, 1e-3))
+
+# --- sampled / structured losses --------------------------------------
+spec("hierarchical_sigmoid",
+     ins={"X": R(95).randn(3, 4).astype(np.float32),
+          "W": R(96).randn(7, 4).astype(np.float32),
+          "Bias": R(97).randn(7).astype(np.float32),
+          "Label": np.array([[1], [4], [6]], np.int64)},
+     attrs={"num_classes": 8},
+     outs=["Out"], grad=["X", "W"], loss=["Out"])
+spec("linear_chain_crf",
+     ins={"Emission": R(98).uniform(-1, 1, (6, 3)).astype(np.float32),
+          "Transition": R(99).uniform(-0.5, 0.5, (5, 3)).astype(np.float32),
+          "Label": np.ascontiguousarray(
+              R(100).randint(0, 3, (6, 1)).astype(np.int64))},
+     lods={"linear_chain_crf_emission_0": _lod6},
+     outs=["LogLikelihood"], grad=["Emission", "Transition"],
+     loss=["LogLikelihood"], gtol=(8e-2, 2e-3))
+spec("warpctc",
+     ins={"Logits": R(101).randn(6, 4).astype(np.float32),
+          "Label": np.array([[1], [2], [1], [3]], np.int64)},
+     lods={"warpctc_logits_0": _lod6, "warpctc_label_0": [0, 1, 4]},
+     outs=["Loss"], grad=["Logits"], loss=["Loss"],
+     gtol=(8e-2, 2e-3))
+
+# --- optimizer update ops (output oracles) ----------------------------
+_p = R(110).randn(4, 3).astype(np.float32)
+_g = R(111).randn(4, 3).astype(np.float32)
+_lr = np.array([0.1], np.float32)
+
+spec("sgd", ins={"Param": _p, "Grad": _g, "LearningRate": _lr},
+     outs=["ParamOut"],
+     oracle=lambda i, a: {"ParamOut": i["Param"] - 0.1 * i["Grad"]})
+spec("momentum",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr,
+          "Velocity": R(112).randn(4, 3).astype(np.float32)},
+     attrs={"mu": 0.9, "use_nesterov": False},
+     outs=["ParamOut", "VelocityOut"],
+     oracle=lambda i, a: {
+         "VelocityOut": 0.9 * i["Velocity"] + i["Grad"],
+         "ParamOut": i["Param"] - 0.1 * (0.9 * i["Velocity"] + i["Grad"])})
+spec("adagrad",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr,
+          "Moment": np.abs(R(113).randn(4, 3)).astype(np.float32)},
+     attrs={"epsilon": 1e-6},
+     outs=["ParamOut", "MomentOut"],
+     oracle=lambda i, a: {
+         "MomentOut": i["Moment"] + i["Grad"] ** 2,
+         "ParamOut": i["Param"] - 0.1 * i["Grad"] / (
+             np.sqrt(i["Moment"] + i["Grad"] ** 2) + 1e-6)})
+spec("adam",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr,
+          "Moment1": R(114).randn(4, 3).astype(np.float32) * 0.1,
+          "Moment2": np.abs(R(115).randn(4, 3)).astype(np.float32) * 0.1,
+          "Beta1Pow": np.array([0.9], np.float32),
+          "Beta2Pow": np.array([0.999], np.float32)},
+     attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+     outs=["ParamOut", "Moment1Out", "Moment2Out"],
+     oracle=lambda i, a: {
+         "Moment1Out": 0.9 * i["Moment1"] + 0.1 * i["Grad"],
+         "Moment2Out": 0.999 * i["Moment2"] + 0.001 * i["Grad"] ** 2,
+         "ParamOut": i["Param"] - (0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)) * (
+             0.9 * i["Moment1"] + 0.1 * i["Grad"]) / (
+             np.sqrt(0.999 * i["Moment2"] + 0.001 * i["Grad"] ** 2) + 1e-8)})
+spec("adamax",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr,
+          "Moment": R(116).randn(4, 3).astype(np.float32) * 0.1,
+          "InfNorm": np.abs(R(117).randn(4, 3)).astype(np.float32) + 0.1,
+          "Beta1Pow": np.array([0.9], np.float32)},
+     attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+     outs=["ParamOut", "MomentOut", "InfNormOut"],
+     oracle=lambda i, a: {
+         "MomentOut": 0.9 * i["Moment"] + 0.1 * i["Grad"],
+         "InfNormOut": np.maximum(0.999 * i["InfNorm"],
+                                  np.abs(i["Grad"]) + 1e-8),
+         "ParamOut": i["Param"] - (0.1 / (1 - 0.9)) * (
+             0.9 * i["Moment"] + 0.1 * i["Grad"]) / np.maximum(
+             0.999 * i["InfNorm"], np.abs(i["Grad"]) + 1e-8)})
+spec("decayed_adagrad",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr,
+          "Moment": np.abs(R(118).randn(4, 3)).astype(np.float32)},
+     attrs={"decay": 0.95, "epsilon": 1e-6},
+     outs=["ParamOut", "MomentOut"],
+     oracle=lambda i, a: {
+         "MomentOut": 0.95 * i["Moment"] + 0.05 * i["Grad"] ** 2,
+         "ParamOut": i["Param"] - 0.1 * i["Grad"] / (np.sqrt(
+             0.95 * i["Moment"] + 0.05 * i["Grad"] ** 2) + 1e-6)})
+spec("rmsprop",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr,
+          "MeanSquare": np.abs(R(119).randn(4, 3)).astype(np.float32),
+          "Moment": R(120).randn(4, 3).astype(np.float32) * 0.1},
+     attrs={"decay": 0.9, "epsilon": 1e-6, "momentum": 0.0},
+     outs=["ParamOut", "MeanSquareOut", "MomentOut"],
+     oracle=lambda i, a: {
+         "MeanSquareOut": 0.9 * i["MeanSquare"] + 0.1 * i["Grad"] ** 2,
+         "MomentOut": 0.1 * i["Grad"] / np.sqrt(
+             0.9 * i["MeanSquare"] + 0.1 * i["Grad"] ** 2 + 1e-6),
+         "ParamOut": i["Param"] - 0.1 * i["Grad"] / np.sqrt(
+             0.9 * i["MeanSquare"] + 0.1 * i["Grad"] ** 2 + 1e-6)})
+spec("adadelta",
+     ins={"Param": _p, "Grad": _g,
+          "AvgSquaredGrad": np.abs(R(121).randn(4, 3)).astype(np.float32),
+          "AvgSquaredUpdate": np.abs(R(122).randn(4, 3)).astype(np.float32)},
+     attrs={"rho": 0.95, "epsilon": 1e-6},
+     outs=["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"])
+spec("ftrl",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr,
+          "SquaredAccumulator": np.abs(R(123).randn(4, 3)).astype(np.float32),
+          "LinearAccumulator": R(124).randn(4, 3).astype(np.float32) * 0.1},
+     attrs={"l1": 0.01, "l2": 0.01, "lr_power": -0.5},
+     outs=["ParamOut", "SquaredAccumOut", "LinearAccumOut"])
+
+# --- random (statistical checks, not pointwise) -----------------------
+RANDOM_SPECS = {
+    "uniform_random": dict(
+        attrs={"shape": [2000], "min": -1.0, "max": 1.0,
+               "dtype": "float32"},
+        check=lambda a: (-1 <= a).all() and (a <= 1).all()
+        and abs(a.mean()) < 0.1),
+    "gaussian_random": dict(
+        attrs={"shape": [2000], "mean": 1.0, "std": 2.0,
+               "dtype": "float32"},
+        check=lambda a: abs(a.mean() - 1.0) < 0.3
+        and abs(a.std() - 2.0) < 0.3),
+    "truncated_gaussian_random": dict(
+        attrs={"shape": [2000], "mean": 0.0, "std": 1.0,
+               "dtype": "float32"},
+        check=lambda a: (np.abs(a) <= 2.0 + 1e-5).all()
+        and abs(a.mean()) < 0.2),
+}
+
+# --- exemptions (VERDICT: every uncovered kernel listed with a reason) -
+EXEMPT = {
+    "while": "control flow; dedicated tests in test_control_flow.py",
+    "array_read": "tensor-array plumbing; test_control_flow.py",
+    "array_write": "tensor-array plumbing; test_control_flow.py",
+    "array_length": "tensor-array plumbing; test_control_flow.py",
+    "dynamic_rnn": "lax.scan machinery; test_rnn_ops.py + book tests",
+    "beam_search": "stateful decode step; test_machine_translation.py",
+    "beam_search_decode": "decode assembly; test_machine_translation.py",
+    "lstm": "full-sequence kernel; gradient-checked via dynamic_lstm in "
+            "test_rnn_ops.py (lstm_unit grad-checked here)",
+    "gru": "full-sequence kernel; test_rnn_ops.py (gru_unit checked here)",
+    "dropout": "random mask resamples per run: numeric diff invalid; "
+               "inference path oracle-checked as dropout_infer",
+    "gaussian_random_noise": "random; statistical family covered by "
+                             "gaussian_random",
+    "nce": "random negative sampling per run; formulation oracle-tested "
+           "in test_executor_cache.py::test_nce_reference_formulation",
+    "auc": "stateful metric over thresholds; covered by "
+           "test_aux_subsystems.py",
+    "precision_recall": "stateful accumulating metric; "
+                        "test_aux_subsystems.py",
+    "chunk_eval": "covered by test_label_semantic_roles.py",
+    "crf_decoding": "argmax decode (non-differentiable); covered by "
+                    "test_crf.py viterbi tests",
+    "edit_distance": "integer DP (non-differentiable); oracle test in "
+                     "test_ctc_sampled_ops.py",
+    "prior_box": "deterministic box generation; test_detection_ops.py",
+    "box_coder": "covered by test_detection_ops.py",
+    "bipartite_match": "greedy assignment (non-differentiable); "
+                       "test_detection_ops.py",
+    "multiclass_nms": "non-differentiable selection; "
+                      "test_detection_ops.py",
+    "lod_reset": "LoD metadata rewrite (no numeric output change); "
+                 "covered via sequence tests",
+    "sequence_slice": "covered by sequence tests in test_rnn_ops.py",
+    "one_hot": "int -> float expansion tested here forward-only",
+    "sequence_erase": "int filtering tested here forward-only",
+    "sequence_mask": "int -> mask tested here forward-only",
+    "accuracy": "int metric tested here forward-only",
+    "cast": "dtype conversion tested here forward-only",
+    "shape": "metadata op tested here forward-only",
+    "isfinite": "boolean reduction tested here forward-only",
+}
+
+
+def _alias_of(name):
+    return SPECS[name].get("op", name)
+
+
+def test_coverage_accounting():
+    """Every registered kernel is either spec'd, randomness-checked, or
+    exempted with a reason."""
+    from paddle_tpu.fluid.core.registry import registered_ops
+
+    covered = {_alias_of(n) for n in SPECS}
+    covered |= set(RANDOM_SPECS)
+    missing = [
+        op for op in registered_ops()
+        if op not in covered and op not in EXEMPT
+    ]
+    assert not missing, "kernels with no op_test coverage: %s" % missing
+    # VERDICT item 3 floor: >= 100 ops through the numeric harness
+    assert len(covered) >= 100, len(covered)
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_op(name):
+    kw = dict(SPECS[name])
+    op = kw.pop("op", name)
+    oracle = kw.pop("oracle", None)
+    grad = kw.pop("grad", None)
+    tol = kw.pop("tol", (1e-4, 1e-5))
+    gtol = kw.pop("gtol", (5e-2, 1e-4))
+    h = OpHarness(
+        op,
+        inputs=kw.pop("ins"),
+        attrs=kw.pop("attrs", {}),
+        outputs=kw.pop("outs", ["Out"]),
+        lods=kw.pop("lods", None),
+        loss_outputs=kw.pop("loss", None),
+        n_outs=kw.pop("n_outs", None),
+    )
+    if oracle is not None:
+        h.check_output(oracle, rtol=tol[0], atol=tol[1])
+    else:
+        h.outputs()  # still must execute
+    if grad:
+        h.check_grad(
+            wrt=None if grad is True else list(grad),
+            rtol=gtol[0], atol=gtol[1],
+        )
+
+
+@pytest.mark.parametrize("name", sorted(RANDOM_SPECS))
+def test_random_op(name):
+    kw = RANDOM_SPECS[name]
+    h = OpHarness(name, inputs={}, attrs=kw["attrs"], outputs=["Out"])
+    (out,) = h.run([h.output_names["Out"][0]])
+    assert kw["check"](np.asarray(out)), "%s statistical check failed" % name
