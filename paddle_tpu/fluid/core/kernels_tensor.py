@@ -251,11 +251,29 @@ def _multiplex(ctx, ins, attrs):
 
 @register_op("row_conv")
 def _row_conv(ctx, ins, attrs):
-    """Lookahead row convolution (reference operators/row_conv_op.cc) on the
-    dense [N, T, D] layout; each step mixes `future_context` future frames."""
+    """Lookahead row convolution (reference operators/row_conv_op.cc); each
+    step mixes `future_context` future frames of the same sequence. Accepts
+    the packed LoD layout [T, D] (masking mixes at sequence boundaries via
+    segment ids) or a dense [N, T, D] batch."""
     x = ins["X"][0]
     filt = ins["Filter"][0]  # [future_context+1, D]
     ctx_len = filt.shape[0]
+    if x.ndim == 2:
+        from .kernels_sequence import lod_key, seg_ids
+
+        key = lod_key(ctx.op.inputs["X"][0])
+        total = x.shape[0]
+        if key in ctx.env:
+            ids = seg_ids(ctx.env[key], total)
+        else:
+            ids = jnp.zeros((total,), jnp.int32)  # one long sequence
+        out = jnp.zeros_like(x)
+        for k in range(ctx_len):
+            shifted = jnp.pad(x[k:], ((0, k), (0, 0)))
+            ids_k = jnp.pad(ids[k:], (0, k), constant_values=-1)
+            valid = (ids_k == ids)[:, None]
+            out = out + jnp.where(valid, shifted * filt[k][None, :], 0.0)
+        return {"Out": out}
     out = jnp.zeros_like(x)
     for k in range(ctx_len):
         shifted = jnp.pad(x[:, k:, :], ((0, 0), (0, k), (0, 0)))

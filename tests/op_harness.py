@@ -156,12 +156,12 @@ class OpHarness(object):
         for slot in self.loss_outputs:
             for name in self.output_names[slot]:
                 out_var = block.var(name)
-                shape = out_var.shape
-                if shape is None:
-                    # run once to discover the runtime shape
-                    (val,) = self.run([name])
-                    shape = val.shape
-                    out_var.shape = tuple(int(s) for s in shape)
+                # always discover the runtime shape: static infer_shape may
+                # be absent, carry -1 placeholders, or disagree with the
+                # runtime for shape-changing ops (slice, squeeze, sequence_*)
+                (val,) = self.run([name])
+                shape = val.shape
+                out_var.shape = tuple(int(s) for s in shape)
                 w_name = name + "_lossw"
                 w = wrng.uniform(0.5, 1.5, size=shape).astype(np.float32)
                 block.create_parameter(
@@ -182,6 +182,7 @@ class OpHarness(object):
                     type="reduce_sum",
                     inputs={"X": [prod]},
                     outputs={"Out": [red]},
+                    attrs={"reduce_all": True},
                 )
                 partials.append(red)
         loss_name = "%s_loss" % self.op_type

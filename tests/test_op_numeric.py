@@ -12,6 +12,8 @@ tests, assignment-style non-differentiable detection ops).
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import pytest
 
@@ -99,7 +101,7 @@ spec("mul_ncd", op="mul",
           "Y": R(11).randn(4, 5).astype(np.float32)},
      attrs={"x_num_col_dims": 2}, grad=True,
      oracle=lambda i, a: {
-         "Out": (i["X"].reshape(6, 4) @ i["Y"]).reshape(6, 5)})
+         "Out": (i["X"].reshape(6, 4) @ i["Y"]).reshape(2, 3, 5)})
 spec("matmul", ins={"X": R(12).randn(3, 4).astype(np.float32),
                     "Y": R(13).randn(4, 5).astype(np.float32)},
      grad=True, oracle=lambda i, a: {"Out": i["X"] @ i["Y"]})
@@ -215,7 +217,7 @@ _act_spec("tanh", np.tanh)
 _act_spec("softsign", lambda x: x / (1 + np.abs(x)))
 _act_spec("softplus", lambda x: np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0))
 _act_spec("relu6", lambda x: np.clip(x, 0, 6))
-_act_spec("gelu", lambda x: 0.5 * x * (1 + np.vectorize(np.math.erf)(x / np.sqrt(2))),
+_act_spec("gelu", lambda x: 0.5 * x * (1 + np.vectorize(math.erf)(x / np.sqrt(2))),
           tol=(1e-3, 1e-4))
 _act_spec("elu", lambda x: np.where(x > 0, x, np.exp(x) - 1))
 _act_spec("silu", lambda x: x / (1 + np.exp(-x)))
