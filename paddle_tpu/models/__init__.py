@@ -5,6 +5,6 @@ the book tests' models (python/paddle/v2/fluid/tests/book/), and
 benchmark/cluster/vgg16/vgg16_fluid.py.
 """
 
-from . import lenet, resnet, vgg, alexnet
+from . import alexnet, googlenet, lenet, resnet, vgg
 
-__all__ = ["lenet", "resnet", "vgg", "alexnet"]
+__all__ = ["lenet", "resnet", "vgg", "alexnet", "googlenet"]
