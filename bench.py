@@ -1,15 +1,42 @@
-"""Benchmark: ResNet-50 training throughput on the local chip.
+"""Benchmark suite: training throughput + MFU on the local chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline: BASELINE.json north star, 1500 images/sec/chip (v5e).
-Workload parity: benchmark/paddle/image/resnet.py with --job=time
-(batch data-parallel train step, cross-entropy + momentum).
+Workloads (BASELINE.md units, reference benchmark/ configs as workload
+definitions):
+
+  resnet50  — headline: chip training throughput, img/s vs the 1500
+              img/s/chip north star (BASELINE.json); a companion
+              `resnet50_input_pipeline` record times the SAME model fed
+              end-to-end from the native recordio prefetch queue (uint8
+              images, normalised on device). On this harness the
+              pipeline number is bounded by the remote-TPU tunnel's
+              ~40 MB/s sustained h2d bandwidth (reported as h2d_MBps),
+              which a real TPU host does not have.
+  vgg16     — benchmark/paddle/image/vgg.py, img/s
+  alexnet   — benchmark/paddle/image/alexnet.py, img/s vs 334 ms/batch
+              bs=128 (benchmark/README.md:37 -> 383 img/s)
+  googlenet — benchmark/paddle/image/googlenet.py, img/s vs 1149 ms/batch
+              bs=128 (benchmark/README.md:50 -> 111.4 img/s)
+  lstm      — benchmark/paddle/rnn/rnn.py (2x LSTM h=512, bs=64, seq 100),
+              ms/batch vs 184 ms/batch (benchmark/README.md:119)
+
+Timing: per-step cost is measured by differencing two multi-step
+`run_repeated` calls ((T(hi)-T(lo))/(hi-lo)), which cancels the
+per-dispatch round-trip latency of the remote-TPU tunnel (~3 s/call —
+an artifact of this harness, not of the framework or chip).
+
+MFU = img_per_sec x 3 x fwd_flops_per_sample / 197e12 (v5e bf16 peak;
+backward ~= 2x forward for conv/matmul nets, so train step ~= 3x fwd).
+
+Prints one JSON line per workload; the FINAL line is the headline
+ResNet-50 record (driver contract) and carries `mfu` and the full
+`workloads` map.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import struct
 import sys
 import time
 
@@ -17,71 +44,329 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-BASELINE_IMG_PER_SEC = 1500.0
+BASELINE_IMG_PER_SEC = 1500.0  # ResNet-50 north star (BASELINE.json)
+PEAK_FLOPS = 197e12  # TPU v5e bf16
+
+# forward FLOPs per sample (2 FLOPs per MAC), standard published counts
+FWD_FLOPS = {
+    "resnet50": 4.09e9,   # 224x224, bottleneck v1
+    "vgg16": 15.47e9,     # 224x224
+    "alexnet": 1.43e9,    # 224x224 (0.71 GMAC)
+    "googlenet": 3.0e9,   # 224x224 inception v1 (1.5 GMAC)
+}
+
+AMP = os.environ.get("BENCH_AMP", "1") == "1"
+IMG_DTYPE = "bfloat16" if AMP else "float32"
+
+
+def _build_image_workload(fluid, model_fn, batch, class_dim=1000, uint8_input=False):
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        if uint8_input:
+            # realistic input pipeline: uint8 images cross the host->device
+            # link; normalisation happens on device in the compiled step
+            raw = fluid.layers.data(name="image", shape=[3, 224, 224], dtype="uint8")
+            image = fluid.layers.scale(
+                x=fluid.layers.cast(raw, IMG_DTYPE), scale=1.0 / 255.0
+            )
+        else:
+            image = fluid.layers.data(name="image", shape=[3, 224, 224], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        predict = model_fn(image, class_dim)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(x=cost)
+        opt = fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+        opt.minimize(avg_cost)
+    main_prog.amp = AMP
+    return main_prog, startup, avg_cost
+
+
+def _per_step_seconds(exe, prog, feed, fetch, s_lo, s_hi):
+    """Steady-state per-step seconds by differencing two multi-step calls
+    (cancels the per-call dispatch/sync overhead of the tunnel)."""
+    ts = {}
+    for s in (s_lo, s_hi):
+        out = exe.run_repeated(prog, feed=feed, fetch_list=[fetch], steps=s)
+        assert np.isfinite(np.ravel(out[0])[-1]), "non-finite loss in warmup"
+    for s in (s_lo, s_hi):
+        t0 = time.time()
+        out = exe.run_repeated(prog, feed=feed, fetch_list=[fetch], steps=s)
+        float(np.ravel(out[0])[-1])  # force
+        ts[s] = time.time() - t0
+    return (ts[s_hi] - ts[s_lo]) / (s_hi - s_lo)
+
+
+def bench_image(name, model_fn, batch, steps=(12, 72), baseline_ips=None):
+    import jax
+
+    import paddle_tpu.fluid as fluid
+
+    prog, startup, cost = _build_image_workload(fluid, model_fn, batch)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "image": jax.device_put(rng.rand(batch, 3, 224, 224).astype(np.float32)),
+        "label": jax.device_put(rng.randint(0, 1000, (batch, 1)).astype(np.int32)),
+    }
+    dt = _per_step_seconds(exe, prog, feed, cost, *steps)
+    exe.close()
+    img_per_sec = batch / dt
+    rec = {
+        "img_per_sec": round(img_per_sec, 2),
+        "ms_per_batch": round(dt * 1e3, 2),
+        "batch": batch,
+        "mfu": round(img_per_sec * 3 * FWD_FLOPS[name] / PEAK_FLOPS, 4),
+    }
+    if baseline_ips:
+        rec["vs_baseline"] = round(img_per_sec / baseline_ips, 4)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# recordio-fed ResNet-50 (headline)
+# ---------------------------------------------------------------------------
+
+
+def _ensure_recordio(path, n_samples, rng):
+    """A record per sample: [label u16][raw uint8 3*224*224] — the data
+    plane the reference's Go master dispatches (RecordIO chunks)."""
+    from paddle_tpu import native
+
+    if os.path.exists(path):
+        return
+    w = native.RecordWriter(path + ".tmp")
+    img_bytes = 3 * 224 * 224
+    for _ in range(n_samples):
+        label = int(rng.randint(0, 1000))
+        img = rng.randint(0, 256, img_bytes, dtype=np.uint8)
+        w.write(struct.pack("<H", label) + img.tobytes())
+    w.close()
+    os.replace(path + ".tmp", path)
+
+
+def bench_resnet50_recordio(batch, chunk_steps, n_chunks):
+    """Timed loop fed from the native recordio prefetch queue: each chunk
+    of `chunk_steps` batches is decoded on the host while the previous
+    chunk trains on device (async dispatch overlaps transfer+compute)."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import native
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    prog, startup, cost = _build_image_workload(
+        fluid,
+        lambda img, cd: resnet_imagenet(img, class_dim=cd, depth=50),
+        batch,
+        uint8_input=True,
+    )
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    path = os.environ.get("BENCH_RECORDIO", "/tmp/bench_imagenet.rio")
+    samples_per_chunk = batch * chunk_steps
+    rng = np.random.RandomState(7)
+    _ensure_recordio(path, samples_per_chunk * 4, rng)  # cycled reader
+
+    img_bytes = 3 * 224 * 224
+
+    def chunks():
+        """Endless chunk stream off the native prefetch queue."""
+        imgs = np.empty((chunk_steps, batch, 3, 224, 224), np.uint8)
+        lbls = np.empty((chunk_steps, batch, 1), np.int64)
+        i = 0
+        while True:
+            reader = native.PrefetchReader([path], capacity=256)
+            for rec in reader:
+                s, b = divmod(i, batch)
+                lbls[s, b, 0] = struct.unpack("<H", rec[:2])[0]
+                imgs[s, b] = np.frombuffer(
+                    rec[2 : 2 + img_bytes], np.uint8
+                ).reshape(3, 224, 224)
+                i += 1
+                if i == samples_per_chunk:
+                    yield imgs, lbls
+                    i = 0
+
+    stream = chunks()
+    # compile + warm with the first chunk
+    imgs, lbls = next(stream)
+    out = exe.run_repeated(
+        prog, feed={"image": imgs, "label": lbls}, fetch_list=[cost],
+        steps=chunk_steps, scan_feeds=True,
+    )
+    assert np.isfinite(np.ravel(out[0])[-1])
+
+    # sustained host->device bandwidth of this harness (the axon tunnel):
+    # the input pipeline is bounded by it, the chip is not
+    jax.device_put(np.zeros(1024, np.uint8)).block_until_ready()  # warm link
+    t0 = time.time()
+    probe = jax.device_put(imgs)
+    probe.block_until_ready()
+    h2d_mbps = imgs.nbytes / 1e6 / (time.time() - t0)
+    del probe
+
+    t0 = time.time()
+    outs = None
+    for _ in range(n_chunks):
+        imgs, lbls = next(stream)
+        outs = exe.run_repeated(
+            prog, feed={"image": imgs, "label": lbls}, fetch_list=[cost],
+            steps=chunk_steps, scan_feeds=True, return_numpy=False,
+        )
+    final_loss = float(np.ravel(np.asarray(outs[0]))[-1])  # full sync
+    dt = time.time() - t0
+    exe.close()
+    assert np.isfinite(final_loss)
+
+    img_per_sec = batch * chunk_steps * n_chunks / dt
+    return {
+        "img_per_sec": round(img_per_sec, 2),
+        "ms_per_batch": round(dt / (chunk_steps * n_chunks) * 1e3, 2),
+        "batch": batch,
+        "mfu": round(img_per_sec * 3 * FWD_FLOPS["resnet50"] / PEAK_FLOPS, 4),
+        "input": "recordio-uint8",
+        "h2d_MBps": round(h2d_mbps, 1),
+        "note": "end-to-end including host->device transfer; bounded by "
+                "the harness tunnel bandwidth above, not the chip",
+    }
+
+
+# ---------------------------------------------------------------------------
+# LSTM (benchmark/paddle/rnn/rnn.py: 2x LSTM h=512, bs=64, seq 100)
+# ---------------------------------------------------------------------------
+
+
+def bench_lstm(batch=64, hidden=512, emb=128, seqlen=100, vocab=30000,
+               layers_n=2, steps=(8, 48)):
+    import jax
+
+    import paddle_tpu.fluid as fluid
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        x = fluid.layers.embedding(input=words, size=[vocab, emb])
+        for _ in range(layers_n):
+            proj = fluid.layers.fc(input=x, size=hidden * 4)
+            x, _ = fluid.layers.dynamic_lstm(input=proj, size=hidden * 4)
+        last = fluid.layers.sequence_last_step(input=x)
+        predict = fluid.layers.fc(input=last, size=2, act="softmax")
+        cost = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=predict, label=label)
+        )
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(cost)
+    main_prog.amp = AMP
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, vocab, (batch * seqlen, 1)).astype(np.int64)
+    offsets = np.arange(0, batch * seqlen + 1, seqlen, dtype=np.int32)
+    feed = {
+        "words": (tokens, [offsets]),
+        "label": rng.randint(0, 2, (batch, 1)).astype(np.int64),
+    }
+    dt = _per_step_seconds(exe, main_prog, feed, cost, *steps)
+    exe.close()
+
+    # fwd FLOPs/batch: per LSTM layer, input proj (E or H -> 4H) + the
+    # recurrent GEMM (H -> 4H) over T*B tokens, 2 FLOPs/MAC
+    toks = batch * seqlen
+    f = 0.0
+    in_dim = emb
+    for _ in range(layers_n):
+        f += 2.0 * toks * (in_dim * 4 * hidden + hidden * 4 * hidden)
+        in_dim = hidden
+    ms = dt * 1e3
+    return {
+        "ms_per_batch": round(ms, 2),
+        "batch": batch,
+        "hidden": hidden,
+        "seq_len": seqlen,
+        "mfu": round((f * 3 / dt) / PEAK_FLOPS, 4),
+        "vs_baseline": round(184.0 / ms, 4),  # >1 = faster than reference
+    }
 
 
 def main():
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "100"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "1"))
-    reps = int(os.environ.get("BENCH_REPS", "2"))
-
-    # standard TPU mixed precision: f32 state, single-pass bf16 on the MXU
     os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "bfloat16")
-
     import jax
 
     jax.config.update(
         "jax_default_matmul_precision",
         os.environ["JAX_DEFAULT_MATMUL_PRECISION"],
     )
-    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.alexnet import alexnet
+    from paddle_tpu.models.googlenet import googlenet
+    from paddle_tpu.models.vgg import vgg16
+
+    quick = os.environ.get("BENCH_QUICK", "0") == "1"
+    only = os.environ.get("BENCH_ONLY", "").split(",") if os.environ.get("BENCH_ONLY") else None
+    workloads = {}
+
+    def run(name, fn):
+        """Side workloads only — the resnet50 headline runs outside run()
+        so its failure fails the bench instead of being swallowed."""
+        if only and name not in only:
+            return
+        try:
+            workloads[name] = fn()
+        except Exception as e:  # a broken side workload must not kill the headline
+            workloads[name] = {"error": "%s: %s" % (type(e).__name__, e)}
+        rec = dict(workloads[name])
+        rec["metric"] = name
+        print(json.dumps(rec), flush=True)
+
+    # reference GPU baselines in img/s: AlexNet 334 ms/batch bs=128,
+    # GoogLeNet 1149 ms/batch bs=128 (benchmark/README.md:37,50); no GPU
+    # number exists in-tree for VGG16
+    if not quick:
+        run("alexnet", lambda: bench_image(
+            "alexnet", lambda i, c: alexnet(i, c), 128, baseline_ips=383.2))
+        run("googlenet", lambda: bench_image(
+            "googlenet", lambda i, c: googlenet(i, c), 128, baseline_ips=111.4))
+        run("vgg16", lambda: bench_image("vgg16", lambda i, c: vgg16(i, c), 64))
+        run("lstm", bench_lstm)
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    chunk_steps = int(os.environ.get("BENCH_CHUNK_STEPS", "25"))
+    n_chunks = int(os.environ.get("BENCH_CHUNKS", "6"))
+
+    # end-to-end input pipeline (recordio -> host decode -> h2d -> train):
+    # on this harness it measures the tunnel, reported for honesty
+    if not quick:
+        run("resnet50_input_pipeline",
+            lambda: bench_resnet50_recordio(batch, chunk_steps, n_chunks))
+
+    # headline: chip training throughput (device-resident data, per-step
+    # cost by multi-step differencing — same semantics as BENCH_r01/r02)
     from paddle_tpu.models.resnet import resnet_imagenet
 
-    main_prog, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_prog, startup):
-        image = fluid.layers.data(name="image", shape=[3, 224, 224], dtype="float32")
-        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-        predict = resnet_imagenet(image, class_dim=1000, depth=50)
-        cost = fluid.layers.cross_entropy(input=predict, label=label)
-        avg_cost = fluid.layers.mean(x=cost)
-        opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
-        opt.minimize(avg_cost)
-    # mixed precision: bf16 forward/backward, f32 master weights
-    main_prog.amp = os.environ.get("BENCH_AMP", "1") == "1"
+    headline = bench_image(
+        "resnet50",
+        lambda i, c: resnet_imagenet(i, class_dim=c, depth=50),
+        batch,
+    )
+    workloads["resnet50"] = headline
 
-    exe = fluid.Executor(fluid.TPUPlace())
-    exe.run(startup)
-
-    rng = np.random.RandomState(0)
-    img = rng.rand(batch, 3, 224, 224).astype(np.float32)
-    lbl = rng.randint(0, 1000, (batch, 1)).astype(np.int64)
-    feed = {"image": img, "label": lbl}
-
-    # multi-step execution: `steps` train iterations inside one compiled
-    # computation (host and data transfers out of the loop). The first
-    # call compiles; timed calls replay the cached executable.
-    for _ in range(max(1, warmup)):
-        out = exe.run_repeated(main_prog, feed=feed, fetch_list=[avg_cost], steps=steps)
-        assert np.isfinite(out[0]).all(), "non-finite loss in warmup: %r" % out[0]
-
-    reps = max(1, reps)
-    t0 = time.time()
-    for _ in range(reps):
-        out = exe.run_repeated(main_prog, feed=feed, fetch_list=[avg_cost], steps=steps)
-        final_loss = float(np.ravel(out[0])[-1])  # forces full sync
-    dt = time.time() - t0
-
-    img_per_sec = batch * steps * reps / dt
     print(
         json.dumps(
             {
                 "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": round(img_per_sec, 2),
+                "value": headline["img_per_sec"],
                 "unit": "images/sec",
-                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 4),
+                "vs_baseline": round(
+                    headline["img_per_sec"] / BASELINE_IMG_PER_SEC, 4
+                ),
+                "mfu": headline["mfu"],
+                "workloads": workloads,
             }
-        )
+        ),
+        flush=True,
     )
 
 

@@ -23,12 +23,41 @@ import glob
 import json
 import os
 import re
+import shutil
 import zlib
 
 import jax
 import numpy as np
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step"]
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+
+
+def _step_dir(dirname: str, step: int) -> str:
+    return os.path.join(dirname, "step_%010d" % int(step))
+
+
+def _list_step_dirs(dirname: str):
+    """[(step, path)] of step-keyed subdirectories, newest first."""
+    out = []
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return out
+    for n in names:
+        m = _STEP_DIR_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(dirname, n)))
+    out.sort(reverse=True)
+    return out
+
+
+def _metas_complete(metas) -> bool:
+    if not metas:
+        return False
+    expected = max(m.get("process_count", 1) for m in metas)
+    return len(metas) >= expected
 
 
 def _meta_name(pidx=None) -> str:
@@ -66,10 +95,16 @@ def _index_to_json(index, shape):
 
 
 def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None):
-    """Write every scope entry (params + optimizer state + BN stats) to
-    `dirname`. Safe against interruption: data files land first, then the
-    meta file commits the checkpoint with one atomic rename. Sharded
-    arrays: this process saves only its owned (replica-0) shards."""
+    """Write every scope entry (params + optimizer state + BN stats) under
+    `dirname/step_<N>/`. Safe against interruption: data files land first,
+    then the meta file commits the checkpoint with one atomic rename — and
+    because every step gets its own subdirectory, a crash mid-save never
+    touches the last committed step (Go pserver keeps its last good
+    checkpoint the same way, service.go:346). Older steps are pruned only
+    after the new step's metas are complete. Sharded arrays: this process
+    saves only its owned (replica-0) shards."""
+    root = dirname
+    dirname = _step_dir(dirname, step)
     os.makedirs(dirname, exist_ok=True)
     pidx = jax.process_index()
     entries = {}
@@ -135,10 +170,29 @@ def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None):
     with open(tmp, "w") as f:
         json.dump(meta, f)
     os.replace(tmp, os.path.join(dirname, _meta_name()))
+    meta["dir"] = dirname
+    _prune_old_steps(root)
     return meta
 
 
-def _all_metas(dirname: str):
+def _prune_old_steps(root: str, keep: int = 1):
+    """Remove step directories older than the newest COMPLETE step (all
+    expected process metas committed), keeping `keep` complete steps.
+    Racing deleters (every process prunes after its own save) are
+    harmless: rmtree errors are ignored."""
+    steps = _list_step_dirs(root)
+    complete_seen = 0
+    for s, path in steps:  # newest first
+        if _metas_complete(_dir_metas(path)):
+            complete_seen += 1
+            if complete_seen > keep:
+                shutil.rmtree(path, ignore_errors=True)
+        elif complete_seen >= keep:
+            # an older incomplete step can never become complete again
+            shutil.rmtree(path, ignore_errors=True)
+
+
+def _dir_metas(dirname: str):
     metas = []
     for path in sorted(glob.glob(os.path.join(dirname, "checkpoint.meta.p*.json"))):
         m = re.search(r"checkpoint\.meta\.p(\d+)\.json$", path)
@@ -149,9 +203,37 @@ def _all_metas(dirname: str):
     return metas
 
 
+def _resolve_dir(dirname: str, strict: bool = True):
+    """Pick the directory holding the checkpoint to load: the newest
+    step_<N>/ subdir whose metas are complete (falling back to older
+    complete steps), or `dirname` itself for the legacy flat layout."""
+    newest_partial = None
+    for s, path in _list_step_dirs(dirname):
+        metas = _dir_metas(path)
+        if _metas_complete(metas):
+            return path, metas
+        if metas and newest_partial is None:
+            newest_partial = (path, metas)
+    if newest_partial is not None and not strict:
+        return newest_partial
+    if newest_partial is not None and strict:
+        path, metas = newest_partial
+        expected = max(m.get("process_count", 1) for m in metas)
+        raise IOError(
+            "newest checkpoint step under %s was written by %d processes "
+            "but only %d meta file(s) are present (and no older complete "
+            "step exists)" % (dirname, expected, len(metas))
+        )
+    return dirname, _dir_metas(dirname)  # legacy flat layout
+
+
 def latest_step(dirname: str):
-    """Highest step committed across all process metas, or None."""
-    metas = _all_metas(dirname)
+    """Highest COMMITTED step — the one load_checkpoint would restore
+    (complete metas only; a partially-written newer step is ignored)."""
+    try:
+        _, metas = _resolve_dir(dirname, strict=True)
+    except IOError:
+        return None  # only a partial step exists: nothing committed
     return max((m["step"] for m in metas), default=None)
 
 
@@ -203,14 +285,17 @@ def load_checkpoint(scope, dirname: str, strict: bool = True) -> dict:
     """Restore a checkpoint into `scope`, verifying every CRC (reference
     LoadCheckpoint rejects corrupt shards).
 
-    Merges ALL per-process metas in the directory: a sharded entry is
-    reassembled from every process's shard files (requires a shared or
-    gathered filesystem, as the reference's save_dir does). Entries are
-    restored as host numpy values; the executor re-places them onto the
-    current mesh/shardings at the next run — so a checkpoint written on N
-    processes restores on any process count. Returns the merged meta
-    (step = max across processes; entries = union)."""
-    metas = _all_metas(dirname)
+    Merges ALL per-process metas of the newest complete step directory
+    (falling back to older complete steps when the newest save was
+    interrupted; legacy flat-layout directories still load): a sharded
+    entry is reassembled from every process's shard files (requires a
+    shared or gathered filesystem, as the reference's save_dir does).
+    Entries are restored as host numpy values; the executor re-places
+    them onto the current mesh/shardings at the next run — so a
+    checkpoint written on N processes restores on any process count.
+    Returns the merged meta (step = max across processes; entries =
+    union)."""
+    dirname, metas = _resolve_dir(dirname, strict=strict)
     if not metas:
         raise FileNotFoundError(
             "no checkpoint meta found under %s" % dirname
@@ -229,6 +314,7 @@ def load_checkpoint(scope, dirname: str, strict: bool = True) -> dict:
         )
     merged = {
         "step": latest,
+        "dir": dirname,
         "extra": {},
         "entries": {},
     }

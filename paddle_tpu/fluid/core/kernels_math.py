@@ -237,7 +237,11 @@ def _cos_sim(ctx, ins, attrs):
 
 @register_op("increment")
 def _increment(ctx, ins, attrs):
-    return {"Out": ins["X"][0] + attrs.get("step", 1.0)}
+    # preserve X's dtype (reference increment_op keeps the variable type;
+    # numpy would promote int + 1.0 to float64 and break loop counters)
+    x = ins["X"][0]
+    dt = x.dtype if hasattr(x, "dtype") else np.float32
+    return {"Out": x + np.asarray(attrs.get("step", 1.0)).astype(dt)}
 
 
 @register_op("cast")

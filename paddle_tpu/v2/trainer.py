@@ -96,6 +96,14 @@ class SGD(object):
             for l in getattr(topo, "extra_layers", [])
             if l.name in topo.var_of
         ]
+        # accumulation semantics per metric: sum-type evaluators report a
+        # running TOTAL over the dataset (reference sum_evaluator /
+        # column_sum_evaluator), ratio metrics an example-weighted mean
+        self._metric_is_sum = [
+            getattr(l, "kind", "") in ("sum_evaluator", "column_sum_evaluator")
+            for l in getattr(topo, "extra_layers", [])
+            if l.name in topo.var_of
+        ]
         # snapshot the forward-only program BEFORE minimize appends the
         # backward+update ops: test() must never touch parameters
         self._test_program = topo.main_program.clone(for_test=True)
@@ -167,16 +175,20 @@ class SGD(object):
                 )
             costs.append(float(np.ravel(fetched[0])[0]) * len(batch))
             for i, m in enumerate(fetched[1:]):
-                # scalar metrics average example-weighted; vector metrics
-                # (column_sum) accumulate element-wise
-                metric_sums[i] = metric_sums[i] + np.asarray(
-                    _metric_value(m)
-                ) * len(batch)
+                # sum evaluators accumulate a dataset TOTAL; ratio metrics
+                # (classification_error, auc) average example-weighted
+                v = np.asarray(_metric_value(m))
+                if self._metric_is_sum[i]:
+                    metric_sums[i] = metric_sums[i] + v
+                else:
+                    metric_sums[i] = metric_sums[i] + v * len(batch)
             n += len(batch)
         avg = sum(costs) / max(n, 1)
         evaluator = {}
         for i, (name, _) in enumerate(self._metric_fetches):
-            val = np.asarray(metric_sums[i]) / max(n, 1)
+            val = np.asarray(metric_sums[i])
+            if not self._metric_is_sum[i]:
+                val = val / max(n, 1)
             evaluator[name] = float(val) if val.ndim == 0 else val
         return v2_event.TestResult(evaluator=evaluator, cost=avg)
 

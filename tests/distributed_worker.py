@@ -138,6 +138,42 @@ def main():
         while True:
             time.sleep(0.2)
 
+    elif role == "dist_resume":
+        # N->M restore with M>1: a FRESH pair of coordinated processes
+        # restores the merged checkpoint onto a process-spanning mesh
+        # (the executor device_puts full host arrays onto it) and
+        # continues the schedule.
+        port, pid, nproc, steps_done, total_steps = sys.argv[4:9]
+        from paddle_tpu.parallel.mesh import DistributedContext
+
+        DistributedContext.initialize(
+            coordinator_address="localhost:%s" % port,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.parallel import make_mesh, set_default_mesh
+
+        mesh = make_mesh({"data": jax.device_count()})
+        set_default_mesh(mesh)
+        main_p, startup, loss = build_model()
+        shard_fsdp(main_p)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.global_scope()
+        meta = ckpt.load_checkpoint(scope, ckpt_dir)
+        result["resumed_step"] = meta["step"]
+
+        ctx = DistributedContext(mesh)
+        per = GLOBAL_BATCH // ctx.process_count
+        lo, hi = int(pid) * per, (int(pid) + 1) * per
+        result["losses"] = train_steps(
+            exe, main_p, loss, int(steps_done), int(total_steps), lo, hi
+        )
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
     elif role == "resume":
         steps_done, total_steps = int(sys.argv[4]), int(sys.argv[5])
         import paddle_tpu.fluid as fluid

@@ -164,15 +164,46 @@ def test_checkpoint_detects_corruption(tmp_path):
     d = str(tmp_path / "ckpt")
     exe, _, _, _ = _train_some(1)
     scope = fluid.global_scope()
-    save_checkpoint(scope, d, step=1)
-    # flip bytes in one shard file
-    victim = next(f for f in os.listdir(d) if f.endswith(".npy"))
-    path = os.path.join(d, victim)
+    meta = save_checkpoint(scope, d, step=1)
+    # flip bytes in one shard file (data lives in the step subdirectory)
+    victim = next(f for f in os.listdir(meta["dir"]) if f.endswith(".npy"))
+    path = os.path.join(meta["dir"], victim)
     data = bytearray(open(path, "rb").read())
     data[-1] ^= 0xFF
     open(path, "wb").write(bytes(data))
     with pytest.raises(IOError):
         load_checkpoint(scope, d)
+
+
+def test_checkpoint_crash_midsave_falls_back(tmp_path):
+    """A crash between data writes and the meta commit of a NEWER step
+    must leave the previous committed step loadable (reference Go pserver
+    always keeps its last good checkpoint, service.go:346)."""
+    import paddle_tpu.distributed.checkpoint as ckptmod
+
+    d = str(tmp_path / "ckpt")
+    _train_some(2)
+    scope = fluid.global_scope()
+    meta1 = save_checkpoint(scope, d, step=1)
+    before = {k: np.asarray(scope.get(k)).copy() for k in scope.keys()}
+
+    # simulate a step-2 save that died after writing data, before any
+    # meta committed: data files exist, no checkpoint.meta.*.json
+    crash_dir = ckptmod._step_dir(d, 2)
+    os.makedirs(crash_dir)
+    with open(os.path.join(crash_dir, "ck_w.p0.npy"), "wb") as f:
+        np.save(f, np.zeros((4, 1), np.float32))
+
+    scope2 = fluid.executor.Scope()
+    got = load_checkpoint(scope2, d)
+    assert got["step"] == 1
+    np.testing.assert_array_equal(np.asarray(scope2.get("ck_w")), before["ck_w"])
+
+    # a later successful save prunes both the crashed dir and older steps
+    save_checkpoint(scope, d, step=3)
+    steps = [s for s, _ in ckptmod._list_step_dirs(d)]
+    assert steps == [3], steps
+    assert load_checkpoint(fluid.executor.Scope(), d)["step"] == 3
 
 
 # ---------------------------------------------------------------------------

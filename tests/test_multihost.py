@@ -99,12 +99,17 @@ def test_two_process_train_kill_resume(tmp_path):
         for p in procs:
             p.wait()
 
-    # sharded checkpoint files from BOTH processes exist
-    metas = [f for f in os.listdir(ckpt_dir) if f.startswith("checkpoint.meta")]
+    # sharded checkpoint files from BOTH processes exist in the newest
+    # complete step directory (each step commits into its own subdir)
+    from paddle_tpu.distributed import checkpoint as _ckpt
+
+    step_dir, _ = _ckpt._resolve_dir(ckpt_dir)
+    assert step_dir != ckpt_dir, "expected a step-keyed checkpoint subdir"
+    metas = [f for f in os.listdir(step_dir) if f.startswith("checkpoint.meta")]
     assert sorted(metas) == [
         "checkpoint.meta.p0.json", "checkpoint.meta.p1.json",
     ]
-    shard_files = [f for f in os.listdir(ckpt_dir) if ".s" in f]
+    shard_files = [f for f in os.listdir(step_dir) if ".s" in f]
     assert any(".p0.s" in f for f in shard_files)
     assert any(".p1.s" in f for f in shard_files)
 
@@ -120,6 +125,34 @@ def test_two_process_train_kill_resume(tmp_path):
     resume = json.load(open(resume_out))
     assert resume["resumed_step"] == STEPS_BEFORE_KILL - 1
 
+    # --- phase B2: N->M with M=2 — a fresh coordinated PAIR resumes ----
+    # (covers the multi-process restore path: full host arrays re-placed
+    # onto a process-spanning mesh)
+    port2 = _free_port()
+    outs2 = [str(tmp_path / ("distres_p%d.json" % i)) for i in range(2)]
+    procs2 = [
+        _spawn(
+            ["dist_resume", outs2[i], ckpt_dir, port2, i, 2,
+             STEPS_BEFORE_KILL, TOTAL_STEPS],
+            devices=4,
+        )
+        for i in range(2)
+    ]
+    try:
+        for o in outs2:
+            assert _wait_file(o, procs2), "dist_resume worker never reported"
+        dist_resume = [json.load(open(o)) for o in outs2]
+    finally:
+        for p in procs2:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs2:
+            p.wait()
+    assert dist_resume[0]["resumed_step"] == STEPS_BEFORE_KILL - 1
+    np.testing.assert_allclose(
+        dist_resume[0]["losses"], dist_resume[1]["losses"], rtol=1e-5
+    )
+
     # --- oracle: single process, full schedule -------------------------
     oracle_out = str(tmp_path / "oracle.json")
     p = _spawn(["oracle", oracle_out, ckpt_dir, TOTAL_STEPS], devices=8)
@@ -131,6 +164,11 @@ def test_two_process_train_kill_resume(tmp_path):
     # dist losses (steps 0..2) + resumed losses (steps 3..5) == oracle's
     np.testing.assert_allclose(
         results[0]["losses"] + resume["losses"], oracle["losses"],
+        rtol=1e-4, atol=1e-6,
+    )
+    # the 2-process resume reproduces the same continuation
+    np.testing.assert_allclose(
+        dist_resume[0]["losses"], oracle["losses"][STEPS_BEFORE_KILL:],
         rtol=1e-4, atol=1e-6,
     )
     # and the final weights match: the 2-process run + sharded checkpoint
@@ -176,7 +214,7 @@ def test_sharded_checkpoint_round_trip_in_process():
 
     # corrupt one shard -> load must fail its CRC
     shard_file = meta["entries"]["w"]["shards"][0]["file"]
-    path = os.path.join(d, shard_file)
+    path = os.path.join(meta["dir"], shard_file)
     raw = open(path, "rb").read()
     with open(path, "wb") as f:
         f.write(raw[:-4] + b"\x00\x00\x00\x01")
