@@ -94,6 +94,11 @@ class TensorArray(object):
         i = int(np.asarray(i).reshape(()))
         if self.base is not None and i >= self.base:
             k = i - self.base
+            if k >= self.buffered_len:
+                raise IndexError(
+                    "LoDTensorArray read at slot %d past length %d"
+                    % (i, len(self))
+                )
             return (
                 self.buf[k],
                 {s: b[k] for s, b in self.band_bufs.items()},
@@ -173,12 +178,14 @@ class TensorArray(object):
         k = jnp.asarray(i).reshape(()).astype(jnp.int32) - self.base
         if not isinstance(i, jax.core.Tracer):
             ki = int(np.asarray(i).reshape(())) - self.base
-            if ki >= self.buf.shape[0]:
-                # JAX scatter would silently DROP an out-of-bounds update
+            if ki < 0 or ki >= self.buf.shape[0]:
+                # JAX scatter would silently DROP (or wrap) an
+                # out-of-bounds update
                 raise IndexError(
-                    "LoDTensorArray write at slot %d exceeds the buffer "
-                    "capacity %d fixed by the compiled while loop"
-                    % (ki + self.base, self.buf.shape[0] + self.base)
+                    "LoDTensorArray write at slot %d outside the buffer "
+                    "window [%d, %d) fixed by the compiled while loop"
+                    % (ki + self.base, self.base,
+                       self.base + self.buf.shape[0])
                 )
             self.buffered_len = max(self.buffered_len, ki + 1)
         self.buf = self.buf.at[k].set(
@@ -432,12 +439,24 @@ def _while_fori(sub_ctx, sub, env, written, remaining, iters):
             if len(shapes) != 1 or len(dts) != 1 or len(keys) != 1:
                 raise _FallbackToUnroll()
 
+    snapshots = {
+        n: (list(arr.items), [dict(b) for b in arr.bands])
+        for n, arr in arrays.items()
+    }
     for n, arr in arrays.items():
         if n in written_arrs:
             # traced writes land in slots [len-1, len-1+remaining]
             arr.to_buffers(remaining + 1)
         else:
             arr.to_stacked()
+
+    def _restore_arrays():
+        for n, arr in arrays.items():
+            arr.items, arr.bands = snapshots[n]
+            arr.base = None
+            arr.buf = None
+            arr.band_bufs = {}
+            arr.buffered_len = 0
 
     base_env = {
         k: v
@@ -461,7 +480,17 @@ def _while_fori(sub_ctx, sub, env, written, remaining, iters):
         out["@arrays"] = {n: arrays[n].carry() for n in arr_names}
         return out
 
-    final = lax.fori_loop(0, remaining, body, init)
+    try:
+        final = lax.fori_loop(0, remaining, body, init)
+    except _FallbackToUnroll:
+        _restore_arrays()
+        raise
+    except Exception:
+        # the body is not expressible under tracing (a kernel needed a
+        # concrete value, a carry dtype/structure mismatch, ...): restore
+        # the arrays and let the exact unroll path handle the loop
+        _restore_arrays()
+        raise _FallbackToUnroll()
     for n in carried:
         env[n] = final[n]
     for n in arr_names:

@@ -249,6 +249,83 @@ def _lower_ops(
     return env
 
 
+def profile_ops(
+    program,
+    env: Dict[str, Any],
+    fetch_names: Sequence[str],
+    persist_names: Sequence[str],
+    collector,
+    base_key=None,
+    is_test: bool = False,
+    seq_maxlen=None,
+    seq_buckets=None,
+):
+    """Interpret-mode timed execution: each forward op runs EAGERLY on the
+    device, synchronised and wall-clock-timed into `collector` — the
+    per-op cost attribution the reference's profiler table gives
+    (platform/profiler.cc:198 ParseEvents), which the fused XLA step
+    cannot provide. When the program trains, the backward+update runs
+    once more through the normal fused path (timed as one row) so the
+    parameter update is applied exactly once with training semantics
+    intact; the eager forward pass is the measurement overhead.
+
+    Returns (fetches, new_persist_dict)."""
+    import time as _time
+
+    block = program.global_block()
+    pruned_ops = _backward_slice(block, list(fetch_names), set(persist_names))
+    ctx = LoweringContext(
+        block, base_key, is_test=is_test, seq_maxlen=seq_maxlen,
+        seq_buckets=seq_buckets,
+    )
+    fwd_ops, ad_op, _tail = _split_at_autodiff(pruned_ops)
+
+    fwd_env = dict(env)
+    if bool(getattr(program, "amp", False)):
+        # the timed forward must run in the SAME precision as the fused
+        # production step (bf16 activations/params under amp)
+        fwd_inputs = set()
+        for op in fwd_ops:
+            fwd_inputs |= set(op.input_arg_names)
+        for k in fwd_inputs:
+            v = fwd_env.get(k)
+            if v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32:
+                fwd_env[k] = jnp.asarray(v).astype(jnp.bfloat16)
+    for op in fwd_ops:
+        if op.type in _SKIP_OPS:
+            continue
+        t0 = _time.time()
+        run_op(ctx, op, fwd_env)
+        for n in op.output_arg_names:
+            v = fwd_env.get(n)
+            if isinstance(v, jax.Array):
+                jax.block_until_ready(v)
+        collector.record(op.type, _time.time() - t0)
+
+    if ad_op is None:
+        final_env = fwd_env
+    else:
+        final_env = dict(env)
+        t0 = _time.time()
+        final_env = _lower_ops(
+            block, pruned_ops, final_env, base_key=base_key, is_test=is_test,
+            seq_maxlen=seq_maxlen, seq_buckets=seq_buckets,
+        )
+        for n in list(fetch_names) + [
+            p for p in persist_names if p in final_env
+        ]:
+            v = final_env.get(n)
+            if isinstance(v, jax.Array):
+                jax.block_until_ready(v)
+        collector.record("backward+update (fused)", _time.time() - t0)
+
+    fetches = [final_env[n] for n in fetch_names]
+    new_persist = {
+        n: final_env[n] for n in persist_names if n in final_env
+    }
+    return fetches, new_persist
+
+
 def build_step_fn(
     program,
     feed_names: Sequence[str],

@@ -241,6 +241,34 @@ class Executor(object):
         # labels) each pad tightly.
         seq_maxlen, seq_buckets = _lod_bucket(feed_arrays)
         persist_in = {n: scope.get(n) for n in persist_names if n in scope}
+
+        # profiler block active: interpret-mode timed run (per-op cost
+        # table, reference profiler.cc:198 ParseEvents) — single-step,
+        # single-chip only
+        from .profiler import active_op_collector
+
+        collector = active_op_collector()
+        if collector is not None and steps is None and mesh is None:
+            from .core.lowering import profile_ops
+
+            self._run_counter += 1
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(program.random_seed), self._run_counter
+            )
+            env: Dict[str, Any] = {}
+            env.update(persist_in)
+            env.update(feed_arrays)
+            fetches, new_persist = profile_ops(
+                program, env, fetch_names, persist_names, collector,
+                base_key=rng, seq_maxlen=seq_maxlen,
+                seq_buckets=seq_buckets,
+            )
+            for n, v in new_persist.items():
+                scope.set(n, v)
+            _maybe_check_nan_inf(fetch_names, fetches, new_persist)
+            if return_numpy:
+                return [np.asarray(f) for f in fetches]
+            return fetches
         if mesh is not None:
             # place persistables on their target shardings up-front (no-op
             # when already placed; once after startup for TP params created

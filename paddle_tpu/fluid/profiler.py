@@ -1,10 +1,18 @@
 """Profiler (reference python/paddle/v2/fluid/profiler.py:33 cuda_profiler,
-:76 profiler; C++ platform/profiler.cc RecordEvent/EnableProfiler).
+:76 profiler; C++ platform/profiler.cc RecordEvent/EnableProfiler:142,
+ParseEvents:198).
 
-On TPU the per-op CUDA-event machinery is replaced by (a) XLA traces via
-jax.profiler (viewable in TensorBoard/XProf) and (b) a host-side wall-clock
-table per executor run, since a fused XLA step has no per-op boundary on
-device. The context-manager API is kept."""
+Two layers on TPU:
+
+* XLA traces via jax.profiler (TensorBoard/XProf) — the deep-dive path.
+* A per-op COST TABLE (reference ParseEvents parity): inside a
+  ``with profiler(...)`` block the Executor switches to an interpret-mode
+  timed run — each forward op executes eagerly on the device and is
+  synchronised + wall-clock timed; a training program's backward+update
+  then runs once through the normal fused path (one row) so update
+  semantics are unchanged. On exit the sorted table prints and is
+  available programmatically via ``last_profile()``.
+"""
 
 from __future__ import annotations
 
@@ -14,9 +22,64 @@ import time
 
 import jax
 
-__all__ = ["cuda_profiler", "reset_profiler", "profiler"]
+__all__ = [
+    "cuda_profiler", "reset_profiler", "profiler", "record_event",
+    "get_events", "last_profile", "active_op_collector",
+]
 
 _events = []
+_last_profile = []
+_active_collector = None
+
+
+class OpCostCollector(object):
+    """op type -> (calls, total, min, max) wall-clock seconds."""
+
+    def __init__(self):
+        self.rows = {}
+
+    def record(self, op_type: str, seconds: float):
+        row = self.rows.get(op_type)
+        if row is None:
+            self.rows[op_type] = [1, seconds, seconds, seconds]
+        else:
+            row[0] += 1
+            row[1] += seconds
+            row[2] = min(row[2], seconds)
+            row[3] = max(row[3], seconds)
+
+    def table(self, sorted_key=None):
+        """[{Event, Calls, Total, Min, Max, Ave}] in ms, sorted like the
+        reference (profiler.py sorted_key in calls/total/max/min/ave)."""
+        out = [
+            {
+                "Event": op,
+                "Calls": calls,
+                "Total": total * 1e3,
+                "Min": mn * 1e3,
+                "Max": mx * 1e3,
+                "Ave": total / calls * 1e3,
+            }
+            for op, (calls, total, mn, mx) in self.rows.items()
+        ]
+        key = {
+            "calls": "Calls", "total": "Total", "max": "Max",
+            "min": "Min", "ave": "Ave",
+        }.get(sorted_key)
+        if key:
+            out.sort(key=lambda r: r[key], reverse=True)
+        return out
+
+
+def active_op_collector():
+    """The executor checks this each run; non-None switches it to the
+    interpret-mode timed path."""
+    return _active_collector
+
+
+def last_profile():
+    """The table from the most recent profiler() block."""
+    return list(_last_profile)
 
 
 @contextlib.contextmanager
@@ -28,34 +91,64 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 def reset_profiler():
     _events.clear()
+    del _last_profile[:]
+
+
+def _print_table(table, elapsed):
+    print("\n------------------------->     Profiling Report     "
+          "<-------------------------\n")
+    print("Place: TPU    Total time span: %.4fs" % elapsed)
+    hdr = "%-32s %8s %12s %12s %12s %12s" % (
+        "Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Ave(ms)")
+    print(hdr)
+    for r in table:
+        print("%-32s %8d %12.4f %12.4f %12.4f %12.4f" % (
+            r["Event"][:32], r["Calls"], r["Total"], r["Min"], r["Max"],
+            r["Ave"]))
 
 
 @contextlib.contextmanager
 def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    """Reference fluid.profiler.profiler parity: times every executor run
+    in the block per-op and prints the sorted cost table on exit."""
+    global _active_collector
     if state not in ["CPU", "GPU", "All", "TPU"]:
         raise ValueError("state must be 'CPU', 'GPU', 'TPU' or 'All'")
-    trace_dir = profile_path if os.path.isdir(profile_path) else os.path.dirname(profile_path) or "/tmp"
+    if sorted_key not in (None, "default", "calls", "total", "max", "min",
+                          "ave"):
+        raise ValueError("unsupported sorted_key %r" % sorted_key)
+    trace_dir = (
+        profile_path if os.path.isdir(profile_path)
+        else os.path.dirname(profile_path) or "/tmp"
+    )
     started = False
-    try:
-        jax.profiler.start_trace(trace_dir)
-        started = True
-    except Exception:
-        pass  # a trace may already be running
+    if os.environ.get("PADDLE_TPU_XLA_TRACE", "0") == "1":
+        try:
+            jax.profiler.start_trace(trace_dir)
+            started = True
+        except Exception:
+            pass  # a trace may already be running
+    prev = _active_collector
+    collector = OpCostCollector()
+    _active_collector = collector
     t0 = time.time()
     try:
         yield
     finally:
         elapsed = time.time() - t0
+        _active_collector = prev
         if started:
             try:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
         _events.append(("profiler_span", elapsed))
-        print(
-            "[paddle_tpu.profiler] span=%.4fs trace_dir=%s (open with "
-            "TensorBoard / xprof)" % (elapsed, trace_dir)
+        table = collector.table(
+            sorted_key if sorted_key != "default" else None
         )
+        del _last_profile[:]
+        _last_profile.extend(table)
+        _print_table(table, elapsed)
 
 
 @contextlib.contextmanager

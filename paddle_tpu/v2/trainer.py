@@ -91,18 +91,19 @@ class SGD(object):
         # metric layers from extra_layers: fetched every batch and handed
         # to event handlers via the evaluator payload (reference book
         # handlers read event.evaluator after each iteration)
-        self._metric_fetches = [
-            (l.name, topo.var_of[l.name])
-            for l in getattr(topo, "extra_layers", [])
+        metric_layers = [
+            l for l in getattr(topo, "extra_layers", [])
             if l.name in topo.var_of
+        ]
+        self._metric_fetches = [
+            (l.name, topo.var_of[l.name]) for l in metric_layers
         ]
         # accumulation semantics per metric: sum-type evaluators report a
         # running TOTAL over the dataset (reference sum_evaluator /
         # column_sum_evaluator), ratio metrics an example-weighted mean
         self._metric_is_sum = [
             getattr(l, "kind", "") in ("sum_evaluator", "column_sum_evaluator")
-            for l in getattr(topo, "extra_layers", [])
-            if l.name in topo.var_of
+            for l in metric_layers
         ]
         # snapshot the forward-only program BEFORE minimize appends the
         # backward+update ops: test() must never touch parameters

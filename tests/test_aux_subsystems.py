@@ -121,3 +121,47 @@ def test_checkgrad_job(tmp_path):
     res = run_config(str(tmp_path / "cg_config.py"), job="checkgrad")
     assert res["checkgrad"]
     assert max(res["checkgrad"].values()) < 5e-2
+
+
+def test_profiler_per_op_table():
+    """Reference profiler parity (platform/profiler.cc:198 ParseEvents):
+    a profiler() block yields a sorted per-op cost table with conv2d and
+    matmul/mul rows carrying nonzero times."""
+    from paddle_tpu.fluid import profiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                act="relu")
+        fcv = fluid.layers.fc(input=c, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=fcv, label=y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.rand(4, 1, 8, 8).astype(np.float32),
+        "y": rng.randint(0, 10, (4, 1)).astype(np.int64),
+    }
+    with profiler.profiler("All", sorted_key="total"):
+        for _ in range(2):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.ravel(lv)).all()
+
+    table = profiler.last_profile()
+    rows = {r["Event"]: r for r in table}
+    assert "conv2d" in rows and rows["conv2d"]["Total"] > 0, rows.keys()
+    assert "mul" in rows and rows["mul"]["Total"] > 0, rows.keys()
+    assert rows["conv2d"]["Calls"] == 2
+    assert any("backward" in e for e in rows), rows.keys()
+    # sorted by total, descending
+    totals = [r["Total"] for r in table]
+    assert totals == sorted(totals, reverse=True)
+    # training still happened under the profiler (params updated)
+    w = np.asarray(fluid.global_scope().get("conv2d_0.w_0"))
+    assert np.isfinite(w).all()
