@@ -485,10 +485,13 @@ def _while_fori(sub_ctx, sub, env, written, remaining, iters):
     except _FallbackToUnroll:
         _restore_arrays()
         raise
-    except Exception:
+    except (NotImplementedError, TypeError, ValueError) as e:
         # the body is not expressible under tracing (a kernel needed a
-        # concrete value, a carry dtype/structure mismatch, ...): restore
-        # the arrays and let the exact unroll path handle the loop
+        # concrete value — jax Concretization/Tracer errors subclass
+        # TypeError — or a carry dtype/structure mismatch): restore the
+        # arrays and let the exact unroll path handle the loop. Other
+        # exception types are genuine bugs and propagate.
+        del e
         _restore_arrays()
         raise _FallbackToUnroll()
     for n in carried:

@@ -281,9 +281,10 @@ def profile_ops(
     fwd_ops, ad_op, _tail = _split_at_autodiff(pruned_ops)
 
     fwd_env = dict(env)
-    if bool(getattr(program, "amp", False)):
+    if ad_op is not None and bool(getattr(program, "amp", False)):
         # the timed forward must run in the SAME precision as the fused
-        # production step (bf16 activations/params under amp)
+        # production step: _lower_ops applies the amp bf16 cast only on
+        # the training (autodiff) path, so mirror exactly that
         fwd_inputs = set()
         for op in fwd_ops:
             fwd_inputs |= set(op.input_arg_names)
@@ -320,9 +321,22 @@ def profile_ops(
         collector.record("backward+update (fused)", _time.time() - t0)
 
     fetches = [final_env[n] for n in fetch_names]
-    new_persist = {
-        n: final_env[n] for n in persist_names if n in final_env
-    }
+    new_persist = {}
+    for n in persist_names:
+        if n not in final_env:
+            continue
+        v = final_env[n]
+        # keep the scope dtype stable (same restore as build_step_fn):
+        # an amp forward must not persist bf16 state over f32 originals
+        orig = env.get(n)
+        if (
+            orig is not None
+            and hasattr(v, "dtype")
+            and hasattr(orig, "dtype")
+            and v.dtype != orig.dtype
+        ):
+            v = jnp.asarray(v).astype(orig.dtype)
+        new_persist[n] = v
     return fetches, new_persist
 
 

@@ -444,9 +444,31 @@ class Program(object):
                 nb.ops.append(nop)
         return p
 
+    def _sub_block_outer_reads(self, op) -> set:
+        """Names an op's sub-block (recursively) reads from OUTSIDE it.
+        Control-flow ops list most reads explicitly (While builds its
+        X list from the sub-block), but a sub-block op may also reference
+        an outer name directly — pruning must treat those as inputs too
+        (reference prune.cc walks sub-blocks the same way)."""
+        idx = op.attrs.get("sub_block")
+        if idx is None:
+            return set()
+        sub = self.block(idx)
+        produced, reads = set(), set()
+        for sop in sub.ops:
+            # order-aware: a name read BEFORE the sub-block produces it is
+            # an outer dependency (matches While.block()'s reads list and
+            # reference prune.cc)
+            reads |= (set(sop.input_arg_names) - produced)
+            reads |= (self._sub_block_outer_reads(sop) - produced)
+            produced |= set(sop.output_arg_names)
+        return reads
+
     def prune(self, targets) -> "Program":
         """Return a clone containing only ops needed to compute `targets`
-        (reference: framework/prune.cc via Program.prune)."""
+        (reference: framework/prune.cc via Program.prune). Dependency
+        tracing descends through `sub_block` attrs (while, dynamic_rnn),
+        so e.g. a beam-search decoder program prunes correctly."""
         if not isinstance(targets, (list, tuple)):
             targets = [targets]
         target_names = set(
@@ -460,6 +482,7 @@ class Program(object):
             if set(op.output_arg_names) & needed or op.type in ("feed",):
                 kept.append(op)
                 needed |= set(op.input_arg_names)
+                needed |= p._sub_block_outer_reads(op)
         blk.ops = list(reversed(kept))
         p._bump_version()
         return p

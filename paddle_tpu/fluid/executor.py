@@ -263,12 +263,9 @@ class Executor(object):
                 base_key=rng, seq_maxlen=seq_maxlen,
                 seq_buckets=seq_buckets,
             )
-            for n, v in new_persist.items():
-                scope.set(n, v)
-            _maybe_check_nan_inf(fetch_names, fetches, new_persist)
-            if return_numpy:
-                return [np.asarray(f) for f in fetches]
-            return fetches
+            return _finish_run(
+                scope, fetch_names, fetches, new_persist, return_numpy
+            )
         if mesh is not None:
             # place persistables on their target shardings up-front (no-op
             # when already placed; once after startup for TP params created
@@ -344,16 +341,23 @@ class Executor(object):
             jax.random.PRNGKey(program.random_seed), self._run_counter
         )
         fetches, new_persist = entry(persist_in, feed_arrays, rng)
-        for n, v in new_persist.items():
-            scope.set(n, v)
-        _maybe_check_nan_inf(fetch_names, fetches, new_persist)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+        return _finish_run(
+            scope, fetch_names, fetches, new_persist, return_numpy
+        )
 
     # convenience used by inference/serving paths ----------------------
     def close(self):
         self._cache.clear()
+
+
+def _finish_run(scope, fetch_names, fetches, new_persist, return_numpy):
+    """Shared run tail: persist write-back, NaN guard, numpy conversion."""
+    for n, v in new_persist.items():
+        scope.set(n, v)
+    _maybe_check_nan_inf(fetch_names, fetches, new_persist)
+    if return_numpy:
+        return [np.asarray(f) for f in fetches]
+    return fetches
 
 
 def _maybe_check_nan_inf(fetch_names, fetches, new_persist):

@@ -203,3 +203,47 @@ def test_decode_main():
     assert ((lens >= 1) & (lens <= MAX_LEN + 1)).all()
     # every emitted token is a valid vocab id
     assert ((ids >= 0) & (ids < DICT_SIZE)).all()
+
+
+def test_decoder_save_load_inference_model(tmp_path):
+    """VERDICT r2 item 6: save_inference_model must round-trip a decoder
+    program whose core is a While + beam_search (multi-block prune), and
+    the reloaded program must reproduce the decode exactly."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        context = encoder()
+        translation_ids, translation_scores = decoder_decode(context)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(5)
+    data = synthetic_wmt(rng, BATCH)
+    feed = {
+        "src_word_id": to_lod_feed([d[0] for d in data]),
+        "init_ids": (
+            np.full((BATCH, 1), START_ID, np.int64),
+            [list(range(BATCH + 1))] * 2,
+        ),
+        "init_scores": (
+            np.ones((BATCH, 1), np.float32),
+            [list(range(BATCH + 1))] * 2,
+        ),
+    }
+    ids0, scores0 = exe.run(
+        main, feed=feed, fetch_list=[translation_ids, translation_scores]
+    )
+
+    d = str(tmp_path / "decoder_model")
+    fluid.io.save_inference_model(
+        d, ["src_word_id", "init_ids", "init_scores"],
+        [translation_ids, translation_scores], exe, main_program=main,
+    )
+
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope2):
+        prog2, feeds2, fetches2 = fluid.io.load_inference_model(d, exe2)
+        ids1, scores1 = exe2.run(prog2, feed=feed, fetch_list=fetches2)
+    np.testing.assert_array_equal(ids0, ids1)
+    np.testing.assert_allclose(scores0, scores1, rtol=1e-6)
