@@ -1,0 +1,239 @@
+"""VGG16 benchmark in Fluid — port of the reference cluster workload
+definition (/root/reference/benchmark/cluster/vgg16/vgg16_fluid.py; the
+BASELINE.md cluster tables name this script).
+
+Deliberate port of benchmark CLIENT code (the workload definition), not
+framework code. Differences from the reference, by design:
+
+* `--parallel` wraps the model in `fluid.layers.ParallelDo` — on this
+  framework that lowers to mesh data-parallel SPMD execution (the
+  reference ran a scope-per-GPU sub-block, parallel_do_op.cc:27).
+* `--local False` uses the DistributeTranspiler shim + jax.distributed
+  multi-host mesh instead of gRPC pservers; PSERVER role is meaningless
+  under SPMD (dense DP = psum over the mesh) and exits with a notice.
+* datasets come from paddle_tpu.v2.dataset (hermetic synthetic data).
+"""
+
+from __future__ import print_function
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+import paddle_tpu.fluid as fluid
+
+
+def str2bool(v):
+    if v.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if v.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError("Boolean value expected.")
+
+
+parser = argparse.ArgumentParser(description=__doc__)
+parser.add_argument("--batch_size", type=int, default=128,
+                    help="Batch size for training.")
+parser.add_argument("--learning_rate", type=float, default=1e-3,
+                    help="Learning rate for training.")
+parser.add_argument("--num_passes", type=int, default=50, help="No. of passes.")
+parser.add_argument("--iterations", type=int, default=0,
+                    help="Cap on train iterations per pass (0 = full pass).")
+parser.add_argument("--device", type=str, default="TPU",
+                    choices=["CPU", "GPU", "TPU"], help="The device type.")
+parser.add_argument("--device_id", type=int, default=0, help="The device id.")
+parser.add_argument("--data_format", type=str, default="NCHW",
+                    choices=["NCHW"], help="The data order.")
+parser.add_argument("--data_set", type=str, default="cifar10",
+                    choices=["cifar10", "flowers"],
+                    help="Optional dataset for benchmark.")
+parser.add_argument("--parallel", type=str2bool, default=True,
+                    help="Run the model under ParallelDo (mesh DP).")
+parser.add_argument("--local", type=str2bool, default=True,
+                    help="Whether to run as local mode.")
+
+
+def vgg16_bn_drop(input):
+    def conv_block(inp, num_filter, groups, dropouts):
+        return fluid.nets.img_conv_group(
+            input=inp,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * groups,
+            conv_filter_size=3,
+            conv_act="relu",
+            conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts,
+            pool_type="max",
+        )
+
+    conv1 = conv_block(input, 64, 2, [0.3, 0])
+    conv2 = conv_block(conv1, 128, 2, [0.4, 0])
+    conv3 = conv_block(conv2, 256, 3, [0.4, 0.4, 0])
+    conv4 = conv_block(conv3, 512, 3, [0.4, 0.4, 0])
+    conv5 = conv_block(conv4, 512, 3, [0.4, 0.4, 0])
+
+    drop = fluid.layers.dropout(x=conv5, dropout_prob=0.5)
+    fc1 = fluid.layers.fc(input=drop, size=512, act=None)
+    bn = fluid.layers.batch_norm(input=fc1, act="relu")
+    drop2 = fluid.layers.dropout(x=bn, dropout_prob=0.5)
+    fc2 = fluid.layers.fc(input=drop2, size=512, act=None)
+    return fc2
+
+
+def main(args=None):
+    args = parser.parse_args(args)
+    if args.data_set == "cifar10":
+        classdim = 10
+        data_shape = [3, 32, 32]
+    else:
+        classdim = 102
+        data_shape = [3, 224, 224]
+
+    # Input data
+    images = fluid.layers.data(name="pixel", shape=data_shape, dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+
+    # Train program
+    def model_head(images_, label_):
+        net = vgg16_bn_drop(images_)
+        predict_ = fluid.layers.fc(input=net, size=classdim, act="softmax")
+        cost = fluid.layers.cross_entropy(input=predict_, label=label_)
+        avg_cost_ = fluid.layers.mean(x=cost)
+        return predict_, avg_cost_
+
+    if args.parallel:
+        places = fluid.layers.get_places()
+        pd = fluid.layers.ParallelDo(places)
+        with pd.do():
+            images_ = pd.read_input(images)
+            label_ = pd.read_input(label)
+            predict, avg_cost = model_head(images_, label_)
+            pd.write_output(avg_cost)
+            pd.write_output(predict)
+        avg_cost, predict = pd()
+        avg_cost = fluid.layers.mean(x=avg_cost)
+    else:
+        predict, avg_cost = model_head(images, label)
+
+    # Evaluator
+    accuracy = fluid.evaluator.Accuracy(input=predict, label=label)
+
+    # inference program
+    inference_program = fluid.default_main_program().clone()
+    with fluid.program_guard(inference_program):
+        test_target = accuracy.metrics + accuracy.states
+        inference_program = fluid.io.get_inference_program(test_target)
+
+    # Optimization
+    optimizer = fluid.optimizer.Adam(learning_rate=args.learning_rate)
+    optimize_ops, params_grads = optimizer.minimize(avg_cost)
+
+    place = (
+        fluid.CPUPlace() if args.device == "CPU"
+        else fluid.TPUPlace(args.device_id)
+    )
+    # mesh data parallelism: every local chip joins the 'data' axis
+    from paddle_tpu import parallel
+
+    import jax
+
+    if parallel.get_default_mesh() is None and jax.local_device_count() > 1:
+        parallel.set_default_mesh(
+            parallel.make_mesh({"data": jax.local_device_count()})
+        )
+    exe = fluid.Executor(place)
+
+    def reshape_batch(data):
+        img_data = np.array(
+            [x[0].reshape(data_shape) for x in data]
+        ).astype("float32")
+        y_data = np.array([x[1] for x in data]).astype("int64").reshape([-1, 1])
+        return img_data, y_data
+
+    def test(exe):
+        accuracy.reset(exe)
+        for batch_id, data in enumerate(test_reader()):
+            img_data, y_data = reshape_batch(data)
+            exe.run(inference_program,
+                    feed={"pixel": img_data, "label": y_data})
+        return accuracy.eval(exe)
+
+    def train_loop(exe, trainer_prog):
+        iters = 0
+        for pass_id in range(args.num_passes):
+            start_time = time.time()
+            num_samples = 0
+            accuracy.reset(exe)
+            for batch_id, data in enumerate(train_reader()):
+                if args.iterations and batch_id >= args.iterations:
+                    break
+                ts = time.time()
+                img_data, y_data = reshape_batch(data)
+                loss, acc = exe.run(
+                    trainer_prog,
+                    feed={"pixel": img_data, "label": y_data},
+                    fetch_list=[avg_cost] + accuracy.metrics,
+                )
+                iters += 1
+                num_samples += len(data)
+                print(
+                    "Pass = %d, Iters = %d, Loss = %f, Accuracy = %f, "
+                    "spent %f"
+                    % (pass_id, iters, float(np.ravel(loss)[0]),
+                       float(np.ravel(acc)[0]), time.time() - ts)
+                )
+            pass_elapsed = time.time() - start_time
+            pass_train_acc = accuracy.eval(exe)
+            pass_test_acc = test(exe)
+            print(
+                "Pass = %d, Training performance = %f imgs/s, "
+                "Train accuracy = %f, Test accuracy = %f\n"
+                % (pass_id, num_samples / pass_elapsed,
+                   float(np.ravel(pass_train_acc)[0]),
+                   float(np.ravel(pass_test_acc)[0]))
+            )
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(
+            paddle.dataset.cifar.train10() if args.data_set == "cifar10"
+            else paddle.dataset.flowers.train(),
+            buf_size=5120,
+        ),
+        batch_size=args.batch_size,
+    )
+    test_reader = paddle.batch(
+        paddle.dataset.cifar.test10()
+        if args.data_set == "cifar10" else paddle.dataset.flowers.test(),
+        batch_size=args.batch_size,
+    )
+
+    if args.local:
+        exe.run(fluid.default_startup_program())
+        train_loop(exe, fluid.default_main_program())
+    else:
+        # multi-host: the transpiler shim validates the call; dense DP is
+        # XLA-SPMD psum over the (process-spanning) mesh, so the PSERVER
+        # role has nothing to serve
+        training_role = os.getenv("TRAINING_ROLE", "TRAINER")
+        if training_role == "PSERVER":
+            print("PSERVER role is unnecessary under SPMD data "
+                  "parallelism; dense gradients allreduce over the mesh.")
+            return
+        pserver_ips = os.getenv("PADDLE_INIT_PSERVERS", "")
+        eplist = [":".join([ip, "6174"]) for ip in pserver_ips.split(",") if ip]
+        trainers = int(os.getenv("TRAINERS", "1"))
+        t = fluid.DistributeTranspiler()
+        t.transpile(
+            optimize_ops, params_grads,
+            pservers=",".join(eplist), trainers=trainers,
+        )
+        exe.run(fluid.default_startup_program())
+        train_loop(exe, t.get_trainer_program())
+
+
+if __name__ == "__main__":
+    main()

@@ -333,6 +333,7 @@ def _while(ctx, ins, attrs):
     sub_ctx = LoweringContext(
         sub, ctx._base_key, is_test=ctx.is_test, seq_maxlen=ctx.seq_maxlen
     )
+    sub_ctx.amp_region = getattr(ctx, "amp_region", False)
     max_iters = attrs.get("max_iters", MAX_WHILE_ITERS)
     written = []
     for op in sub.ops:
@@ -553,6 +554,7 @@ def _dynamic_rnn(ctx, ins, attrs):
     sub_ctx = LoweringContext(
         sub, ctx._base_key, is_test=ctx.is_test, seq_maxlen=ctx.seq_maxlen
     )
+    sub_ctx.amp_region = getattr(ctx, "amp_region", False)
     # everything the sub-block reads from outside (weights, static inputs)
     # is closed over: scan hoists them as loop constants
     base_env = {

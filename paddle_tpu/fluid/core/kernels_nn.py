@@ -182,22 +182,35 @@ def _batch_norm(ctx, ins, attrs):
     bshape = [1] * x.ndim
     bshape[c_axis] = x.shape[c_axis]
 
+    # statistics ALWAYS accumulate in f32 (a bf16 E[x^2]-E[x]^2 loses
+    # mass catastrophically); the convert fuses into the reduce so the
+    # HBM read stays bf16. Only the per-channel apply runs in x.dtype.
+    f32 = jnp.float32
     if is_test:
-        mean, var = mean_in, var_in
+        mean = mean_in.astype(f32)
+        var = var_in.astype(f32)
         mean_out, var_out = mean_in, var_in
-        saved_mean = mean_in
-        saved_var = 1.0 / jnp.sqrt(var_in + eps)
+        saved_mean = mean
+        saved_var = 1.0 / jnp.sqrt(var + eps)
     else:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
-        mean_out = mean_in * momentum + mean * (1.0 - momentum)
-        var_out = var_in * momentum + var * (1.0 - momentum)
+        xs = x.astype(f32)
+        mean = jnp.mean(xs, axis=axes)
+        var = jnp.mean(jnp.square(xs), axis=axes) - jnp.square(mean)
+        mean_out = mean_in.astype(f32) * momentum + mean * (1.0 - momentum)
+        var_out = var_in.astype(f32) * momentum + var * (1.0 - momentum)
         saved_mean = mean
         saved_var = 1.0 / jnp.sqrt(var + eps)
     # running-stat EMA must not leak gradients into scale/bias updates
     mean = lax.stop_gradient(mean) if is_test else mean
     inv = 1.0 / jnp.sqrt(var + eps)
-    y = (x - mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    # fold (mean, inv, scale, bias) into ONE per-channel multiply-add in
+    # x's dtype — tiny vectors, so the f32->bf16 cast costs nothing and
+    # the big activation tensor never leaves bf16
+    eff_scale = (inv * scale.astype(f32)).astype(x.dtype)
+    eff_bias = (
+        bias.astype(f32) - mean * inv * scale.astype(f32)
+    ).astype(x.dtype)
+    y = x * eff_scale.reshape(bshape) + eff_bias.reshape(bshape)
     return {
         "Y": y,
         "MeanOut": lax.stop_gradient(mean_out),
@@ -213,9 +226,11 @@ def _layer_norm(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
-    y = (x - mean) / jnp.sqrt(var + eps)
+    # statistics in f32 (see batch_norm); apply in x.dtype
+    xs = x.astype(jnp.float32)
+    mean = jnp.mean(xs, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xs - mean), axis=axes, keepdims=True)
+    y = ((xs - mean) / jnp.sqrt(var + eps)).astype(x.dtype)
     if ins.get("Scale"):
         y = y * ins["Scale"][0].reshape((1,) * begin + x.shape[begin:])
     if ins.get("Bias"):
@@ -230,13 +245,14 @@ def _lrn(ctx, ins, attrs):
     k = attrs.get("k", 2.0)
     alpha = attrs.get("alpha", 1e-4)
     beta = attrs.get("beta", 0.75)
-    sq = jnp.square(x)
+    # accumulate the cross-channel sum of squares in f32 (bf16-safe)
+    sq = jnp.square(x.astype(jnp.float32))
     half = n // 2
     acc = lax.reduce_window(
         sq, 0.0, lax.add, (1, n, 1, 1), (1, 1, 1, 1), ((0, 0), (half, n - 1 - half), (0, 0), (0, 0))
     )
     mid = k + alpha * acc
-    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+    return {"Out": x * jnp.power(mid, -beta).astype(x.dtype), "MidOut": mid}
 
 
 # --- activations --------------------------------------------------------

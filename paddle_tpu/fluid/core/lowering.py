@@ -33,6 +33,27 @@ AUTODIFF_OP = "autodiff"
 # ops handled by the executor itself, not kernels
 _SKIP_OPS = frozenset(["feed", "fetch"])
 
+# AMP f32 deny-list: numerically sensitive ops that compute in f32 even
+# inside the bf16 forward region (softmax/CE exponentials saturate and
+# reductions lose mass in bf16). Float inputs are upcast, float outputs
+# downcast back to bf16 so the surrounding region stays bf16. These are
+# loss-head / small-tensor ops, so the upcast costs nothing; the
+# normalisation layers (batch_norm/layer_norm/lrn) instead compute their
+# STATISTICS in f32 inside their kernels (kernels_nn.py) — upcasting the
+# whole op there would break conv+BN fusion and tax HBM on the main
+# activation path. Mirrors the reference-era AMP black/white lists
+# (contrib/mixed_precision in later Paddle; capability parity).
+_AMP_F32_OPS = frozenset(
+    [
+        "softmax", "log_softmax", "sequence_softmax",
+        "cross_entropy", "softmax_with_cross_entropy",
+        "sigmoid_cross_entropy_with_logits",
+        "mean", "reduce_mean", "reduce_sum",
+        "exp", "log",
+        "warpctc", "linear_chain_crf", "nce", "hsigmoid",
+    ]
+)
+
 
 # ops that read env directly (tensor arrays, sub-blocks): inputs may be
 # names with no env binding yet (e.g. the first array_write of an array)
@@ -113,12 +134,35 @@ def _share_lod(op, env):
                 env[key] = src
 
 
+def _run_op_f32(ctx: LoweringContext, op, env: Dict[str, Any]):
+    """Run one deny-listed op in f32 inside a bf16 region: upcast bf16
+    float inputs, run, downcast float outputs back to bf16 so the
+    surrounding region stays bf16."""
+    saved = {}
+    for names in op.inputs.values():
+        for n in names:
+            v = env.get(n)
+            if v is not None and hasattr(v, "dtype") and v.dtype == jnp.bfloat16:
+                saved[n] = v
+                env[n] = v.astype(jnp.float32)
+    run_op(ctx, op, env)
+    env.update(saved)  # inputs keep their bf16 values for other readers
+    for slot, names in op.outputs.items():
+        for n in names:
+            v = env.get(n)
+            if v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32:
+                env[n] = v.astype(jnp.bfloat16)
+
+
 def run_ops(ctx: LoweringContext, ops, env: Dict[str, Any]):
+    amp_region = getattr(ctx, "amp_region", False)
     for op in ops:
         if op.type in _SKIP_OPS:
             continue
         if op.type == AUTODIFF_OP:
             _run_autodiff(ctx, op, env)
+        elif amp_region and op.type in _AMP_F32_OPS:
+            _run_op_f32(ctx, op, env)
         else:
             run_op(ctx, op, env)
 
@@ -223,7 +267,11 @@ def _lower_ops(
                 for k, v in pvals.items()
             }
         fenv.update(pvals)
-        run_ops(ctx, fwd_ops, fenv)
+        ctx.amp_region = amp  # f32 deny-list active inside the region
+        try:
+            run_ops(ctx, fwd_ops, fenv)
+        finally:
+            ctx.amp_region = False
         loss = fenv[loss_name].astype(jnp.float32)
         return loss, fenv
 

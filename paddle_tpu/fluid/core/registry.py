@@ -79,6 +79,9 @@ class LoweringContext(object):
         # side-band entries without polluting every kernel signature
         self.op = None
         self.env: dict = {}
+        # True while lowering the bf16 forward region of an AMP program:
+        # deny-listed ops (lowering._AMP_F32_OPS) then compute in f32
+        self.amp_region = False
 
     def next_key(self):
         if self._base_key is None:

