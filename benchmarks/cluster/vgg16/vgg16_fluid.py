@@ -136,15 +136,17 @@ def main(args=None):
         fluid.CPUPlace() if args.device == "CPU"
         else fluid.TPUPlace(args.device_id)
     )
-    # mesh data parallelism: every local chip joins the 'data' axis
-    from paddle_tpu import parallel
+    if args.parallel:
+        # mesh data parallelism: every local chip joins the 'data' axis
+        # (--parallel false = single-device baseline, reference semantics)
+        from paddle_tpu import parallel
 
-    import jax
+        import jax
 
-    if parallel.get_default_mesh() is None and jax.local_device_count() > 1:
-        parallel.set_default_mesh(
-            parallel.make_mesh({"data": jax.local_device_count()})
-        )
+        if parallel.get_default_mesh() is None and jax.local_device_count() > 1:
+            parallel.set_default_mesh(
+                parallel.make_mesh({"data": jax.local_device_count()})
+            )
     exe = fluid.Executor(place)
 
     def reshape_batch(data):

@@ -202,3 +202,25 @@ def _sequence_erase(ctx, ins, attrs):
     )
     _set_lod(ctx, "Out", new_offsets)
     return {"Out": out.reshape((total,) + tuple(x.shape[1:]))}
+
+
+@register_op("sequence_context")
+def _sequence_context(ctx, ins, attrs):
+    """Context-window concatenation WITHOUT weights (reference
+    ContextProjection, gserver/layers/ContextProjection.cpp): row t of the
+    output is [x[t+cs], ..., x[t+cs+cl-1]] with zeros beyond the sequence
+    bounds — the gather half of sequence_conv."""
+    x = ins["X"][0]  # [total, D]
+    offsets = ctx.env[lod_key(ctx.op.inputs["X"][0])]
+    total = x.shape[0]
+    cl = int(attrs["context_length"])
+    cs = int(attrs.get("context_start", -(cl // 2)))
+    s = seg_ids(offsets, total)
+    pos = jnp.arange(total, dtype=offsets.dtype)
+    cols = []
+    for j in range(cl):
+        src = pos + cs + j
+        valid = (src >= offsets[s]) & (src < offsets[s + 1])
+        src_c = jnp.clip(src, 0, total - 1)
+        cols.append(jnp.where(valid[:, None], x[src_c], 0.0))
+    return {"Out": jnp.concatenate(cols, axis=1)}

@@ -53,6 +53,19 @@ _AMP_F32_OPS = frozenset(
         "warpctc", "linear_chain_crf", "nce", "hsigmoid",
     ]
 )
+# deny-listed ops whose outputs STAY f32: loss-head values (tiny tensors
+# whose bf16 re-quantisation would throw away exactly the precision the
+# deny-list bought — cross_entropy -> mean chains keep f32 end to end).
+# Mid-network ops (softmax in attention, exp/log) still downcast so the
+# surrounding bf16 dataflow is uninterrupted.
+_AMP_F32_STICKY = frozenset(
+    [
+        "cross_entropy", "softmax_with_cross_entropy",
+        "sigmoid_cross_entropy_with_logits",
+        "mean", "reduce_mean", "reduce_sum",
+        "warpctc", "linear_chain_crf", "nce", "hsigmoid",
+    ]
+)
 
 
 # ops that read env directly (tensor arrays, sub-blocks): inputs may be
@@ -147,6 +160,8 @@ def _run_op_f32(ctx: LoweringContext, op, env: Dict[str, Any]):
                 env[n] = v.astype(jnp.float32)
     run_op(ctx, op, env)
     env.update(saved)  # inputs keep their bf16 values for other readers
+    if op.type in _AMP_F32_STICKY:
+        return
     for slot, names in op.outputs.items():
         for n in names:
             v = env.get(n)

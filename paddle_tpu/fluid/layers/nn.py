@@ -21,6 +21,9 @@ from . import tensor as tensor_layers
 __all__ = [
     "fc",
     "embedding",
+    "sequence_context",
+    "slice",
+    "equal",
     "conv2d",
     "conv2d_transpose",
     "pool2d",
@@ -1252,3 +1255,55 @@ def precision_recall(input, label, class_number, max_probs=None,
         attrs={"class_number": int(class_number)},
     )
     return batch_metrics, accum_metrics, accum_states
+
+
+def sequence_context(input, context_length, context_start=None, name=None,
+                     **kwargs):
+    """Context-window concatenation without weights (reference
+    ContextProjection; the gather half of sequence_conv). Output width is
+    context_length * input_width."""
+    helper = LayerHelper("sequence_context", name=name, **kwargs)
+    width = None
+    if input.shape and int(input.shape[-1]) > 0:
+        width = int(input.shape[-1]) * int(context_length)
+    out = helper.create_tmp_variable(
+        dtype=input.dtype, shape=(-1, width) if width else None, lod_level=1
+    )
+    helper.append_op(
+        type="sequence_context",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "context_length": int(context_length),
+            "context_start": (
+                -(int(context_length) // 2)
+                if context_start is None else int(context_start)
+            ),
+        },
+    )
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    """Static slice (reference slice_op)."""
+    helper = LayerHelper("slice", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="slice",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"axes": list(axes), "starts": list(starts),
+               "ends": list(ends)},
+    )
+    return out
+
+
+def equal(x, y, name=None, **kwargs):
+    """Elementwise x == y -> bool (reference equal op)."""
+    helper = LayerHelper("equal", name=name)
+    out = helper.create_tmp_variable(dtype="bool")
+    out.stop_gradient = True
+    helper.append_op(
+        type="equal", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}
+    )
+    return out

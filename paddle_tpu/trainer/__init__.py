@@ -46,6 +46,21 @@ def _exec_config(path: str, config_args: Dict[str, str]):
     g: Dict[str, Any] = {"__name__": "__paddle_config__", "__file__": path}
     for name in tch.__all__:
         g[name] = getattr(tch, name)
+    # verbatim reference configs open with
+    # `from paddle.trainer_config_helpers import *` — alias the DSL under
+    # that module path so they exec unchanged
+    if "paddle.trainer_config_helpers" not in sys.modules:
+        import importlib.util
+        import types
+
+        pkg = sys.modules.get("paddle")
+        if pkg is None and importlib.util.find_spec("paddle") is None:
+            # only claim the name when no real PaddlePaddle is installed
+            pkg = types.ModuleType("paddle")
+            sys.modules["paddle"] = pkg
+        if pkg is not None:
+            sys.modules["paddle.trainer_config_helpers"] = tch
+            pkg.trainer_config_helpers = tch
     sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
     try:
         with open(path) as f:
@@ -70,6 +85,38 @@ def _load_provider(data_sources, config_dir):
     if tl and os.path.exists(tl):
         file_list = [l.strip() for l in open(tl) if l.strip()]
     return create(file_list, **data_sources["args"])
+
+
+class _SimpleSlot(object):
+    def __init__(self, type_, seq_type=0):
+        self.type = type_
+        self.seq_type = seq_type
+
+
+def _simple_data_provider(data_nodes, n_samples=256, seed=0):
+    """Reader + slots for TrainData(SimpleData(...)) configs (reference
+    SimpleDataProvider): one dense slot per dense data layer, small
+    random ids for Index (label) layers."""
+    import numpy as np
+
+    slots = []
+    for node in data_nodes:
+        t = node.attrs["type"]
+        slots.append(_SimpleSlot(t.type, t.seq_type))
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_samples):
+            vals = []
+            for node in data_nodes:
+                t = node.attrs["type"]
+                if t.type == 3:  # Index
+                    vals.append(int(rng.randint(0, max(2, t.dim))))
+                else:
+                    vals.append(rng.randn(t.dim).astype("float32"))
+            yield tuple(vals)
+
+    return reader, slots
 
 
 def _batches(reader, slots, data_nodes, batch_size):
@@ -142,6 +189,16 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
                num_passes=1, log_period=10, use_gpu=None, save_dir=None):
     """Programmatic entry (also used by tests). Returns summary dict."""
     state = _exec_config(config_path, config_args or {})
+    if not state["outputs"] and state.get("output_names"):
+        # legacy Outputs("layer_name") form: resolve names to nodes
+        registry = state.get("layers_by_name") or {}
+        missing = [n for n in state["output_names"] if n not in registry]
+        if missing:
+            raise ValueError(
+                "Outputs(%r): no layer with that name in the config"
+                % missing
+            )
+        state["outputs"] = [registry[n] for n in state["output_names"]]
     if not state["outputs"]:
         raise ValueError("config did not call outputs(...)")
     settings = state["settings"]
@@ -174,10 +231,16 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
     with fluid.executor.scope_guard(scope):
         exe.run(topo.startup_program)
 
-    provider_reader = _load_provider(
-        state["data_sources"], os.path.dirname(os.path.abspath(config_path))
-    )
-    slots = provider_reader.settings.slots
+    if state.get("data_sources") is not None:
+        provider_reader = _load_provider(
+            state["data_sources"], os.path.dirname(os.path.abspath(config_path))
+        )
+        slots = provider_reader.settings.slots
+    else:
+        # legacy TrainData(SimpleData(...)) configs: synthesize dense/id
+        # batches from the declared data layers (the framework's datasets
+        # are hermetic synthetics; SimpleDataProvider parity)
+        provider_reader, slots = _simple_data_provider(topo._data_layers)
     batch_size = settings.get("batch_size", 256)
 
     if job == "checkgrad":
@@ -205,6 +268,8 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
                 dt = time.time() - t0
                 stats["batches"] += 1
                 stats["cost"] = cost
+                if stats["batches"] == 1:
+                    stats["first_cost"] = cost
                 # the first batches include compilation; reference --job=time
                 # also skips a warmup via log_period
                 if stats["batches"] > min(log_period, 5):

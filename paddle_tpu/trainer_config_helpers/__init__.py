@@ -26,16 +26,27 @@ from ..v2.layer import Layer
 __all__ = [
     # config-level
     "get_config_arg", "settings", "define_py_data_sources2", "outputs",
+    "Inputs", "Outputs", "TrainData", "TestData", "SimpleData",
+    "ParamAttr", "ExtraAttr", "ExtraLayerAttribute",
     # layers
     "data_layer", "fc_layer", "img_conv_layer", "img_pool_layer",
     "img_conv_group",
     "batch_norm_layer", "concat_layer", "addto_layer", "dropout_layer",
     "embedding_layer", "img_cmrnorm_layer", "simple_lstm", "lstmemory",
-    "grumemory", "last_seq", "first_seq", "max_id",
+    "grumemory", "last_seq", "first_seq", "max_id", "maxid_layer",
+    "eos_layer", "expand_layer", "pooling_layer", "seq_concat_layer",
     "classification_cost", "cross_entropy", "regression_cost", "mse_cost",
+    # mixed layer + projections
+    "mixed_layer", "full_matrix_projection", "trans_full_matrix_projection",
+    "identity_projection", "table_projection", "context_projection",
+    "dotmul_projection", "scaling_projection",
+    # recurrent machinery
+    "recurrent_group", "memory", "StaticInput",
     # activations
     "ReluActivation", "SoftmaxActivation", "LinearActivation",
     "TanhActivation", "SigmoidActivation", "IdentityActivation",
+    "BReluActivation", "SoftReluActivation", "SquareActivation",
+    "ExpActivation", "STanhActivation", "AbsActivation", "LogActivation",
     # pooling types
     "MaxPooling", "AvgPooling", "SumPooling",
     # optimizers / regularization
@@ -57,6 +68,7 @@ def reset_config(config_args: Optional[Dict[str, str]] = None):
         settings={}, outputs=[], data_sources=None,
         config_args=dict(config_args or {}),
     )
+    Layer._registry = _state["layers_by_name"] = {}
 
 
 reset_config()
@@ -100,6 +112,37 @@ def outputs(*layers):
     _state["outputs"].extend(layers)
 
 
+def Inputs(*names):
+    """Legacy config_parser Inputs(): declares feed order; recorded so the
+    CLI can validate provider slots (the graph itself already knows its
+    data layers)."""
+    _state["input_names"] = list(names)
+
+
+def Outputs(*names):
+    """Legacy config_parser Outputs(): outputs by layer NAME."""
+    _state["output_names"] = list(names)
+
+
+class SimpleData(object):
+    """Legacy SimpleData provider config (reference
+    trainer/tests/sample_trainer_config.conf): dense rows of `feat_dim`
+    floats read from `files`."""
+
+    def __init__(self, files=None, feat_dim=1, context_len=0,
+                 buffer_capacity=0, **kwargs):
+        self.files = files
+        self.feat_dim = feat_dim
+
+
+def TrainData(provider):
+    _state["train_data"] = provider
+
+
+def TestData(provider):
+    _state["test_data"] = provider
+
+
 # ---------------------------------------------------------------------
 # activations / pooling / optimizers (reference activations.py,
 # poolings.py, optimizers.py)
@@ -120,6 +163,41 @@ LinearActivation = _mkact("LinearActivation", None)
 IdentityActivation = LinearActivation
 TanhActivation = _mkact("TanhActivation", "tanh")
 SigmoidActivation = _mkact("SigmoidActivation", "sigmoid")
+BReluActivation = _mkact("BReluActivation", "brelu")
+# reference SoftRelu = ln(1 + e^x) (activations.py SoftReluActivation),
+# which is softplus in fluid terms
+SoftReluActivation = _mkact("SoftReluActivation", "softplus")
+SquareActivation = _mkact("SquareActivation", "square")
+ExpActivation = _mkact("ExpActivation", "exp")
+STanhActivation = _mkact("STanhActivation", "stanh")
+AbsActivation = _mkact("AbsActivation", "abs")
+LogActivation = _mkact("LogActivation", "log")
+
+
+class ParamAttr(object):
+    """Legacy attrs.py ParameterAttribute: the subset that affects this
+    core — `name` gives deterministic (shareable) parameter identity;
+    initialization spread/learning-rate fields are accepted and recorded."""
+
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=None,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False, **kwargs):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.learning_rate = learning_rate
+
+
+class ExtraLayerAttribute(object):
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None, **kwargs):
+        self.drop_rate = drop_rate
+
+
+ExtraAttr = ExtraLayerAttribute
 
 
 class _Pooling(object):
@@ -221,9 +299,10 @@ def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
-def fc_layer(input, size, act=None, name=None, bias_attr=None, **kwargs):
+def fc_layer(input, size, act=None, name=None, bias_attr=None,
+             param_attr=None, **kwargs):
     return Layer("fc", name, _as_list(input), {
-        "size": size, "act": _act_name(act), "param_attr": None,
+        "size": size, "act": _act_name(act), "param_attr": param_attr,
         "bias_attr": bias_attr,
     })
 
@@ -334,13 +413,15 @@ def dropout_layer(input, dropout_rate, name=None, **kwargs):
     return node
 
 
-def embedding_layer(input, size, name=None, **kwargs):
+def embedding_layer(input, size, name=None, param_attr=None, **kwargs):
     node = _as_list(input)[0]
     # legacy: a data layer feeding an embedding is an id sequence
     t = node.attrs["type"]
     t.type = 3  # Index
     t.seq_type = 1
-    return Layer("embedding", name, [node], {"size": size})
+    return Layer("embedding", name, [node], {
+        "size": size, "param_attr": param_attr,
+    })
 
 
 def lstmemory(input, size=None, reverse=False, act=None, name=None, **kwargs):
@@ -390,6 +471,182 @@ def mse_cost(input, label, name=None, **kwargs):
 
 
 regression_cost = mse_cost
+
+
+# ---------------------------------------------------------------------
+# mixed_layer + projections (reference layers.py mixed_layer:657,
+# full_matrix_projection:500, identity_projection:540, table_projection,
+# context_projection, gserver MixedLayer + projections/)
+# ---------------------------------------------------------------------
+
+
+class _Projection(object):
+    def __init__(self, ptype, input, **attrs):
+        self.ptype = ptype
+        self.input = input
+        self.attrs = attrs
+
+
+def full_matrix_projection(input, size=0, param_attr=None, **kwargs):
+    return _Projection("full_matrix", input, param_attr=param_attr)
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None, **kwargs):
+    return _Projection("trans_full_matrix", input, param_attr=param_attr)
+
+
+def identity_projection(input, offset=None, size=None, **kwargs):
+    return _Projection("identity", input, offset=offset, size=size)
+
+
+def table_projection(input, size=0, param_attr=None, **kwargs):
+    return _Projection("table", input, param_attr=param_attr)
+
+
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False, **kwargs):
+    return _Projection(
+        "context", input, context_len=context_len,
+        context_start=context_start,
+    )
+
+
+def dotmul_projection(input, param_attr=None, **kwargs):
+    return _Projection("dotmul", input, param_attr=param_attr)
+
+
+def scaling_projection(input, param_attr=None, **kwargs):
+    return _Projection("scaling", input, param_attr=param_attr)
+
+
+class MixedLayerNode(Layer):
+    """`with mixed_layer(...) as m: m += projection` — a Layer node whose
+    attrs collect projections; usable as a context manager and as a
+    regular layer input afterwards."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __iadd__(self, proj):
+        if not isinstance(proj, _Projection):
+            raise TypeError("mixed_layer += expects a projection")
+        self.attrs["projections"].append(proj)
+        self.parents.append(proj.input)
+        return self
+
+
+def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=None,
+                **kwargs):
+    node = MixedLayerNode("mixed", name, [], {
+        "size": size, "act": _act_name(act), "bias_attr": bias_attr,
+        "projections": [],
+    })
+    if input is not None:
+        for proj in _as_list(input):
+            node += proj
+    return node
+
+
+# ---------------------------------------------------------------------
+# recurrent_group / memory / StaticInput (reference layers.py
+# recurrent_group:4082, memory:3590; RecurrentGradientMachine)
+# ---------------------------------------------------------------------
+
+
+class StaticInput(object):
+    """Non-sequence input visible unchanged at every step."""
+
+    def __init__(self, input, size=None, is_seq=False, **kwargs):
+        self.input = input
+        self.size = size
+
+
+_rg_stack: List[List[Layer]] = []
+
+
+def memory(name, size=None, boot_layer=None, is_seq=False, **kwargs):
+    """State carried across recurrent_group steps: reads the PREVIOUS
+    step's value of the layer called `name` (the step must produce a
+    layer with that name); `boot_layer` seeds step 0."""
+    if not _rg_stack:
+        raise RuntimeError("memory() must be called inside a "
+                           "recurrent_group step function")
+    node = Layer("rg_memory", None, [], {
+        "ref_name": name, "size": size,
+        "boot_name": boot_layer.name if boot_layer is not None else None,
+    })
+    node._boot_layer = boot_layer
+    _rg_stack[-1].append(node)
+    return node
+
+
+def recurrent_group(step, input, reverse=False, name=None, **kwargs):
+    """Runs `step` once per timestep over the sequence inputs (lowered to
+    ONE lax.scan via fluid DynamicRNN — core/kernels_control.py). Plain
+    layer inputs are per-step sequences; StaticInput is read-only."""
+    inputs = _as_list(input)
+    seq_nodes, static_nodes, placeholders = [], [], []
+    for inp in inputs:
+        if isinstance(inp, StaticInput):
+            ph = Layer("rg_static_in", None, [], {})
+            ph._outer = inp.input
+            static_nodes.append(ph)
+        else:
+            ph = Layer("rg_step_in", None, [], {})
+            ph._outer = inp
+            seq_nodes.append(ph)
+        placeholders.append(ph)
+
+    _rg_stack.append([])
+    try:
+        out = step(*placeholders)
+    finally:
+        mems = _rg_stack.pop()
+    if isinstance(out, (list, tuple)):
+        raise NotImplementedError(
+            "recurrent_group with multiple step outputs is not supported "
+            "yet; return the primary output layer"
+        )
+    parents = [ph._outer for ph in placeholders] + [
+        m._boot_layer for m in mems if m._boot_layer is not None
+    ]
+    node = Layer("recurrent_group", name, parents, {
+        "reverse": bool(reverse),
+        "step_out": out,
+        "placeholders": placeholders,
+        "mems": mems,
+    })
+    return node
+
+
+def expand_layer(input, expand_as, name=None, **kwargs):
+    """Repeat each row of `input` per `expand_as`'s sequence layout
+    (reference expand_layer -> fluid sequence_expand)."""
+    return Layer("seq_expand", name, [input, expand_as], {})
+
+
+def pooling_layer(input, pooling_type=None, name=None, **kwargs):
+    ptype = "max"
+    if pooling_type is not None:
+        p = pooling_type if isinstance(pooling_type, _Pooling) else pooling_type()
+        ptype = {"max": "max", "avg": "average", "sum": "sum"}[p.name]
+    return Layer("seq_pool", name, [input], {"pool_type": ptype})
+
+
+def seq_concat_layer(a, b, name=None, **kwargs):
+    return Layer("concat", name, [a, b], {})
+
+
+def maxid_layer(input, name=None, **kwargs):
+    return Layer("max_id", name, _as_list(input), {})
+
+
+def eos_layer(input, eos_id, name=None, **kwargs):
+    """1 where the id equals eos_id (reference EosIdCheckLayer)."""
+    return Layer("eos", name, _as_list(input), {"eos_id": int(eos_id)})
 
 
 def img_conv_group(input, conv_num_filter, conv_filter_size=3,

@@ -44,6 +44,8 @@ class Layer(object):
     parameters and feeds can address it."""
 
     _counters: Dict[str, int] = {}
+    _seq = 0  # global creation order (legacy provider slots bind to data
+    #           layers by DECLARATION order, not graph-traversal order)
 
     def __init__(self, kind: str, name: Optional[str], parents: List["Layer"],
                  attrs: Dict[str, Any]):
@@ -55,6 +57,15 @@ class Layer(object):
         self.name = name
         self.parents = parents
         self.attrs = attrs
+        Layer._seq += 1
+        self.created_at = Layer._seq
+        if Layer._registry is not None:
+            Layer._registry[self.name] = self
+
+    # when not None, every created node is recorded by name — the legacy
+    # config path (trainer_config_helpers.reset_config) uses this so
+    # Outputs("layer_name") can resolve names to nodes
+    _registry: Optional[Dict[str, "Layer"]] = None
 
     def __repr__(self):
         return "v2.Layer(%s, %r)" % (self.kind, self.name)

@@ -25,17 +25,30 @@ class Topology(object):
         self.main_program = fluid.Program()
         self.startup_program = fluid.Program()
         self.var_of: Dict[str, object] = {}  # layer name -> fluid Variable
+        self._scopes: List[Dict[str, object]] = []  # recurrent sub-scopes
         self._data_layers: List[Layer] = []
         with fluid.program_guard(self.main_program, self.startup_program):
             for node in self.order:
                 self.var_of[node.name] = self._emit(node)
+        # provider slots bind positionally to data layers in DECLARATION
+        # order (reference config_parser input order), not traversal order
+        self._data_layers.sort(key=lambda n: getattr(n, "created_at", 0))
 
     # ------------------------------------------------------------------
+    def _var(self, name):
+        for scope in reversed(self._scopes):
+            if name in scope:
+                return scope[name]
+        return self.var_of[name]
+
+    def _bind(self, name, var):
+        (self._scopes[-1] if self._scopes else self.var_of)[name] = var
+
     def _in(self, node, i=0):
-        return self.var_of[node.parents[i].name]
+        return self._var(node.parents[i].name)
 
     def _ins(self, node):
-        return [self.var_of[p.name] for p in node.parents]
+        return [self._var(p.name) for p in node.parents]
 
     def _emit(self, node: Layer):
         L = fluid.layers
@@ -53,20 +66,41 @@ class Topology(object):
         if node.kind == "fc":
             # deterministic parameter names derived from the layer name
             # (reference convention "___fc_0__.w0") so Parameters re-bind
-            # across replays of the same DAG
-            attrs = [
-                fluid.ParamAttr(name="%s.w%d" % (node.name, i))
-                for i in range(len(node.parents))
-            ]
+            # across replays of the same DAG; a user ParamAttr(name=...)
+            # overrides them, which is how legacy configs SHARE weights
+            # (e.g. sample_trainer_config.conf's 'sharew')
+            user = a.get("param_attr")
+            user_names = None
+            if user is not None:
+                user_names = [
+                    getattr(p, "name", None)
+                    for p in (user if isinstance(user, (list, tuple)) else [user])
+                ]
+            attrs = []
+            for i in range(len(node.parents)):
+                name = None
+                if user_names and i < len(user_names):
+                    name = user_names[i]
+                attrs.append(
+                    fluid.ParamAttr(name=name or "%s.w%d" % (node.name, i))
+                )
+            bias = a.get("bias_attr")
+            if bias is False:
+                bias_attr = False
+            else:
+                bias_attr = fluid.ParamAttr(
+                    name=getattr(bias, "name", None) or node.name + ".wbias"
+                )
             return L.fc(input=self._ins(node), size=a["size"], act=a["act"],
-                        param_attr=attrs,
-                        bias_attr=fluid.ParamAttr(name=node.name + ".wbias"))
+                        param_attr=attrs, bias_attr=bias_attr)
         if node.kind == "embedding":
             t = node.parents[0].attrs["type"]
+            pa = a.get("param_attr")
             return L.embedding(input=self._in(node),
                                size=[t.dim, a["size"]],
                                param_attr=fluid.ParamAttr(
-                                   name=node.name + ".w0"))
+                                   name=getattr(pa, "name", None)
+                                   or node.name + ".w0"))
         if node.kind == "concat":
             return L.concat(input=self._ins(node), axis=1)
         if node.kind == "img_conv":
@@ -159,7 +193,175 @@ class Topology(object):
             return L.reduce_sum(self._in(node))
         if node.kind == "column_sum_evaluator":
             return L.reduce_sum(self._in(node), dim=0)
+        if node.kind == "mixed":
+            return self._emit_mixed(node)
+        if node.kind == "recurrent_group":
+            return self._emit_recurrent_group(node)
+        if node.kind == "seq_expand":
+            x, y = self._ins(node)
+            return L.sequence_expand(x, y)
+        if node.kind == "eos":
+            # 1.0 where the id equals eos_id (reference EosIdCheckLayer)
+            x = self._in(node)
+            eos = L.fill_constant(shape=[1], dtype="int64",
+                                  value=a["eos_id"])
+            return L.cast(L.equal(x=x, y=eos), "float32")
         raise NotImplementedError("v2 layer kind %r" % node.kind)
+
+    # ------------------------------------------------------------------
+    def _width(self, var, node: Layer):
+        """Feature width of a layer's output: the fluid var's static last
+        dim when known, else derived from the DSL node (many tmp vars
+        carry no static shape)."""
+        if getattr(var, "shape", None):
+            d = var.shape[-1]
+            if d is not None and int(d) > 0:
+                return int(d)
+        w = self._node_width(node)
+        if w is None:
+            raise ValueError(
+                "cannot determine feature width of layer %r (%s)"
+                % (node.name, node.kind)
+            )
+        return w
+
+    def _node_width(self, node: Layer):
+        a = node.attrs
+        if node.kind in ("fc", "embedding", "mixed"):
+            return int(a["size"])
+        if node.kind in ("lstmemory", "gru"):
+            return int(a["size"]) if a.get("size") else None
+        if node.kind == "data":
+            return int(a["type"].dim)
+        if node.kind == "rg_memory":
+            if a.get("size"):
+                return int(a["size"])
+            boot = getattr(node, "_boot_layer", None)
+            return self._node_width(boot) if boot is not None else None
+        if node.kind in ("rg_step_in", "rg_static_in"):
+            return self._node_width(node._outer)
+        if node.parents:
+            return self._node_width(node.parents[0])
+        return None
+
+    def _emit_mixed(self, node: Layer):
+        """mixed_layer = sum of projection outputs (+bias, act) — the
+        reference MixedLayer with full_matrix/trans/identity/table/
+        context/dotmul/scaling projections (gserver/layers/projections)."""
+        L = fluid.layers
+        a = node.attrs
+        size = int(a["size"])
+        terms = []
+        for k, proj in enumerate(a["projections"]):
+            x = self._var(proj.input.name)
+            pa = proj.attrs.get("param_attr")
+            pname = getattr(pa, "name", None) or "%s.w%d" % (node.name, k)
+            if proj.ptype == "full_matrix":
+                in_dim = self._width(x, proj.input)
+                w = L.create_parameter([in_dim, size], "float32", attr=pname)
+                terms.append(L.mul(x=x, y=w))
+            elif proj.ptype == "trans_full_matrix":
+                # y = x @ W^T; W is [size, in_dim] — the transposed view of
+                # a full_matrix/fc weight, enabling weight sharing
+                in_dim = self._width(x, proj.input)
+                w = L.create_parameter([size, in_dim], "float32", attr=pname)
+                terms.append(L.matmul(x=x, y=w, transpose_y=True))
+            elif proj.ptype == "identity":
+                off = proj.attrs.get("offset")
+                if off is not None:
+                    psize = proj.attrs.get("size") or size
+                    terms.append(
+                        L.slice(x, axes=[1], starts=[off], ends=[off + psize])
+                    )
+                else:
+                    terms.append(x)
+            elif proj.ptype == "table":
+                t = proj.input.attrs.get("type")
+                dict_dim = t.dim if t is not None else self._width(x, proj.input)
+                terms.append(L.embedding(
+                    input=x, size=[dict_dim, size],
+                    param_attr=fluid.ParamAttr(name=pname),
+                ))
+            elif proj.ptype == "context":
+                cl = int(proj.attrs["context_len"])
+                cs = proj.attrs.get("context_start")
+                terms.append(L.sequence_context(
+                    input=x, context_length=cl,
+                    context_start=-(cl // 2) if cs is None else int(cs),
+                ))
+            elif proj.ptype == "dotmul":
+                in_dim = self._width(x, proj.input)
+                w = L.create_parameter([in_dim], "float32", attr=pname)
+                terms.append(L.elementwise_mul(x=x, y=w))
+            elif proj.ptype == "scaling":
+                w = L.create_parameter([1], "float32", attr=pname)
+                terms.append(L.elementwise_mul(x=x, y=w))
+            else:
+                raise NotImplementedError("projection %r" % proj.ptype)
+        out = terms[0] if len(terms) == 1 else L.sums(input=terms)
+        if a.get("bias_attr") not in (None, False):
+            b = L.create_parameter(
+                [size], "float32", attr=node.name + ".wbias", is_bias=True
+            )
+            out = L.elementwise_add(x=out, y=b)
+        act = a.get("act")
+        if act:
+            out = getattr(L, act)(out)
+        return out
+
+    # ------------------------------------------------------------------
+    def _emit_recurrent_group(self, node: Layer):
+        """recurrent_group -> fluid DynamicRNN: the step sub-DAG replays
+        inside rnn.block() with placeholders bound to step/static inputs
+        and memory() nodes to rnn.memory() (reference
+        RecurrentGradientMachine; here one lax.scan, kernels_control)."""
+        from .layer import parse_network
+
+        L = fluid.layers
+        a = node.attrs
+        step_out = a["step_out"]
+        placeholders = a["placeholders"]
+        mems = a["mems"]
+        if a.get("reverse"):
+            raise NotImplementedError(
+                "recurrent_group(reverse=True): feed reversed sequences or "
+                "use lstmemory(reverse=True)"
+            )
+
+        rnn = L.DynamicRNN()
+        ph_ids = {id(p) for p in placeholders} | {id(m) for m in mems}
+        with rnn.block():
+            local: Dict[str, object] = {}
+            self._scopes.append(local)
+            try:
+                for ph in placeholders:
+                    outer = self._var(ph._outer.name)
+                    if ph.kind == "rg_step_in":
+                        local[ph.name] = rnn.step_input(outer)
+                    else:
+                        local[ph.name] = rnn.static_input(outer)
+                mem_pre = {}
+                for m in mems:
+                    boot = m.attrs.get("boot_name")
+                    if boot is not None:
+                        pre = rnn.memory(init=self._var(boot))
+                    else:
+                        pre = rnn.memory(
+                            shape=[int(m.attrs["size"])], value=0.0
+                        )
+                    local[m.name] = pre
+                    mem_pre[m.attrs["ref_name"]] = pre
+                # replay the step sub-DAG (placeholders/memories excluded)
+                for sub in parse_network(step_out):
+                    if id(sub) in ph_ids or sub.name in local:
+                        continue
+                    local[sub.name] = self._emit(sub)
+                    if sub.name in mem_pre:
+                        rnn.update_memory(mem_pre[sub.name], local[sub.name])
+                rnn.output(local[step_out.name])
+            finally:
+                self._scopes.pop()
+        return rnn()
 
     # ------------------------------------------------------------------
     def data_layers(self) -> Dict[str, Layer]:

@@ -1,0 +1,33 @@
+"""Synthetic reverse-copy corpus for the seq2seq legacy-DSL config
+(stands in for the reference demo data; hermetic CI)."""
+
+import numpy as np
+
+from paddle_tpu.trainer.PyDataProvider2 import (
+    integer_value_sequence,
+    provider,
+)
+
+
+def init_hook(settings, dict_dim, num_samples=64, **kwargs):
+    settings.dict_dim = dict_dim
+    settings.num_samples = num_samples
+    settings.slots = [
+        integer_value_sequence(dict_dim),  # src_ids
+        integer_value_sequence(dict_dim),  # trg_ids (shifted right, <s>=1)
+        integer_value_sequence(dict_dim),  # next_ids (trg shifted left, <e>=2)
+    ]
+
+
+@provider(init_hook=init_hook, min_pool_size=-1)
+def process(settings, file_list):
+    rng = np.random.RandomState(0)
+    for _ in range(settings.num_samples):
+        l = int(rng.randint(2, 6))
+        src = rng.randint(3, settings.dict_dim, l)
+        rev = src[::-1]
+        yield (
+            src.tolist(),
+            [1] + rev.tolist(),
+            rev.tolist() + [2],
+        )

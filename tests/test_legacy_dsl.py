@@ -1,0 +1,181 @@
+"""Legacy DSL expansion (VERDICT r2 item 4): mixed_layer + projections,
+recurrent_group + memory, weight sharing via ParamAttr, and CLI execution
+of the reference sample_trainer_config.conf plus a seq2seq config."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.trainer_config_helpers as tch
+from paddle_tpu.trainer import run_config
+from paddle_tpu.v2.topology import Topology
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REF_CONF = "/root/reference/paddle/trainer/tests/sample_trainer_config.conf"
+
+
+def _fresh():
+    tch.reset_config()
+
+
+def test_mixed_layer_numpy_oracle():
+    """mixed = sum of projections; trans_full_matrix shares an fc weight
+    transposed (the sample config's 'sharew' pattern)."""
+    _fresh()
+    data = tch.data_layer(name="mx_in", size=4)
+    fc4 = tch.fc_layer(
+        input=data, size=5, bias_attr=False,
+        act=tch.LinearActivation(),
+        param_attr=tch.ParamAttr(name="mx_share"),
+    )
+    with tch.mixed_layer(size=4, act=tch.LinearActivation()) as m:
+        m += tch.full_matrix_projection(input=data)
+        m += tch.trans_full_matrix_projection(
+            input=fc4, param_attr=tch.ParamAttr(name="mx_share"))
+    tch.outputs(m)
+
+    topo = Topology([m])
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 4).astype(np.float32)
+        # overwrite params with known values
+        W_share = rng.randn(4, 5).astype(np.float32)
+        W_full = rng.randn(4, 4).astype(np.float32)
+        scope.set("mx_share", W_share)
+        full_name = [
+            k for k in scope.keys() if k.startswith(m.name) and k != "mx_share"
+        ]
+        assert len(full_name) == 1, full_name
+        scope.set(full_name[0], W_full)
+        (got,) = exe.run(
+            topo.main_program, feed={"mx_in": x}, fetch_list=[topo.var_of[m.name]]
+        )
+    want = x @ W_full + (x @ W_share) @ W_share.T
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_identity_and_context_projection():
+    _fresh()
+    data = tch.data_layer(name="cx_in", size=3)
+    with tch.mixed_layer(size=3) as m:
+        m += tch.identity_projection(input=data)
+    with tch.mixed_layer(size=6) as c:
+        c += tch.context_projection(input=data, context_len=2,
+                                    context_start=0)
+    topo = Topology([m, c])
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        lod = np.array([0, 2, 4], np.int32)
+        ident, ctx = exe.run(
+            topo.main_program, feed={"cx_in": (x, [lod])},
+            fetch_list=[topo.var_of[m.name], topo.var_of[c.name]],
+        )
+    np.testing.assert_allclose(ident, x)
+    # row t = [x[t], x[t+1]] zero-padded at each sequence end
+    want = np.zeros((4, 6), np.float32)
+    want[:, :3] = x
+    want[0, 3:] = x[1]
+    want[2, 3:] = x[3]
+    np.testing.assert_allclose(ctx, want)
+
+
+def test_recurrent_group_trains():
+    """sequence_rnn.conf shape: embedding -> recurrent_group(step with
+    memory) -> last_seq -> fc -> classification_cost."""
+    _fresh()
+    dict_dim, word_dim, hidden, label_dim = 10, 8, 8, 3
+    data = tch.data_layer(name="rg_word", size=dict_dim)
+    emb = tch.embedding_layer(input=data, size=word_dim)
+
+    def step(y):
+        mem = tch.memory(name="rg_state", size=hidden)
+        out = tch.fc_layer(
+            input=[y, mem], size=hidden, act=tch.TanhActivation(),
+            bias_attr=True, name="rg_state",
+        )
+        return out
+
+    out = tch.recurrent_group(name="rg_rnn", step=step, input=emb)
+    rep = tch.last_seq(input=out)
+    prob = tch.fc_layer(input=rep, size=label_dim,
+                        act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name="rg_label", size=label_dim)
+    cost = tch.classification_cost(input=prob, label=lbl)
+
+    topo = Topology([cost])
+    cost_var = topo.var_of[cost.name]
+    with fluid.program_guard(topo.main_program, topo.startup_program):
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(cost_var)
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    lens = [3, 2, 4, 3]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    words = rng.randint(0, dict_dim, (sum(lens), 1)).astype(np.int64)
+    labels = rng.randint(0, label_dim, (len(lens), 1)).astype(np.int64)
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(
+                topo.main_program,
+                feed={"rg_word": (words, [lod]), "rg_label": labels},
+                fetch_list=[cost_var],
+            )
+            losses.append(float(np.ravel(lv)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+@pytest.mark.skipif(not os.path.exists(REF_CONF),
+                    reason="reference tree not mounted")
+def test_sample_trainer_config_runs_via_cli():
+    """The unmodified reference config (mixed_layer with 8 projections,
+    shared transposed weight, BRelu/SoftRelu/Square activations) trains
+    through the CLI path."""
+    summary = run_config(REF_CONF, job="train", num_passes=1)
+    assert np.isfinite(summary["cost"]), summary
+    assert summary["batches"] >= 2
+
+
+def test_sample_trainer_config_lowering_golden():
+    """DSL->Program structural golden: exec the reference config and
+    check the lowered op sequence (guards the lowering, reference
+    config_parser semantics)."""
+    if not os.path.exists(REF_CONF):
+        pytest.skip("reference tree not mounted")
+    from paddle_tpu.trainer import _exec_config
+
+    state = _exec_config(REF_CONF, {})
+    topo = Topology(state["outputs"])
+    ops = [op.type for op in topo.main_program.global_block().ops]
+    # 8 fc muls + 1 full-matrix mul... mixed: 7 full_matrix muls + 1
+    # transposed matmul, summed
+    assert ops.count("mul") >= 15, ops
+    assert ops.count("matmul") == 1, ops  # the trans_full_matrix share
+    assert "sum" in ops
+    assert ops.count("softmax") == 1
+    assert ops[-1] == "mean"  # classification cost tail
+    # the shared parameter appears exactly once among startup inits
+    startup_params = [
+        op.outputs["Out"][0] for op in
+        topo.startup_program.global_block().ops if "Out" in op.outputs
+    ]
+    assert startup_params.count("sharew") >= 1
+
+
+def test_seq2seq_config_via_cli():
+    """A seqToseq-style config (recurrent_group decoder with
+    context-booted memory + mixed_layer update) trains via the CLI."""
+    conf = os.path.join(HERE, "configs", "seq2seq_train.conf")
+    summary = run_config(conf, job="train", num_passes=3)
+    assert np.isfinite(summary["cost"]), summary
+    assert summary["cost"] < summary["first_cost"], summary
