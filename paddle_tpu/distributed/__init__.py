@@ -9,11 +9,14 @@ aggregation itself needs no service at all on TPU — it is a psum over ICI
 data dispatch and durable state.
 """
 
-from .coordinator import Coordinator, MasterClient, Task
+from .coordinator import (Coordinator, CoordinatorServer, MasterClient,
+                          RemoteCoordinator, Task)
 from .checkpoint import load_checkpoint, save_checkpoint
 
 __all__ = [
     "Coordinator",
+    "CoordinatorServer",
+    "RemoteCoordinator",
     "MasterClient",
     "Task",
     "save_checkpoint",

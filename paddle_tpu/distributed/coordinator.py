@@ -19,12 +19,17 @@ from __future__ import annotations
 
 import json
 import os
+import socket
+import socketserver
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-__all__ = ["Task", "Coordinator", "MasterClient"]
+__all__ = [
+    "Task", "Coordinator", "MasterClient", "CoordinatorServer",
+    "RemoteCoordinator",
+]
 
 
 @dataclass
@@ -181,6 +186,168 @@ class Coordinator(object):
         self.todo += [Task.from_json(d) for d in state["pending"]]
         self.done = [Task.from_json(d) for d in state["done"]]
         self.discarded = [Task.from_json(d) for d in state["discarded"]]
+
+
+class CoordinatorServer(object):
+    """TCP/JSON transport for a Coordinator: task leases survive process
+    boundaries, making the coordinator a SERVICE like the reference Go
+    master (go/master/service.go:280,368 serves net/rpc; here the frames
+    are newline-delimited JSON — no proto toolchain needed at runtime).
+
+    Wire format, one JSON object per line:
+      -> {"method": "get_task", "params": {...}}
+      <- {"ok": true, "result": ...} | {"ok": false, "error": "..."}
+    """
+
+    _METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
+                "ping")
+
+    def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.coordinator = coordinator
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    try:
+                        req = json.loads(line)
+                        resp = outer._dispatch(req)
+                    except Exception as e:  # malformed frame / internal
+                        resp = {"ok": False, "error": str(e)}
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode()
+                    )
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = None
+
+    @property
+    def address(self) -> str:
+        return "%s:%d" % (self.host, self.port)
+
+    def _dispatch(self, req):
+        method = req.get("method")
+        params = req.get("params") or {}
+        if method not in self._METHODS:
+            return {"ok": False, "error": "unknown method %r" % method}
+        if method == "ping":
+            return {"ok": True, "result": "pong"}
+        if method == "set_dataset":
+            self.coordinator.set_dataset(params["shards"])
+            return {"ok": True, "result": None}
+        if method == "get_task":
+            task = self.coordinator.get_task(
+                epoch_limit=params.get("epoch_limit")
+            )
+            return {"ok": True,
+                    "result": task.to_json() if task else None}
+        if method == "task_finished":
+            self.coordinator.task_finished(int(params["task_id"]))
+            return {"ok": True, "result": None}
+        self.coordinator.task_failed(int(params["task_id"]))
+        return {"ok": True, "result": None}
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteCoordinator(object):
+    """Client-side proxy with the Coordinator's lease API, usable by
+    MasterClient unchanged (reference go/master/client.go over net/rpc).
+    Reconnects on broken connections; lease safety comes from the
+    server-side timeout, not the transport."""
+
+    def __init__(self, address: str, timeout_s: float = 30.0):
+        host, _, port = address.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.timeout_s = timeout_s
+        self._sock = None
+        self._file = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        self.close()
+        s = socket.create_connection(self.addr, timeout=self.timeout_s)
+        self._sock = s
+        self._file = s.makefile("rwb")
+
+    def _call(self, method, **params):
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._file is None:
+                        self._connect()
+                    self._file.write(
+                        (json.dumps({"method": method, "params": params})
+                         + "\n").encode()
+                    )
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        raise ConnectionError("server closed connection")
+                    resp = json.loads(line)
+                    break
+                except (OSError, ConnectionError):
+                    self.close()
+                    if attempt:
+                        raise
+        if not resp.get("ok"):
+            raise RuntimeError(
+                "coordinator error: %s" % resp.get("error")
+            )
+        return resp.get("result")
+
+    # Coordinator lease API ------------------------------------------------
+    def ping(self):
+        return self._call("ping")
+
+    def set_dataset(self, shards):
+        return self._call("set_dataset", shards=shards)
+
+    def get_task(self, epoch_limit: Optional[int] = None):
+        d = self._call("get_task", epoch_limit=epoch_limit)
+        return Task.from_json(d) if d is not None else None
+
+    def task_finished(self, task_id: int):
+        return self._call("task_finished", task_id=task_id)
+
+    def task_failed(self, task_id: int):
+        return self._call("task_failed", task_id=task_id)
+
+    def close(self):
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
 
 class MasterClient(object):

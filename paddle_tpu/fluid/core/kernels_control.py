@@ -740,3 +740,21 @@ def _beam_search_decode(ctx, ins, attrs):
     if "SentenceLens" in op.outputs:
         outs["SentenceLens"] = lens
     return outs
+
+
+@register_op("beam_init")
+def _beam_init(ctx, ins, attrs):
+    """Synthesize generation-start ids/scores (one <bos> per source row of
+    X) with the 2-level beam side-bands the beam_search kernel expects —
+    the reference builds these inside RecurrentGradientMachine's
+    generation path (RecurrentGradientMachine.h:307) rather than feeding
+    them."""
+    x = ins["X"][0]
+    B = x.shape[0]
+    bos = int(attrs["bos_id"])
+    ids = jnp.full((B, 1), bos, jnp.int32)
+    scores = jnp.ones((B, 1), jnp.float32)
+    off = jnp.arange(B + 1, dtype=jnp.int32)
+    for out_name in (ctx.op.outputs["Ids"][0], ctx.op.outputs["Scores"][0]):
+        set_sidebands(ctx.env, out_name, {"@LOD0": off, LOD_SRC: off})
+    return {"Ids": ids, "Scores": scores}

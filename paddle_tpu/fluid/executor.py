@@ -434,24 +434,37 @@ def _globalize_feeds(mesh, feed_arrays, scanned_feeds=()):
     built from divergent per-process data would desynchronise training
     undetectably. On a mesh with NO 'data' axis (pure TP/SP serving),
     feeds replicate; every process must then feed identical values.
-    Ragged (LoD) feeds are not supported across processes — their
-    per-process shapes diverge, which would desynchronise the SPMD
-    trace."""
+
+    Ragged (LoD) feeds: every process contributes its local packed rows
+    + offsets through a host allgather, and the exact global packed
+    array + global offsets are rebuilt and fed REPLICATED (see
+    _globalize_ragged — the offsets-vector LoD contract cannot express
+    the inter-block gaps a sharded-padded layout would need). Every
+    process must feed the same NUMBER of sequences (equal local batch,
+    the SPMD contract); lengths may diverge freely (reference:
+    variable-length Arguments per trainer, parameter/Argument.h:84)."""
     import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec
 
     has_data = "data" in mesh.axis_names
     n_data = mesh.shape.get("data", 1)
     out = {}
+    lod_bases = {
+        n[: -len(LOD_SUFFIX)] for n in feed_arrays if n.endswith(LOD_SUFFIX)
+    }
     for name, arr in feed_arrays.items():
         if isinstance(arr, _jax.Array) and not arr.is_fully_addressable:
             out[name] = arr  # caller already built a global array
             continue
+        if name in lod_bases:
+            _globalize_ragged(mesh, feed_arrays, name, out)
+            continue
         if "@" in name:
+            if name.split("@")[0] in lod_bases:
+                continue  # handled together with its base feed
             raise NotImplementedError(
-                "LoD/ragged feeds are not supported on a multi-process "
-                "mesh yet (feed %r); pad or bucket on the host first"
-                % name
+                "feed %r: only @LOD side-bands are supported on a "
+                "multi-process mesh" % name
             )
         arr = np.asarray(arr)
         batch_axis = 1 if name in scanned_feeds else 0
@@ -474,6 +487,82 @@ def _globalize_feeds(mesh, feed_arrays, scanned_feeds=()):
                 % (name, arr.shape, n_data, _jax.process_count(), e)
             )
     return out
+
+
+def _globalize_ragged(mesh, feed_arrays, name, out):
+    """Assemble a global ragged feed: every process contributes its local
+    packed rows + offsets via a host allgather (transport-padded to a
+    power-of-two bucket so shapes agree), and the TRUE global packed
+    array + exact global offsets are rebuilt host-side and fed
+    replicated. Exact semantics — the global batch is byte-identical to
+    a single process feeding all sequences, so losses match the
+    single-process oracle.
+
+    Perf note: the ragged payload replicates across processes (token ids
+    and LoD side-bands are small next to activations; the reference's
+    pserver path likewise shipped whole Arguments per trainer,
+    Argument.h:84). Sharding the packed rows over 'data' instead would
+    need per-sequence (start, len) gaps that the offsets-vector LoD
+    contract cannot express."""
+    import jax as _jax
+    from jax.experimental import multihost_utils
+
+    data = np.asarray(feed_arrays[name])
+    offsets = np.asarray(feed_arrays[lod_key(name)], np.int32)
+    nproc = _jax.process_count()
+    total = data.shape[0]
+    n_seqs = offsets.shape[0] - 1
+
+    # agree on shapes: [total, n_seqs] from every process
+    gathered = np.asarray(
+        multihost_utils.process_allgather(
+            np.asarray([total, n_seqs], np.int64)
+        )
+    ).reshape(nproc, 2)
+    if not (gathered[:, 1] == n_seqs).all():
+        raise ValueError(
+            "ragged feed %r: every process must feed the SAME number of "
+            "sequences (got %s); lengths may differ, counts may not"
+            % (name, gathered[:, 1].tolist())
+        )
+    bucket = 8
+    while bucket < int(gathered[:, 0].max()):
+        bucket *= 2
+
+    pad = bucket - total
+    padded = np.concatenate(
+        [data, np.zeros((pad,) + data.shape[1:], data.dtype)]
+    ) if pad else data
+    all_data = np.asarray(
+        multihost_utils.process_allgather(padded)
+    ).reshape((nproc, bucket) + data.shape[1:])
+    all_offsets = np.asarray(
+        multihost_utils.process_allgather(offsets.astype(np.int64))
+    ).reshape(nproc, n_seqs + 1)
+
+    # strip transport padding; rebuild the exact global packed array
+    out[name] = np.concatenate(
+        [all_data[p, : int(all_offsets[p, -1])] for p in range(nproc)]
+    )
+    parts = [np.zeros((1,), np.int64)]
+    base = 0
+    for p in range(nproc):
+        parts.append(all_offsets[p, 1:] + base)
+        base += int(all_offsets[p, -1])
+    out[lod_key(name)] = np.concatenate(parts).astype(np.int32)
+
+    src_key = name + LOD_SRC
+    if src_key in feed_arrays:
+        src = np.asarray(feed_arrays[src_key], np.int64)
+        all_src = np.asarray(
+            multihost_utils.process_allgather(src)
+        ).reshape(nproc, -1)
+        sparts = [np.zeros((1,), np.int64)]
+        sbase = 0
+        for p in range(nproc):
+            sparts.append(all_src[p, 1:] + sbase)
+            sbase += int(all_src[p, -1])
+        out[src_key] = np.concatenate(sparts).astype(np.int32)
 
 
 def _mesh_jit_kwargs(

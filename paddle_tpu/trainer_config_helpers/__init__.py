@@ -40,8 +40,9 @@ __all__ = [
     "mixed_layer", "full_matrix_projection", "trans_full_matrix_projection",
     "identity_projection", "table_projection", "context_projection",
     "dotmul_projection", "scaling_projection",
-    # recurrent machinery
-    "recurrent_group", "memory", "StaticInput",
+    # recurrent machinery + generation
+    "recurrent_group", "memory", "StaticInput", "GeneratedInput",
+    "beam_search",
     # activations
     "ReluActivation", "SoftmaxActivation", "LinearActivation",
     "TanhActivation", "SigmoidActivation", "IdentityActivation",
@@ -620,6 +621,74 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         "mems": mems,
     })
     return node
+
+
+class GeneratedInput(object):
+    """Generation-mode step input: the embedding of the previous step's
+    predicted word (reference layers.py GeneratedInput / the generation
+    path of RecurrentGradientMachine)."""
+
+    def __init__(self, size, embedding_name, embedding_size, **kwargs):
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size=1,
+                num_results_per_sample=None, max_length=10, name=None,
+                **kwargs):
+    """Legacy generation (reference layers.py beam_search ->
+    RecurrentGradientMachine::generateSequence/beamSearch,
+    RecurrentGradientMachine.h:307,309): run `step` up to `max_length`
+    times, feeding back the embedded best words, keeping `beam_size`
+    candidates per source. Lowered to the fluid While + beam_search +
+    beam_search_decode machinery (compiled fori_loop,
+    core/kernels_control.py); returns the decoded sentence-id layer."""
+    if num_results_per_sample not in (None, beam_size):
+        raise NotImplementedError(
+            "beam_search returns the full beam width per source; "
+            "num_results_per_sample=%r != beam_size=%r is not supported"
+            % (num_results_per_sample, beam_size)
+        )
+    inputs = _as_list(input)
+    gen = None
+    placeholders, static_phs = [], []
+    for inp in inputs:
+        if isinstance(inp, GeneratedInput):
+            ph = Layer("rg_gen_in", None, [], {"size": inp.embedding_size})
+            gen = inp
+            placeholders.append(ph)
+        elif isinstance(inp, StaticInput):
+            ph = Layer("rg_static_in", None, [], {})
+            ph._outer = inp.input
+            static_phs.append(ph)
+            placeholders.append(ph)
+        else:
+            raise TypeError(
+                "beam_search inputs must be StaticInput/GeneratedInput"
+            )
+    if gen is None:
+        raise ValueError("beam_search needs a GeneratedInput")
+
+    _rg_stack.append([])
+    try:
+        out = step(*placeholders)
+    finally:
+        mems = _rg_stack.pop()
+    parents = [ph._outer for ph in static_phs] + [
+        m._boot_layer for m in mems if m._boot_layer is not None
+    ]
+    return Layer("beam_gen", name, parents, {
+        "step_out": out,
+        "placeholders": placeholders,
+        "static_phs": static_phs,
+        "mems": mems,
+        "gen": gen,
+        "bos_id": int(bos_id),
+        "eos_id": int(eos_id),
+        "beam_size": int(beam_size),
+        "max_length": int(max_length),
+    })
 
 
 def expand_layer(input, expand_as, name=None, **kwargs):

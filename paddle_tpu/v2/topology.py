@@ -197,6 +197,8 @@ class Topology(object):
             return self._emit_mixed(node)
         if node.kind == "recurrent_group":
             return self._emit_recurrent_group(node)
+        if node.kind == "beam_gen":
+            return self._emit_beam_gen(node)
         if node.kind == "seq_expand":
             x, y = self._ins(node)
             return L.sequence_expand(x, y)
@@ -238,6 +240,8 @@ class Topology(object):
                 return int(a["size"])
             boot = getattr(node, "_boot_layer", None)
             return self._node_width(boot) if boot is not None else None
+        if node.kind == "rg_gen_in":
+            return int(a["size"])
         if node.kind in ("rg_step_in", "rg_static_in"):
             return self._node_width(node._outer)
         if node.parents:
@@ -308,6 +312,104 @@ class Topology(object):
         if act:
             out = getattr(L, act)(out)
         return out
+
+    # ------------------------------------------------------------------
+    def _emit_beam_gen(self, node: Layer):
+        """Legacy beam_search generation (reference
+        RecurrentGradientMachine::generateSequence:307/beamSearch:309):
+        lowered to the fluid While + beam_search + beam_search_decode
+        machinery, which compiles to peel + ONE lax.fori_loop
+        (core/kernels_control.py). The step replays per iteration with
+        the GeneratedInput placeholder bound to the embedding of the
+        previous step's selected words and StaticInputs expanded to the
+        live beam width. Returns the decoded sentence-id layer
+        (reference default output "__beam_search_predict__");
+        num_results_per_sample is the full beam width here."""
+        from .layer import parse_network
+
+        L = fluid.layers
+        a = node.attrs
+        if a["mems"]:
+            raise NotImplementedError(
+                "beam_search step functions with memory() are not "
+                "supported yet; carry state through the generated words"
+            )
+        gen = a["gen"]
+        placeholders = a["placeholders"]
+        statics = a["static_phs"]
+        if statics:
+            anchor = self._var(statics[0]._outer.name)
+        else:
+            raise ValueError(
+                "beam_search needs at least one StaticInput to size the "
+                "generation batch (reference: a Memory must have a boot "
+                "layer when generating)"
+            )
+
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("beam_gen")
+        init_ids = helper.create_tmp_variable(dtype="int64")
+        init_scores = helper.create_tmp_variable(dtype="float32")
+        helper.append_op(
+            type="beam_init", inputs={"X": [anchor]},
+            outputs={"Ids": [init_ids], "Scores": [init_scores]},
+            attrs={"bos_id": a["bos_id"]},
+        )
+
+        max_len = L.fill_constant(shape=[1], dtype="int64",
+                                  value=a["max_length"])
+        counter = L.zeros(shape=[1], dtype="int64", force_cpu=True)
+        ids_array = L.create_array("int64")
+        scores_array = L.create_array("float32")
+        L.array_write(init_ids, array=ids_array, i=counter)
+        L.array_write(init_scores, array=scores_array, i=counter)
+
+        cond = L.less_than(x=counter, y=max_len)
+        while_op = L.While(cond=cond)
+        ph_ids = {id(p) for p in placeholders}
+        with while_op.block():
+            pre_ids = L.array_read(array=ids_array, i=counter)
+            pre_score = L.array_read(array=scores_array, i=counter)
+            emb = L.embedding(
+                input=pre_ids,
+                size=[gen.size, gen.embedding_size],
+                param_attr=fluid.ParamAttr(name=gen.embedding_name),
+            )
+            local: Dict[str, object] = {}
+            self._scopes.append(local)
+            try:
+                for ph in placeholders:
+                    if ph.kind == "rg_gen_in":
+                        local[ph.name] = emb
+                    else:  # static: expand to the live beam width
+                        local[ph.name] = L.sequence_expand(
+                            self._var(ph._outer.name), pre_score
+                        )
+                for sub in parse_network(a["step_out"]):
+                    if id(sub) in ph_ids or sub.name in local:
+                        continue
+                    local[sub.name] = self._emit(sub)
+                out_var = local[a["step_out"].name]
+            finally:
+                self._scopes.pop()
+            # topk width: twice the beam, capped at the vocab size
+            k = min(int(gen.size), 2 * a["beam_size"])
+            topk_scores, topk_idx = L.topk(out_var, k=k)
+            selected_ids, selected_scores = L.beam_search(
+                pre_ids, topk_idx, topk_scores, a["beam_size"],
+                end_id=a["eos_id"],
+            )
+            L.increment(x=counter, value=1, in_place=True)
+            L.array_write(selected_ids, array=ids_array, i=counter)
+            L.array_write(selected_scores, array=scores_array, i=counter)
+            L.less_than(x=counter, y=max_len, cond=cond)
+
+        sentence_ids, sentence_scores = L.beam_search_decode(
+            ids=ids_array, scores=scores_array
+        )
+        self._bind(node.name + ".scores", sentence_scores)
+        return sentence_ids  # carries .lens_name for per-row true lengths
 
     # ------------------------------------------------------------------
     def _emit_recurrent_group(self, node: Layer):

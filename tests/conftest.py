@@ -30,7 +30,9 @@ jax.config.update("jax_default_matmul_precision", "float32")
 
 @pytest.fixture(autouse=True)
 def _fresh_programs():
-    """Each test gets fresh default programs and a fresh scope."""
+    """Each test gets fresh default programs, a fresh scope, and no
+    leaked default mesh (a test that sets one would silently change how
+    later tests execute)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid.core.program import (
         Program,
@@ -38,11 +40,14 @@ def _fresh_programs():
         switch_startup_program,
     )
     from paddle_tpu.fluid.executor import Scope, switch_scope
+    from paddle_tpu.parallel import mesh as mesh_mod
 
     prev_main = switch_main_program(Program())
     prev_startup = switch_startup_program(Program())
     prev_scope = switch_scope(Scope())
+    prev_mesh = mesh_mod.get_default_mesh()
     yield
     switch_main_program(prev_main)
     switch_startup_program(prev_startup)
     switch_scope(prev_scope)
+    mesh_mod.set_default_mesh(prev_mesh)

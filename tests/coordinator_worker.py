@@ -1,0 +1,69 @@
+"""Worker/server processes for the coordinator-as-a-service test
+(reference Go master + EDL trainers, go/master/service.go:280,368).
+
+Roles:
+  serve  <out> <snapshot> <port> <n_shards> <timeout_s>
+      run a CoordinatorServer over TCP until killed
+  work   <out> <addr> [<crash_on_payload>]
+      lease tasks via RemoteCoordinator, append processed records to
+      <out>; if crash_on_payload matches a leased task and no marker
+      file exists yet, hard-exit MID-LEASE (preemption) after writing
+      the marker
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    role = sys.argv[1]
+    out_path = sys.argv[2]
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from paddle_tpu.distributed import (
+        Coordinator, CoordinatorServer, RemoteCoordinator,
+    )
+
+    if role == "serve":
+        snapshot, port, n_shards, timeout_s = sys.argv[3:7]
+        coord = Coordinator(
+            timeout_s=float(timeout_s), failure_max=5,
+            snapshot_path=snapshot,
+        )
+        coord.set_dataset(list(range(int(n_shards))))  # idempotent on recover
+        server = CoordinatorServer(coord, port=int(port))
+        with open(out_path, "w") as f:
+            json.dump({"addr": server.address}, f)
+        server.serve_forever()
+
+    elif role == "work":
+        addr = sys.argv[3]
+        crash_on = int(sys.argv[4]) if len(sys.argv) > 4 else None
+        marker = out_path + ".crashed"
+        client = RemoteCoordinator(addr)
+        while True:
+            task = client.get_task()
+            if task is None:
+                break
+            if (
+                crash_on is not None
+                and task.payload == crash_on
+                and not os.path.exists(marker)
+            ):
+                open(marker, "w").write(str(task.task_id))
+                os._exit(9)  # preempted mid-lease: no task_failed call
+            # "process" the shard: 3 records per payload
+            with open(out_path, "a") as f:
+                for i in range(3):
+                    f.write("%d:%d\n" % (task.payload, i))
+            client.task_finished(task.task_id)
+        client.close()
+
+    else:
+        raise SystemExit("unknown role %r" % role)
+
+
+if __name__ == "__main__":
+    main()

@@ -89,6 +89,59 @@ def train_steps(exe, main, loss, first, last, lo=None, hi=None, report=None):
     return losses
 
 
+VOCAB = 12
+N_SEQS = 8  # global ragged batch: 8 sequences, variable lengths
+
+
+def build_lstm_model():
+    """Ragged-feed model: embedding -> fc(4H) -> dynamic_lstm ->
+    last_seq -> fc softmax -> CE (the multi-process LoD path,
+    VERDICT r2 item 8)."""
+    import paddle_tpu.fluid as fluid
+
+    H = 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data(
+            name="words", shape=[1], dtype="int64", lod_level=1
+        )
+        label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(input=words, size=[VOCAB, 8])
+        proj = fluid.layers.fc(input=emb, size=H * 4)
+        hidden, _ = fluid.layers.dynamic_lstm(input=proj, size=H * 4)
+        last = fluid.layers.sequence_last_step(input=hidden)
+        pred = fluid.layers.fc(input=last, size=3, act="softmax")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=pred, label=label)
+        )
+        fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+    return main, startup, loss
+
+
+def lstm_batch_for(step, lo=None, hi=None):
+    """Deterministic ragged batch; [lo:hi) sequence slice for a process."""
+    rng = np.random.RandomState(777 + step)
+    lens = rng.randint(2, 7, N_SEQS)
+    seqs = [rng.randint(0, VOCAB, l) for l in lens]
+    labels = (np.asarray([s.sum() for s in seqs]) % 3).astype(np.int64)
+    if lo is None:
+        lo, hi = 0, N_SEQS
+    sel = seqs[lo:hi]
+    flat = np.concatenate(sel).reshape(-1, 1).astype(np.int64)
+    offsets = np.cumsum([0] + [len(s) for s in sel]).astype(np.int32)
+    return (flat, [offsets]), labels[lo:hi].reshape(-1, 1)
+
+
+def train_lstm_steps(exe, main, loss, steps, lo=None, hi=None):
+    losses = []
+    for step in range(steps):
+        words, ys = lstm_batch_for(step, lo, hi)
+        (lv,) = exe.run(main, feed={"words": words, "y": ys},
+                        fetch_list=[loss])
+        losses.append(float(np.ravel(lv)[0]))
+    return losses
+
+
 def main():
     role = sys.argv[1]
     out_path = sys.argv[2]
@@ -137,6 +190,35 @@ def main():
         # idle until the harness kills us (simulates a preempted slice)
         while True:
             time.sleep(0.2)
+
+    elif role in ("lstm_dist", "lstm_oracle"):
+        # ragged (LoD) feeds across processes: VERDICT r2 item 8
+        steps = int(sys.argv[4])
+        if role == "lstm_dist":
+            port, pid, nproc = sys.argv[5:8]
+            from paddle_tpu.parallel.mesh import DistributedContext
+
+            DistributedContext.initialize(
+                coordinator_address="localhost:%s" % port,
+                num_processes=int(nproc),
+                process_id=int(pid),
+            )
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.parallel import make_mesh, set_default_mesh
+
+        mesh = make_mesh({"data": jax.device_count()})
+        set_default_mesh(mesh)
+        main_p, startup, loss = build_lstm_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        if role == "lstm_dist":
+            per = N_SEQS // int(nproc)
+            lo, hi = int(pid) * per, (int(pid) + 1) * per
+        else:
+            lo = hi = None
+        result["losses"] = train_lstm_steps(exe, main_p, loss, steps, lo, hi)
+        with open(out_path, "w") as f:
+            json.dump(result, f)
 
     elif role == "dist_resume":
         # N->M restore with M>1: a FRESH pair of coordinated processes

@@ -162,6 +162,11 @@ def test_profiler_per_op_table():
     # sorted by total, descending
     totals = [r["Total"] for r in table]
     assert totals == sorted(totals, reverse=True)
-    # training still happened under the profiler (params updated)
-    w = np.asarray(fluid.global_scope().get("conv2d_0.w_0"))
+    # training still happened under the profiler (params updated);
+    # unique_name counters are process-global, so find the conv weight
+    conv_w = next(
+        k for k in fluid.global_scope().keys()
+        if k.startswith("conv2d_") and k.endswith(".w_0")
+    )
+    w = np.asarray(fluid.global_scope().get(conv_w))
     assert np.isfinite(w).all()

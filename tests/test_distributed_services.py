@@ -250,3 +250,83 @@ def test_distribute_transpiler_api():
         parallel.set_default_mesh(prev_mesh)
 
     assert fluid.memory_optimize(fluid.default_main_program()) is not None
+
+
+# ---------------------------------------------------------------------------
+# coordinator as a TCP/JSON service (Go master parity, service.go:280,368)
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_tcp_service_kill_resume(tmp_path):
+    """Three processes: a coordinator SERVICE + two workers leasing tasks
+    over TCP. One worker is preempted mid-lease (hard exit, no goodbye);
+    the lease times out server-side, the task requeues, and a restarted
+    worker completes it — every record processed at least once and every
+    shard completed (VERDICT r2 item 9 acceptance)."""
+    import json
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    worker_py = os.path.join(os.path.dirname(__file__), "coordinator_worker.py")
+    n_shards = 8
+    serve_out = str(tmp_path / "server.json")
+    snapshot = str(tmp_path / "coord.snap")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    server = subprocess.Popen(
+        [sys.executable, worker_py, "serve", serve_out, snapshot, "0",
+         str(n_shards), "1.5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        for _ in range(200):
+            if os.path.exists(serve_out):
+                break
+            assert server.poll() is None, server.communicate()[1][-2000:]
+            _time.sleep(0.05)
+        addr = json.load(open(serve_out))["addr"]
+
+        out_a = str(tmp_path / "worker_a.txt")
+        out_b = str(tmp_path / "worker_b.txt")
+        # worker A runs ALONE first so it deterministically leases payload
+        # 3 and self-preempts mid-lease
+        wa = subprocess.Popen(
+            [sys.executable, worker_py, "work", out_a, addr, "3"], env=env
+        )
+        wa.wait(timeout=120)
+        assert wa.returncode == 9  # really died mid-lease
+        # worker B drains the rest while A's lease is still pending
+        wb = subprocess.Popen(
+            [sys.executable, worker_py, "work", out_b, addr], env=env
+        )
+        wb.wait(timeout=120)
+        assert wb.returncode == 0
+
+        # restart the preempted worker AFTER the lease expires: the
+        # timed-out task requeues and completes
+        _time.sleep(2.0)
+        wa2 = subprocess.Popen(
+            [sys.executable, worker_py, "work", out_a, addr], env=env
+        )
+        wa2.wait(timeout=120)
+        assert wa2.returncode == 0
+
+        done = set()
+        for path in (out_a, out_b):
+            if os.path.exists(path):
+                for line in open(path):
+                    shard, rec = line.strip().split(":")
+                    done.add((int(shard), int(rec)))
+        want = {(s, r) for s in range(n_shards) for r in range(3)}
+        assert done == want, sorted(want - done)
+        assert os.path.exists(out_a + ".crashed")
+        # the service snapshotted state across the whole run
+        assert os.path.exists(snapshot)
+    finally:
+        if server.poll() is None:
+            server.send_signal(signal.SIGKILL)
+        server.wait()

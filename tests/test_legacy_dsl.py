@@ -179,3 +179,67 @@ def test_seq2seq_config_via_cli():
     summary = run_config(conf, job="train", num_passes=3)
     assert np.isfinite(summary["cost"]), summary
     assert summary["cost"] < summary["first_cost"], summary
+
+
+def test_legacy_beam_search_generation():
+    """Legacy generation (the reference sample_trainer_rnn_gen.conf
+    shape): StaticInput + GeneratedInput with a shared word embedding
+    (trans_full_matrix back onto 'wordvec'), decoded via beam_search.
+    For beam_size=1 the rollout must equal a greedy numpy oracle."""
+    _fresh()
+    num_words = 5
+    max_len = 6
+
+    dummy = tch.data_layer(name="bs_dummy", size=2)
+
+    def step(dummy_memory, predict_word):
+        with tch.mixed_layer(size=num_words) as layer:
+            layer += tch.full_matrix_projection(
+                input=predict_word,
+                param_attr=tch.ParamAttr(name="bs_transtable"))
+        with tch.mixed_layer(size=num_words,
+                             act=tch.ExpActivation()) as out:
+            out += tch.trans_full_matrix_projection(
+                input=layer, param_attr=tch.ParamAttr(name="bs_wordvec"))
+        return out
+
+    gen_inputs = [
+        tch.StaticInput(input=dummy, size=2),
+        tch.GeneratedInput(size=num_words, embedding_name="bs_wordvec",
+                           embedding_size=num_words),
+    ]
+    beam_gen = tch.beam_search(
+        name="bs_gen", step=step, input=gen_inputs, bos_id=0,
+        eos_id=num_words - 1, beam_size=1, max_length=max_len,
+    )
+    topo = Topology([beam_gen])
+
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    B = 3
+    rng = np.random.RandomState(2)
+    emb = rng.randn(num_words, num_words).astype(np.float32) * 0.7
+    trans = rng.randn(num_words, num_words).astype(np.float32) * 0.7
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        scope.set("bs_wordvec", emb)
+        scope.set("bs_transtable", trans)
+        ids_var = topo.var_of[beam_gen.name]
+        ids, lens = exe.run(
+            topo.main_program,
+            feed={"bs_dummy": rng.randn(B, 2).astype(np.float32)},
+            fetch_list=[ids_var, ids_var.lens_name],
+        )
+    assert ids.shape == (B, max_len + 1)
+    assert (ids[:, 0] == 0).all()  # every row starts at <bos>
+
+    # greedy numpy oracle: word -> emb lookup -> @trans -> @emb.T -> argmax
+    for b in range(B):
+        w = 0
+        for t in range(1, max_len + 1):
+            scores = np.exp((emb[w] @ trans) @ emb.T)
+            w = int(np.argmax(scores))
+            if t < lens[b]:
+                assert ids[b, t] == w, (b, t, ids[b], w)
+            if w == num_words - 1:
+                break

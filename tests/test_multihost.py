@@ -220,3 +220,41 @@ def test_sharded_checkpoint_round_trip_in_process():
         f.write(raw[:-4] + b"\x00\x00\x00\x01")
     with pytest.raises((IOError, ValueError)):
         ckpt.load_checkpoint(fluid.executor.Scope(), d)
+
+
+def test_two_process_ragged_lstm(tmp_path):
+    """Ragged (LoD) feeds across a 2-process mesh (VERDICT r2 item 8):
+    each process feeds its half of a variable-length batch; padded
+    packed blocks shard over 'data' with global offsets replicated. The
+    global loss sequence matches across processes AND matches the
+    single-process oracle on the same global batches."""
+    port = _free_port()
+    steps = 4
+    outs = [str(tmp_path / ("lstm_p%d.json" % i)) for i in range(2)]
+    procs = [
+        _spawn(["lstm_dist", outs[i], "-", steps, port, i, 2], devices=4)
+        for i in range(2)
+    ]
+    try:
+        for o in outs:
+            assert _wait_file(o, procs), "lstm_dist worker never reported"
+        results = [json.load(open(o)) for o in outs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait()
+    np.testing.assert_allclose(
+        results[0]["losses"], results[1]["losses"], rtol=1e-5
+    )
+
+    oracle_out = str(tmp_path / "lstm_oracle.json")
+    p = _spawn(["lstm_oracle", oracle_out, "-", steps], devices=8)
+    rc = p.wait(timeout=600)
+    _, err = p.communicate()
+    assert rc == 0, err[-4000:]
+    oracle = json.load(open(oracle_out))
+    np.testing.assert_allclose(
+        results[0]["losses"], oracle["losses"], rtol=1e-4, atol=1e-6
+    )

@@ -538,6 +538,15 @@ spec("sequence_pool_last", op="sequence_pool",
      ins={"X": _seqx}, attrs={"pooltype": "LAST"},
      lods={"sequence_pool_x_0": _lod6}, grad=True,
      oracle=lambda i, a: {"Out": np.stack([i["X"][1], i["X"][5]])})
+spec("sequence_context",
+     ins={"X": R(84).randn(6, 3).astype(np.float32)},
+     attrs={"context_length": 2, "context_start": 0},
+     lods={"sequence_context_x_0": _lod6}, grad=True,
+     oracle=lambda i, a: {"Out": np.concatenate([
+         i["X"],
+         np.concatenate([i["X"][1:2], np.zeros((1, 3), np.float32),
+                         i["X"][3:6], np.zeros((1, 3), np.float32)]),
+     ], axis=1)})
 spec("sequence_softmax", ins={"X": R(81).randn(6, 1).astype(np.float32)},
      lods={"sequence_softmax_x_0": _lod6}, grad=True,
      gtol=(8e-2, 1e-3),
@@ -720,6 +729,8 @@ EXEMPT = {
     "array_length": "tensor-array plumbing; test_control_flow.py",
     "dynamic_rnn": "lax.scan machinery; test_rnn_ops.py + book tests",
     "beam_search": "stateful decode step; test_machine_translation.py",
+    "beam_init": "generation bootstrap (ids/scores constants + beam "
+                 "side-bands); covered by test_legacy_dsl.py beam gen",
     "beam_search_decode": "decode assembly; test_machine_translation.py",
     "lstm": "full-sequence kernel; gradient-checked via dynamic_lstm in "
             "test_rnn_ops.py (lstm_unit grad-checked here)",
