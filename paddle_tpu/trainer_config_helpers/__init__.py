@@ -309,16 +309,19 @@ def fc_layer(input, size, act=None, name=None, bias_attr=None,
 
 
 def _ensure_image(node, num_channels):
-    """Insert a reshape node when the input is still flat (square images,
-    config_parser's inference) and return (input_node, (c, h, w))."""
+    """Insert a reshape node when the input is still flat (data layers
+    are fed [N, size] even when height/width declare image geometry;
+    square images are config_parser's inference) and return
+    (input_node, (c, h, w))."""
     shape = getattr(node, "im_shape", None)
-    if shape is not None:
+    if shape is not None and node.kind != "data":
         return node, shape
     if node.kind == "data":
-        size = node.attrs["type"].dim
-        c = num_channels or 3
-        hw = int(round(math.sqrt(size // c)))
-        shape = (c, hw, hw)
+        if shape is None:
+            size = node.attrs["type"].dim
+            c = num_channels or 3
+            hw = int(round(math.sqrt(size // c)))
+            shape = (c, hw, hw)
         r = Layer("im_reshape", None, [node], {"shape": list(shape)})
         r.im_shape = shape
         return r, shape
@@ -741,3 +744,237 @@ def img_conv_group(input, conv_num_filter, conv_filter_size=3,
         input=tmp, pool_size=pool_size, stride=pool_stride,
         pool_type=pool_type,
     )
+
+
+# ---------------------------------------------------------------------
+# breadth wrappers (reference layers.py; each lowers onto an existing
+# fluid layer/kernel — see v2/topology.py for the lowering)
+# ---------------------------------------------------------------------
+
+
+def _simple(kind, inputs, **attrs):
+    name = attrs.pop("name", None)
+    return Layer(kind, name, _as_list(inputs), attrs)
+
+
+def cos_sim(a, b, scale=1.0, name=None, **kwargs):
+    return _simple("cos_sim", [a, b], name=name, scale=scale)
+
+
+def trans_layer(input, name=None, **kwargs):
+    return _simple("trans", input, name=name)
+
+
+def power_layer(input, weight, name=None, **kwargs):
+    """y_ij = x_ij ^ w_i (reference PowerLayer)."""
+    return _simple("power", [input, weight], name=name)
+
+
+def scaling_layer(input, weight, name=None, **kwargs):
+    """row i scaled by weight row i (reference ScalingLayer)."""
+    return _simple("scaling", [input, weight], name=name)
+
+
+def interpolation_layer(input, weight, name=None, **kwargs):
+    """w*a + (1-w)*b over input=[a, b] (reference InterpolationLayer)."""
+    a, b = _as_list(input)
+    return _simple("interpolation", [a, b, weight], name=name)
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None,
+                          **kwargs):
+    return _simple("slope_intercept", input, name=name,
+                   slope=float(slope), intercept=float(intercept))
+
+
+def sum_to_one_norm_layer(input, name=None, **kwargs):
+    return _simple("sum_to_one_norm", input, name=name)
+
+
+def row_l2_norm_layer(input, name=None, **kwargs):
+    return _simple("row_l2_norm", input, name=name)
+
+
+def dot_prod_layer(a, b, name=None, **kwargs):
+    return _simple("dot_prod", [a, b], name=name)
+
+
+def out_prod_layer(a, b, name=None, **kwargs):
+    return _simple("out_prod", [a, b], name=name)
+
+
+def l2_distance_layer(a, b, name=None, **kwargs):
+    return _simple("l2_distance", [a, b], name=name)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None, **kwargs):
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], None)
+    pad_c, pad_h, pad_w = pad_c or [0, 0], pad_h or [0, 0], pad_w or [0, 0]
+    node = _simple("pad_img", inp, name=name,
+                   pad_c=list(pad_c), pad_h=list(pad_h), pad_w=list(pad_w))
+    node.im_shape = (c + sum(pad_c), h + sum(pad_h), w + sum(pad_w))
+    return node
+
+
+def clip_layer(input, min, max, name=None, **kwargs):  # noqa: A002
+    return _simple("clip", input, name=name, min=float(min), max=float(max))
+
+
+def multiplex_layer(input, name=None, **kwargs):
+    """input[0] = int selector, rest = candidates (reference Multiplex)."""
+    ins = _as_list(input)
+    if ins[0].kind == "data":
+        ins[0].attrs["type"].type = 3  # the selector is an id slot
+    return _simple("multiplex", ins, name=name)
+
+
+def row_conv_layer(input, context_len, act=None, name=None, **kwargs):
+    return _simple("row_conv", input, name=name,
+                   context_len=int(context_len), act=_act_name(act))
+
+
+def maxout_layer(input, groups, num_channels=None, name=None, **kwargs):
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], num_channels)
+    node = _simple("maxout", inp, name=name, groups=int(groups))
+    node.im_shape = (c // int(groups), h, w)
+    return node
+
+
+def block_expand_layer(input, block_x=1, block_y=1, stride_x=1, stride_y=1,
+                       padding_x=0, padding_y=0, num_channels=None,
+                       name=None, **kwargs):
+    """Image -> sequence of blocks (reference BlockExpandLayer; fluid
+    im2sequence)."""
+    input, _ = _ensure_image(_as_list(input)[0], num_channels)
+    return _simple("block_expand", input, name=name,
+                   block=[int(block_y), int(block_x)],
+                   stride=[int(stride_y), int(stride_x)],
+                   padding=[int(padding_y), int(padding_x)],
+                   num_channels=num_channels)
+
+
+def seq_reshape_layer(input, reshape_size, name=None, **kwargs):
+    return _simple("seq_reshape", input, name=name,
+                   new_dim=int(reshape_size))
+
+
+def repeat_layer(input, num_repeats, name=None, **kwargs):
+    return _simple("repeat", input, name=name, num_repeats=int(num_repeats))
+
+
+def recurrent_layer(input, act=None, reverse=False, name=None,
+                    param_attr=None, bias_attr=None, **kwargs):
+    """Simple full-matrix recurrence (reference RecurrentLayer):
+    h_t = act(x_t + W h_{t-1}) — sugar over recurrent_group."""
+    if reverse:
+        raise NotImplementedError("recurrent_layer(reverse=True)")
+    act = act or TanhActivation()
+    inp = _as_list(input)[0]
+    if name is None:
+        # auto-unique like every other wrapper (two unnamed recurrences
+        # must not share a state name or weight)
+        i = Layer._counters.get("recurrent_layer", 0)
+        Layer._counters["recurrent_layer"] = i + 1
+        name = "__recurrent_layer_%d__" % i
+
+    def step(y):
+        mem = memory(name=name + "@state", size=None)
+        out_ = _simple("recurrent_step", [y, mem], name=name + "@state",
+                       act=_act_name(act), param_attr=param_attr)
+        return out_
+
+    return recurrent_group(step=step, input=inp, name=name)
+
+
+def ctc_layer(input, label, size=None, blank=None, norm_by_times=False,
+              name=None, **kwargs):
+    return _simple("ctc_cost", [input, _label_node(label)], name=name,
+                   blank=int(blank if blank is not None else (size or 1) - 1),
+                   norm_by_times=norm_by_times)
+
+
+def warp_ctc_layer(input, label, size=None, blank=0, norm_by_times=False,
+                   name=None, **kwargs):
+    """Reference warp_ctc_layer: blank DEFAULTS TO 0 (ctc_layer's blank
+    defaults to size-1)."""
+    return _simple("ctc_cost", [input, _label_node(label)], name=name,
+                   blank=int(blank), norm_by_times=norm_by_times)
+
+
+def crf_layer(input, label, size=None, param_attr=None, name=None, **kwargs):
+    return _simple("crf_cost", [input, _label_node(label)], name=name,
+                   param_attr=param_attr)
+
+
+def crf_decoding_layer(input, size=None, param_attr=None, label=None,
+                       name=None, **kwargs):
+    return _simple("crf_decode", [input], name=name, param_attr=param_attr)
+
+
+def nce_layer(input, label, num_classes, num_neg_samples=10, name=None,
+              **kwargs):
+    return _simple("nce_cost", _as_list(input) + [_label_node(label)],
+                   name=name,
+                   num_classes=int(num_classes),
+                   num_neg_samples=int(num_neg_samples))
+
+
+def hsigmoid(input, label, num_classes, name=None, **kwargs):
+    return _simple("hsigmoid_cost", _as_list(input) + [_label_node(label)],
+                   name=name,
+                   num_classes=int(num_classes))
+
+
+def rank_cost(left, right, label, name=None, **kwargs):
+    return _simple("rank_cost", [left, right, label], name=name)
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None, **kwargs):
+    return _simple("huber_cost", [input, label], name=name,
+                   delta=float(delta))
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **kwargs):
+    return _simple("multi_binary_ce", [input, label], name=name)
+
+
+def smooth_l1_cost(input, label, name=None, **kwargs):
+    return _simple("smooth_l1_cost", [input, label], name=name)
+
+
+def sum_cost(input, name=None, **kwargs):
+    return _simple("sum_cost", input, name=name)
+
+
+def square_error_cost(input, label, name=None, **kwargs):
+    return mse_cost(input, label, name=name)
+
+
+def scale_shift_layer(input, name=None, param_attr=None, bias_attr=None,
+                      **kwargs):
+    """y = w*x + b with ONE learned scale and bias (reference
+    ScaleShiftLayer)."""
+    return _simple("scale_shift", input, name=name, param_attr=param_attr,
+                   bias_attr=bias_attr)
+
+
+def gated_unit_layer(input, size, act=None, name=None, **kwargs):
+    """act(fc(x)) * sigmoid(fc(x)) (reference gated_unit_layer)."""
+    proj = fc_layer(input=input, size=size, act=act)
+    gate = fc_layer(input=input, size=size,
+                    act=SigmoidActivation())
+    return _simple("elem_mul", [proj, gate], name=name)
+
+
+__all__ += [
+    "cos_sim", "trans_layer", "power_layer", "scaling_layer",
+    "interpolation_layer", "slope_intercept_layer", "sum_to_one_norm_layer",
+    "row_l2_norm_layer", "dot_prod_layer", "out_prod_layer",
+    "l2_distance_layer", "pad_layer", "clip_layer", "multiplex_layer",
+    "row_conv_layer", "maxout_layer", "block_expand_layer",
+    "seq_reshape_layer", "repeat_layer", "recurrent_layer", "ctc_layer",
+    "warp_ctc_layer", "crf_layer", "crf_decoding_layer", "nce_layer",
+    "hsigmoid", "rank_cost", "huber_regression_cost",
+    "multi_binary_label_cross_entropy", "smooth_l1_cost", "sum_cost",
+    "square_error_cost", "scale_shift_layer", "gated_unit_layer",
+]

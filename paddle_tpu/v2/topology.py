@@ -199,6 +199,8 @@ class Topology(object):
             return self._emit_recurrent_group(node)
         if node.kind == "beam_gen":
             return self._emit_beam_gen(node)
+        if node.kind in _BREADTH_EMITTERS:
+            return _BREADTH_EMITTERS[node.kind](self, node)
         if node.kind == "seq_expand":
             x, y = self._ins(node)
             return L.sequence_expand(x, y)
@@ -448,9 +450,16 @@ class Topology(object):
                     if boot is not None:
                         pre = rnn.memory(init=self._var(boot))
                     else:
-                        pre = rnn.memory(
-                            shape=[int(m.attrs["size"])], value=0.0
-                        )
+                        size = m.attrs.get("size")
+                        if size is None:
+                            # reference RecurrentLayer: the state is as
+                            # wide as the step input
+                            seq_phs = [
+                                p for p in placeholders
+                                if p.kind == "rg_step_in"
+                            ]
+                            size = self._node_width(seq_phs[0])
+                        pre = rnn.memory(shape=[int(size)], value=0.0)
                     local[m.name] = pre
                     mem_pre[m.attrs["ref_name"]] = pre
                 # replay the step sub-DAG (placeholders/memories excluded)
@@ -474,3 +483,300 @@ class Topology(object):
 
     def get_layer_proto(self, name):
         return None
+
+
+# ---------------------------------------------------------------------------
+# breadth-wrapper lowerings (trainer_config_helpers breadth layers; each a
+# thin mapping onto fluid layers/kernels — reference layers.py semantics)
+# ---------------------------------------------------------------------------
+
+
+def _L():
+    return fluid.layers
+
+
+def _act_apply(out, act):
+    return getattr(_L(), act)(out) if act else out
+
+
+def _emit_cos_sim(t, node):
+    a, b = t._ins(node)
+    out = _L().cos_sim(X=a, Y=b)
+    s = node.attrs.get("scale", 1.0)
+    return _L().scale(x=out, scale=float(s)) if s != 1.0 else out
+
+
+def _emit_trans(t, node):
+    return _L().transpose(t._in(node), [1, 0])
+
+
+def _emit_power(t, node):
+    x, w = t._ins(node)
+    return _L().elementwise_pow(x=x, y=w)
+
+
+def _emit_scaling(t, node):
+    x, w = t._ins(node)
+    return _L().elementwise_mul(x=x, y=w)
+
+
+def _emit_interpolation(t, node):
+    a, b, w = t._ins(node)
+    one_minus_w = _L().scale(x=w, scale=-1.0, bias=1.0)
+    wa = _L().elementwise_mul(x=a, y=w)
+    wb = _L().elementwise_mul(x=b, y=one_minus_w)
+    return _L().elementwise_add(x=wa, y=wb)
+
+
+def _emit_slope_intercept(t, node):
+    return _L().scale(x=t._in(node), scale=node.attrs["slope"],
+                      bias=node.attrs["intercept"])
+
+
+def _emit_sum_to_one_norm(t, node):
+    x = t._in(node)
+    s = _L().reduce_sum(x, dim=1, keep_dim=True)
+    return _L().elementwise_div(x=x, y=s)
+
+
+def _emit_row_l2_norm(t, node):
+    return _L().l2_normalize(x=t._in(node), axis=1)
+
+
+def _emit_dot_prod(t, node):
+    a, b = t._ins(node)
+    return _L().reduce_sum(_L().elementwise_mul(x=a, y=b), dim=1,
+                           keep_dim=True)
+
+
+def _emit_out_prod(t, node):
+    a, b = t._ins(node)
+    da = t._width(a, node.parents[0])
+    db = t._width(b, node.parents[1])
+    a3 = _L().reshape(x=a, shape=[-1, da, 1])
+    b3 = _L().reshape(x=b, shape=[-1, 1, db])
+    return _L().reshape(x=_L().elementwise_mul(x=a3, y=b3),
+                        shape=[-1, da * db])
+
+
+def _emit_l2_distance(t, node):
+    a, b = t._ins(node)
+    d = _L().elementwise_sub(x=a, y=b)
+    return _L().sqrt(_L().reduce_sum(_L().square(d), dim=1, keep_dim=True))
+
+
+def _emit_pad_img(t, node):
+    a = node.attrs
+    x = t._in(node)  # [N, C, H, W]
+    pads = [0, 0] + list(a["pad_c"]) + list(a["pad_h"]) + list(a["pad_w"])
+    return _L().pad(x=x, paddings=pads)
+
+
+def _emit_clip(t, node):
+    return _L().clip(x=t._in(node), min=node.attrs["min"],
+                     max=node.attrs["max"])
+
+
+def _emit_multiplex(t, node):
+    ins = t._ins(node)
+    return _L().multiplex(inputs=ins[1:], index=ins[0])
+
+
+def _emit_row_conv(t, node):
+    # legacy context_len counts the current step + lookahead; fluid's
+    # future_context_size counts lookahead only
+    out = _L().row_conv(input=t._in(node),
+                        future_context_size=node.attrs["context_len"] - 1)
+    return _act_apply(out, node.attrs.get("act"))
+
+
+def _emit_maxout(t, node):
+    return _L().maxout(x=t._in(node), groups=node.attrs["groups"])
+
+
+def _emit_block_expand(t, node):
+    a = node.attrs
+    return _L().im2sequence(
+        input=t._in(node), filter_size=a["block"], stride=a["stride"],
+        padding=a["padding"],
+    )
+
+
+def _emit_seq_reshape(t, node):
+    return _L().sequence_reshape(input=t._in(node),
+                                 new_dim=node.attrs["new_dim"])
+
+
+def _emit_repeat(t, node):
+    return _L().expand(x=t._in(node),
+                       expand_times=[1, node.attrs["num_repeats"]])
+
+
+def _emit_recurrent_step(t, node):
+    """Inner step of recurrent_layer: act(x_t + W h_prev)."""
+    x, h = t._ins(node)
+    width = t._width(x, node.parents[0])
+    pa = node.attrs.get("param_attr")
+    pname = getattr(pa, "name", None) or node.name + ".w0"
+    w = _L().create_parameter([width, width], "float32", attr=pname)
+    out = _L().elementwise_add(x=x, y=_L().mul(x=h, y=w))
+    return _act_apply(out, node.attrs.get("act"))
+
+
+def _emit_ctc_cost(t, node):
+    pred, label = t._ins(node)
+    cost = _L().warpctc(input=pred, label=label,
+                        blank=node.attrs["blank"],
+                        norm_by_times=node.attrs.get("norm_by_times", False))
+    return _L().mean(x=cost)
+
+
+def _emit_crf_cost(t, node):
+    pred, label = t._ins(node)
+    pa = node.attrs.get("param_attr")
+    attr = fluid.ParamAttr(
+        name=getattr(pa, "name", None) or node.name + ".w0"
+    )
+    cost = _L().linear_chain_crf(input=pred, label=label, param_attr=attr)
+    return _L().mean(x=cost)
+
+
+def _emit_crf_decode(t, node):
+    pred = t._in(node)
+    pa = node.attrs.get("param_attr")
+    pname = getattr(pa, "name", None) or node.name + ".w0"
+    blk = fluid.default_main_program().global_block()
+    if not blk.has_var(pname):
+        # standalone decode (legacy crf_decoding_layer creates its own
+        # transition parameter): [size+2, size] like linear_chain_crf
+        size = t._width(pred, node.parents[0])
+        _L().create_parameter([size + 2, size], "float32", attr=pname)
+    return _L().crf_decoding(input=pred, param_attr=fluid.ParamAttr(name=pname))
+
+
+def _emit_nce_cost(t, node):
+    ins = t._ins(node)
+    cost = _L().nce(input=ins[0], label=ins[-1],
+                    num_total_classes=node.attrs["num_classes"],
+                    num_neg_samples=node.attrs["num_neg_samples"])
+    return _L().mean(x=cost)
+
+
+def _emit_hsigmoid_cost(t, node):
+    ins = t._ins(node)
+    cost = _L().hsigmoid(input=ins[0], label=ins[-1],
+                         num_classes=node.attrs["num_classes"])
+    return _L().mean(x=cost)
+
+
+def _emit_rank_cost(t, node):
+    from ..fluid.layer_helper import LayerHelper
+
+    left, right, label = t._ins(node)
+    helper = LayerHelper("rank_loss")
+    out = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="rank_loss",
+        inputs={"Left": [left], "Right": [right], "Label": [label]},
+        outputs={"Out": [out]},
+    )
+    return _L().mean(x=out)
+
+
+def _emit_huber_cost(t, node):
+    from ..fluid.layer_helper import LayerHelper
+
+    x, y = t._ins(node)
+    helper = LayerHelper("huber_loss")
+    out = helper.create_tmp_variable(dtype="float32")
+    resid = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="huber_loss",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out], "Residual": [resid]},
+        attrs={"delta": node.attrs["delta"]},
+    )
+    return _L().mean(x=out)
+
+
+def _emit_multi_binary_ce(t, node):
+    # the legacy layer takes already-sigmoid-activated PROBABILITIES
+    # (reference multi_binary_label_cross_entropy docs) — plain BCE
+    p, label = t._ins(node)
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("log_loss")
+    out = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="log_loss",
+        inputs={"Predicted": [p], "Labels": [label]},
+        outputs={"Loss": [out]},
+    )
+    return _L().mean(x=out)
+
+
+def _emit_smooth_l1_cost(t, node):
+    x, y = t._ins(node)
+    return _L().mean(x=_L().smooth_l1(x=x, y=y))
+
+
+def _emit_sum_cost(t, node):
+    return _L().reduce_sum(t._in(node))
+
+
+def _emit_scale_shift(t, node):
+    x = t._in(node)
+    pa = node.attrs.get("param_attr")
+    w = _L().create_parameter(
+        [1], "float32",
+        attr=getattr(pa, "name", None) or node.name + ".w0",
+        default_initializer=fluid.initializer.Constant(1.0),
+    )
+    ba = node.attrs.get("bias_attr")
+    b = _L().create_parameter(
+        [1], "float32",
+        attr=getattr(ba, "name", None) or node.name + ".wbias",
+        is_bias=True,
+    )
+    return _L().elementwise_add(x=_L().elementwise_mul(x=x, y=w), y=b)
+
+
+def _emit_elem_mul(t, node):
+    a, b = t._ins(node)
+    return _L().elementwise_mul(x=a, y=b)
+
+
+_BREADTH_EMITTERS = {
+    "cos_sim": _emit_cos_sim,
+    "trans": _emit_trans,
+    "power": _emit_power,
+    "scaling": _emit_scaling,
+    "interpolation": _emit_interpolation,
+    "slope_intercept": _emit_slope_intercept,
+    "sum_to_one_norm": _emit_sum_to_one_norm,
+    "row_l2_norm": _emit_row_l2_norm,
+    "dot_prod": _emit_dot_prod,
+    "out_prod": _emit_out_prod,
+    "l2_distance": _emit_l2_distance,
+    "pad_img": _emit_pad_img,
+    "clip": _emit_clip,
+    "multiplex": _emit_multiplex,
+    "row_conv": _emit_row_conv,
+    "maxout": _emit_maxout,
+    "block_expand": _emit_block_expand,
+    "seq_reshape": _emit_seq_reshape,
+    "repeat": _emit_repeat,
+    "recurrent_step": _emit_recurrent_step,
+    "ctc_cost": _emit_ctc_cost,
+    "crf_cost": _emit_crf_cost,
+    "crf_decode": _emit_crf_decode,
+    "nce_cost": _emit_nce_cost,
+    "hsigmoid_cost": _emit_hsigmoid_cost,
+    "rank_cost": _emit_rank_cost,
+    "huber_cost": _emit_huber_cost,
+    "multi_binary_ce": _emit_multi_binary_ce,
+    "smooth_l1_cost": _emit_smooth_l1_cost,
+    "sum_cost": _emit_sum_cost,
+    "scale_shift": _emit_scale_shift,
+    "elem_mul": _emit_elem_mul,
+}

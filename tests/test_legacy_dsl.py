@@ -243,3 +243,237 @@ def test_legacy_beam_search_generation():
                 assert ids[b, t] == w, (b, t, ids[b], w)
             if w == num_words - 1:
                 break
+
+
+def test_breadth_wrappers_forward():
+    """Every breadth wrapper builds and runs forward with a numpy oracle
+    where the math is closed-form (reference layers.py semantics)."""
+    _fresh()
+    rng = np.random.RandomState(4)
+    a_np = rng.rand(3, 4).astype(np.float32) + 0.5
+    b_np = rng.rand(3, 4).astype(np.float32) + 0.5
+    w_np = rng.rand(3, 1).astype(np.float32)
+
+    a = tch.data_layer(name="bw_a", size=4)
+    b = tch.data_layer(name="bw_b", size=4)
+    w = tch.data_layer(name="bw_w", size=1)
+
+    nodes = {
+        "cos": tch.cos_sim(a, b, scale=2.0),
+        "trans": tch.trans_layer(a),
+        "power": tch.power_layer(a, w),
+        "scaling": tch.scaling_layer(a, w),
+        "interp": tch.interpolation_layer([a, b], w),
+        "slope": tch.slope_intercept_layer(a, slope=2.0, intercept=1.0),
+        "s1norm": tch.sum_to_one_norm_layer(a),
+        "l2row": tch.row_l2_norm_layer(a),
+        "dot": tch.dot_prod_layer(a, b),
+        "outer": tch.out_prod_layer(a, b),
+        "l2d": tch.l2_distance_layer(a, b),
+        "clip": tch.clip_layer(a, min=0.6, max=1.2),
+        "scale_shift": tch.scale_shift_layer(a),
+        "gated": tch.gated_unit_layer(a, size=5,
+                                      act=tch.TanhActivation()),
+        "sumc": tch.sum_cost(a),
+        "huber": tch.huber_regression_cost(tch.dot_prod_layer(a, b), w),
+        "smooth": tch.smooth_l1_cost(a, b),
+        "mbce": tch.multi_binary_label_cross_entropy(
+            tch.fc_layer(input=a, size=4, act=tch.SigmoidActivation()), b),
+    }
+    topo = Topology(list(nodes.values()))
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        got = exe.run(
+            topo.main_program,
+            feed={"bw_a": a_np, "bw_b": b_np, "bw_w": w_np},
+            fetch_list=[topo.var_of[n.name] for n in nodes.values()],
+        )
+    r = dict(zip(nodes.keys(), got))
+    cos = (a_np * b_np).sum(1) / (
+        np.linalg.norm(a_np, axis=1) * np.linalg.norm(b_np, axis=1))
+    np.testing.assert_allclose(np.ravel(r["cos"]), 2.0 * cos, rtol=1e-5)
+    np.testing.assert_allclose(r["trans"], a_np.T, rtol=1e-6)
+    np.testing.assert_allclose(r["power"], a_np ** w_np, rtol=1e-4)
+    np.testing.assert_allclose(r["scaling"], a_np * w_np, rtol=1e-5)
+    np.testing.assert_allclose(
+        r["interp"], w_np * a_np + (1 - w_np) * b_np, rtol=1e-5)
+    np.testing.assert_allclose(r["slope"], 2.0 * a_np + 1.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        r["s1norm"], a_np / a_np.sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(
+        r["l2row"], a_np / np.linalg.norm(a_np, axis=1, keepdims=True),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        np.ravel(r["dot"]), (a_np * b_np).sum(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        r["outer"], (a_np[:, :, None] * b_np[:, None, :]).reshape(3, 16),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        np.ravel(r["l2d"]), np.linalg.norm(a_np - b_np, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(r["clip"], np.clip(a_np, 0.6, 1.2), rtol=1e-6)
+    # scale_shift initialises w=1, b=0 -> identity before training
+    np.testing.assert_allclose(r["scale_shift"], a_np, rtol=1e-5)
+    assert r["gated"].shape == (3, 5)
+    np.testing.assert_allclose(float(np.ravel(r["sumc"])[0]), a_np.sum(), rtol=1e-5)
+    assert np.isfinite(float(np.ravel(r["huber"])[0]))
+    assert np.isfinite(float(np.ravel(r["smooth"])[0]))
+    assert np.isfinite(float(np.ravel(r["mbce"])[0]))
+
+
+def test_breadth_sequence_and_cost_wrappers():
+    """Sequence-shaped breadth wrappers: row_conv, seq_reshape, repeat,
+    block_expand, multiplex, rank_cost, multi_binary CE, crf/ctc costs,
+    recurrent_layer — build + one forward/backward step each."""
+    _fresh()
+    rng = np.random.RandomState(5)
+
+    # recurrent_layer trains (simple full-matrix recurrence)
+    dict_dim, word_dim = 8, 6
+    words = tch.data_layer(name="br_w", size=dict_dim)
+    emb = tch.embedding_layer(input=words, size=word_dim)
+    rec = tch.recurrent_layer(input=emb, act=tch.TanhActivation(),
+                              name="br_rec")
+    rep = tch.last_seq(input=rec)
+    prob = tch.fc_layer(input=rep, size=3, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name="br_y", size=3)
+    cost = tch.classification_cost(input=prob, label=lbl)
+
+    topo = Topology([cost])
+    cost_var = topo.var_of[cost.name]
+    with fluid.program_guard(topo.main_program, topo.startup_program):
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(cost_var)
+    lens = [3, 4, 2]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    wd = rng.randint(0, dict_dim, (sum(lens), 1)).astype(np.int64)
+    yd = rng.randint(0, 3, (len(lens), 1)).astype(np.int64)
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        losses = [
+            float(np.ravel(exe.run(
+                topo.main_program,
+                feed={"br_w": (wd, [lod]), "br_y": yd},
+                fetch_list=[cost_var])[0])[0])
+            for _ in range(15)
+        ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # sequence/cost wrappers: build + forward
+    _fresh()
+    seq = tch.data_layer(name="bs_seq", size=4)
+    e2 = tch.embedding_layer(input=seq, size=6)
+    rc = tch.row_conv_layer(input=e2, context_len=2)
+    rs = tch.seq_reshape_layer(input=e2, reshape_size=3)
+    left = tch.data_layer(name="bs_left", size=1)
+    right = tch.data_layer(name="bs_right", size=1)
+    rl = tch.data_layer(name="bs_rl", size=1)
+    rank = tch.rank_cost(left, right, rl)
+    topo2 = Topology([rc, rs, rank])
+    scope2 = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope2):
+        exe.run(topo2.startup_program)
+        lens2 = [2, 3]
+        lod2 = np.cumsum([0] + lens2).astype(np.int32)
+        ids = rng.randint(0, 4, (5, 1)).astype(np.int64)
+        outs = exe.run(
+            topo2.main_program,
+            feed={
+                "bs_seq": (ids, [lod2]),
+                "bs_left": rng.rand(4, 1).astype(np.float32),
+                "bs_right": rng.rand(4, 1).astype(np.float32),
+                "bs_rl": rng.randint(0, 2, (4, 1)).astype(np.float32),
+            },
+            fetch_list=[topo2.var_of[rc.name], topo2.var_of[rs.name],
+                        topo2.var_of[rank.name]],
+        )
+    assert outs[0].shape == (5, 6)      # row_conv keeps shape
+    assert outs[1].shape == (10, 3)     # seq_reshape 5x6 -> 10x3
+    assert np.isfinite(float(np.ravel(outs[2])[0]))
+
+
+def test_breadth_image_and_structured_wrappers():
+    """maxout/pad/block_expand/multiplex/repeat + CRF and CTC cost
+    wrappers (incl. standalone crf_decoding_layer and warp_ctc blank=0)."""
+    _fresh()
+    rng = np.random.RandomState(6)
+
+    img = tch.data_layer(name="bi_img", size=4 * 6 * 6, height=6, width=6)
+    mo = tch.maxout_layer(input=img, groups=2)
+    padded = tch.pad_layer(input=img, pad_c=[0, 0], pad_h=[1, 1],
+                           pad_w=[1, 1])
+    blocks = tch.block_expand_layer(input=img, block_x=3, block_y=3,
+                                    stride_x=3, stride_y=3)
+    sel = tch.data_layer(name="bi_sel", size=1)
+    x1 = tch.data_layer(name="bi_x1", size=3)
+    x2 = tch.data_layer(name="bi_x2", size=3)
+    mux = tch.multiplex_layer([sel, x1, x2])
+    rep = tch.repeat_layer(input=x1, num_repeats=2)
+    topo = Topology([mo, padded, blocks, mux, rep])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        img_np = rng.rand(2, 4 * 36).astype(np.float32)
+        sel_np = np.array([[0], [1], [0]], np.int64)
+        x1_np = rng.rand(3, 3).astype(np.float32)
+        x2_np = rng.rand(3, 3).astype(np.float32)
+        outs = exe.run(
+            topo.main_program,
+            feed={"bi_img": img_np, "bi_sel": sel_np, "bi_x1": x1_np,
+                  "bi_x2": x2_np},
+            fetch_list=[topo.var_of[n.name]
+                        for n in (mo, padded, blocks, mux, rep)],
+        )
+    mo_np = img_np.reshape(2, 4, 6, 6).reshape(2, 2, 2, 6, 6).max(2)
+    np.testing.assert_allclose(outs[0], mo_np, rtol=1e-6)
+    assert outs[1].shape == (2, 4, 8, 8)
+    assert outs[2].shape[0] == 2 * 4  # 2 imgs x (2x2) blocks of 3x3
+    want_mux = np.where(sel_np == 0, x1_np, x2_np)
+    np.testing.assert_allclose(outs[3], want_mux, rtol=1e-6)
+    np.testing.assert_allclose(outs[4], np.tile(x1_np, (1, 2)), rtol=1e-6)
+
+    # CRF cost + STANDALONE crf_decoding_layer (creates its own
+    # transition param) and CTC costs (warp_ctc blank=0 default)
+    _fresh()
+    n_tags = 4
+    emission = tch.data_layer(name="bc_em", size=n_tags)
+    tags = tch.data_layer(name="bc_tag", size=n_tags)
+    crf = tch.crf_layer(input=emission, label=tags,
+                        param_attr=tch.ParamAttr(name="bc_trans"))
+    decode = tch.crf_decoding_layer(input=emission, size=n_tags)
+    frames = tch.data_layer(name="bc_fr", size=6)
+    labels = tch.data_layer(name="bc_lb", size=5)
+    ctc = tch.warp_ctc_layer(input=frames, label=labels, size=6)
+    assert ctc.attrs["blank"] == 0  # warp_ctc default, unlike ctc_layer
+    ctc2 = tch.ctc_layer(input=frames, label=labels, size=6)
+    assert ctc2.attrs["blank"] == 5
+
+    topo2 = Topology([crf, decode, ctc])
+    scope2 = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope2):
+        exe.run(topo2.startup_program)
+        lens = [3, 2]
+        lod = np.cumsum([0] + lens).astype(np.int32)
+        lab_lens = [2, 1]
+        lab_lod = np.cumsum([0] + lab_lens).astype(np.int32)
+        outs2 = exe.run(
+            topo2.main_program,
+            feed={
+                "bc_em": (rng.rand(5, n_tags).astype(np.float32), [lod]),
+                "bc_tag": (rng.randint(0, n_tags, (5, 1)).astype(np.int64),
+                           [lod]),
+                "bc_fr": (rng.rand(5, 6).astype(np.float32), [lod]),
+                "bc_lb": (rng.randint(1, 5, (3, 1)).astype(np.int64),
+                          [lab_lod]),
+            },
+            fetch_list=[topo2.var_of[crf.name], topo2.var_of[decode.name],
+                        topo2.var_of[ctc.name]],
+        )
+    assert np.isfinite(float(np.ravel(outs2[0])[0]))
+    assert outs2[1].shape[0] == 5  # a tag per row
+    assert ((outs2[1] >= 0) & (outs2[1] < n_tags)).all()
+    assert np.isfinite(float(np.ravel(outs2[2])[0]))
