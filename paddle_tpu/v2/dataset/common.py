@@ -8,7 +8,8 @@ import numpy as np
 
 DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
 
-__all__ = ["DATA_HOME", "rng_for", "md5file", "download"]
+__all__ = ["DATA_HOME", "rng_for", "md5file", "download", "convert",
+           "read_converted", "fetch_all"]
 
 
 def rng_for(name: str, split: str) -> np.random.RandomState:
@@ -18,6 +19,17 @@ def rng_for(name: str, split: str) -> np.random.RandomState:
 
     seed = zlib.crc32(("%s/%s" % (name, split)).encode()) % (2**31)
     return np.random.RandomState(seed)
+
+
+def to_pixels(img):
+    """[-1,1] floats -> uint8 pixels (the real datasets' wire encoding);
+    round-trips exactly with from_pixels."""
+    return np.clip(np.round((img + 1.0) * 127.5), 0, 255).astype(np.uint8)
+
+
+def from_pixels(pixels):
+    """uint8 pixels -> [-1,1] float32 (reference readers' normalisation)."""
+    return pixels.astype("float32") / 127.5 - 1.0
 
 
 def md5file(fname):
@@ -35,3 +47,71 @@ def download(url, module_name, md5sum=None, save_name=None):
         "no network egress in this environment; place files under %s "
         "manually" % DATA_HOME
     )
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Serialise a reader's samples into record files through the NATIVE
+    record writer (reference common.convert -> recordio; the Go master
+    dispatches these chunks). STREAMING: samples never materialise in
+    memory at once; each file holds up to `line_count` pickled samples,
+    named `<prefix>-00000-of-NNNNN` like the reference (temp names are
+    renamed once the final file count is known)."""
+    import pickle
+
+    from ... import native
+
+    os.makedirs(output_path, exist_ok=True)
+    tmp_paths = []
+    writer, written = None, 0
+    for sample in (reader() if callable(reader) else reader):
+        if writer is None:
+            tmp = os.path.join(
+                output_path, ".%s-%05d.tmp" % (name_prefix, len(tmp_paths))
+            )
+            writer = native.RecordWriter(tmp)
+            tmp_paths.append(tmp)
+        writer.write(pickle.dumps(sample, protocol=2))
+        written += 1
+        if written == line_count:
+            writer.close()
+            writer, written = None, 0
+    if writer is not None:
+        writer.close()
+    n_files = max(1, len(tmp_paths))
+    paths = []
+    for i, tmp in enumerate(tmp_paths):
+        path = os.path.join(
+            output_path, "%s-%05d-of-%05d" % (name_prefix, i, n_files)
+        )
+        os.replace(tmp, path)
+        paths.append(path)
+    return paths
+
+
+def read_converted(paths):
+    """Reader creator over files written by convert() (reference
+    master-dispatched recordio consumption)."""
+    import pickle
+
+    from ... import native
+
+    def reader():
+        for rec in native.PrefetchReader(list(paths)):
+            yield pickle.loads(rec)
+
+    return reader
+
+
+def fetch_all():
+    """Populate every dataset module's cache (reference common.fetch_all:
+    iterates the whole dataset package; modules without fetch() skip)."""
+    import importlib
+    import pkgutil
+
+    pkg = importlib.import_module("paddle_tpu.v2.dataset")
+    for info in pkgutil.iter_modules(pkg.__path__):
+        mod = importlib.import_module(
+            "paddle_tpu.v2.dataset." + info.name
+        )
+        if hasattr(mod, "fetch"):
+            mod.fetch()
