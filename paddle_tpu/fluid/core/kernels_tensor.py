@@ -297,3 +297,60 @@ def _im2sequence(ctx, ins, attrs):
     )  # [N, C*kh*kw, oh, ow]
     out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
     return {"Out": out}
+
+
+@register_op("sampling_id")
+def _sampling_id(ctx, ins, attrs):
+    """Sample one class id per row from a probability distribution
+    (reference sampling_id_op.cc / SamplingIdLayer)."""
+    p = ins["X"][0]  # [N, C] probabilities
+    key = ctx.next_key()
+    logits = jnp.log(jnp.maximum(p, 1e-20))
+    ids = jax.random.categorical(key, logits, axis=-1)
+    return {"Out": ids.astype(jnp.int32)}
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    """Bilinear image resize (reference bilinear_interp_op.cc /
+    BilinearInterpLayer) on NCHW with the reference's ALIGN-CORNERS
+    ratios ((in-1)/(out-1)), not jax.image's half-pixel centers."""
+    x = ins["X"][0]
+    oh = int(attrs["out_h"])
+    ow = int(attrs["out_w"])
+    h, w = x.shape[2], x.shape[3]
+
+    def axis_coords(out_n, in_n):
+        if out_n == 1 or in_n == 1:
+            return jnp.zeros((out_n,), x.dtype if x.dtype in (
+                jnp.float32, jnp.float64) else jnp.float32)
+        ratio = (in_n - 1) / (out_n - 1)
+        return jnp.arange(out_n, dtype=jnp.float32) * ratio
+
+    ys = axis_coords(oh, h)
+    xs = axis_coords(ow, w)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0).astype(x.dtype)[None, None, :, None]
+    wx = (xs - x0).astype(x.dtype)[None, None, None, :]
+    top = x[:, :, y0][:, :, :, x0] * (1 - wx) + x[:, :, y0][:, :, :, x1] * wx
+    bot = x[:, :, y1][:, :, :, x0] * (1 - wx) + x[:, :, y1][:, :, :, x1] * wx
+    return {"Out": top * (1 - wy) + bot * wy}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """Circular convolution (reference conv_shift_op.cc /
+    ConvShiftLayer): out[i, j] = sum_k x[i, (j + k - M//2) mod N] * y[i, k]
+    with x [B, N], y [B, M], M odd and M <= N."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    n = x.shape[1]
+    m = y.shape[1]
+    half = m // 2
+    cols = []
+    for k in range(m):
+        cols.append(jnp.roll(x, shift=half - k, axis=1) * y[:, k:k + 1])
+    return {"Out": sum(cols)}

@@ -21,6 +21,9 @@ from . import tensor as tensor_layers
 __all__ = [
     "fc",
     "embedding",
+    "sampling_id",
+    "bilinear_interp",
+    "conv_shift",
     "sequence_context",
     "slice",
     "equal",
@@ -1305,5 +1308,42 @@ def equal(x, y, name=None, **kwargs):
     out.stop_gradient = True
     helper.append_op(
         type="equal", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def sampling_id(x, name=None, **kwargs):
+    """Sample a class id per row of a probability matrix (reference
+    sampling_id_op)."""
+    helper = LayerHelper("sampling_id", name=name)
+    out = helper.create_tmp_variable(dtype="int32")
+    out.stop_gradient = True
+    helper.append_op(
+        type="sampling_id", inputs={"X": [x]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def bilinear_interp(input, out_h, out_w, name=None, **kwargs):
+    """Bilinear resize on NCHW (reference bilinear_interp_op)."""
+    helper = LayerHelper("bilinear_interp", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="bilinear_interp",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"out_h": int(out_h), "out_w": int(out_w)},
+    )
+    return out
+
+
+def conv_shift(x, y, name=None, **kwargs):
+    """Circular convolution of each row of x by the (odd-width) kernel
+    row of y (reference conv_shift_op)."""
+    helper = LayerHelper("conv_shift", name=name)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(
+        type="conv_shift", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
     )
     return out

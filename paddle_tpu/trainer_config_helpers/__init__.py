@@ -539,6 +539,7 @@ class MixedLayerNode(Layer):
             raise TypeError("mixed_layer += expects a projection")
         self.attrs["projections"].append(proj)
         self.parents.append(proj.input)
+        self.parents.extend(getattr(proj, "extra_inputs", []))
         return self
 
 
@@ -977,4 +978,77 @@ __all__ += [
     "hsigmoid", "rank_cost", "huber_regression_cost",
     "multi_binary_label_cross_entropy", "smooth_l1_cost", "sum_cost",
     "square_error_cost", "scale_shift_layer", "gated_unit_layer",
+]
+
+
+def sampling_id_layer(input, name=None, **kwargs):
+    """Sample a class id per row from probabilities (reference
+    SamplingIdLayer)."""
+    return _simple("sampling_id", input, name=name)
+
+
+def bilinear_interp_layer(input, out_size_x, out_size_y, name=None,
+                          **kwargs):
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], None)
+    node = _simple("bilinear_interp", inp, name=name,
+                   out_h=int(out_size_y), out_w=int(out_size_x))
+    node.im_shape = (c, int(out_size_y), int(out_size_x))
+    return node
+
+
+def conv_shift_layer(a, b, name=None, **kwargs):
+    """Circular convolution of a's rows by b's (odd-width) rows
+    (reference ConvShiftLayer)."""
+    return _simple("conv_shift", [a, b], name=name)
+
+
+def switch_order_layer(input, reshape_axis=None, name=None, **kwargs):
+    """NCHW -> NHWC (reference SwitchOrderLayer)."""
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], None)
+    return _simple("switch_order", inp, name=name, shape=[c, h, w])
+
+
+def spp_layer(input, pyramid_height=2, num_channels=None, pool_type=None,
+              name=None, **kwargs):
+    """Spatial pyramid pooling (reference SpatialPyramidPoolLayer): pool
+    the map at pyramid levels 1x1, 2x2, ... and concat the flats."""
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], num_channels)
+    ptype = "max"
+    if pool_type is not None:
+        p = pool_type if isinstance(pool_type, _Pooling) else pool_type()
+        ptype = "avg" if p.name in ("avg", "sum") else "max"
+    return _simple("spp", inp, name=name, pyramid_height=int(pyramid_height),
+                   pool_type=ptype, im_shape=[c, h, w])
+
+
+def factorization_machine(input, factor_size, param_attr=None, name=None,
+                          **kwargs):
+    """Second-order FM interaction term (reference
+    FactorizationMachineLayer): 0.5 * sum_f[(x V)_f^2 - (x^2)(V^2)_f]."""
+    return _simple("factorization_machine", input, name=name,
+                   factor_size=int(factor_size), param_attr=param_attr)
+
+
+def huber_classification_cost(input, label, name=None, **kwargs):
+    """Huberised hinge loss on +-1 labels (reference
+    HuberTwoClassification)."""
+    return _simple("huber_cls_cost", [input, _label_node(label)], name=name)
+
+
+def dotmul_operator(a=None, b=None, scale=1.0, **kwargs):
+    """Element-wise a*b term inside a mixed_layer (reference
+    DotMulOperator; two-input mixed operator)."""
+    if not isinstance(a, Layer) or not isinstance(b, Layer):
+        raise TypeError(
+            "dotmul_operator needs two layers: dotmul_operator(a=x, b=y)"
+        )
+    proj = _Projection("dotmul_op", a, scale=float(scale))
+    proj.extra_inputs = [b]
+    return proj
+
+
+__all__ += [
+    "sampling_id_layer", "bilinear_interp_layer", "conv_shift_layer",
+    "switch_order_layer", "spp_layer", "factorization_machine",
+    "huber_classification_cost", "dotmul_operator",
 ]

@@ -302,6 +302,13 @@ class Topology(object):
             elif proj.ptype == "scaling":
                 w = L.create_parameter([1], "float32", attr=pname)
                 terms.append(L.elementwise_mul(x=x, y=w))
+            elif proj.ptype == "dotmul_op":
+                b = self._var(proj.extra_inputs[0].name)
+                term = L.elementwise_mul(x=x, y=b)
+                sc = proj.attrs.get("scale", 1.0)
+                if sc != 1.0:
+                    term = L.scale(x=term, scale=sc)
+                terms.append(term)
             else:
                 raise NotImplementedError("projection %r" % proj.ptype)
         out = terms[0] if len(terms) == 1 else L.sums(input=terms)
@@ -780,3 +787,84 @@ _BREADTH_EMITTERS = {
     "scale_shift": _emit_scale_shift,
     "elem_mul": _emit_elem_mul,
 }
+
+
+def _emit_sampling_id(t, node):
+    return _L().sampling_id(t._in(node))
+
+
+def _emit_bilinear_interp(t, node):
+    return _L().bilinear_interp(t._in(node), out_h=node.attrs["out_h"],
+                                out_w=node.attrs["out_w"])
+
+
+def _emit_conv_shift(t, node):
+    a, b = t._ins(node)
+    return _L().conv_shift(x=a, y=b)
+
+
+def _emit_switch_order(t, node):
+    c, h, w = node.attrs["shape"]
+    out = _L().transpose(t._in(node), [0, 2, 3, 1])  # NCHW -> NHWC
+    return _L().reshape(x=out, shape=[-1, h * w * c])
+
+
+def _emit_spp(t, node):
+    c, h, w = node.attrs["im_shape"]
+    ptype = node.attrs["pool_type"]
+    flats = []
+    for level in range(int(node.attrs["pyramid_height"])):
+        bins = 2 ** level
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        pooled = _L().pool2d(
+            input=t._in(node), pool_size=[kh, kw],
+            pool_stride=[kh, kw], pool_type=ptype, ceil_mode=True,
+        )
+        # ceil-mode pooling yields ceil(h/kh) x ceil(w/kw) bins — equal to
+        # bins x bins only when 2^level tiles the map (the common SPP
+        # geometry); size the flat from the ACTUAL output
+        obh, obw = -(-h // kh), -(-w // kw)
+        flats.append(_L().reshape(x=pooled, shape=[-1, c * obh * obw]))
+    return flats[0] if len(flats) == 1 else _L().concat(input=flats, axis=1)
+
+
+def _emit_factorization_machine(t, node):
+    x = t._in(node)
+    in_dim = t._width(x, node.parents[0])
+    f = int(node.attrs["factor_size"])
+    pa = node.attrs.get("param_attr")
+    v = _L().create_parameter(
+        [in_dim, f], "float32",
+        attr=getattr(pa, "name", None) or node.name + ".w0",
+    )
+    xv = _L().mul(x=x, y=v)                       # [N, F]
+    x2v2 = _L().mul(x=_L().square(x), y=_L().square(v))
+    diff = _L().elementwise_sub(x=_L().square(xv), y=x2v2)
+    return _L().scale(x=_L().reduce_sum(diff, dim=1, keep_dim=True),
+                      scale=0.5)
+
+
+def _emit_huber_cls_cost(t, node):
+    x, label = t._ins(node)
+    # labels in {0,1} -> y in {-1,+1}; margin m = y*x
+    y = _L().scale(x=_L().cast(label, "float32"), scale=2.0, bias=-1.0)
+    m = _L().elementwise_mul(x=x, y=y)
+    # piecewise: m>=1 -> 0; |m|<1 -> (1-m)^2; m<=-1 -> -4m
+    # == clip(1-m, 0, 2)^2 + 4*clip(-1-m, 0, inf)
+    t1 = _L().clip(x=_L().scale(x=m, scale=-1.0, bias=1.0), min=0.0, max=2.0)
+    t2 = _L().clip(x=_L().scale(x=m, scale=-1.0, bias=-1.0), min=0.0,
+                   max=3.4e38)
+    loss = _L().elementwise_add(x=_L().square(t1),
+                                y=_L().scale(x=t2, scale=4.0))
+    return _L().mean(x=loss)
+
+
+_BREADTH_EMITTERS.update({
+    "sampling_id": _emit_sampling_id,
+    "bilinear_interp": _emit_bilinear_interp,
+    "conv_shift": _emit_conv_shift,
+    "switch_order": _emit_switch_order,
+    "spp": _emit_spp,
+    "factorization_machine": _emit_factorization_machine,
+    "huber_cls_cost": _emit_huber_cls_cost,
+})

@@ -477,3 +477,64 @@ def test_breadth_image_and_structured_wrappers():
     assert outs2[1].shape[0] == 5  # a tag per row
     assert ((outs2[1] >= 0) & (outs2[1] < n_tags)).all()
     assert np.isfinite(float(np.ravel(outs2[2])[0]))
+
+
+def test_breadth_wrappers_round2():
+    """sampling_id/bilinear_interp/conv_shift/switch_order/spp/
+    factorization_machine/huber_classification/dotmul_operator."""
+    _fresh()
+    rng = np.random.RandomState(7)
+
+    img = tch.data_layer(name="r2_img", size=3 * 4 * 4, height=4, width=4)
+    bi = tch.bilinear_interp_layer(input=img, out_size_x=8, out_size_y=8)
+    sw = tch.switch_order_layer(input=img)
+    sp = tch.spp_layer(input=img, pyramid_height=2)
+
+    a = tch.data_layer(name="r2_a", size=5)
+    b = tch.data_layer(name="r2_b", size=5)
+    k = tch.data_layer(name="r2_k", size=3)
+    cs = tch.conv_shift_layer(a, k)
+    with tch.mixed_layer(size=5) as dm:
+        dm += tch.dotmul_operator(a=a, b=b, scale=2.0)
+    fm = tch.factorization_machine(input=a, factor_size=4)
+    prob = tch.fc_layer(input=a, size=6, act=tch.SoftmaxActivation())
+    sid = tch.sampling_id_layer(input=prob)
+    lab = tch.data_layer(name="r2_y", size=1)
+    hub = tch.huber_classification_cost(
+        input=tch.dot_prod_layer(a, b), label=lab)
+
+    topo = Topology([bi, sw, sp, cs, dm, fm, sid, hub])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        img_np = rng.rand(2, 48).astype(np.float32)
+        a_np = rng.rand(4, 5).astype(np.float32)
+        b_np = rng.rand(4, 5).astype(np.float32)
+        k_np = rng.rand(4, 3).astype(np.float32)
+        y_np = rng.randint(0, 2, (4, 1)).astype(np.int64)
+        outs = exe.run(
+            topo.main_program,
+            feed={"r2_img": img_np, "r2_a": a_np, "r2_b": b_np,
+                  "r2_k": k_np, "r2_y": y_np},
+            fetch_list=[topo.var_of[n.name]
+                        for n in (bi, sw, sp, cs, dm, fm, sid, hub)],
+        )
+    assert outs[0].shape == (2, 3, 8, 8)                 # bilinear up
+    np.testing.assert_allclose(                           # NCHW -> NHWC flat
+        outs[1].reshape(2, 4, 4, 3),
+        img_np.reshape(2, 3, 4, 4).transpose(0, 2, 3, 1), rtol=1e-6)
+    assert outs[2].shape == (2, 3 * 1 + 3 * 4)           # 1x1 + 2x2 pyramid
+    want_cs = np.zeros_like(a_np)
+    for j in range(3):
+        want_cs += np.roll(a_np, 1 - j, axis=1) * k_np[:, j:j + 1]
+    np.testing.assert_allclose(outs[3], want_cs, rtol=1e-5)
+    np.testing.assert_allclose(outs[4], 2.0 * a_np * b_np, rtol=1e-5)
+    assert outs[5].shape == (4, 1)                        # FM scalar per row
+    assert ((outs[6] >= 0) & (outs[6] < 6)).all()         # sampled ids
+    # huber-classification numpy oracle
+    m = (a_np * b_np).sum(1, keepdims=True) * (2 * y_np - 1)
+    want_h = np.where(m >= 1, 0.0,
+                      np.where(m <= -1, -4 * m, (1 - m) ** 2)).mean()
+    np.testing.assert_allclose(float(np.ravel(outs[7])[0]), want_h,
+                               rtol=1e-5)

@@ -547,6 +547,54 @@ spec("sequence_context",
          np.concatenate([i["X"][1:2], np.zeros((1, 3), np.float32),
                          i["X"][3:6], np.zeros((1, 3), np.float32)]),
      ], axis=1)})
+def _bilinear_oracle(i, a):
+    """Independent numpy oracle with the reference's ALIGN-CORNERS ratios
+    ((in-1)/(out-1), bilinear_interp_op.cc)."""
+    x = i["X"]
+    import numpy as _np
+    oh, ow = a["out_h"], a["out_w"]
+    n, c, h, w = x.shape
+    ys = _np.arange(oh) * ((h - 1) / (oh - 1)) if oh > 1 else _np.zeros(1)
+    xs = _np.arange(ow) * ((w - 1) / (ow - 1)) if ow > 1 else _np.zeros(1)
+    y0 = _np.clip(_np.floor(ys).astype(int), 0, h - 1)
+    y1 = _np.clip(y0 + 1, 0, h - 1)
+    x0 = _np.clip(_np.floor(xs).astype(int), 0, w - 1)
+    x1 = _np.clip(x0 + 1, 0, w - 1)
+    wy = _np.clip(ys - y0, 0, 1)
+    wx = _np.clip(xs - x0, 0, 1)
+    top = x[:, :, y0][:, :, :, x0] * (1 - wx) + x[:, :, y0][:, :, :, x1] * wx
+    bot = x[:, :, y1][:, :, :, x0] * (1 - wx) + x[:, :, y1][:, :, :, x1] * wx
+    return {"Out": top * (1 - wy[None, None, :, None])
+            + bot * wy[None, None, :, None]}
+
+
+spec("bilinear_interp",
+     ins={"X": R(86).randn(2, 3, 4, 4).astype(np.float32)},
+     attrs={"out_h": 8, "out_w": 6}, grad=True, tol=(1e-3, 1e-4),
+     oracle=_bilinear_oracle)
+spec("bilinear_interp_down", op="bilinear_interp",
+     ins={"X": R(89).randn(2, 2, 6, 6).astype(np.float32)},
+     attrs={"out_h": 3, "out_w": 4}, grad=True, tol=(1e-3, 1e-4),
+     oracle=_bilinear_oracle)
+
+
+def _conv_shift_oracle(i, a):
+    x, y = i["X"], i["Y"]
+    n = x.shape[1]
+    m = y.shape[1]
+    half = m // 2
+    out = np.zeros_like(x)
+    for k in range(m):
+        out += np.roll(x, half - k, axis=1) * y[:, k:k + 1]
+    return {"Out": out}
+
+
+spec("conv_shift",
+     ins={"X": R(87).randn(3, 7).astype(np.float32),
+          "Y": R(88).randn(3, 3).astype(np.float32)},
+     grad=True, oracle=_conv_shift_oracle)
+
+
 spec("sequence_softmax", ins={"X": R(81).randn(6, 1).astype(np.float32)},
      lods={"sequence_softmax_x_0": _lod6}, grad=True,
      gtol=(8e-2, 1e-3),
@@ -731,6 +779,8 @@ EXEMPT = {
     "beam_search": "stateful decode step; test_machine_translation.py",
     "beam_init": "generation bootstrap (ids/scores constants + beam "
                  "side-bands); covered by test_legacy_dsl.py beam gen",
+    "sampling_id": "random categorical draw per run; distribution "
+                   "checked in test_legacy_dsl.py",
     "beam_search_decode": "decode assembly; test_machine_translation.py",
     "lstm": "full-sequence kernel; gradient-checked via dynamic_lstm in "
             "test_rnn_ops.py (lstm_unit grad-checked here)",
