@@ -167,10 +167,31 @@ def _sequence_reshape(ctx, ins, attrs):
 
 @register_op("sequence_slice")
 def _sequence_slice(ctx, ins, attrs):
-    raise NotImplementedError(
-        "sequence_slice requires dynamic packed lengths; use sequence_pool/"
-        "gather formulations (planned with the RNN milestone)"
+    """Per-sequence subrange (operators/sequence_slice_op): keep rows
+    [offset_i, offset_i + length_i) of each sequence. TPU-first layout
+    like sequence_erase: kept rows compact to the front of the
+    static-size buffer, traced output offsets describe the new ragged
+    layout, the tail is zeros."""
+    x = ins["X"][0]
+    off = ins["Offset"][0].reshape(-1)
+    length = ins["Length"][0].reshape(-1)
+    offsets = _offsets(ctx)
+    total = x.shape[0]
+    s = seg_ids(offsets, total)
+    rel = jnp.arange(total, dtype=offsets.dtype) - offsets[s]
+    kept = (rel >= off[s]) & (rel < off[s] + length[s])
+    pos = jnp.cumsum(kept.astype(jnp.int32)) - 1
+    dest = jnp.where(kept, pos, total)  # dropped -> spill slot
+    out = (
+        jnp.zeros((total + 1,) + x.shape[1:], x.dtype)
+        .at[dest].set(x)[:total]
     )
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(length.astype(jnp.int32))]
+    )
+    _set_lod(ctx, "Out", new_offsets)
+    return {"Out": out}
 
 
 @register_op("sequence_erase")

@@ -75,6 +75,7 @@ __all__ = [
     "sequence_softmax",
     "sequence_expand",
     "sequence_reshape",
+    "sequence_slice",
     "im2sequence",
     "row_conv",
     "multiplex",
@@ -1344,6 +1345,19 @@ def conv_shift(x, y, name=None, **kwargs):
     out = helper.create_tmp_variable(dtype=x.dtype)
     helper.append_op(
         type="conv_shift", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_slice(input, offset, length, name=None, **kwargs):
+    """Per-sequence subranges (reference sequence_slice_op): row ranges
+    [offset_i, offset_i+length_i) of each sequence, compacted."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype, lod_level=1)
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
         outputs={"Out": [out]},
     )
     return out

@@ -606,10 +606,13 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         placeholders.append(ph)
 
     _rg_stack.append([])
+    prev_collector = Layer._step_nodes
+    Layer._step_nodes = step_nodes = []
     try:
         out = step(*placeholders)
     finally:
         mems = _rg_stack.pop()
+        Layer._step_nodes = prev_collector
     if isinstance(out, (list, tuple)):
         raise NotImplementedError(
             "recurrent_group with multiple step outputs is not supported "
@@ -623,6 +626,7 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         "step_out": out,
         "placeholders": placeholders,
         "mems": mems,
+        "step_nodes": step_nodes,
     })
     return node
 
@@ -1051,4 +1055,80 @@ __all__ += [
     "sampling_id_layer", "bilinear_interp_layer", "conv_shift_layer",
     "switch_order_layer", "spp_layer", "factorization_machine",
     "huber_classification_cost", "dotmul_operator",
+]
+
+
+def seq_slice_layer(input, starts=None, ends=None, name=None, **kwargs):
+    """Per-sequence subranges (reference seq_slice_layer): keeps rows
+    [starts_i, ends_i) of each sequence. starts/ends are layers of one
+    int per sequence; None means begin/end of each sequence."""
+    return Layer("seq_slice", name,
+                 [input] + [x for x in (starts, ends) if x is not None],
+                 {"has_starts": starts is not None,
+                  "has_ends": ends is not None})
+
+
+def sub_seq_layer(input, offsets, sizes, name=None, **kwargs):
+    """Sub-sequences by (offset, size) per sequence (reference
+    SubSequenceLayer)."""
+    return Layer("sub_seq", name, [input, offsets, sizes], {})
+
+
+def lstm_step_layer(input, state, size=None, act=None,
+                    gate_act=None, state_act=None, name=None, **kwargs):
+    """One LSTM step inside a recurrent_group (reference LstmStepLayer):
+    `input` is the 4H pre-projection, `state` the cell memory. Returns
+    the hidden; the updated cell is reachable via
+    get_output_layer(..., arg_name='state')."""
+    return Layer("lstm_step", name, [input, state], {
+        "size": size,
+    })
+
+
+def gru_step_layer(input, output_mem, size=None, act=None, gate_act=None,
+                   name=None, param_attr=None, bias_attr=None, **kwargs):
+    """One GRU step inside a recurrent_group (reference GruStepLayer):
+    `input` is the 3H pre-projection, `output_mem` the hidden memory."""
+    return Layer("gru_step", name, [input, output_mem], {
+        "size": size, "param_attr": param_attr, "bias_attr": bias_attr,
+    })
+
+
+gru_step_naive_layer = gru_step_layer
+
+
+def get_output_layer(input, arg_name="state", name=None, **kwargs):
+    """Secondary output of a multi-output step layer (reference
+    GetOutputLayer): e.g. the cell state of lstm_step_layer."""
+    return Layer("get_output", name, [input], {"arg_name": arg_name})
+
+
+def tensor_layer(a, b, size, act=None, name=None, param_attr=None,
+                 **kwargs):
+    """Bilinear tensor product (reference TensorLayer):
+    out_k = a W_k b^T with W_k [da, db], k < size."""
+    return Layer("tensor", name, [a, b], {
+        "size": int(size), "act": _act_name(act), "param_attr": param_attr,
+    })
+
+
+def selective_fc_layer(input, size, select=None, act=None, name=None,
+                       param_attr=None, bias_attr=None, **kwargs):
+    """Reference selective_fc_layer; with select=None it equals fc (the
+    full-output case, which is what training configs use — the
+    inference-time column selection is a serving optimisation the fused
+    XLA matmul does not need)."""
+    if select is not None:
+        raise NotImplementedError(
+            "selective_fc with a selection input: the full-matmul path "
+            "makes column selection unnecessary on TPU"
+        )
+    return fc_layer(input=input, size=size, act=act, name=name,
+                    param_attr=param_attr, bias_attr=bias_attr)
+
+
+__all__ += [
+    "seq_slice_layer", "sub_seq_layer", "lstm_step_layer",
+    "gru_step_layer", "gru_step_naive_layer", "get_output_layer",
+    "tensor_layer", "selective_fc_layer",
 ]

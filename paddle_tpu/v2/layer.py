@@ -61,11 +61,18 @@ class Layer(object):
         self.created_at = Layer._seq
         if Layer._registry is not None:
             Layer._registry[self.name] = self
+        if Layer._step_nodes is not None:
+            Layer._step_nodes.append(self)
 
     # when not None, every created node is recorded by name — the legacy
     # config path (trainer_config_helpers.reset_config) uses this so
     # Outputs("layer_name") can resolve names to nodes
     _registry: Optional[Dict[str, "Layer"]] = None
+    # when not None, created nodes are ALSO appended here — used by
+    # recurrent_group to capture side-effect nodes of a step function
+    # (e.g. a get_output_layer that closes a memory cycle but is not on
+    # the path to the step output)
+    _step_nodes: Optional[List["Layer"]] = None
 
     def __repr__(self):
         return "v2.Layer(%s, %r)" % (self.kind, self.name)

@@ -244,6 +244,12 @@ class Topology(object):
             return self._node_width(boot) if boot is not None else None
         if node.kind == "rg_gen_in":
             return int(a["size"])
+        if node.kind in ("lstm_step", "gru_step"):
+            if a.get("size"):
+                return int(a["size"])
+            return self._node_width(node.parents[1])
+        if node.kind == "get_output":
+            return self._node_width(node.parents[0])
         if node.kind in ("rg_step_in", "rg_static_in"):
             return self._node_width(node._outer)
         if node.parents:
@@ -469,8 +475,16 @@ class Topology(object):
                         pre = rnn.memory(shape=[int(size)], value=0.0)
                     local[m.name] = pre
                     mem_pre[m.attrs["ref_name"]] = pre
-                # replay the step sub-DAG (placeholders/memories excluded)
-                for sub in parse_network(step_out):
+                # replay the step sub-DAG (placeholders/memories
+                # excluded), PLUS any side-effect node that closes a
+                # memory cycle without being on the output path (e.g.
+                # get_output_layer of an lstm_step's cell)
+                mem_closers = [
+                    n for n in node.attrs.get("step_nodes", [])
+                    if n.name in mem_pre
+                ]
+                targets = [step_out] + mem_closers
+                for sub in parse_network(*targets):
                     if id(sub) in ph_ids or sub.name in local:
                         continue
                     local[sub.name] = self._emit(sub)
@@ -867,4 +881,101 @@ _BREADTH_EMITTERS.update({
     "spp": _emit_spp,
     "factorization_machine": _emit_factorization_machine,
     "huber_cls_cost": _emit_huber_cls_cost,
+})
+
+
+def _emit_seq_slice(t, node):
+    x = t._in(node)
+    a = node.attrs
+    L = _L()
+    if not a["has_ends"]:
+        raise NotImplementedError(
+            "seq_slice_layer without ends=: pass explicit end indices"
+        )
+    idx = 1
+    if a["has_starts"]:
+        starts = L.cast(t._var(node.parents[idx].name), "int32")
+        idx += 1
+        ends = L.cast(t._var(node.parents[idx].name), "int32")
+    else:
+        # begin of each sequence: a per-SEQUENCE zeros tensor, shaped
+        # like `ends` (one row per sequence, not per token)
+        ends = L.cast(t._var(node.parents[idx].name), "int32")
+        starts = L.scale(x=ends, scale=0.0)
+    length = L.elementwise_sub(x=ends, y=starts)
+    return L.sequence_slice(input=x, offset=starts, length=length)
+
+
+def _emit_sub_seq(t, node):
+    x, offsets, sizes = t._ins(node)
+    L = _L()
+    return L.sequence_slice(input=x, offset=L.cast(offsets, "int32"),
+                            length=L.cast(sizes, "int32"))
+
+
+def _emit_lstm_step(t, node):
+    x, c_prev = t._ins(node)
+    from ..fluid.layer_helper import LayerHelper
+
+    H = t._width(c_prev, node.parents[1])
+    helper = LayerHelper("lstm_unit")
+    c = helper.create_tmp_variable(dtype="float32", shape=(-1, H))
+    h = helper.create_tmp_variable(dtype="float32", shape=(-1, H))
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [x], "C_prev": [c_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": 0.0},
+    )
+    t._bind(node.name + "@out_state", c)
+    return h
+
+
+def _emit_gru_step(t, node):
+    x, h_prev = t._ins(node)
+    size = node.attrs.get("size") or t._width(h_prev, node.parents[1])
+    pa = node.attrs.get("param_attr")
+    # the existing fluid gru_unit wrapper creates weight + bias + outputs
+    # (reference GruStepLayer includes the gate bias)
+    ba = node.attrs.get("bias_attr")
+    hidden, _, _ = _L().gru_unit(
+        input=x, hidden=h_prev, size=3 * int(size),
+        param_attr=fluid.ParamAttr(
+            name=getattr(pa, "name", None) or node.name + ".w0"
+        ),
+        bias_attr=fluid.ParamAttr(
+            name=getattr(ba, "name", None) or node.name + ".wbias"
+        ),
+    )
+    return hidden
+
+
+def _emit_get_output(t, node):
+    return t._var(node.parents[0].name + "@out_state")
+
+
+def _emit_tensor(t, node):
+    a, b = t._ins(node)
+    da = t._width(a, node.parents[0])
+    db = t._width(b, node.parents[1])
+    k = int(node.attrs["size"])
+    pa = node.attrs.get("param_attr")
+    w = _L().create_parameter(
+        [da, k * db], "float32",
+        attr=getattr(pa, "name", None) or node.name + ".w0",
+    )
+    aw = _L().reshape(x=_L().mul(x=a, y=w), shape=[-1, k, db])  # [N,K,db]
+    b3 = _L().reshape(x=b, shape=[-1, 1, db])
+    out = _L().reduce_sum(_L().elementwise_mul(x=aw, y=b3), dim=2)
+    act = node.attrs.get("act")
+    return _act_apply(out, act)
+
+
+_BREADTH_EMITTERS.update({
+    "seq_slice": _emit_seq_slice,
+    "sub_seq": _emit_sub_seq,
+    "lstm_step": _emit_lstm_step,
+    "gru_step": _emit_gru_step,
+    "get_output": _emit_get_output,
+    "tensor": _emit_tensor,
 })

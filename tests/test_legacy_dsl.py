@@ -538,3 +538,153 @@ def test_breadth_wrappers_round2():
                       np.where(m <= -1, -4 * m, (1 - m) ** 2)).mean()
     np.testing.assert_allclose(float(np.ravel(outs[7])[0]), want_h,
                                rtol=1e-5)
+
+
+def test_breadth_wrappers_round3():
+    """lstm_step/gru_step/get_output inside recurrent_group, tensor_layer
+    bilinear oracle, sub_seq_layer slicing."""
+    _fresh()
+    rng = np.random.RandomState(8)
+    dict_dim, word_dim, H = 8, 6, 5
+
+    # custom LSTM cell written with step layers (reference LstmStepLayer)
+    words = tch.data_layer(name="r3_w", size=dict_dim)
+    emb = tch.embedding_layer(input=words, size=word_dim)
+
+    def step(y):
+        c_mem = tch.memory(name="r3_c", size=H)
+        x4h = tch.fc_layer(input=[y], size=H * 4, bias_attr=True)
+        h = tch.lstm_step_layer(input=x4h, state=c_mem, size=H,
+                                name="r3_h")
+        tch.get_output_layer(input=h, arg_name="state", name="r3_c")
+        return h
+
+    out = tch.recurrent_group(name="r3_rnn", step=step, input=emb)
+    rep = tch.last_seq(input=out)
+    prob = tch.fc_layer(input=rep, size=3, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name="r3_y", size=3)
+    cost = tch.classification_cost(input=prob, label=lbl)
+
+    topo = Topology([cost])
+    cost_var = topo.var_of[cost.name]
+    with fluid.program_guard(topo.main_program, topo.startup_program):
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(cost_var)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    lens = [3, 2, 4]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    wd = rng.randint(0, dict_dim, (sum(lens), 1)).astype(np.int64)
+    yd = rng.randint(0, 3, (3, 1)).astype(np.int64)
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        losses = [
+            float(np.ravel(exe.run(
+                topo.main_program,
+                feed={"r3_w": (wd, [lod]), "r3_y": yd},
+                fetch_list=[cost_var])[0])[0])
+            for _ in range(20)
+        ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # tensor_layer: out_k = a W_k b^T oracle, and sub_seq slicing
+    _fresh()
+    a = tch.data_layer(name="r3_a", size=3)
+    b = tch.data_layer(name="r3_b", size=4)
+    tl = tch.tensor_layer(a=a, b=b, size=2,
+                          param_attr=tch.ParamAttr(name="r3_tw"))
+    seq = tch.data_layer(name="r3_seq", size=2)
+    emb2 = tch.embedding_layer(input=seq, size=4)
+    offs = tch.data_layer(name="r3_off", size=1)
+    sizes = tch.data_layer(name="r3_sz", size=1)
+    sub = tch.sub_seq_layer(input=emb2, offsets=offs, sizes=sizes)
+    topo2 = Topology([tl, sub])
+    scope2 = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope2):
+        exe.run(topo2.startup_program)
+        a_np = rng.rand(3, 3).astype(np.float32)
+        b_np = rng.rand(3, 4).astype(np.float32)
+        W = rng.rand(3, 8).astype(np.float32)
+        scope2.set("r3_tw", W)
+        lens2 = [2, 3]
+        lod2 = np.cumsum([0] + lens2).astype(np.int32)
+        ids = rng.randint(0, 2, (5, 1)).astype(np.int64)
+        outs = exe.run(
+            topo2.main_program,
+            feed={
+                "r3_a": a_np, "r3_b": b_np,
+                "r3_seq": (ids, [lod2]),
+                "r3_off": np.array([[0], [1]], np.int64),
+                "r3_sz": np.array([[1], [2]], np.int64),
+            },
+            fetch_list=[topo2.var_of[tl.name], topo2.var_of[sub.name]],
+        )
+    want_t = np.stack(
+        [np.einsum("nd,de,ne->n", a_np, W[:, k * 4:(k + 1) * 4], b_np)
+         for k in range(2)], axis=1)
+    np.testing.assert_allclose(outs[0], want_t, rtol=1e-5)
+    assert outs[1].shape[0] == 5  # static buffer; 3 valid rows compacted
+
+
+def test_gru_step_and_seq_slice_defaults():
+    """gru_step_layer trains inside a recurrent_group (with gate bias),
+    and seq_slice_layer with starts=None slices from sequence begins."""
+    _fresh()
+    rng = np.random.RandomState(9)
+    dict_dim, word_dim, H = 8, 6, 5
+    words = tch.data_layer(name="g_w", size=dict_dim)
+    emb = tch.embedding_layer(input=words, size=word_dim)
+
+    def step(y):
+        mem = tch.memory(name="g_h", size=H)
+        x3h = tch.fc_layer(input=[y], size=H * 3, bias_attr=False)
+        h = tch.gru_step_layer(input=x3h, output_mem=mem, size=H,
+                               name="g_h")
+        return h
+
+    out = tch.recurrent_group(name="g_rnn", step=step, input=emb)
+    rep = tch.last_seq(input=out)
+    prob = tch.fc_layer(input=rep, size=3, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name="g_y", size=3)
+    cost = tch.classification_cost(input=prob, label=lbl)
+    topo = Topology([cost])
+    cost_var = topo.var_of[cost.name]
+    with fluid.program_guard(topo.main_program, topo.startup_program):
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(cost_var)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    lens = [3, 2]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    wd = rng.randint(0, dict_dim, (sum(lens), 1)).astype(np.int64)
+    yd = rng.randint(0, 3, (2, 1)).astype(np.int64)
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        losses = [
+            float(np.ravel(exe.run(
+                topo.main_program,
+                feed={"g_w": (wd, [lod]), "g_y": yd},
+                fetch_list=[cost_var])[0])[0])
+            for _ in range(15)
+        ]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # the gate bias really exists (reference GruStepLayer parity)
+    assert any(k.endswith(".wbias") and "g_h" in k for k in scope.keys())
+
+    # seq_slice with starts=None: begin-of-sequence slicing
+    _fresh()
+    seq = tch.data_layer(name="g_seq", size=2)
+    emb2 = tch.embedding_layer(input=seq, size=4)
+    ends = tch.data_layer(name="g_ends", size=1)
+    sl = tch.seq_slice_layer(input=emb2, ends=ends)
+    topo2 = Topology([sl])
+    scope2 = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope2):
+        exe.run(topo2.startup_program)
+        ids = rng.randint(0, 2, (5, 1)).astype(np.int64)
+        (out2,) = exe.run(
+            topo2.main_program,
+            feed={"g_seq": (ids, [np.array([0, 2, 5], np.int32)]),
+                  "g_ends": np.array([[1], [2]], np.int64)},
+            fetch_list=[topo2.var_of[sl.name]],
+        )
+    assert out2.shape[0] == 5  # static buffer; rows [0] and [2,3] kept

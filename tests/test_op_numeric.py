@@ -595,6 +595,24 @@ spec("conv_shift",
      grad=True, oracle=_conv_shift_oracle)
 
 
+def _seq_slice_oracle(i, a):
+    x, off, ln = i["X"], i["Offset"].ravel(), i["Length"].ravel()
+    segs = [(0, 2), (2, 6)]  # _lod6
+    rows = []
+    for si, (lo, hi) in enumerate(segs):
+        rows.append(x[lo + off[si]: lo + off[si] + ln[si]])
+    kept = np.concatenate(rows)
+    out = np.zeros_like(x)
+    out[: len(kept)] = kept
+    return {"Out": out}
+
+
+spec("sequence_slice",
+     ins={"X": R(90).randn(6, 3).astype(np.float32),
+          "Offset": np.array([[1], [0]], np.int64),
+          "Length": np.array([[1], [2]], np.int64)},
+     lods={"sequence_slice_x_0": _lod6}, grad=True,
+     oracle=_seq_slice_oracle)
 spec("sequence_softmax", ins={"X": R(81).randn(6, 1).astype(np.float32)},
      lods={"sequence_softmax_x_0": _lod6}, grad=True,
      gtol=(8e-2, 1e-3),
@@ -808,7 +826,6 @@ EXEMPT = {
                       "test_detection_ops.py",
     "lod_reset": "LoD metadata rewrite (no numeric output change); "
                  "covered via sequence tests",
-    "sequence_slice": "covered by sequence tests in test_rnn_ops.py",
     "one_hot": "int -> float expansion tested here forward-only",
     "sequence_erase": "int filtering tested here forward-only",
     "sequence_mask": "int -> mask tested here forward-only",
