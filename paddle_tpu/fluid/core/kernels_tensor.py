@@ -354,3 +354,10 @@ def _conv_shift(ctx, ins, attrs):
     for k in range(m):
         cols.append(jnp.roll(x, shift=half - k, axis=1) * y[:, k:k + 1])
     return {"Out": sum(cols)}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    """Flip along the given axes (reference reverse_op)."""
+    x = ins["X"][0]
+    return {"Out": jnp.flip(x, axis=tuple(attrs["axis"]))}

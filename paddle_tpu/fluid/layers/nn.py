@@ -76,6 +76,7 @@ __all__ = [
     "sequence_expand",
     "sequence_reshape",
     "sequence_slice",
+    "reverse",
     "im2sequence",
     "row_conv",
     "multiplex",
@@ -1359,5 +1360,17 @@ def sequence_slice(input, offset, length, name=None, **kwargs):
         type="sequence_slice",
         inputs={"X": [input], "Offset": [offset], "Length": [length]},
         outputs={"Out": [out]},
+    )
+    return out
+
+
+def reverse(x, axis, name=None, **kwargs):
+    """Flip along axes (reference reverse_op)."""
+    helper = LayerHelper("reverse", name=name)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(
+        type="reverse", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"axis": list(axis) if isinstance(axis, (list, tuple))
+               else [axis]},
     )
     return out

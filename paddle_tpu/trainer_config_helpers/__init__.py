@@ -1132,3 +1132,54 @@ __all__ += [
     "gru_step_layer", "gru_step_naive_layer", "get_output_layer",
     "tensor_layer", "selective_fc_layer",
 ]
+
+
+def printer_layer(input, format=None, name=None, **kwargs):
+    """Pass-through that prints values at run time is a debug aid the
+    fused-XLA executor cannot interleave; parity surface: identity
+    (reference PrintLayer prints to the trainer log)."""
+    return _simple("identity", input, name=name)
+
+
+def resize_layer(input, size, name=None, **kwargs):
+    """Reshape rows to width `size` (reference ResizeLayer)."""
+    return _simple("resize", input, name=name, size=int(size))
+
+
+def rotate_layer(input, height=None, width=None, name=None, **kwargs):
+    """90-degree CLOCKWISE rotation of each feature map (reference
+    RotateLayer: out(c, H-1-r) = in(r, c)); height/width declare the
+    geometry when the input has none."""
+    src = _as_list(input)[0]
+    if height and width and getattr(src, "im_shape", None) is None:
+        size = src.attrs["type"].dim
+        src.im_shape = (size // (height * width), int(height), int(width))
+    inp, (c, h, w) = _ensure_image(src, None)
+    node = _simple("rotate", inp, name=name)
+    node.im_shape = (c, w, h)
+    return node
+
+
+def cross_channel_norm_layer(input, name=None, param_attr=None, **kwargs):
+    """L2-normalise across channels per spatial position, with a learned
+    per-channel scale (reference CrossChannelNormLayer, the SSD conv4_3
+    norm)."""
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], None)
+    node = _simple("cross_channel_norm", inp, name=name,
+                   channels=c, param_attr=param_attr)
+    node.im_shape = (c, h, w)
+    return node
+
+
+def slice_projection(input, slices, **kwargs):
+    """Column slices of the input concatenated (reference
+    slice_projection): slices = [(start, end), ...]."""
+    return _Projection("slice", input, slices=[
+        (int(a), int(b)) for a, b in slices
+    ])
+
+
+__all__ += [
+    "printer_layer", "resize_layer", "rotate_layer",
+    "cross_channel_norm_layer", "slice_projection",
+]

@@ -308,6 +308,15 @@ class Topology(object):
             elif proj.ptype == "scaling":
                 w = L.create_parameter([1], "float32", attr=pname)
                 terms.append(L.elementwise_mul(x=x, y=w))
+            elif proj.ptype == "slice":
+                parts = [
+                    L.slice(x, axes=[1], starts=[a], ends=[b])
+                    for a, b in proj.attrs["slices"]
+                ]
+                terms.append(
+                    parts[0] if len(parts) == 1
+                    else L.concat(input=parts, axis=1)
+                )
             elif proj.ptype == "dotmul_op":
                 b = self._var(proj.extra_inputs[0].name)
                 term = L.elementwise_mul(x=x, y=b)
@@ -978,4 +987,42 @@ _BREADTH_EMITTERS.update({
     "gru_step": _emit_gru_step,
     "get_output": _emit_get_output,
     "tensor": _emit_tensor,
+})
+
+
+def _emit_identity(t, node):
+    return t._in(node)
+
+
+def _emit_resize(t, node):
+    return _L().reshape(x=t._in(node), shape=[-1, node.attrs["size"]])
+
+
+def _emit_rotate(t, node):
+    # reference RotateLayer is CLOCKWISE: out(c, H-1-r) = in(r, c) —
+    # transpose H/W then flip the (new) W axis
+    out = _L().transpose(t._in(node), [0, 1, 3, 2])
+    return _L().reverse(out, axis=[3])
+
+
+def _emit_cross_channel_norm(t, node):
+    x = t._in(node)
+    c = int(node.attrs["channels"])
+    pa = node.attrs.get("param_attr")
+    scale = _L().create_parameter(
+        [1, c, 1, 1], "float32",
+        attr=getattr(pa, "name", None) or node.name + ".w0",
+        default_initializer=fluid.initializer.Constant(1.0),
+    )
+    sq = _L().reduce_sum(_L().square(x), dim=1, keep_dim=True)
+    norm = _L().sqrt(_L().scale(x=sq, scale=1.0, bias=1e-10))
+    return _L().elementwise_mul(x=_L().elementwise_div(x=x, y=norm),
+                                y=scale)
+
+
+_BREADTH_EMITTERS.update({
+    "identity": _emit_identity,
+    "resize": _emit_resize,
+    "rotate": _emit_rotate,
+    "cross_channel_norm": _emit_cross_channel_norm,
 })

@@ -688,3 +688,40 @@ def test_gru_step_and_seq_slice_defaults():
             fetch_list=[topo2.var_of[sl.name]],
         )
     assert out2.shape[0] == 5  # static buffer; rows [0] and [2,3] kept
+
+
+def test_breadth_wrappers_round4():
+    """printer/resize/rotate/cross_channel_norm/slice_projection."""
+    _fresh()
+    rng = np.random.RandomState(10)
+    img = tch.data_layer(name="r4_img", size=2 * 3 * 4, height=3, width=4)
+    pr = tch.printer_layer(input=img)
+    rz = tch.resize_layer(input=img, size=12)
+    rot = tch.rotate_layer(input=img)
+    ccn = tch.cross_channel_norm_layer(input=img)
+    a = tch.data_layer(name="r4_a", size=6)
+    with tch.mixed_layer(size=4) as m:
+        m += tch.slice_projection(input=a, slices=[(0, 2), (4, 6)])
+    topo = Topology([pr, rz, rot, ccn, m])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        img_np = rng.rand(2, 24).astype(np.float32)
+        a_np = rng.rand(3, 6).astype(np.float32)
+        outs = exe.run(
+            topo.main_program,
+            feed={"r4_img": img_np, "r4_a": a_np},
+            fetch_list=[topo.var_of[n.name] for n in (pr, rz, rot, ccn, m)],
+        )
+    np.testing.assert_allclose(outs[0], img_np)            # identity
+    np.testing.assert_allclose(outs[1], img_np.reshape(4, 12))
+    x4 = img_np.reshape(2, 2, 3, 4)
+    # reference RotateLayer is CLOCKWISE: out(c, H-1-r) = in(r, c)
+    np.testing.assert_allclose(
+        outs[2], x4.transpose(0, 1, 3, 2)[:, :, :, ::-1], rtol=1e-6)
+    want_ccn = x4 / np.sqrt((x4 ** 2).sum(1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(outs[3], want_ccn, rtol=1e-5)
+    np.testing.assert_allclose(
+        outs[4], np.concatenate([a_np[:, 0:2], a_np[:, 4:6]], axis=1),
+        rtol=1e-6)

@@ -613,6 +613,9 @@ spec("sequence_slice",
           "Length": np.array([[1], [2]], np.int64)},
      lods={"sequence_slice_x_0": _lod6}, grad=True,
      oracle=_seq_slice_oracle)
+spec("reverse", ins={"X": R(91).randn(2, 3, 4).astype(np.float32)},
+     attrs={"axis": [1, 2]}, grad=True,
+     oracle=lambda i, a: {"Out": i["X"][:, ::-1, ::-1]})
 spec("sequence_softmax", ins={"X": R(81).randn(6, 1).astype(np.float32)},
      lods={"sequence_softmax_x_0": _lod6}, grad=True,
      gtol=(8e-2, 1e-3),
