@@ -239,3 +239,183 @@ def _multiclass_nms(ctx, ins, attrs):
     ctx.env[lod_key(out_name)] = offsets
     ctx.env[out_name + "@PAD_STRIDE"] = k
     return {"Out": out}
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """ROI max pooling (reference gserver ROIPoolLayer.cpp, RoIPooling
+    per Fast R-CNN): each ROI's window on the feature map is divided into
+    a pooled_h x pooled_w grid of bins and each bin max-pooled.
+
+    TPU-first: bin membership is expressed as separable H/W masks built
+    from aranges (static shapes), and the pool is a masked max — no
+    per-roi dynamic slicing, so one XLA program covers every ROI set.
+    ROIs: [R, 4] (x1, y1, x2, y2) with a LoD side-band mapping ROIs to
+    batch images (offsets [N+1]).
+    """
+    x = ins["X"][0]  # [N, C, H, W]
+    rois = ins["ROIs"][0]  # [R, 4]
+    roi_name = ctx.op.inputs["ROIs"][0]
+    key = lod_key(roi_name)
+    if key in ctx.env:
+        offsets = ctx.env[key]
+        from .kernels_sequence import seg_ids
+
+        batch_of = seg_ids(offsets, rois.shape[0])  # [R]
+    else:  # single-image default
+        batch_of = jnp.zeros((rois.shape[0],), jnp.int32)
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+
+    def one_roi(roi, b):
+        # round to the feature-map grid like the reference (ROIPoolLayer)
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        # bin p covers [floor(p*rh/ph), ceil((p+1)*rh/ph)) + y1, clipped
+        p = jnp.arange(ph)
+        hstart = jnp.clip(y1 + (p * rh) // ph, 0, H)
+        hend = jnp.clip(y1 + -((-(p + 1) * rh) // ph), 0, H)
+        q = jnp.arange(pw)
+        wstart = jnp.clip(x1 + (q * rw) // pw, 0, W)
+        wend = jnp.clip(x1 + -((-(q + 1) * rw) // pw), 0, W)
+        hs = jnp.arange(H)
+        ws = jnp.arange(W)
+        mh = (hs[None, :] >= hstart[:, None]) & (hs[None, :] < hend[:, None])
+        mw = (ws[None, :] >= wstart[:, None]) & (ws[None, :] < wend[:, None])
+        feat = x[b]  # [C, H, W]
+        masked = jnp.where(
+            mh[None, :, None, :, None] & mw[None, None, :, None, :],
+            feat[:, None, None, :, :],
+            _NEG,
+        )  # [C, ph, pw, H, W]
+        pooled = masked.max(axis=(3, 4))
+        # empty bins read 0 (reference memsets the output)
+        return jnp.where(pooled <= _NEG, 0.0, pooled)
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32), batch_of)  # [R,C,ph,pw]
+    out_name = ctx.op.outputs["Out"][0]
+    if key in ctx.env:
+        ctx.env[lod_key(out_name)] = ctx.env[key]
+    return {"Out": out}
+
+
+@register_op("ssd_multibox_loss")
+def _ssd_multibox_loss(ctx, ins, attrs):
+    """SSD MultiBox training loss (legacy gserver MultiBoxLossLayer.cpp):
+    match priors to ground-truth boxes by IoU, smooth-L1 on the encoded
+    location offsets of the positives, softmax cross-entropy on class
+    confidences with hard negative mining at `neg_pos_ratio`.
+
+    TPU-first: all matching is dense masked argmax over a static
+    [N, P, G] IoU tensor (G = packed ground-truth boxes across the batch,
+    images separated by a mask built from the LoD side-band) — no
+    per-image host loop, one XLA program for every batch composition.
+    Emits a per-image cost [N, 1], each image normalised by its matched
+    prior count (the reference normalises by the batch's total).
+    """
+    loc = ins["Loc"][0]          # [N, P, 4] predicted offsets
+    conf = ins["Conf"][0]        # [N, P, C] raw logits
+    gt_box = ins["GTBox"][0]     # [G, 4] corners, packed over the batch
+    gt_label = ins["GTLabel"][0].reshape(-1).astype(jnp.int32)  # [G]
+    priors = ins["PriorBox"][0]  # [P, 4] corners
+    prior_var = ins["PriorVar"][0]  # [P, 4]
+    gt_name = ctx.op.inputs["GTBox"][0]
+    offsets = ctx.env[lod_key(gt_name)]  # [N+1]
+    from .kernels_sequence import seg_ids
+
+    N, P, C = conf.shape
+    G = gt_box.shape[0]
+    overlap_t = float(attrs.get("overlap_threshold", 0.5))
+    neg_overlap = float(attrs.get("neg_overlap", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    bg = int(attrs.get("background_id", 0))
+
+    img_of = seg_ids(offsets, G)  # [G]
+
+    def _area(b):
+        return jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(
+            b[..., 3] - b[..., 1], 0.0
+        )
+
+    lt = jnp.maximum(priors[:, None, :2], gt_box[None, :, :2])
+    rb = jnp.minimum(priors[:, None, 2:], gt_box[None, :, 2:])
+    inter = jnp.maximum(rb - lt, 0.0)
+    inter = inter[..., 0] * inter[..., 1]  # [P, G]
+    union = _area(priors)[:, None] + _area(gt_box)[None, :] - inter
+    iou = inter / jnp.maximum(union, 1e-10)
+
+    in_img = img_of[None, :] == jnp.arange(N)[:, None]  # [N, G]
+    iou_n = jnp.where(in_img[:, None, :], iou[None, :, :], -1.0)  # [N,P,G]
+    best_iou = iou_n.max(axis=2)         # [N, P]
+    best_g = iou_n.argmax(axis=2)        # [N, P] global gt index
+
+    pos = best_iou > overlap_t
+    # bipartite guarantee: greedy global matching, one (gt, prior) pair
+    # per round with already-claimed priors/gts masked out — each gt gets
+    # a DISTINCT prior even when two gts share a best prior (reference
+    # BipartiteMatch / MultiBoxLossLayer match semantics)
+    def _match_round(_, state):
+        claimed, bg, matched = state
+        sc = jnp.where(matched[None, :], -1.0, iou)  # [P, G]
+        sc = jnp.where(claimed[img_of].T, -1.0, sc)
+        idx = jnp.argmax(sc)
+        p_star, g_star = idx // G, idx % G
+        ok = sc[p_star, g_star] > 0.0
+        n_star = img_of[g_star]
+        claimed = claimed.at[n_star, p_star].set(claimed[n_star, p_star] | ok)
+        bg = bg.at[n_star, p_star].set(
+            jnp.where(ok, g_star, bg[n_star, p_star])
+        )
+        matched = matched.at[g_star].set(matched[g_star] | ok)
+        return claimed, bg, matched
+
+    claimed0 = jnp.zeros((N, P), bool)
+    matched0 = jnp.zeros((G,), bool)
+    claimed, best_g, _ = jax.lax.fori_loop(
+        0, G, _match_round, (claimed0, best_g, matched0)
+    )
+    has_gt = (offsets[1:] - offsets[:-1]) > 0
+    pos = (pos | claimed) & has_gt[:, None]
+
+    # ---- location loss (smooth L1 on encoded offsets, positives only)
+    def _cwh(b):
+        w = b[..., 2] - b[..., 0]
+        h = b[..., 3] - b[..., 1]
+        return (b[..., 0] + b[..., 2]) / 2, (b[..., 1] + b[..., 3]) / 2, w, h
+
+    pcx, pcy, pw, ph = _cwh(priors)
+    g = gt_box[best_g]  # [N, P, 4]
+    gcx, gcy, gw, gh = _cwh(g)
+    var = prior_var[None]  # [1, P, 4]
+    tx = (gcx - pcx) / jnp.maximum(pw, 1e-10) / var[..., 0]
+    ty = (gcy - pcy) / jnp.maximum(ph, 1e-10) / var[..., 1]
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(pw, 1e-10), 1e-10)) / var[..., 2]
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(ph, 1e-10), 1e-10)) / var[..., 3]
+    tgt = jnp.stack([tx, ty, tw, th], axis=-1)  # [N, P, 4]
+    d = loc - jax.lax.stop_gradient(tgt)
+    sl1 = jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d, jnp.abs(d) - 0.5)
+    loc_loss = jnp.where(pos, sl1.sum(-1), 0.0).sum(axis=1)  # [N]
+
+    # ---- confidence loss with hard negative mining
+    tgt_label = jnp.where(pos, gt_label[best_g], bg)  # [N, P]
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    ce = -jnp.take_along_axis(logp, tgt_label[..., None], axis=-1)[..., 0]
+    n_pos = pos.sum(axis=1)  # [N]
+    n_neg = jnp.minimum(
+        (neg_ratio * n_pos).astype(jnp.int32), P - n_pos
+    )
+    neg_cand = (~pos) & (best_iou < neg_overlap)
+    neg_score = jnp.where(neg_cand, jax.lax.stop_gradient(ce), -jnp.inf)
+    order = jnp.argsort(-neg_score, axis=1)  # per image, hardest first
+    rank = jnp.argsort(order, axis=1)
+    neg = neg_cand & (rank < n_neg[:, None])
+    conf_loss = jnp.where(pos | neg, ce, 0.0).sum(axis=1)  # [N]
+
+    denom = jnp.maximum(n_pos.astype(conf.dtype), 1.0)
+    return {"Out": ((loc_loss + conf_loss) / denom)[:, None]}

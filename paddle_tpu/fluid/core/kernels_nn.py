@@ -328,7 +328,11 @@ def _prelu(ctx, ins, attrs):
     x = ins["X"][0]
     alpha = ins["Alpha"][0]
     if alpha.size > 1 and x.ndim >= 2:
-        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+        if alpha.size == int(np.prod(x.shape[1:])):
+            # element mode: one alpha per element of a sample
+            alpha = alpha.reshape((1,) + tuple(x.shape[1:]))
+        else:  # channel mode: one alpha per channel (axis 1)
+            alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
     return {"Out": jnp.where(x > 0, x, alpha * x)}
 
 

@@ -245,3 +245,191 @@ def _sequence_context(ctx, ins, attrs):
         src_c = jnp.clip(src, 0, total - 1)
         cols.append(jnp.where(valid[:, None], x[src_c], 0.0))
     return {"Out": jnp.concatenate(cols, axis=1)}
+
+
+@register_op("kmax_seq_score")
+def _kmax_seq_score(ctx, ins, attrs):
+    """Per-sequence top-k score indices (reference gserver
+    KmaxSeqScoreLayer.cpp): scores are a width-1 sequence; the output row
+    for each sequence holds the WITHIN-sequence indices of its beam_size
+    highest scores, -1 padded where the sequence is shorter.
+
+    TPU-first: one masked top_k over the packed vector per sequence
+    (full static length), no host loop over sequences.
+    """
+    x = ins["X"][0].reshape(-1)  # [total]
+    offsets = _offsets(ctx)
+    total = x.shape[0]
+    n = offsets.shape[0] - 1
+    k = int(attrs.get("beam_size", 1))
+    ids = seg_ids(offsets, total)
+
+    def one_seq(i):
+        masked = jnp.where(ids == i, x, -jnp.inf)
+        top_s, top_i = jax.lax.top_k(masked, min(k, total))
+        rel = top_i.astype(jnp.int32) - offsets[i]
+        rel = jnp.where(jnp.isfinite(top_s), rel, -1)
+        if k > total:  # more slots than tokens exist at all
+            rel = jnp.pad(rel, (0, k - total), constant_values=-1)
+        return rel
+
+    out = jax.vmap(one_seq)(jnp.arange(n))
+    return {"Out": out}
+
+
+@register_op("sub_nested_seq")
+def _sub_nested_seq(ctx, ins, attrs):
+    """Select sub-sequences out of a nested (2-level LoD) sequence
+    (reference gserver SubNestedSequenceLayer.cpp): input X is a nested
+    sequence, `selected_indices` [N, S] gives per outer sequence the
+    (within-sequence) sub-sequence indices to keep, -1 padded.
+
+    Static-shape re-design: the output always has N*S sequences — slot
+    (i, j) is sub-sequence selected_indices[i, j] of sequence i, or an
+    EMPTY sequence for -1 entries; tokens are compacted to the front of a
+    buffer the same packed length as X (tail rows beyond the new total
+    are dead and never addressed through the LoD).
+    """
+    x = ins["X"][0]  # [total, D]
+    sel = ins["S"][0].astype(jnp.int32)  # [N, S]
+    name = ctx.op.inputs["X"][0]
+    tok_off = ctx.env[lod_key(name)]  # [M+1] token offsets per sub-seq
+    from .kernels_control import LOD_SRC
+
+    outer = ctx.env.get(name + LOD_SRC)
+    if outer is None:
+        raise ValueError(
+            "sub_nested_seq input %r is not a nested sequence (feed it "
+            "with a 2-level LoD)" % name
+        )
+    outer = outer.astype(jnp.int32)  # [N+1] sub-seq slots per sequence
+    total = x.shape[0]
+    M = tok_off.shape[0] - 1  # number of sub-sequences
+    N, S = sel.shape
+
+    valid = sel >= 0
+    g = jnp.clip(outer[:-1, None] + sel, 0, M - 1)  # [N,S] global sub-seq id
+    # guard: a selected index past the sequence's own sub-seq count is -1
+    valid &= (outer[:-1, None] + sel) < outer[1:, None]
+    lengths = jnp.where(valid, tok_off[g + 1] - tok_off[g], 0)  # [N,S]
+    flat_len = lengths.reshape(-1)
+    new_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(flat_len, dtype=jnp.int32)]
+    )  # [N*S+1]
+    # token gather: output position p in slot (i,j) reads
+    # x[tok_off[g[i,j]] + (p - new_off[slot])]
+    pos = jnp.arange(total, dtype=jnp.int32)
+    slot = jnp.searchsorted(new_off, pos, side="right") - 1
+    slot_c = jnp.clip(slot, 0, N * S - 1)
+    src = tok_off[g.reshape(-1)[slot_c]] + (pos - new_off[slot_c])
+    live = pos < new_off[-1]
+    out = jnp.where(
+        live.reshape((-1,) + (1,) * (x.ndim - 1)),
+        x[jnp.clip(src, 0, total - 1)],
+        0.0,
+    )
+    _set_lod(ctx, "Out", new_off)
+    return {"Out": out}
+
+
+@register_op("lambda_rank")
+def _lambda_rank(ctx, ins, attrs):
+    """LambdaRank listwise cost (reference gserver CostLayer.cpp
+    LambdaCost::forward/calcGrad/calcNDCG): forward emits each sequence's
+    NDCG@K (computed from the MODEL-score ranking) broadcast over the
+    sequence's rows; the backward pass is the classic lambda gradient —
+    for every in-sequence pair, |deltaDCG| (from the LABEL-score ranking)
+    times the logistic factor on the model-score difference, normalised
+    by maxDCG. maxSortSize=-1 semantics (full sort) only.
+
+    TPU-first: ranks come from pairwise comparison matrices masked to
+    same-sequence pairs — one [T, T] computation, no per-sequence loop.
+    """
+    out_score = ins["X"][0].reshape(-1)  # model scores, packed [T]
+    label = ins["Score"][0].reshape(-1)  # relevance labels, packed [T]
+    offsets = _offsets(ctx)
+    K = int(attrs.get("NDCG_num", 5))
+    total = out_score.shape[0]
+    n = offsets.shape[0] - 1
+    ids = seg_ids(offsets, total)
+    same = ids[:, None] == ids[None, :]  # [T, T]
+    pos = jnp.arange(total)
+
+    def _rank(v):
+        """0-based rank of each token within its sequence, descending v
+        (ties by position, matching std::sort on (value, index) pairs)."""
+        gt = (v[None, :] > v[:, None]) | (
+            (v[None, :] == v[:, None]) & (pos[None, :] < pos[:, None])
+        )
+        return jnp.sum(same & gt, axis=1)
+
+    gain = jnp.exp2(label) - 1.0
+    inv_log = lambda r: 1.0 / jnp.log(r.astype(jnp.float32) + 2.0)
+
+    rank_lbl = _rank(label)
+    max_dcg = jax.ops.segment_sum(
+        jnp.where(rank_lbl < K, gain * inv_log(rank_lbl), 0.0),
+        ids, num_segments=n,
+    )
+    max_dcg = jnp.maximum(max_dcg, 1e-12)
+
+    @jax.custom_vjp
+    def _cost(s):
+        rank_out = _rank(s)
+        dcg = jax.ops.segment_sum(
+            jnp.where(rank_out < K, gain * inv_log(rank_out), 0.0),
+            ids, num_segments=n,
+        )
+        return (dcg / max_dcg)[ids][:, None]  # [T, 1]
+
+    def _fwd(s):
+        return _cost(s), s
+
+    def _bwd(s, gbar):
+        ra = rank_lbl[:, None]
+        rb = rank_lbl[None, :]
+        upper = same & (ra < rb)  # pair (a, b) with a ranked above b
+        dcg_dif = (jnp.exp2(label)[:, None] - jnp.exp2(label)[None, :]) * (
+            inv_log(ra) - inv_log(rb)
+        )
+        lam = -jnp.abs(dcg_dif) / (1.0 + jnp.exp(s[:, None] - s[None, :]))
+        lam = jnp.where(upper, lam / max_dcg[ids][:, None], 0.0)
+        g = jnp.sum(lam, axis=1) - jnp.sum(lam, axis=0)
+        return (g * gbar.reshape(-1),)
+
+    _cost.defvjp(_fwd, _bwd)
+    return {"Out": _cost(out_score)}
+
+
+@register_op("cross_entropy_over_beam")
+def _cross_entropy_over_beam(ctx, ins, attrs):
+    """Cross-entropy over beam expansions (reference gserver
+    CrossEntropyOverBeam.cpp, DSL layers.py cross_entropy_over_beam).
+    Each expansion e contributes, per outer sequence i, a globally
+    normalised term  logsumexp(scores_e over i's candidates) -
+    score_e[gold_i]; expansions are summed into a [N, 1] cost.
+
+    Simplification vs the reference (documented divergence): the
+    reference drops expansions after the step where gold falls off the
+    beam (CrossEntropyOverBeam.h CostForOneSequence); here every
+    expansion is counted — equivalent whenever gold stays on the beam,
+    which the trimming layers (kmax_seq_score/sub_nested_seq/
+    sequence_slice) are designed to ensure during training.
+    """
+    scores_list = ins["Scores"]
+    gold_list = ins["Gold"]
+    total_cost = None
+    for k, (s, g) in enumerate(zip(scores_list, gold_list)):
+        s = s.reshape(-1)
+        name = ctx.op.inputs["Scores"][k]
+        offsets = ctx.env[lod_key(name)]
+        n = offsets.shape[0] - 1
+        ids = seg_ids(offsets, s.shape[0])
+        m = jax.ops.segment_max(s, ids, num_segments=n)
+        lse = m + jnp.log(
+            jax.ops.segment_sum(jnp.exp(s - m[ids]), ids, num_segments=n)
+        )
+        gold_pos = offsets[:-1] + g.reshape(-1).astype(jnp.int32)
+        ce = lse - s[jnp.clip(gold_pos, 0, s.shape[0] - 1)]
+        total_cost = ce if total_cost is None else total_cost + ce
+    return {"Out": total_cost[:, None]}

@@ -180,7 +180,11 @@ def _crop(ctx, ins, attrs):
     shape = attrs.get("shape")
     if ins.get("Y"):
         shape = ins["Y"][0].shape
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    # -1 keeps the full remaining extent of that axis (dynamic batch dim)
+    idx = tuple(
+        slice(o, None) if s == -1 else slice(o, o + s)
+        for o, s in zip(offsets, shape)
+    )
     return {"Out": x[idx]}
 
 
@@ -361,3 +365,27 @@ def _reverse(ctx, ins, attrs):
     """Flip along the given axes (reference reverse_op)."""
     x = ins["X"][0]
     return {"Out": jnp.flip(x, axis=tuple(attrs["axis"]))}
+
+
+@register_op("scale_sub_region")
+def _scale_sub_region(ctx, ins, attrs):
+    """Scale values inside a per-sample (channel, height, width) box
+    (reference function/ScaleSubRegionOp.cpp + gserver
+    ScaleSubRegionLayer.cpp): Indices rows are 1-based INCLUSIVE
+    [c0, c1, h0, h1, w0, w1]; out = x, with x*value inside the region.
+    The gradient scales identically inside the region (autodiff gets
+    this for free from the jnp.where formulation)."""
+    x = ins["X"][0]  # [N, C, H, W]
+    idx = ins["Indices"][0].astype(jnp.int32)  # [N, 6]
+    value = float(attrs.get("value", 1.0))
+    N, C, H, W = x.shape
+    c = jnp.arange(C)
+    h = jnp.arange(H)
+    w = jnp.arange(W)
+    mc = (c[None, :] >= idx[:, 0:1] - 1) & (c[None, :] <= idx[:, 1:2] - 1)
+    mh = (h[None, :] >= idx[:, 2:3] - 1) & (h[None, :] <= idx[:, 3:4] - 1)
+    mw = (w[None, :] >= idx[:, 4:5] - 1) & (w[None, :] <= idx[:, 5:6] - 1)
+    mask = (
+        mc[:, :, None, None] & mh[:, None, :, None] & mw[:, None, None, :]
+    )
+    return {"Out": jnp.where(mask, x * value, x)}

@@ -116,3 +116,33 @@ def bipartite_match(dist_matrix, name=None):
         },
     )
     return match_indices, match_dist
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var, overlap_threshold=0.5, neg_pos_ratio=3.0,
+             neg_overlap=0.5, background_label=0, name=None, **kwargs):
+    """SSD MultiBox training loss (legacy gserver MultiBoxLossLayer.cpp).
+    location [N,P,4], confidence [N,P,C], gt_box packed [G,4] with a LoD
+    mapping boxes to images, gt_label packed [G,1]. Returns a per-image
+    cost [N, 1]."""
+    helper = LayerHelper("ssd_multibox_loss", **locals())
+    out = helper.create_tmp_variable(dtype=location.dtype)
+    helper.append_op(
+        type="ssd_multibox_loss",
+        inputs={
+            "Loc": [location], "Conf": [confidence],
+            "GTBox": [gt_box], "GTLabel": [gt_label],
+            "PriorBox": [prior_box], "PriorVar": [prior_box_var],
+        },
+        outputs={"Out": [out]},
+        attrs={
+            "overlap_threshold": overlap_threshold,
+            "neg_pos_ratio": neg_pos_ratio,
+            "neg_overlap": neg_overlap,
+            "background_id": background_label,
+        },
+    )
+    return out
+
+
+__all__.append("ssd_loss")

@@ -1374,3 +1374,196 @@ def reverse(x, axis, name=None, **kwargs):
                else [axis]},
     )
     return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, act=None, name=None,
+           **kwargs):
+    """3-D convolution, NCDHW (reference conv3d kernels under
+    operators/conv_op.cc; legacy gserver Conv3DLayer.cpp)."""
+    helper = LayerHelper("conv3d", **locals())
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    groups = groups or 1
+
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    filter_size = _triple(filter_size)
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+    fan_in = (num_channels // groups) * int(np.prod(filter_size))
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=Normal(0.0, (2.0 / fan_in) ** 0.5, 0),
+    )
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        type="conv3d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation, "groups": groups},
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, name=None, **kwargs):
+    """3-D pooling, NCDHW (reference operators/pool_op.cc pool3d;
+    legacy gserver Pool3DLayer.cpp)."""
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    helper = LayerHelper("pool3d", **locals())
+    out = helper.create_tmp_variable(helper.input_dtype())
+    helper.append_op(
+        type="pool3d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": _triple(pool_size),
+            "strides": _triple(pool_stride),
+            "paddings": _triple(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+        },
+    )
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None, **kwargs):
+    """Parametric ReLU (reference prelu_op.cc; legacy PReluLayer).
+    mode: 'all' one alpha, 'channel' per channel, 'element' per element."""
+    helper = LayerHelper("prelu", **locals())
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [int(x.shape[1])]
+    else:
+        alpha_shape = [int(s) for s in x.shape[1:]]
+    alpha = helper.create_parameter(
+        attr=helper.param_attr, shape=alpha_shape, dtype=x.dtype,
+        default_initializer=Constant(0.25),
+    )
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+        outputs={"Out": [out]}, attrs={"mode": mode},
+    )
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None, **kwargs):
+    """Crop a static window out of x (reference crop_op.cc; legacy
+    CropLayer). shape/offsets are python lists over ALL axes."""
+    helper = LayerHelper("crop", **locals())
+    out = helper.create_tmp_variable(x.dtype)
+    if offsets is None:
+        offsets = [0] * len(shape)
+    helper.append_op(
+        type="crop", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"offsets": list(offsets), "shape": list(shape)},
+    )
+    return out
+
+
+def roi_pool(input, rois, pooled_height, pooled_width, spatial_scale=1.0,
+             name=None, **kwargs):
+    """ROI max pooling (legacy gserver ROIPoolLayer.cpp). `rois` is an
+    [R, 4] (x1,y1,x2,y2) tensor whose LoD maps ROIs to batch images."""
+    helper = LayerHelper("roi_pool", **locals())
+    out = helper.create_tmp_variable(input.dtype, lod_level=rois.lod_level)
+    helper.append_op(
+        type="roi_pool",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+        },
+    )
+    return out
+
+
+def scale_sub_region(x, indices, value, name=None, **kwargs):
+    """Scale a per-sample (channel, height, width) box by `value`
+    (legacy gserver ScaleSubRegionLayer.cpp; indices rows are 1-based
+    inclusive [c0, c1, h0, h1, w0, w1])."""
+    helper = LayerHelper("scale_sub_region", **locals())
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type="scale_sub_region",
+        inputs={"X": [x], "Indices": [indices]},
+        outputs={"Out": [out]}, attrs={"value": value},
+    )
+    return out
+
+
+def kmax_sequence_score(input, beam_size=1, name=None, **kwargs):
+    """Within-sequence indices of each sequence's top-`beam_size` scores,
+    -1 padded (legacy gserver KmaxSeqScoreLayer.cpp)."""
+    helper = LayerHelper("kmax_seq_score", **locals())
+    out = helper.create_tmp_variable("int32")
+    helper.append_op(
+        type="kmax_seq_score", inputs={"X": [input]},
+        outputs={"Out": [out]}, attrs={"beam_size": beam_size},
+    )
+    return out
+
+
+def sub_nested_seq(input, selected_indices, name=None, **kwargs):
+    """Select sub-sequences of a nested (2-level LoD) sequence by index
+    (legacy gserver SubNestedSequenceLayer.cpp). Output slot (i, j) is
+    sub-sequence selected_indices[i, j] of sequence i (empty for -1)."""
+    helper = LayerHelper("sub_nested_seq", **locals())
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op(
+        type="sub_nested_seq",
+        inputs={"X": [input], "S": [selected_indices]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def lambda_rank_cost(score, label, ndcg_num=5, name=None, **kwargs):
+    """LambdaRank cost over score sequences (legacy gserver
+    CostLayer.cpp LambdaCost): forward is per-sequence NDCG@ndcg_num
+    broadcast over rows; backward is the lambda pairwise gradient."""
+    helper = LayerHelper("lambda_rank", **locals())
+    out = helper.create_tmp_variable(score.dtype, lod_level=1)
+    helper.append_op(
+        type="lambda_rank",
+        inputs={"X": [score], "Score": [label]},
+        outputs={"Out": [out]}, attrs={"NDCG_num": ndcg_num},
+    )
+    return out
+
+
+__all__ += [
+    "conv3d", "pool3d", "prelu", "crop", "roi_pool", "scale_sub_region",
+    "kmax_sequence_score", "sub_nested_seq", "lambda_rank_cost",
+]
+
+
+def lod_reset(x, y=None, target_lod=None, name=None, **kwargs):
+    """Re-attach/replace a LoD on x (reference lod_reset_op.cc): from
+    variable `y`'s LoD when given, else from the static `target_lod`."""
+    helper = LayerHelper("lod_reset", **locals())
+    out = helper.create_tmp_variable(x.dtype, lod_level=1)
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op(
+        type="lod_reset", inputs=inputs, outputs={"Out": [out]},
+        attrs={} if target_lod is None else {"target_lod": list(target_lod)},
+    )
+    return out
+
+
+__all__.append("lod_reset")

@@ -1183,3 +1183,298 @@ __all__ += [
     "printer_layer", "resize_layer", "rotate_layer",
     "cross_channel_norm_layer", "slice_projection",
 ]
+
+
+# ---------------------------------------------------------------------
+# breadth round 5: detection, image geometry, 3-D conv/pool, ranking
+# costs — the last block of reference layers.py wrappers (priorbox:1117,
+# multibox_loss:1178, detection_output:1052, roi_pool:1311, crop:6205,
+# prelu:6565, img_conv3d:6788, img_pool3d:2709, scale_sub_region:7302,
+# kmax_seq_score:6471, sub_nested_seq:6133, lambda_cost:5771,
+# cross_entropy_with_selfnorm:5884, cross_entropy_over_beam:6384,
+# linear_comb:5207, conv_operator:4789, conv_projection:4869,
+# gru_step_naive:3951)
+# ---------------------------------------------------------------------
+
+
+def _triple3(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+
+def crop_layer(input, offset, axis=2, shape=None, name=None, **kwargs):
+    """Crop along trailing axes of an NCHW image (reference CropLayer):
+    `offset`/`shape` cover axes [axis:] of the 4-D tensor."""
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], None)
+    node = Layer("crop", name, [inp], {
+        "offset": list(offset), "axis": axis,
+        "shape": list(shape) if shape is not None else None,
+    })
+    if shape is not None:
+        full = [c, h, w]
+        full[axis - 1:] = list(shape)[: 4 - axis]
+        node.im_shape = tuple(full)
+    else:
+        node.im_shape = (c, h, w)
+    return node
+
+
+def prelu_layer(input, name=None, partial_sum=1, channel_shared=None,
+                num_channels=None, param_attr=None, **kwargs):
+    """Parametric ReLU (reference PReluLayer): partial_sum groups inputs
+    sharing one alpha — 1 = element-wise, one channel's extent =
+    channel-wise, the whole width = all-shared."""
+    inp = _as_list(input)[0]
+    shape = getattr(inp, "im_shape", None)
+    if channel_shared:
+        mode = "all"
+    elif partial_sum == 1:
+        # reference: each element its own weight
+        mode = "element"
+    elif shape is not None and partial_sum >= shape[0] * shape[1] * shape[2]:
+        mode = "all"
+    elif shape is not None and partial_sum == shape[1] * shape[2]:
+        mode = "channel"
+    else:
+        mode = "channel" if shape is not None else "all"
+    node = Layer("prelu", name, [inp], {
+        "mode": mode, "param_attr": param_attr,
+    })
+    node.im_shape = shape
+    return node
+
+
+def priorbox_layer(input, image, aspect_ratio, variance, min_size,
+                   max_size=[], name=None, **kwargs):
+    """SSD anchor generation (reference PriorBoxLayer): the node's main
+    output is the [P, 4] box tensor; the variances ride as an auxiliary
+    `<name>@var` binding consumed by detection_output/multibox_loss."""
+    return Layer("priorbox", name, [input, image], {
+        "aspect_ratio": list(aspect_ratio), "variance": list(variance),
+        "min_size": _as_list(min_size), "max_size": _as_list(max_size),
+    })
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400,
+                           keep_top_k=200, confidence_threshold=0.01,
+                           background_id=0, name=None, **kwargs):
+    """SSD inference head (reference DetectionOutputLayer): decode
+    per-prior offsets against the priors, softmax confidences, NMS."""
+    locs, confs = _as_list(input_loc), _as_list(input_conf)
+    node = Layer("detection_output", name, locs + confs + [priorbox], {
+        "n_loc": len(locs), "num_classes": num_classes,
+        "nms_threshold": nms_threshold, "nms_top_k": nms_top_k,
+        "keep_top_k": keep_top_k,
+        "confidence_threshold": confidence_threshold,
+        "background_id": background_id,
+    })
+    return node
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label, num_classes,
+                        overlap_threshold=0.5, neg_pos_ratio=3.0,
+                        neg_overlap=0.5, background_id=0, name=None,
+                        **kwargs):
+    """SSD training loss (reference MultiBoxLossLayer): `label` is a
+    sequence whose rows are [class, xmin, ymin, xmax, ymax(, difficult)]
+    ground-truth boxes per image."""
+    locs, confs = _as_list(input_loc), _as_list(input_conf)
+    return Layer("multibox_loss", name, locs + confs + [priorbox, label], {
+        "n_loc": len(locs), "num_classes": num_classes,
+        "overlap_threshold": overlap_threshold,
+        "neg_pos_ratio": neg_pos_ratio, "neg_overlap": neg_overlap,
+        "background_id": background_id,
+    })
+
+
+def roi_pool_layer(input, rois, pooled_width, pooled_height, spatial_scale,
+                   num_channels=None, name=None, **kwargs):
+    """ROI max pooling (reference ROIPoolLayer)."""
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], num_channels)
+    node = Layer("roi_pool", name, [inp, rois], {
+        "pooled_width": pooled_width, "pooled_height": pooled_height,
+        "spatial_scale": spatial_scale,
+    })
+    node.im_shape = (c, pooled_height, pooled_width)
+    return node
+
+
+def scale_sub_region_layer(input, indices, value, name=None, **kwargs):
+    """Scale a per-sample (C, H, W) box by `value` (reference
+    ScaleSubRegionLayer); indices rows are 1-based inclusive
+    [c0, c1, h0, h1, w0, w1]."""
+    inp, shape = _ensure_image(_as_list(input)[0], None)
+    node = Layer("scale_sub_region", name, [inp, indices],
+                 {"value": value})
+    node.im_shape = shape
+    return node
+
+
+def img_conv3d_layer(input, filter_size, num_filters, name=None,
+                     num_channels=None, act=None, groups=1, stride=1,
+                     padding=0, bias_attr=None, param_attr=None,
+                     shared_biases=True, layer_attr=None, trans=False,
+                     layer_type=None, **kwargs):
+    """3-D convolution over NCDHW volumes (reference Conv3DLayer). Flat
+    data inputs are reshaped assuming cubic volumes (side =
+    cbrt(size/channels)), matching config_parser's square-image default
+    extended to 3-D."""
+    inp = _as_list(input)[0]
+    vol = getattr(inp, "vol_shape", None)
+    if vol is None:
+        if inp.kind != "data":
+            raise ValueError(
+                "img_conv3d_layer input %r has no volume shape; feed it "
+                "a data layer (cubic volume inferred) or another 3-D "
+                "layer" % inp.name
+            )
+        size = inp.attrs["type"].dim
+        c = num_channels or 3
+        side = int(round((size // c) ** (1.0 / 3)))
+        vol = (c, side, side, side)
+        inp = Layer("vol_reshape", None, [inp], {"shape": list(vol)})
+        inp.vol_shape = vol
+    node = Layer("img_conv3d", name, [inp], {
+        "filter_size": filter_size, "num_filters": num_filters,
+        "act": _act_name(act),
+        "groups": groups, "stride": stride, "padding": padding,
+        "bias": bias_attr is not False, "param_attr": param_attr,
+    })
+    fs, st, pd = (_triple3(filter_size), _triple3(stride),
+                  _triple3(padding))
+    node.vol_shape = (num_filters,) + tuple(
+        _conv_out(d, f, s, p) for d, f, s, p in zip(vol[1:], fs, st, pd)
+    )
+    return node
+
+
+def img_pool3d_layer(input, pool_size, name=None, num_channels=None,
+                     pool_type=None, stride=1, padding=0, layer_attr=None,
+                     ceil_mode=True, **kwargs):
+    """3-D pooling over NCDHW volumes (reference Pool3DLayer)."""
+    ptype = "avg" if isinstance(pool_type, AvgPooling) or pool_type is AvgPooling else "max"
+    inp = _as_list(input)[0]
+    vol = getattr(inp, "vol_shape", None)
+    if vol is None:
+        raise ValueError(
+            "img_pool3d_layer input %r has no volume shape; it must come "
+            "from img_conv3d_layer (or another 3-D layer)" % inp.name
+        )
+    node = Layer("img_pool3d", name, [inp], {
+        "pool_size": pool_size,
+        "pool_type": ptype, "stride": stride, "padding": padding,
+        "ceil_mode": ceil_mode,
+    })
+    def _po(d, ps, s, p):
+        span = d + 2 * p - ps
+        return (-(-span // s) if ceil_mode else span // s) + 1
+
+    node.vol_shape = (vol[0],) + tuple(
+        _po(d, ps, s, p)
+        for d, ps, s, p in zip(vol[1:], _triple3(pool_size),
+                               _triple3(stride), _triple3(padding))
+    )
+    return node
+
+
+def linear_comb_layer(weights, vectors, size=None, name=None, **kwargs):
+    """Weighted sum of sub-vectors (reference ConvexCombinationLayer):
+    out[j] = sum_i weights[i] * vectors[i*size + j]."""
+    return Layer("linear_comb", name, [weights, vectors], {"size": size})
+
+
+def kmax_seq_score_layer(input, name=None, beam_size=1, **kwargs):
+    """Within-sequence indices of the top-`beam_size` scores per
+    sequence (reference KmaxSeqScoreLayer), -1 padded."""
+    return Layer("kmax_seq_score", name, _as_list(input),
+                 {"beam_size": beam_size})
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None, **kwargs):
+    """Select sub-sequences of a nested sequence by per-sequence indices
+    (reference SubNestedSequenceLayer)."""
+    return Layer("sub_nested_seq", name, [input, selected_indices], {})
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
+                **kwargs):
+    """LambdaRank listwise cost (reference LambdaCost): `input` is the
+    model score sequence, `score` the relevance labels. Full-sort
+    (max_sort_size=-1) semantics."""
+    return Layer("lambda_cost", name, [input, score],
+                 {"NDCG_num": NDCG_num})
+
+
+def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1, **kwargs):
+    """Self-normalised CE (reference MultiClassCrossEntropyWithSelfNorm,
+    CostLayer.cpp:113): CE - though over an UNnormalised row - plus
+    log(Z) + alpha*log(Z)^2 where Z is the row sum."""
+    return Layer("ce_selfnorm", name, [input, _label_node(label)], {
+        "coeff": coeff, "alpha": softmax_selfnorm_alpha,
+    })
+
+
+class BeamInput(object):
+    """A (candidate_scores, selected_candidates, gold) triple feeding
+    cross_entropy_over_beam (reference layers.py BeamInput:6362)."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name=None, **kwargs):
+    """Globally normalised CE over beam expansions (reference
+    CrossEntropyOverBeam.cpp); `input` is a list of BeamInput triples."""
+    beams = _as_list(input)
+    parents = []
+    for b in beams:
+        parents += [b.candidate_scores, b.gold]
+    return Layer("ce_over_beam", name, parents, {"n_beams": len(beams)})
+
+
+def gru_step_naive_layer(input, output_mem, size=None, name=None, act=None,
+                         gate_act=None, bias_attr=None, param_attr=None,
+                         layer_attr=None, **kwargs):
+    """Naive-impl GRU step (reference gru_step_naive_layer): identical
+    math to gru_step_layer, which is already a single fused step here."""
+    return gru_step_layer(input=input, output_mem=output_mem, size=size,
+                          name=name, act=act, gate_act=gate_act,
+                          bias_attr=bias_attr, param_attr=param_attr)
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0, **kwargs):
+    """Convolution term inside a mixed_layer (reference ConvOperator):
+    filter comes from a layer (dynamic weights)."""
+    proj = _Projection(
+        "conv_op", img, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channels, stride=stride, padding=padding,
+    )
+    proj.extra_inputs = [filter]
+    return proj
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, groups=1, param_attr=None,
+                    **kwargs):
+    """Convolution projection inside a mixed_layer (reference
+    ConvProjection): learned filter parameter."""
+    return _Projection(
+        "conv_proj", input, filter_size=filter_size,
+        num_filters=num_filters, num_channels=num_channels, stride=stride,
+        padding=padding, groups=groups, param_attr=param_attr,
+    )
+
+
+__all__ += [
+    "crop_layer", "prelu_layer", "priorbox_layer",
+    "detection_output_layer", "multibox_loss_layer", "roi_pool_layer",
+    "scale_sub_region_layer", "img_conv3d_layer", "img_pool3d_layer",
+    "linear_comb_layer", "kmax_seq_score_layer", "sub_nested_seq_layer",
+    "lambda_cost", "cross_entropy_with_selfnorm", "BeamInput",
+    "cross_entropy_over_beam", "gru_step_naive_layer", "conv_operator",
+    "conv_projection",
+]

@@ -256,6 +256,22 @@ class Topology(object):
             return self._node_width(node.parents[0])
         return None
 
+    def _as_image(self, var, proj):
+        """Reshape a flat [N, C*H*W] var to NCHW for conv projections,
+        using the DSL node's image geometry."""
+        if var.shape is not None and len(var.shape) == 4:
+            return var
+        shape = getattr(proj.input, "im_shape", None)
+        if shape is None:
+            c = proj.attrs.get("num_channels") or 3
+            import math as _math
+
+            size = self._node_width(proj.input)
+            hw = int(round(_math.sqrt(size // c)))
+            shape = (c, hw, hw)
+        c, h, w = shape
+        return fluid.layers.reshape(x=var, shape=[-1, c, h, w])
+
     def _emit_mixed(self, node: Layer):
         """mixed_layer = sum of projection outputs (+bias, act) — the
         reference MixedLayer with full_matrix/trans/identity/table/
@@ -317,6 +333,47 @@ class Topology(object):
                     parts[0] if len(parts) == 1
                     else L.concat(input=parts, axis=1)
                 )
+            elif proj.ptype == "conv_proj":
+                # learned-filter conv inside mixed (reference
+                # ConvProjection): output flattened to [N, C*H*W] so it
+                # sums with the other projection terms
+                nf = int(proj.attrs["num_filters"])
+                x = self._as_image(x, proj)
+                conv = L.conv2d(
+                    input=x, num_filters=nf,
+                    filter_size=proj.attrs["filter_size"],
+                    stride=proj.attrs.get("stride", 1),
+                    padding=proj.attrs.get("padding", 0),
+                    groups=proj.attrs.get("groups", 1) or 1,
+                    param_attr=fluid.ParamAttr(name=pname),
+                    bias_attr=False,
+                )
+                terms.append(L.reshape(x=conv, shape=[0, -1]))
+            elif proj.ptype == "conv_op":
+                # dynamic-filter conv (reference ConvOperator): the
+                # filter layer's (first-row) values ARE the weights
+                f = self._var(proj.extra_inputs[0].name)
+                nf = int(proj.attrs["num_filters"])
+                fs = int(proj.attrs["filter_size"])
+                x = self._as_image(x, proj)
+                nc = proj.attrs.get("num_channels") or int(x.shape[1])
+                w = L.reshape(
+                    x=L.slice(f, axes=[0], starts=[0], ends=[1]),
+                    shape=[nf, nc, fs, fs],
+                )
+                helper = fluid.layer_helper.LayerHelper("conv2d")
+                ov = helper.create_tmp_variable("float32")
+                helper.append_op(
+                    type="conv2d",
+                    inputs={"Input": [x], "Filter": [w]},
+                    outputs={"Output": [ov]},
+                    attrs={
+                        "strides": [proj.attrs.get("stride", 1)] * 2,
+                        "paddings": [proj.attrs.get("padding", 0)] * 2,
+                        "dilations": [1, 1], "groups": 1,
+                    },
+                )
+                terms.append(L.reshape(x=ov, shape=[0, -1]))
             elif proj.ptype == "dotmul_op":
                 b = self._var(proj.extra_inputs[0].name)
                 term = L.elementwise_mul(x=x, y=b)
@@ -1025,4 +1082,257 @@ _BREADTH_EMITTERS.update({
     "resize": _emit_resize,
     "rotate": _emit_rotate,
     "cross_channel_norm": _emit_cross_channel_norm,
+})
+
+
+# ---------------------------------------------------------------------
+# breadth round 5 emitters: detection, 3-D conv/pool, image geometry,
+# ranking/beam costs (reference gserver PriorBoxLayer, MultiBoxLossLayer,
+# DetectionOutputLayer, ROIPoolLayer, CropLayer, PReluLayer,
+# Conv3DLayer, Pool3DLayer, ConvexCombinationLayer, KmaxSeqScoreLayer,
+# SubNestedSequenceLayer, CostLayer.cpp LambdaCost /
+# MultiClassCrossEntropyWithSelfNorm, CrossEntropyOverBeam.cpp)
+# ---------------------------------------------------------------------
+
+
+def _emit_crop(t, node):
+    x = t._in(node)
+    a = node.attrs
+    if a["shape"] is None:
+        raise NotImplementedError("crop_layer needs an explicit shape")
+    axis = int(a["axis"])
+    shape = [int(s) for s in x.shape]
+    offs = [0] * len(shape)
+    for k, (o, s) in enumerate(zip(a["offset"], a["shape"])):
+        if axis + k < len(shape):
+            offs[axis + k] = int(o)
+            shape[axis + k] = int(s)
+    # batch axis: crop nothing (dynamic N) — kernel slices by python ints,
+    # so pass the traced dim through as the full extent
+    shape[0] = -1
+    return _L().crop(x, shape=shape, offsets=offs)
+
+
+def _emit_prelu(t, node):
+    pa = node.attrs.get("param_attr")
+    return _L().prelu(
+        t._in(node), mode=node.attrs["mode"],
+        param_attr=fluid.ParamAttr(
+            name=getattr(pa, "name", None) or node.name + ".w0"
+        ),
+    )
+
+
+def _emit_priorbox(t, node):
+    feat = t._var(node.parents[0].name)
+    img = t._var(node.parents[1].name)
+    if img.shape is None or len(img.shape) != 4:
+        c, h, w = node.parents[1].im_shape
+        img = _L().reshape(x=img, shape=[-1, c, h, w])
+    a = node.attrs
+    boxes, variances = fluid.layers.prior_box(
+        input=feat, image=img, min_sizes=a["min_size"],
+        max_sizes=a["max_size"] or None,
+        aspect_ratios=a["aspect_ratio"], variance=a["variance"],
+        flip=True, clip=True,
+    )
+    L = _L()
+    # [H, W, P, 4] anchor grid -> flat [M, 4], matching the loc/conf
+    # head flattening order (NHWC -> [N, H*W*P, ...])
+    boxes = L.reshape(x=boxes, shape=[-1, 4])
+    variances = L.reshape(x=variances, shape=[-1, 4])
+    t._bind(node.name + "@var", variances)
+    return boxes
+
+
+def _ssd_heads(t, node):
+    """Gather loc/conf conv features into [N, P, 4] and [N, P, C]."""
+    L = _L()
+    a = node.attrs
+    n_loc = a["n_loc"]
+    locs = [t._var(p.name) for p in node.parents[:n_loc]]
+    confs = [t._var(p.name) for p in node.parents[n_loc:2 * n_loc]]
+    C = int(a["num_classes"])
+
+    def flat(vs, width):
+        parts = []
+        for v in vs:
+            nhwc = L.transpose(v, [0, 2, 3, 1])
+            parts.append(L.reshape(x=nhwc, shape=[0, -1, width]))
+        return parts[0] if len(parts) == 1 else L.concat(input=parts, axis=1)
+
+    return flat(locs, 4), flat(confs, C)
+
+
+def _emit_detection_output(t, node):
+    L = _L()
+    a = node.attrs
+    loc, conf = _ssd_heads(t, node)
+    priors = t._var(node.parents[-1].name)
+    variances = t._var(node.parents[-1].name + "@var")
+    scores = L.transpose(L.softmax(conf), [0, 2, 1])  # [N, C, P]
+    return fluid.layers.detection_output(
+        scores=scores, loc=loc, prior_box=priors, prior_box_var=variances,
+        background_label=a["background_id"],
+        nms_threshold=a["nms_threshold"], nms_top_k=a["nms_top_k"],
+        keep_top_k=a["keep_top_k"],
+        score_threshold=a["confidence_threshold"],
+    )
+
+
+def _emit_multibox_loss(t, node):
+    L = _L()
+    a = node.attrs
+    loc, conf = _ssd_heads(t, node)
+    priors = t._var(node.parents[-2].name)
+    variances = t._var(node.parents[-2].name + "@var")
+    label = t._var(node.parents[-1].name)
+    # label rows: [class, xmin, ymin, xmax, ymax(, difficult)]
+    gt_label = L.lod_reset(
+        L.cast(L.slice(label, axes=[1], starts=[0], ends=[1]), "int64"),
+        y=label,
+    )
+    gt_box = L.lod_reset(
+        L.slice(label, axes=[1], starts=[1], ends=[5]), y=label
+    )
+    cost = fluid.layers.ssd_loss(
+        location=loc, confidence=conf, gt_box=gt_box, gt_label=gt_label,
+        prior_box=priors, prior_box_var=variances,
+        overlap_threshold=a["overlap_threshold"],
+        neg_pos_ratio=a["neg_pos_ratio"], neg_overlap=a["neg_overlap"],
+        background_label=a["background_id"],
+    )
+    return L.mean(x=cost)
+
+
+def _emit_roi_pool(t, node):
+    a = node.attrs
+    return _L().roi_pool(
+        t._var(node.parents[0].name), t._var(node.parents[1].name),
+        pooled_height=a["pooled_height"], pooled_width=a["pooled_width"],
+        spatial_scale=a["spatial_scale"],
+    )
+
+
+def _emit_scale_sub_region(t, node):
+    x = t._var(node.parents[0].name)
+    idx = _L().cast(t._var(node.parents[1].name), "int32")
+    return _L().scale_sub_region(x, idx, node.attrs["value"])
+
+
+def _emit_vol_reshape(t, node):
+    c, d, h, w = node.attrs["shape"]
+    return _L().reshape(x=t._in(node), shape=[-1, c, d, h, w])
+
+
+def _emit_img_conv3d(t, node):
+    a = node.attrs
+    pa = a.get("param_attr")
+    return _L().conv3d(
+        input=t._in(node), num_filters=a["num_filters"],
+        filter_size=a["filter_size"], stride=a["stride"],
+        padding=a["padding"], groups=a.get("groups", 1) or 1,
+        act=a["act"],
+        param_attr=fluid.ParamAttr(
+            name=getattr(pa, "name", None) or node.name + ".w0"
+        ),
+        bias_attr=(
+            False if not a.get("bias", True)
+            else fluid.ParamAttr(name=node.name + ".wbias")
+        ),
+    )
+
+
+def _emit_img_pool3d(t, node):
+    a = node.attrs
+    return _L().pool3d(
+        input=t._in(node), pool_size=a["pool_size"],
+        pool_type=a["pool_type"], pool_stride=a["stride"],
+        pool_padding=a["padding"], ceil_mode=a.get("ceil_mode", True),
+    )
+
+
+def _emit_linear_comb(t, node):
+    L = _L()
+    w, v = t._ins(node)
+    zdim = t._width(w, node.parents[0])
+    full = t._width(v, node.parents[1])
+    size = node.attrs.get("size") or full // zdim
+    v3 = L.reshape(x=v, shape=[-1, zdim, size])
+    w3 = L.reshape(x=w, shape=[-1, zdim, 1])
+    return L.reshape(
+        x=L.reduce_sum(L.elementwise_mul(x=v3, y=w3), dim=1),
+        shape=[-1, size],
+    )
+
+
+def _emit_kmax_seq_score(t, node):
+    return _L().kmax_sequence_score(
+        t._in(node), beam_size=node.attrs["beam_size"]
+    )
+
+
+def _emit_sub_nested_seq(t, node):
+    x = t._var(node.parents[0].name)
+    sel = _L().cast(t._var(node.parents[1].name), "int32")
+    return _L().sub_nested_seq(x, sel)
+
+
+def _emit_lambda_cost(t, node):
+    score, label = t._ins(node)
+    return _L().lambda_rank_cost(
+        score, label, ndcg_num=node.attrs["NDCG_num"]
+    )
+
+
+def _emit_ce_selfnorm(t, node):
+    L = _L()
+    x, label = t._ins(node)
+    a = node.attrs
+    z = L.reduce_sum(x, dim=1, keep_dim=True)
+    logz = L.log(z)
+    cost = L.elementwise_add(
+        x=L.cross_entropy(input=x, label=label),
+        y=L.elementwise_add(
+            x=logz, y=L.scale(x=L.square(logz), scale=a["alpha"])
+        ),
+    )
+    if a.get("coeff", 1.0) != 1.0:
+        cost = L.scale(x=cost, scale=a["coeff"])
+    return L.mean(x=cost)
+
+
+def _emit_ce_over_beam(t, node):
+    L = _L()
+    helper = fluid.layer_helper.LayerHelper("cross_entropy_over_beam")
+    scores = [t._var(p.name) for p in node.parents[0::2]]
+    golds = [
+        L.cast(t._var(p.name), "int32") for p in node.parents[1::2]
+    ]
+    out = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="cross_entropy_over_beam",
+        inputs={"Scores": scores, "Gold": golds},
+        outputs={"Out": [out]},
+    )
+    return L.mean(x=out)
+
+
+_BREADTH_EMITTERS.update({
+    "crop": _emit_crop,
+    "prelu": _emit_prelu,
+    "priorbox": _emit_priorbox,
+    "detection_output": _emit_detection_output,
+    "multibox_loss": _emit_multibox_loss,
+    "roi_pool": _emit_roi_pool,
+    "scale_sub_region": _emit_scale_sub_region,
+    "vol_reshape": _emit_vol_reshape,
+    "img_conv3d": _emit_img_conv3d,
+    "img_pool3d": _emit_img_pool3d,
+    "linear_comb": _emit_linear_comb,
+    "kmax_seq_score": _emit_kmax_seq_score,
+    "sub_nested_seq": _emit_sub_nested_seq,
+    "lambda_cost": _emit_lambda_cost,
+    "ce_selfnorm": _emit_ce_selfnorm,
+    "ce_over_beam": _emit_ce_over_beam,
 })

@@ -725,3 +725,258 @@ def test_breadth_wrappers_round4():
     np.testing.assert_allclose(
         outs[4], np.concatenate([a_np[:, 0:2], a_np[:, 4:6]], axis=1),
         rtol=1e-6)
+
+
+def test_breadth_wrappers_round5_image():
+    """crop/prelu/scale_sub_region/roi_pool/linear_comb + 3-D conv/pool."""
+    _fresh()
+    rng = np.random.RandomState(11)
+    img = tch.data_layer(name="r5_img", size=2 * 4 * 4, height=4, width=4)
+    cr = tch.crop_layer(input=img, offset=[1, 1], shape=[2, 2], axis=2)
+    pr = tch.prelu_layer(input=img, channel_shared=True)
+    ind = tch.data_layer(name="r5_ind", size=6)
+    ssr = tch.scale_sub_region_layer(input=img, indices=ind, value=3.0)
+    rois = tch.data_layer(name="r5_rois", size=4)
+    rp = tch.roi_pool_layer(input=img, rois=rois, pooled_width=2,
+                            pooled_height=2, spatial_scale=1.0)
+    w = tch.data_layer(name="r5_w", size=2)
+    v = tch.data_layer(name="r5_v", size=6)
+    lc = tch.linear_comb_layer(weights=w, vectors=v, size=3)
+    vol = tch.data_layer(name="r5_vol", size=1 * 8)  # 1x2x2x2 cube
+    c3 = tch.img_conv3d_layer(input=vol, filter_size=2, num_filters=2,
+                              num_channels=1)
+    p3 = tch.img_pool3d_layer(input=c3, pool_size=1)
+    topo = Topology([cr, pr, ssr, rp, lc, p3])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        img_np = rng.rand(2, 32).astype(np.float32)
+        outs = exe.run(
+            topo.main_program,
+            feed={
+                "r5_img": img_np,
+                "r5_ind": np.array([[1, 1, 1, 2, 1, 2],
+                                    [2, 2, 2, 3, 2, 3]], np.float32),
+                "r5_rois": (np.array([[0, 0, 1, 1], [1, 1, 3, 3],
+                                      [0, 0, 3, 3]], np.float32),
+                            [np.array([0, 2, 3], np.int32)]),
+                "r5_w": rng.rand(2, 2).astype(np.float32),
+                "r5_v": rng.rand(2, 6).astype(np.float32),
+                "r5_vol": rng.rand(2, 8).astype(np.float32),
+            },
+            fetch_list=[topo.var_of[n.name]
+                        for n in (cr, pr, ssr, rp, lc, p3)],
+        )
+    x4 = img_np.reshape(2, 2, 4, 4)
+    np.testing.assert_allclose(outs[0], x4[:, :, 1:3, 1:3], rtol=1e-6)
+    np.testing.assert_allclose(
+        outs[1].reshape(x4.shape), np.where(x4 > 0, x4, 0.25 * x4),
+        rtol=1e-6)
+    want = x4.copy()
+    want[0, 0, 0:2, 0:2] *= 3.0
+    want[1, 1, 1:3, 1:3] *= 3.0
+    np.testing.assert_allclose(outs[2], want, rtol=1e-6)
+    assert outs[3].shape == (3, 2, 2, 2)
+    # roi [0,0,1,1] on image 0: 2x2 window maxpooled into 2x2 bins = the
+    # window itself
+    np.testing.assert_allclose(outs[3][0], x4[0, :, 0:2, 0:2], rtol=1e-6)
+    assert np.isfinite(outs[4]).all()  # linear_comb (oracle test below)
+    assert outs[5].shape[1] == 2  # pool keeps conv channels
+
+
+def test_breadth_wrappers_round5_linear_comb_oracle():
+    _fresh()
+    rng = np.random.RandomState(12)
+    w = tch.data_layer(name="lc_w", size=3)
+    v = tch.data_layer(name="lc_v", size=12)
+    lc = tch.linear_comb_layer(weights=w, vectors=v, size=4)
+    topo = Topology([lc])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        wd = rng.rand(2, 3).astype(np.float32)
+        vd = rng.rand(2, 12).astype(np.float32)
+        out = exe.run(topo.main_program,
+                      feed={"lc_w": wd, "lc_v": vd},
+                      fetch_list=[topo.var_of[lc.name]])[0]
+    want = np.einsum("bz,bzd->bd", wd, vd.reshape(2, 3, 4))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_breadth_wrappers_round5_detection():
+    """priorbox -> detection_output forward; multibox_loss is finite and
+    trains the conv heads."""
+    _fresh()
+    rng = np.random.RandomState(13)
+    img = tch.data_layer(name="det_img", size=3 * 8 * 8, height=8, width=8)
+    feat = tch.img_conv_layer(input=img, filter_size=3, num_filters=4,
+                              padding=1, num_channels=3)
+    # priors per location: 1 + 2*aspect + max_size = 1+2+1 = 4
+    pb = tch.priorbox_layer(
+        input=feat, image=img, aspect_ratio=[2.0], variance=[0.1, 0.1,
+                                                             0.2, 0.2],
+        min_size=[2.0], max_size=[4.0],
+    )
+    n_priors = 4
+    loc = tch.img_conv_layer(input=feat, filter_size=3,
+                             num_filters=n_priors * 4, padding=1)
+    conf = tch.img_conv_layer(input=feat, filter_size=3,
+                              num_filters=n_priors * 3, padding=1)
+    det = tch.detection_output_layer(
+        input_loc=loc, input_conf=conf, priorbox=pb, num_classes=3,
+        keep_top_k=8, nms_top_k=16, confidence_threshold=0.0,
+    )
+    gt = tch.data_layer(name="det_gt", size=6)
+    mbl = tch.multibox_loss_layer(
+        input_loc=loc, input_conf=conf, priorbox=pb, label=gt,
+        num_classes=3,
+    )
+    topo = Topology([det, mbl])
+    cost_var = topo.var_of[mbl.name]
+    with fluid.program_guard(topo.main_program, topo.startup_program):
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(cost_var)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    img_np = rng.rand(2, 3 * 64).astype(np.float32)
+    # two images: 2 and 1 gt boxes, rows [class, x1, y1, x2, y2, difficult]
+    gt_np = np.array([
+        [1, 0.1, 0.1, 0.4, 0.4, 0],
+        [2, 0.5, 0.5, 0.9, 0.9, 0],
+        [1, 0.2, 0.3, 0.7, 0.8, 0],
+    ], np.float32)
+    lod = [np.array([0, 2, 3], np.int32)]
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        losses = []
+        for _ in range(8):
+            det_out, loss = exe.run(
+                topo.main_program,
+                feed={"det_img": img_np, "det_gt": (gt_np, lod)},
+                fetch_list=[topo.var_of[det.name], cost_var],
+            )
+            losses.append(float(np.ravel(loss)[0]))
+    assert det_out.shape[1] == 6  # [label, score, x1, y1, x2, y2]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_breadth_wrappers_round5_seq_costs():
+    """kmax_seq_score / sub_nested_seq / lambda_cost /
+    cross_entropy_with_selfnorm / cross_entropy_over_beam."""
+    _fresh()
+    rng = np.random.RandomState(14)
+    s = tch.data_layer(name="sc_s", size=1)
+    km = tch.kmax_seq_score_layer(input=s, beam_size=2)
+    msc = tch.data_layer(name="sc_m", size=1)
+    lbl = tch.data_layer(name="sc_l", size=1)
+    lam = tch.lambda_cost(input=msc, score=lbl, NDCG_num=2)
+    x = tch.data_layer(name="sc_x", size=3)
+    y = tch.data_layer(name="sc_y", size=1)
+    cesn = tch.cross_entropy_with_selfnorm(
+        input=x, label=y, softmax_selfnorm_alpha=0.1)
+    gold = tch.data_layer(name="sc_g", size=1)
+    ceob = tch.cross_entropy_over_beam(input=[
+        tch.BeamInput(candidate_scores=s, selected_candidates=km,
+                      gold=gold),
+    ])
+    topo = Topology([km, lam, cesn, ceob])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    off = np.array([0, 3, 5], np.int32)
+    sv = np.array([[0.1], [0.9], [0.5], [0.3], [0.8]], np.float32)
+    lv = np.array([[2.0], [0.0], [1.0], [1.0], [0.0]], np.float32)
+    xv = rng.rand(2, 3).astype(np.float32) + 0.1
+    yv = np.array([[0], [2]], np.int64)
+    gv = np.array([[1], [0]], np.int64)
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        outs = exe.run(
+            topo.main_program,
+            feed={"sc_s": (sv, [off]), "sc_m": (sv, [off]),
+                  "sc_l": (lv, [off]), "sc_x": xv, "sc_y": yv,
+                  "sc_g": gv},
+            fetch_list=[topo.var_of[n.name]
+                        for n in (km, lam, cesn, ceob)],
+        )
+    assert outs[0].tolist() == [[1, 2], [1, 0]]
+    assert np.isfinite(outs[1]).all()
+    # selfnorm oracle: CE(-log x[label]) + log Z + alpha log(Z)^2, mean
+    z = xv.sum(1)
+    ce = -np.log(xv[np.arange(2), yv.ravel()])
+    want = (ce + np.log(z) + 0.1 * np.log(z) ** 2).mean()
+    np.testing.assert_allclose(float(np.ravel(outs[2])[0]), want,
+                               rtol=1e-5)
+    # beam CE oracle: per seq logsumexp(scores) - score[gold]
+    def lse(a):
+        return np.log(np.exp(a).sum())
+    c0 = lse(sv[0:3, 0]) - sv[1, 0]
+    c1 = lse(sv[3:5, 0]) - sv[3, 0]
+    np.testing.assert_allclose(float(np.ravel(outs[3])[0]),
+                               (c0 + c1) / 2, rtol=1e-5)
+
+
+def test_breadth_wrappers_round5_sub_nested_seq():
+    _fresh()
+    x = tch.data_layer(name="sn_x", size=2)
+    sel = tch.data_layer(name="sn_sel", size=2)
+    sn = tch.sub_nested_seq_layer(input=x, selected_indices=sel)
+    pooled = tch.pooling_layer(input=sn, pooling_type=tch.SumPooling())
+    topo = Topology([sn, pooled])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    tok = np.arange(18, dtype=np.float32).reshape(9, 2)
+    outer = np.array([0, 3, 5], np.int32)
+    inner = np.array([0, 2, 3, 5, 6, 9], np.int32)
+    sv = np.array([[2, 0], [1, -1]], np.int32)
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        out, pool = exe.run(
+            topo.main_program,
+            feed={"sn_x": (tok, [outer, inner]), "sn_sel": sv},
+            fetch_list=[topo.var_of[sn.name], topo.var_of[pooled.name]],
+        )
+    want = np.concatenate([tok[3:5], tok[0:2], tok[6:9]])
+    np.testing.assert_allclose(out[:7], want)
+    # 4 output slots: subseq sums [3:5], [0:2], [6:9], empty
+    np.testing.assert_allclose(
+        pool,
+        np.stack([tok[3:5].sum(0), tok[0:2].sum(0), tok[6:9].sum(0),
+                  np.zeros(2)]),
+        rtol=1e-6,
+    )
+
+
+def test_breadth_wrappers_round5_mixed_conv():
+    """conv_projection and conv_operator inside mixed_layer (1x1 filters
+    so the numpy oracle is a plain einsum)."""
+    _fresh()
+    rng = np.random.RandomState(15)
+    img = tch.data_layer(name="mc_img", size=2 * 3 * 3, height=3, width=3)
+    with tch.mixed_layer(size=3 * 3 * 3) as m:
+        m += tch.conv_projection(input=img, filter_size=1, num_filters=3)
+    filt = tch.data_layer(name="mc_f", size=3 * 2 * 1 * 1)
+    with tch.mixed_layer(size=3 * 3 * 3) as mo:
+        mo += tch.conv_operator(img=img, filter=filt, filter_size=1,
+                                num_filters=3, num_channels=2)
+    topo = Topology([m, mo])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    img_np = rng.rand(2, 18).astype(np.float32)
+    f_np = rng.rand(1, 6).astype(np.float32)
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        out_p, out_o = exe.run(
+            topo.main_program,
+            feed={"mc_img": img_np, "mc_f": f_np},
+            fetch_list=[topo.var_of[m.name], topo.var_of[mo.name]],
+        )
+        wname = "%s.w0" % m.name
+        w = np.asarray(scope.get(wname)).reshape(3, 2)  # [O, I] 1x1
+    x4 = img_np.reshape(2, 2, 3, 3)
+    want_p = np.einsum("oi,bihw->bohw", w, x4).reshape(2, -1)
+    np.testing.assert_allclose(out_p, want_p, rtol=1e-4)
+    wo = f_np.reshape(3, 2)
+    want_o = np.einsum("oi,bihw->bohw", wo, x4).reshape(2, -1)
+    np.testing.assert_allclose(out_o, want_o, rtol=1e-4)
