@@ -103,6 +103,13 @@ class Optimizer(object):
         self.helper.set_variable_initializer(
             var, initializer=Constant(value=float(fill_value))
         )
+        # tensor parallelism: a same-shaped optimizer slot of a sharded
+        # parameter must live on the same mesh spec (parallel/mesh.py
+        # shard_parameter) — inherit it so users annotate only the param
+        prog = var.block.program
+        spec = prog.shardings.get(param.name)
+        if spec is not None and tuple(shape) == tuple(param.shape):
+            prog.shardings[var.name] = spec
         self._accumulators[name][param.name] = var
         return var
 

@@ -16,6 +16,7 @@ its collectives ride DCN only when crossing slices.
 
 from __future__ import annotations
 
+import zlib
 from typing import Dict, Optional, Sequence, Union
 
 import jax
@@ -154,19 +155,70 @@ class DistributedContext(object):
 
     # --- per-process data sharding (replaces per-trainer file lists /
     # master task dispatch for the simple static case) ------------------
-    def shard_reader(self, reader):
+    def shard_reader(self, reader, verify_every: Optional[int] = None):
         """Wrap a v2-style reader so each process sees its 1/process_count
         slice of the stream (round-robin by instance). The global batch
         assembled by the executor is identical to single-process order-
-        stability aside."""
+        stability aside.
+
+        Round-robin assignment REQUIRES every process to enumerate the
+        identical stream (same shuffle seed); silent divergence would feed
+        overlapping/duplicated data. `verify_every=K` guards this: every K
+        raw items AND at stream end, processes all-gather an
+        (item_count, crc) pair and raise on any mismatch. Length
+        divergence pairs one process's end-of-stream gather with the
+        other's next interval gather, so counts differ and BOTH sides
+        raise instead of hanging. (A consumer that abandons the generator
+        mid-stream skips the end gather — the guard covers stream
+        content/length, not consumer aborts.)
+        """
         pidx, pcount = self.process_index, self.process_count
 
+        def _check(count, crc):
+            from jax.experimental import multihost_utils
+
+            pairs = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([count, crc], np.uint32)
+                )
+            ).reshape(-1, 2)
+            if len({(int(c), int(f)) for c, f in pairs}) != 1:
+                raise RuntimeError(
+                    "shard_reader stream divergence: per-process "
+                    "(item_count, fingerprint) pairs %s differ — every "
+                    "process must enumerate the identical reader order "
+                    "(same shuffle seed)" % pairs.tolist()
+                )
+
         def _sharded():
-            for i, item in enumerate(reader()):
-                if i % pcount == pidx:
+            crc, i = 0, 0
+            for i, item in enumerate(reader(), start=1):
+                if verify_every and pcount > 1:
+                    crc = _fingerprint(item, crc)
+                    if i % verify_every == 0:
+                        _check(i, crc)
+                if (i - 1) % pcount == pidx:
                     yield item
+            # unconditional end-of-stream gather: keeps gather COUNTS equal
+            # across processes whenever stream lengths agree, so a length
+            # divergence always pairs mismatched payloads instead of
+            # leaving one process without a partner
+            if verify_every and pcount > 1:
+                _check(i, crc)
 
         return _sharded
+
+
+def _fingerprint(item, crc: int) -> int:
+    """Rolling CRC32 of a reader item (arrays / scalars / nested tuples),
+    order-sensitive, for shard_reader's divergence guard."""
+    if isinstance(item, (tuple, list)):
+        for part in item:
+            crc = _fingerprint(part, crc)
+        return crc
+    a = np.asarray(item)
+    crc = zlib.crc32(str(a.dtype).encode() + str(a.shape).encode(), crc)
+    return zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
 
 
 def spans_processes(mesh: Optional[Mesh]) -> bool:
