@@ -31,8 +31,15 @@ from .attention import (
     ulysses_attention,
 )
 from .embedding import ShardedEmbedding, sharded_lookup
+from .moe import expert_parallel_moe, moe_capacity, reference_moe
+from .pipeline import gpipe_pipeline, reference_pipeline
 
 __all__ = [
+    "gpipe_pipeline",
+    "reference_pipeline",
+    "expert_parallel_moe",
+    "moe_capacity",
+    "reference_moe",
     "make_mesh",
     "get_default_mesh",
     "set_default_mesh",

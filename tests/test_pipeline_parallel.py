@@ -1,0 +1,66 @@
+"""Pipeline parallelism (GPipe microbatch schedule over a 'pipe' axis).
+
+Beyond-reference capability (SURVEY.md §2.2 lists PP as absent; nearest
+reference machinery is ParallelNeuralNetwork's per-layer device threads).
+The sequential `reference_pipeline` is the oracle: the rotating-buffer
+ppermute schedule must reproduce it exactly, forward and gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.parallel import (
+    gpipe_pipeline,
+    make_mesh,
+    reference_pipeline,
+)
+
+
+def _stage(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _setup(S, B, D, seed=0):
+    rng = np.random.RandomState(seed)
+    params = {
+        "w": jnp.asarray(rng.randn(S, D, D).astype(np.float32) * 0.3),
+        "b": jnp.asarray(rng.randn(S, D).astype(np.float32) * 0.1),
+    }
+    x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+    return params, x
+
+
+def test_pipeline_matches_sequential_forward_and_grad():
+    mesh = make_mesh({"pipe": 4})
+    params, x = _setup(S=4, B=16, D=8)
+    out = gpipe_pipeline(_stage, params, x, mesh, n_microbatches=4)
+    ref = reference_pipeline(_stage, params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    g_pp = jax.grad(lambda p: jnp.sum(
+        gpipe_pipeline(_stage, p, x, mesh, n_microbatches=4) ** 2))(params)
+    g_sq = jax.grad(lambda p: jnp.sum(
+        reference_pipeline(_stage, p, x) ** 2))(params)
+    for k in g_pp:
+        np.testing.assert_allclose(
+            np.asarray(g_pp[k]), np.asarray(g_sq[k]), atol=1e-4)
+
+
+def test_pipeline_eight_stages_more_microbatches():
+    mesh = make_mesh({"pipe": 8})
+    params, x = _setup(S=8, B=32, D=4, seed=1)
+    out = gpipe_pipeline(_stage, params, x, mesh, n_microbatches=8)
+    ref = reference_pipeline(_stage, params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_validates_shapes():
+    mesh = make_mesh({"pipe": 4})
+    params, x = _setup(S=3, B=16, D=8)  # wrong stage count
+    with pytest.raises(ValueError):
+        gpipe_pipeline(_stage, params, x, mesh)
+    params, x = _setup(S=4, B=15, D=8)  # indivisible batch
+    with pytest.raises(ValueError):
+        gpipe_pipeline(_stage, params, x, mesh, n_microbatches=4)
