@@ -313,6 +313,11 @@ def main():
 
     quick = os.environ.get("BENCH_QUICK", "0") == "1"
     only = os.environ.get("BENCH_ONLY", "").split(",") if os.environ.get("BENCH_ONLY") else None
+    # wall-clock budget for the SIDE workloads: on a slow-tunnel day the
+    # driver must still get the headline line, so once the budget is
+    # spent remaining side workloads are skipped (marked, not silent)
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1800"))
+    t_start = time.time()
     workloads = {}
 
     def run(name, fn):
@@ -320,10 +325,14 @@ def main():
         so its failure fails the bench instead of being swallowed."""
         if only and name not in only:
             return
-        try:
-            workloads[name] = fn()
-        except Exception as e:  # a broken side workload must not kill the headline
-            workloads[name] = {"error": "%s: %s" % (type(e).__name__, e)}
+        if time.time() - t_start > budget_s:
+            workloads[name] = {"skipped": "side-workload budget exhausted "
+                                          "(BENCH_BUDGET_S=%g)" % budget_s}
+        else:
+            try:
+                workloads[name] = fn()
+            except Exception as e:  # a broken side workload must not kill the headline
+                workloads[name] = {"error": "%s: %s" % (type(e).__name__, e)}
         rec = dict(workloads[name])
         rec["metric"] = name
         print(json.dumps(rec), flush=True)

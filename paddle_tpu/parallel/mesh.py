@@ -163,14 +163,17 @@ class DistributedContext(object):
 
         Round-robin assignment REQUIRES every process to enumerate the
         identical stream (same shuffle seed); silent divergence would feed
-        overlapping/duplicated data. `verify_every=K` guards this: every K
-        raw items AND at stream end, processes all-gather an
-        (item_count, crc) pair and raise on any mismatch. Length
-        divergence pairs one process's end-of-stream gather with the
-        other's next interval gather, so counts differ and BOTH sides
-        raise instead of hanging. (A consumer that abandons the generator
-        mid-stream skips the end gather — the guard covers stream
-        content/length, not consumer aborts.)
+        overlapping/duplicated data. `verify_every=K` guards this: after
+        every K YIELDED items — the same consumer-visible ordinal on
+        every process, so lockstep consumers (the executor's global-batch
+        assembly pulls per-process equal counts) hit the collective at
+        the same pull — processes all-gather (yield_count, crc-of-
+        completed-rounds), and once more at stream end with the full
+        (raw_count, crc). Any content or length divergence pairs
+        mismatched payloads and raises on every process instead of
+        hanging. (A consumer that abandons the generator mid-stream skips
+        the end gather — the guard covers stream content/length, not
+        consumer aborts.)
         """
         pidx, pcount = self.process_index, self.process_count
 
@@ -185,24 +188,31 @@ class DistributedContext(object):
             if len({(int(c), int(f)) for c, f in pairs}) != 1:
                 raise RuntimeError(
                     "shard_reader stream divergence: per-process "
-                    "(item_count, fingerprint) pairs %s differ — every "
+                    "(count, fingerprint) pairs %s differ — every "
                     "process must enumerate the identical reader order "
-                    "(same shuffle seed)" % pairs.tolist()
+                    "(same shuffle seed, balanced length)" % pairs.tolist()
                 )
 
         def _sharded():
-            crc, i = 0, 0
+            crc, i, yielded = 0, 0, 0
+            # crc over all COMPLETE rounds of pcount raw items: identical
+            # on every process at the same yield ordinal, even though
+            # their raw positions within the current round differ
+            round_crc = 0
             for i, item in enumerate(reader(), start=1):
                 if verify_every and pcount > 1:
+                    if (i - 1) % pcount == 0:
+                        round_crc = crc  # round boundary: all complete
                     crc = _fingerprint(item, crc)
-                    if i % verify_every == 0:
-                        _check(i, crc)
                 if (i - 1) % pcount == pidx:
+                    yielded += 1
                     yield item
-            # unconditional end-of-stream gather: keeps gather COUNTS equal
-            # across processes whenever stream lengths agree, so a length
-            # divergence always pairs mismatched payloads instead of
-            # leaving one process without a partner
+                    if verify_every and pcount > 1 \
+                            and yielded % verify_every == 0:
+                        _check(yielded, round_crc)
+            # end-of-stream gather: full stream totals; a diverging or
+            # unbalanced stream pairs this with a peer's interval gather
+            # (or an unequal payload) and raises on BOTH sides
             if verify_every and pcount > 1:
                 _check(i, crc)
 

@@ -215,8 +215,17 @@ def main():
 
         got, err = [], None
         try:
+            from jax.experimental import multihost_utils
+
             for item in ctx.shard_reader(reader, verify_every=8)():
                 got.append(int(item[1]))
+                if len(got) % 2 == 0:
+                    # interleave a training-style collective between
+                    # pulls: the guard's gathers must stay aligned with
+                    # it (yield-ordinal keyed), or this would deadlock
+                    multihost_utils.process_allgather(
+                        np.asarray([len(got)], np.int32)
+                    )
         except RuntimeError as e:
             err = str(e)
         result.update(n_items=len(got), items=got, error=err)
