@@ -375,6 +375,56 @@ spec("pool3d",
      attrs={"pooling_type": "avg", "ksize": [2, 2, 2],
             "strides": [2, 2, 2], "paddings": [0, 0, 0]},
      grad=True)
+# --- conv/pool stride+padding corner branches (sampled numeric grads:
+# realistic odd shapes with stride 2 + padding reach the window-clipping
+# and partial-window paths tiny exhaustive shapes never touch;
+# check_grad(sample=K) keeps the finite-difference cost bounded) -------
+spec("conv2d_s2p1", op="conv2d",
+     ins={"Input": R(140).randn(2, 3, 7, 7).astype(np.float32),
+          "Filter": R(141).randn(4, 3, 3, 3).astype(np.float32)},
+     attrs={"strides": [2, 2], "paddings": [1, 1], "groups": 1,
+            "dilations": [1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"],
+     tol=(1e-3, 1e-4), gsample=24,
+     oracle=lambda i, a: {"Output": _np_conv2d(i["Input"], i["Filter"],
+                                               stride=2, pad=1)})
+spec("conv2d_dilated", op="conv2d",
+     ins={"Input": R(142).randn(1, 2, 8, 8).astype(np.float32),
+          "Filter": R(143).randn(3, 2, 3, 3).astype(np.float32)},
+     attrs={"strides": [1, 1], "paddings": [2, 2], "groups": 1,
+            "dilations": [2, 2]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"],
+     gsample=24)
+spec("depthwise_conv2d_s2", op="depthwise_conv2d",
+     ins={"Input": R(144).randn(2, 3, 7, 7).astype(np.float32),
+          "Filter": R(145).randn(3, 1, 3, 3).astype(np.float32)},
+     attrs={"strides": [2, 2], "paddings": [1, 1], "groups": 3,
+            "dilations": [1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"],
+     gsample=24)
+spec("conv2d_transpose_s2", op="conv2d_transpose",
+     ins={"Input": R(146).randn(1, 3, 5, 5).astype(np.float32),
+          "Filter": R(147).randn(3, 2, 3, 3).astype(np.float32)},
+     attrs={"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"],
+     gsample=24)
+spec("pool2d_max_pad", op="pool2d",
+     ins={"X": R(148).randn(2, 2, 7, 7).astype(np.float32)},
+     attrs={"pooling_type": "max", "ksize": [3, 3], "strides": [2, 2],
+            "paddings": [1, 1]},
+     grad=True, gsample=24)
+spec("pool2d_avg_ceil", op="pool2d",
+     ins={"X": R(149).randn(2, 2, 7, 7).astype(np.float32)},
+     attrs={"pooling_type": "avg", "ksize": [3, 3], "strides": [2, 2],
+            "paddings": [0, 0], "ceil_mode": True},
+     grad=True, gsample=24)
+spec("conv3d_s2p1", op="conv3d",
+     ins={"Input": R(150).randn(1, 2, 5, 5, 5).astype(np.float32),
+          "Filter": R(151).randn(3, 2, 3, 3, 3).astype(np.float32)},
+     attrs={"strides": [2, 2, 2], "paddings": [1, 1, 1], "groups": 1,
+            "dilations": [1, 1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"],
+     gsample=24)
 spec("batch_norm",
      ins={"X": R(51).randn(4, 3, 3, 3).astype(np.float32),
           "Scale": R(52).uniform(0.5, 1.5, 3).astype(np.float32),
@@ -791,7 +841,83 @@ RANDOM_SPECS = {
 }
 
 # --- exemptions (VERDICT: every uncovered kernel listed with a reason) -
+# --- round-5 kernels (detection/sequence breadth) ---------------------
+def _np_roi_pool(x, rois, lod, ph, pw, scale):
+    import math as _m
+    out = np.zeros((len(rois), x.shape[1], ph, pw), np.float64)
+    img = np.zeros(len(rois), np.int64)
+    for n in range(len(lod) - 1):
+        img[lod[n]:lod[n + 1]] = n
+    H, W = x.shape[2:]
+    for r, roi in enumerate(rois):
+        x1, y1, x2, y2 = [int(round(v * scale)) for v in roi]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        for p in range(ph):
+            hs = min(max(y1 + p * rh // ph, 0), H)
+            he = min(max(y1 + -((-(p + 1) * rh) // ph), 0), H)
+            for q in range(pw):
+                ws = min(max(x1 + q * rw // pw, 0), W)
+                we = min(max(x1 + -((-(q + 1) * rw) // pw), 0), W)
+                if he > hs and we > ws:
+                    out[r, :, p, q] = x[img[r], :, hs:he, ws:we].max((1, 2))
+    return out
+
+
+_roi = np.array([[0, 0, 1, 1], [1, 1, 3, 3], [0, 0, 3, 3]], np.float32)
+spec("roi_pool",
+     ins={"X": R(160).randn(2, 2, 4, 4).astype(np.float32),
+          "ROIs": _roi},
+     attrs={"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+     lods={"roi_pool_rois_0": [0, 2, 3]},
+     grad=["X"], gsample=16,
+     oracle=lambda i, a: {"Out": _np_roi_pool(
+         i["X"], i["ROIs"], [0, 2, 3], 2, 2, 1.0)})
+spec("scale_sub_region",
+     ins={"X": R(161).randn(2, 2, 3, 3).astype(np.float32),
+          "Indices": np.array([[1, 1, 1, 2, 1, 2],
+                               [2, 2, 2, 3, 2, 3]], np.int32)},
+     attrs={"value": 2.0}, grad=["X"],
+     oracle=lambda i, a: {"Out": _np_ssr(i["X"], i["Indices"], 2.0)})
+
+
+def _np_ssr(x, idx, value):
+    out = x.copy()
+    for n in range(x.shape[0]):
+        c0, c1, h0, h1, w0, w1 = idx[n]
+        out[n, c0 - 1:c1, h0 - 1:h1, w0 - 1:w1] *= value
+    return out
+
+
+spec("kmax_seq_score",
+     ins={"X": np.array([[0.1], [0.9], [0.5], [0.3], [0.8]], np.float32)},
+     attrs={"beam_size": 2},
+     lods={"kmax_seq_score_x_0": [0, 3, 5]},
+     oracle=lambda i, a: {"Out": np.array([[1, 2], [1, 0]], np.int32)})
+# lambda_rank's forward (NDCG) is piecewise-constant in the model score,
+# so finite differences are zero a.e. and cannot probe the custom-vjp
+# lambda gradient; the gradient's direction/magnitude is exercised in
+# tests/test_legacy_dsl.py round-5 suite. Forward oracle only here.
+spec("lambda_rank",
+     ins={"X": np.array([[0.1], [0.9], [0.5]], np.float32),
+          "Score": np.array([[2.0], [0.0], [1.0]], np.float32)},
+     attrs={"NDCG_num": 2},
+     lods={"lambda_rank_x_0": [0, 3]},
+     oracle=lambda i, a: {"Out": np.full(
+         (3, 1),
+         ((2 ** 0 - 1) / np.log(2) + (2 ** 1 - 1) / np.log(3))
+         / ((2 ** 2 - 1) / np.log(2) + (2 ** 1 - 1) / np.log(3)))})
+
+
 EXEMPT = {
+    "sub_nested_seq": "needs a 2-level LoD feed (outer @LOD_SRC side-band) "
+                      "beyond this harness; numpy-oracle + pooling "
+                      "round-trip in test_legacy_dsl.py round-5",
+    "ssd_multibox_loss": "composite loss over ragged gt boxes; matching/"
+                         "mining semantics oracle-tested via the DSL "
+                         "multibox_loss training test (test_legacy_dsl.py)",
+    "cross_entropy_over_beam": "variadic (Scores_k, Gold_k) slots with "
+                               "per-beam LoD; logsumexp oracle in "
+                               "test_legacy_dsl.py round-5",
     "while": "control flow; dedicated tests in test_control_flow.py",
     "array_read": "tensor-array plumbing; test_control_flow.py",
     "array_write": "tensor-array plumbing; test_control_flow.py",
@@ -884,6 +1010,7 @@ def test_op(name):
         h.check_grad(
             wrt=None if grad is True else list(grad),
             rtol=gtol[0], atol=gtol[1],
+            sample=kw.pop("gsample", None),
         )
 
 
