@@ -758,3 +758,82 @@ def _beam_init(ctx, ins, attrs):
     for out_name in (ctx.op.outputs["Ids"][0], ctx.op.outputs["Scores"][0]):
         set_sidebands(ctx.env, out_name, {"@LOD0": off, LOD_SRC: off})
     return {"Ids": ids, "Scores": scores}
+
+
+@register_op("lod_tensor_to_array")
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """Scatter a ragged batch into a TensorArray of time steps in rank-
+    table order (reference lod_tensor_to_array_op.cc): entry t holds row
+    t of every sequence, sequences sorted longest-first.
+
+    TPU-first divergence (documented): entries keep the STATIC [n, D]
+    shape with zero rows once a sequence has ended, instead of the
+    reference's physically shrinking batch — shrink_memory is then a
+    masked no-op and one compiled program covers every batch mix."""
+    from .kernels_sequence import lod_key as _lk
+
+    x = ctx.env[ctx.op.inputs["X"][0]]
+    table = ctx.env[ctx.op.inputs["RankTable"][0]]
+    offsets = ctx.env[_lk(ctx.op.inputs["X"][0])]
+    order = table[:, 0]
+    n = order.shape[0]
+    total = x.shape[0]
+    from .kernels_rnn import _seq_T
+
+    T = _seq_T(ctx, x.shape[0])
+    arr = TensorArray()
+    for t in range(T):
+        src = offsets[order] + t
+        valid = (src < offsets[order + 1]).reshape((-1,) + (1,) * (x.ndim - 1))
+        row = jnp.where(valid, x[jnp.clip(src, 0, total - 1)], 0.0)
+        arr.write(t, row, {})
+    ctx.env[ctx.op.outputs["Out"][0]] = arr
+    return {}
+
+
+@register_op("array_to_lod_tensor")
+def _array_to_lod_tensor(ctx, ins, attrs):
+    """Inverse of lod_tensor_to_array: gather time-step entries back
+    into the packed ragged layout of the rank table's original order."""
+    from .kernels_sequence import lod_key as _lk
+
+    arr = ctx.env[ctx.op.inputs["X"][0]]
+    table = ctx.env[ctx.op.inputs["RankTable"][0]]
+    order = table[:, 0]
+    lengths = table[:, 1]
+    n = order.shape[0]
+    T = len(arr)
+    stacked = jnp.stack([arr.read(t)[0] for t in range(T)])  # [T, n, D]
+    # original offsets: lengths permuted back to original sequence ids
+    orig_len = jnp.zeros((n,), jnp.int32).at[order].set(lengths)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(orig_len, dtype=jnp.int32)]
+    )
+    total = int(T) * int(n)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    seq = jnp.searchsorted(offsets, pos, side="right") - 1
+    seq_c = jnp.clip(seq, 0, n - 1)
+    # rank slot of original sequence s
+    rank_of = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    t_idx = pos - offsets[seq_c]
+    out = stacked[jnp.clip(t_idx, 0, T - 1), rank_of[seq_c]]
+    live = (pos < offsets[-1]).reshape((-1,) + (1,) * (out.ndim - 1))
+    out = jnp.where(live, out, 0.0)
+    ctx.env[_lk(ctx.op.outputs["Out"][0])] = offsets
+    return {"Out": out}
+
+
+@register_op("shrink_rnn_memory")
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """Reference shrink_rnn_memory_op trims the state to sequences still
+    alive at step I. Static-shape design: states of finished sequences
+    are masked to zero instead of removed (see lod_tensor_to_array)."""
+    x = ins["X"][0]
+    table = ctx.env[ctx.op.inputs["RankTable"][0]]
+    i = ctx.env[ctx.op.inputs["I"][0]]
+    alive = (table[:, 1] > jnp.asarray(i).reshape(())[None]).reshape(
+        (-1,) + (1,) * (x.ndim - 1)
+    )
+    return {"Out": jnp.where(alive, x, 0.0)}

@@ -188,3 +188,42 @@ def _edit_distance(ctx, ins, attrs):
         dist = dist / jnp.maximum(r_lens.astype(dist.dtype), 1.0)
     return {"Out": dist.reshape(B, 1).astype(jnp.float32),
             "SequenceNum": seq_num}
+
+
+@register_op("ctc_align")
+def _ctc_align(ctx, ins, attrs):
+    """CTC greedy (best-path) decode (reference ctc_align_op.cc +
+    ctc_greedy_decoder nn.py): per sequence take the argmax token per
+    step, collapse repeats, drop blanks. Packed-compaction output like
+    sequence_erase: kept tokens move to the buffer front, traced offsets
+    describe the ragged result."""
+    x = ins["Input"][0]  # [total, C] probs/logits OR [total] token ids
+    from .kernels_sequence import lod_key, seg_ids
+
+    offsets = ctx.env[lod_key(ctx.op.inputs["Input"][0])]
+    blank = int(attrs.get("blank", 0))
+    total = x.shape[0]
+    ids = x.reshape(total, -1)
+    tokens = (
+        jnp.argmax(ids, axis=1).astype(jnp.int32)
+        if ids.shape[1] > 1 else ids[:, 0].astype(jnp.int32)
+    )
+    seg = seg_ids(offsets, total)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (tokens[1:] != tokens[:-1]) | (seg[1:] != seg[:-1])]
+    )
+    kept = first & (tokens != blank)
+    pos = jnp.cumsum(kept.astype(jnp.int32)) - 1
+    dest = jnp.where(kept, pos, total)
+    out = jnp.zeros((total + 1,), jnp.int32).at[dest].set(tokens)[:total]
+    n = offsets.shape[0] - 1
+    kept_per_seq = jax.ops.segment_sum(
+        kept.astype(jnp.int32), seg, num_segments=n
+    )
+    new_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(kept_per_seq, dtype=jnp.int32)]
+    )
+    ctx.env[lod_key(ctx.op.outputs["Output"][0])] = new_off
+    return {"Output": out.reshape(total, 1)}

@@ -271,3 +271,21 @@ def _l2_normalize(ctx, ins, attrs):
 def _isfinite(ctx, ins, attrs):
     flat = jnp.concatenate([jnp.ravel(jnp.isfinite(x)) for x in ins["X"]])
     return {"Out": jnp.all(flat).reshape((1,))}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    """Cumulative sum along an axis (reference cum_op.h): exclusive and
+    reverse variants included."""
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1))
+    exclusive = bool(attrs.get("exclusive", False))
+    rev = bool(attrs.get("reverse", False))
+    if rev:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis, dtype=x.dtype)
+    if exclusive:
+        out = out - x
+    if rev:
+        out = jnp.flip(out, axis)
+    return {"Out": out}

@@ -251,3 +251,74 @@ def _sequence_conv(ctx, ins, attrs):
         cols.append(jnp.where(valid[:, None], x[src_c], 0.0))
     ctxmat = jnp.concatenate(cols, axis=1)               # [total, cl*D]
     return {"Out": ctxmat @ filt}
+
+
+@register_op("lstmp")
+def _lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (reference operators/lstmp_op.h,
+    python dynamic_lstmp nn.py:339): the recurrence runs on the
+    PROJECTED state r = proj_act(h @ W_proj) [P wide], so the recurrent
+    GEMM is [P, 4H] — the classic LSTMP memory/compute saving. Outputs
+    the projection sequence and the cell sequence."""
+    x = ins["Input"][0]            # [total, 4H]
+    w = ins["Weight"][0]           # [P, 4H] recurrent weight over r
+    w_proj = ins["ProjWeight"][0]  # [H, P]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    offsets = ctx.env[lod_key(ctx.op.inputs["Input"][0])]
+    n = offsets.shape[0] - 1
+    H = w_proj.shape[0]
+    Pdim = w_proj.shape[1]
+    total = x.shape[0]
+    reverse = bool(attrs.get("is_reverse", False))
+    peephole = bool(attrs.get("use_peepholes", True))
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    proj_act = _act(attrs.get("proj_activation", "tanh"))
+
+    if bias is not None:
+        x = x + bias[:, : 4 * H]
+    if peephole and bias is not None:
+        w_ic = bias[0, 4 * H : 5 * H]
+        w_fc = bias[0, 5 * H : 6 * H]
+        w_oc = bias[0, 6 * H : 7 * H]
+    else:
+        w_ic = w_fc = w_oc = None
+
+    T = _seq_T(ctx, total)
+    xp, mask = packed_to_padded(x, offsets, T, reverse=reverse)
+    xp = jnp.swapaxes(xp, 0, 1)
+    mask_t = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
+
+    r0 = jnp.zeros((n, Pdim), x.dtype)
+    c0 = jnp.zeros((n, H), x.dtype)
+
+    def step(carry, xm):
+        r, c = carry
+        xt, m = xm
+        g = xt + r @ w
+        gi, gf, gc, go = jnp.split(g, 4, axis=1)
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(h_new @ w_proj)
+        r_new = m * r_new + (1 - m) * r
+        c_new = m * c_new + (1 - m) * c
+        return (r_new, c_new), (r_new, c_new)
+
+    (_, _), (rs, cs) = lax.scan(step, (r0, c0), (xp, mask_t))
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    proj = padded_to_packed(rs, offsets, total, reverse=reverse)
+    cell = padded_to_packed(cs, offsets, total, reverse=reverse)
+    out_name = ctx.op.outputs["Projection"][0]
+    ctx.env[lod_key(out_name)] = offsets
+    ctx.env[lod_key(ctx.op.outputs["Cell"][0])] = offsets
+    return {"Projection": proj, "Cell": cell}

@@ -433,3 +433,100 @@ def _cross_entropy_over_beam(ctx, ins, attrs):
         ce = lse - s[jnp.clip(gold_pos, 0, s.shape[0] - 1)]
         total_cost = ce if total_cost is None else total_cost + ce
     return {"Out": total_cost[:, None]}
+
+
+# ---------------------------------------------------------------------------
+# LoD plumbing layer ops (reference layers/control_flow.py lod_rank_table,
+# max_sequence_len, reorder_lod_tensor_by_rank, split/merge_lod_tensor —
+# the building blocks of the reference's while-op DynamicRNN and IfElse).
+# Our DynamicRNN lowers to lax.scan instead, but the ops stand alone as
+# user-visible surface with the same semantics on the packed+offsets
+# ragged representation.
+# ---------------------------------------------------------------------------
+
+
+@register_op("lod_rank_table")
+def _lod_rank_table(ctx, ins, attrs):
+    """Sequences sorted by length descending (stable): out rows are
+    [original_index, length] (reference lod_rank_table.h RankTable)."""
+    offsets = _offsets(ctx)
+    lengths = seg_lengths(offsets)
+    n = lengths.shape[0]
+    # stable descending sort: key = (-length, index)
+    order = jnp.lexsort((jnp.arange(n), -lengths))
+    table = jnp.stack(
+        [order.astype(jnp.int32), lengths[order].astype(jnp.int32)], axis=1
+    )
+    return {"Out": table}
+
+
+@register_op("max_sequence_len")
+def _max_sequence_len(ctx, ins, attrs):
+    table = ins["RankTable"][0]
+    return {"Out": jnp.max(table[:, 1]).reshape((1,)).astype(jnp.int64)}
+
+
+@register_op("reorder_lod_tensor_by_rank")
+def _reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """Reorder X's sequences into the rank table's order (reference
+    reorder_lod_tensor_by_rank_op.cc): compaction gather on the packed
+    buffer, new offsets from the permuted lengths."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    offsets = _offsets(ctx)
+    total = x.shape[0]
+    order = table[:, 0]
+    lengths = table[:, 1]
+    new_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)]
+    )
+    pos = jnp.arange(total, dtype=jnp.int32)
+    slot = jnp.searchsorted(new_off, pos, side="right") - 1
+    slot = jnp.clip(slot, 0, order.shape[0] - 1)
+    src = offsets[order[slot]] + (pos - new_off[slot])
+    out = x[jnp.clip(src, 0, total - 1)]
+    _set_lod(ctx, "Out", new_off)
+    return {"Out": out}
+
+
+@register_op("split_lod_tensor")
+def _split_lod_tensor(ctx, ins, attrs):
+    """Route rows by boolean mask into two full-size buffers with valid
+    counts (reference split_lod_tensor_op.cc; the IfElse scatter half).
+    Row order within each branch preserves input order; tail rows beyond
+    each branch's count are zeros, addressed only through the LoD."""
+    x = ins["X"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    n = x.shape[0]
+    rank_t = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    rank_f = jnp.cumsum((~mask).astype(jnp.int32)) - 1
+    dest_t = jnp.where(mask, rank_t, n)
+    dest_f = jnp.where(~mask, rank_f, n)
+    buf = jnp.zeros((n + 1,) + x.shape[1:], x.dtype)
+    out_t = buf.at[dest_t].set(x)[:n]
+    out_f = buf.at[dest_f].set(x)[:n]
+    n_true = mask.sum().astype(jnp.int32)
+    env = ctx.env
+    env[lod_key(ctx.op.outputs["OutTrue"][0])] = jnp.stack(
+        [jnp.zeros((), jnp.int32), n_true]
+    )
+    env[lod_key(ctx.op.outputs["OutFalse"][0])] = jnp.stack(
+        [jnp.zeros((), jnp.int32), n - n_true]
+    )
+    return {"OutTrue": out_t, "OutFalse": out_f}
+
+
+@register_op("merge_lod_tensor")
+def _merge_lod_tensor(ctx, ins, attrs):
+    """Inverse of split_lod_tensor (reference merge_lod_tensor_op.cc):
+    out[i] = InTrue[rank_true[i]] if mask[i] else InFalse[rank_false[i]]."""
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    t = ins["InTrue"][0]
+    f = ins["InFalse"][0]
+    n = mask.shape[0]
+    rank_t = jnp.clip(jnp.cumsum(mask.astype(jnp.int32)) - 1, 0, None)
+    rank_f = jnp.clip(jnp.cumsum((~mask).astype(jnp.int32)) - 1, 0, None)
+    sel_t = t[jnp.clip(rank_t, 0, t.shape[0] - 1)]
+    sel_f = f[jnp.clip(rank_f, 0, f.shape[0] - 1)]
+    mexp = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    return {"Out": jnp.where(mexp, sel_t, sel_f)}

@@ -55,7 +55,10 @@ def _fill_zeros_like(ctx, ins, attrs):
 @register_op("uniform_random")
 def _uniform_random(ctx, ins, attrs):
     shape = tuple(int(s) for s in attrs["shape"])
-    key = ctx.next_key()
+    # a nonzero `seed` attr pins the draw (reference uniform_random_op
+    # seed semantics); seed=0 means "use the executor's RNG stream"
+    seed = int(attrs.get("seed", 0) or 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_key()
     lo = attrs.get("min", -1.0)
     hi = attrs.get("max", 1.0)
     return {"Out": jax.random.uniform(key, shape, _np_dtype(attrs.get("dtype", "float32")), lo, hi)}

@@ -1567,3 +1567,240 @@ def lod_reset(x, y=None, target_lod=None, name=None, **kwargs):
 
 
 __all__.append("lod_reset")
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, **kwargs):
+    """LSTM with recurrent projection over a ragged batch (reference
+    nn.py:339 dynamic_lstmp, operators/lstmp_op). `size` is 4*hidden;
+    `proj_size` is the projection width the recurrence runs on.
+    Returns (projection, cell), LoD-shaped like the input."""
+    helper = LayerHelper("dynamic_lstmp", name=name, **kwargs)
+    hidden_size = size // 4
+    attr = ParamAttr.to_attr(param_attr)
+    weight = helper.create_parameter(
+        attr=attr,
+        shape=[proj_size, 4 * hidden_size], dtype=dtype,
+    )
+    # the projection weight needs its OWN attr: create_parameter fills in
+    # attr.name, so reusing the caller's object would collide both params
+    # on one (overwritten) variable
+    proj_attr = ParamAttr(
+        name=(attr.name + "_proj") if getattr(attr, "name", None) else None,
+        initializer=getattr(attr, "initializer", None),
+        learning_rate=getattr(attr, "learning_rate", 1.0),
+        regularizer=getattr(attr, "regularizer", None),
+        trainable=getattr(attr, "trainable", True),
+    )
+    proj_weight = helper.create_parameter(
+        attr=proj_attr,
+        shape=[hidden_size, proj_size], dtype=dtype,
+    )
+    bias_size = [1, 7 * hidden_size] if use_peepholes else [1, 4 * hidden_size]
+    bias = helper.create_parameter(
+        attr=ParamAttr.to_attr(bias_attr), shape=bias_size, dtype=dtype,
+        is_bias=True,
+    )
+    projection = helper.create_tmp_variable(dtype, lod_level=1)
+    cell = helper.create_tmp_variable(dtype, lod_level=1)
+    helper.append_op(
+        type="lstmp",
+        inputs={"Input": [input], "Weight": [weight],
+                "ProjWeight": [proj_weight], "Bias": [bias]},
+        outputs={"Projection": [projection], "Cell": [cell]},
+        attrs={
+            "use_peepholes": use_peepholes, "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+            "proj_activation": proj_activation,
+        },
+    )
+    return projection, cell
+
+
+def ctc_greedy_decoder(input, blank, name=None, **kwargs):
+    """CTC best-path decode: per-step argmax, collapse repeats, drop
+    blanks (reference nn.py ctc_greedy_decoder, ctc_align_op)."""
+    helper = LayerHelper("ctc_align", name=name, **kwargs)
+    out = helper.create_tmp_variable("int32", lod_level=1)
+    helper.append_op(
+        type="ctc_align", inputs={"Input": [input]},
+        outputs={"Output": [out]}, attrs={"blank": blank},
+    )
+    return out
+
+
+def cumsum(x, axis=None, exclusive=False, reverse=False, name=None,
+           **kwargs):
+    """Cumulative sum (reference cum_op)."""
+    helper = LayerHelper("cumsum", name=name, **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type="cumsum", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"axis": -1 if axis is None else axis,
+               "exclusive": exclusive, "reverse": reverse},
+    )
+    return out
+
+
+def _logical2(op_type):
+    def layer(x, y, out=None, name=None, **kwargs):
+        helper = LayerHelper(op_type, name=name, **kwargs)
+        out_var = out or helper.create_tmp_variable("bool")
+        helper.append_op(
+            type=op_type, inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out_var]},
+        )
+        return out_var
+
+    layer.__name__ = op_type
+    layer.__doc__ = "Elementwise %s (reference logical_op.cc)." % op_type
+    return layer
+
+
+logical_and = _logical2("logical_and")
+logical_or = _logical2("logical_or")
+logical_xor = _logical2("logical_xor")
+
+
+def logical_not(x, out=None, name=None, **kwargs):
+    """Elementwise NOT (reference logical_op.cc)."""
+    helper = LayerHelper("logical_not", name=name, **kwargs)
+    out_var = out or helper.create_tmp_variable("bool")
+    helper.append_op(
+        type="logical_not", inputs={"X": [x]}, outputs={"Out": [out_var]},
+    )
+    return out_var
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None, **kwargs):
+    """Uniform random tensor (reference uniform_random_op)."""
+    helper = LayerHelper("uniform_random", name=name, **kwargs)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        type="uniform_random", inputs={}, outputs={"Out": [out]},
+        attrs={"shape": list(shape), "min": min, "max": max, "seed": seed,
+               "dtype": dtype},
+    )
+    return out
+
+
+def lod_rank_table(x, level=0, name=None, **kwargs):
+    """Rank table: sequences sorted by length descending, rows
+    [original_index, length] (reference control_flow.py lod_rank_table)."""
+    helper = LayerHelper("lod_rank_table", name=name, **kwargs)
+    out = helper.create_tmp_variable("int32")
+    helper.append_op(
+        type="lod_rank_table", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"level": level},
+    )
+    return out
+
+
+def max_sequence_len(rank_table, name=None, **kwargs):
+    """Longest sequence length from a rank table (reference
+    max_sequence_len_op)."""
+    helper = LayerHelper("max_sequence_len", name=name, **kwargs)
+    out = helper.create_tmp_variable("int64")
+    helper.append_op(
+        type="max_sequence_len", inputs={"RankTable": [rank_table]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table, name=None, **kwargs):
+    """Reorder sequences into rank-table order (reference
+    reorder_lod_tensor_by_rank_op)."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank", name=name, **kwargs)
+    out = helper.create_tmp_variable(x.dtype, lod_level=1)
+    helper.append_op(
+        type="reorder_lod_tensor_by_rank",
+        inputs={"X": [x], "RankTable": [rank_table]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def split_lod_tensor(input, mask, level=0, name=None, **kwargs):
+    """Route rows into (true, false) branches by boolean mask (reference
+    split_lod_tensor_op; the IfElse scatter half)."""
+    helper = LayerHelper("split_lod_tensor", name=name, **kwargs)
+    out_true = helper.create_tmp_variable(input.dtype, lod_level=1)
+    out_false = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op(
+        type="split_lod_tensor",
+        inputs={"X": [input], "Mask": [mask]},
+        outputs={"OutTrue": [out_true], "OutFalse": [out_false]},
+        attrs={"level": level},
+    )
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0, name=None,
+                     **kwargs):
+    """Inverse of split_lod_tensor (reference merge_lod_tensor_op)."""
+    helper = LayerHelper("merge_lod_tensor", name=name, **kwargs)
+    out = helper.create_tmp_variable(in_true.dtype)
+    helper.append_op(
+        type="merge_lod_tensor",
+        inputs={"InTrue": [in_true], "InFalse": [in_false],
+                "X": [x], "Mask": [mask]},
+        outputs={"Out": [out]},
+        attrs={"level": level},
+    )
+    return out
+
+
+def lod_tensor_to_array(x, table, name=None, **kwargs):
+    """Scatter a ragged batch into a time-step TensorArray in rank-table
+    order (reference lod_tensor_to_array_op). Entries keep static [n, D]
+    shapes with ended sequences masked to zero."""
+    helper = LayerHelper("lod_tensor_to_array", name=name, **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type="lod_tensor_to_array",
+        inputs={"X": [x], "RankTable": [table]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def array_to_lod_tensor(x, table, name=None, **kwargs):
+    """Gather a time-step TensorArray back into packed ragged layout
+    (reference array_to_lod_tensor_op)."""
+    helper = LayerHelper("array_to_lod_tensor", name=name, **kwargs)
+    out = helper.create_tmp_variable("float32", lod_level=1)
+    helper.append_op(
+        type="array_to_lod_tensor",
+        inputs={"X": [x], "RankTable": [table]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def shrink_memory(x, i, table, name=None, **kwargs):
+    """Mask RNN state rows of sequences finished before step i
+    (reference shrink_rnn_memory_op; static-shape masked variant)."""
+    helper = LayerHelper("shrink_rnn_memory", name=name, **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type="shrink_rnn_memory",
+        inputs={"X": [x], "I": [i], "RankTable": [table]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+__all__ += [
+    "dynamic_lstmp", "ctc_greedy_decoder", "cumsum", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "uniform_random",
+    "lod_rank_table", "max_sequence_len", "reorder_lod_tensor_by_rank",
+    "split_lod_tensor", "merge_lod_tensor", "lod_tensor_to_array",
+    "array_to_lod_tensor", "shrink_memory",
+]

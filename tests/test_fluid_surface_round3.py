@@ -1,0 +1,190 @@
+"""Round-3 fluid-surface completion: the last reference layers/*
+functions without counterparts (dynamic_lstmp, ctc_greedy_decoder,
+cumsum, logical_*, uniform_random, and the LoD plumbing family
+lod_rank_table / max_sequence_len / reorder_lod_tensor_by_rank /
+split_lod_tensor / merge_lod_tensor / lod_tensor_to_array /
+array_to_lod_tensor / shrink_memory).
+
+Reference: python/paddle/v2/fluid/layers/{nn,ops,control_flow}.py.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _run(build, feeds, fetch_builder):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds, fetch_list=list(fetches))
+    return outs, scope
+
+
+def test_cumsum_and_logicals():
+    x_np = np.arange(6, dtype=np.float32).reshape(2, 3)
+    a_np = np.array([[1, 0, 1]], bool)
+    b_np = np.array([[1, 1, 0]], bool)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        a = fluid.layers.data(name="a", shape=[3], dtype="bool")
+        b = fluid.layers.data(name="b", shape=[3], dtype="bool")
+        return [
+            fluid.layers.cumsum(x, axis=1),
+            fluid.layers.cumsum(x, axis=1, exclusive=True),
+            fluid.layers.cumsum(x, axis=1, reverse=True),
+            fluid.layers.logical_and(a, b),
+            fluid.layers.logical_or(a, b),
+            fluid.layers.logical_xor(a, b),
+            fluid.layers.logical_not(a),
+        ]
+
+    outs, _ = _run(build, {"x": x_np, "a": a_np, "b": b_np}, None)
+    np.testing.assert_allclose(outs[0], np.cumsum(x_np, 1))
+    np.testing.assert_allclose(outs[1], np.cumsum(x_np, 1) - x_np)
+    np.testing.assert_allclose(
+        outs[2], np.cumsum(x_np[:, ::-1], 1)[:, ::-1])
+    np.testing.assert_array_equal(outs[3], a_np & b_np)
+    np.testing.assert_array_equal(outs[4], a_np | b_np)
+    np.testing.assert_array_equal(outs[5], a_np ^ b_np)
+    np.testing.assert_array_equal(outs[6], ~a_np)
+
+
+def test_uniform_random_stats():
+    def build():
+        return [fluid.layers.uniform_random([64, 64], min=-2.0, max=2.0,
+                                            seed=3)]
+
+    outs, _ = _run(build, {"__d__": np.zeros(1, np.float32)}, None)
+    u = outs[0]
+    assert u.shape == (64, 64)
+    assert u.min() >= -2.0 and u.max() <= 2.0
+    assert abs(float(u.mean())) < 0.1
+
+
+def test_ctc_greedy_decoder():
+    # two sequences of per-step class probs (blank=0)
+    probs = np.zeros((7, 3), np.float32)
+    # seq 1 steps: argmax -> 1,1,0,2  => collapse/deblank => [1, 2]
+    for t, c in enumerate([1, 1, 0, 2]):
+        probs[t, c] = 1.0
+    # seq 2 steps: 0,2,2 => [2]
+    for t, c in enumerate([0, 2, 2]):
+        probs[4 + t, c] = 1.0
+    lod = [np.array([0, 4, 7], np.int32)]
+
+    def build():
+        x = fluid.layers.data(name="p", shape=[3], dtype="float32",
+                              lod_level=1)
+        return [fluid.layers.ctc_greedy_decoder(x, blank=0)]
+
+    outs, _ = _run(build, {"p": (probs, lod)}, None)
+    got = np.ravel(outs[0])[:3]
+    np.testing.assert_array_equal(got, [1, 2, 2])
+
+
+def test_dynamic_lstmp_trains_and_projects():
+    H, P = 6, 4
+    rng = np.random.RandomState(0)
+    lens = [3, 5]
+    lod = [np.cumsum([0] + lens).astype(np.int32)]
+    x_np = rng.randn(sum(lens), 4 * H).astype(np.float32) * 0.1
+    y_np = rng.randn(len(lens), P).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4 * H], dtype="float32",
+                              lod_level=1)
+        y = fluid.layers.data(name="y", shape=[P], dtype="float32")
+        proj, cell = fluid.layers.dynamic_lstmp(
+            input=x, size=4 * H, proj_size=P, use_peepholes=False)
+        last = fluid.layers.sequence_last_step(input=proj)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=last, label=y))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        losses = [
+            float(np.ravel(exe.run(
+                main, feed={"x": (x_np, lod), "y": y_np},
+                fetch_list=[loss])[0])[0])
+            for _ in range(25)
+        ]
+        pv, cv = exe.run(main, feed={"x": (x_np, lod), "y": y_np},
+                         fetch_list=[proj, cell])
+    assert pv.shape == (sum(lens), P)  # projection width, not hidden
+    assert cv.shape == (sum(lens), H)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.5 * losses[0], losses[::6]
+
+
+def test_lod_rank_table_reorder_and_array_round_trip():
+    lens = [2, 4, 1]
+    lod = [np.cumsum([0] + lens).astype(np.int32)]
+    x_np = np.arange(14, dtype=np.float32).reshape(7, 2)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        mx = fluid.layers.max_sequence_len(table)
+        ro = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        return [table, mx, ro, back]
+
+    outs, _ = _run(build, {"x": (x_np, lod)}, None)
+    table, mx, ro, back = outs
+    # rank order: lengths desc -> seq1 (4), seq0 (2), seq2 (1)
+    np.testing.assert_array_equal(table, [[1, 4], [0, 2], [2, 1]])
+    assert int(np.ravel(mx)[0]) == 4
+    want_ro = np.concatenate([x_np[2:6], x_np[0:2], x_np[6:7]])
+    np.testing.assert_allclose(ro, want_ro)
+    # array round trip restores the ORIGINAL packed layout
+    np.testing.assert_allclose(back[:7], x_np)
+
+
+def test_split_merge_lod_tensor_round_trip():
+    x_np = np.arange(10, dtype=np.float32).reshape(5, 2)
+    mask_np = np.array([[1], [0], [1], [0], [0]], bool)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        m = fluid.layers.data(name="m", shape=[1], dtype="bool")
+        t, f = fluid.layers.split_lod_tensor(x, m)
+        merged = fluid.layers.merge_lod_tensor(t, f, x, m)
+        return [t, f, merged]
+
+    outs, _ = _run(build, {"x": x_np, "m": mask_np}, None)
+    t, f, merged = outs
+    np.testing.assert_allclose(t[:2], x_np[[0, 2]])
+    np.testing.assert_allclose(f[:3], x_np[[1, 3, 4]])
+    np.testing.assert_allclose(merged, x_np)
+
+
+def test_shrink_memory_masks_finished():
+    lens = [3, 1, 2]
+    lod = [np.cumsum([0] + lens).astype(np.int32)]
+    x_np = np.ones((6, 2), np.float32)
+    state_np = np.arange(6, dtype=np.float32).reshape(3, 2) + 1.0
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        st = fluid.layers.data(name="st", shape=[2], dtype="float32")
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=1)
+        table = fluid.layers.lod_rank_table(x)
+        return [fluid.layers.shrink_memory(st, i, table)]
+
+    outs, _ = _run(build, {"x": (x_np, lod), "st": state_np}, None)
+    # rank order lengths: [3, 2, 1]; alive at step 1: len > 1 -> rows 0, 1
+    want = state_np.copy()
+    want[2] = 0.0
+    np.testing.assert_allclose(outs[0], want)
