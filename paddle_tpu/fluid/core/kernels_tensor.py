@@ -392,3 +392,12 @@ def _scale_sub_region(ctx, ins, attrs):
         mc[:, :, None, None] & mh[:, None, :, None] & mw[:, None, None, :]
     )
     return {"Out": jnp.where(mask, x * value, x)}
+
+
+@register_op("select")
+def _select(ctx, ins, attrs):
+    """Scalar-condition select: Out = X if Cond else Y (backs the Switch
+    control-flow class; reference conditional_block_op semantics for the
+    assign-only Switch pattern)."""
+    cond = ins["Cond"][0].reshape(()).astype(bool)
+    return {"Out": jnp.where(cond, ins["X"][0], ins["Y"][0])}

@@ -395,3 +395,342 @@ def beam_search_decode(ids, scores):
     sentence_ids.lens_name = lens.name
     sentence_scores.lens_name = lens.name
     return sentence_ids, sentence_scores
+
+
+class IfElse(object):
+    """Row-wise two-branch conditional (reference control_flow.py IfElse
+    + split_lod_tensor/merge_lod_tensor ops). `cond` is an [N, 1] bool;
+    inputs are routed into the active branch's rows, both branch bodies
+    append row-parallel ops, and outputs merge back into original row
+    order.
+
+    TPU-first: both branches always execute on their routed (compacted,
+    zero-padded) buffers inside the one fused XLA program — there is no
+    host-side branching; `merge_lod_tensor` reassembles by the mask's
+    rank, so branch ops must be row-wise (the same contract the
+    reference's scope-per-branch execution imposes).
+
+    Usage:
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(some_rowwise_fn(d))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(other_fn(d))
+        (out,) = ie()
+    """
+
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._branch = None  # True / False while inside a block
+        self._outs = {True: [], False: []}
+        self._splits = {}  # input var name -> (true_rows, false_rows)
+
+    @contextlib.contextmanager
+    def _block(self, is_true):
+        if self._branch is not None:
+            raise RuntimeError("IfElse blocks cannot nest")
+        self._branch = is_true
+        try:
+            yield
+        finally:
+            self._branch = None
+
+    def true_block(self):
+        return self._block(True)
+
+    def false_block(self):
+        return self._block(False)
+
+    def input(self, x):
+        if self._branch is None:
+            raise RuntimeError("IfElse.input() must be called in a block")
+        if x.name not in self._splits:
+            from . import nn as _nn
+
+            self._splits[x.name] = _nn.split_lod_tensor(x, self.cond)
+        t, f = self._splits[x.name]
+        return t if self._branch else f
+
+    def output(self, *outs):
+        if self._branch is None:
+            raise RuntimeError("IfElse.output() must be called in a block")
+        self._outs[self._branch].extend(outs)
+
+    def __call__(self):
+        if len(self._outs[True]) != len(self._outs[False]):
+            raise ValueError(
+                "IfElse branches produced %d vs %d outputs"
+                % (len(self._outs[True]), len(self._outs[False]))
+            )
+        from . import nn as _nn
+
+        return [
+            _nn.merge_lod_tensor(t, f, t, self.cond)
+            for t, f in zip(self._outs[True], self._outs[False])
+        ]
+
+
+class Switch(object):
+    """First-true-case-wins conditional assignment (reference
+    control_flow.py Switch + conditional_block_op; the learning-rate
+    warmup pattern).
+
+    Case bodies may contain any ops; every variable they WRITE that is
+    visible outside the Switch becomes a select chain: the value from the
+    first case whose scalar condition holds, else the value from
+    `default()`, else the variable's prior value. Lowered to `select`
+    ops — all branches compute inside the fused program, selection is a
+    jnp.where (the TPU-idiomatic form of the reference's scope-guarded
+    conditional block execution).
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._cases = []  # (cond_var or None for default, writes dict)
+        self._inside = False
+        # select chains are built only for variables that existed BEFORE
+        # the switch: those are the assignment targets; vars created
+        # inside a case body are case-local temps
+        self._preexisting = set(
+            self.helper.main_program.current_block().vars
+        )
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        yield from self._capture(condition)
+
+    @contextlib.contextmanager
+    def default(self):
+        yield from self._capture(None)
+
+    def _capture(self, condition):
+        if self._inside:
+            raise RuntimeError("Switch cases cannot nest")
+        block = self.helper.main_program.current_block()
+        start = len(block.ops)
+        self._inside = True
+        try:
+            yield
+        finally:
+            self._inside = False
+        # redirect every visible write of the case body into a case-local
+        # temp; record target -> temp for the select chain. Only reads
+        # AFTER the write see the temp — reads before it (e.g.
+        # `scale(lr, 0.5)` feeding the `assign` back into lr) must keep
+        # reading the prior value.
+        writes = {}
+        case_ops = block.ops[start:]
+        for i, op in enumerate(case_ops):
+            for slot, names in op.outputs.items():
+                for k, n in enumerate(names):
+                    if n not in self._preexisting:
+                        continue  # case-local temp, keep as-is
+                    tmp = "%s@case%d" % (n, len(self._cases))
+                    src = block.var(n)
+                    block.create_var(name=tmp, dtype=src.dtype,
+                                     shape=src.shape)
+                    op.outputs[slot][k] = tmp
+                    for later in case_ops[i + 1:]:
+                        for islot, inames in later.inputs.items():
+                            for j, inn in enumerate(inames):
+                                if inn == n:
+                                    later.inputs[islot][j] = tmp
+                    writes[n] = tmp
+        self._cases.append((condition, writes))
+
+    def __exit__(self, *exc):
+        if exc and exc[0] is not None:
+            return False
+        block = self.helper.main_program.current_block()
+        targets = []
+        for _, writes in self._cases:
+            for t in writes:
+                if t not in targets:
+                    targets.append(t)
+        for t in targets:
+            # first-true-wins: fold cases right-to-left. EVERY case
+            # participates in every target's chain — a case that matched
+            # but did not write t pins t to its PRIOR value (the
+            # reference executes exactly one conditional block, so later
+            # cases must not leak through a matching earlier one).
+            current = t  # no case matches -> prior value
+            for cond, writes in reversed(self._cases):
+                val = writes.get(t, t)
+                if cond is None:
+                    current = val  # default runs when nothing matched
+                    continue
+                sel = "%s@sel%d" % (t, len(block.ops))
+                block.create_var(name=sel, dtype=block.var(t).dtype,
+                                 shape=block.var(t).shape)
+                block.append_op(
+                    type="select",
+                    inputs={"Cond": [cond.name], "X": [val],
+                            "Y": [current]},
+                    outputs={"Out": [sel]},
+                )
+                current = sel
+            if current != t:
+                block.append_op(
+                    type="assign", inputs={"X": [current]},
+                    outputs={"Out": [t]},
+                )
+        return False
+
+    def __enter__(self):
+        return self
+
+
+class StaticRNN(object):
+    """Fixed-length unrolled RNN builder (reference control_flow.py
+    StaticRNN): inputs are [T, ...] time-major dense tensors, the step
+    body is captured once and REPLAYED T times at graph-build time with
+    step-suffixed variable names (graph-level unroll — XLA then sees T
+    identical fused steps; for ragged batches use DynamicRNN, which
+    lowers to one lax.scan instead).
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._mems = []      # dict(init_name, pre_name, update_name)
+        self._step_in = []   # (outer_name, inner_name)
+        self._outs = []      # (inner_name, outer_var)
+        self._T = None
+        self._ops = None
+
+    @contextlib.contextmanager
+    def step(self):
+        block = self.helper.main_program.current_block()
+        start = len(block.ops)
+        yield
+        self._ops = block.ops[start:]
+        del block.ops[start:]
+        self._unroll(block)
+
+    def step_input(self, x):
+        T = int(x.shape[0])
+        if self._T is None:
+            self._T = T
+        elif self._T != T:
+            raise ValueError("step_input lengths disagree: %d vs %d"
+                             % (self._T, T))
+        block = self.helper.main_program.current_block()
+        inner = self.helper.create_tmp_variable(x.dtype)
+        inner.shape = tuple(x.shape[1:])
+        self._step_in.append((x.name, inner.name))
+        return inner
+
+    def memory(self, init):
+        block = self.helper.main_program.current_block()
+        pre = self.helper.create_tmp_variable(init.dtype)
+        pre.shape = init.shape
+        self._mems.append({"init": init.name, "pre": pre.name,
+                           "update": None})
+        return pre
+
+    def update_memory(self, mem, new):
+        for m in self._mems:
+            if m["pre"] == mem.name:
+                m["update"] = new.name
+                return
+        raise ValueError("update_memory: unknown memory %r" % mem.name)
+
+    def step_output(self, o):
+        outer = self.helper.create_tmp_variable(o.dtype)
+        outer.lod_level = 0
+        self._outs.append((o.name, outer))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        outs = [v for _, v in self._outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------------------------
+    def _unroll(self, block):
+        for m in self._mems:
+            if m["update"] is None:
+                raise ValueError("StaticRNN memory never update_memory()'d")
+        if self._T is None:
+            raise ValueError("StaticRNN needs at least one step_input")
+        T = self._T
+
+        inner_names = {n for _, n in self._step_in}
+        inner_names |= {m["pre"] for m in self._mems}
+        for op in self._ops:
+            inner_names.update(op.output_arg_names)
+
+        def t_name(n, t):
+            return "%s@t%d" % (n, t) if n in inner_names else n
+
+        step_results = {i: [] for i, _ in self._outs}
+        for t in range(T):
+            # bind step inputs: x[t] (slice keeps a leading 1, squeeze it)
+            for outer, inner in self._step_in:
+                src = block.var(outer)
+                sl = t_name(inner, t) + "@sl"
+                block.create_var(name=sl, dtype=src.dtype)
+                block.create_var(name=t_name(inner, t), dtype=src.dtype)
+                block.append_op(
+                    type="slice",
+                    inputs={"Input": [outer]},
+                    outputs={"Out": [sl]},
+                    attrs={"axes": [0], "starts": [t], "ends": [t + 1]},
+                )
+                block.append_op(
+                    type="squeeze",
+                    inputs={"X": [sl]},
+                    outputs={"Out": [t_name(inner, t)]},
+                    attrs={"axes": [0]},
+                )
+            # bind memories: init at t=0, else previous step's update
+            for m in self._mems:
+                src = m["init"] if t == 0 else t_name(m["update"], t - 1)
+                block.create_var(name=t_name(m["pre"], t), dtype="float32")
+                block.append_op(
+                    type="assign", inputs={"X": [src]},
+                    outputs={"Out": [t_name(m["pre"], t)]},
+                )
+            # replay body with step-suffixed names
+            for op in self._ops:
+                inputs = {s: [t_name(n, t) for n in ns]
+                          for s, ns in op.inputs.items()}
+                outputs = {}
+                for s, ns in op.outputs.items():
+                    outs = []
+                    for n in ns:
+                        nn = t_name(n, t)
+                        if block._find_var_recursive(nn) is None:
+                            v = block.var(n)
+                            block.create_var(name=nn, dtype=v.dtype)
+                        outs.append(nn)
+                    outputs[s] = outs
+                block.append_op(type=op.type, inputs=inputs,
+                                outputs=outputs, attrs=dict(op.attrs))
+            for inner, _ in self._outs:
+                step_results[inner].append(t_name(inner, t))
+
+        # stack step outputs to [T, ...]
+        for inner, outer in self._outs:
+            parts = []
+            for t, n in enumerate(step_results[inner]):
+                un = n + "@u"
+                block.create_var(name=un, dtype="float32")
+                block.append_op(
+                    type="unsqueeze", inputs={"X": [n]},
+                    outputs={"Out": [un]}, attrs={"axes": [0]},
+                )
+                parts.append(un)
+            block.append_op(
+                type="concat", inputs={"X": parts},
+                outputs={"Out": [outer.name]}, attrs={"axis": 0},
+            )
+
+
+__all__ += ["IfElse", "Switch", "StaticRNN"]

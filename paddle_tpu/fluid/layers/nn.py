@@ -1585,16 +1585,15 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
         attr=attr,
         shape=[proj_size, 4 * hidden_size], dtype=dtype,
     )
-    # the projection weight needs its OWN attr: create_parameter fills in
-    # attr.name, so reusing the caller's object would collide both params
-    # on one (overwritten) variable
-    proj_attr = ParamAttr(
-        name=(attr.name + "_proj") if getattr(attr, "name", None) else None,
-        initializer=getattr(attr, "initializer", None),
-        learning_rate=getattr(attr, "learning_rate", 1.0),
-        regularizer=getattr(attr, "regularizer", None),
-        trainable=getattr(attr, "trainable", True),
-    )
+    # the projection weight needs its OWN attr object: create_parameter
+    # fills in attr.name, so reusing the caller's would collide both
+    # params on one (overwritten) variable. copy keeps every field
+    # (regularizer, gradient_clip, ...) intact.
+    import copy as _copy
+
+    proj_attr = _copy.copy(attr)
+    proj_attr.name = (attr.name + "_proj") if getattr(attr, "name", None) \
+        else None
     proj_weight = helper.create_parameter(
         attr=proj_attr,
         shape=[hidden_size, proj_size], dtype=dtype,

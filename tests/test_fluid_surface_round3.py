@@ -188,3 +188,161 @@ def test_shrink_memory_masks_finished():
     want = state_np.copy()
     want[2] = 0.0
     np.testing.assert_allclose(outs[0], want)
+
+
+def test_ifelse_row_routing():
+    """IfElse (reference control_flow.py IfElse): rows with cond take the
+    true branch (x*10), others the false branch (x-1); merged output is
+    in original row order."""
+    x_np = np.arange(10, dtype=np.float32).reshape(5, 2)
+    cond_np = np.array([[1], [0], [1], [0], [1]], bool)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        c = fluid.layers.data(name="c", shape=[1], dtype="bool")
+        ie = fluid.layers.IfElse(c)
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(fluid.layers.scale(x=d, scale=10.0))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(fluid.layers.scale(x=d, scale=1.0, bias=-1.0))
+        (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        got = exe.run(main, feed={"x": x_np, "c": cond_np},
+                      fetch_list=[out])[0]
+    want = np.where(cond_np, x_np * 10.0, x_np - 1.0)
+    np.testing.assert_allclose(got, want)
+
+
+def test_switch_first_true_wins():
+    """Switch (reference Switch + conditional_block): the classic LR
+    warmup pattern — first true case assigns, else default."""
+    def build_and_run(step_val):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            step = fluid.layers.data(name="s", shape=[1], dtype="float32")
+            lr = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                            value=0.0)
+            one = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                             value=1.0)
+            two = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                             value=2.0)
+            with fluid.layers.Switch() as switch:
+                with switch.case(fluid.layers.less_than(step, one)):
+                    fluid.layers.assign(
+                        fluid.layers.fill_constant(
+                            shape=[1], dtype="float32", value=0.1), lr)
+                with switch.case(fluid.layers.less_than(step, two)):
+                    fluid.layers.assign(
+                        fluid.layers.fill_constant(
+                            shape=[1], dtype="float32", value=0.5), lr)
+                with switch.default():
+                    fluid.layers.assign(
+                        fluid.layers.fill_constant(
+                            shape=[1], dtype="float32", value=1.0), lr)
+            out = fluid.layers.scale(x=lr, scale=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.executor.Scope()
+        with fluid.executor.scope_guard(scope):
+            exe.run(startup)
+            return float(np.ravel(exe.run(
+                main, feed={"s": np.array([[step_val]], np.float32)},
+                fetch_list=[out])[0])[0])
+
+    np.testing.assert_allclose(build_and_run(0.5), 0.1, rtol=1e-6)  # case 1
+    np.testing.assert_allclose(build_and_run(1.5), 0.5, rtol=1e-6)  # case 2
+    np.testing.assert_allclose(build_and_run(5.0), 1.0, rtol=1e-6)  # default
+
+
+def test_static_rnn_matches_manual_unroll():
+    """StaticRNN (reference control_flow.py StaticRNN): h_t = tanh(x_t @ W
+    + h_{t-1} @ U) over a [T, N, D] dense input, outputs stacked."""
+    T, N, D = 3, 2, 4
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(T, N, D).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, N, D], dtype="float32",
+                              append_batch_size=False)
+        h0 = fluid.layers.fill_constant(shape=[N, D], dtype="float32",
+                                        value=0.0)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            hprev = rnn.memory(init=h0)
+            w = fluid.layers.create_parameter([D, D], "float32",
+                                              attr="srnn_w")
+            h = fluid.layers.tanh(
+                x=fluid.layers.elementwise_add(
+                    x=fluid.layers.matmul(x=xt, y=w),
+                    y=fluid.layers.matmul(x=hprev, y=w)))
+            rnn.update_memory(hprev, h)
+            rnn.step_output(h)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        got, w_val = exe.run(main, feed={"x": x_np},
+                             fetch_list=[out, "srnn_w"])
+    # manual unroll oracle
+    h = np.zeros((N, D), np.float32)
+    want = []
+    for t in range(T):
+        h = np.tanh(x_np[t] @ w_val + h @ w_val)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want), rtol=1e-5, atol=1e-6)
+
+
+def test_switch_read_before_write_and_partial_targets():
+    """Regression: (a) a case body that READS the target before assigning
+    (decay pattern lr = lr*0.5) must read the prior value, not its own
+    temp; (b) a matching case that does NOT write a target pins that
+    target to its prior value (exactly-one-block semantics)."""
+    def run(step_val):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            step = fluid.layers.data(name="s", shape=[1], dtype="float32")
+            lr = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                            value=0.8)
+            aux = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                             value=7.0)
+            one = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                             value=1.0)
+            two = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                             value=2.0)
+            with fluid.layers.Switch() as sw:
+                with sw.case(fluid.layers.less_than(step, one)):
+                    # reads lr BEFORE writing it; does NOT touch aux
+                    halved = fluid.layers.scale(x=lr, scale=0.5)
+                    fluid.layers.assign(halved, lr)
+                with sw.case(fluid.layers.less_than(step, two)):
+                    fluid.layers.assign(
+                        fluid.layers.fill_constant(
+                            shape=[1], dtype="float32", value=0.3), lr)
+                    fluid.layers.assign(
+                        fluid.layers.fill_constant(
+                            shape=[1], dtype="float32", value=9.0), aux)
+            o1 = fluid.layers.scale(x=lr, scale=1.0)
+            o2 = fluid.layers.scale(x=aux, scale=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.executor.Scope()
+        with fluid.executor.scope_guard(scope):
+            exe.run(startup)
+            a, b = exe.run(
+                main, feed={"s": np.array([[step_val]], np.float32)},
+                fetch_list=[o1, o2])
+        return float(np.ravel(a)[0]), float(np.ravel(b)[0])
+
+    lr, aux = run(0.5)   # case 1 matches: lr = 0.8*0.5, aux untouched
+    np.testing.assert_allclose([lr, aux], [0.4, 7.0], rtol=1e-6)
+    lr, aux = run(1.5)   # case 2 matches: lr = 0.3, aux = 9.0
+    np.testing.assert_allclose([lr, aux], [0.3, 9.0], rtol=1e-6)
+    lr, aux = run(5.0)   # nothing matches, no default: priors
+    np.testing.assert_allclose([lr, aux], [0.8, 7.0], rtol=1e-6)

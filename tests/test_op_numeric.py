@@ -941,6 +941,8 @@ EXEMPT = {
                    "test_fluid_surface_round3.py",
     "logical_or": "boolean; test_fluid_surface_round3.py",
     "logical_xor": "boolean; test_fluid_surface_round3.py",
+    "select": "scalar-cond branch select backing the Switch class; "
+              "first-true-wins chain oracle in test_fluid_surface_round3",
     "sub_nested_seq": "needs a 2-level LoD feed (outer @LOD_SRC side-band) "
                       "beyond this harness; numpy-oracle + pooling "
                       "round-trip in test_legacy_dsl.py round-5",
