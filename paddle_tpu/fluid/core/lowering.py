@@ -125,11 +125,24 @@ def _share_lod(op, env):
     row-wise ops keep their input's raggedness, so any output that hasn't
     set its own @LOD0 inherits the first input's. Sequence kernels that
     compute a new LoD set it explicitly before this runs; reductions that
-    collapse the ragged axis are barriers."""
+    collapse the ragged (row) axis are barriers — a reduce over FEATURE
+    axes only (dim excludes 0, no reduce_all) stays row-wise and
+    propagates (e.g. the per-row dot product feeding an attention's
+    sequence_softmax)."""
     from .kernels_sequence import lod_key
 
     if op.type in _LOD_BARRIER_OPS:
-        return
+        if not op.type.startswith("reduce_"):
+            return
+        dims = op.attrs.get("dim", 0)
+        dims = list(dims) if isinstance(dims, (list, tuple)) else [dims]
+        # negative dims can address the row axis without containing 0
+        # (dim=-2 on 2-D); rank is unknown here, so treat any negative
+        # dim conservatively as a barrier
+        if op.attrs.get("reduce_all", False) or 0 in dims or any(
+            d < 0 for d in dims
+        ):
+            return
     src = None
     for names in op.inputs.values():
         for n in names:

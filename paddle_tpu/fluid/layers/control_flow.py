@@ -511,6 +511,9 @@ class Switch(object):
     def _capture(self, condition):
         if self._inside:
             raise RuntimeError("Switch cases cannot nest")
+        if any(c is None for c, _ in self._cases):
+            # reference control_flow.py Switch raises the same way
+            raise ValueError("there should be no case after default")
         block = self.helper.main_program.current_block()
         start = len(block.ops)
         self._inside = True
@@ -528,8 +531,15 @@ class Switch(object):
         for i, op in enumerate(case_ops):
             for slot, names in op.outputs.items():
                 for k, n in enumerate(names):
-                    if n not in self._preexisting:
-                        continue  # case-local temp, keep as-is
+                    if n not in self._preexisting and not (
+                        n not in block.vars
+                        and block._find_var_recursive(n) is not None
+                    ):
+                        # created inside the switch: case-local temp.
+                        # Parent-block vars (Switch inside a While body)
+                        # are targets even though the current block's own
+                        # vars dict never held them.
+                        continue
                     tmp = "%s@case%d" % (n, len(self._cases))
                     src = block.var(n)
                     block.create_var(name=tmp, dtype=src.dtype,
@@ -612,6 +622,12 @@ class StaticRNN(object):
         self._unroll(block)
 
     def step_input(self, x):
+        if not x.shape or int(x.shape[0]) <= 0:
+            raise ValueError(
+                "StaticRNN.step_input needs a STATIC time-major leading "
+                "dim (got shape %r); declare the data layer with "
+                "append_batch_size=False and an explicit T" % (x.shape,)
+            )
         T = int(x.shape[0])
         if self._T is None:
             self._T = T

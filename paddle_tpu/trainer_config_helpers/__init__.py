@@ -48,6 +48,7 @@ __all__ = [
     "TanhActivation", "SigmoidActivation", "IdentityActivation",
     "BReluActivation", "SoftReluActivation", "SquareActivation",
     "ExpActivation", "STanhActivation", "AbsActivation", "LogActivation",
+    "SequenceSoftmaxActivation",
     # pooling types
     "MaxPooling", "AvgPooling", "SumPooling",
     # optimizers / regularization
@@ -169,6 +170,7 @@ BReluActivation = _mkact("BReluActivation", "brelu")
 # which is softplus in fluid terms
 SoftReluActivation = _mkact("SoftReluActivation", "softplus")
 SquareActivation = _mkact("SquareActivation", "square")
+SequenceSoftmaxActivation = _mkact("SequenceSoftmaxActivation", "sequence_softmax")
 ExpActivation = _mkact("ExpActivation", "exp")
 STanhActivation = _mkact("STanhActivation", "stanh")
 AbsActivation = _mkact("AbsActivation", "abs")
@@ -1435,16 +1437,6 @@ def cross_entropy_over_beam(input, name=None, **kwargs):
     return Layer("ce_over_beam", name, parents, {"n_beams": len(beams)})
 
 
-def gru_step_naive_layer(input, output_mem, size=None, name=None, act=None,
-                         gate_act=None, bias_attr=None, param_attr=None,
-                         layer_attr=None, **kwargs):
-    """Naive-impl GRU step (reference gru_step_naive_layer): identical
-    math to gru_step_layer, which is already a single fused step here."""
-    return gru_step_layer(input=input, output_mem=output_mem, size=size,
-                          name=name, act=act, gate_act=gate_act,
-                          bias_attr=bias_attr, param_attr=param_attr)
-
-
 def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
                   stride=1, padding=0, **kwargs):
     """Convolution term inside a mixed_layer (reference ConvOperator):
@@ -1478,3 +1470,18 @@ __all__ += [
     "cross_entropy_over_beam", "gru_step_naive_layer", "conv_operator",
     "conv_projection",
 ]
+
+
+# composite network helpers (reference networks.py) — star-import them
+# into the DSL namespace the way the reference's config environment does
+from . import networks  # noqa: E402
+from .networks import (  # noqa: E402,F401
+    bidirectional_gru, bidirectional_lstm, dot_product_attention,
+    gru_group, gru_unit, img_conv_bn_pool, img_separable_conv,
+    lstmemory_group, lstmemory_unit, multi_head_attention,
+    sequence_conv_pool, simple_attention, simple_gru, simple_gru2,
+    simple_img_conv_pool, small_vgg, text_conv_pool, vgg_16_network,
+)
+from .networks import inputs as inputs  # noqa: E402,F401
+
+__all__ += [n for n in networks.__all__ if n != "outputs"]

@@ -346,3 +346,19 @@ def test_switch_read_before_write_and_partial_targets():
     np.testing.assert_allclose([lr, aux], [0.3, 9.0], rtol=1e-6)
     lr, aux = run(5.0)   # nothing matches, no default: priors
     np.testing.assert_allclose([lr, aux], [0.8, 7.0], rtol=1e-6)
+
+
+def test_switch_rejects_case_after_default():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                        value=0.0)
+        one = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=1.0)
+        import pytest
+        with pytest.raises(ValueError, match="no case after default"):
+            with fluid.layers.Switch() as sw:
+                with sw.default():
+                    fluid.layers.assign(one, lr)
+                with sw.case(fluid.layers.less_than(lr, one)):
+                    fluid.layers.assign(one, lr)

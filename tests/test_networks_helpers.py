@@ -1,0 +1,173 @@
+"""Composite network helpers (reference trainer_config_helpers/
+networks.py): image blocks, text conv, GRU/LSTM units+groups,
+bidirectional RNNs, attention, VGG nets — each builds, runs forward,
+and the recurrent/attention paths train to a lower loss."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.trainer_config_helpers as tch
+from paddle_tpu.v2.topology import Topology
+
+
+def _fresh():
+    tch.reset_config()
+
+
+def _train(topo, cost_node, feeds, steps=12, lr=0.05):
+    cost_var = topo.var_of[cost_node.name]
+    with fluid.program_guard(topo.main_program, topo.startup_program):
+        fluid.optimizer.Adam(learning_rate=lr).minimize(cost_var)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        losses = [
+            float(np.ravel(exe.run(topo.main_program, feed=feeds,
+                                   fetch_list=[cost_var])[0])[0])
+            for _ in range(steps)
+        ]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    return losses
+
+
+def test_image_blocks_forward():
+    _fresh()
+    rng = np.random.RandomState(0)
+    img = tch.data_layer(name="nb_img", size=3 * 8 * 8, height=8, width=8)
+    p1 = tch.simple_img_conv_pool(input=img, filter_size=3, num_filters=4,
+                                  pool_size=2, pool_stride=2,
+                                  conv_padding=1, num_channel=3,
+                                  act=tch.ReluActivation())
+    p2 = tch.img_conv_bn_pool(input=img, filter_size=3, num_filters=4,
+                              pool_size=2, pool_stride=2, conv_padding=1,
+                              num_channel=3, act=tch.ReluActivation())
+    sep = tch.img_separable_conv(input=img, num_channels=3,
+                                 num_out_channels=6, filter_size=3,
+                                 padding=1, act=tch.ReluActivation())
+    topo = Topology([p1, p2, sep])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        outs = exe.run(
+            topo.main_program,
+            feed={"nb_img": rng.rand(2, 3 * 64).astype(np.float32)},
+            fetch_list=[topo.var_of[n.name] for n in (p1, p2, sep)],
+        )
+    assert outs[0].shape == (2, 4, 4, 4)
+    assert outs[1].shape == (2, 4, 4, 4)
+    assert outs[2].shape == (2, 6, 8, 8)
+
+
+def test_small_vgg_builds_and_runs():
+    _fresh()
+    rng = np.random.RandomState(1)
+    img = tch.data_layer(name="vgg_img", size=3 * 32 * 32, height=32,
+                         width=32)
+    predict = tch.small_vgg(input_image=img, num_channels=3,
+                            num_classes=10)
+    topo = Topology([predict])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        out = exe.run(
+            topo.main_program,
+            feed={"vgg_img": rng.rand(2, 3 * 1024).astype(np.float32)},
+            fetch_list=[topo.var_of[predict.name]],
+        )[0]
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)  # softmax
+
+
+def test_text_and_gru_paths_train():
+    _fresh()
+    rng = np.random.RandomState(2)
+    dict_dim, emb_dim = 12, 8
+    words = tch.data_layer(name="tx_w", size=dict_dim)
+    emb = tch.embedding_layer(input=words, size=emb_dim)
+    conv = tch.sequence_conv_pool(input=emb, context_len=3,
+                                  hidden_size=10)
+    gru = tch.simple_gru(input=emb, size=6)
+    gru_last = tch.last_seq(input=gru)
+    bi = tch.bidirectional_gru(input=emb, size=5)
+    feat = tch.concat_layer(input=[conv, gru_last, bi])
+    prob = tch.fc_layer(input=feat, size=2,
+                        act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name="tx_y", size=2)
+    cost = tch.classification_cost(input=prob, label=lbl)
+    topo = Topology([cost])
+    lens = [4, 6]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    feeds = {
+        "tx_w": (rng.randint(0, dict_dim, (sum(lens), 1)).astype(np.int64),
+                 [lod]),
+        "tx_y": rng.randint(0, 2, (2, 1)).astype(np.int64),
+    }
+    _train(topo, cost, feeds)
+
+
+def test_lstm_group_and_bidirectional_train():
+    _fresh()
+    rng = np.random.RandomState(3)
+    dict_dim, emb_dim, H = 10, 8, 6
+    words = tch.data_layer(name="lg_w", size=dict_dim)
+    emb = tch.embedding_layer(input=words, size=emb_dim)
+    grp = tch.lstmemory_group(input=emb, size=H, name="lg_lstm")
+    last = tch.last_seq(input=grp)
+    bi = tch.bidirectional_lstm(input=emb, size=H)
+    prob = tch.fc_layer(input=tch.concat_layer(input=[last, bi]), size=2,
+                        act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name="lg_y", size=2)
+    cost = tch.classification_cost(input=prob, label=lbl)
+    topo = Topology([cost])
+    lens = [3, 5]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    feeds = {
+        "lg_w": (rng.randint(0, dict_dim, (sum(lens), 1)).astype(np.int64),
+                 [lod]),
+        "lg_y": rng.randint(0, 2, (2, 1)).astype(np.int64),
+    }
+    _train(topo, cost, feeds)
+
+
+def test_attention_blocks():
+    """simple/dot-product attention: weights sum to 1 per sequence and
+    the output is inside the value hull; multi-head concatenates."""
+    _fresh()
+    rng = np.random.RandomState(4)
+    D = 6
+    seq = tch.data_layer(name="at_seq", size=D)
+    state = tch.data_layer(name="at_state", size=D)
+    att = tch.simple_attention(encoded_sequence=seq, encoded_proj=seq,
+                               decoder_state=state, name="at_simple")
+    datt = tch.dot_product_attention(encoded_sequence=seq,
+                                     attended_sequence=seq,
+                                     transformed_state=state,
+                                     name="at_dot")
+    matt = tch.multi_head_attention(query=state, key=seq, value=seq,
+                                    key_proj_size=4, value_proj_size=4,
+                                    head_num=2, name="at_multi")
+    topo = Topology([att, datt, matt])
+    lens = [3, 4]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    seq_np = rng.rand(sum(lens), D).astype(np.float32)
+    st_np = rng.rand(2, D).astype(np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        a, d, m = exe.run(
+            topo.main_program,
+            feed={"at_seq": (seq_np, [lod]), "at_state": st_np},
+            fetch_list=[topo.var_of[n.name] for n in (att, datt, matt)],
+        )
+    assert a.shape == (2, D)
+    assert d.shape == (2, D)
+    assert m.shape == (2, 8)  # 2 heads x value_proj_size 4
+    # attention output is a convex combination -> within min/max hull
+    for i, (lo, hi) in enumerate(zip(lod[:-1], lod[1:])):
+        assert (d[i] >= seq_np[lo:hi].min(0) - 1e-5).all()
+        assert (d[i] <= seq_np[lo:hi].max(0) + 1e-5).all()
