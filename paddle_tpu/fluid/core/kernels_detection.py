@@ -419,3 +419,143 @@ def _ssd_multibox_loss(ctx, ins, attrs):
 
     denom = jnp.maximum(n_pos.astype(conf.dtype), 1.0)
     return {"Out": ((loc_loss + conf_loss) / denom)[:, None]}
+
+
+@register_op("detection_map")
+def _detection_map(ctx, ins, attrs):
+    """Per-batch VOC mean Average Precision as a GRAPH metric (reference
+    gserver/evaluators/DetectionMAPEvaluator.cpp; the host-side
+    accumulating form lives in fluid/evaluator.py DetectionMAP).
+
+    Inputs: Detection = the padded multiclass_nms buffer [N*K, 6]
+    (rows [label, score, x1, y1, x2, y2], -1 padded, pad stride K from
+    the producing op's @PAD_STRIDE side-band); GTBox [G, 4] packed with
+    an image LoD; GTLabel [G, 1]; optional GTDifficult [G, 1] (difficult
+    ground truth is excluded from recall counts and its matches score
+    neither TP nor FP, per VOC). Matching follows the VOC protocol: in
+    score order each detection takes its best-OVERLAP ground truth; if
+    that box is already claimed the detection is a false positive.
+    Static-shape design: ONE lax.fori_loop over the padded rows with the
+    per-class state vectorised over a leading class axis (compile cost
+    independent of num_classes); AP is the integral form.
+    """
+    det = ins["Detection"][0]  # [M, 6]
+    gt_box = ins["GTBox"][0]   # [G, 4]
+    gt_label = ins["GTLabel"][0].reshape(-1).astype(jnp.int32)
+    difficult = (
+        ins["GTDifficult"][0].reshape(-1).astype(bool)
+        if ins.get("GTDifficult")
+        else jnp.zeros((gt_box.shape[0],), bool)
+    )
+    det_name = ctx.op.inputs["Detection"][0]
+    offsets = ctx.env[lod_key(ctx.op.inputs["GTBox"][0])]
+    if attrs.get("pad_stride"):
+        K = int(attrs["pad_stride"])  # direct/test feeds
+    elif det_name + "@PAD_STRIDE" in ctx.env:
+        K = int(ctx.env[det_name + "@PAD_STRIDE"])
+    else:
+        raise ValueError(
+            "detection_map input %r has no @PAD_STRIDE side-band: feed "
+            "it the multiclass_nms/detection_output buffer directly, or "
+            "set the pad_stride attr explicitly" % det_name
+        )
+    from .kernels_sequence import seg_ids
+
+    M = det.shape[0]
+    G = gt_box.shape[0]
+    C = int(attrs.get("num_classes", 0))
+    if not C:
+        raise ValueError("detection_map needs a num_classes attr")
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    bg = int(attrs.get("background_id", -1))
+
+    det_img = jnp.arange(M) // K               # [M]
+    gt_img = seg_ids(offsets, G)               # [G]
+    valid = det[:, 0] >= 0
+
+    lt = jnp.maximum(det[:, None, 2:4], gt_box[None, :, :2])
+    rb = jnp.minimum(det[:, None, 4:6], gt_box[None, :, 2:])
+    inter = jnp.maximum(rb - lt, 0.0)
+    inter = inter[..., 0] * inter[..., 1]      # [M, G]
+    area_d = jnp.maximum(det[:, 4] - det[:, 2], 0.0) * jnp.maximum(
+        det[:, 5] - det[:, 3], 0.0
+    )
+    area_g = jnp.maximum(gt_box[:, 2] - gt_box[:, 0], 0.0) * jnp.maximum(
+        gt_box[:, 3] - gt_box[:, 1], 0.0
+    )
+    iou = inter / jnp.maximum(area_d[:, None] + area_g[None, :] - inter,
+                              1e-12)
+    same_img = det_img[:, None] == gt_img[None, :]
+
+    classes = jnp.arange(C)                    # [C]
+    gt_of = gt_label[None, :] == classes[:, None]          # [C, G]
+    is_c = valid[None, :] & (
+        det[None, :, 0].astype(jnp.int32) == classes[:, None]
+    )                                                       # [C, M]
+    n_gt = jnp.sum(gt_of & ~difficult[None, :], axis=1)     # [C]
+    scores = jnp.where(is_c, det[None, :, 1], -jnp.inf)     # [C, M]
+    order = jnp.argsort(-scores, axis=1)                    # [C, M]
+    cand = jnp.where(
+        same_img[None, :, :] & gt_of[:, None, :], iou[None, :, :], 0.0
+    )                                                       # [C, M, G]
+
+    def body(r, state):
+        matched, tp, fp = state  # [C, G], [C, M], [C, M]
+        j = order[:, r]                                      # [C]
+        live = jnp.isfinite(scores[jnp.arange(C), j])        # [C]
+        row = cand[jnp.arange(C), j]                         # [C, G]
+        best = jnp.argmax(row, axis=1)                       # [C] best OVERLAP
+        best_iou = row[jnp.arange(C), best]
+        overlap = best_iou > thresh
+        fresh = ~matched[jnp.arange(C), best]
+        hard = difficult[best]                               # [C]
+        is_tp = live & overlap & fresh & ~hard
+        # difficult matches: neither TP nor FP (VOC); claimed-gt or
+        # low-overlap detections are FPs
+        is_fp = live & ~(overlap & hard) & ~is_tp
+        matched = matched.at[jnp.arange(C), best].set(
+            matched[jnp.arange(C), best] | (is_tp & overlap)
+        )
+        tp = tp.at[:, r].set(is_tp.astype(jnp.float32))
+        fp = fp.at[:, r].set(is_fp.astype(jnp.float32))
+        return matched, tp, fp
+
+    matched0 = jnp.zeros((C, G), bool)
+    _, tp, fp = jax.lax.fori_loop(
+        0, M, body, (matched0, jnp.zeros((C, M)), jnp.zeros((C, M))),
+    )
+    ctp = jnp.cumsum(tp, axis=1)
+    cfp = jnp.cumsum(fp, axis=1)
+    precision = ctp / jnp.maximum(ctp + cfp, 1e-12)
+    recall_step = tp / jnp.maximum(
+        n_gt[:, None].astype(jnp.float32), 1.0
+    )
+    aps = jnp.sum(precision * recall_step, axis=1)           # [C]
+    has_gt = (n_gt > 0) & (classes != bg)
+    mAP = jnp.sum(aps * has_gt) / jnp.maximum(
+        jnp.sum(has_gt.astype(jnp.float32)), 1.0
+    )
+    return {"MAP": mAP.reshape((1,))}
+
+
+@register_op("pnpair_eval")
+def _pnpair_eval(ctx, ins, attrs):
+    """Positive-negative pair ratio (reference gserver
+    PnpairEvaluator): over all within-query pairs with different labels,
+    the fraction ranked correctly by score (ties count half)."""
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1).astype(jnp.float32)
+    query = ins["QueryID"][0].reshape(-1).astype(jnp.int32)
+    w = (
+        ins["Weight"][0].reshape(-1).astype(jnp.float32)
+        if ins.get("Weight")
+        else jnp.ones_like(score)
+    )
+    same_q = query[:, None] == query[None, :]
+    pos_pair = same_q & (label[:, None] > label[None, :])
+    pair_w = w[:, None] * w[None, :]
+    correct = (score[:, None] > score[None, :]).astype(jnp.float32)
+    tie = (score[:, None] == score[None, :]).astype(jnp.float32)
+    num = jnp.sum(jnp.where(pos_pair, (correct + 0.5 * tie) * pair_w, 0.0))
+    den = jnp.maximum(jnp.sum(jnp.where(pos_pair, pair_w, 0.0)), 1e-12)
+    return {"Out": (num / den).reshape((1,))}

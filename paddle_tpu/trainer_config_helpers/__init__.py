@@ -1485,3 +1485,18 @@ from .networks import (  # noqa: E402,F401
 from .networks import inputs as inputs  # noqa: E402,F401
 
 __all__ += [n for n in networks.__all__ if n != "outputs"]
+
+
+# evaluator wrappers (reference trainer_config_helpers/evaluators.py)
+from . import evaluators  # noqa: E402
+from .evaluators import (  # noqa: E402,F401
+    auc_evaluator, chunk_evaluator, classification_error_evaluator,
+    classification_error_printer_evaluator, column_sum_evaluator,
+    ctc_error_evaluator, detection_map_evaluator, evaluator_base,
+    gradient_printer_evaluator, maxframe_printer_evaluator,
+    maxid_printer_evaluator, pnpair_evaluator,
+    precision_recall_evaluator, seqtext_printer_evaluator,
+    sum_evaluator, value_printer_evaluator,
+)
+
+__all__ += list(evaluators.__all__)

@@ -1336,3 +1336,128 @@ _BREADTH_EMITTERS.update({
     "ce_selfnorm": _emit_ce_selfnorm,
     "ce_over_beam": _emit_ce_over_beam,
 })
+
+
+# ---------------------------------------------------------------------
+# evaluator emitters (reference trainer_config_helpers/evaluators.py;
+# DSL wrappers in trainer_config_helpers/evaluators.py here)
+# ---------------------------------------------------------------------
+
+
+def _emit_precision_recall_eval(t, node):
+    L = _L()
+    pred, label = t._ins(node)
+    n_cls = t._width(pred, node.parents[0])
+    _, idx = L.topk(pred, k=1)
+    # batch metrics = [macro-P, macro-R, macro-F1, micro-P, micro-R,
+    # micro-F1]; per-class counts ride the states tensor [C, (tp,fp,tn,fn)]
+    batch_m, _, states = fluid.layers.precision_recall(
+        input=L.cast(idx, "int64"), label=L.cast(label, "int64"),
+        class_number=n_cls,
+    )
+    pos = node.attrs.get("positive_label")
+    if pos is None:
+        return L.slice(batch_m, axes=[0], starts=[2], ends=[3])
+    row = L.slice(states, axes=[0], starts=[int(pos)],
+                  ends=[int(pos) + 1])
+    tp = L.slice(row, axes=[1], starts=[0], ends=[1])
+    fp = L.slice(row, axes=[1], starts=[1], ends=[2])
+    fn = L.slice(row, axes=[1], starts=[3], ends=[4])
+    denom = L.sums(input=[L.scale(x=tp, scale=2.0), fp, fn])
+    eps = L.fill_constant(shape=[1], dtype="float32", value=1e-12)
+    return L.elementwise_div(
+        x=L.scale(x=tp, scale=2.0),
+        y=L.elementwise_max(x=L.reshape(x=denom, shape=[1]), y=eps),
+    )
+
+
+def _emit_pnpair_eval(t, node):
+    helper = fluid.layer_helper.LayerHelper("pnpair_eval")
+    vars_ = [t._var(p.name) for p in node.parents]
+    inputs = {"Score": [vars_[0]], "Label": [vars_[1]],
+              "QueryID": [vars_[2]]}
+    if len(vars_) > 3:
+        inputs["Weight"] = [vars_[3]]
+    out = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="pnpair_eval", inputs=inputs, outputs={"Out": [out]},
+    )
+    return out
+
+
+def _emit_ctc_error_eval(t, node):
+    L = _L()
+    pred, label = t._ins(node)
+    n_cls = t._width(pred, node.parents[0])
+    decoded = L.ctc_greedy_decoder(pred, blank=n_cls - 1)
+    dist, _ = L.edit_distance(decoded, label, normalized=True)
+    return L.mean(x=dist)
+
+
+def _emit_chunk_eval(t, node):
+    L = _L()
+    pred, label = t._ins(node)
+    a = node.attrs
+    # prediction may be per-class scores: reduce to tag ids
+    w = t._width(pred, node.parents[0])
+    if w and w > 1:
+        _, idx = L.topk(pred, k=1)
+        pred = L.cast(idx, "int64")
+    _, _, f1, _, _, _ = fluid.layers.chunk_eval(
+        input=pred, label=label, chunk_scheme=a["chunk_scheme"],
+        num_chunk_types=a["num_chunk_types"],
+        excluded_chunk_types=a.get("excluded_chunk_types"),
+    )
+    return f1
+
+
+def _emit_detection_map_eval(t, node):
+    L = _L()
+    det = t._var(node.parents[0].name)
+    label = t._var(node.parents[1].name)
+    a = node.attrs
+    n_cls = a.get("num_classes")
+    if not n_cls:
+        n_cls = node.parents[0].attrs.get("num_classes")
+    gt_label = L.lod_reset(
+        L.cast(L.slice(label, axes=[1], starts=[0], ends=[1]), "int64"),
+        y=label,
+    )
+    gt_box = L.lod_reset(
+        L.slice(label, axes=[1], starts=[1], ends=[5]), y=label
+    )
+    inputs = {"Detection": [det], "GTBox": [gt_box],
+              "GTLabel": [gt_label]}
+    width = t._node_width(node.parents[1])
+    if width and width >= 6:  # [class, x1, y1, x2, y2, difficult]
+        inputs["GTDifficult"] = [
+            L.slice(label, axes=[1], starts=[5], ends=[6])
+        ]
+    helper = fluid.layer_helper.LayerHelper("detection_map")
+    out = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="detection_map",
+        inputs=inputs,
+        outputs={"MAP": [out]},
+        attrs={
+            "overlap_threshold": a.get("overlap_threshold", 0.5),
+            "num_classes": int(n_cls),
+            "background_id": int(a.get("background_id", 0)),
+        },
+    )
+    return out
+
+
+def _emit_maxid_printer(t, node):
+    _, idx = _L().topk(t._in(node), k=1)
+    return idx
+
+
+_BREADTH_EMITTERS.update({
+    "precision_recall_evaluator": _emit_precision_recall_eval,
+    "pnpair_evaluator": _emit_pnpair_eval,
+    "ctc_error_evaluator": _emit_ctc_error_eval,
+    "chunk_evaluator": _emit_chunk_eval,
+    "detection_map_evaluator": _emit_detection_map_eval,
+    "maxid_printer": _emit_maxid_printer,
+})

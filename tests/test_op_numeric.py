@@ -943,6 +943,10 @@ EXEMPT = {
     "logical_xor": "boolean; test_fluid_surface_round3.py",
     "select": "scalar-cond branch select backing the Switch class; "
               "first-true-wins chain oracle in test_fluid_surface_round3",
+    "detection_map": "VOC matching protocol; exact-value oracles (perfect, "
+                     "claimed-gt FP, difficult-gt) in test_detection_ops.py",
+    "pnpair_eval": "pairwise ranking ratio (non-differentiable); perfect-"
+                   "ranking oracle in test_networks_helpers.py",
     "sub_nested_seq": "needs a 2-level LoD feed (outer @LOD_SRC side-band) "
                       "beyond this harness; numpy-oracle + pooling "
                       "round-trip in test_legacy_dsl.py round-5",
