@@ -262,6 +262,8 @@ class RMSPropOptimizer(object):
 
 
 class L2Regularization(object):
+    # superseded by the BaseRegularization-based rebind further down
+    # (the shared base class is declared later in the file)
     def __init__(self, rate):
         self.rate = float(rate)
 
@@ -711,7 +713,8 @@ def pooling_layer(input, pooling_type=None, name=None, **kwargs):
     ptype = "max"
     if pooling_type is not None:
         p = pooling_type if isinstance(pooling_type, _Pooling) else pooling_type()
-        ptype = {"max": "max", "avg": "average", "sum": "sum"}[p.name]
+        ptype = {"max": "max", "avg": "average", "sum": "sum",
+                 "sqrt": "sqrt"}[p.name]
     return Layer("seq_pool", name, [input], {"pool_type": ptype})
 
 
@@ -1500,3 +1503,193 @@ from .evaluators import (  # noqa: E402,F401
 )
 
 __all__ += list(evaluators.__all__)
+
+
+# ---------------------------------------------------------------------
+# remaining optimizers / poolings / attrs / decorators (reference
+# trainer_config_helpers/{optimizers,poolings,attrs,
+# default_decorators}.py)
+# ---------------------------------------------------------------------
+
+
+class Optimizer(object):
+    """Base of the DSL optimizer classes (reference optimizers.py
+    Optimizer): subclasses implement make(lr) -> fluid optimizer."""
+
+    def make(self, lr):
+        raise NotImplementedError
+
+
+BaseSGDOptimizer = Optimizer
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, **kwargs):
+        self.beta1, self.beta2 = beta1, beta2
+
+    def make(self, lr):
+        from .. import fluid
+
+        return fluid.optimizer.Adamax(
+            learning_rate=lr, beta1=self.beta1, beta2=self.beta2
+        )
+
+
+class AdaDeltaOptimizer(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        self.rho, self.epsilon = rho, epsilon
+
+    def make(self, lr):
+        from .. import fluid
+
+        return fluid.optimizer.Adadelta(
+            learning_rate=lr, rho=self.rho, epsilon=self.epsilon
+        )
+
+
+class DecayedAdaGradOptimizer(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        self.rho, self.epsilon = rho, epsilon
+
+    def make(self, lr):
+        from .. import fluid
+
+        return fluid.optimizer.DecayedAdagrad(
+            learning_rate=lr, decay=self.rho, epsilon=self.epsilon
+        )
+
+
+class BaseRegularization(object):
+    """Base of the DSL regularization markers (reference optimizers.py
+    BaseRegularization); L1/L2Regularization carry a `rate`."""
+
+    def __init__(self, rate=0.0):
+        self.rate = float(rate)
+
+
+class L1Regularization(BaseRegularization):
+    pass
+
+
+class L2Regularization(BaseRegularization):  # noqa: F811
+    """Rebinds the early definition under the shared base so
+    isinstance(x, BaseRegularization) covers both L1 and L2."""
+
+
+class ModelAverage(object):
+    """Parameter averaging window (reference optimizers.py ModelAverage
+    / trainer sgd average_window). Recorded-only in this core (same
+    stance as HookAttr): evaluation runs on the live weights — averaged
+    evaluation weights are not maintained."""
+
+    def __init__(self, average_window, max_average_window=None, **kwargs):
+        self.average_window = float(average_window)
+        self.max_average_window = max_average_window
+
+
+BasePoolingType = _Pooling
+
+
+class SquareRootNPooling(_Pooling):
+    name = "sqrt"
+
+
+class MaxWithMaskPooling(_Pooling):
+    name = "max"
+
+
+# cudnn pooling variants are device hints in the reference; identical
+# math here (XLA picks the implementation)
+CudnnMaxPooling = MaxPooling
+CudnnAvgPooling = AvgPooling
+CudnnAvgInclPadPooling = AvgPooling
+
+ParameterAttribute = ParamAttr
+
+
+class HookAttr(object):
+    """Parameter update hook marker (reference attrs.py HookAttribute:
+    pruning masks etc). Recorded; pruning-style hooks are not executed
+    by the TPU core (documented stance — static masks belong in the
+    program, not a post-update hook)."""
+
+    def __init__(self, type=None, sparsity_ratio=None, **kwargs):
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+
+
+HookAttribute = HookAttr
+
+
+# --- default_decorators (reference default_decorators.py): utility
+# decorators some external configs import directly -------------------
+
+
+def wrap_name_default(prefix=None, name_prefix=None):
+    """Fill a None `name` kwarg with an auto-generated unique name.
+    Names draw from Layer's own per-kind counter namespace so they can
+    never collide with auto-named layers (v2/layer.py Layer.__init__)."""
+    p = prefix or name_prefix or "layer"
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if kwargs.get("name") is None:
+                i = Layer._counters.get(p, 0)
+                Layer._counters[p] = i + 1
+                kwargs["name"] = "__%s_%d__" % (p, i)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def _wrap_default(key, builtin_factory):
+    """Reference default_decorators.wrap_param_default shape: optional
+    `param_names` list (defaults to [key]) and `default_factory`
+    (called with the decorated function) override the built-in."""
+
+    def outer(param_names=None, default_factory=None, **_ignored):
+        names = list(param_names) if isinstance(
+            param_names, (list, tuple)
+        ) else [key]
+        fn = param_names if callable(param_names) else None
+
+        def deco(f):
+            import functools
+
+            @functools.wraps(f)
+            def wrapper(*args, **kwargs):
+                for n in names:
+                    if kwargs.get(n) is None:
+                        kwargs[n] = (
+                            default_factory(f) if default_factory
+                            else builtin_factory()
+                        )
+                return f(*args, **kwargs)
+
+            return wrapper
+
+        return deco(fn) if fn is not None else deco
+
+    return outer
+
+
+wrap_param_attr_default = _wrap_default("param_attr", lambda: ParamAttr())
+wrap_bias_attr_default = _wrap_default("bias_attr", lambda: None)
+wrap_act_default = _wrap_default("act", lambda: TanhActivation())
+wrap_param_default = _wrap_default("param_attr", lambda: ParamAttr())
+
+__all__ += [
+    "Optimizer", "BaseSGDOptimizer", "AdamaxOptimizer",
+    "AdaDeltaOptimizer", "DecayedAdaGradOptimizer",
+    "BaseRegularization", "L1Regularization", "ModelAverage",
+    "BasePoolingType", "SquareRootNPooling", "MaxWithMaskPooling",
+    "CudnnMaxPooling", "CudnnAvgPooling", "CudnnAvgInclPadPooling",
+    "ParameterAttribute", "HookAttr", "HookAttribute",
+    "wrap_name_default", "wrap_param_attr_default",
+    "wrap_bias_attr_default", "wrap_act_default", "wrap_param_default",
+]
