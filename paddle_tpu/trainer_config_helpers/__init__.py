@@ -261,11 +261,7 @@ class RMSPropOptimizer(object):
         )
 
 
-class L2Regularization(object):
-    # superseded by the BaseRegularization-based rebind further down
-    # (the shared base class is declared later in the file)
-    def __init__(self, rate):
-        self.rate = float(rate)
+# L2Regularization is defined further down, under BaseRegularization
 
 
 # ---------------------------------------------------------------------

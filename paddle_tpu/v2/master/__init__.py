@@ -14,11 +14,25 @@ __all__ = ["client"]
 
 
 class client(object):
-    """API-shaped like the reference: set_dataset(paths), next_record()."""
+    """API-shaped like the reference: set_dataset(paths), next_record().
+
+    `etcd_endpoints` of the form "host:port" connects to a Coordinator
+    service (distributed/coordinator.py RemoteCoordinator) so multiple
+    workers share one task queue; anything else gets a private
+    in-process Coordinator (single-worker / tests)."""
 
     def __init__(self, etcd_endpoints=None, timeout_sec=60, buf_size=32):
-        self._coordinator = Coordinator(timeout_s=timeout_sec)
+        addr = etcd_endpoints if isinstance(etcd_endpoints, str) else None
+        if addr and ":" in addr.rsplit("/", 1)[-1]:
+            from ...distributed.coordinator import RemoteCoordinator
+
+            self._coordinator = RemoteCoordinator(
+                addr.rsplit("/", 1)[-1], timeout_s=timeout_sec
+            )
+        else:
+            self._coordinator = Coordinator(timeout_s=timeout_sec)
         self._iter = None
+        self._pass = 0
 
     def set_dataset(self, paths: List[str]):
         self._coordinator.set_dataset(list(paths))
@@ -27,7 +41,7 @@ class client(object):
         from ..reader import creator
 
         while True:
-            task = self._coordinator.get_task()
+            task = self._coordinator.get_task(epoch_limit=self._pass)
             if task is None:
                 return
             try:
@@ -39,14 +53,18 @@ class client(object):
             self._coordinator.task_finished(task.task_id)
 
     def next_record(self) -> Optional[bytes]:
-        """One raw record, None at pass end (reference returns (r, err))."""
+        """One raw record, None at pass end (reference returns (r, err));
+        the next call after a pass end starts the NEXT pass (epoch
+        rollover in the coordinator's queue)."""
         if self._iter is None:
             self._iter = self._records()
         try:
             return next(self._iter)
         except StopIteration:
             self._iter = None
+            self._pass += 1
             return None
 
     def paddle_start_get_records(self, pass_id):
+        self._pass = int(pass_id)
         self._iter = self._records()

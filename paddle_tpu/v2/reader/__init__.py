@@ -195,3 +195,60 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 yield sample
 
     return xreader
+
+
+class PipeReader(object):
+    """Stream records from a shell command's stdout (reference
+    reader/decorator.py PipeReader): `get_line` yields lines (or
+    fixed-size chunks when line splitting is off) — the HDFS/S3/curl
+    ingestion hook."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("PipeReader needs a command string")
+        self.command = command
+        self.bufsize = int(bufsize)
+        if file_type not in ("plain", "gzip"):
+            raise TypeError("file_type must be 'plain' or 'gzip'")
+        self.file_type = file_type
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import subprocess
+        import zlib
+
+        proc = subprocess.Popen(
+            self.command, shell=True, bufsize=self.bufsize,
+            stdout=subprocess.PIPE,
+        )
+        dec = zlib.decompressobj(32 + zlib.MAX_WBITS) \
+            if self.file_type == "gzip" else None
+        remained = b""
+        try:
+            while True:
+                buff = proc.stdout.read(self.bufsize)
+                if not buff:
+                    break
+                if dec is not None:
+                    buff = dec.decompress(buff)
+                if not cut_lines:
+                    if buff:
+                        yield buff
+                    continue
+                remained += buff
+                parts = remained.split(line_break.encode())
+                remained = parts.pop()
+                for line in parts:
+                    yield line.decode(errors="replace")
+            if cut_lines and remained:
+                yield remained.decode(errors="replace")
+        finally:
+            proc.stdout.close()
+            rc = proc.wait()
+        if rc != 0:
+            raise RuntimeError(
+                "PipeReader command %r exited with status %d"
+                % (self.command, rc)
+            )
+
+
+__all__.append("PipeReader")

@@ -49,3 +49,34 @@ def pickled_records(paths, buf_size=100):
             yield pickle.loads(raw)
 
     return reader
+
+
+def cloud_reader(paths, etcd_endpoints=None, timeout_sec=5, buf_size=64):
+    """Records dispatched through the master/coordinator task queue
+    (reference creator.py cloud_reader over the Go master + etcd; the
+    Coordinator service provides the same lease/retry semantics).
+    `etcd_endpoints` may be a coordinator "host:port" (shared queue
+    across workers) or None for an in-process coordinator. Records are
+    pickled python objects, as written by v2.dataset.common.convert —
+    exactly the reference's cPickle.loads contract. Each call of the
+    returned reader consumes one pass (coordinator epoch)."""
+    import pickle
+
+    from ..master import client as master_client
+
+    if isinstance(paths, str):
+        paths = [paths]
+    c = master_client(etcd_endpoints, timeout_sec, buf_size)
+    c.set_dataset(list(paths))
+
+    def reader():
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            yield pickle.loads(r)
+
+    return reader
+
+
+__all__.append("cloud_reader")
