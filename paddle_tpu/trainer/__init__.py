@@ -119,6 +119,39 @@ def _simple_data_provider(data_nodes, n_samples=256, seed=0):
     return reader, slots
 
 
+def _recordio_provider(paths, data_nodes):
+    """Instances from recordio files through the native C++ prefetch
+    queue (reference: the Go master dispatches RecordIO chunks;
+    trainer-side records are pickled sample tuples as written by
+    v2.dataset.common.convert). Slot order = data-layer declaration
+    order, like every legacy provider."""
+    import glob as _glob
+
+    from ..v2.reader import creator
+
+    if isinstance(paths, str):
+        paths = paths.split(",")
+    files = []
+    for p in paths:
+        hits = sorted(_glob.glob(p))
+        if hits:
+            files.extend(hits)
+        elif os.path.exists(p):
+            files.append(p)
+    if not files:
+        raise ValueError("recordio provider: no files match %r" % (paths,))
+
+    slots = []
+    for node in data_nodes:
+        t = node.attrs["type"]
+        slots.append(_SimpleSlot(t.type, t.seq_type))
+
+    # non-tuple samples (single-data-layer configs) pass through
+    # unchanged; _batches wraps them — same contract as every reader
+    reader = creator.pickled_records(files, buf_size=256)
+    return reader, slots
+
+
 def _batches(reader, slots, data_nodes, batch_size):
     """Group provider instances into feed dicts (py_paddle
     DataProviderConverter's role). Provider slot order == data-layer
@@ -186,7 +219,8 @@ def check_gradients(topo, cost_var, scope, exe, feed, eps=1e-3,
 
 
 def run_config(config_path, job="train", config_args=None, trainer_count=1,
-               num_passes=1, log_period=10, use_gpu=None, save_dir=None):
+               num_passes=1, log_period=10, use_gpu=None, save_dir=None,
+               recordio=None):
     """Programmatic entry (also used by tests). Returns summary dict."""
     state = _exec_config(config_path, config_args or {})
     if not state["outputs"] and state.get("output_names"):
@@ -231,7 +265,11 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
     with fluid.executor.scope_guard(scope):
         exe.run(topo.startup_program)
 
-    if state.get("data_sources") is not None:
+    if recordio:
+        provider_reader, slots = _recordio_provider(
+            recordio, topo._data_layers
+        )
+    elif state.get("data_sources") is not None:
         provider_reader = _load_provider(
             state["data_sources"], os.path.dirname(os.path.abspath(config_path))
         )
@@ -307,6 +345,10 @@ def main(argv=None):
     p.add_argument("--test_period", type=int, default=0)
     p.add_argument("--use_gpu", default=None)
     p.add_argument("--save_dir", default=None)
+    p.add_argument("--recordio", default=None,
+                   help="comma-separated recordio files/globs of pickled "
+                        "sample tuples; feeds training through the native "
+                        "prefetch queue")
     args = p.parse_args(argv)
     run_config(
         args.config,
@@ -317,4 +359,5 @@ def main(argv=None):
         log_period=args.log_period,
         use_gpu=args.use_gpu,
         save_dir=args.save_dir,
+        recordio=args.recordio.split(",") if args.recordio else None,
     )
