@@ -131,15 +131,19 @@ def _recordio_provider(paths, data_nodes):
 
     if isinstance(paths, str):
         paths = paths.split(",")
-    files = []
+    files, missing = [], []
     for p in paths:
         hits = sorted(_glob.glob(p))
         if hits:
             files.extend(hits)
         elif os.path.exists(p):
             files.append(p)
-    if not files:
-        raise ValueError("recordio provider: no files match %r" % (paths,))
+        else:
+            missing.append(p)
+    if missing:
+        raise ValueError(
+            "recordio provider: no files match %r" % (missing,)
+        )
 
     slots = []
     for node in data_nodes:
@@ -218,13 +222,11 @@ def check_gradients(topo, cost_var, scope, exe, feed, eps=1e-3,
     return results
 
 
-def run_config(config_path, job="train", config_args=None, trainer_count=1,
-               num_passes=1, log_period=10, use_gpu=None, save_dir=None,
-               recordio=None):
-    """Programmatic entry (also used by tests). Returns summary dict."""
-    state = _exec_config(config_path, config_args or {})
+def resolve_config_outputs(state):
+    """Resolve a config's output layers in place: legacy
+    Outputs("name") forms map to nodes with clear errors (shared by
+    run_config and utils/dump_config)."""
     if not state["outputs"] and state.get("output_names"):
-        # legacy Outputs("layer_name") form: resolve names to nodes
         registry = state.get("layers_by_name") or {}
         missing = [n for n in state["output_names"] if n not in registry]
         if missing:
@@ -235,6 +237,15 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
         state["outputs"] = [registry[n] for n in state["output_names"]]
     if not state["outputs"]:
         raise ValueError("config did not call outputs(...)")
+    return state["outputs"]
+
+
+def run_config(config_path, job="train", config_args=None, trainer_count=1,
+               num_passes=1, log_period=10, use_gpu=None, save_dir=None,
+               recordio=None):
+    """Programmatic entry (also used by tests). Returns summary dict."""
+    state = _exec_config(config_path, config_args or {})
+    resolve_config_outputs(state)
     settings = state["settings"]
     topo = Topology(state["outputs"])
     cost_var = topo.var_of[state["outputs"][0].name]

@@ -1,0 +1,60 @@
+"""Merge a v2 net config + trained parameters into a deployable
+inference bundle (reference python/paddle/utils/merge_model.py
+merge_v2_model, which packed ModelConfig proto + tar'd params for the
+capi runner).
+
+Here the bundle is the JSON program + npy parameters directory that
+both `fluid.io.load_inference_model` and the dependency-free C++
+runner (`native/inference.cc`) consume.
+
+Usage:
+    from paddle_tpu.utils.merge_model import merge_v2_model
+    net = softmax_output_layer(...)          # a v2/DSL layer node
+    merge_v2_model(net, "trained.tar", "./deploy_model")
+"""
+
+from __future__ import annotations
+
+__all__ = ["merge_v2_model"]
+
+
+def merge_v2_model(net, param_file, output_dir):
+    """net: the network's output layer node; param_file: a Parameters
+    tar (v2 wire format) path or file object; output_dir: bundle
+    directory (created)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.v2.parameters import Parameters
+    from paddle_tpu.v2.topology import Topology
+
+    topo = Topology([net])
+    if hasattr(param_file, "read"):
+        loaded = Parameters.from_tar(param_file)
+    else:
+        with open(param_file, "rb") as f:
+            loaded = Parameters.from_tar(f)
+
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(topo.startup_program)
+        net_params = {
+            v.name
+            for v in topo.main_program.global_block().all_parameters()
+        }
+        tar_names = set(loaded.names())
+        missing = sorted(net_params - tar_names)
+        if missing:
+            raise ValueError(
+                "parameter tar does not cover the net: missing %r "
+                "(tar has %r) — a bundle with random weights would be "
+                "silently wrong" % (missing, sorted(tar_names))
+            )
+        for name in tar_names & net_params:
+            scope.set(name, loaded.get(name))
+        out_var = topo.var_of[net.name]
+        feed_names = [n.name for n in topo._data_layers]
+        fluid.io.save_inference_model(
+            output_dir, feed_names, [out_var], exe,
+            main_program=topo.main_program,
+        )
+    return output_dir

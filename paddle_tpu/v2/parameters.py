@@ -88,6 +88,20 @@ def _decode_parameter_config(data: bytes):
     return name, size, dims
 
 
+def write_tar_param(tar, name, arr):
+    """One parameter into an open tar in the v2 wire layout (the single
+    writer — Parameters.to_tar and utils/torch2paddle both call this)."""
+    flat = np.ascontiguousarray(arr, dtype="<f4")
+    data = struct.pack("IIQ", 0, 4, flat.size) + flat.tobytes()
+    info = tarfile.TarInfo(name=name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+    conf = _encode_parameter_config(name, np.asarray(arr).shape)
+    info = tarfile.TarInfo(name="%s.protobuf" % name)
+    info.size = len(conf)
+    tar.addfile(info, io.BytesIO(conf))
+
+
 class Parameters(object):
     def __init__(self, topology: Topology):
         self.topology = topology
@@ -156,17 +170,7 @@ class Parameters(object):
         tars for the name/size/dims fields this framework uses."""
         with tarfile.open(fileobj=f, mode="w") as tar:
             for name in self._param_names:
-                arr = self[name]
-                buf = io.BytesIO()
-                self.serialize(name, buf)
-                data = buf.getvalue()
-                info = tarfile.TarInfo(name=name)
-                info.size = len(data)
-                tar.addfile(info, io.BytesIO(data))
-                conf = _encode_parameter_config(name, arr.shape)
-                info = tarfile.TarInfo(name="%s.protobuf" % name)
-                info.size = len(conf)
-                tar.addfile(info, io.BytesIO(conf))
+                write_tar_param(tar, name, self[name])
 
     @staticmethod
     def from_tar(f):

@@ -170,3 +170,64 @@ def test_profiler_per_op_table():
     )
     w = np.asarray(fluid.global_scope().get(conv_w))
     assert np.isfinite(w).all()
+
+
+def test_utils_tool_scripts(tmp_path):
+    """paddle.utils tool parity (reference python/paddle/utils/):
+    dump_config prints the lowered program; torch2paddle converts a
+    torch state_dict into the v2 Parameters tar; merge_v2_model builds
+    an inference bundle that load_inference_model round-trips."""
+    import numpy as np
+    import torch
+
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.trainer_config_helpers as tch
+    from paddle_tpu.utils.dump_config import dump_config
+    from paddle_tpu.utils.merge_model import merge_v2_model
+    from paddle_tpu.utils.torch2paddle import torch2paddle
+    from paddle_tpu.v2.parameters import Parameters
+    from paddle_tpu.v2.topology import Topology
+
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(
+        "settings(batch_size=4)\n"
+        "x = data_layer(name='x', size=3)\n"
+        "p = fc_layer(input=x, size=2, act=SoftmaxActivation(),\n"
+        "             name='out_fc')\n"
+        "outputs(p)\n"
+    )
+    code = dump_config(str(cfg))
+    assert "fc" in code and "softmax" in code
+
+    # torch linear -> paddle fc weights (transposed) + bias
+    torch_model = torch.nn.Linear(3, 2)
+    tar_path = str(tmp_path / "params.tar")
+    torch2paddle(
+        torch_model.state_dict(),
+        name_map={"weight": "out_fc.w0", "bias": "out_fc.wbias"},
+        output=tar_path,
+    )
+    with open(tar_path, "rb") as f:
+        loaded = Parameters.from_tar(f)
+    w = loaded.get("out_fc.w0")
+    np.testing.assert_allclose(
+        w, torch_model.weight.detach().numpy().T, rtol=1e-6)
+
+    # merge config + tar into an inference bundle; outputs must match
+    # the torch model exactly
+    tch.reset_config()
+    x = tch.data_layer(name="x", size=3)
+    net = tch.fc_layer(input=x, size=2, act=tch.SoftmaxActivation(),
+                       name="out_fc")
+    bundle = str(tmp_path / "bundle")
+    merge_v2_model(net, tar_path, bundle)
+
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        prog, feeds, fetches = fluid.io.load_inference_model(bundle, exe)
+        xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        out = exe.run(prog, feed={"x": xv}, fetch_list=fetches)[0]
+    want = torch.softmax(torch_model(torch.from_numpy(xv)), dim=1)
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)

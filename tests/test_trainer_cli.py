@@ -137,6 +137,12 @@ def test_rnn_benchmark_config_scaled_down():
 
 
 def test_cli_trains_from_recordio(tmp_path):
+    from paddle_tpu import native as _native
+
+    if not _native.available():
+        import pytest
+
+        pytest.skip("native recordio unavailable (no C++ toolchain)")
     """--recordio feeds the CLI train loop from the native prefetch
     queue with pickled sample tuples (VERDICT r2: recordio was wired
     into bench but not the trainer CLI)."""
