@@ -242,7 +242,7 @@ def resolve_config_outputs(state):
 
 def run_config(config_path, job="train", config_args=None, trainer_count=1,
                num_passes=1, log_period=10, use_gpu=None, save_dir=None,
-               recordio=None):
+               recordio=None, init_model_path=None, saving_period=1):
     """Programmatic entry (also used by tests). Returns summary dict."""
     state = _exec_config(config_path, config_args or {})
     resolve_config_outputs(state)
@@ -275,6 +275,20 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
     exe = fluid.Executor(fluid.CPUPlace(), mesh=mesh)
     with fluid.executor.scope_guard(scope):
         exe.run(topo.startup_program)
+    if init_model_path:
+        # resume/finetune (reference --init_model_path): a checkpoint
+        # directory or a v2 Parameters tar
+        if os.path.isdir(init_model_path):
+            from ..distributed import load_checkpoint
+
+            load_checkpoint(scope, init_model_path, strict=False)
+        else:
+            from ..v2.parameters import Parameters
+
+            with open(init_model_path, "rb") as f:
+                loaded = Parameters.from_tar(f)
+            for name in loaded.names():
+                scope.set(name, loaded.get(name))
 
     if recordio:
         provider_reader, slots = _recordio_provider(
@@ -328,6 +342,14 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
                         "Pass %d, Batch %d, Cost %.4f"
                         % (pass_id, stats["batches"], cost)
                     )
+            if save_dir and saving_period and \
+                    (pass_id + 1) % saving_period == 0:
+                from ..distributed import save_checkpoint
+
+                save_checkpoint(
+                    scope, os.path.join(save_dir, "pass-%05d" % pass_id),
+                    step=stats["batches"],
+                )
     if times:
         stats["ms_per_batch"] = 1000.0 * float(np.mean(times))
         stats["img_per_sec"] = batch_size / float(np.mean(times))
@@ -336,7 +358,12 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             "Time: %.2f ms/batch (%.1f samples/sec)"
             % (stats["ms_per_batch"], stats["img_per_sec"])
         )
-    if save_dir:
+    if save_dir and not (
+        saving_period and num_passes % saving_period == 0
+        and job not in ("test", "checkgrad")
+    ):
+        # root-level final save only when the last pass did NOT already
+        # land in save_dir/pass-NNNNN (avoids double checkpoint I/O)
         from ..distributed import save_checkpoint
 
         save_checkpoint(scope, save_dir, step=stats["batches"])
@@ -356,6 +383,10 @@ def main(argv=None):
     p.add_argument("--test_period", type=int, default=0)
     p.add_argument("--use_gpu", default=None)
     p.add_argument("--save_dir", default=None)
+    p.add_argument("--init_model_path", default=None,
+                   help="checkpoint dir or Parameters tar to start from")
+    p.add_argument("--saving_period", type=int, default=1,
+                   help="save into save_dir/pass-NNNNN every N passes")
     p.add_argument("--recordio", default=None,
                    help="comma-separated recordio files/globs of pickled "
                         "sample tuples; feeds training through the native "
@@ -371,4 +402,6 @@ def main(argv=None):
         use_gpu=args.use_gpu,
         save_dir=args.save_dir,
         recordio=args.recordio.split(",") if args.recordio else None,
+        init_model_path=args.init_model_path,
+        saving_period=args.saving_period,
     )

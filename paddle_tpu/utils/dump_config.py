@@ -29,12 +29,9 @@ def main(argv=None):
     if not argv:
         print(__doc__)
         return 1
-    args = {}
-    if len(argv) > 1:
-        for kv in argv[1].split(","):
-            if "=" in kv:
-                k, v = kv.split("=", 1)
-                args[k] = v
+    from paddle_tpu.trainer import _parse_config_args
+
+    args = _parse_config_args(argv[1]) if len(argv) > 1 else {}
     print(dump_config(argv[0], args))
     return 0
 

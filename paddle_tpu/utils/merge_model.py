@@ -37,19 +37,22 @@ def merge_v2_model(net, param_file, output_dir):
     with fluid.executor.scope_guard(scope):
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(topo.startup_program)
-        net_params = {
+        # every persistable (parameters AND batch-norm moving stats —
+        # Parameters.to_tar writes both) must come from the tar
+        net_persist = {
             v.name
-            for v in topo.main_program.global_block().all_parameters()
+            for v in topo.main_program.list_vars()
+            if v.persistable
         }
         tar_names = set(loaded.names())
-        missing = sorted(net_params - tar_names)
+        missing = sorted(net_persist - tar_names)
         if missing:
             raise ValueError(
                 "parameter tar does not cover the net: missing %r "
                 "(tar has %r) — a bundle with random weights would be "
                 "silently wrong" % (missing, sorted(tar_names))
             )
-        for name in tar_names & net_params:
+        for name in tar_names & net_persist:
             scope.set(name, loaded.get(name))
         out_var = topo.var_of[net.name]
         feed_names = [n.name for n in topo._data_layers]

@@ -47,12 +47,14 @@ def torch2paddle(state_dict, name_map: Dict[str, str], output,
             t = t.detach().cpu().numpy()
         return np.asarray(t, np.float32)
 
+    transpose_set = None if transpose is None else set(transpose)
     arrays = {}
     for torch_name, paddle_name in name_map.items():
         a = _np(state_dict[torch_name])
-        auto_t = transpose is None and torch_name.endswith("weight") \
+        auto_t = transpose_set is None and torch_name.endswith("weight") \
             and a.ndim == 2
-        if auto_t or (transpose is not None and torch_name in set(transpose)):
+        if auto_t or (transpose_set is not None
+                      and torch_name in transpose_set):
             a = a.T
         arrays[paddle_name] = np.ascontiguousarray(a)
 

@@ -137,15 +137,15 @@ def test_rnn_benchmark_config_scaled_down():
 
 
 def test_cli_trains_from_recordio(tmp_path):
+    """--recordio feeds the CLI train loop from the native prefetch
+    queue with pickled sample tuples (VERDICT r2: recordio was wired
+    into bench but not the trainer CLI)."""
     from paddle_tpu import native as _native
 
     if not _native.available():
         import pytest
 
         pytest.skip("native recordio unavailable (no C++ toolchain)")
-    """--recordio feeds the CLI train loop from the native prefetch
-    queue with pickled sample tuples (VERDICT r2: recordio was wired
-    into bench but not the trainer CLI)."""
     import pickle
 
     import numpy as np
@@ -175,3 +175,34 @@ def test_cli_trains_from_recordio(tmp_path):
                      recordio=[rio])
     assert out["batches"] == 8  # 64/16 x 2 passes
     assert np.isfinite(out["cost"])
+
+
+def test_cli_save_per_pass_and_resume(tmp_path):
+    """--save_dir/--saving_period write per-pass checkpoints
+    (reference per-pass save dirs) and --init_model_path resumes from
+    one: the resumed run starts at the saved run's final cost."""
+    import numpy as np
+
+    from paddle_tpu.trainer import run_config
+
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(
+        "settings(batch_size=8, learning_rate=0.3,\n"
+        "         learning_method=MomentumOptimizer())\n"
+        "x = data_layer(name='x', size=4)\n"
+        "y = data_layer(name='y', size=2)\n"
+        "p = fc_layer(input=x, size=2, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=p, label=y))\n"
+    )
+    save = str(tmp_path / "ckpt")
+    out1 = run_config(str(cfg), num_passes=2, save_dir=save)
+    import os
+    passes = sorted(d for d in os.listdir(save) if d.startswith("pass-"))
+    assert passes == ["pass-00000", "pass-00001"], passes
+
+    out2 = run_config(str(cfg), num_passes=1,
+                      init_model_path=os.path.join(save, "pass-00001"))
+    # _simple_data_provider is deterministic (seed 0) so the resumed
+    # first cost continues from (not restarts above) the trained model
+    assert out2["first_cost"] <= out1["first_cost"], (out1, out2)
+    assert np.isfinite(out2["cost"])
