@@ -39,9 +39,24 @@ def _nce(ctx, ins, attrs):
     num_true = label.shape[1] if label.ndim > 1 else 1
     label = label.reshape(N, num_true)
 
-    samples = jax.random.randint(
-        ctx.next_key(), (N, k), 0, num_total
-    )  # uniform sampler, reference's default Sampler
+    neg_dist = attrs.get("neg_distribution")
+    if neg_dist and len(neg_dist) != num_total:
+        raise ValueError(
+            "neg_distribution has %d entries but num_total_classes is %d"
+            % (len(neg_dist), num_total)
+        )
+    if neg_dist:
+        # legacy NCELayer custom distribution (MultinomialSampler): noise
+        # ids drawn ~ dist, and the NCE noise prob becomes k*q(id)
+        dist = jnp.asarray(neg_dist, jnp.float32)
+        dist = dist / jnp.sum(dist)
+        samples = jax.random.categorical(
+            ctx.next_key(), jnp.log(dist)[None, :], shape=(N, k)
+        )
+    else:
+        samples = jax.random.randint(
+            ctx.next_key(), (N, k), 0, num_total
+        )  # uniform sampler, reference's default Sampler
     all_ids = jnp.concatenate([label, samples], axis=1)  # [N, T+k]
     wj = w[all_ids]  # [N, T+k, D]
     logits = jnp.einsum("nd,nkd->nk", x, wj)
@@ -55,7 +70,13 @@ def _nce(ctx, ins, attrs):
     # log(o+b) + softplus(-s); -log(b/(o+b)) = log(o+b) - log(b).
     s = logits.astype(jnp.float32)
     o = jax.nn.sigmoid(s)
-    noise_b = jnp.float32(k / num_total)
+    if neg_dist:
+        # clamp: a zero-probability class can still appear as a TRUE
+        # label; its (masked-out) noise term must not produce log(0)=inf
+        # which 0*inf would turn into NaN
+        noise_b = jnp.maximum(k * dist[all_ids], 1e-20)  # [N, T+k]
+    else:
+        noise_b = jnp.float32(k / num_total)
     log_opb = jnp.log(o + noise_b)
     true_cost = log_opb + jax.nn.softplus(-s)
     neg_cost = log_opb - jnp.log(noise_b)

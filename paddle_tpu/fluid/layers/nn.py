@@ -1169,7 +1169,7 @@ def edit_distance(input, label, normalized=False, ignored_tokens=None,
 
 
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
-        bias_attr=None, num_neg_samples=None):
+        bias_attr=None, num_neg_samples=None, neg_distribution=None):
     """Noise-contrastive estimation loss (reference layers/nn.py:2767 ->
     operators/nce_op)."""
     helper = LayerHelper("nce", **locals())
@@ -1201,6 +1201,9 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         attrs={
             "num_total_classes": int(num_total_classes),
             "num_neg_samples": num_neg_samples,
+            "neg_distribution": (
+                list(neg_distribution) if neg_distribution else None
+            ),
         },
     )
     return cost

@@ -343,6 +343,7 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
                         % (pass_id, stats["batches"], cost)
                     )
             if save_dir and saving_period and \
+                    job not in ("test", "checkgrad") and \
                     (pass_id + 1) % saving_period == 0:
                 from ..distributed import save_checkpoint
 

@@ -308,26 +308,49 @@ def fc_layer(input, size, act=None, name=None, bias_attr=None,
     })
 
 
+def _node_flat_width(node):
+    a = getattr(node, "attrs", {})
+    if a.get("size"):
+        return int(a["size"])
+    t = a.get("type")
+    if t is not None:
+        return int(t.dim)
+    return None
+
+
+def _factor_hw(size, c):
+    """Reference config_parser geometry fallback (config_parser.py:1210):
+    width = floor(sqrt(pixels)), height = pixels / width."""
+    pixels = size // c
+    w = int(math.sqrt(pixels))
+    h = pixels // max(w, 1)
+    if h * w * c != size:
+        raise ValueError(
+            "cannot factor size %d into %d channels x H x W" % (size, c)
+        )
+    return h, w
+
+
 def _ensure_image(node, num_channels):
-    """Insert a reshape node when the input is still flat (data layers
-    are fed [N, size] even when height/width declare image geometry;
-    square images are config_parser's inference) and return
+    """Insert a reshape node when the input is still flat (data layers —
+    and any flat layer given an explicit num_channels — are [N, size];
+    geometry follows config_parser's inference) and return
     (input_node, (c, h, w))."""
     shape = getattr(node, "im_shape", None)
     if shape is not None and node.kind != "data":
         return node, shape
-    if node.kind == "data":
+    size = _node_flat_width(node)
+    if node.kind == "data" or (num_channels and size):
         if shape is None:
-            size = node.attrs["type"].dim
             c = num_channels or 3
-            hw = int(round(math.sqrt(size // c)))
-            shape = (c, hw, hw)
+            h, w = _factor_hw(size, c)
+            shape = (c, h, w)
         r = Layer("im_reshape", None, [node], {"shape": list(shape)})
         r.im_shape = shape
         return r, shape
     raise ValueError(
         "img layer input %r has no image shape; give num_channels on the "
-        "first conv or height/width on the data layer" % node.name
+        "first conv/pool or height/width on the data layer" % node.name
     )
 
 
@@ -354,20 +377,32 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
 
 
 def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
-                   name=None, **kwargs):
-    inp = _as_list(input)[0]
-    c, h, w = inp.im_shape
+                   name=None, pool_size_y=None, stride_y=None,
+                   padding_y=None, num_channels=None, ceil_mode=True,
+                   **kwargs):
+    """Image pooling, rectangular windows supported via the *_y params
+    (reference img_pool_layer / config_parser parse_pool; legacy default
+    is ceil_mode=True)."""
+    inp, (c, h, w) = _ensure_image(_as_list(input)[0], num_channels)
     ptype = "max"
     if pool_type is not None:
         p = pool_type if isinstance(pool_type, _Pooling) else pool_type()
         ptype = "avg" if p.name in ("avg", "sum") else "max"
+    ph = pool_size_y if pool_size_y is not None else pool_size
+    sh = stride_y if stride_y is not None else stride
+    dh = padding_y if padding_y is not None else padding
     node = Layer("img_pool", name, [inp], {
-        "pool_size": pool_size, "stride": stride, "padding": padding,
-        "pool_type": ptype,
+        "pool_size": [ph, pool_size], "stride": [sh, stride],
+        "padding": [dh, padding], "pool_type": ptype,
+        "ceil_mode": bool(ceil_mode),
     })
+
+    def _po(d, ps, st, pd):
+        span = d + 2 * pd - ps
+        return (-(-span // st) if ceil_mode else span // st) + 1
+
     node.im_shape = (
-        c, _conv_out(h, pool_size, stride, padding),
-        _conv_out(w, pool_size, stride, padding),
+        c, _po(h, ph, sh, dh), _po(w, pool_size, stride, padding),
     )
     return node
 
@@ -462,8 +497,12 @@ def _label_node(label):
     return label
 
 
-def classification_cost(input, label, name=None, **kwargs):
-    return Layer("classification_cost", name, [input, _label_node(label)], {})
+def classification_cost(input, label, name=None, weight=None, **kwargs):
+    parents = [input, _label_node(label)]
+    if weight is not None:
+        parents.append(weight)
+    return Layer("classification_cost", name, parents,
+                 {"weighted": weight is not None})
 
 
 def cross_entropy(input, label, name=None, **kwargs):
@@ -918,11 +957,18 @@ def crf_decoding_layer(input, size=None, param_attr=None, label=None,
 
 
 def nce_layer(input, label, num_classes, num_neg_samples=10, name=None,
-              **kwargs):
-    return _simple("nce_cost", _as_list(input) + [_label_node(label)],
+              weight=None, neg_distribution=None, **kwargs):
+    parents = _as_list(input) + [_label_node(label)]
+    if weight is not None:
+        parents.append(weight)
+    return _simple("nce_cost", parents,
                    name=name,
                    num_classes=int(num_classes),
-                   num_neg_samples=int(num_neg_samples))
+                   num_neg_samples=int(num_neg_samples),
+                   weighted=weight is not None,
+                   neg_distribution=(
+                       list(neg_distribution) if neg_distribution else None
+                   ))
 
 
 def hsigmoid(input, label, num_classes, name=None, **kwargs):

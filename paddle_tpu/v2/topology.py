@@ -134,6 +134,7 @@ class Topology(object):
                 input=self._in(node), pool_size=a["pool_size"],
                 pool_stride=a["stride"], pool_padding=a["padding"],
                 pool_type=a["pool_type"],
+                ceil_mode=a.get("ceil_mode", False),
             )
         if node.kind == "batch_norm":
             return L.batch_norm(input=self._in(node), act=a["act"])
@@ -167,10 +168,19 @@ class Topology(object):
             _, idx = L.topk(self._in(node), k=1)
             return idx
         if node.kind == "classification_cost":
-            pred, label = self._ins(node)
+            ins = self._ins(node)
+            pred, label = ins[0], ins[1]
             # reference classification_cost = softmax output + CE cost; the
             # DSL's `input` already went through act=Softmax
             cost = L.cross_entropy(input=pred, label=label)
+            if a.get("weighted") and len(ins) > 2:
+                wgt = ins[2]
+                num = L.reduce_sum(L.elementwise_mul(x=cost, y=wgt))
+                den = L.reduce_sum(wgt)
+                return L.elementwise_div(
+                    x=L.reshape(x=num, shape=[1]),
+                    y=L.reshape(x=den, shape=[1]),
+                )
             return L.mean(x=cost)
         if node.kind == "cross_entropy_cost":
             pred, label = self._ins(node)
@@ -742,11 +752,29 @@ def _emit_crf_decode(t, node):
 
 
 def _emit_nce_cost(t, node):
+    L = _L()
     ins = t._ins(node)
-    cost = _L().nce(input=ins[0], label=ins[-1],
-                    num_total_classes=node.attrs["num_classes"],
-                    num_neg_samples=node.attrs["num_neg_samples"])
-    return _L().mean(x=cost)
+    weighted = node.attrs.get("weighted")
+    sample_weight = ins[-1] if weighted else None
+    label = ins[-2] if weighted else ins[-1]
+    feats = ins[:-2] if weighted else ins[:-1]
+    # multi-input NCE: separate per-input weight matrices in the
+    # reference sum into one concatenated feature (same math)
+    x = feats[0] if len(feats) == 1 else L.concat(input=feats, axis=1)
+    cost = L.nce(input=x, label=label,
+                 num_total_classes=node.attrs["num_classes"],
+                 num_neg_samples=node.attrs["num_neg_samples"],
+                 sample_weight=sample_weight,
+                 neg_distribution=node.attrs.get("neg_distribution"))
+    if weighted:
+        # same convention as weighted classification_cost:
+        # sum(w * cost_i) / sum(w) — the kernel already applied w
+        den = L.reduce_sum(sample_weight)
+        return L.elementwise_div(
+            x=L.reshape(x=L.reduce_sum(cost), shape=[1]),
+            y=L.reshape(x=den, shape=[1]),
+        )
+    return L.mean(x=cost)
 
 
 def _emit_hsigmoid_cost(t, node):

@@ -980,3 +980,25 @@ def test_breadth_wrappers_round5_mixed_conv():
     wo = f_np.reshape(3, 2)
     want_o = np.einsum("oi,bihw->bohw", wo, x4).reshape(2, -1)
     np.testing.assert_allclose(out_o, want_o, rtol=1e-4)
+
+
+def test_reference_test_config_and_hsigmoid_conf_run():
+    """Two more reference .conf files execute verbatim through the CLI
+    (trainer/tests/test_config.conf: weighted classification cost, NCE
+    with neg_distribution + weights, rectangular CudnnAvgPooling over a
+    1x3x4 fc output, mixed_layer weight sharing;
+    sample_trainer_config_hsigmoid.conf: 4-input hsigmoid)."""
+    from paddle_tpu.trainer import run_config
+
+    out = run_config(
+        "/root/reference/paddle/trainer/tests/test_config.conf",
+        job="train", num_passes=1,
+    )
+    assert out["batches"] > 0 and np.isfinite(out["cost"])
+
+    out2 = run_config(
+        "/root/reference/paddle/trainer/tests/"
+        "sample_trainer_config_hsigmoid.conf",
+        job="train", num_passes=1,
+    )
+    assert out2["batches"] > 0 and np.isfinite(out2["cost"])
