@@ -240,9 +240,62 @@ def resolve_config_outputs(state):
     return state["outputs"]
 
 
+def _write_gen_results(state, ids, lens, feed, config_dir,
+                       gen_result_dir):
+    """Write decoded id rows as dictionary words (reference
+    SequenceTextPrinter: one "<source>\t<word word ...>" line per
+    generated sequence). Relative dict paths resolve against the config
+    dir and its ancestors; result files land in gen_result_dir when
+    given (the reference tree is read-only here)."""
+    written = []
+    for spec in state.get("seqtext_printers", []):
+        dict_path = spec.get("dict_file")
+        words = None
+        if dict_path:
+            for base in (os.getcwd(), config_dir,
+                         os.path.dirname(config_dir),
+                         os.path.dirname(os.path.dirname(config_dir))):
+                cand = os.path.normpath(os.path.join(base, dict_path))
+                if os.path.exists(cand):
+                    with open(cand) as f:
+                        words = [w.strip() for w in f]
+                    break
+        result_path = spec.get("result_file") or "gen_result.txt"
+        if gen_result_dir:
+            result_path = os.path.join(
+                gen_result_dir, os.path.basename(result_path)
+            )
+        src_raw = feed.get(spec.get("id_input"))
+        src_flat = None if src_raw is None else np.ravel(src_raw)
+        # beam decode emits beam_size rows PER SOURCE (source-major), so
+        # row r belongs to source r // beam_width
+        group = 1
+        if src_flat is not None and src_flat.size \
+                and ids.shape[0] % src_flat.size == 0:
+            group = ids.shape[0] // src_flat.size
+        with open(result_path, "w") as f:
+            for row in range(ids.shape[0]):
+                n = int(lens[row]) if row < len(lens) else ids.shape[1]
+                toks = [int(t) for t in ids[row][:n]]
+                text = " ".join(
+                    words[t] if words and 0 <= t < len(words) else str(t)
+                    for t in toks
+                )
+                si = row // group
+                src = (
+                    int(src_flat[si])
+                    if src_flat is not None and si < src_flat.size
+                    else si
+                )
+                f.write("%d\t%s\n" % (src, text))
+        written.append(result_path)
+    return written
+
+
 def run_config(config_path, job="train", config_args=None, trainer_count=1,
                num_passes=1, log_period=10, use_gpu=None, save_dir=None,
-               recordio=None, init_model_path=None, saving_period=1):
+               recordio=None, init_model_path=None, saving_period=1,
+               gen_result_dir=None):
     """Programmatic entry (also used by tests). Returns summary dict."""
     state = _exec_config(config_path, config_args or {})
     resolve_config_outputs(state)
@@ -260,6 +313,9 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
         if n > 1:
             mesh = make_mesh({"data": n})
 
+    # generation configs (rnn_gen.conf family): the output is decoded
+    # sentence ids (the var carries a lens side-band), not a scalar cost
+    gen_mode = bool(getattr(cost_var, "lens_name", None))
     with fluid.program_guard(topo.main_program, topo.startup_program):
         method = settings.get("learning_method")
         lr = settings.get("learning_rate", 1e-3)
@@ -268,7 +324,7 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             if method is not None
             else fluid.optimizer.SGD(learning_rate=lr)
         )
-        if job not in ("test", "checkgrad"):
+        if job not in ("test", "checkgrad") and not gen_mode:
             opt.minimize(cost_var)
 
     scope = fluid.executor.Scope()
@@ -305,6 +361,41 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
         # are hermetic synthetics; SimpleDataProvider parity)
         provider_reader, slots = _simple_data_provider(topo._data_layers)
     batch_size = settings.get("batch_size", 256)
+
+    if gen_mode:
+        all_ids, all_lens, all_src = [], [], {}
+        with fluid.executor.scope_guard(scope):
+            for feed in _batches(
+                provider_reader, slots, topo._data_layers, batch_size
+            ):
+                ids, lens = exe.run(
+                    topo.main_program, feed=feed,
+                    fetch_list=[cost_var, cost_var.lens_name],
+                )
+                all_ids.append(np.asarray(ids))
+                all_lens.append(np.ravel(np.asarray(lens)))
+                for k, v in feed.items():
+                    all_src.setdefault(k, []).append(
+                        np.ravel(np.asarray(v[0] if isinstance(v, tuple)
+                                            else v))
+                    )
+        # pad rows to one width before stacking (last batch may be short)
+        width = max(a.shape[1] for a in all_ids)
+        ids = np.concatenate([
+            np.pad(a, ((0, 0), (0, width - a.shape[1])))
+            for a in all_ids
+        ])
+        lens = np.concatenate(all_lens)
+        merged_feed = {k: np.concatenate(v) for k, v in all_src.items()}
+        written = _write_gen_results(
+            state, ids, lens, merged_feed,
+            os.path.dirname(os.path.abspath(config_path)), gen_result_dir,
+        )
+        return {
+            "generated": int(ids.shape[0]),
+            "ids": ids, "lens": lens,
+            "result_files": written,
+        }
 
     if job == "checkgrad":
         feed = next(
@@ -388,6 +479,10 @@ def main(argv=None):
                    help="checkpoint dir or Parameters tar to start from")
     p.add_argument("--saving_period", type=int, default=1,
                    help="save into save_dir/pass-NNNNN every N passes")
+    p.add_argument("--gen_result_dir", default=None,
+                   help="redirect generation result files into this "
+                        "directory (the config's own paths may be "
+                        "read-only)")
     p.add_argument("--recordio", default=None,
                    help="comma-separated recordio files/globs of pickled "
                         "sample tuples; feeds training through the native "
@@ -405,4 +500,5 @@ def main(argv=None):
         recordio=args.recordio.split(",") if args.recordio else None,
         init_model_path=args.init_model_path,
         saving_period=args.saving_period,
+        gen_result_dir=args.gen_result_dir,
     )

@@ -358,6 +358,13 @@ def _conv_out(h, f, s, p):
     return (h + 2 * p - f) // s + 1
 
 
+def _pool_out(d, ps, st, pd, ceil_mode):
+    """Pooling output extent (shared by img_pool_layer / img_pool3d_layer;
+    reference parse_pool ceil/floor semantics)."""
+    span = d + 2 * pd - ps
+    return (-(-span // st) if ceil_mode else span // st) + 1
+
+
 def img_conv_layer(input, filter_size, num_filters, num_channels=None,
                    stride=1, padding=0, groups=1, act=None, bias_attr=None,
                    name=None, **kwargs):
@@ -397,12 +404,9 @@ def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
         "ceil_mode": bool(ceil_mode),
     })
 
-    def _po(d, ps, st, pd):
-        span = d + 2 * pd - ps
-        return (-(-span // st) if ceil_mode else span // st) + 1
-
     node.im_shape = (
-        c, _po(h, ph, sh, dh), _po(w, pool_size, stride, padding),
+        c, _pool_out(h, ph, sh, dh, ceil_mode),
+        _pool_out(w, pool_size, stride, padding, ceil_mode),
     )
     return node
 
@@ -725,7 +729,7 @@ def beam_search(step, input, bos_id, eos_id, beam_size=1,
     parents = [ph._outer for ph in static_phs] + [
         m._boot_layer for m in mems if m._boot_layer is not None
     ]
-    return Layer("beam_gen", name, parents, {
+    node = Layer("beam_gen", name, parents, {
         "step_out": out,
         "placeholders": placeholders,
         "static_phs": static_phs,
@@ -736,6 +740,12 @@ def beam_search(step, input, bos_id, eos_id, beam_size=1,
         "beam_size": int(beam_size),
         "max_length": int(max_length),
     })
+    # reference default generation output name (config_parser registers
+    # the decode layer as "__beam_search_predict__"; rnn_gen confs say
+    # Outputs("__beam_search_predict__"))
+    if Layer._registry is not None:
+        Layer._registry.setdefault("__beam_search_predict__", node)
+    return node
 
 
 def expand_layer(input, expand_as, name=None, **kwargs):
@@ -1412,14 +1422,10 @@ def img_pool3d_layer(input, pool_size, name=None, num_channels=None,
         "pool_type": ptype, "stride": stride, "padding": padding,
         "ceil_mode": ceil_mode,
     })
-    def _po(d, ps, s, p):
-        span = d + 2 * p - ps
-        return (-(-span // s) if ceil_mode else span // s) + 1
-
     node.vol_shape = (vol[0],) + tuple(
-        _po(d, ps, s, p)
-        for d, ps, s, p in zip(vol[1:], _triple3(pool_size),
-                               _triple3(stride), _triple3(padding))
+        _pool_out(d, ps, st, pd, ceil_mode)
+        for d, ps, st, pd in zip(vol[1:], _triple3(pool_size),
+                                 _triple3(stride), _triple3(padding))
     )
     return node
 

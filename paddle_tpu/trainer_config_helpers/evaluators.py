@@ -144,5 +144,31 @@ value_printer_evaluator = _printer("printer")
 gradient_printer_evaluator = _printer("printer")
 maxid_printer_evaluator = _printer("maxid_printer")
 maxframe_printer_evaluator = _printer("printer")
-seqtext_printer_evaluator = _printer("printer")
 classification_error_printer_evaluator = _printer("printer")
+
+
+def seqtext_printer_evaluator(input, result_file, id_input=None,
+                              dict_file=None, name=None, **kwargs):
+    """Write generated id sequences as dictionary words to result_file
+    (reference SequenceTextPrinter, evaluators.py:697 — result_file is
+    the required second positional): the trainer CLI's generation job
+    consumes the recorded (dict_file, result_file) pair after decoding
+    (trainer/__init__.py run_config)."""
+    from . import get_config_state
+
+    if not isinstance(result_file, str):
+        raise TypeError(
+            "seqtext_printer_evaluator(input, result_file, ...): "
+            "result_file must be a path string, got %r" % (result_file,)
+        )
+    if id_input is not None and isinstance(id_input, str):
+        raise TypeError("id_input must be a layer, not a string")
+    node = Layer("printer", name, _as_list(input), {})
+    get_config_state().setdefault("seqtext_printers", []).append({
+        "input": _as_list(input)[0].name,
+        "id_input": _as_list(id_input)[0].name if id_input is not None
+        else None,
+        "dict_file": dict_file,
+        "result_file": result_file,
+    })
+    return node

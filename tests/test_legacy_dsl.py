@@ -1002,3 +1002,35 @@ def test_reference_test_config_and_hsigmoid_conf_run():
         job="train", num_passes=1,
     )
     assert out2["batches"] > 0 and np.isfinite(out2["cost"])
+
+
+def test_reference_parallel_and_rnn_gen_confs(tmp_path):
+    """Two more reference .conf files verbatim: the parallel_nn config
+    (per-layer ExtraAttr(device=N) hints — per-tensor sharding replaces
+    pinning on TPU, hints are accepted) trains; the rnn_gen generation
+    config decodes through the CLI generation job, greedy and beam,
+    writing the seqtext result file."""
+    out = run_config(
+        "/root/reference/paddle/trainer/tests/"
+        "sample_trainer_config_parallel.conf",
+        job="train", num_passes=1,
+    )
+    assert out["batches"] > 0 and np.isfinite(out["cost"])
+
+    gen = run_config(
+        "/root/reference/paddle/trainer/tests/sample_trainer_rnn_gen.conf",
+        job="test", gen_result_dir=str(tmp_path),
+    )
+    # the generation job decodes EVERY provider batch (256 synthetic
+    # samples at batch_size 15), not just the first
+    assert gen["generated"] == 256, gen["generated"]
+    assert (gen["ids"][:, 0] == 0).all()  # every row starts at <bos>
+    text = open(gen["result_files"][0]).read().strip().splitlines()
+    assert len(text) == 256 and "\t" in text[0]
+
+    beam = run_config(
+        "/root/reference/paddle/trainer/tests/sample_trainer_rnn_gen.conf",
+        job="test", config_args={"beam_search": "1"},
+        gen_result_dir=str(tmp_path),
+    )
+    assert beam["generated"] == 512  # beam_size 2 per source
