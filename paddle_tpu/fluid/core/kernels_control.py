@@ -727,10 +727,28 @@ def _beam_search_decode(ctx, ins, attrs):
     first_end = jnp.argmax(ended, axis=1)
     lens = jnp.where(any_end, first_end + 1, T + 1).astype(jnp.int32)
 
+    src_off = last_b.get(LOD_SRC)
+    # num_results_per_sample < beam: keep only each source's top-n rows
+    # by cumulative score (reference RecurrentGradientMachine's
+    # numResultsPerSample truncation)
+    n_res = int(attrs.get("num_results_per_sample", 0) or 0)
+    beam = int(attrs.get("beam_width", 0) or 0)
+    if n_res and beam and n_res < beam and R % beam == 0:
+        S = R // beam
+        final_sc, _ = scores_arr.read(T)
+        per_src = final_sc.reshape(S, beam)
+        order = jnp.argsort(-per_src, axis=1)[:, :n_res]  # best-first
+        take = (
+            jnp.arange(S, dtype=jnp.int32)[:, None] * beam + order
+        ).reshape(-1)
+        ids_mat = ids_mat[take]
+        scores_mat = scores_mat[take]
+        lens = lens[take]
+        src_off = jnp.arange(S + 1, dtype=jnp.int32) * n_res
+
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)]
     )
-    src_off = last_b.get(LOD_SRC)
     for out_name in (op.outputs["SentenceIds"][0], op.outputs["SentenceScores"][0]):
         bands = {"@LOD0": offsets, BEAM_LENS: lens}
         if src_off is not None:

@@ -375,10 +375,13 @@ def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0):
     return selected_ids, selected_scores
 
 
-def beam_search_decode(ids, scores):
+def beam_search_decode(ids, scores, beam_width=0,
+                       num_results_per_sample=0):
     """Backtrack completed beams into sentences. Returns (sentence_ids,
     sentence_scores) as padded [n_source*beam, T] arrays; per-row true
-    lengths are fetchable via `sentence_ids.lens_name`."""
+    lengths are fetchable via `sentence_ids.lens_name`. When
+    0 < num_results_per_sample < beam_width, only each source's top-n
+    rows (by cumulative score) are kept."""
     helper = LayerHelper("beam_search_decode", **locals())
     sentence_ids = helper.create_tmp_variable(dtype=ids.dtype)
     sentence_scores = helper.create_tmp_variable(dtype=scores.dtype)
@@ -390,6 +393,10 @@ def beam_search_decode(ids, scores):
             "SentenceIds": [sentence_ids],
             "SentenceScores": [sentence_scores],
             "SentenceLens": [lens],
+        },
+        attrs={
+            "beam_width": int(beam_width),
+            "num_results_per_sample": int(num_results_per_sample),
         },
     )
     sentence_ids.lens_name = lens.name

@@ -42,7 +42,7 @@ __all__ = [
     "dotmul_projection", "scaling_projection",
     # recurrent machinery + generation
     "recurrent_group", "memory", "StaticInput", "GeneratedInput",
-    "beam_search",
+    "SubsequenceInput", "beam_search",
     # activations
     "ReluActivation", "SoftmaxActivation", "LinearActivation",
     "TanhActivation", "SigmoidActivation", "IdentityActivation",
@@ -631,11 +631,27 @@ def memory(name, size=None, boot_layer=None, is_seq=False, **kwargs):
     return node
 
 
+class SubsequenceInput(object):
+    """Marks a NESTED-sequence input to recurrent_group (reference
+    layers.py SubsequenceInput): each outer step consumes one
+    sub-sequence. In the memory-less generation lowering the packed
+    tokens are the per-source batch either way, so the marker unwraps
+    to its layer."""
+
+    def __init__(self, input):  # noqa: A002 - reference name
+        self.input = input
+
+
 def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     """Runs `step` once per timestep over the sequence inputs (lowered to
     ONE lax.scan via fluid DynamicRNN — core/kernels_control.py). Plain
     layer inputs are per-step sequences; StaticInput is read-only."""
-    inputs = _as_list(input)
+    raw_inputs = _as_list(input)
+    has_subseq = any(isinstance(i, SubsequenceInput) for i in raw_inputs)
+    inputs = [
+        i.input if isinstance(i, SubsequenceInput) else i
+        for i in raw_inputs
+    ]
     seq_nodes, static_nodes, placeholders = [], [], []
     for inp in inputs:
         if isinstance(inp, StaticInput):
@@ -661,6 +677,42 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
             "recurrent_group with multiple step outputs is not supported "
             "yet; return the primary output layer"
         )
+    is_nested_gen = getattr(out, "kind", None) == "beam_gen" and not mems
+    if has_subseq and not is_nested_gen:
+        raise NotImplementedError(
+            "SubsequenceInput recurrent groups are supported only for "
+            "the memory-less nested-GENERATION form (beam_search in the "
+            "step); nested training groups need per-subsequence "
+            "iteration — use DynamicRNN composition instead"
+        )
+    if is_nested_gen:
+        # nested generation (reference sample_trainer_nest_rnn_gen.conf):
+        # a memory-less outer group whose step runs beam_search is a MAP
+        # over the outer sequence's tokens — rewire the beam's static
+        # inputs from the per-step placeholders to the packed outer
+        # sequences (every token becomes one generation source; the
+        # packed order IS the reference's concat-over-outer-steps order)
+        ph_to_outer = {ph: ph._outer for ph in placeholders}
+        for sph in out.attrs["static_phs"]:
+            if sph._outer in ph_to_outer:
+                sph._outer = ph_to_outer[sph._outer]
+            elif getattr(sph._outer, "kind", None) in (
+                "rg_step_in", "rg_static_in"
+            ) or any(
+                getattr(par, "kind", None) in ("rg_step_in",
+                                               "rg_static_in")
+                for par in getattr(sph._outer, "parents", [])
+            ):
+                raise NotImplementedError(
+                    "nested generation supports only DIRECT "
+                    "SubsequenceInput -> StaticInput pass-through; layer "
+                    "%r transforms the outer step input before the "
+                    "beam's StaticInput" % sph._outer.name
+                )
+        out.parents = [sph._outer for sph in out.attrs["static_phs"]]
+        if name and Layer._registry is not None:
+            Layer._registry.setdefault(name, out)
+        return out
     parents = [ph._outer for ph in placeholders] + [
         m._boot_layer for m in mems if m._boot_layer is not None
     ]
@@ -695,12 +747,8 @@ def beam_search(step, input, bos_id, eos_id, beam_size=1,
     candidates per source. Lowered to the fluid While + beam_search +
     beam_search_decode machinery (compiled fori_loop,
     core/kernels_control.py); returns the decoded sentence-id layer."""
-    if num_results_per_sample not in (None, beam_size):
-        raise NotImplementedError(
-            "beam_search returns the full beam width per source; "
-            "num_results_per_sample=%r != beam_size=%r is not supported"
-            % (num_results_per_sample, beam_size)
-        )
+    if num_results_per_sample is None:
+        num_results_per_sample = beam_size
     inputs = _as_list(input)
     gen = None
     placeholders, static_phs = [], []
@@ -738,6 +786,7 @@ def beam_search(step, input, bos_id, eos_id, beam_size=1,
         "bos_id": int(bos_id),
         "eos_id": int(eos_id),
         "beam_size": int(beam_size),
+        "num_results_per_sample": int(num_results_per_sample),
         "max_length": int(max_length),
     })
     # reference default generation output name (config_parser registers

@@ -497,7 +497,9 @@ class Topology(object):
             L.less_than(x=counter, y=max_len, cond=cond)
 
         sentence_ids, sentence_scores = L.beam_search_decode(
-            ids=ids_array, scores=scores_array
+            ids=ids_array, scores=scores_array,
+            beam_width=a["beam_size"],
+            num_results_per_sample=a.get("num_results_per_sample", 0),
         )
         self._bind(node.name + ".scores", sentence_scores)
         return sentence_ids  # carries .lens_name for per-row true lengths

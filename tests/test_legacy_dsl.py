@@ -1034,3 +1034,26 @@ def test_reference_parallel_and_rnn_gen_confs(tmp_path):
         gen_result_dir=str(tmp_path),
     )
     assert beam["generated"] == 512  # beam_size 2 per source
+
+
+def test_reference_nested_rnn_gen_conf(tmp_path):
+    """The nested-generation config (SubsequenceInput + beam_search
+    inside a memory-less outer recurrent_group) lowers as a map over
+    the outer tokens — every token generates one sequence, packed in
+    the reference's concat-over-outer-steps order."""
+    out = run_config(
+        "/root/reference/paddle/trainer/tests/"
+        "sample_trainer_nest_rnn_gen.conf",
+        job="test", gen_result_dir=str(tmp_path),
+    )
+    assert out["generated"] == 256
+    assert (out["ids"][:, 0] == 0).all()
+
+    # beam mode: beam_size=2 searched, num_results_per_sample=1 kept
+    beam = run_config(
+        "/root/reference/paddle/trainer/tests/"
+        "sample_trainer_nest_rnn_gen.conf",
+        job="test", config_args={"beam_search": "1"},
+        gen_result_dir=str(tmp_path),
+    )
+    assert beam["generated"] == 256  # top-1 of each source's beam
