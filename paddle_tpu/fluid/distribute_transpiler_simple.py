@@ -1,0 +1,13 @@
+"""paddle.v2.fluid.distribute_transpiler_simple (reference
+distribute_transpiler_simple.py:65 SimpleDistributeTranspiler — the
+unsplit whole-variable pserver transpile). Delegates to the same SPMD
+shim as DistributeTranspiler: on TPU both transpiles lower to mesh
+data-parallel execution with XLA collectives."""
+
+from .distribute_transpiler import DistributeTranspiler
+
+__all__ = ["SimpleDistributeTranspiler"]
+
+
+class SimpleDistributeTranspiler(DistributeTranspiler):
+    pass

@@ -1,0 +1,40 @@
+"""paddle.v2.fluid.distributed_spliter (reference
+distributed_spliter.py): assign variables to parameter-server endpoints
+by name hash or round robin. On this core the transpiler path is an
+SPMD shim, but the assignment functions keep their exact semantics for
+code that partitions by them."""
+
+from __future__ import annotations
+
+import hashlib
+
+__all__ = ["hash_name", "round_robin"]
+
+
+def hash_name(varlist, pserver_endpoints):
+    """Stable name-hash assignment: returns a per-variable endpoint list
+    (reference hash_name)."""
+    def _hash_block(block_str, total):
+        return int(
+            hashlib.md5(block_str.encode()).hexdigest(), 16
+        ) % total
+
+    eplist = []
+    for var in varlist:
+        server_id = _hash_block(var.name, len(pserver_endpoints))
+        eplist.append(pserver_endpoints[server_id])
+    return eplist
+
+
+def round_robin(varlist, pserver_endpoints):
+    """Cyclic assignment (reference round_robin)."""
+    if len(varlist) <= len(pserver_endpoints):
+        raise AssertionError(
+            "round_robin expects more variables than endpoints"
+        )
+    eplist = []
+    idx = 0
+    for _ in varlist:
+        eplist.append(pserver_endpoints[idx])
+        idx = (idx + 1) % len(pserver_endpoints)
+    return eplist

@@ -1,0 +1,23 @@
+"""paddle.v2.fluid.memory_optimization_transpiler (reference
+memory_optimization_transpiler.py:270 memory_optimize — a liveness
+analysis that rewrites var reuse in the op-at-a-time interpreter).
+
+On this core the whole block compiles to ONE fused XLA computation and
+XLA's buffer assignment already performs liveness-based reuse plus
+donation of the parameter buffers (executor.py), so the transpile is a
+semantic no-op by design — kept as the API with that contract stated,
+the same stance as DistributeTranspiler.memory_optimize."""
+
+from __future__ import annotations
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program):
+    """No-op by design: XLA buffer assignment does the reuse."""
+    return input_program
+
+
+def release_memory(input_program):
+    """No-op by design: buffers are freed by XLA/PJRT liveness."""
+    return input_program

@@ -693,16 +693,24 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         # sequences (every token becomes one generation source; the
         # packed order IS the reference's concat-over-outer-steps order)
         ph_to_outer = {ph: ph._outer for ph in placeholders}
+
+        def _reaches_placeholder(node, seen=None):
+            seen = seen if seen is not None else set()
+            if id(node) in seen:
+                return False
+            seen.add(id(node))
+            if getattr(node, "kind", None) in ("rg_step_in",
+                                               "rg_static_in"):
+                return True
+            return any(
+                _reaches_placeholder(par, seen)
+                for par in getattr(node, "parents", [])
+            )
+
         for sph in out.attrs["static_phs"]:
             if sph._outer in ph_to_outer:
                 sph._outer = ph_to_outer[sph._outer]
-            elif getattr(sph._outer, "kind", None) in (
-                "rg_step_in", "rg_static_in"
-            ) or any(
-                getattr(par, "kind", None) in ("rg_step_in",
-                                               "rg_static_in")
-                for par in getattr(sph._outer, "parents", [])
-            ):
+            elif _reaches_placeholder(sph._outer):
                 raise NotImplementedError(
                     "nested generation supports only DIRECT "
                     "SubsequenceInput -> StaticInput pass-through; layer "
@@ -749,6 +757,11 @@ def beam_search(step, input, bos_id, eos_id, beam_size=1,
     core/kernels_control.py); returns the decoded sentence-id layer."""
     if num_results_per_sample is None:
         num_results_per_sample = beam_size
+    if num_results_per_sample > beam_size:
+        raise ValueError(
+            "num_results_per_sample=%d exceeds beam_size=%d"
+            % (num_results_per_sample, beam_size)
+        )
     inputs = _as_list(input)
     gen = None
     placeholders, static_phs = [], []

@@ -414,8 +414,9 @@ class Topology(object):
         the GeneratedInput placeholder bound to the embedding of the
         previous step's selected words and StaticInputs expanded to the
         live beam width. Returns the decoded sentence-id layer
-        (reference default output "__beam_search_predict__");
-        num_results_per_sample is the full beam width here."""
+        (reference default output "__beam_search_predict__"); when
+        num_results_per_sample < beam_size the decode keeps each
+        source's top-n rows by cumulative score."""
         from .layer import parse_network
 
         L = fluid.layers

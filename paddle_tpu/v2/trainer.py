@@ -204,24 +204,10 @@ class SGD(object):
 
 
 def infer(output_layer, parameters, input, feeding=None):
-    """paddle.infer (reference python/paddle/v2/inference.py): forward the
-    prediction sub-graph with the given parameters."""
-    outputs = output_layer if isinstance(output_layer, (list, tuple)) else [
-        output_layer
-    ]
-    topo = Topology(list(outputs))
-    # bind trained parameter values by (deterministic) name
-    exe = fluid.Executor(fluid.CPUPlace())
-    scope = fluid.executor.Scope()
-    with fluid.executor.scope_guard(scope):
-        exe.run(topo.startup_program)
-        for v in topo.main_program.list_vars():
-            if v.persistable and parameters.has_key(v.name):
-                scope.set(v.name, parameters[v.name])
-        feed = _convert_feed(input, topo._data_layers, feeding)
-        fetches = exe.run(
-            topo.main_program,
-            feed=feed,
-            fetch_list=[topo.var_of[o.name] for o in outputs],
-        )
-    return fetches[0] if len(fetches) == 1 else fetches
+    """paddle.infer (reference python/paddle/v2/inference.py): forward
+    the prediction sub-graph with the given parameters. Delegates to
+    inference.Inference — one binding path."""
+    from .inference import Inference
+
+    return Inference(output_layer, parameters).infer(input,
+                                                     feeding=feeding)
