@@ -39,18 +39,19 @@ def leave_local_scope():
 
 
 def var(name):
-    """Get-or-create an (empty) entry in the current scope (reference
-    Scope.var)."""
-    scope = get_cur_scope()
-    if name not in scope:
-        scope.set(name, None)
-    return scope.get(name)
+    """Get-or-create a variable HANDLE in the current scope (reference
+    Scope.var returns a Variable whose get_tensor() is settable) —
+    delegates to executor.Scope.var's _TensorView."""
+    return get_cur_scope().var(name)
 
 
 def find_var(name):
+    """Variable handle, or None when absent anywhere on the stack
+    (reference Scope.find_var semantics)."""
     for scope in reversed(_stack()):
-        if name in scope:
-            return scope.get(name)
+        found = scope.find_var(name)
+        if found is not None:
+            return found
     return None
 
 

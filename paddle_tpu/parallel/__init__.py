@@ -33,8 +33,10 @@ from .attention import (
 from .embedding import ShardedEmbedding, sharded_lookup
 from .moe import expert_parallel_moe, moe_capacity, reference_moe
 from .pipeline import gpipe_pipeline, reference_pipeline
+from .flash_attention import flash_attention
 
 __all__ = [
+    "flash_attention",
     "gpipe_pipeline",
     "reference_pipeline",
     "expert_parallel_moe",

@@ -1,0 +1,211 @@
+"""Pallas TPU flash attention: blockwise online-softmax attention that
+never materialises the [T, T] score matrix.
+
+The hot-op kernel story (SURVEY §7.1: "pallas for kernels XLA can't
+express"): XLA fuses elementwise chains into matmuls but still allocates
+the full attention score matrix; flash attention tiles Q into VMEM-sized
+blocks and streams K/V blocks through the MXU with a running
+(max, sum, accumulator) — O(T) memory instead of O(T^2), the same
+algorithm the ring-attention path uses ACROSS chips
+(parallel/attention.py), here applied WITHIN a chip.
+
+Forward is a single `pl.pallas_call` over a (batch*heads, q_blocks,
+k_blocks) grid with the k axis innermost (grid-reduction pattern:
+initialise at k==0, accumulate, finalise at the last k step). Backward
+(jax.custom_vjp) is a blockwise recompute: a lax.scan over q blocks
+rebuilds one [block_q, S] score tile per step — the flash-style
+"recompute instead of store" trade with transient memory O(block_q*S),
+never the full [T, S] residual.
+
+`interpret=True` runs the kernel on CPU for CI (tests/conftest runs on
+a CPU mesh); on TPU the same kernel compiles to Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+               scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    s = (q @ k.T) * scale  # [block_q, block_k] on the MXU
+    if causal:
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+
+    m_prev = m_ref[...]  # [block_q, 1]
+    l_prev = l_ref[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (causal upper blocks): exp(-inf - -inf)
+    p = jnp.exp(s - m_new)  # [block_q, block_k]
+    p = jnp.where(s <= _NEG_INF, 0.0, p)
+    alpha = jnp.exp(m_prev - m_new)
+    alpha = jnp.where(m_prev <= _NEG_INF, 0.0, alpha)
+
+    l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + p @ v
+    m_ref[...] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _finalise():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _fa_forward(q, k, v, scale: float, causal: bool, block_q: int,
+                block_k: int, interpret: bool):
+    BH, T, D = q.shape
+    S = k.shape[1]
+    nq = pl.cdiv(T, block_q)
+    nk = pl.cdiv(S, block_k)
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference(q, k, v, scale, causal):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        T, S = s.shape[1], s.shape[2]
+        mask = jnp.tril(jnp.ones((T, S), bool), k=S - T)
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _fa_forward(q, k, v, scale, causal, block_q, block_k,
+                       interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out = _fa_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    """Blockwise recompute backward: scan over q blocks, each step
+    rebuilding only its [block_q, S] score tile — transient memory
+    O(block_q * S), never the full [T, S] matrix (the flash trade)."""
+    q, k, v = res
+    BH, T, D = q.shape
+    nq = T // block_q
+
+    def one_block(carry, i):
+        dk_acc, dv_acc = carry
+        qb = jax.lax.dynamic_slice_in_dim(q, i * block_q, block_q, axis=1)
+        gb = jax.lax.dynamic_slice_in_dim(g, i * block_q, block_q, axis=1)
+
+        def blk(qb, k, v):
+            s = jnp.einsum(
+                "bqd,bkd->bqk", qb.astype(jnp.float32),
+                k.astype(jnp.float32)
+            ) * scale
+            if causal:
+                q_idx = i * block_q + jnp.arange(block_q)
+                k_idx = jnp.arange(k.shape[1])
+                s = jnp.where(
+                    (q_idx[:, None] >= k_idx[None, :])[None], s, _NEG_INF
+                )
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bqk,bkd->bqd", p,
+                              v.astype(jnp.float32)).astype(qb.dtype)
+
+        _, vjp = jax.vjp(blk, qb, k, v)
+        dqb, dkb, dvb = vjp(gb)
+        return (dk_acc + dkb, dv_acc + dvb), dqb
+
+    (dk, dv), dq_blocks = jax.lax.scan(
+        one_block,
+        (jnp.zeros_like(k), jnp.zeros_like(v)),
+        jnp.arange(nq),
+    )
+    # dq_blocks: [nq, BH, block_q, D] -> [BH, T, D]
+    dq = jnp.moveaxis(dq_blocks, 0, 1).reshape(BH, T, D)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Blockwise attention for [B, T, H, D] tensors (same layout as
+    parallel/attention.py). Block sizes clamp to the sequence lengths;
+    T and S must divide by the (clamped) blocks."""
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    if T % block_q or S % block_k:
+        raise ValueError(
+            "sequence lengths (%d, %d) must divide by blocks (%d, %d)"
+            % (T, S, block_q, block_k)
+        )
+    if causal and T != S:
+        raise ValueError(
+            "causal flash attention requires matching q/k lengths "
+            "(got %d vs %d)" % (T, S)
+        )
+
+    def bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    out = _flash(bh(q), bh(k), bh(v), float(scale), bool(causal),
+                 int(block_q), int(block_k), bool(interpret))
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -13,11 +13,12 @@ from .. import fluid
 __all__ = ["infer", "Inference"]
 
 
-def infer(output_layer, parameters, input, feeding=None):
+def infer(output_layer, parameters, input, feeding=None, field="value"):
     """paddle.infer (reference inference.py:125): one-shot form over the
     Inference class — single binding path for parameter loading."""
     return Inference(output_layer, parameters).infer(input,
-                                                     feeding=feeding)
+                                                     feeding=feeding,
+                                                     field=field)
 
 
 class Inference(object):
@@ -38,7 +39,12 @@ class Inference(object):
                 if v.persistable and parameters.has_key(v.name):
                     self._scope.set(v.name, parameters[v.name])
 
-    def infer(self, input, feeding=None):
+    def infer(self, input, feeding=None, field="value"):
+        if field not in ("value",):
+            raise NotImplementedError(
+                "field=%r: this core returns layer VALUES; ids come from "
+                "max_id/beam layers in the graph itself" % (field,)
+            )
         feed = _convert_feed(input, self._topo._data_layers, feeding)
         with fluid.executor.scope_guard(self._scope):
             fetches = self._exe.run(
@@ -48,6 +54,13 @@ class Inference(object):
             )
         return fetches[0] if len(fetches) == 1 else fetches
 
-    def iter_infer(self, input, feeding=None):
-        for batch in minibatch.batch(lambda: iter(input), 128)():
+    def iter_infer(self, input, feeding=None, batch_size=128):
+        for batch in minibatch.batch(lambda: iter(input), batch_size)():
             yield self.infer(batch, feeding=feeding)
+
+    def iter_infer_field(self, input, field="value", feeding=None,
+                         batch_size=128):
+        """Reference inference.py iter_infer_field: per-batch results of
+        one field."""
+        for batch in minibatch.batch(lambda: iter(input), batch_size)():
+            yield self.infer(batch, feeding=feeding, field=field)
