@@ -299,6 +299,48 @@ def bench_lstm(batch=64, hidden=512, emb=128, seqlen=100, vocab=30000,
     }
 
 
+def bench_flash_attention(B=4, T=4096, H=16, D=64, iters=20):
+    """Pallas flash attention vs XLA full-matrix attention, single chip
+    (parallel/flash_attention.py). Forward-only timing; the memory win
+    is the point, the MXU time should at least match."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel import flash_attention, reference_attention
+
+    if jax.default_backend() == "cpu":
+        return {"skipped": "pallas flash timing needs the TPU backend "
+                           "(CPU runs it in interpret mode only)"}
+
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.1)
+        for _ in range(3)
+    )
+
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    ref = jax.jit(lambda q, k, v: reference_attention(q, k, v, causal=True))
+
+    def timed(fn):
+        fn(q, k, v).block_until_ready()  # compile
+        t0 = time.time()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        out.block_until_ready()
+        return (time.time() - t0) / iters * 1e3
+
+    ms_flash = timed(flash)
+    ms_ref = timed(ref)
+    err = float(jnp.abs(flash(q, k, v) - ref(q, k, v)).max())
+    return {
+        "ms_flash": round(ms_flash, 3),
+        "ms_xla_full": round(ms_ref, 3),
+        "speedup": round(ms_ref / ms_flash, 3),
+        "max_err": err,
+        "shape": [B, T, H, D],
+    }
+
+
 def main():
     os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "bfloat16")
     import jax
@@ -347,6 +389,7 @@ def main():
             "googlenet", lambda i, c: googlenet(i, c), 128, baseline_ips=111.4))
         run("vgg16", lambda: bench_image("vgg16", lambda i, c: vgg16(i, c), 64))
         run("lstm", bench_lstm)
+        run("flash_attention", bench_flash_attention)
 
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     chunk_steps = int(os.environ.get("BENCH_CHUNK_STEPS", "25"))
