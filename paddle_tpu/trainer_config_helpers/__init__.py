@@ -48,7 +48,7 @@ __all__ = [
     "TanhActivation", "SigmoidActivation", "IdentityActivation",
     "BReluActivation", "SoftReluActivation", "SquareActivation",
     "ExpActivation", "STanhActivation", "AbsActivation", "LogActivation",
-    "SequenceSoftmaxActivation",
+    "SequenceSoftmaxActivation", "SqrtActivation", "ReciprocalActivation",
     # pooling types
     "MaxPooling", "AvgPooling", "SumPooling",
     # optimizers / regularization
@@ -175,6 +175,8 @@ ExpActivation = _mkact("ExpActivation", "exp")
 STanhActivation = _mkact("STanhActivation", "stanh")
 AbsActivation = _mkact("AbsActivation", "abs")
 LogActivation = _mkact("LogActivation", "log")
+SqrtActivation = _mkact("SqrtActivation", "sqrt")
+ReciprocalActivation = _mkact("ReciprocalActivation", "reciprocal")
 
 
 class ParamAttr(object):

@@ -1057,3 +1057,47 @@ def test_reference_nested_rnn_gen_conf(tmp_path):
         gen_result_dir=str(tmp_path),
     )
     assert beam["generated"] == 256  # top-1 of each source's beam
+
+
+def test_layer_math_and_config_parser_utils():
+    """layer_math operator sugar (reference layer_math.py: +,-,* and
+    unary registrations) and config_parser_utils (parse callables into
+    Topology / settings)."""
+    import paddle_tpu.trainer_config_helpers.config_parser_utils as cpu
+    import paddle_tpu.trainer_config_helpers.layer_math as lm
+
+    _fresh()
+    a = tch.data_layer(name="lm_a", size=3)
+    b = tch.data_layer(name="lm_b", size=3)
+    c = (a + b) * 2.0 - 1.0
+    r = 3.0 - a      # __rsub__
+    e = lm.sqrt(lm.exp(a))
+    topo = Topology([c, r, e])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        av = np.full((2, 3), 4.0, np.float32)
+        bv = np.full((2, 3), 2.0, np.float32)
+        o1, o2, o3 = exe.run(
+            topo.main_program, feed={"lm_a": av, "lm_b": bv},
+            fetch_list=[topo.var_of[n.name] for n in (c, r, e)],
+        )
+    np.testing.assert_allclose(o1, (av + bv) * 2 - 1)
+    np.testing.assert_allclose(o2, 3.0 - av)
+    np.testing.assert_allclose(o3, np.exp(av / 2), rtol=1e-5)
+
+    def netconf():
+        x = tch.data_layer(name="cpn_x", size=4)
+        tch.outputs(tch.fc_layer(input=x, size=2,
+                                 act=tch.SoftmaxActivation()))
+
+    t2 = cpu.parse_network_config(netconf)
+    assert t2.main_program.global_block().ops
+
+    def optconf():
+        tch.settings(batch_size=8, learning_rate=0.5,
+                     learning_method=tch.AdamOptimizer())
+
+    st = cpu.parse_optimizer_config(optconf)
+    assert st["batch_size"] == 8 and st["learning_rate"] == 0.5
