@@ -222,14 +222,19 @@ def lstmemory_unit(input, out_memory=None, name=None, size=None,
     else:
         out_mem = out_memory
     state_mem = tch.memory(name=out_name + "_state", size=size)
+    # two projections of DIFFERENT input widths: a shared ParamAttr
+    # name would alias one weight for both — derive distinct names
+    pa_in = pa_rec = None
+    if param_attr is not None:
+        base = getattr(param_attr, "name", None)
+        pa_in = tch.ParamAttr(name=(base + "_in") if base else None)
+        pa_rec = tch.ParamAttr(name=(base + "_rec") if base else None)
     with tch.mixed_layer(
         size=size * 4, bias_attr=input_proj_bias_attr,
         name=out_name + "_input_proj",
     ) as m:
-        m += tch.full_matrix_projection(input=input,
-                                        param_attr=param_attr)
-        m += tch.full_matrix_projection(input=out_mem,
-                                        param_attr=param_attr)
+        m += tch.full_matrix_projection(input=input, param_attr=pa_in)
+        m += tch.full_matrix_projection(input=out_mem, param_attr=pa_rec)
     step_l = tch.lstm_step_layer(
         input=m, state=state_mem, size=size, name=out_name,
         act=act, gate_act=gate_act, state_act=state_act,

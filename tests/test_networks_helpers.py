@@ -272,3 +272,36 @@ def test_detection_map_evaluator_graph():
         )[0]
     v = float(np.ravel(out)[0])
     assert 0.0 <= v <= 1.0, v
+
+
+def test_recurrent_units_helpers_train():
+    """paddle.trainer.recurrent_units (reference recurrent_units.py):
+    the pre-DSL Lstm/GatedRecurrentLayerGroup helpers build trainable
+    step graphs through the networks composites."""
+    from paddle_tpu.trainer.recurrent_units import (
+        GatedRecurrentLayerGroup,
+        LstmRecurrentLayerGroup,
+    )
+
+    _fresh()
+    H = 4
+    rng = np.random.RandomState(7)
+    w = tch.data_layer(name="ru_w", size=6)
+    emb = tch.embedding_layer(input=w, size=5)
+    lstm = LstmRecurrentLayerGroup(
+        "ru_lstm", H, "tanh", "tanh", "sigmoid",
+        [tch.fc_layer(input=emb, size=H * 4, bias_attr=False)])
+    gru = GatedRecurrentLayerGroup(
+        "ru_gru", H, "tanh", "sigmoid",
+        [tch.fc_layer(input=emb, size=H * 3, bias_attr=False)])
+    last = tch.concat_layer(input=[tch.last_seq(input=lstm),
+                                   tch.last_seq(input=gru)])
+    prob = tch.fc_layer(input=last, size=2, act=tch.SoftmaxActivation())
+    y = tch.data_layer(name="ru_y", size=2)
+    cost = tch.classification_cost(input=prob, label=y)
+    topo = Topology([cost])
+    lod = np.array([0, 3, 7], np.int32)
+    _train(topo, cost, {
+        "ru_w": (rng.randint(0, 6, (7, 1)).astype(np.int64), [lod]),
+        "ru_y": rng.randint(0, 2, (2, 1)).astype(np.int64),
+    }, steps=15)
