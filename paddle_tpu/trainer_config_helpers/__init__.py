@@ -302,12 +302,25 @@ def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _apply_layer_attr(node, kwargs):
+    """Honor ExtraLayerAttribute knobs that change the graph: drop_rate
+    wraps the layer in dropout (reference config_parser applies
+    layer_attr.drop_rate to any layer's output); device hints are
+    accepted (per-tensor sharding replaces pinning on TPU)."""
+    attr = kwargs.get("layer_attr")
+    rate = getattr(attr, "drop_rate", None)
+    if rate:
+        return dropout_layer(input=node, dropout_rate=float(rate))
+    return node
+
+
 def fc_layer(input, size, act=None, name=None, bias_attr=None,
              param_attr=None, **kwargs):
-    return Layer("fc", name, _as_list(input), {
+    node = Layer("fc", name, _as_list(input), {
         "size": size, "act": _act_name(act), "param_attr": param_attr,
         "bias_attr": bias_attr,
     })
+    return _apply_layer_attr(node, kwargs)
 
 
 def _node_flat_width(node):
