@@ -133,18 +133,33 @@ def sequence_parallel_attention(
 ):
     """Global-view entry point: q/k/v are [B, T, H, D] global arrays; the
     sequence dim is sharded over `axis` of `mesh` and attention runs
-    sequence-parallel. Falls back to plain attention without a mesh."""
+    sequence-parallel. Without a mesh (or on a size-1 axis):
+    impl="flash" runs the pallas flash kernel on the chip, anything else
+    the plain full-matrix attention."""
     if mesh is None:
         from .mesh import get_default_mesh
 
         mesh = get_default_mesh()
     if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        if impl == "flash":
+            import jax as _jax
+
+            from .flash_attention import flash_attention
+
+            return flash_attention(
+                q, k, v, causal=causal, scale=scale,
+                interpret=_jax.default_backend() == "cpu",
+            )
         return reference_attention(q, k, v, causal=causal, scale=scale)
     if q.shape[1] % mesh.shape[axis] != 0:
         raise ValueError(
             "sequence length %d not divisible by mesh axis %r size %d"
             % (q.shape[1], axis, mesh.shape[axis])
         )
+    if impl == "flash":
+        # sharded flash = ring layout with the pallas kernel per block is
+        # future work; today multi-shard requests fall back to ring
+        impl = "ring"
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
     if impl == "ulysses" and q.shape[2] % mesh.shape[axis] != 0:
         raise ValueError("ulysses needs heads divisible by the seq axis size")

@@ -64,3 +64,23 @@ def test_flash_validates():
     with pytest.raises(ValueError):
         flash_attention(q2, k2[:, :64], v2[:, :64], causal=True,
                         interpret=True)
+
+
+def test_sequence_parallel_entry_flash_impl():
+    """sequence_parallel_attention(impl='flash') dispatches to the
+    pallas kernel on the single-shard path (interpret mode on CPU) and
+    to ring attention when the mesh axis is real."""
+    from paddle_tpu.parallel import make_mesh, sequence_parallel_attention
+
+    q, k, v = _qkv(np.random.RandomState(5), T=128)
+    out = sequence_parallel_attention(q, k, v, mesh=None, impl="flash",
+                                      causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+    mesh = make_mesh({"seq": 4})
+    out2 = sequence_parallel_attention(q, k, v, mesh=mesh, impl="flash",
+                                       causal=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               atol=5e-4)
