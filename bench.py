@@ -343,12 +343,54 @@ def bench_flash_attention(B=4, T=4096, H=16, D=64, iters=20):
 
 def main():
     os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "bfloat16")
+
+    # bounded device-init wait: a dead tunnel otherwise hangs the bench
+    # forever inside jax.devices() with no output at all (seen in r3:
+    # multi-hour axon outage). The watchdog turns that into a diagnostic
+    # line + clean nonzero exit the driver can act on.
+    import threading
+
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "1200"))
+    total_timeout = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "7200"))
+    init_done = threading.Event()
+
+    def _watchdog():
+        if not init_done.wait(init_timeout):
+            print(
+                json.dumps({
+                    "metric": "bench_error",
+                    "error": "device init exceeded %gs — accelerator "
+                             "backend unavailable" % init_timeout,
+                }),
+                flush=True,
+            )
+            os._exit(3)
+        # stay armed for the WHOLE run: a tunnel death mid-workload
+        # otherwise blocks inside a device call with no output at all
+        remaining = total_timeout - init_timeout
+        if remaining > 0 and not _bench_finished.wait(remaining):
+            print(
+                json.dumps({
+                    "metric": "bench_error",
+                    "error": "bench exceeded BENCH_TOTAL_TIMEOUT_S=%g — "
+                             "device call likely hung mid-run"
+                             % total_timeout,
+                }),
+                flush=True,
+            )
+            os._exit(3)
+
+    _bench_finished = threading.Event()
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     import jax
 
     jax.config.update(
         "jax_default_matmul_precision",
         os.environ["JAX_DEFAULT_MATMUL_PRECISION"],
     )
+    jax.devices()  # force backend init under the watchdog
+    init_done.set()
     from paddle_tpu.models.alexnet import alexnet
     from paddle_tpu.models.googlenet import googlenet
     from paddle_tpu.models.vgg import vgg16
@@ -412,6 +454,7 @@ def main():
     )
     workloads["resnet50"] = headline
 
+    _bench_finished.set()
     print(
         json.dumps(
             {
