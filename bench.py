@@ -355,6 +355,7 @@ def main():
     init_done = threading.Event()
 
     def _watchdog():
+        start = time.monotonic()
         if not init_done.wait(init_timeout):
             print(
                 json.dumps({
@@ -366,8 +367,10 @@ def main():
             )
             os._exit(3)
         # stay armed for the WHOLE run: a tunnel death mid-workload
-        # otherwise blocks inside a device call with no output at all
-        remaining = total_timeout - init_timeout
+        # otherwise blocks inside a device call with no output at all.
+        # Budget from ACTUAL elapsed init time (a fast init must not
+        # shrink the run budget; a total <= init_timeout must still arm)
+        remaining = total_timeout - (time.monotonic() - start)
         if remaining > 0 and not _bench_finished.wait(remaining):
             print(
                 json.dumps({

@@ -225,6 +225,23 @@ def _sequence_erase(ctx, ins, attrs):
     return {"Out": out.reshape((total,) + tuple(x.shape[1:]))}
 
 
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    """Per-sequence time reversal: row p of sequence i moves to
+    offsets[i] + (offsets[i+1]-1-p). Used to lower reverse recurrent
+    groups (reference RecurrentLayer/RecurrentGradientMachine
+    reversed_=true walk the sequence backward; here: reverse -> forward
+    scan -> reverse, one gather each way)."""
+    x = ins["X"][0]
+    offsets = _offsets(ctx)
+    total = x.shape[0]
+    pos = jnp.arange(total, dtype=offsets.dtype)
+    ids = seg_ids(offsets, total)
+    perm = offsets[ids] + (offsets[ids + 1] - 1 - pos)
+    _set_lod(ctx, "Out", offsets)
+    return {"Out": x[perm]}
+
+
 @register_op("sequence_context")
 def _sequence_context(ctx, ins, attrs):
     """Context-window concatenation WITHOUT weights (reference

@@ -25,15 +25,18 @@ tests/test_multihost.py proves train/checkpoint/kill/resume parity with
 the reference multi-node axis (RemoteParameterUpdater.h:55,
 go/pserver/service.go:120-226).
 
-ASYNC SGD stance (reference ParameterServer2.h:127-139 AsyncSGD,
-go/pserver/service.go:285 per-gradient async updates): NOT implemented,
-by design. Async parameter updates exist to hide straggler/network
-latency on loosely-coupled GPU clusters; on a TPU pod the SPMD step is
-globally synchronous by construction (ICI collectives are part of the
-compiled program) and stragglers do not exist at the software level —
-sync data parallelism strictly dominates. `transpile(sync_mode=False)`
-is accepted for script compatibility and warns that it runs
-synchronously with identical convergence-or-better semantics.
+ASYNC SGD (reference ParameterServer2.h:127-139 AsyncSGD,
+go/pserver/service.go:285 per-gradient async updates): redesigned as
+**local SGD** — `Executor.run_async_local(steps, sync_every)` gives each
+'data'-axis replica its own parameter/optimizer-state copy, runs
+`sync_every` purely-local optimizer steps, then averages the models
+(one pmean per round). That expresses async's actual trade — staleness
+for communication — in a form a globally-synchronous SPMD step can
+compile (parallel/async_sgd.py has the full argument; sync_every=1
+with SGD/momentum is bit-equal to the sync allreduce step).
+`transpile(sync_mode=False)` records the request and warns which call
+to use; plain `exe.run` still executes synchronously because per-batch
+async dispatch does not exist inside one compiled step.
 """
 
 from __future__ import annotations
@@ -75,11 +78,13 @@ class DistributeTranspiler(object):
         self._trainers = int(trainers)
         self._trainer_id = int(trainer_id)
         self._pservers = pservers.split(",") if isinstance(pservers, str) else list(pservers)
+        self._sync_mode = bool(sync_mode)
         if not sync_mode:
             warnings.warn(
-                "sync_mode=False (AsyncSGD) requested: TPU SPMD steps are "
-                "globally synchronous by construction; running sync with "
-                "identical global-batch semantics (see module docstring)"
+                "sync_mode=False (AsyncSGD) requested: use "
+                "Executor.run_async_local(steps, sync_every) — the "
+                "local-SGD redesign of async DP (parallel/async_sgd.py); "
+                "plain exe.run executes synchronously"
             )
 
     def get_trainer_program(self) -> Program:

@@ -181,6 +181,112 @@ class Executor(object):
             use_cache=True, steps=int(steps), scan_feeds=scan_feeds,
         )
 
+    def run_async_local(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[List[Any]] = None,
+        steps: int = 1,
+        sync_every: int = 1,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+    ):
+        """AsyncSGD equivalent (reference ParameterServer2.h:127 /
+        go/pserver SendGrad): local-SGD redesign — every 'data'-axis
+        replica trains its OWN parameter + optimizer-state copy for
+        `sync_every` steps with zero inter-chip traffic, then replicas
+        average their models (one pmean per round). See
+        parallel/async_sgd.py for the semantics argument. Feeds must be
+        dense arrays with a leading [steps] dim then the global batch
+        dim; fetches return stacked [steps, ...], replica-averaged.
+        Parameters land back in the scope as ordinary consensus arrays
+        (checkpoint/save need no special handling)."""
+        from ..parallel.async_sgd import build_local_sgd_fn
+
+        if program is None:
+            program = core.default_main_program()
+        scope = scope or global_scope()
+        mesh = self._resolve_mesh()
+        if mesh is None or "data" not in mesh.axis_names:
+            raise ValueError(
+                "run_async_local needs a mesh with a 'data' axis "
+                "(Executor(mesh=...) or parallel.set_default_mesh)"
+            )
+        from ..parallel.mesh import spans_processes
+
+        if spans_processes(mesh):
+            raise NotImplementedError(
+                "run_async_local is single-controller for now: feeds "
+                "enter as whole global arrays, not per-process shards "
+                "(the _globalize_feeds assembly the sync path does is "
+                "not wired here yet)"
+            )
+        if program.shardings:
+            raise ValueError(
+                "run_async_local composes with data parallelism only; "
+                "drop the tensor-parallel shard_parameter annotations "
+                "(replicas must own complete models): %r"
+                % sorted(program.shardings)
+            )
+        block = program.global_block()
+        fetch_names = [_feed_name(f) for f in fetch_list or []]
+        persist_names = sorted(
+            v.name for v in program.list_vars() if v.persistable
+        )
+        feed_arrays: Dict[str, Any] = {}
+        for name, value in (feed or {}).items():
+            data, lod = _split_lod_feed(value)
+            if lod is not None:
+                raise NotImplementedError(
+                    "run_async_local supports dense feeds only (LoD "
+                    "batches change shape per step)"
+                )
+            var = block.var(name) if block.has_var(name) else None
+            feed_arrays[name] = _to_device_dtype(data, var)
+        persist_in = {n: scope.get(n) for n in persist_names if n in scope}
+
+        feed_sig = tuple(
+            (n, tuple(a.shape), str(a.dtype))
+            for n, a in sorted(feed_arrays.items())
+        )
+        key = (
+            "async_local", program.uid, program.version, program.amp,
+            feed_sig, tuple(fetch_names),
+            tuple(sorted(persist_in.keys())),
+            int(steps), int(sync_every), mesh,
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            step, persist_out = build_step_fn(
+                program,
+                feed_names=list(feed_arrays.keys()),
+                fetch_names=fetch_names,
+                persist_names=persist_names,
+                persist_in=list(persist_in.keys()),
+            )
+            if set(persist_out) != set(persist_in.keys()):
+                raise ValueError(
+                    "run_async_local requires the program to update (not "
+                    "create) persistables; missing from scope: %r"
+                    % sorted(set(persist_out) - set(persist_in))
+                )
+            fn = build_local_sgd_fn(
+                step, mesh,
+                feed_names=list(feed_arrays.keys()),
+                steps=int(steps), sync_every=int(sync_every),
+            )
+            entry = jax.jit(fn, donate_argnums=(0,))
+            self._cache[key] = entry
+
+        self._run_counter += 1
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(program.random_seed), self._run_counter
+        )
+        fetches, new_persist = entry(persist_in, feed_arrays, rng)
+        return _finish_run(
+            scope, fetch_names, fetches, new_persist, return_numpy
+        )
+
     # ------------------------------------------------------------------
     def _execute(
         self,

@@ -1004,6 +1004,17 @@ def sequence_softmax(x, **kwargs):
     return out
 
 
+def sequence_reverse(x, name=None):
+    """Reverse each sequence of a LoD tensor in time (kept LoD). Lowers
+    reverse recurrent groups: reverse -> forward scan -> reverse."""
+    helper = LayerHelper("sequence_reverse", **locals())
+    out = helper.create_tmp_variable(dtype=x.dtype, lod_level=1)
+    helper.append_op(
+        type="sequence_reverse", inputs={"X": [x]}, outputs={"Out": [out]}
+    )
+    return out
+
+
 def sequence_expand(x, y, name=None):
     helper = LayerHelper("sequence_expand", **locals())
     out = helper.create_tmp_variable(dtype=x.dtype, lod_level=y.lod_level)
@@ -1551,6 +1562,7 @@ def lambda_rank_cost(score, label, ndcg_num=5, name=None, **kwargs):
 __all__ += [
     "conv3d", "pool3d", "prelu", "crop", "roi_pool", "scale_sub_region",
     "kmax_sequence_score", "sub_nested_seq", "lambda_rank_cost",
+    "sequence_reverse",
 ]
 
 

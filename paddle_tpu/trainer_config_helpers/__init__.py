@@ -997,9 +997,9 @@ def repeat_layer(input, num_repeats, name=None, **kwargs):
 def recurrent_layer(input, act=None, reverse=False, name=None,
                     param_attr=None, bias_attr=None, **kwargs):
     """Simple full-matrix recurrence (reference RecurrentLayer):
-    h_t = act(x_t + W h_{t-1}) — sugar over recurrent_group."""
-    if reverse:
-        raise NotImplementedError("recurrent_layer(reverse=True)")
+    h_t = act(x_t + W h_{t-1}) — sugar over recurrent_group; with
+    reverse=True the recurrence runs t = len-1 .. 0 (reference
+    RecurrentLayer reversed_)."""
     act = act or TanhActivation()
     inp = _as_list(input)[0]
     if name is None:
@@ -1015,7 +1015,8 @@ def recurrent_layer(input, act=None, reverse=False, name=None,
                        act=_act_name(act), param_attr=param_attr)
         return out_
 
-    return recurrent_group(step=step, input=inp, name=name)
+    return recurrent_group(step=step, input=inp, name=name,
+                           reverse=reverse)
 
 
 def ctc_layer(input, label, size=None, blank=None, norm_by_times=False,

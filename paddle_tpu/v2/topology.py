@@ -518,20 +518,27 @@ class Topology(object):
         step_out = a["step_out"]
         placeholders = a["placeholders"]
         mems = a["mems"]
-        if a.get("reverse"):
-            raise NotImplementedError(
-                "recurrent_group(reverse=True): feed reversed sequences or "
-                "use lstmemory(reverse=True)"
-            )
+        reverse = bool(a.get("reverse"))
 
         rnn = L.DynamicRNN()
         ph_ids = {id(p) for p in placeholders} | {id(m) for m in mems}
+        # outer-block vars resolved (and, for a reversed group,
+        # time-flipped) BEFORE entering the step sub-block: a reversed
+        # group = forward scan over the flipped sequences, output
+        # un-flipped below (reference RecurrentLayer reversed_=true
+        # walks t = len-1 .. 0)
+        outer_vars = {}
+        for ph in placeholders:
+            outer = self._var(ph._outer.name)
+            if reverse and ph.kind == "rg_step_in":
+                outer = L.sequence_reverse(outer)
+            outer_vars[id(ph)] = outer
         with rnn.block():
             local: Dict[str, object] = {}
             self._scopes.append(local)
             try:
                 for ph in placeholders:
-                    outer = self._var(ph._outer.name)
+                    outer = outer_vars[id(ph)]
                     if ph.kind == "rg_step_in":
                         local[ph.name] = rnn.step_input(outer)
                     else:
@@ -572,7 +579,10 @@ class Topology(object):
                 rnn.output(local[step_out.name])
             finally:
                 self._scopes.pop()
-        return rnn()
+        out = rnn()
+        if reverse:
+            out = L.sequence_reverse(out)
+        return out
 
     # ------------------------------------------------------------------
     def data_layers(self) -> Dict[str, Layer]:

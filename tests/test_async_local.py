@@ -1,0 +1,159 @@
+"""AsyncSGD-as-local-SGD (parallel/async_sgd.py, Executor.run_async_local).
+
+Two oracles:
+  1. sync_every=1 with SGD is mathematically identical to synchronous
+     data parallelism: averaging models after one gradient-linear update
+     equals updating with the averaged gradient. The async runner must
+     match the sync executor bit-for-bit (up to f32 tolerance).
+  2. sync_every=K equals K fully independent single-device trainings
+     (one per replica, each on its own batch shard) followed by a
+     parameter average — simulated here with the ordinary single-device
+     executor, which shares none of the shard_map machinery.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import parallel
+
+STEPS = 8
+BATCH = 32  # global batch; 8 replicas x 4
+DIM = 6
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(STEPS, BATCH, DIM).astype(np.float32)
+    w = rng.rand(DIM, 1).astype(np.float32)
+    y = (x @ w + 0.1 * rng.rand(STEPS, BATCH, 1)).astype(np.float32)
+    return x, y
+
+
+def _build(lr=0.1, momentum=None):
+    x = fluid.layers.data(name="x", shape=[DIM], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(
+        input=x, size=1,
+        param_attr=fluid.ParamAttr(
+            name="w", initializer=fluid.initializer.Constant(0.25)),
+        bias_attr=fluid.ParamAttr(
+            name="b", initializer=fluid.initializer.Constant(0.0)),
+    )
+    loss = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=pred, label=y))
+    if momentum is None:
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    else:
+        fluid.optimizer.Momentum(
+            learning_rate=lr, momentum=momentum).minimize(loss)
+    return loss
+
+
+def test_sync_every_1_equals_sync_dp():
+    x, y, = None, None
+    x, y = _data()
+    mesh = parallel.make_mesh({"data": 8})
+
+    # sync path: run_repeated over the same mesh
+    loss = _build(momentum=0.9)
+    exe = fluid.Executor(mesh=mesh)
+    exe.run(fluid.default_startup_program())
+    sync_losses = exe.run_repeated(
+        feed={"x": x, "y": y}, fetch_list=[loss],
+        steps=STEPS, scan_feeds=True,
+    )[0].ravel()
+    sync_w = np.asarray(fluid.global_scope().get("w")).copy()
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        with fluid.scope_guard(fluid.Scope()):
+            loss2 = _build(momentum=0.9)
+            exe2 = fluid.Executor(mesh=mesh)
+            exe2.run(fluid.default_startup_program())
+            async_losses = exe2.run_async_local(
+                feed={"x": x, "y": y}, fetch_list=[loss2],
+                steps=STEPS, sync_every=1,
+            )[0].ravel()
+            async_w = np.asarray(fluid.global_scope().get("w")).copy()
+
+    np.testing.assert_allclose(async_losses, sync_losses, rtol=2e-5)
+    np.testing.assert_allclose(async_w, sync_w, rtol=2e-5, atol=1e-7)
+
+
+def test_sync_every_k_matches_independent_replicas():
+    x, y = _data(seed=1)
+    nrep, K = 8, 4
+    shard = BATCH // nrep
+
+    # oracle: per round, 8 independent single-device trainings (one per
+    # replica, each on its own batch shard, starting from the round's
+    # consensus params), then average — none of the shard_map machinery
+    param_names = ("w", "b")
+    consensus = {"w": np.full((DIM, 1), 0.25, np.float32),
+                 "b": np.zeros((1,), np.float32)}
+    for rnd in range(STEPS // K):
+        updated = []
+        for r in range(nrep):
+            with fluid.program_guard(fluid.Program(), fluid.Program()):
+                with fluid.scope_guard(fluid.Scope()):
+                    loss = _build()
+                    exe = fluid.Executor(fluid.CPUPlace())
+                    exe.run(fluid.default_startup_program())
+                    sc = fluid.global_scope()
+                    for n, v in consensus.items():
+                        sc.set(n, v)
+                    for j in range(rnd * K, rnd * K + K):
+                        exe.run(
+                            feed={
+                                "x": x[j, r * shard:(r + 1) * shard],
+                                "y": y[j, r * shard:(r + 1) * shard],
+                            },
+                            fetch_list=[loss],
+                        )
+                    updated.append({
+                        n: np.asarray(sc.get(n)).copy()
+                        for n in param_names
+                    })
+        consensus = {
+            n: np.mean([u[n] for u in updated], axis=0)
+            for n in param_names
+        }
+
+    # the async runner
+    mesh = parallel.make_mesh({"data": nrep})
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        with fluid.scope_guard(fluid.Scope()):
+            loss = _build()
+            exe = fluid.Executor(mesh=mesh)
+            exe.run(fluid.default_startup_program())
+            losses = exe.run_async_local(
+                feed={"x": x, "y": y}, fetch_list=[loss],
+                steps=STEPS, sync_every=K,
+            )[0].ravel()
+            got = {
+                n: np.asarray(fluid.global_scope().get(n)).copy()
+                for n in param_names
+            }
+
+    assert np.isfinite(losses).all()
+    for n in param_names:
+        np.testing.assert_allclose(
+            got[n], consensus[n], rtol=3e-5, atol=1e-6,
+            err_msg="param %r diverges from the independent-replica "
+                    "oracle" % n,
+        )
+
+
+def test_async_local_guards():
+    x, y = _data(seed=2)
+    loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())  # no mesh
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(ValueError, match="mesh with a 'data' axis"):
+        exe.run_async_local(feed={"x": x, "y": y}, fetch_list=[loss],
+                            steps=4, sync_every=2)
+    mesh = parallel.make_mesh({"data": 8})
+    exe2 = fluid.Executor(mesh=mesh)
+    with pytest.raises(ValueError, match="multiple of sync_every"):
+        exe2.run_async_local(feed={"x": x, "y": y}, fetch_list=[loss],
+                             steps=5, sync_every=2)

@@ -1101,3 +1101,40 @@ def test_layer_math_and_config_parser_utils():
 
     st = cpu.parse_optimizer_config(optconf)
     assert st["batch_size"] == 8 and st["learning_rate"] == 0.5
+
+
+def test_recurrent_layer_reverse_numpy_oracle():
+    """recurrent_layer(reverse=True): h_t = act(x_t + h_{t+1} @ W),
+    walked t = len-1 .. 0 per sequence (reference RecurrentLayer.cpp
+    reversed_ path; lowered here as reverse -> forward scan -> reverse
+    via the sequence_reverse kernel)."""
+    _fresh()
+    H = 4
+    data = tch.data_layer(name="rev_x", size=H)
+    rec = tch.recurrent_layer(
+        input=data, reverse=True, act=tch.TanhActivation(),
+        param_attr=tch.ParamAttr(name="rev_w"), name="revrec",
+    )
+    topo = Topology([rec])
+    out_var = topo.var_of[rec.name]
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    lens = [3, 5, 2]
+    lod = np.cumsum([0] + lens).astype(np.int32)
+    x = (0.5 * rng.randn(sum(lens), H)).astype(np.float32)
+    with fluid.executor.scope_guard(scope):
+        exe.run(topo.startup_program)
+        (out,) = exe.run(
+            topo.main_program,
+            feed={"rev_x": (x, [lod])},
+            fetch_list=[out_var],
+        )
+        w = np.asarray(scope.find_var("rev_w").get_tensor())
+    expect = np.zeros_like(x)
+    for s, e in zip(lod[:-1], lod[1:]):
+        h = np.zeros((H,), np.float32)
+        for t in range(e - 1, s - 1, -1):
+            h = np.tanh(x[t] + h @ w)
+            expect[t] = h
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
