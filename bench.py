@@ -18,6 +18,9 @@ definitions):
               bs=128 (benchmark/README.md:50 -> 111.4 img/s)
   lstm      — benchmark/paddle/rnn/rnn.py (2x LSTM h=512, bs=64, seq 100),
               ms/batch vs 184 ms/batch (benchmark/README.md:119)
+  transformer_lm — long-context flagship: decoder-only LM (8x512, T=1024,
+              flash attention, bf16), tokens/s + MFU; beyond-reference,
+              no 2018 baseline
 
 Timing: per-step cost is measured by differencing two multi-step
 `run_repeated` calls ((T(hi)-T(lo))/(hi-lo)), which cancels the
@@ -299,6 +302,66 @@ def bench_lstm(batch=64, hidden=512, emb=128, seqlen=100, vocab=30000,
     }
 
 
+def bench_transformer_lm(B=8, T=1024, dim=512, heads=8, layers_n=8,
+                         vocab=32000, steps=(4, 24)):
+    """Decoder-only transformer LM training throughput (tokens/s + MFU):
+    the long-context flagship (models/transformer.py) with the pallas
+    flash-attention kernel, bf16 params, steps inside one lax.scan.
+    Beyond-reference capability — no 2018 baseline exists, reported for
+    the record."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from paddle_tpu.models import transformer as tlm
+
+    impl = "flash" if jax.default_backend() != "cpu" else "xla"
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=T,
+                                dtype=jnp.bfloat16)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    step = tlm.make_train_step(cfg, lr=1e-3, attn_impl=impl)
+
+    def multi(p, toks, n):
+        def body(c, _):
+            c, l = step(c, toks)
+            return c, l
+
+        return lax.scan(body, p, None, length=n)
+
+    runners = {n: jax.jit(lambda p, t, n=n: multi(p, t, n)) for n in steps}
+    rng = np.random.RandomState(0)
+    toks = jax.device_put(
+        rng.randint(0, vocab, (B, T + 1)).astype(np.int32))
+
+    ts = {}
+    for n in steps:
+        p2, losses = runners[n](params, toks)  # compile + warm
+        assert np.isfinite(float(np.ravel(np.asarray(losses))[-1]))
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.time()
+            p2, losses = runners[n](params, toks)
+            float(np.ravel(np.asarray(losses))[-1])  # force
+            best = min(best, time.time() - t0)
+        ts[n] = best
+    dt = (ts[steps[1]] - ts[steps[0]]) / (steps[1] - steps[0])
+    assert dt > 0, "timing inversion: %r" % ts
+
+    # FLOPs: matmul params (tied head counted once at the logits matmul)
+    p_mat = vocab * dim + layers_n * 12 * dim * dim
+    fwd = 2.0 * B * T * p_mat + layers_n * B * 2.0 * T * T * dim  # causal
+    tok_per_sec = B * T / dt
+    return {
+        "tokens_per_sec": round(tok_per_sec, 1),
+        "ms_per_step": round(dt * 1e3, 2),
+        "batch": B,
+        "seq_len": T,
+        "attn_impl": impl,
+        "mfu": round(3.0 * fwd / dt / PEAK_FLOPS, 4),
+    }
+
+
 def bench_flash_attention(B=4, T=4096, H=16, D=64, iters=20):
     """Pallas flash attention vs XLA full-matrix attention, single chip
     (parallel/flash_attention.py). Forward-only timing; the memory win
@@ -371,7 +434,20 @@ def main():
         # Budget from ACTUAL elapsed init time (a fast init must not
         # shrink the run budget; a total <= init_timeout must still arm)
         remaining = total_timeout - (time.monotonic() - start)
-        if remaining > 0 and not _bench_finished.wait(remaining):
+        if remaining <= 0:
+            # init alone consumed the whole budget: report rather than
+            # silently disarming mid-run coverage
+            print(
+                json.dumps({
+                    "metric": "bench_error",
+                    "error": "device init consumed the whole "
+                             "BENCH_TOTAL_TIMEOUT_S=%g budget"
+                             % total_timeout,
+                }),
+                flush=True,
+            )
+            os._exit(3)
+        if not _bench_finished.wait(remaining):
             print(
                 json.dumps({
                     "metric": "bench_error",
@@ -435,7 +511,11 @@ def main():
         run("vgg16", lambda: bench_image("vgg16", lambda i, c: vgg16(i, c), 64))
         run("lstm", bench_lstm)
         run("flash_attention", bench_flash_attention)
+        run("transformer_lm", bench_transformer_lm)
 
+    # r3 batch sweep: 512 is past the knee (~2.4k img/s); 128 vs 256 is
+    # within the tunnel's run-to-run noise (2.5-3.8k observed), so the
+    # default stays at the historically comparable 128
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     chunk_steps = int(os.environ.get("BENCH_CHUNK_STEPS", "25"))
     n_chunks = int(os.environ.get("BENCH_CHUNKS", "6"))

@@ -94,6 +94,16 @@ class DistributeTranspiler(object):
 
         from ..parallel.mesh import get_default_mesh, make_mesh, set_default_mesh
 
+        if not getattr(self, "_sync_mode", True):
+            # fire at the point of use too — the transpile-time warning
+            # may be long scrolled away
+            warnings.warn(
+                "AsyncSGD was requested (sync_mode=False): exe.run on "
+                "this program is synchronous; drive it with "
+                "Executor.run_async_local(steps, sync_every) for the "
+                "local-SGD async semantics"
+            )
+
         if get_default_mesh() is None:
             n = min(self._trainers, jax.device_count())
             if n > 1:

@@ -51,7 +51,6 @@ def _build(lr=0.1, momentum=None):
 
 
 def test_sync_every_1_equals_sync_dp():
-    x, y, = None, None
     x, y = _data()
     mesh = parallel.make_mesh({"data": 8})
 
