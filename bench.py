@@ -84,21 +84,24 @@ def _build_image_workload(fluid, model_fn, batch, class_dim=1000, uint8_input=Fa
     return main_prog, startup, avg_cost
 
 
-def _per_step_seconds(exe, prog, feed, fetch, s_lo, s_hi):
+_DEADLINE = None  # monotonic deadline set by main(); guards extra compiles
+
+
+def _diff_time(run_at, s_lo, s_hi):
     """Steady-state per-step seconds by differencing two multi-step calls
-    (cancels the per-call dispatch/sync overhead of the tunnel)."""
+    (cancels the per-call dispatch/sync overhead of the tunnel).
+    `run_at(steps)` must execute `steps` iterations and block until the
+    result is real. Warm both step counts first (compile), then best-of-2
+    per count: a single tunnel hiccup in either call would otherwise
+    corrupt (or even negate) the difference."""
     ts = {}
     for s in (s_lo, s_hi):
-        out = exe.run_repeated(prog, feed=feed, fetch_list=[fetch], steps=s)
-        assert np.isfinite(np.ravel(out[0])[-1]), "non-finite loss in warmup"
-    # best-of-2 per step count: a single tunnel hiccup in either call
-    # would otherwise corrupt (or even negate) the difference
+        run_at(s)  # compile + warm
     for s in (s_lo, s_hi):
         best = float("inf")
         for _ in range(2):
             t0 = time.time()
-            out = exe.run_repeated(prog, feed=feed, fetch_list=[fetch], steps=s)
-            float(np.ravel(out[0])[-1])  # force
+            run_at(s)
             best = min(best, time.time() - t0)
         ts[s] = best
     dt = (ts[s_hi] - ts[s_lo]) / (s_hi - s_lo)
@@ -106,7 +109,47 @@ def _per_step_seconds(exe, prog, feed, fetch, s_lo, s_hi):
     return dt
 
 
-def bench_image(name, model_fn, batch, steps=(12, 72), baseline_ips=None):
+def _per_step_seconds(exe, prog, feed, fetch, s_lo, s_hi):
+    def run_at(s):
+        out = exe.run_repeated(prog, feed=feed, fetch_list=[fetch], steps=s)
+        v = np.ravel(out[0])[-1]
+        assert np.isfinite(float(v)), "non-finite loss"
+
+    return _diff_time(run_at, s_lo, s_hi)
+
+
+def _xla_step_cost(prog, cost, feed):
+    """XLA's own cost model for the compiled train step: flops + bytes
+    accessed. The model-FLOPs MFU we report is conservative — XLA counts
+    ~1.8x more flops for ResNet-50 (backward convs via dilated convs are
+    tallied over the dilated windows) — so the record carries both.
+    Costs one extra XLA compile (lower().cost_analysis() without compile
+    returns None on this backend), so callers deadline-guard it."""
+    import jax
+
+    from paddle_tpu.fluid.core.lowering import build_step_fn
+    from paddle_tpu.fluid.executor import global_scope
+
+    scope = global_scope()
+    persist_names = sorted(
+        v.name for v in prog.list_vars() if v.persistable)
+    persist_in = {n: scope.get(n) for n in persist_names if n in scope}
+    fn, _ = build_step_fn(
+        prog, feed_names=list(feed), fetch_names=[cost.name],
+        persist_names=persist_names, persist_in=list(persist_in))
+    ca = (
+        jax.jit(fn)
+        .lower(persist_in, feed, jax.random.PRNGKey(0))
+        .compile()
+        .cost_analysis()
+    )
+    if isinstance(ca, list):
+        ca = ca[0]
+    return float(ca.get("flops", 0.0)), float(ca.get("bytes accessed", 0.0))
+
+
+def bench_image(name, model_fn, batch, steps=(12, 72), baseline_ips=None,
+                xla_cost=False):
     import jax
 
     import paddle_tpu.fluid as fluid
@@ -120,7 +163,6 @@ def bench_image(name, model_fn, batch, steps=(12, 72), baseline_ips=None):
         "label": jax.device_put(rng.randint(0, 1000, (batch, 1)).astype(np.int32)),
     }
     dt = _per_step_seconds(exe, prog, feed, cost, *steps)
-    exe.close()
     img_per_sec = batch / dt
     rec = {
         "img_per_sec": round(img_per_sec, 2),
@@ -128,6 +170,20 @@ def bench_image(name, model_fn, batch, steps=(12, 72), baseline_ips=None):
         "batch": batch,
         "mfu": round(img_per_sec * 3 * FWD_FLOPS[name] / PEAK_FLOPS, 4),
     }
+    if (
+        xla_cost
+        and os.environ.get("BENCH_XLA_COST", "1") == "1"
+        # the extra compile must not push a near-budget run into the
+        # watchdog: skip when under 5 minutes remain
+        and (_DEADLINE is None or _DEADLINE - time.monotonic() > 300)
+    ):
+        try:
+            flops, hbm_bytes = _xla_step_cost(prog, cost, feed)
+            rec["xla_flops_util"] = round(flops / dt / PEAK_FLOPS, 4)
+            rec["hbm_GBps"] = round(hbm_bytes / dt / 1e9, 1)
+        except Exception as e:  # cost model is informational only
+            rec["xla_cost_error"] = "%s: %s" % (type(e).__name__, e)
+    exe.close()
     if baseline_ips:
         rec["vs_baseline"] = round(img_per_sec / baseline_ips, 4)
     return rec
@@ -334,19 +390,11 @@ def bench_transformer_lm(B=8, T=1024, dim=512, heads=8, layers_n=8,
     toks = jax.device_put(
         rng.randint(0, vocab, (B, T + 1)).astype(np.int32))
 
-    ts = {}
-    for n in steps:
-        p2, losses = runners[n](params, toks)  # compile + warm
+    def run_at(s):
+        _, losses = runners[s](params, toks)
         assert np.isfinite(float(np.ravel(np.asarray(losses))[-1]))
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.time()
-            p2, losses = runners[n](params, toks)
-            float(np.ravel(np.asarray(losses))[-1])  # force
-            best = min(best, time.time() - t0)
-        ts[n] = best
-    dt = (ts[steps[1]] - ts[steps[0]]) / (steps[1] - steps[0])
-    assert dt > 0, "timing inversion: %r" % ts
+
+    dt = _diff_time(run_at, *steps)
 
     # FLOPs: matmul params (tied head counted once at the logits matmul)
     p_mat = vocab * dim + layers_n * 12 * dim * dim
@@ -415,6 +463,8 @@ def main():
 
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "1200"))
     total_timeout = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "7200"))
+    global _DEADLINE
+    _DEADLINE = time.monotonic() + total_timeout
     init_done = threading.Event()
 
     def _watchdog():
@@ -534,6 +584,7 @@ def main():
         "resnet50",
         lambda i, c: resnet_imagenet(i, class_dim=c, depth=50),
         batch,
+        xla_cost=True,
     )
     workloads["resnet50"] = headline
 
