@@ -9,6 +9,7 @@ generators are the fallback, not the format)."""
 
 from . import (  # noqa: F401
     cifar,
+    criteo,
     common,
     conll05,
     flowers,
@@ -27,5 +28,5 @@ from . import (  # noqa: F401
 __all__ = [
     "mnist", "cifar", "imdb", "imikolov", "movielens", "uci_housing",
     "wmt14", "wmt16", "conll05", "sentiment", "flowers", "voc2012",
-    "mq2007", "common",
+    "mq2007", "criteo", "common",
 ]
