@@ -25,6 +25,7 @@
 #include <fstream>
 #include <map>
 #include <memory>
+#include <limits>
 #include <sstream>
 #include <string>
 #include <vector>
@@ -652,9 +653,15 @@ static bool run_op(Model& m, const OpDesc& op) {
     Tensor& ids = m.vars[op.in("Ids")];
     Tensor* o = named(m, op.out("Out"));
     int64_t V = w.shape[0], D = w.shape[1], n = ids.numel();
-    o->shape = {n, D};
+    // mirror the Python kernel's shape rule (kernels_tensor.py
+    // _lookup_table): [N,1] ids -> [N,D]; otherwise ids.shape + [D]
+    // (multi-field CTR ids [B,F] -> [B,F,D])
+    o->shape = ids.shape;
+    if (!o->shape.empty() && o->shape.back() == 1) o->shape.pop_back();
+    o->shape.push_back(D);
     o->is_int = false;
     o->f.resize(n * D);
+    int64_t padding_idx = (int64_t)op.attr_num("padding_idx", -1);
     for (int64_t k = 0; k < n; ++k) {
       int64_t id = ids.is_int ? ids.i[k] : (int64_t)ids.f[k];
       if (id < 0 || id >= V) {  // external feeds are untrusted
@@ -662,7 +669,67 @@ static bool run_op(Model& m, const OpDesc& op) {
                   " (vocab " + std::to_string(V) + ")";
         return false;
       }
-      memcpy(&o->f[k * D], &w.f[id * D], D * sizeof(float));
+      if (id == padding_idx)  // kernels_tensor.py: padding rows read 0
+        memset(&o->f[k * D], 0, D * sizeof(float));
+      else
+        memcpy(&o->f[k * D], &w.f[id * D], D * sizeof(float));
+    }
+    return true;
+  }
+  if (t == "reduce_sum" || t == "reduce_mean" || t == "reduce_max") {
+    Tensor& x = m.vars[op.in("X")];
+    Tensor* o = named(m, op.out("Out"));
+    bool keep = op.attr_bool("keep_dim", false);
+    int rank = (int)x.shape.size();
+    std::vector<bool> red(rank, false);
+    if (op.attr_bool("reduce_all", false)) {
+      red.assign(rank, true);
+    } else {
+      std::vector<int64_t> dims = op.attr_ints("dim");
+      if (dims.empty()) dims.push_back((int64_t)op.attr_num("dim", 0));
+      for (int64_t d : dims) {
+        if (d < 0) d += rank;
+        if (d < 0 || d >= rank) {  // model files are untrusted input
+          m.error = t + " dim out of range for rank " +
+                    std::to_string(rank);
+          return false;
+        }
+        red[d] = true;
+      }
+    }
+    std::vector<int64_t> oshape;
+    for (int k = 0; k < rank; ++k) {
+      if (!red[k])
+        oshape.push_back(x.shape[k]);
+      else if (keep)
+        oshape.push_back(1);
+    }
+    if (oshape.empty()) oshape.push_back(1);
+    int64_t onum = 1;
+    for (int64_t s : oshape) onum *= s;
+    bool is_max = (t == "reduce_max");
+    o->shape = oshape;
+    o->is_int = false;
+    o->f.assign(onum, is_max ? -std::numeric_limits<float>::infinity()
+                             : 0.f);
+    std::vector<int64_t> idx(rank, 0);
+    for (int64_t k = 0; k < x.numel(); ++k) {
+      int64_t oi = 0;
+      for (int q = 0; q < rank; ++q)
+        if (!red[q]) oi = oi * x.shape[q] + idx[q];
+      if (is_max)
+        o->f[oi] = std::max(o->f[oi], x.at(k));
+      else
+        o->f[oi] += x.at(k);
+      for (int q = rank - 1; q >= 0; --q) {
+        if (++idx[q] < x.shape[q]) break;
+        idx[q] = 0;
+      }
+    }
+    if (t == "reduce_mean") {
+      // every output cell reduces the same number of input elements
+      int64_t div = std::max<int64_t>(x.numel() / onum, 1);
+      for (int64_t k = 0; k < onum; ++k) o->f[k] /= div;
     }
     return true;
   }
