@@ -407,32 +407,116 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             raise AssertionError("gradient check failed: %r" % results)
         return {"checkgrad": results}
 
+    # AsyncSGD (reference TrainerConfig.proto OptimizationConfig.algorithm
+    # = 'async_sgd'; legacy settings(algorithm='async_sgd')): on a mesh,
+    # run the local-SGD redesign — buffer `async_sync_every` dense
+    # batches and execute them as one run_async_local round
+    # (parallel/async_sgd.py). Without a mesh (or with ragged feeds) the
+    # loop below stays synchronous, which is the documented fallback.
+    extra = settings.get("extra") or {}
+    async_every = 0
+    if extra.get("algorithm") == "async_sgd" and job == "train":
+        if mesh is not None:
+            async_every = max(int(extra.get("async_sync_every", 1)), 1)
+        else:
+            import warnings
+
+            warnings.warn(
+                "settings(algorithm='async_sgd') needs trainer_count>1 "
+                "devices; running synchronously"
+            )
+
     stats = dict(batches=0, cost=None, ms_per_batch=None, img_per_sec=None)
     times: List[float] = []
+    state_box = {"async_every": async_every, "pass_id": 0}
+
+    def _record(costs, dt_per):
+        for cost in costs:
+            stats["batches"] += 1
+            stats["cost"] = cost
+            if stats["batches"] == 1:
+                stats["first_cost"] = cost
+            # the first batches include compilation; reference --job=time
+            # also skips a warmup via log_period
+            if stats["batches"] > min(log_period, 5):
+                times.append(dt_per)
+            if stats["batches"] % log_period == 0:
+                print(
+                    "Pass %d, Batch %d, Cost %.4f"
+                    % (state_box["pass_id"], stats["batches"], cost)
+                )
+
+    def _run_sync(feed):
+        (cost,) = exe.run(
+            topo.main_program, feed=feed, fetch_list=[cost_var]
+        )
+        return [float(np.ravel(np.asarray(cost))[0])]
+
+    def _async_fallback(msg):
+        import warnings
+
+        warnings.warn("async_sgd: %s; running synchronously" % msg)
+        state_box["async_every"] = 0
+
+    def _run_async_buffer(buf):
+        """Stack buffered feeds [K, B, ...] and run one local-SGD round.
+        Batches the mesh cannot shard evenly run synchronously instead
+        (the sync executor replicates such feeds; shard_map cannot)."""
+        n_data = mesh.shape["data"]
+        first = next(iter(buf[0].values()))
+        if np.shape(first)[0] % n_data:
+            costs = []
+            for f in buf:
+                costs += _run_sync(f)
+            return costs
+        stacked = {
+            k: np.stack([f[k] for f in buf]) for k in buf[0]
+        }
+        losses = exe.run_async_local(
+            topo.main_program, feed=stacked, fetch_list=[cost_var],
+            steps=len(buf), sync_every=len(buf),
+        )[0]
+        return [float(v) for v in np.ravel(np.asarray(losses))]
+
     with fluid.executor.scope_guard(scope):
         for pass_id in range(num_passes):
+            state_box["pass_id"] = pass_id
+            buf = []
             for feed in _batches(
                 provider_reader, slots, topo._data_layers, batch_size
             ):
                 t0 = time.time()
-                (cost,) = exe.run(
-                    topo.main_program, feed=feed, fetch_list=[cost_var]
-                )
-                cost = float(np.ravel(np.asarray(cost))[0])
-                dt = time.time() - t0
-                stats["batches"] += 1
-                stats["cost"] = cost
-                if stats["batches"] == 1:
-                    stats["first_cost"] = cost
-                # the first batches include compilation; reference --job=time
-                # also skips a warmup via log_period
-                if stats["batches"] > min(log_period, 5):
-                    times.append(dt)
-                if stats["batches"] % log_period == 0:
-                    print(
-                        "Pass %d, Batch %d, Cost %.4f"
-                        % (pass_id, stats["batches"], cost)
-                    )
+                if state_box["async_every"] and any(
+                    isinstance(v, tuple) for v in feed.values()
+                ):
+                    # ragged (LoD) batches change shape per step; the
+                    # documented fallback is the synchronous loop
+                    for f in buf:
+                        _record(_run_sync(f), time.time() - t0)
+                    buf = []
+                    _async_fallback("LoD feeds cannot stack across steps")
+                if state_box["async_every"]:
+                    costs = []
+                    if buf and any(
+                        np.shape(feed[k]) != np.shape(buf[0][k])
+                        for k in feed
+                    ):
+                        # flush a buffer the new batch can't stack with
+                        costs += _run_async_buffer(buf)
+                        buf = []
+                    buf.append(feed)
+                    if len(buf) == state_box["async_every"]:
+                        costs += _run_async_buffer(buf)
+                        buf = []
+                    if not costs:
+                        continue
+                else:
+                    costs = _run_sync(feed)
+                _record(costs, (time.time() - t0) / len(costs))
+            if buf:
+                t0 = time.time()
+                costs = _run_async_buffer(buf)
+                _record(costs, (time.time() - t0) / len(costs))
             if save_dir and saving_period and \
                     job not in ("test", "checkgrad") and \
                     (pass_id + 1) % saving_period == 0:

@@ -206,3 +206,71 @@ def test_cli_save_per_pass_and_resume(tmp_path):
     # first cost continues from (not restarts above) the trained model
     assert out2["first_cost"] <= out1["first_cost"], (out1, out2)
     assert np.isfinite(out2["cost"])
+
+
+ASYNC_CONFIG = textwrap.dedent(
+    """
+    dim = 16
+    num_class = 4
+    settings(
+        batch_size=16,
+        learning_rate=0.1,
+        learning_method=MomentumOptimizer(0.9),
+        algorithm='async_sgd',
+        async_sync_every=2)
+
+    x = data_layer(name='x', size=dim)
+    net = fc_layer(input=x, size=32, act=TanhActivation())
+    net = fc_layer(input=net, size=num_class, act=SoftmaxActivation())
+    lbl = data_layer(name='label', size=num_class)
+    outputs(cross_entropy(name='loss', input=net, label=lbl))
+    """
+)
+
+
+def test_cli_async_sgd_local_sgd(tmp_path):
+    """settings(algorithm='async_sgd') (reference OptimizationConfig
+    .algorithm) on a multi-trainer mesh trains via the local-SGD
+    redesign (Executor.run_async_local), two batches per sync round."""
+    (tmp_path / "async_config.py").write_text(ASYNC_CONFIG)
+    stats = run_config(
+        str(tmp_path / "async_config.py"),
+        job="train",
+        trainer_count=8,
+        num_passes=6,
+        log_period=100,
+    )
+    # SimpleData provider synthesizes 256 samples -> 16 batches/pass
+    assert stats["batches"] == 6 * 16
+    assert np.isfinite(stats["cost"])
+    assert stats["cost"] < stats["first_cost"] * 0.7, stats
+
+
+def test_cli_async_sgd_single_device_warns(tmp_path):
+    (tmp_path / "async_config.py").write_text(ASYNC_CONFIG)
+    import warnings as w
+
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        stats = run_config(
+            str(tmp_path / "async_config.py"),
+            job="train", trainer_count=1, num_passes=1, log_period=100,
+        )
+    assert any("async_sgd" in str(x.message) for x in rec)
+    assert np.isfinite(stats["cost"])
+
+
+def test_cli_async_sgd_nondivisible_batch_falls_back(tmp_path):
+    """Batches the mesh cannot shard evenly (20 % 8 != 0) must run
+    synchronously instead of crashing shard_map; first_cost bookkeeping
+    must survive the pass-end flush path (async_sync_every > batches)."""
+    cfg = ASYNC_CONFIG.replace("batch_size=16", "batch_size=20").replace(
+        "async_sync_every=2", "async_sync_every=1000")
+    (tmp_path / "async_config.py").write_text(cfg)
+    stats = run_config(
+        str(tmp_path / "async_config.py"),
+        job="train", trainer_count=8, num_passes=2, log_period=100,
+    )
+    assert stats["batches"] > 0
+    assert "first_cost" in stats
+    assert np.isfinite(stats["cost"])
