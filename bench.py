@@ -410,12 +410,16 @@ def bench_transformer_lm(B=8, T=1024, dim=512, heads=8, layers_n=8,
     }
 
 
-def bench_flash_attention(B=4, T=4096, H=16, D=64, iters=20):
-    """Pallas flash attention vs XLA full-matrix attention, single chip
-    (parallel/flash_attention.py). Forward-only timing; the memory win
-    is the point, the MXU time should at least match."""
+def bench_flash_attention(B=4, T=4096, H=16, D=64, steps=(4, 16)):
+    """Pallas flash attention vs XLA full-matrix attention, single chip,
+    bf16, causal (parallel/flash_attention.py). Timing puts the
+    iterations inside one lax.scan and differences two step counts —
+    per-call timing is invalid on this harness (the tunnel acks
+    dispatches before execution and memoizes repeated identical calls;
+    both failure modes observed in r3)."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from paddle_tpu.parallel import flash_attention, reference_attention
 
@@ -424,30 +428,49 @@ def bench_flash_attention(B=4, T=4096, H=16, D=64, iters=20):
                            "(CPU runs it in interpret mode only)"}
 
     rng = np.random.RandomState(0)
-    q, k, v = (
-        jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.1)
-        for _ in range(3)
-    )
+    base = rng.randn(B, T, H, D).astype(np.float32) * 0.1
+    q = jnp.asarray(base + 1e-3, jnp.bfloat16)
+    k = jnp.asarray(base, jnp.bfloat16)
+    v = jnp.asarray(base * 0.5, jnp.bfloat16)
 
-    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    ref = jax.jit(lambda q, k, v: reference_attention(q, k, v, causal=True))
+    def per_iter(attn):
+        def multi(n):
+            @jax.jit
+            def f(q, k, v):
+                def body(c, _):
+                    o = attn(c, k, v)
+                    # feed the output back so no iteration is dead code
+                    return (c + 1e-6 * o).astype(c.dtype), ()
 
-    def timed(fn):
-        fn(q, k, v).block_until_ready()  # compile
-        t0 = time.time()
-        for _ in range(iters):
-            out = fn(q, k, v)
-        out.block_until_ready()
-        return (time.time() - t0) / iters * 1e3
+                out, _ = lax.scan(body, q, None, length=n)
+                return out.sum()
 
-    ms_flash = timed(flash)
-    ms_ref = timed(ref)
-    err = float(jnp.abs(flash(q, k, v) - ref(q, k, v)).max())
+            return f
+
+        fs = {n: multi(n) for n in steps}
+
+        def run_at(n):
+            float(fs[n](q, k, v))  # scalar readback forces completion
+
+        return _diff_time(run_at, *steps)
+
+    ms_flash = per_iter(
+        lambda c, kk, vv: flash_attention(c, kk, vv, causal=True)) * 1e3
+    ms_ref = per_iter(
+        lambda c, kk, vv: reference_attention(c, kk, vv, causal=True)) * 1e3
+    err = float(jnp.abs(
+        flash_attention(q, k, v, causal=True).astype(jnp.float32)
+        - reference_attention(q, k, v, causal=True).astype(jnp.float32)
+    ).max())
+    # causal attention fwd FLOPs: 2 matmuls, half the T^2 window
+    flops = 2.0 * B * H * T * T * D
     return {
         "ms_flash": round(ms_flash, 3),
         "ms_xla_full": round(ms_ref, 3),
         "speedup": round(ms_ref / ms_flash, 3),
+        "flash_tflops": round(flops / (ms_flash / 1e3) / 1e12, 1),
         "max_err": err,
+        "dtype": "bfloat16",
         "shape": [B, T, H, D],
     }
 

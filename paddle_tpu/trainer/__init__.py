@@ -430,15 +430,16 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
     times: List[float] = []
     state_box = {"async_every": async_every, "pass_id": 0}
 
-    def _record(costs, dt_per):
+    def _record(costs, dt_per, skip_times=False):
         for cost in costs:
             stats["batches"] += 1
             stats["cost"] = cost
             if stats["batches"] == 1:
                 stats["first_cost"] = cost
             # the first batches include compilation; reference --job=time
-            # also skips a warmup via log_period
-            if stats["batches"] > min(log_period, 5):
+            # also skips a warmup via log_period. Async rounds with a
+            # fresh step-count signature compile too (skip_times).
+            if stats["batches"] > min(log_period, 5) and not skip_times:
                 times.append(dt_per)
             if stats["batches"] % log_period == 0:
                 print(
@@ -461,7 +462,9 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
     def _run_async_buffer(buf):
         """Stack buffered feeds [K, B, ...] and run one local-SGD round.
         Batches the mesh cannot shard evenly run synchronously instead
-        (the sync executor replicates such feeds; shard_map cannot)."""
+        (the sync executor replicates such feeds; shard_map cannot).
+        Flags a compile-bearing run (fresh step-count signature) in
+        state_box so its wall time stays out of the throughput stats."""
         n_data = mesh.shape["data"]
         first = next(iter(buf[0].values()))
         if np.shape(first)[0] % n_data:
@@ -469,6 +472,9 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             for f in buf:
                 costs += _run_sync(f)
             return costs
+        seen = state_box.setdefault("async_seen_steps", set())
+        state_box["async_cold"] = len(buf) not in seen
+        seen.add(len(buf))
         stacked = {
             k: np.stack([f[k] for f in buf]) for k in buf[0]
         }
@@ -492,9 +498,11 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
                     # ragged (LoD) batches change shape per step; the
                     # documented fallback is the synchronous loop
                     for f in buf:
-                        _record(_run_sync(f), time.time() - t0)
+                        tf = time.time()
+                        _record(_run_sync(f), time.time() - tf)
                     buf = []
                     _async_fallback("LoD feeds cannot stack across steps")
+                    t0 = time.time()
                 if state_box["async_every"]:
                     costs = []
                     if buf and any(
@@ -512,11 +520,13 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
                         continue
                 else:
                     costs = _run_sync(feed)
-                _record(costs, (time.time() - t0) / len(costs))
+                _record(costs, (time.time() - t0) / len(costs),
+                        skip_times=state_box.pop("async_cold", False))
             if buf:
                 t0 = time.time()
                 costs = _run_async_buffer(buf)
-                _record(costs, (time.time() - t0) / len(costs))
+                _record(costs, (time.time() - t0) / len(costs),
+                        skip_times=state_box.pop("async_cold", False))
             if save_dir and saving_period and \
                     job not in ("test", "checkgrad") and \
                     (pass_id + 1) % saving_period == 0:

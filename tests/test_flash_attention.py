@@ -57,9 +57,24 @@ def test_flash_gradients():
 
 
 def test_flash_validates():
-    q, k, v = _qkv(np.random.RandomState(3), T=100)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    # non-power-of-two T: blocks halve until they divide (T=768: 512 ->
+    # 256), result still matches the reference
+    q, k, v = _qkv(np.random.RandomState(3), T=768)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # T=100 clamps to one whole-sequence block
+    q4, k4, v4 = _qkv(np.random.RandomState(6), T=100)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q4, k4, v4, interpret=True)),
+        np.asarray(reference_attention(q4, k4, v4)),
+        rtol=2e-5, atol=2e-5)
+    # lengths whose largest power-of-two factor is tiny (1034 = 2*11*47)
+    # refuse instead of degrading to a 2-row-block grid
+    q3, k3, v3 = _qkv(np.random.RandomState(5), T=1034)
+    with pytest.raises(ValueError, match="pad the sequence"):
+        flash_attention(q3, k3, v3, interpret=True)
     q2, k2, v2 = _qkv(np.random.RandomState(4), T=128)
     with pytest.raises(ValueError):
         flash_attention(q2, k2[:, :64], v2[:, :64], causal=True,

@@ -666,6 +666,10 @@ spec("sequence_slice",
 spec("reverse", ins={"X": R(91).randn(2, 3, 4).astype(np.float32)},
      attrs={"axis": [1, 2]}, grad=True,
      oracle=lambda i, a: {"Out": i["X"][:, ::-1, ::-1]})
+spec("sequence_reverse", ins={"X": R(95).randn(6, 3).astype(np.float32)},
+     lods={"sequence_reverse_x_0": _lod6}, grad=True,
+     oracle=lambda i, a: {"Out": np.concatenate([
+         i["X"][0:2][::-1], i["X"][2:6][::-1]])})
 spec("sequence_softmax", ins={"X": R(81).randn(6, 1).astype(np.float32)},
      lods={"sequence_softmax_x_0": _lod6}, grad=True,
      gtol=(8e-2, 1e-3),
