@@ -66,7 +66,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
     me = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    qf = (q * scale).astype(jnp.float32)
+    # keep the MXU matmuls in the input dtype (bf16 stays bf16) with f32
+    # accumulation via preferred_element_type; softmax stats are f32 and
+    # the scale multiplies the f32 scores post-matmul (folding it into
+    # bf16 q would round it — same rule as the flash kernel)
+    qf = q
 
     q_pos = me * T + jnp.arange(T)  # global row ids of the local queries
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -87,25 +91,48 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
     def step(carry, i):
         o, l, m, kb, vb = carry
         src = (me - i) % n  # which shard's K/V block we hold this step
-        k_pos = src * T + jnp.arange(T)
-        s = jnp.einsum("bthd,bshd->bhts", qf, kb.astype(jnp.float32))
+
+        def accumulate(o, l, m, kb, vb):
+            k_pos = src * T + jnp.arange(T)
+            s = jnp.einsum("bthd,bshd->bhts", qf, kb,
+                           preferred_element_type=jnp.float32) * scale
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]
+                s = jnp.where(mask[None, None], s, _NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            # fully-masked rows keep m_new at -inf; shift by a safe max
+            # so exp never sees inf-inf
+            safe = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
+            p = jnp.exp(s - safe[..., None])
+            p = jnp.where(s <= _NEG_INF, 0.0, p)
+            corr = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - safe))
+            l_new = l * corr + p.sum(axis=-1)
+            o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                "bhts,bshd->bthd", p.astype(vb.dtype), vb,
+                preferred_element_type=jnp.float32,
+            )
+            return o_new, l_new, m_new
+
         if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # fully-masked steps keep m_new at -inf; shift by a safe max so
-        # exp never sees inf-inf
-        safe = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
-        p = jnp.exp(s - safe[..., None])
-        p = jnp.where(s <= _NEG_INF, 0.0, p)
-        corr = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - safe))
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhts,bshd->bthd", p, vb.astype(jnp.float32)
-        )
+            # a source chunk strictly to the right of this shard's rows
+            # is fully masked: skip both matmuls. NOTE: the ring is
+            # lock-step (every device reaches the ppermute each step),
+            # so this frees compute/energy on the skipping devices but
+            # does NOT shorten the critical path — the last shard
+            # accumulates on every step. The latency fix is striped
+            # (zigzag) row assignment so all shards do ~half a block
+            # per step; future work.
+            o, l, m = lax.cond(
+                src > me,
+                lambda o, l, m, kb, vb: (o, l, m),
+                accumulate,
+                o, l, m, kb, vb,
+            )
+        else:
+            o, l, m = accumulate(o, l, m, kb, vb)
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        return (o, l, m_new, kb, vb), None
+        return (o, l, m, kb, vb), None
 
     (o, l, _, _, _), _ = lax.scan(step, (o0, l0, m0, k, v), jnp.arange(n))
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
