@@ -83,7 +83,21 @@ def run_op(ctx: LoweringContext, op, env: Dict[str, Any]):
     ins = {}
     lazy = op.type in _ENV_OPS
     for slot, names in op.inputs.items():
-        ins[slot] = [env.get(n) for n in names] if lazy else [env[n] for n in names]
+        if lazy:
+            ins[slot] = [env.get(n) for n in names]
+            continue
+        try:
+            ins[slot] = [env[n] for n in names]
+        except KeyError as e:
+            raise RuntimeError(
+                "op %r input %s=%r is not available: variable %r was "
+                "neither fed nor produced by an earlier op. Common cause:"
+                " fetching predictions from the TRAINING program without "
+                "feeding labels — optimizer ops keep the loss subgraph "
+                "alive; clone(for_test=True) BEFORE optimizer.minimize() "
+                "and run the clone instead." % (op.type, slot, names,
+                                                e.args[0])
+            ) from e
     # sequence kernels read LoD offsets / write output LoD via ctx.env
     ctx.op = op
     ctx.env = env
