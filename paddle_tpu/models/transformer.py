@@ -2,6 +2,11 @@
 long-context flagship (beyond-2018 capability; SURVEY §2.2 marks SP/ring
 attention absent in the reference, first-class here).
 
+With `moe_experts > 0` every `moe_every`-th block's MLP becomes a
+Switch-Transformer top-1 MoE FFN (parallel/moe.py) whose experts shard
+over the 'expert' mesh axis — the Switch-LM flagship of the
+expert-parallel path.
+
 Pure-JAX param-pytree model designed for a ('data', 'seq', 'model') mesh:
   * token embedding row-sharded over 'model' (parallel.sharded_lookup)
   * attention via parallel.sequence_parallel_attention (ring or Ulysses)
@@ -31,7 +36,8 @@ __all__ = ["TransformerConfig", "init_params", "param_specs", "forward",
 
 class TransformerConfig:
     def __init__(self, vocab=256, dim=128, heads=4, layers=2, mlp_mult=4,
-                 max_len=1024, dtype=jnp.float32):
+                 max_len=1024, dtype=jnp.float32, moe_experts=0,
+                 moe_every=2, moe_capacity_factor=1.25):
         self.vocab = vocab
         self.dim = dim
         self.heads = heads
@@ -39,6 +45,16 @@ class TransformerConfig:
         self.mlp_mult = mlp_mult
         self.max_len = max_len
         self.dtype = dtype
+        # Switch-Transformer MoE: with moe_experts > 0, every
+        # `moe_every`-th block's MLP becomes a top-1 MoE FFN
+        # (parallel/moe.py) — experts shard over the 'expert' mesh axis
+        self.moe_experts = moe_experts
+        self.moe_every = moe_every
+        self.moe_capacity_factor = moe_capacity_factor
+
+    def is_moe_block(self, i: int) -> bool:
+        return self.moe_experts > 0 and (i % self.moe_every
+                                         == self.moe_every - 1)
 
 
 def init_params(cfg: TransformerConfig, key) -> Dict[str, Any]:
@@ -57,37 +73,73 @@ def init_params(cfg: TransformerConfig, key) -> Dict[str, Any]:
     }
     for i in range(cfg.layers):
         kq, kk, kv, ko, k1, k2 = jax.random.split(ks[2 + i], 6)
-        params["blocks"].append({
+        # gate key derived separately so dense-model init stays
+        # bit-identical to pre-MoE checkpoints for the same seed
+        kg = jax.random.fold_in(ks[2 + i], 7)
+        blk = {
             "ln1": {"g": jnp.ones((d,), cfg.dtype), "b": jnp.zeros((d,), cfg.dtype)},
             "wq": dense(kq, (d, d)),
             "wk": dense(kk, (d, d)),
             "wv": dense(kv, (d, d)),
             "wo": dense(ko, (d, d)),
             "ln2": {"g": jnp.ones((d,), cfg.dtype), "b": jnp.zeros((d,), cfg.dtype)},
-            "w1": dense(k1, (d, cfg.mlp_mult * d)),
-            "w2": dense(k2, (cfg.mlp_mult * d, d)),
-        })
+        }
+        if cfg.is_moe_block(i):
+            E, m = cfg.moe_experts, cfg.mlp_mult * d
+            blk["moe"] = {
+                "gate_w": dense(kg, (d, E)),
+                "w1": dense(k1, (E, d, m)),
+                "b1": jnp.zeros((E, m), cfg.dtype),
+                "w2": dense(k2, (E, m, d)),
+                "b2": jnp.zeros((E, d), cfg.dtype),
+            }
+        else:
+            blk["w1"] = dense(k1, (d, cfg.mlp_mult * d))
+            blk["w2"] = dense(k2, (cfg.mlp_mult * d, d))
+        params["blocks"].append(blk)
     return params
 
 
-def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+def param_specs(cfg: TransformerConfig, mesh=None) -> Dict[str, Any]:
     """PartitionSpecs for tensor parallelism over 'model' + row-sharded
-    vocab. Megatron-style: qkv/w1 column-parallel, wo/w2 row-parallel."""
+    vocab + expert-sharded MoE FFNs. Megatron-style: qkv/w1
+    column-parallel, wo/w2 row-parallel. Pass `mesh` to drop axes the
+    mesh does not have (e.g. MoE params replicate on a mesh without an
+    'expert' axis, matching forward()'s reference_moe fallback)."""
     rep = P()
-    block = {
-        "ln1": {"g": rep, "b": rep},
-        "wq": P(None, "model"),
-        "wk": P(None, "model"),
-        "wv": P(None, "model"),
-        "wo": P("model", None),
-        "ln2": {"g": rep, "b": rep},
-        "w1": P(None, "model"),
-        "w2": P("model", None),
-    }
+
+    def fit(spec):
+        if mesh is None:
+            return spec
+        return P(*(a if a in mesh.axis_names else None for a in spec))
+
+    def block(i):
+        b = {
+            "ln1": {"g": rep, "b": rep},
+            "wq": fit(P(None, "model")),
+            "wk": fit(P(None, "model")),
+            "wv": fit(P(None, "model")),
+            "wo": fit(P("model", None)),
+            "ln2": {"g": rep, "b": rep},
+        }
+        if cfg.is_moe_block(i):
+            # experts shard over their leading E dim on 'expert'
+            b["moe"] = {
+                "gate_w": rep,
+                "w1": fit(P("expert", None, None)),
+                "b1": fit(P("expert", None)),
+                "w2": fit(P("expert", None, None)),
+                "b2": fit(P("expert", None)),
+            }
+        else:
+            b["w1"] = fit(P(None, "model"))
+            b["w2"] = fit(P("model", None))
+        return b
+
     return {
-        "embed": P("model", None),
+        "embed": fit(P("model", None)),
         "pos": rep,
-        "blocks": [block for _ in range(cfg.layers)],
+        "blocks": [block(i) for i in range(cfg.layers)],
         "ln_f": {"g": rep, "b": rep},
     }
 
@@ -120,7 +172,24 @@ def forward(params, tokens, cfg: TransformerConfig,
         )
         x = x + o.reshape(B, T, cfg.dim) @ blk["wo"]
         h = _ln(x, blk["ln2"])
-        x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
+        if "moe" in blk:
+            from ..parallel.moe import expert_parallel_moe, reference_moe
+
+            mp = blk["moe"]
+            flat = h.reshape(B * T, cfg.dim)
+            if mesh is not None and "expert" in mesh.axis_names and \
+                    mesh.shape["expert"] > 1:
+                y = expert_parallel_moe(
+                    flat, mp["gate_w"], mp["w1"], mp["b1"], mp["w2"],
+                    mp["b2"], mesh=mesh,
+                    capacity_factor=cfg.moe_capacity_factor,
+                )
+            else:
+                y = reference_moe(flat, mp["gate_w"], mp["w1"], mp["b1"],
+                                  mp["w2"], mp["b2"])
+            x = x + y.reshape(B, T, cfg.dim)
+        else:
+            x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
 
     x = _ln(x, params["ln_f"])
     return x @ params["embed"].T  # weight-tied output head
