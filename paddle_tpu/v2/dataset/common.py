@@ -102,6 +102,18 @@ def read_converted(paths):
     return reader
 
 
+def ranked_vocab(word_freq, cutoff=0):
+    """Frequency dictionary -> {word: id} ranked by (-freq, word), with
+    '<unk>' assigned the LAST id (the reference's build_dict convention,
+    shared by imdb/imikolov)."""
+    kept = [x for x in word_freq.items() if x[1] > cutoff]
+    ranked = sorted(kept, key=lambda x: (-x[1], x[0]))
+    words = [w for w, _ in ranked]
+    word_idx = dict(zip(words, range(len(words))))
+    word_idx["<unk>"] = len(words)
+    return word_idx
+
+
 def fetch_all():
     """Populate every dataset module's cache (reference common.fetch_all:
     iterates the whole dataset package; modules without fetch() skip)."""
