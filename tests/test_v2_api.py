@@ -582,3 +582,25 @@ def test_wmt14_wmt16_real_format_decode(tmp_path, monkeypatch):
     # de column is the reversed en sentence: structural check through ids
     de = wmt16.get_dict("de", 40)
     assert any(w.endswith("de") for w in de)
+
+
+def test_movielens_zip_decode(tmp_path, monkeypatch):
+    """movielens: ml-1m.zip of ::-separated .dat files — year stripped
+    from titles, corpus-built dicts, rating*2-5, seeded split."""
+    from paddle_tpu.v2.dataset import common, movielens
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    fallback = list(movielens.train()())[:5]
+    movielens.fetch()
+    decoded = list(movielens.train()())[:5]
+    assert decoded == fallback
+    uid, gender, age, job, mid, cats, title, rating = decoded[0]
+    assert gender in (0, 1)
+    assert 0 <= age < len(movielens.age_table)
+    assert -3.0 <= rating[0] <= 5.0
+    assert all(c in movielens.movie_categories().values() for c in cats)
+    # train/test partition the ratings deterministically
+    n_train = len(list(movielens.train()()))
+    n_test = len(list(movielens.test()()))
+    assert n_train + n_test == movielens.N_RATINGS
+    assert n_test > 0
