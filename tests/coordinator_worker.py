@@ -34,8 +34,11 @@ def main():
         )
         coord.set_dataset(list(range(int(n_shards))))  # idempotent on recover
         server = CoordinatorServer(coord, port=int(port))
-        with open(out_path, "w") as f:
+        # atomic publish: a reader polling for the file's existence must
+        # never see a partial document
+        with open(out_path + ".tmp", "w") as f:
             json.dump({"addr": server.address}, f)
+        os.replace(out_path + ".tmp", out_path)
         server.serve_forever()
 
     elif role == "work":

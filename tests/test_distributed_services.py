@@ -283,12 +283,16 @@ def test_coordinator_tcp_service_kill_resume(tmp_path):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )
     try:
-        for _ in range(200):
+        # generous deadline: under a fully loaded CI box the server
+        # process can take many seconds to import + bind
+        addr = None
+        for _ in range(1200):
             if os.path.exists(serve_out):
+                addr = json.load(open(serve_out))["addr"]
                 break
             assert server.poll() is None, server.communicate()[1][-2000:]
             _time.sleep(0.05)
-        addr = json.load(open(serve_out))["addr"]
+        assert addr is not None, "coordinator server never published its address"
 
         out_a = str(tmp_path / "worker_a.txt")
         out_b = str(tmp_path / "worker_b.txt")
