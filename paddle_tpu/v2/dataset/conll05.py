@@ -1,43 +1,299 @@
-"""CoNLL-2005 SRL reader creators (reference dataset/conll05.py API:
-get_dict() -> (word_dict, verb_dict, label_dict); test() yields the
-9-field record used by the label_semantic_roles book test)."""
+"""CoNLL-2005 SRL reader creators (reference dataset/conll05.py:
+conll05st-tests.tar.gz holding `conll05st-release/test.wsj/words/
+test.wsj.words.gz` (one token per line, blank line between sentences)
+and `.../props/test.wsj.props.gz` (per line: predicate-lemma column +
+one bracket-label column per predicate — `(A0*`, `*`, `*)`, `(V*)` ...);
+plus wordDict.txt / verbDict.txt / targetDict.txt files loaded by line
+number. The bracket columns convert to B-/I-/O tag sequences and each
+predicate yields one 9-field sample: word ids, 5 predicate-context
+columns (bos/eos padded), predicate id, context mark, label ids —
+conll05.py:132-178 semantics with UNK_IDX=0.
+
+fetch() synthesises REAL-FORMAT files (tarball with gzipped members,
+dict text files, f32 embedding blob) from the deterministic corpus;
+real downloads decode through the same parser.
+"""
+
+import gzip
+import io
+import itertools
+import os
+import tarfile
 
 from . import common
 
-__all__ = ["get_dict", "get_embedding", "test"]
+__all__ = ["get_dict", "get_embedding", "test", "fetch", "convert"]
 
-_N_WORDS, _N_VERBS, _N_LABELS = 120, 20, 9
+UNK_IDX = 0
+_WORDS_MEMBER = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+_PROPS_MEMBER = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+N_SENTENCES = 128
+_WORD_POOL = ["w%02d" % i for i in range(80)]
+_VERBS = ["say", "make", "take", "give", "find", "tell", "ask", "keep",
+          "show", "hold", "bring", "begin", "move", "play", "run"]
+_ROLES = ["A0", "A1", "A2", "AM-TMP"]
+EMB_DIM = 32
+
+
+def _cache(name):
+    return os.path.join(common.DATA_HOME, "conll05st", name)
+
+
+def _synthetic_sentences():
+    """(words, lemma, verb position, B-/I-/O tags); the writer encodes
+    the tags into bracket notation and the parser must round-trip."""
+    rng = common.rng_for("conll05", "test")
+    out = []
+    for _ in range(N_SENTENCES):
+        L = int(rng.randint(4, 12))
+        words = [_WORD_POOL[rng.randint(len(_WORD_POOL))] for _ in range(L)]
+        v = int(rng.randint(1, L - 1))
+        lemma = _VERBS[rng.randint(len(_VERBS))]
+        words[v] = lemma
+        tags = ["O"] * L
+        tags[v] = "B-V"
+        # an A0 span somewhere before the verb
+        a0_end = int(rng.randint(0, v))
+        a0_start = int(rng.randint(0, a0_end + 1))
+        for i in range(a0_start, a0_end + 1):
+            tags[i] = "B-A0" if i == a0_start else "I-A0"
+        # a second role span after the verb, when room remains
+        if v + 2 < L:
+            role = _ROLES[1:][int(rng.randint(3))]
+            a1_start = v + 1 + int(rng.randint(0, L - v - 2))
+            a1_end = a1_start + int(rng.randint(0, L - a1_start))
+            for i in range(a1_start, a1_end + 1):
+                tags[i] = ("B-" + role) if i == a1_start else ("I-" + role)
+        out.append((words, lemma, v, tags))
+    return out
+
+
+def _encode_brackets(tags):
+    """B-/I-/O -> the props bracket column (inverse of the reference's
+    decoding state machine)."""
+    col = []
+    for i, t in enumerate(tags):
+        nxt = tags[i + 1] if i + 1 < len(tags) else "O"
+        same_continues = nxt.startswith("I-") and (
+            t[2:] == nxt[2:] if t != "O" else False
+        )
+        if t == "O":
+            col.append("*")
+        elif t.startswith("B-"):
+            tag = t[2:]
+            col.append("(%s*" % tag if same_continues else "(%s*)" % tag)
+        else:  # I- : continue or close the open span
+            col.append("*" if same_continues else "*)")
+    return col
+
+
+def _dict_words():
+    return ["<unk>"] + sorted(set(_WORD_POOL) | set(_VERBS)) + \
+        ["bos", "eos"]
+
+
+def _label_entries():
+    labels = ["O"]
+    for r in _ROLES + ["V"]:
+        labels += ["B-" + r, "I-" + r]
+    return labels
+
+
+def fetch():
+    d = os.path.dirname(_cache("x"))
+    os.makedirs(d, exist_ok=True)
+    for name, entries in (
+        ("wordDict.txt", _dict_words()),
+        ("verbDict.txt", sorted(_VERBS)),
+        ("targetDict.txt", _label_entries()),
+    ):
+        path = _cache(name)
+        if not os.path.exists(path):
+            with open(path + ".tmp", "w") as f:
+                f.write("\n".join(entries) + "\n")
+            os.replace(path + ".tmp", path)
+    # embedding blob: [n_words, EMB_DIM] f32 (the reference ships a
+    # pretrained binary; here deterministic random)
+    emb_path = _cache("emb")
+    if not os.path.exists(emb_path):
+        import numpy as np
+
+        rng = common.rng_for("conll05", "emb")
+        arr = rng.randn(len(_dict_words()), EMB_DIM).astype("<f4")
+        with open(emb_path + ".tmp", "wb") as f:
+            f.write(arr.tobytes())
+        os.replace(emb_path + ".tmp", emb_path)
+    tar_path = _cache("conll05st-tests.tar.gz")
+    if not os.path.exists(tar_path):
+        words_lines, props_lines = [], []
+        for words, lemma, v, tags in _synthetic_sentences():
+            col = _encode_brackets(tags)
+            for i, w in enumerate(words):
+                words_lines.append(w)
+                props_lines.append(
+                    "%s %s" % (lemma if i == v else "-", col[i]))
+            words_lines.append("")
+            props_lines.append("")
+        with tarfile.open(tar_path + ".tmp", "w:gz") as tf:
+            for member, lines in ((_WORDS_MEMBER, words_lines),
+                                  (_PROPS_MEMBER, props_lines)):
+                blob = io.BytesIO()
+                with gzip.GzipFile(fileobj=blob, mode="wb") as gz:
+                    gz.write(("\n".join(lines) + "\n").encode())
+                data = blob.getvalue()
+                info = tarfile.TarInfo(member)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        os.replace(tar_path + ".tmp", tar_path)
+    return d
+
+
+def load_dict(filename):
+    d = {}
+    with open(filename) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
 
 
 def get_dict():
-    word_dict = {("w%d" % i): i for i in range(_N_WORDS)}
-    verb_dict = {("v%d" % i): i for i in range(_N_VERBS)}
-    label_dict = {("l%d" % i): i for i in range(_N_LABELS)}
-    return word_dict, verb_dict, label_dict
+    """(word_dict, verb_dict, label_dict) from the dict files (reference
+    get_dict); synthesised via fetch() when absent."""
+    fetch()  # idempotent per artifact; heals a partially-written cache
+    return (load_dict(_cache("wordDict.txt")),
+            load_dict(_cache("verbDict.txt")),
+            load_dict(_cache("targetDict.txt")))
 
 
 def get_embedding():
-    return None
+    """Path to the [n_words, EMB_DIM] f32 embedding blob (reference
+    returns the downloaded file path)."""
+    if not os.path.exists(_cache("emb")):
+        fetch()
+    return _cache("emb")
+
+
+def corpus_reader(data_path=None, words_name=_WORDS_MEMBER,
+                  props_name=_PROPS_MEMBER):
+    """Yield (sentence words, predicate lemma, B-/I-/O labels) per
+    predicate column — the reference corpus_reader bracket decoding."""
+    data_path = data_path or _cache("conll05st-tests.tar.gz")
+    if not os.path.exists(data_path):
+        fetch()
+
+    def reader():
+        tf = tarfile.open(data_path)
+        wf = tf.extractfile(words_name)
+        pf = tf.extractfile(props_name)
+        with gzip.GzipFile(fileobj=wf) as words_file, \
+                gzip.GzipFile(fileobj=pf) as props_file:
+            sentences = []
+            labels = []
+            one_seg = []
+            for word, label in itertools.zip_longest(words_file,
+                                                     props_file):
+                word = word.decode().strip()
+                label = label.decode().strip().split()
+                if len(label) == 0:  # end of sentence
+                    for i in range(len(one_seg[0])):
+                        a_kind_lable = [x[i] for x in one_seg]
+                        labels.append(a_kind_lable)
+                    if len(labels) >= 1:
+                        verb_list = []
+                        for x in labels[0]:
+                            if x != "-":
+                                verb_list.append(x)
+                        for i, lbl in enumerate(labels[1:]):
+                            cur_tag = "O"
+                            is_in_bracket = False
+                            lbl_seq = []
+                            for l in lbl:
+                                if l == "*" and not is_in_bracket:
+                                    lbl_seq.append("O")
+                                elif l == "*" and is_in_bracket:
+                                    lbl_seq.append("I-" + cur_tag)
+                                elif l == "*)":
+                                    lbl_seq.append("I-" + cur_tag)
+                                    is_in_bracket = False
+                                elif "(" in l and ")" in l:
+                                    cur_tag = l[1:l.find("*")]
+                                    lbl_seq.append("B-" + cur_tag)
+                                    is_in_bracket = False
+                                elif "(" in l and ")" not in l:
+                                    cur_tag = l[1:l.find("*")]
+                                    lbl_seq.append("B-" + cur_tag)
+                                    is_in_bracket = True
+                                else:
+                                    raise RuntimeError(
+                                        "unexpected label: %s" % l)
+                            yield sentences, verb_list[i], lbl_seq
+                    sentences = []
+                    labels = []
+                    one_seg = []
+                else:
+                    sentences.append(word)
+                    one_seg.append(label)
+        wf.close()
+        pf.close()
+        tf.close()
+
+    return reader
+
+
+def reader_creator(corpus_reader, word_dict=None, predicate_dict=None,
+                   label_dict=None):
+    def reader():
+        for sentence, predicate, labels in corpus_reader():
+            sen_len = len(sentence)
+            verb_index = labels.index("B-V")
+            mark = [0] * len(labels)
+            if verb_index > 0:
+                mark[verb_index - 1] = 1
+                ctx_n1 = sentence[verb_index - 1]
+            else:
+                ctx_n1 = "bos"
+            if verb_index > 1:
+                mark[verb_index - 2] = 1
+                ctx_n2 = sentence[verb_index - 2]
+            else:
+                ctx_n2 = "bos"
+            mark[verb_index] = 1
+            ctx_0 = sentence[verb_index]
+            if verb_index < len(labels) - 1:
+                mark[verb_index + 1] = 1
+                ctx_p1 = sentence[verb_index + 1]
+            else:
+                ctx_p1 = "eos"
+            if verb_index < len(labels) - 2:
+                mark[verb_index + 2] = 1
+                ctx_p2 = sentence[verb_index + 2]
+            else:
+                ctx_p2 = "eos"
+
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            ctx_n2_idx = [word_dict.get(ctx_n2, UNK_IDX)] * sen_len
+            ctx_n1_idx = [word_dict.get(ctx_n1, UNK_IDX)] * sen_len
+            ctx_0_idx = [word_dict.get(ctx_0, UNK_IDX)] * sen_len
+            ctx_p1_idx = [word_dict.get(ctx_p1, UNK_IDX)] * sen_len
+            ctx_p2_idx = [word_dict.get(ctx_p2, UNK_IDX)] * sen_len
+            pred_idx = [predicate_dict.get(predicate)] * sen_len
+            label_idx = [label_dict.get(w) for w in labels]
+
+            yield (word_idx, ctx_n2_idx, ctx_n1_idx, ctx_0_idx,
+                   ctx_p1_idx, ctx_p2_idx, pred_idx, mark, label_idx)
+
+    return reader
 
 
 def test():
-    def reader():
-        rng = common.rng_for("conll05", "test")
-        for _ in range(128):
-            l = int(rng.randint(3, 12))
-            words = list(map(int, rng.randint(2, _N_WORDS, l)))
-            pred_pos = int(rng.randint(0, l))
-            verb = [int(rng.randint(0, _N_VERBS))] * l
-            mark = [1 if i == pred_pos else 0 for i in range(l)]
-            labels = [
-                int(w % (_N_LABELS - 1)) if m == 0 else _N_LABELS - 1
-                for w, m in zip(words, mark)
-            ]
+    word_dict, verb_dict, label_dict = get_dict()
+    return reader_creator(
+        corpus_reader(),
+        word_dict=word_dict,
+        predicate_dict=verb_dict,
+        label_dict=label_dict,
+    )
 
-            def roll(k):
-                return [words[(i + k) % l] for i in range(l)]
 
-            yield (words, roll(-2), roll(-1), words, roll(1), roll(2), verb,
-                   mark, labels)
-
-    return reader
+def convert(path):
+    common.convert(path, test(), 128, "conll05_test")

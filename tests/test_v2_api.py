@@ -604,3 +604,38 @@ def test_movielens_zip_decode(tmp_path, monkeypatch):
     n_test = len(list(movielens.test()()))
     assert n_train + n_test == movielens.N_RATINGS
     assert n_test > 0
+
+
+def test_conll05_srl_bracket_decode(tmp_path, monkeypatch):
+    """conll05: tarball with gzipped words/props members, bracket-label
+    columns round-tripped through the reference decoding state machine,
+    dict files by line number, f32 embedding blob."""
+    import numpy as np
+
+    from paddle_tpu.v2.dataset import common, conll05
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    d = conll05.fetch()
+    import os
+
+    assert os.path.exists(
+        os.path.join(d, "conll05st-tests.tar.gz"))
+    rows = list(conll05.test()())
+    assert len(rows) == conll05.N_SENTENCES  # one predicate per sentence
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    inv_l = {v: k for k, v in label_dict.items()}
+    for rec in rows[:16]:
+        assert len(rec) == 9
+        L = len(rec[0])
+        assert all(len(col) == L for col in rec)
+        tags = [inv_l[i] for i in rec[8]]
+        assert tags.count("B-V") == 1
+        # every I- continues a matching B-
+        for i, t in enumerate(tags):
+            if t.startswith("I-"):
+                assert tags[i - 1] in ("B-" + t[2:], "I-" + t[2:]), tags
+        # predicate id consistent and context mark window of 3-5 ones
+        assert len(set(rec[6])) == 1
+        assert 3 <= sum(rec[7]) <= 5
+    emb = np.fromfile(conll05.get_embedding(), "<f4")
+    assert emb.size == len(word_dict) * conll05.EMB_DIM
