@@ -639,3 +639,37 @@ def test_conll05_srl_bracket_decode(tmp_path, monkeypatch):
         assert 3 <= sum(rec[7]) <= 5
     emb = np.fromfile(conll05.get_embedding(), "<f4")
     assert emb.size == len(word_dict) * conll05.EMB_DIM
+
+
+def test_flowers_voc2012_image_format_decode(tmp_path, monkeypatch):
+    """flowers: real JPEG tgz + .mat label/setid files (PIL + scipy);
+    voc2012: VOCtrainval tar with JPEG photos and paletted PNG masks."""
+    import numpy as np
+
+    from paddle_tpu.v2.dataset import common, flowers, voc2012
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+
+    rows = list(flowers.train()())
+    assert len(rows) == flowers.N_IMAGES // 2
+    img, label = rows[0]
+    assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+    assert 0 <= label <= 101  # reference yields int(label) - 1
+    # the three .mat/.tgz artifacts exist in the real layout
+    import os
+    for f in ("102flowers.tgz", "imagelabels.mat", "setid.mat"):
+        assert os.path.exists(tmp_path / "flowers" / f)
+    # splits are disjoint
+    test_rows = list(flowers.test()())
+    assert len(test_rows) == flowers.N_IMAGES // 4
+
+    pairs = list(voc2012.val()())
+    assert len(pairs) == voc2012.N_VAL
+    img, mask = pairs[0]
+    assert img.shape == (64, 64, 3) and img.dtype == np.uint8
+    assert mask.shape == (64, 64)
+    # paletted PNG round-trips the class INDICES exactly
+    assert mask.max() < voc2012._CLASSES
+    synth_mask = voc2012._synthetic_pairs()[voc2012.N_TRAIN][2]
+    np.testing.assert_array_equal(mask, synth_mask)
+    assert len(list(voc2012.train()())) == voc2012.N_TRAIN + voc2012.N_VAL
