@@ -181,106 +181,87 @@ def corpus_reader(data_path=None, words_name=_WORDS_MEMBER,
     if not os.path.exists(data_path):
         fetch()
 
+    def _decode_column(col):
+        """One bracket column -> B-/I-/O tags. Grammar: '(TAG*' opens a
+        span, '*' continues it (or is O outside one), '*)' closes it,
+        '(TAG*)' is a single-token span."""
+        tags, span = [], None
+        for tok in col:
+            if tok.startswith("("):
+                tag = tok[1:tok.index("*")]
+                tags.append("B-" + tag)
+                span = None if tok.endswith(")") else tag
+            elif tok == "*)":
+                tags.append("I-" + (span or "O"))
+                span = None
+            elif tok == "*":
+                tags.append("I-" + span if span else "O")
+            else:
+                raise RuntimeError("unexpected props token: %s" % tok)
+        return tags
+
+    def _sentences():
+        """Group the parallel line streams into per-sentence
+        (words, prop rows) chunks at blank lines."""
+        with tarfile.open(data_path) as tf:
+            wf = tf.extractfile(words_name)
+            pf = tf.extractfile(props_name)
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                words, rows = [], []
+                for wline, pline in itertools.zip_longest(words_file,
+                                                          props_file):
+                    w = wline.decode().strip()
+                    row = pline.decode().strip().split()
+                    if not row:  # sentence boundary
+                        if rows:
+                            yield words, rows
+                        words, rows = [], []
+                    else:
+                        words.append(w)
+                        rows.append(row)
+                if rows:
+                    yield words, rows
+
     def reader():
-        tf = tarfile.open(data_path)
-        wf = tf.extractfile(words_name)
-        pf = tf.extractfile(props_name)
-        with gzip.GzipFile(fileobj=wf) as words_file, \
-                gzip.GzipFile(fileobj=pf) as props_file:
-            sentences = []
-            labels = []
-            one_seg = []
-            for word, label in itertools.zip_longest(words_file,
-                                                     props_file):
-                word = word.decode().strip()
-                label = label.decode().strip().split()
-                if len(label) == 0:  # end of sentence
-                    for i in range(len(one_seg[0])):
-                        a_kind_lable = [x[i] for x in one_seg]
-                        labels.append(a_kind_lable)
-                    if len(labels) >= 1:
-                        verb_list = []
-                        for x in labels[0]:
-                            if x != "-":
-                                verb_list.append(x)
-                        for i, lbl in enumerate(labels[1:]):
-                            cur_tag = "O"
-                            is_in_bracket = False
-                            lbl_seq = []
-                            for l in lbl:
-                                if l == "*" and not is_in_bracket:
-                                    lbl_seq.append("O")
-                                elif l == "*" and is_in_bracket:
-                                    lbl_seq.append("I-" + cur_tag)
-                                elif l == "*)":
-                                    lbl_seq.append("I-" + cur_tag)
-                                    is_in_bracket = False
-                                elif "(" in l and ")" in l:
-                                    cur_tag = l[1:l.find("*")]
-                                    lbl_seq.append("B-" + cur_tag)
-                                    is_in_bracket = False
-                                elif "(" in l and ")" not in l:
-                                    cur_tag = l[1:l.find("*")]
-                                    lbl_seq.append("B-" + cur_tag)
-                                    is_in_bracket = True
-                                else:
-                                    raise RuntimeError(
-                                        "unexpected label: %s" % l)
-                            yield sentences, verb_list[i], lbl_seq
-                    sentences = []
-                    labels = []
-                    one_seg = []
-                else:
-                    sentences.append(word)
-                    one_seg.append(label)
-        wf.close()
-        pf.close()
-        tf.close()
+        for words, rows in _sentences():
+            lemma_col = [r[0] for r in rows]
+            predicates = [x for x in lemma_col if x != "-"]
+            n_preds = len(rows[0]) - 1
+            for k in range(n_preds):
+                col = [r[1 + k] for r in rows]
+                yield words, predicates[k], _decode_column(col)
 
     return reader
 
 
 def reader_creator(corpus_reader, word_dict=None, predicate_dict=None,
                    label_dict=None):
+    # context-window offsets and their out-of-range padding tokens: the
+    # reference marks the 5-token window around the predicate and pads
+    # positions that fall off the sentence with 'bos'/'eos'
+    # (conll05.py:135-162)
+    offsets = ((-2, "bos"), (-1, "bos"), (0, None), (1, "eos"), (2, "eos"))
+
     def reader():
         for sentence, predicate, labels in corpus_reader():
-            sen_len = len(sentence)
-            verb_index = labels.index("B-V")
-            mark = [0] * len(labels)
-            if verb_index > 0:
-                mark[verb_index - 1] = 1
-                ctx_n1 = sentence[verb_index - 1]
-            else:
-                ctx_n1 = "bos"
-            if verb_index > 1:
-                mark[verb_index - 2] = 1
-                ctx_n2 = sentence[verb_index - 2]
-            else:
-                ctx_n2 = "bos"
-            mark[verb_index] = 1
-            ctx_0 = sentence[verb_index]
-            if verb_index < len(labels) - 1:
-                mark[verb_index + 1] = 1
-                ctx_p1 = sentence[verb_index + 1]
-            else:
-                ctx_p1 = "eos"
-            if verb_index < len(labels) - 2:
-                mark[verb_index + 2] = 1
-                ctx_p2 = sentence[verb_index + 2]
-            else:
-                ctx_p2 = "eos"
+            n = len(sentence)
+            v = labels.index("B-V")
+            mark = [0] * n
+            ctx_cols = []
+            for off, pad in offsets:
+                ok = 0 <= v + off < n
+                if ok:
+                    mark[v + off] = 1
+                word = sentence[v + off] if ok else pad
+                ctx_cols.append([word_dict.get(word, UNK_IDX)] * n)
 
-            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
-            ctx_n2_idx = [word_dict.get(ctx_n2, UNK_IDX)] * sen_len
-            ctx_n1_idx = [word_dict.get(ctx_n1, UNK_IDX)] * sen_len
-            ctx_0_idx = [word_dict.get(ctx_0, UNK_IDX)] * sen_len
-            ctx_p1_idx = [word_dict.get(ctx_p1, UNK_IDX)] * sen_len
-            ctx_p2_idx = [word_dict.get(ctx_p2, UNK_IDX)] * sen_len
-            pred_idx = [predicate_dict.get(predicate)] * sen_len
-            label_idx = [label_dict.get(w) for w in labels]
-
-            yield (word_idx, ctx_n2_idx, ctx_n1_idx, ctx_0_idx,
-                   ctx_p1_idx, ctx_p2_idx, pred_idx, mark, label_idx)
+            yield tuple(
+                [[word_dict.get(w, UNK_IDX) for w in sentence]]
+                + ctx_cols
+                + [[predicate_dict.get(predicate)] * n, mark,
+                   [label_dict.get(t) for t in labels]]
+            )
 
     return reader
 
