@@ -318,6 +318,12 @@ def _lower_ops(
         return loss, fenv
 
     primal_params = {p: env[p] for p in param_names}
+    if bool(getattr(block.program, "remat", False)):
+        # memory_optimize(): rematerialize the forward region during the
+        # cotangent pass instead of keeping every activation live — the
+        # TPU-native form of the reference's liveness-based buffer reuse
+        # (memory_optimization_transpiler.py:270), trading FLOPs for HBM
+        fwd = jax.checkpoint(fwd)
     loss_val, pullback, fenv = jax.vjp(fwd, primal_params, has_aux=True)
     (grads,) = pullback(jnp.ones_like(loss_val))
 

@@ -366,6 +366,8 @@ class Program(object):
         self.shardings: Dict[str, Any] = {}
         # mixed precision: forward/backward in bf16, f32 master params
         self.amp = False
+        # memory_optimize(): rematerialize the forward region in backward
+        self.remat = False
 
     def _bump_version(self):
         self.version += 1
@@ -419,6 +421,7 @@ class Program(object):
         p._seed = self._seed
         p.shardings = dict(self.shardings)
         p.amp = self.amp
+        p.remat = self.remat
         for blk in self.blocks:
             nb = Block(p, blk.idx, blk.parent_idx)
             for name, v in blk.vars.items():

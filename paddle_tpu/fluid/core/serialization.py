@@ -126,6 +126,7 @@ def program_to_dict(program: Program) -> Dict[str, Any]:
         "version": FORMAT_VERSION,
         "random_seed": program.random_seed,
         "amp": bool(program.amp),
+        "remat": bool(program.remat),
         "shardings": {
             k: _spec_to_json(v) for k, v in program.shardings.items()
         },
@@ -144,6 +145,7 @@ def program_from_dict(d: Dict[str, Any]) -> Program:
     program = Program()
     program.random_seed = int(d.get("random_seed", 0))
     program.amp = bool(d.get("amp", False))
+    program.remat = bool(d.get("remat", False))
     if d.get("shardings"):
         program.shardings = {
             k: _spec_from_json(v) for k, v in d["shardings"].items()

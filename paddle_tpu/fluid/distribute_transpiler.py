@@ -129,6 +129,10 @@ class SimpleDistributeTranspiler(DistributeTranspiler):
 
 def memory_optimize(input_program, print_log=False, **kwargs):
     """reference memory_optimization_transpiler.py:270 rewrites var reuse
-    via liveness analysis. XLA's buffer assignment already performs this
-    inside the fused computation, so the API is a validated no-op."""
-    return input_program
+    via liveness analysis. Delegates to the real implementation: XLA's
+    buffer assignment already does the reuse, and the remaining lever —
+    rematerializing the forward region — is enabled here (see
+    memory_optimization_transpiler.memory_optimize)."""
+    from .memory_optimization_transpiler import memory_optimize as _mo
+
+    return _mo(input_program, print_log=print_log, **kwargs)

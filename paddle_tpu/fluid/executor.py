@@ -251,6 +251,7 @@ class Executor(object):
         )
         key = (
             "async_local", program.uid, program.version, program.amp,
+            program.remat,
             feed_sig, tuple(fetch_names),
             tuple(sorted(persist_in.keys())),
             int(steps), int(sync_every), mesh,
@@ -394,6 +395,7 @@ class Executor(object):
             program.uid,
             program.version,
             program.amp,
+            program.remat,
             feed_sig,
             tuple(fetch_names),
             tuple(sorted(persist_in.keys())),

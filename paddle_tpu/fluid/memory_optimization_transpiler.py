@@ -2,19 +2,35 @@
 memory_optimization_transpiler.py:270 memory_optimize — a liveness
 analysis that rewrites var reuse in the op-at-a-time interpreter).
 
-On this core the whole block compiles to ONE fused XLA computation and
-XLA's buffer assignment already performs liveness-based reuse plus
-donation of the parameter buffers (executor.py), so the transpile is a
-semantic no-op by design — kept as the API with that contract stated,
-the same stance as DistributeTranspiler.memory_optimize."""
+On this core the whole block compiles to ONE fused XLA computation whose
+buffer assignment already performs liveness-based reuse plus donation of
+the parameter buffers (executor.py). The transpile therefore maps to the
+memory lever XLA does NOT take on its own: rematerialization. Marking a
+program with `memory_optimize` makes the lowering wrap the forward region
+in `jax.checkpoint`, so the cotangent pass recomputes activations instead
+of keeping them live across forward+backward — the same peak-memory
+reduction the reference's var-reuse rewrite bought its interpreter,
+expressed the TPU way (FLOPs traded for HBM residency). Training results
+match the un-optimized program to fusion-level rounding; only the
+schedule changes."""
 
 from __future__ import annotations
 
 __all__ = ["memory_optimize", "release_memory"]
 
 
-def memory_optimize(input_program):
-    """No-op by design: XLA buffer assignment does the reuse."""
+def memory_optimize(input_program, print_log=False, **kwargs):
+    """Enable forward-region rematerialization for `input_program`.
+
+    Reference semantics: rewrite the program so activation memory is
+    reused once dead (memory_optimization_transpiler.py:270). Here the
+    equivalent peak-memory reduction comes from `jax.checkpoint` around
+    the traced forward region (core/lowering.py), which drops activations
+    after the primal pass and recomputes them inside the backward.
+    """
+    input_program.remat = True
+    if print_log:
+        print("memory_optimize: forward-region rematerialization enabled")
     return input_program
 
 
