@@ -28,6 +28,7 @@ python/paddle/v2/fluid/layers/control_flow.py):
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -855,3 +856,84 @@ def _shrink_rnn_memory(ctx, ins, attrs):
         (-1,) + (1,) * (x.ndim - 1)
     )
     return {"Out": jnp.where(alive, x, 0.0)}
+
+
+# print-op access counters, keyed by the Operator instance so first_n
+# survives retraces (a new feed shape re-lowers the block; the closure
+# would otherwise restart the budget). WeakKey: dies with the program.
+_PRINT_COUNTS = weakref.WeakKeyDictionary()
+
+
+@register_op("print")
+def _print_op(ctx, ins, attrs):
+    """Debug print that fires when the tensor is computed (reference
+    layers/control_flow.py:149 Print -> operators/print_op.cc). The fused
+    XLA step has no per-op execution to hook, so the kernel taps the value
+    with `jax.debug.callback` (host print at runtime, jit-safe) and prints
+    the cotangent through a custom_vjp when print_phase includes backward.
+
+    Under memory_optimize() the forward region is rematerialized, so the
+    value really is computed twice per training step — the forward print
+    then fires on both passes (standard JAX remat-effect semantics) and
+    first_n budgets accordingly."""
+    x = ins["In"][0]
+    name = (ctx.op.inputs.get("In") or [""])[0]
+    message = attrs.get("message", "") or ""
+    first_n = int(attrs.get("first_n", -1))
+    summarize = int(attrs.get("summarize", -1))
+    phase = str(attrs.get("print_phase", "BOTH")).upper()
+    show_name = attrs.get("print_tensor_name", True)
+    show_type = attrs.get("print_tensor_type", True)
+    show_shape = attrs.get("print_tensor_shape", True)
+    show_lod = attrs.get("print_tensor_lod", True)
+    lod = ctx.env.get(lod_key(name)) if show_lod else None
+
+    counter = _PRINT_COUNTS.setdefault(ctx.op, {"n": 0})
+
+    def _emit(tag, val, lod_val=None):
+        # reference print_op semantics: first_n <= 0 means no limit
+        if 0 < first_n <= counter["n"]:
+            return
+        counter["n"] += 1
+        flat = np.ravel(np.asarray(val))
+        if summarize >= 0:
+            flat = flat[:summarize]
+        bits = [message] if message else []
+        if show_name:
+            bits.append("name=%s%s" % (name, tag))
+        if show_type:
+            bits.append("dtype=%s" % np.asarray(val).dtype)
+        if show_shape:
+            bits.append("shape=%s" % (tuple(np.asarray(val).shape),))
+        if lod_val is not None:
+            bits.append("lod=%s" % np.asarray(lod_val).tolist())
+        print("%s data=%s" % (" ".join(bits), flat), flush=True)
+
+    fwd_print = phase in ("FORWARD", "BOTH")
+    bwd_print = phase in ("BACKWARD", "BOTH")
+
+    # the forward print attaches to the primal trace directly (a
+    # custom_vjp fwd rule would only run under differentiation, and
+    # inference programs never differentiate)
+    if fwd_print:
+        if lod is not None:
+            jax.debug.callback(lambda val, lv: _emit("", val, lv), x, lod)
+        else:
+            jax.debug.callback(lambda val: _emit("", val), x)
+
+    if bwd_print:
+
+        @jax.custom_vjp
+        def _tap(v):
+            return v
+
+        def _tap_fwd(v):
+            return v, None
+
+        def _tap_bwd(_, g):
+            jax.debug.callback(lambda val: _emit("@GRAD", val), g)
+            return (g,)
+
+        _tap.defvjp(_tap_fwd, _tap_bwd)
+        x = _tap(x)
+    return {"Out": x}

@@ -26,7 +26,38 @@ __all__ = [
     "less_than",
     "beam_search",
     "beam_search_decode",
+    "Print",
 ]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print of a tensor's runtime value (reference
+    layers/control_flow.py:149). Returns a pass-through of `input`; the
+    message fires whenever the compiled step computes the value —
+    including the gradient when print_phase is 'backward'/'both'."""
+    helper = LayerHelper("print", **locals())
+    out = helper.create_tmp_variable(
+        dtype=input.dtype, shape=tuple(input.shape)
+    )
+    helper.append_op(
+        type="print",
+        inputs={"In": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "first_n": int(first_n),
+            "summarize": int(summarize),
+            "message": message or "",
+            "print_tensor_name": print_tensor_name,
+            "print_tensor_type": print_tensor_type,
+            "print_tensor_shape": print_tensor_shape,
+            "print_tensor_lod": print_tensor_lod,
+            "print_phase": print_phase.upper(),
+        },
+    )
+    return out
 
 
 def get_places(device_count=None, device_type=None):

@@ -49,6 +49,11 @@ __all__ = [
     "BReluActivation", "SoftReluActivation", "SquareActivation",
     "ExpActivation", "STanhActivation", "AbsActivation", "LogActivation",
     "SequenceSoftmaxActivation", "SqrtActivation", "ReciprocalActivation",
+    "SoftSignActivation", "BaseActivation",
+    # layer-surface compatibility objects
+    "AggregateLevel", "ExpandLevel", "LayerType", "LayerOutput",
+    "BaseGeneratedInput", "layer_support", "print_layer",
+    "convex_comb_layer",
     # pooling types
     "MaxPooling", "AvgPooling", "SumPooling",
     # optimizers / regularization
@@ -177,6 +182,9 @@ AbsActivation = _mkact("AbsActivation", "abs")
 LogActivation = _mkact("LogActivation", "log")
 SqrtActivation = _mkact("SqrtActivation", "sqrt")
 ReciprocalActivation = _mkact("ReciprocalActivation", "reciprocal")
+SoftSignActivation = _mkact("SoftSignActivation", "softsign")
+# reference activations.py exports the base class too
+BaseActivation = _Act
 
 
 class ParamAttr(object):
@@ -749,12 +757,21 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     return node
 
 
-class GeneratedInput(object):
+class BaseGeneratedInput(object):
+    """Base for generation-mode step inputs (reference layers.py:4203)."""
+
+    def __init__(self):
+        self.bos_id = None
+        self.eos_id = None
+
+
+class GeneratedInput(BaseGeneratedInput):
     """Generation-mode step input: the embedding of the previous step's
     predicted word (reference layers.py GeneratedInput / the generation
     path of RecurrentGradientMachine)."""
 
     def __init__(self, size, embedding_name, embedding_size, **kwargs):
+        super(GeneratedInput, self).__init__()
         self.size = size
         self.embedding_name = embedding_name
         self.embedding_size = embedding_size
@@ -1819,3 +1836,79 @@ __all__ += [
     "wrap_name_default", "wrap_param_attr_default",
     "wrap_bias_attr_default", "wrap_act_default", "wrap_param_default",
 ]
+
+
+# ---------------------------------------------------------------------
+# layer-surface compatibility objects (reference layers.py:155,289,315,
+# 393,1836,4203): enumerations and base classes that reference configs
+# import by name. The sequence-level enums carry the same wire strings
+# the reference config_parser understands ('non-seq'/'seq'); the rest
+# are structural parity for isinstance checks and introspection.
+# ---------------------------------------------------------------------
+
+
+class AggregateLevel(object):
+    """Which nesting level a sequence aggregation collapses
+    (reference layers.py:289)."""
+
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    # compatible with previous configuration names
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel(object):
+    """Which nesting level an expansion starts from
+    (reference layers.py:1836)."""
+
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    # compatible with previous configuration names
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+class LayerType(object):
+    """Layer type name constants (reference layers.py:155). This core
+    identifies layers by their op graph rather than a type registry, so
+    the constants exist for config/introspection compatibility."""
+
+    DATA = "data"
+    FC_LAYER = "fc"
+    MIXED_LAYER = "mixed"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    SEQUENCE_LAST_INSTANCE = "seqlastins"
+    SEQUENCE_FIRST_INSTANCE = "seqfirstins"
+    SEQUENCE_RESHAPE = "seqreshape"
+    POOLING_MAX = "max"
+    POOLING_AVG = "average"
+    COST = "cost"
+    CONV_LAYER = "conv"
+
+    @staticmethod
+    def is_layer_type(type_name):
+        return isinstance(type_name, str) and bool(type_name)
+
+
+# wrappers here return v2-layer graph nodes; LayerOutput is the
+# reference's name for that node type (layers.py:315)
+LayerOutput = Layer
+
+
+def layer_support(*attrs):
+    """Decorator marking which ExtraLayerAttribute fields a wrapper
+    honors (reference layers.py:393). Attribute enforcement here happens
+    in the wrappers themselves, so the decorator only preserves the
+    wrapped function's identity."""
+
+    def decorator(method):
+        return method
+
+    return decorator
+
+
+# V1-compatibility aliases (reference layers.py:1123 print_layer,
+# :5353 convex_comb_layer)
+print_layer = printer_layer
+convex_comb_layer = linear_comb_layer

@@ -1,0 +1,92 @@
+"""The legacy DSL's module layout matches the reference package
+(python/paddle/trainer_config_helpers/): configs import wrappers both
+from the package and from its submodules (layers, activations, attrs,
+poolings, optimizers, data_sources, default_decorators), so every
+reference submodule must exist and carry its reference __all__."""
+
+import importlib
+
+import pytest
+
+# name -> the reference module's __all__ (layers.py spot-checked, not
+# exhaustively listed — the package __all__ test covers the rest)
+_REF_EXPORTS = {
+    "activations": [
+        "TanhActivation", "SigmoidActivation", "SoftmaxActivation",
+        "IdentityActivation", "LinearActivation",
+        "SequenceSoftmaxActivation", "ExpActivation", "ReluActivation",
+        "BReluActivation", "SoftReluActivation", "STanhActivation",
+        "AbsActivation", "SquareActivation", "BaseActivation",
+        "LogActivation", "SqrtActivation", "ReciprocalActivation",
+        "SoftSignActivation",
+    ],
+    "attrs": [
+        "HookAttr", "ParamAttr", "ExtraAttr", "ParameterAttribute",
+        "ExtraLayerAttribute",
+    ],
+    "data_sources": ["define_py_data_sources2"],
+    "default_decorators": [
+        "wrap_name_default", "wrap_param_attr_default",
+        "wrap_bias_attr_default", "wrap_act_default", "wrap_param_default",
+    ],
+    "optimizers": [
+        "Optimizer", "BaseSGDOptimizer", "MomentumOptimizer",
+        "AdamaxOptimizer", "AdamOptimizer", "AdaGradOptimizer",
+        "RMSPropOptimizer", "DecayedAdaGradOptimizer",
+        "AdaDeltaOptimizer", "settings",
+    ],
+    "poolings": [
+        "BasePoolingType", "MaxPooling", "AvgPooling",
+        "MaxWithMaskPooling", "CudnnMaxPooling", "CudnnAvgPooling",
+        "CudnnAvgInclPadPooling", "SumPooling", "SquareRootNPooling",
+    ],
+    "layers": [
+        "fc_layer", "data_layer", "mixed_layer", "lstmemory",
+        "recurrent_group", "full_matrix_projection", "AggregateLevel",
+        "ExpandLevel", "LayerType", "LayerOutput", "BaseGeneratedInput",
+        "layer_support", "print_layer", "convex_comb_layer",
+    ],
+    "config_parser_utils": [
+        "parse_network_config", "parse_optimizer_config",
+        "parse_trainer_config", "reset_parser",
+    ],
+}
+
+
+@pytest.mark.parametrize("mod", sorted(_REF_EXPORTS))
+def test_submodule_exports(mod):
+    m = importlib.import_module("paddle_tpu.trainer_config_helpers." + mod)
+    missing = [n for n in _REF_EXPORTS[mod] if not hasattr(m, n)]
+    assert not missing, "%s missing %r" % (mod, missing)
+
+
+def test_level_enums_carry_wire_strings():
+    from paddle_tpu.trainer_config_helpers import AggregateLevel, ExpandLevel
+
+    assert AggregateLevel.TO_NO_SEQUENCE == "non-seq"
+    assert AggregateLevel.TO_SEQUENCE == "seq"
+    assert AggregateLevel.EACH_TIMESTEP == AggregateLevel.TO_NO_SEQUENCE
+    assert ExpandLevel.FROM_NO_SEQUENCE == "non-seq"
+    assert ExpandLevel.FROM_SEQUENCE == "seq"
+
+
+def test_generated_input_is_base_subclass():
+    from paddle_tpu.trainer_config_helpers import (
+        BaseGeneratedInput,
+        GeneratedInput,
+    )
+
+    g = GeneratedInput(size=7, embedding_name="emb", embedding_size=8)
+    assert isinstance(g, BaseGeneratedInput)
+    assert g.bos_id is None and g.eos_id is None
+
+
+def test_layer_aliases_are_same_objects():
+    import paddle_tpu.trainer_config_helpers as tch
+
+    assert tch.print_layer is tch.printer_layer
+    assert tch.convex_comb_layer is tch.linear_comb_layer
+    assert tch.LayerOutput is not None
+    # layer_support returns the method unchanged
+    fn = lambda: 1
+    assert tch.layer_support("dropout")(fn) is fn

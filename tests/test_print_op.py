@@ -1,0 +1,119 @@
+"""fluid.layers.Print — runtime debug print through the fused step
+(reference layers/control_flow.py:149 Print / operators/print_op.cc).
+The kernel taps values with jax.debug.callback, so the message fires
+from inside the compiled computation; backward phase prints the
+cotangent via a custom_vjp."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _run_with_print(print_phase, capsys, first_n=-1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="tanh")
+        h = fluid.layers.Print(
+            h, message="DBG_H", summarize=3, print_phase=print_phase,
+            first_n=first_n,
+        )
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(x=fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(3):
+            feed = {
+                "x": rng.randn(8, 4).astype(np.float32),
+                "y": rng.randn(8, 1).astype(np.float32),
+            }
+            out = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.ravel(out[0])[0]))
+    import jax
+
+    jax.effects_barrier()  # flush pending debug callbacks
+    return losses, capsys.readouterr().out
+
+
+def test_print_forward(capsys):
+    losses, out = _run_with_print("forward", capsys)
+    assert all(np.isfinite(losses))
+    assert "DBG_H" in out
+    assert "name=" in out and "shape=(8, 4)" in out
+    assert "@GRAD" not in out
+
+
+def test_print_both_includes_grad(capsys):
+    _, out = _run_with_print("both", capsys)
+    assert "DBG_H" in out
+    assert "@GRAD" in out
+
+
+def test_print_first_n_limits(capsys):
+    # 3 steps with both phases = 6 potential prints; first_n=2 caps it
+    _, out = _run_with_print("both", capsys, first_n=2)
+    assert out.count("DBG_H") == 2
+
+
+def test_print_first_n_zero_means_unlimited(capsys):
+    # reference print_op only limits when first_n > 0
+    _, out = _run_with_print("forward", capsys, first_n=0)
+    assert out.count("DBG_H") == 3
+
+
+def test_print_first_n_survives_retrace(capsys):
+    # a new batch shape re-lowers the block; the access budget must not
+    # restart (reference print_op holds one persistent counter per op)
+    import jax
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.Print(x, message="DBG_R", first_n=2)
+        out = fluid.layers.reduce_sum(h)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for batch in (2, 2, 3, 3):  # shape change at step 3 retraces
+            exe.run(
+                main,
+                feed={"x": np.ones((batch, 4), np.float32)},
+                fetch_list=[out],
+            )
+    jax.effects_barrier()
+    assert capsys.readouterr().out.count("DBG_R") == 2
+
+
+def test_print_passthrough_value():
+    # Print must be identity on the dataflow: same loss with and without
+    def build(with_print):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            h = fluid.layers.fc(
+                input=x,
+                size=2,
+                param_attr=fluid.ParamAttr(
+                    initializer=fluid.initializer.Constant(0.5)
+                ),
+            )
+            if with_print:
+                h = fluid.layers.Print(h, message="ignored")
+            out = fluid.layers.reduce_sum(h)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            feed = {"x": np.ones((2, 4), np.float32)}
+            return float(
+                np.ravel(exe.run(main, feed=feed, fetch_list=[out])[0])[0]
+            )
+
+    assert build(False) == build(True)
