@@ -449,6 +449,13 @@ class Executor(object):
             jax.random.PRNGKey(program.random_seed), self._run_counter
         )
         fetches, new_persist = entry(persist_in, feed_arrays, rng)
+        if any(
+            op.type == "print" for blk in program.blocks for op in blk.ops
+        ):
+            # Print taps are jax.debug callbacks: flush them so debug
+            # output lands before run() returns (pending effects would
+            # otherwise be dropped at interpreter teardown)
+            jax.effects_barrier()
         return _finish_run(
             scope, fetch_names, fetches, new_persist, return_numpy
         )

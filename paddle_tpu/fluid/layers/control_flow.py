@@ -30,7 +30,7 @@ __all__ = [
 ]
 
 
-def Print(input, first_n=-1, message=None, summarize=-1,
+def Print(input, first_n=-1, message=None, summarize=20,
           print_tensor_name=True, print_tensor_type=True,
           print_tensor_shape=True, print_tensor_lod=True,
           print_phase="both"):
@@ -38,6 +38,11 @@ def Print(input, first_n=-1, message=None, summarize=-1,
     layers/control_flow.py:149). Returns a pass-through of `input`; the
     message fires whenever the compiled step computes the value —
     including the gradient when print_phase is 'backward'/'both'."""
+    if print_phase.upper() not in ("FORWARD", "BACKWARD", "BOTH"):
+        raise ValueError(
+            "print_phase must be 'forward', 'backward' or 'both', got %r"
+            % (print_phase,)
+        )
     helper = LayerHelper("print", **locals())
     out = helper.create_tmp_variable(
         dtype=input.dtype, shape=tuple(input.shape)

@@ -442,8 +442,10 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             if stats["batches"] > min(log_period, 5) and not skip_times:
                 times.append(dt_per)
             if stats["batches"] % log_period == 0:
+                # reference Trainer.cpp log format — what
+                # utils/plotcurve.py parses
                 print(
-                    "Pass %d, Batch %d, Cost %.4f"
+                    "Pass=%d Batch=%d AvgCost=%.4f"
                     % (state_box["pass_id"], stats["batches"], cost)
                 )
 

@@ -35,9 +35,8 @@ def _run_with_print(print_phase, capsys, first_n=-1):
             }
             out = exe.run(main, feed=feed, fetch_list=[loss])
             losses.append(float(np.ravel(out[0])[0]))
-    import jax
-
-    jax.effects_barrier()  # flush pending debug callbacks
+    # no manual jax.effects_barrier(): Executor.run flushes debug
+    # effects itself when the program contains a print op
     return losses, capsys.readouterr().out
 
 
@@ -65,6 +64,16 @@ def test_print_first_n_zero_means_unlimited(capsys):
     # reference print_op only limits when first_n > 0
     _, out = _run_with_print("forward", capsys, first_n=0)
     assert out.count("DBG_H") == 3
+
+
+def test_print_rejects_bad_phase():
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        with pytest.raises(ValueError, match="print_phase"):
+            fluid.layers.Print(x, print_phase="forwards")
 
 
 def test_print_first_n_survives_retrace(capsys):

@@ -1,0 +1,285 @@
+"""paddle.utils + paddle.trainer tool-module parity (reference
+python/paddle/utils/ image_util, preprocess_util/img, plotcurve,
+dump_v2_config, show_pb, predefined_net, image_multiproc,
+make_model_diagram; python/paddle/trainer/ config_parser,
+config_parser_extension, PyDataProviderWrapper)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.trainer_config_helpers as tch
+
+
+# --------------------------------------------------------------------
+# image_util
+# --------------------------------------------------------------------
+
+
+def _checker_image(w=24, h=16):
+    from PIL import Image
+
+    arr = np.zeros((h, w, 3), np.uint8)
+    arr[::2, ::2] = [255, 0, 0]
+    return Image.fromarray(arr)
+
+
+def test_image_util_resize_crop_flip():
+    from paddle_tpu.utils import image_util
+
+    img = _checker_image(24, 16)
+    resized = image_util.resize_image(img, 8)
+    assert min(resized.size) == 8 and resized.size[0] > 8  # aspect kept
+
+    chw = np.asarray(resized.convert("RGB")).transpose(2, 0, 1)
+    crop = image_util.crop_img(chw, 6, test=True)
+    assert crop.shape == (3, 6, 6)
+
+    flipped = image_util.flip(crop)
+    np.testing.assert_array_equal(flipped[..., ::-1], crop)
+
+    # jpeg round trip
+    buf = io.BytesIO()
+    img.save(buf, "jpeg")
+    decoded = image_util.decode_jpeg(buf.getvalue())
+    assert decoded.shape[0] == 3 and decoded.dtype == np.uint8
+
+    # 10-crop oversample
+    crops = image_util.oversample(np.asarray(img)[None], (8, 8))
+    assert crops.shape == (10, 8, 8, 3)
+
+
+def test_image_transformer_pipeline():
+    from paddle_tpu.utils.image_util import ImageTransformer
+
+    t = ImageTransformer(transpose=(2, 0, 1), mean=[1.0, 2.0, 3.0])
+    t.set_scale(2.0)
+    hwc = np.ones((4, 5, 3), np.float32)
+    out = t.transformer(hwc)
+    assert out.shape == (3, 4, 5)
+    np.testing.assert_allclose(out[0], 2 - 1.0)
+    np.testing.assert_allclose(out[2], 2 - 3.0)
+
+
+def test_multiproc_transformer_single_sample(tmp_path):
+    from paddle_tpu.utils.image_multiproc import PILTransformer
+
+    img = _checker_image(20, 20)
+    buf = io.BytesIO()
+    img.save(buf, "jpeg")
+    t = PILTransformer(min_size=16, crop_size=12, is_train=False,
+                       mean=np.zeros(3, np.float32))
+    out, label = t(buf.getvalue(), 7)
+    assert out.shape == (3, 12, 12) and label == 7
+
+
+# --------------------------------------------------------------------
+# preprocess_util / preprocess_img / predefined_net data path
+# --------------------------------------------------------------------
+
+
+def _make_image_tree(root, n_per_label=4, labels=("cat", "dog")):
+    for split in ("train", "test"):
+        for lab in labels:
+            d = os.path.join(root, split, lab)
+            os.makedirs(d, exist_ok=True)
+            for i in range(n_per_label):
+                _checker_image(20, 20).save(
+                    os.path.join(d, "img%d.jpg" % i)
+                )
+
+
+def test_image_dataset_creater_end_to_end(tmp_path):
+    from paddle_tpu.utils.preprocess_img import (
+        ImageClassificationDatasetCreater,
+    )
+
+    root = str(tmp_path)
+    _make_image_tree(root)
+    creater = ImageClassificationDatasetCreater(root, 16, color=True)
+    creater.num_per_batch = 3
+    out = creater.create_batches()
+    assert os.path.exists(os.path.join(out, "train.list"))
+    import pickle
+
+    with open(os.path.join(out, "train_batch_000"), "rb") as f:
+        batch = pickle.load(f)
+    assert set(batch) == {"images", "labels"}
+    assert len(batch["labels"]) == 3  # num_per_batch
+    assert isinstance(batch["images"][0], bytes)  # jpeg-compressed
+    with open(os.path.join(out, "batches.meta"), "rb") as f:
+        meta = pickle.load(f)
+    assert meta["num_classes"] == 2 and meta["image_size"] == 16
+
+    # predefined_net.image_data declares the source off the same tree
+    from paddle_tpu.utils.predefined_net import image_data
+
+    tch.reset_config({})
+    conf = image_data(root, 16)
+    assert conf["num_classes"] == 2 and conf["image_size"] == 16
+
+
+def test_dataset_permute_by_key():
+    from paddle_tpu.utils.preprocess_util import Dataset, Label
+
+    items = [(("x%d" % i), Label(i % 3, str(i % 3))) for i in range(30)]
+    ds = Dataset(list(items), ["data", "labels"])
+    ds.permute(1, 9)
+    labels = [it[1].label for it in ds.data]
+    assert sorted(labels) == sorted(it[1].label for it in items)
+    # stratified: every label appears in the first batch of 9
+    assert set(labels[:9]) == {0, 1, 2}
+
+
+# --------------------------------------------------------------------
+# predefined_net model builders
+# --------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("builder,conf", [
+    ("simple_conv_net", {"image_size": 20, "num_classes": 4}),
+    ("small_vgg", {"image_size": 8, "num_classes": 3, "is_color": True}),
+])
+def test_predefined_net_builds(builder, conf):
+    from paddle_tpu.trainer import resolve_config_outputs
+    from paddle_tpu.utils import predefined_net
+    from paddle_tpu.v2.topology import Topology
+
+    tch.reset_config({})
+    predefined_net.training_settings()
+    getattr(predefined_net, builder)(conf)
+    topo = Topology(resolve_config_outputs(tch.get_config_state()))
+    assert len(list(topo.main_program.global_block().ops)) > 10
+
+
+# --------------------------------------------------------------------
+# plotcurve / dump_v2_config / show_pb / make_model_diagram
+# --------------------------------------------------------------------
+
+
+def test_plotcurve_parse_and_plot(tmp_path):
+    from paddle_tpu.utils.plotcurve import parse_log, plot_paddle_curve
+
+    log = io.StringIO(
+        "I Trainer: Pass=0 Batch=10 AvgCost=1.5 Eval: error=0.5\n"
+        "I Tester: Test samples=100 AvgCost=1.2 Eval: error=0.4\n"
+        "I Trainer: Pass=1 Batch=10 AvgCost=0.9 Eval: error=0.3\n"
+    )
+    x, xt = parse_log(["AvgCost", "error"], log)
+    assert x.shape == (2, 3) and xt.shape == (1, 3)
+    assert x[0, 1] == 1.5 and x[1, 2] == 0.3
+
+    pytest.importorskip("matplotlib")
+    out = str(tmp_path / "curve.png")
+    log2 = io.StringIO("Pass=0 AvgCost=2.0\nPass=1 AvgCost=1.0\n")
+    plot_paddle_curve(["AvgCost"], log2, out)
+    assert os.path.getsize(out) > 0
+
+
+def _mlp_topology():
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.v2.topology import Topology
+
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector(4)
+    )
+    y = paddle.layer.fc(input=x, size=2,
+                        act=paddle.activation.Softmax())
+    return Topology(y)
+
+
+def test_dump_v2_config_and_show_pb(tmp_path, capsys):
+    from paddle_tpu.utils.dump_v2_config import dump_v2_config
+    from paddle_tpu.utils.show_pb import main as show_main
+
+    topo = _mlp_topology()
+    plain = str(tmp_path / "net.json")
+    packed = str(tmp_path / "net.json.gz")
+    dump_v2_config(topo, plain)
+    dump_v2_config(topo, packed, binary=True)
+
+    assert show_main([plain]) == 0
+    out1 = capsys.readouterr().out
+    assert "op fc" in out1 or "op mul" in out1
+    assert show_main([packed]) == 0  # gzip path decodes identically
+    assert capsys.readouterr().out == out1
+
+
+def test_make_model_diagram(tmp_path):
+    from paddle_tpu.utils.make_model_diagram import make_diagram
+
+    conf = tmp_path / "conf.py"
+    conf.write_text(
+        "settings(batch_size=8, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=6)\n"
+        "h = fc_layer(input=x, size=4)\n"
+        "outputs(h)\n"
+    )
+    dot_file = str(tmp_path / "net.dot")
+    make_diagram(str(conf), dot_file)
+    dot = open(dot_file).read()
+    assert "digraph" in dot and "fc" in dot
+
+
+# --------------------------------------------------------------------
+# trainer.config_parser / extension / v1 provider wrapper
+# --------------------------------------------------------------------
+
+
+def test_parse_config_from_callable_and_file(tmp_path):
+    from paddle_tpu.trainer.config_parser import (
+        parse_config,
+        parse_config_and_serialize,
+    )
+
+    def conf():
+        tch.settings(batch_size=4, learning_rate=0.01)
+        x = tch.data_layer(name="x", size=5)
+        tch.outputs(tch.fc_layer(input=x, size=3))
+
+    parsed = parse_config(conf)
+    assert parsed.model_config is parsed.topology
+    assert parsed.opt_config.get("batch_size") == 4
+
+    f = tmp_path / "c.py"
+    f.write_text(
+        "settings(batch_size=2, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=5)\n"
+        "outputs(fc_layer(input=x, size=3))\n"
+    )
+    s = parse_config_and_serialize(str(f))
+    assert '"fc"' in s or '"mul"' in s
+
+
+def test_config_parser_extension():
+    from paddle_tpu.trainer import config_parser_extension as ext
+
+    funcs = ext.get_config_funcs("cfg-sentinel")
+    d = funcs["SimpleData"](files="a.list", feat_dim=10, buffer_capacity=5)
+    assert d == {
+        "type": "simple", "files": "a.list", "feat_dim": 10,
+        "buffer_capacity": 5,
+    }
+    assert ext.g_config == "cfg-sentinel"
+
+
+def test_v1_provider_wrapper_reader():
+    from paddle_tpu.trainer.PyDataProviderWrapper import (
+        DenseSlot,
+        IndexSlot,
+        provider,
+    )
+
+    @provider(slots=[DenseSlot(3), IndexSlot(2)])
+    def process(obj, file_name):
+        for i in range(4):
+            yield [[float(i)] * 3, i % 2]
+
+    reader = process([None])
+    samples = list(reader())
+    assert len(samples) == 4
+    assert samples[2][0] == [2.0, 2.0, 2.0] and samples[2][1] == 0
+    # slot declarations lower to v2 input types
+    assert reader.input_types[0].dim == 3
