@@ -284,6 +284,7 @@ class Executor(object):
             jax.random.PRNGKey(program.random_seed), self._run_counter
         )
         fetches, new_persist = entry(persist_in, feed_arrays, rng)
+        _flush_print_effects(program)
         return _finish_run(
             scope, fetch_names, fetches, new_persist, return_numpy
         )
@@ -370,6 +371,7 @@ class Executor(object):
                 base_key=rng, seq_maxlen=seq_maxlen,
                 seq_buckets=seq_buckets,
             )
+            _flush_print_effects(program)
             return _finish_run(
                 scope, fetch_names, fetches, new_persist, return_numpy
             )
@@ -449,13 +451,7 @@ class Executor(object):
             jax.random.PRNGKey(program.random_seed), self._run_counter
         )
         fetches, new_persist = entry(persist_in, feed_arrays, rng)
-        if any(
-            op.type == "print" for blk in program.blocks for op in blk.ops
-        ):
-            # Print taps are jax.debug callbacks: flush them so debug
-            # output lands before run() returns (pending effects would
-            # otherwise be dropped at interpreter teardown)
-            jax.effects_barrier()
+        _flush_print_effects(program)
         return _finish_run(
             scope, fetch_names, fetches, new_persist, return_numpy
         )
@@ -463,6 +459,25 @@ class Executor(object):
     # convenience used by inference/serving paths ----------------------
     def close(self):
         self._cache.clear()
+
+
+_print_flag_cache: Dict[Any, bool] = {}
+
+
+def _flush_print_effects(program):
+    """If the program contains a print op, block on pending jax.debug
+    callbacks so debug output lands before run() returns (they would
+    otherwise be dropped at interpreter teardown). The per-program answer
+    is memoized on (uid, version) — no per-step op scan."""
+    key = (program.uid, program.version)
+    flag = _print_flag_cache.get(key)
+    if flag is None:
+        flag = any(
+            op.type == "print" for blk in program.blocks for op in blk.ops
+        )
+        _print_flag_cache[key] = flag
+    if flag:
+        jax.effects_barrier()
 
 
 def _finish_run(scope, fetch_names, fetches, new_persist, return_numpy):
@@ -696,7 +711,13 @@ def _mesh_jit_kwargs(
     from ..parallel.mesh import replicated
 
     rep = replicated(mesh)
-    n_data = mesh.shape.get("data", 1)
+    # batch dim shards over every data-parallel tier the mesh carries:
+    # 'dcn' (across slices, make_hybrid_mesh) outermost, then 'data'
+    # (within a slice). XLA's sharding propagation inserts the gradient
+    # reduction over both tiers, riding DCN only for the slice-crossing
+    # part.
+    data_axes = tuple(a for a in ("dcn", "data") if a in mesh.shape)
+    n_data = int(np.prod([mesh.shape[a] for a in data_axes])) if data_axes else 1
 
     def feed_shard(name, arr):
         if "@" in name:  # LoD / beam side-bands are replicated
@@ -704,12 +725,13 @@ def _mesh_jit_kwargs(
         # scanned feeds carry a leading [steps] dim; the batch is axis 1
         batch_axis = 1 if name in scanned_feeds else 0
         if (
-            arr.ndim > batch_axis
+            data_axes
+            and arr.ndim > batch_axis
             and arr.shape[batch_axis] > 0
             and arr.shape[batch_axis] % n_data == 0
         ):
             spec = [None] * arr.ndim
-            spec[batch_axis] = "data"
+            spec[batch_axis] = data_axes if len(data_axes) > 1 else data_axes[0]
             return NamedSharding(mesh, PartitionSpec(*spec))
         return rep
 

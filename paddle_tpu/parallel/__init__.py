@@ -19,6 +19,7 @@ from .mesh import (
     DistributedContext,
     data_sharding,
     get_default_mesh,
+    make_hybrid_mesh,
     make_mesh,
     replicated,
     set_default_mesh,
@@ -43,6 +44,7 @@ __all__ = [
     "moe_capacity",
     "reference_moe",
     "make_mesh",
+    "make_hybrid_mesh",
     "get_default_mesh",
     "set_default_mesh",
     "shard_parameter",

@@ -53,6 +53,69 @@ def make_mesh(
     return Mesh(arr, names)
 
 
+def make_hybrid_mesh(
+    dcn_axes: Dict[str, int],
+    ici_axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Multi-slice mesh: `dcn_axes` partition across slices (collectives
+    ride the data-center network), `ici_axes` partition within a slice
+    (collectives ride the chip interconnect). DCN axes are laid
+    outermost so only they cross slice boundaries — the layout the
+    scaling playbook prescribes (dp over DCN x tp/sp over ICI), and the
+    TPU-native form of the reference's two-tier topology (NCCL ring
+    within a node, pserver/gRPC across nodes).
+
+    Devices are grouped into slices by `slice_index` (TPU multi-slice)
+    or `process_index` (multi-host CPU/GPU); a single-group platform —
+    e.g. the one-process CPU test fixture — emulates the slice structure
+    by splitting the device list into contiguous groups, so the mesh
+    layout (and the collectives XLA inserts over it) compiles and
+    validates without pod hardware.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    dcn_names = tuple(dcn_axes.keys())
+    dcn_sizes = tuple(int(dcn_axes[n]) for n in dcn_names)
+    ici_names = tuple(ici_axes.keys())
+    ici_sizes = tuple(int(ici_axes[n]) for n in ici_names)
+    n_slices = int(np.prod(dcn_sizes))
+    per_slice = int(np.prod(ici_sizes))
+
+    groups: Dict[int, list] = {}
+    for d in devices:
+        key = getattr(d, "slice_index", None)
+        if key is None:
+            key = getattr(d, "process_index", 0)
+        groups.setdefault(int(key), []).append(d)
+    ordered = [groups[k] for k in sorted(groups)]
+    if len(ordered) == 1:
+        # single-slice platform: emulate the slice split contiguously
+        flat = ordered[0]
+        if n_slices * per_slice > len(flat):
+            raise ValueError(
+                "hybrid mesh needs %d devices but only %d available"
+                % (n_slices * per_slice, len(flat))
+            )
+        ordered = [
+            flat[i * per_slice:(i + 1) * per_slice] for i in range(n_slices)
+        ]
+    if len(ordered) != n_slices:
+        raise ValueError(
+            "dcn axes %r want %d slices but the platform has %d device "
+            "groups" % (dict(dcn_axes), n_slices, len(ordered))
+        )
+    for g in ordered:
+        if len(g) < per_slice:
+            raise ValueError(
+                "ici axes %r want %d devices per slice, a slice has %d"
+                % (dict(ici_axes), per_slice, len(g))
+            )
+    arr = np.asarray(
+        [g[:per_slice] for g in ordered], dtype=object
+    ).reshape(dcn_sizes + ici_sizes)
+    return Mesh(arr, dcn_names + ici_names)
+
+
 def set_default_mesh(mesh: Optional[Mesh]):
     global _default_mesh
     _default_mesh = mesh

@@ -73,15 +73,18 @@ def preprocess_img(im, img_mean, crop_size, is_train, color=True):
     return out.ravel()
 
 def load_meta(meta_path, mean_img_size, crop_size, color=True):
-    """Load a dataset meta file (pickled dict with a 'mean' image of size
-    mean_img_size) and center-crop the mean to crop_size."""
+    """Load a dataset meta file (the pickled dict
+    ImageClassificationDatasetCreater writes, flattened mean image under
+    'data_mean') and center-crop the mean to crop_size."""
     import pickle
 
     with open(meta_path, "rb") as f:
         meta = pickle.load(f)
-    mean = np.asarray(meta["mean"], np.float32)
-    c = 3 if color else 1
-    mean = mean.reshape(c, mean_img_size, mean_img_size)
+    mean = np.asarray(meta["data_mean"], np.float32)
+    if color:
+        mean = mean.reshape(3, mean_img_size, mean_img_size)
+    else:
+        mean = mean.reshape(mean_img_size, mean_img_size)
     return crop_img(mean, crop_size, color=color, test=True)
 
 

@@ -49,6 +49,13 @@ def plot_paddle_curve(keys, inputfile, outputfile, format="png",
     from matplotlib import cm
 
     m = len(keys) + 1
+    # test lines are one per pass while train lines come every
+    # log_period batches, so test curves get their own x coordinates
+    xs_test = (
+        x[:, 0]
+        if x_test.shape[0] == x.shape[0]
+        else np.arange(x_test.shape[0])
+    )
     for i in range(1, m):
         pyplot.plot(
             x[:, 0], x[:, i],
@@ -56,7 +63,7 @@ def plot_paddle_curve(keys, inputfile, outputfile, format="png",
         )
         if x_test.shape[0] > 0:
             pyplot.plot(
-                x[:, 0], x_test[:, i],
+                xs_test, x_test[:, i],
                 color=cm.jet(1.0 - 1.0 * (i - 1) / (2 * m)),
                 label="Test " + keys[i - 1],
             )

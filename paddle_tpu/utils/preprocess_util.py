@@ -200,7 +200,13 @@ class DatasetCreater(object):
         os.makedirs(out_path, exist_ok=True)
         train_data = self.create_dataset_from_dir(train_path)
         test_data = self.create_dataset_from_dir(test_path)
-        train_data.permute(None, self.num_per_batch)
+        permute_key = getattr(self, "permute_key", None)
+        key_id = (
+            self.keys.index(permute_key)
+            if permute_key and permute_key in getattr(self, "keys", [])
+            else None
+        )
+        train_data.permute(key_id, self.num_per_batch)
         batcher = DataBatcher(
             train_data, test_data, get_label_set_from_dir(train_path)
         )

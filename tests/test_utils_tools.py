@@ -113,6 +113,13 @@ def test_image_dataset_creater_end_to_end(tmp_path):
         meta = pickle.load(f)
     assert meta["num_classes"] == 2 and meta["image_size"] == 16
 
+    # writer -> reader round trip: image_util.load_meta reads the meta
+    # this creater wrote and center-crops the mean image
+    from paddle_tpu.utils.image_util import load_meta
+
+    mean = load_meta(os.path.join(out, "batches.meta"), 16, 12, color=True)
+    assert mean.shape == (3, 12, 12)
+
     # predefined_net.image_data declares the source off the same tree
     from paddle_tpu.utils.predefined_net import image_data
 
@@ -173,7 +180,16 @@ def test_plotcurve_parse_and_plot(tmp_path):
 
     pytest.importorskip("matplotlib")
     out = str(tmp_path / "curve.png")
-    log2 = io.StringIO("Pass=0 AvgCost=2.0\nPass=1 AvgCost=1.0\n")
+    # several train lines per pass + one test line per pass (the normal
+    # CLI log shape): the test curve must not crash on the count mismatch
+    log2 = io.StringIO(
+        "Pass=0 Batch=2 AvgCost=2.0\n"
+        "Pass=0 Batch=4 AvgCost=1.8\n"
+        "Test samples=10 AvgCost=1.5\n"
+        "Pass=1 Batch=2 AvgCost=1.2\n"
+        "Pass=1 Batch=4 AvgCost=1.0\n"
+        "Test samples=10 AvgCost=0.9\n"
+    )
     plot_paddle_curve(["AvgCost"], log2, out)
     assert os.path.getsize(out) > 0
 
