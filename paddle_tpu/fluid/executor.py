@@ -461,22 +461,19 @@ class Executor(object):
         self._cache.clear()
 
 
-_print_flag_cache: Dict[Any, bool] = {}
-
-
 def _flush_print_effects(program):
     """If the program contains a print op, block on pending jax.debug
     callbacks so debug output lands before run() returns (they would
-    otherwise be dropped at interpreter teardown). The per-program answer
-    is memoized on (uid, version) — no per-step op scan."""
-    key = (program.uid, program.version)
-    flag = _print_flag_cache.get(key)
-    if flag is None:
+    otherwise be dropped at interpreter teardown). The answer is
+    memoized ON the program (version-keyed, dies with it) — no per-step
+    op scan and no global cache to leak."""
+    memo = getattr(program, "_print_flag", None)
+    if memo is None or memo[0] != program.version:
         flag = any(
             op.type == "print" for blk in program.blocks for op in blk.ops
         )
-        _print_flag_cache[key] = flag
-    if flag:
+        program._print_flag = memo = (program.version, flag)
+    if memo[1]:
         jax.effects_barrier()
 
 
@@ -576,8 +573,11 @@ def _globalize_feeds(mesh, feed_arrays, scanned_feeds=()):
     import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec
 
-    has_data = "data" in mesh.axis_names
-    n_data = mesh.shape.get("data", 1)
+    # batch shards over every data-parallel tier ('dcn' across slices
+    # outermost, then 'data' within a slice — make_hybrid_mesh layout)
+    data_axes = tuple(a for a in ("dcn", "data") if a in mesh.axis_names)
+    has_data = bool(data_axes)
+    n_data = int(np.prod([mesh.shape[a] for a in data_axes])) if data_axes else 1
     out = {}
     lod_bases = {
         n[: -len(LOD_SUFFIX)] for n in feed_arrays if n.endswith(LOD_SUFFIX)
@@ -600,7 +600,9 @@ def _globalize_feeds(mesh, feed_arrays, scanned_feeds=()):
         batch_axis = 1 if name in scanned_feeds else 0
         if has_data and arr.ndim > batch_axis and arr.shape[batch_axis] > 0:
             spec = [None] * arr.ndim
-            spec[batch_axis] = "data"
+            spec[batch_axis] = (
+                data_axes if len(data_axes) > 1 else data_axes[0]
+            )
             sharding = NamedSharding(mesh, PartitionSpec(*spec))
         else:
             sharding = NamedSharding(mesh, PartitionSpec())

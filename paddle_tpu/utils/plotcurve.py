@@ -51,11 +51,17 @@ def plot_paddle_curve(keys, inputfile, outputfile, format="png",
     m = len(keys) + 1
     # test lines are one per pass while train lines come every
     # log_period batches, so test curves get their own x coordinates
-    xs_test = (
-        x[:, 0]
-        if x_test.shape[0] == x.shape[0]
-        else np.arange(x_test.shape[0])
-    )
+    if x_test.shape[0] == x.shape[0]:
+        xs_test = x[:, 0]
+    else:
+        # one test line per pass vs several train lines per pass: align
+        # test points to the actual pass ids
+        passes = np.unique(x[:, 0])
+        xs_test = (
+            passes[: x_test.shape[0]]
+            if x_test.shape[0] <= passes.shape[0]
+            else np.arange(x_test.shape[0])
+        )
     for i in range(1, m):
         pyplot.plot(
             x[:, 0], x[:, i],

@@ -15,6 +15,9 @@ The engine underneath is the fluid Program + XLA executor — `init`'s
 use_gpu/trainer_count map to the TPU chip / mesh data axis."""
 
 from . import activation  # noqa: F401
+from . import attr  # noqa: F401
+from . import networks  # noqa: F401
+from . import pooling  # noqa: F401
 from . import data_type  # noqa: F401
 from . import dataset  # noqa: F401
 from . import evaluator  # noqa: F401
@@ -32,6 +35,10 @@ from .trainer import infer  # noqa: F401
 
 # `import paddle.v2.fluid as fluid` parity: the fluid package is shared
 from .. import fluid  # noqa: F401
+from ..fluid import (  # noqa: F401
+    default_main_program,
+    default_startup_program,
+)
 
 __all__ = [
     "init", "batch", "infer", "layer", "activation", "data_type", "dataset",

@@ -103,3 +103,13 @@ class RMSProp(Optimizer):
             learning_rate=self.learning_rate, rho=self.rho,
             epsilon=self.epsilon,
         )
+
+
+# settings-objects shared with the legacy DSL (reference v2/optimizer.py
+# aliases the trainer_config_helpers implementations the same way)
+from ..trainer_config_helpers import (  # noqa: E402,F401
+    L2Regularization,
+    ModelAverage,
+)
+
+__all__ += ["ModelAverage", "L2Regularization"]
