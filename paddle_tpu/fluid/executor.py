@@ -573,11 +573,10 @@ def _globalize_feeds(mesh, feed_arrays, scanned_feeds=()):
     import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec
 
-    # batch shards over every data-parallel tier ('dcn' across slices
-    # outermost, then 'data' within a slice — make_hybrid_mesh layout)
-    data_axes = tuple(a for a in ("dcn", "data") if a in mesh.axis_names)
+    from ..parallel.mesh import data_parallel_axes
+
+    data_axes, n_data = data_parallel_axes(mesh)
     has_data = bool(data_axes)
-    n_data = int(np.prod([mesh.shape[a] for a in data_axes])) if data_axes else 1
     out = {}
     lod_bases = {
         n[: -len(LOD_SUFFIX)] for n in feed_arrays if n.endswith(LOD_SUFFIX)
@@ -600,9 +599,7 @@ def _globalize_feeds(mesh, feed_arrays, scanned_feeds=()):
         batch_axis = 1 if name in scanned_feeds else 0
         if has_data and arr.ndim > batch_axis and arr.shape[batch_axis] > 0:
             spec = [None] * arr.ndim
-            spec[batch_axis] = (
-                data_axes if len(data_axes) > 1 else data_axes[0]
-            )
+            spec[batch_axis] = data_axes
             sharding = NamedSharding(mesh, PartitionSpec(*spec))
         else:
             sharding = NamedSharding(mesh, PartitionSpec())
@@ -613,10 +610,11 @@ def _globalize_feeds(mesh, feed_arrays, scanned_feeds=()):
             # divergent per-process batches would desynchronise training
             # undetectably
             raise ValueError(
-                "feed %r local shape %s does not shard over the mesh "
-                "'data' axis (%d-way, %d processes); pad the batch or "
-                "drop the remainder on the host: %s"
-                % (name, arr.shape, n_data, _jax.process_count(), e)
+                "feed %r local shape %s does not shard over the mesh's "
+                "data-parallel tiers %s (%d-way total, %d processes); "
+                "pad the batch or drop the remainder on the host: %s"
+                % (name, arr.shape, list(data_axes), n_data,
+                   _jax.process_count(), e)
             )
     return out
 
@@ -712,14 +710,15 @@ def _mesh_jit_kwargs(
 
     from ..parallel.mesh import replicated
 
+    from ..parallel.mesh import data_parallel_axes
+
     rep = replicated(mesh)
-    # batch dim shards over every data-parallel tier the mesh carries:
-    # 'dcn' (across slices, make_hybrid_mesh) outermost, then 'data'
-    # (within a slice). XLA's sharding propagation inserts the gradient
-    # reduction over both tiers, riding DCN only for the slice-crossing
+    # batch dim shards over the mesh's data-parallel tiers (dcn* across
+    # slices outermost, 'data' within — one definition shared with
+    # _globalize_feeds). XLA's sharding propagation inserts the gradient
+    # reduction over every tier, riding DCN only for the slice-crossing
     # part.
-    data_axes = tuple(a for a in ("dcn", "data") if a in mesh.shape)
-    n_data = int(np.prod([mesh.shape[a] for a in data_axes])) if data_axes else 1
+    data_axes, n_data = data_parallel_axes(mesh)
 
     def feed_shard(name, arr):
         if "@" in name:  # LoD / beam side-bands are replicated
@@ -733,7 +732,7 @@ def _mesh_jit_kwargs(
             and arr.shape[batch_axis] % n_data == 0
         ):
             spec = [None] * arr.ndim
-            spec[batch_axis] = data_axes if len(data_axes) > 1 else data_axes[0]
+            spec[batch_axis] = data_axes
             return NamedSharding(mesh, PartitionSpec(*spec))
         return rep
 

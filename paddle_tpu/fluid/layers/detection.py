@@ -82,8 +82,17 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
               variance=None, flip=False, clip=False, step_w=0.0, step_h=0.0,
               offset=0.5, name=None):
     helper = LayerHelper("prior_box", **locals())
-    boxes = helper.create_tmp_variable(dtype=input.dtype)
-    variances = helper.create_tmp_variable(dtype=input.dtype)
+    # static output shape [H, W, P, 4]: P follows the kernel's anchor
+    # count — |min_sizes| x |{1} u aspects(x2 if flip)| + |max_sizes|
+    n_ar = 1 + len(aspect_ratios or []) * (2 if flip else 1)
+    n_priors = len(min_sizes) * n_ar + len(max_sizes or [])
+    h = input.shape[2] if input.shape and len(input.shape) == 4 else None
+    w = input.shape[3] if input.shape and len(input.shape) == 4 else None
+    out_shape = (
+        (int(h), int(w), n_priors, 4) if h and w else None
+    )
+    boxes = helper.create_tmp_variable(dtype=input.dtype, shape=out_shape)
+    variances = helper.create_tmp_variable(dtype=input.dtype, shape=out_shape)
     helper.append_op(
         type="prior_box",
         inputs={"Input": [input], "Image": [image]},

@@ -71,9 +71,7 @@ def ssd_lite(image, num_classes, image_size, batch, min_sizes=(0.2, 0.45)):
             clip=True,
             variance=[0.1, 0.1, 0.2, 0.2],
         )
-        # priors/location = |min_sizes| x |{1} u aspects(+flips)|
-        # (+1 per max_size, unused here) — the prior_box kernel's count
-        n_priors = 1 + 2  # ar=1, ar=2, ar=1/2 (flip)
+        n_priors = int(box.shape[2])  # [H, W, P, 4] static layer shape
         loc, conf = _head(feat, n_priors, num_classes, batch)
         heads.append((loc, conf))
         priors.append(layers.reshape(box, [-1, 4]))

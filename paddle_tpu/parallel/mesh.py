@@ -66,6 +66,11 @@ def make_hybrid_mesh(
     TPU-native form of the reference's two-tier topology (NCCL ring
     within a node, pserver/gRPC across nodes).
 
+    Batch sharding convention: the executor data-shards over axes
+    named 'dcn'/'dcn_*' and 'data' (data_parallel_axes); a DCN axis
+    with any other name stays out of the batch partition (e.g. a
+    cross-slice pipeline tier).
+
     Devices are grouped into slices by `slice_index` (TPU multi-slice)
     or `process_index` (multi-host CPU/GPU); a single-group platform —
     e.g. the one-process CPU test fixture — emulates the slice structure
@@ -114,6 +119,21 @@ def make_hybrid_mesh(
         [g[:per_slice] for g in ordered], dtype=object
     ).reshape(dcn_sizes + ici_sizes)
     return Mesh(arr, dcn_names + ici_names)
+
+
+def data_parallel_axes(mesh: Mesh):
+    """(axes, total) of the mesh's data-parallel tiers: every axis named
+    'dcn' or 'dcn_*' (slice-crossing, laid outermost by
+    make_hybrid_mesh) plus 'data' (within a slice). The executor shards
+    batch dims over exactly these axes — the single definition both the
+    jit-sharding and multi-process feed paths use."""
+    axes = tuple(
+        a
+        for a in mesh.axis_names
+        if a == "data" or a == "dcn" or str(a).startswith("dcn_")
+    )
+    total = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+    return axes, total
 
 
 def set_default_mesh(mesh: Optional[Mesh]):

@@ -43,7 +43,8 @@ from ..fluid import (  # noqa: F401
 __all__ = [
     "init", "batch", "infer", "layer", "activation", "data_type", "dataset",
     "evaluator", "event", "minibatch", "optimizer", "parameters", "reader",
-    "trainer",
+    "trainer", "attr", "pooling", "networks",
+    "default_main_program", "default_startup_program",
     "master", "plot",
     "fluid",
 ]

@@ -108,6 +108,23 @@ def test_clone_preserves_remat():
     assert main.clone(for_test=True).remat
 
 
+def test_memory_optimize_composes_with_amp():
+    # remat wraps the same fwd closure AMP rewrites; together they must
+    # match the AMP-only run (bf16 forward, f32 master params, recompute
+    # backward changes the schedule, not the math)
+    main, startup, loss = _build_mlp()
+    main.amp = True
+    amp_only = _train_losses(main, startup, loss)
+
+    main, startup, loss = _build_mlp()
+    main.amp = True
+    fluid.memory_optimize(main)
+    amp_remat = _train_losses(main, startup, loss)
+
+    assert all(np.isfinite(amp_remat)), amp_remat
+    np.testing.assert_allclose(amp_only, amp_remat, rtol=2e-2, atol=1e-2)
+
+
 def test_serialization_round_trips_remat():
     from paddle_tpu.fluid.core import serialization
 
