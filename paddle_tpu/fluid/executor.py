@@ -708,9 +708,7 @@ def _mesh_jit_kwargs(
     """
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from ..parallel.mesh import replicated
-
-    from ..parallel.mesh import data_parallel_axes
+    from ..parallel.mesh import data_parallel_axes, replicated
 
     rep = replicated(mesh)
     # batch dim shards over the mesh's data-parallel tiers (dcn* across

@@ -83,9 +83,11 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
               offset=0.5, name=None):
     helper = LayerHelper("prior_box", **locals())
     # static output shape [H, W, P, 4]: P follows the kernel's anchor
-    # count — |min_sizes| x |{1} u aspects(x2 if flip)| + |max_sizes|
+    # count — per min_size: {1} u aspects(x2 if flip), plus ONE
+    # sqrt(min*max) box when max_sizes are given (kernel pairs them
+    # per min_size)
     n_ar = 1 + len(aspect_ratios or []) * (2 if flip else 1)
-    n_priors = len(min_sizes) * n_ar + len(max_sizes or [])
+    n_priors = len(min_sizes) * (n_ar + (1 if max_sizes else 0))
     h = input.shape[2] if input.shape and len(input.shape) == 4 else None
     w = input.shape[3] if input.shape and len(input.shape) == 4 else None
     out_shape = (

@@ -151,8 +151,11 @@ def _ln(x, p):
 
 
 def forward(params, tokens, cfg: TransformerConfig,
-            mesh: Optional[Mesh] = None, attn_impl: str = "ring"):
-    """tokens [B, T] int -> logits [B, T, vocab]."""
+            mesh: Optional[Mesh] = None, attn_impl: str = "ring",
+            kv_sink: Optional[list] = None):
+    """tokens [B, T] int -> logits [B, T, vocab]. With `kv_sink` (a
+    list), each block appends its (k, v) [B, T, H, Dh] — the prefill
+    hook for cached decoding, so serving reuses THIS block math."""
     B, T = tokens.shape
     if mesh is not None and "model" in mesh.axis_names:
         from ..parallel.embedding import sharded_lookup
@@ -167,6 +170,8 @@ def forward(params, tokens, cfg: TransformerConfig,
         q = (h @ blk["wq"]).reshape(B, T, cfg.heads, cfg.dim // cfg.heads)
         k = (h @ blk["wk"]).reshape(B, T, cfg.heads, cfg.dim // cfg.heads)
         v = (h @ blk["wv"]).reshape(B, T, cfg.heads, cfg.dim // cfg.heads)
+        if kv_sink is not None:
+            kv_sink.append((k, v))
         o = sequence_parallel_attention(
             q, k, v, mesh=mesh, axis="seq", impl=attn_impl, causal=True
         )
@@ -218,3 +223,140 @@ def make_train_step(cfg: TransformerConfig, lr=1e-2,
         return params, loss
 
     return step
+
+
+# ---------------------------------------------------------------------
+# incremental decoding (serving): per-layer KV cache + one-token steps.
+# The reference era served RNN generation through beam search
+# (RecurrentGradientMachine.h:307); the transformer-equivalent serving
+# primitive is cached autoregressive decode — prefill computes the
+# prompt's K/V once, then each new token attends over the cache instead
+# of re-running the whole prefix (O(T) per token, not O(T^2)).
+# ---------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len=None,
+                  dtype=None):
+    """Per-layer K/V buffers [B, L, H, Dh], zero-initialised."""
+    L = int(max_len or cfg.max_len)
+    dh = cfg.dim // cfg.heads
+    shape = (batch, L, cfg.heads, dh)
+    dt = dtype or cfg.dtype
+    return [
+        {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        for _ in range(cfg.layers)
+    ]
+
+
+def _cached_attention(q, cache_k, cache_v, pos):
+    """q [B,H,Dh] against the cache [B,L,H,Dh]; positions > pos masked."""
+    B, L, H, dh = cache_k.shape
+    scores = jnp.einsum("bhd,blhd->bhl", q, cache_k) / math.sqrt(dh)
+    mask = (jnp.arange(L) <= pos)[None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhl,blhd->bhd", probs, cache_v)
+
+
+def decode_step(params, token, pos, cache, cfg: TransformerConfig):
+    """One decode step: token [B] int at position `pos` (scalar) ->
+    (logits [B, vocab], updated cache)."""
+    B = token.shape[0]
+    dh = cfg.dim // cfg.heads
+    x = params["embed"][token] + params["pos"][pos]
+    new_cache = []
+    for blk, kv in zip(params["blocks"], cache):
+        h = _ln(x, blk["ln1"])
+        q = (h @ blk["wq"]).reshape(B, cfg.heads, dh)
+        k = (h @ blk["wk"]).reshape(B, cfg.heads, dh)
+        v = (h @ blk["wv"]).reshape(B, cfg.heads, dh)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            kv["k"], k[:, None].astype(kv["k"].dtype), pos, axis=1
+        )
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            kv["v"], v[:, None].astype(kv["v"].dtype), pos, axis=1
+        )
+        new_cache.append({"k": ck, "v": cv})
+        o = _cached_attention(q, ck, cv, pos).reshape(B, cfg.dim)
+        x = x + o @ blk["wo"]
+        h = _ln(x, blk["ln2"])
+        if "moe" in blk:
+            from ..parallel.moe import reference_moe
+
+            mp = blk["moe"]
+            x = x + reference_moe(
+                h, mp["gate_w"], mp["w1"], mp["b1"], mp["w2"], mp["b2"]
+            )
+        else:
+            x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
+    x = _ln(x, params["ln_f"])
+    return x @ params["embed"].T, new_cache
+
+
+def prefill(params, tokens, cfg: TransformerConfig, max_len=None):
+    """Run the prompt [B, T0] once through forward() (kv_sink hook),
+    filling the cache; returns (logits of the LAST prompt position
+    [B, vocab], cache). Reuses forward's block math exactly — no
+    duplicated transformer loop to drift."""
+    B, T0 = tokens.shape
+    cache = init_kv_cache(cfg, B, max_len=max_len)
+    sink: list = []
+    logits = forward(
+        params, tokens, cfg, mesh=None, attn_impl="reference",
+        kv_sink=sink,
+    )
+    for i, (k, v) in enumerate(sink):
+        cache[i] = {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["k"], k.astype(cache[i]["k"].dtype), 0, axis=1
+            ),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["v"], v.astype(cache[i]["v"].dtype), 0, axis=1
+            ),
+        }
+    return logits[:, -1], cache
+
+
+def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,
+             temperature=0.0, key=None, max_len=None):
+    """Autoregressive generation: prefill the prompt [B, T0], then
+    `max_new_tokens` cached decode steps inside ONE lax.scan (compiled
+    once; the host never re-enters the loop). temperature<=0 is greedy;
+    otherwise softmax sampling with `key`. Returns [B, T0+max_new]."""
+    B, T0 = prompt.shape
+    L = int(max_len or cfg.max_len)
+    # the positional table bounds every position regardless of cache
+    # size — JAX gather would silently clamp out-of-range indices
+    L = min(L, int(params["pos"].shape[0]))
+    if T0 + max_new_tokens > L:
+        raise ValueError(
+            "generate needs T0+max_new <= max_len (%d + %d > %d, "
+            "positional table %d)"
+            % (T0, max_new_tokens, L, int(params["pos"].shape[0]))
+        )
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires `key`")
+    logits, cache = prefill(params, prompt, cfg, max_len=L)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    def pick(logits, k):
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits.astype(jnp.float32) / temperature, axis=-1
+        ).astype(prompt.dtype)
+
+    def body(carry, i):
+        logits, cache, k = carry
+        k, sub = jax.random.split(k)
+        tok = pick(logits, sub)
+        logits, cache = decode_step(params, tok, T0 + i, cache, cfg)
+        return (logits, cache, k), tok
+
+    (_, _, _), toks = jax.lax.scan(
+        body, (logits, cache, key), jnp.arange(max_new_tokens)
+    )
+    return jnp.concatenate([prompt, toks.T], axis=1)
+
+
+__all__ += ["init_kv_cache", "decode_step", "prefill", "generate"]

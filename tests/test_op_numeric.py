@@ -921,6 +921,9 @@ spec("lambda_rank",
 
 
 EXEMPT = {
+    "print": "identity pass-through debug tap (jax.debug.callback side "
+             "effect); forward/backward/first_n semantics covered in "
+             "test_print_op.py",
     "lstmp": "full-sequence projected LSTM; trained + shape-checked in "
              "test_fluid_surface_round3.py (lstm_unit grad-checked here)",
     "ctc_align": "integer decode (non-differentiable); oracle in "
