@@ -533,7 +533,7 @@ def _dynamic_rnn(ctx, ins, attrs):
     x0_name = step_outer[0]
     offsets = env[lod_key(x0_name)]
     total = env[x0_name].shape[0]
-    T = _seq_T(ctx, total)
+    T = _seq_T(ctx, total, offsets)
     B = offsets.shape[0] - 1
 
     xs_padded = []
@@ -799,7 +799,7 @@ def _lod_tensor_to_array(ctx, ins, attrs):
     total = x.shape[0]
     from .kernels_rnn import _seq_T
 
-    T = _seq_T(ctx, x.shape[0])
+    T = _seq_T(ctx, x.shape[0], offsets)
     arr = TensorArray()
     for t in range(T):
         src = offsets[order] + t

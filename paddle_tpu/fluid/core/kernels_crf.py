@@ -45,7 +45,7 @@ def _linear_chain_crf(ctx, ins, attrs):
     label = ins["Label"][0].reshape(-1)  # [total]
     offsets = _emission_lod(ctx)
     total, n = em.shape
-    T = _seq_T(ctx, total)
+    T = _seq_T(ctx, total, offsets)
     B = offsets.shape[0] - 1
 
     a, b, w = tr[0], tr[1], tr[2:]  # start, end, transitions
@@ -100,7 +100,7 @@ def _crf_decoding(ctx, ins, attrs):
     tr = ins["Transition"][0]
     offsets = _emission_lod(ctx)
     total, n = em.shape
-    T = _seq_T(ctx, total)
+    T = _seq_T(ctx, total, offsets)
     B = offsets.shape[0] - 1
 
     a, b, w = tr[0], tr[1], tr[2:]

@@ -34,7 +34,7 @@ def _bucket_of(ctx, slot, total):
     b = ctx.seq_buckets.get(name)
     if b is not None:
         return min(int(b), int(total))
-    return _seq_T(ctx, total)
+    return _seq_T(ctx, total, ctx.env.get(name))
 
 
 @register_op("warpctc")

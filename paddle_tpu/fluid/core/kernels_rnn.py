@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register_op
@@ -40,10 +41,24 @@ def _act(name):
     return _ACTS[name]
 
 
-def _seq_T(ctx, total):
-    """Static time extent for padded RNN compute: the Executor's bucketed
-    max sequence length when available, else the packed total (correct for
-    any batch, just wasteful — only hit on direct build_step_fn uses)."""
+def _seq_T(ctx, total, offsets=None):
+    """Static time extent for padded RNN compute. Preference order:
+
+    1. `offsets` when they are trace-time CONSTANTS (e.g. the uniform
+       LoD im2sequence emits from static image geometry): the exact
+       bucketed max length — fed-LoD buckets know nothing about
+       graph-produced sequences, and a too-small bucket would silently
+       truncate the scan.
+    2. the Executor's bucketed max FED sequence length (ctx.seq_maxlen).
+    3. the packed total (correct for any batch, just wasteful — only
+       hit on direct build_step_fn uses)."""
+    if offsets is not None and not isinstance(offsets, jax.core.Tracer):
+        d = np.diff(np.asarray(offsets))
+        if d.size and int(d.max()) > 0:
+            m, b = int(d.max()), 8
+            while b < m:
+                b *= 2
+            return b
     T = getattr(ctx, "seq_maxlen", None)
     return int(T) if T else int(total)
 
@@ -103,7 +118,7 @@ def _lstm(ctx, ins, attrs):
     else:
         w_ic = w_fc = w_oc = None
 
-    T = _seq_T(ctx, total)
+    T = _seq_T(ctx, total, offsets)
     xp, mask = packed_to_padded(x, offsets, T, reverse=reverse)  # [n,T,4H]
     xp = jnp.swapaxes(xp, 0, 1)          # [T, n, 4H] time-major
     mask_t = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)  # [T,n,1]
@@ -163,7 +178,7 @@ def _gru(ctx, ins, attrs):
     w_ur = w[:, : 2 * H]   # update|reset
     w_c = w[:, 2 * H :]    # candidate
 
-    T = _seq_T(ctx, total)
+    T = _seq_T(ctx, total, offsets)
     xp, mask = packed_to_padded(x, offsets, T, reverse=reverse)
     xp = jnp.swapaxes(xp, 0, 1)
     mask_t = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
@@ -285,7 +300,7 @@ def _lstmp(ctx, ins, attrs):
     else:
         w_ic = w_fc = w_oc = None
 
-    T = _seq_T(ctx, total)
+    T = _seq_T(ctx, total, offsets)
     xp, mask = packed_to_padded(x, offsets, T, reverse=reverse)
     xp = jnp.swapaxes(xp, 0, 1)
     mask_t = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)

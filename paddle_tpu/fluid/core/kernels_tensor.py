@@ -303,6 +303,14 @@ def _im2sequence(ctx, ins, attrs):
         x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
     )  # [N, C*kh*kw, oh, ow]
     out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    # each image becomes one sequence of oh*ow steps (reference
+    # im2sequence_op.cc sets the output LoD the same way) — downstream
+    # sequence ops (warpctc, dynamic RNN) read the offsets side-band
+    from .kernels_sequence import lod_key
+
+    ctx.env[lod_key(ctx.op.outputs["Out"][0])] = jnp.arange(
+        n + 1, dtype=jnp.int32
+    ) * (oh * ow)
     return {"Out": out}
 
 

@@ -152,10 +152,12 @@ def _ln(x, p):
 
 def forward(params, tokens, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None, attn_impl: str = "ring",
-            kv_sink: Optional[list] = None):
-    """tokens [B, T] int -> logits [B, T, vocab]. With `kv_sink` (a
-    list), each block appends its (k, v) [B, T, H, Dh] — the prefill
-    hook for cached decoding, so serving reuses THIS block math."""
+            kv_sink: Optional[list] = None, last_only: bool = False):
+    """tokens [B, T] int -> logits [B, T, vocab] (or [B, vocab] of just
+    the final position with last_only — prefill skips the O(T x vocab)
+    head it would discard). With `kv_sink` (a list), each block appends
+    its (k, v) [B, T, H, Dh] — the prefill hook for cached decoding, so
+    serving reuses THIS block math."""
     B, T = tokens.shape
     if mesh is not None and "model" in mesh.axis_names:
         from ..parallel.embedding import sharded_lookup
@@ -196,6 +198,8 @@ def forward(params, tokens, cfg: TransformerConfig,
         else:
             x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
 
+    if last_only:
+        x = x[:, -1]
     x = _ln(x, params["ln_f"])
     return x @ params["embed"].T  # weight-tied output head
 
@@ -303,7 +307,7 @@ def prefill(params, tokens, cfg: TransformerConfig, max_len=None):
     sink: list = []
     logits = forward(
         params, tokens, cfg, mesh=None, attn_impl="reference",
-        kv_sink=sink,
+        kv_sink=sink, last_only=True,
     )
     for i, (k, v) in enumerate(sink):
         cache[i] = {
@@ -314,7 +318,7 @@ def prefill(params, tokens, cfg: TransformerConfig, max_len=None):
                 cache[i]["v"], v.astype(cache[i]["v"].dtype), 0, axis=1
             ),
         }
-    return logits[:, -1], cache
+    return logits, cache
 
 
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,

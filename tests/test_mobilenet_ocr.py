@@ -1,0 +1,144 @@
+"""MobileNet v1 (depthwise/grouped conv) + CRNN-CTC OCR model families."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models.mobilenet import mobilenet_v1
+from paddle_tpu.models.ocr_crnn import crnn_ctc, greedy_decode
+
+
+def test_mobilenet_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+        pred = mobilenet_v1(img, class_dim=8, scale=0.25)
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=pred, label=lbl)
+        )
+        fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+            loss
+        )
+    rng = np.random.RandomState(0)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        # learnable signal: class = brightest channel-quadrant pattern
+        losses = []
+        for _ in range(8):
+            imgs = 0.1 * rng.rand(16, 3, 32, 32).astype(np.float32)
+            ys = rng.randint(0, 8, (16, 1)).astype(np.int64)
+            for i, y in enumerate(ys[:, 0]):
+                imgs[i, y % 3, (y // 3) * 8:(y // 3) * 8 + 8] += 1.0
+            out = exe.run(main, feed={"img": imgs, "lbl": ys},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(out[0])[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_mobilenet_depthwise_groups_in_graph():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        mobilenet_v1(img, class_dim=4, scale=0.25)
+    groups = [
+        op.attrs.get("groups", 1)
+        for op in main.global_block().ops
+        if op.type == "conv2d"
+    ]
+    # 13 depthwise convs with groups == channels
+    assert sum(1 for g in groups if g > 1) == 13
+
+
+def _ocr_batch(rng, n=4, num_classes=5, label_len=3):
+    """Images whose column blocks encode the label digits as vertical
+    intensity bands — enough signal for CTC to latch onto."""
+    W = 24
+    imgs = 0.05 * rng.rand(n, 1, 8, W).astype(np.float32)
+    labels, lens = [], []
+    for i in range(n):
+        lab = rng.randint(0, num_classes, label_len)
+        for j, c in enumerate(lab):
+            col = 2 + j * 8
+            imgs[i, 0, :, col:col + 4] += 0.2 + 0.15 * c
+        labels.extend(lab)
+        lens.append(label_len)
+    lod = [np.cumsum([0] + lens).astype(np.int32)]
+    return imgs, (np.asarray(labels, np.int64).reshape(-1, 1), lod)
+
+
+def test_graph_produced_lod_not_truncated_by_fed_bucket():
+    """im2sequence emits MORE steps than any fed LoD's bucket: the RNN
+    time extent must follow the graph-produced offsets, not the fed
+    bucket (a too-small bucket silently dropped late columns)."""
+    NC = 3
+    W = 96  # 24 columns per image after /4 pooling — way past bucket 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, W],
+                                dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                                lod_level=1)  # fed LoD: len-2 seqs
+        loss, logits = crnn_ctc(img, lab, num_classes=NC, hidden=16)
+    rng = np.random.RandomState(3)
+    base = 0.05 * rng.rand(2, 1, 8, W).astype(np.float32)
+    labels = (
+        np.asarray([0, 1, 1, 2], np.int64).reshape(-1, 1),
+        [np.asarray([0, 2, 4], np.int32)],
+    )
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        out0 = exe.run(main, feed={"img": base, "lab": labels},
+                       fetch_list=[logits])[0]
+        bumped = base.copy()
+        bumped[:, :, :, -3:] += 1.0  # signal ONLY in the last columns
+        out1 = exe.run(main, feed={"img": bumped, "lab": labels},
+                       fetch_list=[logits])[0]
+    # 24 columns per image, 2 images
+    assert out0.shape[0] == 2 * 24, out0.shape
+    # the late columns must influence the logits (no silent truncation)
+    tail = slice(20, 24)
+    assert not np.allclose(out0[tail], out1[tail])
+
+
+def test_crnn_ctc_trains_and_decodes():
+    NC = 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 24],
+                                dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                                lod_level=1)
+        loss, logits = crnn_ctc(img, lab, num_classes=NC, hidden=24)
+        decoded = greedy_decode(logits, NC)
+        infer_prog = main.clone(for_test=True)  # BEFORE minimize
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(15):
+            imgs, labels = _ocr_batch(rng, num_classes=NC)
+            out = exe.run(main, feed={"img": imgs, "lab": labels},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(out[0])[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+        # greedy decode on the SAME scope's trained weights (infer clone
+        # shares parameter names through the scope)
+        imgs, _ = _ocr_batch(rng, num_classes=NC)
+        dec = exe.run(infer_prog, feed={"img": imgs},
+                      fetch_list=[decoded])[0]
+    dec = np.ravel(dec)
+    # decoded ids are real classes (blank stripped)
+    assert ((dec >= 0) & (dec < NC)).all() or dec.size == 0
