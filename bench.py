@@ -56,6 +56,7 @@ FWD_FLOPS = {
     "vgg16": 15.47e9,     # 224x224
     "alexnet": 1.43e9,    # 224x224 (0.71 GMAC)
     "googlenet": 3.0e9,   # 224x224 inception v1 (1.5 GMAC)
+    "mobilenet": 1.14e9,  # 224x224 v1 1.0x (0.57 GMAC)
 }
 
 AMP = os.environ.get("BENCH_AMP", "1") == "1"
@@ -149,12 +150,14 @@ def _xla_step_cost(prog, cost, feed):
 
 
 def bench_image(name, model_fn, batch, steps=(12, 72), baseline_ips=None,
-                xla_cost=False):
+                xla_cost=False, remat=False):
     import jax
 
     import paddle_tpu.fluid as fluid
 
     prog, startup, cost = _build_image_workload(fluid, model_fn, batch)
+    if remat:
+        fluid.memory_optimize(prog)  # forward-region rematerialization
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
     rng = np.random.RandomState(0)
@@ -545,7 +548,11 @@ def main():
     init_done.set()
     from paddle_tpu.models.alexnet import alexnet
     from paddle_tpu.models.googlenet import googlenet
+    from paddle_tpu.models.mobilenet import mobilenet_v1
+    from paddle_tpu.models.resnet import resnet_imagenet
     from paddle_tpu.models.vgg import vgg16
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
 
     quick = os.environ.get("BENCH_QUICK", "0") == "1"
     only = os.environ.get("BENCH_ONLY", "").split(",") if os.environ.get("BENCH_ONLY") else None
@@ -582,6 +589,13 @@ def main():
         run("googlenet", lambda: bench_image(
             "googlenet", lambda i, c: googlenet(i, c), 128, baseline_ips=111.4))
         run("vgg16", lambda: bench_image("vgg16", lambda i, c: vgg16(i, c), 64))
+        run("mobilenet", lambda: bench_image(
+            "mobilenet", lambda i, c: mobilenet_v1(i, c), 128))
+        # the memory_optimize pass on the headline model: recompute
+        # trades HBM residency for FLOPs — records the throughput cost
+        run("resnet50_remat", lambda: bench_image(
+            "resnet50", lambda i, c: resnet_imagenet(
+                i, class_dim=c, depth=50), batch, remat=True))
         run("lstm", bench_lstm)
         run("flash_attention", bench_flash_attention)
         run("transformer_lm", bench_transformer_lm)
@@ -589,7 +603,6 @@ def main():
     # r3 batch sweep: 512 is past the knee (~2.4k img/s); 128 vs 256 is
     # within the tunnel's run-to-run noise (2.5-3.8k observed), so the
     # default stays at the historically comparable 128
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
     chunk_steps = int(os.environ.get("BENCH_CHUNK_STEPS", "25"))
     n_chunks = int(os.environ.get("BENCH_CHUNKS", "6"))
 

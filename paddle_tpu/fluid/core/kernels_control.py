@@ -895,16 +895,17 @@ def _print_op(ctx, ins, attrs):
         if 0 < first_n <= counter["n"]:
             return
         counter["n"] += 1
-        flat = np.ravel(np.asarray(val))
+        arr = np.asarray(val)
+        flat = np.ravel(arr)
         if summarize >= 0:
             flat = flat[:summarize]
         bits = [message] if message else []
         if show_name:
             bits.append("name=%s%s" % (name, tag))
         if show_type:
-            bits.append("dtype=%s" % np.asarray(val).dtype)
+            bits.append("dtype=%s" % arr.dtype)
         if show_shape:
-            bits.append("shape=%s" % (tuple(np.asarray(val).shape),))
+            bits.append("shape=%s" % (arr.shape,))
         if lod_val is not None:
             bits.append("lod=%s" % np.asarray(lod_val).tolist())
         print("%s data=%s" % (" ".join(bits), flat), flush=True)

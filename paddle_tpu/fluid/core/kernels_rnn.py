@@ -45,20 +45,18 @@ def _seq_T(ctx, total, offsets=None):
     """Static time extent for padded RNN compute. Preference order:
 
     1. `offsets` when they are trace-time CONSTANTS (e.g. the uniform
-       LoD im2sequence emits from static image geometry): the exact
-       bucketed max length — fed-LoD buckets know nothing about
-       graph-produced sequences, and a too-small bucket would silently
-       truncate the scan.
+       LoD im2sequence emits from static image geometry): the EXACT max
+       length — fed-LoD buckets know nothing about graph-produced
+       sequences (a too-small bucket would silently truncate the scan),
+       and constants can never vary within a compiled program, so
+       power-of-two bucketing would only pad the scan with dead steps.
     2. the Executor's bucketed max FED sequence length (ctx.seq_maxlen).
     3. the packed total (correct for any batch, just wasteful — only
        hit on direct build_step_fn uses)."""
     if offsets is not None and not isinstance(offsets, jax.core.Tracer):
         d = np.diff(np.asarray(offsets))
         if d.size and int(d.max()) > 0:
-            m, b = int(d.max()), 8
-            while b < m:
-                b *= 2
-            return b
+            return int(d.max())
     T = getattr(ctx, "seq_maxlen", None)
     return int(T) if T else int(total)
 

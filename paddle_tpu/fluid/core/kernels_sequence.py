@@ -24,6 +24,16 @@ from .registry import register_op
 LOD_SUFFIX = "@LOD0"
 
 
+def bucket_pow2(m: int, floor: int = 8) -> int:
+    """Smallest power-of-two >= m (min `floor`) — the static sequence
+    bucket used by the Executor's feed-time bucketing and the kernels'
+    trace-time-constant LoD sizing."""
+    b = floor
+    while b < m:
+        b *= 2
+    return b
+
+
 def lod_key(name: str) -> str:
     return name + LOD_SUFFIX
 

@@ -509,12 +509,7 @@ def _maybe_check_nan_inf(fetch_names, fetches, new_persist):
 def _lod_bucket(feed_arrays):
     """Bucket each fed LoD's max sequence length up to the next power of
     two (min 8). Returns (global_max_bucket_or_None, {lod_name: bucket})."""
-
-    def bucket(m):
-        b = 8
-        while b < m:
-            b *= 2
-        return b
+    from .core.kernels_sequence import bucket_pow2 as bucket
 
     per_name = {}
     m = 0
