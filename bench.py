@@ -413,6 +413,43 @@ def bench_transformer_lm(B=8, T=1024, dim=512, heads=8, layers_n=8,
     }
 
 
+def bench_lm_decode(B=8, T0=512, new_tokens=(64, 192), dim=512, heads=8,
+                    layers_n=8, vocab=32000):
+    """Cached autoregressive decode throughput (tokens/s/chip): prefill
+    once, then KV-cache decode steps inside one lax.scan
+    (models/transformer.py generate). The serving-side companion to the
+    training record; beyond-reference capability, no 2018 baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as tlm
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=T0 + max(new_tokens),
+                                dtype=jnp.bfloat16)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompt = jax.device_put(
+        rng.randint(0, vocab, (B, T0)).astype(np.int32))
+
+    gens = {
+        n: jax.jit(lambda p, pr, n=n: tlm.generate(p, pr, cfg, n))
+        for n in new_tokens
+    }
+
+    def run_at(n):
+        out = gens[n](params, prompt)
+        assert int(np.asarray(out[0, -1])) >= 0
+
+    dt = _diff_time(run_at, *new_tokens)  # seconds per generated token
+    return {
+        "decode_tokens_per_sec": round(B / dt, 1),
+        "ms_per_token": round(dt * 1e3 / B, 3),
+        "batch": B,
+        "prompt_len": T0,
+    }
+
+
 def bench_flash_attention(B=4, T=4096, H=16, D=64, steps=(4, 16)):
     """Pallas flash attention vs XLA full-matrix attention, single chip,
     bf16, causal (parallel/flash_attention.py). Timing puts the
@@ -598,6 +635,7 @@ def main():
                 i, class_dim=c, depth=50), batch, remat=True))
         run("lstm", bench_lstm)
         run("flash_attention", bench_flash_attention)
+        run("lm_decode", bench_lm_decode)
         run("transformer_lm", bench_transformer_lm)
 
     # r3 batch sweep: 512 is past the knee (~2.4k img/s); 128 vs 256 is

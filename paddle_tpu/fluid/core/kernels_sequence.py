@@ -26,8 +26,9 @@ LOD_SUFFIX = "@LOD0"
 
 def bucket_pow2(m: int, floor: int = 8) -> int:
     """Smallest power-of-two >= m (min `floor`) — the static sequence
-    bucket used by the Executor's feed-time bucketing and the kernels'
-    trace-time-constant LoD sizing."""
+    bucket the Executor applies to FED LoD max lengths so XLA compiles
+    once per bucket, not per batch. (Trace-time-constant LoDs skip the
+    bucket and use their exact max — kernels_rnn._seq_T.)"""
     b = floor
     while b < m:
         b *= 2

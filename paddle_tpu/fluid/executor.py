@@ -20,7 +20,7 @@ import numpy as np
 
 from . import core
 from .core.kernels_control import LOD_SRC
-from .core.kernels_sequence import LOD_SUFFIX, lod_key
+from .core.kernels_sequence import LOD_SUFFIX, bucket_pow2, lod_key
 from .core.lowering import build_step_fn
 from .core.program import Program, Variable
 
@@ -509,7 +509,7 @@ def _maybe_check_nan_inf(fetch_names, fetches, new_persist):
 def _lod_bucket(feed_arrays):
     """Bucket each fed LoD's max sequence length up to the next power of
     two (min 8). Returns (global_max_bucket_or_None, {lod_name: bucket})."""
-    from .core.kernels_sequence import bucket_pow2 as bucket
+    bucket = bucket_pow2
 
     per_name = {}
     m = 0
