@@ -11,7 +11,8 @@ data dispatch and durable state.
 
 from .coordinator import (Coordinator, CoordinatorServer, MasterClient,
                           RemoteCoordinator, Task)
-from .checkpoint import load_checkpoint, save_checkpoint
+from .checkpoint import (AsyncCheckpoint, load_checkpoint,
+                         save_checkpoint, save_checkpoint_async)
 
 __all__ = [
     "Coordinator",
@@ -20,5 +21,7 @@ __all__ = [
     "MasterClient",
     "Task",
     "save_checkpoint",
+    "save_checkpoint_async",
+    "AsyncCheckpoint",
     "load_checkpoint",
 ]

@@ -354,3 +354,100 @@ def load_checkpoint(scope, dirname: str, strict: bool = True) -> dict:
             scope.set(name, val)
             merged["entries"][name] = ent
     return merged
+
+
+# ---------------------------------------------------------------------
+# async save: snapshot now, write in the background. Preemption-aware
+# training wants the step loop paused only for the device->host pull,
+# not for CRC + disk + rename (the reference's Go pserver likewise
+# checkpoints off the serving path, service.go:120).
+# ---------------------------------------------------------------------
+
+
+class _HostScope(object):
+    """Scope-shaped view over host numpy snapshots."""
+
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    def keys(self):
+        return self._arrays.keys()
+
+    def get(self, name):
+        return self._arrays[name]
+
+
+class AsyncCheckpoint(object):
+    """Handle for an in-flight save: result() joins and re-raises any
+    writer error; done() polls. thread=None marks an already-committed
+    save (the synchronous fallback)."""
+
+    def __init__(self, thread, box):
+        self._thread = thread
+        self._box = box
+
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+    def result(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("checkpoint writer still running")
+        if self._box.get("error") is not None:
+            raise self._box["error"]
+        return self._box.get("value")
+
+
+def save_checkpoint_async(scope, dirname: str, step: int = 0,
+                          extra: dict = None) -> AsyncCheckpoint:
+    """Snapshot the scope to host memory NOW (so later training steps —
+    including donated-buffer updates — cannot touch the saved values),
+    then run the normal atomic save on a background thread. Returns an
+    AsyncCheckpoint; call result() before relying on the checkpoint.
+
+    Process-spanning (multi-host) arrays need cross-process save
+    coordination, so they fall back to a synchronous save_checkpoint —
+    the handle is already done when returned.
+    """
+    import threading
+
+    # multi-host fallback decided BEFORE any device->host pulls
+    if any(
+        isinstance(scope.get(n), jax.Array)
+        and not scope.get(n).is_fully_addressable
+        for n in scope.keys()
+    ):
+        save_checkpoint(scope, dirname, step=step, extra=extra)
+        return AsyncCheckpoint(
+            None, {"value": _step_dir(dirname, step), "error": None}
+        )
+
+    arrays = {}
+    for name in sorted(scope.keys()):
+        val = scope.get(name)
+        if val is None:
+            continue
+        # device->host pull happens here, synchronously. np.array(copy)
+        # so in-place mutation of numpy scope values after the call can
+        # never reach the writer; single-process sharded (TP) values
+        # materialise whole — load_checkpoint reads whole-array and
+        # shard-file layouts interchangeably
+        arrays[name] = np.array(val, copy=True)
+
+    box = {"value": None, "error": None}
+
+    def _write():
+        try:
+            save_checkpoint(_HostScope(arrays), dirname, step=step,
+                            extra=extra)
+            box["value"] = _step_dir(dirname, step)
+        except BaseException as e:  # surfaced by result()
+            box["error"] = e
+
+    t = threading.Thread(target=_write, daemon=True)
+    t.start()
+    return AsyncCheckpoint(t, box)
+
+
+__all__ += ["save_checkpoint_async", "AsyncCheckpoint"]

@@ -532,12 +532,21 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             if save_dir and saving_period and \
                     job not in ("test", "checkgrad") and \
                     (pass_id + 1) % saving_period == 0:
-                from ..distributed import save_checkpoint
+                from ..distributed import save_checkpoint_async
 
-                save_checkpoint(
+                # async: the step loop pauses only for the host
+                # snapshot; CRC + disk + commit run in the background.
+                # One save in flight at a time.
+                prev = state_box.pop("ckpt_handle", None)
+                if prev is not None:
+                    prev.result()
+                state_box["ckpt_handle"] = save_checkpoint_async(
                     scope, os.path.join(save_dir, "pass-%05d" % pass_id),
                     step=stats["batches"],
                 )
+    pending = state_box.pop("ckpt_handle", None)
+    if pending is not None:
+        pending.result()  # commit the last pass checkpoint before exit
     if times:
         stats["ms_per_batch"] = 1000.0 * float(np.mean(times))
         stats["img_per_sec"] = batch_size / float(np.mean(times))

@@ -136,3 +136,11 @@ def train(dict_size):
 
 def test(dict_size):
     return _reader_creator("test", N_TEST, dict_size)
+
+
+def convert(path):
+    """Convert the dataset to record files (reference wmt14.convert),
+    through the native record writer."""
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")

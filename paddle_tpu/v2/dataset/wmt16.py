@@ -181,3 +181,22 @@ def get_dict(lang, dict_size, reverse=False):
     dict_size = min(dict_size, (
         TOTAL_EN_WORDS if lang == "en" else TOTAL_DE_WORDS))
     return _load_dict(dict_size, lang, reverse)
+
+
+def convert(path, src_dict_size, trg_dict_size, src_lang):
+    """Convert the dataset to record files (reference wmt16.convert),
+    through the native record writer."""
+    common.convert(
+        path,
+        train(src_dict_size=src_dict_size, trg_dict_size=trg_dict_size,
+              src_lang=src_lang),
+        1000,
+        "wmt16_train",
+    )
+    common.convert(
+        path,
+        test(src_dict_size=src_dict_size, trg_dict_size=trg_dict_size,
+              src_lang=src_lang),
+        1000,
+        "wmt16_test",
+    )

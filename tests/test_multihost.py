@@ -298,3 +298,59 @@ def test_shard_reader_divergence_guard(tmp_path, divergent):
         s0, s1 = (set(r["items"]) for r in results)
         assert not (s0 & s1), (s0, s1)
         assert s0 | s1 == set(range(32)), (s0, s1)
+
+
+def test_async_checkpoint_snapshot_semantics(tmp_path):
+    """save_checkpoint_async snapshots at CALL time: mutations after the
+    call never reach the checkpoint, the background write commits the
+    same bytes a sync save would, and result() surfaces the step dir."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    scope = fluid.executor.Scope()
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    scope.set("w", w.copy())
+    scope.set("opt_state", np.float32(3.0))
+
+    d = str(tmp_path / "ck")
+    handle = ckpt.save_checkpoint_async(scope, d, step=5)
+    # training continues: IN-PLACE mutation and rebinding immediately
+    scope.get("w")[:] = -1.0
+    scope.set("w", np.zeros_like(w))
+    path = handle.result(timeout=30)
+    assert handle.done() and path.endswith("step_0000000005")
+
+    scope2 = fluid.executor.Scope()
+    got = ckpt.load_checkpoint(scope2, d)
+    assert got["step"] == 5
+    np.testing.assert_array_equal(np.asarray(scope2.get("w")), w)
+    assert float(np.asarray(scope2.get("opt_state"))) == 3.0
+
+    # a second async save at a later step supersedes the first
+    scope.set("w", 2 * w)
+    ckpt.save_checkpoint_async(scope, d, step=6).result(timeout=30)
+    scope3 = fluid.executor.Scope()
+    got = ckpt.load_checkpoint(scope3, d)
+    assert got["step"] == 6
+    np.testing.assert_array_equal(np.asarray(scope3.get("w")), 2 * w)
+
+
+def test_async_checkpoint_sharded_single_process(tmp_path):
+    """Single-process sharded (TP) values snapshot whole-array; the
+    loader reads them back exactly."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    scope = fluid.executor.Scope()
+    w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    scope.set("w", jax.device_put(w, NamedSharding(mesh, P("data", None))))
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint_async(scope, d, step=1).result(timeout=30)
+    scope2 = fluid.executor.Scope()
+    ckpt.load_checkpoint(scope2, d)
+    np.testing.assert_array_equal(np.asarray(scope2.get("w")), w)
