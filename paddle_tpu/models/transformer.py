@@ -364,3 +364,103 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,
 
 
 __all__ += ["init_kv_cache", "decode_step", "prefill", "generate"]
+
+
+def beam_search_generate(params, prompt, cfg: TransformerConfig,
+                         max_new_tokens, beam_size=4, alpha=0.0,
+                         max_len=None):
+    """Beam-search generation over the KV cache (the transformer
+    counterpart of the legacy RecurrentGradientMachine beam decode,
+    RecurrentGradientMachine.h:309, kernels_control.py beam_search).
+
+    Beams live flattened on the batch dim ([B*W, ...]) so every decode
+    step is the SAME cached computation greedy uses; after top-k the
+    caches gather along the beam dim by parent index. Finished beams
+    (emitted eos) freeze: they re-emit eos with their frozen score.
+    Returns (tokens [B, W, T0+max_new], scores [B, W]) sorted best
+    first; alpha applies GNMT length normalisation at the final sort.
+    eos is cfg.vocab - 1 by convention of this toy-vocab family.
+    """
+    B, T0 = prompt.shape
+    W = int(beam_size)
+    if max_new_tokens < 1:
+        raise ValueError("beam_search_generate needs max_new_tokens >= 1")
+    L = min(int(max_len or cfg.max_len), int(params["pos"].shape[0]))
+    if T0 + max_new_tokens > L:
+        raise ValueError(
+            "beam_search_generate needs T0+max_new <= max_len "
+            "(%d + %d > %d)" % (T0, max_new_tokens, L)
+        )
+    eos = cfg.vocab - 1
+
+    logits, cache = prefill(params, prompt, cfg, max_len=L)  # [B, V]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # seed beams from the prompt's top-W first tokens
+    top_lp, top_tok = jax.lax.top_k(logp, W)  # [B, W]
+
+    def tile_beam(x):
+        return jnp.repeat(x, W, axis=0)  # [B*W, ...]
+
+    cache = jax.tree_util.tree_map(tile_beam, cache)
+    # fixed-size token buffer [B, W, T0+max_new]: scan carries must keep
+    # their shape, so steps write in place instead of concatenating
+    T_out = T0 + max_new_tokens
+    tokens = jnp.zeros((B, W, T_out), prompt.dtype)
+    tokens = tokens.at[:, :, :T0].set(tile_beam(prompt).reshape(B, W, T0))
+    tokens = tokens.at[:, :, T0].set(top_tok)
+    scores = top_lp  # [B, W] cumulative logprob
+    alive = top_tok != eos  # [B, W]
+    V = cfg.vocab
+
+    def body(carry, i):
+        tokens, scores, alive, cache = carry
+        pos = T0 + i  # position of the newest written token
+        last = jax.lax.dynamic_index_in_dim(
+            tokens, pos, axis=2, keepdims=False
+        ).reshape(B * W)
+        lg, cache = decode_step(params, last, pos, cache, cfg)
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), -1).reshape(B, W, V)
+        # frozen beams contribute exactly one continuation: eos at zero
+        # added cost (their score must not change or multiply)
+        frozen_row = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
+        lp = jnp.where(alive[..., None], lp, frozen_row[None, None])
+        cand = scores[..., None] + lp  # [B, W, V]
+        flat = cand.reshape(B, W * V)
+        new_scores, idx = jax.lax.top_k(flat, W)  # [B, W]
+        parent = idx // V  # [B, W] which beam it extends
+        tok = idx % V
+        # reorder histories + caches by parent beam, write the new token
+        tokens = jnp.take_along_axis(
+            tokens, parent[..., None], axis=1
+        )
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, tok, pos + 1, axis=2
+        )
+        alive = (
+            jnp.take_along_axis(alive, parent, axis=1) & (tok != eos)
+        )
+        gather = (
+            parent + jnp.arange(B)[:, None] * W
+        ).reshape(B * W)  # flat indices into [B*W]
+
+        def reorder(c):
+            return c[gather]
+
+        cache = jax.tree_util.tree_map(reorder, cache)
+        return (tokens, new_scores, alive, cache), None
+
+    (tokens, scores, alive, _), _ = jax.lax.scan(
+        body, (tokens, scores, alive, cache),
+        jnp.arange(max_new_tokens - 1),
+    )
+    # GNMT length penalty: ((5 + len) / 6)^alpha
+    lens = (tokens[:, :, T0:] != eos).sum(-1) + 1
+    penal = jnp.power((5.0 + lens.astype(jnp.float32)) / 6.0, alpha)
+    final = scores / penal  # penal > 0 always (lens >= 1)
+    order = jnp.argsort(-final, axis=1)
+    tokens = jnp.take_along_axis(tokens, order[..., None], axis=1)
+    final = jnp.take_along_axis(final, order, axis=1)
+    return tokens, final
+
+
+__all__ += ["beam_search_generate"]

@@ -486,67 +486,72 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
         )[0]
         return [float(v) for v in np.ravel(np.asarray(losses))]
 
-    with fluid.executor.scope_guard(scope):
-        for pass_id in range(num_passes):
-            state_box["pass_id"] = pass_id
-            buf = []
-            for feed in _batches(
-                provider_reader, slots, topo._data_layers, batch_size
-            ):
-                t0 = time.time()
-                if state_box["async_every"] and any(
-                    isinstance(v, tuple) for v in feed.values()
+    try:
+        with fluid.executor.scope_guard(scope):
+            for pass_id in range(num_passes):
+                state_box["pass_id"] = pass_id
+                buf = []
+                for feed in _batches(
+                    provider_reader, slots, topo._data_layers, batch_size
                 ):
-                    # ragged (LoD) batches change shape per step; the
-                    # documented fallback is the synchronous loop
-                    for f in buf:
-                        tf = time.time()
-                        _record(_run_sync(f), time.time() - tf)
-                    buf = []
-                    _async_fallback("LoD feeds cannot stack across steps")
                     t0 = time.time()
-                if state_box["async_every"]:
-                    costs = []
-                    if buf and any(
-                        np.shape(feed[k]) != np.shape(buf[0][k])
-                        for k in feed
+                    if state_box["async_every"] and any(
+                        isinstance(v, tuple) for v in feed.values()
                     ):
-                        # flush a buffer the new batch can't stack with
-                        costs += _run_async_buffer(buf)
+                        # ragged (LoD) batches change shape per step; the
+                        # documented fallback is the synchronous loop
+                        for f in buf:
+                            tf = time.time()
+                            _record(_run_sync(f), time.time() - tf)
                         buf = []
-                    buf.append(feed)
-                    if len(buf) == state_box["async_every"]:
-                        costs += _run_async_buffer(buf)
-                        buf = []
-                    if not costs:
-                        continue
-                else:
-                    costs = _run_sync(feed)
-                _record(costs, (time.time() - t0) / len(costs),
-                        skip_times=state_box.pop("async_cold", False))
-            if buf:
-                t0 = time.time()
-                costs = _run_async_buffer(buf)
-                _record(costs, (time.time() - t0) / len(costs),
-                        skip_times=state_box.pop("async_cold", False))
-            if save_dir and saving_period and \
-                    job not in ("test", "checkgrad") and \
-                    (pass_id + 1) % saving_period == 0:
-                from ..distributed import save_checkpoint_async
+                        _async_fallback("LoD feeds cannot stack across steps")
+                        t0 = time.time()
+                    if state_box["async_every"]:
+                        costs = []
+                        if buf and any(
+                            np.shape(feed[k]) != np.shape(buf[0][k])
+                            for k in feed
+                        ):
+                            # flush a buffer the new batch can't stack with
+                            costs += _run_async_buffer(buf)
+                            buf = []
+                        buf.append(feed)
+                        if len(buf) == state_box["async_every"]:
+                            costs += _run_async_buffer(buf)
+                            buf = []
+                        if not costs:
+                            continue
+                    else:
+                        costs = _run_sync(feed)
+                    _record(costs, (time.time() - t0) / len(costs),
+                            skip_times=state_box.pop("async_cold", False))
+                if buf:
+                    t0 = time.time()
+                    costs = _run_async_buffer(buf)
+                    _record(costs, (time.time() - t0) / len(costs),
+                            skip_times=state_box.pop("async_cold", False))
+                if save_dir and saving_period and \
+                        job not in ("test", "checkgrad") and \
+                        (pass_id + 1) % saving_period == 0:
+                    from ..distributed import save_checkpoint_async
 
-                # async: the step loop pauses only for the host
-                # snapshot; CRC + disk + commit run in the background.
-                # One save in flight at a time.
-                prev = state_box.pop("ckpt_handle", None)
-                if prev is not None:
-                    prev.result()
-                state_box["ckpt_handle"] = save_checkpoint_async(
-                    scope, os.path.join(save_dir, "pass-%05d" % pass_id),
-                    step=stats["batches"],
-                )
-    pending = state_box.pop("ckpt_handle", None)
-    if pending is not None:
-        pending.result()  # commit the last pass checkpoint before exit
+                    # async: the step loop pauses only for the host
+                    # snapshot; CRC + disk + commit run in the background.
+                    # One save in flight at a time.
+                    prev = state_box.pop("ckpt_handle", None)
+                    if prev is not None:
+                        prev.result()
+                    state_box["ckpt_handle"] = save_checkpoint_async(
+                        scope, os.path.join(save_dir, "pass-%05d" % pass_id),
+                        step=stats["batches"],
+                    )
+    finally:
+        # the in-flight async checkpoint must commit even when a pass
+        # raises (durability parity with the old synchronous save);
+        # result() also re-raises any writer error
+        pending = state_box.pop("ckpt_handle", None)
+        if pending is not None:
+            pending.result()
     if times:
         stats["ms_per_batch"] = 1000.0 * float(np.mean(times))
         stats["img_per_sec"] = batch_size / float(np.mean(times))

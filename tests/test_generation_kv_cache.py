@@ -88,3 +88,100 @@ def test_sampled_generate_shapes_and_budget():
         T.generate(params, prompt, cfg, max_new_tokens=cfg.max_len)
     with pytest.raises(ValueError, match="requires"):
         T.generate(params, prompt, cfg, max_new_tokens=2, temperature=1.0)
+
+
+def test_beam_size_one_matches_greedy():
+    cfg = _cfg()
+    params = T.init_params(cfg, jax.random.PRNGKey(7))
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 4), 0, cfg.vocab)
+    greedy = T.generate(params, prompt, cfg, max_new_tokens=6)
+    beams, scores = T.beam_search_generate(
+        params, prompt, cfg, max_new_tokens=6, beam_size=1
+    )
+    assert beams.shape == (2, 1, 10)
+    np.testing.assert_array_equal(np.asarray(beams[:, 0]), np.asarray(greedy))
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def _frozen_objective(params, cfg, seq, t0):
+    """The beam objective: sum of next-token logprobs of the generated
+    region, counted only up to (and including) the first eos (frozen
+    beams re-emit eos at zero added cost)."""
+    eos = cfg.vocab - 1
+    logits = T.forward(params, seq[:, :-1], cfg, mesh=None,
+                       attn_impl="reference")
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    tgt = seq[:, 1:]
+    per = np.asarray(
+        jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0][:, t0 - 1:]
+    )
+    gen = np.asarray(seq[:, t0:])
+    keep = np.ones_like(gen, bool)
+    for r in range(gen.shape[0]):
+        hits = np.nonzero(gen[r] == eos)[0]
+        if hits.size:
+            keep[r, hits[0] + 1:] = False
+    return (per * keep).sum(-1)
+
+
+def test_beam_scores_are_self_consistent_and_sorted():
+    """True invariants (beam > greedy is NOT one — the greedy prefix can
+    be pruned): the returned score of every beam equals the frozen
+    objective recomputed from its tokens, and beams come back sorted."""
+    cfg = _cfg()
+    params = T.init_params(cfg, jax.random.PRNGKey(9))
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (3, 4), 0, cfg.vocab)
+    beams, scores = T.beam_search_generate(
+        params, prompt, cfg, max_new_tokens=5, beam_size=4
+    )
+    scores = np.asarray(scores)
+    assert (np.diff(scores, axis=1) <= 1e-5).all()  # sorted best-first
+    for w in range(beams.shape[1]):
+        got = _frozen_objective(params, cfg, beams[:, w], t0=4)
+        np.testing.assert_allclose(scores[:, w], got, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_beam_depth_one_is_exact():
+    """With one step, beam search IS exact top-k: the W returned beams
+    are the W best single continuations with their exact logprobs."""
+    cfg = _cfg(vocab=12)
+    params = T.init_params(cfg, jax.random.PRNGKey(13))
+    prompt = jax.random.randint(jax.random.PRNGKey(14), (2, 4), 0,
+                                cfg.vocab)
+    W = 5
+    beams, scores = T.beam_search_generate(
+        params, prompt, cfg, max_new_tokens=1, beam_size=W
+    )
+    logits = T.forward(params, prompt, cfg, mesh=None,
+                       attn_impl="reference")[:, -1]
+    lp = np.asarray(jax.nn.log_softmax(logits.astype(jnp.float32), -1))
+    for b in range(2):
+        order = np.argsort(-lp[b])[:W]
+        np.testing.assert_array_equal(np.asarray(beams[b, :, -1]), order)
+        np.testing.assert_allclose(
+            np.asarray(scores[b]), lp[b][order], rtol=1e-5, atol=1e-5
+        )
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="max_new_tokens"):
+        T.beam_search_generate(params, prompt, cfg, max_new_tokens=0)
+
+
+def test_beam_search_eos_freezes():
+    # force eos as the argmax everywhere: frozen beams keep re-emitting
+    # eos and their score stays fixed
+    cfg = _cfg(vocab=8)
+    params = T.init_params(cfg, jax.random.PRNGKey(11))
+    # bias the output head towards eos by inflating its embedding row
+    eos = cfg.vocab - 1
+    params["embed"] = params["embed"].at[eos].mul(20.0)
+    prompt = jax.random.randint(jax.random.PRNGKey(12), (1, 3), 0, eos)
+    beams, scores = T.beam_search_generate(
+        params, prompt, cfg, max_new_tokens=6, beam_size=3
+    )
+    top = np.asarray(beams[0, 0, 3:])
+    if top[0] == eos:  # once finished, only eos follows
+        assert (top == eos).all()
+    assert np.isfinite(np.asarray(scores)).all()
