@@ -524,6 +524,7 @@ def main():
     # line + clean nonzero exit the driver can act on.
     import threading
 
+    _state = {"headline": None, "workloads": {}}
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "1200"))
     total_timeout = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "7200"))
     global _DEADLINE
@@ -561,6 +562,17 @@ def main():
             )
             os._exit(3)
         if not _bench_finished.wait(remaining):
+            # the headline runs FIRST: if a later side workload hung,
+            # mark the hang (not silent) and still emit the contract
+            # line before exiting
+            if _state.get("headline") is not None:
+                _state["workloads"]["bench_watchdog"] = {
+                    "error": "side workload hung past "
+                             "BENCH_TOTAL_TIMEOUT_S=%g; headline was "
+                             "already measured" % total_timeout,
+                }
+                _emit_headline()
+                os._exit(0)
             print(
                 json.dumps({
                     "metric": "bench_error",
@@ -574,6 +586,30 @@ def main():
 
     _bench_finished = threading.Event()
     threading.Thread(target=_watchdog, daemon=True).start()
+
+    def _emit_headline():
+        """The driver-contract line (LAST line printed). Called on the
+        normal path and by the watchdog if a side workload hangs after
+        the headline was already measured."""
+        headline = _state.get("headline")
+        if headline is None:
+            return False
+        print(
+            json.dumps(
+                {
+                    "metric": "resnet50_train_images_per_sec_per_chip",
+                    "value": headline["img_per_sec"],
+                    "unit": "images/sec",
+                    "vs_baseline": round(
+                        headline["img_per_sec"] / BASELINE_IMG_PER_SEC, 4
+                    ),
+                    "mfu": headline["mfu"],
+                    "workloads": _state["workloads"],
+                }
+            ),
+            flush=True,
+        )
+        return True
 
     import jax
 
@@ -597,8 +633,7 @@ def main():
     # driver must still get the headline line, so once the budget is
     # spent remaining side workloads are skipped (marked, not silent)
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "1800"))
-    t_start = time.time()
-    workloads = {}
+    workloads = _state["workloads"]
 
     def run(name, fn):
         """Side workloads only — the resnet50 headline runs outside run()
@@ -616,6 +651,22 @@ def main():
         rec = dict(workloads[name])
         rec["metric"] = name
         print(json.dumps(rec), flush=True)
+
+    # headline FIRST (chip training throughput; device-resident data,
+    # per-step cost by multi-step differencing — same semantics as
+    # BENCH_r01/r02): a slow-tunnel day must not starve the driver-
+    # contract number behind the side workloads. The line still prints
+    # LAST (or from the watchdog on a hang).
+    _state["headline"] = bench_image(
+        "resnet50",
+        lambda i, c: resnet_imagenet(i, class_dim=c, depth=50),
+        batch,
+        xla_cost=True,
+    )
+    workloads["resnet50"] = _state["headline"]
+    # the side budget starts AFTER the headline: it belongs to the side
+    # workloads alone
+    t_start = time.time()
 
     # reference GPU baselines in img/s: AlexNet 334 ms/batch bs=128,
     # GoogLeNet 1149 ms/batch bs=128 (benchmark/README.md:37,50); no GPU
@@ -650,34 +701,8 @@ def main():
         run("resnet50_input_pipeline",
             lambda: bench_resnet50_recordio(batch, chunk_steps, n_chunks))
 
-    # headline: chip training throughput (device-resident data, per-step
-    # cost by multi-step differencing — same semantics as BENCH_r01/r02)
-    from paddle_tpu.models.resnet import resnet_imagenet
-
-    headline = bench_image(
-        "resnet50",
-        lambda i, c: resnet_imagenet(i, class_dim=c, depth=50),
-        batch,
-        xla_cost=True,
-    )
-    workloads["resnet50"] = headline
-
     _bench_finished.set()
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": headline["img_per_sec"],
-                "unit": "images/sec",
-                "vs_baseline": round(
-                    headline["img_per_sec"] / BASELINE_IMG_PER_SEC, 4
-                ),
-                "mfu": headline["mfu"],
-                "workloads": workloads,
-            }
-        ),
-        flush=True,
-    )
+    _emit_headline()
 
 
 if __name__ == "__main__":
