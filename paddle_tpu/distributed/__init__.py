@@ -13,6 +13,8 @@ from .coordinator import (Coordinator, CoordinatorServer, MasterClient,
                           RemoteCoordinator, Task)
 from .checkpoint import (AsyncCheckpoint, load_checkpoint,
                          save_checkpoint, save_checkpoint_async)
+from .fault_injection import (FaultInjected, FaultInjector, corrupt_file,
+                              default_injector)
 
 __all__ = [
     "Coordinator",
@@ -23,5 +25,9 @@ __all__ = [
     "save_checkpoint",
     "save_checkpoint_async",
     "AsyncCheckpoint",
+    "FaultInjected",
+    "FaultInjector",
+    "default_injector",
+    "corrupt_file",
     "load_checkpoint",
 ]

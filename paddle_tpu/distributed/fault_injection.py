@@ -1,0 +1,124 @@
+"""Fault injection as a first-class fixture (SURVEY §5.3: the reference
+had no injection framework — its elasticity was only provable on a live
+cluster; here preemptions/crashes/stalls are injectable into any worker
+or the trainer CLI itself, so recovery paths are CI-testable).
+
+A fault spec is a comma-separated string, e.g.::
+
+    PADDLE_FAULT="kill@12"          SIGKILL self at step 12 (preemption)
+    PADDLE_FAULT="exc@7"            raise FaultInjected at step 7
+    PADDLE_FAULT="delay@3:0.5"      sleep 0.5s at step 3 (straggler)
+    PADDLE_FAULT="corrupt@5:/path"  flip bytes of a file at step 5
+
+The trainer CLI ticks its injector once per batch when PADDLE_FAULT is
+set; worker scripts call `default_injector().tick()` wherever their
+step boundary is.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import List, Optional
+
+__all__ = [
+    "FaultInjected", "FaultInjector", "default_injector", "corrupt_file",
+]
+
+ENV_VAR = "PADDLE_FAULT"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by exc@N faults."""
+
+
+def corrupt_file(path: str, offset: int = -4, flip: bytes = b"\x5a"):
+    """Flip byte(s) in `path` (checkpoint-corruption fixture: the CRC
+    check must reject the file afterwards)."""
+    with open(path, "r+b") as f:
+        f.seek(offset, os.SEEK_END if offset < 0 else os.SEEK_SET)
+        pos = f.tell()
+        raw = f.read(len(flip))
+        if len(raw) != len(flip):
+            raise ValueError(
+                "corrupt_file: offset %d leaves only %d byte(s) to flip "
+                "in %s" % (offset, len(raw), path)
+            )
+        f.seek(pos)
+        f.write(bytes(b ^ f2 for b, f2 in zip(raw, flip)))
+
+
+class _Fault(object):
+    def __init__(self, kind: str, step: int, arg: Optional[str]):
+        self.kind = kind
+        self.step = step
+        self.arg = arg
+
+    def fire(self):
+        if self.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif self.kind == "exc":
+            raise FaultInjected("injected fault at step %d" % self.step)
+        elif self.kind == "delay":
+            time.sleep(float(self.arg or "1.0"))
+        elif self.kind == "corrupt":
+            corrupt_file(self.arg)
+        else:
+            raise ValueError("unknown fault kind %r" % self.kind)
+
+
+_KINDS = ("kill", "exc", "delay", "corrupt")
+
+
+def _parse(spec: str) -> List[_Fault]:
+    faults = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, rest = part.partition("@")
+        kind = kind.strip()
+        step_s, _, arg = rest.partition(":")
+        # a bad spec must fail HERE, not N training steps later
+        if kind not in _KINDS:
+            raise ValueError(
+                "unknown fault kind %r (want one of %s)" % (kind, _KINDS)
+            )
+        if kind == "corrupt" and not arg:
+            raise ValueError("corrupt@N:<path> needs the file path")
+        faults.append(_Fault(kind, int(step_s), arg or None))
+    return faults
+
+
+class FaultInjector(object):
+    """Counts step boundaries via tick(); fires matching faults."""
+
+    def __init__(self, spec: Optional[str] = None):
+        self.faults = _parse(
+            spec if spec is not None else os.environ.get(ENV_VAR, "")
+        )
+        self.step = 0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.faults)
+
+    def tick(self):
+        """Advance one step; fire any fault scheduled for it."""
+        self.step += 1
+        for f in self.faults:
+            if f.step == self.step:
+                f.fire()
+        return self.step
+
+
+_default: Optional[FaultInjector] = None
+
+
+def default_injector() -> FaultInjector:
+    """Process-wide injector built from PADDLE_FAULT (parsed once)."""
+    global _default
+    if _default is None:
+        _default = FaultInjector()
+    return _default

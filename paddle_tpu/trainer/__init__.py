@@ -430,10 +430,20 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
     times: List[float] = []
     state_box = {"async_every": async_every, "pass_id": 0}
 
+    from ..distributed.fault_injection import FaultInjector
+
+    # fresh injector per run: fault steps count THIS run's batches, not
+    # a process-lifetime total
+    fault = FaultInjector()
+
     def _record(costs, dt_per, skip_times=False):
         for cost in costs:
             stats["batches"] += 1
             stats["cost"] = cost
+            if fault.active:
+                # PADDLE_FAULT fixture: injected preemption/crash/stall
+                # at this batch boundary (SURVEY 5.3)
+                fault.tick()
             if stats["batches"] == 1:
                 stats["first_cost"] = cost
             # the first batches include compilation; reference --job=time
@@ -573,6 +583,22 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
 
 
 def main(argv=None):
+    # honor a JAX_PLATFORMS request even when an ambient sitecustomize
+    # imported jax at interpreter boot with another platform latched
+    # (same re-application the driver hooks do)
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            if jax.config.jax_platforms != want:
+                jax.config.update("jax_platforms", want)
+        except Exception as e:
+            print(
+                "warning: could not apply JAX_PLATFORMS=%s (%s); "
+                "continuing on the ambient platform" % (want, e),
+                file=sys.stderr,
+            )
     p = argparse.ArgumentParser(prog="paddle_tpu.trainer")
     p.add_argument("command", nargs="?", default="train")
     p.add_argument("--config", required=True)
