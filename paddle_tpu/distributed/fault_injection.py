@@ -87,6 +87,8 @@ def _parse(spec: str) -> List[_Fault]:
             )
         if kind == "corrupt" and not arg:
             raise ValueError("corrupt@N:<path> needs the file path")
+        if kind == "delay":
+            arg = str(float(arg or "1.0"))  # fail fast on a bad duration
         faults.append(_Fault(kind, int(step_s), arg or None))
     return faults
 

@@ -78,6 +78,7 @@ __all__ = [
     "sequence_slice",
     "reverse",
     "im2sequence",
+    "flash_attention",
     "row_conv",
     "multiplex",
     "maxout",
@@ -1032,6 +1033,22 @@ def sequence_reshape(input, new_dim):
         inputs={"X": [input]},
         outputs={"Out": [out]},
         attrs={"new_dim": new_dim},
+    )
+    return out
+
+
+def flash_attention(q, k, v, causal=False, scale=None, name=None):
+    """Fused blockwise attention over [B, T, H, D] inputs (the pallas
+    flash kernel; beyond-reference perf surface). Gradients flow through
+    the kernel's custom vjp; on CPU it runs in interpret mode so the
+    graph is platform-portable."""
+    helper = LayerHelper("flash_attention", **locals())
+    out = helper.create_tmp_variable(dtype=q.dtype, shape=tuple(q.shape))
+    helper.append_op(
+        type="flash_attention",
+        inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": bool(causal), "scale": scale},
     )
     return out
 

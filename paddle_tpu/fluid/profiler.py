@@ -166,3 +166,24 @@ def record_event(name):
 
 def get_events():
     return list(_events)
+
+
+def device_memory_stats(device=None):
+    """Per-device memory counters (bytes_in_use, peak_bytes_in_use,
+    bytes_limit, ...) straight from the runtime — the observability the
+    reference exposed through its allocator stats
+    (memory/detail/buddy_allocator). Returns {} when the backend does
+    not report memory (e.g. the CPU test fixture)."""
+    import jax
+
+    d = device if device is not None else jax.local_devices()[0]
+    stats = getattr(d, "memory_stats", None)
+    if stats is None:
+        return {}
+    try:
+        return dict(stats() or {})
+    except Exception:
+        return {}
+
+
+__all__.append("device_memory_stats")

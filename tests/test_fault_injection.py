@@ -87,14 +87,23 @@ def test_cli_preemption_and_resume(tmp_path):
     assert proc.returncode == -signal.SIGKILL, (
         proc.returncode, proc.stdout[-500:], proc.stderr[-500:],
     )
-    # pass 0 committed before the kill at batch 40
+    # pass 0's async save must be COMMITTED (not just the dir created):
+    # only resume from a pass whose checkpoint actually loads — the
+    # SIGKILL may land while a later pass's writer is mid-commit
+    from paddle_tpu.distributed import checkpoint as ckpt
+
     passes = sorted(d for d in os.listdir(save) if d.startswith("pass-"))
     assert "pass-00000" in passes, passes
+    committed = [
+        p for p in passes
+        if ckpt.latest_step(os.path.join(save, p)) is not None
+    ]
+    assert committed, passes
 
     from paddle_tpu.trainer import run_config
 
     out = run_config(
         str(cfg), num_passes=1,
-        init_model_path=os.path.join(save, passes[-1]),
+        init_model_path=os.path.join(save, committed[-1]),
     )
     assert np.isfinite(out["cost"])

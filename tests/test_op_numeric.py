@@ -924,6 +924,11 @@ EXEMPT = {
     "print": "identity pass-through debug tap (jax.debug.callback side "
              "effect); forward/backward/first_n semantics covered in "
              "test_print_op.py",
+    "flash_attention": "pallas kernel with its own custom vjp; forward "
+                       "oracle + gradient checks in "
+                       "test_flash_attention.py and training through "
+                       "the fluid layer in "
+                       "test_fluid_flash_attention.py",
     "lstmp": "full-sequence projected LSTM; trained + shape-checked in "
              "test_fluid_surface_round3.py (lstm_unit grad-checked here)",
     "ctc_align": "integer decode (non-differentiable); oracle in "
