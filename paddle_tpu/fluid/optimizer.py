@@ -529,3 +529,135 @@ DecayedAdagrad = DecayedAdagradOptimizer
 RMSProp = RMSPropOptimizer
 Adadelta = AdadeltaOptimizer
 Ftrl = FtrlOptimizer
+
+
+class ModelAverage(object):
+    """Averaged parameters (reference v2 ModelAverage / legacy
+    ParameterAverager and the trainer's catchUp/apply/restore dance,
+    v2/trainer.py:130): evaluation and export use a running average of
+    the weights rather than the last SGD iterate.
+
+    TPU-first form: an exponential moving average maintained INSIDE the
+    fused train step (per-param `@MODEL_AVG` slot updated by graph ops —
+    no host work per step), with `apply()` a context manager that swaps
+    bias-corrected averages into the scope for eval/save and restores
+    the live weights after. The reference's sliding window maps to the
+    EMA decay beta = W/(W+1) where W is the effective window:
+    `average_window` > 1 is taken as W directly, <= 1 as a fraction of
+    `max_average_window` (clamped to [min_average_window,
+    max_average_window]).
+
+    Call `build(program)` AFTER optimizer.minimize, inside the same
+    program_guard. Inside `apply()` run a for_test clone (or any
+    inference program): running the TRAINING program there would train
+    onward from the averaged weights.
+    """
+
+    AVG_SUFFIX = "@MODEL_AVG"
+
+    def __init__(self, average_window=0.15, min_average_window=100,
+                 max_average_window=10000):
+        w = float(average_window)
+        if w <= 1.0:
+            w = w * float(max_average_window)
+        w = min(max(w, float(min_average_window)), float(max_average_window))
+        self.window = w
+        self.beta = w / (w + 1.0)
+        self._avg_names = {}  # param name -> avg var name
+        self._steps_name = None
+
+    def build(self, program=None):
+        program = program or default_main_program()
+        if program is not default_main_program():
+            # the var initializers land in the CURRENT guard's programs;
+            # a mismatched program would get ops whose vars live (and
+            # initialize) elsewhere
+            raise ValueError(
+                "ModelAverage.build must run inside program_guard of the "
+                "program it averages"
+            )
+        block = program.global_block()
+        steps = tensor_layers.create_global_var(
+            name=unique_name("model_average_steps"), shape=[1], value=0.0,
+            dtype="float32", persistable=True,
+        )
+        self._steps_name = steps.name
+        block.append_op(
+            type="increment", inputs={"X": [steps]},
+            outputs={"Out": [steps]}, attrs={"step": 1.0},
+        )
+        for p in block.all_parameters():
+            # ParamAttr(do_model_average=False) opts a parameter out
+            if not p.trainable or getattr(p, "do_model_average", True) is False:
+                continue
+            avg = tensor_layers.create_global_var(
+                name=p.name + self.AVG_SUFFIX, shape=list(p.shape),
+                value=0.0, dtype=p.dtype, persistable=True,
+            )
+            # avg slots of sharded params live on the param's spec
+            spec = program.shardings.get(p.name)
+            if spec is not None:
+                program.shardings[avg.name] = spec
+            self._avg_names[p.name] = avg.name
+
+            def tmp(suffix):
+                return block.create_var(
+                    name=unique_name(p.name + suffix), shape=list(p.shape),
+                    dtype=p.dtype,
+                )
+
+            t_old, t_new, t_sum = tmp("@avg_old"), tmp("@avg_new"), tmp("@avg_sum")
+            block.append_op(
+                type="scale", inputs={"X": [avg]}, outputs={"Out": [t_old]},
+                attrs={"scale": self.beta},
+            )
+            block.append_op(
+                type="scale", inputs={"X": [p]}, outputs={"Out": [t_new]},
+                attrs={"scale": 1.0 - self.beta},
+            )
+            block.append_op(
+                type="elementwise_add", inputs={"X": [t_old], "Y": [t_new]},
+                outputs={"Out": [t_sum]}, attrs={},
+            )
+            block.append_op(
+                type="assign", inputs={"X": [t_sum]},
+                outputs={"Out": [avg]}, attrs={},
+            )
+        return self
+
+    def apply(self, scope=None, need_restore=True):
+        """Context manager: swap bias-corrected averaged weights into
+        the scope (eval/save run on averages), restore live weights on
+        exit."""
+        import contextlib
+
+        import numpy as _np
+
+        from .executor import global_scope
+
+        @contextlib.contextmanager
+        def _ctx():
+            sc = scope or global_scope()
+            t = float(_np.ravel(_np.asarray(sc.get(self._steps_name)))[0])
+            if t < 1.0:
+                raise RuntimeError(
+                    "ModelAverage.apply before any training step: the "
+                    "averages are still zero"
+                )
+            corr = 1.0 - self.beta ** t
+            saved = {}
+            for pname, aname in self._avg_names.items():
+                saved[pname] = sc.get(pname)
+                avg = _np.asarray(sc.get(aname))
+                sc.set(pname, (avg / corr).astype(avg.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for pname, val in saved.items():
+                        sc.set(pname, val)
+
+        return _ctx()
+
+
+__all__.append("ModelAverage")

