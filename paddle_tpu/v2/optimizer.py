@@ -12,12 +12,21 @@ __all__ = ["Momentum", "Adam", "Adamax", "AdaGrad", "DecayedAdaGrad",
 
 
 class Optimizer(object):
+    # settings-objects shared across the update equations: v2 configs
+    # pass model_average=ModelAverage(...) / regularization through the
+    # optimizer ctor (reference v2/optimizer.py kwargs)
+    model_average = None
+
+    def _capture(self, kwargs):
+        self.model_average = kwargs.get("model_average")
+
     def _fluid(self):
         raise NotImplementedError
 
 
 class SGD(Optimizer):
     def __init__(self, learning_rate=1e-3, **kwargs):
+        self._capture(kwargs)
         self.learning_rate = learning_rate
 
     def _fluid(self):
@@ -26,6 +35,7 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     def __init__(self, momentum=0.9, learning_rate=1e-3, sparse=False, **kwargs):
+        self._capture(kwargs)
         self.momentum = momentum
         self.learning_rate = learning_rate
 
@@ -38,6 +48,7 @@ class Momentum(Optimizer):
 class Adam(Optimizer):
     def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  learning_rate=1e-3, **kwargs):
+        self._capture(kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.learning_rate = learning_rate
 
@@ -50,6 +61,7 @@ class Adam(Optimizer):
 
 class Adamax(Optimizer):
     def __init__(self, beta1=0.9, beta2=0.999, learning_rate=1e-3, **kwargs):
+        self._capture(kwargs)
         self.beta1, self.beta2 = beta1, beta2
         self.learning_rate = learning_rate
 
@@ -61,6 +73,7 @@ class Adamax(Optimizer):
 
 class AdaGrad(Optimizer):
     def __init__(self, learning_rate=1e-3, epsilon=1e-6, **kwargs):
+        self._capture(kwargs)
         self.learning_rate, self.epsilon = learning_rate, epsilon
 
     def _fluid(self):
@@ -71,6 +84,7 @@ class AdaGrad(Optimizer):
 
 class DecayedAdaGrad(Optimizer):
     def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+        self._capture(kwargs)
         self.rho, self.epsilon = rho, epsilon
         self.learning_rate = learning_rate
 
@@ -83,6 +97,7 @@ class DecayedAdaGrad(Optimizer):
 
 class AdaDelta(Optimizer):
     def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+        self._capture(kwargs)
         self.rho, self.epsilon = rho, epsilon
         self.learning_rate = learning_rate
 
@@ -95,6 +110,7 @@ class AdaDelta(Optimizer):
 
 class RMSProp(Optimizer):
     def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+        self._capture(kwargs)
         self.rho, self.epsilon = rho, epsilon
         self.learning_rate = learning_rate
 

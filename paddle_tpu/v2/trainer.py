@@ -109,8 +109,23 @@ class SGD(object):
         # backward+update ops: test() must never touch parameters
         self._test_program = topo.main_program.clone(for_test=True)
         self._optimizer = update_equation._fluid()
+        self._model_average = None
         with fluid.program_guard(topo.main_program, topo.startup_program):
             self._optimizer.minimize(self._cost_var)
+            ma_spec = getattr(update_equation, "model_average", None)
+            if ma_spec is not None:
+                # reference averaged parameters (trainer.py:130 catchUp/
+                # apply/restore): EMA slots inside the train step; test()
+                # and save_parameter_to_tar run on the averages
+                self._model_average = fluid.optimizer.ModelAverage(
+                    average_window=getattr(ma_spec, "average_window", 0.15),
+                    # honor small windows exactly: the v2 spec has no
+                    # min knob, so don't let fluid's default inflate it
+                    min_average_window=1,
+                    max_average_window=getattr(
+                        ma_spec, "max_average_window", None
+                    ) or 10000,
+                ).build(topo.main_program)
         topo._minimized = True
         # initialize ONLY vars not already in the parameters' scope (the
         # optimizer state); re-running the full startup program would
@@ -154,9 +169,28 @@ class SGD(object):
             event_handler(v2_event.EndPass(pass_id))
 
     # ------------------------------------------------------------------
+    def _avg_apply_ctx(self):
+        """Averaged-parameter context for eval/export: the EMA weights
+        when averaging is configured AND at least one step has trained;
+        the live weights otherwise (e.g. evaluating a freshly-loaded
+        model before train())."""
+        import contextlib
+
+        ma = self._model_average
+        if ma is None:
+            return contextlib.nullcontext()
+        scope = self.__parameters__.scope
+        steps = scope.get(ma._steps_name) if ma._steps_name in scope else None
+        if steps is None or float(np.ravel(np.asarray(steps))[0]) < 1.0:
+            return contextlib.nullcontext()
+        return ma.apply(scope=scope)
+
     def test(self, reader, feeding=None):
         data_nodes = self._topology._data_layers
         scope = self.__parameters__.scope
+        # averaged parameters evaluate the EMA weights (reference: the
+        # tester's apply/restore around averaged params)
+        avg_ctx = self._avg_apply_ctx()
         test_prog = self._test_program  # forward-only snapshot, stable id
         # the test program is a pre-minimize clone: metric vars live in it
         # under the same names
@@ -164,34 +198,35 @@ class SGD(object):
             test_prog.global_block().var(v.name)
             for _, v in self._metric_fetches
         ]
-        costs, n = [], 0
-        metric_sums = [0.0] * len(metric_vars)
-        for batch in reader():
-            feed = _convert_feed(batch, data_nodes, feeding)
-            with fluid.executor.scope_guard(scope):
-                fetched = self._exe.run(
-                    test_prog, feed=feed,
-                    fetch_list=[test_prog.global_block().var(
-                        self._cost_var.name)] + metric_vars,
-                )
-            costs.append(float(np.ravel(fetched[0])[0]) * len(batch))
-            for i, m in enumerate(fetched[1:]):
-                # sum evaluators accumulate a dataset TOTAL; ratio metrics
-                # (classification_error, auc) average example-weighted
-                v = np.asarray(_metric_value(m))
-                if self._metric_is_sum[i]:
-                    metric_sums[i] = metric_sums[i] + v
-                else:
-                    metric_sums[i] = metric_sums[i] + v * len(batch)
-            n += len(batch)
-        avg = sum(costs) / max(n, 1)
-        evaluator = {}
-        for i, (name, _) in enumerate(self._metric_fetches):
-            val = np.asarray(metric_sums[i])
-            if not self._metric_is_sum[i]:
-                val = val / max(n, 1)
-            evaluator[name] = float(val) if val.ndim == 0 else val
-        return v2_event.TestResult(evaluator=evaluator, cost=avg)
+        with avg_ctx:
+            costs, n = [], 0
+            metric_sums = [0.0] * len(metric_vars)
+            for batch in reader():
+                feed = _convert_feed(batch, data_nodes, feeding)
+                with fluid.executor.scope_guard(scope):
+                    fetched = self._exe.run(
+                        test_prog, feed=feed,
+                        fetch_list=[test_prog.global_block().var(
+                            self._cost_var.name)] + metric_vars,
+                    )
+                costs.append(float(np.ravel(fetched[0])[0]) * len(batch))
+                for i, m in enumerate(fetched[1:]):
+                    # sum evaluators accumulate a dataset TOTAL; ratio metrics
+                    # (classification_error, auc) average example-weighted
+                    v = np.asarray(_metric_value(m))
+                    if self._metric_is_sum[i]:
+                        metric_sums[i] = metric_sums[i] + v
+                    else:
+                        metric_sums[i] = metric_sums[i] + v * len(batch)
+                n += len(batch)
+            avg = sum(costs) / max(n, 1)
+            evaluator = {}
+            for i, (name, _) in enumerate(self._metric_fetches):
+                val = np.asarray(metric_sums[i])
+                if not self._metric_is_sum[i]:
+                    val = val / max(n, 1)
+                evaluator[name] = float(val) if val.ndim == 0 else val
+            return v2_event.TestResult(evaluator=evaluator, cost=avg)
 
     def _metric_payload(self, metrics):
         return {
@@ -200,7 +235,10 @@ class SGD(object):
         }
 
     def save_parameter_to_tar(self, f):
-        self.__parameters__.to_tar(f)
+        # export averaged weights when averaging is active (reference
+        # save with averaged params applied); live weights otherwise
+        with self._avg_apply_ctx():
+            self.__parameters__.to_tar(f)
 
 
 def infer(output_layer, parameters, input, feeding=None):

@@ -134,3 +134,61 @@ def test_opt_out_and_premature_apply():
     # build outside the right guard is rejected
     with pytest.raises(ValueError, match="program_guard"):
         fluid.optimizer.ModelAverage().build(main)
+
+
+def test_v2_trainer_model_average():
+    """v2 surface: optimizer(model_average=ModelAverage(...)) makes
+    test() and save_parameter_to_tar run on averaged weights."""
+    import io as _io
+
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.v2.optimizer import ModelAverage as V2MA
+
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1,
+                           act=paddle.activation.Linear())
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    opt = paddle.optimizer.Momentum(
+        momentum=0.9, learning_rate=5e-2,
+        model_average=V2MA(average_window=0.05, max_average_window=500),
+    )
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=opt)
+    assert trainer._model_average is not None
+    # requested window honored exactly (no silent min-clamp inflation)
+    assert trainer._model_average.window == 25.0
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 1).astype(np.float32)
+
+    def reader():
+        for _ in range(40):
+            xv = rng.randn(4).astype(np.float32)
+            yield xv, (xv @ W).astype(np.float32)
+
+    # eval/export BEFORE any training falls back to live weights
+    pre = trainer.test(paddle.batch(reader, 8))
+    assert np.isfinite(pre.cost)
+
+    trainer.train(paddle.batch(reader, 8), num_passes=3)
+
+    # test() runs on averages and restores live weights afterwards
+    w_name = trainer._topology.main_program.global_block().all_parameters()[0].name
+    live = np.asarray(params.scope.get(w_name)).copy()
+    res = trainer.test(paddle.batch(reader, 8))
+    np.testing.assert_array_equal(
+        np.asarray(params.scope.get(w_name)), live
+    )
+    assert np.isfinite(res.cost)
+
+    # the exported tar carries the averaged weights, not the live ones
+    buf = _io.BytesIO()
+    trainer.save_parameter_to_tar(buf)
+    buf.seek(0)
+    loaded = paddle.parameters.Parameters.from_tar(buf)
+    avg_name = w_name + fluid.optimizer.ModelAverage.AVG_SUFFIX
+    assert avg_name in params.scope.keys()  # the EMA slot trains along
+    exported = loaded.get(w_name)
+    assert not np.allclose(exported, live)  # averaged, not last iterate
