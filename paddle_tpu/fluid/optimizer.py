@@ -555,6 +555,17 @@ class ModelAverage(object):
 
     AVG_SUFFIX = "@MODEL_AVG"
 
+    @classmethod
+    def from_spec(cls, spec):
+        """Build from a settings-object spec (tch/v2 ModelAverage):
+        honor small windows exactly (the specs have no min knob)."""
+        return cls(
+            average_window=getattr(spec, "average_window", 0.15),
+            min_average_window=1,
+            max_average_window=getattr(spec, "max_average_window", None)
+            or 10000,
+        )
+
     def __init__(self, average_window=0.15, min_average_window=100,
                  max_average_window=10000):
         w = float(average_window)
@@ -623,6 +634,20 @@ class ModelAverage(object):
                 type="assign", inputs={"X": [t_sum]},
                 outputs={"Out": [avg]}, attrs={},
             )
+        return self
+
+    def attach(self, scope):
+        """Adopt the @MODEL_AVG slots of a LOADED scope (a checkpoint
+        trained with averaging) so apply() works without rebuilding the
+        training graph. Returns self; slots may be empty if the
+        checkpoint carried none."""
+        self._avg_names = {
+            k[: -len(self.AVG_SUFFIX)]: k
+            for k in scope.keys()
+            if k.endswith(self.AVG_SUFFIX)
+        }
+        steps = [k for k in scope.keys() if "model_average_steps" in k]
+        self._steps_name = steps[0] if steps else None
         return self
 
     def apply(self, scope=None, need_restore=True):

@@ -324,8 +324,16 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             if method is not None
             else fluid.optimizer.SGD(learning_rate=lr)
         )
+        ma_spec = (settings.get("extra") or {}).get("model_average")
         if job not in ("test", "checkgrad") and not gen_mode:
             opt.minimize(cost_var)
+            if ma_spec is not None:
+                # settings(model_average=ModelAverage(...)): EMA slots
+                # train inside the step and persist into every
+                # checkpoint (live weights stay the resume state)
+                fluid.optimizer.ModelAverage.from_spec(ma_spec).build(
+                    topo.main_program
+                )
 
     scope = fluid.executor.Scope()
     exe = fluid.Executor(fluid.CPUPlace(), mesh=mesh)
@@ -496,8 +504,18 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
         )[0]
         return [float(v) for v in np.ravel(np.asarray(losses))]
 
+    import contextlib
+
+    eval_avg_ctx = contextlib.nullcontext()
+    if job == "test" and ma_spec is not None:
+        # evaluate on the averaged weights a checkpoint carries (same
+        # apply/restore the v2 tester does)
+        _ma = fluid.optimizer.ModelAverage.from_spec(ma_spec).attach(scope)
+        if _ma._avg_names and _ma._steps_name:
+            eval_avg_ctx = _ma.apply(scope=scope)
+
     try:
-        with fluid.executor.scope_guard(scope):
+        with eval_avg_ctx, fluid.executor.scope_guard(scope):
             for pass_id in range(num_passes):
                 state_box["pass_id"] = pass_id
                 buf = []

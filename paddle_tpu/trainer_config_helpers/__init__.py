@@ -1721,9 +1721,11 @@ class L2Regularization(BaseRegularization):  # noqa: F811
 
 class ModelAverage(object):
     """Parameter averaging window (reference optimizers.py ModelAverage
-    / trainer sgd average_window). Recorded-only in this core (same
-    stance as HookAttr): evaluation runs on the live weights — averaged
-    evaluation weights are not maintained."""
+    / trainer sgd average_window). IMPLEMENTED: both the v2 trainer and
+    the CLI build in-graph EMA slots from this spec
+    (fluid.optimizer.ModelAverage.from_spec); v2 test()/
+    save_parameter_to_tar and --job=test evaluate/export the averaged
+    weights."""
 
     def __init__(self, average_window, max_average_window=None, **kwargs):
         self.average_window = float(average_window)

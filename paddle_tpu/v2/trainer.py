@@ -117,14 +117,8 @@ class SGD(object):
                 # reference averaged parameters (trainer.py:130 catchUp/
                 # apply/restore): EMA slots inside the train step; test()
                 # and save_parameter_to_tar run on the averages
-                self._model_average = fluid.optimizer.ModelAverage(
-                    average_window=getattr(ma_spec, "average_window", 0.15),
-                    # honor small windows exactly: the v2 spec has no
-                    # min knob, so don't let fluid's default inflate it
-                    min_average_window=1,
-                    max_average_window=getattr(
-                        ma_spec, "max_average_window", None
-                    ) or 10000,
+                self._model_average = fluid.optimizer.ModelAverage.from_spec(
+                    ma_spec
                 ).build(topo.main_program)
         topo._minimized = True
         # initialize ONLY vars not already in the parameters' scope (the

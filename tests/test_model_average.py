@@ -2,6 +2,8 @@
 apply/restore swap (reference v2 averaged parameters / legacy
 ParameterAverager)."""
 
+import os
+
 import numpy as np
 
 import paddle_tpu.fluid as fluid
@@ -192,3 +194,42 @@ def test_v2_trainer_model_average():
     assert avg_name in params.scope.keys()  # the EMA slot trains along
     exported = loaded.get(w_name)
     assert not np.allclose(exported, live)  # averaged, not last iterate
+
+
+def test_cli_settings_model_average_slots_in_checkpoint(tmp_path):
+    """settings(model_average=...) through the CLI: EMA slots train
+    along and land in the per-pass checkpoint."""
+    import textwrap
+
+    from paddle_tpu.trainer import run_config
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(textwrap.dedent("""
+        settings(batch_size=8, learning_rate=0.1,
+                 learning_method=MomentumOptimizer(),
+                 model_average=ModelAverage(average_window=0.05,
+                                            max_average_window=200))
+        x = data_layer(name='x', size=4)
+        y = data_layer(name='y', size=2)
+        p = fc_layer(input=x, size=2, act=SoftmaxActivation())
+        outputs(classification_cost(input=p, label=y))
+    """))
+    save = str(tmp_path / "ck")
+    out = run_config(str(cfg), num_passes=1, save_dir=save)
+    assert np.isfinite(out["cost"])
+
+    scope = fluid.Scope()
+    got = ckpt.load_checkpoint(scope, os.path.join(save, "pass-00000"))
+    avg_keys = [k for k in scope.keys() if k.endswith("@MODEL_AVG")]
+    assert avg_keys, sorted(scope.keys())
+    steps = [k for k in scope.keys() if "model_average_steps" in k]
+    assert steps and float(np.ravel(np.asarray(scope.get(steps[0])))[0]) > 0
+
+    # --job=test on that checkpoint evaluates the AVERAGED weights
+    out_t = run_config(
+        str(cfg), job="test", num_passes=1,
+        init_model_path=os.path.join(save, "pass-00000"),
+    )
+    assert np.isfinite(out_t["cost"])
+
