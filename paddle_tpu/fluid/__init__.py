@@ -65,7 +65,7 @@ from .optimizer import (
     AdadeltaOptimizer,
     FtrlOptimizer,
 )
-from .backward import append_backward
+from .backward import append_backward, calc_gradient
 from .regularizer import L1Decay, L2Decay, L1DecayRegularizer, L2DecayRegularizer
 from .clip import (
     ErrorClipByValue,
@@ -82,6 +82,7 @@ __all__ = framework.__dict__.keys() if False else [
     "optimizer",
     "learning_rate_decay",
     "backward",
+    "calc_gradient",
     "regularizer",
     "profiler",
     "clip",

@@ -1,0 +1,124 @@
+"""fluid.calc_gradient (reference backward.py:464): gradients of
+arbitrary targets w.r.t. leaf variables through the same fused vjp the
+training path uses."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_gradient_wrt_feed_matches_analytic():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        w = fluid.layers.create_parameter(shape=[3, 2], dtype="float32")
+        y = fluid.layers.mul(x=x, y=w)
+        (gx,) = fluid.calc_gradient(y, x)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+        wv = np.asarray(scope.get(w.name))
+    np.testing.assert_allclose(g, np.tile(wv.sum(1), (4, 1)), rtol=1e-5)
+
+
+def test_target_gradients_weighting():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        tg = fluid.layers.data(name="tg", shape=[3], dtype="float32")
+        y = fluid.layers.scale(x=x, scale=2.0)
+        (gx,) = fluid.calc_gradient(y, x, target_gradients=tg)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        xv = rng.randn(2, 3).astype(np.float32)
+        tgv = rng.randn(2, 3).astype(np.float32)
+        (g,) = exe.run(main, feed={"x": xv, "tg": tgv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2.0 * tgv, rtol=1e-5)
+
+
+def test_gradient_wrt_parameter():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        w = fluid.layers.create_parameter(shape=[3, 1], dtype="float32")
+        y = fluid.layers.mul(x=x, y=w)
+        (gw,) = fluid.calc_gradient(y, w)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(2).randn(5, 3).astype(np.float32)
+        (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gw])
+    np.testing.assert_allclose(
+        g, xv.sum(0, keepdims=True).T, rtol=1e-5
+    )
+
+
+def test_second_marker_rejected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.scale(x=x, scale=3.0)
+        fluid.calc_gradient(y, x)
+        with pytest.raises(ValueError, match="autodiff marker"):
+            fluid.calc_gradient(y, x)
+
+
+def test_no_grad_set_skips():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        z = fluid.layers.data(name="z", shape=[2], dtype="float32")
+        y = fluid.layers.elementwise_add(x=x, y=z)
+        gx, gz = fluid.calc_gradient(y, [x, z], no_grad_set={z.name})
+    assert gz is None and gx is not None
+
+
+def test_outside_guard_builds_into_targets_program():
+    # the objective ops must land in the TARGETS' program even when no
+    # program_guard is active at call time
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.scale(x=x, scale=3.0)
+    (gx,) = fluid.calc_gradient(y, x)  # outside any guard
+    types = [op.type for op in main.global_block().ops]
+    assert "reduce_sum" in types and "autodiff" in types
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (g,) = exe.run(
+            main, feed={"x": np.ones((2, 2), np.float32)},
+            fetch_list=[gx],
+        )
+    np.testing.assert_allclose(g, np.full((2, 2), 3.0), rtol=1e-6)
+
+
+def test_minimize_after_calc_gradient_rejected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        w = fluid.layers.create_parameter(shape=[2, 1], dtype="float32")
+        y = fluid.layers.mul(x=x, y=w)
+        loss = fluid.layers.mean(x=y)
+        fluid.calc_gradient(y, x)
+        with pytest.raises(ValueError, match="autodiff marker"):
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+
+def test_intermediate_no_grad_set_rejected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        h = fluid.layers.scale(x=x, scale=2.0)
+        y = fluid.layers.scale(x=h, scale=3.0)
+        with pytest.raises(NotImplementedError, match="no_grad_set"):
+            fluid.calc_gradient(y, x, no_grad_set={h.name})
