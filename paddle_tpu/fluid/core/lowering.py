@@ -102,6 +102,7 @@ def run_op(ctx: LoweringContext, op, env: Dict[str, Any]):
     ctx.op = op
     ctx.env = env
     outs = kernel(ctx, ins, op.attrs)
+    find_var = getattr(ctx.block, "_find_var_recursive", None)
     for slot, names in op.outputs.items():
         if slot not in outs:
             continue
@@ -109,6 +110,21 @@ def run_op(ctx: LoweringContext, op, env: Dict[str, Any]):
         if not isinstance(vals, (list, tuple)):
             vals = [vals]
         for name, val in zip(names, vals):
+            # honor Variable.stop_gradient (reference backward prunes
+            # grad flow at such vars): cut the vjp here so e.g. frozen
+            # feature extractors really receive no gradient. Recursive
+            # lookup: a sub-block op may write an ancestor block's var.
+            var = (
+                find_var(name)
+                if find_var is not None
+                else getattr(ctx.block, "vars", {}).get(name)
+            )
+            if (
+                var is not None
+                and getattr(var, "stop_gradient", False)
+                and isinstance(val, jax.core.Tracer)
+            ):
+                val = jax.lax.stop_gradient(val)
             env[name] = val
     _share_lod(op, env)
 

@@ -1,0 +1,48 @@
+"""Variable.stop_gradient honors gradient freezing (reference backward
+prunes grad ops at stop_gradient vars): layers behind a stopped
+activation receive zero gradient and do not train."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _losses_and_first_layer(freeze):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="tanh")
+        if freeze:
+            h.stop_gradient = True
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(x=fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    w1 = main.global_block().all_parameters()[0].name
+    rng = np.random.RandomState(0)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        before = np.asarray(scope.get(w1)).copy()
+        losses = []
+        for _ in range(5):
+            feed = {
+                "x": rng.randn(8, 4).astype(np.float32),
+                "y": rng.randn(8, 1).astype(np.float32),
+            }
+            out = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.ravel(out[0])[0]))
+        after = np.asarray(scope.get(w1))
+    return losses, before, after
+
+
+def test_frozen_branch_does_not_train():
+    losses, before, after = _losses_and_first_layer(freeze=True)
+    assert np.isfinite(losses).all()
+    np.testing.assert_array_equal(before, after)  # zero grad upstream
+
+
+def test_unfrozen_branch_trains():
+    losses, before, after = _losses_and_first_layer(freeze=False)
+    assert not np.allclose(before, after)
