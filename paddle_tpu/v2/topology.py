@@ -13,6 +13,16 @@ from .layer import Layer, parse_network
 __all__ = ["Topology"]
 
 
+def _user_attr(pa, default_name):
+    """fluid ParamAttr from a legacy user attribute: a user name override
+    (the legacy weight-sharing mechanism) plus is_static freezing
+    (reference ParameterConfig.is_static — the parameter never updates)."""
+    return fluid.ParamAttr(
+        name=getattr(pa, "name", None) or default_name,
+        trainable=not getattr(pa, "is_static", False),
+    )
+
+
 class Topology(object):
     def __init__(self, layers, extra_layers=None):
         if not isinstance(layers, (list, tuple)):
@@ -70,27 +80,31 @@ class Topology(object):
             # overrides them, which is how legacy configs SHARE weights
             # (e.g. sample_trainer_config.conf's 'sharew')
             user = a.get("param_attr")
-            user_names = None
-            if user is not None:
-                user_names = [
-                    getattr(p, "name", None)
-                    for p in (user if isinstance(user, (list, tuple)) else [user])
-                ]
+            user_list = (
+                list(user) if isinstance(user, (list, tuple))
+                else ([user] if user is not None else [])
+            )
             attrs = []
             for i in range(len(node.parents)):
-                name = None
-                if user_names and i < len(user_names):
-                    name = user_names[i]
+                if i < len(user_list):
+                    ua = user_list[i]
+                elif len(user_list) == 1:
+                    ua = user_list[0]  # single attr broadcasts (reference)
+                else:
+                    ua = None
                 attrs.append(
-                    fluid.ParamAttr(name=name or "%s.w%d" % (node.name, i))
+                    fluid.ParamAttr(
+                        name=(getattr(ua, "name", None) if i < len(user_list)
+                              else None) or "%s.w%d" % (node.name, i),
+                        # legacy is_static: the parameter never updates
+                        trainable=not getattr(ua, "is_static", False),
+                    )
                 )
             bias = a.get("bias_attr")
             if bias is False:
                 bias_attr = False
             else:
-                bias_attr = fluid.ParamAttr(
-                    name=getattr(bias, "name", None) or node.name + ".wbias"
-                )
+                bias_attr = _user_attr(bias, node.name + ".wbias")
             return L.fc(input=self._ins(node), size=a["size"], act=a["act"],
                         param_attr=attrs, bias_attr=bias_attr)
         if node.kind == "embedding":
@@ -98,9 +112,7 @@ class Topology(object):
             pa = a.get("param_attr")
             return L.embedding(input=self._in(node),
                                size=[t.dim, a["size"]],
-                               param_attr=fluid.ParamAttr(
-                                   name=getattr(pa, "name", None)
-                                   or node.name + ".w0"))
+                               param_attr=_user_attr(pa, node.name + ".w0"))
         if node.kind == "concat":
             return L.concat(input=self._ins(node), axis=1)
         if node.kind == "img_conv":
@@ -744,9 +756,7 @@ def _emit_ctc_cost(t, node):
 def _emit_crf_cost(t, node):
     pred, label = t._ins(node)
     pa = node.attrs.get("param_attr")
-    attr = fluid.ParamAttr(
-        name=getattr(pa, "name", None) or node.name + ".w0"
-    )
+    attr = _user_attr(pa, node.name + ".w0")
     cost = _L().linear_chain_crf(input=pred, label=label, param_attr=attr)
     return _L().mean(x=cost)
 
@@ -1047,12 +1057,8 @@ def _emit_gru_step(t, node):
     ba = node.attrs.get("bias_attr")
     hidden, _, _ = _L().gru_unit(
         input=x, hidden=h_prev, size=3 * int(size),
-        param_attr=fluid.ParamAttr(
-            name=getattr(pa, "name", None) or node.name + ".w0"
-        ),
-        bias_attr=fluid.ParamAttr(
-            name=getattr(ba, "name", None) or node.name + ".wbias"
-        ),
+        param_attr=_user_attr(pa, node.name + ".w0"),
+        bias_attr=_user_attr(ba, node.name + ".wbias"),
     )
     return hidden
 
@@ -1158,9 +1164,7 @@ def _emit_prelu(t, node):
     pa = node.attrs.get("param_attr")
     return _L().prelu(
         t._in(node), mode=node.attrs["mode"],
-        param_attr=fluid.ParamAttr(
-            name=getattr(pa, "name", None) or node.name + ".w0"
-        ),
+        param_attr=_user_attr(pa, node.name + ".w0"),
     )
 
 
@@ -1274,9 +1278,7 @@ def _emit_img_conv3d(t, node):
         filter_size=a["filter_size"], stride=a["stride"],
         padding=a["padding"], groups=a.get("groups", 1) or 1,
         act=a["act"],
-        param_attr=fluid.ParamAttr(
-            name=getattr(pa, "name", None) or node.name + ".w0"
-        ),
+        param_attr=_user_attr(pa, node.name + ".w0"),
         bias_attr=(
             False if not a.get("bias", True)
             else fluid.ParamAttr(name=node.name + ".wbias")

@@ -46,3 +46,45 @@ def test_frozen_branch_does_not_train():
 def test_unfrozen_branch_trains():
     losses, before, after = _losses_and_first_layer(freeze=False)
     assert not np.allclose(before, after)
+
+
+def test_legacy_is_static_freezes_parameter():
+    """Legacy ParamAttr(is_static=True) (reference ParameterConfig
+    is_static): the parameter is excluded from updates entirely."""
+    import paddle_tpu.v2 as paddle
+
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.integer_value_sequence(20)
+    )
+    emb = paddle.layer.embedding(
+        input=x, size=8,
+        param_attr=paddle.attr.Param(name="frozen_emb", is_static=True),
+    )
+    pool = paddle.layer.pooling(
+        input=emb, pooling_type=paddle.pooling.Sum()
+    )
+    pred = paddle.layer.fc(input=pool, size=3,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(
+        input=pred,
+        label=paddle.layer.data(
+            name="y", type=paddle.data_type.integer_value(3)
+        ),
+    )
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1),
+    )
+    before = np.asarray(params.scope.get("frozen_emb")).copy()
+
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(24):
+            seq = rng.randint(0, 20, 3).tolist()
+            yield seq, int(rng.randint(0, 3))
+
+    trainer.train(paddle.batch(reader, 8), num_passes=2)
+    after = np.asarray(params.scope.get("frozen_emb"))
+    np.testing.assert_array_equal(before, after)
