@@ -184,6 +184,7 @@ class Parameter(Variable):
         self.regularizer = kwargs.get("regularizer", None)
         self.gradient_clip_attr = kwargs.get("gradient_clip_attr", None)
         self.do_model_average = kwargs.get("do_model_average", None)
+        self.update_hook = kwargs.get("update_hook", None)
 
 
 class Operator(object):

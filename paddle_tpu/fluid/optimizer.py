@@ -686,3 +686,172 @@ class ModelAverage(object):
 
 
 __all__.append("ModelAverage")
+
+
+class StaticPruning(object):
+    """Static magnitude pruning hook (reference
+    parameter/ParameterUpdaterHook.cpp:39 StaticPruningHook /
+    HookAttr(type='pruning', sparsity_ratio=...)): a fixed mask keeps the
+    largest-|w| (1 - sparsity) fraction of each hooked parameter; every
+    update re-applies the mask so pruned weights stay exactly zero.
+
+    TPU-first form: the mask is computed IN the startup program (abs ->
+    top_k threshold -> compare), stored as a persistable `@PRUNE_MASK`
+    slot, applied once at init and then by graph ops appended after the
+    optimizer update — all inside the fused step, no host work.
+
+    Call build(program, startup_program) AFTER minimize and BEFORE
+    running the startup program, inside the same program_guard. Parameters are discovered from their
+    ParamAttr(update_hook=...) spec (any object with type='pruning' and
+    sparsity_ratio), or passed explicitly via `targets`.
+    """
+
+    MASK_SUFFIX = "@PRUNE_MASK"
+
+    def __init__(self, sparsity_ratio=None):
+        self.sparsity_ratio = sparsity_ratio
+        self.masks = {}
+        self._built_ratio = {}
+
+    DEFAULT_RATIO = 0.6  # reference ParameterUpdaterHookConfig default
+
+    @staticmethod
+    def _hook_ratio(p):
+        hook = getattr(p, "update_hook", None)
+        if hook is None:
+            return None
+        hooks = hook if isinstance(hook, (list, tuple)) else [hook]
+        for h in hooks:
+            if getattr(h, "type", None) == "pruning":
+                r = getattr(h, "sparsity_ratio", None)
+                return (
+                    float(r) if r is not None
+                    else StaticPruning.DEFAULT_RATIO
+                )
+        return None
+
+    def build(self, program=None, startup_program=None, targets=None):
+        import numpy as _np
+
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        block = program.global_block()
+        sblock = startup.global_block()
+
+        if targets is not None:
+            if self.sparsity_ratio is None:
+                raise ValueError(
+                    "build(targets=...) needs StaticPruning("
+                    "sparsity_ratio=...)"
+                )
+            plan = [(p, float(self.sparsity_ratio)) for p in targets]
+        else:
+            plan = [
+                (p, r)
+                for p in block.all_parameters()
+                for r in [self._hook_ratio(p)]
+                if r is not None
+            ]
+        for p, ratio in plan:
+            if not (0.0 < ratio < 1.0):
+                raise ValueError(
+                    "sparsity_ratio must be in (0, 1), got %r for %s"
+                    % (ratio, p.name)
+                )
+            numel = int(_np.prod(p.shape))
+            keep = max(1, int(round(numel * (1.0 - ratio))))
+            self._built_ratio[p.name] = ratio
+            mask = block.create_var(
+                name=p.name + self.MASK_SUFFIX, shape=list(p.shape),
+                dtype=p.dtype, persistable=True,
+            )
+            # mirror into startup so its ops may write it there
+            smask = sblock.create_var(
+                name=mask.name, shape=list(p.shape), dtype=p.dtype,
+                persistable=True,
+            )
+            def stmp(suffix, shape, dtype=p.dtype):
+                return sblock.create_var(
+                    name=unique_name(p.name + suffix), shape=list(shape),
+                    dtype=dtype,
+                )
+
+            # |w| -> flat [1, numel] -> top_k(keep) -> threshold
+            a = stmp("@abs", p.shape)
+            sblock.append_op(type="abs", inputs={"X": [p.name]},
+                             outputs={"Out": [a]}, attrs={})
+            flat = stmp("@flat", [1, numel])
+            sblock.append_op(type="reshape", inputs={"X": [a]},
+                             outputs={"Out": [flat]},
+                             attrs={"shape": [1, numel]})
+            vals = stmp("@topk", [1, keep])
+            idx = stmp("@topki", [1, keep], dtype="int32")
+            sblock.append_op(type="top_k", inputs={"X": [flat]},
+                             outputs={"Out": [vals], "Indices": [idx]},
+                             attrs={"k": keep})
+            # mask by INDEX (exactly `keep` survivors even under ties —
+            # a threshold compare would keep every tied value)
+            zeros = stmp("@zeros", [numel])
+            sblock.append_op(type="fill_constant", inputs={},
+                             outputs={"Out": [zeros]},
+                             attrs={"shape": [numel], "value": 0.0,
+                                    "dtype": p.dtype})
+            ones = stmp("@ones", [keep])
+            sblock.append_op(type="fill_constant", inputs={},
+                             outputs={"Out": [ones]},
+                             attrs={"shape": [keep], "value": 1.0,
+                                    "dtype": p.dtype})
+            maskf = stmp("@maskf", [numel])
+            sblock.append_op(type="scatter",
+                             inputs={"X": [zeros], "Ids": [idx],
+                                     "Updates": [ones]},
+                             outputs={"Out": [maskf]}, attrs={})
+            sblock.append_op(type="reshape", inputs={"X": [maskf]},
+                             outputs={"Out": [smask]},
+                             attrs={"shape": list(p.shape)})
+            # sparsify the initial weights too
+            pruned0 = stmp("@p0", p.shape)
+            sblock.append_op(type="elementwise_mul",
+                             inputs={"X": [p.name], "Y": [smask]},
+                             outputs={"Out": [pruned0]}, attrs={})
+            sblock.append_op(type="assign", inputs={"X": [pruned0]},
+                             outputs={"Out": [p.name]}, attrs={})
+
+            # main program: re-apply after every optimizer update
+            t = block.create_var(
+                name=unique_name(p.name + "@pruned"), shape=list(p.shape),
+                dtype=p.dtype,
+            )
+            block.append_op(type="elementwise_mul",
+                            inputs={"X": [p.name], "Y": [mask]},
+                            outputs={"Out": [t]}, attrs={})
+            block.append_op(type="assign", inputs={"X": [t]},
+                            outputs={"Out": [p.name]}, attrs={})
+            self.masks[p.name] = mask.name
+        return self
+
+    def recompute(self, scope):
+        """Rebuild masks from the CURRENT scope values (host-side) and
+        sparsify — for weights loaded from a checkpoint AFTER startup
+        ran (the in-startup mask would reflect the discarded random
+        init)."""
+        import numpy as _np
+
+        for pname, mname in self.masks.items():
+            if pname not in scope:
+                continue
+            w = _np.asarray(scope.get(pname))
+            flat = _np.abs(w).ravel()
+            keep = max(1, int(round(
+                flat.size * (1.0 - self._built_ratio[pname])
+            )))
+            idx = _np.argpartition(-flat, keep - 1)[:keep]
+            mask = _np.zeros_like(flat)
+            mask[idx] = 1.0
+            mask = mask.reshape(w.shape)
+            scope.set(mname, mask.astype(w.dtype))
+            scope.set(pname, (w * mask).astype(w.dtype))
+        return self
+
+
+__all__.append("StaticPruning")

@@ -17,6 +17,7 @@ class ParamAttr(object):
         trainable=True,
         gradient_clip=None,
         do_model_average=None,
+        update_hook=None,
     ):
         self.name = name
         self.initializer = initializer
@@ -25,6 +26,7 @@ class ParamAttr(object):
         self.trainable = trainable
         self.gradient_clip = gradient_clip
         self.do_model_average = do_model_average
+        self.update_hook = update_hook
 
     def set_default_initializer(self, initializer):
         if self.initializer is None:
@@ -60,6 +62,7 @@ class ParamAttr(object):
             "trainable": self.trainable,
             "gradient_clip_attr": self.gradient_clip,
             "do_model_average": self.do_model_average,
+            "update_hook": self.update_hook,
         }
         if with_initializer:
             kwargs["initializer"] = self.initializer

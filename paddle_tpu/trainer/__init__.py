@@ -325,8 +325,15 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             else fluid.optimizer.SGD(learning_rate=lr)
         )
         ma_spec = (settings.get("extra") or {}).get("model_average")
+        pruning = None
         if job not in ("test", "checkgrad") and not gen_mode:
             opt.minimize(cost_var)
+            # params with a legacy pruning update_hook get their static
+            # mask built + re-applied after every update — BEFORE
+            # ModelAverage so the EMA accumulates masked values
+            pruning = fluid.optimizer.StaticPruning().build(
+                topo.main_program, topo.startup_program
+            )
             if ma_spec is not None:
                 # settings(model_average=ModelAverage(...)): EMA slots
                 # train inside the step and persist into every
@@ -346,6 +353,11 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
             from ..distributed import load_checkpoint
 
             load_checkpoint(scope, init_model_path, strict=False)
+            if pruning is not None and pruning.masks:
+                # masks computed in startup reflected the now-discarded
+                # random init; rebuild them from the LOADED weights
+                with fluid.executor.scope_guard(scope):
+                    pruning.recompute(scope)
         else:
             from ..v2.parameters import Parameters
 

@@ -196,12 +196,13 @@ class ParamAttr(object):
                  initial_mean=None, initial_max=None, initial_min=None,
                  l1_rate=None, l2_rate=None, learning_rate=None,
                  momentum=None, gradient_clipping_threshold=None,
-                 sparse_update=False, **kwargs):
+                 sparse_update=False, update_hooks=None, **kwargs):
         self.name = name
         self.is_static = is_static
         self.initial_std = initial_std
         self.initial_mean = initial_mean
         self.learning_rate = learning_rate
+        self.update_hooks = update_hooks
 
 
 class ExtraLayerAttribute(object):

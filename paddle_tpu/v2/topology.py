@@ -20,6 +20,7 @@ def _user_attr(pa, default_name):
     return fluid.ParamAttr(
         name=getattr(pa, "name", None) or default_name,
         trainable=not getattr(pa, "is_static", False),
+        update_hook=getattr(pa, "update_hooks", None),
     )
 
 
@@ -98,6 +99,7 @@ class Topology(object):
                               else None) or "%s.w%d" % (node.name, i),
                         # legacy is_static: the parameter never updates
                         trainable=not getattr(ua, "is_static", False),
+                        update_hook=getattr(ua, "update_hooks", None),
                     )
                 )
             bias = a.get("bias_attr")

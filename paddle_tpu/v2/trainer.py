@@ -112,6 +112,12 @@ class SGD(object):
         self._model_average = None
         with fluid.program_guard(topo.main_program, topo.startup_program):
             self._optimizer.minimize(self._cost_var)
+            # legacy update_hooks: params with a pruning hook get their
+            # static mask built + re-applied after every update — BEFORE
+            # ModelAverage so the EMA accumulates masked (sparse) values
+            self._pruning = fluid.optimizer.StaticPruning().build(
+                topo.main_program, topo.startup_program
+            )
             ma_spec = getattr(update_equation, "model_average", None)
             if ma_spec is not None:
                 # reference averaged parameters (trainer.py:130 catchUp/
@@ -134,6 +140,16 @@ class SGD(object):
         ]
         with fluid.executor.scope_guard(parameters.scope):
             self._exe.run(startup)
+        # params that were initialized BEFORE this trainer existed (the
+        # Parameters.create startup) bypassed the in-startup mask apply:
+        # sparsify them now so pruning holds from step 0
+        for pname, mname in self._pruning.masks.items():
+            sc = parameters.scope
+            if pname in sc and mname in sc:
+                sc.set(
+                    pname,
+                    np.asarray(sc.get(pname)) * np.asarray(sc.get(mname)),
+                )
 
     # ------------------------------------------------------------------
     def train(self, reader, num_passes=1, event_handler=None, feeding=None):

@@ -1,0 +1,167 @@
+"""Static magnitude pruning hook (reference StaticPruningHook /
+HookAttr(type='pruning')): a fixed top-|w| mask applied at init and
+after every update — pruned weights stay exactly zero through
+training."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _sparsity(arr):
+    return float((np.asarray(arr) == 0.0).mean())
+
+
+def test_fluid_static_pruning_maintains_sparsity():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(
+            input=x, size=1,
+            param_attr=fluid.ParamAttr(name="w_pruned"),
+            bias_attr=False,
+        )
+        loss = fluid.layers.mean(x=fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+            loss
+        )
+        pr = fluid.optimizer.StaticPruning(sparsity_ratio=0.75).build(
+            main, startup,
+            targets=[
+                p for p in main.global_block().all_parameters()
+                if p.name == "w_pruned"
+            ],
+        )
+    assert pr.masks == {"w_pruned": "w_pruned@PRUNE_MASK"}
+
+    rng = np.random.RandomState(0)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        w0 = np.asarray(scope.get("w_pruned")).copy()
+        # init already masked at 75%
+        assert abs(_sparsity(w0) - 0.75) < 0.1, _sparsity(w0)
+        zero_set = np.asarray(scope.get("w_pruned")) == 0.0
+
+        losses = []
+        for _ in range(10):
+            xv = rng.randn(8, 16).astype(np.float32)
+            yv = rng.randn(8, 1).astype(np.float32)
+            out = exe.run(main, feed={"x": xv, "y": yv},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(out[0])[0]))
+        w_after = np.asarray(scope.get("w_pruned"))
+    assert np.isfinite(losses).all()
+    # the SAME entries stay exactly zero; surviving weights trained
+    assert (w_after[zero_set] == 0.0).all()
+    assert not np.allclose(w_after[~zero_set], w0[~zero_set])
+
+
+def test_legacy_update_hooks_prune_through_v2():
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu.trainer_config_helpers as tch
+
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(12))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(
+        input=x, size=1, act=paddle.activation.Linear(),
+        param_attr=paddle.attr.Param(
+            name="hooked_w",
+            update_hooks=tch.HookAttr(type="pruning", sparsity_ratio=0.5),
+        ),
+        bias_attr=False,
+    )
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.05),
+    )
+    w0 = np.asarray(params.scope.get("hooked_w"))
+    assert abs(_sparsity(w0) - 0.5) < 0.2, _sparsity(w0)
+    zeros = w0 == 0.0
+
+    rng = np.random.RandomState(1)
+
+    def reader():
+        for _ in range(32):
+            xv = rng.randn(12).astype(np.float32)
+            yield xv, [float(xv.sum())]
+
+    trainer.train(paddle.batch(reader, 8), num_passes=2)
+    w_after = np.asarray(params.scope.get("hooked_w"))
+    assert (w_after[zeros] == 0.0).all()
+    assert not np.allclose(w_after[~zeros], w0[~zeros])
+
+
+def test_tied_magnitudes_prune_exact_fraction():
+    """Constant-initialized weights: index-based masking still prunes
+    the exact fraction (a threshold compare would keep everything)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        pred = fluid.layers.fc(
+            input=x, size=4,
+            param_attr=fluid.ParamAttr(
+                name="w_const",
+                initializer=fluid.initializer.Constant(0.5),
+            ),
+            bias_attr=False,
+        )
+        loss = fluid.layers.mean(x=pred)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        fluid.optimizer.StaticPruning(sparsity_ratio=0.5).build(
+            main, startup,
+            targets=[
+                p for p in main.global_block().all_parameters()
+                if p.name == "w_const"
+            ],
+        )
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        w = np.asarray(scope.get("w_const"))
+    assert abs(_sparsity(w) - 0.5) < 0.05, _sparsity(w)
+
+
+def test_hook_without_ratio_uses_reference_default():
+    from paddle_tpu.fluid.optimizer import StaticPruning
+
+    class Hook:
+        type = "pruning"
+        sparsity_ratio = None
+
+    class P:
+        update_hook = Hook()
+
+    assert StaticPruning._hook_ratio(P()) == StaticPruning.DEFAULT_RATIO
+
+
+def test_recompute_masks_from_loaded_weights():
+    from paddle_tpu.fluid.optimizer import StaticPruning
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[10], dtype="float32")
+        fluid.layers.fc(
+            input=x, size=1,
+            param_attr=fluid.ParamAttr(name="w_load"), bias_attr=False,
+        )
+        pr = StaticPruning(sparsity_ratio=0.7).build(
+            main, startup,
+            targets=[
+                p for p in main.global_block().all_parameters()
+                if p.name == "w_load"
+            ],
+        )
+    scope = fluid.Scope()
+    # simulate a loaded checkpoint: weights with known magnitudes
+    w = np.arange(1, 11, dtype=np.float32).reshape(10, 1)
+    scope.set("w_load", w.copy())
+    pr.recompute(scope)
+    got = np.asarray(scope.get("w_load"))
+    # keep = round(10*0.3) = 3 largest -> 8, 9, 10 survive
+    assert (got[:7] == 0).all() and (got[7:] == w[7:]).all()
