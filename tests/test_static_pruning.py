@@ -165,3 +165,46 @@ def test_recompute_masks_from_loaded_weights():
     got = np.asarray(scope.get("w_load"))
     # keep = round(10*0.3) = 3 largest -> 8, 9, 10 survive
     assert (got[:7] == 0).all() and (got[7:] == w[7:]).all()
+
+
+def test_pruning_composes_with_model_average():
+    """Pruning ops precede the EMA accumulation, so the averaged
+    weights (what test()/export see) are sparse at pruned positions."""
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu.trainer_config_helpers as tch
+    from paddle_tpu.v2.optimizer import ModelAverage as V2MA
+
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(16))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(
+        input=x, size=1, act=paddle.activation.Linear(),
+        param_attr=paddle.attr.Param(
+            name="pw",
+            update_hooks=tch.HookAttr(type="pruning", sparsity_ratio=0.5),
+        ),
+        bias_attr=False,
+    )
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(
+            learning_rate=0.05,
+            model_average=V2MA(average_window=0.1, max_average_window=100),
+        ),
+    )
+    zeros = np.asarray(params.scope.get("pw")) == 0.0
+    assert zeros.any()
+
+    rng = np.random.RandomState(2)
+
+    def reader():
+        for _ in range(32):
+            xv = rng.randn(16).astype(np.float32)
+            yield xv, [float(xv.mean())]
+
+    trainer.train(paddle.batch(reader, 8), num_passes=2)
+    with trainer._model_average.apply(scope=params.scope):
+        averaged = np.asarray(params.scope.get("pw")).copy()
+    assert (averaged[zeros] == 0.0).all()
+    assert not np.allclose(averaged[~zeros], 0.0)
