@@ -15,6 +15,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .registry import register_op
+from .selected_rows import SelectedRows, as_dense
 
 
 def _lr(ins):
@@ -24,7 +25,15 @@ def _lr(ins):
 
 @register_op("sgd")
 def _sgd(ctx, ins, attrs):
-    return {"ParamOut": ins["Param"][0] - _lr(ins) * ins["Grad"][0]}
+    g = ins["Grad"][0]
+    p = ins["Param"][0]
+    if isinstance(g, SelectedRows):
+        # reference sgd_op.cc SelectedRows branch: row scatter-add.
+        # Duplicate rows accumulate, so this is bit-equal to the dense
+        # update on touched rows and a no-op elsewhere.
+        upd = (-_lr(ins)) * g.values.astype(p.dtype)
+        return {"ParamOut": p.at[g.rows].add(upd, mode="drop")}
+    return {"ParamOut": p - _lr(ins) * g}
 
 
 @register_op("momentum")
@@ -32,6 +41,22 @@ def _momentum(ctx, ins, attrs):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = attrs["mu"]
     lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        # lazy update (reference momentum SelectedRows branch): velocity
+        # decays only on touched rows
+        r, gv = g.merged()
+        gv = gv.astype(p.dtype)
+        v_r = jnp.take(v, r, axis=0, mode="clip")
+        v_new = mu * v_r + gv
+        if attrs.get("use_nesterov", False):
+            step = (gv + mu * v_new) * lr
+        else:
+            step = lr * v_new
+        p_new = jnp.take(p, r, axis=0, mode="clip") - step
+        return {
+            "ParamOut": p.at[r].set(p_new, mode="drop"),
+            "VelocityOut": v.at[r].set(v_new, mode="drop"),
+        }
     v_out = mu * v + g
     if attrs.get("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr
@@ -44,6 +69,19 @@ def _momentum(ctx, ins, attrs):
 def _adagrad(ctx, ins, attrs):
     p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        # reference adagrad_op.h SelectedRows branch: duplicates merged
+        # (MergeAdd), then per-touched-row moment + param update
+        r, gv = g.merged()
+        gv = gv.astype(p.dtype)
+        m_new = jnp.take(m, r, axis=0, mode="clip") + gv * gv
+        p_new = jnp.take(p, r, axis=0, mode="clip") - _lr(ins) * gv / (
+            jnp.sqrt(m_new) + eps
+        )
+        return {
+            "ParamOut": p.at[r].set(p_new, mode="drop"),
+            "MomentOut": m.at[r].set(m_new, mode="drop"),
+        }
     m_out = m + g * g
     p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
     return {"ParamOut": p_out, "MomentOut": m_out}
@@ -58,16 +96,37 @@ def _adam(ctx, ins, attrs):
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins) * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    if isinstance(g, SelectedRows):
+        # lazy adam (reference adam_op.h SparseAdamFunctor): moments decay
+        # and the param moves only on touched rows; untouched rows keep
+        # their state bit-exact. Documented divergence from dense adam,
+        # same as the reference's sparse branch.
+        r, gv = g.merged()
+        gv = gv.astype(p.dtype)
+        m1_new = b1 * jnp.take(m1, r, axis=0, mode="clip") + (1.0 - b1) * gv
+        m2_new = b2 * jnp.take(m2, r, axis=0, mode="clip") + (
+            1.0 - b2
+        ) * gv * gv
+        p_new = jnp.take(p, r, axis=0, mode="clip") - lr * m1_new / (
+            jnp.sqrt(m2_new) + eps
+        )
+        return {
+            "ParamOut": p.at[r].set(p_new, mode="drop"),
+            "Moment1Out": m1.at[r].set(m1_new, mode="drop"),
+            "Moment2Out": m2.at[r].set(m2_new, mode="drop"),
+        }
     m1_out = b1 * m1 + (1.0 - b1) * g
     m2_out = b2 * m2 + (1.0 - b2) * g * g
-    lr = _lr(ins) * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
     p_out = p - lr * m1_out / (jnp.sqrt(m2_out) + eps)
     return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out}
 
 
 @register_op("adamax")
 def _adamax(ctx, ins, attrs):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    # no sparse branch for this rule (matches the reference op set):
+    # an arriving SelectedRows densifies to the exact dense gradient
+    p, g = ins["Param"][0], as_dense(ins["Grad"][0])
     m, inf = ins["Moment"][0], ins["InfNorm"][0]
     b1p = ins["Beta1Pow"][0].reshape(())
     b1 = attrs.get("beta1", 0.9)
@@ -82,7 +141,9 @@ def _adamax(ctx, ins, attrs):
 
 @register_op("decayed_adagrad")
 def _decayed_adagrad(ctx, ins, attrs):
-    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    # no sparse branch for this rule (matches the reference op set):
+    # an arriving SelectedRows densifies to the exact dense gradient
+    p, g, m = ins["Param"][0], as_dense(ins["Grad"][0]), ins["Moment"][0]
     decay = attrs.get("decay", 0.95)
     eps = attrs.get("epsilon", 1e-6)
     m_out = decay * m + (1.0 - decay) * g * g
@@ -92,7 +153,9 @@ def _decayed_adagrad(ctx, ins, attrs):
 
 @register_op("rmsprop")
 def _rmsprop(ctx, ins, attrs):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    # no sparse branch for this rule (matches the reference op set):
+    # an arriving SelectedRows densifies to the exact dense gradient
+    p, g = ins["Param"][0], as_dense(ins["Grad"][0])
     ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
     eps = attrs.get("epsilon", 1e-10)
     decay = attrs.get("decay", 0.9)
@@ -105,7 +168,9 @@ def _rmsprop(ctx, ins, attrs):
 
 @register_op("adadelta")
 def _adadelta(ctx, ins, attrs):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    # no sparse branch for this rule (matches the reference op set):
+    # an arriving SelectedRows densifies to the exact dense gradient
+    p, g = ins["Param"][0], as_dense(ins["Grad"][0])
     ag, au = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
     rho = attrs.get("rho", 0.95)
     eps = attrs.get("epsilon", 1e-6)
@@ -117,7 +182,9 @@ def _adadelta(ctx, ins, attrs):
 
 @register_op("ftrl")
 def _ftrl(ctx, ins, attrs):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    # no sparse branch for this rule (matches the reference op set):
+    # an arriving SelectedRows densifies to the exact dense gradient
+    p, g = ins["Param"][0], as_dense(ins["Grad"][0])
     sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
@@ -136,3 +203,37 @@ def _ftrl(ctx, ins, attrs):
     pre = jnp.sign(new_lin) * l1 - new_lin
     p_out = jnp.where(jnp.abs(new_lin) > l1, pre / denom, jnp.zeros_like(p))
     return {"ParamOut": p_out, "SquaredAccumOut": new_sq, "LinearAccumOut": new_lin}
+
+
+def _prox_project(prox, lr, attrs):
+    """Soft-threshold by lr*l1 then shrink by 1/(1+lr*l2) — the shared
+    projection of proximal_gd/proximal_adagrad (reference
+    proximal_gd_op.h / proximal_adagrad_op.h)."""
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    if l1 > 0:
+        return jnp.sign(prox) * (
+            jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+        )
+    return prox / (1.0 + lr * l2)
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    """Proximal gradient descent (reference operators/proximal_gd_op.h)."""
+    p, g = ins["Param"][0], as_dense(ins["Grad"][0])
+    lr = _lr(ins)
+    return {"ParamOut": _prox_project(p - lr * g, lr, attrs)}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    """Reference operators/proximal_adagrad_op.h: adagrad moment, then
+    the same proximal projection as proximal_gd."""
+    p, g = ins["Param"][0], as_dense(ins["Grad"][0])
+    m = ins["Moment"][0]
+    lr = _lr(ins)
+    m_out = m + g * g
+    prox = p - lr * g / jnp.sqrt(m_out)
+    return {"ParamOut": _prox_project(prox, lr, attrs),
+            "MomentOut": m_out}

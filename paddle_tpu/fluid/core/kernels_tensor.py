@@ -194,13 +194,36 @@ def _crop(ctx, ins, attrs):
 @register_op("lookup_table")
 def _lookup_table(ctx, ins, attrs):
     """Embedding gather (reference operators/lookup_table_op.cc). Ids come in
-    as [N, 1] int; padding_idx rows read as zeros."""
+    as [N, 1] int; padding_idx rows read as zeros.
+
+    Sparse-grad sites (lowering._find_sparse_sites): the table is a trace
+    constant here and the gather result instead carries the site's zero
+    "delta" cotangent leaf, so the vjp produces a [n_ids, dim] gradient —
+    the SelectedRows value block — rather than a dense [vocab, dim]
+    cotangent (reference lookup_table_op.cc SelectedRows grad branch).
+    The touched row ids are recorded in the env side-band for the
+    optimizer's row-scatter update; padding positions record the
+    out-of-range sentinel so they drop out of the scatter."""
     w = ins["W"][0]
     ids = ins["Ids"][0]
     flat = ids.reshape(-1).astype(jnp.int32)
-    out = jnp.take(w, flat, axis=0)
     padding_idx = attrs.get("padding_idx", -1)
-    if padding_idx is not None and padding_idx >= 0:
+    has_pad = padding_idx is not None and padding_idx >= 0
+    out_name = ctx.op.outputs["Out"][0]
+    delta_name = ctx.sparse_sites.get(out_name)
+    if delta_name is not None and delta_name in ctx.env:
+        out = jnp.take(w, flat, axis=0) + ctx.env[delta_name]
+        rows = (
+            jnp.where(flat == padding_idx, w.shape[0], flat)
+            if has_pad
+            else flat
+        )
+        ctx.env[out_name + "@sparse_rows"] = rows
+    else:
+        out = jnp.take(w, flat, axis=0)
+    if has_pad:
+        # masking AFTER the delta add zeroes the delta cotangent at
+        # padding positions too (their sentinel rows drop regardless)
         out = jnp.where((flat == padding_idx)[:, None], 0.0, out)
     out_shape = tuple(ids.shape[:-1]) + (w.shape[1],) if ids.shape[-1] == 1 else tuple(ids.shape) + (w.shape[1],)
     return {"Out": out.reshape(out_shape)}
@@ -409,3 +432,12 @@ def _select(ctx, ins, attrs):
     assign-only Switch pattern)."""
     cond = ins["Cond"][0].reshape(()).astype(bool)
     return {"Out": jnp.where(cond, ins["X"][0], ins["Y"][0])}
+
+
+@register_op("is_empty")
+def _is_empty(ctx, ins, attrs):
+    """Out = [numel(X) == 0] (reference operators/is_empty_op.cc). Static
+    under XLA: emptiness is a property of the traced shape."""
+    x = ins["X"][0]
+    empty = int(np.prod(x.shape)) == 0 if hasattr(x, "shape") else False
+    return {"Out": jnp.asarray([empty], dtype=bool)}

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import LoweringContext, get_kernel
+from .selected_rows import SelectedRows, as_dense
 
 AUTODIFF_OP = "autodiff"
 # ops handled by the executor itself, not kernels
@@ -101,7 +102,12 @@ def run_op(ctx: LoweringContext, op, env: Dict[str, Any]):
     # sequence kernels read LoD offsets / write output LoD via ctx.env
     ctx.op = op
     ctx.env = env
-    outs = kernel(ctx, ins, op.attrs)
+    # the scope tag rides into HLO metadata (op_name="...op:<type>/...")
+    # and survives fusion — the compiled-step profiler maps fused
+    # instructions back to op provenance through it (fluid/profiler.py
+    # compiled_profile; reference profiler.cc:198 ParseEvents parity)
+    with jax.named_scope("op:%s" % op.type):
+        outs = kernel(ctx, ins, op.attrs)
     find_var = getattr(ctx.block, "_find_var_recursive", None)
     for slot, names in op.outputs.items():
         if slot not in outs:
@@ -235,6 +241,56 @@ def _run_autodiff(ctx, op, env):
     )
 
 
+# optimizer ops with a SelectedRows-aware update branch (reference: the
+# SelectedRows specialisations in operators/sgd_op.cc, adam_op.h,
+# adagrad_op.h, momentum in later snapshots). A gradient may stay sparse
+# only if EVERY tail op consuming it is one of these.
+_SPARSE_OPT_OPS = frozenset(["sgd", "momentum", "adagrad", "adam"])
+
+
+def _find_sparse_sites(fwd_ops, tail_ops, param_names, grad_names, base_env):
+    """Select params whose gradient can flow as SelectedRows instead of a
+    dense [vocab, dim] cotangent. A param qualifies when every forward
+    reader is a `lookup_table` op with is_sparse=True whose Ids are
+    leaves (fed or persisted — their static shape sizes the per-site
+    cotangent leaf), and every tail consumer of its grad var has a
+    sparse update branch (_SPARSE_OPT_OPS). Anything else — shared with
+    a dense op, regularized/clipped grads, exotic optimizers — falls
+    back to the exact dense path.
+
+    Returns {param_name: [lookup-output var name per site]}.
+    """
+    pset = set(param_names)
+    readers: Dict[str, list] = {}
+    for op in fwd_ops:
+        for names in op.inputs.values():
+            for n in names:
+                if n in pset:
+                    readers.setdefault(n, []).append(op)
+    sites = {}
+    for p in param_names:
+        ops_r = readers.get(p, [])
+        if not ops_r:
+            continue
+        ok = all(
+            op.type == "lookup_table"
+            and op.attrs.get("is_sparse", False)
+            and op.inputs.get("W") == [p]
+            and all(i in base_env for i in op.inputs.get("Ids", []))
+            for op in ops_r
+        )
+        if not ok:
+            continue
+        gname = grad_names[p]
+        consumers = [o for o in tail_ops if gname in o.input_arg_names]
+        if not consumers or any(
+            o.type not in _SPARSE_OPT_OPS for o in consumers
+        ):
+            continue
+        sites[p] = [op.outputs["Out"][0] for op in ops_r]
+    return sites
+
+
 def _split_at_autodiff(ops) -> Tuple[list, Optional[Any], list]:
     for i, op in enumerate(ops):
         if op.type == AUTODIFF_OP:
@@ -301,6 +357,19 @@ def _lower_ops(
     amp = bool(getattr(block.program, "amp", False))
 
     base_env = dict(env)
+    # SelectedRows sparse-grad path: qualifying embedding params leave the
+    # vjp leaf set; their cotangent is captured per lookup site through a
+    # zero "delta" leaf of the site's [n_ids, dim] output shape instead of
+    # a dense [vocab, dim] array (design note in selected_rows.py)
+    sparse_sites = _find_sparse_sites(
+        fwd_ops, tail_ops, param_names, grad_names, base_env
+    )
+    site_delta = {}  # lookup-out var name -> delta leaf name
+    for p, outs in sparse_sites.items():
+        for o in outs:
+            site_delta[o] = o + "@sparse_delta"
+    ctx.sparse_sites = site_delta
+    dense_param_names = [p for p in param_names if p not in sparse_sites]
     if amp:
         # mixed precision: cast ONLY what the forward region reads (feeds,
         # params, BN state) to bf16 — optimizer state and scalar
@@ -333,7 +402,22 @@ def _lower_ops(
         loss = fenv[loss_name].astype(jnp.float32)
         return loss, fenv
 
-    primal_params = {p: env[p] for p in param_names}
+    primal_params = {p: env[p] for p in dense_param_names}
+    for p, outs in sparse_sites.items():
+        w = base_env[p]
+        for o in outs:
+            ids = base_env[
+                next(
+                    op.inputs["Ids"][0]
+                    for op in fwd_ops
+                    if op.type == "lookup_table"
+                    and op.outputs["Out"] == [o]
+                )
+            ]
+            n = int(np.prod(ids.shape))
+            primal_params[site_delta[o]] = jnp.zeros(
+                (n, w.shape[1]), dtype=w.dtype
+            )
     if bool(getattr(block.program, "remat", False)):
         # memory_optimize(): rematerialize the forward region during the
         # cotangent pass instead of keeping every activation live — the
@@ -353,9 +437,24 @@ def _lower_ops(
     env.clear()
     env.update(fenv)
     env.update(saved)
-    for p in param_names:
+    for p in dense_param_names:
         g = grads[p]
         env[grad_names[p]] = g.astype(jnp.float32) if amp else g
+    for p, outs in sparse_sites.items():
+        rows = jnp.concatenate(
+            [fenv[o + "@sparse_rows"].reshape(-1) for o in outs]
+        )
+        vals = jnp.concatenate(
+            [
+                grads[site_delta[o]].reshape(
+                    -1, grads[site_delta[o]].shape[-1]
+                )
+                for o in outs
+            ]
+        )
+        if amp:
+            vals = vals.astype(jnp.float32)
+        env[grad_names[p]] = SelectedRows(rows, vals, env[p].shape[0])
 
     run_ops(ctx, tail_ops, env)
     return env
@@ -432,7 +531,7 @@ def profile_ops(
                 jax.block_until_ready(v)
         collector.record("backward+update (fused)", _time.time() - t0)
 
-    fetches = [final_env[n] for n in fetch_names]
+    fetches = [as_dense(final_env[n]) for n in fetch_names]
     new_persist = {}
     for n in persist_names:
         if n not in final_env:
@@ -491,7 +590,8 @@ def build_step_fn(
             block, pruned_ops, env, base_key=key, is_test=is_test,
             seq_maxlen=seq_maxlen, seq_buckets=seq_buckets,
         )
-        fetches = [env[n] for n in fetch_names]
+        # a fetched sparse gradient is observed as its dense equivalent
+        fetches = [as_dense(env[n]) for n in fetch_names]
         new_persist = {}
         for n in persist_out:
             v = env[n]

@@ -82,6 +82,9 @@ class LoweringContext(object):
         # True while lowering the bf16 forward region of an AMP program:
         # deny-listed ops (lowering._AMP_F32_OPS) then compute in f32
         self.amp_region = False
+        # lookup-out var name -> cotangent ("delta") leaf name for the
+        # SelectedRows sparse-grad path (lowering._find_sparse_sites)
+        self.sparse_sites: dict = {}
 
     def next_key(self):
         if self._base_key is None:

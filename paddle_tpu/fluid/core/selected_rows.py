@@ -1,0 +1,86 @@
+"""SelectedRows: the sparse-gradient value for embedding tables.
+
+Reference parity: paddle/fluid/framework/selected_rows.h (rows + value
+block + height), the SelectedRows branches of the optimizer ops
+(operators/sgd_op.cc, adam_op.h) and math/selected_rows_functor.cc
+(MergeAdd). The legacy counterpart is the sparse-row update machinery in
+paddle/math/SparseRowMatrix.h + MultiGradientMachine.h:140-166.
+
+TPU-native design: a SelectedRows is a pair of stacked device arrays
+(`rows` int32 [n], `values` [n, dim]) with a static `height` (vocab
+size). `n` is the number of *lookup sites* in the batch — static under
+jit — so the whole sparse path traces to fixed-shape gather/scatter ops
+the MXU-adjacent scatter units handle natively; no dense [vocab, dim]
+cotangent is ever materialised. Out-of-range rows (== height) are
+sentinels: every scatter in this module uses mode='drop', so sentinel
+rows (padding_idx positions, merge leftovers) fall out of the update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "as_dense"]
+
+
+class SelectedRows:
+    """Sparse gradient: `values[i]` is the gradient contribution to row
+    `rows[i]` of a [height, dim] parameter. Rows may repeat (one entry
+    per lookup occurrence); duplicates SUM, matching the dense gradient.
+    """
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        """Densify: scatter-add contributions into a zero [height, dim]
+        array — bit-equal to the dense gradient (duplicates merge by
+        addition; sentinel rows drop)."""
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values, mode="drop")
+
+    def merged(self):
+        """Combine duplicate rows (reference MergeAdd,
+        math/selected_rows_functor.cc): returns (rows', values') of the
+        SAME static length where each in-bounds row appears at most once
+        with its contributions summed; surplus slots carry the sentinel
+        row `height` (dropped by mode='drop' scatters). Required by the
+        moment-tracking optimizers (adagrad/adam), whose per-row state
+        update must fire once per touched row, not once per occurrence.
+        """
+        n = self.rows.shape[0]
+        order = jnp.argsort(self.rows)
+        r = jnp.take(self.rows, order)
+        v = jnp.take(self.values, order, axis=0)
+        is_new = jnp.concatenate(
+            [jnp.ones((1,), bool), r[1:] != r[:-1]]
+        )
+        seg = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+        merged_v = jax.ops.segment_sum(v, seg, num_segments=n)
+        # every element of a segment writes the same row id, so the
+        # duplicate-index scatter-set is deterministic; untouched slots
+        # keep the sentinel
+        merged_r = (
+            jnp.full((n,), self.height, dtype=jnp.int32).at[seg].set(r)
+        )
+        return merged_r, merged_v
+
+
+def as_dense(x):
+    """Densify if `x` is a SelectedRows, else pass through. Fetch sites
+    and sparse-unaware consumers use this so a sparse gradient is always
+    observable as its dense equivalent."""
+    return x.to_dense() if isinstance(x, SelectedRows) else x

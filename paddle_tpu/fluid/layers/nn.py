@@ -144,8 +144,20 @@ def embedding(
     dtype="float32",
     **kwargs,
 ):
-    """Lookup-table layer (reference nn.py:193). `is_sparse` selects the
-    SelectedRows-style sparse gradient path on the optimizer side."""
+    """Lookup-table layer (reference nn.py:193).
+
+    `is_sparse=True` selects the SelectedRows sparse-gradient path
+    (reference framework/selected_rows.h + the SelectedRows branches of
+    sgd/adagrad/adam ops): the backward produces a (rows, values) pair
+    sized by the batch's lookup count, and the optimizer applies a
+    row-scatter update — work proportional to touched rows, not vocab
+    size. The sparse path engages when the table is read only by sparse
+    lookups over fed ids and its gradient feeds a sparse-capable
+    optimizer (sgd/momentum/adagrad/adam, no regularizer/clip on this
+    param); otherwise lowering falls back to the exact dense gradient
+    (core/lowering.py:_find_sparse_sites). Moment-tracking optimizers
+    update touched rows lazily, matching the reference's sparse
+    branches."""
     helper = LayerHelper("embedding", **locals())
     w = helper.create_parameter(
         attr=helper.param_attr, shape=size, dtype=dtype, is_bias=False
