@@ -112,7 +112,10 @@ def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None):
         val = scope.get(name)
         if val is None:
             continue
-        if isinstance(val, jax.Array) and not val.is_fully_replicated:
+        if (
+            isinstance(val, (jax.Array, _HostShardedArray))
+            and not val.is_fully_replicated
+        ):
             # genuinely sharded (TP / FSDP): write shard-by-shard — the
             # same path whether the shards span processes or not, and no
             # full-array materialisation for big weights
@@ -377,6 +380,32 @@ class _HostScope(object):
         return self._arrays[name]
 
 
+class _HostShard(object):
+    """One addressable shard pulled to host (mirrors jax.Array shard)."""
+
+    __slots__ = ("replica_id", "data", "index")
+
+    def __init__(self, replica_id, data, index):
+        self.replica_id = replica_id
+        self.data = data
+        self.index = index
+
+
+class _HostShardedArray(object):
+    """Host-side snapshot of a sharded jax.Array that PRESERVES the shard
+    layout, so the async writer emits the same shard-by-shard files as
+    the synchronous saver — a big TP weight is pulled one owned shard at
+    a time, never materialised whole on host."""
+
+    is_fully_replicated = False
+    is_fully_addressable = True
+
+    def __init__(self, shards, shape, dtype):
+        self.addressable_shards = shards
+        self.shape = shape
+        self.dtype = dtype
+
+
 class AsyncCheckpoint(object):
     """Handle for an in-flight save: result() joins and re-raises any
     writer error; done() polls. thread=None marks an already-committed
@@ -430,10 +459,18 @@ def save_checkpoint_async(scope, dirname: str, step: int = 0,
             continue
         # device->host pull happens here, synchronously. np.array(copy)
         # so in-place mutation of numpy scope values after the call can
-        # never reach the writer; single-process sharded (TP) values
-        # materialise whole — load_checkpoint reads whole-array and
-        # shard-file layouts interchangeably
-        arrays[name] = np.array(val, copy=True)
+        # never reach the writer. Sharded (TP) values snapshot per owned
+        # shard, keeping the sync saver's shard-file layout and the
+        # 'no full-array materialisation' property
+        if isinstance(val, jax.Array) and not val.is_fully_replicated:
+            shards = [
+                _HostShard(s.replica_id, np.asarray(s.data), s.index)
+                for s in val.addressable_shards
+                if s.replica_id == 0
+            ]
+            arrays[name] = _HostShardedArray(shards, val.shape, val.dtype)
+        else:
+            arrays[name] = np.array(val, copy=True)
 
     box = {"value": None, "error": None}
 

@@ -81,14 +81,35 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     or parameter; gradients w.r.t. intermediates would require a second
     trace cut), and a program carries at most one autodiff marker —
     call this OR minimize, not both, on the same program.
+
+    Divergence from the reference: for a leaf input that does not affect
+    the targets the reference returns None; the fused vjp returns a
+    ZEROS array of the leaf's shape (same calculus, different encoding).
     """
-    from .core.program import unique_name
+    from .core.program import Parameter, unique_name
 
     def _as_list(x):
         return list(x) if isinstance(x, (list, tuple)) else [x]
 
     targets = _as_list(targets)
     inputs = _as_list(inputs)
+    # eager leaf validation: an intermediate input would silently fall out
+    # of the vjp leaf set (lowering keeps only names already bound in the
+    # scope/feed env), leaving its grad var unpopulated and failing much
+    # later with an opaque fetch KeyError — reject it here instead
+    for v in inputs:
+        if not (
+            isinstance(v, Parameter)
+            or getattr(v, "is_data", False)
+            or getattr(v, "persistable", False)  # scope-bound leaves
+        ):
+            raise NotImplementedError(
+                "calc_gradient input %r is neither a Parameter nor a "
+                "data (feed) variable nor a persistable; gradients "
+                "w.r.t. intermediate values are not supported by the "
+                "fused-vjp design — take the gradient at the leaves "
+                "that produce it" % v.name
+            )
     target_gradients = _as_list(target_gradients or [])
     if target_gradients and len(target_gradients) != len(targets):
         raise ValueError(

@@ -888,13 +888,17 @@ def _print_op(ctx, ins, attrs):
     show_lod = attrs.get("print_tensor_lod", True)
     lod = ctx.env.get(lod_key(name)) if show_lod else None
 
-    counter = _PRINT_COUNTS.setdefault(ctx.op, {"n": 0})
+    # per-direction budgets: the reference print_op counts per op
+    # invocation per direction, so first_n=N means N forward prints AND
+    # N backward prints — a shared counter would halve the budget under
+    # print_phase='both' (and double-spend it under remat re-emission)
+    counter = _PRINT_COUNTS.setdefault(ctx.op, {"": 0, "@GRAD": 0})
 
     def _emit(tag, val, lod_val=None):
         # reference print_op semantics: first_n <= 0 means no limit
-        if 0 < first_n <= counter["n"]:
+        if 0 < first_n <= counter[tag]:
             return
-        counter["n"] += 1
+        counter[tag] += 1
         arr = np.asarray(val)
         flat = np.ravel(arr)
         if summarize >= 0:

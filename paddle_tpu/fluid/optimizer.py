@@ -646,7 +646,23 @@ class ModelAverage(object):
             for k in scope.keys()
             if k.endswith(self.AVG_SUFFIX)
         }
-        steps = [k for k in scope.keys() if "model_average_steps" in k]
+        # bind the steps counter by its exact name family
+        # ("model_average_steps" + unique_name suffix). A scope holding
+        # MORE than one such var (e.g. a program rebuilt twice into one
+        # scope) is ambiguous — binding the wrong counter would silently
+        # skew the bias correction, so refuse instead of guessing.
+        steps = sorted(
+            k for k in scope.keys()
+            if k == "model_average_steps"
+            or k.startswith("model_average_steps_")
+        )
+        if len(steps) > 1:
+            raise ValueError(
+                "scope holds %d model_average_steps counters (%r); "
+                "cannot tell which matches the averaged slots — load a "
+                "checkpoint produced by a single minimize(), or delete "
+                "the stale counters" % (len(steps), steps)
+            )
         self._steps_name = steps[0] if steps else None
         return self
 

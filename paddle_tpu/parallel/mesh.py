@@ -115,6 +115,21 @@ def make_hybrid_mesh(
                 "ici axes %r want %d devices per slice, a slice has %d"
                 % (dict(ici_axes), per_slice, len(g))
             )
+        if len(g) > per_slice and len(groups) > 1:
+            # a REAL multi-slice platform with surplus chips per slice:
+            # silently dropping them would read as a working mesh while
+            # under-utilizing the hardware. (The single-group emulation
+            # path above keeps the silent split — its surplus is the
+            # virtual-device fixture, not idle chips.)
+            import warnings
+
+            warnings.warn(
+                "make_hybrid_mesh: slice has %d devices but ici axes %r "
+                "use only %d — %d chips per slice will sit idle; size "
+                "the ici axes to the slice"
+                % (len(g), dict(ici_axes), per_slice, len(g) - per_slice),
+                stacklevel=2,
+            )
     arr = np.asarray(
         [g[:per_slice] for g in ordered], dtype=object
     ).reshape(dcn_sizes + ici_sizes)

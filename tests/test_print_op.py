@@ -55,9 +55,11 @@ def test_print_both_includes_grad(capsys):
 
 
 def test_print_first_n_limits(capsys):
-    # 3 steps with both phases = 6 potential prints; first_n=2 caps it
+    # reference print_op budgets PER DIRECTION: first_n=2 with
+    # print_phase='both' over 3 steps = 2 forward + 2 backward prints
     _, out = _run_with_print("both", capsys, first_n=2)
-    assert out.count("DBG_H") == 2
+    assert out.count("DBG_H") == 4
+    assert out.count("@GRAD") == 2
 
 
 def test_print_first_n_zero_means_unlimited(capsys):
