@@ -289,3 +289,23 @@ def _cumsum(ctx, ins, attrs):
     if rev:
         out = jnp.flip(out, axis)
     return {"Out": out}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    """Out = sum(|x|) (reference operators/l1_norm_op.h)."""
+    return {"Out": jnp.sum(jnp.abs(ins["X"][0])).reshape((1,))}
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    """out = (1-eps)*label + eps*prior (uniform 1/K without PriorDist) —
+    reference operators/label_smooth_op.h."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    prior = ins.get("PriorDist")
+    if prior and prior[0] is not None:
+        smooth = eps * prior[0].reshape(1, -1)
+    else:
+        smooth = eps / x.shape[-1]
+    return {"Out": (1.0 - eps) * x + smooth}

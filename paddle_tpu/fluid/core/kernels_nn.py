@@ -23,6 +23,12 @@ def _pair(v):
     return (int(v), int(v))
 
 
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
 @register_op("conv2d")
 def _conv2d(ctx, ins, attrs):
     x = ins["Input"][0]  # NCHW
@@ -54,6 +60,9 @@ def _depthwise_conv2d(ctx, ins, attrs):
 def _conv2d_transpose(ctx, ins, attrs):
     x = ins["Input"][0]  # NCHW
     w = ins["Filter"][0]  # IOHW in reference conv2d_transpose
+    if int(attrs.get("groups", 1) or 1) != 1:
+        # reference conv_transpose_op.cc:101 enforces groups == 1
+        raise NotImplementedError("conv2d_transpose requires groups == 1")
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
@@ -80,8 +89,6 @@ def _conv2d_transpose(ctx, ins, attrs):
 def _conv3d(ctx, ins, attrs):
     x = ins["Input"][0]  # NCDHW
     w = ins["Filter"][0]  # OIDHW
-    def _triple(v):
-        return tuple(int(a) for a in v) if isinstance(v, (list, tuple)) else (int(v),) * 3
     strides = _triple(attrs.get("strides", [1, 1, 1]))
     pads = _triple(attrs.get("paddings", [0, 0, 0]))
     dil = _triple(attrs.get("dilations", [1, 1, 1]))
@@ -147,8 +154,6 @@ def _pool2d(ctx, ins, attrs):
 
 @register_op("pool3d")
 def _pool3d(ctx, ins, attrs):
-    def _triple(v):
-        return tuple(int(a) for a in v) if isinstance(v, (list, tuple)) else (int(v),) * 3
     x = ins["X"][0]
     out = _pool(
         x,
@@ -527,3 +532,223 @@ def _flash_attention(ctx, ins, attrs):
         interpret=jax.default_backend() == "cpu",
     )
     return {"Out": out}
+
+
+# --- r4 op-tail: pooling-with-index / unpool / spp / conv3d_transpose ---
+
+
+def _pool_with_index(x, ksize, strides, pads, global_pooling, nd):
+    """Max pooling that also returns the argmax's flat index within the
+    UNPADDED input plane (reference math/pooling.cc
+    MaxPool2dWithIndexFunctor: index = h * input_w + w; windows are
+    clipped to the input, so a padding position can never win). Static
+    shapes throughout: windows are materialised as a gather (XLA folds
+    it), argmax ties break on the first element in window scan order —
+    the same (h, w[, d]) order the reference loop visits."""
+    spatial = x.shape[2:]
+    if global_pooling:
+        ksize = spatial
+        pads = (0,) * nd
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    pad_cfg = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    xp = jnp.pad(x, pad_cfg, constant_values=neg)
+    out_dims = [
+        (spatial[i] + 2 * pads[i] - ksize[i]) // strides[i] + 1
+        for i in range(nd)
+    ]
+    # per-axis window index grids: idx[i] has shape [out_i, k_i]
+    grids = [
+        np.arange(out_dims[i])[:, None] * strides[i] + np.arange(ksize[i])
+        for i in range(nd)
+    ]
+    # broadcast to [N, C, out..., k...]: axis layout (o1..on, k1..kn)
+    ix = []
+    for i in range(nd):
+        shape = [1] * (2 * nd)
+        shape[i] = out_dims[i]
+        shape[nd + i] = ksize[i]
+        ix.append(grids[i].reshape(shape))
+    windows = xp[(slice(None), slice(None)) + tuple(ix)]
+    # -> [N, C, o..., kprod]
+    kprod = int(np.prod(ksize))
+    windows = windows.reshape(windows.shape[: 2 + nd] + (kprod,))
+    arg = jnp.argmax(windows, axis=-1)
+    out = jnp.take_along_axis(windows, arg[..., None], axis=-1)[..., 0]
+    # flat index in the unpadded plane: per window element, its padded
+    # coordinate minus pad, row-majored over the input spatial dims
+    coord = np.zeros((int(np.prod(out_dims)), kprod), np.int32)
+    flat_mult = np.cumprod((spatial[1:] + (1,))[::-1])[::-1]  # row-major
+    o_grid = np.meshgrid(*[np.arange(o) for o in out_dims], indexing="ij")
+    k_grid = np.meshgrid(*[np.arange(k) for k in ksize], indexing="ij")
+    for i in range(nd):
+        c = (
+            o_grid[i].reshape(-1, 1) * strides[i]
+            + k_grid[i].reshape(1, -1)
+            - pads[i]
+        )
+        coord += c.astype(np.int32) * int(flat_mult[i])
+    coord = jnp.asarray(coord.reshape(tuple(out_dims) + (kprod,)))
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(coord, arg.shape + (kprod,)), arg[..., None],
+        axis=-1,
+    )[..., 0]
+    return out, mask
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """Reference operators/pool_with_index_op.cc (2-D)."""
+    out, mask = _pool_with_index(
+        ins["X"][0],
+        _pair(attrs.get("ksize", [1, 1])),
+        _pair(attrs.get("strides", [1, 1])),
+        _pair(attrs.get("paddings", [0, 0])),
+        attrs.get("global_pooling", False),
+        nd=2,
+    )
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """Reference operators/pool_with_index_op.cc (3-D, NCDHW)."""
+    out, mask = _pool_with_index(
+        ins["X"][0],
+        _triple(attrs.get("ksize", [1, 1, 1])),
+        _triple(attrs.get("strides", [1, 1, 1])),
+        _triple(attrs.get("paddings", [0, 0, 0])),
+        attrs.get("global_pooling", False),
+        nd=3,
+    )
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("unpool")
+def _unpool(ctx, ins, attrs):
+    """Max unpooling (reference operators/unpool_op.cc +
+    math/unpooling.cc): scatter each input element to the output-plane
+    position its Indices entry names; everything else is zero. Output
+    size = (in-1)*stride - 2*pad + ksize per spatial dim."""
+    x = ins["X"][0]  # [N, C, H, W]
+    idx = ins["Indices"][0].astype(jnp.int32)
+    ksize = _pair(attrs.get("ksize", [1, 1]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    oh = (h - 1) * strides[0] - 2 * pads[0] + ksize[0]
+    ow = (w - 1) * strides[1] - 2 * pads[1] + ksize[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    bi = jnp.arange(n).reshape(n, 1, 1)
+    ci = jnp.arange(c).reshape(1, c, 1)
+    out = flat.at[bi, ci, idx.reshape(n, c, -1)].set(
+        x.reshape(n, c, -1), mode="drop"
+    )
+    return {"Out": out.reshape(n, c, oh, ow)}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference operators/spp_op.cc): levels
+    p = 0..H-1 pool to 2^p x 2^p bins (ksize = ceil(in/bins), stride =
+    ksize, pad centers the grid), flatten and concatenate along
+    channels*bins^2."""
+    x = ins["X"][0]
+    height = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    parts = []
+    for p in range(height):
+        bins = 2 ** p
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        lvl = _pool(
+            x, ptype, (kh, kw), (kh, kw), (ph, pw),
+            global_pooling=False, exclusive=True,
+        )
+        parts.append(lvl.reshape(n, c * bins * bins))
+    return {"Out": jnp.concatenate(parts, axis=1)}
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """Reference operators/conv_transpose_op.cc (3-D): conv3d's
+    input-gradient with an IODHW filter — dilate the input by stride and
+    run a stride-1 conv with the flipped, channel-swapped kernel. Output
+    size = (i-1)*s - 2p + d*(k-1) + 1 per spatial dim."""
+    x = ins["Input"][0]  # NCDHW
+    w = ins["Filter"][0]  # IODHW
+    if int(attrs.get("groups", 1) or 1) != 1:
+        # reference conv_transpose_op.cc:101 enforces groups == 1
+        raise NotImplementedError("conv3d_transpose requires groups == 1")
+    strides = _triple(attrs.get("strides", [1, 1, 1]))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    dil = _triple(attrs.get("dilations", [1, 1, 1]))
+    w = jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1, ::-1]
+    ks = [dil[i] * (w.shape[2 + i] - 1) for i in range(3)]
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1, 1, 1),
+        padding=[(ks[i] - pads[i], ks[i] - pads[i]) for i in range(3)],
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    return {"Output": out}
+
+
+@register_op("norm")
+def _norm(ctx, ins, attrs):
+    """SSD-style cross-channel L2 normalisation with learned per-channel
+    scale (reference operators/norm_op.h): out[n,c,h,w] =
+    x / sqrt(eps + sum_c x^2) * scale[c]."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(-1)
+    eps = attrs.get("epsilon", 1e-10)
+    denom = jnp.sqrt(eps + jnp.sum(
+        jnp.square(x.astype(jnp.float32)), axis=1, keepdims=True
+    ))
+    out = (x / denom) * scale.reshape(1, -1, *([1] * (x.ndim - 2)))
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """out[b,k] = x[b,:] @ W[k] @ y[b,:] + bias[k] (reference
+    operators/bilinear_tensor_product_op.h)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    w = ins["Weight"][0]  # [size, M, N]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": out}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ctx, ins, attrs):
+    """Reference operators/modified_huber_loss_op.h: a = x * (2y - 1);
+    loss = -4a for a < -1, (1-a)^2 for a < 1, else 0. Y in {0, 1}."""
+    x = ins["X"][0]
+    y = ins["Y"][0].astype(x.dtype)
+    a = x * (2.0 * y - 1.0)
+    loss = jnp.where(
+        a < -1.0, -4.0 * a,
+        jnp.where(a < 1.0, jnp.square(1.0 - a), jnp.zeros_like(a)),
+    )
+    return {"IntermediateVal": a, "Out": loss}
+
+
+@register_op("soft_relu")
+def _soft_relu(ctx, ins, attrs):
+    """out = log(1 + exp(clip(x, -t, t))) (reference activation_op.h
+    SoftReluFunctor). The clip is straight-through for the gradient:
+    the reference backward is dx = dout * (1 - exp(-out)) = sigmoid of
+    the CLIPPED input everywhere — a plain jnp.clip would instead kill
+    the gradient outside [-t, t]."""
+    x = ins["X"][0]
+    t = attrs.get("threshold", 40.0)
+    xc = x + lax.stop_gradient(jnp.clip(x, -t, t) - x)
+    return {"Out": jnp.log1p(jnp.exp(xc))}

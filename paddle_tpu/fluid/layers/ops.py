@@ -76,8 +76,7 @@ def _single_in(op_type, out_dtype=None):
 
 
 for _op in __activations__ + ["clip", "clip_by_norm", "sequence_softmax"]:
-    # soft_relu has no dedicated kernel; softplus is the same function
-    globals()[_op] = _single_in("softplus" if _op == "soft_relu" else _op)
+    globals()[_op] = _single_in(_op)
 
 
 def mean(x=None, **kwargs):

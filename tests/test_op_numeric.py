@@ -920,6 +920,243 @@ spec("lambda_rank",
          / ((2 ** 2 - 1) / np.log(2) + (2 ** 1 - 1) / np.log(3)))})
 
 
+
+
+# --- r4 op tail (VERDICT r3 "What's missing #4") ----------------------
+
+
+def _np_pool_with_index(x, ksize, strides, pads):
+    """Reference math/pooling.cc MaxPool{2,3}dWithIndexFunctor loop."""
+    nd = len(ksize)
+    spatial = x.shape[2:]
+    out_dims = [
+        (spatial[i] + 2 * pads[i] - ksize[i]) // strides[i] + 1
+        for i in range(nd)
+    ]
+    out = np.zeros(x.shape[:2] + tuple(out_dims), x.dtype)
+    mask = np.zeros_like(out, dtype=np.int32)
+    mults = np.cumprod((spatial[1:] + (1,))[::-1])[::-1]
+    for n in range(x.shape[0]):
+        for c in range(x.shape[1]):
+            for opos in np.ndindex(*out_dims):
+                best, besti = -np.inf, -1
+                ranges = []
+                for i in range(nd):
+                    st = opos[i] * strides[i] - pads[i]
+                    en = min(st + ksize[i], spatial[i])
+                    ranges.append(range(max(st, 0), en))
+                for ipos in np.ndindex(*[len(r) for r in ranges]):
+                    coord = tuple(ranges[i][ipos[i]] for i in range(nd))
+                    v = x[(n, c) + coord]
+                    if v > best:
+                        best = v
+                        besti = sum(
+                            coord[i] * int(mults[i]) for i in range(nd)
+                        )
+                out[(n, c) + opos] = best
+                mask[(n, c) + opos] = besti
+    return out, mask
+
+
+def _np_spp(x, height, ptype):
+    n, c, h, w = x.shape
+    parts = []
+    for p_lvl in range(height):
+        bins = 2 ** p_lvl
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        lvl = np.zeros((n, c, bins, bins), np.float64)
+        for i in range(n):
+            for ch in range(c):
+                for bh in range(bins):
+                    hs = max(bh * kh - ph, 0)
+                    he = min(bh * kh - ph + kh, h)
+                    for bw in range(bins):
+                        ws = max(bw * kw - pw, 0)
+                        we = min(bw * kw - pw + kw, w)
+                        win = x[i, ch, hs:he, ws:we]
+                        lvl[i, ch, bh, bw] = (
+                            win.max() if ptype == "max" else win.mean()
+                        )
+        parts.append(lvl.reshape(n, c * bins * bins))
+    return np.concatenate(parts, axis=1)
+
+
+def _np_conv3d_transpose(x, w, stride, pad):
+    N, Ci, D, H, W_ = x.shape
+    _, Co, KD, KH, KW = w.shape
+    od = (D - 1) * stride - 2 * pad + KD
+    oh = (H - 1) * stride - 2 * pad + KH
+    ow = (W_ - 1) * stride - 2 * pad + KW
+    out = np.zeros((N, Co, od, oh, ow), np.float64)
+    for n in range(N):
+        for ci in range(Ci):
+            for d in range(D):
+                for h in range(H):
+                    for wd in range(W_):
+                        v = x[n, ci, d, h, wd]
+                        for kd in range(KD):
+                            for kh in range(KH):
+                                for kw in range(KW):
+                                    zd = d * stride + kd - pad
+                                    zh = h * stride + kh - pad
+                                    zw = wd * stride + kw - pad
+                                    if (0 <= zd < od and 0 <= zh < oh
+                                            and 0 <= zw < ow):
+                                        out[n, :, zd, zh, zw] += (
+                                            v * w[ci, :, kd, kh, kw]
+                                        )
+    return out
+
+
+_pwi_x = R(160).randn(2, 2, 7, 7).astype(np.float32)
+spec("max_pool2d_with_index",
+     ins={"X": _pwi_x},
+     attrs={"ksize": [3, 3], "strides": [2, 2], "paddings": [1, 1]},
+     outs=["Out", "Mask"], loss=["Out"], grad=["X"], gsample=24,
+     oracle=lambda i, a: dict(zip(
+         ("Out", "Mask"),
+         _np_pool_with_index(i["X"], (3, 3), (2, 2), (1, 1)))))
+_pwi3_x = R(161).randn(1, 2, 5, 5, 5).astype(np.float32)
+spec("max_pool3d_with_index",
+     ins={"X": _pwi3_x},
+     attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+            "paddings": [0, 0, 0]},
+     outs=["Out", "Mask"], loss=["Out"], grad=["X"], gsample=24,
+     oracle=lambda i, a: dict(zip(
+         ("Out", "Mask"),
+         _np_pool_with_index(i["X"], (2, 2, 2), (2, 2, 2), (0, 0, 0)))))
+
+
+def _np_unpool_oracle(i, a):
+    x, idx = i["X"], i["Indices"].astype(np.int64)
+    n, c, h, w = x.shape
+    oh = (h - 1) * 2 - 0 + 2
+    ow = (w - 1) * 2 - 0 + 2
+    out = np.zeros((n, c, oh * ow), x.dtype)
+    for b in range(n):
+        for ch in range(c):
+            out[b, ch, idx[b, ch].reshape(-1)] = x[b, ch].reshape(-1)
+    return {"Out": out.reshape(n, c, oh, ow)}
+
+
+_unp_x, _unp_idx = _np_pool_with_index(
+    R(162).randn(2, 2, 8, 8).astype(np.float32), (2, 2), (2, 2), (0, 0)
+)
+spec("unpool",
+     ins={"X": _unp_x, "Indices": _unp_idx},
+     attrs={"unpooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0]},
+     grad=["X"], gsample=24, oracle=_np_unpool_oracle)
+
+spec("spp_max", op="spp",
+     ins={"X": R(163).randn(2, 3, 7, 7).astype(np.float32)},
+     attrs={"pyramid_height": 3, "pooling_type": "max"},
+     grad=True, gsample=24,
+     oracle=lambda i, a: {"Out": _np_spp(i["X"], 3, "max")})
+spec("spp_avg", op="spp",
+     ins={"X": R(164).randn(2, 3, 6, 6).astype(np.float32)},
+     attrs={"pyramid_height": 2, "pooling_type": "avg"},
+     grad=True, gsample=24,
+     oracle=lambda i, a: {"Out": _np_spp(i["X"], 2, "avg")})
+
+spec("conv3d_transpose",
+     ins={"Input": R(165).randn(1, 2, 3, 3, 3).astype(np.float32),
+          "Filter": R(166).randn(2, 3, 2, 2, 2).astype(np.float32)},
+     attrs={"strides": [2, 2, 2], "paddings": [1, 1, 1],
+            "dilations": [1, 1, 1]},
+     outs=["Output"], grad=["Input", "Filter"], loss=["Output"],
+     gsample=24, tol=(1e-3, 1e-4),
+     oracle=lambda i, a: {"Output": _np_conv3d_transpose(
+         i["Input"], i["Filter"], 2, 1)})
+
+_norm_x = R(167).randn(2, 3, 4, 4).astype(np.float32)
+_norm_s = R(168).uniform(0.5, 1.5, (3,)).astype(np.float32)
+spec("norm",
+     ins={"X": _norm_x, "Scale": _norm_s},
+     attrs={"epsilon": 1e-10},
+     grad=["X", "Scale"], gsample=24,
+     oracle=lambda i, a: {"Out": (
+         i["X"] / np.sqrt(1e-10 + (i["X"] ** 2).sum(1, keepdims=True))
+         * i["Scale"].reshape(1, -1, 1, 1))})
+
+spec("bilinear_tensor_product",
+     ins={"X": R(169).randn(3, 4).astype(np.float32),
+          "Y": R(170).randn(3, 5).astype(np.float32),
+          "Weight": R(171).randn(6, 4, 5).astype(np.float32) * 0.3,
+          "Bias": R(172).randn(1, 6).astype(np.float32)},
+     grad=["X", "Y", "Weight", "Bias"],
+     oracle=lambda i, a: {"Out": np.einsum(
+         "bm,kmn,bn->bk", i["X"], i["Weight"], i["Y"]) + i["Bias"]})
+
+spec("l1_norm", ins={"X": _x34 - 1.0}, grad=True,
+     oracle=lambda i, a: {"Out": np.abs(i["X"]).sum().reshape(1)})
+
+_ls_lbl = _softmax(R(173).randn(4, 5).astype(np.float32))
+spec("label_smooth",
+     ins={"X": _ls_lbl}, attrs={"epsilon": 0.1}, grad=True,
+     oracle=lambda i, a: {"Out": 0.9 * i["X"] + 0.1 / 5})
+spec("label_smooth_prior", op="label_smooth",
+     ins={"X": _ls_lbl,
+          "PriorDist": _softmax(R(174).randn(1, 5).astype(np.float32))},
+     attrs={"epsilon": 0.2}, grad=["X"],
+     oracle=lambda i, a: {"Out": 0.8 * i["X"] + 0.2 * i["PriorDist"]})
+
+
+def _np_modified_huber(i, a):
+    x = i["X"].astype(np.float64)
+    inter = x * (2.0 * i["Y"] - 1.0)
+    loss = np.where(
+        inter < -1, -4.0 * inter,
+        np.where(inter < 1, (1 - inter) ** 2, 0.0))
+    return {"IntermediateVal": inter, "Out": loss}
+
+
+spec("modified_huber_loss",
+     ins={"X": R(175).uniform(-2.5, 2.5, (8, 1)).astype(np.float32),
+          "Y": R(176).randint(0, 2, (8, 1)).astype(np.float32)},
+     outs=["IntermediateVal", "Out"], loss=["Out"], grad=["X"],
+     oracle=_np_modified_huber)
+
+spec("soft_relu",
+     ins={"X": _x34 - 1.2}, attrs={"threshold": 40.0}, grad=True,
+     oracle=lambda i, a: {"Out": np.log1p(np.exp(
+         np.clip(i["X"], -40.0, 40.0)))})
+
+
+def _np_prox(prox, lr, l1, l2):
+    if l1 > 0:
+        return np.sign(prox) * (
+            np.maximum(np.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2))
+    return prox / (1.0 + lr * l2)
+
+
+spec("proximal_gd",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr},
+     attrs={"l1": 0.05, "l2": 0.01}, outs=["ParamOut"],
+     oracle=lambda i, a: {"ParamOut": _np_prox(
+         i["Param"] - 0.1 * i["Grad"], 0.1, 0.05, 0.01)})
+spec("proximal_gd_l2only", op="proximal_gd",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr},
+     attrs={"l1": 0.0, "l2": 0.02}, outs=["ParamOut"],
+     oracle=lambda i, a: {"ParamOut": _np_prox(
+         i["Param"] - 0.1 * i["Grad"], 0.1, 0.0, 0.02)})
+spec("proximal_adagrad",
+     ins={"Param": _p, "Grad": _g, "LearningRate": _lr,
+          "Moment": np.abs(R(177).randn(4, 3)).astype(np.float32) + 0.1},
+     attrs={"l1": 0.05, "l2": 0.01}, outs=["ParamOut", "MomentOut"],
+     oracle=lambda i, a: {
+         "MomentOut": i["Moment"] + i["Grad"] ** 2,
+         "ParamOut": _np_prox(
+             i["Param"] - 0.1 * i["Grad"] / np.sqrt(
+                 i["Moment"] + i["Grad"] ** 2),
+             0.1, 0.05, 0.01)})
+
+spec("is_empty",
+     ins={"X": R(178).randn(3, 2).astype(np.float32)},
+     oracle=lambda i, a: {"Out": np.array([False])})
+
+
 EXEMPT = {
     "print": "identity pass-through debug tap (jax.debug.callback side "
              "effect); forward/backward/first_n semantics covered in "
@@ -1070,3 +1307,26 @@ def test_random_op(name):
     h = OpHarness(name, inputs={}, attrs=kw["attrs"], outputs=["Out"])
     (out,) = h.run([h.output_names["Out"][0]])
     assert kw["check"](np.asarray(out)), "%s statistical check failed" % name
+
+
+def test_soft_relu_saturated_gradient_matches_reference_backward():
+    """Beyond |threshold| the reference SoftReluGradFunctor still returns
+    dx = dout * (1 - exp(-out)) (activation_op.h:540) — the clip is
+    straight-through in backward. A naive clip would zero it."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.core.registry import get_kernel
+
+    kern = get_kernel("soft_relu")
+    t = 1.5
+
+    def f(x):
+        return kern(None, {"X": [x]}, {"threshold": t})["Out"].sum()
+
+    x = jnp.array([-3.0, -0.5, 0.7, 4.0])
+    g = jax.grad(f)(x)
+    out = np.log1p(np.exp(np.clip(np.array(x), -t, t)))
+    expect = 1.0 - np.exp(-out)
+    np.testing.assert_allclose(np.array(g), expect, rtol=1e-5)
+    assert g[0] > 0 and g[3] > 0.5  # saturated entries keep gradient
