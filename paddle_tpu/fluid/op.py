@@ -1,0 +1,59 @@
+"""Raw operator factory (reference python/paddle/v2/fluid/op.py).
+
+The reference builds OpDesc protobufs from the C++ OpInfoMap
+(get_all_op_protos, OperatorFactory, op.py:19,167); its unit tests use
+`Operator("sgd", Param=..., ...)` to make one op outside any layer
+helper. Here the registry is the kernel table (core/registry.py), and an
+Operator appends to a Block — same raw-construction surface over the
+traced executor.
+"""
+
+from __future__ import annotations
+
+from .core.registry import has_kernel, registered_ops
+
+__all__ = ["Operator", "get_all_op_protos"]
+
+
+def get_all_op_protos():
+    """Names of every registered op type (the reference returns OpProto
+    messages; the kernel registry is the single source of truth here)."""
+    return list(registered_ops())
+
+
+class Operator(object):
+    """Build one raw op: `Operator("scale", X=["x"], Out=["y"], scale=2.0)`.
+    Slot arguments (capitalised, list-or-str of var names) become
+    inputs/outputs according to the target block's variables; remaining
+    kwargs are attributes. Call `append_to(block)` to attach."""
+
+    def __init__(self, type, **kwargs):
+        if not has_kernel(type):
+            raise ValueError(
+                "no kernel registered for op type %r (have %d)"
+                % (type, len(registered_ops()))
+            )
+        self.type = type
+        self.slots = {}
+        self.attrs = {}
+        for k, v in kwargs.items():
+            if k[:1].isupper():
+                self.slots[k] = [v] if isinstance(v, str) else list(v)
+            else:
+                self.attrs[k] = v
+
+    def append_to(self, block):
+        ins, outs = {}, {}
+        for slot, names in self.slots.items():
+            # a name already defined in the block is an input; fresh
+            # names are outputs (created on demand)
+            if all(n in block.vars for n in names):
+                ins[slot] = names
+            else:
+                for n in names:
+                    if n not in block.vars:
+                        block.create_var(name=n)
+                outs[slot] = names
+        return block.append_op(
+            type=self.type, inputs=ins, outputs=outs, attrs=self.attrs
+        )

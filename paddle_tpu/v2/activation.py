@@ -4,6 +4,7 @@ helpers.activations). Each instance names the fluid activation to apply."""
 __all__ = [
     "Tanh", "Sigmoid", "Softmax", "Identity", "Linear", "Relu", "BRelu",
     "SoftRelu", "STanh", "Abs", "Square", "Exp", "Log", "SquareRootN",
+    "Reciprocal",
 ]
 
 
@@ -26,10 +27,11 @@ Identity = _make("Identity", None)
 Linear = Identity
 Relu = _make("Relu", "relu")
 BRelu = _make("BRelu", "brelu")
-SoftRelu = _make("SoftRelu", "softplus")
+SoftRelu = _make("SoftRelu", "soft_relu")
 STanh = _make("STanh", "stanh")
 Abs = _make("Abs", "abs")
 Square = _make("Square", "square")
 Exp = _make("Exp", "exp")
 Log = _make("Log", "log")
+Reciprocal = _make("Reciprocal", "reciprocal")
 SquareRootN = _make("SquareRootN", "sqrt")

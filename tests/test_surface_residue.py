@@ -1,0 +1,151 @@
+"""Round-4 surface-residue sweep (VERDICT r3 "What's missing #5"):
+fluid ListenAndServ/Send/BlockGuardServ shims (reference
+python/paddle/v2/fluid/layers/io.py), layers/device.py, fluid/op.py
+(raw Operator factory), v2/config_base.py, v2/op.py — import parity plus
+behavioural checks where the shim computes something.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def test_module_parity_v2_and_fluid():
+    """Every reference module name under python/paddle/v2/*.py and
+    python/paddle/v2/fluid/*.py has a same-named module here."""
+    import importlib
+    import os
+
+    ref_v2 = "/root/reference/python/paddle/v2"
+    for sub, pkg in ((".", "paddle_tpu.v2"), ("fluid", "paddle_tpu.fluid")):
+        d = os.path.join(ref_v2, sub)
+        for f in sorted(os.listdir(d)):
+            if not f.endswith(".py") or f == "__init__.py":
+                continue
+            mod = f[:-3]
+            importlib.import_module("%s.%s" % (pkg, mod))
+    # layers submodules too
+    d = os.path.join(ref_v2, "fluid", "layers")
+    for f in sorted(os.listdir(d)):
+        if f.endswith(".py") and f not in (
+            "__init__.py", "layer_function_generator.py",
+        ):
+            importlib.import_module("paddle_tpu.fluid.layers." + f[:-3])
+
+
+def test_listen_and_serv_send_inline():
+    """The in-process ListenAndServ/Send pairing (the reference's own
+    send_recv_op_test layout): the optimize block recorded under do()
+    executes with the program, so the 'served' param really updates."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None,
+                               param_attr=fluid.ParamAttr(name="w_serv"))
+        cost = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y)
+        )
+        params_grads = fluid.backward.append_backward(cost)
+
+        serv = fluid.layers.ListenAndServ("127.0.0.1:0", fan_in=1)
+        with serv.do():
+            block = fluid.default_main_program().current_block()
+            lr = block.create_var(name="lr_const", shape=[1],
+                                  dtype="float32", persistable=True)
+            block.append_op(
+                type="fill_constant", inputs={}, outputs={"Out": [lr]},
+                attrs={"shape": [1], "value": 0.1, "dtype": "float32"},
+            )
+            for p, g in params_grads:
+                block.append_op(
+                    type="sgd",
+                    inputs={"Param": [p], "Grad": [g],
+                            "LearningRate": [lr]},
+                    outputs={"ParamOut": [p]},
+                )
+        got = fluid.layers.Send(
+            "127.0.0.1:0", [p for p, _ in params_grads],
+            [p for p, _ in params_grads],
+        )
+        assert got == [p for p, _ in params_grads]
+        # params/grads are captured before the block is spliced inline
+        sp, sg = serv.get_params_and_grads()
+        assert sp == [p.name for p, _ in params_grads]
+        assert sg == [g.name for _, g in params_grads]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w0 = np.asarray(fluid.global_scope().find_var("w_serv").get_tensor()).copy()
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        exe.run(main, feed={
+            "x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32),
+        }, fetch_list=[cost])
+    w1 = np.asarray(fluid.global_scope().find_var("w_serv").get_tensor())
+    assert np.abs(w1 - w0).max() > 1e-6  # the served sgd really ran
+
+
+def test_send_unknown_endpoint_raises():
+    import pytest
+
+    from paddle_tpu.fluid.layers.io import _SERV_REGISTRY
+
+    if not _SERV_REGISTRY:
+        _SERV_REGISTRY["127.0.0.1:1"] = object()
+    with pytest.raises(ValueError, match="unregistered endpoint"):
+        fluid.layers.Send("10.0.0.9:9999", [], [])
+
+
+def test_raw_operator_factory():
+    from paddle_tpu.fluid.op import Operator, get_all_op_protos
+
+    assert "sgd" in get_all_op_protos()
+    main = fluid.Program()
+    block = main.global_block()
+    block.create_parameter(name="op_x", shape=[3], dtype="float32")
+    op = Operator("scale", X=["op_x"], Out=["op_y"], scale=2.0)
+    op.append_to(block)
+    sc = fluid.executor.Scope()
+    sc.set("op_x", np.array([1.0, 2.0, 3.0], np.float32))
+    with fluid.executor.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (out,) = exe.run(main, feed={"__d__": np.zeros(1, np.float32)},
+                         fetch_list=["op_y"])
+    np.testing.assert_allclose(np.asarray(out), [2.0, 4.0, 6.0])
+
+
+def test_v2_op_module_math():
+    """paddle.v2.op surface: unary ops + arithmetic on layers build mixed
+    / slope_intercept graphs that train through the v2 path."""
+    from paddle_tpu import v2 as paddle
+    from paddle_tpu.v2 import op as v2_op
+
+    x = paddle.layer.data(
+        name="vx", type=paddle.data_type.dense_vector(4)
+    )
+    h = paddle.layer.fc(input=x, size=3,
+                        act=paddle.activation.Identity())
+    e = v2_op.exp(h)
+    s = h + e
+    t = 2.0 * h
+    n = -h
+    for node in (e, s, t, n):
+        assert node.kind in ("mixed", "slope_intercept"), node.kind
+
+
+def test_v2_config_base_layer_map():
+    from paddle_tpu import v2 as paddle
+    from paddle_tpu.v2 import config_base
+
+    assert config_base.Layer is paddle.layer.Layer
+
+    def make(name):
+        return paddle.layer.data(
+            name=name, type=paddle.data_type.dense_vector(2)
+        )
+
+    wrapped = config_base.__convert_to_v2__(make, "make", __name__)
+    out = wrapped("cb_x")
+    assert config_base.__layer_map__["cb_x"] is out
