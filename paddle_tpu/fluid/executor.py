@@ -135,6 +135,8 @@ class Executor(object):
         self.mesh = mesh
         self._cache: Dict[Any, Any] = {}
         self._run_counter = 0
+        self._last_exec = None  # (jitted entry, arg avals) of last run
+        self._capture_avals = False  # set by profiler.compiled_profile
 
     def _resolve_mesh(self):
         if self.mesh is not None:
@@ -450,6 +452,20 @@ class Executor(object):
         rng = jax.random.fold_in(
             jax.random.PRNGKey(program.random_seed), self._run_counter
         )
+        # aval snapshot BEFORE the call (args are donated): lets the
+        # compiled-step profiler re-lower this exact signature to read
+        # the scheduled HLO. Gated — the tree_map over every param is
+        # wasted work on ordinary training steps.
+        if self._capture_avals:
+            self._last_exec = (
+                entry,
+                jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        getattr(a, "shape", ()), getattr(a, "dtype", None)
+                    ),
+                    (persist_in, feed_arrays, rng),
+                ),
+            )
         fetches, new_persist = entry(persist_in, feed_arrays, rng)
         _flush_print_effects(program)
         return _finish_run(

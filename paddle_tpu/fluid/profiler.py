@@ -187,3 +187,157 @@ def device_memory_stats(device=None):
 
 
 __all__.append("device_memory_stats")
+
+
+# ---------------------------------------------------------------------
+# compiled-step per-op profiling (r4): the interpret-mode table above
+# times ops EAGERLY; this path reads the truth of the FUSED program —
+# every scheduled HLO instruction of the compiled step is attributed
+# back to the fluid op that produced it via the `op:<type>` named-scope
+# tags lowering stamps into HLO metadata (core/lowering.py run_op), and
+# the measured compiled-step wall time is distributed over ops by each
+# instruction's memory traffic (operand + output bytes — the HBM-roof
+# proxy appropriate on TPU). Backward instructions (op_name carries
+# XLA's transpose(...) wrapper) land on "<op>_grad" rows, mirroring the
+# reference's per-grad-op rows (platform/profiler.cc:198 ParseEvents).
+# ---------------------------------------------------------------------
+
+import re as _re
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+_SHAPE_RE = _re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
+_INST_RE = _re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+_OPNAME_RE = _re.compile(r'op_name="([^"]*)"')
+_TAG_RE = _re.compile(r"op:([\w.]+)")
+
+
+def _shape_bytes(type_str):
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+def parse_hlo_op_costs(hlo_text):
+    """{op_row: {'instructions': n, 'bytes': b}} from scheduled HLO text.
+    Only the ENTRY computation's instructions count (fusions are single
+    scheduled instructions; their internals are not separately
+    scheduled). Instructions with no op tag pool under '[xla]'."""
+    entry_lines = []
+    in_entry = False
+    depth = 0
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            depth = line.count("{") - line.count("}")
+            continue
+        if in_entry:
+            depth += line.count("{") - line.count("}")
+            if depth <= 0:
+                # the entry computation's closing brace: stop so any
+                # computation printed AFTER the entry never leaks rows
+                break
+            entry_lines.append(line)
+
+    # symbol table: instruction name -> result type string
+    types = {}
+    for line in entry_lines:
+        m = _INST_RE.match(line)
+        if m:
+            types[m.group(1)] = m.group(2).split(" ")[0]
+
+    rows = {}
+    for line in entry_lines:
+        m = _INST_RE.match(line)
+        if not m:
+            continue
+        name, rest = m.groups()
+        opcode = rest.split(" ", 1)[1].split("(")[0].strip() if " " in rest else ""
+        if opcode in ("parameter", "constant", "tuple", "get-tuple-element"):
+            continue
+        tag = "[xla]"
+        onm = _OPNAME_RE.search(line)
+        if onm:
+            t = _TAG_RE.search(onm.group(1))
+            if t:
+                tag = t.group(1)
+                if "transpose(" in onm.group(1):
+                    tag += "_grad"  # cotangent-pass instruction
+        byts = _shape_bytes(types.get(name, ""))
+        for ref in _re.findall(r"%([\w.\-]+)", rest):
+            if ref in types and ref != name:
+                byts += _shape_bytes(types[ref])
+        row = rows.setdefault(tag, {"instructions": 0, "bytes": 0})
+        row["instructions"] += 1
+        row["bytes"] += byts
+    return rows
+
+
+def compiled_profile(exe, program, feed, fetch_list, runs=3,
+                     sorted_key="total"):
+    """Per-op cost table of the COMPILED training step.
+
+    Runs the program once to compile (and prime the executor cache),
+    re-lowers the cached signature to read the scheduled HLO, times
+    `runs` steps wall-clock, and splits the measured per-step time over
+    op rows by attributed memory traffic. Returns (table, meta) where
+    table rows follow OpCostCollector.table() ({'Event', 'Calls',
+    'Total', ...} — Total in ms) and meta carries the raw bytes and the
+    XLA cost-analysis flops for the step."""
+    import numpy as _np
+
+    exe._capture_avals = True
+    try:
+        exe.run(program, feed=feed, fetch_list=fetch_list)
+        entry, avals = exe._last_exec
+    finally:
+        exe._capture_avals = False
+    lowered = entry.lower(*avals)
+    compiled = lowered.compile()
+    rows = parse_hlo_op_costs(compiled.as_text())
+
+    t0 = time.time()
+    for _ in range(runs):
+        out = exe.run(program, feed=feed, fetch_list=fetch_list)
+    _np.asarray(out[0])  # sync
+    step_s = (time.time() - t0) / runs
+
+    total_bytes = sum(r["bytes"] for r in rows.values()) or 1
+    table = [
+        {
+            "Event": tag,
+            "Calls": r["instructions"],
+            "Total": step_s * 1e3 * r["bytes"] / total_bytes,
+            "Min": 0.0,
+            "Max": 0.0,
+            "Ave": step_s * 1e3 * r["bytes"] / total_bytes
+            / max(r["instructions"], 1),
+            "Bytes": r["bytes"],
+        }
+        for tag, r in rows.items()
+    ]
+    key = {"calls": "Calls", "total": "Total", "ave": "Ave"}.get(
+        sorted_key, "Total"
+    )
+    table.sort(key=lambda r: r[key], reverse=True)
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    meta = {
+        "step_seconds": step_s,
+        "flops": float((ca or {}).get("flops", 0.0)),
+        "bytes_attributed": total_bytes,
+    }
+    _print_table(table, step_s * runs)
+    return table, meta
+
+
+__all__ += ["compiled_profile", "parse_hlo_op_costs"]
