@@ -144,6 +144,13 @@ def infer_lib():
         L.ptpu_infer_out_shape.argtypes = [ctypes.c_void_p, ctypes.c_int]
         L.ptpu_infer_out_data.restype = ctypes.POINTER(ctypes.c_float)
         L.ptpu_infer_out_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.ptpu_infer_out_lod_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.ptpu_infer_out_lod.restype = ctypes.POINTER(ctypes.c_int64)
+        L.ptpu_infer_out_lod.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.ptpu_infer_set_input_lod.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
         L.ptpu_infer_destroy.argtypes = [ctypes.c_void_p]
         _infer_lib = L
         return _infer_lib
@@ -178,7 +185,10 @@ class InferenceRunner(object):
             for i in range(L.ptpu_infer_num_fetch(h))
         ]
 
-    def run(self, feeds: dict):
+    def run(self, feeds: dict, lods: dict = None, return_lod: bool = False):
+        """feeds: name -> array. lods: name -> offsets (ragged inputs).
+        With return_lod, returns (outs, lods_out) where lods_out[k] is
+        the k-th fetch's sequence offsets ([] when dense)."""
         np = self._np
         L, h = self._L, self._h
         for name, arr in feeds.items():
@@ -194,12 +204,17 @@ class InferenceRunner(object):
                 h, name.encode(),
                 arr.ctypes.data_as(ctypes.c_void_p), code, shape, arr.ndim,
             )
+        for name, off in (lods or {}).items():
+            off = np.ascontiguousarray(off, np.int64)
+            buf = (ctypes.c_int64 * len(off))(*off.tolist())
+            L.ptpu_infer_set_input_lod(h, name.encode(), buf, len(off))
         if L.ptpu_infer_forward(h) != 0:
             raise RuntimeError(
                 "native forward failed: %s"
                 % L.ptpu_infer_error(h).decode()
             )
         outs = []
+        lods_out = []
         for i in range(L.ptpu_infer_num_fetch(h)):
             rank = L.ptpu_infer_out_rank(h, i)
             shape = [L.ptpu_infer_out_shape(h, i)[k] for k in range(rank)]
@@ -208,7 +223,11 @@ class InferenceRunner(object):
                 L.ptpu_infer_out_data(h, i), shape=(n,)
             ).copy()
             outs.append(data.reshape(shape))
-        return outs
+            ll = L.ptpu_infer_out_lod_len(h, i)
+            lods_out.append(
+                [L.ptpu_infer_out_lod(h, i)[k] for k in range(ll)]
+            )
+        return (outs, lods_out) if return_lod else outs
 
     def close(self):
         if self._h:

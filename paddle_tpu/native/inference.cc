@@ -167,6 +167,9 @@ struct Tensor {
   std::vector<int64_t> shape;
   std::vector<float> f;
   std::vector<int64_t> i;  // non-empty when the tensor is integral
+  // ragged metadata: sequence start offsets over rows (reference
+  // LoDTensor level 0; the Python side's "<name>@LOD0" side-band)
+  std::vector<int64_t> lod;
   bool is_int = false;
 
   int64_t numel() const {
@@ -338,6 +341,10 @@ struct Model {
   std::map<std::string, Tensor> vars;  // persistables + runtime values
   std::vector<std::string> feed_names, fetch_names;
   std::map<std::string, bool> var_is_int;
+  // names whose lod was set by the caller (ptpu_infer_set_input_lod):
+  // every OTHER var's lod is derived and cleared at each forward so a
+  // second run with different offsets cannot read run-1's stale LoD
+  std::map<std::string, bool> fed_lod;
   std::string error;
 };
 
@@ -739,9 +746,12 @@ static bool run_op(Model& m, const OpDesc& op) {
     int axis = (int)op.attr_num("axis", 0);
     const Tensor& first = m.vars[it->second[0]];
     if (axis < 0) axis += (int)first.shape.size();
-    int64_t outer = 1, cat = 0;
+    int64_t outer = 1, cat = 0, inner = 1;
     for (int k = 0; k < axis; ++k) outer *= first.shape[k];
-    int64_t inner = first.numel() / std::max<int64_t>(outer * first.shape[axis], 1);
+    // explicit trailing product: numel()-based division breaks when the
+    // first operand has 0 rows (an empty KV cache on decode step 0)
+    for (size_t k = axis + 1; k < first.shape.size(); ++k)
+      inner *= first.shape[k];
     for (auto& nm : it->second) cat += m.vars[nm].shape[axis];
     o->shape = first.shape;
     o->shape[axis] = cat;
@@ -756,6 +766,205 @@ static bool run_op(Model& m, const OpDesc& op) {
           for (int64_t c = 0; c < inner; ++c)
             o->f[(a * cat + off + b) * inner + c] = x.at((a * xc + b) * inner + c);
       off += xc;
+    }
+    return true;
+  }
+  if (t == "im2sequence") {
+    // reference operators/im2sequence_op.cc: sliding blocks -> rows in
+    // (c, kh, kw) order, one sequence of oh*ow steps per image (matches
+    // kernels_tensor.py _im2sequence / conv_general_dilated_patches)
+    Tensor& x = m.vars[op.in("X")];
+    Tensor* o = named(m, op.out("Out"));
+    auto ks = op.attr_ints("kernels");
+    auto st = op.attr_ints("strides");
+    auto pd = op.attr_ints("paddings");
+    int64_t kh = ks.empty() ? 1 : ks[0], kw = ks.size() > 1 ? ks[1] : kh;
+    int64_t sh = st.empty() ? 1 : st[0], sw = st.size() > 1 ? st[1] : sh;
+    int64_t pu = pd.size() > 0 ? pd[0] : 0, pl = pd.size() > 1 ? pd[1] : 0;
+    int64_t pb = pd.size() > 2 ? pd[2] : pu, pr = pd.size() > 3 ? pd[3] : pl;
+    int64_t N = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+    int64_t PH = H + pu + pb, PW = W + pl + pr;
+    int64_t OH = (PH - kh) / sh + 1, OW = (PW - kw) / sw + 1;
+    int64_t D = C * kh * kw;
+    o->shape = {N * OH * OW, D};
+    o->is_int = false;
+    o->f.assign(N * OH * OW * D, 0.f);
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t oh = 0; oh < OH; ++oh)
+        for (int64_t ow = 0; ow < OW; ++ow) {
+          float* row = &o->f[((n * OH + oh) * OW + ow) * D];
+          for (int64_t c = 0; c < C; ++c)
+            for (int64_t a = 0; a < kh; ++a) {
+              int64_t ih = oh * sh + a - pu;
+              if (ih < 0 || ih >= H) continue;
+              for (int64_t b2 = 0; b2 < kw; ++b2) {
+                int64_t iw = ow * sw + b2 - pl;
+                if (iw < 0 || iw >= W) continue;
+                row[(c * kh + a) * kw + b2] =
+                    x.f[((n * C + c) * H + ih) * W + iw];
+              }
+            }
+        }
+    o->lod.clear();
+    for (int64_t n = 0; n <= N; ++n) o->lod.push_back(n * OH * OW);
+    return true;
+  }
+  if (t == "gru") {
+    // full-sequence GRU over a packed ragged batch (reference gru_op;
+    // same math as kernels_rnn.py _gru: w[:, :H]=update, [H:2H]=reset,
+    // [2H:]=candidate; x already holds the 3H input projection)
+    Tensor& x = m.vars[op.in("Input")];
+    Tensor& w = m.vars[op.in("Weight")];
+    Tensor* bias = op.in("Bias").empty() ? nullptr : &m.vars[op.in("Bias")];
+    Tensor* o = named(m, op.out("Hidden"));
+    if (x.lod.empty()) {
+      m.error = "gru input has no sequence offsets (lod)";
+      return false;
+    }
+    bool reverse = op.attr_bool("is_reverse", false);
+    int64_t Hd = w.shape[0];
+    int64_t total = x.shape[0];
+    o->shape = {total, Hd};
+    o->is_int = false;
+    o->f.assign(total * Hd, 0.f);
+    o->lod = x.lod;
+    std::vector<float> h(Hd), hn(Hd), g(3 * Hd);
+    for (size_t s = 0; s + 1 < x.lod.size(); ++s) {
+      int64_t b0 = x.lod[s], b1 = x.lod[s + 1];
+      std::fill(h.begin(), h.end(), 0.f);
+      for (int64_t q = 0; q < b1 - b0; ++q) {
+        int64_t row = reverse ? (b1 - 1 - q) : (b0 + q);
+        const float* xr = &x.f[row * 3 * Hd];
+        for (int64_t k = 0; k < 3 * Hd; ++k)
+          g[k] = xr[k] + (bias ? bias->f[k] : 0.f);
+        // g += h @ w for the update|reset halves
+        for (int64_t r = 0; r < Hd; ++r) {
+          float hv = h[r];
+          if (hv == 0.f) continue;
+          const float* wr = &w.f[r * 3 * Hd];
+          for (int64_t c = 0; c < 2 * Hd; ++c) g[c] += hv * wr[c];
+        }
+        for (int64_t k = 0; k < 2 * Hd; ++k)
+          g[k] = 1.f / (1.f + std::exp(-g[k]));
+        // candidate: xc + (r*h) @ w_c
+        for (int64_t r = 0; r < Hd; ++r) {
+          float rh = g[Hd + r] * h[r];
+          if (rh == 0.f) continue;
+          const float* wr = &w.f[r * 3 * Hd];
+          for (int64_t c = 0; c < Hd; ++c) g[2 * Hd + c] += rh * wr[2 * Hd + c];
+        }
+        for (int64_t k = 0; k < Hd; ++k) {
+          float u = g[k], c = std::tanh(g[2 * Hd + k]);
+          hn[k] = (1.f - u) * h[k] + u * c;
+        }
+        h = hn;
+        memcpy(&o->f[row * Hd], h.data(), Hd * sizeof(float));
+      }
+    }
+    return true;
+  }
+  if (t == "ctc_align") {
+    // CTC greedy decode (reference ctc_align_op.cc): per-step argmax,
+    // collapse repeats, drop blanks. Output: packed kept tokens with
+    // per-sequence lod (exact ragged — no padding needed host-side).
+    Tensor& x = m.vars[op.in("Input")];
+    Tensor* o = named(m, op.out("Output"));
+    if (x.lod.empty()) {
+      m.error = "ctc_align input has no sequence offsets (lod)";
+      return false;
+    }
+    int64_t blank = (int64_t)op.attr_num("blank", 0);
+    int64_t C = x.shape.size() > 1 ? x.shape.back() : 1;
+    o->is_int = true;
+    o->i.clear();
+    o->lod.assign(1, 0);
+    for (size_t s = 0; s + 1 < x.lod.size(); ++s) {
+      int64_t prev = -1;
+      for (int64_t r = x.lod[s]; r < x.lod[s + 1]; ++r) {
+        int64_t tok = 0;
+        if (C > 1) {
+          const float* px = &x.f[r * C];
+          for (int64_t c = 1; c < C; ++c)
+            if (px[c] > px[tok]) tok = c;
+        } else {
+          tok = x.is_int ? x.i[r] : (int64_t)x.f[r];
+        }
+        if (tok != blank && tok != prev) o->i.push_back(tok);
+        prev = tok;
+      }
+      o->lod.push_back((int64_t)o->i.size());
+    }
+    o->shape = {(int64_t)o->i.size(), 1};
+    return true;
+  }
+  if (t == "matmul") {
+    // 2-D (optionally transposed) matmul — the attention building block
+    // (reference matmul_op.cc; batched ranks collapse to 2-D here
+    // because the serving decoder runs one sequence at a time)
+    Tensor& x = m.vars[op.in("X")];
+    Tensor& y = m.vars[op.in("Y")];
+    Tensor* o = named(m, op.out("Out"));
+    bool tx = op.attr_bool("transpose_X", false) ||
+              op.attr_bool("transpose_x", false);
+    bool ty = op.attr_bool("transpose_Y", false) ||
+              op.attr_bool("transpose_y", false);
+    if (x.shape.size() != 2 || y.shape.size() != 2) {
+      m.error = "native matmul supports rank-2 operands";
+      return false;
+    }
+    int64_t xr = x.shape[0], xc = x.shape[1];
+    int64_t yr = y.shape[0], yc = y.shape[1];
+    int64_t Mr = tx ? xc : xr, K = tx ? xr : xc;
+    int64_t K2 = ty ? yc : yr, Nc = ty ? yr : yc;
+    if (K != K2) {
+      m.error = "matmul inner-dim mismatch";
+      return false;
+    }
+    o->shape = {Mr, Nc};
+    o->is_int = false;
+    o->f.assign(Mr * Nc, 0.f);
+    for (int64_t r = 0; r < Mr; ++r)
+      for (int64_t k = 0; k < K; ++k) {
+        float xv = tx ? x.at(k * xc + r) : x.at(r * xc + k);
+        if (xv == 0.f) continue;
+        for (int64_t c = 0; c < Nc; ++c) {
+          float yv = ty ? y.at(c * yc + k) : y.at(k * yc + c);
+          o->f[r * Nc + c] += xv * yv;
+        }
+      }
+    return true;
+  }
+  if (t == "layer_norm") {
+    // normalise over trailing dims from begin_norm_axis (reference
+    // layer_norm_op.cc), with optional per-feature scale/bias
+    Tensor& x = m.vars[op.in("X")];
+    Tensor* scale = op.in("Scale").empty() ? nullptr : &m.vars[op.in("Scale")];
+    Tensor* bias = op.in("Bias").empty() ? nullptr : &m.vars[op.in("Bias")];
+    Tensor* o = named(m, op.out("Y"));
+    float eps = (float)op.attr_num("epsilon", 1e-5);
+    int bna = (int)op.attr_num("begin_norm_axis", 1);
+    int64_t R = 1, C = 1;
+    for (size_t k = 0; k < x.shape.size(); ++k)
+      ((int)k < bna ? R : C) *= x.shape[k];
+    o->shape = x.shape;
+    o->is_int = false;
+    o->f.resize(x.numel());
+    for (int64_t r = 0; r < R; ++r) {
+      const float* px = &x.f[r * C];
+      float* po = &o->f[r * C];
+      double mu = 0;
+      for (int64_t c = 0; c < C; ++c) mu += px[c];
+      mu /= C;
+      double var = 0;
+      for (int64_t c = 0; c < C; ++c) var += (px[c] - mu) * (px[c] - mu);
+      var /= C;
+      float inv = 1.f / std::sqrt((float)var + eps);
+      for (int64_t c = 0; c < C; ++c) {
+        float v = (px[c] - (float)mu) * inv;
+        if (scale) v *= scale->f[c];
+        if (bias) v += bias->f[c];
+        po[c] = v;
+      }
     }
     return true;
   }
@@ -899,14 +1108,40 @@ int ptpu_infer_set_input(void* h, const char* name, const void* data,
                static_cast<const float*>(data) + n);
   }
   m.vars[name] = std::move(t);
+  m.fed_lod.erase(name);  // fresh tensor: any lod must be re-set
   return 0;
 }
 
 int ptpu_infer_forward(void* h) {
   Model& m = *static_cast<Model*>(h);
   m.error.clear();
-  for (auto& op : m.ops)
+  for (auto& kv : m.vars)
+    if (!m.fed_lod.count(kv.first)) kv.second.lod.clear();
+  for (auto& op : m.ops) {
     if (!run_op(m, op)) return -1;
+    // default LoD propagation (reference ShareLoD; Python _share_lod):
+    // row-wise ops keep their input's raggedness. Guard: only when the
+    // output's row count matches the ragged input's (reductions and
+    // reshapes drop out naturally).
+    const Tensor* src = nullptr;
+    for (auto& kv : op.inputs)
+      for (auto& nm : kv.second) {
+        auto it = m.vars.find(nm);
+        if (it != m.vars.end() && !it->second.lod.empty()) {
+          src = &it->second;
+          break;
+        }
+      }
+    if (src)
+      for (auto& kv : op.outputs)
+        for (auto& nm : kv.second) {
+          auto it = m.vars.find(nm);
+          if (it != m.vars.end() && it->second.lod.empty() &&
+              !it->second.shape.empty() &&
+              it->second.shape[0] == src->shape[0])
+            it->second.lod = src->lod;
+        }
+  }
   return 0;
 }
 
@@ -934,5 +1169,27 @@ const float* ptpu_infer_out_data(void* h, int k) {
 }
 
 void ptpu_infer_destroy(void* h) { delete static_cast<Model*>(h); }
+
+// ragged outputs (CTC decode, RNN sequences): per-sequence start
+// offsets of fetch k — length 0 means the output is dense
+int ptpu_infer_out_lod_len(void* h, int k) {
+  Model& m = *static_cast<Model*>(h);
+  return (int)m.vars[m.fetch_names[k]].lod.size();
+}
+const int64_t* ptpu_infer_out_lod(void* h, int k) {
+  Model& m = *static_cast<Model*>(h);
+  return m.vars[m.fetch_names[k]].lod.data();
+}
+
+// feed a ragged input: offsets for a previously-set input tensor
+int ptpu_infer_set_input_lod(void* h, const char* name, const int64_t* lod,
+                             int len) {
+  Model& m = *static_cast<Model*>(h);
+  auto it = m.vars.find(name);
+  if (it == m.vars.end()) return -1;
+  it->second.lod.assign(lod, lod + len);
+  m.fed_lod[name] = true;
+  return 0;
+}
 
 }  // extern "C"
