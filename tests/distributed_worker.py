@@ -142,6 +142,31 @@ def train_lstm_steps(exe, main, loss, steps, lo=None, hi=None):
     return losses
 
 
+
+
+def build_hybrid_model():
+    """Ragged LSTM model with the first fc weight tensor-parallel over
+    the mesh's 'model' (ici) axis — the multi-slice DCNxICI layout."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.mesh import shard_parameter
+
+    main, startup, loss = build_lstm_model()
+    w = main.global_block().var("fc_0.w_0")
+    shard_parameter(w, P(None, "model"))
+    return main, startup, loss
+
+
+def train_lstm_steps_range(exe, main, loss, first, last, lo=None, hi=None):
+    losses = []
+    for step in range(first, last):
+        words, ys = lstm_batch_for(step, lo, hi)
+        (lv,) = exe.run(main, feed={"words": words, "y": ys},
+                        fetch_list=[loss])
+        losses.append(float(np.ravel(lv)[0]))
+    return losses
+
+
 def main():
     role = sys.argv[1]
     out_path = sys.argv[2]
@@ -339,6 +364,116 @@ def main():
         result["final_b"] = np.asarray(scope.get("fc_1.b_0")).tolist()
         with open(out_path, "w") as f:
             json.dump(result, f)
+
+
+    elif role == "hybrid_dist":
+        # VERDICT r4 item 6: make_hybrid_mesh + _globalize_feeds together
+        # across processes — each process is one DCN "slice" of 2 chips
+        # (ici 'model' axis shards a weight inside the slice), the batch
+        # (a RAGGED LoD feed) shards over the dcn tier, and the slice
+        # assignment is LEASED from the coordinator TCP service.
+        port, pid, nproc, steps, coord_port = sys.argv[4:9]
+        from paddle_tpu.parallel.mesh import DistributedContext
+
+        DistributedContext.initialize(
+            coordinator_address="localhost:%s" % port,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.distributed.coordinator import RemoteCoordinator
+        from paddle_tpu.parallel import set_default_mesh
+        from paddle_tpu.parallel.mesh import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh({"dcn": int(nproc)}, {"model": 2})
+        set_default_mesh(mesh)
+
+        rcoord = RemoteCoordinator("localhost:%s" % coord_port)
+        task = rcoord.get_task()
+        assert task is not None, "no shard lease available"
+        lo, hi = task.payload
+
+        main_p, startup, loss = build_hybrid_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.global_scope()
+        for step in range(int(steps)):
+            words, ys = lstm_batch_for(step, int(lo), int(hi))
+            (lv,) = exe.run(main_p, feed={"words": words, "y": ys},
+                            fetch_list=[loss])
+            result["losses"].append(float(np.ravel(lv)[0]))
+            ckpt.save_checkpoint(scope, ckpt_dir, step=step)
+        w = scope.get("fc_0.w_0")
+        result["task_id"] = task.task_id
+        result["lo_hi"] = [int(lo), int(hi)]
+        result["tp_sharded"] = bool(
+            isinstance(w, jax.Array) and not w.is_fully_replicated
+        )
+        # the lease is NOT finished: the harness SIGKILLs us mid-pass and
+        # the resumer must reclaim it after the server-side timeout
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+        while True:
+            time.sleep(0.2)
+
+    elif role == "hybrid_resume":
+        # N->M elastic resume (M=1): reclaim every dead worker's expired
+        # lease from the coordinator, restore the merged sharded
+        # checkpoint onto an emulated hybrid mesh, finish the schedule.
+        steps_done, total_steps, nslices, coord_port = sys.argv[4:8]
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.distributed.coordinator import RemoteCoordinator
+        from paddle_tpu.parallel import set_default_mesh
+        from paddle_tpu.parallel.mesh import make_hybrid_mesh
+
+        rcoord = RemoteCoordinator("localhost:%s" % coord_port)
+        reclaimed = []
+        deadline = time.time() + 60
+        while len(reclaimed) < int(nslices) and time.time() < deadline:
+            t = rcoord.get_task()
+            if t is None:
+                time.sleep(0.5)
+                continue
+            reclaimed.append(t)
+        assert len(reclaimed) == int(nslices), (
+            "reclaimed %d/%s leases" % (len(reclaimed), nslices)
+        )
+        result["reclaimed_slices"] = sorted(t.payload for t in reclaimed)
+
+        mesh = make_hybrid_mesh({"dcn": int(nslices)}, {"model": 2})
+        set_default_mesh(mesh)
+        main_p, startup, loss = build_hybrid_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.global_scope()
+        meta = ckpt.load_checkpoint(scope, ckpt_dir)
+        result["resumed_step"] = meta["step"]
+        result["losses"] = train_lstm_steps_range(
+            exe, main_p, loss, int(steps_done), int(total_steps)
+        )
+        for t in reclaimed:
+            rcoord.task_finished(t.task_id)
+        result["final_w"] = np.asarray(scope.get("fc_0.w_0")).tolist()
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
+    elif role == "hybrid_oracle":
+        total_steps = int(sys.argv[4])
+        import paddle_tpu.fluid as fluid
+
+        main_p, startup, loss = build_hybrid_model()
+        exe = fluid.Executor(fluid.CPUPlace())  # no mesh: plain oracle
+        exe.run(startup)
+        scope = fluid.global_scope()
+        result["losses"] = train_lstm_steps_range(
+            exe, main_p, loss, 0, total_steps
+        )
+        result["final_w"] = np.asarray(scope.get("fc_0.w_0")).tolist()
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
 
     else:
         raise SystemExit("unknown role %r" % role)

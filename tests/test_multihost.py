@@ -354,3 +354,94 @@ def test_async_checkpoint_sharded_single_process(tmp_path):
     scope2 = fluid.executor.Scope()
     ckpt.load_checkpoint(scope2, d)
     np.testing.assert_array_equal(np.asarray(scope2.get("w")), w)
+
+
+def test_hybrid_mesh_multiprocess_elastic(tmp_path):
+    """VERDICT r4 item 6: 4 processes x 2 virtual devices on a
+    make_hybrid_mesh (dcn=4 slices, ici 'model'=2 TP) layout, ragged
+    LoD feeds globalized through the dcn tier, slice assignment leased
+    from the coordinator TCP service, then the elastic path: SIGKILL
+    all workers mid-pass, a fresh single process reclaims the expired
+    leases, restores the merged sharded checkpoint (N->M, 4->1), and
+    reproduces the single-process oracle."""
+    from paddle_tpu.distributed.coordinator import (
+        Coordinator,
+        CoordinatorServer,
+    )
+
+    ckpt_dir = str(tmp_path / "hckpt")
+    port = _free_port()
+    nproc, steps_a, total = 4, 2, 4
+
+    coord = Coordinator(timeout_s=10.0)
+    coord.set_dataset([[0, 2], [2, 4], [4, 6], [6, 8]])
+    svc = CoordinatorServer(coord, host="127.0.0.1", port=0)
+    svc.start()
+    try:
+        outs = [str(tmp_path / ("hyb_p%d.json" % i)) for i in range(nproc)]
+        procs = [
+            _spawn(
+                ["hybrid_dist", outs[i], ckpt_dir, port, i, nproc,
+                 steps_a, svc.port],
+                devices=2,
+            )
+            for i in range(nproc)
+        ]
+        try:
+            for o in outs:
+                assert _wait_file(o, procs), "worker output missing: %s" % o
+            results = [json.load(open(o)) for o in outs]
+            # all processes observed the same GLOBAL loss each step
+            for r in results[1:]:
+                np.testing.assert_allclose(
+                    r["losses"], results[0]["losses"], rtol=1e-5
+                )
+            assert all(r["tp_sharded"] for r in results), (
+                "fc_0.w_0 was not TP-sharded over the ici axis"
+            )
+            # the coordinator really assigned disjoint slices
+            slices = sorted(tuple(r["lo_hi"]) for r in results)
+            assert slices == [(0, 2), (2, 4), (4, 6), (6, 8)]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGKILL)
+            for p in procs:
+                p.wait()
+
+        # oracle: one plain process, full schedule
+        oracle_out = str(tmp_path / "hyb_oracle.json")
+        p = _spawn(["hybrid_oracle", oracle_out, ckpt_dir, total], devices=2)
+        rc = p.wait(timeout=600)
+        _, err = p.communicate()
+        assert rc == 0, err[-4000:]
+        oracle = json.load(open(oracle_out))
+        np.testing.assert_allclose(
+            results[0]["losses"], oracle["losses"][:steps_a], rtol=2e-4
+        )
+
+        # elastic resume: fresh single process, 8 emulated devices,
+        # reclaims the 4 expired leases and finishes the schedule
+        resume_out = str(tmp_path / "hyb_resume.json")
+        p = _spawn(
+            ["hybrid_resume", resume_out, ckpt_dir, steps_a, total, nproc,
+             svc.port],
+            devices=8,
+        )
+        rc = p.wait(timeout=600)
+        _, err = p.communicate()
+        assert rc == 0, err[-4000:]
+        resume = json.load(open(resume_out))
+        assert resume["resumed_step"] == steps_a - 1
+        assert resume["reclaimed_slices"] == [[0, 2], [2, 4], [4, 6], [6, 8]]
+        np.testing.assert_allclose(
+            resume["losses"], oracle["losses"][steps_a:], rtol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(resume["final_w"]), np.asarray(oracle["final_w"]),
+            rtol=1e-4, atol=1e-5,
+        )
+        # every lease was ultimately finished by the resumer
+        assert len(coord.done) == nproc
+    finally:
+        svc.stop()
