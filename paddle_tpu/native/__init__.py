@@ -206,8 +206,16 @@ class InferenceRunner(object):
             )
         for name, off in (lods or {}).items():
             off = np.ascontiguousarray(off, np.int64)
-            buf = (ctypes.c_int64 * len(off))(*off.tolist())
-            L.ptpu_infer_set_input_lod(h, name.encode(), buf, len(off))
+            rc = L.ptpu_infer_set_input_lod(
+                h, name.encode(),
+                off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(off),
+            )
+            if rc != 0:
+                raise KeyError(
+                    "lod for unknown input %r (set its tensor first)"
+                    % name
+                )
         if L.ptpu_infer_forward(h) != 0:
             raise RuntimeError(
                 "native forward failed: %s"
@@ -223,10 +231,10 @@ class InferenceRunner(object):
                 L.ptpu_infer_out_data(h, i), shape=(n,)
             ).copy()
             outs.append(data.reshape(shape))
-            ll = L.ptpu_infer_out_lod_len(h, i)
-            lods_out.append(
-                [L.ptpu_infer_out_lod(h, i)[k] for k in range(ll)]
-            )
+            if return_lod:
+                ll = L.ptpu_infer_out_lod_len(h, i)
+                ptr = L.ptpu_infer_out_lod(h, i) if ll else None
+                lods_out.append([ptr[k] for k in range(ll)] if ll else [])
         return (outs, lods_out) if return_lod else outs
 
     def close(self):

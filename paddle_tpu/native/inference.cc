@@ -816,10 +816,22 @@ static bool run_op(Model& m, const OpDesc& op) {
     Tensor& x = m.vars[op.in("Input")];
     Tensor& w = m.vars[op.in("Weight")];
     Tensor* bias = op.in("Bias").empty() ? nullptr : &m.vars[op.in("Bias")];
+    Tensor* h0 = op.in("H0").empty() ? nullptr : &m.vars[op.in("H0")];
     Tensor* o = named(m, op.out("Hidden"));
     if (x.lod.empty()) {
       m.error = "gru input has no sequence offsets (lod)";
       return false;
+    }
+    {
+      // only the default activations are compiled in; a model asking
+      // for others must fail loudly, not diverge silently
+      std::string ga = op.attr_str("gate_activation");
+      std::string ca = op.attr_str("activation");
+      if ((!ga.empty() && ga != "sigmoid") || (!ca.empty() && ca != "tanh")) {
+        m.error = "native gru supports gate_activation=sigmoid / "
+                  "activation=tanh only (got " + ga + "/" + ca + ")";
+        return false;
+      }
     }
     bool reverse = op.attr_bool("is_reverse", false);
     int64_t Hd = w.shape[0];
@@ -831,7 +843,10 @@ static bool run_op(Model& m, const OpDesc& op) {
     std::vector<float> h(Hd), hn(Hd), g(3 * Hd);
     for (size_t s = 0; s + 1 < x.lod.size(); ++s) {
       int64_t b0 = x.lod[s], b1 = x.lod[s + 1];
-      std::fill(h.begin(), h.end(), 0.f);
+      if (h0)
+        memcpy(h.data(), &h0->f[s * Hd], Hd * sizeof(float));
+      else
+        std::fill(h.begin(), h.end(), 0.f);
       for (int64_t q = 0; q < b1 - b0; ++q) {
         int64_t row = reverse ? (b1 - 1 - q) : (b0 + q);
         const float* xr = &x.f[row * 3 * Hd];
@@ -883,9 +898,9 @@ static bool run_op(Model& m, const OpDesc& op) {
       for (int64_t r = x.lod[s]; r < x.lod[s + 1]; ++r) {
         int64_t tok = 0;
         if (C > 1) {
-          const float* px = &x.f[r * C];
+          // at() reads the int or float payload uniformly
           for (int64_t c = 1; c < C; ++c)
-            if (px[c] > px[tok]) tok = c;
+            if (x.at(r * C + c) > x.at(r * C + tok)) tok = c;
         } else {
           tok = x.is_int ? x.i[r] : (int64_t)x.f[r];
         }
