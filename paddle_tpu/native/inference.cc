@@ -878,6 +878,137 @@ static bool run_op(Model& m, const OpDesc& op) {
     }
     return true;
   }
+  if (t == "lstm") {
+    // full-sequence LSTM over a packed ragged batch (reference lstm_op;
+    // same math as kernels_rnn.py _lstm: gate order i,f,c,o in the 4H
+    // axis; optional peephole weights ride in bias[4H:7H])
+    Tensor& x = m.vars[op.in("Input")];
+    Tensor& w = m.vars[op.in("Weight")];
+    Tensor* bias = op.in("Bias").empty() ? nullptr : &m.vars[op.in("Bias")];
+    Tensor* h0 = op.in("H0").empty() ? nullptr : &m.vars[op.in("H0")];
+    Tensor* c0 = op.in("C0").empty() ? nullptr : &m.vars[op.in("C0")];
+    Tensor* o = named(m, op.out("Hidden"));
+    Tensor* oc = op.out("Cell").empty() ? nullptr : named(m, op.out("Cell"));
+    if (x.lod.empty()) {
+      m.error = "lstm input has no sequence offsets (lod)";
+      return false;
+    }
+    {
+      std::string ga = op.attr_str("gate_activation");
+      std::string ca = op.attr_str("cell_activation");
+      std::string da = op.attr_str("candidate_activation");
+      if ((!ga.empty() && ga != "sigmoid") || (!ca.empty() && ca != "tanh") ||
+          (!da.empty() && da != "tanh")) {
+        m.error = "native lstm supports sigmoid/tanh activations only";
+        return false;
+      }
+    }
+    bool reverse = op.attr_bool("is_reverse", false);
+    bool peephole = op.attr_bool("use_peepholes", true) && bias &&
+                    bias->numel() >= 7 * w.shape[0];
+    int64_t Hd = w.shape[0];
+    int64_t total = x.shape[0];
+    o->shape = {total, Hd};
+    o->is_int = false;
+    o->f.assign(total * Hd, 0.f);
+    o->lod = x.lod;
+    if (oc) {
+      oc->shape = o->shape;
+      oc->is_int = false;
+      oc->f.assign(total * Hd, 0.f);
+      oc->lod = x.lod;
+    }
+    std::vector<float> h(Hd), c(Hd), g(4 * Hd);
+    auto sig = [](float v) { return 1.f / (1.f + std::exp(-v)); };
+    for (size_t s = 0; s + 1 < x.lod.size(); ++s) {
+      int64_t b0 = x.lod[s], b1 = x.lod[s + 1];
+      if (h0)
+        memcpy(h.data(), &h0->f[s * Hd], Hd * sizeof(float));
+      else
+        std::fill(h.begin(), h.end(), 0.f);
+      if (c0)
+        memcpy(c.data(), &c0->f[s * Hd], Hd * sizeof(float));
+      else
+        std::fill(c.begin(), c.end(), 0.f);
+      for (int64_t q = 0; q < b1 - b0; ++q) {
+        int64_t row = reverse ? (b1 - 1 - q) : (b0 + q);
+        const float* xr = &x.f[row * 4 * Hd];
+        for (int64_t k = 0; k < 4 * Hd; ++k)
+          g[k] = xr[k] + (bias ? bias->f[k] : 0.f);
+        for (int64_t r = 0; r < Hd; ++r) {
+          float hv = h[r];
+          if (hv == 0.f) continue;
+          const float* wr = &w.f[r * 4 * Hd];
+          for (int64_t k = 0; k < 4 * Hd; ++k) g[k] += hv * wr[k];
+        }
+        for (int64_t k = 0; k < Hd; ++k) {
+          float gi = g[k], gf = g[Hd + k];
+          if (peephole) {
+            gi += c[k] * bias->f[4 * Hd + k];
+            gf += c[k] * bias->f[5 * Hd + k];
+          }
+          float i = sig(gi), f2 = sig(gf);
+          float cn = f2 * c[k] + i * std::tanh(g[2 * Hd + k]);
+          float go = g[3 * Hd + k];
+          if (peephole) go += cn * bias->f[6 * Hd + k];
+          c[k] = cn;
+          h[k] = sig(go) * std::tanh(cn);
+        }
+        memcpy(&o->f[row * Hd], h.data(), Hd * sizeof(float));
+        if (oc) memcpy(&oc->f[row * Hd], c.data(), Hd * sizeof(float));
+      }
+    }
+    return true;
+  }
+  if (t == "sequence_pool") {
+    // per-sequence reduction (reference sequence_pool_op.cc); LAST and
+    // FIRST are how sequence_last_step/sequence_first_step lower
+    Tensor& x = m.vars[op.in("X")];
+    Tensor* o = named(m, op.out("Out"));
+    if (x.lod.empty()) {
+      m.error = "sequence_pool input has no sequence offsets (lod)";
+      return false;
+    }
+    std::string pt = op.attr_str("pooltype");
+    if (pt.empty()) pt = op.attr_str("pool_type");
+    if (pt.empty()) pt = "average";  // reference default
+    for (auto& ch : pt) ch = std::tolower(ch);
+    if (pt != "last" && pt != "first" && pt != "max" && pt != "sum" &&
+        pt != "sqrt" && pt != "average" && pt != "avg" && pt != "mean") {
+      m.error = "sequence_pool: unknown pooltype " + pt;
+      return false;
+    }
+    int64_t n = (int64_t)x.lod.size() - 1;
+    int64_t D = x.numel() / std::max<int64_t>(x.shape[0], 1);
+    o->shape = {n, D};
+    o->is_int = false;
+    o->f.assign(n * D, 0.f);
+    for (int64_t s = 0; s < n; ++s) {
+      int64_t b0 = x.lod[s], b1 = x.lod[s + 1];
+      if (b1 <= b0) continue;  // empty sequence pools to zeros
+      if (pt == "last" || pt == "first") {
+        int64_t row = (pt == "last") ? b1 - 1 : b0;
+        memcpy(&o->f[s * D], &x.f[row * D], D * sizeof(float));
+        continue;
+      }
+      for (int64_t d = 0; d < D; ++d) {
+        float acc = (pt == "max") ? -3.4e38f : 0.f;
+        for (int64_t r = b0; r < b1; ++r) {
+          float v = x.f[r * D + d];
+          if (pt == "max")
+            acc = std::max(acc, v);
+          else
+            acc += v;
+        }
+        if (pt == "average" || pt == "avg" || pt == "mean")
+          acc /= (float)(b1 - b0);
+        else if (pt == "sqrt")
+          acc /= std::sqrt((float)(b1 - b0));
+        o->f[s * D + d] = acc;
+      }
+    }
+    return true;
+  }
   if (t == "ctc_align") {
     // CTC greedy decode (reference ctc_align_op.cc): per-step argmax,
     // collapse repeats, drop blanks. Output: packed kept tokens with

@@ -218,3 +218,59 @@ def test_native_seq_serving_no_paddle_import(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "SERVED-OK" in proc.stdout
+
+
+def test_native_lstm_sentiment_matches_python(tmp_path):
+    """Ragged-input LSTM classifier (the understand_sentiment family)
+    through the C ABI: embedding over a fed LoD ids tensor -> fc(4H) ->
+    dynamic_lstm -> sequence_last_step -> softmax head, with the ids'
+    offsets fed via ptpu_infer_set_input_lod."""
+    VOCAB, H = 30, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        emb = fluid.layers.embedding(
+            input=words, size=[VOCAB, 8],
+            param_attr=fluid.ParamAttr(
+                name="s_emb",
+                initializer=fluid.initializer.Normal(scale=0.3, seed=31)),
+        )
+        proj = fluid.layers.fc(
+            input=emb, size=H * 4,
+            param_attr=fluid.ParamAttr(
+                name="s_proj",
+                initializer=fluid.initializer.Normal(scale=0.3, seed=32)),
+        )
+        hidden, _ = fluid.layers.dynamic_lstm(input=proj, size=H * 4)
+        last = fluid.layers.sequence_last_step(input=hidden)
+        pooled = fluid.layers.sequence_pool(input=hidden, pool_type="average")
+        feat = fluid.layers.concat([last, pooled], axis=1)
+        pred = fluid.layers.fc(
+            input=feat, size=3, act="softmax",
+            param_attr=fluid.ParamAttr(
+                name="s_out",
+                initializer=fluid.initializer.Normal(scale=0.3, seed=33)),
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.io.save_inference_model(
+        str(tmp_path), ["words"], [pred], exe, main_program=main,
+    )
+
+    rng = np.random.RandomState(6)
+    lens = [5, 3, 7]
+    flat = rng.randint(0, VOCAB, (sum(lens), 1)).astype(np.int64)
+    offsets = np.cumsum([0] + lens).astype(np.int32)
+
+    (py_pred,) = exe.run(
+        main, feed={"words": (flat, [offsets])}, fetch_list=[pred]
+    )
+    runner = native.InferenceRunner(str(tmp_path))
+    (c_pred,) = runner.run(
+        {"words": flat}, lods={"words": offsets.astype(np.int64)}
+    )
+    assert c_pred.shape == (3, 3)
+    np.testing.assert_allclose(c_pred, np.asarray(py_pred),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(c_pred.sum(1), np.ones(3), atol=1e-5)
