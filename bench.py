@@ -396,6 +396,64 @@ def bench_lstm(batch=64, hidden=512, emb=128, seqlen=100, vocab=30000,
     }
 
 
+def bench_sparse_embedding(vocab=1_000_000, dim=64, batch=4096, fields=8,
+                           steps=(8, 40)):
+    """CTR-style sparse-embedding training step (SelectedRows path, r4):
+    `fields` id lookups per example into a [1M, dim] table, sum-pooled
+    into a logistic head, SGD. The sparse step's gradient work scales
+    with touched rows (batch*fields), not vocab; the dense run of the
+    SAME model is timed for the on-chip comparison. Reference workload
+    family: sparse remote updaters + SelectedRows CTR path
+    (RemoteParameterUpdater.h:265, operators/sgd_op.cc sparse branch)."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+
+    def build(is_sparse):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data(name="ids", shape=[fields],
+                                    dtype="int64")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            emb = fluid.layers.embedding(
+                input=ids, size=[vocab, dim], is_sparse=is_sparse,
+            )
+            pooled = fluid.layers.reduce_sum(emb, dim=1)
+            pred = fluid.layers.fc(input=pooled, size=1, act=None)
+            cost = fluid.layers.mean(
+                x=fluid.layers.sigmoid_cross_entropy_with_logits(
+                    x=pred, label=y
+                )
+            )
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+        return main, startup, cost
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "ids": rng.randint(0, vocab, (batch, fields)).astype(np.int64),
+        "y": (rng.rand(batch, 1) > 0.5).astype(np.float32),
+    }
+
+    out = {}
+    for is_sparse in (True, False):
+        main, startup, cost = build(is_sparse)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        dt, timing = _per_step_seconds(exe, main, feed, cost, *steps)
+        exe.close()
+        key = "sparse" if is_sparse else "dense"
+        out["ms_per_step_" + key] = round(dt * 1e3, 3)
+        if is_sparse:
+            out["timing"] = timing
+            out["examples_per_sec"] = round(batch / dt, 1)
+            out["touched_rows_per_sec"] = round(batch * fields / dt, 1)
+    out.update(vocab=vocab, dim=dim, batch=batch, fields=fields)
+    out["sparse_speedup"] = round(
+        out["ms_per_step_dense"] / out["ms_per_step_sparse"], 3
+    )
+    return out
+
+
 def bench_transformer_lm(B=8, T=1024, dim=512, heads=8, layers_n=8,
                          vocab=32000, steps=(4, 24)):
     """Decoder-only transformer LM training throughput (tokens/s + MFU):
@@ -743,6 +801,7 @@ def main():
             "resnet50", lambda i, c: resnet_imagenet(
                 i, class_dim=c, depth=50), batch, remat=True))
         run("lstm", bench_lstm)
+        run("sparse_embedding", bench_sparse_embedding)
         run("flash_attention", bench_flash_attention)
         run("lm_decode", bench_lm_decode)
         run("transformer_lm", bench_transformer_lm)
