@@ -24,6 +24,7 @@ from .mesh import (
     replicated,
     set_default_mesh,
     shard_parameter,
+    shard_parameters_fsdp,
 )
 from .attention import (
     reference_attention,
@@ -48,6 +49,7 @@ __all__ = [
     "get_default_mesh",
     "set_default_mesh",
     "shard_parameter",
+    "shard_parameters_fsdp",
     "data_sharding",
     "replicated",
     "DistributedContext",

@@ -203,6 +203,10 @@ class ParamAttr(object):
         self.initial_mean = initial_mean
         self.learning_rate = learning_rate
         self.update_hooks = update_hooks
+        # legacy sparse-row updates (reference attrs.py:130, the
+        # SparseRemoteParameterUpdater surface) select the SelectedRows
+        # sparse-gradient path when the parameter feeds an embedding
+        self.sparse_update = sparse_update
 
 
 class ExtraLayerAttribute(object):

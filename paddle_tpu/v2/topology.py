@@ -112,9 +112,13 @@ class Topology(object):
         if node.kind == "embedding":
             t = node.parents[0].attrs["type"]
             pa = a.get("param_attr")
-            return L.embedding(input=self._in(node),
-                               size=[t.dim, a["size"]],
-                               param_attr=_user_attr(pa, node.name + ".w0"))
+            return L.embedding(
+                input=self._in(node),
+                size=[t.dim, a["size"]],
+                # legacy ParamAttr(sparse_update=True) -> SelectedRows
+                is_sparse=bool(getattr(pa, "sparse_update", False)),
+                param_attr=_user_attr(pa, node.name + ".w0"),
+            )
         if node.kind == "concat":
             return L.concat(input=self._ins(node), axis=1)
         if node.kind == "img_conv":

@@ -695,3 +695,55 @@ def test_flowers_voc2012_image_format_decode(tmp_path, monkeypatch):
     synth_mask = voc2012._synthetic_pairs()[voc2012.N_TRAIN][2]
     np.testing.assert_array_equal(mask, synth_mask)
     assert len(list(voc2012.train()())) == voc2012.N_TRAIN + voc2012.N_VAL
+
+
+def test_v2_sparse_update_embedding_matches_dense():
+    """Legacy ParamAttr(sparse_update=True) (reference attrs.py:130 -> the
+    SparseRemoteParameterUpdater path) rides the SelectedRows sparse
+    gradient here; under SGD it must reproduce the dense run exactly."""
+    import paddle_tpu.v2.layer as _L
+
+    def train(sparse):
+        words = paddle.layer.data(
+            name="w2", type=paddle.data_type.integer_value_sequence(40)
+        )
+        emb = paddle.layer.embedding(
+            input=words, size=6,
+            param_attr=paddle.attr.Param(
+                name="sp_v2_emb", sparse_update=sparse, initial_std=0.2
+            ),
+        )
+        pooled = paddle.layer.pooling(
+            input=emb, pooling_type=paddle.pooling.Sum()
+        )
+        pred = paddle.layer.fc(
+            input=pooled, size=3, act=paddle.activation.Softmax(),
+            param_attr=paddle.attr.Param(name="sp_v2_fc"),
+        )
+        lbl = paddle.layer.data(
+            name="y2", type=paddle.data_type.integer_value(3)
+        )
+        cost = paddle.layer.classification_cost(input=pred, label=lbl)
+        params = paddle.parameters.create(cost)
+        trainer = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Momentum(
+                momentum=0.0, learning_rate=0.1
+            ),
+        )
+
+        def reader():
+            rng = np.random.RandomState(9)
+            for _ in range(24):
+                seq = rng.randint(0, 40, rng.randint(2, 6)).tolist()
+                yield seq, int(sum(seq) % 3)
+
+        trainer.train(
+            reader=paddle.batch(reader, batch_size=8), num_passes=2
+        )
+        return np.asarray(params.get("sp_v2_emb"))
+
+    w_dense = train(False)
+    w_sparse = train(True)
+    assert w_sparse.shape == (40, 6)
+    np.testing.assert_allclose(w_sparse, w_dense, rtol=0, atol=1e-6)
