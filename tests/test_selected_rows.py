@@ -320,3 +320,73 @@ def test_sparse_step_work_scales_with_rows_not_vocab():
     assert n_sparse <= 2, "sparse step materialised %d vocab-sized arrays" % (
         n_sparse
     )
+
+
+def test_sparse_composes_with_amp():
+    """program.amp (bf16 forward region) + is_sparse: delta leaves are
+    created in the cast dtype and the SelectedRows values come back
+    f32 for the optimizer — training stays finite and close to the
+    dense-amp run."""
+    vocab, dim = 40, 8
+    bs = _batches(3, vocab)
+
+    def train(is_sparse):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            _build_embedding_model(
+                vocab, dim, is_sparse,
+                lambda: fluid.optimizer.SGD(learning_rate=0.1),
+            )
+        main.amp = True
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        cost_name = None
+        for op in main.global_block().ops:
+            if op.type == "mean":
+                cost_name = op.outputs["Out"][0]
+        for ids_np, y_np in bs:
+            out = exe.run(main, feed={"ids": ids_np, "y": y_np},
+                          fetch_list=[cost_name])
+        assert np.isfinite(np.ravel(out[0])).all()
+        return np.asarray(fluid.global_scope().find_var("emb_w").get_tensor())
+
+    w_sparse = train(True)
+    w_dense = train(False)
+    assert w_sparse.dtype == np.float32
+    # bf16 forward: agreement is approximate but must be tight relative
+    # to the update magnitude
+    np.testing.assert_allclose(w_sparse, w_dense, rtol=0, atol=5e-3)
+
+
+def test_sparse_composes_with_memory_optimize():
+    """memory_optimize() wraps the forward in jax.checkpoint (remat);
+    the delta-leaf sparse path must survive the rematerialised
+    cotangent pass with dense-equal results under SGD."""
+    vocab, dim = 30, 5
+    bs = _batches(3, vocab)
+
+    def train(is_sparse, remat):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            _build_embedding_model(
+                vocab, dim, is_sparse,
+                lambda: fluid.optimizer.SGD(learning_rate=0.2),
+            )
+        if remat:
+            fluid.memory_optimize(main)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        cost = [
+            op.outputs["Out"][0] for op in main.global_block().ops
+            if op.type == "mean"
+        ][0]
+        for ids_np, y_np in bs:
+            exe.run(main, feed={"ids": ids_np, "y": y_np},
+                    fetch_list=[cost])
+        return np.asarray(fluid.global_scope().find_var("emb_w").get_tensor())
+
+    w_sr = train(True, remat=True)
+    w_dr = train(False, remat=True)
+    w_d = train(False, remat=False)
+    np.testing.assert_allclose(w_sr, w_dr, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(w_sr, w_d, rtol=0, atol=1e-6)
