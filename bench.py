@@ -49,6 +49,7 @@ import numpy as np
 
 BASELINE_IMG_PER_SEC = 1500.0  # ResNet-50 north star (BASELINE.json)
 PEAK_FLOPS = 197e12  # TPU v5e bf16
+HBM_BW = 819e9  # TPU v5e HBM bytes/s
 
 # forward FLOPs per sample (2 FLOPs per MAC), standard published counts
 FWD_FLOPS = {
@@ -218,6 +219,22 @@ def bench_image(name, model_fn, batch, steps=(12, 72), baseline_ips=None,
             flops, hbm_bytes = _xla_step_cost(prog, cost, feed)
             rec["xla_flops_util"] = round(flops / dt / PEAK_FLOPS, 4)
             rec["hbm_GBps"] = round(hbm_bytes / dt / 1e9, 1)
+            # roofline verdict (r3 ask): where does this step sit
+            # relative to the v5e machine balance, and how much of the
+            # model-implied ceiling is achieved? The ridge point is
+            # PEAK_FLOPS/HBM_BW ~ 240 flops/byte; a step below it is
+            # bandwidth-bound and its ceiling is bytes/BW.
+            if flops > 0 and hbm_bytes > 0:
+                ai = flops / hbm_bytes
+                t_roof = max(flops / PEAK_FLOPS, hbm_bytes / HBM_BW)
+                rec["roofline"] = {
+                    "ai_flops_per_byte": round(ai, 1),
+                    "ridge_flops_per_byte": round(PEAK_FLOPS / HBM_BW, 1),
+                    "bound": "hbm" if ai < PEAK_FLOPS / HBM_BW else "mxu",
+                    "roofline_ms": round(t_roof * 1e3, 3),
+                    "roofline_img_per_sec": round(batch / t_roof, 1),
+                    "achieved_frac_of_roofline": round(t_roof / dt, 4),
+                }
         except Exception as e:  # cost model is informational only
             rec["xla_cost_error"] = "%s: %s" % (type(e).__name__, e)
     exe.close()
