@@ -663,3 +663,150 @@ def build_multi_step_fn(
         return fetch_stack, new_persist
 
     return multi, persist_out
+
+
+def build_accum_step_fn(
+    program,
+    feed_names: Sequence[str],
+    fetch_names: Sequence[str],
+    persist_names: Sequence[str],
+    micro_batches: int,
+    persist_in: Optional[Sequence[str]] = None,
+):
+    """ONE optimizer step over `micro_batches` forward/backward passes
+    (gradient accumulation): the feed batch splits into equal chunks
+    along axis 0, a lax.scan runs forward+vjp per chunk accumulating
+    the MEAN of chunk gradients (exact for mean-reduced losses), and
+    the tail ops (regularizer/clip/optimizer) run ONCE on the
+    accumulated gradients. The HBM lever the reference never needed:
+    activations live for one micro-batch at a time, so the effective
+    batch is bounded by steps, not memory.
+
+    Forward-written persistables (BN running stats, counters) update
+    per chunk — the same semantics as K small batches. Restrictions
+    (v1): training programs only, dense gradients (sparse lookup sites
+    fall back dense), no LoD side-band feeds, no AMP/remat flags, and
+    fetches must be the loss (returned as the mean over chunks) or
+    tail-op outputs.
+    """
+    if int(micro_batches) < 1:
+        raise ValueError("micro_batches must be >= 1")
+    if bool(getattr(program, "amp", False)) or bool(
+        getattr(program, "remat", False)
+    ):
+        raise NotImplementedError(
+            "gradient accumulation does not compose with program.amp/"
+            "remat yet"
+        )
+    block = program.global_block()
+    persist_names = list(persist_names)
+    fetch_names = list(fetch_names)
+    persist_in = list(persist_in or [])
+    pruned = _backward_slice(block, fetch_names, set(persist_names))
+    fwd_ops, ad_op, tail_ops = _split_at_autodiff(pruned)
+    if ad_op is None:
+        raise ValueError(
+            "gradient accumulation requires a training program "
+            "(optimizer.minimize before run)"
+        )
+    loss_name = ad_op.attrs["loss_name"]
+    grad_names = dict(
+        zip(ad_op.attrs["param_names"], ad_op.attrs["grad_names"])
+    )
+    produced = set()
+    for op in pruned:
+        produced |= set(op.output_arg_names)
+    persist_out = sorted(set(persist_in) | (produced & set(persist_names)))
+    missing = set(persist_out) - set(persist_in)
+    if missing:
+        raise ValueError(
+            "gradient accumulation requires the program to update (not "
+            "create) persistables; missing from scope: %r" % sorted(missing)
+        )
+    k = int(micro_batches)
+
+    def step(persist: Dict[str, Any], feeds: Dict[str, Any], key):
+        param_names = [
+            p for p in ad_op.attrs["param_names"] if p in persist
+        ]
+        chunks = {}
+        for n, v in feeds.items():
+            if "@" in n:
+                raise NotImplementedError(
+                    "gradient accumulation with ragged (LoD) feeds is "
+                    "not supported"
+                )
+            if v.shape[0] % k:
+                raise ValueError(
+                    "batch dim %d of feed %r is not divisible by "
+                    "micro_batches=%d" % (v.shape[0], n, k)
+                )
+            chunks[n] = v.reshape((k, v.shape[0] // k) + v.shape[1:])
+
+        def body(carry, xs):
+            pstate, gsum, i = carry
+            ctx = LoweringContext(block, jax.random.fold_in(key, i))
+            base_env = dict(pstate)
+            base_env.update(xs)
+
+            def fwd(pvals):
+                fenv = dict(base_env)
+                fenv.update(pvals)
+                run_ops(ctx, fwd_ops, fenv)
+                return fenv[loss_name].astype(jnp.float32), fenv
+
+            primal = {p: pstate[p] for p in param_names}
+            loss, pullback, fenv = jax.vjp(fwd, primal, has_aux=True)
+            (g,) = pullback(jnp.ones_like(loss))
+            gsum = {p: gsum[p] + g[p] for p in param_names}
+            newp = dict(pstate)
+            for n2 in pstate:
+                if n2 in fenv:
+                    v = fenv[n2]
+                    if hasattr(v, "dtype") and v.dtype != pstate[n2].dtype:
+                        v = v.astype(pstate[n2].dtype)
+                    newp[n2] = v
+            return (newp, gsum, i + 1), loss
+
+        gzero = {
+            p: jnp.zeros(persist[p].shape, jnp.float32)
+            for p in param_names
+        }
+        (pstate, gsum, _), losses = jax.lax.scan(
+            body, (dict(persist), gzero, 0), chunks
+        )
+        env = dict(pstate)
+        for p in param_names:
+            env[grad_names[p]] = gsum[p] / float(k)
+        ctx = LoweringContext(block, key)
+        run_ops(ctx, tail_ops, env)
+        fetches = []
+        for n in fetch_names:
+            if n == loss_name:
+                # mean over the chunk axis only: keeps the mean op's
+                # documented [1] fetch shape (kernels_math.py)
+                fetches.append(jnp.mean(losses, axis=0))
+            elif n in env:
+                fetches.append(as_dense(env[n]))
+            else:
+                raise KeyError(
+                    "fetch %r is neither the loss nor a tail-op output; "
+                    "per-chunk intermediates are not retained under "
+                    "gradient accumulation" % n
+                )
+        new_persist = {}
+        for n in persist_out:
+            v = env[n]
+            # scope dtypes stay stable across steps (same restore as
+            # build_step_fn): the f32 grad arithmetic must not widen a
+            # low-precision param in the scope
+            if (
+                n in persist
+                and hasattr(v, "dtype")
+                and v.dtype != persist[n].dtype
+            ):
+                v = v.astype(persist[n].dtype)
+            new_persist[n] = v
+        return fetches, new_persist
+
+    return step, persist_out

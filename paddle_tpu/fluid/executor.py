@@ -183,6 +183,79 @@ class Executor(object):
             use_cache=True, steps=int(steps), scan_feeds=scan_feeds,
         )
 
+    def run_grad_accum(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[List[Any]] = None,
+        micro_batches: int = 2,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+    ):
+        """ONE optimizer step over `micro_batches` forward/backward
+        passes (gradient accumulation): the feed batch splits into
+        equal chunks, a lax.scan accumulates the mean of chunk
+        gradients, and the update applies once — activations live one
+        micro-batch at a time, so the effective batch is bounded by
+        step count, not HBM (core/lowering.py build_accum_step_fn)."""
+        from .core.lowering import build_accum_step_fn
+
+        if self._resolve_mesh() is not None:
+            raise NotImplementedError(
+                "run_grad_accum is single-chip; compose large batches "
+                "on a mesh with the data axis instead"
+            )
+        if program is None:
+            program = core.default_main_program()
+        feed = dict(feed or {})
+        scope = scope or global_scope()
+        block = program.global_block()
+        fetch_names = [_feed_name(f) for f in fetch_list or []]
+        persist_names = sorted(
+            v.name for v in program.list_vars() if v.persistable
+        )
+        feed_arrays = {}
+        for name, value in feed.items():
+            var = block.var(name) if block.has_var(name) else None
+            data, lod = _split_lod_feed(value)
+            if lod is not None:
+                raise NotImplementedError(
+                    "gradient accumulation with ragged (LoD) feeds is "
+                    "not supported"
+                )
+            feed_arrays[name] = _to_device_dtype(data, var)
+        persist_in = {n: scope.get(n) for n in persist_names if n in scope}
+        feed_sig = tuple(
+            (n, tuple(a.shape), str(a.dtype))
+            for n, a in sorted(feed_arrays.items())
+        )
+        key = (
+            "grad_accum", program.uid, program.version, program.amp,
+            program.remat, feed_sig, tuple(fetch_names),
+            tuple(sorted(persist_in)), int(micro_batches),
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            fn, _ = build_accum_step_fn(
+                program,
+                feed_names=list(feed_arrays),
+                fetch_names=fetch_names,
+                persist_names=persist_names,
+                micro_batches=int(micro_batches),
+                persist_in=list(persist_in),
+            )
+            entry = jax.jit(fn, donate_argnums=(0,))
+            self._cache[key] = entry
+        self._run_counter += 1
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(program.random_seed), self._run_counter
+        )
+        fetches, new_persist = entry(persist_in, feed_arrays, rng)
+        _flush_print_effects(program)
+        return _finish_run(
+            scope, fetch_names, fetches, new_persist, return_numpy
+        )
+
     def run_async_local(
         self,
         program: Optional[Program] = None,

@@ -1,0 +1,138 @@
+"""Gradient accumulation (Executor.run_grad_accum /
+core/lowering.py build_accum_step_fn): one optimizer step over K
+micro-batches with the mean of chunk gradients — exact for
+mean-reduced losses, so a K-chunk accumulated step must equal the
+full-batch step bit-for-bit under SGD. Beyond-reference capability
+(the HBM lever for batches larger than memory)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _model(with_bn=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[12], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(
+            input=x, size=16, act="relu",
+            param_attr=fluid.ParamAttr(
+                name="ga_w1",
+                initializer=fluid.initializer.Normal(scale=0.3, seed=51),
+            ),
+        )
+        if with_bn:
+            h = fluid.layers.batch_norm(input=h)
+        pred = fluid.layers.fc(
+            input=h, size=1,
+            param_attr=fluid.ParamAttr(
+                name="ga_w2",
+                initializer=fluid.initializer.Normal(scale=0.3, seed=52),
+            ),
+        )
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _data(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 12).astype(np.float32),
+            rng.randn(n, 1).astype(np.float32))
+
+
+def test_accum_step_equals_full_batch_step():
+    xs, ys = _data()
+    results = {}
+    for k in (1, 4):
+        main, startup, loss = _model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(3):
+            (lv,) = exe.run_grad_accum(
+                main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                micro_batches=k,
+            )
+            losses.append(float(np.ravel(lv)[0]))
+        results[k] = (
+            losses,
+            np.asarray(fluid.global_scope().find_var("ga_w1").get_tensor()),
+        )
+    np.testing.assert_allclose(results[4][0], results[1][0], rtol=1e-6)
+    np.testing.assert_allclose(results[4][1], results[1][1],
+                               rtol=0, atol=1e-6)
+
+
+def test_accum_matches_plain_run():
+    """k=1 accumulation == the ordinary fused step (same loss, same
+    weights), and the returned loss is the batch mean."""
+    xs, ys = _data(seed=3)
+    main, startup, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (l_plain,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    w_plain = np.asarray(
+        fluid.global_scope().find_var("ga_w1").get_tensor()
+    ).copy()
+
+    main2, startup2, loss2 = _model()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup2)
+    (l_acc,) = exe2.run_grad_accum(
+        main2, feed={"x": xs, "y": ys}, fetch_list=[loss2], micro_batches=1
+    )
+    w_acc = np.asarray(fluid.global_scope().find_var("ga_w1").get_tensor())
+    np.testing.assert_allclose(
+        np.ravel(l_acc), np.ravel(l_plain), rtol=1e-6
+    )
+    np.testing.assert_allclose(w_acc, w_plain, rtol=0, atol=1e-6)
+
+
+def test_accum_with_batch_norm_updates_stats_per_chunk():
+    """BN running stats update K times per accumulated step (the
+    K-small-batches semantics) — params still train and stay finite."""
+    xs, ys = _data(n=32, seed=5)
+    main, startup, loss = _model(with_bn=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    # BN running stats are the _generated_var persistables
+    bn_mean_name = [
+        n for n in sorted(
+            v.name for v in main.list_vars() if v.persistable
+        ) if n.startswith("_generated_var")
+    ][0]
+    m0 = np.asarray(scope.find_var(bn_mean_name).get_tensor()).copy()
+    for _ in range(2):
+        (lv,) = exe.run_grad_accum(
+            main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+            micro_batches=4,
+        )
+    assert np.isfinite(np.ravel(lv)).all()
+    m1 = np.asarray(scope.find_var(bn_mean_name).get_tensor())
+    assert np.abs(m1 - m0).max() > 1e-6  # stats really moved
+
+
+def test_accum_rejects_bad_configs():
+    xs, ys = _data(n=30)  # 30 % 4 != 0
+    main, startup, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(ValueError, match="divisible"):
+        exe.run_grad_accum(main, feed={"x": xs, "y": ys},
+                           fetch_list=[loss], micro_batches=4)
+
+    infer = fluid.Program()
+    with fluid.program_guard(infer, fluid.Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(input=x, size=2)
+    with pytest.raises(ValueError, match="training program"):
+        exe.run_grad_accum(
+            infer, feed={"x": np.zeros((4, 4), np.float32)},
+            fetch_list=[out], micro_batches=2,
+        )
