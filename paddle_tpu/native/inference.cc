@@ -623,9 +623,17 @@ static bool run_op(Model& m, const OpDesc& op) {
     o->shape = shape;
     return true;
   }
-  if (t == "dropout") {  // inference: identity (test-mode clone)
+  if (t == "dropout") {
+    // inference semantics = downscale by keep probability (reference
+    // dropout_op.cc default upscale_in_train=False; matches
+    // kernels_nn.py _dropout's is_test branch)
     Tensor& x = m.vars[op.in("X")];
-    *named(m, op.out("Out")) = x;
+    Tensor* o = named(m, op.out("Out"));
+    float keep = 1.f - (float)op.attr_num("dropout_prob", 0.5);
+    o->shape = x.shape;
+    o->is_int = false;
+    o->f.resize(x.numel());
+    for (int64_t kq = 0; kq < x.numel(); ++kq) o->f[kq] = x.at(kq) * keep;
     return true;
   }
   if (t == "batch_norm") {
@@ -655,6 +663,38 @@ static bool run_op(Model& m, const OpDesc& op) {
   }
   if (t == "conv2d") return conv2d(m, op);
   if (t == "pool2d") return pool2d(m, op);
+  if (t == "lrn") {
+    // cross-channel local response normalisation (reference lrn_op.cc;
+    // matches kernels_nn.py _lrn: window n centred with left pad n/2,
+    // out = x * (k + alpha * sum(x^2 over window))^-beta)
+    Tensor& x = m.vars[op.in("X")];
+    Tensor* o = named(m, op.out("Out"));
+    int64_t n = (int64_t)op.attr_num("n", 5);
+    float kk = (float)op.attr_num("k", 2.0);
+    float alpha = (float)op.attr_num("alpha", 1e-4);
+    float beta = (float)op.attr_num("beta", 0.75);
+    int64_t N = x.shape[0], C = x.shape[1];
+    int64_t inner = x.numel() / std::max<int64_t>(N * C, 1);
+    o->shape = x.shape;
+    o->is_int = false;
+    o->f.resize(x.numel());
+    int64_t half = n / 2;
+    for (int64_t b = 0; b < N; ++b)
+      for (int64_t c = 0; c < C; ++c) {
+        int64_t c0 = std::max<int64_t>(c - half, 0);
+        int64_t c1 = std::min<int64_t>(c - half + n, C);
+        for (int64_t kx = 0; kx < inner; ++kx) {
+          float acc = 0.f;
+          for (int64_t cc = c0; cc < c1; ++cc) {
+            float v = x.f[(b * C + cc) * inner + kx];
+            acc += v * v;
+          }
+          int64_t idx = (b * C + c) * inner + kx;
+          o->f[idx] = x.f[idx] * std::pow(kk + alpha * acc, -beta);
+        }
+      }
+    return true;
+  }
   if (t == "lookup_table") {
     Tensor& w = m.vars[op.in("W")];
     Tensor& ids = m.vars[op.in("Ids")];

@@ -251,3 +251,42 @@ def test_native_lookup_table_padding_idx(tmp_path, native_infer_ok):
     (got,) = runner.run({"pids": ids})
     np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
     runner.close()
+
+
+def test_native_serves_image_zoo(tmp_path, native_infer_ok):
+    """Every image-classification family in the zoo serves through the
+    dependency-free C runner (capi parity for the benchmark models):
+    AlexNet (conv/lrn-free path) and GoogLeNet (inception concat + LRN)
+    at reduced resolution, matching the Python executor."""
+    from paddle_tpu.models.alexnet import alexnet
+    from paddle_tpu.models.googlenet import googlenet
+
+    rng = np.random.RandomState(11)
+    for name, fn, hw in (("alexnet", alexnet, 96), ("googlenet",
+                                                    googlenet, 64)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(
+                name="image", shape=[3, hw, hw], dtype="float32"
+            )
+            pred = fn(img, 12)
+            if isinstance(pred, (list, tuple)):  # googlenet aux heads
+                pred = pred[0]
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = str(tmp_path / name)
+        fluid.io.save_inference_model(d, ["image"], [pred], exe,
+                                      main_program=main)
+        x = rng.rand(2, 3, hw, hw).astype(np.float32)
+        # oracle must run TEST-mode (dropout identity), like the saved
+        # inference program the C runner executes
+        (py_out,) = exe.run(main.clone(for_test=True),
+                            feed={"image": x}, fetch_list=[pred])
+        runner = native.InferenceRunner(d)
+        (c_out,) = runner.run({"image": x})
+        np.testing.assert_allclose(
+            c_out, np.asarray(py_out), rtol=1e-3, atol=1e-4,
+            err_msg="%s native serving diverged" % name,
+        )
+        np.testing.assert_allclose(c_out.sum(1), np.ones(2), atol=1e-4)
+        runner.close()
