@@ -260,10 +260,16 @@ def test_native_serves_image_zoo(tmp_path, native_infer_ok):
     at reduced resolution, matching the Python executor."""
     from paddle_tpu.models.alexnet import alexnet
     from paddle_tpu.models.googlenet import googlenet
+    from paddle_tpu.models.mobilenet import mobilenet_v1
+    from paddle_tpu.models.resnet import resnet_cifar10
 
     rng = np.random.RandomState(11)
-    for name, fn, hw in (("alexnet", alexnet, 96), ("googlenet",
-                                                    googlenet, 64)):
+    for name, fn, hw in (
+        ("alexnet", alexnet, 96),
+        ("googlenet", googlenet, 64),
+        ("mobilenet", lambda i, c: mobilenet_v1(i, c, scale=0.25), 64),
+        ("resnet20", lambda i, c: resnet_cifar10(i, c, depth=20), 32),
+    ):
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup):
             img = fluid.layers.data(
