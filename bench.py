@@ -33,6 +33,24 @@ backward ~= 2x forward for conv/matmul nets, so train step ~= 3x fwd).
 Prints one JSON line per workload; the FINAL line is the headline
 ResNet-50 record (driver contract) and carries `mfu` and the full
 `workloads` map.
+
+Record field glossary (r4 measurement protocol):
+  timing.raw_chunk_s   every raw multi-step chunk wall time, per step
+                       count — the full audit trail
+  timing.per_step_s_min/median  per-step estimates differencing the
+                       per-count minima (noise-robust: a tunnel hiccup
+                       only ADDs time) and medians
+  timing.spread        (max-min)/min of the raw chunks per step count
+  timing.stable / stable  true iff every spread <= BENCH_SPREAD_LIMIT
+                       (default 10%) — a record with stable=false
+                       cannot demonstrate progress or regression
+  mfu                  model-FLOPs utilisation (published fwd FLOPs x3)
+  xla_flops_util       XLA cost-model FLOPs / peak (counts backward
+                       dilated convs, ~1.8x model FLOPs on ResNet)
+  roofline             arithmetic intensity vs the v5e ridge
+                       (~240 flops/byte), the bound verdict (hbm|mxu),
+                       the cost-model-implied ceiling img/s, and the
+                       achieved fraction of that ceiling
 """
 
 from __future__ import annotations
