@@ -710,6 +710,51 @@ def build_accum_step_fn(
             "(optimizer.minimize before run)"
         )
     loss_name = ad_op.attrs["loss_name"]
+    # chunk gradients are averaged, which is exact ONLY for mean-reduced
+    # losses; a sum-reduced loss would silently train with gradients
+    # scaled by 1/micro_batches (ADVICE r4) — detect the loss producer
+    # and warn on a definite sum reduction
+    producers = {}
+    for op in fwd_ops:
+        for nm in op.output_arg_names:
+            producers[nm] = op  # last write wins
+    # walk back through shape-only wrappers so `reshape(reduce_sum(x))`
+    # is still recognised as a sum reduction
+    _PASSTHROUGH = ("reshape", "reshape2", "squeeze", "unsqueeze", "cast")
+    loss_producer = producers.get(loss_name)
+    seen = 0
+    while (
+        loss_producer is not None
+        and loss_producer.type in _PASSTHROUGH
+        and seen < 8
+    ):
+        src = loss_producer.input_arg_names
+        loss_producer = producers.get(src[0]) if src else None
+        seen += 1
+    # NOTE: op type "sum" is elementwise N-tensor addition here (linear,
+    # so accumulation stays exact) — only a batch-axis reduce_sum is a
+    # real mismatch
+    _is_batch_sum = False
+    if loss_producer is not None and loss_producer.type == "reduce_sum":
+        if loss_producer.attrs.get("reduce_all", False):
+            _is_batch_sum = True
+        else:
+            _d = loss_producer.attrs.get("dim", 0)
+            _dims = list(_d) if isinstance(_d, (list, tuple)) else [_d]
+            # negative dims can address the row axis; rank unknown here,
+            # so treat them conservatively (same rule as _share_lod)
+            _is_batch_sum = 0 in _dims or any(d < 0 for d in _dims)
+    if _is_batch_sum:
+        import warnings
+
+        warnings.warn(
+            "gradient accumulation averages chunk gradients (exact for "
+            "mean-reduced losses) but the loss %r is produced by %r — a "
+            "SUM reduction trains with gradients scaled by 1/"
+            "micro_batches; reduce the loss with mean() instead"
+            % (loss_name, loss_producer.type),
+            stacklevel=3,
+        )
     grad_names = dict(
         zip(ad_op.attrs["param_names"], ad_op.attrs["grad_names"])
     )

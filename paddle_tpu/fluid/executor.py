@@ -135,7 +135,8 @@ class Executor(object):
         self.mesh = mesh
         self._cache: Dict[Any, Any] = {}
         self._run_counter = 0
-        self._last_exec = None  # (jitted entry, arg avals) of last run
+        # (jitted entry, arg avals, host-arg snapshot) of last run
+        self._last_exec = None
         self._capture_avals = False  # set by profiler.compiled_profile
 
     def _resolve_mesh(self):
@@ -197,7 +198,12 @@ class Executor(object):
         equal chunks, a lax.scan accumulates the mean of chunk
         gradients, and the update applies once — activations live one
         micro-batch at a time, so the effective batch is bounded by
-        step count, not HBM (core/lowering.py build_accum_step_fn)."""
+        step count, not HBM (core/lowering.py build_accum_step_fn).
+
+        Exactness caveat: chunk gradients are AVERAGED, which matches
+        the full-batch step only for mean-reduced losses. A sum-reduced
+        loss trains with gradients scaled by 1/micro_batches (a warning
+        fires when the loss producer is a detectable sum reduction)."""
         from .core.lowering import build_accum_step_fn
 
         if self._resolve_mesh() is not None:
@@ -530,14 +536,23 @@ class Executor(object):
         # the scheduled HLO. Gated — the tree_map over every param is
         # wasted work on ordinary training steps.
         if self._capture_avals:
+            # host snapshot BEFORE the call (args are donated): lets the
+            # compiled-step profiler rebuild fresh device args per timed
+            # run and measure pure device time (ADVICE r4: exe.run()
+            # end-to-end folds host feed/fetch overhead into op rows)
+            host_snap = jax.tree_util.tree_map(
+                lambda a: np.asarray(a) if hasattr(a, "shape") else a,
+                (persist_in, feed_arrays, rng),
+            )
             self._last_exec = (
                 entry,
                 jax.tree_util.tree_map(
                     lambda a: jax.ShapeDtypeStruct(
                         getattr(a, "shape", ()), getattr(a, "dtype", None)
                     ),
-                    (persist_in, feed_arrays, rng),
+                    host_snap,
                 ),
+                host_snap,
             )
         fetches, new_persist = entry(persist_in, feed_arrays, rng)
         _flush_print_effects(program)

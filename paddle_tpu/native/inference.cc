@@ -25,6 +25,7 @@
 #include <fstream>
 #include <map>
 #include <memory>
+#include <set>
 #include <limits>
 #include <sstream>
 #include <string>
@@ -352,6 +353,19 @@ static Tensor* named(Model& m, const std::string& name) {
   return name.empty() ? nullptr : &m.vars[name];
 }
 
+// Integral tensors carry values in .i with .f empty; kernels whose inner
+// loops index x.f directly (layer_norm, lrn, gru, lstm) must reject them
+// up front instead of reading out of bounds.
+static bool require_float(Model& m, const Tensor& t, const char* op_type,
+                          const char* slot) {
+  if (t.is_int) {
+    m.error = std::string(op_type) + ": integral tensor fed to float slot " +
+              slot + " (cast it first)";
+    return false;
+  }
+  return true;
+}
+
 static void softmax_lastdim(const Tensor& x, Tensor* y) {
   y->shape = x.shape;
   y->is_int = false;
@@ -420,6 +434,7 @@ static bool eltwise(Model& m, const OpDesc& op, char kind) {
 
 static bool conv2d(Model& m, const OpDesc& op) {
   Tensor& x = m.vars[op.in("Input")];
+  if (!require_float(m, x, "conv2d", "Input")) return false;
   Tensor& w = m.vars[op.in("Filter")];
   Tensor* o = named(m, op.out("Output"));
   auto strides = op.attr_ints("strides");
@@ -464,6 +479,7 @@ static bool conv2d(Model& m, const OpDesc& op) {
 
 static bool pool2d(Model& m, const OpDesc& op) {
   Tensor& x = m.vars[op.in("X")];
+  if (!require_float(m, x, "pool2d", "X")) return false;
   Tensor* o = named(m, op.out("Out"));
   auto ksize = op.attr_ints("ksize");
   auto strides = op.attr_ints("strides");
@@ -513,6 +529,7 @@ static bool run_op(Model& m, const OpDesc& op) {
   if (t == "mul") {
     Tensor& x = m.vars[op.in("X")];
     Tensor& y = m.vars[op.in("Y")];
+    if (!require_float(m, y, "mul", "Y")) return false;
     Tensor* o = named(m, op.out("Out"));
     int xnc = (int)op.attr_num("x_num_col_dims", 1);
     int ync = (int)op.attr_num("y_num_col_dims", 1);
@@ -570,6 +587,7 @@ static bool run_op(Model& m, const OpDesc& op) {
     return true;
   }
   if (t == "softmax") {
+    if (!require_float(m, m.vars[op.in("X")], "softmax", "X")) return false;
     softmax_lastdim(m.vars[op.in("X")], named(m, op.out("Out")));
     return true;
   }
@@ -668,6 +686,7 @@ static bool run_op(Model& m, const OpDesc& op) {
     // matches kernels_nn.py _lrn: window n centred with left pad n/2,
     // out = x * (k + alpha * sum(x^2 over window))^-beta)
     Tensor& x = m.vars[op.in("X")];
+    if (!require_float(m, x, "lrn", "X")) return false;
     Tensor* o = named(m, op.out("Out"));
     int64_t n = (int64_t)op.attr_num("n", 5);
     float kk = (float)op.attr_num("k", 2.0);
@@ -814,6 +833,7 @@ static bool run_op(Model& m, const OpDesc& op) {
     // (c, kh, kw) order, one sequence of oh*ow steps per image (matches
     // kernels_tensor.py _im2sequence / conv_general_dilated_patches)
     Tensor& x = m.vars[op.in("X")];
+    if (!require_float(m, x, "im2sequence", "X")) return false;
     Tensor* o = named(m, op.out("Out"));
     auto ks = op.attr_ints("kernels");
     auto st = op.attr_ints("strides");
@@ -854,6 +874,7 @@ static bool run_op(Model& m, const OpDesc& op) {
     // same math as kernels_rnn.py _gru: w[:, :H]=update, [H:2H]=reset,
     // [2H:]=candidate; x already holds the 3H input projection)
     Tensor& x = m.vars[op.in("Input")];
+    if (!require_float(m, x, "gru", "Input")) return false;
     Tensor& w = m.vars[op.in("Weight")];
     Tensor* bias = op.in("Bias").empty() ? nullptr : &m.vars[op.in("Bias")];
     Tensor* h0 = op.in("H0").empty() ? nullptr : &m.vars[op.in("H0")];
@@ -923,6 +944,7 @@ static bool run_op(Model& m, const OpDesc& op) {
     // same math as kernels_rnn.py _lstm: gate order i,f,c,o in the 4H
     // axis; optional peephole weights ride in bias[4H:7H])
     Tensor& x = m.vars[op.in("Input")];
+    if (!require_float(m, x, "lstm", "Input")) return false;
     Tensor& w = m.vars[op.in("Weight")];
     Tensor* bias = op.in("Bias").empty() ? nullptr : &m.vars[op.in("Bias")];
     Tensor* h0 = op.in("H0").empty() ? nullptr : &m.vars[op.in("H0")];
@@ -1004,6 +1026,7 @@ static bool run_op(Model& m, const OpDesc& op) {
     // per-sequence reduction (reference sequence_pool_op.cc); LAST and
     // FIRST are how sequence_last_step/sequence_first_step lower
     Tensor& x = m.vars[op.in("X")];
+    if (!require_float(m, x, "sequence_pool", "X")) return false;
     Tensor* o = named(m, op.out("Out"));
     if (x.lod.empty()) {
       m.error = "sequence_pool input has no sequence offsets (lod)";
@@ -1124,6 +1147,7 @@ static bool run_op(Model& m, const OpDesc& op) {
     // normalise over trailing dims from begin_norm_axis (reference
     // layer_norm_op.cc), with optional per-feature scale/bias
     Tensor& x = m.vars[op.in("X")];
+    if (!require_float(m, x, "layer_norm", "X")) return false;
     Tensor* scale = op.in("Scale").empty() ? nullptr : &m.vars[op.in("Scale")];
     Tensor* bias = op.in("Bias").empty() ? nullptr : &m.vars[op.in("Bias")];
     Tensor* o = named(m, op.out("Y"));
@@ -1303,20 +1327,58 @@ int ptpu_infer_forward(void* h) {
   m.error.clear();
   for (auto& kv : m.vars)
     if (!m.fed_lod.count(kv.first)) kv.second.lod.clear();
+  // default LoD propagation (reference ShareLoD; Python _share_lod):
+  // restricted to an allowlist of row-preserving op types, mirroring
+  // the Python side's barrier logic — a shape-match heuristic alone
+  // can hand a reshape/elementwise output a coincidental lod. Sequence
+  // ops (im2sequence/gru/lstm/ctc_align/sequence_pool) set or clear
+  // their own lod explicitly and are NOT listed.
+  static const std::set<std::string> kLodPropagate = {
+      "mul",         "matmul",        "elementwise_add", "elementwise_sub",
+      "elementwise_mul", "elementwise_div", "relu",      "sigmoid",
+      "tanh",        "exp",           "sqrt",            "abs",
+      "square",      "softmax",       "scale",           "sum",
+      "dropout",     "batch_norm",    "layer_norm",      "lookup_table",
+      "cast",        "concat"};
+  // reduces over FEATURE axes only stay row-wise (Python _share_lod:
+  // dim excludes 0, no reduce_all, no negative dims)
+  auto reduce_propagates = [](const OpDesc& op) {
+    if (op.attr_bool("reduce_all", false)) return false;
+    std::vector<int64_t> dims = op.attr_ints("dim");
+    if (dims.empty()) dims.push_back((int64_t)op.attr_num("dim", 0));
+    for (int64_t d : dims)
+      if (d <= 0) return false;  // row axis (or negative: conservative)
+    return true;
+  };
   for (auto& op : m.ops) {
     if (!run_op(m, op)) return -1;
-    // default LoD propagation (reference ShareLoD; Python _share_lod):
-    // row-wise ops keep their input's raggedness. Guard: only when the
-    // output's row count matches the ragged input's (reductions and
-    // reshapes drop out naturally).
+    bool is_reduce = op.type == "reduce_sum" || op.type == "reduce_mean" ||
+                     op.type == "reduce_max";
+    if (is_reduce ? !reduce_propagates(op) : !kLodPropagate.count(op.type))
+      continue;
+    // pick the ragged source positionally: prefer the canonical data
+    // slot ("X" / "Input") over std::map iteration order so e.g.
+    // elementwise(X=ragged, Y=broadcast) never inherits from Y.
     const Tensor* src = nullptr;
-    for (auto& kv : op.inputs)
-      for (auto& nm : kv.second) {
-        auto it = m.vars.find(nm);
-        if (it != m.vars.end() && !it->second.lod.empty()) {
-          src = &it->second;
-          break;
+    for (const char* slot : {"X", "Input", "Ids"}) {
+      auto sit = op.inputs.find(slot);
+      if (sit == op.inputs.end() || sit->second.empty()) continue;
+      auto it = m.vars.find(sit->second[0]);
+      if (it != m.vars.end() && !it->second.lod.empty()) {
+        src = &it->second;
+        break;
+      }
+    }
+    if (!src)
+      for (auto& kv : op.inputs) {
+        for (auto& nm : kv.second) {
+          auto it = m.vars.find(nm);
+          if (it != m.vars.end() && !it->second.lod.empty()) {
+            src = &it->second;
+            break;
+          }
         }
+        if (src) break;
       }
     if (src)
       for (auto& kv : op.outputs)

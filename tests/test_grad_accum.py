@@ -136,3 +136,23 @@ def test_accum_rejects_bad_configs():
             infer, feed={"x": np.zeros((4, 4), np.float32)},
             fetch_list=[out], micro_batches=2,
         )
+
+
+def test_accum_warns_on_sum_reduced_loss():
+    """ADVICE r4: averaging chunk gradients is exact only for
+    mean-reduced losses — a sum-reduced loss must raise a warning."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[12], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.square_error_cost(input=pred, label=y)
+        loss = fluid.layers.reduce_sum(cost, dim=0, keep_dim=False)
+        loss = fluid.layers.reshape(x=loss, shape=[1])
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    xs, ys = _data(n=8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.warns(UserWarning, match="SUM reduction"):
+        exe.run_grad_accum(main, feed={"x": xs, "y": ys},
+                           fetch_list=[loss], micro_batches=2)

@@ -116,6 +116,44 @@ def test_raw_operator_factory():
     np.testing.assert_allclose(np.asarray(out), [2.0, 4.0, 6.0])
 
 
+def test_raw_operator_inplace_output():
+    """ADVICE r4 (medium): an in-place output slot (sgd ParamOut names the
+    existing param) must land in outputs, not inputs — previously the
+    existing-var heuristic classified it as input and the update was a
+    silent no-op. Slot direction now comes from the op's output-slot
+    table (reference resolves from OpProto, op.py:19)."""
+    from paddle_tpu.fluid.op import Operator
+
+    main = fluid.Program()
+    block = main.global_block()
+    block.create_parameter(name="ip_w", shape=[3], dtype="float32")
+    block.create_var(name="ip_g")
+    block.create_var(name="ip_lr")
+    op = Operator(
+        "sgd",
+        Param=["ip_w"],
+        Grad=["ip_g"],
+        LearningRate=["ip_lr"],
+        ParamOut=["ip_w"],
+    )
+    desc = op.append_to(block)
+    assert "ParamOut" in desc.outputs and desc.outputs["ParamOut"] == ["ip_w"]
+    assert "ParamOut" not in desc.inputs
+    sc = fluid.executor.Scope()
+    sc.set("ip_w", np.array([1.0, 2.0, 3.0], np.float32))
+    with fluid.executor.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (w,) = exe.run(
+            main,
+            feed={
+                "ip_g": np.array([1.0, 1.0, 1.0], np.float32),
+                "ip_lr": np.array([0.5], np.float32),
+            },
+            fetch_list=["ip_w"],
+        )
+    np.testing.assert_allclose(np.asarray(w), [0.5, 1.5, 2.5])
+
+
 def test_v2_op_module_math():
     """paddle.v2.op surface: unary ops + arithmetic on layers build mixed
     / slope_intercept graphs that train through the v2 path."""
