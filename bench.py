@@ -125,8 +125,11 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False):
     tunnel that can only ADD time); the median-based estimate, every
     raw chunk timing, the spreads, and a `stable` verdict are all
     reported so the record can be audited and two runs compared."""
+    warm_s = {}
     for s in (s_lo, s_hi):
+        t0 = time.time()
         run_at(s)  # compile + warm
+        warm_s[s] = time.time() - t0
     raw = {s_lo: [], s_hi: []}
     rounds = 0
     while True:
@@ -151,6 +154,11 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False):
     assert dt > 0, "timing inversion: %r" % raw
     info = {
         "steps": [s_lo, s_hi],
+        # trace+compile+first-execution per signature (each step count
+        # jits its own scan): the compile-time budget column (r4 verdict
+        # #9 — the reference tracked per-step op-creation overhead,
+        # executor.cc:119; ours moved to compile time)
+        "warm_s": {str(s): round(warm_s[s], 2) for s in warm_s},
         "raw_chunk_s": {
             str(s): [round(t, 4) for t in raw[s]] for s in raw
         },
@@ -658,6 +666,38 @@ def main():
     import threading
 
     _state = {"headline": None, "workloads": {}}
+
+    def _run_offline(reason):
+        """Regenerate BENCH_offline_r05.json (AOT v5e HLO + cost
+        analysis — perf evidence that survives tunnel outages, r4
+        verdict #2) in a subprocess on the host backend. Bounded by the
+        SMALLER of BENCH_OFFLINE_TIMEOUT_S and the time left before the
+        total-budget watchdog, so it can never eat the contract line."""
+        if os.environ.get("BENCH_OFFLINE", "1") != "1":
+            return {"skipped": "BENCH_OFFLINE=0"}
+        import subprocess
+
+        budget = float(os.environ.get("BENCH_OFFLINE_TIMEOUT_S", "900"))
+        if _DEADLINE is not None:
+            budget = min(budget, _DEADLINE - time.monotonic() - 60)
+        if budget < 120:
+            return {"skipped": "under 120s of total budget left"}
+        t0 = time.time()
+        try:
+            p = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_offline.py")],
+                capture_output=True, text=True, timeout=budget,
+            )
+            rec = {"ok": p.returncode == 0,
+                   "seconds": round(time.time() - t0, 1), "reason": reason}
+            if p.returncode != 0:
+                rec["tail"] = (p.stdout[-200:] + p.stderr[-200:])
+            return rec
+        except Exception as e:
+            return {"error": "%s: %s" % (type(e).__name__, e)}
+
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "1200"))
     total_timeout = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "7200"))
     global _DEADLINE
@@ -667,6 +707,11 @@ def main():
     def _watchdog():
         start = time.monotonic()
         if not init_done.wait(init_timeout):
+            # outage day: still leave auditable perf evidence behind
+            # (offline v5e AOT artifact), then the error contract line
+            print(json.dumps({"offline_artifact":
+                              _run_offline("device init timed out")}),
+                  flush=True)
             print(
                 json.dumps({
                     "metric": "bench_error",
@@ -852,6 +897,12 @@ def main():
     if not quick:
         run("resnet50_input_pipeline",
             lambda: bench_resnet50_recordio(batch, chunk_steps, n_chunks))
+
+    # refresh the offline v5e AOT artifact so it always matches the code
+    # that produced this record (_run_offline itself skips when the
+    # total budget is nearly spent: the artifact is committed, a stale
+    # copy beats a watchdog kill)
+    workloads["offline_artifact"] = _run_offline("post-run refresh")
 
     _bench_finished.set()
     _emit_headline()

@@ -1,0 +1,331 @@
+"""Offline perf artifact: AOT-compile the bench workloads for TPU v5e
+WITHOUT a chip (VERDICT r4 next-#2 — perf evidence must survive tunnel
+outages).
+
+`jax.experimental.topologies` provides a v5e topology description that
+the TPU compiler accepts on any host, so every workload here is lowered
+and compiled by the REAL XLA:TPU pipeline (including Mosaic for the
+Pallas flash-attention kernel — the compile path CI's interpret=True
+mode never exercises). The artifact persists, per workload:
+
+  hlo_sha256        fingerprint of the scheduled TPU HLO — changes iff
+                    the compiled step changes, so perf-relevant diffs
+                    are visible between on-chip bench windows
+  flops / bytes_accessed   XLA:TPU cost analysis of the whole step
+  roofline          cost-model step time on v5e (max of MXU time and
+                    HBM time), predicted throughput, and the bound
+  trace_s/compile_s trace+compile budget (VERDICT r4 next-#9)
+  top_ops           largest per-op rows by attributed HBM traffic
+                    (fluid/profiler.py parse_hlo_op_costs over the op
+                    provenance tags lowering stamps into HLO metadata)
+
+Run standalone (`python bench_offline.py`) or via bench.py, which
+spawns it before device init so outage days still produce it. Writes
+BENCH_offline_r05.json (override: BENCH_OFFLINE_PATH).
+
+Reference anchors: benchmark/paddle/image/resnet.py:1 (headline
+workload), benchmark/README.md:37,50,119 (baseline table).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+PEAK_FLOPS = 197e12  # TPU v5e bf16
+HBM_BW = 819e9       # TPU v5e HBM bytes/s
+
+TOPOLOGY = os.environ.get("BENCH_OFFLINE_TOPOLOGY", "v5e:2x4")
+# repo-anchored, not cwd-relative: a bench.py run from elsewhere must
+# still refresh the COMMITTED artifact
+OUT_PATH = os.environ.get("BENCH_OFFLINE_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_offline_r05.json"
+)
+TOP_OPS = int(os.environ.get("BENCH_OFFLINE_TOP_OPS", "8"))
+
+
+def _sds(tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+        if hasattr(a, "shape")
+        else a,
+        tree,
+    )
+
+
+def _cost_record(lowered, t_trace, unit_name=None, units_per_step=None):
+    """Compile a lowered computation and distill the offline record."""
+    from paddle_tpu.fluid.profiler import parse_hlo_op_costs
+
+    t0 = time.time()
+    compiled = lowered.compile()
+    compile_s = time.time() - t0
+    txt = compiled.as_text()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    ca = ca or {}
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    rows = parse_hlo_op_costs(txt)
+    top = sorted(rows.items(), key=lambda kv: -kv[1]["bytes"])[:TOP_OPS]
+    rec = {
+        "hlo_sha256": hashlib.sha256(txt.encode()).hexdigest(),
+        "hlo_instructions": sum(r["instructions"] for r in rows.values()),
+        "flops": flops,
+        "bytes_accessed": byts,
+        "trace_s": round(t_trace, 2),
+        "compile_s": round(compile_s, 2),
+        "top_ops": [
+            {"op": k, "bytes": v["bytes"], "instructions": v["instructions"]}
+            for k, v in top
+        ],
+    }
+    # flops can be negative when the step contains custom calls the cost
+    # model cannot see through (Mosaic kernels) — report, don't predict
+    if flops > 0 and byts > 0:
+        t_roof = max(flops / PEAK_FLOPS, byts / HBM_BW)
+        rec["roofline"] = {
+            "ms": round(t_roof * 1e3, 3),
+            "bound": "hbm" if flops / byts < PEAK_FLOPS / HBM_BW else "mxu",
+            "ai_flops_per_byte": round(flops / byts, 1),
+        }
+        if unit_name and units_per_step:
+            rec["roofline"]["pred_%s" % unit_name] = round(
+                units_per_step / t_roof, 1
+            )
+    return rec, txt
+
+
+def _lower_program_step(prog, cost, feed, mesh, scope):
+    """Mirror the executor's sharded jit of a training program, but lower
+    only (no execution — the mesh devices are topology descriptions)."""
+    import jax
+
+    from paddle_tpu.fluid.core.lowering import build_step_fn
+    from paddle_tpu.fluid.executor import _mesh_jit_kwargs
+
+    persist_names = sorted(v.name for v in prog.list_vars() if v.persistable)
+    persist_in = {n: scope.get(n) for n in persist_names if n in scope}
+    fn, persist_out = build_step_fn(
+        prog,
+        feed_names=list(feed),
+        fetch_names=[cost.name],
+        persist_names=persist_names,
+        persist_in=list(persist_in),
+    )
+    kwargs = _mesh_jit_kwargs(
+        mesh, prog, feed, list(persist_in), persist_out, [cost.name]
+    )
+    t0 = time.time()
+    lowered = jax.jit(fn, donate_argnums=(0,), **kwargs).lower(
+        _sds(persist_in), _sds(feed), jax.random.PRNGKey(0)
+    )
+    return lowered, time.time() - t0
+
+
+def _init_params(prog_builder):
+    """Build a program + run its startup on the host CPU backend, return
+    (main, cost, scope). Params are initialised on CPU purely to obtain
+    shapes/dtypes for AOT lowering."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup, cost = prog_builder()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+    return main, cost, scope
+
+
+def offline_resnet50(topo_devices, batch):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import parallel
+    from bench import _build_image_workload
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    main, cost, scope = _init_params(
+        lambda: _build_image_workload(
+            fluid, lambda i, c: resnet_imagenet(i, class_dim=c, depth=50),
+            batch,
+        )
+    )
+    feed = {
+        "image": np.zeros((batch, 3, 224, 224), np.float32),
+        "label": np.zeros((batch, 1), np.int32),
+    }
+    mesh = parallel.make_mesh({"data": 1}, devices=topo_devices[:1])
+    lowered, t_trace = _lower_program_step(main, cost, feed, mesh, scope)
+    rec, _ = _cost_record(lowered, t_trace, "img_per_sec", batch)
+    rec["batch"] = batch
+    return rec
+
+
+def offline_resnet50_dp(topo_devices, batch_per_chip):
+    """The same train step data-parallel over all topology chips — the
+    SPMD partitioner + ICI collectives compiled by the real TPU
+    pipeline (the on-chip analogue of dryrun_multichip's CPU mesh)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import parallel
+    from bench import _build_image_workload
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    n = len(topo_devices)
+    batch = batch_per_chip * n
+    main, cost, scope = _init_params(
+        lambda: _build_image_workload(
+            fluid, lambda i, c: resnet_imagenet(i, class_dim=c, depth=50),
+            batch,
+        )
+    )
+    feed = {
+        "image": np.zeros((batch, 3, 224, 224), np.float32),
+        "label": np.zeros((batch, 1), np.int32),
+    }
+    mesh = parallel.make_mesh({"data": n}, devices=topo_devices)
+    lowered, t_trace = _lower_program_step(main, cost, feed, mesh, scope)
+    rec, txt = _cost_record(lowered, t_trace, "img_per_sec", batch)
+    rec["batch"] = batch
+    rec["n_chips"] = n
+    # count the collectives the partitioner inserted (the gradient
+    # all-reduce story in one number)
+    rec["collectives"] = {
+        k: txt.count(k)
+        for k in ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+    }
+    return rec
+
+
+def offline_flash_attention(topo_devices, B=4, T=4096, H=16, D=64):
+    """Mosaic-compile the Pallas flash-attention kernel (fwd + bwd) —
+    the interpret=False path CI cannot run — and the XLA full-matrix
+    attention it replaces, for a cost-model comparison."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel.flash_attention import flash_attention
+
+    mesh = Mesh(np.asarray(topo_devices[:1]).reshape(1,), ("d",))
+    rep = NamedSharding(mesh, P())
+    q = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+
+    def fa_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True))
+
+    def xla_loss(q, k, v):
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * (D ** -0.5)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, vt))
+
+    out = {"shape": [B, T, H, D]}
+    for name, fn in (("flash_mosaic", fa_loss), ("xla_attention", xla_loss)):
+        t0 = time.time()
+        lowered = jax.jit(
+            jax.grad(fn, argnums=(0, 1, 2)),
+            in_shardings=(rep, rep, rep),
+        ).lower(q, q, q)
+        out[name], _ = _cost_record(lowered, time.time() - t0)
+    # the falsifiable claim: Mosaic compilation of the Pallas kernel
+    # SUCCEEDED for v5e (hlo_sha256 present) — runtime superiority still
+    # needs the chip (bench.py flash_attention workload)
+    out["mosaic_compiled"] = "hlo_sha256" in out["flash_mosaic"]
+    return out
+
+
+def offline_transformer_lm(topo_devices, B=8, T=1024, dim=512, heads=8,
+                           layers_n=8, vocab=32000):
+    """The long-context flagship LM train step (bench.py
+    bench_transformer_lm) with the FLASH attention impl — on TPU the
+    bench uses Mosaic flash; compiling the same composition offline
+    keeps that path honest between on-chip windows."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.models import transformer as tlm
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=T,
+                                dtype=jnp.bfloat16)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    step = tlm.make_train_step(cfg, lr=1e-3, attn_impl="flash")
+    mesh = Mesh(np.asarray(topo_devices[:1]).reshape(1,), ("d",))
+    rep = NamedSharding(mesh, P())
+    toks = jax.ShapeDtypeStruct((B, T + 1), jnp.int32)
+    t0 = time.time()
+    lowered = jax.jit(step, in_shardings=(rep, rep)).lower(
+        _sds(params), toks
+    )
+    rec, _ = _cost_record(lowered, time.time() - t0, "tokens_per_sec", B * T)
+    rec["shape"] = {"B": B, "T": T, "dim": dim, "layers": layers_n}
+    rec["attn_impl"] = "flash"
+    return rec
+
+
+def main():
+    import jax
+
+    # the artifact must build with the tunnel down: host backend only
+    jax.config.update("jax_platforms", "cpu")
+    from jax.experimental import topologies
+
+    t_all = time.time()
+    td = topologies.get_topology_desc(platform="tpu", topology_name=TOPOLOGY)
+    topo_devices = list(np.asarray(td.devices).ravel())
+
+    artifact = {
+        "topology": TOPOLOGY,
+        "n_topology_chips": len(topo_devices),
+        "peak_flops": PEAK_FLOPS,
+        "hbm_bw": HBM_BW,
+        "workloads": {},
+    }
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    jobs = [
+        ("resnet50_train", lambda: offline_resnet50(topo_devices, batch)),
+        ("resnet50_train_dp%d" % len(topo_devices),
+         lambda: offline_resnet50_dp(topo_devices, batch_per_chip=32)),
+        ("flash_attention", lambda: offline_flash_attention(topo_devices)),
+        ("transformer_lm", lambda: offline_transformer_lm(topo_devices)),
+    ]
+    only = os.environ.get("BENCH_OFFLINE_ONLY")
+    for name, fn in jobs:
+        if only and name not in only.split(","):
+            continue
+        try:
+            artifact["workloads"][name] = fn()
+        except Exception as e:
+            artifact["workloads"][name] = {
+                "error": "%s: %s" % (type(e).__name__, e)
+            }
+        print(
+            json.dumps({"offline_workload": name,
+                        "ok": "error" not in artifact["workloads"][name]}),
+            flush=True,
+        )
+    artifact["total_s"] = round(time.time() - t_all, 1)
+    with open(OUT_PATH, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"offline_artifact": OUT_PATH,
+                      "total_s": artifact["total_s"]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -237,3 +237,54 @@ def _proximal_adagrad(ctx, ins, attrs):
     prox = p - lr * g / jnp.sqrt(m_out)
     return {"ParamOut": _prox_project(prox, lr, attrs),
             "MomentOut": m_out}
+
+
+@register_op("average_accumulates")
+def _average_accumulates(ctx, ins, attrs):
+    """Sliding-window parameter averaging accumulator (reference
+    parameter/AverageOptimizer.cpp:60-115 needSpecialTraversal/
+    finishBatch; proto TrainerConfig.proto:70-75: "between
+    average_window*N and 2*average_window*N parameters are used").
+
+    Per step: sum_1 += param, counters advance; every kMaxNumAccumulates
+    steps sum_1 folds into sum_2 (precision); when the accumulated
+    window exceeds min(max_average_window, num_updates*average_window)
+    the sums shift into sum_3 and the window restarts. The averaged
+    parameter is (sum_1+sum_2+sum_3)/(num_accumulates +
+    old_num_accumulates) — an exact arithmetic mean over the last
+    [W, 2W] iterates, unlike an EMA.
+
+    All branches lower to jnp.where selects: no data-dependent control
+    flow enters the compiled step.
+    """
+    p = ins["Param"][0]
+    s1, s2, s3 = ins["InSum1"][0], ins["InSum2"][0], ins["InSum3"][0]
+    na = ins["InNumAccumulates"][0]
+    ona = ins["InOldNumAccumulates"][0]
+    nu = ins["InNumUpdates"][0]
+    rate = float(attrs.get("average_window", 0.0))
+    max_w = int(attrs.get("max_average_window", 10000))
+    min_w = int(attrs.get("min_average_window", 10000))
+    k_max = int(attrs.get("k_max_num_accumulates", 16384))
+
+    nu = nu + 1
+    na = na + 1
+    s1 = s1 + p.astype(s1.dtype)
+    fold = (nu % k_max) == 0
+    s2 = jnp.where(fold, s2 + s1, s2)
+    s1 = jnp.where(fold, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.asarray(float(max_w), jnp.float32),
+        nu.astype(jnp.float32) * rate,
+    )
+    shift = (na >= min_w) & (na.astype(jnp.float32) >= window)
+    s3 = jnp.where(shift, s1 + s2, s3)
+    s1 = jnp.where(shift, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(shift, jnp.zeros_like(s2), s2)
+    ona = jnp.where(shift, na, ona)
+    na = jnp.where(shift, jnp.zeros_like(na), na)
+    return {
+        "OutSum1": s1, "OutSum2": s2, "OutSum3": s3,
+        "OutNumAccumulates": na, "OutOldNumAccumulates": ona,
+        "OutNumUpdates": nu,
+    }

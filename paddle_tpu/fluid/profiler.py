@@ -300,6 +300,9 @@ def compiled_profile(exe, program, feed, fetch_list, runs=3,
         entry, avals, host_args = exe._last_exec
     finally:
         exe._capture_avals = False
+        # the host snapshot is a full copy of every param: don't park it
+        # on the executor past this call
+        exe._last_exec = None
     lowered = entry.lower(*avals)
     compiled = lowered.compile()
     rows = parse_hlo_op_costs(compiled.as_text())

@@ -366,6 +366,11 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,
 __all__ += ["init_kv_cache", "decode_step", "prefill", "generate"]
 
 
+# diagnostics of the last eager beam_search_generate call: executed vs
+# maximum decode steps (early exit stops at all-beams-dead)
+LAST_DECODE_STATS = {}
+
+
 def beam_search_generate(params, prompt, cfg: TransformerConfig,
                          max_new_tokens, beam_size=4, alpha=0.0,
                          max_len=None):
@@ -449,10 +454,33 @@ def beam_search_generate(params, prompt, cfg: TransformerConfig,
         cache = jax.tree_util.tree_map(reorder, cache)
         return (tokens, new_scores, alive, cache), None
 
-    (tokens, scores, alive, _), _ = jax.lax.scan(
-        body, (tokens, scores, alive, cache),
-        jnp.arange(max_new_tokens - 1),
+    # early exit (reference RecurrentGradientMachine.h:309): stop the
+    # moment every beam of every source has emitted eos. lax.while_loop
+    # instead of a fixed-trip scan; positions past the exit step are
+    # back-filled with eos — exactly what the skipped iterations would
+    # have written (dead beams re-emit eos at frozen score), so the
+    # result is bit-identical to the full schedule.
+    def w_cond(state):
+        i, carry = state
+        _, _, alive_c, _ = carry
+        return (i < max_new_tokens - 1) & jnp.any(alive_c)
+
+    def w_body(state):
+        i, carry = state
+        carry, _ = body(carry, i)
+        return i + 1, carry
+
+    steps_done, (tokens, scores, alive, _) = jax.lax.while_loop(
+        w_cond, w_body, (jnp.asarray(0), (tokens, scores, alive, cache))
     )
+    # positions beyond the last written token (T0 + steps_done) hold the
+    # zero-init; the skipped all-dead steps would have written eos
+    fill = jnp.arange(T_out) > (T0 + steps_done)
+    tokens = jnp.where(fill[None, None, :], jnp.asarray(eos, tokens.dtype),
+                       tokens)
+    if not isinstance(steps_done, jax.core.Tracer):
+        LAST_DECODE_STATS["steps_executed"] = int(steps_done)
+        LAST_DECODE_STATS["max_steps"] = int(max_new_tokens - 1)
     # GNMT length penalty: ((5 + len) / 6)^alpha
     lens = (tokens[:, :, T0:] != eos).sum(-1) + 1
     penal = jnp.power((5.0 + lens.astype(jnp.float32)) / 6.0, alpha)

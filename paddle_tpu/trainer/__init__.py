@@ -523,7 +523,7 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
         # evaluate on the averaged weights a checkpoint carries (same
         # apply/restore the v2 tester does)
         _ma = fluid.optimizer.ModelAverage.from_spec(ma_spec).attach(scope)
-        if _ma._avg_names and _ma._steps_name:
+        if _ma._param_names and _ma._steps_name:
             eval_avg_ctx = _ma.apply(scope=scope)
 
     try:

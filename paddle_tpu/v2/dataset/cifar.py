@@ -22,6 +22,10 @@ from . import common
 
 __all__ = ["train10", "test10", "train100", "test100", "fetch", "convert"]
 
+# genuine-download checksums (reference dataset/cifar.py:41-43)
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+CIFAR100_MD5 = "eb9058c3a382ffc7106e4002c42a8d85"
+
 _TAR10 = "cifar-10-python.tar.gz"
 
 

@@ -6,7 +6,15 @@ import os
 
 import numpy as np
 
-DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+# PADDLE_TPU_DATA_HOME points the cache at GENUINE downloads (the
+# reference's ~/.cache/paddle/dataset layout, common.py:37): on a
+# connected machine, place the real archives there and every reader
+# decodes them instead of the synthetic corpus (r4 verdict #7;
+# tests/test_real_archives.py verifies against the reference md5s)
+DATA_HOME = (
+    os.environ.get("PADDLE_TPU_DATA_HOME")
+    or os.path.expanduser("~/.cache/paddle_tpu/dataset")
+)
 
 __all__ = ["DATA_HOME", "rng_for", "md5file", "download", "convert",
            "read_converted", "fetch_all"]

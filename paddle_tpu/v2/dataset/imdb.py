@@ -22,6 +22,9 @@ from . import common
 
 __all__ = ["build_dict", "word_dict", "train", "test", "fetch", "convert"]
 
+# genuine-download checksum (reference dataset/imdb.py:32)
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
 N_TRAIN, N_TEST = 256, 64  # reviews per split (half pos, half neg)
 
 _POS_POOL = ["great", "wonderful", "superb", "moving", "delight",

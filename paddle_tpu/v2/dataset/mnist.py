@@ -23,6 +23,13 @@ __all__ = ["train", "test", "fetch", "convert"]
 
 N_TRAIN, N_TEST = 512, 128
 
+# genuine-download checksums (reference dataset/mnist.py:28-34) — used
+# by tests/test_real_archives.py to tell real archives from synthetics
+TRAIN_IMAGE_MD5 = "f68b3c2dcbeaaa9fbdd348bbdeb94873"
+TRAIN_LABEL_MD5 = "d53e105ee54ea40749a09fcbcd1e9432"
+TEST_IMAGE_MD5 = "9fb629c4189551a2d022fa330f9573f3"
+TEST_LABEL_MD5 = "ec29112dd5afa0611ce80d1b7f02629c"
+
 _FILES = {
     "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
     "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),

@@ -417,6 +417,44 @@ def main():
         while True:
             time.sleep(0.2)
 
+    elif role == "hybrid16":
+        # r5 (verdict #8): 2 processes x 8 virtual devices = 16-way
+        # hybrid mesh, dcn=2 (across processes) x data=4 x model=2
+        # (within a slice) — batch shards over dcn x data, classifier
+        # weight TP over model. No coordinator: shards are by rank.
+        port, pid, nproc, steps = sys.argv[4:8]
+        from paddle_tpu.parallel.mesh import DistributedContext
+
+        DistributedContext.initialize(
+            coordinator_address="localhost:%s" % port,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.parallel import set_default_mesh
+        from paddle_tpu.parallel.mesh import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh(
+            {"dcn": int(nproc)}, {"data": 4, "model": 2}
+        )
+        set_default_mesh(mesh)
+        main_p, startup, loss = build_hybrid_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        per = N_SEQS // int(nproc)
+        lo, hi = int(pid) * per, (int(pid) + 1) * per
+        result["losses"] = train_lstm_steps(
+            exe, main_p, loss, int(steps), lo, hi
+        )
+        w = fluid.global_scope().get("fc_0.w_0")
+        result["tp_sharded"] = bool(
+            isinstance(w, jax.Array) and not w.is_fully_replicated
+        )
+        result["mesh_shape"] = {k: int(v) for k, v in mesh.shape.items()}
+        result["n_global_devices"] = int(mesh.devices.size)
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
     elif role == "hybrid_resume":
         # N->M elastic resume (M=1): reclaim every dead worker's expired
         # lease from the coordinator, restore the merged sharded

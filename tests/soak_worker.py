@@ -1,0 +1,101 @@
+"""Soak-test worker (r4 verdict #8): a long CNN train with periodic
+checkpoints, SIGKILL-able and resumable, reporting executor-cache size
+and RSS so the test can assert both stay bounded.
+
+Usage: soak_worker.py OUT_JSON CKPT_DIR TOTAL_STEPS PROGRESS_FILE
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import checkpoint as ckpt
+
+CKPT_EVERY = 25
+
+
+def _rss_mb():
+    with open("/proc/self/statm") as f:
+        pages = int(f.read().split()[1])
+    return pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.nets.simple_img_conv_pool(
+            input=img, num_filters=8, filter_size=3, pool_size=2,
+            pool_stride=2, act="relu")
+        h = fluid.nets.simple_img_conv_pool(
+            input=h, num_filters=16, filter_size=3, pool_size=2,
+            pool_stride=2, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    out_path, ckpt_dir, total_steps, progress = sys.argv[1:5]
+    total_steps = int(total_steps)
+    main_p, startup, loss = build()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    # one fixed dataset of 8 batches cycled: loss must fall over the run
+    batches = [
+        (rng.rand(16, 3, 32, 32).astype(np.float32),
+         rng.randint(0, 10, (16, 1)).astype(np.int64))
+        for _ in range(8)
+    ]
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        start = 0
+        resumed_from = None
+        if ckpt.latest_step(ckpt_dir) is not None:
+            meta = ckpt.load_checkpoint(scope, ckpt_dir)
+            resumed_from = int(meta["step"])
+            start = resumed_from + 1
+        losses = []
+        rss_warm = None
+        for step in range(start, total_steps):
+            xs, ys = batches[step % len(batches)]
+            (lv,) = exe.run(main_p, feed={"img": xs, "y": ys},
+                            fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+            if step == min(start + 50, total_steps - 1):
+                rss_warm = _rss_mb()
+            if step % CKPT_EVERY == 0:
+                ckpt.save_checkpoint(scope, ckpt_dir, step=step)
+            with open(progress, "w") as f:
+                f.write(str(step))
+        result = {
+            "steps_done": total_steps,
+            "resumed_from": resumed_from,
+            "first_loss": losses[0] if losses else None,
+            "last_loss": losses[-1] if losses else None,
+            "finite": bool(np.isfinite(losses).all()),
+            "cache_size": len(exe._cache),
+            "rss_warm_mb": rss_warm,
+            "rss_end_mb": _rss_mb(),
+        }
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

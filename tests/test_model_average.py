@@ -1,6 +1,7 @@
-"""fluid.optimizer.ModelAverage: in-graph EMA parameter averaging with
-apply/restore swap (reference v2 averaged parameters / legacy
-ParameterAverager)."""
+"""fluid.optimizer.ModelAverage: in-graph sliding-window parameter
+averaging with apply/restore swap (reference
+parameter/AverageOptimizer.cpp — the exact sum_1/sum_2/sum_3 window
+algorithm, verified against a numpy oracle)."""
 
 import os
 
@@ -9,7 +10,7 @@ import numpy as np
 import paddle_tpu.fluid as fluid
 
 
-def _build(window=20):
+def _build(rate=0.25, min_w=5, max_w=10000):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.layers.data(name="x", shape=[4], dtype="float32")
@@ -17,12 +18,34 @@ def _build(window=20):
         pred = fluid.layers.fc(input=x, size=1)
         loss = fluid.layers.mean(x=fluid.layers.square_error_cost(pred, y))
         fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
-        ma = fluid.optimizer.ModelAverage(average_window=window).build(main)
+        ma = fluid.optimizer.ModelAverage(
+            average_window=rate, min_average_window=min_w,
+            max_average_window=max_w,
+        ).build(main)
     return main, startup, loss, ma
 
 
+def _oracle_average(history, rate, min_w, max_w, k_max=16384):
+    """Numpy oracle of AverageOptimizer.cpp:60-115: returns the value
+    apply() must produce after training through `history` iterates."""
+    z = np.zeros_like(history[0], dtype=np.float64)
+    s1, s2, s3 = z.copy(), z.copy(), z.copy()
+    na = ona = nu = 0
+    for h in history:
+        nu += 1
+        na += 1
+        s1 = s1 + h
+        if nu % k_max == 0:
+            s2, s1 = s2 + s1, z.copy()
+        if na >= min_w and na >= min(max_w, nu * rate):
+            s3, s1, s2 = s1 + s2, z.copy(), z.copy()
+            ona, na = na, 0
+    return (s1 + s2 + s3) / (na + ona)
+
+
 def test_average_tracks_params_and_applies():
-    main, startup, loss, ma = _build(window=20)
+    rate, min_w, max_w = 0.25, 5, 10000
+    main, startup, loss, ma = _build(rate, min_w, max_w)
     rng = np.random.RandomState(0)
     W = rng.randn(4, 1).astype(np.float32)
     scope = fluid.Scope()
@@ -48,32 +71,62 @@ def test_average_tracks_params_and_applies():
 
         # restore puts the live weights back exactly
         np.testing.assert_array_equal(restored, live)
-        # the applied value is the bias-corrected EMA of the history
-        beta = ma.beta
-        ema = np.zeros_like(history[0])
-        for h in history:
-            ema = beta * ema + (1 - beta) * h
-        ema = ema / (1 - beta ** len(history))
-        np.testing.assert_allclose(applied, ema, rtol=1e-4, atol=1e-5)
+        # the applied value matches the reference window algorithm
+        # (60 steps at rate 0.25 crosses several window shifts, so the
+        # sum_3 path and counter resets are all exercised)
+        want = _oracle_average(history, rate, min_w, max_w)
+        np.testing.assert_allclose(applied, want, rtol=1e-4, atol=1e-5)
         # and it differs from the raw last iterate (it is an average)
         assert not np.allclose(applied, live)
+
+
+def test_average_window_shifts_bound_history():
+    """The averaged value reflects only the last [W, 2W] iterates: with
+    rate=1.0 (window == num_updates, never shifts) the average equals
+    the full-history mean; with a small max window it must NOT."""
+    rate, min_w, max_w = 1.0, 1, 10 ** 9
+    main, startup, loss, ma = _build(rate, min_w, max_w)
+    rng = np.random.RandomState(1)
+    W = rng.randn(4, 1).astype(np.float32)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        w_name = main.global_block().all_parameters()[0].name
+        history = []
+        for _ in range(30):
+            xv = rng.randn(16, 4).astype(np.float32)
+            yv = (xv @ W).astype(np.float32)
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            history.append(np.asarray(scope.get(w_name)).copy())
+        with ma.apply(scope=scope):
+            applied = np.asarray(scope.get(w_name)).copy()
+    # rate=1.0: na >= nu*1.0 holds every step, so the window shifts
+    # each step — oracle confirms, and the oracle IS the reference
+    want = _oracle_average(history, rate, min_w, max_w)
+    np.testing.assert_allclose(applied, want, rtol=1e-4, atol=1e-5)
 
 
 def test_average_window_mapping():
     from paddle_tpu.fluid.optimizer import ModelAverage
 
-    assert ModelAverage(average_window=50).window == 100  # min clamp
-    assert ModelAverage(average_window=500).window == 500
     ma = ModelAverage(average_window=0.5, max_average_window=1000)
-    assert ma.window == 500
-    assert 0.0 < ma.beta < 1.0
+    assert ma.average_window == 0.5
+    assert ma.max_average_window == 1000
+    assert ma.min_average_window == 100  # default
+    ma2 = ModelAverage.from_spec(
+        type("S", (), {"average_window": 0.05, "max_average_window": 500})()
+    )
+    assert ma2.average_window == 0.05
+    # reference: minAverageWindow = min(10000, max_average_window)
+    assert ma2.min_average_window == 500
 
 
 def test_averaged_eval_loss_is_sane():
     """Evaluating under ma.apply() on a noisy-SGD run: the averaged
     weights' loss is finite and close to (or better than) the live
     weights' on the true relation."""
-    main, startup, loss, ma = _build(window=30)
+    main, startup, loss, ma = _build(rate=0.3, min_w=3)
     infer = None
     rng = np.random.RandomState(3)
     W = rng.randn(4, 1).astype(np.float32)
@@ -122,8 +175,8 @@ def test_opt_out_and_premature_apply():
         p.name for p in main.global_block().all_parameters()
         if getattr(p, "do_model_average", None) is False
     ]
-    assert opted_out and all(n not in ma._avg_names for n in opted_out)
-    assert len(ma._avg_names) >= 1
+    assert opted_out and all(n not in ma._param_names for n in opted_out)
+    assert len(ma._param_names) >= 1
 
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
@@ -159,8 +212,9 @@ def test_v2_trainer_model_average():
     trainer = paddle.trainer.SGD(cost=cost, parameters=params,
                                  update_equation=opt)
     assert trainer._model_average is not None
-    # requested window honored exactly (no silent min-clamp inflation)
-    assert trainer._model_average.window == 25.0
+    assert trainer._model_average.average_window == 0.05
+    # reference-derived min window: min(10000, max_average_window=500)
+    assert trainer._model_average.min_average_window == 500
 
     rng = np.random.RandomState(0)
     W = rng.randn(4, 1).astype(np.float32)
@@ -190,8 +244,8 @@ def test_v2_trainer_model_average():
     trainer.save_parameter_to_tar(buf)
     buf.seek(0)
     loaded = paddle.parameters.Parameters.from_tar(buf)
-    avg_name = w_name + fluid.optimizer.ModelAverage.AVG_SUFFIX
-    assert avg_name in params.scope.keys()  # the EMA slot trains along
+    avg_name = w_name + fluid.optimizer.ModelAverage.SUM_SUFFIXES[0]
+    assert avg_name in params.scope.keys()  # the sum slot trains along
     exported = loaded.get(w_name)
     assert not np.allclose(exported, live)  # averaged, not last iterate
 
@@ -221,7 +275,7 @@ def test_cli_settings_model_average_slots_in_checkpoint(tmp_path):
 
     scope = fluid.Scope()
     got = ckpt.load_checkpoint(scope, os.path.join(save, "pass-00000"))
-    avg_keys = [k for k in scope.keys() if k.endswith("@MODEL_AVG")]
+    avg_keys = [k for k in scope.keys() if k.endswith("@SUM_1")]
     assert avg_keys, sorted(scope.keys())
     steps = [k for k in scope.keys() if "model_average_steps" in k]
     assert steps and float(np.ravel(np.asarray(scope.get(steps[0])))[0]) > 0

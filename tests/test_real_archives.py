@@ -1,0 +1,121 @@
+"""Real-download decode proof (r4 verdict #7; reference
+python/paddle/v2/dataset/common.py:37 md5-checked download).
+
+The zero-egress harness only ever feeds the readers synthesised
+real-FORMAT files; these tests run against GENUINE archives when an
+operator points ``PADDLE_TPU_DATA_HOME`` at a reference-layout download
+cache (``<home>/mnist/train-images-idx3-ubyte.gz`` etc.). Each test
+md5-verifies the archive against the reference checksum first — a
+synthesized stand-in never matches, so off-harness these skip rather
+than false-pass — then decodes real samples and trains a few fluid
+steps on them.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.v2.dataset import cifar, common, imdb, mnist
+
+
+def _genuine(path, md5):
+    """Present AND byte-identical to the published archive."""
+    return os.path.exists(path) and common.md5file(path) == md5
+
+
+def _require(path, md5, what):
+    if not os.path.exists(path):
+        pytest.skip("no %s archive at %s (set PADDLE_TPU_DATA_HOME to a "
+                    "real download cache)" % (what, path))
+    if common.md5file(path) != md5:
+        pytest.skip("%s at %s is not the genuine download (md5 mismatch "
+                    "vs reference checksum)" % (what, path))
+
+
+def _train_few_steps(samples, dim, n_classes):
+    """Train a softmax classifier on decoded samples for a few steps;
+    the loss must be finite and decrease is not required (2 steps)."""
+    import paddle_tpu.fluid as fluid
+
+    xs = np.stack([np.asarray(s[0], np.float32) for s in samples])
+    ys = np.asarray([[int(s[1])] for s in samples], np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[dim], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(input=x, size=n_classes, act="softmax")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=pred, label=y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(3):
+            (lv,) = exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])
+    assert np.isfinite(np.ravel(lv)).all()
+
+
+def test_real_mnist_decodes_and_trains():
+    d = os.path.join(common.DATA_HOME, "mnist")
+    _require(os.path.join(d, "train-images-idx3-ubyte.gz"),
+             mnist.TRAIN_IMAGE_MD5, "MNIST train images")
+    _require(os.path.join(d, "train-labels-idx1-ubyte.gz"),
+             mnist.TRAIN_LABEL_MD5, "MNIST train labels")
+    samples = []
+    for s in mnist.train()():
+        samples.append(s)
+        if len(samples) == 64:
+            break
+    assert len(samples) == 64
+    for img, label in samples:
+        assert img.shape == (784,)
+        assert -1.0 - 1e-6 <= float(img.min()) <= float(img.max()) <= 1.0 + 1e-6
+        assert 0 <= label <= 9
+    # the genuine train split holds 60000 samples; the synthetic only 512
+    n = sum(1 for _ in mnist.train()())
+    assert n == 60000, n
+    _train_few_steps(samples, 784, 10)
+
+
+def test_real_cifar10_decodes_and_trains():
+    path = os.path.join(common.DATA_HOME, "cifar", "cifar-10-python.tar.gz")
+    _require(path, cifar.CIFAR10_MD5, "CIFAR-10")
+    samples = []
+    for s in cifar.train10()():
+        samples.append(s)
+        if len(samples) == 64:
+            break
+    for img, label in samples:
+        assert np.asarray(img).shape == (3072,)
+        assert 0 <= label <= 9
+    _train_few_steps(samples, 3072, 10)
+
+
+def test_real_imdb_decodes_and_trains():
+    path = os.path.join(common.DATA_HOME, "imdb", "aclImdb_v1.tar.gz")
+    _require(path, imdb.MD5, "IMDB")
+    w = imdb.word_dict()
+    assert len(w) > 10000  # genuine vocabulary is ~90k; synthetic ~30
+    samples = []
+    for ids, label in imdb.train(w)():
+        assert label in (0, 1)
+        assert all(0 <= i < len(w) for i in ids)
+        samples.append((ids, label))
+        if len(samples) == 32:
+            break
+    assert len(samples) == 32
+
+
+def test_skip_logic_rejects_synthetic_standins(tmp_path, monkeypatch):
+    """Runs EVERYWHERE: a synthesised real-format archive must NOT pass
+    the genuine-md5 gate — proving the tests above can't false-pass on
+    this harness's stand-ins."""
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    mnist.fetch()  # synthesises real-FORMAT files into the fake home
+    img = os.path.join(str(tmp_path), "mnist", "train-images-idx3-ubyte.gz")
+    assert os.path.exists(img)
+    assert not _genuine(img, mnist.TRAIN_IMAGE_MD5)
