@@ -76,6 +76,7 @@ def _cost_record(lowered, t_trace, unit_name=None, units_per_step=None):
     ca = ca or {}
     flops = float(ca.get("flops", 0.0))
     byts = float(ca.get("bytes accessed", 0.0))
+    opt_s = float(ca.get("optimal_seconds", 0.0))
     rows = parse_hlo_op_costs(txt)
     top = sorted(rows.items(), key=lambda kv: -kv[1]["bytes"])[:TOP_OPS]
     rec = {
@@ -90,6 +91,15 @@ def _cost_record(lowered, t_trace, unit_name=None, units_per_step=None):
             for k, v in top
         ],
     }
+    # the TPU compiler's own performance model: tighter than the naive
+    # roofline (it knows fusion/VMEM prefetch; "bytes accessed" counts
+    # every instruction operand and overcounts true HBM traffic)
+    if opt_s > 0:
+        rec["optimal_seconds"] = opt_s
+        if unit_name and units_per_step:
+            rec["pred_%s_optimal" % unit_name] = round(
+                units_per_step / opt_s, 1
+            )
     # flops can be negative when the step contains custom calls the cost
     # model cannot see through (Mosaic kernels) — report, don't predict
     if flops > 0 and byts > 0:
@@ -320,6 +330,18 @@ def main():
             flush=True,
         )
     artifact["total_s"] = round(time.time() - t_all, 1)
+    # MERGE into the committed artifact: a partial run (BENCH_OFFLINE_ONLY,
+    # or a failed workload) must not destroy the other workloads' HLO
+    # fingerprints — they are the between-windows comparison baseline
+    if os.path.exists(OUT_PATH):
+        try:
+            with open(OUT_PATH) as f:
+                prev = json.load(f)
+            merged = dict(prev.get("workloads", {}))
+            merged.update(artifact["workloads"])
+            artifact["workloads"] = merged
+        except (ValueError, OSError):
+            pass  # corrupt/missing previous artifact: write fresh
     with open(OUT_PATH, "w") as f:
         json.dump(artifact, f, indent=1, sort_keys=True)
         f.write("\n")

@@ -335,9 +335,15 @@ def _while(ctx, ins, attrs):
         sub, ctx._base_key, is_test=ctx.is_test, seq_maxlen=ctx.seq_maxlen
     )
     sub_ctx.amp_region = getattr(ctx, "amp_region", False)
-    # names ops AFTER this while read (early-exit safety gate: state
-    # arrays with dead tails must not be observable downstream)
-    reads = set()
+    # names ops AFTER this while read — directly, through their
+    # sub-blocks (program._sub_block_outer_reads), or via fetch —
+    # (early-exit safety gate: values frozen at the exit step must not
+    # be observable downstream). The counter/cond chain is EXEMPT: under
+    # early exit it intentionally reports the exit step, the reference's
+    # own semantics (RecurrentGradientMachine stops the loop where the
+    # condition turned false).
+    program = ctx.block.program
+    reads = set(getattr(ctx, "fetch_names", ()))
     seen_self = False
     for op in ctx.block.ops:
         if op is ctx.op:
@@ -345,7 +351,11 @@ def _while(ctx, ins, attrs):
             continue
         if seen_self:
             reads |= set(op.input_arg_names)
-    sub_ctx.downstream_reads = reads
+            reads |= program._sub_block_outer_reads(op)
+    cond_chain = set()
+    for cop in _cond_slice_ops(sub, cond_name):
+        cond_chain |= set(cop.output_arg_names)
+    sub_ctx.downstream_reads = reads - cond_chain
     max_iters = attrs.get("max_iters", MAX_WHILE_ITERS)
     written = []
     for op in sub.ops:
@@ -512,7 +522,13 @@ def _while_fori(sub_ctx, sub, env, written, remaining, iters):
             ):
                 beam_arrs.add(n)
         downstream = getattr(sub_ctx, "downstream_reads", set())
-        if (written_arrs - beam_arrs) & downstream or not beam_arrs:
+        # both non-beam arrays AND carried loop variables freeze at the
+        # exit step; if anything after the while reads one, its value
+        # would diverge from the fixed-trip schedule — stay exact
+        if (
+            ((written_arrs - beam_arrs) | set(carried)) & downstream
+            or not beam_arrs
+        ):
             early_exit = False
 
     def body(j, carry):

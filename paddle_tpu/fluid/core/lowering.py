@@ -342,9 +342,13 @@ def _lower_ops(
     is_test: bool = False,
     seq_maxlen=None,
     seq_buckets=None,
+    fetch_names=(),
 ) -> Dict[str, Any]:
     ctx = LoweringContext(block, base_key, is_test=is_test, seq_maxlen=seq_maxlen,
                           seq_buckets=seq_buckets)
+    # fetched names are observed by the caller: the While early-exit
+    # gate treats them as downstream reads (kernels_control.py)
+    ctx.fetch_names = frozenset(fetch_names)
     fwd_ops, ad_op, tail_ops = _split_at_autodiff(ops)
 
     if ad_op is None:
@@ -589,6 +593,7 @@ def build_step_fn(
         env = _lower_ops(
             block, pruned_ops, env, base_key=key, is_test=is_test,
             seq_maxlen=seq_maxlen, seq_buckets=seq_buckets,
+            fetch_names=fetch_names,
         )
         # a fetched sparse gradient is observed as its dense equivalent
         fetches = [as_dense(env[n]) for n in fetch_names]

@@ -57,3 +57,105 @@ class DataFeeder(object):
                     "lod_level>=2 feeds land with the nested-sequence milestone"
                 )
         return out
+
+
+class AsyncDeviceFeeder(object):
+    """Host->device double buffering (r4 verdict #3's prefetch item; the
+    reference's double-buffered DataProvider / PyDataProvider2 async
+    pool, paddle/gserver/dataproviders/DataProvider.h DoubleBuffer):
+    a background thread pulls feed dicts from an iterator and uploads
+    every array to the device AHEAD of the training loop, so the h2d
+    transfer of batch k+1 overlaps the device compute of batch k.
+
+    Device-resident arrays pass straight through the executor's feed
+    path (no second upload). Use::
+
+        feeder = AsyncDeviceFeeder(feed_iter, capacity=2)
+        for feed in feeder:            # feed dicts, arrays on device
+            exe.run(prog, feed=feed, fetch_list=[loss])
+
+    The iterator ends when `feed_iter` does; `close()` stops early.
+    Exceptions in the source iterator re-raise at the consuming side.
+    """
+
+    _END = object()
+
+    def __init__(self, feed_iter, capacity: int = 2):
+        import queue
+        import threading
+
+        self._q = queue.Queue(maxsize=max(1, int(capacity)))
+        self._stop = threading.Event()
+        self._done = False  # terminal: END/exception delivered or closed
+
+        def _upload(v):
+            import jax
+
+            if isinstance(v, np.ndarray):
+                return jax.device_put(v)
+            if isinstance(v, tuple) and len(v) == 2 and isinstance(
+                v[0], np.ndarray
+            ):
+                # (data, lod) ragged feed: the lod offsets stay host-side
+                return (jax.device_put(v[0]), v[1])
+            return v
+
+        def _producer():
+            try:
+                for feed in feed_iter:
+                    if self._stop.is_set():
+                        return
+                    self._q.put({k: _upload(v) for k, v in feed.items()})
+                self._q.put(self._END)
+            except BaseException as e:  # surface in the consumer
+                self._q.put(e)
+
+        self._thread = threading.Thread(target=_producer, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import queue
+
+        while True:
+            if self._done:
+                raise StopIteration
+            if self._stop.is_set():
+                # closed: drain what's left, then stop — never block on
+                # a producer that has already been told to quit
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    raise StopIteration
+            else:
+                item = self._q.get()
+            if item is self._END:
+                self._done = True  # terminal: later next() must not block
+                raise StopIteration
+            if isinstance(item, BaseException):
+                self._done = True
+                raise item
+            return item
+
+    def close(self):
+        self._stop.set()
+        self._done = True
+
+        def _drain():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except Exception:
+                pass
+
+        # a producer blocked in put() completes that put once the drain
+        # frees a slot and only THEN sees _stop — drain, wait for the
+        # thread to exit, drain the stragglers
+        _drain()
+        self._thread.join(timeout=5.0)
+        _drain()
+
+
+__all__.append("AsyncDeviceFeeder")

@@ -373,3 +373,150 @@ def compiled_profile(exe, program, feed, fetch_list, runs=3,
 
 
 __all__ += ["compiled_profile", "parse_hlo_op_costs"]
+
+
+def parse_hlo_instr_tags(hlo_text):
+    """{instruction_name: op_tag} over the ENTRY computation — the join
+    key between a device profiler trace (events named per HLO
+    instruction) and the lowering's op provenance metadata."""
+    tags = {}
+    in_entry = False
+    depth = 0
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            depth = line.count("{") - line.count("}")
+            continue
+        if not in_entry:
+            continue
+        depth += line.count("{") - line.count("}")
+        if depth <= 0:
+            break
+        m = _INST_RE.match(line)
+        if not m:
+            continue
+        name = m.group(1)
+        tag = "[xla]"
+        onm = _OPNAME_RE.search(line)
+        if onm:
+            t = _TAG_RE.search(onm.group(1))
+            if t:
+                tag = t.group(1)
+                if "transpose(" in onm.group(1):
+                    tag += "_grad"
+        tags[name] = tag
+    return tags
+
+
+def _parse_trace_durations(trace_dir):
+    """Sum per-HLO-instruction device durations (us) from a
+    jax.profiler.trace output directory. Events carry the instruction
+    name verbatim ('fusion.123', 'dot_general.1'); bookkeeping events
+    ('end: ...', runtime internals) are dropped by the join later."""
+    import glob
+    import gzip
+    import json as _json
+
+    durs = {}
+    for p in glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    ):
+        tr = _json.loads(gzip.open(p).read())
+        for e in tr.get("traceEvents", []):
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            name = e.get("name", "")
+            if name.startswith("end: "):
+                continue
+            durs[name] = durs.get(name, 0.0) + float(e["dur"])
+    return durs
+
+
+def trace_profile(exe, program, feed, fetch_list, runs=3):
+    """Reconcile the traffic-MODELED per-op attribution against
+    MEASURED per-instruction device times from a real `jax.profiler`
+    trace (r4 verdict #4; the reference measured per-op times with CUDA
+    events, platform/profiler.cc:142,198 — this is the TPU equivalent:
+    XLA instruction events joined back to op provenance through the HLO
+    metadata tags lowering stamps).
+
+    Returns (table, meta): rows {'Event', 'measured_ms',
+    'modeled_ms', 'disagreement'} sorted by measured time;
+    meta['top5_max_disagreement'] is the reconciliation verdict — the
+    share-of-step disagreement between the two attributions over the
+    five biggest measured rows. Works on any backend with profiler
+    support (CPU validates the machinery; TPU gives real device
+    times)."""
+    import tempfile
+
+    import jax
+    import numpy as _np
+
+    exe._capture_avals = True
+    try:
+        exe.run(program, feed=feed, fetch_list=fetch_list)
+        entry, avals, host_args = exe._last_exec
+    finally:
+        exe._capture_avals = False
+        exe._last_exec = None
+    compiled = entry.lower(*avals).compile()
+    txt = compiled.as_text()
+    tags = parse_hlo_instr_tags(txt)
+    model_rows = parse_hlo_op_costs(txt)
+
+    import shutil
+
+    trace_dir = tempfile.mkdtemp(prefix="ptpu_trace_")
+    try:
+        with jax.profiler.trace(trace_dir):
+            for _ in range(runs):
+                out = exe.run(program, feed=feed, fetch_list=fetch_list)
+            _np.asarray(out[0])  # sync inside the trace window
+        durs = _parse_trace_durations(trace_dir)
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+    # join: instruction event -> op tag. Trace event names sometimes
+    # carry a '.remat'/suffix variant; exact match first, then prefix.
+    measured = {}
+    unmatched_us = 0.0
+    for name, us in durs.items():
+        tag = tags.get(name)
+        if tag is None:
+            base = name.split(" ")[0]
+            tag = tags.get(base)
+        if tag is None:
+            unmatched_us += us
+            continue
+        measured[tag] = measured.get(tag, 0.0) + us
+    total_meas = sum(measured.values()) or 1.0
+    total_bytes = sum(r["bytes"] for r in model_rows.values()) or 1
+
+    table = []
+    for tag in sorted(set(measured) | set(model_rows)):
+        m_us = measured.get(tag, 0.0)
+        b = model_rows.get(tag, {}).get("bytes", 0)
+        meas_share = m_us / total_meas
+        model_share = b / total_bytes
+        table.append({
+            "Event": tag,
+            "measured_ms": round(m_us / 1e3 / runs, 4),
+            "measured_share": round(meas_share, 4),
+            "modeled_share": round(model_share, 4),
+            "disagreement": round(abs(meas_share - model_share), 4),
+        })
+    table.sort(key=lambda r: -r["measured_ms"])
+    top5 = table[:5]
+    meta = {
+        "runs": runs,
+        "measured_total_ms": round(total_meas / 1e3 / runs, 3),
+        "unmatched_ms": round(unmatched_us / 1e3 / runs, 3),
+        "top5_max_disagreement": max(
+            (r["disagreement"] for r in top5), default=0.0
+        ),
+        "backend": jax.default_backend(),
+    }
+    return table, meta
+
+
+__all__ += ["trace_profile", "parse_hlo_instr_tags"]

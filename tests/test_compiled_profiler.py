@@ -77,3 +77,43 @@ ENTRY %main (p0: f32[4,8]) -> f32[4,8] {
     # transpose(...) wrapper -> grad row
     assert "mul_op_grad" in rows
     assert rows["mul_op_grad"]["bytes"] == 384  # out + two operands
+
+
+def test_trace_profile_reconciles_on_cpu():
+    """trace_profile (r4 verdict #4): jax.profiler instruction events
+    join back to op tags through the HLO metadata; measured rows cover
+    the dominant ops and the two attributions produce comparable
+    tables. CPU validates the machinery; the same call on TPU is the
+    silicon reconciliation."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import profiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=128, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(256, 64).astype(np.float32),
+            "y": rng.rand(256, 1).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        table, meta = profiler.trace_profile(
+            exe, main, feed, [loss], runs=3)
+    assert meta["measured_total_ms"] > 0
+    events = {r["Event"] for r in table if r["measured_ms"] > 0}
+    # the matmul-bearing op must appear with measured device time
+    assert "mul" in events or "mul_grad" in events, sorted(events)
+    # both attributions present on the top rows
+    top = table[0]
+    assert top["measured_share"] > 0
+    assert 0.0 <= top["disagreement"] <= 1.0
+    assert 0.0 <= meta["top5_max_disagreement"] <= 1.0

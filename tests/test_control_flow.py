@@ -589,3 +589,92 @@ def test_beam_early_exit_stops_before_max_len():
     np.testing.assert_array_equal(ids_e, ids_f)
     np.testing.assert_array_equal(lens_e, lens_f)
     np.testing.assert_allclose(scores_e, scores_f, rtol=1e-5, atol=1e-6)
+
+
+def test_beam_early_exit_gate_disables_on_state_read():
+    """Safety gate: when an op AFTER the while reads a non-beam state
+    array (whose dead-tail slots early exit would leave frozen), the
+    early exit must disarm and the fixed-trip schedule run — outputs
+    identical to PADDLE_TPU_NO_EARLY_EXIT=1, counter at max_len."""
+    from paddle_tpu.fluid.core import kernels_control as kc
+
+    V, D, H, T_MAX, BEAM = 7, 4, 5, 10, 2
+    end_id = 0
+    B = 2
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        init_state = pd.data(name="init_state", shape=[H], dtype="float32")
+        init_ids = pd.data(name="init_ids", shape=[1], dtype="int64",
+                           lod_level=2)
+        init_scores = pd.data(name="init_scores", shape=[1],
+                              dtype="float32", lod_level=2)
+        array_len = pd.fill_constant(shape=[1], dtype="int64", value=T_MAX)
+        counter = pd.zeros(shape=[1], dtype="int64", force_cpu=True)
+        state_array = pd.create_array("float32")
+        pd.array_write(init_state, array=state_array, i=counter)
+        ids_array = pd.create_array("int64")
+        scores_array = pd.create_array("float32")
+        pd.array_write(init_ids, array=ids_array, i=counter)
+        pd.array_write(init_scores, array=scores_array, i=counter)
+        cond = pd.less_than(x=counter, y=array_len)
+        w = pd.While(cond=cond)
+        with w.block():
+            pre_ids = pd.array_read(array=ids_array, i=counter)
+            pre_state = pd.array_read(array=state_array, i=counter)
+            pre_score = pd.array_read(array=scores_array, i=counter)
+            pre_state_expanded = pd.sequence_expand(pre_state, pre_score)
+            pre_ids_emb = pd.embedding(
+                input=pre_ids, size=[V, D], dtype="float32",
+                param_attr=fluid.ParamAttr(name="gg_emb"),
+            )
+            current_state = pd.fc(
+                input=[pre_ids_emb, pre_state_expanded], size=H,
+                act="tanh", param_attr=fluid.ParamAttr(name="gg_dec"),
+                bias_attr=False,
+            )
+            current_score = pd.fc(
+                input=current_state, size=V, act="softmax",
+                param_attr=fluid.ParamAttr(name="gg_out"),
+                bias_attr=False,
+            )
+            topk_scores, topk_indices = pd.topk(current_score, k=5)
+            sel_ids, sel_scores = pd.beam_search(
+                pre_ids, topk_indices, topk_scores, BEAM,
+                end_id=end_id, level=0,
+            )
+            pd.increment(x=counter, value=1, in_place=True)
+            pd.array_write(current_state, array=state_array, i=counter)
+            pd.array_write(sel_ids, array=ids_array, i=counter)
+            pd.array_write(sel_scores, array=scores_array, i=counter)
+            pd.less_than(x=counter, y=array_len, cond=cond)
+        trans_ids, trans_scores = pd.beam_search_decode(
+            ids=ids_array, scores=scores_array
+        )
+        # downstream read of the STATE array: early exit must disarm
+        final_state = pd.array_read(array=state_array, i=array_len)
+
+    scope = fluid.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out_w = np.zeros((H, V), np.float32)
+        out_w[:, end_id] = 4.0  # beams die immediately
+        scope.set("gg_out", out_w)
+        rng = np.random.RandomState(9)
+        feed = {
+            "init_state": rng.randn(B, H).astype(np.float32),
+            "init_ids": (np.full((B, 1), 1, np.int64),
+                         [list(range(B + 1))] * 2),
+            "init_scores": (np.ones((B, 1), np.float32),
+                            [list(range(B + 1))] * 2),
+        }
+        ids_v, steps_v, fs = exe.run(
+            main, feed=feed,
+            fetch_list=[trans_ids, counter, final_state],
+        )
+    stats = dict(kc.LAST_WHILE_STATS)
+    assert stats.get("early_exit_armed") is False, stats
+    # fixed-trip ran to the end; the final state slot is real
+    assert int(np.ravel(steps_v)[0]) == T_MAX
+    assert np.isfinite(np.asarray(fs)).all()

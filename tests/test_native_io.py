@@ -79,3 +79,90 @@ def test_reader_creator_and_coordinator(tmp_path):
         seen.append(rec)
     assert len(seen) == 100
     assert sorted(set(x[0] for x in seen)) == [0, 1, 2, 3]
+
+
+def test_async_device_feeder_trains_and_propagates():
+    """AsyncDeviceFeeder (reference DataProvider.h:249 DoubleBuffer):
+    feeds arrive device-resident ahead of the loop, training matches
+    the synchronous path bit-for-bit, source exceptions surface at the
+    consumer, close() stops a blocked producer."""
+    import jax
+    import numpy as np
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.data_feeder import AsyncDeviceFeeder
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(
+                input=x, size=1,
+                param_attr=fluid.ParamAttr(
+                    name="adf_w",
+                    initializer=fluid.initializer.Constant(0.2)),
+            )
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    batches = [
+        {"x": rng.rand(8, 6).astype(np.float32),
+         "y": rng.rand(8, 1).astype(np.float32)}
+        for _ in range(5)
+    ]
+
+    def run(feeds):
+        main, startup, loss = build()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = [
+                float(np.ravel(exe.run(main, feed=f,
+                                       fetch_list=[loss])[0])[0])
+                for f in feeds
+            ]
+            w = np.asarray(scope.get("adf_w")).copy()
+        return losses, w
+
+    sync_losses, sync_w = run(batches)
+
+    seen_types = []
+
+    def checking_iter():
+        for b in batches:
+            yield b
+
+    feeder = AsyncDeviceFeeder(checking_iter(), capacity=2)
+    fed = []
+    for f in feeder:
+        seen_types.append(type(f["x"]))
+        fed.append(f)
+    assert all(issubclass(t, jax.Array) for t in seen_types)
+    async_losses, async_w = run(fed)
+    np.testing.assert_array_equal(async_w, sync_w)
+    np.testing.assert_allclose(async_losses, sync_losses, rtol=0, atol=0)
+
+    # exception propagation
+    def bad_iter():
+        yield batches[0]
+        raise ValueError("boom in the reader")
+
+    feeder = AsyncDeviceFeeder(bad_iter())
+    next(feeder)
+    with pytest.raises(ValueError, match="boom in the reader"):
+        next(feeder)
+
+    # close() releases a producer blocked on a full queue
+    def endless():
+        while True:
+            yield batches[0]
+
+    feeder = AsyncDeviceFeeder(endless(), capacity=1)
+    next(feeder)
+    feeder.close()
