@@ -335,6 +335,9 @@ def _while(ctx, ins, attrs):
         sub, ctx._base_key, is_test=ctx.is_test, seq_maxlen=ctx.seq_maxlen
     )
     sub_ctx.amp_region = getattr(ctx, "amp_region", False)
+    # a nested While's gate must still see the step's fetches and the
+    # OUTER loop's downstream readers
+    sub_ctx.fetch_names = getattr(ctx, "fetch_names", frozenset())
     # names ops AFTER this while read — directly, through their
     # sub-blocks (program._sub_block_outer_reads), or via fetch —
     # (early-exit safety gate: values frozen at the exit step must not
@@ -344,6 +347,7 @@ def _while(ctx, ins, attrs):
     # condition turned false).
     program = ctx.block.program
     reads = set(getattr(ctx, "fetch_names", ()))
+    reads |= getattr(ctx, "downstream_reads", set())
     seen_self = False
     for op in ctx.block.ops:
         if op is ctx.op:

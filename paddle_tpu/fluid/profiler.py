@@ -226,12 +226,10 @@ def _shape_bytes(type_str):
     return total
 
 
-def parse_hlo_op_costs(hlo_text):
-    """{op_row: {'instructions': n, 'bytes': b}} from scheduled HLO text.
-    Only the ENTRY computation's instructions count (fusions are single
-    scheduled instructions; their internals are not separately
-    scheduled). Instructions with no op tag pool under '[xla]'."""
-    entry_lines = []
+def _entry_lines(hlo_text):
+    """The ENTRY computation's lines only — a computation printed AFTER
+    the entry must never leak rows."""
+    lines = []
     in_entry = False
     depth = 0
     for line in hlo_text.splitlines():
@@ -242,10 +240,32 @@ def parse_hlo_op_costs(hlo_text):
         if in_entry:
             depth += line.count("{") - line.count("}")
             if depth <= 0:
-                # the entry computation's closing brace: stop so any
-                # computation printed AFTER the entry never leaks rows
                 break
-            entry_lines.append(line)
+            lines.append(line)
+    return lines
+
+
+def _line_tag(line):
+    """Op provenance tag of one HLO line ('[xla]' when untagged);
+    backward instructions (op_name carries XLA's transpose(...) wrapper)
+    land on '<op>_grad' rows."""
+    onm = _OPNAME_RE.search(line)
+    if onm:
+        t = _TAG_RE.search(onm.group(1))
+        if t:
+            tag = t.group(1)
+            if "transpose(" in onm.group(1):
+                tag += "_grad"  # cotangent-pass instruction
+            return tag
+    return "[xla]"
+
+
+def parse_hlo_op_costs(hlo_text):
+    """{op_row: {'instructions': n, 'bytes': b}} from scheduled HLO text.
+    Only the ENTRY computation's instructions count (fusions are single
+    scheduled instructions; their internals are not separately
+    scheduled). Instructions with no op tag pool under '[xla]'."""
+    entry_lines = _entry_lines(hlo_text)
 
     # symbol table: instruction name -> result type string
     types = {}
@@ -263,19 +283,13 @@ def parse_hlo_op_costs(hlo_text):
         opcode = rest.split(" ", 1)[1].split("(")[0].strip() if " " in rest else ""
         if opcode in ("parameter", "constant", "tuple", "get-tuple-element"):
             continue
-        tag = "[xla]"
-        onm = _OPNAME_RE.search(line)
-        if onm:
-            t = _TAG_RE.search(onm.group(1))
-            if t:
-                tag = t.group(1)
-                if "transpose(" in onm.group(1):
-                    tag += "_grad"  # cotangent-pass instruction
         byts = _shape_bytes(types.get(name, ""))
         for ref in _re.findall(r"%([\w.\-]+)", rest):
             if ref in types and ref != name:
                 byts += _shape_bytes(types[ref])
-        row = rows.setdefault(tag, {"instructions": 0, "bytes": 0})
+        row = rows.setdefault(
+            _line_tag(line), {"instructions": 0, "bytes": 0}
+        )
         row["instructions"] += 1
         row["bytes"] += byts
     return rows
@@ -378,33 +392,14 @@ __all__ += ["compiled_profile", "parse_hlo_op_costs"]
 def parse_hlo_instr_tags(hlo_text):
     """{instruction_name: op_tag} over the ENTRY computation — the join
     key between a device profiler trace (events named per HLO
-    instruction) and the lowering's op provenance metadata."""
+    instruction) and the lowering's op provenance metadata. Shares the
+    entry walk and tag extraction with parse_hlo_op_costs so the
+    modeled and measured tables can never disagree about ownership."""
     tags = {}
-    in_entry = False
-    depth = 0
-    for line in hlo_text.splitlines():
-        if line.startswith("ENTRY "):
-            in_entry = True
-            depth = line.count("{") - line.count("}")
-            continue
-        if not in_entry:
-            continue
-        depth += line.count("{") - line.count("}")
-        if depth <= 0:
-            break
+    for line in _entry_lines(hlo_text):
         m = _INST_RE.match(line)
-        if not m:
-            continue
-        name = m.group(1)
-        tag = "[xla]"
-        onm = _OPNAME_RE.search(line)
-        if onm:
-            t = _TAG_RE.search(onm.group(1))
-            if t:
-                tag = t.group(1)
-                if "transpose(" in onm.group(1):
-                    tag += "_grad"
-        tags[name] = tag
+        if m:
+            tags[m.group(1)] = _line_tag(line)
     return tags
 
 

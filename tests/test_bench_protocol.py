@@ -1,0 +1,59 @@
+"""The bench measurement protocol itself (r3/r4 falsifiability asks +
+r4 verdict #9 compile-time budget): pure-python tests of bench._diff_time
+— no device, no model, just the timing contract the driver's records
+rely on."""
+
+import time
+
+import numpy as np
+import pytest
+
+import bench
+
+
+class FakeRunner(object):
+    """run_at(steps) stub with controllable per-step cost + warm cost."""
+
+    def __init__(self, per_step=0.004, first_extra=0.05):
+        self.calls = []
+        self.per_step = per_step
+        self.first_extra = first_extra
+
+    def __call__(self, steps):
+        extra = self.first_extra if steps not in [
+            s for s, _ in self.calls
+        ] else 0.0
+        self.calls.append((steps, extra))
+        time.sleep(steps * self.per_step + extra)
+
+
+def test_diff_time_record_carries_protocol_fields():
+    r = FakeRunner()
+    dt, info = bench._diff_time(r, 2, 6, return_info=True)
+    # the per-step estimate lands near the configured cost
+    assert 0.5 * r.per_step < dt < 3.0 * r.per_step
+    # r4 falsifiability fields
+    assert info["steps"] == [2, 6]
+    assert set(info["raw_chunk_s"]) == {"2", "6"}
+    assert all(
+        len(v) >= bench.TIMING_CHUNKS for v in info["raw_chunk_s"].values()
+    )
+    assert set(info["spread"]) == {"2", "6"}
+    assert isinstance(info["stable"], bool)
+    # r4 verdict #9: trace+compile budget column — the warm pass is the
+    # only one that pays compile, and its extra cost must be visible
+    assert set(info["warm_s"]) == {"2", "6"}
+    assert info["warm_s"]["2"] >= r.first_extra * 0.5
+    # warm includes the first-run extra; steady chunks must not
+    assert min(info["raw_chunk_s"]["2"]) < r.first_extra + 2 * 0.004 * 2
+
+
+def test_diff_time_inversion_raises():
+    """A pathological runner where more steps are FASTER must be
+    rejected, not silently recorded (timing inversion guard)."""
+
+    def weird(steps):
+        time.sleep(0.06 if steps == 2 else 0.01)
+
+    with pytest.raises(AssertionError, match="timing inversion"):
+        bench._diff_time(weird, 2, 6, return_info=True)

@@ -743,6 +743,36 @@ _p = R(110).randn(4, 3).astype(np.float32)
 _g = R(111).randn(4, 3).astype(np.float32)
 _lr = np.array([0.1], np.float32)
 
+_aa_s1 = R(118).randn(4, 3).astype(np.float32)
+_aa_s2 = R(119).randn(4, 3).astype(np.float32)
+_aa_s3 = R(120).randn(4, 3).astype(np.float32)
+
+
+def _aa_oracle(i, a):
+    # reference AverageOptimizer.cpp one-step update: nu=7->8, na=3->4,
+    # window = min(100, 8*0.5) = 4 -> na 4 >= min_w 2 and >= 4: SHIFT
+    s1 = i["InSum1"] + i["Param"]
+    return {
+        "OutSum1": np.zeros_like(s1),
+        "OutSum2": np.zeros_like(s1),
+        "OutSum3": s1 + i["InSum2"],
+        "OutNumAccumulates": np.array([0], np.int32),
+        "OutOldNumAccumulates": np.array([4], np.int32),
+        "OutNumUpdates": np.array([8], np.int32),
+    }
+
+
+spec("average_accumulates",
+     ins={"Param": _p, "InSum1": _aa_s1, "InSum2": _aa_s2,
+          "InSum3": _aa_s3,
+          "InNumAccumulates": np.array([3], np.int32),
+          "InOldNumAccumulates": np.array([5], np.int32),
+          "InNumUpdates": np.array([7], np.int32)},
+     attrs={"average_window": 0.5, "min_average_window": 2,
+            "max_average_window": 100},
+     outs=["OutSum1", "OutSum2", "OutSum3", "OutNumAccumulates",
+           "OutOldNumAccumulates", "OutNumUpdates"],
+     oracle=_aa_oracle)
 spec("sgd", ins={"Param": _p, "Grad": _g, "LearningRate": _lr},
      outs=["ParamOut"],
      oracle=lambda i, a: {"ParamOut": i["Param"] - 0.1 * i["Grad"]})
