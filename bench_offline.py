@@ -208,11 +208,7 @@ def offline_resnet50_dp(topo_devices, batch_per_chip):
     rec["n_chips"] = n
     # count the collectives the partitioner inserted (the gradient
     # all-reduce story in one number)
-    rec["collectives"] = {
-        k: txt.count(k)
-        for k in ("all-reduce", "all-gather", "reduce-scatter",
-                  "collective-permute", "all-to-all")
-    }
+    rec["collectives"] = _count_collectives(txt)
     return rec
 
 
@@ -288,6 +284,100 @@ def offline_transformer_lm(topo_devices, B=8, T=1024, dim=512, heads=8,
     return rec
 
 
+def _count_collectives(txt):
+    return {
+        k: txt.count(k)
+        for k in ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+    }
+
+
+def offline_resnet50_hybrid(topo_devices, batch_per_chip=16):
+    """The full hybrid-mesh layout (dcn=2 slices x data x model=2 TP on
+    the classifier fc) AOT-compiled over 8 v5e chips — the
+    dryrun_multichip topology through the real TPU SPMD partitioner.
+    The fc weight is sharded BEFORE minimize so the momentum slot
+    inherits the spec (fluid/optimizer.py _add_accumulator)."""
+    import paddle_tpu.fluid as fluid
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import parallel
+    from bench import AMP
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    n = len(topo_devices)
+    batch = batch_per_chip * n
+    ici_axes = {"data": n // 4, "model": 2}
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            image = fluid.layers.data(
+                name="image", shape=[3, 224, 224], dtype="float32")
+            label = fluid.layers.data(
+                name="label", shape=[1], dtype="int64")
+            predict = resnet_imagenet(image, class_dim=1000, depth=50)
+            cost = fluid.layers.cross_entropy(input=predict, label=label)
+            avg_cost = fluid.layers.mean(x=cost)
+            # TP shard BEFORE minimize: optimizer slots inherit the spec
+            for p in main.global_block().all_parameters():
+                if len(p.shape) == 2 and p.shape[1] == 1000:
+                    parallel.shard_parameter(p, P(None, "model"))
+            opt = fluid.optimizer.Momentum(
+                learning_rate=0.01, momentum=0.9)
+            opt.minimize(avg_cost)
+        main.amp = AMP
+        return main, startup, avg_cost
+
+    main, cost, scope = _init_params(build)
+    feed = {
+        "image": np.zeros((batch, 3, 224, 224), np.float32),
+        "label": np.zeros((batch, 1), np.int32),
+    }
+    mesh = parallel.make_hybrid_mesh(
+        {"dcn": 2}, ici_axes, devices=topo_devices
+    )
+    lowered, t_trace = _lower_program_step(main, cost, feed, mesh, scope)
+    rec, txt = _cost_record(lowered, t_trace, "img_per_sec", batch)
+    rec["batch"] = batch
+    rec["mesh"] = dict({"dcn": 2}, **ici_axes)
+    rec["collectives"] = _count_collectives(txt)
+    return rec
+
+
+def offline_lm_decode(topo_devices, B=8, T0=512, dim=512, heads=8,
+                      layers_n=8, vocab=32000):
+    """One cached decode step (the serving inner loop) AOT-compiled for
+    v5e: the latency unit of bench_lm_decode, with its cost analysis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.models import transformer as tlm
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=T0 + 256,
+                                dtype=jnp.bfloat16)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    cache = tlm.init_kv_cache(cfg, B, max_len=T0 + 256)
+    mesh = Mesh(np.asarray(topo_devices[:1]).reshape(1,), ("d",))
+    rep = NamedSharding(mesh, P())
+
+    def step(params, tok, cache):
+        return tlm.decode_step(params, tok, T0, cache, cfg)
+
+    t0 = time.time()
+    lowered = jax.jit(step, in_shardings=(rep, rep, rep)).lower(
+        _sds(params),
+        jax.ShapeDtypeStruct((B,), jnp.int32),
+        _sds(cache),
+    )
+    rec, _ = _cost_record(lowered, time.time() - t0, "tokens_per_sec", B)
+    rec["shape"] = {"B": B, "cache_len": T0 + 256, "dim": dim,
+                    "layers": layers_n}
+    return rec
+
+
 def offline_ring_attention_sp8(topo_devices, B=2, T_per=2048, H=8, D=64):
     """Ring attention (sequence parallelism) fwd+bwd over ALL topology
     chips — the long-context scaling story compiled by the real TPU
@@ -316,10 +406,7 @@ def offline_ring_attention_sp8(topo_devices, B=2, T_per=2048, H=8, D=64):
     lowered = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q)
     rec, txt = _cost_record(lowered, time.time() - t0)
     rec["shape"] = {"B": B, "T_global": T, "H": H, "D": D, "chips": n}
-    rec["collectives"] = {
-        k: txt.count(k)
-        for k in ("collective-permute", "all-gather", "all-reduce")
-    }
+    rec["collectives"] = _count_collectives(txt)
     return rec
 
 
@@ -362,11 +449,7 @@ def offline_switch_moe_ep8(topo_devices, tokens_per_chip=1024, Dm=512,
     ).lower(*args)
     rec, txt = _cost_record(lowered, time.time() - t0)
     rec["shape"] = {"tokens": N, "d_model": Dm, "d_ff": Hf, "experts": n}
-    rec["collectives"] = {
-        k: txt.count(k)
-        for k in ("all-to-all", "all-reduce", "all-gather",
-                  "collective-permute")
-    }
+    rec["collectives"] = _count_collectives(txt)
     return rec
 
 
@@ -399,6 +482,8 @@ def main():
          lambda: offline_ring_attention_sp8(topo_devices)),
         ("switch_moe_ep%d" % len(topo_devices),
          lambda: offline_switch_moe_ep8(topo_devices)),
+        ("resnet50_hybrid", lambda: offline_resnet50_hybrid(topo_devices)),
+        ("lm_decode", lambda: offline_lm_decode(topo_devices)),
     ]
     only = os.environ.get("BENCH_OFFLINE_ONLY")
     run_stamp = {"run_at": round(time.time(), 1),

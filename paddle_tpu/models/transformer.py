@@ -322,11 +322,19 @@ def prefill(params, tokens, cfg: TransformerConfig, max_len=None):
 
 
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,
-             temperature=0.0, key=None, max_len=None):
+             temperature=0.0, key=None, max_len=None, eos_id=None):
     """Autoregressive generation: prefill the prompt [B, T0], then
-    `max_new_tokens` cached decode steps inside ONE lax.scan (compiled
-    once; the host never re-enters the loop). temperature<=0 is greedy;
-    otherwise softmax sampling with `key`. Returns [B, T0+max_new]."""
+    `max_new_tokens` cached decode steps inside ONE compiled loop (the
+    host never re-enters it). temperature<=0 is greedy; otherwise
+    softmax sampling with `key`. Returns [B, T0+max_new].
+
+    `eos_id` opts into the reference's end-of-sequence semantics
+    (RecurrentGradientMachine.h:309): a row that emits eos_id freezes
+    (keeps re-emitting eos), and the loop EXITS EARLY once every row is
+    done — a lax.while_loop instead of the fixed-trip scan, with the
+    unwritten tail back-filled with eos (identical to what the frozen
+    rows would have produced). Default None keeps the fixed-trip
+    free-running behavior."""
     B, T0 = prompt.shape
     L = int(max_len or cfg.max_len)
     # the positional table bounds every position regardless of cache
@@ -357,10 +365,48 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,
         logits, cache = decode_step(params, tok, T0 + i, cache, cfg)
         return (logits, cache, k), tok
 
-    (_, _, _), toks = jax.lax.scan(
-        body, (logits, cache, key), jnp.arange(max_new_tokens)
+    if eos_id is None:
+        (_, _, _), toks = jax.lax.scan(
+            body, (logits, cache, key), jnp.arange(max_new_tokens)
+        )
+        return jnp.concatenate([prompt, toks.T], axis=1)
+
+    # eos semantics + early exit: buffer writes under lax.while_loop
+    eos = jnp.asarray(eos_id, prompt.dtype)
+    buf0 = jnp.zeros((B, max_new_tokens), prompt.dtype)
+
+    def w_cond(state):
+        i, alive, _, _, _, _ = state
+        return (i < max_new_tokens) & jnp.any(alive)
+
+    def w_body(state):
+        i, alive, buf, logits, cache, k = state
+        k, sub = jax.random.split(k)
+        tok = pick(logits, sub)
+        tok = jnp.where(alive, tok, eos)  # frozen rows re-emit eos
+        buf = jax.lax.dynamic_update_index_in_dim(buf, tok, i, axis=1)
+        alive = alive & (tok != eos)
+        logits, cache = decode_step(params, tok, T0 + i, cache, cfg)
+        return i + 1, alive, buf, logits, cache, k
+
+    state = (
+        jnp.asarray(0),
+        jnp.ones((B,), bool),
+        buf0,
+        logits,
+        cache,
+        key,
     )
-    return jnp.concatenate([prompt, toks.T], axis=1)
+    steps_done, alive, buf, _, _, _ = jax.lax.while_loop(
+        w_cond, w_body, state
+    )
+    # unwritten tail (all rows were done): exactly eos
+    fill = jnp.arange(max_new_tokens)[None, :] >= steps_done
+    buf = jnp.where(fill, eos, buf)
+    if not isinstance(steps_done, jax.core.Tracer):
+        LAST_DECODE_STATS["greedy_steps_executed"] = int(steps_done)
+        LAST_DECODE_STATS["greedy_max_steps"] = int(max_new_tokens)
+    return jnp.concatenate([prompt, buf], axis=1)
 
 
 __all__ += ["init_kv_cache", "decode_step", "prefill", "generate"]

@@ -216,3 +216,37 @@ def test_beam_search_early_exit_fewer_steps_same_output():
             k = dead_from[b, w]
             assert (gen[b, w, k:] == eos).all(), (b, w, gen[b, w])
     assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_greedy_generate_eos_early_exit():
+    """generate(eos_id=...): rows freeze at eos, the compiled loop exits
+    once all rows are done (fewer executed steps), the tail is eos, and
+    the pre-eos prefix matches the free-running default path."""
+    cfg = _cfg(vocab=8)
+    params = T.init_params(cfg, jax.random.PRNGKey(31))
+    eos = cfg.vocab - 1
+    params["embed"] = params["embed"].at[eos].mul(50.0)
+    prompt = jax.random.randint(jax.random.PRNGKey(32), (3, 4), 0, eos)
+
+    out = T.generate(params, prompt, cfg, max_new_tokens=20, eos_id=eos)
+    stats = dict(T.LAST_DECODE_STATS)
+    assert stats["greedy_max_steps"] == 20
+    assert stats["greedy_steps_executed"] < 10, stats
+    gen = np.asarray(out)[:, 4:]
+    # every row: once eos appears, only eos follows (incl. back-fill)
+    for b in range(gen.shape[0]):
+        k = int((gen[b] == eos).argmax())
+        assert (gen[b, k:] == eos).all(), gen[b]
+
+    # prefix agreement with the free-running path up to the first eos
+    before = dict(T.LAST_DECODE_STATS)
+    free = np.asarray(
+        T.generate(params, prompt, cfg, max_new_tokens=20)
+    )[:, 4:]
+    for b in range(gen.shape[0]):
+        k = int((gen[b] == eos).argmax())
+        np.testing.assert_array_equal(gen[b, :k + 1], free[b, :k + 1])
+
+    # default path (eos_id=None) really took the fixed-trip scan branch:
+    # the while_loop branch would have rewritten the greedy stats
+    assert dict(T.LAST_DECODE_STATS) == before
