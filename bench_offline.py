@@ -453,6 +453,72 @@ def offline_switch_moe_ep8(topo_devices, tokens_per_chip=1024, Dm=512,
     return rec
 
 
+def offline_scaling_projection(batch_per_chip=32):
+    """Cost-model projection of 1->16 chip weak scaling (BASELINE.json
+    asks >=90% on a v5e-16; no multi-chip hardware exists here, so this
+    is the best available evidence): the SAME per-chip batch compiled
+    single-chip and data-parallel over a virtual v5e 4x4 topology, and
+    efficiency = t_roof(1) / t_roof(16) from the per-device cost
+    analysis (flops/bytes are per-device; dp adds the gradient
+    all-reduces, which is exactly what degrades weak scaling)."""
+    import jax
+    from jax.experimental import topologies
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import parallel
+    from bench import _build_image_workload
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    td16 = topologies.get_topology_desc(
+        platform="tpu", topology_name="v5e:4x4")
+    devs16 = list(np.asarray(td16.devices).ravel())
+
+    out = {"batch_per_chip": batch_per_chip}
+    preds = {}
+    for n, devs in ((1, devs16[:1]), (16, devs16)):
+        batch = batch_per_chip * n
+        main, cost, scope = _init_params(
+            lambda: _build_image_workload(
+                fluid,
+                lambda i, c: resnet_imagenet(i, class_dim=c, depth=50),
+                batch,
+            )
+        )
+        feed = {
+            "image": np.zeros((batch, 3, 224, 224), np.float32),
+            "label": np.zeros((batch, 1), np.int32),
+        }
+        mesh = parallel.make_mesh({"data": n}, devices=devs)
+        lowered, t_trace = _lower_program_step(
+            main, cost, feed, mesh, scope)
+        rec, txt = _cost_record(lowered, t_trace, "img_per_sec", batch)
+        rec["collectives"] = _count_collectives(txt)
+        out["dp%d" % n] = rec
+        preds[n] = rec.get("roofline", {}).get("ms")
+    if preds.get(1) and preds.get(16):
+        # weak scaling: per-chip work identical, so efficiency is the
+        # single-chip step time over the 16-chip (per-device) step time.
+        # CAVEAT: XLA's cost analysis does NOT charge interconnect time
+        # for collectives, so this compute-side number can exceed 1.
+        out["weak_scaling_efficiency_compute_only"] = round(
+            preds[1] / preds[16], 4
+        )
+        # analytic ICI bound: ring all-reduce of the f32 gradients moves
+        # 2*(n-1)/n * grad_bytes per chip; ~90 GB/s effective one-way
+        # ICI per v5e chip (scaling-book order of magnitude). Reported
+        # as the NO-overlap lower bound — XLA overlaps the reduce with
+        # backward compute, so the real number sits between the two.
+        grad_bytes = 25.6e6 * 4  # ResNet-50 params, f32 grads
+        ici_bw = 90e9
+        ar_ms = 2 * (15.0 / 16.0) * grad_bytes / ici_bw * 1e3
+        out["allreduce_ici_ms_no_overlap"] = round(ar_ms, 3)
+        out["weak_scaling_efficiency_no_overlap"] = round(
+            preds[1] / (preds[16] + ar_ms), 4
+        )
+        out["target"] = 0.90  # BASELINE.json
+    return out
+
+
 def main():
     import jax
 
@@ -484,6 +550,7 @@ def main():
          lambda: offline_switch_moe_ep8(topo_devices)),
         ("resnet50_hybrid", lambda: offline_resnet50_hybrid(topo_devices)),
         ("lm_decode", lambda: offline_lm_decode(topo_devices)),
+        ("scaling_projection", lambda: offline_scaling_projection()),
     ]
     only = os.environ.get("BENCH_OFFLINE_ONLY")
     run_stamp = {"run_at": round(time.time(), 1),
