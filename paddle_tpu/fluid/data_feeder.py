@@ -80,7 +80,7 @@ class AsyncDeviceFeeder(object):
 
     _END = object()
 
-    def __init__(self, feed_iter, capacity: int = 2):
+    def __init__(self, feed_iter, capacity: int = 2, upload: bool = True):
         import queue
         import threading
 
@@ -89,6 +89,12 @@ class AsyncDeviceFeeder(object):
         self._done = False  # terminal: END/exception delivered or closed
 
         def _upload(v):
+            # upload=False keeps arrays host-side (multi-process DCN
+            # meshes globalize feeds from HOST data — a device_put here
+            # would be undone by a device->host copy per batch) while
+            # still overlapping the decode
+            if not upload:
+                return v
             import jax
 
             if isinstance(v, np.ndarray):
@@ -140,14 +146,24 @@ class AsyncDeviceFeeder(object):
             return item
 
     def close(self):
+        import queue
+        import warnings
+
         self._stop.set()
         self._done = True
 
         def _drain():
             try:
                 while True:
-                    self._q.get_nowait()
-            except Exception:
+                    item = self._q.get_nowait()
+                    if isinstance(item, BaseException):
+                        # a real data-source error must not vanish just
+                        # because the consumer exited for another reason
+                        warnings.warn(
+                            "AsyncDeviceFeeder.close() discarded a "
+                            "pending reader error: %r" % item
+                        )
+            except queue.Empty:
                 pass
 
         # a producer blocked in put() completes that put once the drain
@@ -155,6 +171,16 @@ class AsyncDeviceFeeder(object):
         # thread to exit, drain the stragglers
         _drain()
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # blocked INSIDE the source iterator (close() can only stop
+            # it between batches): the daemon thread lingers until that
+            # read returns — don't share one data source with a new
+            # feeder while this is pending
+            warnings.warn(
+                "AsyncDeviceFeeder producer still blocked in the data "
+                "source after close(); its prefetched buffers stay "
+                "alive until the read returns"
+            )
         _drain()
 
 

@@ -628,12 +628,19 @@ def _lod_bucket(feed_arrays):
 
 def _split_lod_feed(value):
     """Accept numpy arrays, (data, lod) tuples, and objects exposing
-    `.data/.lod` (our LoDTensor helper)."""
+    `.data/.lod` (our LoDTensor helper). Device-resident jax arrays
+    pass through UNTOUCHED — np.asarray on them is a device->host copy
+    that would defeat the device-resident fast path (_to_device_dtype)
+    and, through a remote tunnel, re-cross the wire per run call."""
     if isinstance(value, tuple) and len(value) == 2 and not np.isscalar(value[0]):
         data, lod = value
-        return np.asarray(data), _flatten_lod(lod)
+        if not isinstance(data, jax.Array):
+            data = np.asarray(data)
+        return data, _flatten_lod(lod)
     if hasattr(value, "lod") and hasattr(value, "data"):
         return np.asarray(value.data), _flatten_lod(value.lod())
+    if isinstance(value, jax.Array):
+        return value, None
     return np.asarray(value), None
 
 

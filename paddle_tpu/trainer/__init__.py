@@ -526,14 +526,34 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
         if _ma._param_names and _ma._steps_name:
             eval_avg_ctx = _ma.apply(scope=scope)
 
+    from ..fluid.data_feeder import AsyncDeviceFeeder
+
+    def _pass_feeds():
+        """One pass's batches; the synchronous path double-buffers
+        (reference DataProvider.h:249 DoubleBuffer): a background
+        thread decodes + uploads batch k+1 while the device trains on
+        batch k. The async-SGD path stacks host batches itself, so it
+        reads the provider directly."""
+        src = _batches(provider_reader, slots, topo._data_layers,
+                       batch_size)
+        if state_box["async_every"]:
+            return src, None
+        # multi-process meshes globalize feeds from host data — keep the
+        # prefetch host-side there (decode still overlaps)
+        from ..parallel.mesh import spans_processes
+
+        up = not (mesh is not None and spans_processes(mesh))
+        feeder = AsyncDeviceFeeder(src, capacity=2, upload=up)
+        return feeder, feeder
+
     try:
         with eval_avg_ctx, fluid.executor.scope_guard(scope):
             for pass_id in range(num_passes):
                 state_box["pass_id"] = pass_id
                 buf = []
-                for feed in _batches(
-                    provider_reader, slots, topo._data_layers, batch_size
-                ):
+                feed_src, _feeder = _pass_feeds()
+                state_box["feeder"] = _feeder
+                for feed in feed_src:
                     t0 = time.time()
                     if state_box["async_every"] and any(
                         isinstance(v, tuple) for v in feed.values()
@@ -586,6 +606,11 @@ def run_config(config_path, job="train", config_args=None, trainer_count=1,
                         step=stats["batches"],
                     )
     finally:
+        # a raise mid-pass must not leave the prefetch producer pinning
+        # device buffers
+        feeder = state_box.pop("feeder", None)
+        if feeder is not None:
+            feeder.close()
         # the in-flight async checkpoint must commit even when a pass
         # raises (durability parity with the old synchronous save);
         # result() also re-raises any writer error

@@ -157,26 +157,49 @@ class SGD(object):
             event_handler = lambda e: None
         data_nodes = self._topology._data_layers
         scope = self.__parameters__.scope
+        from ..fluid.data_feeder import AsyncDeviceFeeder
+
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
-            for batch_id, batch in enumerate(reader()):
-                event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                feed = _convert_feed(batch, data_nodes, feeding)
-                with fluid.executor.scope_guard(scope):
-                    fetched = self._exe.run(
-                        self._topology.main_program,
-                        feed=feed,
-                        fetch_list=[self._cost_var]
-                        + [v for _, v in self._metric_fetches],
-                    )
-                cost, metrics = fetched[0], fetched[1:]
-                event_handler(
-                    v2_event.EndIteration(
-                        pass_id, batch_id, float(np.ravel(cost)[0]),
-                        evaluator=self._metric_payload(metrics),
-                    )
-                )
+
+            def _feeds():
+                # decode + upload in a background thread: batch k+1
+                # overlaps the device step on batch k (reference
+                # DataProvider.h:249 DoubleBuffer)
+                for batch in reader():
+                    yield _convert_feed(batch, data_nodes, feeding)
+
+            from ..parallel.mesh import get_default_mesh, spans_processes
+
+            _mesh = self._exe.mesh or get_default_mesh()
+            feeder = AsyncDeviceFeeder(
+                _feeds(), capacity=2,
+                upload=not (_mesh is not None and spans_processes(_mesh)),
+            )
+            try:
+                self._train_pass(
+                    feeder, pass_id, event_handler, scope)
+            finally:
+                feeder.close()
             event_handler(v2_event.EndPass(pass_id))
+
+    def _train_pass(self, feeds, pass_id, event_handler, scope):
+        for batch_id, feed in enumerate(feeds):
+            event_handler(v2_event.BeginIteration(pass_id, batch_id))
+            with fluid.executor.scope_guard(scope):
+                fetched = self._exe.run(
+                    self._topology.main_program,
+                    feed=feed,
+                    fetch_list=[self._cost_var]
+                    + [v for _, v in self._metric_fetches],
+                )
+            cost, metrics = fetched[0], fetched[1:]
+            event_handler(
+                v2_event.EndIteration(
+                    pass_id, batch_id, float(np.ravel(cost)[0]),
+                    evaluator=self._metric_payload(metrics),
+                )
+            )
 
     # ------------------------------------------------------------------
     def _avg_apply_ctx(self):

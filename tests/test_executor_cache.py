@@ -162,3 +162,19 @@ def test_nce_reference_formulation():
     # the true-class term alone is >= -log(1/(1+b)) = log(1+b) > 0 is not
     # guaranteed pointwise (o can approach 1), but the sum must be finite
     assert np.all(np.isfinite(out))
+
+
+def test_device_resident_feed_no_host_round_trip():
+    """A device-resident feed must reach the step as the SAME jax array
+    (no np.asarray device->host copy): through a remote tunnel that
+    silent round trip re-crosses the wire on every run call."""
+    import jax
+
+    from paddle_tpu.fluid.executor import _split_lod_feed
+
+    x = jax.numpy.ones((4, 4))
+    d, lod = _split_lod_feed(x)
+    assert d is x and lod is None
+    # ragged tuple: device data passes through, lod normalises
+    d2, lod2 = _split_lod_feed((x, [[0, 2, 4]]))
+    assert d2 is x and lod2 is not None
