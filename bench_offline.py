@@ -78,7 +78,7 @@ def _cost_record(lowered, t_trace, unit_name=None, units_per_step=None):
     byts = float(ca.get("bytes accessed", 0.0))
     opt_s = float(ca.get("optimal_seconds", 0.0))
     rows = parse_hlo_op_costs(txt)
-    top = sorted(rows.items(), key=lambda kv: -kv[1]["bytes"])[:TOP_OPS]
+    top = sorted(rows.items(), key=lambda kv: -kv[1]["teq"])[:TOP_OPS]
     rec = {
         "hlo_sha256": hashlib.sha256(txt.encode()).hexdigest(),
         "hlo_instructions": sum(r["instructions"] for r in rows.values()),
@@ -87,7 +87,8 @@ def _cost_record(lowered, t_trace, unit_name=None, units_per_step=None):
         "trace_s": round(t_trace, 2),
         "compile_s": round(compile_s, 2),
         "top_ops": [
-            {"op": k, "bytes": v["bytes"], "instructions": v["instructions"]}
+            {"op": k, "bytes": v["bytes"], "flops": v["flops"],
+             "instructions": v["instructions"]}
             for k, v in top
         ],
     }

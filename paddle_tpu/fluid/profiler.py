@@ -196,8 +196,8 @@ __all__.append("device_memory_stats")
 # back to the fluid op that produced it via the `op:<type>` named-scope
 # tags lowering stamps into HLO metadata (core/lowering.py run_op), and
 # the measured compiled-step wall time is distributed over ops by each
-# instruction's memory traffic (operand + output bytes — the HBM-roof
-# proxy appropriate on TPU). Backward instructions (op_name carries
+# instruction's roofline time — max(HBM time from operand+output bytes,
+# MXU time from conv/dot FLOPs). Backward instructions (op_name carries
 # XLA's transpose(...) wrapper) land on "<op>_grad" rows, mirroring the
 # reference's per-grad-op rows (platform/profiler.cc:198 ParseEvents).
 # ---------------------------------------------------------------------
@@ -224,6 +224,168 @@ def _shape_bytes(type_str):
                 n *= int(d)
         total += n * _DTYPE_BYTES.get(dt, 4)
     return total
+
+
+def _shape_elems(type_str):
+    """Element count of the FIRST shape in an HLO type string."""
+    m = _SHAPE_RE.search(type_str)
+    if not m:
+        return 0
+    n = 1
+    if m.group(2):
+        for d in m.group(2).split(","):
+            n *= int(d)
+    return n
+
+
+# v5e ridge point (peak bf16 flops / HBM bytes per second ~= 197e12 /
+# 819e9). Only the RATIO enters the modeled per-op shares below; override
+# for other parts.
+RIDGE_FLOPS_PER_BYTE = float(
+    os.environ.get("PADDLE_TPU_RIDGE_FLOPS_PER_BYTE", "240.5")
+)
+
+_WINDOW_RE = _re.compile(r"window=\{([^}]*)\}")
+_DIMLABEL_RE = _re.compile(r"dim_labels=([\w?]+_[\w?]+->[\w?]+)")
+_LHS_CONTRACT_RE = _re.compile(r"lhs_contracting_dims=\{([\d,]*)\}")
+
+
+def _window_fields(window_str):
+    """{'size': [..], 'stride': [..], 'pad_lo'/'pad_hi': [..],
+    'lhs_dilate'/'rhs_dilate': [..]} from an HLO window attribute body
+    ('size=56x56 pad=55_55x55_55 lhs_dilate=2x2 rhs_reversal=1x1')."""
+    out = {}
+    for field in window_str.split():
+        if "=" not in field:
+            continue
+        k, v = field.split("=", 1)
+        parts = v.split("x")
+        if k == "pad":
+            out["pad_lo"] = [int(p.split("_")[0]) for p in parts]
+            out["pad_hi"] = [int(p.split("_")[1]) for p in parts]
+        elif k in ("size", "stride", "lhs_dilate", "rhs_dilate"):
+            out[k] = [int(p) for p in parts]
+    return out
+
+
+def _conv_valid_taps(out_size, w, stride, pad_lo, pad_hi, lhs_dil, rhs_dil):
+    """Sum over output positions of IN-BOUNDS, non-dilation-zero kernel
+    taps along one spatial dim — the real MAC count per (batch, feature,
+    contracted-channel) triple, matching XLA's cost analysis: a backward
+    conv with a 56x56 window and pad=55 mostly multiplies padding and
+    would otherwise be overcounted ~8x."""
+    win_dil = (w - 1) * rhs_dil + 1
+    base_dil = (out_size - 1) * stride + win_dil - pad_lo - pad_hi
+    total = 0
+    for o in range(out_size):
+        start = o * stride - pad_lo
+        for k in range(w):
+            loc = start + k * rhs_dil
+            if 0 <= loc < base_dil and loc % lhs_dil == 0:
+                total += 1
+    return total
+
+
+def _instr_flops(name, rest, types):
+    """Estimated FLOPs of one HLO instruction (convolution/dot; 0 for
+    everything else — elementwise flops are noise next to HBM traffic).
+    `types` is the enclosing computation's {instr: result type} table
+    (operands are referenced by name, their shapes live there).
+
+    convolution: 2 * non-spatial out elems * valid window taps *
+    per-group contracted input-feature dim (read off the rhs operand
+    shape via dim_labels — works for forward, grad-input (dilated) and
+    grad-filter convs alike).
+    dot: 2 * out_elems * prod(lhs contracting dim sizes)."""
+    if " convolution(" in rest or rest.startswith("convolution("):
+        dl = _DIMLABEL_RE.search(rest)
+        wm = _WINDOW_RE.search(rest)
+        sm_out = _SHAPE_RE.search(rest.split(" ")[0])
+        if not (dl and sm_out and sm_out.group(2)):
+            return 0.0
+        out_dims = [int(d) for d in sm_out.group(2).split(",")]
+        out_labels = dl.group(1).split("->")[1]
+        spatial_pos = [i for i, c in enumerate(out_labels) if c.isdigit()]
+        nonspatial = 1
+        for i, d in enumerate(out_dims):
+            if i not in spatial_pos:
+                nonspatial *= d
+        w = _window_fields(wm.group(1)) if wm else {}
+        sizes = w.get("size", [1] * len(spatial_pos))
+        strides = w.get("stride", [1] * len(sizes))
+        pad_lo = w.get("pad_lo", [0] * len(sizes))
+        pad_hi = w.get("pad_hi", [0] * len(sizes))
+        lhs_dil = w.get("lhs_dilate", [1] * len(sizes))
+        rhs_dil = w.get("rhs_dilate", [1] * len(sizes))
+        taps = 1.0
+        for j, pos in enumerate(spatial_pos):
+            if j >= len(sizes):
+                break
+            taps *= _conv_valid_taps(
+                out_dims[pos], sizes[j], strides[j], pad_lo[j], pad_hi[j],
+                lhs_dil[j], rhs_dil[j],
+            )
+        contracted = 1
+        ops = _re.findall(r"%([\w.\-]+)", rest.split("(", 1)[1])
+        if len(ops) >= 2 and ops[1] in types:
+            rhs_labels = dl.group(1).split("_")[1].split("->")[0]
+            i_pos = rhs_labels.find("i")
+            sm = _SHAPE_RE.search(types[ops[1]])
+            if i_pos >= 0 and sm and sm.group(2):
+                dims = [int(d) for d in sm.group(2).split(",")]
+                if i_pos < len(dims):
+                    contracted = dims[i_pos]
+        return 2.0 * nonspatial * taps * contracted
+    if " dot(" in rest or rest.startswith("dot("):
+        out_elems = _shape_elems(rest.split(" ")[0])
+        contracted = 1
+        cm = _LHS_CONTRACT_RE.search(rest)
+        ops = _re.findall(r"%([\w.\-]+)", rest.split("(", 1)[1])
+        if cm and ops and ops[0] in types:
+            sm = _SHAPE_RE.search(types[ops[0]])
+            if sm and sm.group(2):
+                dims = [int(d) for d in sm.group(2).split(",")]
+                for ix in (int(x) for x in cm.group(1).split(",") if x):
+                    if ix < len(dims):
+                        contracted *= dims[ix]
+        return 2.0 * out_elems * contracted
+    return 0.0
+
+
+def _computation_flops(hlo_text):
+    """{computation_name: total conv/dot FLOPs} over every non-entry
+    computation — so an entry `fusion(...) calls=%comp` instruction can
+    be charged for the matmul work hidden inside its fused computation
+    (transformer steps fuse dots; ResNet convs stay at entry level)."""
+    comps = {}
+    cur, types, lines = None, {}, []
+    for line in hlo_text.splitlines():
+        if (not line.startswith(" ") and line.rstrip().endswith("{")
+                and "=" not in line.split("{")[0]):
+            if line.lstrip().startswith("ENTRY"):
+                # entry instructions are walked by parse_hlo_op_costs
+                # itself; parsing them here would double the flops work
+                cur = None
+                continue
+            nm = _re.match(r"\s*%?([\w.\-]+)", line)
+            cur = nm.group(1) if nm else None
+            types, lines = {}, []
+            if cur:
+                comps[cur] = {"types": types, "lines": lines}
+            continue
+        if cur and line.startswith(" "):
+            im = _INST_RE.match(line)
+            if im:
+                types[im.group(1)] = im.group(2).split(" ")[0]
+                lines.append((im.group(1), im.group(2)))
+    out = {}
+    for cname, c in comps.items():
+        fl = 0.0
+        for name, rest in c["lines"]:
+            fl += _instr_flops(name, rest, c["types"])
+        if fl:
+            out[cname] = fl
+    return out
 
 
 def _entry_lines(hlo_text):
@@ -260,12 +422,47 @@ def _line_tag(line):
     return "[xla]"
 
 
+_CALLS_RE = _re.compile(r"calls=%?([\w.\-]+)")
+_OPCODE_RE = _re.compile(r"\b([a-z][a-z0-9\-]*)\(")
+
+# Overlapped memory-movement / bookkeeping instructions: XLA hides them
+# behind compute (async weight-prefetch slices, aliasing bitcasts), so
+# they carry bytes but ~zero serial time — billing them serially made
+# the '[xla]' row claim 58% of the modeled step vs 22% measured on-chip
+# (BENCH_r05_builder.jsonl profiler_reconciliation). Synchronous VMEM
+# staging `copy`/`copy-done` instructions are NOT here: the on-chip
+# trace shows they DO serialize (~25% of the ResNet step at b=32);
+# `copy-start` alone stays free so the start/done pair is billed once.
+_OVERLAPPED_OPCODES = {
+    "copy-start", "async-start", "async-done",
+    "slice-start", "slice-done", "bitcast", "bitcast-convert",
+}
+
+
+def _opcode(rest):
+    """HLO opcode of an instruction body ('bf16[...]{...} fusion(%a)' ->
+    'fusion'). Tuple-typed async instructions bury the opcode mid-line;
+    the first lowercase identifier followed by '(' is it (dtype tokens
+    carry digits/brackets, layout T()/S() tokens are uppercase)."""
+    m = _OPCODE_RE.search(rest)
+    return m.group(1) if m else ""
+
+
 def parse_hlo_op_costs(hlo_text):
-    """{op_row: {'instructions': n, 'bytes': b}} from scheduled HLO text.
-    Only the ENTRY computation's instructions count (fusions are single
-    scheduled instructions; their internals are not separately
-    scheduled). Instructions with no op tag pool under '[xla]'."""
+    """{op_row: {'instructions': n, 'bytes': b, 'flops': f, 'teq': t}}
+    from scheduled HLO text. Only the ENTRY computation's instructions
+    count (fusions are single scheduled instructions; their internals are
+    not separately scheduled) — but conv/dot FLOPs hiding inside a fused
+    computation are charged to the entry `fusion` instruction that
+    `calls=` it (XLA:TPU fuses BN stats into convs, dots into transformer
+    blocks). Instructions with no op tag pool under '[xla]'.
+
+    'teq' is the roofline time proxy in byte-equivalents:
+    max(bytes, flops / RIDGE_FLOPS_PER_BYTE) — a compute-bound conv is
+    weighted by MXU time, a bandwidth-bound fusion by HBM time. Shares
+    of `teq` are the modeled per-op time split."""
     entry_lines = _entry_lines(hlo_text)
+    comp_flops = _computation_flops(hlo_text)
 
     # symbol table: instruction name -> result type string
     types = {}
@@ -280,18 +477,31 @@ def parse_hlo_op_costs(hlo_text):
         if not m:
             continue
         name, rest = m.groups()
-        opcode = rest.split(" ", 1)[1].split("(")[0].strip() if " " in rest else ""
+        opcode = _opcode(rest)
         if opcode in ("parameter", "constant", "tuple", "get-tuple-element"):
             continue
         byts = _shape_bytes(types.get(name, ""))
         for ref in _re.findall(r"%([\w.\-]+)", rest):
             if ref in types and ref != name:
                 byts += _shape_bytes(types[ref])
+        flops = _instr_flops(name, rest, types)
+        if opcode == "fusion":
+            cm = _CALLS_RE.search(rest)
+            if cm:
+                flops += comp_flops.get(cm.group(1), 0.0)
+        overlapped = opcode in _OVERLAPPED_OPCODES or (
+            opcode == "custom-call"
+            and ("Bitcast" in rest or "Sharding" in rest)
+        )
         row = rows.setdefault(
-            _line_tag(line), {"instructions": 0, "bytes": 0}
+            _line_tag(line), {"instructions": 0, "bytes": 0, "flops": 0.0,
+                              "teq": 0.0}
         )
         row["instructions"] += 1
         row["bytes"] += byts
+        row["flops"] += flops
+        if not overlapped:
+            row["teq"] += max(byts, flops / RIDGE_FLOPS_PER_BYTE)
     return rows
 
 
@@ -350,17 +560,22 @@ def compiled_profile(exe, program, feed, fetch_list, runs=3,
     e2e_s = (time.time() - t0) / runs
     step_s = dev_s if dev_s is not None else e2e_s
 
-    total_bytes = sum(r["bytes"] for r in rows.values()) or 1
+    # roofline-time split: each row's share is max(HBM time, MXU time) in
+    # byte-equivalents (teq) — on-chip reconciliation against jax.profiler
+    # traces showed a bytes-only split under-weighting the compute-bound
+    # backward convs by ~3x (BENCH_r05_builder.jsonl profiler_reconciliation)
+    total_teq = sum(r["teq"] for r in rows.values()) or 1
     table = [
         {
             "Event": tag,
             "Calls": r["instructions"],
-            "Total": step_s * 1e3 * r["bytes"] / total_bytes,
+            "Total": step_s * 1e3 * r["teq"] / total_teq,
             "Min": 0.0,
             "Max": 0.0,
-            "Ave": step_s * 1e3 * r["bytes"] / total_bytes
+            "Ave": step_s * 1e3 * r["teq"] / total_teq
             / max(r["instructions"], 1),
             "Bytes": r["bytes"],
+            "Flops": r["flops"],
         }
         for tag, r in rows.items()
     ]
@@ -380,7 +595,8 @@ def compiled_profile(exe, program, feed, fetch_list, runs=3,
         ),
         "timing_mode": "device" if dev_s is not None else "e2e",
         "flops": float((ca or {}).get("flops", 0.0)),
-        "bytes_attributed": total_bytes,
+        "bytes_attributed": sum(r["bytes"] for r in rows.values()),
+        "teq_attributed": total_teq,
     }
     _print_table(table, step_s * runs)
     return table, meta
@@ -404,15 +620,18 @@ def parse_hlo_instr_tags(hlo_text):
 
 
 def _parse_trace_durations(trace_dir):
-    """Sum per-HLO-instruction device durations (us) from a
-    jax.profiler.trace output directory. Events carry the instruction
-    name verbatim ('fusion.123', 'dot_general.1'); bookkeeping events
-    ('end: ...', runtime internals) are dropped by the join later."""
+    """Per-plane sums of per-event durations (us) from a
+    jax.profiler.trace output directory: {pid: {event_name: us}}. Events
+    carry the HLO instruction name verbatim ('fusion.123',
+    'dot_general.1') on the device plane; host planes carry Python /
+    runtime spans that must never pollute the device accounting — the
+    caller picks the plane that actually holds the compiled step's
+    instructions."""
     import glob
     import gzip
     import json as _json
 
-    durs = {}
+    planes = {}
     for p in glob.glob(
         os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
     ):
@@ -423,8 +642,9 @@ def _parse_trace_durations(trace_dir):
             name = e.get("name", "")
             if name.startswith("end: "):
                 continue
+            durs = planes.setdefault(e.get("pid", 0), {})
             durs[name] = durs.get(name, 0.0) + float(e["dur"])
-    return durs
+    return planes
 
 
 def trace_profile(exe, program, feed, fetch_list, runs=3):
@@ -467,32 +687,58 @@ def trace_profile(exe, program, feed, fetch_list, runs=3):
             for _ in range(runs):
                 out = exe.run(program, feed=feed, fetch_list=fetch_list)
             _np.asarray(out[0])  # sync inside the trace window
-        durs = _parse_trace_durations(trace_dir)
+        planes = _parse_trace_durations(trace_dir)
     finally:
         shutil.rmtree(trace_dir, ignore_errors=True)
 
-    # join: instruction event -> op tag. Trace event names sometimes
-    # carry a '.remat'/suffix variant; exact match first, then prefix.
-    measured = {}
-    unmatched_us = 0.0
-    for name, us in durs.items():
-        tag = tags.get(name)
-        if tag is None:
-            base = name.split(" ")[0]
-            tag = tags.get(base)
-        if tag is None:
-            unmatched_us += us
-            continue
-        measured[tag] = measured.get(tag, 0.0) + us
+    # join: instruction event -> op tag, on the DEVICE plane only. The
+    # trace holds one plane per pid — host Python/runtime threads, the
+    # dispatch queue, and the device's instruction track. Joining every
+    # plane inflated unmatched_ms ~100x (host spans nest device events;
+    # r5 on-chip capture). The device plane is identified, not assumed:
+    # the pid whose events best match the entry's instruction names.
+    # module-level / bookkeeping spans on the device plane (the whole
+    # 'jit_step(...)' execution span, numeric queue ids) nest the
+    # instruction events — counting them as unmatched instruction time
+    # double-bills the entire step
+    _instr_name = _re.compile(r"^[a-z][\w.\-]*$")
+
+    def _match(durs):
+        meas, unmatched = {}, 0.0
+        for name, us in durs.items():
+            tag = tags.get(name)
+            if tag is None:
+                tag = tags.get(name.split(" ")[0])
+            if tag is None:
+                base = name.split(" ")[0]
+                if _instr_name.match(base) and not base.startswith("jit_"):
+                    unmatched += us
+                continue
+            meas[tag] = meas.get(tag, 0.0) + us
+        return meas, unmatched
+
+    best = ({}, 0.0)
+    for durs in planes.values():
+        cand = _match(durs)
+        if sum(cand[0].values()) > sum(best[0].values()):
+            best = cand
+    measured, unmatched_us = best
+    if not measured:
+        # no plane matched a single instruction tag (renamed events,
+        # empty trace): surface the largest instruction-like residue
+        # instead of reporting a silently-clean 0.0 join
+        unmatched_us = max(
+            (_match(d)[1] for d in planes.values()), default=0.0
+        )
     total_meas = sum(measured.values()) or 1.0
-    total_bytes = sum(r["bytes"] for r in model_rows.values()) or 1
+    total_teq = sum(r["teq"] for r in model_rows.values()) or 1
 
     table = []
     for tag in sorted(set(measured) | set(model_rows)):
         m_us = measured.get(tag, 0.0)
-        b = model_rows.get(tag, {}).get("bytes", 0)
+        t = model_rows.get(tag, {}).get("teq", 0)
         meas_share = m_us / total_meas
-        model_share = b / total_bytes
+        model_share = t / total_teq
         table.append({
             "Event": tag,
             "measured_ms": round(m_us / 1e3 / runs, 4),
@@ -505,6 +751,7 @@ def trace_profile(exe, program, feed, fetch_list, runs=3):
     meta = {
         "runs": runs,
         "measured_total_ms": round(total_meas / 1e3 / runs, 3),
+        # leftover time on the DEVICE plane only (infeed, runtime ops)
         "unmatched_ms": round(unmatched_us / 1e3 / runs, 3),
         "top5_max_disagreement": max(
             (r["disagreement"] for r in top5), default=0.0

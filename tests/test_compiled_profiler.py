@@ -79,6 +79,47 @@ ENTRY %main (p0: f32[4,8]) -> f32[4,8] {
     assert rows["mul_op_grad"]["bytes"] == 384  # out + two operands
 
 
+def test_parse_hlo_flops_conv_dot_and_overlap():
+    """Roofline-time attribution inputs (on-chip reconciliation, r5):
+    conv FLOPs count only in-bounds window taps (a full-padding backward
+    conv is ~8x overcounted otherwise), dot FLOPs use the contracting
+    dims, fusion-called computations charge their entry caller, and
+    async prefetch machinery carries bytes but zero time weight."""
+    txt = """HloModule jit_step, is_scheduled=true
+
+%fused_dot {
+  %pa = f32[8,16]{1,0} parameter(0)
+  %pb = f32[16,4]{1,0} parameter(1)
+  ROOT %dot.1 = f32[8,4]{1,0} dot(%pa, %pb), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+ENTRY %main (p0: bf16[2,3,8,8], w0: bf16[4,3,3,3], a0: f32[8,16], b0: f32[16,4]) -> f32[8,4] {
+  %p0 = bf16[2,3,8,8]{3,2,1,0} parameter(0)
+  %w0 = bf16[4,3,3,3]{3,2,1,0} parameter(1)
+  %a0 = f32[8,16]{1,0} parameter(2)
+  %b0 = f32[16,4]{1,0} parameter(3)
+  %conv.1 = bf16[2,4,8,8]{3,2,1,0} convolution(%p0, %w0), window={size=3x3 pad=1_1x1_1}, dim_labels=bf01_oi01->bf01, metadata={op_name="jit(step)/jvp(op:conv2d)/conv_general_dilated"}
+  %copy-start.1 = (f32[8,16]{1,0}, f32[8,16]{1,0}, u32[]) copy-start(%a0)
+  %copy-done.1 = f32[8,16]{1,0} copy-done(%copy-start.1)
+  ROOT %fusion.2 = f32[8,4]{1,0} fusion(%copy-done.1, %b0), kind=kOutput, calls=%fused_dot, metadata={op_name="jit(step)/op:mul/dot_general"}
+}
+"""
+    rows = parse_hlo_op_costs(txt)
+    # conv: 8x8 out, 3x3 window, pad 1 -> valid taps per dim =
+    # 6*3 + 2*2(edges missing one tap) = 22; 2 * (2*4) * 22*22 * Cin=3
+    assert rows["conv2d"]["flops"] == 2 * (2 * 4) * (22 * 22) * 3
+    # dot inside the called computation charges the entry fusion:
+    # 2 * out(8*4) * contracted(16)
+    assert rows["mul"]["flops"] == 2 * 8 * 4 * 16
+    # copy-start is free (its pair carries the traffic); copy-done
+    # bills bytes but no flops
+    xla = rows["[xla]"]
+    assert xla["flops"] == 0.0
+    assert xla["bytes"] > 0
+    # every row's time weight is positive except pure bookkeeping
+    assert rows["conv2d"]["teq"] > 0 and rows["mul"]["teq"] > 0
+
+
 def test_trace_profile_reconciles_on_cpu():
     """trace_profile (r4 verdict #4): jax.profiler instruction events
     join back to op tags through the HLO metadata; measured rows cover
