@@ -11,11 +11,13 @@ algorithm the ring-attention path uses ACROSS chips
 
 Forward is a single `pl.pallas_call` over a (batch*heads, q_blocks,
 k_blocks) grid with the k axis innermost (grid-reduction pattern:
-initialise at k==0, accumulate, finalise at the last k step). Backward
-(jax.custom_vjp) is a blockwise recompute: a lax.scan over q blocks
-rebuilds one [block_q, S] score tile per step — the flash-style
-"recompute instead of store" trade with transient memory O(block_q*S),
-never the full [T, S] residual.
+initialise at k==0, accumulate, finalise at the last k step), emitting
+the per-row log-sum-exp as a residual. Backward (jax.custom_vjp) is
+two pallas passes that rebuild each probability tile from the lse —
+dk/dv over a (bh, k_blocks, q_blocks) grid, dq over the forward's grid
+— so every matmul stays a VMEM-tiled MXU op and memory stays O(T)
+(r5; the previous XLA blockwise-recompute scan materialised
+[block_q, S] f32 score tiles in HBM).
 
 `interpret=True` runs the kernel on CPU for CI (tests/conftest runs on
 a CPU mesh); on TPU the same kernel compiles to Mosaic.
@@ -35,9 +37,46 @@ __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
 
+# backward tile cap: the bwd kernels hold ~3 extra [block_q, block_k]
+# f32 intermediates vs the forward, so 1024-wide blocks that fit the
+# forward would exceed the 16 MB scoped-VMEM budget here
+_BWD_BLOCK_CAP = 512
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-               scale: float, causal: bool, block_q: int, block_k: int):
+
+def _block_needed(qi, kj, block_q, block_k, causal):
+    """Whole-block causal skip: a k block strictly above this q block's
+    last row is fully masked — skip its matmuls entirely."""
+    return kj * block_k <= qi * block_q + block_q - 1 if causal else True
+
+
+def _causal_fill(s, qi, kj, block_q, block_k):
+    """Mask the upper triangle of one [block_q, block_k] score tile to
+    -inf. Shared by the forward online-softmax and the backward
+    probability reconstruction so the two can never disagree."""
+    q_idx = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_idx = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_idx >= k_idx, s, _NEG_INF)
+
+
+def _bwd_block(block, length):
+    """Backward tile size: cap at _BWD_BLOCK_CAP, halve until it
+    divides — but never below the 8-row minimum the forward refuses;
+    awkward lengths (e.g. prime T<=1024 that the forward runs as one
+    whole-sequence block) fall back to a whole-length block instead of
+    degrading to a per-row grid."""
+    b = min(block, _BWD_BLOCK_CAP)
+    while b > 1 and length % b:
+        b //= 2
+    return b if b >= 8 else length
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+               l_ref, *, scale: float, causal: bool, block_q: int,
+               block_k: int):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -48,13 +87,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # causal: a k block strictly above this q block's last row is fully
-    # masked — skip its matmuls entirely (half the grid for long T)
-    needed = (
-        kj * block_k <= qi * block_q + block_q - 1 if causal else True
-    )
-
-    @pl.when(needed)
+    @pl.when(_block_needed(qi, kj, block_q, block_k, causal))
     def _accumulate():
         q = q_ref[0]  # [block_q, D], input dtype (bf16 stays on the MXU
         k = k_ref[0]  # bf16 path; accumulation is f32 via
@@ -65,13 +98,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k]
         if causal:
-            q_idx = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_idx = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+            s = _causal_fill(s, qi, kj, block_q, block_k)
 
         m_prev = m_ref[...]  # [block_q, 1]
         l_prev = l_ref[...]
@@ -95,6 +122,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finalise():
         denom = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        # per-row log-sum-exp residual for the pallas backward:
+        # p = exp(s - lse) reconstructs the normalised softmax directly
+        lse_ref[0] = m_ref[...] + jnp.log(denom)
 
 
 def _fa_forward(q, k, v, scale: float, causal: bool, block_q: int,
@@ -115,8 +145,14 @@ def _fa_forward(q, k, v, scale: float, causal: bool, block_q: int,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -139,56 +175,171 @@ def _reference(q, k, v, scale, causal):
     )
 
 
+def _bwd_scores(q, k, lse, qi, kj, *, scale, causal, block_q, block_k):
+    """Rebuild one normalised probability tile p = exp(s*scale - lse)
+    inside a backward kernel. Masked taps reconstruct to exact 0 via
+    exp(-inf); no separate mask needed beyond the causal score fill."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        s = _causal_fill(s, qi, kj, block_q, block_k)
+    return jnp.exp(s - lse)
+
+
+def _fa_bwd_kv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                      block_q, block_k):
+    """dk/dv pass: grid (BH, k_blocks, q_blocks), q innermost — each k
+    block accumulates over the q blocks that attend to it."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_block_needed(qi, kj, block_q, block_k, causal))
+    def _accumulate():
+        q = q_ref[0]
+        g = g_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        p = _bwd_scores(q, k, lse_ref[0], qi, kj, scale=scale,
+                        causal=causal, block_q=block_q, block_k=block_k)
+        # dv += p^T g   (contract the q rows)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # ds = p * (g v^T - delta) * scale
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        # dk += ds^T q
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalise():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_q_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref,
+                     dq_ref, dq_acc, *, scale, causal, block_q,
+                     block_k):
+    """dq pass: grid (BH, q_blocks, k_blocks), k innermost — mirrors the
+    forward's grid-reduction shape."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_block_needed(qi, kj, block_q, block_k, causal))
+    def _accumulate():
+        q = q_ref[0]
+        g = g_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        p = _bwd_scores(q, k, lse_ref[0], qi, kj, scale=scale,
+                        causal=causal, block_q=block_q, block_k=block_k)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        # dq += ds k
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finalise():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _fa_forward(q, k, v, scale, causal, block_q, block_k,
-                       interpret)
+    out, _ = _fa_forward(q, k, v, scale, causal, block_q, block_k,
+                         interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _fa_forward(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _fa_forward(q, k, v, scale, causal, block_q, block_k,
+                           interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    """Blockwise recompute backward: scan over q blocks, each step
-    rebuilding only its [block_q, S] score tile — transient memory
-    O(block_q * S), never the full [T, S] matrix (the flash trade)."""
-    q, k, v = res
+    """Pallas flash backward (r5; previously an XLA blockwise-recompute
+    scan that materialised [block_q, S] f32 score tiles in HBM): two
+    tiled passes that rebuild each probability block from the saved
+    log-sum-exp — dk/dv with q innermost, dq with k innermost. Memory
+    stays O(T), all matmuls hit the MXU with f32 accumulation."""
+    q, k, v, out, lse = res
     BH, T, D = q.shape
-    nq = T // block_q
-
-    def one_block(carry, i):
-        dk_acc, dv_acc = carry
-        qb = jax.lax.dynamic_slice_in_dim(q, i * block_q, block_q, axis=1)
-        gb = jax.lax.dynamic_slice_in_dim(g, i * block_q, block_q, axis=1)
-
-        def blk(qb, k, v):
-            s = jnp.einsum(
-                "bqd,bkd->bqk", qb.astype(jnp.float32),
-                k.astype(jnp.float32)
-            ) * scale
-            if causal:
-                q_idx = i * block_q + jnp.arange(block_q)
-                k_idx = jnp.arange(k.shape[1])
-                s = jnp.where(
-                    (q_idx[:, None] >= k_idx[None, :])[None], s, _NEG_INF
-                )
-            p = jax.nn.softmax(s, axis=-1)
-            return jnp.einsum("bqk,bkd->bqd", p,
-                              v.astype(jnp.float32)).astype(qb.dtype)
-
-        _, vjp = jax.vjp(blk, qb, k, v)
-        dqb, dkb, dvb = vjp(gb)
-        return (dk_acc + dkb, dv_acc + dvb), dqb
-
-    (dk, dv), dq_blocks = jax.lax.scan(
-        one_block,
-        (jnp.zeros_like(k), jnp.zeros_like(v)),
-        jnp.arange(nq),
+    S = k.shape[1]
+    bq = _bwd_block(block_q, T)
+    bk = _bwd_block(block_k, S)
+    nq = T // bq
+    nk = S // bk
+    # delta_i = rowsum(g * o): the p·dp row-dot every ds tile needs
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
     )
-    # dq_blocks: [nq, BH, block_q, D] -> [BH, T, D]
-    dq = jnp.moveaxis(dq_blocks, 0, 1).reshape(BH, T, D)
+    lse = lse.reshape(BH, T, 1)
+
+    q_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    row_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_kv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(BH, nk, nq),
+        in_specs=[q_spec, q_spec, kv_spec, kv_spec, row_spec, row_spec],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, g, k, v, lse, delta)
+
+    q_spec2 = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    row_spec2 = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_q_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec2, q_spec2, kv_spec2, kv_spec2, row_spec2,
+                  row_spec2],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, g, k, v, lse, delta)
     return dq, dk, dv
 
 

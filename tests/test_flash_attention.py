@@ -56,6 +56,34 @@ def test_flash_gradients():
                                    atol=3e-4)
 
 
+def test_flash_bwd_awkward_length_whole_block():
+    """T<=1024 with a tiny power-of-two factor runs as ONE forward
+    block; the pallas backward must fall back to a whole-length block
+    too instead of degrading to a per-row grid (r5 review finding:
+    T=516 halved to 4-row blocks, T=521 to 1-row)."""
+    from paddle_tpu.parallel.flash_attention import _bwd_block
+
+    assert _bwd_block(1024, 516) == 516
+    assert _bwd_block(1024, 521) == 521
+    assert _bwd_block(1024, 4096) == 512
+    assert _bwd_block(64, 256) == 64
+
+    q, k, v = _qkv(np.random.RandomState(7), T=516)
+
+    def f_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) ** 2)
+
+    def r_loss(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_f = jax.grad(f_loss, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(r_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-4)
+
+
 def test_flash_validates():
     # non-power-of-two T: blocks halve until they divide (T=768: 512 ->
     # 256), result still matches the reference
