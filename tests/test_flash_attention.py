@@ -56,6 +56,30 @@ def test_flash_gradients():
                                    atol=3e-4)
 
 
+def test_flash_gradients_cross_attention():
+    """Backward on the T != S path: the dk/dv pass runs a different
+    grid extent than dq (nq != nk) and the lse/delta row side-bands
+    index by q while dk/dv index by k — an index-map mixup would only
+    surface here, not in the square causal case."""
+    rng = np.random.RandomState(8)
+    q = jnp.asarray(rng.randn(2, 128, 2, 32).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 384, 2, 32).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 384, 2, 32).astype(np.float32))
+
+    def f_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=64, block_k=128,
+                                       interpret=True) ** 2)
+
+    def r_loss(q, k, v):
+        return jnp.sum(reference_attention(q, k, v) ** 2)
+
+    g_f = jax.grad(f_loss, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(r_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-4)
+
+
 def test_flash_bwd_awkward_length_whole_block():
     """T<=1024 with a tiny power-of-two factor runs as ONE forward
     block; the pallas backward must fall back to a whole-length block
