@@ -139,14 +139,33 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
     return out.astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale=None, impl: str = "reference",
+                      interpret: Optional[bool] = None):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism; call
-    inside shard_map with [B, T/n, H, D] shards. Requires H % n == 0."""
+    inside shard_map with [B, T/n, H, D] shards. Requires H % n == 0.
+
+    After the head<->sequence exchange each shard holds its heads' FULL
+    sequence, so the local attention is exactly the single-chip problem
+    — impl="flash" runs the pallas flash kernel per shard (O(T) memory,
+    pallas backward; the enclosing shard_map needs check_vma=False for
+    the interpret-mode CI path — sequence_parallel_attention arranges
+    that); the default impl="reference" materialises the [T, T] scores
+    (oracle path, and the pre-r5 behavior for direct callers).
+    `interpret` follows flash_attention.resolve_interpret."""
     # exchange: split heads across the axis, gather the full sequence
     qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    og = reference_attention(qg, kg, vg, causal=causal, scale=scale)
+    if impl == "flash":
+        from .flash_attention import flash_attention, resolve_interpret
+
+        og = flash_attention(
+            qg, kg, vg, causal=causal, scale=scale,
+            interpret=resolve_interpret(interpret),
+        )
+    else:
+        og = reference_attention(qg, kg, vg, causal=causal, scale=scale)
     return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
@@ -157,6 +176,7 @@ def sequence_parallel_attention(
     impl: str = "ring",
     causal: bool = False,
     scale=None,
+    interpret: Optional[bool] = None,
 ):
     """Global-view entry point: q/k/v are [B, T, H, D] global arrays; the
     sequence dim is sharded over `axis` of `mesh` and attention runs
@@ -169,13 +189,11 @@ def sequence_parallel_attention(
         mesh = get_default_mesh()
     if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] == 1:
         if impl == "flash":
-            import jax as _jax
-
-            from .flash_attention import flash_attention
+            from .flash_attention import flash_attention, resolve_interpret
 
             return flash_attention(
                 q, k, v, causal=causal, scale=scale,
-                interpret=_jax.default_backend() == "cpu",
+                interpret=resolve_interpret(interpret),
             )
         return reference_attention(q, k, v, causal=causal, scale=scale)
     if q.shape[1] % mesh.shape[axis] != 0:
@@ -183,18 +201,35 @@ def sequence_parallel_attention(
             "sequence length %d not divisible by mesh axis %r size %d"
             % (q.shape[1], axis, mesh.shape[axis])
         )
+    flash_inner = False
     if impl == "flash":
-        # sharded flash = ring layout with the pallas kernel per block is
-        # future work; today multi-shard requests fall back to ring
-        impl = "ring"
+        # multi-shard flash: ulysses' head<->seq all-to-all puts a full
+        # sequence per shard, where the pallas kernel (fwd + backward)
+        # applies unchanged; heads not divisible by the axis fall back
+        # to ring (jnp online-softmax across ppermute steps)
+        flash_inner = q.shape[2] % mesh.shape[axis] == 0
+        impl = "ulysses" if flash_inner else "ring"
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
     if impl == "ulysses" and q.shape[2] % mesh.shape[axis] != 0:
         raise ValueError("ulysses needs heads divisible by the seq axis size")
     spec = P(None, axis, None, None)
-    mapped = shard_map(
-        functools.partial(fn, axis_name=axis, causal=causal, scale=scale),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+    body = functools.partial(fn, axis_name=axis, causal=causal,
+                             scale=scale)
+    if flash_inner:
+        body = functools.partial(body, impl="flash", interpret=interpret)
+    kwargs = dict(
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
+    if flash_inner:
+        # interpret-mode pallas under the vma type system rejects the
+        # kernel's internal dynamic_slice on mixed-vma operands (JAX's
+        # own error text recommends check_vma=False as the workaround);
+        # only the pallas-bearing path drops the check — ring and
+        # ulysses-reference keep the replication typing
+        try:
+            mapped = shard_map(body, check_vma=False, **kwargs)
+        except TypeError:  # older jax: no check_vma kwarg
+            mapped = shard_map(body, **kwargs)
+    else:
+        mapped = shard_map(body, **kwargs)
     return mapped(q, k, v)

@@ -74,6 +74,29 @@ def _bwd_block(block, length):
     return b if b >= 8 else length
 
 
+def resolve_interpret(interpret):
+    """None -> interpret on the CPU backend (CI), compile Mosaic
+    elsewhere. AOT lowering for a TPU topology from a CPU host must
+    pass an explicit False — the host backend is the wrong signal
+    there (bench_offline's ulysses workload does)."""
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() == "cpu"
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct for a pallas output, propagating the input's
+    varying-mesh-axes type (vma) so the kernel is callable inside
+    shard_map (ulysses runs it per shard) under JAX's check_vma."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
                l_ref, *, scale: float, causal: bool, block_q: int,
                block_k: int):
@@ -150,8 +173,8 @@ def _fa_forward(q, k, v, scale: float, causal: bool, block_q: int,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+            _out_struct((BH, T, D), q.dtype, q),
+            _out_struct((BH, T, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -316,8 +339,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            _out_struct((BH, S, D), k.dtype, k),
+            _out_struct((BH, S, D), v.dtype, k),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
@@ -336,7 +359,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
         in_specs=[q_spec2, q_spec2, kv_spec2, kv_spec2, row_spec2,
                   row_spec2],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_shape=_out_struct((BH, T, D), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(q, g, k, v, lse, delta)
