@@ -689,9 +689,38 @@ def bench_flash_attention(B=4, T=4096, H=16, D=64, steps=(4, 16)):
 
         return _diff_time(run_at, *steps, return_info=True)
 
+    def per_iter_grad(attn):
+        """fwd+bwd per-iteration cost: grads chain into the carry so no
+        iteration is dead code (r5: exercises the pallas backward)."""
+        def loss(c, kk, vv):
+            return attn(c, kk, vv).astype(jnp.float32).sum()
+
+        def multi(n):
+            @jax.jit
+            def f(q, k, v):
+                def body(c, _):
+                    gq = jax.grad(loss)(c, k, v)
+                    return (c + 1e-6 * gq).astype(c.dtype), ()
+
+                out, _ = lax.scan(body, q, None, length=n)
+                return out.sum()
+
+            return f
+
+        fs = {n: multi(n) for n in steps}
+
+        def run_at(n):
+            float(fs[n](q, k, v))
+
+        return _diff_time(run_at, *steps, return_info=True)
+
     dt_flash, t_flash = per_iter(
         lambda c, kk, vv: flash_attention(c, kk, vv, causal=True))
     dt_ref, t_ref = per_iter(
+        lambda c, kk, vv: reference_attention(c, kk, vv, causal=True))
+    dt_fb_flash, t_fb_flash = per_iter_grad(
+        lambda c, kk, vv: flash_attention(c, kk, vv, causal=True))
+    dt_fb_ref, t_fb_ref = per_iter_grad(
         lambda c, kk, vv: reference_attention(c, kk, vv, causal=True))
     ms_flash, ms_ref = dt_flash * 1e3, dt_ref * 1e3
     err = float(jnp.abs(
@@ -705,10 +734,16 @@ def bench_flash_attention(B=4, T=4096, H=16, D=64, steps=(4, 16)):
         "ms_xla_full": round(ms_ref, 3),
         "speedup": round(ms_ref / ms_flash, 3),
         "flash_tflops": round(flops / (ms_flash / 1e3) / 1e12, 1),
+        # fwd+bwd: the pallas backward (two tiled passes off the lse
+        # residual) vs XLA autodiff of the full-matrix attention
+        "ms_fwdbwd_flash": round(dt_fb_flash * 1e3, 3),
+        "ms_fwdbwd_xla": round(dt_fb_ref * 1e3, 3),
+        "fwdbwd_speedup": round(dt_fb_ref / dt_fb_flash, 3),
         "max_err": err,
         "dtype": "bfloat16",
         "shape": [B, T, H, D],
-        "timing": {"flash": t_flash, "xla_full": t_ref},
+        "timing": {"flash": t_flash, "xla_full": t_ref,
+                   "fwdbwd_flash": t_fb_flash, "fwdbwd_xla": t_fb_ref},
     }
 
 
