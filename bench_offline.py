@@ -411,6 +411,38 @@ def offline_ring_attention_sp8(topo_devices, B=2, T_per=2048, H=8, D=64):
     return rec
 
 
+def offline_zigzag_sp8(topo_devices, B=2, T_per=2048, H=8, D=64):
+    """Zigzag (striped) causal ring attention fwd+bwd over all topology
+    chips (r5 beyond-reference: balances the causal mask so every chip
+    does ~2 stripe-matmuls per ring step instead of the tail chip's 4
+    — the lock-step critical path halves vs the contiguous layout)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import parallel
+
+    n = len(topo_devices)
+    mesh = parallel.make_mesh({"seq": n}, devices=topo_devices)
+    T = T_per * n
+
+    def loss(q, k, v):
+        out = parallel.sequence_parallel_attention(
+            q, k, v, mesh=mesh, impl="zigzag", causal=True
+        )
+        return jnp.sum(out.astype(jnp.float32))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(None, "seq"))
+    q = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16, sharding=sh)
+    t0 = time.time()
+    lowered = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q)
+    rec, txt = _cost_record(lowered, time.time() - t0)
+    rec["shape"] = {"B": B, "T_global": T, "H": H, "D": D, "chips": n}
+    rec["collectives"] = _count_collectives(txt)
+    return rec
+
+
 def offline_ulysses_flash_sp8(topo_devices, B=2, T_per=2048, H=8, D=64):
     """Ulysses sequence parallelism with the PALLAS flash kernel per
     shard (r5: sequence_parallel_attention impl='flash' routes here when
@@ -589,6 +621,8 @@ def main():
          lambda: offline_ring_attention_sp8(topo_devices)),
         ("ulysses_flash_sp%d" % len(topo_devices),
          lambda: offline_ulysses_flash_sp8(topo_devices)),
+        ("zigzag_sp%d" % len(topo_devices),
+         lambda: offline_zigzag_sp8(topo_devices)),
         ("switch_moe_ep%d" % len(topo_devices),
          lambda: offline_switch_moe_ep8(topo_devices)),
         ("resnet50_hybrid", lambda: offline_resnet50_hybrid(topo_devices)),

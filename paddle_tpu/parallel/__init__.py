@@ -31,6 +31,8 @@ from .attention import (
     ring_attention,
     sequence_parallel_attention,
     ulysses_attention,
+    zigzag_ring_attention,
+    zigzag_permutation,
 )
 from .embedding import ShardedEmbedding, sharded_lookup
 from .moe import expert_parallel_moe, moe_capacity, reference_moe
@@ -55,6 +57,8 @@ __all__ = [
     "DistributedContext",
     "ring_attention",
     "ulysses_attention",
+    "zigzag_ring_attention",
+    "zigzag_permutation",
     "sequence_parallel_attention",
     "reference_attention",
     "sharded_lookup",

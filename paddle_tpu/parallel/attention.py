@@ -59,6 +59,35 @@ def reference_attention(q, k, v, causal: bool = False, scale=None):
     return jnp.einsum("bhts,bshd->bthd", p, v)
 
 
+def _varying(x, axis_name):
+    """Mark a scan-carry constant as device-varying over the ring axis
+    (shard_map's vma type system; constants start out unvarying)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis_name,))
+    return x
+
+
+def _online_softmax_update(o, l, m, s, vs):
+    """One online-softmax accumulation over a pre-masked f32 score tile
+    `s`: rescale the running (o, l) by the max shift and fold in this
+    tile's contribution. The _NEG_INF guards keep fully-masked rows at
+    exact zero (exp never sees inf - inf). Shared by both ring
+    layouts so the numerics can never diverge."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    safe = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - safe[..., None])
+    p = jnp.where(s <= _NEG_INF, 0.0, p)
+    corr = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - safe))
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhts,bshd->bthd", p.astype(vs.dtype), vs,
+        preferred_element_type=jnp.float32,
+    )
+    return o_new, l_new, m_new
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
     """Blockwise ring attention; call inside shard_map with q/k/v sharded
     [B, T/n, H, D] on the sequence axis."""
@@ -75,18 +104,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
     q_pos = me * T + jnp.arange(T)  # global row ids of the local queries
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def _varying(x):
-        # the scan carry must be marked device-varying over the ring axis
-        # (shard_map's vma type system; constants start out unvarying)
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, (axis_name,), to="varying")
-        if hasattr(lax, "pvary"):
-            return lax.pvary(x, (axis_name,))
-        return x
-
-    o0 = _varying(jnp.zeros((B, T, H, D), jnp.float32))
-    l0 = _varying(jnp.zeros((B, H, T), jnp.float32))
-    m0 = _varying(jnp.full((B, H, T), _NEG_INF, jnp.float32))
+    o0 = _varying(jnp.zeros((B, T, H, D), jnp.float32), axis_name)
+    l0 = _varying(jnp.zeros((B, H, T), jnp.float32), axis_name)
+    m0 = _varying(jnp.full((B, H, T), _NEG_INF, jnp.float32), axis_name)
 
     def step(carry, i):
         o, l, m, kb, vb = carry
@@ -99,19 +119,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
             if causal:
                 mask = q_pos[:, None] >= k_pos[None, :]
                 s = jnp.where(mask[None, None], s, _NEG_INF)
-            m_new = jnp.maximum(m, s.max(axis=-1))
-            # fully-masked rows keep m_new at -inf; shift by a safe max
-            # so exp never sees inf-inf
-            safe = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
-            p = jnp.exp(s - safe[..., None])
-            p = jnp.where(s <= _NEG_INF, 0.0, p)
-            corr = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - safe))
-            l_new = l * corr + p.sum(axis=-1)
-            o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-                "bhts,bshd->bthd", p.astype(vb.dtype), vb,
-                preferred_element_type=jnp.float32,
-            )
-            return o_new, l_new, m_new
+            return _online_softmax_update(o, l, m, s, vb)
 
         if causal:
             # a source chunk strictly to the right of this shard's rows
@@ -137,6 +145,144 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
     (o, l, _, _, _), _ = lax.scan(step, (o0, l0, m0, k, v), jnp.arange(n))
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def zigzag_ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                          scale=None):
+    """Causal ring attention with ZIGZAG (striped) row assignment —
+    the load-balance fix ring_attention's causal path documents as
+    future work: with contiguous rows, the last shard's rows see every
+    source block, so the lock-step ring's critical path never benefits
+    from the causal skip. Striped, shard i holds stripe i (rows
+    [iC, (i+1)C), C = T_local/2) and its mirror stripe 2n-1-i; each
+    ring step then costs every shard ~2 stripe-matmuls instead of the
+    tail shard's 4 (per-step work is the max over shards — lock-step).
+
+    Because stripes are aligned, visibility per (q-stripe, k-stripe)
+    pair is decided at stripe granularity: mirror-vs-front is always
+    visible, front-vs-mirror never, equal indices are the tril
+    diagonal — no global position arrays needed. Call inside shard_map
+    with the STRIPED layout (sequence_parallel_attention permutes);
+    causal only (the balance problem does not exist otherwise)."""
+    if not causal:
+        raise ValueError("zigzag ring attention is causal-only; use "
+                         "ring_attention for the non-causal case")
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    C = T // 2
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    tril = jnp.tril(jnp.ones((C, C), bool))
+
+    def accum(qs, ks, vs, masked):
+        def f(o, l, m):
+            s = jnp.einsum("bthd,bshd->bhts", qs, ks,
+                           preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = jnp.where(tril[None, None], s, _NEG_INF)
+            return _online_softmax_update(o, l, m, s, vs)
+
+        return f
+
+    def attend(carry_half, qs, ks, vs, mode):
+        """Online-softmax update of one q stripe against one k stripe.
+        mode: 0 skip (fully masked), 1 diagonal (tril), 2 fully
+        visible."""
+        o, l, m = carry_half
+        return lax.switch(
+            mode,
+            [lambda o, l, m: (o, l, m), accum(qs, ks, vs, True),
+             accum(qs, ks, vs, False)],
+            o, l, m,
+        )
+
+    def half_init():
+        return (
+            _varying(jnp.zeros((B, C, H, D), jnp.float32), axis_name),
+            _varying(jnp.zeros((B, H, C), jnp.float32), axis_name),
+            _varying(jnp.full((B, H, C), _NEG_INF, jnp.float32),
+                     axis_name),
+        )
+
+    def step(carry, i):
+        f_half, b_half, kb, vb = carry
+        src = (me - i) % n
+        kf, km = kb[:, :C], kb[:, C:]
+        vf, vm = vb[:, :C], vb[:, C:]
+        # front q stripe (index me) vs source front stripe (index src):
+        # strictly later stripe sees all of an earlier one
+        mode_ff = jnp.where(me > src, 2, jnp.where(me == src, 1, 0))
+        f_half = attend(f_half, q[:, :C], kf, vf, mode_ff)
+        # mirror q stripe (index 2n-1-me) vs source front: ALWAYS later
+        # — unconditional accumulate, no branch to obscure the matmul
+        b_half = accum(q[:, C:], kf, vf, False)(*b_half)
+        # mirror q vs source mirror (index 2n-1-src): inverted order
+        mode_bm = jnp.where(me < src, 2, jnp.where(me == src, 1, 0))
+        b_half = attend(b_half, q[:, C:], km, vm, mode_bm)
+        # front q vs source mirror: a front stripe never sees a mirror
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (f_half, b_half, kb, vb), None
+
+    (f_half, b_half, _, _), _ = lax.scan(
+        step, (half_init(), half_init(), k, v), jnp.arange(n)
+    )
+
+    def finish(half):
+        o, l, _ = half
+        return o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+
+    return jnp.concatenate(
+        [finish(f_half), finish(b_half)], axis=1
+    ).astype(q.dtype)
+
+
+def _zigzag_entry(q, k, v, mesh, axis, causal, scale):
+    """Global-view zigzag dispatch: permute rows to the striped layout,
+    run the balanced causal ring under shard_map, un-permute.
+
+    Convenience form — it pays the stripe gather/scatter per call. A
+    transformer stack should instead keep activations in the striped
+    layout end-to-end (position-free layers are layout-oblivious):
+    apply zigzag_permutation once at the embedding, call
+    zigzag_ring_attention directly inside the model's shard_map region,
+    and invert once at the head."""
+    if not causal:
+        raise ValueError("impl='zigzag' is causal-only")
+    n = mesh.shape[axis]
+    T = q.shape[1]
+    if T % (2 * n) != 0:
+        raise ValueError(
+            "zigzag needs the sequence length (%d) divisible by 2*axis "
+            "size (%d)" % (T, 2 * n)
+        )
+    perm, inv = zigzag_permutation(T, n)
+    qz, kz, vz = (jnp.take(x, perm, axis=1) for x in (q, k, v))
+    spec = P(None, axis, None, None)
+    mapped = shard_map(
+        functools.partial(zigzag_ring_attention, axis_name=axis,
+                          causal=True, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return jnp.take(mapped(qz, kz, vz), inv, axis=1)
+
+
+def zigzag_permutation(T_global: int, n: int):
+    """Row permutation taking the natural sequence order to the zigzag
+    shard layout: shard i's contiguous slot holds stripe i then stripe
+    2n-1-i. Returns (perm, inverse) index arrays of length T_global."""
+    import numpy as _np
+
+    C = T_global // (2 * n)
+    order = []
+    for i in range(n):
+        order.append(_np.arange(i * C, (i + 1) * C))
+        j = 2 * n - 1 - i
+        order.append(_np.arange(j * C, (j + 1) * C))
+    perm = _np.concatenate(order)
+    inv = _np.argsort(perm)
+    return perm, inv
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -187,6 +333,10 @@ def sequence_parallel_attention(
         from .mesh import get_default_mesh
 
         mesh = get_default_mesh()
+    if impl == "zigzag" and not causal:
+        # validate BEFORE the no-mesh fallback so a single-device dev
+        # run fails the same way the multi-chip run will
+        raise ValueError("impl='zigzag' is causal-only")
     if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] == 1:
         if impl == "flash":
             from .flash_attention import flash_attention, resolve_interpret
@@ -209,6 +359,8 @@ def sequence_parallel_attention(
         # to ring (jnp online-softmax across ppermute steps)
         flash_inner = q.shape[2] % mesh.shape[axis] == 0
         impl = "ulysses" if flash_inner else "ring"
+    if impl == "zigzag":
+        return _zigzag_entry(q, k, v, mesh, axis, causal, scale)
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
     if impl == "ulysses" and q.shape[2] % mesh.shape[axis] != 0:
         raise ValueError("ulysses needs heads divisible by the seq axis size")

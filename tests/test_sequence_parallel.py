@@ -40,6 +40,54 @@ def test_attention_matches_reference(qkv, seq_mesh, impl, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+def test_zigzag_matches_reference_and_balances(qkv, seq_mesh):
+    """Zigzag (striped) causal ring == the oracle exactly, for forward
+    AND gradients — the balanced layout must not change the math. Also
+    pins the permutation's shard layout: shard i holds stripe i and its
+    mirror 2n-1-i."""
+    q, k, v = qkv
+    want = parallel.reference_attention(q, k, v, causal=True)
+    got = parallel.sequence_parallel_attention(
+        q, k, v, mesh=seq_mesh, impl="zigzag", causal=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(parallel.reference_attention(q, k, v, causal=True) ** 2)
+
+    def loss_z(q, k, v):
+        return jnp.sum(
+            parallel.sequence_parallel_attention(
+                q, k, v, mesh=seq_mesh, impl="zigzag", causal=True
+            ) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_z = jax.grad(loss_z, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_z):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-4)
+
+    perm, inv = parallel.zigzag_permutation(16, 8)
+    assert list(perm[:2]) == [0, 15]  # shard 0: stripe 0 + mirror 15
+    assert list(perm[2:4]) == [1, 14]
+    assert list(np.asarray(perm)[np.asarray(inv)]) == list(range(16))
+
+
+def test_zigzag_validates(qkv, seq_mesh):
+    q, k, v = qkv
+    with pytest.raises(ValueError, match="causal-only"):
+        parallel.sequence_parallel_attention(
+            q, k, v, mesh=seq_mesh, impl="zigzag", causal=False
+        )
+    with pytest.raises(ValueError, match="divisible by 2"):
+        parallel.sequence_parallel_attention(
+            q[:, :8], k[:, :8], v[:, :8],
+            mesh=seq_mesh, impl="zigzag", causal=True
+        )
+
+
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_attention_gradients_match(qkv, seq_mesh, impl):
     q, k, v = qkv
