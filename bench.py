@@ -21,6 +21,9 @@ definitions):
   transformer_lm — long-context flagship: decoder-only LM (8x512, T=1024,
               flash attention, bf16), tokens/s + MFU; beyond-reference,
               no 2018 baseline
+  transformer_lm_large — the MFU headline for the LM family: 12x1024
+              (heads=16, T=2048, flash, bf16) — every matmul is
+              MXU-shaped; beyond-reference, no 2018 baseline
 
 Timing: per-step cost is measured by differencing two multi-step
 `run_repeated` calls ((T(hi)-T(lo))/(hi-lo)), which cancels the
@@ -41,9 +44,18 @@ Record field glossary (r4 measurement protocol):
                        per-count minima (noise-robust: a tunnel hiccup
                        only ADDs time) and medians
   timing.spread        (max-min)/min of the raw chunks per step count
-  timing.stable / stable  true iff every spread <= BENCH_SPREAD_LIMIT
-                       (default 10%) — a record with stable=false
-                       cannot demonstrate progress or regression
+  timing.spread_trimmed  same after dropping at most ONE worst chunk
+                       per count (only when >=4 chunks were taken and
+                       the raw spread failed — a single gross tunnel
+                       stall; the drop is recorded in outliers_dropped
+                       and the raw data stays)
+  timing.stable / stable  true iff every trimmed spread <=
+                       BENCH_SPREAD_LIMIT (default 10%) — a record
+                       with stable=false cannot demonstrate progress
+                       or regression
+  timing.chunk_scale   >1 when step counts were scaled up so the low
+                       chunk reaches BENCH_MIN_CHUNK_S (iterative
+                       probe; tunnel jitter is additive per call)
   mfu                  model-FLOPs utilisation (published fwd FLOPs x3)
   xla_flops_util       XLA cost-model FLOPs / peak (counts backward
                        dilated convs, ~1.8x model FLOPs on ResNet)
@@ -109,13 +121,26 @@ _DEADLINE = None  # monotonic deadline set by main(); guards extra compiles
 
 SPREAD_LIMIT = float(os.environ.get("BENCH_SPREAD_LIMIT", "0.10"))
 TIMING_CHUNKS = int(os.environ.get("BENCH_TIMING_CHUNKS", "3"))
+# floor on the LOW-count chunk's steady-state wall time: the tunnel's
+# per-call jitter is additive and of order tens of ms, so a chunk much
+# shorter than this cannot pass the spread gate no matter how steady the
+# chip is (r5: alexnet/mobilenet/lstm/sparse all captured stable=false
+# purely because their 8-12-step chunks ran 0.06-0.25 s)
+MIN_CHUNK_S = float(os.environ.get("BENCH_MIN_CHUNK_S", "1.0"))
+# bounds the iterative rescale (runtime/compile guard; the r5 sparse row
+# needed >16 to bring its 8-step chunks to the floor)
+MAX_CHUNK_SCALE = int(os.environ.get("BENCH_MAX_CHUNK_SCALE", "32"))
 
 
-def _diff_time(run_at, s_lo, s_hi, return_info=False):
+def _diff_time(run_at, s_lo, s_hi, return_info=False, scale_steps=True):
     """Steady-state per-step seconds by differencing two multi-step calls
     (cancels the per-call dispatch/sync overhead of the tunnel).
     `run_at(steps)` must execute `steps` iterations and block until the
-    result is real.
+    result is real; with scale_steps=True (default) it must accept ANY
+    positive step count, because the counts are scaled up until the low
+    chunk runs at least MIN_CHUNK_S (callers whose step count has
+    semantic meaning — e.g. KV-cache decode length — pass
+    scale_steps=False).
 
     Measurement protocol (falsifiability requirements from the r3
     verdict): warm both step counts (compile), then time >=3 chunks per
@@ -126,10 +151,45 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False):
     raw chunk timing, the spreads, and a `stable` verdict are all
     reported so the record can be audited and two runs compared."""
     warm_s = {}
-    for s in (s_lo, s_hi):
-        t0 = time.time()
-        run_at(s)  # compile + warm
-        warm_s[s] = time.time() - t0
+
+    def _warm(s):
+        if s not in warm_s:
+            t0 = time.time()
+            run_at(s)  # compile + warm
+            warm_s[s] = time.time() - t0
+
+    _warm(s_lo)
+    scale = 1
+    if scale_steps:
+        # probe the low chunk and rescale until it reaches the floor.
+        # The probe INCLUDES the additive per-call tunnel overhead, so a
+        # one-shot scale = ceil(floor/probe) undershoots by
+        # (scale-1)*overhead — iterating (re-probing the scaled count)
+        # converges instead of trusting the first estimate.
+        for _ in range(3):
+            s_cur = s_lo * scale
+            _warm(s_cur)
+            t0 = time.time()
+            run_at(s_cur)  # steady-state probe (already compiled)
+            probe = time.time() - t0
+            # every run_at blocks on a value readback, so a healthy
+            # probe is a full execution (>= tunnel RTT + real steps). A
+            # probe under 10 ms is the signature of the r3
+            # memoized/ack-only failure mode — scaling off it would
+            # saturate at MAX_CHUNK_SCALE and waste the side budget on
+            # every workload, so stop scaling there.
+            if probe < 0.01 or probe >= MIN_CHUNK_S:
+                break
+            new_scale = min(
+                MAX_CHUNK_SCALE,
+                scale * int(np.ceil(MIN_CHUNK_S / probe)),
+            )
+            if new_scale == scale:
+                break
+            scale = new_scale
+    s_lo, s_hi = s_lo * scale, s_hi * scale
+    _warm(s_lo)
+    _warm(s_hi)
     raw = {s_lo: [], s_hi: []}
     rounds = 0
     while True:
@@ -144,6 +204,21 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False):
         }
         if max(spread.values()) <= SPREAD_LIMIT or rounds >= 2:
             break
+    # stability verdict: a single gross tunnel stall (r5 observed one
+    # 144-step chunk at 42 s among five at 6.47 s) should not flip the
+    # flag when the remaining chunks agree — drop at most ONE worst
+    # chunk per count (only when >=4 were taken), visibly: the full raw
+    # data stays in the record and trimmed counts are reported. The
+    # per-step ESTIMATE never used the outlier anyway (min/median
+    # differencing).
+    spread_trimmed, outliers_dropped = {}, {}
+    for s in raw:
+        if spread[s] > SPREAD_LIMIT and len(raw[s]) >= 4:
+            kept = sorted(raw[s])[:-1]
+            spread_trimmed[s] = (max(kept) - min(kept)) / min(kept)
+            outliers_dropped[s] = 1
+        else:
+            spread_trimmed[s] = spread[s]
     dt_min = (min(raw[s_hi]) - min(raw[s_lo])) / (s_hi - s_lo)
     dt_med = float(
         (np.median(raw[s_hi]) - np.median(raw[s_lo])) / (s_hi - s_lo)
@@ -165,9 +240,33 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False):
         "per_step_s_min": round(dt_min, 6),
         "per_step_s_median": round(dt_med, 6),
         "spread": {str(s): round(spread[s], 4) for s in raw},
-        "stable": bool(max(spread.values()) <= SPREAD_LIMIT),
+        "spread_trimmed": {
+            str(s): round(spread_trimmed[s], 4) for s in raw
+        },
+        "stable": bool(max(spread_trimmed.values()) <= SPREAD_LIMIT),
+        # >1 when the requested counts were scaled to reach MIN_CHUNK_S;
+        # warm_s then also carries the intermediate probe counts' warms
+        "chunk_scale": scale,
     }
+    if outliers_dropped:
+        info["outliers_dropped"] = {
+            str(s): n for s, n in outliers_dropped.items()
+        }
     return (dt, info) if return_info else dt
+
+
+def _jit_per_count(build, consume):
+    """run_at factory for the scale_steps contract: jit `build(n)` on
+    demand per step count (any count — chunk scaling picks new ones)
+    and pass the result to `consume` (which must block on a readback)."""
+    fs = {}
+
+    def run_at(n):
+        if n not in fs:
+            fs[n] = build(n)
+        consume(fs[n])
+
+    return run_at
 
 
 def _per_step_seconds(exe, prog, feed, fetch, s_lo, s_hi):
@@ -580,14 +679,16 @@ def bench_transformer_lm(B=8, T=1024, dim=512, heads=8, layers_n=8,
 
         return lax.scan(body, p, None, length=n)
 
-    runners = {n: jax.jit(lambda p, t, n=n: multi(p, t, n)) for n in steps}
     rng = np.random.RandomState(0)
     toks = jax.device_put(
         rng.randint(0, vocab, (B, T + 1)).astype(np.int32))
 
-    def run_at(s):
-        _, losses = runners[s](params, toks)
+    def _check(f):
+        _, losses = f(params, toks)
         assert np.isfinite(float(np.ravel(np.asarray(losses))[-1]))
+
+    run_at = _jit_per_count(
+        lambda n: jax.jit(lambda p, t: multi(p, t, n)), _check)
 
     dt, timing = _diff_time(run_at, *steps, return_info=True)
 
@@ -634,8 +735,10 @@ def bench_lm_decode(B=8, T0=512, new_tokens=(64, 192), dim=512, heads=8,
         out = gens[n](params, prompt)
         assert int(np.asarray(out[0, -1])) >= 0
 
-    # seconds per generated token
-    dt, timing = _diff_time(run_at, *new_tokens, return_info=True)
+    # seconds per generated token; the step count IS the decode length
+    # (bounded by cfg.max_len), so chunk scaling must not touch it
+    dt, timing = _diff_time(
+        run_at, *new_tokens, return_info=True, scale_steps=False)
     return {
         "decode_tokens_per_sec": round(B / dt, 1),
         "ms_per_token": round(dt * 1e3 / B, 3),
@@ -682,11 +785,8 @@ def bench_flash_attention(B=4, T=4096, H=16, D=64, steps=(4, 16)):
 
             return f
 
-        fs = {n: multi(n) for n in steps}
-
-        def run_at(n):
-            float(fs[n](q, k, v))  # scalar readback forces completion
-
+        # scalar readback forces completion
+        run_at = _jit_per_count(multi, lambda f: float(f(q, k, v)))
         return _diff_time(run_at, *steps, return_info=True)
 
     def per_iter_grad(attn):
@@ -707,11 +807,7 @@ def bench_flash_attention(B=4, T=4096, H=16, D=64, steps=(4, 16)):
 
             return f
 
-        fs = {n: multi(n) for n in steps}
-
-        def run_at(n):
-            float(fs[n](q, k, v))
-
+        run_at = _jit_per_count(multi, lambda f: float(f(q, k, v)))
         return _diff_time(run_at, *steps, return_info=True)
 
     dt_flash, t_flash = per_iter(
@@ -922,7 +1018,11 @@ def main():
     # wall-clock budget for the SIDE workloads: on a slow-tunnel day the
     # driver must still get the headline line, so once the budget is
     # spent remaining side workloads are skipped (marked, not silent)
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1800"))
+    # 3600 leaves room for the chunk-scaled workloads (a probe chunk +
+    # two extra compiles each) and transformer_lm_large while keeping
+    # headline (~5 min) + sides + offline refresh (<=1500 s) inside the
+    # 7200 s watchdog
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "3600"))
     workloads = _state["workloads"]
 
     def run(name, fn):
@@ -981,6 +1081,15 @@ def main():
         run("flash_attention", bench_flash_attention)
         run("lm_decode", bench_lm_decode)
         run("transformer_lm", bench_transformer_lm)
+        # larger-matmul flagship: dim=1024 keeps every matmul MXU-shaped
+        # (the dim=512 row leaves lane headroom), so this is the MFU
+        # headline for the LM family; beyond-reference, no 2018 baseline
+        run("transformer_lm_large", lambda: bench_transformer_lm(
+            B=8, T=2048, dim=1024, heads=16, layers_n=12))
+        # dim=2048 runs the MXU near peak — 72% MFU measured r5; the
+        # framework's utilization headline
+        run("transformer_lm_xl", lambda: bench_transformer_lm(
+            B=2, T=2048, dim=2048, heads=16, layers_n=16, steps=(2, 8)))
 
     # r3 batch sweep: 512 is past the knee (~2.4k img/s); 128 vs 256 is
     # within the tunnel's run-to-run noise (2.5-3.8k observed), so the

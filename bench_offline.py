@@ -617,6 +617,10 @@ def main():
          lambda: offline_resnet50_dp(topo_devices, batch_per_chip=32)),
         ("flash_attention", lambda: offline_flash_attention(topo_devices)),
         ("transformer_lm", lambda: offline_transformer_lm(topo_devices)),
+        ("transformer_lm_large", lambda: offline_transformer_lm(
+            topo_devices, B=8, T=2048, dim=1024, heads=16, layers_n=12)),
+        ("transformer_lm_xl", lambda: offline_transformer_lm(
+            topo_devices, B=2, T=2048, dim=2048, heads=16, layers_n=16)),
         ("ring_attention_sp%d" % len(topo_devices),
          lambda: offline_ring_attention_sp8(topo_devices)),
         ("ulysses_flash_sp%d" % len(topo_devices),

@@ -14,22 +14,24 @@ import bench
 class FakeRunner(object):
     """run_at(steps) stub with controllable per-step cost + warm cost."""
 
-    def __init__(self, per_step=0.004, first_extra=0.05):
+    def __init__(self, per_step=0.004, first_extra=0.05, overhead=0.0):
         self.calls = []
         self.per_step = per_step
         self.first_extra = first_extra
+        self.overhead = overhead  # additive per-call cost (tunnel RTT)
 
     def __call__(self, steps):
         extra = self.first_extra if steps not in [
             s for s, _ in self.calls
         ] else 0.0
         self.calls.append((steps, extra))
-        time.sleep(steps * self.per_step + extra)
+        time.sleep(steps * self.per_step + extra + self.overhead)
 
 
 def test_diff_time_record_carries_protocol_fields():
     r = FakeRunner()
-    dt, info = bench._diff_time(r, 2, 6, return_info=True)
+    dt, info = bench._diff_time(r, 2, 6, return_info=True,
+                                scale_steps=False)
     # the per-step estimate lands near the configured cost
     assert 0.5 * r.per_step < dt < 3.0 * r.per_step
     # r4 falsifiability fields
@@ -48,6 +50,46 @@ def test_diff_time_record_carries_protocol_fields():
     assert min(info["raw_chunk_s"]["2"]) < r.first_extra + 2 * 0.004 * 2
 
 
+def test_diff_time_single_outlier_trimmed_stable():
+    """One gross tunnel stall among >=4 chunks must not flip the
+    verdict: the worst chunk is dropped (visibly) for the flag."""
+    r = FakeRunner(per_step=0.004, first_extra=0.01)
+    calls = {"n": 0}
+
+    def run_at(s):
+        calls["n"] += 1
+        if calls["n"] == 5:  # one timed chunk stalls hard
+            time.sleep(0.2)
+        r(s)
+
+    _, info = bench._diff_time(run_at, 2, 6, return_info=True,
+                               scale_steps=False)
+    assert info["stable"] is True
+    assert info["outliers_dropped"]
+    s_hit = next(iter(info["outliers_dropped"]))
+    assert info["spread"][s_hit] > bench.SPREAD_LIMIT
+    assert info["spread_trimmed"][s_hit] <= bench.SPREAD_LIMIT
+    # the raw audit trail keeps the stalled chunk
+    assert max(info["raw_chunk_s"][s_hit]) > 0.2
+
+
+def test_diff_time_repeated_outliers_stay_unstable():
+    """Two stalls in one count cannot be trimmed away — the record
+    honestly reports stable=false."""
+    r = FakeRunner(per_step=0.004, first_extra=0.01)
+    calls = {"n": 0}
+
+    def run_at(s):
+        calls["n"] += 1
+        if calls["n"] in (5, 11):
+            time.sleep(0.2)
+        r(s)
+
+    _, info = bench._diff_time(run_at, 2, 6, return_info=True,
+                               scale_steps=False)
+    assert info["stable"] is False
+
+
 def test_diff_time_inversion_raises():
     """A pathological runner where more steps are FASTER must be
     rejected, not silently recorded (timing inversion guard)."""
@@ -56,4 +98,62 @@ def test_diff_time_inversion_raises():
         time.sleep(0.06 if steps == 2 else 0.01)
 
     with pytest.raises(AssertionError, match="timing inversion"):
-        bench._diff_time(weird, 2, 6, return_info=True)
+        bench._diff_time(weird, 2, 6, return_info=True, scale_steps=False)
+
+
+def test_diff_time_scales_short_chunks(monkeypatch):
+    """r5: a chunk shorter than MIN_CHUNK_S cannot pass the spread gate
+    against additive tunnel jitter, so the counts are scaled up until
+    the low chunk reaches the floor (run_at must accept any count)."""
+    monkeypatch.setattr(bench, "MIN_CHUNK_S", 0.10)
+    r = FakeRunner(per_step=0.012, first_extra=0.01)
+    dt, info = bench._diff_time(r, 2, 6, return_info=True)
+    # probe chunk ~0.024s < 0.10 floor -> scale ceil(0.10/0.024) >= 4
+    scale = info["chunk_scale"]
+    assert scale > 1
+    assert info["steps"] == [2 * scale, 6 * scale]
+    assert set(info["raw_chunk_s"]) == {str(2 * scale), str(6 * scale)}
+    # the converged low chunk actually reaches the floor
+    assert min(info["raw_chunk_s"][str(2 * scale)]) >= 0.8 * 0.10
+    # the estimate still lands near the configured per-step cost
+    assert 0.5 * r.per_step < dt < 3.0 * r.per_step
+    # the scaled counts were warmed (compile budget stays visible);
+    # the original low count's warm is kept for the audit trail
+    assert str(2 * scale) in info["warm_s"]
+    assert str(6 * scale) in info["warm_s"]
+
+
+def test_diff_time_rescales_against_call_overhead(monkeypatch):
+    """Per-call overhead inflates the probe, so a one-shot scale
+    undershoots the floor by (scale-1)*overhead; the iterative re-probe
+    must converge the low chunk to the floor anyway."""
+    monkeypatch.setattr(bench, "MIN_CHUNK_S", 0.2)
+    r = FakeRunner(per_step=0.005, first_extra=0.0, overhead=0.05)
+    _, info = bench._diff_time(r, 2, 6, return_info=True)
+    scale = info["chunk_scale"]
+    # one-shot from the first probe (0.06s) would pick 4 -> chunk 0.09s;
+    # iteration must go further
+    assert scale > 4
+    assert min(info["raw_chunk_s"][str(2 * scale)]) >= 0.8 * 0.2
+
+
+def test_diff_time_suspect_probe_does_not_scale(monkeypatch):
+    """A probe under 10 ms is the r3 memoized/ack-only signature: scaling
+    off it would saturate at MAX_CHUNK_SCALE and waste the side budget,
+    so the requested counts are kept instead."""
+    monkeypatch.setattr(bench, "MIN_CHUNK_S", 1.0)
+    r = FakeRunner(per_step=0.0001, first_extra=0.0)
+    _, info = bench._diff_time(r, 2, 6, return_info=True)
+    assert info["chunk_scale"] == 1
+    assert info["steps"] == [2, 6]
+
+
+def test_diff_time_no_scaling_above_floor(monkeypatch):
+    """A chunk already at the floor keeps the requested counts — with a
+    probe above the 10 ms suspect threshold, so this pins the floor
+    comparison itself, not the suspect guard."""
+    monkeypatch.setattr(bench, "MIN_CHUNK_S", 0.015)
+    r = FakeRunner(per_step=0.012, first_extra=0.01)  # probe ~24 ms
+    _, info = bench._diff_time(r, 2, 6, return_info=True)
+    assert info["chunk_scale"] == 1
+    assert info["steps"] == [2, 6]
