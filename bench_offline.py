@@ -654,6 +654,15 @@ def main():
             flush=True,
         )
     artifact["total_s"] = round(time.time() - t_all, 1)
+    # entries merged from earlier runs keep their own run_at/compile_s;
+    # total_s covers only THIS run's regenerated workloads, so a
+    # BENCH_OFFLINE_ONLY refresh legitimately reports a small total
+    # while carrying expensive carried-forward entries
+    artifact["total_s_note"] = (
+        "wall seconds of the run that last wrote this file (only the "
+        "workloads it regenerated); per-entry compile_s/trace_s and "
+        "run_at stamps are the per-workload truth"
+    )
     # MERGE into the committed artifact: a partial run (BENCH_OFFLINE_ONLY,
     # or a failed workload) must not destroy the other workloads' HLO
     # fingerprints — they are the between-windows comparison baseline

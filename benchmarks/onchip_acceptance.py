@@ -1,0 +1,200 @@
+"""On-chip acceptance drive: train three book models (SURVEY §4.3) on
+the REAL device through the user-facing fluid surface and check they
+learn, then round-trip an inference model through save/load.
+
+The pytest suite runs the full acceptance set on the virtual CPU mesh
+(tests/conftest.py pins JAX_PLATFORMS=cpu); this script is the silicon
+companion — run it with no JAX_PLATFORMS override so the default
+(tunnel TPU) backend is used:
+
+    python benchmarks/onchip_acceptance.py
+
+Prints one JSON line per model and a final summary line. Reference
+anchors: fit_a_line / recognize_digits / understand_sentiment book
+chapters (python/paddle/v2/fluid/tests/book/ in the reference tree).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _honor_platform_env():
+    """The ambient sitecustomize latches the tunnel platform at
+    interpreter boot; honor an explicit JAX_PLATFORMS request (e.g.
+    JAX_PLATFORMS=cpu for a smoke run of this script off-chip)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+
+_honor_platform_env()
+
+
+def _losses_fall(losses, factor=0.7):
+    head = float(np.mean(losses[:3]))
+    tail = float(np.mean(losses[-3:]))
+    return tail < head * factor, head, tail
+
+
+def drive_fit_a_line(steps=60):
+    """Linear regression on a synthetic housing-style feature set."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype(np.float32)
+    losses = []
+    for _ in range(steps):
+        xb = rng.randn(32, 13).astype(np.float32)
+        yb = xb @ w_true + 0.01 * rng.randn(32, 1).astype(np.float32)
+        (loss,) = exe.run(main, feed={"x": xb, "y": yb},
+                          fetch_list=[cost])
+        losses.append(float(np.ravel(loss)[0]))
+    ok, head, tail = _losses_fall(losses)
+    return {"model": "fit_a_line", "ok": ok,
+            "loss_head": round(head, 4), "loss_tail": round(tail, 4)}
+
+
+def drive_recognize_digits(steps=40):
+    """Conv net on synthetic MNIST-shaped data + save/load round trip."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.nets.simple_img_conv_pool(
+            input=img, filter_size=5, num_filters=8, pool_size=2,
+            pool_stride=2, act="relu")
+        pred = fluid.layers.fc(input=conv, size=10, act="softmax")
+        cost = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=pred, label=label))
+        acc = fluid.layers.accuracy(input=pred, label=label)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(cost)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    # ten fixed class templates + noise: learnable quickly, non-trivial
+    templates = rng.rand(10, 1, 28, 28).astype(np.float32)
+    losses, accs = [], []
+    for _ in range(steps):
+        lb = rng.randint(0, 10, (64, 1)).astype(np.int64)
+        xb = templates[lb[:, 0]] + 0.1 * rng.randn(64, 1, 28, 28).astype(
+            np.float32)
+        loss, a = exe.run(main, feed={"img": xb, "label": lb},
+                          fetch_list=[cost, acc])
+        losses.append(float(np.ravel(loss)[0]))
+        accs.append(float(np.ravel(a)[0]))
+    ok, head, tail = _losses_fall(losses)
+    # inference save/load round trip through the on-disk format
+    with tempfile.TemporaryDirectory() as d:
+        fluid.io.save_inference_model(d, ["img"], [pred], exe,
+                                      main_program=main)
+        prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        lb = rng.randint(0, 10, (8, 1)).astype(np.int64)
+        xb = templates[lb[:, 0]].astype(np.float32)
+        (out,) = exe.run(prog2, feed={feeds[0]: xb}, fetch_list=fetches)
+        reload_ok = (np.asarray(out).shape == (8, 10)
+                     and float(np.max(out)) <= 1.0)
+    return {"model": "recognize_digits", "ok": bool(ok and reload_ok),
+            "loss_head": round(head, 4), "loss_tail": round(tail, 4),
+            "final_acc": round(accs[-1], 3), "reload_ok": bool(reload_ok)}
+
+
+def drive_understand_sentiment(steps=40):
+    """Embedding + LSTM + pool classifier on synthetic token streams."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(input=data, size=[500, 32])
+        fc = fluid.layers.fc(input=emb, size=128)
+        lstm, _ = fluid.layers.dynamic_lstm(input=fc, size=128)
+        pooled = fluid.layers.sequence_pool(input=lstm, pool_type="max")
+        pred = fluid.layers.fc(input=pooled, size=2, act="softmax")
+        cost = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(cost)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        lb = rng.randint(0, 2, (32, 1)).astype(np.int64)
+        # ragged batch: variable-length sequences with class-dependent
+        # token ranges (low ids class 0, high ids class 1) — learnable
+        # by the embedding alone, and exercises the LoD path on-chip
+        lens = rng.randint(20, 64, 32)
+        toks = [
+            (0 if lb[i, 0] == 0 else 250)
+            + rng.randint(0, 250, lens[i])
+            for i in range(32)
+        ]
+        lod = np.cumsum([0] + list(lens)).astype(np.int32)
+        flat = np.concatenate(toks).astype(np.int64)
+        (loss,) = exe.run(main,
+                          feed={"words": (flat, [lod]), "label": lb},
+                          fetch_list=[cost])
+        losses.append(float(np.ravel(loss)[0]))
+    ok, head, tail = _losses_fall(losses)
+    return {"model": "understand_sentiment", "ok": ok,
+            "loss_head": round(head, 4), "loss_tail": round(tail, 4)}
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    t0 = time.time()
+    results = []
+    for fn in (drive_fit_a_line, drive_recognize_digits,
+               drive_understand_sentiment):
+        t = time.time()
+        try:
+            rec = fn()
+        except Exception as e:  # one failure must not hide the others
+            rec = {"model": fn.__name__, "ok": False,
+                   "error": "%s: %s" % (type(e).__name__, e)}
+        rec["seconds"] = round(time.time() - t, 1)
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({
+        "metric": "onchip_acceptance",
+        "backend": backend,
+        "all_ok": all(r["ok"] for r in results),
+        "total_s": round(time.time() - t0, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
