@@ -291,6 +291,48 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False, scale_steps=True):
     return (dt, info) if return_info else dt
 
 
+def _last_banked_headline():
+    """Best stable driver-format headline in the committed evidence
+    file (records are not timestamped and restoration can append old
+    captures, so file order is not capture order) — referenced
+    (clearly labeled as NOT this run's measurement) when an outage
+    blocks a fresh one, so the error line points the reader at
+    auditable data instead of nothing."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r05_builder.jsonl")
+    best = None
+    # strictly best-effort enrichment: the caller is the watchdog's
+    # must-exit path, so NO exception may escape (a hand-appended or
+    # corrupted evidence line must not cancel the bench_error contract
+    # line and the exit)
+    try:
+        with open(path, errors="replace") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                val = rec.get("value")
+                if (rec.get("metric")
+                        == "resnet50_train_images_per_sec_per_chip"
+                        and rec.get("stable")
+                        and isinstance(val, (int, float))
+                        and (best is None or val > best["value"])):
+                    best = {
+                        "value": val,
+                        "unit": rec.get("unit"),
+                        "vs_baseline": rec.get("vs_baseline"),
+                        "mfu": rec.get("mfu"),
+                        "source": "BENCH_r05_builder.jsonl",
+                        "note": "banked during an earlier on-chip "
+                                "window of this round — NOT this "
+                                "run's measurement",
+                    }
+    except Exception:
+        return best
+    return best
+
+
 def _jit_per_count(build, consume):
     """run_at factory for the scale_steps contract: jit `build(n)` on
     demand per step count (any count — chunk scaling picks new ones)
@@ -900,14 +942,12 @@ def main():
             return {"skipped": "BENCH_OFFLINE=0"}
         import subprocess
 
-        # 900s fits an uncontended regeneration (~350s) but not one
-        # racing the CPU test suite or the chip-holding parent's AOT
-        # compiles (r5: two 900s timeouts on capture days); the stale
-        # committed artifact remains the fallback either way
         # 2200: the artifact now carries 14 AOT workloads (~25 min on a
-        # loaded box — the r5 rehearsal hit the old 1500 s budget);
-        # worst case headline (~300 s) + sides (<=3600 s) + this still
-        # clears the 7200 s watchdog
+        # loaded box — the r5 rehearsal hit the old 1500 s budget, and
+        # before that two 900 s refreshes timed out racing capture
+        # runs); worst case headline (~300 s) + sides (<=3600 s) + this
+        # still clears the 7200 s watchdog. The stale committed
+        # artifact remains the fallback either way.
         budget = float(os.environ.get("BENCH_OFFLINE_TIMEOUT_S", "2200"))
         if _DEADLINE is not None:
             budget = min(budget, _DEADLINE - time.monotonic() - 60)
@@ -943,14 +983,15 @@ def main():
             print(json.dumps({"offline_artifact":
                               _run_offline("device init timed out")}),
                   flush=True)
-            print(
-                json.dumps({
-                    "metric": "bench_error",
-                    "error": "device init exceeded %gs — accelerator "
-                             "backend unavailable" % init_timeout,
-                }),
-                flush=True,
-            )
+            err = {
+                "metric": "bench_error",
+                "error": "device init exceeded %gs — accelerator "
+                         "backend unavailable" % init_timeout,
+            }
+            banked = _last_banked_headline()
+            if banked:
+                err["best_banked_stable_headline"] = banked
+            print(json.dumps(err), flush=True)
             os._exit(3)
         # stay armed for the WHOLE run: a tunnel death mid-workload
         # otherwise blocks inside a device call with no output at all.
