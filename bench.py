@@ -18,6 +18,10 @@ definitions):
               bs=128 (benchmark/README.md:50 -> 111.4 img/s)
   lstm      — benchmark/paddle/rnn/rnn.py (2x LSTM h=512, bs=64, seq 100),
               ms/batch vs 184 ms/batch (benchmark/README.md:119)
+  resnet50_infer — serving-side: clone(for_test=True) forward, img/s
+              vs the reference's only published inference number
+              (217.69 img/s CPU MKL-DNN bs=16,
+              IntelOptimizedPaddle.md:87)
   transformer_lm — long-context flagship: decoder-only LM (8x512, T=1024,
               flash attention, bf16), tokens/s + MFU; beyond-reference,
               no 2018 baseline
@@ -466,6 +470,57 @@ def _ensure_recordio(path, n_samples, rng):
         w.write(struct.pack("<H", label) + img.tobytes())
     w.close()
     os.replace(path + ".tmp", path)
+
+
+def bench_resnet50_infer(batch=None, steps=None):
+    """ResNet-50 inference throughput (img/s): the serving-side image
+    row, run through clone(for_test=True) so batch-norm uses the moving
+    statistics (the same program save_inference_model would export).
+    Reference baseline: 217.69 img/s, MKL-DNN bs=16 on a 2S Xeon
+    Gold 6148 (/root/reference/benchmark/IntelOptimizedPaddle.md:87) —
+    the only published inference number in the reference tree."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    # bs=16 matches the reference baseline; overridable for CPU smokes
+    batch = batch or int(os.environ.get("BENCH_INFER_BATCH", "16"))
+    steps = steps or tuple(
+        int(s)
+        for s in os.environ.get("BENCH_INFER_STEPS", "24,144").split(","))
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        # f32 vars, like every training bench: this IS the program
+        # save_inference_model exports (declaring bf16 vars would
+        # instead create bf16 parameters — a different model). The amp
+        # lowering only engages on the autodiff path, so this forward
+        # runs f32 — conservative, and precision-matched to the f32
+        # MKL-DNN baseline.
+        image = fluid.layers.data(
+            name="image", shape=[3, 224, 224], dtype="float32")
+        pred = resnet_imagenet(image, class_dim=1000, depth=50)
+    test_prog = main_prog.clone(for_test=True)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "image": jax.device_put(
+            rng.rand(batch, 3, 224, 224).astype(np.float32)),
+    }
+    dt, timing = _per_step_seconds(exe, test_prog, feed, pred, *steps)
+    exe.close()
+    img_per_sec = batch / dt
+    baseline = 217.69  # IntelOptimizedPaddle.md:87, bs=16
+    return {
+        "img_per_sec": round(img_per_sec, 2),
+        "ms_per_batch": round(dt * 1e3, 2),
+        "batch": batch,
+        "mfu": round(
+            img_per_sec * FWD_FLOPS["resnet50"] / PEAK_FLOPS, 4),
+        "vs_baseline": round(img_per_sec / baseline, 4),
+        "timing": timing,
+    }
 
 
 def bench_resnet50_recordio(batch, chunk_steps, n_chunks):
@@ -1156,6 +1211,9 @@ def main():
         run("resnet50_remat", lambda: bench_image(
             "resnet50", lambda i, c: resnet_imagenet(
                 i, class_dim=c, depth=50), batch, remat=True))
+        # serving-side: the only published inference number in the
+        # reference tree is CPU MKL-DNN 217.69 img/s bs=16
+        run("resnet50_infer", bench_resnet50_infer)
         run("profiler_reconciliation", bench_profiler_reconciliation)
         run("lstm", bench_lstm)
         run("sparse_embedding", bench_sparse_embedding)
