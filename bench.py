@@ -473,6 +473,22 @@ def _ensure_recordio(path, n_samples, rng):
     os.replace(path + ".tmp", path)
 
 
+def _build_image_infer_program(fluid, model_fn, class_dim=1000):
+    """The serving-side program: f32 vars (declaring bf16 vars would
+    create bf16 parameters — a different model than the f32 one
+    save_inference_model exports; the amp lowering only engages on the
+    autodiff path, so this forward runs f32 — conservative, and
+    precision-matched to the f32 MKL-DNN baselines), clone(for_test)
+    so batch-norm uses moving statistics. Shared with bench_offline so
+    the AOT fingerprint always matches the program benched on-chip."""
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        image = fluid.layers.data(
+            name="image", shape=[3, 224, 224], dtype="float32")
+        pred = model_fn(image, class_dim)
+    return main_prog.clone(for_test=True), startup, pred
+
+
 def bench_image_infer(name, model_fn, baseline_ips, batch=None,
                       steps=None):
     """Image-model inference throughput (img/s): the serving-side rows,
@@ -490,18 +506,7 @@ def bench_image_infer(name, model_fn, baseline_ips, batch=None,
     steps = steps or tuple(
         int(s)
         for s in os.environ.get("BENCH_INFER_STEPS", "24,144").split(","))
-    main_prog, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_prog, startup):
-        # f32 vars, like every training bench: this IS the program
-        # save_inference_model exports (declaring bf16 vars would
-        # instead create bf16 parameters — a different model). The amp
-        # lowering only engages on the autodiff path, so this forward
-        # runs f32 — conservative, and precision-matched to the f32
-        # MKL-DNN baseline.
-        image = fluid.layers.data(
-            name="image", shape=[3, 224, 224], dtype="float32")
-        pred = model_fn(image, 1000)
-    test_prog = main_prog.clone(for_test=True)
+    test_prog, startup, pred = _build_image_infer_program(fluid, model_fn)
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
     rng = np.random.RandomState(0)

@@ -181,6 +181,29 @@ def offline_resnet50(topo_devices, batch):
     return rec
 
 
+def offline_resnet50_infer(topo_devices, batch=None):
+    """The serving-side forward AOT-compiled for v5e — between-windows
+    evidence for the inference row. Builds the SAME program as the
+    on-chip bench (shared bench._build_image_infer_program) and honors
+    the same BENCH_INFER_BATCH override, so the fingerprint always
+    matches what the row measures. Baseline anchor:
+    /root/reference/benchmark/IntelOptimizedPaddle.md:87."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import parallel
+    from bench import _build_image_infer_program
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    batch = batch or int(os.environ.get("BENCH_INFER_BATCH", "16"))
+    main, pred, scope = _init_params(lambda: _build_image_infer_program(
+        fluid, lambda i, c: resnet_imagenet(i, class_dim=c, depth=50)))
+    feed = {"image": np.zeros((batch, 3, 224, 224), np.float32)}
+    mesh = parallel.make_mesh({"data": 1}, devices=topo_devices[:1])
+    lowered, t_trace = _lower_program_step(main, pred, feed, mesh, scope)
+    rec, _ = _cost_record(lowered, t_trace, "img_per_sec", batch)
+    rec["batch"] = batch
+    return rec
+
+
 def offline_resnet50_dp(topo_devices, batch_per_chip):
     """The same train step data-parallel over all topology chips — the
     SPMD partitioner + ICI collectives compiled by the real TPU
@@ -615,6 +638,7 @@ def main():
         ("resnet50_train", lambda: offline_resnet50(topo_devices, batch)),
         ("resnet50_train_dp%d" % len(topo_devices),
          lambda: offline_resnet50_dp(topo_devices, batch_per_chip=32)),
+        ("resnet50_infer", lambda: offline_resnet50_infer(topo_devices)),
         ("flash_attention", lambda: offline_flash_attention(topo_devices)),
         ("transformer_lm", lambda: offline_transformer_lm(topo_devices)),
         ("transformer_lm_large", lambda: offline_transformer_lm(
