@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from ..fluid import layers, nets
 
-__all__ = ["vgg16_bn_drop", "vgg16"]
+__all__ = ["vgg16_bn_drop", "vgg16", "vgg19"]
 
 
 def vgg16_bn_drop(input, class_dim=10):

@@ -140,3 +140,24 @@ def test_resnet():
 
 def test_vgg():
     losses = _run("vgg", steps=4, batch=8)
+
+
+def test_vgg19_builder_graph():
+    """The zoo's vgg19 (reference IntelOptimizedPaddle.md benches
+    VGG-19) must emit the 16-conv layout (2+2+4+4+4) vs vgg16's 13
+    (2+2+3+3+3) — graph-level check, no execution (224x224 is too
+    heavy for CI)."""
+    from paddle_tpu.models.vgg import vgg16, vgg19
+
+    def conv_count(model_fn):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = pd.data(name="image", shape=[3, 224, 224],
+                          dtype="float32")
+            pred = model_fn(img, 1000)
+        ops = [op.type for op in main.global_block().ops]
+        assert pred.shape[-1] == 1000
+        return sum(1 for t in ops if t == "conv2d")
+
+    assert conv_count(vgg19) == 16
+    assert conv_count(vgg16) == 13
