@@ -184,15 +184,21 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False, scale_steps=True):
         # — the additive per-call tunnel overhead that makes a naive
         # scale = ceil(floor/probe) undershoot is solved for exactly.
         t1 = _probe(base_lo)
-        seeds.setdefault(base_lo, []).append(t1)
         # every run_at blocks on a value readback, so a healthy probe is
         # a full execution (>= tunnel RTT + real steps). A probe under
         # 10 ms is the signature of the r3 memoized/ack-only failure
         # mode — scaling off it would saturate at MAX_CHUNK_SCALE and
-        # waste the side budget on every workload, so don't scale then.
+        # waste the side budget on every workload, so don't scale then;
+        # and the suspect probe is NOT a steady-state chunk, so it must
+        # not seed raw[] either (it would deflate dt_min and inflate
+        # that count's spread — the stable=false flag still fires from
+        # the real chunks if the mode persists).
+        if t1 >= 0.01:
+            seeds.setdefault(base_lo, []).append(t1)
         if 0.01 <= t1 < MIN_CHUNK_S:
             t2 = _probe(base_hi)
-            seeds.setdefault(base_hi, []).append(t2)
+            if t2 >= 0.01:
+                seeds.setdefault(base_hi, []).append(t2)
             per_step = (t2 - t1) / (base_hi - base_lo)
             if per_step > 0:
                 ovh = max(t1 - base_lo * per_step, 0.0)
@@ -201,6 +207,15 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False, scale_steps=True):
                 need = MIN_CHUNK_S / t1
             scale = int(np.clip(np.ceil(need), 1, MAX_CHUNK_SCALE))
     s_lo, s_hi = base_lo * scale, base_hi * scale
+    if scale > 1:
+        # the probes above ran at the PRE-scale counts. When the solved
+        # scale lands a final count on base_hi (e.g. steps (24,144) at
+        # scale 6 -> s_lo == 144), merging them would count a pre-scale
+        # probe — possibly carrying exactly the stall the corrective-
+        # rescale path below exists to absorb — as a steady chunk at
+        # the final count and consume the single-outlier trim
+        # allowance. Only probes taken at the FINAL counts are reused.
+        seeds = {}
     _warm(s_lo)
     _warm(s_hi)
     if scale > 1:
@@ -218,8 +233,9 @@ def _diff_time(run_at, s_lo, s_hi, return_info=False, scale_steps=True):
         else:
             seeds.setdefault(s_lo, []).append(tv)
     raw = {s_lo: [], s_hi: []}
-    # probes taken at the FINAL counts are valid steady-state chunks —
-    # count them instead of discarding (saves an execution per workload)
+    # only probes taken at the FINAL counts survive in `seeds`; they are
+    # valid steady-state chunks — count them instead of discarding
+    # (saves an execution per workload)
     for s, ts in seeds.items():
         if s in raw:
             raw[s].extend(ts)

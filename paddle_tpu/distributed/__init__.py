@@ -6,15 +6,19 @@ fault-tolerant data-task queue (go/master/service.go) becomes
 checkpoints (go/pserver/service.go:120-226) become `checkpoint`. Gradient
 aggregation itself needs no service at all on TPU — it is a psum over ICI
 (see paddle_tpu.parallel); what remains job-level is exactly this: elastic
-data dispatch and durable state.
+data dispatch, durable state, and the `supervisor` loop that composes the
+two with heartbeat liveness into restart-from-checkpoint fault tolerance
+(the role etcd TTL keys + the cluster controller play in the reference,
+go/pserver/etcd_client.go).
 """
 
 from .coordinator import (Coordinator, CoordinatorServer, MasterClient,
                           RemoteCoordinator, Task)
-from .checkpoint import (AsyncCheckpoint, load_checkpoint,
-                         save_checkpoint, save_checkpoint_async)
+from .checkpoint import (AsyncCheckpoint, load_checkpoint, resume_or_init,
+                         retain, save_checkpoint, save_checkpoint_async)
 from .fault_injection import (FaultInjected, FaultInjector, corrupt_file,
-                              default_injector)
+                              default_injector, netsplit_active)
+from .supervisor import Supervisor, WorkerHandle
 
 __all__ = [
     "Coordinator",
@@ -29,5 +33,10 @@ __all__ = [
     "FaultInjector",
     "default_injector",
     "corrupt_file",
+    "netsplit_active",
     "load_checkpoint",
+    "retain",
+    "resume_or_init",
+    "Supervisor",
+    "WorkerHandle",
 ]

@@ -29,7 +29,8 @@ import zlib
 import jax
 import numpy as np
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_step"]
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step", "retain",
+           "resume_or_init"]
 
 _STEP_DIR_RE = re.compile(r"^step_(\d+)$")
 
@@ -94,7 +95,8 @@ def _index_to_json(index, shape):
     return out
 
 
-def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None):
+def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None,
+                    keep_last: int = 1):
     """Write every scope entry (params + optimizer state + BN stats) under
     `dirname/step_<N>/`. Safe against interruption: data files land first,
     then the meta file commits the checkpoint with one atomic rename — and
@@ -174,7 +176,7 @@ def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None):
         json.dump(meta, f)
     os.replace(tmp, os.path.join(dirname, _meta_name()))
     meta["dir"] = dirname
-    _prune_old_steps(root)
+    _prune_old_steps(root, keep=keep_last)
     return meta
 
 
@@ -193,6 +195,36 @@ def _prune_old_steps(root: str, keep: int = 1):
         elif complete_seen >= keep:
             # an older incomplete step can never become complete again
             shutil.rmtree(path, ignore_errors=True)
+
+
+def retain(dirname: str, keep_last: int = 1):
+    """Garbage-collect old checkpoint steps under `dirname`, keeping the
+    newest `keep_last` COMPLETE steps (plus any newer still-incomplete
+    save in flight). A crash-looping worker checkpoints every restart
+    cycle; without GC its disk fills exactly when the job is least
+    healthy — the supervisor calls this after every restart. Returns the
+    steps still on disk, newest first."""
+    if keep_last < 1:
+        raise ValueError("retain(keep_last=%d): must keep >= 1" % keep_last)
+    _prune_old_steps(dirname, keep=keep_last)
+    return [s for s, _ in _list_step_dirs(dirname)]
+
+
+def resume_or_init(scope, dirname: str, init_fn=None, strict: bool = True):
+    """One-call crash-recovery glue for supervised workers: restore the
+    latest complete checkpoint under `dirname` into `scope` and return
+    its merged meta, or — when nothing is committed yet (first launch, or
+    a crash before the first save) — run `init_fn()` and return None.
+    The caller branches on the return value for its start step:
+
+        meta = resume_or_init(scope, ckpt_dir, init_fn=run_startup)
+        start = meta["step"] + 1 if meta else 0
+    """
+    if dirname and latest_step(dirname) is not None:
+        return load_checkpoint(scope, dirname, strict=strict)
+    if init_fn is not None:
+        init_fn()
+    return None
 
 
 def _dir_metas(dirname: str):
@@ -429,7 +461,8 @@ class AsyncCheckpoint(object):
 
 
 def save_checkpoint_async(scope, dirname: str, step: int = 0,
-                          extra: dict = None) -> AsyncCheckpoint:
+                          extra: dict = None,
+                          keep_last: int = 1) -> AsyncCheckpoint:
     """Snapshot the scope to host memory NOW (so later training steps —
     including donated-buffer updates — cannot touch the saved values),
     then run the normal atomic save on a background thread. Returns an
@@ -447,7 +480,8 @@ def save_checkpoint_async(scope, dirname: str, step: int = 0,
         and not scope.get(n).is_fully_addressable
         for n in scope.keys()
     ):
-        save_checkpoint(scope, dirname, step=step, extra=extra)
+        save_checkpoint(scope, dirname, step=step, extra=extra,
+                        keep_last=keep_last)
         return AsyncCheckpoint(
             None, {"value": _step_dir(dirname, step), "error": None}
         )
@@ -477,7 +511,7 @@ def save_checkpoint_async(scope, dirname: str, step: int = 0,
     def _write():
         try:
             save_checkpoint(_HostScope(arrays), dirname, step=step,
-                            extra=extra)
+                            extra=extra, keep_last=keep_last)
             box["value"] = _step_dir(dirname, step)
         except BaseException as e:  # surfaced by result()
             box["error"] = e

@@ -19,12 +19,15 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import socketserver
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+from . import fault_injection as _fi
 
 __all__ = [
     "Task", "Coordinator", "MasterClient", "CoordinatorServer",
@@ -62,10 +65,12 @@ class Coordinator(object):
     keep distributed paths CI-testable in one process)."""
 
     def __init__(self, timeout_s: float = 60.0, failure_max: int = 3,
-                 snapshot_path: Optional[str] = None):
+                 snapshot_path: Optional[str] = None,
+                 heartbeat_timeout_s: float = 30.0):
         self._lock = threading.Lock()
         self.timeout_s = timeout_s
         self.failure_max = failure_max
+        self.heartbeat_timeout_s = heartbeat_timeout_s
         self.snapshot_path = snapshot_path
         self.todo: List[Task] = []
         self.pending: Dict[int, Task] = {}
@@ -73,6 +78,12 @@ class Coordinator(object):
         self.discarded: List[Task] = []
         self.epoch = 0
         self._next_id = 0
+        # worker liveness registry (reference: trainers announce
+        # themselves in etcd and the master watches their keys,
+        # go/pserver/etcd_client.go:70-150). Ephemeral BY DESIGN: a
+        # restarted coordinator sees workers re-register on their next
+        # heartbeat, so membership is not snapshotted.
+        self.workers: Dict[str, dict] = {}
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
 
@@ -133,6 +144,63 @@ class Coordinator(object):
             else:
                 self.todo.append(task)
             self._snapshot()
+
+    # --- worker liveness (elastic supervisor protocol) ---------------
+    def _new_worker_record(self, now: float, incarnation: int = 1,
+                           meta: Optional[dict] = None) -> dict:
+        return {
+            "incarnation": incarnation,
+            "registered_at": now,
+            "last_seen": now,
+            "deadline": now + self.heartbeat_timeout_s,
+            "step": 0,
+            "meta": meta or {},
+        }
+
+    def register_worker(self, worker_id: str, meta: Optional[dict] = None):
+        """(Re-)announce a worker. Each registration bumps the worker's
+        incarnation — a supervisor restart of the same worker id is a NEW
+        liveness lease, so a stale pre-crash heartbeat can never vouch
+        for the replacement process."""
+        with self._lock:
+            now = time.time()
+            prev = self.workers.get(worker_id)
+            self.workers[worker_id] = self._new_worker_record(
+                now, incarnation=(prev["incarnation"] + 1) if prev else 1,
+                meta=meta,
+            )
+            return {"incarnation": self.workers[worker_id]["incarnation"]}
+
+    def heartbeat(self, worker_id: str, step: Optional[int] = None):
+        """Extend a worker's liveness deadline (auto-registers unknown
+        ids so a worker that outlived a coordinator restart keeps its
+        membership). Returns the new deadline so clients can observe
+        clock skew."""
+        with self._lock:
+            w = self.workers.get(worker_id)
+            if w is None:
+                w = self.workers[worker_id] = self._new_worker_record(
+                    time.time()
+                )
+            w["last_seen"] = time.time()
+            w["deadline"] = w["last_seen"] + self.heartbeat_timeout_s
+            if step is not None:
+                w["step"] = int(step)
+            return {"deadline": w["deadline"]}
+
+    def membership(self) -> Dict[str, dict]:
+        """Snapshot of every known worker with a computed `alive` flag
+        (deadline not yet passed). The supervisor polls this to find hung
+        workers: a process that is running but past its deadline gets
+        killed and restarted."""
+        with self._lock:
+            now = time.time()
+            out = {}
+            for wid, w in self.workers.items():
+                d = dict(w)
+                d["alive"] = w["deadline"] > now
+                out[wid] = d
+            return out
 
     # --- internals ----------------------------------------------------
     def _reclaim_expired(self) -> bool:
@@ -200,7 +268,7 @@ class CoordinatorServer(object):
     """
 
     _METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
-                "ping")
+                "ping", "register_worker", "heartbeat", "membership")
 
     def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
                  port: int = 0):
@@ -254,6 +322,14 @@ class CoordinatorServer(object):
         if method == "task_finished":
             self.coordinator.task_finished(int(params["task_id"]))
             return {"ok": True, "result": None}
+        if method == "register_worker":
+            return {"ok": True, "result": self.coordinator.register_worker(
+                str(params["worker_id"]), meta=params.get("meta"))}
+        if method == "heartbeat":
+            return {"ok": True, "result": self.coordinator.heartbeat(
+                str(params["worker_id"]), step=params.get("step"))}
+        if method == "membership":
+            return {"ok": True, "result": self.coordinator.membership()}
         self.coordinator.task_failed(int(params["task_id"]))
         return {"ok": True, "result": None}
 
@@ -275,29 +351,72 @@ class CoordinatorServer(object):
 class RemoteCoordinator(object):
     """Client-side proxy with the Coordinator's lease API, usable by
     MasterClient unchanged (reference go/master/client.go over net/rpc).
-    Reconnects on broken connections; lease safety comes from the
-    server-side timeout, not the transport."""
 
-    def __init__(self, address: str, timeout_s: float = 30.0):
+    Transport failures retry with exponential backoff + full jitter
+    under a per-call deadline (the reference trainer's etcd client loops
+    the same way while the master key is absent,
+    go/pserver/etcd_client.go:70-110) — a coordinator restart, a dropped
+    TCP session, or an injected netsplit all heal transparently as long
+    as the service returns within `retry_deadline_s`. Lease safety under
+    retries comes from the SERVER-side lease timeout, not the transport:
+    a get_task whose response was lost leases a task nobody works on,
+    and that lease expires and requeues like any other dead worker's.
+    """
+
+    def __init__(self, address: str, timeout_s: float = 30.0,
+                 retry_deadline_s: Optional[float] = None,
+                 backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0):
         host, _, port = address.rpartition(":")
         self.addr = (host or "127.0.0.1", int(port))
         self.timeout_s = timeout_s
+        self.retry_deadline_s = (
+            timeout_s if retry_deadline_s is None else retry_deadline_s
+        )
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
         self._sock = None
         self._file = None
         self._lock = threading.Lock()
 
-    def _connect(self):
+    def _connect(self, connect_timeout: Optional[float] = None):
         self.close()
-        s = socket.create_connection(self.addr, timeout=self.timeout_s)
+        s = socket.create_connection(
+            self.addr,
+            timeout=min(self.timeout_s, connect_timeout or self.timeout_s),
+        )
+        s.settimeout(self.timeout_s)
         self._sock = s
         self._file = s.makefile("rwb")
 
+    def _check_netsplit(self):
+        # injected partition (PADDLE_FAULT=netsplit@N:dur): drop the live
+        # connection and fail the attempt, exactly like losing the wire
+        if _fi.netsplit_active():
+            self.close()
+            raise ConnectionError("netsplit fault active: connection dropped")
+
     def _call(self, method, **params):
         with self._lock:
-            for attempt in (0, 1):
+            deadline = time.monotonic() + self.retry_deadline_s
+            attempt = 0
+            while True:
                 try:
+                    self._check_netsplit()
                     if self._file is None:
-                        self._connect()
+                        self._connect(
+                            connect_timeout=max(
+                                deadline - time.monotonic(), 0.01
+                            )
+                        )
+                    # the write/readline below must also respect the
+                    # per-call deadline: a server that accepts but never
+                    # replies would otherwise hold the call for the full
+                    # transport timeout_s regardless of retry_deadline_s
+                    self._sock.settimeout(min(
+                        self.timeout_s,
+                        max(deadline - time.monotonic(), 0.01),
+                    ))
                     self._file.write(
                         (json.dumps({"method": method, "params": params})
                          + "\n").encode()
@@ -306,12 +425,20 @@ class RemoteCoordinator(object):
                     line = self._file.readline()
                     if not line:
                         raise ConnectionError("server closed connection")
+                    self._check_netsplit()  # split mid-flight: distrust resp
                     resp = json.loads(line)
                     break
                 except (OSError, ConnectionError):
                     self.close()
-                    if attempt:
+                    attempt += 1
+                    delay = min(
+                        self.backoff_max_s,
+                        self.backoff_base_s * (2 ** (attempt - 1)),
+                    )
+                    delay *= random.uniform(0.5, 1.5)  # jitter: no thundering herd
+                    if time.monotonic() + delay >= deadline:
                         raise
+                    time.sleep(delay)
         if not resp.get("ok"):
             raise RuntimeError(
                 "coordinator error: %s" % resp.get("error")
@@ -334,6 +461,15 @@ class RemoteCoordinator(object):
 
     def task_failed(self, task_id: int):
         return self._call("task_failed", task_id=task_id)
+
+    def register_worker(self, worker_id: str, meta: Optional[dict] = None):
+        return self._call("register_worker", worker_id=worker_id, meta=meta)
+
+    def heartbeat(self, worker_id: str, step: Optional[int] = None):
+        return self._call("heartbeat", worker_id=worker_id, step=step)
+
+    def membership(self):
+        return self._call("membership")
 
     def close(self):
         if self._file is not None:

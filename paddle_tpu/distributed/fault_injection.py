@@ -9,6 +9,13 @@ A fault spec is a comma-separated string, e.g.::
     PADDLE_FAULT="exc@7"            raise FaultInjected at step 7
     PADDLE_FAULT="delay@3:0.5"      sleep 0.5s at step 3 (straggler)
     PADDLE_FAULT="corrupt@5:/path"  flip bytes of a file at step 5
+    PADDLE_FAULT="hang@4"           spin forever at step 4 (livelock: the
+                                    process stays up but makes no
+                                    progress — only a heartbeat timeout
+                                    can detect it)
+    PADDLE_FAULT="netsplit@3:2.0"   drop coordinator connections for 2 s
+                                    starting at step 3 (partition: RPCs
+                                    fail and must ride it out on backoff)
 
 The trainer CLI ticks its injector once per batch when PADDLE_FAULT is
 set; worker scripts call `default_injector().tick()` wherever their
@@ -24,9 +31,23 @@ from typing import List, Optional
 
 __all__ = [
     "FaultInjected", "FaultInjector", "default_injector", "corrupt_file",
+    "netsplit_active",
 ]
 
 ENV_VAR = "PADDLE_FAULT"
+
+# wall-clock end of the current injected partition window (0 = none).
+# Process-wide on purpose: every RemoteCoordinator in the process loses
+# its "network" at once, like a real NIC/switch failure would look from
+# one host.
+_netsplit_until = 0.0
+
+
+def netsplit_active() -> bool:
+    """True while an injected netsplit window is open. Transport clients
+    (RemoteCoordinator) consult this and drop/refuse connections so the
+    partition is exercised end-to-end without real firewalling."""
+    return time.time() < _netsplit_until
 
 
 class FaultInjected(RuntimeError):
@@ -64,11 +85,21 @@ class _Fault(object):
             time.sleep(float(self.arg or "1.0"))
         elif self.kind == "corrupt":
             corrupt_file(self.arg)
+        elif self.kind == "hang":
+            # livelock, NOT a crash: the process keeps its sockets and
+            # pid, stops heartbeating, and never returns — detectable
+            # only by the supervisor's heartbeat deadline. sleep in
+            # small slices so an external SIGKILL reaps promptly.
+            while True:
+                time.sleep(0.05)
+        elif self.kind == "netsplit":
+            global _netsplit_until
+            _netsplit_until = time.time() + float(self.arg or "1.0")
         else:
             raise ValueError("unknown fault kind %r" % self.kind)
 
 
-_KINDS = ("kill", "exc", "delay", "corrupt")
+_KINDS = ("kill", "exc", "delay", "corrupt", "hang", "netsplit")
 
 
 def _parse(spec: str) -> List[_Fault]:
@@ -87,7 +118,7 @@ def _parse(spec: str) -> List[_Fault]:
             )
         if kind == "corrupt" and not arg:
             raise ValueError("corrupt@N:<path> needs the file path")
-        if kind == "delay":
+        if kind in ("delay", "netsplit"):
             arg = str(float(arg or "1.0"))  # fail fast on a bad duration
         faults.append(_Fault(kind, int(step_s), arg or None))
     return faults

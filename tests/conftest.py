@@ -28,6 +28,14 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "float32")
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP); slow marks the long
+    # elasticity drills that exceed that budget
+    config.addinivalue_line(
+        "markers", "slow: long end-to-end runs excluded from tier-1"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs, a fresh scope, and no

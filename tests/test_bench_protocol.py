@@ -120,10 +120,15 @@ def test_best_banked_headline_points_at_stable_record():
     assert rec["source"] == "BENCH_r05_builder.jsonl"
     assert "NOT this run's measurement" in rec["note"]
     # selection is best-of-stable, not file order: no stable record in
-    # the file exceeds the one chosen
+    # the file exceeds the one chosen (path anchored to bench.__file__,
+    # NOT the CWD — pytest may be launched from anywhere)
     import json as _json
+    import os as _os
 
-    path = "BENCH_r05_builder.jsonl"
+    path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(bench.__file__)),
+        "BENCH_r05_builder.jsonl",
+    )
     vals = [
         r.get("value", 0)
         for r in (
@@ -155,6 +160,49 @@ def test_best_banked_headline_never_raises(tmp_path, monkeypatch):
         else real_join(*a))
     rec = bench._last_banked_headline()
     assert rec is not None and rec["value"] == 100.0
+
+
+def test_best_banked_headline_is_cwd_independent(tmp_path, monkeypatch):
+    """The helper must resolve the evidence file relative to
+    bench.__file__, never the CWD: the watchdog's must-exit path can run
+    with any working directory (regression for the rule now also
+    followed by test_best_banked_headline_points_at_stable_record)."""
+    monkeypatch.chdir(tmp_path)  # no BENCH_r05_builder.jsonl here
+    rec = bench._last_banked_headline()
+    assert rec is not None and rec["value"] > 0
+
+
+def test_diff_time_drops_sub10ms_probe_from_seeds(monkeypatch):
+    """A sub-10 ms probe is the r3 memoized/ack-only signature: it must
+    neither drive chunk scaling NOR be merged into raw[] as a steady
+    chunk (ADVICE r5 — it deflated dt_min and inflated spread)."""
+    monkeypatch.setattr(bench, "MIN_CHUNK_S", 0.10)
+    monkeypatch.setattr(bench, "SPREAD_LIMIT", 10.0)  # one round exactly
+    r = FakeRunner(per_step=0.001, first_extra=0.01)
+    _, info = bench._diff_time(r, 2, 6, return_info=True)
+    assert info["chunk_scale"] == 1  # no scaling off the suspect probe
+    # raw[] holds ONLY the timed loop's chunks; the ~2 ms probe was
+    # dropped instead of seeding the low count
+    assert len(info["raw_chunk_s"]["2"]) == bench.TIMING_CHUNKS
+    assert len(info["raw_chunk_s"]["6"]) == bench.TIMING_CHUNKS
+
+
+def test_diff_time_prescale_probe_not_reused_at_final_count(monkeypatch):
+    """When the solved scale lands s_lo exactly on base_hi (here (2,6)
+    at scale 3 -> s_lo == 6), the pre-scale base_hi probe must NOT be
+    merged into raw[s_lo]: it predates the floor verification and could
+    consume the single-outlier trim allowance (ADVICE r5). Only the
+    post-scale verification probe is reused."""
+    monkeypatch.setattr(bench, "MIN_CHUNK_S", 0.12)
+    monkeypatch.setattr(bench, "SPREAD_LIMIT", 10.0)  # one round exactly
+    r = FakeRunner(per_step=0.02, first_extra=0.01)
+    _, info = bench._diff_time(r, 2, 6, return_info=True)
+    assert info["chunk_scale"] == 3
+    assert info["steps"] == [6, 18]
+    # s_lo == 6 == base_hi: TIMING_CHUNKS timed chunks + the ONE
+    # post-scale verification probe — the pre-scale probe at 6 is gone
+    assert len(info["raw_chunk_s"]["6"]) == bench.TIMING_CHUNKS + 1
+    assert len(info["raw_chunk_s"]["18"]) == bench.TIMING_CHUNKS
 
 
 def test_diff_time_inversion_raises():
@@ -234,7 +282,11 @@ def test_diff_time_suspect_probe_does_not_scale(monkeypatch):
     off it would saturate at MAX_CHUNK_SCALE and waste the side budget,
     so the requested counts are kept instead."""
     monkeypatch.setattr(bench, "MIN_CHUNK_S", 1.0)
-    r = FakeRunner(per_step=0.0001, first_extra=0.0)
+    # ~2 ms probe: suspect (under 10 ms) yet above the sleep-scheduler
+    # noise floor, so the timed chunks still order correctly — with the
+    # suspect probe no longer seeding raw[], a 0.1 ms/step runner sat
+    # entirely inside scheduler jitter and inverted the differencing
+    r = FakeRunner(per_step=0.001, first_extra=0.0)
     _, info = bench._diff_time(r, 2, 6, return_info=True)
     assert info["chunk_scale"] == 1
     assert info["steps"] == [2, 6]

@@ -13,6 +13,8 @@ from paddle_tpu.distributed import (
     Coordinator,
     MasterClient,
     load_checkpoint,
+    resume_or_init,
+    retain,
     save_checkpoint,
 )
 
@@ -115,6 +117,27 @@ def test_master_client_streams_and_retries():
     assert crashed == [2]
 
 
+def test_worker_membership_heartbeats_and_deadlines():
+    """Per-worker liveness: registration starts the deadline clock,
+    heartbeats extend it, silence expires it, and re-registration bumps
+    the incarnation (a supervisor restart is a NEW lease — stale
+    heartbeats cannot vouch for the replacement)."""
+    c = Coordinator(heartbeat_timeout_s=0.15)
+    assert c.membership() == {}
+    assert c.register_worker("w0")["incarnation"] == 1
+    c.heartbeat("w0", step=5)
+    m = c.membership()["w0"]
+    assert m["alive"] and m["step"] == 5
+    time.sleep(0.2)  # silence: deadline passes
+    assert not c.membership()["w0"]["alive"]
+    c.heartbeat("w0", step=6)  # a late heartbeat revives membership
+    assert c.membership()["w0"]["alive"]
+    assert c.register_worker("w0")["incarnation"] == 2
+    # unknown ids auto-register on heartbeat (coordinator restart case)
+    c.heartbeat("w9")
+    assert c.membership()["w9"]["alive"]
+
+
 # ---------------------------------------------------------------------------
 # checkpoint/resume (Go pserver parity)
 # ---------------------------------------------------------------------------
@@ -204,6 +227,40 @@ def test_checkpoint_crash_midsave_falls_back(tmp_path):
     steps = [s for s, _ in ckptmod._list_step_dirs(d)]
     assert steps == [3], steps
     assert load_checkpoint(fluid.executor.Scope(), d)["step"] == 3
+
+
+def test_retain_garbage_collects_old_steps(tmp_path):
+    import paddle_tpu.distributed.checkpoint as ckptmod
+
+    d = str(tmp_path / "ckpt")
+    scope = fluid.executor.Scope()
+    scope.set("w", np.arange(4, dtype=np.float32))
+    for step in range(1, 5):
+        save_checkpoint(scope, d, step=step, keep_last=10)
+    assert [s for s, _ in ckptmod._list_step_dirs(d)] == [4, 3, 2, 1]
+    assert retain(d, keep_last=2) == [4, 3]
+    # still loads the newest complete step after GC
+    assert load_checkpoint(fluid.executor.Scope(), d)["step"] == 4
+    with pytest.raises(ValueError):
+        retain(d, keep_last=0)
+
+
+def test_resume_or_init_branches(tmp_path):
+    d = str(tmp_path / "ckpt")
+    inits = []
+    scope = fluid.executor.Scope()
+    # nothing committed yet: init path
+    assert resume_or_init(scope, d, init_fn=lambda: inits.append(1)) is None
+    assert inits == [1]
+    scope.set("w", np.full(3, 7.0, np.float32))
+    save_checkpoint(scope, d, step=3, extra={"step": 3})
+    # committed checkpoint: restore path, init_fn NOT called
+    scope2 = fluid.executor.Scope()
+    meta = resume_or_init(scope2, d, init_fn=lambda: inits.append(2))
+    assert inits == [1]
+    assert meta["step"] == 3 and meta["extra"]["step"] == 3
+    np.testing.assert_array_equal(np.asarray(scope2.get("w")),
+                                  np.full(3, 7.0, np.float32))
 
 
 # ---------------------------------------------------------------------------
