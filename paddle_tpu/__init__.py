@@ -6,6 +6,8 @@ Layout:
   fluid/     Fluid-compatible frontend: Program IR, layers, optimizers,
              executor that lowers whole blocks to fused XLA computations
   parallel/  device mesh, data/tensor parallel training over ICI (pjit)
+  serving/   continuous-batching inference engine (slotted KV cache,
+             bucketed prefill, one compiled decode step)
   models/    reference model zoo (LeNet, ResNet, VGG, RNNs, ...)
   reader/    composable data readers (v2 reader decorator parity)
   ops/       pallas kernels for ops XLA cannot express well

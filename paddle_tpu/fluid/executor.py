@@ -11,7 +11,9 @@ core/lowering.py for the story.
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import os
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -119,6 +121,72 @@ def _feed_name(f):
     return f.name if isinstance(f, Variable) else str(f)
 
 
+class CompileCache(object):
+    """Bounded LRU over compiled step entries, keyed by (program,
+    feed-signature, ...) tuples. A long-lived serving or supervisor
+    process walks many shape buckets over its lifetime; the old
+    unbounded dict grew a compiled XLA executable per signature forever.
+    Capacity counts ENTRIES (signatures), not bytes — each entry pins
+    one compiled executable. Hit/miss/eviction counters are exposed via
+    Executor.cache_stats() so occupancy is observable, not guessed.
+
+    get() returns None on miss (the dict.get contract every call site
+    already uses) and refreshes recency on hit; insertion evicts the
+    least-recently-used entry past capacity. An evicted signature is
+    not an error — the next run recompiles, exactly like first contact.
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(
+                os.environ.get("PADDLE_TPU_EXECUTOR_CACHE_CAP", "64")
+            )
+        if capacity < 1:
+            raise ValueError("cache capacity must be >= 1")
+        self.capacity = capacity
+        self._od: "collections.OrderedDict[Any, Any]" = (
+            collections.OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        try:
+            entry = self._od[key]
+        except KeyError:
+            self.misses += 1
+            return None
+        self._od.move_to_end(key)
+        self.hits += 1
+        return entry
+
+    def __setitem__(self, key, entry):
+        self._od[key] = entry
+        self._od.move_to_end(key)
+        while len(self._od) > self.capacity:
+            self._od.popitem(last=False)
+            self.evictions += 1
+
+    def __contains__(self, key):
+        return key in self._od
+
+    def __len__(self):
+        return len(self._od)
+
+    def clear(self):
+        self._od.clear()
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "size": len(self._od),
+            "capacity": self.capacity,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }
+
+
 class Executor(object):
     """Single-chip by default. Pass `mesh=jax.sharding.Mesh(...)` (or set a
     default via paddle_tpu.parallel.set_default_mesh) to run data/tensor-
@@ -128,12 +196,15 @@ class Executor(object):
     MultiGradientMachine / NCCL / pserver paths with identical global-batch
     semantics."""
 
-    def __init__(self, places=None, mesh=None):
+    def __init__(self, places=None, mesh=None, cache_capacity=None):
         if isinstance(places, (list, tuple)):
             places = places[0] if places else None
         self.place = places
         self.mesh = mesh
-        self._cache: Dict[Any, Any] = {}
+        # bounded LRU (PADDLE_TPU_EXECUTOR_CACHE_CAP, default 64): a
+        # long-lived serving/supervisor process must not grow a compiled
+        # executable per shape bucket without limit
+        self._cache = CompileCache(cache_capacity)
         self._run_counter = 0
         # (jitted entry, arg avals, host-arg snapshot) of last run
         self._last_exec = None
@@ -563,6 +634,15 @@ class Executor(object):
     # convenience used by inference/serving paths ----------------------
     def close(self):
         self._cache.clear()
+        # the profiler's aval/host-arg snapshot pins a compiled entry
+        # plus a full host copy of the params — the LRU bound must not
+        # be exceeded by a stale capture after close
+        self._last_exec = None
+
+    def cache_stats(self) -> Dict[str, int]:
+        """Compilation-cache occupancy counters: size/capacity/hits/
+        misses/evictions (observability for long-lived processes)."""
+        return self._cache.stats()
 
 
 def _flush_print_effects(program):

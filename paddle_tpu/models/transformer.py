@@ -152,12 +152,16 @@ def _ln(x, p):
 
 def forward(params, tokens, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None, attn_impl: str = "ring",
-            kv_sink: Optional[list] = None, last_only: bool = False):
+            kv_sink: Optional[list] = None, last_only: bool = False,
+            last_index=None):
     """tokens [B, T] int -> logits [B, T, vocab] (or [B, vocab] of just
     the final position with last_only — prefill skips the O(T x vocab)
-    head it would discard). With `kv_sink` (a list), each block appends
-    its (k, v) [B, T, H, Dh] — the prefill hook for cached decoding, so
-    serving reuses THIS block math."""
+    head it would discard). `last_index` is the dynamic counterpart: a
+    traced scalar position whose single row feeds the head (the bucketed
+    serving prefill pads T to a power-of-two bucket, so the true last
+    prompt position is an argument, not the static T-1). With `kv_sink`
+    (a list), each block appends its (k, v) [B, T, H, Dh] — the prefill
+    hook for cached decoding, so serving reuses THIS block math."""
     B, T = tokens.shape
     if mesh is not None and "model" in mesh.axis_names:
         from ..parallel.embedding import sharded_lookup
@@ -198,7 +202,10 @@ def forward(params, tokens, cfg: TransformerConfig,
         else:
             x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
 
-    if last_only:
+    if last_index is not None:
+        x = jax.lax.dynamic_index_in_dim(x, last_index, axis=1,
+                                         keepdims=False)
+    elif last_only:
         x = x[:, -1]
     x = _ln(x, params["ln_f"])
     return x @ params["embed"].T  # weight-tied output head
@@ -253,18 +260,46 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len=None,
 
 
 def _cached_attention(q, cache_k, cache_v, pos):
-    """q [B,H,Dh] against the cache [B,L,H,Dh]; positions > pos masked."""
+    """q [B,H,Dh] against the cache [B,L,H,Dh]; positions > pos masked.
+    `pos` is a scalar (one shared decode position — generate's path) or
+    a [B] vector of PER-ROW positions (the slotted serving cache, where
+    every row is an independent request at its own depth). Masked
+    positions contribute exactly 0 (exp(-inf) == 0, 0 * finite == 0),
+    so stale/dead-slot cache rows cannot perturb live rows."""
     B, L, H, dh = cache_k.shape
     scores = jnp.einsum("bhd,blhd->bhl", q, cache_k) / math.sqrt(dh)
-    mask = (jnp.arange(L) <= pos)[None, None, :]
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        mask = (jnp.arange(L) <= pos)[None, None, :]
+    else:
+        mask = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, :]
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhl,blhd->bhd", probs, cache_v)
 
 
+def _write_kv(buf, new, pos):
+    """Write one new K or V row [B, H, Dh] into the cache [B, L, H, Dh]
+    at position `pos`: a contiguous dynamic_update_slice for the scalar
+    case (generate — every row at the same depth), a per-row scatter for
+    vector pos [B] (slotted serving — each slot at its own depth).
+    Out-of-range vector positions are DROPPED by scatter semantics, so a
+    retired slot parked at the clamp boundary never corrupts neighbors."""
+    if jnp.ndim(pos) == 0:
+        return jax.lax.dynamic_update_slice_in_dim(
+            buf, new[:, None].astype(buf.dtype), pos, axis=1
+        )
+    B = buf.shape[0]
+    return buf.at[jnp.arange(B), pos].set(new.astype(buf.dtype))
+
+
 def decode_step(params, token, pos, cache, cfg: TransformerConfig):
-    """One decode step: token [B] int at position `pos` (scalar) ->
-    (logits [B, vocab], updated cache)."""
+    """One decode step: token [B] int at position `pos` -> (logits
+    [B, vocab], updated cache). `pos` is a scalar (generate: all rows at
+    the same depth) or a [B] vector of per-row positions (the slotted
+    serving cache — many independent requests in one batched step); the
+    per-row math is identical either way, so the serving engine's
+    decode is bit-identical to generate's row by row."""
     B = token.shape[0]
     dh = cfg.dim // cfg.heads
     x = params["embed"][token] + params["pos"][pos]
@@ -274,12 +309,8 @@ def decode_step(params, token, pos, cache, cfg: TransformerConfig):
         q = (h @ blk["wq"]).reshape(B, cfg.heads, dh)
         k = (h @ blk["wk"]).reshape(B, cfg.heads, dh)
         v = (h @ blk["wv"]).reshape(B, cfg.heads, dh)
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            kv["k"], k[:, None].astype(kv["k"].dtype), pos, axis=1
-        )
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            kv["v"], v[:, None].astype(kv["v"].dtype), pos, axis=1
-        )
+        ck = _write_kv(kv["k"], k, pos)
+        cv = _write_kv(kv["v"], v, pos)
         new_cache.append({"k": ck, "v": cv})
         o = _cached_attention(q, ck, cv, pos).reshape(B, cfg.dim)
         x = x + o @ blk["wo"]

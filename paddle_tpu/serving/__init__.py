@@ -1,0 +1,10 @@
+"""Continuous-batching inference serving (ISSUE 2 tentpole): slotted KV
+cache + bucketed prefill + one compiled decode step over
+models/transformer.py's cached-decode primitives. See engine.py for the
+design story and tests/test_serving_engine.py for the correctness bar
+(greedy outputs bit-identical to sequential generate())."""
+
+from .engine import ServingEngine, ServingHandle
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine", "ServingHandle", "ServingMetrics"]

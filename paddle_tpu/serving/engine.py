@@ -1,0 +1,356 @@
+"""Continuous-batching serving engine: slotted KV cache, bucketed
+prefill, and ONE compiled decode step for many concurrent requests.
+
+The training path sits at the HBM roof (PERF.md r5); the unclaimed
+serving throughput is workload shape — one request per batch underfills
+the lanes and every new prompt length recompiles. This engine
+reproduces Orca-style iteration-level scheduling (Yu et al., OSDI '22)
+and vLLM-style slot management (Kwon et al., SOSP '23) in JAX/XLA
+idiom: static shapes everywhere, slots instead of dynamic allocation.
+
+  * Slotted KV cache — one fixed [MAX_SLOTS, max_len] cache per layer
+    holds many independent requests; per-slot `pos`/`alive` side-bands
+    and the per-row mask in models/transformer._cached_attention make a
+    dead or stale slot contribute exactly 0 to live rows.
+  * Bucketed prefill — prompts pad to pow-2 length buckets (the same
+    discipline as executor.py _lod_bucket) and write into a free slot
+    via dynamic_update_slice, so distinct compiled prefill shapes are
+    O(log max_len), not O(#prompts). Causality + the exp(-inf)==0 mask
+    make the padded prefill BIT-IDENTICAL to an unpadded one at the
+    true last prompt position.
+  * One jitted decode step — advances all MAX_SLOTS slots at once with
+    per-slot positions, temperatures, and sampling keys; cache buffers
+    are donated. Traced exactly once per engine lifetime (guarded by
+    tests/test_serving_engine.py's compile-count test).
+  * Iteration-level scheduling — ServingEngine.step() retires a slot
+    the moment its request emits EOS or exhausts its budget and refills
+    it from the FCFS queue on the SAME step; a new request never waits
+    for the whole batch to drain. `max_prefills_per_step` bounds how
+    much prefill work may delay in-flight decodes (the prefill-vs-
+    decode interleave policy).
+
+Correctness bar (tested): greedy engine output per request is
+bit-identical to sequential models/transformer.generate() at every
+slot count and admission order. Sampled requests use a per-request
+fold_in(key, token_index) schedule — deterministic per request and
+independent of slot assignment, but not the same key schedule as
+generate(temperature>0).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fluid.core.kernels_sequence import bucket_pow2
+from ..models import transformer as tlm
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine", "ServingHandle"]
+
+
+class ServingHandle(object):
+    """Per-request future: filled in by the engine as steps run.
+    `result()` drives the owning engine until this request completes
+    (single-threaded engines have no background loop to wait on)."""
+
+    def __init__(self, engine, rid, prompt, max_new_tokens, temperature,
+                 eos_id, seed):
+        self._engine = engine
+        self.rid = rid
+        self.prompt = prompt  # np.int32 [T0]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.seed = seed
+        self.tokens: List[int] = []  # generated tokens (may include eos)
+        self.done = False
+        self.finish_reason: Optional[str] = None  # 'eos' | 'budget'
+        self.submit_t = time.monotonic()
+        self.queue_wait_s: Optional[float] = None
+        self.ttft_s: Optional[float] = None
+
+    def result(self) -> np.ndarray:
+        """Block (by stepping the engine) until done; returns the full
+        sequence [T0 + n_generated] — prompt then generated tokens."""
+        while not self.done:
+            if not self._engine.step():
+                raise RuntimeError(
+                    "engine made no progress but request %r is not done"
+                    % self.rid
+                )
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)]
+        )
+
+
+class ServingEngine(object):
+    """Continuous-batching engine over a transformer LM's decode
+    primitives. Knobs: `max_slots` (concurrent requests in the batched
+    decode), `max_len` (per-slot KV capacity, bounded by the positional
+    table), `min_bucket` (smallest prefill pad length), and
+    `max_prefills_per_step` (admission per step; None = fill every free
+    slot — throughput-biased; 1 = latency-biased for in-flight decodes).
+    """
+
+    def __init__(self, params, cfg, max_slots=8, max_len=None,
+                 min_bucket=8, max_prefills_per_step=None, donate=True):
+        self._params = params
+        self._cfg = cfg
+        S = int(max_slots)
+        if S < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_slots = S
+        # the positional table bounds every position (same clamp as
+        # generate: a gather past it would silently clamp, not error)
+        L = int(max_len or cfg.max_len)
+        L = min(L, int(params["pos"].shape[0]))
+        self.max_len = L
+        self.min_bucket = int(min_bucket)
+        if max_prefills_per_step is not None and max_prefills_per_step < 1:
+            raise ValueError("max_prefills_per_step must be >= 1 or None")
+        self.max_prefills_per_step = max_prefills_per_step
+        self.metrics = ServingMetrics(S)
+
+        self._cache = tlm.init_kv_cache(cfg, S, max_len=L)
+        # host-side truth of the per-slot side-bands; uploaded per step
+        self._tok = np.zeros(S, np.int32)     # last emitted, not yet cached
+        self._pos = np.zeros(S, np.int32)     # its write position
+        self._alive = np.zeros(S, bool)
+        self._temps = np.zeros(S, np.float32)
+        self._counts = np.zeros(S, np.int32)  # tokens generated so far
+        self._base_keys = np.zeros((S, 2), np.uint32)  # per-request keys
+        self._slot_req: List[Optional[ServingHandle]] = [None] * S
+
+        self._queue: collections.deque = collections.deque()
+        self._next_rid = 0
+        self._donate = bool(donate)
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode_fn = self._make_decode()
+
+    # ------------------------------------------------------------------
+    # compiled steps
+    # ------------------------------------------------------------------
+    def _make_decode(self):
+        cfg, metrics, L = self._cfg, self.metrics, self.max_len
+
+        def _decode(params, cache, tok, pos, alive, temps, counts,
+                    base_keys):
+            metrics.count_trace("decode_step")  # trace-time side effect
+            # dead slots park their write out of range: scatter DROPS
+            # out-of-bounds rows, so a retired slot can never dirty the
+            # cache a future prefill will claim
+            write_pos = jnp.where(alive, pos, jnp.int32(L))
+            logits, cache = tlm.decode_step(
+                params, tok, write_pos, cache, cfg
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
+            safe_t = jnp.where(temps > 0, temps, 1.0)
+            sampled = jax.vmap(
+                lambda k, l, t: jax.random.categorical(
+                    k, l.astype(jnp.float32) / t
+                )
+            )(keys, logits, safe_t).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            return cache, nxt
+
+        kw = {"donate_argnums": (1,)} if self._donate else {}
+        return jax.jit(_decode, **kw)
+
+    def _prefill_fn(self, Tb):
+        fn = self._prefill_fns.get(Tb)
+        if fn is not None:
+            return fn
+        cfg, metrics = self._cfg, self.metrics
+
+        def _prefill(params, cache, padded, true_len, slot, temp, key):
+            metrics.count_trace("prefill_T%d" % Tb)
+            sink: list = []
+            # reuses forward()'s block math exactly; last_index picks
+            # the TRUE last prompt row out of the padded bucket
+            last = tlm.forward(
+                params, padded, cfg, mesh=None, attn_impl="reference",
+                kv_sink=sink, last_index=true_len - 1,
+            )[0]  # [vocab]
+            new_cache = []
+            for kv, (k, v) in zip(cache, sink):
+                ck = jax.lax.dynamic_update_slice(
+                    kv["k"], k.astype(kv["k"].dtype), (slot, 0, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    kv["v"], v.astype(kv["v"].dtype), (slot, 0, 0, 0)
+                )
+                new_cache.append({"k": ck, "v": cv})
+            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                key,
+                last.astype(jnp.float32) / jnp.where(temp > 0, temp, 1.0),
+            ).astype(jnp.int32)
+            first = jnp.where(temp > 0, sampled, greedy)
+            return new_cache, first
+
+        kw = {"donate_argnums": (1,)} if self._donate else {}
+        fn = jax.jit(_prefill, **kw)
+        self._prefill_fns[Tb] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # scheduler
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, temperature=0.0, eos_id=None,
+               seed=0) -> ServingHandle:
+        """Enqueue one request (FCFS). Returns a handle whose `.tokens`
+        fills in as the engine steps; `handle.result()` drives the
+        engine to completion of this request."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        T0 = prompt.shape[0]
+        if T0 < 1:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if T0 + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                "request needs T0+max_new <= max_len (%d + %d > %d)"
+                % (T0, int(max_new_tokens), self.max_len)
+            )
+        h = ServingHandle(self, self._next_rid, prompt, max_new_tokens,
+                          temperature, eos_id, seed)
+        self._next_rid += 1
+        self._queue.append(h)
+        return h
+
+    def _free_slot(self) -> Optional[int]:
+        for s in range(self.max_slots):
+            if self._slot_req[s] is None:
+                return s
+        return None
+
+    def _bucket(self, T0: int) -> int:
+        return min(bucket_pow2(T0, floor=self.min_bucket), self.max_len)
+
+    def _retire(self, s: int, reason: str):
+        h = self._slot_req[s]
+        h.done = True
+        h.finish_reason = reason
+        self._slot_req[s] = None
+        self._alive[s] = False
+
+    def _emit(self, s: int, token: int) -> bool:
+        """Append one generated token to slot s's request; retire on EOS
+        or budget (EOS on the budget-exhausting step reports 'eos').
+        Returns True if the slot was retired."""
+        h = self._slot_req[s]
+        h.tokens.append(int(token))
+        self._counts[s] += 1
+        self.metrics.tokens_out += 1
+        if h.eos_id is not None and int(token) == int(h.eos_id):
+            self._retire(s, "eos")
+            return True
+        if len(h.tokens) >= h.max_new_tokens:
+            self._retire(s, "budget")
+            return True
+        return False
+
+    def _admit(self, h: ServingHandle, s: int):
+        t0 = time.monotonic()
+        h.queue_wait_s = t0 - h.submit_t
+        self.metrics.queue_wait_s.append(h.queue_wait_s)
+        T0 = h.prompt.shape[0]
+        Tb = self._bucket(T0)
+        padded = np.zeros((1, Tb), np.int32)
+        padded[0, :T0] = h.prompt
+        fn = self._prefill_fn(Tb)
+        key = jax.random.fold_in(jax.random.PRNGKey(h.seed), 0)
+        self._cache, first = fn(
+            self._params, self._cache, jnp.asarray(padded),
+            jnp.int32(T0), jnp.int32(s),
+            jnp.float32(h.temperature), key,
+        )
+        first = int(np.asarray(first))  # blocks: first token is real
+        now = time.monotonic()
+        h.ttft_s = now - h.submit_t
+        self.metrics.ttft_s.append(h.ttft_s)
+        self.metrics.span("prefill_T%d" % Tb, now - t0)
+        self.metrics.prefills += 1
+
+        self._slot_req[s] = h
+        self._tok[s] = first
+        self._pos[s] = T0
+        self._alive[s] = True
+        self._temps[s] = h.temperature
+        self._counts[s] = 0
+        self._base_keys[s] = np.asarray(jax.random.PRNGKey(h.seed))
+        self._emit(s, first)  # may retire immediately (max_new==1 / eos)
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit queued requests into free
+        slots (bounded by max_prefills_per_step), then ONE batched
+        decode advancing every live slot; retirements free slots for
+        the next step's admissions. Returns False when there was
+        nothing to do (queue empty and no live slots)."""
+        admitted = 0
+        cap = self.max_prefills_per_step
+        while self._queue and (cap is None or admitted < cap):
+            s = self._free_slot()
+            if s is None:
+                break
+            self._admit(self._queue.popleft(), s)
+            admitted += 1
+
+        if not self._alive.any():
+            return admitted > 0
+
+        t0 = time.monotonic()
+        self._cache, nxt = self._decode_fn(
+            self._params, self._cache,
+            jnp.asarray(self._tok), jnp.asarray(self._pos),
+            jnp.asarray(self._alive), jnp.asarray(self._temps),
+            jnp.asarray(self._counts), jnp.asarray(self._base_keys),
+        )
+        nxt = np.asarray(nxt)  # blocks; tokens are real
+        self.metrics.span("decode_step", time.monotonic() - t0)
+        self.metrics.decode_steps += 1
+        self.metrics.occupancy.append(
+            float(self._alive.sum()) / self.max_slots
+        )
+
+        live = np.nonzero(self._alive)[0]
+        self._pos[live] += 1  # the token just cached sat at pos
+        for s in live:
+            self._tok[s] = nxt[s]
+            self._emit(s, nxt[s])
+        return True
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive the engine until the queue drains and every slot
+        retires; returns {request_id: full sequence} for every request
+        completed during this call."""
+        finished: Dict[int, np.ndarray] = {}
+        # a retired handle never lingers in _slot_req, so everything
+        # in-flight or queued right now is exactly this call's work
+        pending = list(self._queue) + [
+            h for h in self._slot_req if h is not None
+        ]
+        while self.step():
+            pass
+        for h in pending:
+            if h.done:
+                finished[h.rid] = np.concatenate(
+                    [h.prompt, np.asarray(h.tokens, np.int32)]
+                )
+        return finished
+
+    # ------------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def live_slots(self) -> int:
+        return int(self._alive.sum())

@@ -1,0 +1,123 @@
+"""Serving metrics: tokens/s, slot occupancy, queue wait, time-to-first-
+token, and compile (trace) counts for the continuous-batching engine.
+
+Reporting rides the existing fluid/profiler.py machinery: wall-clock
+spans land in an OpCostCollector (the same rows `with profiler(...)`
+prints — Event/Calls/Total/Min/Max/Ave in ms) and `print_report()`
+renders through profiler._print_table, so serving output reads exactly
+like a training profile. Aggregates (`report()`) carry the
+offline-measurable numbers the PERF.md serving section cites: mean slot
+occupancy and per-bucket compile counts are deterministic on any
+backend; tokens/s is only meaningful on-chip.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+__all__ = ["ServingMetrics"]
+
+
+class _RunningStat(object):
+    """O(1) mean/max accumulator. A long-lived engine records one value
+    per decode step / per request forever — growing a Python float list
+    without bound is the same trap the executor's CompileCache closes
+    for compiled entries, so aggregates are running sums, not history."""
+
+    __slots__ = ("count", "total", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = None
+
+    def append(self, x):
+        x = float(x)
+        self.count += 1
+        self.total += x
+        if self.max is None or x > self.max:
+            self.max = x
+
+    @property
+    def mean(self):
+        return self.total / self.count if self.count else None
+
+    def __len__(self):
+        return self.count
+
+
+class ServingMetrics(object):
+    def __init__(self, max_slots: int):
+        from ..fluid.profiler import OpCostCollector
+
+        self.max_slots = int(max_slots)
+        self.ops = OpCostCollector()  # wall-clock spans, profiler rows
+        # fn-name -> times TRACED (a retrace == a recompile; the static
+        # shape discipline the engine depends on makes these O(1))
+        self.trace_counts: Dict[str, int] = {}
+        self.prefills = 0
+        self.decode_steps = 0
+        self.tokens_out = 0
+        self.occupancy = _RunningStat()  # live slots / max_slots per decode
+        self.queue_wait_s = _RunningStat()  # submit -> admission
+        self.ttft_s = _RunningStat()  # submit -> first token
+        self._t0 = None
+        self._t1 = None
+
+    # -- recording ------------------------------------------------------
+    def count_trace(self, name: str):
+        """Called from INSIDE the traced functions: runs once per trace
+        (== once per compile signature), never per execution."""
+        self.trace_counts[name] = self.trace_counts.get(name, 0) + 1
+
+    def span(self, name: str, seconds: float):
+        self.ops.record(name, seconds)
+        now = time.monotonic()
+        if self._t0 is None:
+            self._t0 = now - seconds
+        self._t1 = now
+
+    # -- derived --------------------------------------------------------
+    @property
+    def wall_s(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return (self._t1 or self._t0) - self._t0
+
+    def prefill_trace_count(self) -> int:
+        return sum(
+            n for k, n in self.trace_counts.items() if k.startswith("prefill")
+        )
+
+    def decode_trace_count(self) -> int:
+        return self.trace_counts.get("decode_step", 0)
+
+    def report(self) -> dict:
+        def _mean(st):
+            return round(st.mean, 6) if st.count else None
+
+        wall = self.wall_s
+        return {
+            "tokens_out": self.tokens_out,
+            "tokens_per_sec": round(self.tokens_out / wall, 2) if wall else None,
+            "decode_steps": self.decode_steps,
+            "prefills": self.prefills,
+            "mean_occupancy": _mean(self.occupancy),
+            "mean_queue_wait_s": _mean(self.queue_wait_s),
+            "max_queue_wait_s": round(self.queue_wait_s.max, 6)
+            if self.queue_wait_s.count else None,
+            "mean_ttft_s": _mean(self.ttft_s),
+            "compile_counts": dict(self.trace_counts),
+            "prefill_traces": self.prefill_trace_count(),
+            "decode_traces": self.decode_trace_count(),
+            "wall_s": round(wall, 4),
+        }
+
+    def table(self, sorted_key="total"):
+        return self.ops.table(sorted_key)
+
+    def print_report(self):
+        from ..fluid.profiler import _print_table
+
+        _print_table(self.table(), self.wall_s)

@@ -36,6 +36,34 @@ def pytest_configure(config):
     )
 
 
+# tier-1 budget guard: the ROADMAP's 870 s timeout is a shared budget;
+# any single test taking >= this many seconds is visibly flagged at the
+# end of the run so a creeping drill can't silently eat the suite
+SLOW_TEST_SECONDS = 10.0
+
+
+def pytest_terminal_summary(terminalreporter):
+    slow = []
+    for reports in terminalreporter.stats.values():
+        for rep in reports:
+            if (
+                getattr(rep, "when", None) == "call"
+                and getattr(rep, "duration", 0.0) >= SLOW_TEST_SECONDS
+            ):
+                slow.append((rep.duration, rep.nodeid))
+    if not slow:
+        return
+    terminalreporter.write_sep(
+        "=", "tier-1 budget guard: tests >= %.0fs" % SLOW_TEST_SECONDS
+    )
+    for dur, nodeid in sorted(slow, reverse=True):
+        terminalreporter.write_line("%8.1fs  %s" % (dur, nodeid))
+    terminalreporter.write_line(
+        "(mark non-essential end-to-end drills @pytest.mark.slow to "
+        "keep tier-1 under the ROADMAP timeout)"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs, a fresh scope, and no

@@ -1,6 +1,7 @@
 """Mixed precision (program.amp) and multi-step scan execution."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 
@@ -141,6 +142,8 @@ def test_amp_f32_denylist_active():
     assert np.isclose(outs[True], outs[False], rtol=0.08), outs
 
 
+@pytest.mark.slow  # 51s CIFAR loss-curve drill; the amp semantics
+# tests above stay in tier-1 (ISSUE 2 satellite)
 def test_amp_loss_curve_parity_cifar():
     """VERDICT r1 item 10 / r2 item 7: the AMP loss CURVE tracks the f32
     curve within tolerance on the CIFAR-style conv+BN book model."""

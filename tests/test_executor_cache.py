@@ -164,6 +164,64 @@ def test_nce_reference_formulation():
     assert np.all(np.isfinite(out))
 
 
+def test_executor_cache_lru_bounded_with_counters():
+    """Executor._cache is a bounded LRU: a long-lived process walking
+    many feed-shape buckets stays at the cap (evicting oldest), and
+    hit/miss/eviction counters expose occupancy (ISSUE 2 satellite)."""
+    exe = fluid.Executor(fluid.CPUPlace(), cache_capacity=3)
+    main, y = _build_program(2.0)
+    # 5 distinct feed signatures (batch sizes) -> 5 compiles through a
+    # cap of 3: size stays bounded, 2 evictions
+    for b in (1, 2, 3, 4, 5):
+        (out,) = exe.run(main, feed={"x": np.ones((b, 4), np.float32)},
+                         fetch_list=[y])
+        assert float(out.ravel()[0]) == 2.0
+    st = exe.cache_stats()
+    assert st["size"] == 3 and st["capacity"] == 3
+    assert st["misses"] == 5 and st["hits"] == 0 and st["evictions"] == 2
+
+    # b=5 is resident (hit); b=1 was evicted (miss + recompile + a new
+    # eviction); the re-run still computes correctly either way
+    (out,) = exe.run(main, feed={"x": np.ones((5, 4), np.float32)},
+                     fetch_list=[y])
+    assert float(out.ravel()[0]) == 2.0
+    assert exe.cache_stats()["hits"] == 1
+    (out,) = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                     fetch_list=[y])
+    assert float(out.ravel()[0]) == 2.0
+    st = exe.cache_stats()
+    assert st["misses"] == 6 and st["evictions"] == 3 and st["size"] == 3
+
+    # LRU recency: the b=5 hit refreshed it, so it must still be
+    # resident after the b=1 insertion evicted the oldest entry
+    before = exe.cache_stats()["hits"]
+    exe.run(main, feed={"x": np.ones((5, 4), np.float32)}, fetch_list=[y])
+    assert exe.cache_stats()["hits"] == before + 1
+
+    exe.close()
+    assert exe.cache_stats()["size"] == 0
+
+
+def test_executor_cache_capacity_env_and_validation():
+    import pytest
+
+    from paddle_tpu.fluid.executor import CompileCache
+
+    with pytest.raises(ValueError, match="capacity"):
+        CompileCache(0)
+    import os
+
+    old = os.environ.get("PADDLE_TPU_EXECUTOR_CACHE_CAP")
+    os.environ["PADDLE_TPU_EXECUTOR_CACHE_CAP"] = "7"
+    try:
+        assert CompileCache().capacity == 7
+    finally:
+        if old is None:
+            del os.environ["PADDLE_TPU_EXECUTOR_CACHE_CAP"]
+        else:
+            os.environ["PADDLE_TPU_EXECUTOR_CACHE_CAP"] = old
+
+
 def test_device_resident_feed_no_host_round_trip():
     """A device-resident feed must reach the step as the SAME jax array
     (no np.asarray device->host copy): through a remote tunnel that

@@ -101,6 +101,8 @@ def test_tensor_parallel_fc():
     np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # 72s end-to-end dryrun; in-budget tests cover the
+# mesh entry paths (ISSUE 2 satellite)
 def test_dryrun_multichip_entry():
     """The driver-facing multichip dry run must compile and execute."""
     import __graft_entry__

@@ -253,6 +253,8 @@ def test_native_lookup_table_padding_idx(tmp_path, native_infer_ok):
     runner.close()
 
 
+@pytest.mark.slow  # 35s whole-zoo C-serving sweep; per-model native
+# serving tests stay in tier-1 (ISSUE 2 satellite)
 def test_native_serves_image_zoo(tmp_path, native_infer_ok):
     """Every image-classification family in the zoo serves through the
     dependency-free C runner (capi parity for the benchmark models):

@@ -1,0 +1,335 @@
+"""Continuous-batching serving engine (paddle_tpu/serving):
+
+* Correctness bar — greedy engine output per request is BIT-IDENTICAL
+  to sequential models/transformer.generate() at every slot count and
+  admission order (three configurations below).
+* Compile-count regression — a session over N requests with mixed
+  prompt lengths traces prefill <= #buckets times and the decode step
+  exactly once (the static-shape discipline the engine depends on).
+* Slot lifecycle edge cases — queueing when full, EOS on the
+  budget-exhausting step, refill right after retirement mid-flight,
+  W>1 requests landing in non-contiguous free slots.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serving import ServingEngine
+
+
+def _cfg(**kw):
+    kw.setdefault("vocab", 50)
+    kw.setdefault("dim", 32)
+    kw.setdefault("heads", 4)
+    kw.setdefault("layers", 2)
+    kw.setdefault("max_len", 64)
+    return T.TransformerConfig(**kw)
+
+
+def _mk(seed=0, **kw):
+    cfg = _cfg(**kw)
+    return cfg, T.init_params(cfg, jax.random.PRNGKey(seed))
+
+
+def _oracle(params, cfg, prompt, max_new):
+    return np.asarray(
+        T.generate(params, jnp.asarray(prompt)[None], cfg, max_new)
+    )[0]
+
+
+def _full(h):
+    return np.concatenate([h.prompt, np.asarray(h.tokens, np.int32)])
+
+
+def test_greedy_bit_identical_across_slot_counts_and_orders():
+    """Acceptance: three slot-count/arrival-order configurations, every
+    request bit-identical to the sequential generate() oracle."""
+    cfg, params = _mk(0)
+    rng = np.random.RandomState(0)
+    prompts = [
+        rng.randint(0, cfg.vocab, (t,)).astype(np.int32)
+        for t in (3, 7, 12, 5, 9, 17)
+    ]
+    budgets = [6, 8, 5, 10, 4, 7]
+    oracle = [
+        _oracle(params, cfg, p, n) for p, n in zip(prompts, budgets)
+    ]
+
+    # config 1: single slot (fully sequential through the engine)
+    eng = ServingEngine(params, cfg, max_slots=1)
+    hs = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+    eng.run()
+    for h, want in zip(hs, oracle):
+        np.testing.assert_array_equal(_full(h), want)
+
+    # config 2: more slots than requests, all submitted upfront
+    eng = ServingEngine(params, cfg, max_slots=8)
+    hs = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+    eng.run()
+    for h, want in zip(hs, oracle):
+        np.testing.assert_array_equal(_full(h), want)
+
+    # config 3: staggered arrivals mid-decode, latency-biased admission
+    # (one prefill per step), reversed submission order
+    eng = ServingEngine(params, cfg, max_slots=2, max_prefills_per_step=1)
+    order = [5, 4, 3, 2, 1, 0]
+    hs = {}
+    for j, i in enumerate(order):
+        hs[i] = eng.submit(prompts[i], budgets[i])
+        if j % 2 == 1:
+            eng.step()  # requests keep arriving while others decode
+    eng.run()
+    for i in order:
+        np.testing.assert_array_equal(_full(hs[i]), oracle[i])
+
+
+def test_compile_count_regression():
+    """One engine lifetime over N requests with mixed prompt lengths:
+    prefill traces <= #buckets and the decode step traces EXACTLY once
+    (iteration count, slot churn, and admission order must not leak
+    into compiled shapes)."""
+    cfg, params = _mk(1)
+    rng = np.random.RandomState(1)
+    lengths = [3, 5, 8, 9, 12, 16, 20, 25, 4, 11]  # buckets: 8, 16, 32
+    eng = ServingEngine(params, cfg, max_slots=4)
+    hs = [
+        eng.submit(rng.randint(0, cfg.vocab, (t,)).astype(np.int32), 5)
+        for t in lengths
+    ]
+    eng.run()
+    buckets = {eng._bucket(t) for t in lengths}
+    assert eng.metrics.prefill_trace_count() <= len(buckets)
+    assert eng.metrics.decode_trace_count() == 1
+
+    # a second wave on the same engine must not retrace anything
+    hs2 = [
+        eng.submit(rng.randint(0, cfg.vocab, (t,)).astype(np.int32), 4)
+        for t in (6, 13, 30)
+    ]
+    eng.run()
+    assert eng.metrics.prefill_trace_count() <= len(buckets)
+    assert eng.metrics.decode_trace_count() == 1
+    assert all(h.done for h in hs + hs2)
+
+
+def test_admission_queues_when_all_slots_busy():
+    cfg, params = _mk(2)
+    rng = np.random.RandomState(2)
+    prompts = [
+        rng.randint(0, cfg.vocab, (t,)).astype(np.int32)
+        for t in (4, 6, 5, 7, 3)
+    ]
+    oracle = [_oracle(params, cfg, p, 6) for p in prompts]
+    eng = ServingEngine(params, cfg, max_slots=2)
+    hs = [eng.submit(p, 6) for p in prompts]
+    eng.step()
+    # two slots filled, three requests wait; the waiters have produced
+    # nothing yet (admission is FCFS, not speculative)
+    assert eng.live_slots == 2
+    assert eng.queue_depth == 3
+    assert hs[2].tokens == [] and not hs[2].done
+    eng.run()
+    for h, want in zip(hs, oracle):
+        np.testing.assert_array_equal(_full(h), want)
+
+
+def test_eos_on_same_step_as_budget_exhaustion():
+    """A request whose EOS lands exactly on the budget-exhausting token
+    retires ONCE (reason 'eos'), emits exactly max_new tokens, and the
+    slot is immediately reusable."""
+    cfg, params = _mk(3, vocab=8)
+    eos = cfg.vocab - 1
+    params["embed"] = params["embed"].at[eos].mul(50.0)  # eos is argmax
+    rng = np.random.RandomState(3)
+    eng = ServingEngine(params, cfg, max_slots=1)
+    h = eng.submit(rng.randint(0, eos, (4,)), 1, eos_id=eos)
+    eng.run()
+    assert h.done and h.finish_reason == "eos"
+    assert h.tokens == [eos] and len(h.tokens) == 1
+    # slot freed exactly once: a follow-up request runs clean
+    h2 = eng.submit(rng.randint(0, eos, (5,)), 3, eos_id=eos)
+    eng.run()
+    assert h2.done and h2.tokens[-1] == eos
+
+
+def test_eos_mid_budget_stops_early():
+    # seed chosen so the 50x embed bias makes eos the argmax on the
+    # THIRD generated token: genuinely mid-budget, not at-prefill
+    cfg, params = _mk(5, vocab=8)
+    eos = cfg.vocab - 1
+    params["embed"] = params["embed"].at[eos].mul(50.0)
+    rng = np.random.RandomState(6)
+    eng = ServingEngine(params, cfg, max_slots=2)
+    h = eng.submit(rng.randint(0, eos, (4,)), 10, eos_id=eos)
+    eng.run()
+    assert h.finish_reason == "eos"
+    assert len(h.tokens) < 10 and h.tokens[-1] == eos
+    # prefix agreement with the eos-aware sequential path
+    want = np.asarray(T.generate(
+        params, jnp.asarray(h.prompt)[None], cfg, 10, eos_id=eos
+    ))[0]
+    np.testing.assert_array_equal(_full(h), want[: 4 + len(h.tokens)])
+
+
+def test_refill_on_retirement_mid_flight():
+    """A queued request is admitted into a just-retired slot while the
+    other slot is mid-decode; both the long-running neighbor and the
+    refilled request stay bit-identical to the oracle."""
+    cfg, params = _mk(5)
+    rng = np.random.RandomState(5)
+    long_p = rng.randint(0, cfg.vocab, (6,)).astype(np.int32)
+    short_p = rng.randint(0, cfg.vocab, (4,)).astype(np.int32)
+    late_p = rng.randint(0, cfg.vocab, (9,)).astype(np.int32)
+    eng = ServingEngine(params, cfg, max_slots=2)
+    h_long = eng.submit(long_p, 12)
+    h_short = eng.submit(short_p, 2)   # retires after one decode
+    h_late = eng.submit(late_p, 5)     # queued until short retires
+    eng.step()
+    assert h_late.tokens == []  # both slots busy
+    eng.step()  # short's budget exhausts here...
+    assert h_short.done
+    eng.step()  # ...freeing its slot for late's admission
+    assert h_late.tokens != [] and not h_long.done
+    eng.run()
+    np.testing.assert_array_equal(
+        _full(h_long), _oracle(params, cfg, long_p, 12))
+    np.testing.assert_array_equal(
+        _full(h_short), _oracle(params, cfg, short_p, 2))
+    np.testing.assert_array_equal(
+        _full(h_late), _oracle(params, cfg, late_p, 5))
+
+
+def test_multiple_requests_land_in_noncontiguous_free_slots():
+    """W=2 requests admitted into slot holes (0 and 2) left by early
+    retirements, with live neighbors in slots 1 and 3."""
+    cfg, params = _mk(6)
+    rng = np.random.RandomState(6)
+    prompts = [
+        rng.randint(0, cfg.vocab, (t,)).astype(np.int32)
+        for t in (4, 5, 6, 7, 8, 10)
+    ]
+    budgets = [2, 12, 2, 12, 6, 6]  # slots 0 and 2 retire first
+    oracle = [
+        _oracle(params, cfg, p, n) for p, n in zip(prompts, budgets)
+    ]
+    eng = ServingEngine(params, cfg, max_slots=4)
+    hs = [eng.submit(p, n) for p, n in zip(prompts[:4], budgets[:4])]
+    eng.step()   # admit 4, decode once (short ones hit budget 2 here)
+    assert hs[0].done and hs[2].done
+    assert not hs[1].done and not hs[3].done
+    hs.append(eng.submit(prompts[4], budgets[4]))
+    hs.append(eng.submit(prompts[5], budgets[5]))
+    eng.step()   # both land in the holes at slots 0 and 2
+    assert eng._slot_req[0] is hs[4] and eng._slot_req[2] is hs[5]
+    assert eng._slot_req[1] is hs[1] and eng._slot_req[3] is hs[3]
+    eng.run()
+    for h, want in zip(hs, oracle):
+        np.testing.assert_array_equal(_full(h), want)
+
+
+def test_submit_validation_and_handle_result():
+    cfg, params = _mk(7)
+    eng = ServingEngine(params, cfg, max_slots=2)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(np.zeros(10, np.int32), cfg.max_len)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit(np.zeros(0, np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(np.zeros(4, np.int32), 0)
+    rng = np.random.RandomState(7)
+    p = rng.randint(0, cfg.vocab, (5,)).astype(np.int32)
+    h = eng.submit(p, 6)
+    out = h.result()  # drives the engine itself
+    np.testing.assert_array_equal(out, _oracle(params, cfg, p, 6))
+
+
+def test_sampled_requests_deterministic_and_slot_independent():
+    """temperature>0 uses a per-request fold_in(key, token_index)
+    schedule: the same (prompt, seed) reproduces the same tokens no
+    matter the slot count or what shares the batch."""
+    cfg, params = _mk(8)
+    rng = np.random.RandomState(8)
+    p = rng.randint(0, cfg.vocab, (6,)).astype(np.int32)
+
+    eng = ServingEngine(params, cfg, max_slots=1)
+    h1 = eng.submit(p, 8, temperature=0.7, seed=13)
+    eng.run()
+
+    eng2 = ServingEngine(params, cfg, max_slots=4)
+    others = [
+        eng2.submit(rng.randint(0, cfg.vocab, (4,)), 8) for _ in range(3)
+    ]
+    h2 = eng2.submit(p, 8, temperature=0.7, seed=13)
+    eng2.run()
+    assert h1.tokens == h2.tokens
+    assert all(o.done for o in others)
+    assert all(0 <= t < cfg.vocab for t in h1.tokens)
+
+
+def test_metrics_report_and_profiler_table(capsys):
+    cfg, params = _mk(9)
+    rng = np.random.RandomState(9)
+    eng = ServingEngine(params, cfg, max_slots=2)
+    for t in (4, 9, 5, 12):
+        eng.submit(rng.randint(0, cfg.vocab, (t,)).astype(np.int32), 5)
+    eng.run()
+    rep = eng.metrics.report()
+    assert rep["tokens_out"] == 4 * 5
+    assert rep["prefills"] == 4
+    assert 0.0 < rep["mean_occupancy"] <= 1.0
+    assert rep["decode_traces"] == 1
+    assert rep["tokens_per_sec"] > 0
+    assert rep["mean_ttft_s"] >= rep["mean_queue_wait_s"] >= 0.0
+    # profiler-style table: prefill buckets + decode rows, ms columns
+    rows = {r["Event"]: r for r in eng.metrics.table("total")}
+    assert "decode_step" in rows
+    assert any(e.startswith("prefill_T") for e in rows)
+    assert rows["decode_step"]["Calls"] == rep["decode_steps"]
+    eng.metrics.print_report()
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out and "decode_step" in out
+
+
+def test_slot_decode_step_vector_pos_matches_scalar_rows():
+    """The slotted per-row pos path of decode_step is bit-identical,
+    row by row, to the scalar-pos path generate() uses."""
+    cfg, params = _mk(10)
+    rng = np.random.RandomState(10)
+    seqs = [rng.randint(0, cfg.vocab, (t,)) for t in (5, 9)]
+    caches, toks, poss, want = [], [], [], []
+    for s in seqs:
+        _, cache = T.prefill(params, jnp.asarray(s[:-1])[None], cfg)
+        lg, c2 = T.decode_step(
+            params, jnp.asarray(s[-1:]), len(s) - 1, cache, cfg
+        )
+        caches.append(cache)
+        want.append((np.asarray(lg)[0], c2))
+        toks.append(s[-1])
+        poss.append(len(s) - 1)
+    # stack the two independent rows into one slotted batch
+    batched = [
+        {
+            "k": jnp.concatenate([a["k"], b["k"]]),
+            "v": jnp.concatenate([a["v"], b["v"]]),
+        }
+        for a, b in zip(*caches)
+    ]
+    lg, new_cache = T.decode_step(
+        params,
+        jnp.asarray(np.asarray(toks, np.int32)),
+        jnp.asarray(np.asarray(poss, np.int32)),
+        batched,
+        cfg,
+    )
+    lg = np.asarray(lg)
+    for row in range(2):
+        np.testing.assert_array_equal(lg[row], want[row][0])
+        for li in range(cfg.layers):
+            np.testing.assert_array_equal(
+                np.asarray(new_cache[li]["k"][row]),
+                np.asarray(want[row][1][li]["k"][0]),
+            )
