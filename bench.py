@@ -34,6 +34,12 @@ definitions):
               (paddle_tpu/serving): aggregate tok/s + mean slot
               occupancy + compile counts under a fixed-seed Poisson
               arrival trace; beyond-reference, no 2018 baseline
+  input_pipeline — host-side loader overlap (paddle_tpu/data):
+              RecordShard shards -> ShardedDataset -> DataLoader on a
+              fixed-seed synthetic trace, prefetch OFF (synchronous
+              baseline) vs ON (decode threads + bounded queue);
+              reports batches/s and the loader-wait fraction. Pure
+              host work — fully offline-measurable (ISSUE 3)
 
 Timing: per-step cost is measured by differencing two multi-step
 `run_repeated` calls ((T(hi)-T(lo))/(hi-lo)), which cancels the
@@ -988,6 +994,111 @@ def bench_serving_decode(max_slots=None, n_requests=None):
     }
 
 
+def bench_input_pipeline(n_shards=4, chunks_per_shard=8,
+                         records_per_chunk=64, batch=64, step_s=0.004,
+                         decode_sleep_s=0.0001, num_workers=2,
+                         prefetch_batches=4):
+    """Host-side input pipeline (paddle_tpu/data): the SAME fixed-seed
+    synthetic shards + consumer, measured twice — prefetch OFF
+    (num_workers=0: chunk decode runs synchronously inside next(), the
+    pre-ISSUE-3 one-record-at-a-time posture) vs prefetch ON (decode
+    threads + bounded queue overlap decode under the consumer's
+    simulated step). The columns that matter are `wait_fraction` (share
+    of consumer time blocked on input — the accelerator-idle fraction
+    an input-bound job would see) and batches/s; both are pure host
+    work, so the row is fully offline-measurable and deterministic in
+    WHAT it delivers (the per-record checksum must match between runs —
+    prefetch must never change what the model sees).
+
+    `decode_sleep_s` adds a fixed GIL-RELEASING per-record decode cost
+    on top of the small numpy work — the stand-in for real decodes
+    (JPEG, decompression, tokenization in C) which release the GIL and
+    therefore actually parallelize across the loader's threads. A
+    decode that is pure small-ndarray Python stays GIL-bound and gains
+    little from threads (CPython); the knob keeps the measured overlap
+    about the pipeline, not about the GIL."""
+    import pickle
+    import tempfile
+
+    from paddle_tpu.data import DataLoader, ShardedDataset, ShardWriter
+
+    dim = 1024
+    root = os.environ.get("BENCH_DATA_DIR") or tempfile.gettempdir()
+    sdir = os.path.join(
+        root, "bench_input_pipeline_%dx%dx%dx%d"
+        % (n_shards, chunks_per_shard, records_per_chunk, dim))
+    os.makedirs(sdir, exist_ok=True)
+    paths = []
+    for s in range(n_shards):
+        p = os.path.join(sdir, "shard_%03d.rs" % s)
+        paths.append(p)
+        if os.path.exists(p):
+            continue
+        # per-shard RNG stream: skipping cached shards must not shift
+        # the draws of the ones still to be written (a partially
+        # populated cache dir would otherwise silently produce a
+        # different "fixed-seed" trace than a fresh run)
+        rng = np.random.RandomState(7 * 1000003 + s)
+        rid = s * chunks_per_shard * records_per_chunk
+        with ShardWriter(p, records_per_chunk=records_per_chunk) as w:
+            for _ in range(chunks_per_shard * records_per_chunk):
+                vec = rng.rand(dim).astype(np.float32)
+                w.write(struct.pack("<I", rid) + vec.tobytes())
+                rid += 1
+
+    def decode(rec):
+        (r,) = struct.unpack_from("<I", rec)
+        vec = np.frombuffer(rec[4:], np.float32).astype(np.float64)
+        vec = (vec - vec.mean()) / (vec.std() + 1e-6)  # host normalise
+        if decode_sleep_s:
+            time.sleep(decode_sleep_s)
+        return r, vec.astype(np.float32)
+
+    def run(workers, prefetch):
+        import zlib
+
+        ds = ShardedDataset(paths, decode_fn=decode, seed=7)
+        dl = DataLoader(ds, batch, num_workers=workers,
+                        prefetch_batches=prefetch)
+        # ORDER-SENSITIVE digest (crc chained over ids in delivery
+        # order): reordered batches or records must change it, or the
+        # "prefetch never changes what the model sees" assert could not
+        # catch a broken reassembly
+        checksum = 0
+        try:
+            for ids, _vecs in dl:
+                checksum = zlib.crc32(
+                    np.ascontiguousarray(ids, np.int64).tobytes(),
+                    checksum)
+                time.sleep(step_s)  # the consumer's simulated step
+        finally:
+            dl.close()
+        rep = dl.metrics.report()
+        rep["checksum"] = checksum
+        return rep
+
+    off = run(0, 1)
+    on = run(num_workers, prefetch_batches)
+    assert on["checksum"] == off["checksum"], \
+        "prefetch changed the delivered record stream"
+    rec = {
+        "prefetch_off": off,
+        "prefetch_on": on,
+        "wait_fraction_off": off["wait_fraction"],
+        "wait_fraction_on": on["wait_fraction"],
+        "batches_per_sec_off": off["batches_per_sec"],
+        "batches_per_sec_on": on["batches_per_sec"],
+        "overlap_speedup": round(off["wall_s"] / on["wall_s"], 3)
+        if on["wall_s"] else None,
+        "records": n_shards * chunks_per_shard * records_per_chunk,
+        "batch": batch,
+        "num_workers": num_workers,
+        "prefetch_batches": prefetch_batches,
+        "trace": "fixed-seed(7) synthetic shards, step_s=%g" % step_s,
+    }
+    return rec
+
+
 def bench_flash_attention(B=4, T=4096, H=16, D=64, steps=(4, 16)):
     """Pallas flash attention vs XLA full-matrix attention, single chip,
     bf16, causal (parallel/flash_attention.py). Timing puts the
@@ -1367,6 +1478,9 @@ def main():
     # end-to-end input pipeline (recordio -> host decode -> h2d -> train):
     # on this harness it measures the tunnel, reported for honesty
     if not quick:
+        # the pure-host loader-overlap row first (paddle_tpu/data): no
+        # device work at all, so it is meaningful on every backend
+        run("input_pipeline", bench_input_pipeline)
         run("resnet50_input_pipeline",
             lambda: bench_resnet50_recordio(batch, chunk_steps, n_chunks))
 

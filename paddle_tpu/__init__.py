@@ -8,6 +8,9 @@ Layout:
   parallel/  device mesh, data/tensor parallel training over ICI (pjit)
   serving/   continuous-batching inference engine (slotted KV cache,
              bucketed prefill, one compiled decode step)
+  data/      input pipeline: chunked CRC-checked shards, prefetching
+             DataLoader with exact mid-epoch resume, coordinator-leased
+             elastic sharding
   models/    reference model zoo (LeNet, ResNet, VGG, RNNs, ...)
   reader/    composable data readers (v2 reader decorator parity)
   ops/       pallas kernels for ops XLA cannot express well

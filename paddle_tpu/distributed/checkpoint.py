@@ -96,7 +96,7 @@ def _index_to_json(index, shape):
 
 
 def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None,
-                    keep_last: int = 1):
+                    keep_last: int = 1, stateful: dict = None):
     """Write every scope entry (params + optimizer state + BN stats) under
     `dirname/step_<N>/`. Safe against interruption: data files land first,
     then the meta file commits the checkpoint with one atomic rename — and
@@ -104,7 +104,19 @@ def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None,
     touches the last committed step (Go pserver keeps its last good
     checkpoint the same way, service.go:346). Older steps are pruned only
     after the new step's metas are complete. Sharded arrays: this process
-    saves only its owned (replica-0) shards."""
+    saves only its owned (replica-0) shards.
+
+    `stateful` maps names to objects with a JSON-serializable
+    `state_dict()` (a data.DataLoader cursor, an LR schedule, ...);
+    their states commit atomically with the tensors and are restored by
+    load_checkpoint/resume_or_init(stateful=...) — so a supervisor
+    restart resumes the input pipeline at the exact record the model
+    state was checkpointed at."""
+    extra = dict(extra or {})
+    if stateful:
+        extra["stateful"] = {
+            name: obj.state_dict() for name, obj in stateful.items()
+        }
     root = dirname
     dirname = _step_dir(dirname, step)
     os.makedirs(dirname, exist_ok=True)
@@ -169,7 +181,7 @@ def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None,
         "process": pidx,
         "process_count": jax.process_count(),
         "entries": entries,
-        "extra": extra or {},
+        "extra": extra,
     }
     tmp = os.path.join(dirname, _meta_name() + ".tmp")
     with open(tmp, "w") as f:
@@ -210,7 +222,8 @@ def retain(dirname: str, keep_last: int = 1):
     return [s for s, _ in _list_step_dirs(dirname)]
 
 
-def resume_or_init(scope, dirname: str, init_fn=None, strict: bool = True):
+def resume_or_init(scope, dirname: str, init_fn=None, strict: bool = True,
+                   stateful: dict = None):
     """One-call crash-recovery glue for supervised workers: restore the
     latest complete checkpoint under `dirname` into `scope` and return
     its merged meta, or — when nothing is committed yet (first launch, or
@@ -219,9 +232,14 @@ def resume_or_init(scope, dirname: str, init_fn=None, strict: bool = True):
 
         meta = resume_or_init(scope, ckpt_dir, init_fn=run_startup)
         start = meta["step"] + 1 if meta else 0
+
+    `stateful` objects (see save_checkpoint) get `load_state_dict()`
+    called with their checkpointed state on the restore path; on the
+    init path they are left at their constructed state.
     """
     if dirname and latest_step(dirname) is not None:
-        return load_checkpoint(scope, dirname, strict=strict)
+        return load_checkpoint(scope, dirname, strict=strict,
+                               stateful=stateful)
     if init_fn is not None:
         init_fn()
     return None
@@ -316,7 +334,8 @@ def _load_entry(dirname: str, name: str, ent: dict, strict: bool):
     return arr
 
 
-def load_checkpoint(scope, dirname: str, strict: bool = True) -> dict:
+def load_checkpoint(scope, dirname: str, strict: bool = True,
+                    stateful: dict = None) -> dict:
     """Restore a checkpoint into `scope`, verifying every CRC (reference
     LoadCheckpoint rejects corrupt shards).
 
@@ -388,6 +407,15 @@ def load_checkpoint(scope, dirname: str, strict: bool = True) -> dict:
         if val is not None:
             scope.set(name, val)
             merged["entries"][name] = ent
+    if stateful:
+        states = merged["extra"].get("stateful") or {}
+        for name, obj in stateful.items():
+            if name in states:
+                obj.load_state_dict(states[name])
+            elif strict:
+                raise KeyError(
+                    "stateful object %r has no state in the checkpoint "
+                    "under %s" % (name, dirname))
     return merged
 
 
@@ -462,17 +490,28 @@ class AsyncCheckpoint(object):
 
 def save_checkpoint_async(scope, dirname: str, step: int = 0,
                           extra: dict = None,
-                          keep_last: int = 1) -> AsyncCheckpoint:
+                          keep_last: int = 1,
+                          stateful: dict = None) -> AsyncCheckpoint:
     """Snapshot the scope to host memory NOW (so later training steps —
     including donated-buffer updates — cannot touch the saved values),
     then run the normal atomic save on a background thread. Returns an
     AsyncCheckpoint; call result() before relying on the checkpoint.
+
+    `stateful` objects have their state_dict() taken NOW too, so a
+    loader that keeps delivering batches while the writer runs cannot
+    leak post-snapshot positions into the checkpoint.
 
     Process-spanning (multi-host) arrays need cross-process save
     coordination, so they fall back to a synchronous save_checkpoint —
     the handle is already done when returned.
     """
     import threading
+
+    extra = dict(extra or {})
+    if stateful:
+        extra["stateful"] = {
+            name: obj.state_dict() for name, obj in stateful.items()
+        }
 
     # multi-host fallback decided BEFORE any device->host pulls
     if any(

@@ -41,6 +41,18 @@ class Task:
     payload: Any  # JSON-serializable shard description
     epoch: int = 0
     failures: int = 0
+    # records of this task already DELIVERED (and durably absorbed) by a
+    # previous lease holder: a re-leased task resumes here instead of
+    # replaying the whole chunk (offset-aware leases, ISSUE 3). Reported
+    # via task_progress/task_failed; reset at epoch rollover.
+    offset: int = 0
+    # lease generation: bumped every time the task is handed out. Holder
+    # calls (progress/finished/failed) that present a stale generation
+    # are refused — after an expiry + re-lease, the ORIGINAL holder can
+    # no longer ack, renew, or fail the new holder's lease (the
+    # fencing-token pattern; without it "held" answers by task_id alone
+    # and a zombie holder silently keeps a lost lease alive).
+    lease: int = 0
     deadline: float = field(default=0.0, compare=False)
 
     def to_json(self):
@@ -49,13 +61,16 @@ class Task:
             "payload": self.payload,
             "epoch": self.epoch,
             "failures": self.failures,
+            "offset": self.offset,
+            "lease": self.lease,
         }
 
     @staticmethod
     def from_json(d):
         return Task(
             task_id=d["task_id"], payload=d["payload"], epoch=d["epoch"],
-            failures=d["failures"],
+            failures=d["failures"], offset=d.get("offset", 0),
+            lease=d.get("lease", 0),
         )
 
 
@@ -105,7 +120,10 @@ class Coordinator(object):
         (pass end — the reference signals it with ErrPassAfter). Reclaims
         expired leases first (reference checkTimeoutFunc). Rollover into
         the next pass happens only when `epoch_limit` allows it, so bare
-        `while get_task()` drain loops always terminate."""
+        `while get_task()` drain loops always terminate — and a caller's
+        `epoch_limit` also caps what it can POP: a worker still draining
+        pass e must not be handed tasks a faster peer already rolled to
+        pass e+1 (epoch_limit=None places no cap)."""
         with self._lock:
             reclaimed = self._reclaim_expired()
             if not self.todo:
@@ -119,31 +137,83 @@ class Coordinator(object):
                     if reclaimed:
                         self._snapshot()
                     return None
+            if epoch_limit is not None and self.todo[0].epoch > epoch_limit:
+                # a peer rolled the queue into a later pass than this
+                # caller is on: for THIS caller the current pass is over
+                if reclaimed:
+                    self._snapshot()
+                return None
             task = self.todo.pop(0)
             task.deadline = time.time() + self.timeout_s
+            task.lease += 1  # fence out the previous holder, if any
             self.pending[task.task_id] = task
             self._snapshot()
             return task
 
-    def task_finished(self, task_id: int):
+    def task_finished(self, task_id: int, lease: Optional[int] = None):
+        """Mark a lease done. A stale `lease` generation (expired +
+        re-leased to someone else) is refused: the new holder still owns
+        the task. lease=None skips the fence (single-holder callers)."""
         with self._lock:
-            task = self.pending.pop(task_id, None)
-            if task is not None:
-                self.done.append(task)
-                self._snapshot()
-
-    def task_failed(self, task_id: int):
-        """Failure count + requeue or discard (reference processFailedTask)."""
-        with self._lock:
-            task = self.pending.pop(task_id, None)
+            task = self.pending.get(task_id)
             if task is None:
                 return
+            if lease is not None and task.lease != lease:
+                return  # zombie holder: the task moved on without it
+            del self.pending[task_id]
+            self.done.append(task)
+            self._snapshot()
+
+    def task_failed(self, task_id: int, offset: Optional[int] = None,
+                    lease: Optional[int] = None):
+        """Failure count + requeue or discard (reference
+        processFailedTask). `offset` records how many of the task's
+        records the failing holder already delivered durably — the next
+        lease resumes there instead of replaying them. A stale `lease`
+        is a no-op (a zombie holder must not fail — or move the offset
+        of — the lease the task was re-issued under)."""
+        with self._lock:
+            task = self.pending.get(task_id)
+            if task is None:
+                return
+            if lease is not None and task.lease != lease:
+                return
+            del self.pending[task_id]
+            if offset is not None:
+                task.offset = max(task.offset, int(offset))
             task.failures += 1
             if task.failures >= self.failure_max:
                 self.discarded.append(task)
             else:
                 self.todo.append(task)
             self._snapshot()
+
+    def task_progress(self, task_id: int, offset: int,
+                      lease: Optional[int] = None) -> dict:
+        """Record durable delivery progress on a HELD lease (and renew
+        its deadline — progress is also a keepalive). A lease that
+        expires later requeues with this offset, so the next holder
+        never re-delivers committed records. Returns {"held": False}
+        when the lease is no longer pending — or is pending under a
+        NEWER lease generation than the caller presents (expired and
+        re-leased: the caller is a zombie) — and the caller must stop
+        delivering from it; the committed offset travels with the
+        requeued task instead."""
+        with self._lock:
+            task = self.pending.get(task_id)
+            if task is None:
+                return {"held": False}
+            if lease is not None and task.lease != lease:
+                return {"held": False}
+            changed = int(offset) > task.offset
+            task.offset = max(task.offset, int(offset))
+            task.deadline = time.time() + self.timeout_s
+            if changed:
+                # deadline renewal alone is not persisted (deadlines do
+                # not survive recovery anyway): pure keepalives must not
+                # rewrite a byte-identical snapshot every poll
+                self._snapshot()
+            return {"held": True, "offset": task.offset}
 
     # --- worker liveness (elastic supervisor protocol) ---------------
     def _new_worker_record(self, now: float, incarnation: int = 1,
@@ -222,6 +292,7 @@ class Coordinator(object):
         for t in rollover:
             t.epoch = self.epoch
             t.failures = 0
+            t.offset = 0  # a new pass delivers every record again
         self.todo = rollover
         self.done = []
         self.discarded = []
@@ -268,7 +339,8 @@ class CoordinatorServer(object):
     """
 
     _METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
-                "ping", "register_worker", "heartbeat", "membership")
+                "task_progress", "ping", "register_worker", "heartbeat",
+                "membership")
 
     def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
                  port: int = 0):
@@ -320,8 +392,13 @@ class CoordinatorServer(object):
             return {"ok": True,
                     "result": task.to_json() if task else None}
         if method == "task_finished":
-            self.coordinator.task_finished(int(params["task_id"]))
+            self.coordinator.task_finished(int(params["task_id"]),
+                                           lease=params.get("lease"))
             return {"ok": True, "result": None}
+        if method == "task_progress":
+            return {"ok": True, "result": self.coordinator.task_progress(
+                int(params["task_id"]), int(params["offset"]),
+                lease=params.get("lease"))}
         if method == "register_worker":
             return {"ok": True, "result": self.coordinator.register_worker(
                 str(params["worker_id"]), meta=params.get("meta"))}
@@ -330,7 +407,11 @@ class CoordinatorServer(object):
                 str(params["worker_id"]), step=params.get("step"))}
         if method == "membership":
             return {"ok": True, "result": self.coordinator.membership()}
-        self.coordinator.task_failed(int(params["task_id"]))
+        self.coordinator.task_failed(
+            int(params["task_id"]),
+            offset=params.get("offset"),
+            lease=params.get("lease"),
+        )
         return {"ok": True, "result": None}
 
     def start(self):
@@ -456,11 +537,18 @@ class RemoteCoordinator(object):
         d = self._call("get_task", epoch_limit=epoch_limit)
         return Task.from_json(d) if d is not None else None
 
-    def task_finished(self, task_id: int):
-        return self._call("task_finished", task_id=task_id)
+    def task_finished(self, task_id: int, lease: Optional[int] = None):
+        return self._call("task_finished", task_id=task_id, lease=lease)
 
-    def task_failed(self, task_id: int):
-        return self._call("task_failed", task_id=task_id)
+    def task_failed(self, task_id: int, offset: Optional[int] = None,
+                    lease: Optional[int] = None):
+        return self._call("task_failed", task_id=task_id, offset=offset,
+                          lease=lease)
+
+    def task_progress(self, task_id: int, offset: int,
+                      lease: Optional[int] = None):
+        return self._call("task_progress", task_id=task_id, offset=offset,
+                          lease=lease)
 
     def register_worker(self, worker_id: str, meta: Optional[dict] = None):
         return self._call("register_worker", worker_id=worker_id, meta=meta)
@@ -492,26 +580,40 @@ class MasterClient(object):
 
     `record_fn(payload)` maps a task payload to an iterable of records;
     records stream out while the lease is held, and the task is marked
-    finished (or failed, on exception) automatically."""
+    finished (or failed, on exception) automatically. A failure reports
+    the per-task record offset (with the lease's fencing token), so a
+    re-leased task skips the records already yielded instead of
+    replaying them (offset-aware leases). `epoch_limit` permits epoch
+    rollover up to that pass number (None: this pass only)."""
 
-    def __init__(self, coordinator: Coordinator, record_fn):
+    def __init__(self, coordinator: Coordinator, record_fn,
+                 epoch_limit: Optional[int] = None):
         self.coordinator = coordinator
         self.record_fn = record_fn
+        self.epoch_limit = epoch_limit
 
     def __iter__(self):
         # one full pass over the dataset: no rollover into the next epoch
         # (the training loop drives passes; reference client.go pass_end)
         while True:
-            task = self.coordinator.get_task()
+            task = self.coordinator.get_task(epoch_limit=self.epoch_limit)
             if task is None:
                 return
+            skip = getattr(task, "offset", 0)
+            lease = getattr(task, "lease", None)
+            delivered = 0
             try:
-                for rec in self.record_fn(task.payload):
+                for i, rec in enumerate(self.record_fn(task.payload)):
+                    if i < skip:
+                        continue  # delivered by a previous lease holder
                     yield rec
+                    delivered += 1
             except Exception:
-                self.coordinator.task_failed(task.task_id)
+                self.coordinator.task_failed(task.task_id,
+                                             offset=skip + delivered,
+                                             lease=lease)
                 continue
-            self.coordinator.task_finished(task.task_id)
+            self.coordinator.task_finished(task.task_id, lease=lease)
 
     def reader(self):
         """As a v2-style reader creator."""

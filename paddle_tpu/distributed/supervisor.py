@@ -49,6 +49,22 @@ __all__ = ["Supervisor", "WorkerHandle"]
 _FAULT_ENV = "PADDLE_FAULT"
 
 
+class _BlindSpawn(object):
+    """Sentinel for WorkerHandle.spawn_incarnation: the process was
+    spawned while the membership view was blind (partition / bouncing
+    coordinator), so NO baseline snapshot could be taken. It is replaced
+    by a real snapshot on the first sweep with a visible view — without
+    it, `spawn_incarnation=None` would let the dead predecessor's
+    expired record (any incarnation != None) condemn the healthy new
+    process the moment the partition heals."""
+
+    def __repr__(self):
+        return "<blind-spawn>"
+
+
+_BLIND_SPAWN = _BlindSpawn()
+
+
 class WorkerHandle(object):
     """Supervisor-side state for one logical worker id across all of its
     incarnations (process restarts)."""
@@ -172,9 +188,15 @@ class Supervisor(object):
         env["PADDLE_RESTART_COUNT"] = str(h.restarts)
         # snapshot whatever membership record is ALREADY there (the dead
         # predecessor's, usually): only a record with a different
-        # incarnation can vouch for — or condemn — the new process
-        m = (membership or {}).get(h.worker_id)
-        h.spawn_incarnation = m["incarnation"] if m else None
+        # incarnation can vouch for — or condemn — the new process. A
+        # BLIND spawn (no view at all) defers the snapshot to the first
+        # visible sweep via the sentinel — an empty view is a real
+        # "no record" snapshot, a None view is not.
+        if membership is None:
+            h.spawn_incarnation = _BLIND_SPAWN
+        else:
+            m = membership.get(h.worker_id)
+            h.spawn_incarnation = m["incarnation"] if m else None
         h.proc = subprocess.Popen(self.argv_for(h.worker_id), env=env)
         h.spawned_at = time.time()
         self._event("spawn", h.worker_id, pid=h.proc.pid,
@@ -246,6 +268,17 @@ class Supervisor(object):
     def _check_hang(self, h: WorkerHandle, membership):
         m = membership.get(h.worker_id)
         now = time.time()
+        if h.spawn_incarnation is _BLIND_SPAWN:
+            # first visible sweep after a blind spawn: take the baseline
+            # snapshot _spawn could not. Whatever record is here now is
+            # treated as predating this process (the dead predecessor's,
+            # usually) — only a LATER registration can vouch for or
+            # condemn it. Never kill on the sweep the view healed; if
+            # the record is actually this process's own registration,
+            # hang detection degrades to the spawn-grace path, which is
+            # safe (conservative) rather than lethal.
+            h.spawn_incarnation = m["incarnation"] if m else None
+            return False
         if m is not None and m.get("incarnation") != h.spawn_incarnation:
             # the registry holds a record NEWER than whatever was there
             # when this process spawned, so THIS incarnation registered

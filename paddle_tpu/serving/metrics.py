@@ -19,32 +19,13 @@ from typing import Dict
 __all__ = ["ServingMetrics"]
 
 
-class _RunningStat(object):
-    """O(1) mean/max accumulator. A long-lived engine records one value
-    per decode step / per request forever — growing a Python float list
-    without bound is the same trap the executor's CompileCache closes
-    for compiled entries, so aggregates are running sums, not history."""
-
-    __slots__ = ("count", "total", "max")
-
-    def __init__(self):
-        self.count = 0
-        self.total = 0.0
-        self.max = None
-
-    def append(self, x):
-        x = float(x)
-        self.count += 1
-        self.total += x
-        if self.max is None or x > self.max:
-            self.max = x
-
-    @property
-    def mean(self):
-        return self.total / self.count if self.count else None
-
-    def __len__(self):
-        return self.count
+# A long-lived engine records one value per decode step / per request
+# forever — growing a Python float list without bound is the same trap
+# the executor's CompileCache closes for compiled entries, so aggregates
+# are running sums, not history. The accumulator lives in utils.stat
+# (shared with data.DataMetrics); the underscore alias is the
+# backward-compatible name.
+from ..utils.stat import RunningStat as _RunningStat
 
 
 class ServingMetrics(object):

@@ -38,19 +38,19 @@ class client(object):
         self._coordinator.set_dataset(list(paths))
 
     def _records(self):
+        # the offset-aware lease loop (skip records a previous holder
+        # already delivered, report the offset + fencing token on
+        # failure) lives in ONE place: MasterClient. task_failed used to
+        # re-lease the WHOLE chunk, replaying every record delivered
+        # before the error.
         from ..reader import creator
+        from ...distributed.coordinator import MasterClient
 
-        while True:
-            task = self._coordinator.get_task(epoch_limit=self._pass)
-            if task is None:
-                return
-            try:
-                for rec in creator.recordio([task.payload])():
-                    yield rec
-            except Exception:
-                self._coordinator.task_failed(task.task_id)
-                continue
-            self._coordinator.task_finished(task.task_id)
+        return iter(MasterClient(
+            self._coordinator,
+            lambda payload: creator.recordio([payload])(),
+            epoch_limit=self._pass,
+        ))
 
     def next_record(self) -> Optional[bytes]:
         """One raw record, None at pass end (reference returns (r, err));

@@ -51,6 +51,27 @@ def pickled_records(paths, buf_size=100):
     return reader
 
 
+def record_shard(paths, decode_fn=None):
+    """Raw-bytes (or decoded) reader over RecordShard chunked shards
+    (paddle_tpu.data.record_shard) — the v2-reader face of the input-
+    pipeline subsystem's storage format; for prefetching/sharding use
+    `paddle_tpu.data.DataLoader` directly."""
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        from ...data.record_shard import RecordShard
+
+        for p in paths:
+            for rec in RecordShard(p).iter_records():
+                yield decode_fn(rec) if decode_fn is not None else rec
+
+    return reader
+
+
+__all__.append("record_shard")
+
+
 def cloud_reader(paths, etcd_endpoints=None, timeout_sec=5, buf_size=64):
     """Records dispatched through the master/coordinator task queue
     (reference creator.py cloud_reader over the Go master + etcd; the

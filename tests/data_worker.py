@@ -1,0 +1,134 @@
+"""Supervised input-pipeline drill worker (driven by
+tests/test_data_drill.py).
+
+One logical job: N of these workers drain ONE coordinator chunk queue
+through `paddle_tpu.data.DataLoader` (CoordinatedChunkSource), recording
+every delivered record id. The job-level deliverable is the MULTISET of
+record ids across all workers' histories: it must equal the dataset
+exactly — every record once, no loss, no duplicates — no matter which
+worker was killed when (the acceptance bar of ISSUE 3).
+
+Protocol per batch (the fault injector ticks at the batch boundary, so
+kill@N lands between batches, where resume must be exact):
+
+    tick -> heartbeat -> next(loader) -> accumulate history ->
+    checkpoint (atomic; loader cursor rides in `stateful`, history in
+    `extra`) -> loader.commit()  (acks/progress flushed AFTER the
+    checkpoint commits, the supervisor_worker pending_ack discipline)
+
+On restart, `resume_or_init(..., stateful={"loader": loader})` restores
+the exact cursor; the first commit() re-flushes any acks the crash cut
+off. Lease timeouts are sized above the supervisor restart latency and
+the loader's idle grace above the lease timeout, so a killed worker's
+in-flight chunk is either reclaimed by its own resume or requeued to a
+peer at the committed offset.
+
+Usage: data_worker.py OUT_JSON CKPT_DIR COORD_ADDR SHARD_DIR
+Env:   PADDLE_WORKER_ID   logical id (set by the Supervisor)
+       PADDLE_FAULT       injected faults, e.g. kill@N (stripped on
+                          restart by the Supervisor)
+       DATA_BATCH         batch size (default 6)
+       DATA_SEED          dataset shuffle seed (default 11)
+       DATA_IDLE_GRACE_S  loader idle grace (default 8; must exceed the
+                          coordinator lease timeout)
+       DATA_STEP_SLEEP    extra seconds per batch (paces the drain so
+                          kill@N lands mid-epoch)
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.data import CoordinatedChunkSource, DataLoader, ShardedDataset
+from paddle_tpu.distributed import (
+    RemoteCoordinator,
+    checkpoint as ckpt,
+    fault_injection as fi,
+)
+
+import pickle
+
+
+def main():
+    out_path, ckpt_dir, addr, shard_dir = sys.argv[1:5]
+    wid = os.environ.get("PADDLE_WORKER_ID", "w?")
+    batch = int(os.environ.get("DATA_BATCH", "6"))
+    seed = int(os.environ.get("DATA_SEED", "11"))
+    idle_grace = float(os.environ.get("DATA_IDLE_GRACE_S", "8.0"))
+    step_sleep = float(os.environ.get("DATA_STEP_SLEEP", "0.02"))
+
+    shard_paths = sorted(glob.glob(os.path.join(shard_dir, "*.rs")))
+    dataset = ShardedDataset(shard_paths, decode_fn=pickle.loads, seed=seed)
+
+    client = RemoteCoordinator(addr, retry_deadline_s=20.0,
+                               backoff_base_s=0.05)
+    client.register_worker(wid)
+    injector = fi.default_injector()
+
+    loader = DataLoader(
+        dataset, batch_size=batch,
+        source=CoordinatedChunkSource(client, idle_grace_s=idle_grace,
+                                      poll_s=0.1),
+        num_workers=2, auto_commit=False)
+
+    scope = fluid.Scope()
+    meta = ckpt.resume_or_init(scope, ckpt_dir,
+                               stateful={"loader": loader})
+    if meta is not None:
+        resumed_from = int(meta["extra"]["step"])
+        step = resumed_from
+        history = list(meta["extra"]["history"])
+        loader.commit()  # re-flush acks the crash may have cut off
+    else:
+        resumed_from = None
+        step = 0
+        history = []
+        scope.set("acc", np.zeros((1,), np.float64))
+
+    for ids, _vals in loader:
+        injector.tick()
+        client.heartbeat(wid, step=step)
+        if step_sleep:
+            time.sleep(step_sleep)
+        history.extend(int(i) for i in ids.tolist())
+        step += 1
+        scope.set("acc", np.asarray(scope.get("acc"), np.float64)
+                  + float(np.sum(ids)))
+        ckpt.save_checkpoint(
+            scope, ckpt_dir, step=step,
+            extra={"step": step, "history": history, "worker": wid},
+            stateful={"loader": loader}, keep_last=2)
+        loader.commit()
+    # trailing completion acks (chunks whose records all rode earlier,
+    # already-checkpointed batches) surface at epoch end — flush them
+    loader.commit()
+    client.heartbeat(wid, step=step)
+    loader.close()
+    client.close()
+
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({
+            "worker": wid,
+            "resumed_from": resumed_from,
+            "steps_done": step,
+            "history": history,
+            "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
+                                                "0")),
+        }, f)
+    os.replace(tmp, out_path)
+
+
+if __name__ == "__main__":
+    main()

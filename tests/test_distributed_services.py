@@ -117,6 +117,100 @@ def test_master_client_streams_and_retries():
     assert crashed == [2]
 
 
+def test_offset_aware_releases_skip_delivered_records():
+    """ISSUE 3 satellite: a failed/expired lease requeues WITH the
+    holder's committed record offset, and the next lease carries it —
+    re-leased chunks never replay delivered records."""
+    c = Coordinator(timeout_s=0.1, failure_max=10)
+    c.set_dataset(["s0"])
+    t = c.get_task()
+    assert t.offset == 0
+    # the holder reports durable progress; progress doubles as keepalive
+    r = c.task_progress(t.task_id, 7)
+    assert r == {"held": True, "offset": 7}
+    # offsets never move backwards (a stale report cannot rewind)
+    assert c.task_progress(t.task_id, 3)["offset"] == 7
+    time.sleep(0.15)  # lease expires: holder presumed dead
+    t2 = c.get_task()
+    assert t2.task_id == t.task_id and t2.offset == 7
+    # progress on the OLD (expired, re-leased-elsewhere) lease would be
+    # indistinguishable here (same id); after finishing, it's refused
+    c.task_finished(t2.task_id)
+    assert c.task_progress(t2.task_id, 9) == {"held": False}
+    # explicit failure also carries the offset forward
+    nxt = c.get_task(epoch_limit=1)
+    assert nxt.epoch == 1 and nxt.offset == 0  # rollover resets offsets
+    c.task_failed(nxt.task_id, offset=4)
+    again = c.get_task(epoch_limit=1)
+    assert again.offset == 4 and again.failures == 1
+
+
+def test_lease_generation_fences_zombie_holder():
+    """Fencing tokens: after expiry + re-lease, the ORIGINAL holder's
+    progress/finished/failed calls (same task_id, stale lease
+    generation) are refused — a zombie cannot keep a lost lease alive,
+    complete it under the new holder, or move its offset."""
+    c = Coordinator(timeout_s=0.1, failure_max=10)
+    c.set_dataset(["s0"])
+    t_a = c.get_task()
+    # snapshot the generation VALUE: the in-process coordinator hands
+    # out the live Task object, which mutates on re-lease
+    tid, lease_a = t_a.task_id, t_a.lease
+    assert c.task_progress(tid, 3, lease=lease_a)["held"]
+    time.sleep(0.15)  # A stalls past its lease
+    t_b = c.get_task()  # re-leased (to 'B'): generation bumps
+    lease_b = t_b.lease
+    assert t_b.task_id == tid and lease_b == lease_a + 1
+    assert t_b.offset == 3
+    # the zombie can neither renew/advance...
+    assert c.task_progress(tid, 9, lease=lease_a) == {"held": False}
+    assert c.pending[tid].offset == 3
+    # ...nor complete or fail B's lease
+    c.task_finished(tid, lease=lease_a)
+    assert tid in c.pending and not c.done
+    c.task_failed(tid, offset=9, lease=lease_a)
+    assert tid in c.pending and not c.todo
+    # B's own calls work
+    assert c.task_progress(tid, 5, lease=lease_b)["held"]
+    c.task_finished(tid, lease=lease_b)
+    assert len(c.done) == 1
+
+
+def test_offset_survives_snapshot_recover(tmp_path):
+    snap = str(tmp_path / "m.json")
+    c = Coordinator(timeout_s=60, snapshot_path=snap)
+    c.set_dataset(["a"])
+    t = c.get_task()
+    c.task_progress(t.task_id, 5)
+    del c
+    c2 = Coordinator(timeout_s=60, snapshot_path=snap)
+    t2 = c2.get_task()
+    assert t2.task_id == t.task_id and t2.offset == 5
+
+
+def test_master_client_failure_does_not_replay_records():
+    """Regression for the v2/master duplicate-record replay: a reader
+    that crashes mid-chunk used to re-yield every record of the chunk on
+    re-lease; now the failure reports the offset and the retry resumes
+    after the last yielded record."""
+    c = Coordinator(timeout_s=60, failure_max=10)
+    c.set_dataset([0, 1])
+    crashes = []
+
+    def record_fn(payload):
+        for i in range(4):
+            if payload == 1 and i == 2 and not crashes:
+                crashes.append(i)
+                raise IOError("transient mid-chunk error")
+            yield payload * 10 + i
+
+    got = list(MasterClient(c, record_fn))
+    want = [p * 10 + i for p in range(2) for i in range(4)]
+    assert sorted(got) == sorted(want), got
+    assert len(got) == len(set(got)), "records replayed after re-lease"
+    assert crashes == [2]
+
+
 def test_worker_membership_heartbeats_and_deadlines():
     """Per-worker liveness: registration starts the deadline clock,
     heartbeats extend it, silence expires it, and re-registration bumps

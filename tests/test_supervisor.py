@@ -232,6 +232,53 @@ def test_supervisor_incarnation_collision_spares_alive_worker():
         sup.stop()
 
 
+def test_supervisor_blind_spawn_resnapshot_spares_healed_partition():
+    """DEFERRED PR-1 bug (CHANGES.md): a worker respawned while the
+    membership view is blind used to snapshot spawn_incarnation=None, so
+    when the partition healed, the dead predecessor's EXPIRED record
+    (incarnation != None) condemned the healthy replacement — repeated
+    partitions at respawn time walked rapid_failures to abandonment.
+    The blind-spawn sentinel defers the snapshot to the first visible
+    sweep; the stale record becomes the baseline instead of a verdict.
+    A real later registration still vouches for — and condemns — the
+    process exactly as before."""
+    from paddle_tpu.distributed import supervisor as sup_mod
+
+    state = {"blind": True}
+    # the dead predecessor's record: expired, from before the partition
+    view = {"w0": {"incarnation": 3, "alive": False}}
+
+    class _Healing(object):
+        def membership(self):
+            if state["blind"]:
+                raise ConnectionError("partitioned")
+            return {k: dict(v) for k, v in view.items()}
+
+    argv = [sys.executable, "-c", "import time; time.sleep(30)"]
+    sup = Supervisor(lambda wid: argv, ["w0"], coordinator=_Healing(),
+                     spawn_grace_s=60.0, restart_max=2)
+    sup.start()  # view is blind: the spawn CANNOT snapshot a baseline
+    try:
+        h = sup.handles["w0"]
+        assert h.spawn_incarnation is sup_mod._BLIND_SPAWN
+        state["blind"] = False  # partition heals; stale record visible
+        sup.poll()
+        # the healed sweep re-snapshots instead of killing
+        assert h.running and h.hang_kills == 0, h.summary()
+        assert h.spawn_incarnation == 3
+        sup.poll()  # and stays calm on later sweeps
+        assert h.running and h.hang_kills == 0, h.summary()
+        # the process now actually registers (incarnation bumps)...
+        view["w0"] = {"incarnation": 4, "alive": True}
+        sup.poll()
+        assert h.running and h.hang_kills == 0
+        # ...and when ITS heartbeats stop, detection still fires
+        view["w0"]["alive"] = False
+        assert _poll_until(sup, lambda: h.hang_kills >= 1, timeout_s=10.0)
+    finally:
+        sup.stop()
+
+
 def test_supervisor_membership_poll_bounded_during_partition():
     """Supervision must keep sweeping during a partition: _membership
     clamps a RemoteCoordinator's per-call retry deadline (default 30 s)
