@@ -34,6 +34,11 @@ definitions):
               (paddle_tpu/serving): aggregate tok/s + mean slot
               occupancy + compile counts under a fixed-seed Poisson
               arrival trace; beyond-reference, no 2018 baseline
+  serving_shared_prefix — prefix-cache acceptance (ISSUE 4): the same
+              fixed-seed Poisson trace over K prompt families sharing
+              a common header, run with the prefix KV pool off vs on;
+              reports prefill-tokens-computed both ways, hit rate, and
+              TTFT; greedy outputs must match between runs
   input_pipeline — host-side loader overlap (paddle_tpu/data):
               RecordShard shards -> ShardedDataset -> DataLoader on a
               fixed-seed synthetic trace, prefetch OFF (synchronous
@@ -962,7 +967,8 @@ def bench_serving_decode(max_slots=None, n_requests=None):
     eng = ServingEngine(params, cfg, max_slots=max_slots)
     t0 = time.time()
     i = step = 0
-    while i < n_requests or eng.live_slots or eng.queue_depth:
+    while i < n_requests or eng.live_slots or eng.queue_depth \
+            or eng.prefilling_slots:
         while i < n_requests and arrive_at[i] <= step:
             p, n = reqs[i]
             eng.submit(p, n)
@@ -989,6 +995,126 @@ def bench_serving_decode(max_slots=None, n_requests=None):
         "max_slots": max_slots,
         "n_requests": n_requests,
         "arrival": "poisson(rate=%g/step, seed=0)" % rate,
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
+def bench_serving_shared_prefix(n_requests=None, families=None,
+                                header_len=None, family_len=None,
+                                max_slots=None, dim=None, heads=None,
+                                layers_n=None, vocab=None, max_len=None,
+                                chunk_tokens=None, block_tokens=None,
+                                cache_tokens=None):
+    """Prefix-cache acceptance trace (ISSUE 4): fixed-seed Poisson
+    arrivals over K prompt families sharing a common header (system-
+    prompt/few-shot shape — the workload RadixAttention exists for).
+    The SAME deterministic trace runs twice through the serving engine —
+    prefix cache OFF vs ON — and the row reports the offline-meaningful
+    columns: prefill-tokens-computed (the work the cache deletes),
+    prefix-hit rate, evictions, and mean TTFT both ways. Greedy outputs
+    must be token-identical between the two runs (asserted in-bench:
+    reuse must never change what a request decodes to); tokens/s is
+    only meaningful on-chip, like the serving_decode row."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import ServingEngine
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape: exercises both engine paths, seconds not minutes
+        dim, heads, layers_n = dim or 128, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 512, max_len or 256
+        n_requests, families = n_requests or 12, families or 3
+        header_len, family_len = header_len or 32, family_len or 16
+        max_slots = max_slots or 4
+        t_lo, t_hi, n_lo, n_hi, rate = 4, 12, 4, 10, 2.0
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_requests, families = n_requests or 64, families or 4
+        header_len, family_len = header_len or 256, family_len or 64
+        max_slots = max_slots or 16
+        t_lo, t_hi, n_lo, n_hi, rate = 16, 64, 32, 128, 1.0
+        dtype = jnp.bfloat16
+    chunk_tokens = chunk_tokens or max(16, header_len // 2)
+    block_tokens = block_tokens or 16
+    cache_tokens = cache_tokens or 8 * (header_len + family_len)
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    header = rng.randint(0, vocab, header_len).astype(np.int32)
+    fam = [rng.randint(0, vocab, family_len).astype(np.int32)
+           for _ in range(families)]
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    reqs = []
+    for _ in range(n_requests):
+        f = int(rng.randint(families))
+        tail = rng.randint(0, vocab,
+                           int(rng.randint(t_lo, t_hi + 1))).astype(np.int32)
+        prompt = np.concatenate([header, fam[f], tail])
+        reqs.append((prompt, int(rng.randint(n_lo, n_hi + 1)),
+                     header_len + family_len))
+
+    def run_once(pool_tokens):
+        eng = ServingEngine(
+            params, cfg, max_slots=max_slots,
+            prefill_chunk_tokens=chunk_tokens,
+            prefix_cache_tokens=pool_tokens,
+            prefix_block_tokens=block_tokens)
+        hs = []
+        i = step = 0
+        while i < n_requests or eng.live_slots or eng.queue_depth \
+                or eng.prefilling_slots:
+            while i < n_requests and arrive_at[i] <= step:
+                p, n, pub = reqs[i]
+                # publish-boundary tag: only the shared header+family
+                # prefix enters the pool, never the unique tails
+                hs.append(eng.submit(p, n, publish_len=pub))
+                i += 1
+            if not eng.step() and i < n_requests:
+                step = max(step + 1, int(arrive_at[i]))  # idle gap: jump
+                continue
+            step += 1
+        return eng, [list(h.tokens) for h in hs]
+
+    eng_off, out_off = run_once(None)
+    eng_on, out_on = run_once(cache_tokens)
+    # reuse must never change what any request decodes to
+    assert out_on == out_off, "prefix cache changed greedy outputs"
+    rep_off, rep_on = eng_off.metrics.report(), eng_on.metrics.report()
+    pc = eng_on.prefix_cache.stats()
+    return {
+        "prefill_tokens_computed_off": rep_off["prefill_tokens_computed"],
+        "prefill_tokens_computed_on": rep_on["prefill_tokens_computed"],
+        "prefill_tokens_saved_frac": round(
+            1.0 - rep_on["prefill_tokens_computed"]
+            / max(rep_off["prefill_tokens_computed"], 1), 4),
+        "prefix_hit_rate": pc["hit_rate"],
+        "prefix_tokens_saved": pc["tokens_saved"],
+        "prefix_evictions": pc["evictions"],
+        "mean_ttft_s_off": rep_off["mean_ttft_s"],
+        "mean_ttft_s_on": rep_on["mean_ttft_s"],
+        "decode_steps_off": rep_off["decode_steps"],
+        "decode_steps_on": rep_on["decode_steps"],
+        "prefill_traces_on": rep_on["prefill_traces"],
+        "decode_traces_on": rep_on["decode_traces"],
+        "tokens_out": rep_on["tokens_out"],
+        "n_requests": n_requests,
+        "families": families,
+        "arrival": "poisson(rate=%g/step, seed=0)" % rate,
+        "knobs": {"prefill_chunk_tokens": chunk_tokens,
+                  "prefix_block_tokens": block_tokens,
+                  "prefix_cache_tokens": cache_tokens,
+                  "publish_len": header_len + family_len,
+                  "max_slots": max_slots},
         "model": {"dim": dim, "heads": heads, "layers": layers_n,
                   "vocab": vocab, "max_len": max_len},
     }
@@ -1458,6 +1584,10 @@ def main():
         # Poisson trace — occupancy/compile counts meaningful offline,
         # tokens/s awaits an on-chip tunnel window
         run("serving_decode", bench_serving_decode)
+        # prefix-cache acceptance: the SAME fixed-seed shared-header
+        # trace with the pool off vs on — prefill-tokens-computed and
+        # hit rate are deterministic offline, TTFT deltas on-chip
+        run("serving_shared_prefix", bench_serving_shared_prefix)
         run("transformer_lm", bench_transformer_lm)
         # larger-matmul flagship: dim=1024 keeps every matmul MXU-shaped
         # (the dim=512 row leaves lane headroom), so this is the MFU

@@ -352,6 +352,85 @@ def prefill(params, tokens, cfg: TransformerConfig, max_len=None):
     return logits, cache
 
 
+def prefill_chunk(params, cache, chunk, start_pos, slot, cfg: TransformerConfig,
+                  true_len=None):
+    """Multi-token incremental prefill: extend slot `slot` of a slotted
+    cache ([S, L, H, Dh] per layer) by a [C]-token `chunk` whose first
+    token sits at position `start_pos` (tokens 0..start_pos-1 must
+    already be cached — written by earlier chunks or device-copied from
+    a prefix pool). Each chunk row attends to cache[0:start_pos] plus
+    the intra-chunk causal prefix, so running a prompt through
+    consecutive chunks is mathematically the monolithic prefill — and
+    BIT-identical to it, because every op mirrors forward()'s numerics
+    exactly: reference_attention's scale-into-q einsum and -1e30 mask
+    (NOT _cached_attention's divide-after-matmul/-inf variant — the two
+    differ in low bits), softmax in the score dtype, the same reshape/
+    matmul order per block, and forward(last_index=...)'s head on the
+    true last row.
+
+    `chunk` may be padded (pow-2 bucketing: compiled shapes stay
+    O(log max_len), the same discipline as the monolithic prefill);
+    `true_len` is the number of real tokens. Padded rows write their
+    K/V OUT OF RANGE (position L — scatter drops them, the same parking
+    trick the batched decode uses for dead slots), so the cache beyond
+    start_pos+true_len is never dirtied, and their attention output is
+    garbage that nothing reads. `start_pos`/`slot`/`true_len` are
+    traced scalars: one compile per chunk bucket, not per position.
+
+    Returns (logits [vocab] of the true last chunk row, new cache).
+    The logits are only meaningful on a prompt's FINAL chunk (where
+    start_pos + true_len == T0); earlier chunks exist for their cache
+    writes. MoE caveat (same as decode_step): reference_moe's capacity
+    cutoff couples rows, so MoE blocks are not bit-stable across
+    chunking — the serving family is dense."""
+    from ..parallel.attention import _NEG_INF
+
+    (C,) = chunk.shape
+    S, L, H, dh = cache[0]["k"].shape
+    if true_len is None:
+        true_len = C
+    scale = 1.0 / math.sqrt(dh)
+    offs = jnp.arange(C)
+    positions = start_pos + offs  # [C] global rows of the chunk
+    # padded rows park out of range: scatter DROPS them
+    wpos = jnp.where(offs < true_len, positions, jnp.int32(L))
+    x = params["embed"][chunk][None] + params["pos"][positions][None]
+    new_cache = []
+    for blk, kv in zip(params["blocks"], cache):
+        h = _ln(x, blk["ln1"])
+        q = (h @ blk["wq"]).reshape(1, C, cfg.heads, dh)
+        k = (h @ blk["wk"]).reshape(1, C, cfg.heads, dh)
+        v = (h @ blk["wv"]).reshape(1, C, cfg.heads, dh)
+        ck = kv["k"].at[slot, wpos].set(k[0].astype(kv["k"].dtype))
+        cv = kv["v"].at[slot, wpos].set(v[0].astype(kv["v"].dtype))
+        new_cache.append({"k": ck, "v": cv})
+        slot_k = jax.lax.dynamic_slice(ck, (slot, 0, 0, 0), (1, L, H, dh))
+        slot_v = jax.lax.dynamic_slice(cv, (slot, 0, 0, 0), (1, L, H, dh))
+        # reference_attention numerics, verbatim: scale folded into q
+        # BEFORE the matmul, -1e30 mask, softmax in the score dtype
+        s = jnp.einsum("bthd,bshd->bhts", q * scale, slot_k)
+        mask = jnp.arange(L)[None, :] <= positions[:, None]  # [C, L]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhts,bshd->bthd", p, slot_v)
+        x = x + o.reshape(1, C, cfg.dim) @ blk["wo"]
+        h = _ln(x, blk["ln2"])
+        if "moe" in blk:
+            from ..parallel.moe import reference_moe
+
+            mp = blk["moe"]
+            flat = h.reshape(C, cfg.dim)
+            y = reference_moe(flat, mp["gate_w"], mp["w1"], mp["b1"],
+                              mp["w2"], mp["b2"])
+            x = x + y.reshape(1, C, cfg.dim)
+        else:
+            x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
+    xl = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
+                                      keepdims=False)  # [1, dim]
+    xl = _ln(xl, params["ln_f"])
+    return (xl @ params["embed"].T)[0], new_cache
+
+
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,
              temperature=0.0, key=None, max_len=None, eos_id=None):
     """Autoregressive generation: prefill the prompt [B, T0], then
@@ -440,7 +519,8 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,
     return jnp.concatenate([prompt, buf], axis=1)
 
 
-__all__ += ["init_kv_cache", "decode_step", "prefill", "generate"]
+__all__ += ["init_kv_cache", "decode_step", "prefill", "prefill_chunk",
+            "generate"]
 
 
 # diagnostics of the last eager beam_search_generate call: executed vs

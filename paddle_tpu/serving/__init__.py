@@ -1,10 +1,14 @@
-"""Continuous-batching inference serving (ISSUE 2 tentpole): slotted KV
-cache + bucketed prefill + one compiled decode step over
-models/transformer.py's cached-decode primitives. See engine.py for the
-design story and tests/test_serving_engine.py for the correctness bar
-(greedy outputs bit-identical to sequential generate())."""
+"""Continuous-batching inference serving (ISSUE 2 tentpole + ISSUE 4
+prefix reuse): slotted KV cache + prefix-cached chunked prefill + one
+compiled decode step over models/transformer.py's cached-decode
+primitives. See engine.py for the design story, prefix_cache.py for the
+trie-keyed KV pool, and tests/test_serving_engine.py for the
+correctness bar (greedy outputs bit-identical to sequential
+generate() on every hit/miss/partial-hit/eviction path)."""
 
 from .engine import ServingEngine, ServingHandle
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache, PrefixMatch
 
-__all__ = ["ServingEngine", "ServingHandle", "ServingMetrics"]
+__all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
+           "PrefixCache", "PrefixMatch"]

@@ -1,5 +1,6 @@
-"""Continuous-batching serving engine: slotted KV cache, bucketed
-prefill, and ONE compiled decode step for many concurrent requests.
+"""Continuous-batching serving engine: slotted KV cache, prefix-cached
+chunked prefill, and ONE compiled decode step for many concurrent
+requests.
 
 The training path sits at the HBM roof (PERF.md r5); the unclaimed
 serving throughput is workload shape — one request per batch underfills
@@ -7,31 +8,51 @@ the lanes and every new prompt length recompiles. This engine
 reproduces Orca-style iteration-level scheduling (Yu et al., OSDI '22)
 and vLLM-style slot management (Kwon et al., SOSP '23) in JAX/XLA
 idiom: static shapes everywhere, slots instead of dynamic allocation.
+On top of that base (PR 2), admission now reuses and bounds prefill
+work (PR 4):
 
   * Slotted KV cache — one fixed [MAX_SLOTS, max_len] cache per layer
     holds many independent requests; per-slot `pos`/`alive` side-bands
     and the per-row mask in models/transformer._cached_attention make a
     dead or stale slot contribute exactly 0 to live rows.
-  * Bucketed prefill — prompts pad to pow-2 length buckets (the same
-    discipline as executor.py _lod_bucket) and write into a free slot
-    via dynamic_update_slice, so distinct compiled prefill shapes are
-    O(log max_len), not O(#prompts). Causality + the exp(-inf)==0 mask
-    make the padded prefill BIT-IDENTICAL to an unpadded one at the
-    true last prompt position.
+  * Prefix cache — completed prompt prefixes are published (up to the
+    request's publish boundary) into a trie-keyed block pool
+    (prefix_cache.py, RadixAttention-style); admission matches the
+    longest cached chain and device-copies it into the slot — a
+    dynamic_update_slice per block instead of recomputing the header
+    every request shares.
+  * Chunked prefill — the uncached suffix runs through
+    models/transformer.prefill_chunk in chunks of
+    `prefill_chunk_tokens`, interleaved with batched decode steps
+    (Sarathi-Serve, Agrawal et al., OSDI '24): a long prompt no longer
+    stalls every in-flight decode for its whole duration. Chunks pad to
+    pow-2 buckets (the same discipline as executor.py _lod_bucket), so
+    distinct compiled prefill shapes stay O(log max_len).
   * One jitted decode step — advances all MAX_SLOTS slots at once with
     per-slot positions, temperatures, and sampling keys; cache buffers
     are donated. Traced exactly once per engine lifetime (guarded by
-    tests/test_serving_engine.py's compile-count test).
+    tests/test_serving_engine.py's compile-count test). The six host
+    side-band arrays are device-resident between steps: the decode
+    step returns the advanced tok/pos/counts bands, and only bands a
+    scheduler event dirtied (_admit activation, retirement) are
+    re-uploaded — the steady decode loop does zero h2d band traffic.
   * Iteration-level scheduling — ServingEngine.step() retires a slot
     the moment its request emits EOS or exhausts its budget and refills
     it from the FCFS queue on the SAME step; a new request never waits
-    for the whole batch to drain. `max_prefills_per_step` bounds how
-    much prefill work may delay in-flight decodes (the prefill-vs-
-    decode interleave policy).
+    for the whole batch to drain. A pending slot advances at most ONE
+    chunk per step (chunks always interleave with decodes — the
+    Sarathi policy); `max_prefills_per_step` additionally caps the
+    TOTAL chunks across slots per step (None = every pending slot
+    advances, 1 = only the FCFS head — the flattest decode latency).
 
 Correctness bar (tested): greedy engine output per request is
 bit-identical to sequential models/transformer.generate() at every
-slot count and admission order. Sampled requests use a per-request
+slot count and admission order, for every cache path — cold miss,
+full hit, partial hit, and post-eviction re-admit. (Identity is at the
+TOKEN level: padded/chunked prefill drifts from the unpadded oracle in
+the last ~2 float bits — reduction order under masked padding, present
+since PR 2 — which never moves an argmax in practice and is pinned by
+the fixed-seed drills.) Sampled requests use a per-request
 fold_in(key, token_index) schedule — deterministic per request and
 independent of slot assignment, but not the same key schedule as
 generate(temperature>0).
@@ -50,8 +71,11 @@ import numpy as np
 from ..fluid.core.kernels_sequence import bucket_pow2
 from ..models import transformer as tlm
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
 
 __all__ = ["ServingEngine", "ServingHandle"]
+
+_BANDS = ("tok", "pos", "alive", "temps", "counts", "base_keys")
 
 
 class ServingHandle(object):
@@ -60,7 +84,7 @@ class ServingHandle(object):
     (single-threaded engines have no background loop to wait on)."""
 
     def __init__(self, engine, rid, prompt, max_new_tokens, temperature,
-                 eos_id, seed):
+                 eos_id, seed, publish_len):
         self._engine = engine
         self.rid = rid
         self.prompt = prompt  # np.int32 [T0]
@@ -68,6 +92,9 @@ class ServingHandle(object):
         self.temperature = float(temperature)
         self.eos_id = eos_id
         self.seed = seed
+        # publish boundary: how many leading prompt tokens may be
+        # published back to the prefix pool (None = whole prompt)
+        self.publish_len = publish_len
         self.tokens: List[int] = []  # generated tokens (may include eos)
         self.done = False
         self.finish_reason: Optional[str] = None  # 'eos' | 'budget'
@@ -93,13 +120,21 @@ class ServingEngine(object):
     """Continuous-batching engine over a transformer LM's decode
     primitives. Knobs: `max_slots` (concurrent requests in the batched
     decode), `max_len` (per-slot KV capacity, bounded by the positional
-    table), `min_bucket` (smallest prefill pad length), and
-    `max_prefills_per_step` (admission per step; None = fill every free
-    slot — throughput-biased; 1 = latency-biased for in-flight decodes).
-    """
+    table), `min_bucket` (smallest prefill pad length),
+    `max_prefills_per_step` (total prefill chunks per step across
+    slots; each pending slot advances at most one chunk per step
+    regardless, so None = all pending slots advance, 1 = only the FCFS
+    head — latency-biased for in-flight decodes),
+    `prefill_chunk_tokens` (max tokens per prefill chunk;
+    None = whole suffix in one chunk), `prefix_cache_tokens` (token
+    budget of the shared prefix KV pool; None/0 disables reuse), and
+    `prefix_block_tokens` (pool block granularity — prefixes cache and
+    match in whole blocks)."""
 
     def __init__(self, params, cfg, max_slots=8, max_len=None,
-                 min_bucket=8, max_prefills_per_step=None, donate=True):
+                 min_bucket=8, max_prefills_per_step=None, donate=True,
+                 prefill_chunk_tokens=None, prefix_cache_tokens=None,
+                 prefix_block_tokens=16):
         self._params = params
         self._cfg = cfg
         S = int(max_slots)
@@ -115,23 +150,41 @@ class ServingEngine(object):
         if max_prefills_per_step is not None and max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1 or None")
         self.max_prefills_per_step = max_prefills_per_step
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ValueError("prefill_chunk_tokens must be >= 1 or None")
+        self.prefill_chunk_tokens = prefill_chunk_tokens
         self.metrics = ServingMetrics(S)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache_tokens:
+            self.prefix_cache = PrefixCache(
+                int(prefix_cache_tokens),
+                block_tokens=int(prefix_block_tokens),
+            )
+            self.metrics.prefix_cache = self.prefix_cache
 
         self._cache = tlm.init_kv_cache(cfg, S, max_len=L)
-        # host-side truth of the per-slot side-bands; uploaded per step
+        # host-side truth of the per-slot side-bands; device copies are
+        # kept across steps and re-uploaded only when dirtied
         self._tok = np.zeros(S, np.int32)     # last emitted, not yet cached
         self._pos = np.zeros(S, np.int32)     # its write position
         self._alive = np.zeros(S, bool)
         self._temps = np.zeros(S, np.float32)
         self._counts = np.zeros(S, np.int32)  # tokens generated so far
         self._base_keys = np.zeros((S, 2), np.uint32)  # per-request keys
+        self._dev: Dict[str, Any] = {}
+        self._dirty = set(_BANDS)
         self._slot_req: List[Optional[ServingHandle]] = [None] * S
+        # per-slot chunked-prefill cursors + FCFS order of pending slots
+        self._prefill_state: Dict[int, dict] = {}
+        self._prefill_q: collections.deque = collections.deque()
 
         self._queue: collections.deque = collections.deque()
         self._next_rid = 0
         self._donate = bool(donate)
-        self._prefill_fns: Dict[int, Any] = {}
+        self._chunk_fns: Dict[int, Any] = {}
         self._decode_fn = self._make_decode()
+        self._copy_fn = None
+        self._extract_fn = None
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -158,56 +211,118 @@ class ServingEngine(object):
                 )
             )(keys, logits, safe_t).astype(jnp.int32)
             nxt = jnp.where(temps > 0, sampled, greedy)
-            return cache, nxt
+            # advance the device-resident bands in-step: the steady
+            # decode loop re-uploads nothing (satellite: h2d dispatch
+            # off the hot path). Dead rows advance by 0, matching the
+            # untouched host mirrors.
+            live = alive.astype(jnp.int32)
+            return cache, nxt, pos + live, counts + live
 
         kw = {"donate_argnums": (1,)} if self._donate else {}
         return jax.jit(_decode, **kw)
 
-    def _prefill_fn(self, Tb):
-        fn = self._prefill_fns.get(Tb)
+    def _chunk_fn(self, Cb):
+        """One compiled prefill-chunk step per pow-2 bucket: extends a
+        slot's cached prefix by a [Cb]-padded chunk and returns the
+        would-be first generated token (meaningful only when the chunk
+        completes the prompt)."""
+        fn = self._chunk_fns.get(Cb)
         if fn is not None:
             return fn
         cfg, metrics = self._cfg, self.metrics
 
-        def _prefill(params, cache, padded, true_len, slot, temp, key):
-            metrics.count_trace("prefill_T%d" % Tb)
-            sink: list = []
-            # reuses forward()'s block math exactly; last_index picks
-            # the TRUE last prompt row out of the padded bucket
-            last = tlm.forward(
-                params, padded, cfg, mesh=None, attn_impl="reference",
-                kv_sink=sink, last_index=true_len - 1,
-            )[0]  # [vocab]
-            new_cache = []
-            for kv, (k, v) in zip(cache, sink):
-                ck = jax.lax.dynamic_update_slice(
-                    kv["k"], k.astype(kv["k"].dtype), (slot, 0, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    kv["v"], v.astype(kv["v"].dtype), (slot, 0, 0, 0)
-                )
-                new_cache.append({"k": ck, "v": cv})
-            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        def _chunk(params, cache, padded, start, slot, true_len, temp,
+                   key):
+            metrics.count_trace("prefill_T%d" % Cb)
+            logits, cache = tlm.prefill_chunk(
+                params, cache, padded, start, slot, cfg,
+                true_len=true_len,
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             sampled = jax.random.categorical(
                 key,
-                last.astype(jnp.float32) / jnp.where(temp > 0, temp, 1.0),
+                logits.astype(jnp.float32)
+                / jnp.where(temp > 0, temp, 1.0),
             ).astype(jnp.int32)
             first = jnp.where(temp > 0, sampled, greedy)
-            return new_cache, first
+            return cache, first
 
         kw = {"donate_argnums": (1,)} if self._donate else {}
-        fn = jax.jit(_prefill, **kw)
-        self._prefill_fns[Tb] = fn
+        fn = jax.jit(_chunk, **kw)
+        self._chunk_fns[Cb] = fn
         return fn
+
+    def _make_copy_fn(self):
+        """Device-side prefix reuse: one dynamic_update_slice per layer
+        writes a cached [B, H, Dh] block into the slot at its depth.
+        ONE compiled shape total (fixed block size) — reuse adds no
+        pressure on the pow-2 prefill bucket budget."""
+        metrics = self.metrics
+
+        def _copy(cache, kk, vv, slot, pos):
+            metrics.count_trace("prefix_copy")
+            new = []
+            for i, kv in enumerate(cache):
+                ck = jax.lax.dynamic_update_slice(
+                    kv["k"], kk[i][None].astype(kv["k"].dtype),
+                    (slot, pos, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    kv["v"], vv[i][None].astype(kv["v"].dtype),
+                    (slot, pos, 0, 0))
+                new.append({"k": ck, "v": cv})
+            return new
+
+        kw = {"donate_argnums": (0,)} if self._donate else {}
+        return jax.jit(_copy, **kw)
+
+    def _make_extract_fn(self):
+        """Publish path: slice one block's per-layer K/V out of a slot
+        into stacked [layers, B, H, Dh] pool payloads. Not donated —
+        the engine keeps using the cache it reads from."""
+        metrics = self.metrics
+        B = self.prefix_cache.block_tokens
+        H = self._cfg.heads
+        dh = self._cfg.dim // self._cfg.heads
+
+        def _extract(cache, slot, pos):
+            metrics.count_trace("prefix_extract")
+            kk = jnp.stack([
+                jax.lax.dynamic_slice(
+                    kv["k"], (slot, pos, 0, 0), (1, B, H, dh))[0]
+                for kv in cache])
+            vv = jnp.stack([
+                jax.lax.dynamic_slice(
+                    kv["v"], (slot, pos, 0, 0), (1, B, H, dh))[0]
+                for kv in cache])
+            return kk, vv
+
+        return jax.jit(_extract)
+
+    # ------------------------------------------------------------------
+    # device-resident side-bands
+    # ------------------------------------------------------------------
+    def _band(self, name):
+        if name in self._dirty:
+            self._dev[name] = jnp.asarray(getattr(self, "_" + name))
+            self._dirty.discard(name)
+            self.metrics.band_uploads += 1
+        return self._dev[name]
+
+    def _mark_dirty(self, *names):
+        self._dirty.update(names or _BANDS)
 
     # ------------------------------------------------------------------
     # scheduler
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0, eos_id=None,
-               seed=0) -> ServingHandle:
+               seed=0, publish_len=None) -> ServingHandle:
         """Enqueue one request (FCFS). Returns a handle whose `.tokens`
         fills in as the engine steps; `handle.result()` drives the
-        engine to completion of this request."""
+        engine to completion of this request. `publish_len` is the
+        publish-boundary tag: at most this many leading prompt tokens
+        are published to the prefix pool once prefill completes (None =
+        the whole prompt; pass the shared-header length to keep
+        request-unique tails out of the pool)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T0 = prompt.shape[0]
         if T0 < 1:
@@ -219,8 +334,10 @@ class ServingEngine(object):
                 "request needs T0+max_new <= max_len (%d + %d > %d)"
                 % (T0, int(max_new_tokens), self.max_len)
             )
+        if publish_len is not None and publish_len < 0:
+            raise ValueError("publish_len must be >= 0 or None")
         h = ServingHandle(self, self._next_rid, prompt, max_new_tokens,
-                          temperature, eos_id, seed)
+                          temperature, eos_id, seed, publish_len)
         self._next_rid += 1
         self._queue.append(h)
         return h
@@ -240,6 +357,7 @@ class ServingEngine(object):
         h.finish_reason = reason
         self._slot_req[s] = None
         self._alive[s] = False
+        self._mark_dirty("alive")
 
     def _emit(self, s: int, token: int) -> bool:
         """Append one generated token to slot s's request; retire on EOS
@@ -258,62 +376,150 @@ class ServingEngine(object):
         return False
 
     def _admit(self, h: ServingHandle, s: int):
-        t0 = time.monotonic()
-        h.queue_wait_s = t0 - h.submit_t
+        """Assign a free slot: match the longest cached prefix,
+        device-copy it into the slot (zero recompute), and queue the
+        uncached suffix for chunked prefill. No model compute happens
+        here — chunks run in step()'s prefill phase."""
+        h.queue_wait_s = time.monotonic() - h.submit_t
         self.metrics.queue_wait_s.append(h.queue_wait_s)
         T0 = h.prompt.shape[0]
-        Tb = self._bucket(T0)
-        padded = np.zeros((1, Tb), np.int32)
-        padded[0, :T0] = h.prompt
-        fn = self._prefill_fn(Tb)
+        matched = 0
+        if self.prefix_cache is not None:
+            # cap at T0-1: the last prompt token must be COMPUTED — its
+            # logits seed the first generated token
+            with self.prefix_cache.match(h.prompt[:T0 - 1]) as m:
+                if m.length:
+                    if self._copy_fn is None:
+                        self._copy_fn = self._make_copy_fn()
+                    B = self.prefix_cache.block_tokens
+                    for d, (kk, vv) in enumerate(m.payloads):
+                        self._cache = self._copy_fn(
+                            self._cache, kk, vv, jnp.int32(s),
+                            jnp.int32(d * B))
+                matched = m.length
+            # the match is ref-held until here: eviction during a
+            # concurrent publish cannot free a block mid-copy
+            self.metrics.prefix_hit_tokens.append(matched)
+        self._slot_req[s] = h
+        self._prefill_state[s] = {"handle": h, "cursor": matched,
+                                  "t0": time.monotonic()}
+        self._prefill_q.append(s)
+
+    def _publish(self, s: int, h: ServingHandle):
+        """Publish the finished prompt's prefix blocks (up to the
+        request's publish boundary) back to the pool. Extraction runs
+        only for blocks the trie does not already hold."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        T0 = h.prompt.shape[0]
+        bound = T0 if h.publish_len is None else min(h.publish_len, T0)
+        n_blocks = bound // pc.block_tokens
+        if n_blocks < 1:
+            return
+        if self._extract_fn is None:
+            self._extract_fn = self._make_extract_fn()
+        pc.publish(
+            h.prompt, n_blocks,
+            lambda d: self._extract_fn(
+                self._cache, jnp.int32(s),
+                jnp.int32(d * pc.block_tokens)),
+        )
+
+    def _run_chunk(self, s: int) -> bool:
+        """Advance slot s's prefill by one chunk; on the final chunk,
+        publish the prefix, activate the slot, and emit the first
+        token. Returns True when the prefill completed."""
+        st = self._prefill_state[s]
+        h = st["handle"]
+        T0 = h.prompt.shape[0]
+        cursor = st["cursor"]
+        c = T0 - cursor
+        if self.prefill_chunk_tokens is not None:
+            c = min(c, self.prefill_chunk_tokens)
+        Cb = self._bucket(c)
+        padded = np.zeros(Cb, np.int32)
+        padded[:c] = h.prompt[cursor:cursor + c]
+        fn = self._chunk_fn(Cb)
+        t0 = time.monotonic()
         key = jax.random.fold_in(jax.random.PRNGKey(h.seed), 0)
         self._cache, first = fn(
             self._params, self._cache, jnp.asarray(padded),
-            jnp.int32(T0), jnp.int32(s),
+            jnp.int32(cursor), jnp.int32(s), jnp.int32(c),
             jnp.float32(h.temperature), key,
         )
+        st["cursor"] = cursor + c
+        self.metrics.prefill_chunks += 1
+        self.metrics.prefill_tokens_computed += c
+        if st["cursor"] < T0:
+            # mid-prompt chunk: dispatch only, nothing to read back —
+            # the batched decode below overlaps with it
+            self.metrics.span("prefill_T%d" % Cb, time.monotonic() - t0)
+            return False
         first = int(np.asarray(first))  # blocks: first token is real
         now = time.monotonic()
         h.ttft_s = now - h.submit_t
         self.metrics.ttft_s.append(h.ttft_s)
-        self.metrics.span("prefill_T%d" % Tb, now - t0)
+        self.metrics.span("prefill_T%d" % Cb, now - t0)
         self.metrics.prefills += 1
+        self._publish(s, h)
+        del self._prefill_state[s]
 
-        self._slot_req[s] = h
         self._tok[s] = first
         self._pos[s] = T0
         self._alive[s] = True
         self._temps[s] = h.temperature
         self._counts[s] = 0
         self._base_keys[s] = np.asarray(jax.random.PRNGKey(h.seed))
+        self._mark_dirty()  # all bands: slot s changed everywhere
         self._emit(s, first)  # may retire immediately (max_new==1 / eos)
+        return True
 
     def step(self) -> bool:
         """One scheduler iteration: admit queued requests into free
-        slots (bounded by max_prefills_per_step), then ONE batched
+        slots (prefix match + device copy), advance pending prefills by
+        up to `max_prefills_per_step` chunks (FCFS), then ONE batched
         decode advancing every live slot; retirements free slots for
         the next step's admissions. Returns False when there was
-        nothing to do (queue empty and no live slots)."""
-        admitted = 0
-        cap = self.max_prefills_per_step
-        while self._queue and (cap is None or admitted < cap):
+        nothing to do (queue empty, no pending prefill, no live
+        slots)."""
+        progressed = False
+        while self._queue:
             s = self._free_slot()
             if s is None:
                 break
             self._admit(self._queue.popleft(), s)
-            admitted += 1
+            progressed = True
+
+        cap = self.max_prefills_per_step
+        chunks = 0
+        for s in list(self._prefill_q):
+            if cap is not None and chunks >= cap:
+                break
+            if self._run_chunk(s):
+                self._prefill_q.remove(s)
+            chunks += 1
+            progressed = True
 
         if not self._alive.any():
-            return admitted > 0
+            return progressed
 
         t0 = time.monotonic()
-        self._cache, nxt = self._decode_fn(
+        self._cache, nxt_d, pos_d, counts_d = self._decode_fn(
             self._params, self._cache,
-            jnp.asarray(self._tok), jnp.asarray(self._pos),
-            jnp.asarray(self._alive), jnp.asarray(self._temps),
-            jnp.asarray(self._counts), jnp.asarray(self._base_keys),
+            self._band("tok"), self._band("pos"), self._band("alive"),
+            self._band("temps"), self._band("counts"),
+            self._band("base_keys"),
         )
-        nxt = np.asarray(nxt)  # blocks; tokens are real
+        nxt = np.asarray(nxt_d)  # blocks; tokens are real
+        # the decode step advanced tok/pos/counts on device; adopt its
+        # outputs so an admission-free step re-uploads nothing. (Dead
+        # rows: device tok holds this step's don't-care sample, host
+        # keeps the stale final token — both are masked and parked, and
+        # an admission re-dirties every band anyway.)
+        self._dev["tok"], self._dev["pos"], self._dev["counts"] = (
+            nxt_d, pos_d, counts_d)
+        self._dirty.difference_update(("tok", "pos", "counts"))
         self.metrics.span("decode_step", time.monotonic() - t0)
         self.metrics.decode_steps += 1
         self.metrics.occupancy.append(
@@ -354,3 +560,7 @@ class ServingEngine(object):
     @property
     def live_slots(self) -> int:
         return int(self._alive.sum())
+
+    @property
+    def prefilling_slots(self) -> int:
+        return len(self._prefill_q)

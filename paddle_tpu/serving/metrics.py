@@ -43,6 +43,15 @@ class ServingMetrics(object):
         self.occupancy = _RunningStat()  # live slots / max_slots per decode
         self.queue_wait_s = _RunningStat()  # submit -> admission
         self.ttft_s = _RunningStat()  # submit -> first token
+        # PR 4 counters — same O(1) discipline (ints + RunningStat, no
+        # per-request lists): chunked-prefill work actually computed,
+        # prefix-pool reuse per admission, and side-band h2d uploads
+        # (the steady decode loop must not grow this)
+        self.prefill_chunks = 0
+        self.prefill_tokens_computed = 0
+        self.band_uploads = 0
+        self.prefix_hit_tokens = _RunningStat()  # cached tokens/admission
+        self.prefix_cache = None  # set by the engine when reuse is on
         self._t0 = None
         self._t1 = None
 
@@ -79,7 +88,7 @@ class ServingMetrics(object):
             return round(st.mean, 6) if st.count else None
 
         wall = self.wall_s
-        return {
+        rep = {
             "tokens_out": self.tokens_out,
             "tokens_per_sec": round(self.tokens_out / wall, 2) if wall else None,
             "decode_steps": self.decode_steps,
@@ -93,7 +102,14 @@ class ServingMetrics(object):
             "prefill_traces": self.prefill_trace_count(),
             "decode_traces": self.decode_trace_count(),
             "wall_s": round(wall, 4),
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_tokens_computed": self.prefill_tokens_computed,
+            "band_uploads": self.band_uploads,
+            "mean_prefix_hit_tokens": _mean(self.prefix_hit_tokens),
         }
+        if self.prefix_cache is not None:
+            rep["prefix_cache"] = self.prefix_cache.stats()
+        return rep
 
     def table(self, sorted_key="total"):
         return self.ops.table(sorted_key)

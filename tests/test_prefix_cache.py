@@ -1,0 +1,142 @@
+"""PrefixCache (paddle_tpu/serving/prefix_cache.py) pool discipline —
+pure host bookkeeping, no device, no model:
+
+* trie matching at block granularity (partial trailing blocks never
+  match; longest cached chain wins)
+* publish() creates payloads only for novel blocks (extract cost paid
+  once per block, not per request)
+* LRU eviction under the token budget is LEAF-only (an interior block
+  of a longer cached chain is never evicted out from under it)
+* ref-count safety: a matched (acquired) entry cannot be evicted
+  mid-admit, no matter the eviction pressure; release() restores
+  evictability (the ISSUE 4 satellite drill)
+* O(1) counters: hits/misses/evictions/tokens-saved/size
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import PrefixCache
+
+
+def _toks(*vals):
+    return np.asarray(vals, np.int32)
+
+
+def test_match_block_granularity_and_counters():
+    pc = PrefixCache(token_budget=64, block_tokens=4)
+    pc.publish(_toks(*range(8)), 2, lambda d: "blk%d" % d)
+    # full 2-block match
+    with pc.match(_toks(*range(8))) as m:
+        assert m.length == 8
+        assert m.payloads == ["blk0", "blk1"]
+    # a longer probe still matches only the cached chain
+    with pc.match(_toks(*range(12))) as m:
+        assert m.length == 8
+    # 7 tokens = one full block + a partial block: partial never matches
+    with pc.match(_toks(*range(7))) as m:
+        assert m.length == 4
+    # diverging second block stops the walk after block 0
+    with pc.match(_toks(0, 1, 2, 3, 9, 9, 9, 9)) as m:
+        assert m.length == 4
+    # under one block, or diverging at block 0: miss
+    with pc.match(_toks(0, 1, 2)) as m:
+        assert m.length == 0
+    with pc.match(_toks(5, 5, 5, 5)) as m:
+        assert m.length == 0
+    st = pc.stats()
+    assert st["hits"] == 4 and st["misses"] == 2
+    assert st["tokens_saved"] == 8 + 8 + 4 + 4
+    assert st["size_tokens"] == 8 and st["blocks"] == 2
+
+
+def test_publish_extracts_only_novel_blocks():
+    pc = PrefixCache(token_budget=64, block_tokens=4)
+    calls = []
+
+    def payload(d):
+        calls.append(d)
+        return d
+
+    assert pc.publish(_toks(*range(8)), 2, payload) == 2
+    assert calls == [0, 1]
+    # republishing the same prefix extracts nothing
+    assert pc.publish(_toks(*range(8)), 2, payload) == 0
+    assert calls == [0, 1]
+    # extending the chain extracts only the new block
+    assert pc.publish(_toks(*range(12)), 3, payload) == 1
+    assert calls == [0, 1, 2]
+    with pytest.raises(ValueError, match="n_blocks"):
+        pc.publish(_toks(0, 1), 1, payload)
+
+
+def test_lru_eviction_is_leaf_only_and_ordered():
+    pc = PrefixCache(token_budget=12, block_tokens=4)
+    # chain A: a0 -> a1 (a0 is interior, a1 leaf)
+    pc.publish(_toks(*range(8)), 2, lambda d: "a%d" % d)
+    # touch chain A so B becomes the LRU candidate later
+    pc.match(_toks(*range(8))).release()
+    # chain B: one block, least recently used after A's touch... until
+    # publishing C (4 tokens) pushes size to 16 > 12
+    pc.publish(_toks(100, 101, 102, 103), 1, lambda d: "b")
+    pc.match(_toks(*range(8))).release()  # A most recent again
+    pc.publish(_toks(200, 201, 202, 203), 1, lambda d: "c")
+    st = pc.stats()
+    assert st["evictions"] == 1 and st["size_tokens"] == 12
+    # the evicted block is B (LRU leaf) — NOT a0 (interior, would
+    # orphan a1) and not the just-published C
+    assert pc.match(_toks(100, 101, 102, 103)).length == 0
+    assert pc.match(_toks(*range(8))).length == 8
+    assert pc.match(_toks(200, 201, 202, 203)).length == 4
+
+
+def test_eviction_cascades_leafward_until_budget():
+    pc = PrefixCache(token_budget=8, block_tokens=4)
+    pc.publish(_toks(*range(12)), 3, lambda d: d)  # 12 tokens > 8
+    st = pc.stats()
+    # the deepest (newest) leaf goes first: a chain trims from the tail
+    assert st["size_tokens"] == 8 and st["evictions"] == 1
+    assert pc.match(_toks(*range(12))).length == 8
+
+
+def test_refcounted_entry_survives_eviction_mid_admit():
+    """ISSUE 4 satellite: an entry serving a live device-copy is
+    acquired by match() and must survive any publish-triggered
+    eviction until released."""
+    pc = PrefixCache(token_budget=8, block_tokens=4)
+    pc.publish(_toks(*range(8)), 2, lambda d: d)
+    held = pc.match(_toks(*range(8)))  # admission in flight: 2 blocks held
+    assert held.length == 8
+    # eviction pressure: publishing 2 more blocks doubles the size, but
+    # every held block is pinned (leaf a1 by its ref, interior a0 by
+    # its child), so the NEW chain is what shrinks back to budget
+    pc.publish(_toks(50, 51, 52, 53, 54, 55, 56, 57), 2, lambda d: d)
+    with pc.match(_toks(*range(8))) as m:
+        assert m.length == 8  # the held chain survived in full
+    st = pc.stats()
+    assert st["evictions"] == 2 and st["size_tokens"] == 8
+    held.release()
+    held.release()  # idempotent
+    # released, the chain is ordinary LRU prey again: the next publish
+    # over budget trims its leaf
+    pc.publish(_toks(90, 91, 92, 93), 1, lambda d: d)
+    assert pc.stats()["size_tokens"] <= pc.token_budget
+    with pc.match(_toks(*range(8))) as m:
+        assert m.length == 4  # a1 evicted, interior a0 still serves
+
+
+def test_all_pinned_pool_stays_over_budget_without_spinning():
+    pc = PrefixCache(token_budget=4, block_tokens=4)
+    pc.publish(_toks(1, 2, 3, 4), 1, lambda d: d)
+    held = pc.match(_toks(1, 2, 3, 4))
+    # over budget with everything pinned: publish must return, not spin
+    pc.publish(_toks(7, 8, 9, 10), 1, lambda d: d)
+    assert pc.stats()["size_tokens"] >= 4
+    held.release()
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="block_tokens"):
+        PrefixCache(16, block_tokens=0)
+    with pytest.raises(ValueError, match="token_budget"):
+        PrefixCache(0, block_tokens=4)
