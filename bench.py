@@ -1087,8 +1087,10 @@ def bench_serving_shared_prefix(n_requests=None, families=None,
 
     eng_off, out_off = run_once(None)
     eng_on, out_on = run_once(cache_tokens)
-    # reuse must never change what any request decodes to
-    assert out_on == out_off, "prefix cache changed greedy outputs"
+    # reuse must never change what any request decodes to — a hard
+    # raise, not a bare assert: the acceptance gate must survive -O
+    if out_on != out_off:
+        raise RuntimeError("prefix cache changed greedy outputs")
     rep_off, rep_on = eng_off.metrics.report(), eng_on.metrics.report()
     pc = eng_on.prefix_cache.stats()
     return {

@@ -1,0 +1,81 @@
+"""paddle_tpu.analysis — custom static analyzers for this codebase.
+
+Three analyzers over one shared diagnostic framework (stable codes,
+file:line anchors, checked-in baseline in `baseline.txt`):
+
+  * program verifier  (`program_lint`)  P001-P006 — validates
+    Program/Block/Operator IR the way the reference's C++ ProgramDesc
+    checks did, before the executor lowers it
+  * trace-hazard linter (`trace_lint`)  T001-T004 — AST pass over the
+    jitted hot paths for host-sync / retrace / impurity hazards inside
+    traced functions
+  * lock-discipline linter (`lock_lint`) L001-L002 — learns guarded
+    attributes from `# guarded-by:` annotations and checks mutations +
+    lock-acquisition ordering
+
+Run everything:  python -m paddle_tpu.analysis --all
+One analyzer:    python -m paddle_tpu.analysis program <entry.py>
+                 python -m paddle_tpu.analysis trace [files...]
+                 python -m paddle_tpu.analysis locks [paths...]
+
+The tier-1 test
+`tests/test_static_analysis.py::test_repo_is_clean_modulo_baseline`
+asserts `run_all()` reports nothing beyond the baseline — new code
+cannot merge with a fresh finding.
+
+This package deliberately imports nothing heavy at module level: the
+trace/lock linters are pure-AST and must run without jax. The program
+verifier imports the fluid IR lazily.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .diagnostics import (  # noqa: F401
+    CODES,
+    Diagnostic,
+    ProgramVerifyError,
+    default_baseline_path,
+    format_diag,
+    load_baseline,
+    split_new,
+)
+
+__all__ = [
+    "Diagnostic", "ProgramVerifyError", "CODES", "run_all",
+    "collect_diagnostics", "load_baseline", "split_new", "format_diag",
+    "default_baseline_path",
+]
+
+
+def collect_diagnostics(with_programs: bool = True) -> List[Diagnostic]:
+    """Run every analyzer over the repo and return the raw findings —
+    the ONE assembly point shared by run_all() and the CLI's --all, so
+    the tier-1 self-check and the lint gate cannot diverge."""
+    from . import lock_lint, trace_lint
+
+    diags: List[Diagnostic] = []
+    if with_programs:
+        from .entries import verify_entries
+
+        diags.extend(verify_entries())
+    diags.extend(trace_lint.lint_paths())
+    diags.extend(lock_lint.lint_paths())
+    return diags
+
+
+def run_all(baseline_path: Optional[str] = None,
+            with_programs: bool = True,
+            ) -> Tuple[List[Diagnostic], List[Diagnostic], List[str]]:
+    """Run every analyzer over the repo; returns (new, baselined,
+    stale_baseline_entries). `with_programs=False` skips the built-in
+    program entries (they import jax via fluid)."""
+    diags = collect_diagnostics(with_programs)
+    baseline = load_baseline(baseline_path)
+    new, old, stale = split_new(diags, baseline)
+    if not with_programs:
+        # the program verifier did not run: its baseline entries are
+        # out of scope, not stale (same scoping the CLI applies)
+        stale = [fp for fp in stale if fp[:1] in ("T", "L")]
+    return new, old, stale

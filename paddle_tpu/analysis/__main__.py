@@ -1,0 +1,208 @@
+"""CLI for paddle_tpu.analysis.
+
+    python -m paddle_tpu.analysis --all
+    python -m paddle_tpu.analysis program path/to/entry.py [--fetch NAME]
+    python -m paddle_tpu.analysis trace [files...]
+    python -m paddle_tpu.analysis locks [files-or-dirs...]
+
+Exit status: 0 when every finding is covered by the baseline
+(`paddle_tpu/analysis/baseline.txt` unless --baseline overrides) and
+no baseline entry is stale, 1 on a NEW finding or a stale entry
+(the tier-1 self-check rejects both), 2 on usage errors.
+`--write-baseline` rewrites the baseline to accept the current
+findings (each entry still needs a hand-written justification —
+the tool writes a TODO marker you must replace).
+
+`program <entry.py>` executes the file (it is expected to build into
+`fluid.default_main_program()` — the normal shape of a model script)
+and verifies the resulting program; feeds are the program's `is_data`
+vars, fetches default to the last op's outputs or --fetch names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from typing import List
+
+from . import diagnostics
+from .diagnostics import Diagnostic, format_diag, load_baseline, split_new
+
+
+def _report(diags: List[Diagnostic], baseline_path, write_baseline,
+            scope=None, out=sys.stdout) -> int:
+    """`scope` limits STALE detection to the given code prefixes
+    ("P"/"T"/"L"): a partial run (one analyzer) must not read the other
+    analyzers' baseline entries as stale."""
+    baseline = load_baseline(baseline_path)
+    new, old, stale = split_new(diags, baseline)
+    # a TODO/empty justification is a defect of the baseline FILE, not
+    # of this run's findings — checked unscoped on every non-write run
+    unjustified = [fp for fp, why in baseline.items()
+                   if not why or "TODO" in why]
+    if scope is not None:
+        stale = [fp for fp in stale if fp[:1] in scope]
+    for d in old:
+        out.write(format_diag(d, baselined=True) + "\n")
+    for d in new:
+        out.write(format_diag(d) + "\n")
+    for fp in stale:
+        out.write("stale baseline entry (fix landed? remove it): %s\n"
+                  % fp)
+    if not write_baseline:
+        for fp in unjustified:
+            out.write("unjustified baseline entry (replace the TODO "
+                      "with a real reason): %s\n" % fp)
+    out.write("%d finding%s (%d new, %d baselined, %d stale baseline "
+              "entr%s)\n"
+              % (len(diags), "" if len(diags) == 1 else "s", len(new),
+                 len(old), len(stale), "y" if len(stale) == 1 else "ies"))
+    if write_baseline:
+        path = baseline_path or diagnostics.default_baseline_path()
+        with open(path, "w") as f:
+            f.write("# paddle_tpu.analysis baseline — accepted findings."
+                    "\n# Every entry MUST carry a one-line justification"
+                    " after '  #'.\n# Format: <CODE> <path>::<symbol>::"
+                    "<detail>  # <why this is accepted>\n")
+            written = set()
+            for d in sorted(diags, key=lambda d: d.fingerprint):
+                if d.fingerprint in written:
+                    continue  # one entry per fingerprint, not per site
+                written.add(d.fingerprint)
+                why = baseline.get(d.fingerprint,
+                                   "TODO: justify or fix")
+                f.write("%s  # %s\n" % (d.fingerprint, why))
+        out.write("baseline written: %s (%d entries)\n"
+                  % (path, len(written)))
+        return 0
+    # stale and TODO-justified entries fail too: the tier-1 self-check
+    # rejects both, so a green lint.sh must imply a green tier-1 gate
+    return 1 if (new or stale or unjustified) else 0
+
+
+def _cmd_program(args, baseline, write_baseline) -> int:
+    # the entry script either builds into the default programs (bare
+    # layer calls) or builds its own Program objects (the program_guard
+    # idiom) — verify BOTH: the guarded default pair and every Program
+    # left in the script's globals. An entry that built nothing is a
+    # usage error, never a silent '0 findings'.
+    sys.path.insert(0, os.path.dirname(os.path.abspath(args.entry)) or ".")
+    import paddle_tpu.fluid as fluid
+
+    from .program_lint import verify_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        mod = runpy.run_path(args.entry, run_name="__analysis__")
+    base = os.path.basename(args.entry)
+    programs = []
+    if main.global_block().ops:
+        programs.append(("<%s>" % base, main))
+    if startup.global_block().ops:
+        programs.append(("<%s:startup>" % base, startup))
+    seen = {id(p) for _, p in programs}
+    for name in sorted(mod):
+        val = mod[name]
+        if (isinstance(val, fluid.Program) and id(val) not in seen
+                and val.global_block().ops):
+            seen.add(id(val))
+            programs.append(("<%s:%s>" % (base, name), val))
+    if not programs:
+        sys.stderr.write(
+            "error: %s built no non-empty Program — build into the "
+            "default programs or leave your Program objects in module "
+            "globals\n" % args.entry)
+        return 2
+    diags = []
+    for label, prog in programs:
+        diags.extend(verify_program(prog, fetches=args.fetch or (),
+                                    label=label))
+    # an ad-hoc entry cannot assess baseline staleness at all
+    return _report(diags, baseline, write_baseline, scope=())
+
+
+def _lint_args_paths(lint_paths, paths):
+    """Run an AST linter over CLI paths; a typo'd path is a usage
+    error (exit 2), not a finding and not a traceback."""
+    try:
+        return lint_paths(paths or None)
+    except (FileNotFoundError, SyntaxError, ValueError) as e:
+        # SyntaxError: a non-parseable target file is equally a usage
+        # error, not "a new finding" and not a traceback
+        sys.stderr.write("error: %s\n" % e)
+        return None
+
+
+def _cmd_trace(args, baseline, write_baseline) -> int:
+    from .trace_lint import lint_paths
+
+    diags = _lint_args_paths(lint_paths, args.paths)
+    if diags is None:
+        return 2
+    # explicit paths lint a SUBSET of files: entries for unlinted files
+    # are out of scope, not stale — only the default full-scope run can
+    # judge staleness for its analyzer
+    return _report(diags, baseline, write_baseline,
+                   scope=() if args.paths else ("T",))
+
+
+def _cmd_locks(args, baseline, write_baseline) -> int:
+    from .lock_lint import lint_paths
+
+    diags = _lint_args_paths(lint_paths, args.paths)
+    if diags is None:
+        return 2
+    return _report(diags, baseline, write_baseline,
+                   scope=() if args.paths else ("L",))
+
+
+def _cmd_all(args, baseline, write_baseline) -> int:
+    from . import collect_diagnostics
+
+    return _report(collect_diagnostics(), baseline, write_baseline)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m paddle_tpu.analysis")
+    p.add_argument("--all", action="store_true",
+                   help="run every analyzer over the repo")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: packaged baseline.txt)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept current findings into the baseline")
+    sub = p.add_subparsers(dest="cmd")
+    sp = sub.add_parser("program", help="verify a program-building script")
+    sp.add_argument("entry")
+    sp.add_argument("--fetch", action="append", default=[])
+    st = sub.add_parser("trace", help="trace-hazard lint")
+    st.add_argument("paths", nargs="*")
+    sl = sub.add_parser("locks", help="lock-discipline lint")
+    sl.add_argument("paths", nargs="*")
+    args = p.parse_args(argv)
+
+    if args.write_baseline and not args.all and args.baseline is None:
+        # a partial run sees only its own analyzer's findings; writing
+        # the SHARED baseline from it would silently delete every other
+        # analyzer's justified entries
+        p.error("--write-baseline without --all would clobber the "
+                "shared baseline with a partial view; pass --all or an "
+                "explicit --baseline path")
+    # NO blanket try/except here: an entry script failing under
+    # `program` must surface its full traceback, not masquerade as a
+    # usage error (path typos are handled inside _cmd_trace/_cmd_locks)
+    if args.all:
+        return _cmd_all(args, args.baseline, args.write_baseline)
+    if args.cmd == "program":
+        return _cmd_program(args, args.baseline, args.write_baseline)
+    if args.cmd == "trace":
+        return _cmd_trace(args, args.baseline, args.write_baseline)
+    if args.cmd == "locks":
+        return _cmd_locks(args, args.baseline, args.write_baseline)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

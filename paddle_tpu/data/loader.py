@@ -277,25 +277,34 @@ class DataLoader(object):
         self.drop_last = drop_last
         self.auto_commit = auto_commit
         self.metrics = DataMetrics()
-        # cursor (captured by state_dict at batch boundaries)
-        self._epoch = 0
-        self._pos = 0          # local mode: chunks consumed this epoch
-        self._offset = 0       # records consumed within current chunk
-        self._inflight = None  # coordinated: reclaimable lease position
-        self._records_epoch = 0
-        self._batches_total = 0
+        # cursor (captured by state_dict at batch boundaries). The
+        # whole cursor is CONSUMER-thread state: the producer thread
+        # communicates through the bounded queue only and never touches
+        # it — lock_lint enforces the split via the `consumer` domain
+        # ('# thread: producer' methods must not mutate these).
+        self._epoch = 0        # guarded-by: consumer
+        self._pos = 0          # guarded-by: consumer
+        self._offset = 0       # guarded-by: consumer
+        self._inflight = None  # guarded-by: consumer
+        self._records_epoch = 0   # guarded-by: consumer
+        self._batches_total = 0   # guarded-by: consumer
         # uncommitted coordinator acks (flushed by commit())
-        self._pending_finish = []
-        self._pending_progress = None
-        self._batches_since_load = 0
-        self._lease_lost = False
-        self._exhausted = False  # epoch ended; iter() starts the next
-        # iteration machinery
-        self._pool = None
-        self._gen = None       # inline generator (num_workers == 0)
-        self._q = None
-        self._thread = None
-        self._stop = None
+        self._pending_finish = []       # guarded-by: consumer
+        self._pending_progress = None   # guarded-by: consumer
+        self._batches_since_load = 0    # guarded-by: consumer
+        self._lease_lost = False        # guarded-by: consumer
+        self._exhausted = False         # guarded-by: consumer
+        # iteration machinery (consumer-owned: the producer receives
+        # q/stop as call arguments and only READS self._pool to submit
+        # decodes; the consumer replaces/tears down _pool only after
+        # joining the producer — a producer outliving the 5 s join
+        # deadline in _abort_iteration is abandoned, not raced)
+        self._pool = None      # guarded-by: consumer
+        # inline generator (num_workers == 0)
+        self._gen = None       # guarded-by: consumer
+        self._q = None         # guarded-by: consumer
+        self._thread = None    # guarded-by: consumer
+        self._stop = None      # guarded-by: consumer
 
     # --- epoch / cursor ------------------------------------------------
     @property
@@ -406,7 +415,7 @@ class DataLoader(object):
         return self.dataset.load_chunk(plan.chunk_index, epoch=plan.epoch,
                                        skip=plan.skip)
 
-    def _pipelined_chunks(self, plans, stop):
+    def _pipelined_chunks(self, plans, stop):  # thread: producer
         """(plan, items) with up to ~2x num_workers chunk decodes in
         flight, results consumed strictly in plan order — parallel
         decode, deterministic delivery."""
@@ -486,7 +495,7 @@ class DataLoader(object):
                              if p.task_id is not None],
                 "inflight": None, "n": 0})
 
-    def _produce(self, epoch, pos, offset, inflight, q, stop):
+    def _produce(self, epoch, pos, offset, inflight, q, stop):  # thread: producer
         try:
             plans = self.source.plans(self.dataset, epoch, pos, offset,
                                       inflight)

@@ -87,18 +87,21 @@ class Coordinator(object):
         self.failure_max = failure_max
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.snapshot_path = snapshot_path
-        self.todo: List[Task] = []
-        self.pending: Dict[int, Task] = {}
-        self.done: List[Task] = []
-        self.discarded: List[Task] = []
-        self.epoch = 0
-        self._next_id = 0
+        # queue state below is served to many worker threads at once;
+        # every mutation must hold _lock (enforced by
+        # paddle_tpu.analysis lock_lint)
+        self.todo: List[Task] = []              # guarded-by: _lock
+        self.pending: Dict[int, Task] = {}      # guarded-by: _lock
+        self.done: List[Task] = []              # guarded-by: _lock
+        self.discarded: List[Task] = []         # guarded-by: _lock
+        self.epoch = 0                          # guarded-by: _lock
+        self._next_id = 0                       # guarded-by: _lock
         # worker liveness registry (reference: trainers announce
         # themselves in etcd and the master watches their keys,
         # go/pserver/etcd_client.go:70-150). Ephemeral BY DESIGN: a
         # restarted coordinator sees workers re-register on their next
         # heartbeat, so membership is not snapshotted.
-        self.workers: Dict[str, dict] = {}
+        self.workers: Dict[str, dict] = {}      # guarded-by: _lock
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
 
@@ -456,8 +459,11 @@ class RemoteCoordinator(object):
         )
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
-        self._sock = None
-        self._file = None
+        # the connection pair is swapped by the retry loop; _lock also
+        # serialises whole calls (one request/response in flight).
+        # close() is the accepted exception — see baseline.txt.
+        self._sock = None   # guarded-by: _lock
+        self._file = None   # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _connect(self, connect_timeout: Optional[float] = None):
@@ -560,6 +566,11 @@ class RemoteCoordinator(object):
         return self._call("membership")
 
     def close(self):
+        """Tear down the connection. Deliberately lock-free (baselined
+        L001): taking _lock here would block shutdown for up to the
+        full retry deadline behind an in-flight _call, and the
+        transport already tolerates a torn connection — a raced _call
+        attempt fails like a dropped wire and reconnects."""
         if self._file is not None:
             try:
                 self._file.close()

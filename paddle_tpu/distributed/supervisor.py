@@ -168,10 +168,17 @@ class Supervisor(object):
         self.spawn_grace_s = spawn_grace_s
         self.poll_s = poll_s
         self.membership_deadline_s = membership_deadline_s
+        # supervision state is single-threaded BY DESIGN (the whole
+        # point of the heartbeat/membership split: workers never talk
+        # to the supervisor). A future callback/timer method must
+        # declare its `# thread: <domain>` — lock_lint then flags its
+        # mutations of `supervisor`-domain state (undeclared methods
+        # are assumed to run on the owning domain).
         self.handles: Dict[str, WorkerHandle] = {
             wid: WorkerHandle(wid) for wid in self.worker_ids
-        }
-        self.events: List[dict] = []  # audit trail for tests/operators
+        }  # guarded-by: supervisor
+        # audit trail for tests/operators
+        self.events: List[dict] = []  # guarded-by: supervisor
 
     # --- internals ----------------------------------------------------
     def _event(self, kind: str, worker_id: str, **info):

@@ -217,6 +217,22 @@ class Executor(object):
 
         return get_default_mesh()
 
+    def _maybe_preflight(self, program, feed, fetch_list, force=False):
+        """Program-verifier pre-flight shared by EVERY run entry point
+        (run / run_repeated / run_grad_accum / run_async_local), so
+        PADDLE_TPU_VALIDATE=1 means what it says regardless of which
+        loop drives the program."""
+        if force or os.environ.get(
+                "PADDLE_TPU_VALIDATE", "") not in ("", "0"):
+            from ..analysis.program_lint import preflight
+
+            preflight(
+                program if program is not None
+                else core.default_main_program(),
+                feeds=list(feed or ()),
+                fetches=fetch_list or (),
+            )
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -228,7 +244,17 @@ class Executor(object):
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,
+        validate: bool = False,
     ):
+        """`validate=True` (or env PADDLE_TPU_VALIDATE=1) runs the
+        paddle_tpu.analysis program verifier as a pre-flight: a
+        malformed program (dangling input, dtype clash, duplicate
+        parameter, unpaired grad var) raises ProgramVerifyError with
+        P-coded findings BEFORE lowering, instead of surfacing as a
+        cryptic tracer error inside the compiled step. Memoized per
+        (program version, feed/fetch signature), so a cached training
+        loop pays one dict lookup per run."""
+        self._maybe_preflight(program, feed, fetch_list, force=validate)
         return self._execute(
             program, feed, fetch_list, scope, return_numpy,
             use_cache=use_program_cache, steps=None, scan_feeds=False,
@@ -250,6 +276,7 @@ class Executor(object):
         per-step batches (LoD side-bands are always broadcast); otherwise
         the same feed is reused each step. Fetches return stacked
         [steps, ...]."""
+        self._maybe_preflight(program, feed, fetch_list)
         return self._execute(
             program, feed, fetch_list, scope, return_numpy,
             use_cache=True, steps=int(steps), scan_feeds=scan_feeds,
@@ -275,6 +302,7 @@ class Executor(object):
         the full-batch step only for mean-reduced losses. A sum-reduced
         loss trains with gradients scaled by 1/micro_batches (a warning
         fires when the loss producer is a detectable sum reduction)."""
+        self._maybe_preflight(program, feed, fetch_list)
         from .core.lowering import build_accum_step_fn
 
         if self._resolve_mesh() is not None:
@@ -353,6 +381,7 @@ class Executor(object):
         dim; fetches return stacked [steps, ...], replica-averaged.
         Parameters land back in the scope as ordinary consensus arrays
         (checkpoint/save need no special handling)."""
+        self._maybe_preflight(program, feed, fetch_list)
         from ..parallel.async_sgd import build_local_sgd_fn
 
         if program is None:
@@ -672,21 +701,34 @@ def _finish_run(scope, fetch_names, fetches, new_persist, return_numpy):
 
 
 def _maybe_check_nan_inf(fetch_names, fetches, new_persist):
-    """FLAGS.check_nan_inf parity (reference executor.cc:30,132-140 scans
-    every op output per step; here the fused step's outputs and updated
-    persistables are scanned after each run)."""
+    """Opt-in runtime numerics guard: set PADDLE_TPU_CHECK_NUMERICS=1
+    (or the legacy FLAGS.check_nan_inf / PADDLE_FLAG_CHECK_NAN_INF)
+    and every run scans the step's fetches and updated persistables for
+    NaN/Inf, raising FloatingPointError that NAMES each offending var
+    and whether it was a fetch or a persistable — the runtime
+    counterpart of the static pre-flight (`validate=True`). Reference
+    parity: executor.cc:30,132-140 scanned every op output per step;
+    the fused XLA step has no per-op boundary, so the scan runs on the
+    step's outputs after each run. Off by default: the scan forces a
+    device->host copy of every fetched/updated array."""
     from ..utils import FLAGS
 
-    if not FLAGS.check_nan_inf:
+    if not (FLAGS.check_nan_inf or os.environ.get(
+            "PADDLE_TPU_CHECK_NUMERICS", "") not in ("", "0")):
         return
     bad = []
-    for name, v in list(zip(fetch_names, fetches)) + list(new_persist.items()):
-        arr = np.asarray(v)
-        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
-            bad.append(name)
+    for kind, pairs in (("fetch", list(zip(fetch_names, fetches))),
+                        ("persistable", list(new_persist.items()))):
+        for name, v in pairs:
+            arr = np.asarray(v)
+            if (np.issubdtype(arr.dtype, np.floating)
+                    and not np.isfinite(arr).all()):
+                n_bad = int(arr.size - np.isfinite(arr).sum())
+                bad.append("%s %r (%d/%d non-finite)"
+                           % (kind, name, n_bad, arr.size))
     if bad:
         raise FloatingPointError(
-            "check_nan_inf: non-finite values in %s" % ", ".join(sorted(bad))
+            "check_numerics: non-finite values in %s" % "; ".join(bad)
         )
 
 

@@ -137,6 +137,15 @@ class ServingEngine(object):
                  prefix_block_tokens=16):
         self._params = params
         self._cfg = cfg
+        if getattr(cfg, "moe_experts", 0):
+            # reference_moe's capacity cutoff couples rows: padded
+            # chunk rows would compete with real rows for expert slots
+            # and silently change real outputs (prefill_chunk
+            # docstring) — refuse loudly instead
+            raise ValueError(
+                "ServingEngine serves dense models only; MoE configs "
+                "(moe_experts > 0) are not bit-stable under "
+                "padded/chunked prefill")
         S = int(max_slots)
         if S < 1:
             raise ValueError("max_slots must be >= 1")
@@ -164,22 +173,27 @@ class ServingEngine(object):
 
         self._cache = tlm.init_kv_cache(cfg, S, max_len=L)
         # host-side truth of the per-slot side-bands; device copies are
-        # kept across steps and re-uploaded only when dirtied
-        self._tok = np.zeros(S, np.int32)     # last emitted, not yet cached
-        self._pos = np.zeros(S, np.int32)     # its write position
-        self._alive = np.zeros(S, bool)
-        self._temps = np.zeros(S, np.float32)
-        self._counts = np.zeros(S, np.int32)  # tokens generated so far
-        self._base_keys = np.zeros((S, 2), np.uint32)  # per-request keys
-        self._dev: Dict[str, Any] = {}
-        self._dirty = set(_BANDS)
-        self._slot_req: List[Optional[ServingHandle]] = [None] * S
+        # kept across steps and re-uploaded only when dirtied. All
+        # scheduler state below is confined to the thread driving
+        # step()/submit() (the engine has no background loop). A future
+        # background method must declare its `# thread: <domain>` —
+        # lock_lint then flags its mutations of scheduler state
+        # (undeclared methods are assumed to run on the owning domain).
+        self._tok = np.zeros(S, np.int32)     # guarded-by: scheduler
+        self._pos = np.zeros(S, np.int32)     # guarded-by: scheduler
+        self._alive = np.zeros(S, bool)       # guarded-by: scheduler
+        self._temps = np.zeros(S, np.float32)  # guarded-by: scheduler
+        self._counts = np.zeros(S, np.int32)  # guarded-by: scheduler
+        self._base_keys = np.zeros((S, 2), np.uint32)  # guarded-by: scheduler
+        self._dev: Dict[str, Any] = {}        # guarded-by: scheduler
+        self._dirty = set(_BANDS)             # guarded-by: scheduler
+        self._slot_req: List[Optional[ServingHandle]] = [None] * S  # guarded-by: scheduler
         # per-slot chunked-prefill cursors + FCFS order of pending slots
-        self._prefill_state: Dict[int, dict] = {}
-        self._prefill_q: collections.deque = collections.deque()
+        self._prefill_state: Dict[int, dict] = {}  # guarded-by: scheduler
+        self._prefill_q: collections.deque = collections.deque()  # guarded-by: scheduler
 
-        self._queue: collections.deque = collections.deque()
-        self._next_rid = 0
+        self._queue: collections.deque = collections.deque()  # guarded-by: scheduler
+        self._next_rid = 0                    # guarded-by: scheduler
         self._donate = bool(donate)
         self._chunk_fns: Dict[int, Any] = {}
         self._decode_fn = self._make_decode()
@@ -401,8 +415,12 @@ class ServingEngine(object):
             # concurrent publish cannot free a block mid-copy
             self.metrics.prefix_hit_tokens.append(matched)
         self._slot_req[s] = h
-        self._prefill_state[s] = {"handle": h, "cursor": matched,
-                                  "t0": time.monotonic()}
+        # the first-token sampling key is per-request, not per-chunk:
+        # computed once here, consumed on the prompt's final chunk
+        self._prefill_state[s] = {
+            "handle": h, "cursor": matched,
+            "key": jax.random.fold_in(jax.random.PRNGKey(h.seed), 0),
+        }
         self._prefill_q.append(s)
 
     def _publish(self, s: int, h: ServingHandle):
@@ -442,11 +460,10 @@ class ServingEngine(object):
         padded[:c] = h.prompt[cursor:cursor + c]
         fn = self._chunk_fn(Cb)
         t0 = time.monotonic()
-        key = jax.random.fold_in(jax.random.PRNGKey(h.seed), 0)
         self._cache, first = fn(
             self._params, self._cache, jnp.asarray(padded),
             jnp.int32(cursor), jnp.int32(s), jnp.int32(c),
-            jnp.float32(h.temperature), key,
+            jnp.float32(h.temperature), st["key"],
         )
         st["cursor"] = cursor + c
         self.metrics.prefill_chunks += 1

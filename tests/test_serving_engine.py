@@ -492,3 +492,12 @@ def test_slot_decode_step_vector_pos_matches_scalar_rows():
                 np.asarray(new_cache[li]["k"][row]),
                 np.asarray(want[row][1][li]["k"][0]),
             )
+
+
+def test_moe_config_rejected_loudly():
+    # reference_moe's capacity cutoff couples rows, so padded/chunked
+    # prefill is not bit-stable for MoE — the engine refuses instead of
+    # silently serving wrong tokens (PR 5 review hardening)
+    cfg, params = _mk(moe_experts=2)
+    with pytest.raises(ValueError, match="dense models only"):
+        ServingEngine(params, cfg, max_slots=2)

@@ -1,0 +1,839 @@
+"""paddle_tpu.analysis: program verifier + trace-hazard and
+lock-discipline linters (ISSUE 5).
+
+Three layers of coverage:
+
+  1. Seeded-defect corpus — for every diagnostic code, a minimal
+     malformed program / snippet file that must trigger EXACTLY that
+     code and nothing else, plus clean-corpus zero-findings cases.
+  2. Framework mechanics — baseline suppression, stale-entry
+     reporting, Executor.run(validate=True) pre-flight, and the
+     PADDLE_TPU_CHECK_NUMERICS runtime guard.
+  3. The tier-1 self-check — `run_all()` reports nothing beyond the
+     checked-in baseline (every entry justified, none stale) and the
+     CLI `python -m paddle_tpu.analysis --all` exits 0. New code
+     cannot merge with a fresh finding.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import (
+    ProgramVerifyError,
+    diagnostics,
+    format_diag,
+)
+from paddle_tpu.analysis import lock_lint, program_lint, trace_lint
+from paddle_tpu.analysis.entries import ENTRIES, build_entry
+from paddle_tpu.fluid.core.program import Parameter
+
+REPO = diagnostics.repo_root()
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+# ---------------------------------------------------------------------
+# 1a. program-verifier corpus: one malformed program per P-code
+# ---------------------------------------------------------------------
+
+
+def _data_var(block, name, shape, dtype="float32"):
+    return block.create_var(name=name, shape=shape, dtype=dtype,
+                            is_data=True)
+
+
+def test_p001_dangling_input():
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4,))
+    b.create_var(name="out", shape=(4,), dtype="float32")
+    b.append_op("elementwise_add", inputs={"X": ["x"], "Y": ["ghost"]},
+                outputs={"Out": ["out"]})
+    diags = program_lint.verify_program(p, fetches=["out"])
+    assert _codes(diags) == ["P001"]
+    assert "ghost" in diags[0].message
+
+
+def test_p002_dead_write():
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4,))
+    b.create_var(name="dead", shape=(4,), dtype="float32")
+    b.create_var(name="live", shape=(4,), dtype="float32")
+    b.append_op("square", inputs={"X": ["x"]}, outputs={"Out": ["dead"]})
+    b.append_op("square", inputs={"X": ["x"]}, outputs={"Out": ["live"]})
+    diags = program_lint.verify_program(p, fetches=["live"])
+    assert _codes(diags) == ["P002"]
+    assert "dead" in diags[0].detail
+
+
+def test_p003_dtype_mismatch():
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4,), "float32")
+    _data_var(b, "y", (4,), "int32")
+    b.create_var(name="out", shape=(4,), dtype="float32")
+    b.append_op("elementwise_add", inputs={"X": ["x"], "Y": ["y"]},
+                outputs={"Out": ["out"]})
+    diags = program_lint.verify_program(p, fetches=["out"])
+    assert _codes(diags) == ["P003"]
+
+
+def test_p004_shape_mismatch():
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4, 3))
+    _data_var(b, "y", (4, 2))
+    b.create_var(name="out", shape=(4, 3), dtype="float32")
+    b.append_op("elementwise_add", inputs={"X": ["x"], "Y": ["y"]},
+                outputs={"Out": ["out"]})
+    diags = program_lint.verify_program(p, fetches=["out"])
+    assert _codes(diags) == ["P004"]
+
+
+def test_p004_broadcast_is_not_a_mismatch():
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4, 3))
+    _data_var(b, "y", (1, 3))  # broadcastable; batch -1 also exempt
+    b.create_var(name="out", shape=(4, 3), dtype="float32")
+    b.append_op("elementwise_add", inputs={"X": ["x"], "Y": ["y"]},
+                outputs={"Out": ["out"]})
+    assert program_lint.verify_program(p, fetches=["out"]) == []
+
+
+def test_p005_duplicate_parameter():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_parameter(name="w", shape=(2,), dtype="float32")
+    b1 = p.create_block()
+    b1.vars["w"] = Parameter(b1, shape=(2,), dtype="float32", name="w")
+    assert _codes(program_lint.verify_program(p)) == ["P005"]
+
+
+def test_p006_unpaired_grad():
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4,))
+    b.create_var(name="phantom@GRAD", shape=(4,), dtype="float32")
+    b.append_op("square", inputs={"X": ["x"]},
+                outputs={"Out": ["phantom@GRAD"]})
+    diags = program_lint.verify_program(p, fetches=["phantom@GRAD"])
+    assert _codes(diags) == ["P006"]
+
+
+def test_clean_program_corpus_zero_findings():
+    # every built-in entry (real layer stack + backward + optimizer)
+    # must verify clean — the dogfood bar
+    for name in ENTRIES:
+        main, startup, feeds, fetches = build_entry(name)
+        assert program_lint.verify_program(
+            main, feeds=feeds, fetches=fetches, label=name) == []
+        assert program_lint.verify_program(
+            startup, label=name + ":startup") == []
+
+
+def test_sub_block_reads_outer_names():
+    # a sub-block op reading a name produced BEFORE the owning op is
+    # fine; reading one produced AFTER it is dangling
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4,))
+    b.create_var(name="pre", shape=(4,), dtype="float32")
+    b.append_op("square", inputs={"X": ["x"]}, outputs={"Out": ["pre"]})
+    sub = p.create_block()
+    sub.create_var(name="s_out", shape=(4,), dtype="float32")
+    sub.append_op("square", inputs={"X": ["pre"]},
+                  outputs={"Out": ["s_out"]})
+    p.current_block_idx = 0
+    b.append_op("while", inputs={}, outputs={},
+                attrs={"sub_block": sub.idx})
+    b.create_var(name="late", shape=(4,), dtype="float32")
+    b.append_op("square", inputs={"X": ["x"]}, outputs={"Out": ["late"]})
+    assert program_lint.verify_program(p, fetches=["s_out", "late"]) == []
+    # now make the sub-block read 'late' (produced after the while op);
+    # 'pre' joins the fetches so the rewire leaves exactly one defect
+    sub.ops[0].inputs["X"] = ["late"]
+    diags = program_lint.verify_program(
+        p, fetches=["s_out", "late", "pre"])
+    assert _codes(diags) == ["P001"]
+
+
+# ---------------------------------------------------------------------
+# 1b. trace-hazard corpus: one snippet file per T-code
+# ---------------------------------------------------------------------
+
+def _trace_codes(tmp_path, name, src):
+    f = tmp_path / name
+    f.write_text(src)
+    return _codes(trace_lint.lint_file(str(f)))
+
+
+def test_t001_host_sync(tmp_path):
+    assert _trace_codes(tmp_path, "t001.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return jnp.sin(x) + float(x)\n"
+        "g = jax.jit(f)\n"
+    )) == ["T001"]
+
+
+def test_t001_item_and_np_asarray(tmp_path):
+    codes = _trace_codes(tmp_path, "t001b.py", (
+        "import jax\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    return np.asarray(x).sum() + x.item()\n"
+        "g = jax.jit(f)\n"
+    ))
+    assert codes == ["T001", "T001"]
+
+
+def test_t002_impure_call(tmp_path):
+    assert _trace_codes(tmp_path, "t002.py", (
+        "import jax\n"
+        "import time\n"
+        "def f(x):\n"
+        "    return x * time.time()\n"
+        "g = jax.jit(f)\n"
+    )) == ["T002"]
+
+
+def test_t003_tracer_branch_in_scan_body(tmp_path):
+    assert _trace_codes(tmp_path, "t003.py", (
+        "from jax import lax\n"
+        "def outer(xs):\n"
+        "    def body(carry, x):\n"
+        "        if x > 0:\n"
+        "            carry = carry + x\n"
+        "        return carry, x\n"
+        "    return lax.scan(body, 0.0, xs)\n"
+    )) == ["T003"]
+
+
+def test_t004_unhashable_static_arg(tmp_path):
+    assert _trace_codes(tmp_path, "t004.py", (
+        "import jax\n"
+        "def f(x, opts=[]):\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnums=(1,))\n"
+    )) == ["T004"]
+
+
+def test_t004_decorator_form(tmp_path):
+    # @partial(jax.jit, static_argnames=...) — the common decorator
+    # idiom gets the same T004 coverage as the call form
+    assert _trace_codes(tmp_path, "t004b.py", (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, static_argnames=('opts',))\n"
+        "def f(x, opts={}):\n"
+        "    return x\n"
+    )) == ["T004"]
+
+
+def test_t004_keyword_only_param(tmp_path):
+    assert _trace_codes(tmp_path, "t004c.py", (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, static_argnames=('opts',))\n"
+        "def f(x, *, opts={}):\n"
+        "    return x\n"
+    )) == ["T004"]
+
+
+def test_trace_clean_corpus(tmp_path):
+    # static accessors, is-None tests, jnp aliases, host code OUTSIDE
+    # the traced function: all clean
+    assert _trace_codes(tmp_path, "clean.py", (
+        "import time\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(x, mask=None):\n"
+        "    if mask is None:\n"
+        "        mask = jnp.ones_like(x)\n"
+        "    if x.ndim == 2:\n"
+        "        x = x[None]\n"
+        "    heads = int(x.shape[1] // 2)  # static shape math: no sync\n"
+        "    y = jnp.asarray(x)  # jnp, not np: no host sync\n"
+        "    return y * mask * float(len(x.shape))\n"
+        "g = jax.jit(f)\n"
+        "def host(xs):\n"
+        "    t0 = time.time()  # untraced: fine\n"
+        "    out = g(np.asarray(xs))\n"
+        "    return out, time.time() - t0\n"
+    )) == []
+
+
+def test_trace_detects_keyword_form_markers(tmp_path):
+    # lax.while_loop(cond_fun=..., body_fun=...) traces its operands
+    # exactly like the positional form
+    assert _trace_codes(tmp_path, "kw.py", (
+        "import time\n"
+        "from jax import lax\n"
+        "def cond(s):\n"
+        "    return s[0] < 10\n"
+        "def body(s):\n"
+        "    return (s[0] + 1, s[1] * time.time())\n"
+        "def run(s):\n"
+        "    return lax.while_loop(cond_fun=cond, body_fun=body,\n"
+        "                          init_val=s)\n"
+    )) == ["T002"]
+
+
+def test_trace_nested_def_calls_resolve_in_their_own_scope(tmp_path):
+    # a nested def's local helper shadows a same-named module function;
+    # the module one (with the host-sync) is never traced
+    assert _trace_codes(tmp_path, "nest.py", (
+        "import time\n"
+        "import jax\n"
+        "def h():\n"
+        "    return time.time()  # host-side, untraced: not flagged\n"
+        "def outer(x):\n"
+        "    def inner(y):\n"
+        "        def h():\n"
+        "            return 1.0\n"
+        "        return y * h()\n"
+        "    return inner(x)\n"
+        "g = jax.jit(outer)\n"
+    )) == []
+
+
+def test_trace_resolves_past_class_scope(tmp_path):
+    # Python name lookup skips class bodies: a bare `helper(x)` in a
+    # jitted method-local fn calls the MODULE helper (whose float() is
+    # the real hazard), never the same-named sibling method
+    assert _trace_codes(tmp_path, "scope.py", (
+        "import jax\n"
+        "def helper(x):\n"
+        "    return float(x)\n"
+        "class Engine:\n"
+        "    def helper(self):\n"
+        "        return bool(self)  # untraced: must NOT be flagged\n"
+        "    def make(self):\n"
+        "        def step(x):\n"
+        "            return helper(x)\n"
+        "        return jax.jit(step)\n"
+    )) == ["T001"]
+
+
+def test_trace_propagates_through_local_calls(tmp_path):
+    # the hazard is in a helper the jitted function calls — still found
+    assert _trace_codes(tmp_path, "prop.py", (
+        "import jax\n"
+        "def helper(x):\n"
+        "    return float(x)\n"
+        "def f(x):\n"
+        "    return helper(x)\n"
+        "g = jax.jit(f)\n"
+    )) == ["T001"]
+
+
+# ---------------------------------------------------------------------
+# 1c. lock-discipline corpus: one snippet file per L-code
+# ---------------------------------------------------------------------
+
+def _lock_codes(tmp_path, name, src):
+    f = tmp_path / name
+    f.write_text(src)
+    return _codes(lock_lint.lint_file(str(f)))
+
+
+_L001_SRC = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.items = []  # guarded-by: _lock\n"
+    "    def add(self, x):\n"
+    "        self.items.append(x)\n"
+)
+
+
+def test_l001_unguarded_mutation(tmp_path):
+    assert _lock_codes(tmp_path, "l001.py", _L001_SRC) == ["L001"]
+
+
+def test_l001_wrong_thread_domain(tmp_path):
+    assert _lock_codes(tmp_path, "l001b.py", (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._pos = 0  # guarded-by: consumer\n"
+        "    def step(self):  # thread: producer\n"
+        "        self._pos += 1\n"
+    )) == ["L001"]
+
+
+def test_l002_lock_order_inversion(tmp_path):
+    assert _lock_codes(tmp_path, "l002.py", (
+        "import threading\n"
+        "class D:\n"
+        "    def __init__(self):\n"
+        "        self.a = threading.Lock()\n"
+        "        self.b = threading.Lock()\n"
+        "    def m1(self):\n"
+        "        with self.a:\n"
+        "            with self.b:\n"
+        "                pass\n"
+        "    def m2(self):\n"
+        "        with self.b:\n"
+        "            self._inner()\n"
+        "    def _inner(self):\n"
+        "        with self.a:\n"
+        "            pass\n"
+    )) == ["L002"]
+
+
+def test_l001_domain_inferred_through_call_graph(tmp_path):
+    # a private helper called ONLY from a producer-declared method
+    # inherits the producer domain — mutating consumer state there is
+    # the same race as doing it in the caller
+    assert _lock_codes(tmp_path, "dom.py", (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._pos = 0  # guarded-by: consumer\n"
+        "    def run(self):  # thread: producer\n"
+        "        self._helper()\n"
+        "    def _helper(self):\n"
+        "        self._pos = 99\n"
+    )) == ["L001"]
+
+
+def test_domain_not_inferred_for_mixed_callers(tmp_path):
+    # called from both a producer method and an undeclared (consumer)
+    # method: domain is ambiguous, so no finding (the inline
+    # num_workers==0 loader path is exactly this shape)
+    assert _lock_codes(tmp_path, "mix.py", (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._pos = 0  # guarded-by: consumer\n"
+        "    def run(self):  # thread: producer\n"
+        "        self._helper()\n"
+        "    def step(self):\n"
+        "        self._helper()\n"
+        "    def _helper(self):\n"
+        "        self._pos += 1\n"
+    )) == []
+
+
+def test_bare_annotation_is_not_a_mutation(tmp_path):
+    # `self.items: list` (no value) declares, it does not mutate
+    assert _lock_codes(tmp_path, "ann.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []  # guarded-by: _lock\n"
+        "    def describe(self):\n"
+        "        self.items: list\n"
+        "        return len(self.items)\n"
+    )) == []
+
+
+def test_lock_annotation_placeholder_ignored(tmp_path):
+    # the docs' template form `# guarded-by: <lock>` must neither crash
+    # the linter nor register a guard
+    assert _lock_codes(tmp_path, "ph.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []  # guarded-by: <lock>\n"
+        "    def add(self, x):  # thread: <domain>\n"
+        "        self.items.append(x)\n"
+    )) == []
+
+
+def test_lock_lint_walks_match_and_except_suites(tmp_path):
+    # case/except bodies are statement suites: a locked mutation inside
+    # one is clean, an unguarded one is exactly one L001
+    assert _lock_codes(tmp_path, "match.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.todo = []  # guarded-by: _lock\n"
+        "    def ok(self, msg):\n"
+        "        match msg:\n"
+        "            case 'add':\n"
+        "                with self._lock:\n"
+        "                    self.todo.append(msg)\n"
+        "        try:\n"
+        "            pass\n"
+        "        except ValueError:\n"
+        "            with self._lock:\n"
+        "                self.todo.append(msg)\n"
+        "    def bad(self, msg):\n"
+        "        match msg:\n"
+        "            case 'add':\n"
+        "                self.todo.append(msg)\n"
+    )) == ["L001"]
+
+
+def test_lock_lint_scans_case_guard_and_except_type(tmp_path):
+    # mutator calls hiding in a case guard expression are still
+    # mutations of guarded state
+    assert _lock_codes(tmp_path, "guard.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []  # guarded-by: _lock\n"
+        "    def bad(self, x):\n"
+        "        match x:\n"
+        "            case _ if self.items.pop():\n"
+        "                pass\n"
+    )) == ["L001"]
+
+
+def test_lambda_mutation_is_deferred_not_guarded(tmp_path):
+    # a lambda handed to an executor under the lock runs LATER without
+    # it: its guarded-attr mutation must flag even though the submit
+    # site lexically sits inside `with self._lock:`
+    assert _lock_codes(tmp_path, "lam.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.q = []  # guarded-by: _lock\n"
+        "        self.pool = None\n"
+        "    def defer(self, x):\n"
+        "        with self._lock:\n"
+        "            self.pool.submit(lambda: self.q.append(x))\n"
+    )) == ["L001"]
+
+
+def test_baseline_single_space_separator_tolerated(tmp_path):
+    src_file = tmp_path / "l001.py"
+    src_file.write_text(_L001_SRC)
+    diags = lock_lint.lint_file(str(src_file))
+    bl = tmp_path / "bl.txt"
+    # a hand-edit normalised the canonical two spaces to one
+    bl.write_text("%s # justified with one space\n"
+                  % diags[0].fingerprint)
+    baseline = analysis.load_baseline(str(bl))
+    new, old, stale = analysis.split_new(diags, baseline)
+    assert new == [] and stale == []
+    assert baseline[diags[0].fingerprint] == "justified with one space"
+
+
+def test_lock_clean_corpus(tmp_path):
+    # mutations under the lock, a private helper whose only call sites
+    # hold it, a `# holds:` contract, and construction in __init__
+    assert _lock_codes(tmp_path, "clean.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []  # guarded-by: _lock\n"
+        "        self.items.append(0)\n"
+        "    def add(self, x):\n"
+        "        with self._lock:\n"
+        "            self.items.append(x)\n"
+        "            self._trim()\n"
+        "    def _trim(self):\n"
+        "        del self.items[:-10]\n"
+        "    def _flush_locked(self):  # holds: _lock\n"
+        "        self.items.clear()\n"
+    )) == []
+
+
+# ---------------------------------------------------------------------
+# 2. framework mechanics
+# ---------------------------------------------------------------------
+
+def test_baseline_suppression_and_stale(tmp_path):
+    src_file = tmp_path / "l001.py"
+    src_file.write_text(_L001_SRC)
+    diags = lock_lint.lint_file(str(src_file))
+    assert _codes(diags) == ["L001"]
+    baseline_file = tmp_path / "baseline.txt"
+    baseline_file.write_text(
+        "# test baseline\n"
+        "%s  # justified for the test\n"
+        "T001 gone.py::f::float  # a stale entry\n" % diags[0].fingerprint
+    )
+    baseline = analysis.load_baseline(str(baseline_file))
+    new, old, stale = analysis.split_new(diags, baseline)
+    assert new == [] and _codes(old) == ["L001"]
+    assert stale == ["T001 gone.py::f::float"]
+
+
+def test_fingerprint_is_line_number_free(tmp_path):
+    (tmp_path / "a.py").write_text(_L001_SRC)
+    f1 = lock_lint.lint_file(str(tmp_path / "a.py"))[0]
+    (tmp_path / "b.py").write_text("# a comment shifting lines\n\n"
+                                   + _L001_SRC)
+    f2 = lock_lint.lint_file(str(tmp_path / "b.py"))[0]
+    assert f1.line != f2.line
+    assert f1.fingerprint.split("::", 1)[1] == \
+        f2.fingerprint.split("::", 1)[1]
+
+
+def test_executor_validate_preflight():
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4,))
+    b.create_var(name="out", shape=(4,), dtype="float32")
+    b.append_op("elementwise_add", inputs={"X": ["x"], "Y": ["ghost"]},
+                outputs={"Out": ["out"]})
+    exe = fluid.Executor()
+    with pytest.raises(ProgramVerifyError) as ei:
+        exe.run(p, feed={"x": np.ones(4, np.float32)},
+                fetch_list=["out"], validate=True)
+    assert "P001" in str(ei.value) and "ghost" in str(ei.value)
+
+
+def test_executor_validate_env_var(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "1")
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4,))
+    b.create_var(name="out", shape=(4,), dtype="float32")
+    b.append_op("square", inputs={"X": ["x"]}, outputs={"Out": ["out"]})
+    exe = fluid.Executor()
+    # clean program: env-forced validation passes and the run works
+    res = exe.run(p, feed={"x": 2 * np.ones(4, np.float32)},
+                  fetch_list=["out"])
+    assert np.allclose(res[0], 4.0)
+
+
+def test_env_validate_covers_every_run_entry_point(monkeypatch):
+    # PADDLE_TPU_VALIDATE must mean what it says on run_repeated /
+    # run_grad_accum too, not just run()
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "1")
+    p = fluid.Program()
+    b = p.global_block()
+    _data_var(b, "x", (4,))
+    b.create_var(name="out", shape=(4,), dtype="float32")
+    b.append_op("elementwise_add", inputs={"X": ["x"], "Y": ["ghost"]},
+                outputs={"Out": ["out"]})
+    exe = fluid.Executor()
+    feed = {"x": np.ones(4, np.float32)}
+    with pytest.raises(ProgramVerifyError):
+        exe.run_repeated(p, feed=feed, fetch_list=["out"], steps=2)
+    with pytest.raises(ProgramVerifyError):
+        exe.run_grad_accum(p, feed=feed, fetch_list=["out"],
+                           micro_batches=2)
+
+
+def test_run_all_without_programs_scopes_stale(tmp_path):
+    # a jax-less run_all(with_programs=False) must not read P-code
+    # baseline entries as stale — the program verifier never ran
+    bl = tmp_path / "bl.txt"
+    bl.write_text(
+        "P001 <x>::block0::op:ghost  # program-scope entry\n"
+        + "".join("%s  # kept\n" % fp
+                  for fp in analysis.load_baseline()))
+    new, old, stale = analysis.run_all(baseline_path=str(bl),
+                                       with_programs=False)
+    assert new == [] and stale == []
+
+
+def test_check_numerics_names_offending_fetch(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        m = fluid.layers.mean(x=x)
+    exe = fluid.Executor()
+    bad = {"x": np.array([[1.0, np.nan, 2.0, 3.0]], np.float32)}
+    with pytest.raises(FloatingPointError) as ei:
+        exe.run(main, feed=bad, fetch_list=[m])
+    # the guard names the offending fetch var, not just "NaN somewhere"
+    assert m.name in str(ei.value) and "fetch" in str(ei.value)
+    # finite feeds pass with the guard on
+    ok = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                 fetch_list=[m])
+    assert np.allclose(ok[0], 1.0)
+
+
+# ---------------------------------------------------------------------
+# 3. tier-1 self-check: the repo is clean modulo the baseline
+# ---------------------------------------------------------------------
+
+def test_repo_is_clean_modulo_baseline():
+    new, old, stale = analysis.run_all()
+    assert new == [], "new static-analysis findings:\n" + "\n".join(
+        format_diag(d) for d in new)
+    assert stale == [], "stale baseline entries (fix landed? remove " \
+        "them): %r" % stale
+
+
+def test_baseline_entries_are_justified():
+    baseline = analysis.load_baseline()
+    for fp, why in baseline.items():
+        assert why and "TODO" not in why, (
+            "baseline entry without a real justification: %s" % fp)
+
+
+def test_cli_all_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--all"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new" in proc.stdout
+
+
+def test_cli_write_baseline_refuses_partial_clobber(tmp_path):
+    # a single-analyzer run must not rewrite the SHARED baseline (it
+    # would silently delete the other analyzers' justified entries);
+    # an explicit --baseline path is the sanctioned escape hatch
+    f = tmp_path / "bad.py"
+    f.write_text(_L001_SRC)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--write-baseline",
+         "locks", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "clobber" in proc.stderr
+    # two mutation sites share one fingerprint: the written baseline
+    # must carry ONE entry per fingerprint, not one per site
+    f.write_text(_L001_SRC + "    def add2(self, x):\n"
+                             "        self.items.append(x)\n"
+                             "        self.items.append(x)\n")
+    own = tmp_path / "own_baseline.txt"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis",
+         "--baseline", str(own), "--write-baseline", "locks", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    lines = [l for l in own.read_text().splitlines()
+             if l and not l.startswith("#")]
+    assert len(lines) == 2  # C.add and C.add2, each once
+    assert all("L001" in l for l in lines)
+
+
+def test_cli_bad_path_is_usage_error(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "trace",
+         str(tmp_path / "does_not_exist.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "no such file" in proc.stderr
+    assert "Traceback" not in proc.stderr
+    # a non-parseable target is equally a usage error, not a traceback
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "trace",
+         str(broken)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "Traceback" not in proc.stderr
+
+
+def test_cli_fails_on_todo_justification(tmp_path):
+    # an accepted finding with the --write-baseline TODO marker still
+    # fails the gate: lint.sh green must imply tier-1 green
+    f = tmp_path / "bad.py"
+    f.write_text(_L001_SRC)
+    diags = lock_lint.lint_file(str(f))
+    bl = tmp_path / "bl.txt"
+    bl.write_text("%s  # TODO: justify or fix\n" % diags[0].fingerprint)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis",
+         "--baseline", str(bl), "locks", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "unjustified baseline entry" in proc.stdout
+
+
+def test_cli_nonzero_on_fresh_finding(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(_L001_SRC)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "locks", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "L001" in proc.stdout
+
+
+def test_cli_program_verifies_guarded_own_programs(tmp_path):
+    # the program_guard idiom: Programs built by the script (not the
+    # CLI's default pair) are found in module globals and verified —
+    # a malformed one cannot slip through as '0 findings'
+    entry = tmp_path / "train.py"
+    entry.write_text(
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "import paddle_tpu.fluid as fluid\n"
+        "main, startup = fluid.Program(), fluid.Program()\n"
+        "with fluid.program_guard(main, startup):\n"
+        "    x = fluid.layers.data('x', shape=[4], dtype='float32')\n"
+        "b = main.global_block()\n"
+        "b.create_var(name='out', shape=(4,), dtype='float32')\n"
+        "b.append_op('elementwise_add',\n"
+        "            inputs={'X': ['x'], 'Y': ['ghost']},\n"
+        "            outputs={'Out': ['out']})\n" % REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "program",
+         str(entry), "--fetch", "out"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "P001" in proc.stdout and "ghost" in proc.stdout
+    # and an entry that builds NOTHING is a usage error, not a pass
+    empty = tmp_path / "empty.py"
+    empty.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "program",
+         str(empty)],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 2
+    assert "no non-empty Program" in proc.stderr
+
+
+def test_cli_partial_path_run_skips_stale_check():
+    # linting a path SUBSET cannot judge staleness: baseline entries
+    # for files outside the subset are out of scope, and the run must
+    # exit 0 on a clean tree with the shipped baseline
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "locks",
+         "paddle_tpu/data"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stale baseline entry" not in proc.stdout
+    assert "0 stale" in proc.stdout
+
+
+def test_cli_nonzero_on_stale_entry_within_scope(tmp_path):
+    # a stale entry FAILS the full-scope gate (the tier-1 self-check
+    # rejects it, so a green lint run must imply a green tier-1) — but
+    # only within the running analyzer's scope: a `locks` run must not
+    # read P/T baseline entries as stale
+    real = analysis.load_baseline()
+    bl = tmp_path / "bl.txt"
+    bl.write_text(
+        "".join("%s  # kept\n" % fp for fp in real
+                if fp.startswith("L"))
+        + "L001 gone.py::C.add::items  # fixed long ago\n"
+        + "T003 other.py::f::x  # belongs to the trace analyzer\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis",
+         "--baseline", str(bl), "locks"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "0 new" in proc.stdout
+    assert "stale" in proc.stdout and "L001 gone.py" in proc.stdout
+    assert "T003 other.py" not in proc.stdout

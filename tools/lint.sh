@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Repo static-analysis gate: program verifier + trace-hazard and
+# lock-discipline linters (paddle_tpu.analysis, ISSUE 5).
+#
+# Exits non-zero on any finding not covered by
+# paddle_tpu/analysis/baseline.txt. Run it before committing; the
+# tier-1 suite enforces the same invariant
+# (tests/test_static_analysis.py::test_repo_is_clean_modulo_baseline).
+#
+# To accept a finding instead of fixing it:
+#   python -m paddle_tpu.analysis --all --write-baseline
+# then REPLACE every 'TODO: justify or fix' marker with a real one-line
+# justification (a tier-1 test rejects TODO markers).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+# the program entries import jax via fluid; lint runs host-only
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+exec python -m paddle_tpu.analysis --all "$@"
