@@ -39,6 +39,14 @@ definitions):
               a common header, run with the prefix KV pool off vs on;
               reports prefill-tokens-computed both ways, hit rate, and
               TTFT; greedy outputs must match between runs
+  serving_fleet — fault-tolerant fleet acceptance (ISSUE 6): the same
+              fixed-seed shared-header Poisson trace through a
+              single replica, an N=3 fleet with prefix-affinity
+              routing + a mid-trace kill drill, and an N=3 fleet with
+              affinity off; reports requests lost (must be 0),
+              duplicate completions (must be 0), failovers, the
+              fleet-wide prefix reuse contrast, and tok/s vs the N×1
+              ideal; outputs must be token-identical across all runs
   input_pipeline — host-side loader overlap (paddle_tpu/data):
               RecordShard shards -> ShardedDataset -> DataLoader on a
               fixed-seed synthetic trace, prefetch OFF (synchronous
@@ -1122,6 +1130,175 @@ def bench_serving_shared_prefix(n_requests=None, families=None,
     }
 
 
+def bench_serving_fleet(n_replicas=None, n_requests=None, families=None,
+                        header_len=None, family_len=None, max_slots=None,
+                        dim=None, heads=None, layers_n=None, vocab=None,
+                        max_len=None, chunk_tokens=None, block_tokens=None,
+                        cache_tokens=None, kill_replica=0):
+    """Serving-fleet acceptance trace (ISSUE 6): the SAME fixed-seed
+    Poisson shared-header trace runs through (a) a single-replica
+    fleet (the N=1 baseline row), (b) an N-replica fleet with prefix
+    AFFINITY routing and a kill drill — replica `kill_replica` is
+    killed mid-trace once a third of the paced requests completed —
+    and (c) an N-replica fleet with affinity OFF (undisturbed). The
+    deterministic offline columns: requests lost (MUST be 0 — the
+    drill's whole point), duplicate completions (must be 0), and
+    failovers (must be 1 in the drill). The fleet-wide prefix reuse
+    contrast (tokens saved / prefill tokens computed, affinity on vs
+    off) is REPORTED but timing-dependent: least-loaded routing under
+    concurrent load depends on replica-thread scheduling, and the
+    kill erases one replica's pool mid-trace — the strict on>off
+    inequality is pinned by the no-kill drill in
+    tests/test_serving_fleet.py instead.
+    Outputs must be token-identical across all three runs (hard raise
+    in-bench: neither replication, routing, nor failover may change
+    what a request decodes to). tokens/s and the speedup-vs-N×1 ratio
+    are only meaningful on-chip — on CPU the replica threads share the
+    GIL and one chip's compute, like every serving row here. A warm
+    wave (one request per family, concurrent) precedes the paced trace
+    so compiles and pool publication happen before measurement starts,
+    matching the steady state the fleet serves in."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import ServingFleet
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape: 3 fleets' worth of tiny engines, seconds each
+        dim, heads, layers_n = dim or 64, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 256, max_len or 128
+        n_replicas = n_replicas or 3
+        n_requests, families = n_requests or 12, families or 3
+        header_len, family_len = header_len or 16, family_len or 8
+        max_slots = max_slots or 2
+        t_lo, t_hi, n_lo, n_hi, rate = 3, 8, 4, 10, 0.5
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_replicas = n_replicas or 3
+        n_requests, families = n_requests or 48, families or 3
+        header_len, family_len = header_len or 256, family_len or 64
+        max_slots = max_slots or 8
+        t_lo, t_hi, n_lo, n_hi, rate = 16, 64, 32, 128, 0.5
+        dtype = jnp.bfloat16
+    chunk_tokens = chunk_tokens or max(16, header_len // 2)
+    block_tokens = block_tokens or max(4, header_len // 4)
+    cache_tokens = cache_tokens or 4 * (header_len + family_len)
+    pub = header_len + family_len
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    header = rng.randint(0, vocab, header_len).astype(np.int32)
+    fam = [rng.randint(0, vocab, family_len).astype(np.int32)
+           for _ in range(families)]
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    # warm wave: one request per family (published headers + compiled
+    # buckets), then the paced Poisson trace
+    warm = []
+    for f in range(families):
+        tail = rng.randint(0, vocab,
+                           int(rng.randint(t_lo, t_hi + 1))).astype(np.int32)
+        warm.append((np.concatenate([header, fam[f], tail]),
+                     int(rng.randint(n_lo, n_hi + 1))))
+    reqs = []
+    for _ in range(n_requests):
+        f = int(rng.randint(families))
+        tail = rng.randint(0, vocab,
+                           int(rng.randint(t_lo, t_hi + 1))).astype(np.int32)
+        reqs.append((np.concatenate([header, fam[f], tail]),
+                     int(rng.randint(n_lo, n_hi + 1))))
+
+    def run_once(n_reps, affinity, kill_at=None):
+        fleet = ServingFleet(
+            params, cfg, n_replicas=n_reps, affinity=affinity,
+            heartbeat_timeout_s=120.0,
+            max_pending=2 * (n_requests + families),
+            engine_kw={"max_slots": max_slots,
+                       "prefill_chunk_tokens": chunk_tokens,
+                       "prefix_cache_tokens": cache_tokens,
+                       "prefix_block_tokens": block_tokens})
+        try:
+            ws = [fleet.submit(p, n, publish_len=pub) for p, n in warm]
+            for h in ws:
+                h.result(timeout=600)
+            t0 = time.time()
+            hs, i, step, killed = [], 0, 0, False
+            while True:
+                while i < n_requests and arrive_at[i] <= step:
+                    p, n = reqs[i]
+                    hs.append(fleet.submit(p, n, publish_len=pub))
+                    i += 1
+                if kill_at is not None and not killed \
+                        and sum(h.done for h in hs) >= kill_at:
+                    fleet.kill_replica(kill_replica)
+                    killed = True
+                if i >= n_requests and all(h.done for h in hs):
+                    break
+                time.sleep(0.004)
+                step += 1
+            for h in hs:
+                h.result(timeout=600)  # raises if anything was lost
+            wall = time.time() - t0
+            time.sleep(0.2)  # final replica-stats sync
+            st = fleet.stats()
+            toks = sum(len(h.tokens) for h in hs)
+            return st, [list(h.tokens) for h in ws + hs], toks / wall
+        finally:
+            fleet.close()
+
+    st_1, out_1, tps_1 = run_once(1, affinity=True)
+    kill_at = max(1, n_requests // 3)
+    st_on, out_on, tps_on = run_once(n_replicas, affinity=True,
+                                     kill_at=kill_at)
+    st_off, out_off, tps_off = run_once(n_replicas, affinity=False)
+    if not (out_1 == out_on == out_off):
+        raise RuntimeError(
+            "fleet outputs diverge across replication/affinity/kill runs")
+    if st_on["lost"] or st_off["lost"] or st_1["lost"]:
+        raise RuntimeError("fleet lost requests: %r" % (
+            (st_1["lost"], st_on["lost"], st_off["lost"]),))
+    return {
+        # the drill columns (deterministic offline): nothing lost,
+        # nothing double-answered, exactly one failover
+        "requests_lost": st_on["lost"],
+        "duplicate_completions": st_on["duplicate_refused"],
+        "failovers": st_on["failovers"],
+        "resubmitted": st_on["resubmitted"],
+        "completed": st_on["completed"],
+        # fleet-wide prefix reuse: affinity keeps families hot
+        "prefix_tokens_saved_affinity_on": st_on["prefix_tokens_saved"],
+        "prefix_tokens_saved_affinity_off": st_off["prefix_tokens_saved"],
+        "prefill_tokens_computed_on": st_on["prefill_tokens_computed"],
+        "prefill_tokens_computed_off": st_off["prefill_tokens_computed"],
+        "prefix_hit_rate_on": st_on["prefix_hit_rate"],
+        "prefix_hit_rate_off": st_off["prefix_hit_rate"],
+        # throughput (on-chip meaningful; CPU shares one chip + GIL)
+        "tokens_per_sec_single": round(tps_1, 1),
+        "tokens_per_sec_fleet": round(tps_on, 1),
+        "tokens_per_sec_fleet_no_kill": round(tps_off, 1),
+        "speedup_vs_single": round(tps_on / tps_1, 3) if tps_1 else None,
+        "ideal_speedup": n_replicas,
+        "n_replicas": n_replicas,
+        "n_requests": n_requests,
+        "kill_drill": {"replica": kill_replica, "after_completed": kill_at},
+        "arrival": "poisson(rate=%g/step, seed=0)" % rate,
+        "knobs": {"max_slots": max_slots,
+                  "prefill_chunk_tokens": chunk_tokens,
+                  "prefix_block_tokens": block_tokens,
+                  "prefix_cache_tokens": cache_tokens,
+                  "publish_len": pub},
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
 def bench_input_pipeline(n_shards=4, chunks_per_shard=8,
                          records_per_chunk=64, batch=64, step_s=0.004,
                          decode_sleep_s=0.0001, num_workers=2,
@@ -1590,6 +1767,11 @@ def main():
         # trace with the pool off vs on — prefill-tokens-computed and
         # hit rate are deterministic offline, TTFT deltas on-chip
         run("serving_shared_prefix", bench_serving_shared_prefix)
+        # serving fleet (ISSUE 6): N replicas + kill drill on the same
+        # fixed-seed shared-header trace — requests lost / duplicates /
+        # failovers and the affinity-routing reuse contrast are
+        # deterministic offline; tokens/s and speedup-vs-N×1 on-chip
+        run("serving_fleet", bench_serving_fleet)
         run("transformer_lm", bench_transformer_lm)
         # larger-matmul flagship: dim=1024 keeps every matmul MXU-shaped
         # (the dim=512 row leaves lane headroom), so this is the MFU

@@ -50,6 +50,7 @@ __all__ = ["lint_file", "lint_paths", "HOT_PATHS"]
 HOT_PATHS = [
     "paddle_tpu/models/transformer.py",
     "paddle_tpu/serving/engine.py",
+    "paddle_tpu/serving/fleet.py",
     "paddle_tpu/fluid/executor.py",
     "paddle_tpu/fluid/core/lowering.py",
 ]

@@ -20,6 +20,19 @@ A fault spec is a comma-separated string, e.g.::
 The trainer CLI ticks its injector once per batch when PADDLE_FAULT is
 set; worker scripts call `default_injector().tick()` wherever their
 step boundary is.
+
+Serving semantics (ISSUE 6): `ServingEngine.step()` is a step boundary
+too — when PADDLE_FAULT is set, every scheduler step (one admission +
+prefill-chunk + batched-decode round) ticks the default injector, so
+`kill@N` SIGKILLs a serving replica mid-decode, `delay@N:dur` turns it
+into a straggler that misses its fleet heartbeat deadline (zombie
+drill), and `exc@N` crashes the replica thread in-process. Fleet kill
+drills count on this: N is a deterministic engine-step index on a
+fixed-seed trace, so the fault lands with requests in flight and the
+journal-resubmit/failover path is exercised, not the happy path. An
+engine can also be handed its OWN `FaultInjector` (the in-process fleet
+drills do, one per replica) — the env-driven default stays process-wide
+on purpose, like a host-level fault.
 """
 
 from __future__ import annotations
@@ -136,6 +149,19 @@ class FaultInjector(object):
     @property
     def active(self) -> bool:
         return bool(self.faults)
+
+    def arm(self, spec: str, relative: bool = True):
+        """Add faults mid-run. With `relative=True` (default) the @N
+        indices count from the CURRENT step — `arm("delay@3:1.0")`
+        fires three ticks from now. Drills use this to warm a system up
+        (compile, prime caches) under no faults and then schedule the
+        fault at a deterministic step of the measured phase, without
+        hand-counting the warm-up's ticks."""
+        new = _parse(spec)
+        if relative:
+            for f in new:
+                f.step += self.step
+        self.faults.extend(new)
 
     def tick(self):
         """Advance one step; fire any fault scheduled for it."""

@@ -1,14 +1,26 @@
 """Continuous-batching inference serving (ISSUE 2 tentpole + ISSUE 4
-prefix reuse): slotted KV cache + prefix-cached chunked prefill + one
-compiled decode step over models/transformer.py's cached-decode
-primitives. See engine.py for the design story, prefix_cache.py for the
-trie-keyed KV pool, and tests/test_serving_engine.py for the
-correctness bar (greedy outputs bit-identical to sequential
-generate() on every hit/miss/partial-hit/eviction path)."""
+prefix reuse + ISSUE 6 fleet): slotted KV cache + prefix-cached chunked
+prefill + one compiled decode step over models/transformer.py's
+cached-decode primitives, replicated behind a fault-tolerant front
+door. See engine.py for the engine design story, prefix_cache.py for
+the trie-keyed KV pool, fleet.py for the supervised replica fleet
+(durable request journal, incarnation-fenced failover, prefix-affinity
+routing, backpressure), and tests/test_serving_engine.py +
+tests/test_serving_fleet.py for the correctness bars (token identity
+vs sequential generate(); zero requests lost or answered twice under
+kill drills)."""
 
-from .engine import ServingEngine, ServingHandle
+from .engine import EngineFailed, ServingEngine, ServingHandle
+from .fleet import (
+    FleetHandle,
+    FleetSaturated,
+    RequestJournal,
+    ServingFleet,
+)
 from .metrics import ServingMetrics
-from .prefix_cache import PrefixCache, PrefixMatch
+from .prefix_cache import PrefixCache, PrefixMatch, chain_keys
 
 __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
-           "PrefixCache", "PrefixMatch"]
+           "PrefixCache", "PrefixMatch", "chain_keys", "EngineFailed",
+           "ServingFleet", "FleetHandle", "FleetSaturated",
+           "RequestJournal"]

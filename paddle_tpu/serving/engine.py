@@ -61,6 +61,7 @@ generate(temperature>0).
 from __future__ import annotations
 
 import collections
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -68,14 +69,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..distributed import fault_injection as _fi
 from ..fluid.core.kernels_sequence import bucket_pow2
 from ..models import transformer as tlm
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 
-__all__ = ["ServingEngine", "ServingHandle"]
+__all__ = ["ServingEngine", "ServingHandle", "EngineFailed"]
 
 _BANDS = ("tok", "pos", "alive", "temps", "counts", "base_keys")
+
+
+class EngineFailed(RuntimeError):
+    """The engine (or the fleet replica driving it) died with requests
+    pending. Raised by `ServingHandle.result()` instead of blocking
+    forever, and by `ServingEngine.step()` on every call after the
+    failure (the compiled steps donate their cache buffers, so a step
+    that died mid-dispatch leaves the cache unusable — the latch keeps
+    a half-donated cache from being stepped again). `replica` names the
+    failing replica when the engine serves inside a fleet."""
+
+    def __init__(self, msg: str, replica=None):
+        super().__init__(msg)
+        self.replica = replica
 
 
 class ServingHandle(object):
@@ -98,14 +114,25 @@ class ServingHandle(object):
         self.tokens: List[int] = []  # generated tokens (may include eos)
         self.done = False
         self.finish_reason: Optional[str] = None  # 'eos' | 'budget'
+        # set by ServingEngine.abort() when the engine dies with this
+        # request pending: result() raises it instead of spinning on a
+        # dead engine forever (ISSUE 6 satellite)
+        self.error: Optional[BaseException] = None
         self.submit_t = time.monotonic()
         self.queue_wait_s: Optional[float] = None
         self.ttft_s: Optional[float] = None
 
     def result(self) -> np.ndarray:
         """Block (by stepping the engine) until done; returns the full
-        sequence [T0 + n_generated] — prompt then generated tokens."""
+        sequence [T0 + n_generated] — prompt then generated tokens.
+        Raises `EngineFailed` (naming the failing replica when the
+        engine serves in a fleet) if the engine died with this request
+        pending — including when a BACKGROUND thread owned the engine
+        and crashed: the failure is propagated into the handle, never
+        an indefinite block."""
         while not self.done:
+            if self.error is not None:
+                raise self.error
             if not self._engine.step():
                 raise RuntimeError(
                     "engine made no progress but request %r is not done"
@@ -134,7 +161,8 @@ class ServingEngine(object):
     def __init__(self, params, cfg, max_slots=8, max_len=None,
                  min_bucket=8, max_prefills_per_step=None, donate=True,
                  prefill_chunk_tokens=None, prefix_cache_tokens=None,
-                 prefix_block_tokens=16):
+                 prefix_block_tokens=16, replica_id=None,
+                 fault_injector=None):
         self._params = params
         self._cfg = cfg
         if getattr(cfg, "moe_experts", 0):
@@ -199,6 +227,15 @@ class ServingEngine(object):
         self._decode_fn = self._make_decode()
         self._copy_fn = None
         self._extract_fn = None
+        # failure latch (abort() docstring) + fleet attribution
+        self.replica_id = replica_id
+        self._failed: Optional[EngineFailed] = None  # guarded-by: scheduler
+        # fault-injection tick source for step(): an explicit injector
+        # (fleet drills give each replica its own), or — resolved
+        # lazily on the first step — the process-wide default_injector
+        # when PADDLE_FAULT is set, else an inert one (same contract as
+        # the trainer CLI's per-batch tick; see fault_injection.py)
+        self._injector = fault_injector       # guarded-by: scheduler
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -492,6 +529,28 @@ class ServingEngine(object):
         self._emit(s, first)  # may retire immediately (max_new==1 / eos)
         return True
 
+    def abort(self, exc: BaseException):
+        """Latch the engine as failed and propagate `exc` into every
+        pending handle (queued, prefilling, or decoding): their
+        `result()` raises instead of blocking forever. Called
+        internally when a step dies, and externally by whatever thread
+        drives the engine (a fleet replica loop) when IT dies between
+        steps. Idempotent; the first failure wins."""
+        if self._failed is None:
+            if isinstance(exc, EngineFailed):
+                self._failed = exc
+            else:
+                self._failed = EngineFailed(
+                    "engine%s failed: %r" % (
+                        "" if self.replica_id is None
+                        else " (replica %s)" % self.replica_id,
+                        exc),
+                    replica=self.replica_id)
+                self._failed.__cause__ = exc
+        for h in list(self._queue) + list(self._slot_req):
+            if h is not None and not h.done and h.error is None:
+                h.error = self._failed
+
     def step(self) -> bool:
         """One scheduler iteration: admit queued requests into free
         slots (prefix match + device copy), advance pending prefills by
@@ -499,7 +558,32 @@ class ServingEngine(object):
         decode advancing every live slot; retirements free slots for
         the next step's admissions. Returns False when there was
         nothing to do (queue empty, no pending prefill, no live
-        slots)."""
+        slots).
+
+        Each call ticks the fault injector (PADDLE_FAULT, or the
+        engine's own `fault_injector`) BEFORE doing work, so
+        `kill@N`/`exc@N`/`delay@N:dur` specs land mid-decode — the
+        fleet kill drills' step boundary. Any failure (injected or
+        real) aborts every pending handle and latches the engine: the
+        compiled steps donate their cache buffers, so a step that died
+        mid-dispatch must never run again on the half-donated cache."""
+        if self._failed is not None:
+            raise self._failed
+        inj = self._injector
+        if inj is None:
+            inj = self._injector = (
+                _fi.default_injector()
+                if os.environ.get(_fi.ENV_VAR) else _fi.FaultInjector("")
+            )
+        try:
+            if inj.active:
+                inj.tick()
+            return self._step_inner()
+        except Exception as exc:
+            self.abort(exc)
+            raise
+
+    def _step_inner(self) -> bool:
         progressed = False
         while self._queue:
             s = self._free_slot()

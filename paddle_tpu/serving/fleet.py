@@ -1,0 +1,1145 @@
+"""Fault-tolerant serving fleet: N supervised `ServingEngine` replicas
+behind one front door (ISSUE 6; ROADMAP item 3).
+
+The reference's cloud layer exists so that *training* survives any
+single process dying: the Go master leases tasks with timeouts and
+fencing, etcd TTL keys detect dead trainers, and the cluster controller
+respawns them (go/master/service.go, go/pserver/etcd_client.go). PR 1
+rebuilt those primitives for trainers — coordinator heartbeats,
+incarnation-fenced membership, lease generations, supervisor
+restart/backoff. This module points the same control plane at
+*inference*: one `ServingFleet` owns N engine replicas (in-process
+threads here; a subprocess mode through `distributed/supervisor.py`
+below for kill drills), and a crash mid-decode loses nothing.
+
+Guarantees (the PR-1 drills' falsifiability bar, recast for serving):
+
+  * No request lost — every `submit()` lands in a durable REQUEST
+    JOURNAL before it is routed; when a replica dies (crash, hang past
+    the heartbeat deadline, or drill kill), its queued + in-flight
+    requests are recovered FROM THE JOURNAL and resubmitted to
+    survivors. Outputs are token-identical to sequential `generate()`
+    no matter which replica (or how many replicas, in sequence) ran
+    the request: the engine's per-request sampling keys depend only on
+    (seed, token index), never on slot or replica assignment.
+  * No request answered twice — completions are deduplicated by
+    request id, and a result reported by a replica that has been
+    declared dead is REFUSED (incarnation fencing: the registered
+    replica object + its incarnation are the liveness lease, exactly
+    the zombie-holder rule the coordinator's task leases enforce). A
+    stalled replica that wakes after failover cannot overwrite the
+    survivor's answer.
+  * Bounded admission — at most `max_pending` requests may be open
+    (queued + in-flight) fleet-wide; past that `submit()` raises
+    `FleetSaturated` instead of growing an unbounded queue. Explicit
+    load-shed is the backpressure contract: the CALLER decides what to
+    drop, the fleet never hides an hour of queue wait.
+  * Prefix-affinity routing — each replica's engine publishes a
+    host-side SUMMARY of its prefix pool (chained-crc block keys,
+    `prefix_cache.chain_keys`); routing sends a prompt to the replica
+    whose pool holds its longest cached prefix (ties: least loaded),
+    so shared-header families keep hitting the replica whose blocks
+    are hot and PR 4's prefill deletion becomes a fleet-wide number
+    (RadixAttention-style reuse, now across replicas).
+  * Drain/refill — `drain(i)` stops admitting to a replica, finishes
+    its in-flight work (publishing prefixes back to its pool as every
+    completed prefill does), then parks it; `refill(i)` brings a
+    DRAINED replica back with its engine — and prefix pool — warm, or
+    replaces a DEAD one with a fresh incarnation. Planned restarts
+    lose neither requests nor the hot prefix working set.
+  * SLO classes — `replica_slo` maps each replica to a class
+    ("interactive"/"batch"), and `slo_classes` maps the class onto the
+    engine's `max_prefills_per_step` (interactive = 1: flattest decode
+    latency; batch = None: maximum prefill throughput). `submit(slo=)`
+    routes within the class, falling back to any live replica before
+    failing — SLO is a preference, survival is a guarantee.
+
+Threading: all shared scheduler state lives on `ServingFleet` and is
+guarded by ONE condition's lock (`_cond`); replica threads and the
+monitor thread touch it only through fleet methods that take it.
+Engines (and their prefix tries) are confined to their replica's
+thread — the router sees pools only through the immutable summary sets
+handed over under the lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from .engine import EngineFailed, ServingEngine
+from .prefix_cache import chain_keys
+
+__all__ = [
+    "ServingFleet", "FleetHandle", "FleetSaturated", "RequestJournal",
+    "run_fleet_subprocess",
+]
+
+# replica lifecycle states
+_LIVE, _DRAINING, _DRAINED, _DEAD = "live", "draining", "drained", "dead"
+
+_DEFAULT_SLO_CLASSES = {
+    # interactive: one prefill chunk per step fleet-wide per replica —
+    # the flattest decode latency for that replica's neighbors (TTFT of
+    # long prompts pays); batch: every pending slot advances (highest
+    # prefill throughput, decode latency of neighbors pays)
+    "interactive": {"max_prefills_per_step": 1},
+    "batch": {"max_prefills_per_step": None},
+}
+
+
+class FleetSaturated(RuntimeError):
+    """`submit()` refused: the fleet already holds `max_pending` open
+    requests. Explicit load-shed — retry later or scale out; the fleet
+    never grows an unbounded admission queue."""
+
+
+class _KillDrill(RuntimeError):
+    """Injected replica death (ServingFleet.kill_replica)."""
+
+
+class FleetHandle(object):
+    """Per-request future filled in by whichever replica completes the
+    request (possibly a survivor after failover). Thread-safe: waiters
+    block on an event, never by driving an engine."""
+
+    def __init__(self, rid: int, prompt: np.ndarray, spec: dict,
+                 slo: Optional[str]):
+        self.rid = rid
+        self.prompt = prompt  # np.int32 [T0]
+        self.spec = spec      # JSON-able request record (journal form)
+        self.slo = slo
+        self.generation = 0   # bumped on every resubmission
+        self.tokens: Optional[List[int]] = None
+        self.replica: Optional[str] = None  # who answered
+        self.error: Optional[BaseException] = None
+        self.chain: List[int] = []  # affinity keys (set by the fleet)
+        self._event = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request completes somewhere in the fleet;
+        returns prompt + generated tokens. Raises `EngineFailed` if the
+        fleet lost every replica (or was closed) with this request
+        pending, `TimeoutError` on timeout."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                "request %d not completed within %r s" % (self.rid, timeout))
+        if self.error is not None:
+            raise self.error
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class RequestJournal(object):
+    """Durable request table: every submit/assign/done/rejected
+    transition is appended (JSON lines) BEFORE the fleet acts on it,
+    and mirrored in memory as the authoritative OPEN-request index
+    (terminal records prune their mirror entries, so memory is bounded
+    by in-flight work, not lifetime traffic). Failover reads the
+    journal mirror — `lost(replica, incarnation)` — not scheduler
+    guesswork. Opening an EXISTING journal replays it: the mirror
+    resumes the open set and `next_rid()` continues past every rid
+    ever issued, so a restarted front door appending to the same file
+    can never collide with (and thereby corrupt) the history.
+    `path=None` keeps the mirror only (tests); `recover(path)` is the
+    read-only restart helper.
+
+    Durability: records are flushed per append (they survive any
+    process death — the failure mode the fleet handles). `fsync=True`
+    additionally fsyncs each record for OS-crash/power-loss
+    durability, at per-request disk latency cost."""
+
+    def __init__(self, path: Optional[str] = None, fsync: bool = False):
+        self._lock = threading.Lock()
+        self.path = path
+        self.fsync = bool(fsync)
+        self._open_specs: Dict[int, dict] = {}       # guarded-by: _lock
+        self._assign: Dict[int, Tuple[str, int, int]] = {}  # guarded-by: _lock
+        self._done: Set[int] = set()                 # guarded-by: _lock
+        self._max_rid = -1                           # guarded-by: _lock
+        if path and os.path.exists(path):
+            self._replay_and_heal(path)
+        self._f = open(path, "a") if path else None  # guarded-by: _lock
+
+    @staticmethod
+    def _read(path: str):
+        """Parse a journal file, tolerating a TORN FINAL line (the
+        process died mid-append — the crash this journal exists to
+        survive must not make it unreadable). A malformed line
+        followed by valid records is real corruption and raises."""
+        pending_error = None
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                if pending_error is not None:
+                    raise ValueError(
+                        "corrupt journal %s: unparseable line %d is "
+                        "not a torn tail" % (path, pending_error))
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    pending_error = lineno  # torn IF nothing follows
+                    continue
+                yield rec
+
+    def _replay_and_heal(self, path: str):
+        """Replay an existing journal into the mirror and TRUNCATE a
+        torn final line: reopening in append mode would otherwise glue
+        the next record onto the partial text, turning a tolerated
+        torn tail into mid-file corruption for every later reader."""
+        good_end = 0
+        torn_at = None
+        with open(path, "rb") as f:
+            for lineno, raw in enumerate(f.readlines(), 1):
+                line = raw.decode("utf-8", "replace").strip()
+                if not line:
+                    if torn_at is None:
+                        good_end += len(raw)
+                    continue
+                if torn_at is not None:
+                    raise ValueError(
+                        "corrupt journal %s: unparseable line %d is "
+                        "not a torn tail" % (path, torn_at))
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn_at = lineno
+                    continue
+                self._replay(rec)
+                good_end += len(raw)
+        if torn_at is not None:
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+
+    def _replay(self, rec: dict):
+        rid = rec["rid"]
+        self._max_rid = max(self._max_rid, rid)
+        if rec["kind"] == "submit":
+            self._open_specs[rid] = rec["spec"]
+        elif rec["kind"] == "assign":
+            self._assign[rid] = (rec["replica"], rec["incarnation"],
+                                 rec["gen"])
+        elif rec["kind"] in ("done", "rejected"):
+            self._done.add(rid)
+            self._open_specs.pop(rid, None)
+            self._assign.pop(rid, None)
+
+    def _append(self, rec: dict):
+        if self._f is not None:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+
+    def next_rid(self) -> int:
+        """First rid safe to issue: past everything this journal file
+        has ever seen (restart-collision guard)."""
+        with self._lock:
+            return self._max_rid + 1
+
+    def submit(self, rid: int, spec: dict):
+        with self._lock:
+            self._open_specs[rid] = spec
+            self._max_rid = max(self._max_rid, rid)
+            self._append({"kind": "submit", "rid": rid, "spec": spec})
+
+    def assign(self, rid: int, replica: str, incarnation: int, gen: int,
+               defer: bool = False) -> Optional[dict]:
+        """Record an assignment. The MIRROR updates synchronously (a
+        failover consulting `lost()` an instant later must see it);
+        with `defer=True` the file append is returned as a record for
+        the caller to `write()` later — the fleet defers file I/O
+        until it has released its scheduler lock."""
+        rec = {"kind": "assign", "rid": rid, "replica": replica,
+               "incarnation": incarnation, "gen": gen}
+        with self._lock:
+            self._assign[rid] = (replica, incarnation, gen)
+            if defer:
+                return rec
+            self._append(rec)
+        return None
+
+    def complete(self, rid: int, replica: str, incarnation: int,
+                 gen: int, tokens: List[int],
+                 defer: bool = False) -> Optional[dict]:
+        rec = {"kind": "done", "rid": rid, "replica": replica,
+               "incarnation": incarnation, "gen": gen,
+               "tokens": list(tokens)}
+        with self._lock:
+            self._done.add(rid)
+            self._open_specs.pop(rid, None)
+            self._assign.pop(rid, None)
+            if defer:
+                return rec
+            self._append(rec)
+        return None
+
+    def write(self, recs: List[dict]):
+        """File-append records whose mirror updates already happened
+        (the deferred half of assign/complete)."""
+        with self._lock:
+            for rec in recs:
+                self._append(rec)
+
+    def reject(self, rid: int, reason: str,
+               defer: bool = False) -> Optional[dict]:
+        """Terminal record for a request that can never complete (a
+        malformed spec an engine refused, or no live replica to serve
+        it): without it the rid would stay open forever and every
+        future recover() would resubmit an unservable request."""
+        rec = {"kind": "rejected", "rid": rid, "reason": reason}
+        with self._lock:
+            self._done.add(rid)
+            self._open_specs.pop(rid, None)
+            self._assign.pop(rid, None)
+            if defer:
+                return rec
+            self._append(rec)
+        return None
+
+    def lost(self, replica: str, incarnation: int) -> List[Tuple[int, dict, int]]:
+        """(rid, spec, gen) of every OPEN request whose latest
+        assignment is (replica, incarnation) — the set a failover must
+        resubmit."""
+        with self._lock:
+            out = []
+            for rid, (rep, inc, gen) in sorted(self._assign.items()):
+                if rep == replica and inc == incarnation \
+                        and rid in self._open_specs:
+                    out.append((rid, self._open_specs[rid], gen))
+            return out
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._open_specs)
+
+    def is_done(self, rid: int) -> bool:
+        with self._lock:
+            return rid in self._done
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    @staticmethod
+    def recover(path: str) -> List[Tuple[int, dict]]:
+        """Rebuild the incomplete-request list from a journal file:
+        (rid, spec) for every submitted rid with no terminal
+        (done/rejected) record, in submission order. A restarted front
+        door resubmits exactly these — requests survive even a full
+        fleet-process crash."""
+        specs: Dict[int, dict] = {}
+        done: Set[int] = set()
+        for rec in RequestJournal._read(path):
+            if rec["kind"] == "submit":
+                specs[rec["rid"]] = rec["spec"]
+            elif rec["kind"] in ("done", "rejected"):
+                done.add(rec["rid"])
+        return [(rid, specs[rid]) for rid in sorted(specs)
+                if rid not in done]
+
+
+class _Replica(object):
+    """One engine replica: a thread that builds and exclusively owns a
+    `ServingEngine`, pulls work from the fleet, steps, and reports
+    completions. Identity (object + incarnation) IS the liveness lease
+    the fleet fences on. Everything here is confined to the replica
+    thread; the fleet reads only the immutable fields (name, index,
+    incarnation, slo)."""
+
+    def __init__(self, fleet: "ServingFleet", index: int, incarnation: int,
+                 slo: Optional[str], engine_kw: dict):
+        self.index = index
+        self.incarnation = incarnation
+        self.slo = slo
+        self.name = "r%d" % index
+        self._fleet = fleet
+        self._engine_kw = engine_kw
+        self.engine: Optional[ServingEngine] = None  # guarded-by: replica
+        self._serving: Dict[int, Any] = {}           # guarded-by: replica
+        self._pool_rev = (0, 0)                      # guarded-by: replica
+        self.thread = threading.Thread(
+            target=self._loop, name="fleet-%s-i%d" % (self.name, incarnation),
+            daemon=True)
+
+    def start(self):
+        self.thread.start()
+        return self
+
+    def _idle(self) -> bool:  # thread: replica
+        e = self.engine
+        return (not self._serving and e is not None
+                and not e.live_slots and not e.queue_depth
+                and not e.prefilling_slots)
+
+    def _pool_summary(self):  # thread: replica
+        """Rebuild the routing summary only when the pool changed (the
+        trie is thread-confined here; the summary set handed to the
+        fleet is immutable)."""
+        pc = self.engine.prefix_cache
+        if pc is None:
+            return None
+        rev = (pc.inserted_blocks, pc.evictions)
+        if rev == self._pool_rev:
+            return None
+        self._pool_rev = rev
+        return pc.summary()
+
+    def _loop(self):  # thread: replica
+        fleet = self._fleet
+        try:
+            self.engine = ServingEngine(
+                fleet._params, fleet._cfg, replica_id=self.name,
+                **self._engine_kw)
+            completed: List[Tuple[int, List[int]]] = []
+            while True:
+                cmd, work = fleet._sync(
+                    self, completed, idle=self._idle(),
+                    summary=self._pool_summary(), stats=self._stats())
+                completed = []
+                if cmd == "stop":
+                    return
+                for h in work:
+                    try:
+                        sh = self.engine.submit(
+                            h.prompt, h.spec["max_new_tokens"],
+                            temperature=h.spec["temperature"],
+                            eos_id=h.spec["eos_id"], seed=h.spec["seed"],
+                            publish_len=h.spec["publish_len"])
+                    except ValueError as exc:
+                        # a malformed request must fail ITSELF, not
+                        # crash-loop the replica through failover
+                        fleet._reject(h.rid, exc)
+                        continue
+                    self._serving[h.rid] = sh
+                if not self._idle():
+                    self.engine.step()
+                for rid, sh in list(self._serving.items()):
+                    if sh.done:
+                        completed.append((rid, list(sh.tokens)))
+                        del self._serving[rid]
+        except Exception as exc:  # crash -> failover (incl. _KillDrill)
+            if self.engine is not None:
+                self.engine.abort(exc)
+            self._fleet._on_crash(self, exc)
+
+    def _stats(self) -> Optional[dict]:  # thread: replica
+        e = self.engine
+        if e is None:
+            return None
+        m = e.metrics
+        out = {
+            "tokens_out": m.tokens_out,
+            "decode_steps": m.decode_steps,
+            "prefills": m.prefills,
+            "prefill_tokens_computed": m.prefill_tokens_computed,
+        }
+        if e.prefix_cache is not None:
+            out["prefix_hits"] = e.prefix_cache.hits
+            out["prefix_misses"] = e.prefix_cache.misses
+            out["prefix_tokens_saved"] = e.prefix_cache.tokens_saved
+        return out
+
+
+class ServingFleet(object):
+    """Front door over N `ServingEngine` replica threads. Knobs:
+
+      n_replicas           engine replicas (threads; one engine each)
+      journal_path         durable request journal (None = in-memory
+                           mirror only — failover still exact, but a
+                           whole-process crash loses the table); an
+                           existing file is replayed, so a restarted
+                           front door resumes rids past its history
+      journal_fsync        fsync every journal record (OS-crash
+                           durability) instead of flush-only
+                           (process-crash durability, the default —
+                           fsync costs per-request disk latency)
+      max_pending          fleet-wide bound on OPEN requests; past it
+                           submit() raises FleetSaturated (load-shed)
+      heartbeat_timeout_s  replica declared dead after this long
+                           without a scheduler-loop heartbeat; size it
+                           a few times the worst single engine step
+                           (first-compile included!) or a busy replica
+                           reads as dead (README sizing rule)
+      affinity             prefix-affinity routing on/off (off =
+                           least-loaded only)
+      replica_slo          per-replica SLO class name list
+                           ("interactive"/"batch"; None entry = serves
+                           any class); default: all wildcard
+      slo_classes          class -> engine-kw overrides (default maps
+                           interactive/batch onto max_prefills_per_step
+                           1/None)
+      engine_kw            base kwargs for every replica engine
+                           (max_slots, prefill_chunk_tokens,
+                           prefix_cache_tokens, ...)
+      engine_kw_for        optional fn(index) -> extra kwargs for one
+                           replica (drills inject per-replica
+                           FaultInjectors through this)
+      auto_refill          monitor replaces DEAD replicas with a fresh
+                           incarnation automatically (default False:
+                           drills and operators call refill())
+    """
+
+    def __init__(self, params, cfg, n_replicas=2, journal_path=None,
+                 journal_fsync=False, max_pending=64,
+                 heartbeat_timeout_s=30.0, monitor_interval_s=None,
+                 affinity=True, replica_slo=None, slo_classes=None,
+                 engine_kw=None, engine_kw_for=None, auto_refill=False):
+        if int(n_replicas) < 1:
+            raise ValueError("n_replicas must be >= 1")
+        if int(max_pending) < 1:
+            raise ValueError("max_pending must be >= 1")
+        self._params = params
+        self._cfg = cfg
+        self.n_replicas = int(n_replicas)
+        self.max_pending = int(max_pending)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.affinity = bool(affinity)
+        self.auto_refill = bool(auto_refill)
+        self.slo_classes = dict(_DEFAULT_SLO_CLASSES)
+        if slo_classes:
+            self.slo_classes.update(slo_classes)
+        if replica_slo is not None and len(replica_slo) != self.n_replicas:
+            raise ValueError("replica_slo must name a class per replica")
+        self._replica_slo = list(replica_slo or [None] * self.n_replicas)
+        for c in self._replica_slo:
+            if c is not None and c not in self.slo_classes:
+                raise ValueError("unknown SLO class %r" % c)
+        self._engine_kw = dict(engine_kw or {})
+        self._engine_kw_for = engine_kw_for
+        self.block_tokens = int(self._engine_kw.get(
+            "prefix_block_tokens", 16))
+        # chain keys only pay off when there is a pool to match: with
+        # no base prefix_cache_tokens every summary stays empty, so
+        # skip the per-submit O(T0) crc work entirely
+        self._chain_prompts = bool(affinity) and bool(
+            self._engine_kw.get("prefix_cache_tokens"))
+
+        # ONE lock for all fleet scheduler state (the condition owns
+        # it); replica + monitor threads mutate ONLY under it
+        self._cond = threading.Condition()
+        self._journal = RequestJournal(journal_path, fsync=journal_fsync)
+        self._replicas: List[_Replica] = []            # guarded-by: _cond
+        self._state: List[str] = []                    # guarded-by: _cond
+        self._beats: List[float] = []                  # guarded-by: _cond
+        self._kill: List[bool] = []                    # guarded-by: _cond
+        self._inbox: List[collections.deque] = []      # guarded-by: _cond
+        self._in_flight: List[Dict[int, FleetHandle]] = []  # guarded-by: _cond
+        self._summaries: List[Set[int]] = []           # guarded-by: _cond
+        self._rep_stats: List[Optional[dict]] = []     # guarded-by: _cond
+        # dead incarnations' last stats snapshots fold in here so
+        # fleet totals stay monotonic across failover/refill
+        self._stats_base: Dict[str, int] = {}          # guarded-by: _cond
+        self._spawned: List[float] = []                # guarded-by: _cond
+        self._rapid: List[int] = []                    # guarded-by: _cond
+        self._refill_at: List[float] = []              # guarded-by: _cond
+        self._incarnations: List[int] = []             # guarded-by: _cond
+        self._handles: Dict[int, FleetHandle] = {}     # guarded-by: _cond
+        self._open: Set[int] = set()                   # guarded-by: _cond
+        self._done_rids: Set[int] = set()              # guarded-by: _cond
+        # journal FILE records produced under the lock (mirror updates
+        # are synchronous); flushed by _flush_journal() after release
+        # so disk latency never stalls handshakes or the monitor.
+        # Completion events fire AFTER the flush: a caller observing a
+        # result implies its done record is already written
+        self._pending_journal: List[dict] = []         # guarded-by: _cond
+        self._pending_events: List[FleetHandle] = []   # guarded-by: _cond
+        # continue past an existing journal's history: a restarted
+        # front door appending to the same file must never reuse a rid
+        self._next_rid = self._journal.next_rid()      # guarded-by: _cond
+        self._closing = False                          # guarded-by: _cond
+        # O(1) counters (the ServingMetrics discipline)
+        self.submitted = 0                             # guarded-by: _cond
+        self.completed = 0                             # guarded-by: _cond
+        self.shed = 0                                  # guarded-by: _cond
+        self.rejected = 0                              # guarded-by: _cond
+        self.resubmitted = 0                           # guarded-by: _cond
+        self.failovers = 0                             # guarded-by: _cond
+        self.zombie_refused = 0                        # guarded-by: _cond
+        self.duplicate_refused = 0                     # guarded-by: _cond
+
+        self._idle_wait_s = min(0.02, self.heartbeat_timeout_s / 10.0)
+        self._monitor_interval_s = (
+            monitor_interval_s if monitor_interval_s is not None
+            else max(0.01, min(0.2, self.heartbeat_timeout_s / 5.0)))
+        with self._cond:
+            for i in range(self.n_replicas):
+                self._incarnations.append(1)
+                self._state.append(_LIVE)
+                self._beats.append(time.monotonic())
+                self._kill.append(False)
+                self._inbox.append(collections.deque())
+                self._in_flight.append({})
+                self._summaries.append(set())
+                self._rep_stats.append(None)
+                self._spawned.append(time.monotonic())
+                self._rapid.append(0)
+                self._refill_at.append(0.0)
+                self._replicas.append(self._make_replica(i, 1))
+        for r in self._replicas:
+            r.start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-monitor", daemon=True)
+        self._monitor.start()
+
+    # -- construction helpers -------------------------------------------
+    def _make_replica(self, index: int, incarnation: int) -> _Replica:
+        kw = dict(self._engine_kw)
+        slo = self._replica_slo[index]
+        if slo is not None:
+            kw.update(self.slo_classes[slo])
+        if self._engine_kw_for is not None:
+            kw.update(self._engine_kw_for(index) or {})
+        if self.affinity \
+                and int(kw.get("prefix_block_tokens", 16)) != self.block_tokens:
+            # chain keys are computed at the FLEET's block size; a
+            # replica caching at a different granularity would never
+            # match them and affinity would silently degrade to
+            # least-loaded — refuse loudly instead
+            raise ValueError(
+                "affinity routing requires a uniform prefix_block_tokens "
+                "across replicas (fleet %d, replica %d override %r)"
+                % (self.block_tokens, index, kw.get("prefix_block_tokens")))
+        return _Replica(self, index, incarnation, slo, kw)
+
+    # -- admission -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, temperature=0.0,
+               eos_id=None, seed=0, publish_len=None,
+               slo="interactive") -> FleetHandle:
+        """Journal the request durably, then route it (prefix affinity
+        within the SLO class). Raises `FleetSaturated` when
+        `max_pending` requests are already open — the shed request is
+        NOT journaled, so backpressure never grows the durable table
+        either."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # fail fast HERE with the engine's admission rule (including a
+        # base engine_kw max_len override): a request that cannot fit
+        # must error in the caller, not asynchronously at result()
+        L = min(int(self._engine_kw.get("max_len") or self._cfg.max_len),
+                int(self._params["pos"].shape[0]))
+        if prompt.shape[0] + int(max_new_tokens) > L:
+            raise ValueError(
+                "request needs T0+max_new <= max_len (%d + %d > %d)"
+                % (prompt.shape[0], int(max_new_tokens), L))
+        if publish_len is not None and publish_len < 0:
+            raise ValueError("publish_len must be >= 0 or None")
+        if slo is not None and slo not in self.slo_classes:
+            raise ValueError("unknown SLO class %r" % slo)
+        spec = {
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "eos_id": None if eos_id is None else int(eos_id),
+            "seed": int(seed),
+            "publish_len": None if publish_len is None else int(publish_len),
+            "slo": slo,
+        }
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("fleet is closed")
+            if len(self._open) >= self.max_pending:
+                self.shed += 1
+                raise FleetSaturated(
+                    "fleet saturated: %d open requests (max_pending=%d)"
+                    % (len(self._open), self.max_pending))
+            rid = self._next_rid
+            self._next_rid += 1
+            h = FleetHandle(rid, prompt, spec, slo)
+            if self._chain_prompts:  # keys feed ONLY affinity routing
+                h.chain = chain_keys(prompt, self.block_tokens)
+            self._handles[rid] = h
+            self._open.add(rid)
+            self.submitted += 1
+        # durable BEFORE routing — and OUTSIDE the fleet lock, so the
+        # journal's write+flush never stalls replica handshakes or the
+        # monitor behind disk latency
+        self._journal.submit(rid, spec)
+        try:
+            with self._cond:
+                if self._closing:
+                    # close() raced the journal write: it already
+                    # failed this handle (it was in _open). Terminal
+                    # record, or the journaled rid stays open and
+                    # every future recover() resubmits a request
+                    # whose caller was told it failed
+                    self._open.discard(rid)
+                    self._handles.pop(rid, None)
+                    self._done_rids.add(rid)
+                    self.rejected += 1
+                    self._pending_journal.append(self._journal.reject(
+                        rid, "fleet closed", defer=True))
+                    raise RuntimeError("fleet is closed")
+                self._route(h, exclude=None)
+        finally:
+            # also on the raises above: the terminal reject record
+            # must be on disk before the caller sees the error
+            self._flush_journal()
+        return h
+
+    def _route(self, h: FleetHandle, exclude: Optional[int]):
+        """Pick a replica for `h` (caller holds `_cond`): longest
+        cached-prefix match against the pool summaries, ties broken by
+        load; SLO class first, any live replica as fallback; no live
+        replica at all fails the handle."""
+        live = [i for i in range(self.n_replicas)
+                if self._state[i] == _LIVE and i != exclude]
+        cands = [i for i in live if self._replica_slo[i] in (None, h.slo)]
+        if not cands:
+            cands = live  # survival beats SLO placement
+        if not cands:
+            # terminal: the caller gets the error NOW, so the request
+            # must not stay open (journal-wise) to be resubmitted by
+            # every future recover(); prune like _accept does
+            h.error = EngineFailed(
+                "no live replica for request %d" % h.rid, replica=None)
+            self._open.discard(h.rid)
+            self._handles.pop(h.rid, None)
+            self._done_rids.add(h.rid)
+            self.rejected += 1
+            self._pending_journal.append(self._journal.reject(
+                h.rid, "no live replica", defer=True))
+            # event fires at flush, AFTER the reject record is on disk
+            # (submit's caller still gets the raise synchronously)
+            self._pending_events.append(h)
+            raise h.error
+        best, best_key = None, None
+        for i in cands:
+            depth = 0
+            if self.affinity and h.chain:
+                s = self._summaries[i]
+                for key in h.chain:
+                    if key not in s:
+                        break
+                    depth += 1
+            load = len(self._inbox[i]) + len(self._in_flight[i])
+            key = (-depth, load, i)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        rep = self._replicas[best]
+        self._inbox[best].append(h)
+        # mirror updates NOW (a failover consulting lost() must see
+        # this assignment); the file record flushes after the lock
+        self._pending_journal.append(self._journal.assign(
+            h.rid, rep.name, rep.incarnation, h.generation, defer=True))
+        self._cond.notify_all()
+
+    def _flush_journal(self):
+        """Write journal records produced under the lock, THEN release
+        the waiters whose completions those records describe — called
+        by every entry point after dropping the lock (submit, replica
+        syncs, monitor sweeps, drain, close). The ordering makes the
+        journal read-your-writes for anyone a result just unblocked."""
+        with self._cond:
+            if not self._pending_journal and not self._pending_events:
+                return
+            pending, self._pending_journal = self._pending_journal, []
+            fired, self._pending_events = self._pending_events, []
+        if pending:
+            self._journal.write(pending)
+        for h in fired:
+            h._event.set()
+
+    def _reject(self, rid: int, exc: Exception):
+        """A single malformed request failed engine admission: fail it
+        alone (called from replica threads), with a TERMINAL journal
+        record — an unservable request must not stay open forever and
+        be resubmitted by every future recover()."""
+        with self._cond:
+            h = self._handles.pop(rid, None)
+            if h is None or h.done:
+                return
+            h.error = exc
+            self._open.discard(rid)
+            self._done_rids.add(rid)
+            for fl in self._in_flight:
+                fl.pop(rid, None)
+            self.rejected += 1
+            self._pending_journal.append(self._journal.reject(
+                rid, repr(exc), defer=True))
+            self._pending_events.append(h)
+            self._cond.notify_all()
+        self._flush_journal()
+
+    # -- replica protocol ------------------------------------------------
+    def _sync(self, rep: _Replica, completed, idle: bool,
+              summary: Optional[Set[int]],
+              stats: Optional[dict]):  # thread: replica
+        """One replica scheduler handshake: report completions (fenced
+        + deduped), heartbeat, absorb the pool summary, pick up new
+        work. Returns ("stop", []) when this replica object is no
+        longer the registered incarnation (fenced zombie, closing
+        fleet) — the loop must exit. May raise `_KillDrill`."""
+        ret = self._sync_locked(rep, completed, idle, summary, stats)
+        self._flush_journal()
+        return ret
+
+    def _sync_locked(self, rep: _Replica, completed, idle: bool,
+                     summary: Optional[Set[int]],
+                     stats: Optional[dict]):  # thread: replica
+        with self._cond:
+            i = rep.index
+            current = (self._replicas[i] is rep
+                       and self._state[i] != _DEAD)
+            for rid, tokens in completed:
+                self._accept(rid, tokens, rep, accepted=current)
+            if not current or self._closing:
+                return "stop", []
+            self._beats[i] = time.monotonic()
+            if stats is not None:
+                self._rep_stats[i] = stats
+            if summary is not None:
+                self._summaries[i] = summary
+            if self._kill[i]:
+                self._kill[i] = False
+                raise _KillDrill("replica %s killed by drill" % rep.name)
+            if self._state[i] == _DRAINING and idle \
+                    and not self._inbox[i] and not self._in_flight[i]:
+                self._state[i] = _DRAINED
+                self._cond.notify_all()
+            if self._state[i] == _DRAINED:
+                # parked: wait for refill/close; the monitor exempts
+                # DRAINED replicas from the heartbeat deadline
+                self._cond.wait(timeout=self._idle_wait_s)
+                return "park", []
+            work: List[FleetHandle] = []
+            q = self._inbox[i]
+            while q:
+                h = q.popleft()
+                self._in_flight[i][h.rid] = h
+                work.append(h)
+            if not work and idle:
+                # nothing to do: sleep on the condition (bounded, so
+                # heartbeats keep flowing) instead of spinning
+                self._cond.wait(timeout=self._idle_wait_s)
+            return "run", work
+
+    def _accept(self, rid: int, tokens: List[int], rep: _Replica,
+                accepted: bool):
+        """Completion fence + dedupe (caller holds `_cond`): refuse a
+        dead/superseded replica's late result, refuse a second answer
+        for an already-done rid."""
+        if not accepted:
+            self.zombie_refused += 1
+            return
+        if rid in self._done_rids:
+            self.duplicate_refused += 1
+            return
+        h = self._handles.get(rid)
+        if h is None or h.done:
+            self.duplicate_refused += 1
+            return
+        self._done_rids.add(rid)
+        self._in_flight[rep.index].pop(rid, None)
+        self._open.discard(rid)
+        # prune the handle (the caller holds its own reference): a
+        # long-lived front door must not retain every prompt + output
+        # it ever served — _done_rids (ints) carries the dedupe
+        self._handles.pop(rid, None)
+        self._pending_journal.append(self._journal.complete(
+            rid, rep.name, rep.incarnation, h.generation, tokens,
+            defer=True))
+        h.tokens = list(tokens)
+        h.replica = rep.name
+        # the event fires in _flush_journal, AFTER the done record is
+        # on disk — result() observers get read-your-writes recovery
+        self._pending_events.append(h)
+        self.completed += 1
+        self._cond.notify_all()
+
+    def _on_crash(self, rep: _Replica, exc: BaseException):  # thread: replica
+        with self._cond:
+            self._fail_over(rep.index, rep, exc)
+        self._flush_journal()
+
+    # -- failure handling ------------------------------------------------
+    def _fail_over(self, i: int, rep: _Replica, exc: BaseException):
+        """Declare replica `i` dead and resubmit its journal-recorded
+        open requests to survivors (caller holds `_cond`). Idempotent
+        per incarnation: the crash path and the heartbeat path can both
+        land here."""
+        if self._replicas[i] is not rep or self._state[i] == _DEAD:
+            return
+        self._state[i] = _DEAD
+        self._summaries[i] = set()
+        self.failovers += 1
+        # fold the dead incarnation's last stats snapshot into the
+        # fleet-wide base: totals must not decrease on refill
+        st = self._rep_stats[i]
+        if st:
+            for k, v in st.items():
+                self._stats_base[k] = self._stats_base.get(k, 0) + v
+        self._rep_stats[i] = None
+        # rapid-death accounting gates auto_refill (exponential
+        # backoff, the Supervisor's restart/backoff discipline): a
+        # deterministically-failing replica must not crash/refill at
+        # monitor frequency forever
+        rapid = time.monotonic() - self._spawned[i] < 2.0
+        self._rapid[i] = self._rapid[i] + 1 if rapid else 0
+        self._refill_at[i] = time.monotonic() + min(
+            5.0, 0.05 * (2 ** self._rapid[i]))
+        self._inbox[i].clear()
+        self._in_flight[i].clear()
+        # the JOURNAL is the recovery source: every open request whose
+        # latest assignment names this replica+incarnation
+        for rid, _spec, _gen in self._journal.lost(rep.name, rep.incarnation):
+            h = self._handles.get(rid)
+            if h is None or h.done:
+                continue
+            h.generation += 1
+            self.resubmitted += 1
+            try:
+                self._route(h, exclude=i)
+            except EngineFailed:
+                pass  # no survivors: handle already failed by _route
+        self._cond.notify_all()
+
+    def _monitor_loop(self):  # thread: monitor
+        while True:
+            with self._cond:
+                if self._closing:
+                    return
+                now = time.monotonic()
+                for i, rep in enumerate(self._replicas):
+                    if self._state[i] in (_LIVE, _DRAINING) \
+                            and now - self._beats[i] > self.heartbeat_timeout_s:
+                        self._fail_over(
+                            i, rep,
+                            TimeoutError(
+                                "replica %s missed heartbeat deadline "
+                                "(%.2fs)" % (rep.name,
+                                             self.heartbeat_timeout_s)))
+                    elif self._state[i] == _DEAD and self.auto_refill \
+                            and now >= self._refill_at[i]:
+                        self._refill_locked(i)
+            self._flush_journal()  # fail-over resubmissions above
+            time.sleep(self._monitor_interval_s)
+
+    # -- operator surface ------------------------------------------------
+    def kill_replica(self, i: int):
+        """Drill: the replica's next scheduler handshake raises, its
+        thread dies, and the normal crash→failover path runs. (The
+        subprocess mode SIGKILLs for real via PADDLE_FAULT=kill@N.)"""
+        with self._cond:
+            self._kill[i] = True
+            self._cond.notify_all()
+
+    def drain(self, i: int, wait: bool = False,
+              timeout: Optional[float] = None) -> bool:
+        """Stop admitting to replica `i`, re-route its queued (not yet
+        started) requests, let in-flight work finish and publish its
+        prefixes, then park the replica DRAINED (engine and prefix
+        pool stay warm for `refill`). With `wait=True`, block until
+        drained; returns whether the replica is drained."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            if self._state[i] == _LIVE:
+                self._state[i] = _DRAINING
+                queued = list(self._inbox[i])
+                self._inbox[i].clear()
+                for h in queued:
+                    h.generation += 1
+                    self.resubmitted += 1
+                    try:
+                        self._route(h, exclude=i)
+                    except EngineFailed:
+                        pass  # no other live replica: handle failed
+                self._cond.notify_all()
+        self._flush_journal()  # re-assignments above, before any wait
+        with self._cond:
+            if not wait:
+                return self._state[i] == _DRAINED
+            while self._state[i] == _DRAINING:
+                t = (None if deadline is None
+                     else deadline - time.monotonic())
+                if t is not None and t <= 0.0:
+                    break
+                self._cond.wait(timeout=t if t is not None else 0.5)
+            return self._state[i] == _DRAINED
+
+    def refill(self, i: int):
+        """Bring replica `i` back: a DRAINED replica resumes with its
+        engine (and hot prefix pool) intact; a DEAD one is replaced by
+        a fresh incarnation (cold engine) — the restart half of the
+        supervisor's restart/backoff story."""
+        with self._cond:
+            if self._state[i] == _DRAINED:
+                self._state[i] = _LIVE
+                self._beats[i] = time.monotonic()
+                self._cond.notify_all()
+            elif self._state[i] == _DEAD:
+                self._refill_locked(i)
+
+    def _refill_locked(self, i: int):
+        self._incarnations[i] += 1
+        rep = self._make_replica(i, self._incarnations[i])
+        self._replicas[i] = rep
+        self._state[i] = _LIVE
+        self._beats[i] = time.monotonic()
+        # a kill_replica() drill aimed at the DEAD predecessor (it
+        # crashed before consuming the flag) must not assassinate the
+        # fresh incarnation at its first handshake
+        self._kill[i] = False
+        self._summaries[i] = set()
+        self._rep_stats[i] = None
+        self._spawned[i] = time.monotonic()
+        # starting the thread under the lock is safe: its first _sync
+        # blocks on the condition until we release
+        rep.start()
+        self._cond.notify_all()
+
+    def wait_all(self, timeout: Optional[float] = None) -> bool:
+        """Block until no request is open (completed, rejected, or
+        failed). Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._open:
+                t = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
+                if t is not None and t <= 0.0:
+                    return False
+                self._cond.wait(timeout=t if t is not None else 0.5)
+            return True
+
+    def stats(self) -> dict:
+        with self._cond:
+            base = self._stats_base
+            hits = base.get("prefix_hits", 0)
+            misses = base.get("prefix_misses", 0)
+            saved = base.get("prefix_tokens_saved", 0)
+            tokens_out = base.get("tokens_out", 0)
+            prefill_tok = base.get("prefill_tokens_computed", 0)
+            reps = []
+            for i, rep in enumerate(self._replicas):
+                st = self._rep_stats[i] or {}
+                hits += st.get("prefix_hits", 0)
+                misses += st.get("prefix_misses", 0)
+                saved += st.get("prefix_tokens_saved", 0)
+                tokens_out += st.get("tokens_out", 0)
+                prefill_tok += st.get("prefill_tokens_computed", 0)
+                reps.append({
+                    "name": rep.name, "slo": rep.slo,
+                    "state": self._state[i],
+                    "incarnation": rep.incarnation,
+                    "load": len(self._inbox[i]) + len(self._in_flight[i]),
+                    "stats": st,
+                })
+            total = hits + misses
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "shed": self.shed,
+                "rejected": self.rejected,
+                "resubmitted": self.resubmitted,
+                "failovers": self.failovers,
+                "zombie_refused": self.zombie_refused,
+                "duplicate_refused": self.duplicate_refused,
+                "open": len(self._open),
+                "lost": self.submitted - self.completed - self.rejected
+                - len(self._open),
+                "tokens_out": tokens_out,
+                "prefill_tokens_computed": prefill_tok,
+                "prefix_hit_rate": round(hits / total, 4) if total else None,
+                "prefix_tokens_saved": saved,
+                "replicas": reps,
+            }
+
+    def close(self, timeout: float = 10.0):
+        """Stop every replica and the monitor; fail any still-open
+        handle with `EngineFailed` (their waiters must not block on a
+        dead fleet)."""
+        with self._cond:
+            if self._closing:
+                return
+            self._closing = True
+            for rid in list(self._open):
+                h = self._handles.get(rid)
+                if h is not None and not h.done:
+                    h.error = EngineFailed(
+                        "fleet closed with request %d pending" % rid,
+                        replica=None)
+                    h._event.set()
+            self._open.clear()
+            self._cond.notify_all()
+        self._monitor.join(timeout=timeout)
+        for rep in list(self._replicas):
+            rep.thread.join(timeout=timeout)
+        self._flush_journal()  # stragglers from the final syncs
+        self._journal.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# subprocess mode: real-process kill drills through the PR-1 control plane
+# ---------------------------------------------------------------------------
+
+def run_fleet_subprocess(argv_for, worker_ids, requests,
+                         lease_timeout_s=15.0, heartbeat_timeout_s=15.0,
+                         env_for=None, deadline_s=240.0,
+                         supervisor_kw=None):
+    """Serve `requests` (journal-form spec dicts) through N worker
+    SUBPROCESSES (tests/fleet_worker.py is the reference worker): the
+    requests become Coordinator task leases, the workers run a real
+    `ServingEngine` each (`step()` ticks PADDLE_FAULT, so `kill@N`
+    SIGKILLs mid-decode), and `distributed/supervisor.py` restarts
+    casualties. Fault tolerance is exactly the PR-1 story: a dead
+    worker's leases time out and requeue to survivors (no request
+    lost), lease GENERATIONS fence a zombie's late `task_finished` (no
+    request acked twice), and results are written atomically per rid.
+
+    `argv_for(worker_id, coordinator_address)` builds one worker's
+    command line; result files land wherever the caller's argv points
+    the workers. Returns {"report": supervisor report, "coordinator":
+    queue counts} — `coordinator["done"] == len(requests)` with
+    `discarded == 0` is the no-lost-request check, and lease fencing
+    means each rid was acked exactly once.
+    """
+    from ..distributed.coordinator import Coordinator, CoordinatorServer
+    from ..distributed.supervisor import Supervisor
+
+    coord = Coordinator(timeout_s=lease_timeout_s, failure_max=10,
+                        heartbeat_timeout_s=heartbeat_timeout_s)
+    coord.set_dataset([dict(spec, rid=i)
+                       for i, spec in enumerate(requests)])
+    server = CoordinatorServer(coord).start()
+    try:
+        sup = Supervisor(
+            lambda wid: argv_for(wid, server.address), worker_ids,
+            env_for=env_for, coordinator=coord,
+            **(supervisor_kw or {}))
+        report = sup.run(deadline_s=deadline_s)
+    finally:
+        server.stop()
+    return {
+        "report": report,
+        "coordinator": {
+            "done": len(coord.done), "todo": len(coord.todo),
+            "pending": len(coord.pending),
+            "discarded": len(coord.discarded),
+        },
+    }

@@ -37,11 +37,38 @@ pure host bookkeeping and unit-testable without a device.
 from __future__ import annotations
 
 import heapq
-from typing import Any, Callable, Dict, List, Optional, Tuple
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-__all__ = ["PrefixCache", "PrefixMatch"]
+__all__ = ["PrefixCache", "PrefixMatch", "chain_keys"]
+
+
+def _fold(acc: int, block) -> int:
+    """One chain-key step: crc32 of a block's tokens folded over the
+    parent key. The SINGLE definition both sides of affinity routing
+    use — `chain_keys` (prompt side) and `PrefixCache.summary` (trie
+    side) must produce identical keys or every lookup silently
+    misses."""
+    return zlib.crc32(np.asarray(block, np.int64).tobytes(), acc)
+
+
+def chain_keys(tokens, block_tokens: int) -> List[int]:
+    """Chained-crc32 key per whole leading block of `tokens`: key[d]
+    identifies the token prefix tokens[:(d+1)*block_tokens] (the crc of
+    block d folded over key[d-1]). Two prefixes share key[d] iff they
+    share the first d+1 blocks (modulo crc collision — harmless where
+    this is used: fleet AFFINITY routing, which only steers load; the
+    pool's trie match stays exact). Module-level so the fleet router can
+    key a prompt without holding any pool."""
+    tokens = np.asarray(tokens).reshape(-1)
+    out: List[int] = []
+    acc = 0
+    for d in range(len(tokens) // int(block_tokens)):
+        acc = _fold(acc, tokens[d * block_tokens:(d + 1) * block_tokens])
+        out.append(acc)
+    return out
 
 
 class _Node(object):
@@ -204,6 +231,24 @@ class PrefixCache(object):
         # heap drained with pinned entries left: honestly over budget
 
     # -- reporting ------------------------------------------------------
+    def summary(self) -> Set[int]:
+        """Host-only routing digest: the chain key (see `chain_keys`) of
+        every cached block-chain prefix. A fleet front door matches a
+        prompt's chain keys against each replica's summary to find the
+        replica whose pool holds the longest prefix — without touching
+        the trie from another thread (the summary is rebuilt by the
+        replica's own thread and handed over as an immutable set).
+        O(blocks) walk; the pool is budget-bounded so this stays small."""
+        out: Set[int] = set()
+        stack: List[Tuple[_Node, int]] = [(self._root, 0)]
+        while stack:
+            node, acc = stack.pop()
+            for child in node.children.values():
+                key = _fold(acc, child.block)
+                out.add(key)
+                stack.append((child, key))
+        return out
+
     def __len__(self) -> int:
         return len(self._nodes)
 

@@ -1,0 +1,111 @@
+"""Supervised serving-fleet replica SUBPROCESS (driven by
+tests/test_serving_fleet.py through
+paddle_tpu.serving.fleet.run_fleet_subprocess).
+
+One logical fleet: N of these processes drain a Coordinator queue whose
+tasks are serving REQUESTS (journal-form specs). Each worker runs a
+real `ServingEngine`; `engine.step()` ticks the PADDLE_FAULT injector,
+so `kill@N` SIGKILLs this process mid-decode — the drill the in-process
+fleet can only simulate. Fault tolerance is the PR-1 control plane,
+unchanged:
+
+  * a killed worker's lease times out server-side and the request
+    requeues to a survivor (or to the restarted incarnation) — no
+    request lost;
+  * `task_finished` presents the lease GENERATION, so a zombie that
+    computed a result under an expired lease cannot ack it — no
+    request acked twice;
+  * results are written atomically (tmp + rename) per request id, and
+    outputs are deterministic in (seed, prompt), so a re-computed
+    request produces a byte-identical record.
+
+Usage: fleet_worker.py OUT_DIR COORD_ADDR
+Env:   PADDLE_WORKER_ID      logical id (set by the Supervisor)
+       PADDLE_FAULT          injected faults (stripped on restart)
+       FLEET_MODEL           json {vocab,dim,heads,layers,max_len,
+                             max_slots} — params derive from
+                             PRNGKey(0), identical in every process
+       FLEET_IDLE_GRACE_S    keep polling an empty queue this long
+                             before exiting 0; MUST exceed the lease
+                             timeout or a survivor can exit while a
+                             dead peer's request is still leased
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from paddle_tpu.distributed import RemoteCoordinator
+from paddle_tpu.models import transformer as tlm
+from paddle_tpu.serving import ServingEngine
+
+
+def main():
+    out_dir, addr = sys.argv[1:3]
+    wid = os.environ.get("PADDLE_WORKER_ID", "w?")
+    m = json.loads(os.environ["FLEET_MODEL"])
+    idle_grace = float(os.environ.get("FLEET_IDLE_GRACE_S", "20.0"))
+
+    cfg = tlm.TransformerConfig(
+        vocab=m["vocab"], dim=m["dim"], heads=m["heads"],
+        layers=m["layers"], max_len=m["max_len"])
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, max_slots=m.get("max_slots", 2))
+
+    client = RemoteCoordinator(addr, retry_deadline_s=20.0,
+                               backoff_base_s=0.05)
+    incarnation = client.register_worker(wid)["incarnation"]
+
+    last_beat = 0.0
+    idle_since = None
+    while True:
+        now = time.time()
+        if now - last_beat > 0.5:
+            client.heartbeat(wid)
+            last_beat = now
+        task = client.get_task()
+        if task is None:
+            if idle_since is None:
+                idle_since = now
+            if now - idle_since > idle_grace:
+                break  # queue drained AND any dead peer's lease expired
+            time.sleep(0.1)
+            continue
+        idle_since = None
+        spec = task.payload
+        h = engine.submit(
+            np.asarray(spec["prompt"], np.int32),
+            spec["max_new_tokens"], temperature=spec["temperature"],
+            eos_id=spec["eos_id"], seed=spec["seed"])
+        while not h.done:
+            engine.step()  # ticks PADDLE_FAULT: kill@N lands mid-decode
+            now = time.time()
+            if now - last_beat > 0.5:
+                client.heartbeat(wid)
+                last_beat = now
+        rec = {"rid": spec["rid"],
+               "tokens": [int(t) for t in h.tokens],
+               "worker": wid, "incarnation": incarnation,
+               "lease": task.lease}
+        # result BEFORE ack: a crash in between re-leases the request
+        # and the survivor overwrites with an identical record — losing
+        # the race the other way (acked but no result) is impossible
+        tmp = os.path.join(out_dir, ".tmp_%s_%d" % (wid, spec["rid"]))
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, os.path.join(out_dir, "%d.json" % spec["rid"]))
+        client.task_finished(task.task_id, lease=task.lease)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

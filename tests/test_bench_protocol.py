@@ -379,6 +379,41 @@ def test_serving_shared_prefix_outputs_match_generate():
         np.testing.assert_array_equal(got, want)
 
 
+def test_serving_fleet_workload_contract():
+    """ISSUE 6 satellite: the `serving_fleet` row cannot decay into a
+    no-op — on the fixed-seed shared-header trace (tiny model, host
+    backend) the kill drill loses ZERO requests and answers none
+    twice, exactly one failover happens, the pools actually reuse
+    prefixes, and the bench itself raises unless outputs are
+    token-identical across the single-replica, fleet+kill, and
+    affinity-off runs. (The strict affinity-on > affinity-off reuse
+    inequality is pinned by the dedicated no-kill drill in
+    test_serving_fleet.py — here the kill erases one replica's pool
+    mid-trace, so the cross-run contrast is reported, not asserted.)"""
+    rec = bench.bench_serving_fleet(
+        n_replicas=2, n_requests=6, families=2, header_len=8,
+        family_len=4, max_slots=2, dim=32, heads=4, layers_n=2,
+        vocab=64, max_len=64, chunk_tokens=8, block_tokens=4,
+        cache_tokens=96)
+    assert rec["requests_lost"] == 0, rec
+    assert rec["duplicate_completions"] == 0, rec
+    assert rec["failovers"] == 1, rec
+    assert rec["resubmitted"] >= 0
+    assert rec["completed"] == 6 + 2  # paced trace + warm wave
+    assert rec["prefix_hit_rate_on"] > 0, rec
+    assert rec["prefix_tokens_saved_affinity_on"] > 0, rec
+    assert rec["kill_drill"]["replica"] == 0
+
+
+def test_serving_fleet_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_fleet", bench_serving_fleet' in src
+
+
 def test_serving_shared_prefix_registered_in_bench_main():
     """The workload is wired into bench.main()'s side-workload list
     (the registration is what lands it in the driver's record)."""

@@ -22,6 +22,27 @@ def test_spec_parsing_and_exc():
         inj.tick()
 
 
+def test_arm_adds_relative_faults_mid_run():
+    """arm() schedules faults relative to the CURRENT step: drills warm
+    up under no faults, then land one at a deterministic step of the
+    measured phase (serving-fleet zombie drill)."""
+    inj = fi.FaultInjector("")
+    assert not inj.active
+    for _ in range(5):
+        inj.tick()
+    inj.arm("exc@2")
+    assert inj.active
+    inj.tick()  # step 6
+    with pytest.raises(fi.FaultInjected):
+        inj.tick()  # step 7 == 5 + 2
+    # absolute arming keeps the spec's raw indices
+    inj2 = fi.FaultInjector("")
+    inj2.tick()
+    inj2.arm("exc@2", relative=False)
+    with pytest.raises(fi.FaultInjected):
+        inj2.tick()  # step 2
+
+
 def test_delay_fault_sleeps():
     import time
 

@@ -1,0 +1,556 @@
+"""Fault-tolerant serving fleet (paddle_tpu/serving/fleet.py, ISSUE 6):
+
+* No request lost / none answered twice — a replica crashed MID-DECODE
+  (deterministic injected fault) has its journal-recorded open requests
+  resubmitted to survivors; every output is token-identical to
+  sequential generate(); the journal shows exactly one `done` per rid
+  and recovers to an empty incomplete set.
+* Backpressure — `max_pending` open requests fleet-wide, then
+  `submit()` raises FleetSaturated and journals NOTHING for the shed
+  request.
+* Drain/refill — a draining replica finishes its in-flight work and
+  parks with its engine (and prefix pool) warm; refill resumes the
+  SAME incarnation; a dead replica refills as a NEW incarnation.
+* Incarnation fence — a replica stalled past the heartbeat deadline is
+  failed over; when the zombie wakes and reports its late result, the
+  fleet refuses it (slow drill).
+* Engine-failure propagation (satellite) — a background thread driving
+  an engine dies: pending `ServingHandle.result()` raises EngineFailed
+  naming the replica instead of blocking forever.
+* Subprocess mode (slow drill) — N real worker processes under
+  distributed/supervisor.py; PADDLE_FAULT=kill@N SIGKILLs one
+  mid-decode (the serving-step injector tick satellite); lease
+  timeout + generations give exactly-once; outputs match generate().
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.fault_injection import FaultInjector
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serving import (
+    EngineFailed,
+    FleetSaturated,
+    RequestJournal,
+    ServingEngine,
+    ServingFleet,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = T.TransformerConfig(vocab=64, dim=32, heads=4, layers=2,
+                              max_len=64)
+    return cfg, T.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _oracle(params, cfg, prompt, max_new):
+    return np.asarray(
+        T.generate(params, jnp.asarray(prompt)[None], cfg, max_new)
+    )[0]
+
+
+def _requests(cfg, n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        t = int(rng.randint(4, 13))
+        out.append((rng.randint(0, cfg.vocab, (t,)).astype(np.int32),
+                    int(rng.randint(8, 13))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# journal (host-only)
+# ---------------------------------------------------------------------------
+
+def test_journal_lifecycle_and_recovery(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path)
+    j.submit(0, {"p": [1]})
+    j.submit(1, {"p": [2]})
+    j.submit(2, {"p": [3]})
+    j.assign(0, "r0", 1, 0)
+    j.assign(1, "r0", 1, 0)
+    j.assign(2, "r1", 1, 0)
+    j.complete(0, "r0", 1, 0, [7, 8])
+    # r0 died: its open assignments (and only those) are the lost set
+    assert [(rid, g) for rid, _s, g in j.lost("r0", 1)] == [(1, 0)]
+    # resubmitted to r1 under a bumped generation
+    j.assign(1, "r1", 1, 1)
+    assert j.lost("r0", 1) == []
+    assert j.open_count() == 2
+    j.complete(1, "r1", 1, 1, [9])
+    j.complete(2, "r1", 1, 0, [4])
+    j.close()
+    # disk recovery agrees: nothing incomplete
+    assert RequestJournal.recover(path) == []
+    lines = [json.loads(l) for l in open(path)]
+    done = [r["rid"] for r in lines if r["kind"] == "done"]
+    assert sorted(done) == [0, 1, 2] and len(set(done)) == 3
+    # a journal cut before the done records recovers the open set
+    half = str(tmp_path / "half.jsonl")
+    with open(half, "w") as f:
+        for r in lines:
+            if r["kind"] != "done":
+                f.write(json.dumps(r) + "\n")
+    assert [rid for rid, _ in RequestJournal.recover(half)] == [0, 1, 2]
+
+
+def test_journal_restart_continues_rids_and_prunes_mirror(tmp_path):
+    """Reopening a journal replays its history: next_rid() continues
+    past every rid ever issued (a restarted front door appending to
+    the same file must not collide with — and thereby corrupt — old
+    records), and terminal records prune the open mirror so memory is
+    bounded by in-flight work."""
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path)
+    assert j.next_rid() == 0
+    j.submit(0, {"p": [1]})
+    j.submit(1, {"p": [2]})
+    j.assign(0, "r0", 1, 0)
+    j.complete(0, "r0", 1, 0, [5])
+    assert j.open_count() == 1
+    j.close()
+    # session 2: same file — rids continue, the open set resumes
+    j2 = RequestJournal(path)
+    assert j2.next_rid() == 2
+    assert j2.open_count() == 1  # rid 1 still open from session 1
+    j2.submit(2, {"p": [3]})
+    j2.complete(2, "rX", 1, 0, [6])
+    j2.reject(1, "ValueError('bad')")  # terminal: never resubmitted
+    assert j2.open_count() == 0
+    j2.close()
+    assert RequestJournal.recover(path) == []
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    """A process killed mid-append leaves a partial final line; the
+    journal must reopen and recover past it (the crash it exists to
+    survive must not make it unreadable). A malformed line FOLLOWED by
+    valid records is real corruption and raises."""
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path)
+    j.submit(0, {"p": [1]})
+    j.submit(1, {"p": [2]})
+    j.complete(0, "r0", 1, 0, [5])
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"kind": "done", "rid": 1, "tok')  # torn mid-append
+    assert [rid for rid, _ in RequestJournal.recover(path)] == [1]
+    j2 = RequestJournal(path)  # reopens fine, resumes past history
+    assert j2.next_rid() == 2 and j2.open_count() == 1
+    # appending after the heal must NOT glue onto the torn text (the
+    # torn tail is truncated at open) — the file stays parseable
+    j2.submit(2, {"p": [3]})
+    j2.close()
+    assert [rid for rid, _ in RequestJournal.recover(path)] == [1, 2]
+    j3 = RequestJournal(path)
+    assert j3.next_rid() == 3
+    j3.close()
+    # corruption mid-file (valid records after the bad line) raises
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write('{"kind": "submit", "rid": 0, "spec": {}}\n')
+        f.write("not json\n")
+        f.write('{"kind": "done", "rid": 0, "tokens": []}\n')
+    with pytest.raises(ValueError, match="not a torn tail"):
+        RequestJournal.recover(bad)
+
+
+def test_rejected_request_is_terminal_in_journal(model, tmp_path):
+    """A request the ENGINE refuses (fleet-level checks passed, e.g. a
+    PER-REPLICA max_len override the front door's precheck cannot see)
+    fails its own handle AND writes a terminal journal record —
+    recover() must not resubmit an unservable request forever."""
+    cfg, params = model
+    journal = str(tmp_path / "j.jsonl")
+    # the per-replica override (32) is tighter than the base admission
+    # rule (cfg.max_len 64): the fleet admits, the engine rejects
+    fleet = ServingFleet(params, cfg, n_replicas=1, journal_path=journal,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 1},
+                         engine_kw_for=lambda i: {"max_len": 32})
+    try:
+        with pytest.raises(ValueError):  # fleet-level check: > cfg.max_len
+            fleet.submit(np.arange(1, 41, dtype=np.int32), 30)
+        h = fleet.submit(np.arange(1, 21, dtype=np.int32), 13)  # 33 > 32
+        with pytest.raises(ValueError):
+            h.result(timeout=120)
+        st = fleet.stats()
+        assert st["rejected"] == 1 and st["open"] == 0 and st["lost"] == 0
+        assert RequestJournal.recover(journal) == []
+    finally:
+        fleet.close()
+
+
+def test_no_live_replica_fails_terminally(model, tmp_path):
+    """With every replica dead, submit() fails the caller immediately
+    AND terminally: the journal must not keep the unservable request
+    open for every future recover() to resubmit."""
+    cfg, params = model
+    journal = str(tmp_path / "j.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=1, journal_path=journal,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 1})
+    try:
+        fleet.kill_replica(0)
+        deadline = time.monotonic() + 60
+        while fleet.stats()["replicas"][0]["state"] != "dead":
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        with pytest.raises(EngineFailed):
+            fleet.submit(np.arange(1, 6, dtype=np.int32), 4)
+        assert RequestJournal.recover(journal) == []
+        assert fleet.stats()["open"] == 0
+        # refill revives service — and must NOT inherit the consumed
+        # kill flag or any stale state
+        fleet.refill(0)
+        h = fleet.submit(np.arange(1, 6, dtype=np.int32), 4)
+        np.testing.assert_array_equal(
+            h.result(timeout=120),
+            _oracle(params, cfg, np.arange(1, 6, dtype=np.int32), 4))
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 in-process drills
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_decode_journal_resubmit_token_identity(model, tmp_path):
+    """The tier-1 smoke drill: replica r0 crashes deterministically on
+    its 4th engine step (mid-decode of its first batch); every request
+    completes on the survivor, token-identical to generate(); exactly
+    one journal `done` per rid; refill brings a fresh incarnation."""
+    cfg, params = model
+    reqs = _requests(cfg, n=6)
+    oracle = [_oracle(params, cfg, p, n) for p, n in reqs]
+    journal = str(tmp_path / "journal.jsonl")
+    inj = FaultInjector("exc@4")
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, heartbeat_timeout_s=60.0,
+        journal_path=journal, engine_kw={"max_slots": 2},
+        engine_kw_for=lambda i: (
+            {"fault_injector": inj} if i == 0 else {}))
+    try:
+        hs = [fleet.submit(p, n) for p, n in reqs]
+        for h, want in zip(hs, oracle):
+            np.testing.assert_array_equal(h.result(timeout=180), want)
+        st = fleet.stats()
+        assert st["failovers"] == 1, st
+        assert st["resubmitted"] >= 1, st
+        assert st["completed"] == 6 and st["lost"] == 0, st
+        assert st["duplicate_refused"] == 0, st
+        assert st["replicas"][0]["state"] == "dead"
+        # the journal is the exactly-once evidence: one done per rid,
+        # nothing incomplete on recovery
+        lines = [json.loads(l) for l in open(journal)]
+        done = [r["rid"] for r in lines if r["kind"] == "done"]
+        assert sorted(done) == list(range(6)) and len(set(done)) == 6
+        assert RequestJournal.recover(journal) == []
+        # resubmissions are visible as bumped generations
+        assert any(r["kind"] == "assign" and r["gen"] > 0 for r in lines)
+        # refill after death: a NEW incarnation serves again
+        fleet.refill(0)
+        h = fleet.submit(*reqs[0])
+        np.testing.assert_array_equal(h.result(timeout=120), oracle[0])
+        assert fleet.stats()["replicas"][0]["incarnation"] == 2
+    finally:
+        fleet.close()
+
+
+def test_bounded_queue_sheds_with_fleet_saturated(model, tmp_path):
+    cfg, params = model
+    journal = str(tmp_path / "j.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=1, max_pending=2,
+                         heartbeat_timeout_s=60.0, journal_path=journal,
+                         engine_kw={"max_slots": 1})
+    try:
+        p = np.arange(1, 8, dtype=np.int32)
+        a = fleet.submit(p, 30)
+        b = fleet.submit(p, 30, seed=1, temperature=0.8)
+        with pytest.raises(FleetSaturated):
+            fleet.submit(p, 5)
+        a.result(timeout=120)
+        b.result(timeout=120)
+        # capacity frees with completion; the shed request was never
+        # journaled (backpressure must not grow the durable table)
+        c = fleet.submit(p, 5)
+        c.result(timeout=120)
+        st = fleet.stats()
+        assert st["shed"] == 1 and st["completed"] == 3
+        subs = [json.loads(l) for l in open(journal)]
+        assert sum(r["kind"] == "submit" for r in subs) == 3
+    finally:
+        fleet.close()
+
+
+def test_drain_refill_completes_all_in_flight(model):
+    cfg, params = model
+    reqs = _requests(cfg, n=6, seed=3)
+    oracle = [_oracle(params, cfg, p, n) for p, n in reqs]
+    fleet = ServingFleet(params, cfg, n_replicas=2,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 2})
+    try:
+        hs = [fleet.submit(p, n) for p, n in reqs]
+        assert fleet.drain(0, wait=True, timeout=120)
+        st = fleet.stats()
+        assert st["replicas"][0]["state"] == "drained"
+        for h, want in zip(hs, oracle):
+            np.testing.assert_array_equal(h.result(timeout=120), want)
+        assert fleet.stats()["lost"] == 0
+        # planned restart: refill resumes the SAME incarnation (warm
+        # engine + prefix pool), not a replacement replica
+        fleet.refill(0)
+        assert fleet.stats()["replicas"][0]["state"] == "live"
+        assert fleet.stats()["replicas"][0]["incarnation"] == 1
+        h2 = fleet.submit(*reqs[0])
+        np.testing.assert_array_equal(h2.result(timeout=120), oracle[0])
+    finally:
+        fleet.close()
+
+
+def test_handle_result_raises_when_background_engine_dies(model):
+    """Satellite regression: an engine driven by a background thread
+    that dies mid-serve must FAIL its pending handles (EngineFailed,
+    naming the replica) — result() raises promptly instead of blocking
+    forever, and the engine latches (donated cache must not step
+    again)."""
+    cfg, params = model
+    inj = FaultInjector("exc@3")
+    eng = ServingEngine(params, cfg, max_slots=2, replica_id="bg0",
+                        fault_injector=inj)
+    hs = [eng.submit(np.arange(1, 7, dtype=np.int32), 12),
+          eng.submit(np.arange(2, 9, dtype=np.int32), 12)]
+
+    def drive():
+        try:
+            eng.run()
+        except Exception:
+            pass  # the thread dies; handles must still unblock
+
+    t = threading.Thread(target=drive)
+    t.start()
+    t.join(timeout=120)
+    assert not t.is_alive()
+    for h in hs:
+        t0 = time.monotonic()
+        with pytest.raises(EngineFailed) as ei:
+            h.result()
+        assert time.monotonic() - t0 < 5.0  # raised, not blocked
+        assert ei.value.replica == "bg0"
+    with pytest.raises(EngineFailed):
+        eng.step()
+
+
+def test_serving_step_ticks_env_fault_injector(model, monkeypatch):
+    """Satellite: with PADDLE_FAULT set, ServingEngine.step() ticks the
+    process-wide default injector — serving has the same step-boundary
+    fault semantics as the trainer CLI."""
+    import paddle_tpu.distributed.fault_injection as fi
+
+    cfg, params = model
+    monkeypatch.setenv("PADDLE_FAULT", "exc@2")
+    monkeypatch.setattr(fi, "_default", None)  # fresh env parse
+    eng = ServingEngine(params, cfg, max_slots=1)
+    h = eng.submit(np.arange(1, 6, dtype=np.int32), 10)
+    with pytest.raises(fi.FaultInjected):
+        eng.run()
+    assert isinstance(h.error, EngineFailed)
+    monkeypatch.setattr(fi, "_default", None)  # don't leak the injector
+
+
+# ---------------------------------------------------------------------------
+# slow drills
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # real sleeps: stall past the heartbeat deadline
+def test_zombie_replica_result_refused_by_incarnation_fence(model):
+    """r0 stalls (injected delay) on the very step that completes its
+    request and misses the heartbeat deadline: the monitor fails it
+    over, the survivor answers, and the woken zombie's late result is
+    REFUSED — completed exactly once, token-identical."""
+    cfg, params = model
+    p = np.arange(3, 12, dtype=np.int32)
+    inj = FaultInjector("")  # inert until armed (post warm-up)
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, heartbeat_timeout_s=60.0,
+        monitor_interval_s=0.05, engine_kw={"max_slots": 2},
+        engine_kw_for=lambda i: (
+            {"fault_injector": inj} if i == 0 else {}))
+    try:
+        # warm both replicas first: compiles take seconds, and the
+        # deadline below is sized for warmed ~ms steps (README sizing
+        # rule — deadline must exceed the worst step latency)
+        w0, w1 = fleet.submit(p, 4), fleet.submit(p, 4)
+        w0.result(timeout=180)
+        w1.result(timeout=180)
+        assert {w0.replica, w1.replica} == {"r0", "r1"}
+        time.sleep(0.1)
+        fleet.heartbeat_timeout_s = 0.5
+        # max_new=4 completes on engine step 3 (the first step emits
+        # the prefill token AND a decode token): stall exactly there so
+        # r0 finishes the request AS a zombie
+        inj.arm("delay@3:2.5")
+        h = fleet.submit(p, 4)
+        got = h.result(timeout=120)
+        np.testing.assert_array_equal(got, _oracle(params, cfg, p, 4))
+        assert h.replica == "r1"  # the survivor answered
+        time.sleep(2.8)  # zombie wakes, completes, must be refused
+        st = fleet.stats()
+        assert st["failovers"] == 1 and st["zombie_refused"] == 1, st
+        assert st["completed"] == 3 and st["lost"] == 0, st
+        assert st["duplicate_refused"] == 0, st
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # two full fleets (4 engine compiles)
+def test_prefix_affinity_routes_families_to_hot_replicas(model):
+    """Affinity on: shared-header families stick to the replica whose
+    pool is hot (strictly more prefix tokens saved, strictly fewer
+    prefill tokens computed, fleet-wide); outputs identical either
+    way."""
+    cfg, params = model
+    rng = np.random.RandomState(0)
+    header = rng.randint(0, cfg.vocab, 12).astype(np.int32)
+    fams = [rng.randint(0, cfg.vocab, 4).astype(np.int32)
+            for _ in range(2)]
+
+    def prompts():
+        rng2 = np.random.RandomState(1)
+        return [np.concatenate(
+            [header, fams[f], rng2.randint(0, cfg.vocab, 3).astype(np.int32)])
+            for f in [0, 1] + [0, 0, 1, 1, 0, 0, 1, 1]]
+
+    def run(affinity):
+        fleet = ServingFleet(
+            params, cfg, n_replicas=2, heartbeat_timeout_s=60.0,
+            affinity=affinity,
+            engine_kw={"max_slots": 2, "prefix_cache_tokens": 256,
+                       "prefix_block_tokens": 4,
+                       "prefill_chunk_tokens": 8})
+        try:
+            ps = prompts()
+            # warm wave: one request per family lands one family per
+            # replica and publishes its blocks
+            w = [fleet.submit(p, 4, publish_len=16) for p in ps[:2]]
+            for h in w:
+                h.result(timeout=180)
+            # burst: routed concurrently — affinity must beat the
+            # instantaneous load tie-break
+            hs = [fleet.submit(p, 4, publish_len=16) for p in ps[2:]]
+            for h in hs:
+                h.result(timeout=180)
+            time.sleep(0.15)  # let the final sync push replica stats
+            st = fleet.stats()
+            return st, [list(h.tokens) for h in w + hs]
+        finally:
+            fleet.close()
+
+    st_on, out_on = run(True)
+    st_off, out_off = run(False)
+    assert out_on == out_off  # routing must never change outputs
+    assert st_on["prefix_tokens_saved"] > st_off["prefix_tokens_saved"]
+    assert st_on["prefill_tokens_computed"] < \
+        st_off["prefill_tokens_computed"]
+    assert st_on["lost"] == 0 and st_off["lost"] == 0
+
+
+@pytest.mark.slow  # two engine compiles + failover
+def test_slo_classes_route_and_fall_back(model):
+    """replica_slo maps classes onto engine max_prefills_per_step;
+    submit(slo=) routes within the class; with the class's replica
+    dead, requests fall back to any live replica (survival beats SLO
+    placement)."""
+    cfg, params = model
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, heartbeat_timeout_s=60.0,
+        replica_slo=["interactive", "batch"],
+        engine_kw={"max_slots": 2})
+    try:
+        p = np.arange(2, 11, dtype=np.int32)
+        hi = fleet.submit(p, 4, slo="interactive")
+        hb = fleet.submit(p, 4, slo="batch")
+        hi.result(timeout=180)
+        hb.result(timeout=180)
+        assert hi.replica == "r0" and hb.replica == "r1"
+        # the class mapping landed on the engines (Sarathi knob)
+        assert fleet._replicas[0].engine.max_prefills_per_step == 1
+        assert fleet._replicas[1].engine.max_prefills_per_step is None
+        with pytest.raises(ValueError):
+            fleet.submit(p, 4, slo="no-such-class")
+        # batch replica dies -> batch traffic falls back to r0
+        fleet.kill_replica(1)
+        h2 = fleet.submit(p, 4, slo="batch")
+        np.testing.assert_array_equal(
+            h2.result(timeout=120), _oracle(params, cfg, p, 4))
+        assert h2.replica == "r0"
+        assert fleet.stats()["lost"] == 0
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full subprocess tree: supervisor + coordinator + 2 jax workers
+def test_subprocess_kill_drill_no_request_lost(model, tmp_path):
+    """The real-process drill: PADDLE_FAULT=kill@7 SIGKILLs worker w0
+    mid-decode (the ServingEngine.step() injector tick); the supervisor
+    restarts it, its lease times out and requeues, and every request
+    completes exactly once (lease generations fence the acks) with
+    outputs token-identical to generate()."""
+    from paddle_tpu.serving.fleet import run_fleet_subprocess
+
+    cfg, params = model
+    mspec = {"vocab": cfg.vocab, "dim": cfg.dim, "heads": cfg.heads,
+             "layers": cfg.layers, "max_len": cfg.max_len,
+             "max_slots": 2}
+    reqs = _requests(cfg, n=6, seed=5)
+    specs = [{"prompt": [int(t) for t in p], "max_new_tokens": n,
+              "temperature": 0.0, "eos_id": None, "seed": 0}
+             for p, n in reqs]
+    out_dir = tmp_path / "results"
+    out_dir.mkdir()
+
+    def env_for(wid):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["FLEET_MODEL"] = json.dumps(mspec)
+        # MUST exceed the lease timeout: a survivor draining the queue
+        # may only exit once a dead peer's lease had time to requeue
+        env["FLEET_IDLE_GRACE_S"] = "20"
+        if wid == "w0":
+            env["PADDLE_FAULT"] = "kill@7"  # mid-decode of request 1
+        return env
+
+    res = run_fleet_subprocess(
+        lambda wid, addr: [sys.executable,
+                           os.path.join(HERE, "fleet_worker.py"),
+                           str(out_dir), addr],
+        ["w0", "w1"], specs, lease_timeout_s=10.0, env_for=env_for,
+        deadline_s=240.0)
+    rep = res["report"]
+    assert rep["ok"], rep
+    assert rep["workers"]["w0"]["restarts"] == 1
+    assert rep["workers"]["w0"]["exit_codes"][0] == -signal.SIGKILL
+    # exactly-once: every request acked once, none discarded
+    assert res["coordinator"]["done"] == len(specs)
+    assert res["coordinator"]["discarded"] == 0
+    for i, (p, n) in enumerate(reqs):
+        rec = json.load(open(out_dir / ("%d.json" % i)))
+        want = _oracle(params, cfg, p, n)
+        np.testing.assert_array_equal(
+            np.asarray(rec["tokens"], np.int32), want[len(p):])
