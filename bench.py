@@ -39,6 +39,14 @@ definitions):
               a common header, run with the prefix KV pool off vs on;
               reports prefill-tokens-computed both ways, hit rate, and
               TTFT; greedy outputs must match between runs
+  serving_paged — paged-KV + speculative-decoding acceptance (ISSUE
+              7): the same fixed-seed Poisson trace at ONE fixed KV
+              HBM budget through the [S, max_len]-slab-equivalent
+              engine, the paged block pool, and paged + self-drafting
+              speculative decoding; reports peak resident slots (paged
+              must beat slab at equal budget), speculative
+              accept-rate, and tok/s per mode; outputs must be
+              token-identical across all three runs
   serving_fleet — fault-tolerant fleet acceptance (ISSUE 6): the same
               fixed-seed shared-header Poisson trace through a
               single replica, an N=3 fleet with prefix-affinity
@@ -1130,6 +1138,150 @@ def bench_serving_shared_prefix(n_requests=None, families=None,
     }
 
 
+def bench_serving_paged(n_requests=None, max_slots=None, dim=None,
+                        heads=None, layers_n=None, vocab=None,
+                        max_len=None, block_tokens=None,
+                        budget_tokens=None, spec_draft_len=None):
+    """Paged-KV acceptance trace (ISSUE 7): the SAME fixed-seed Poisson
+    trace of short requests runs three times at ONE fixed KV HBM budget
+    (`budget_tokens` cached tokens per layer):
+
+      slab  — the pre-paging concurrency wall: a [S, max_len] slab at
+              this budget holds floor(budget/max_len) slots, each
+              paying max_len whether the request needs it or not
+              (emulated exactly: max_slots = that floor, pool =
+              worst-case blocks per slot);
+      paged — the block pool shares budget/block_tokens fixed-size
+              blocks across many slots; admission reserves each
+              request's OWN worst case (ceil((T0+max_new)/Bt)), so
+              resident slots scale with actual tokens;
+      spec  — paged + self-drafting speculative decoding
+              (`spec_draft_len`-token verify windows, one compiled
+              verify step).
+
+    The row reports peak resident slots both ways (the acceptance
+    inequality: paged > slab at the same budget — pinned by
+    tests/test_bench_protocol.py), speculative accept-rate, and
+    tokens/s for each mode. Greedy outputs must be token-identical
+    across all three runs (hard raise in-bench: paging and speculation
+    must never change WHAT a request decodes to, only when/where).
+    Peak-resident, accept-rate, and compile counts are deterministic
+    offline; the tokens/s contrast is only meaningful on-chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import ServingEngine
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape: exercises all three engine modes in seconds
+        dim, heads, layers_n = dim or 64, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 256, max_len or 96
+        n_requests = n_requests or 10
+        max_slots = max_slots or 8
+        block_tokens = block_tokens or 8
+        budget_tokens = budget_tokens or 2 * (max_len or 96)
+        spec_draft_len = spec_draft_len or 4
+        t_lo, t_hi, n_lo, n_hi, rate = 4, 12, 6, 14, 3.0
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_requests = n_requests or 64
+        max_slots = max_slots or 32
+        block_tokens = block_tokens or 16
+        budget_tokens = budget_tokens or 8 * (max_len or 1024)
+        spec_draft_len = spec_draft_len or 4
+        t_lo, t_hi, n_lo, n_hi, rate = 32, 128, 32, 96, 2.0
+        dtype = jnp.bfloat16
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    reqs = [
+        (
+            rng.randint(0, vocab,
+                        int(rng.randint(t_lo, t_hi + 1))).astype(np.int32),
+            int(rng.randint(n_lo, n_hi + 1)),
+        )
+        for _ in range(n_requests)
+    ]
+    # the slab wall at this budget: floor(budget/max_len) slots, each
+    # paying max_len (the [MAX_SLOTS, max_len] allocation PR 7 removed)
+    slab_slots = max(1, int(budget_tokens) // int(max_len))
+    pool_blocks = int(budget_tokens) // int(block_tokens)
+
+    def run_once(slots, blocks, spec):
+        eng = ServingEngine(
+            params, cfg, max_slots=slots, kv_block_tokens=block_tokens,
+            kv_pool_blocks=blocks, spec_draft_len=spec)
+        hs, peak, peak_blocks = [], 0, 0
+        t0 = time.time()
+        i = step = 0
+        while i < n_requests or eng.live_slots or eng.queue_depth \
+                or eng.prefilling_slots:
+            while i < n_requests and arrive_at[i] <= step:
+                p, n = reqs[i]
+                hs.append(eng.submit(p, n))
+                i += 1
+            if not eng.step() and i < n_requests:
+                step = max(step + 1, int(arrive_at[i]))  # idle gap: jump
+                continue
+            peak = max(peak, eng.live_slots + eng.prefilling_slots)
+            peak_blocks = max(peak_blocks, eng.kv_blocks_in_use)
+            step += 1
+        wall = time.time() - t0
+        return eng, wall, peak, peak_blocks, [list(h.tokens) for h in hs]
+
+    eng_slab, wall_slab, peak_slab, _, out_slab = run_once(
+        slab_slots, None, None)
+    eng_paged, wall_paged, peak_paged, pk_blocks, out_paged = run_once(
+        max_slots, pool_blocks, None)
+    eng_spec, wall_spec, peak_spec, _, out_spec = run_once(
+        max_slots, pool_blocks, spec_draft_len)
+    # paging/speculation must never change what any request decodes to
+    # — a hard raise, not a bare assert: the gate must survive -O
+    if out_paged != out_slab or out_spec != out_slab:
+        raise RuntimeError("paged/speculative run changed greedy outputs")
+    rep_paged = eng_paged.metrics.report()
+    rep_spec = eng_spec.metrics.report()
+    toks = rep_paged["tokens_out"]
+    return {
+        # the acceptance inequality: resident slots at ONE KV budget
+        "slots_resident_slab": peak_slab,
+        "slots_resident_paged": peak_paged,
+        "slots_resident_spec": peak_spec,
+        "kv_budget_tokens": int(budget_tokens),
+        "kv_pool_blocks": pool_blocks,
+        "kv_block_tokens": int(block_tokens),
+        "peak_kv_blocks_in_use": pk_blocks,
+        "kv_frag_tokens_last": rep_paged["kv_frag_tokens"],
+        "kv_tail_blocks_freed": rep_paged["kv_tail_blocks_freed"],
+        "cow_blocks": rep_paged["cow_blocks"],
+        "spec_draft_len": int(spec_draft_len),
+        "spec_accept_rate": rep_spec["spec_accept_rate"],
+        "spec_windows": rep_spec["spec_windows"],
+        "tokens_out": toks,
+        "tokens_per_sec_slab": round(toks / wall_slab, 1),
+        "tokens_per_sec_paged": round(toks / wall_paged, 1),
+        "tokens_per_sec_spec": round(toks / wall_spec, 1),
+        "decode_steps_paged": rep_paged["decode_steps"],
+        "decode_steps_spec": rep_spec["decode_steps"],
+        "decode_traces_paged": rep_paged["decode_traces"],
+        "spec_verify_traces":
+            eng_spec.metrics.trace_counts.get("spec_verify", 0),
+        "n_requests": n_requests,
+        "arrival": "poisson(rate=%g/step, seed=0)" % rate,
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
 def bench_serving_fleet(n_replicas=None, n_requests=None, families=None,
                         header_len=None, family_len=None, max_slots=None,
                         dim=None, heads=None, layers_n=None, vocab=None,
@@ -1767,6 +1919,11 @@ def main():
         # trace with the pool off vs on — prefill-tokens-computed and
         # hit rate are deterministic offline, TTFT deltas on-chip
         run("serving_shared_prefix", bench_serving_shared_prefix)
+        # paged KV block pool + speculative decoding (ISSUE 7): one
+        # fixed KV budget, slab vs paged vs paged+spec — peak resident
+        # slots, accept-rate, and output identity are deterministic
+        # offline; the tokens/s contrast awaits an on-chip window
+        run("serving_paged", bench_serving_paged)
         # serving fleet (ISSUE 6): N replicas + kill drill on the same
         # fixed-seed shared-header trace — requests lost / duplicates /
         # failovers and the affinity-routing reuse contrast are

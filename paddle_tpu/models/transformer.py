@@ -431,6 +431,224 @@ def prefill_chunk(params, cache, chunk, start_pos, slot, cfg: TransformerConfig,
     return (xl @ params["embed"].T)[0], new_cache
 
 
+# ---------------------------------------------------------------------
+# paged KV cache (serving, ISSUE 7): the cache is a pool of fixed-size
+# token BLOCKS ([NB, Bt, H, Dh] per layer) and each slot owns a block
+# TABLE (row of physical block ids) instead of a contiguous cache row —
+# PagedAttention (Kwon et al., SOSP '23) in static-shape JAX idiom. HBM
+# residency scales with blocks actually written, not MAX_SLOTS*max_len;
+# prefix reuse becomes table aliasing (two slots naming the same
+# physical block) instead of device copies. The gathered per-slot view
+# these primitives attend over is TRANSIENT activation scratch (freed
+# after the step), unlike the slab, which was resident between steps.
+# ---------------------------------------------------------------------
+
+
+def init_paged_kv_cache(cfg: TransformerConfig, num_blocks: int,
+                        block_tokens: int, dtype=None):
+    """Per-layer pooled K/V block buffers [NB, Bt, H, Dh]."""
+    dh = cfg.dim // cfg.heads
+    shape = (int(num_blocks), int(block_tokens), cfg.heads, dh)
+    dt = dtype or cfg.dtype
+    return [
+        {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        for _ in range(cfg.layers)
+    ]
+
+
+def _paged_view(buf, tables):
+    """Gather a contiguous per-slot view [S, MAXB*Bt, H, Dh] out of the
+    block pool [NB, Bt, H, Dh] through block tables [S, MAXB].
+    Unallocated table entries (-1) clamp to block 0 — the rows they
+    surface are garbage, but every caller masks attention by position,
+    and position masks always exclude unwritten depths, so garbage
+    rows contribute exactly 0 (finite * zero-prob)."""
+    NB, Bt, H, dh = buf.shape
+    v = buf[jnp.clip(tables, 0, NB - 1)]
+    lead = tables.shape[:-1] + (tables.shape[-1] * Bt, H, dh)
+    return v.reshape(lead)
+
+
+def _phys_rows(tables, wpos, NB, Bt):
+    """Map global write positions to (physical block, in-block offset).
+    A position past the table span (the engine parks dead/padded rows
+    at MAXB*Bt, the paged analogue of the slab's position-L trick) or
+    landing on an unallocated (-1) entry resolves to block NB — out of
+    range, so the scatter DROPS the write."""
+    maxb = tables.shape[-1]
+    bi = wpos // Bt
+    safe = jnp.clip(bi, 0, maxb - 1)
+    if tables.ndim == 1:
+        phys = tables[safe]
+    elif safe.ndim == tables.ndim:
+        phys = jnp.take_along_axis(tables, safe, axis=-1)
+    else:  # one position per table row (the decode step's [S] case)
+        phys = jnp.take_along_axis(tables, safe[..., None], axis=-1)[..., 0]
+    phys = jnp.where((bi < maxb) & (phys >= 0), phys, jnp.int32(NB))
+    return phys, wpos % Bt
+
+
+def paged_decode_step(params, token, pos, tables, cache,
+                      cfg: TransformerConfig):
+    """One decode step over the paged pool: token [S] at per-row
+    positions `pos` [S], block tables [S, MAXB] -> (logits [S, vocab],
+    updated cache). Mirrors decode_step's numerics verbatim
+    (_cached_attention's divide-after-matmul/-inf mask) on the gathered
+    per-slot view, so a paged engine row decodes to the same tokens the
+    slab engine (and sequential generate()) produces. A parked row
+    (pos >= MAXB*Bt) writes nothing; its logits are garbage nothing
+    reads."""
+    B = token.shape[0]
+    dh = cfg.dim // cfg.heads
+    NB, Bt = cache[0]["k"].shape[0], cache[0]["k"].shape[1]
+    x = params["embed"][token] + params["pos"][pos]
+    new_cache = []
+    for blk, kv in zip(params["blocks"], cache):
+        h = _ln(x, blk["ln1"])
+        q = (h @ blk["wq"]).reshape(B, cfg.heads, dh)
+        k = (h @ blk["wk"]).reshape(B, cfg.heads, dh)
+        v = (h @ blk["wv"]).reshape(B, cfg.heads, dh)
+        pk, off = _phys_rows(tables, pos, NB, Bt)
+        ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
+        cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
+        new_cache.append({"k": ck, "v": cv})
+        o = _cached_attention(
+            q, _paged_view(ck, tables), _paged_view(cv, tables), pos
+        ).reshape(B, cfg.dim)
+        x = x + o @ blk["wo"]
+        h = _ln(x, blk["ln2"])
+        if "moe" in blk:
+            from ..parallel.moe import reference_moe
+
+            mp = blk["moe"]
+            x = x + reference_moe(
+                h, mp["gate_w"], mp["w1"], mp["b1"], mp["w2"], mp["b2"]
+            )
+        else:
+            x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
+    x = _ln(x, params["ln_f"])
+    return x @ params["embed"].T, new_cache
+
+
+def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
+                        cfg: TransformerConfig, true_len=None):
+    """prefill_chunk over the paged pool: extend the slot whose block
+    table is `table_row` [MAXB] by a [C]-token chunk starting at
+    `start_pos`. Identical math to prefill_chunk (reference_attention's
+    scale-into-q einsum and -1e30 mask — see its docstring for why),
+    with the slot's contiguous cache replaced by the gathered block
+    view; padded rows (offs >= true_len) park their writes past the
+    table span, where the scatter drops them."""
+    from ..parallel.attention import _NEG_INF
+
+    (C,) = chunk.shape
+    NB, Bt, H, dh = cache[0]["k"].shape
+    Lv = table_row.shape[0] * Bt
+    if true_len is None:
+        true_len = C
+    scale = 1.0 / math.sqrt(dh)
+    offs = jnp.arange(C)
+    positions = start_pos + offs  # [C] global rows of the chunk
+    wpos = jnp.where(offs < true_len, positions, jnp.int32(Lv))
+    x = params["embed"][chunk][None] + params["pos"][positions][None]
+    new_cache = []
+    for blk, kv in zip(params["blocks"], cache):
+        h = _ln(x, blk["ln1"])
+        q = (h @ blk["wq"]).reshape(1, C, cfg.heads, dh)
+        k = (h @ blk["wk"]).reshape(1, C, cfg.heads, dh)
+        v = (h @ blk["wv"]).reshape(1, C, cfg.heads, dh)
+        pk, off = _phys_rows(table_row, wpos, NB, Bt)
+        ck = kv["k"].at[pk, off].set(k[0].astype(kv["k"].dtype))
+        cv = kv["v"].at[pk, off].set(v[0].astype(kv["v"].dtype))
+        new_cache.append({"k": ck, "v": cv})
+        slot_k = _paged_view(ck, table_row[None])  # [1, Lv, H, dh]
+        slot_v = _paged_view(cv, table_row[None])
+        s = jnp.einsum("bthd,bshd->bhts", q * scale, slot_k)
+        mask = jnp.arange(Lv)[None, :] <= positions[:, None]  # [C, Lv]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhts,bshd->bthd", p, slot_v)
+        x = x + o.reshape(1, C, cfg.dim) @ blk["wo"]
+        h = _ln(x, blk["ln2"])
+        if "moe" in blk:
+            from ..parallel.moe import reference_moe
+
+            mp = blk["moe"]
+            flat = h.reshape(C, cfg.dim)
+            y = reference_moe(flat, mp["gate_w"], mp["w1"], mp["b1"],
+                              mp["w2"], mp["b2"])
+            x = x + y.reshape(1, C, cfg.dim)
+        else:
+            x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
+    xl = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
+                                      keepdims=False)  # [1, dim]
+    xl = _ln(xl, params["ln_f"])
+    return (xl @ params["embed"].T)[0], new_cache
+
+
+def paged_verify_step(params, cache, window, pos, wpos, tables,
+                      cfg: TransformerConfig):
+    """Speculative-decoding verify: run a K-token `window` [S, K] per
+    slot (the pending token followed by K-1 drafted tokens) through the
+    paged cache in ONE batched step, returning logits for every window
+    position [S, K, vocab]. Row (s, i) sits at global position
+    pos[s] + i and attends the slot's cache up to and including itself
+    (the intra-window causal prefix falls out of the position mask,
+    because earlier window rows were just written at earlier
+    positions). `wpos` [S, K] are the WRITE positions, precomputed by
+    the caller so dead slots and rows past a request's token budget
+    park (>= MAXB*Bt -> dropped); the mask/embedding positions are
+    always pos[s] + i. logits[s, i] is "the next token after
+    window[s, :i+1]" — exactly decode_step's answer when drafts
+    0..i match what the model would have produced, which is what the
+    engine's acceptance rule checks. Chunk-family numerics
+    (scale-into-q, -1e30 mask), the same low-bit-vs-decode_step class
+    prefill_chunk documents."""
+    from ..parallel.attention import _NEG_INF
+
+    S, K = window.shape
+    NB, Bt, H, dh = cache[0]["k"].shape
+    Lv = tables.shape[1] * Bt
+    scale = 1.0 / math.sqrt(dh)
+    positions = pos[:, None] + jnp.arange(K)[None, :]  # [S, K]
+    x = params["embed"][window] + params["pos"][positions]
+    new_cache = []
+    for blk, kv in zip(params["blocks"], cache):
+        h = _ln(x, blk["ln1"])
+        q = (h @ blk["wq"]).reshape(S, K, cfg.heads, dh)
+        k = (h @ blk["wk"]).reshape(S, K, cfg.heads, dh)
+        v = (h @ blk["wv"]).reshape(S, K, cfg.heads, dh)
+        pk, off = _phys_rows(tables, wpos, NB, Bt)  # [S, K]
+        ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
+        cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
+        new_cache.append({"k": ck, "v": cv})
+        kview = _paged_view(ck, tables)  # [S, Lv, H, dh]
+        vview = _paged_view(cv, tables)
+        s = jnp.einsum("bthd,bshd->bhts", q * scale, kview)
+        mask = jnp.arange(Lv)[None, None, :] <= positions[:, :, None]
+        s = jnp.where(mask[:, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhts,bshd->bthd", p, vview)
+        x = x + o.reshape(S, K, cfg.dim) @ blk["wo"]
+        h = _ln(x, blk["ln2"])
+        if "moe" in blk:
+            from ..parallel.moe import reference_moe
+
+            mp = blk["moe"]
+            flat = h.reshape(S * K, cfg.dim)
+            y = reference_moe(flat, mp["gate_w"], mp["w1"], mp["b1"],
+                              mp["w2"], mp["b2"])
+            x = x + y.reshape(S, K, cfg.dim)
+        else:
+            x = x + jax.nn.gelu(h @ blk["w1"]) @ blk["w2"]
+    x = _ln(x, params["ln_f"])
+    return x @ params["embed"].T, new_cache
+
+
+__all__ += ["init_paged_kv_cache", "paged_decode_step",
+            "paged_prefill_chunk", "paged_verify_step"]
+
+
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,
              temperature=0.0, key=None, max_len=None, eos_id=None):
     """Autoregressive generation: prefill the prompt [B, T0], then

@@ -1,14 +1,17 @@
 """Continuous-batching inference serving (ISSUE 2 tentpole + ISSUE 4
-prefix reuse + ISSUE 6 fleet): slotted KV cache + prefix-cached chunked
-prefill + one compiled decode step over models/transformer.py's
-cached-decode primitives, replicated behind a fault-tolerant front
-door. See engine.py for the engine design story, prefix_cache.py for
-the trie-keyed KV pool, fleet.py for the supervised replica fleet
-(durable request journal, incarnation-fenced failover, prefix-affinity
-routing, backpressure), and tests/test_serving_engine.py +
+prefix reuse + ISSUE 6 fleet + ISSUE 7 paged KV): a paged KV block
+pool with per-slot block tables + prefix reuse by ref-counted block
+aliasing + chunked prefill + one compiled decode (or speculative
+verify) step over models/transformer.py's paged primitives, replicated
+behind a fault-tolerant front door. See engine.py for the engine
+design story, kv_blocks.py for the pool allocator
+(reservation/ref-count discipline), prefix_cache.py for the trie-keyed
+prefix pool, fleet.py for the supervised replica fleet (durable
+request journal, incarnation-fenced failover, prefix-affinity routing,
+backpressure), and tests/test_serving_engine.py +
 tests/test_serving_fleet.py for the correctness bars (token identity
-vs sequential generate(); zero requests lost or answered twice under
-kill drills)."""
+vs sequential generate() across paging/speculation/failover; zero
+requests lost or answered twice under kill drills)."""
 
 from .engine import EngineFailed, ServingEngine, ServingHandle
 from .fleet import (
@@ -17,10 +20,11 @@ from .fleet import (
     RequestJournal,
     ServingFleet,
 )
+from .kv_blocks import KVBlockAllocator
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache, PrefixMatch, chain_keys
 
 __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
            "PrefixCache", "PrefixMatch", "chain_keys", "EngineFailed",
            "ServingFleet", "FleetHandle", "FleetSaturated",
-           "RequestJournal"]
+           "RequestJournal", "KVBlockAllocator"]

@@ -1,60 +1,79 @@
-"""Continuous-batching serving engine: slotted KV cache, prefix-cached
-chunked prefill, and ONE compiled decode step for many concurrent
-requests.
+"""Continuous-batching serving engine: paged KV block pool, prefix
+reuse by block-table aliasing, chunked prefill, and ONE compiled decode
+(or speculative-verify) step for many concurrent requests.
 
 The training path sits at the HBM roof (PERF.md r5); the unclaimed
 serving throughput is workload shape — one request per batch underfills
 the lanes and every new prompt length recompiles. This engine
 reproduces Orca-style iteration-level scheduling (Yu et al., OSDI '22)
-and vLLM-style slot management (Kwon et al., SOSP '23) in JAX/XLA
-idiom: static shapes everywhere, slots instead of dynamic allocation.
-On top of that base (PR 2), admission now reuses and bounds prefill
-work (PR 4):
+in JAX/XLA idiom: static shapes everywhere, slots instead of dynamic
+allocation. On top of that base (PR 2), admission reuses and bounds
+prefill work (PR 4), and the KV cache itself is paged (PR 7):
 
-  * Slotted KV cache — one fixed [MAX_SLOTS, max_len] cache per layer
-    holds many independent requests; per-slot `pos`/`alive` side-bands
-    and the per-row mask in models/transformer._cached_attention make a
-    dead or stale slot contribute exactly 0 to live rows.
-  * Prefix cache — completed prompt prefixes are published (up to the
-    request's publish boundary) into a trie-keyed block pool
-    (prefix_cache.py, RadixAttention-style); admission matches the
-    longest cached chain and device-copies it into the slot — a
-    dynamic_update_slice per block instead of recomputing the header
-    every request shares.
+  * Paged KV block pool — the per-layer cache is a pool of fixed
+    `kv_block_tokens`-token blocks ([NB, Bt, H, Dh]); each slot owns a
+    block-table row mapping logical depth to physical blocks
+    (PagedAttention, Kwon et al., SOSP '23; the reference's
+    PoolAllocator.h/MemoryHandle pooled-allocator lineage). Admission
+    RESERVES the request's worst case (ceil((T0+max_new)/Bt) blocks)
+    so decode can never deadlock, but blocks are ALLOCATED on demand
+    as the sequence grows, and retirement frees the allocated blocks
+    plus the reserved-but-unreached tail — HBM residency and admission
+    capacity scale with tokens actually resident, not
+    MAX_SLOTS x max_len (the slab this replaces).
+  * Prefix reuse = table aliasing — completed prompt prefixes publish
+    their PHYSICAL block ids into the trie pool (prefix_cache.py,
+    RadixAttention-style); a hit writes those ids into the new slot's
+    table (ref-counted, zero-copy — no dynamic_update_slice copies).
+    When the suffix must recompute a token inside a shared block (the
+    maximal-reuse case: the whole prompt is cached but the last
+    token's logits must be computed), the block is COPY-ON-WRITE
+    privatised first, so a shared block is never written through.
   * Chunked prefill — the uncached suffix runs through
-    models/transformer.prefill_chunk in chunks of
+    models/transformer.paged_prefill_chunk in chunks of
     `prefill_chunk_tokens`, interleaved with batched decode steps
-    (Sarathi-Serve, Agrawal et al., OSDI '24): a long prompt no longer
-    stalls every in-flight decode for its whole duration. Chunks pad to
-    pow-2 buckets (the same discipline as executor.py _lod_bucket), so
-    distinct compiled prefill shapes stay O(log max_len).
+    (Sarathi-Serve, Agrawal et al., OSDI '24). Chunks pad to pow-2
+    buckets, so distinct compiled prefill shapes stay O(log max_len).
   * One jitted decode step — advances all MAX_SLOTS slots at once with
     per-slot positions, temperatures, and sampling keys; cache buffers
-    are donated. Traced exactly once per engine lifetime (guarded by
-    tests/test_serving_engine.py's compile-count test). The six host
-    side-band arrays are device-resident between steps: the decode
-    step returns the advanced tok/pos/counts bands, and only bands a
-    scheduler event dirtied (_admit activation, retirement) are
-    re-uploaded — the steady decode loop does zero h2d band traffic.
+    are donated. Traced exactly once per engine lifetime. The eight
+    host side-band arrays (now including the block tables and budget
+    limits) are device-resident between steps; the steady decode loop
+    re-uploads a band only when a scheduler event dirties it (block
+    tables change only every `kv_block_tokens` decodes, at the
+    on-demand append).
+  * Self-drafting speculative decoding — with `spec_draft_len` = K,
+    each decode phase proposes K-1 draft tokens per slot by prompt
+    lookup (the last bigram's previous continuation in
+    prompt+generated context — "self-drafting": no draft model) and
+    verifies the K-token window in ONE batched compiled step
+    (models/transformer.paged_verify_step, traced exactly once). The
+    acceptance rule emits the model's own tokens — greedy outputs are
+    IDENTICAL to the plain decode path whatever the drafts were;
+    drafts only change how many tokens one step emits. Sampled
+    requests keep the fold_in(key, token_index) schedule (position i
+    uses index counts+i), so sampling is spec-invariant too.
   * Iteration-level scheduling — ServingEngine.step() retires a slot
     the moment its request emits EOS or exhausts its budget and refills
-    it from the FCFS queue on the SAME step; a new request never waits
-    for the whole batch to drain. A pending slot advances at most ONE
-    chunk per step (chunks always interleave with decodes — the
-    Sarathi policy); `max_prefills_per_step` additionally caps the
-    TOTAL chunks across slots per step (None = every pending slot
-    advances, 1 = only the FCFS head — the flattest decode latency).
+    it from the FCFS queue on the SAME step; a saturated block pool
+    QUEUES admissions (backpressure) instead of raising, and the next
+    retirement's freed blocks admit them. A pending slot advances at
+    most ONE chunk per step (chunks always interleave with decodes —
+    the Sarathi policy); `max_prefills_per_step` additionally caps the
+    TOTAL chunks across slots per step.
 
 Correctness bar (tested): greedy engine output per request is
-bit-identical to sequential models/transformer.generate() at every
+token-identical to sequential models/transformer.generate() at every
 slot count and admission order, for every cache path — cold miss,
-full hit, partial hit, and post-eviction re-admit. (Identity is at the
-TOKEN level: padded/chunked prefill drifts from the unpadded oracle in
-the last ~2 float bits — reduction order under masked padding, present
-since PR 2 — which never moves an argmax in practice and is pinned by
-the fixed-seed drills.) Sampled requests use a per-request
-fold_in(key, token_index) schedule — deterministic per request and
-independent of slot assignment, but not the same key schedule as
+aliased hit, copy-on-write, post-eviction re-admit — and with
+speculative decoding on or off (spec changes WHEN tokens are produced,
+never WHICH). Identity is at the TOKEN level: padded/chunked prefill
+drifts from the unpadded oracle in the last ~2 float bits — reduction
+order under masked padding, present since PR 2 — which never moves an
+argmax in practice and is pinned by the fixed-seed drills. Sampled
+requests use a per-request fold_in(key, token_index) schedule —
+deterministic per request and independent of slot assignment and
+spec_draft_len, but not the same key schedule as
 generate(temperature>0).
 """
 
@@ -72,12 +91,14 @@ import numpy as np
 from ..distributed import fault_injection as _fi
 from ..fluid.core.kernels_sequence import bucket_pow2
 from ..models import transformer as tlm
+from .kv_blocks import KVBlockAllocator
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 
 __all__ = ["ServingEngine", "ServingHandle", "EngineFailed"]
 
-_BANDS = ("tok", "pos", "alive", "temps", "counts", "base_keys")
+_BANDS = ("tok", "pos", "alive", "temps", "counts", "base_keys",
+          "tables", "limits")
 
 
 class EngineFailed(RuntimeError):
@@ -144,25 +165,33 @@ class ServingHandle(object):
 
 
 class ServingEngine(object):
-    """Continuous-batching engine over a transformer LM's decode
+    """Continuous-batching engine over a transformer LM's paged decode
     primitives. Knobs: `max_slots` (concurrent requests in the batched
-    decode), `max_len` (per-slot KV capacity, bounded by the positional
-    table), `min_bucket` (smallest prefill pad length),
+    decode), `max_len` (per-request position cap, bounded by the
+    positional table), `min_bucket` (smallest prefill pad length),
     `max_prefills_per_step` (total prefill chunks per step across
     slots; each pending slot advances at most one chunk per step
     regardless, so None = all pending slots advance, 1 = only the FCFS
     head — latency-biased for in-flight decodes),
     `prefill_chunk_tokens` (max tokens per prefill chunk;
-    None = whole suffix in one chunk), `prefix_cache_tokens` (token
-    budget of the shared prefix KV pool; None/0 disables reuse), and
-    `prefix_block_tokens` (pool block granularity — prefixes cache and
-    match in whole blocks)."""
+    None = whole suffix in one chunk), `kv_block_tokens` (KV pool
+    block granularity — allocation, prefix caching, and copy-on-write
+    all happen in whole blocks), `kv_pool_blocks` (physical blocks in
+    the pool = the engine's KV HBM budget / (Bt tokens x layers);
+    default max_slots x ceil(max_len/Bt), the slab-parity worst case),
+    `spec_draft_len` (speculative window size K: the pending token
+    plus K-1 self-drafted tokens verified per step; None/<2 = off),
+    and `prefix_cache_tokens` (token budget of the shared prefix trie;
+    None/0 disables reuse). `prefix_block_tokens` is the pre-paging
+    name for the block granularity and still accepted: trie blocks ARE
+    pool blocks now, so the two sizes cannot differ."""
 
     def __init__(self, params, cfg, max_slots=8, max_len=None,
                  min_bucket=8, max_prefills_per_step=None, donate=True,
                  prefill_chunk_tokens=None, prefix_cache_tokens=None,
-                 prefix_block_tokens=16, replica_id=None,
-                 fault_injector=None):
+                 prefix_block_tokens=None, kv_block_tokens=None,
+                 kv_pool_blocks=None, spec_draft_len=None,
+                 replica_id=None, fault_injector=None):
         self._params = params
         self._cfg = cfg
         if getattr(cfg, "moe_experts", 0):
@@ -190,16 +219,44 @@ class ServingEngine(object):
         if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
             raise ValueError("prefill_chunk_tokens must be >= 1 or None")
         self.prefill_chunk_tokens = prefill_chunk_tokens
+        if (kv_block_tokens is not None and prefix_block_tokens is not None
+                and int(kv_block_tokens) != int(prefix_block_tokens)):
+            raise ValueError(
+                "trie blocks ARE pool blocks: kv_block_tokens (%d) and "
+                "prefix_block_tokens (%d) cannot differ"
+                % (int(kv_block_tokens), int(prefix_block_tokens)))
+        if kv_block_tokens is None:
+            kv_block_tokens = prefix_block_tokens
+        Bt = 16 if kv_block_tokens is None else int(kv_block_tokens)
+        if Bt < 1:  # an explicit 0 must be loud, not a silent default
+            raise ValueError("kv_block_tokens must be >= 1")
+        self.kv_block_tokens = Bt
+        self.blocks_per_slot = -(-L // Bt)  # ceil: table row width
+        NB = (S * self.blocks_per_slot if kv_pool_blocks is None
+              else int(kv_pool_blocks))
+        if NB < 1:
+            raise ValueError("kv_pool_blocks must be >= 1")
+        # a pool smaller than one slot's max_len worst case is legal —
+        # submit() rejects the individual requests that can never fit
+        self.num_kv_blocks = NB
+        if spec_draft_len is not None and int(spec_draft_len) < 0:
+            raise ValueError("spec_draft_len must be >= 0 or None")
+        # K < 2 means no drafts to verify — the plain decode step
+        self.spec_draft_len = (
+            int(spec_draft_len) if spec_draft_len and int(spec_draft_len) >= 2
+            else None)
         self.metrics = ServingMetrics(S)
+        self.metrics.kv_blocks_total = NB
+        self._alloc = KVBlockAllocator(NB, Bt)  # guarded-by: scheduler
         self.prefix_cache: Optional[PrefixCache] = None
         if prefix_cache_tokens:
             self.prefix_cache = PrefixCache(
-                int(prefix_cache_tokens),
-                block_tokens=int(prefix_block_tokens),
+                int(prefix_cache_tokens), block_tokens=Bt,
+                on_evict=self._alloc.decref,
             )
             self.metrics.prefix_cache = self.prefix_cache
 
-        self._cache = tlm.init_kv_cache(cfg, S, max_len=L)
+        self._cache = tlm.init_paged_kv_cache(cfg, NB, Bt)
         # host-side truth of the per-slot side-bands; device copies are
         # kept across steps and re-uploaded only when dirtied. All
         # scheduler state below is confined to the thread driving
@@ -213,20 +270,35 @@ class ServingEngine(object):
         self._temps = np.zeros(S, np.float32)  # guarded-by: scheduler
         self._counts = np.zeros(S, np.int32)  # guarded-by: scheduler
         self._base_keys = np.zeros((S, 2), np.uint32)  # guarded-by: scheduler
+        # per-slot block table (logical depth -> physical block id; -1
+        # = not yet allocated) and position limit (T0 + max_new: verify
+        # rows at or past it park their writes)
+        self._tables = np.full((S, self.blocks_per_slot), -1,
+                               np.int32)      # guarded-by: scheduler
+        self._limits = np.zeros(S, np.int32)  # guarded-by: scheduler
+        self._n_alloc = np.zeros(S, np.int32)  # table entries >= 0  # guarded-by: scheduler
+        self._reserved_tail = np.zeros(S, np.int32)  # guarded-by: scheduler
         self._dev: Dict[str, Any] = {}        # guarded-by: scheduler
         self._dirty = set(_BANDS)             # guarded-by: scheduler
         self._slot_req: List[Optional[ServingHandle]] = [None] * S  # guarded-by: scheduler
         # per-slot chunked-prefill cursors + FCFS order of pending slots
         self._prefill_state: Dict[int, dict] = {}  # guarded-by: scheduler
         self._prefill_q: collections.deque = collections.deque()  # guarded-by: scheduler
+        # per-slot self-drafting index (spec decode): the context token
+        # list, a bigram -> end-of-last-occurrence map maintained
+        # incrementally per emitted token, and the tail bigram's
+        # PREVIOUS occurrence — O(1) per step instead of rescanning the
+        # whole context every decode
+        self._spec_ctx: Dict[int, dict] = {}  # guarded-by: scheduler
 
         self._queue: collections.deque = collections.deque()  # guarded-by: scheduler
         self._next_rid = 0                    # guarded-by: scheduler
         self._donate = bool(donate)
         self._chunk_fns: Dict[int, Any] = {}
         self._decode_fn = self._make_decode()
-        self._copy_fn = None
-        self._extract_fn = None
+        self._verify_fn = (
+            self._make_verify() if self.spec_draft_len else None)
+        self._cow_fn = None
         # failure latch (abort() docstring) + fleet attribution
         self.replica_id = replica_id
         self._failed: Optional[EngineFailed] = None  # guarded-by: scheduler
@@ -241,17 +313,19 @@ class ServingEngine(object):
     # compiled steps
     # ------------------------------------------------------------------
     def _make_decode(self):
-        cfg, metrics, L = self._cfg, self.metrics, self.max_len
+        cfg, metrics = self._cfg, self.metrics
+        Lv = self.blocks_per_slot * self.kv_block_tokens
 
-        def _decode(params, cache, tok, pos, alive, temps, counts,
-                    base_keys):
+        def _decode(params, cache, tables, tok, pos, alive, temps,
+                    counts, base_keys):
             metrics.count_trace("decode_step")  # trace-time side effect
-            # dead slots park their write out of range: scatter DROPS
-            # out-of-bounds rows, so a retired slot can never dirty the
-            # cache a future prefill will claim
-            write_pos = jnp.where(alive, pos, jnp.int32(L))
-            logits, cache = tlm.decode_step(
-                params, tok, write_pos, cache, cfg
+            # dead slots park their write past the table span: the
+            # block lookup resolves them to the out-of-range sentinel
+            # block and the scatter DROPS the row, so a retired slot
+            # can never dirty a block a future request will claim
+            write_pos = jnp.where(alive, pos, jnp.int32(Lv))
+            logits, cache = tlm.paged_decode_step(
+                params, tok, write_pos, tables, cache, cfg
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
@@ -272,6 +346,52 @@ class ServingEngine(object):
         kw = {"donate_argnums": (1,)} if self._donate else {}
         return jax.jit(_decode, **kw)
 
+    def _make_verify(self):
+        """ONE compiled speculative-verify step: writes every slot's
+        K-token window into its paged cache, returns the model's
+        candidate token after each window prefix. Host-side acceptance
+        turns candidates into emitted tokens; device-side this is a
+        fixed [S, K] shape traced exactly once per engine lifetime."""
+        cfg, metrics = self._cfg, self.metrics
+        K = self.spec_draft_len
+        Lv = self.blocks_per_slot * self.kv_block_tokens
+
+        def _verify(params, cache, tables, window, pos, alive, limits,
+                    temps, counts, base_keys):
+            metrics.count_trace("spec_verify")  # trace-time side effect
+            rows = pos[:, None] + jnp.arange(K)[None, :]  # [S, K]
+            # dead slots and rows past the request's token budget park
+            ok = alive[:, None] & (rows < limits[:, None])
+            wpos = jnp.where(ok, rows, jnp.int32(Lv))
+            logits, cache = tlm.paged_verify_step(
+                params, cache, window, pos, wpos, tables, cfg
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # per-position sampling keys: position i of a slot whose
+            # request has emitted `counts` tokens samples token index
+            # counts + i — the SAME fold_in schedule the plain decode
+            # path uses, so sampled outputs are spec-invariant
+            idx = counts[:, None] + jnp.arange(K)[None, :]
+            keys = jax.vmap(
+                jax.vmap(jax.random.fold_in, in_axes=(None, 0)),
+                in_axes=(0, 0),
+            )(base_keys, idx)
+            safe_t = jnp.where(temps > 0, temps, 1.0)
+            sampled = jax.vmap(
+                jax.vmap(
+                    lambda k, l, t: jax.random.categorical(
+                        k, l.astype(jnp.float32) / t
+                    ),
+                    in_axes=(0, 0, None),
+                ),
+                in_axes=(0, 0, 0),
+            )(keys, logits, safe_t).astype(jnp.int32)
+            cand = jnp.where((temps > 0)[:, None], sampled, greedy)
+            return cache, cand
+
+        kw = {"donate_argnums": (1,)} if self._donate else {}
+        return jax.jit(_verify, **kw)
+
     def _chunk_fn(self, Cb):
         """One compiled prefill-chunk step per pow-2 bucket: extends a
         slot's cached prefix by a [Cb]-padded chunk and returns the
@@ -282,11 +402,11 @@ class ServingEngine(object):
             return fn
         cfg, metrics = self._cfg, self.metrics
 
-        def _chunk(params, cache, padded, start, slot, true_len, temp,
-                   key):
+        def _chunk(params, cache, padded, start, table_row, true_len,
+                   temp, key):
             metrics.count_trace("prefill_T%d" % Cb)
-            logits, cache = tlm.prefill_chunk(
-                params, cache, padded, start, slot, cfg,
+            logits, cache = tlm.paged_prefill_chunk(
+                params, cache, padded, start, table_row, cfg,
                 true_len=true_len,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -303,51 +423,23 @@ class ServingEngine(object):
         self._chunk_fns[Cb] = fn
         return fn
 
-    def _make_copy_fn(self):
-        """Device-side prefix reuse: one dynamic_update_slice per layer
-        writes a cached [B, H, Dh] block into the slot at its depth.
-        ONE compiled shape total (fixed block size) — reuse adds no
-        pressure on the pow-2 prefill bucket budget."""
+    def _make_cow(self):
+        """Copy-on-write: privatise one shared block before the suffix
+        writes into it. ONE compiled shape total (fixed block size) —
+        the only device copy left in the reuse path; plain aliasing
+        moves zero bytes."""
         metrics = self.metrics
 
-        def _copy(cache, kk, vv, slot, pos):
-            metrics.count_trace("prefix_copy")
-            new = []
-            for i, kv in enumerate(cache):
-                ck = jax.lax.dynamic_update_slice(
-                    kv["k"], kk[i][None].astype(kv["k"].dtype),
-                    (slot, pos, 0, 0))
-                cv = jax.lax.dynamic_update_slice(
-                    kv["v"], vv[i][None].astype(kv["v"].dtype),
-                    (slot, pos, 0, 0))
-                new.append({"k": ck, "v": cv})
-            return new
+        def _cow(cache, dst, src):
+            metrics.count_trace("cow_copy")
+            return [
+                {"k": kv["k"].at[dst].set(kv["k"][src]),
+                 "v": kv["v"].at[dst].set(kv["v"][src])}
+                for kv in cache
+            ]
 
         kw = {"donate_argnums": (0,)} if self._donate else {}
-        return jax.jit(_copy, **kw)
-
-    def _make_extract_fn(self):
-        """Publish path: slice one block's per-layer K/V out of a slot
-        into stacked [layers, B, H, Dh] pool payloads. Not donated —
-        the engine keeps using the cache it reads from."""
-        metrics = self.metrics
-        B = self.prefix_cache.block_tokens
-        H = self._cfg.heads
-        dh = self._cfg.dim // self._cfg.heads
-
-        def _extract(cache, slot, pos):
-            metrics.count_trace("prefix_extract")
-            kk = jnp.stack([
-                jax.lax.dynamic_slice(
-                    kv["k"], (slot, pos, 0, 0), (1, B, H, dh))[0]
-                for kv in cache])
-            vv = jnp.stack([
-                jax.lax.dynamic_slice(
-                    kv["v"], (slot, pos, 0, 0), (1, B, H, dh))[0]
-                for kv in cache])
-            return kk, vv
-
-        return jax.jit(_extract)
+        return jax.jit(_cow, **kw)
 
     # ------------------------------------------------------------------
     # device-resident side-bands
@@ -363,16 +455,83 @@ class ServingEngine(object):
         self._dirty.update(names or _BANDS)
 
     # ------------------------------------------------------------------
+    # block bookkeeping
+    # ------------------------------------------------------------------
+    def _blocks_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.kv_block_tokens)
+
+    def _ensure_blocks(self, s: int, lo: int, hi: int):
+        """Materialise (from this slot's reservation) every block
+        covering positions [lo, hi) that the table has not allocated
+        yet — the on-demand append that keeps residency at tokens
+        actually written."""
+        if hi <= lo:
+            return
+        Bt = self.kv_block_tokens
+        for b in range(lo // Bt, (hi - 1) // Bt + 1):
+            if self._tables[s, b] < 0:
+                self._tables[s, b] = self._alloc.alloc_reserved()
+                self._reserved_tail[s] -= 1
+                self._n_alloc[s] += 1
+                self._mark_dirty("tables")
+
+    def _reclaim_for(self, need_new: int):
+        """Evict idle trie chains until `need_new` blocks are
+        available — but ONLY when eviction can actually bridge the gap
+        (the freeable gain is trie payloads nobody holds whose pool
+        refcount is 1: eviction of a slot-aliased or match-held block
+        frees nothing). A failed admission attempt must leave the trie
+        INTACT: a block-starved request retries every scheduler step,
+        and unconditional reclaim would drain every shareable chain
+        before anything admits (review hardening)."""
+        pc = self.prefix_cache
+        if pc is None or self._alloc.available >= need_new:
+            return
+        gain = sum(1 for bid in pc.idle_payloads()
+                   if self._alloc.refcount(int(bid)) == 1)
+        if self._alloc.available + gain < need_new:
+            return  # hopeless right now: stay queued, trie untouched
+        while self._alloc.available < need_new:
+            # shareability yields to admitting the next request
+            if pc.reclaim(need_new - self._alloc.available) == 0:
+                break
+
+    def _free_slot_blocks(self, s: int):
+        """Retirement: drop this slot's reference on every allocated
+        block (a block shared with the prefix trie or another slot
+        survives) and release the reserved-but-unreached tail — the
+        capacity an early-EOS request never grew into."""
+        freed = 0
+        for b in range(self.blocks_per_slot):
+            bid = int(self._tables[s, b])
+            if bid >= 0 and self._alloc.decref(bid):
+                freed += 1
+        tail = int(self._reserved_tail[s])
+        if tail:
+            self._alloc.release_reservation(tail)
+        self.metrics.kv_blocks_freed_at_retire += freed
+        self.metrics.kv_tail_blocks_freed += tail
+        self._tables[s, :] = -1
+        self._n_alloc[s] = 0
+        self._reserved_tail[s] = 0
+        self._limits[s] = 0
+        self._mark_dirty("tables", "limits")
+
+    # ------------------------------------------------------------------
     # scheduler
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0, eos_id=None,
                seed=0, publish_len=None) -> ServingHandle:
         """Enqueue one request (FCFS). Returns a handle whose `.tokens`
         fills in as the engine steps; `handle.result()` drives the
-        engine to completion of this request. `publish_len` is the
-        publish-boundary tag: at most this many leading prompt tokens
-        are published to the prefix pool once prefill completes (None =
-        the whole prompt; pass the shared-header length to keep
+        engine to completion of this request. Structurally impossible
+        requests (past the positional table, or needing more blocks
+        than the whole pool) raise; a merely SATURATED pool queues —
+        the block-budget check happens at admission and retirements
+        free capacity (backpressure, ISSUE 7 satellite). `publish_len`
+        is the publish-boundary tag: at most this many leading prompt
+        tokens are published to the prefix pool once prefill completes
+        (None = the whole prompt; pass the shared-header length to keep
         request-unique tails out of the pool)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T0 = prompt.shape[0]
@@ -384,6 +543,13 @@ class ServingEngine(object):
             raise ValueError(
                 "request needs T0+max_new <= max_len (%d + %d > %d)"
                 % (T0, int(max_new_tokens), self.max_len)
+            )
+        if self._blocks_for(T0 + int(max_new_tokens)) > self.num_kv_blocks:
+            raise ValueError(
+                "request worst case (%d blocks) exceeds the whole KV "
+                "pool (%d blocks of %d tokens)"
+                % (self._blocks_for(T0 + int(max_new_tokens)),
+                   self.num_kv_blocks, self.kv_block_tokens)
             )
         if publish_len is not None and publish_len < 0:
             raise ValueError("publish_len must be >= 0 or None")
@@ -408,6 +574,9 @@ class ServingEngine(object):
         h.finish_reason = reason
         self._slot_req[s] = None
         self._alive[s] = False
+        self._spec_ctx.pop(s, None)
+        self._free_slot_blocks(s)
+        self.metrics.kv_blocks_in_use = self._alloc.blocks_in_use
         self._mark_dirty("alive")
 
     def _emit(self, s: int, token: int) -> bool:
@@ -416,6 +585,13 @@ class ServingEngine(object):
         Returns True if the slot was retired."""
         h = self._slot_req[s]
         h.tokens.append(int(token))
+        st = self._spec_ctx.get(s)
+        if st is not None:  # keep the drafting index current in O(1)
+            ctx = st["ctx"]
+            ctx.append(int(token))
+            pair = (ctx[-2], ctx[-1])
+            st["from"] = st["map"].get(pair)
+            st["map"][pair] = len(ctx)
         self._counts[s] += 1
         self.metrics.tokens_out += 1
         if h.eos_id is not None and int(token) == int(h.eos_id):
@@ -426,44 +602,105 @@ class ServingEngine(object):
             return True
         return False
 
-    def _admit(self, h: ServingHandle, s: int):
-        """Assign a free slot: match the longest cached prefix,
-        device-copy it into the slot (zero recompute), and queue the
-        uncached suffix for chunked prefill. No model compute happens
-        here — chunks run in step()'s prefill phase."""
+    def _admit(self, h: ServingHandle, s: int) -> bool:
+        """Try to assign a free slot: match the longest cached prefix
+        chain, ALIAS its physical blocks into the slot's table
+        (ref-counted, zero-copy), copy-on-write any aliased block the
+        suffix must write into, and reserve the worst-case remainder
+        from the pool. Returns False — leaving the request QUEUED and
+        the engine state untouched — when the pool cannot cover the
+        reservation even after reclaiming idle trie blocks. No model
+        compute happens here — chunks run in step()'s prefill phase."""
+        T0 = h.prompt.shape[0]
+        Bt = self.kv_block_tokens
+        need_total = self._blocks_for(T0 + h.max_new_tokens)
+        pc = self.prefix_cache
+        # a pure PROBE: a block-starved request retries every step, and
+        # retries must not inflate hit/miss stats or restamp LRU order
+        # — record_hit/record_miss fire once the admission resolves
+        m = pc.match(h.prompt, record=False) if pc is not None else None
+        if m is not None and m.length == 0:
+            m.release()
+            m = None
+        cursor = n_alias = n_cow = 0
+        need_new = need_total
+        if m is not None:
+            matched = m.length
+            # the last prompt token must be COMPUTED — its logits seed
+            # the first generated token — so the suffix cursor stops at
+            # T0-1 even when the whole prompt is cached…
+            cursor = min(matched, T0 - 1)
+            n_alias = matched // Bt
+            # …and any aliased block overlapping [cursor, T0) (only the
+            # last one can: cursor >= (n_alias-1)*Bt) is copy-on-write
+            # privatised below, never written through
+            n_cow = n_alias - min(n_alias, cursor // Bt)
+            need_new = need_total - (n_alias - n_cow)
+            self._reclaim_for(need_new)
+            if self._alloc.available < need_new:
+                # the held match PINS the very chain reclaim would have
+                # to evict (a fully-cached prompt whose worst case
+                # fills the pool would deadlock here forever) — drop
+                # the alias plan and fall through to a cold-miss
+                # admission, where those blocks are reclaim's fair game
+                m.release()
+                m = None
+                cursor = n_alias = n_cow = 0
+                need_new = need_total
+        if m is None:
+            self._reclaim_for(need_new)
+            if not self._alloc.reserve(need_new):
+                return False  # saturated: stay queued (backpressure)
+            if pc is not None:
+                pc.record_miss()
+        else:
+            try:
+                # the match is ref-held until the aliases take their
+                # own pool refs: reclaim/eviction cannot free a block
+                # mid-alias
+                if not self._alloc.reserve(need_new):
+                    return False  # unreachable single-threaded; defensive
+                pc.record_hit(m)  # the probe resolves to a real use
+                keep = n_alias - n_cow
+                for d in range(keep):
+                    bid = int(m.payloads[d])
+                    self._alloc.incref(bid)
+                    self._tables[s, d] = bid
+                for d in range(keep, n_alias):
+                    nb = self._alloc.alloc_reserved()
+                    if self._cow_fn is None:
+                        self._cow_fn = self._make_cow()
+                    self._cache = self._cow_fn(
+                        self._cache, jnp.int32(nb),
+                        jnp.int32(int(m.payloads[d])))
+                    self._tables[s, d] = nb
+                    self.metrics.cow_blocks += 1
+            finally:
+                m.release()
+        self._n_alloc[s] = n_alias
+        self._reserved_tail[s] = need_new - n_cow
+        if pc is not None:
+            self.metrics.prefix_hit_tokens.append(cursor if n_alias else 0)
         h.queue_wait_s = time.monotonic() - h.submit_t
         self.metrics.queue_wait_s.append(h.queue_wait_s)
-        T0 = h.prompt.shape[0]
-        matched = 0
-        if self.prefix_cache is not None:
-            # cap at T0-1: the last prompt token must be COMPUTED — its
-            # logits seed the first generated token
-            with self.prefix_cache.match(h.prompt[:T0 - 1]) as m:
-                if m.length:
-                    if self._copy_fn is None:
-                        self._copy_fn = self._make_copy_fn()
-                    B = self.prefix_cache.block_tokens
-                    for d, (kk, vv) in enumerate(m.payloads):
-                        self._cache = self._copy_fn(
-                            self._cache, kk, vv, jnp.int32(s),
-                            jnp.int32(d * B))
-                matched = m.length
-            # the match is ref-held until here: eviction during a
-            # concurrent publish cannot free a block mid-copy
-            self.metrics.prefix_hit_tokens.append(matched)
+        self.metrics.kv_blocks_in_use = self._alloc.blocks_in_use
         self._slot_req[s] = h
+        self._limits[s] = T0 + h.max_new_tokens
+        self._mark_dirty("tables", "limits")
         # the first-token sampling key is per-request, not per-chunk:
         # computed once here, consumed on the prompt's final chunk
         self._prefill_state[s] = {
-            "handle": h, "cursor": matched,
+            "handle": h, "cursor": cursor,
             "key": jax.random.fold_in(jax.random.PRNGKey(h.seed), 0),
         }
         self._prefill_q.append(s)
+        return True
 
     def _publish(self, s: int, h: ServingHandle):
         """Publish the finished prompt's prefix blocks (up to the
-        request's publish boundary) back to the pool. Extraction runs
-        only for blocks the trie does not already hold."""
+        request's publish boundary) back to the pool — zero-copy: the
+        trie takes a ref on the slot's PHYSICAL block ids. Novel blocks
+        only; a chain the trie already holds gains nothing."""
         pc = self.prefix_cache
         if pc is None:
             return
@@ -472,14 +709,13 @@ class ServingEngine(object):
         n_blocks = bound // pc.block_tokens
         if n_blocks < 1:
             return
-        if self._extract_fn is None:
-            self._extract_fn = self._make_extract_fn()
-        pc.publish(
-            h.prompt, n_blocks,
-            lambda d: self._extract_fn(
-                self._cache, jnp.int32(s),
-                jnp.int32(d * pc.block_tokens)),
-        )
+
+        def _take(d):
+            bid = int(self._tables[s, d])
+            self._alloc.incref(bid)
+            return bid
+
+        pc.publish(h.prompt, n_blocks, _take)
 
     def _run_chunk(self, s: int) -> bool:
         """Advance slot s's prefill by one chunk; on the final chunk,
@@ -492,6 +728,7 @@ class ServingEngine(object):
         c = T0 - cursor
         if self.prefill_chunk_tokens is not None:
             c = min(c, self.prefill_chunk_tokens)
+        self._ensure_blocks(s, cursor, cursor + c)
         Cb = self._bucket(c)
         padded = np.zeros(Cb, np.int32)
         padded[:c] = h.prompt[cursor:cursor + c]
@@ -499,12 +736,13 @@ class ServingEngine(object):
         t0 = time.monotonic()
         self._cache, first = fn(
             self._params, self._cache, jnp.asarray(padded),
-            jnp.int32(cursor), jnp.int32(s), jnp.int32(c),
-            jnp.float32(h.temperature), st["key"],
+            jnp.int32(cursor), jnp.asarray(self._tables[s]),
+            jnp.int32(c), jnp.float32(h.temperature), st["key"],
         )
         st["cursor"] = cursor + c
         self.metrics.prefill_chunks += 1
         self.metrics.prefill_tokens_computed += c
+        self.metrics.kv_blocks_in_use = self._alloc.blocks_in_use
         if st["cursor"] < T0:
             # mid-prompt chunk: dispatch only, nothing to read back —
             # the batched decode below overlaps with it
@@ -525,6 +763,14 @@ class ServingEngine(object):
         self._temps[s] = h.temperature
         self._counts[s] = 0
         self._base_keys[s] = np.asarray(jax.random.PRNGKey(h.seed))
+        if self.spec_draft_len is not None:
+            # seed the drafting index from the prompt once (O(T0));
+            # _emit keeps it current per token from here on
+            ctx = [int(t) for t in h.prompt]
+            bmap = {}
+            for i in range(len(ctx) - 1):
+                bmap[(ctx[i], ctx[i + 1])] = i + 2
+            self._spec_ctx[s] = {"ctx": ctx, "map": bmap, "from": None}
         self._mark_dirty()  # all bands: slot s changed everywhere
         self._emit(s, first)  # may retire immediately (max_new==1 / eos)
         return True
@@ -553,12 +799,14 @@ class ServingEngine(object):
 
     def step(self) -> bool:
         """One scheduler iteration: admit queued requests into free
-        slots (prefix match + device copy), advance pending prefills by
-        up to `max_prefills_per_step` chunks (FCFS), then ONE batched
-        decode advancing every live slot; retirements free slots for
-        the next step's admissions. Returns False when there was
-        nothing to do (queue empty, no pending prefill, no live
-        slots).
+        slots (prefix aliasing + block reservation; a block-starved
+        pool leaves them queued), advance pending prefills by up to
+        `max_prefills_per_step` chunks (FCFS), then ONE batched decode
+        — or, with `spec_draft_len` set, ONE batched speculative
+        verify emitting up to K tokens per slot — advancing every live
+        slot; retirements free blocks and slots for the next step's
+        admissions. Returns False when there was nothing to do (queue
+        empty, no pending prefill, no live slots).
 
         Each call ticks the fault injector (PADDLE_FAULT, or the
         engine's own `fault_injector`) BEFORE doing work, so
@@ -589,7 +837,9 @@ class ServingEngine(object):
             s = self._free_slot()
             if s is None:
                 break
-            self._admit(self._queue.popleft(), s)
+            if not self._admit(self._queue[0], s):
+                break  # block-starved: FCFS head waits, so do followers
+            self._queue.popleft()
             progressed = True
 
         cap = self.max_prefills_per_step
@@ -605,9 +855,33 @@ class ServingEngine(object):
         if not self._alive.any():
             return progressed
 
+        if self.spec_draft_len is not None:
+            self._spec_step()
+        else:
+            self._decode_once()
+
+        frag = 0
+        for s in np.nonzero(self._alive)[0]:
+            frag += int(self._n_alloc[s]) * self.kv_block_tokens \
+                - int(self._pos[s])
+        for s in self._prefill_q:
+            frag += int(self._n_alloc[s]) * self.kv_block_tokens \
+                - int(self._prefill_state[s]["cursor"])
+        self.metrics.kv_frag_tokens = frag
+        self.metrics.kv_blocks_in_use = self._alloc.blocks_in_use
+        return True
+
+    def _decode_once(self):
+        """The plain (non-speculative) batched decode: one token per
+        live slot, bands advanced on device so a steady loop uploads
+        nothing (tables change only at a block-boundary append)."""
+        live = np.nonzero(self._alive)[0]
+        for s in live:
+            p = int(self._pos[s])
+            self._ensure_blocks(s, p, p + 1)
         t0 = time.monotonic()
         self._cache, nxt_d, pos_d, counts_d = self._decode_fn(
-            self._params, self._cache,
+            self._params, self._cache, self._band("tables"),
             self._band("tok"), self._band("pos"), self._band("alive"),
             self._band("temps"), self._band("counts"),
             self._band("base_keys"),
@@ -627,12 +901,82 @@ class ServingEngine(object):
             float(self._alive.sum()) / self.max_slots
         )
 
-        live = np.nonzero(self._alive)[0]
         self._pos[live] += 1  # the token just cached sat at pos
         for s in live:
             self._tok[s] = nxt[s]
             self._emit(s, nxt[s])
-        return True
+
+    def _draft_window(self, s: int) -> np.ndarray:
+        """Self-drafting by prompt lookup: continue the context's last
+        bigram from its most recent earlier occurrence (Leviathan et
+        al.'s speculative schedule with the request's own text as the
+        draft model — free drafts, no second network). Unfilled draft
+        rows are -1: never accepted (candidates are valid vocab ids),
+        so a draft-less window degrades to plain one-token decode."""
+        K = self.spec_draft_len
+        w = np.full(K, -1, np.int32)
+        w[0] = self._tok[s]  # the pending (unwritten) token leads
+        st = self._spec_ctx.get(s)
+        if st is not None and st["from"] is not None:
+            # tokens following the tail bigram's previous occurrence
+            cont = st["ctx"][st["from"]:st["from"] + K - 1]
+            w[1:1 + len(cont)] = cont
+        return w
+
+    def _spec_step(self):
+        """One speculative decode phase: build every live slot's
+        K-token window (pending token + K-1 drafts), verify in ONE
+        compiled batched step, then emit the model's own candidates up
+        to the first draft mismatch (plus the bonus token) — greedy
+        emission is exactly the plain path's, only batched in time.
+        Host-side acceptance re-uploads the tok/pos/counts bands next
+        step (the documented spec trade: ~3 small h2d per multi-token
+        step instead of zero per single-token step)."""
+        K = self.spec_draft_len
+        live = np.nonzero(self._alive)[0]
+        window = np.zeros((self.max_slots, K), np.int32)
+        for s in live:
+            lo = int(self._pos[s])
+            self._ensure_blocks(s, lo, min(lo + K, int(self._limits[s])))
+            window[s] = self._draft_window(s)
+        t0 = time.monotonic()
+        self._cache, cand_d = self._verify_fn(
+            self._params, self._cache, self._band("tables"),
+            jnp.asarray(window), self._band("pos"), self._band("alive"),
+            self._band("limits"), self._band("temps"),
+            self._band("counts"), self._band("base_keys"),
+        )
+        cand = np.asarray(cand_d)  # blocks; candidates are real
+        self.metrics.span("spec_verify", time.monotonic() - t0)
+        self.metrics.decode_steps += 1
+        self.metrics.occupancy.append(
+            float(self._alive.sum()) / self.max_slots
+        )
+        for s in live:
+            h = self._slot_req[s]
+            m = 0  # accepted drafts: longest window prefix the model agrees with
+            while m < K - 1 and window[s, m + 1] == cand[s, m]:
+                m += 1
+            budget_left = h.max_new_tokens - len(h.tokens)
+            n = min(m + 1, budget_left)
+            self.metrics.spec_windows += 1
+            # count only drafts actually PROPOSED (-1 rows are empty
+            # lanes, not rejections) AND within the request's remaining
+            # budget (a final window's over-budget lanes can never be
+            # accepted): accept_rate stays an honest measure of draft
+            # quality
+            lanes = window[s, 1:max(1, budget_left)]
+            self.metrics.spec_drafted += int((lanes >= 0).sum())
+            adv = 0
+            for j in range(n):
+                adv += 1
+                self._tok[s] = cand[s, j]
+                if self._emit(s, cand[s, j]):
+                    break  # EOS/budget: later accepted drafts discarded
+            self._pos[s] += adv  # one cache write per emitted token
+            self.metrics.spec_accepted += max(0, adv - 1)
+        # acceptance is a host decision: these bands re-upload next step
+        self._mark_dirty("tok", "pos", "counts")
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drive the engine until the queue drains and every slot
@@ -665,3 +1009,11 @@ class ServingEngine(object):
     @property
     def prefilling_slots(self) -> int:
         return len(self._prefill_q)
+
+    @property
+    def kv_blocks_in_use(self) -> int:
+        return self._alloc.blocks_in_use
+
+    @property
+    def kv_blocks_free(self) -> int:
+        return self._alloc.free_blocks

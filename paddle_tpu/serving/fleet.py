@@ -450,6 +450,16 @@ class _Replica(object):
             "decode_steps": m.decode_steps,
             "prefills": m.prefills,
             "prefill_tokens_computed": m.prefill_tokens_computed,
+            # ISSUE 7 block-pool / spec counters: the cumulative ones
+            # fold into the fleet's _stats_base on replica death like
+            # every other int here; kv_blocks_in_use is a GAUGE (a dead
+            # replica's pool is gone), summed over LIVE snapshots only
+            "kv_blocks_in_use": m.kv_blocks_in_use,
+            "kv_blocks_freed_at_retire": m.kv_blocks_freed_at_retire,
+            "kv_tail_blocks_freed": m.kv_tail_blocks_freed,
+            "cow_blocks": m.cow_blocks,
+            "spec_drafted": m.spec_drafted,
+            "spec_accepted": m.spec_accepted,
         }
         if e.prefix_cache is not None:
             out["prefix_hits"] = e.prefix_cache.hits
@@ -524,8 +534,30 @@ class ServingFleet(object):
                 raise ValueError("unknown SLO class %r" % c)
         self._engine_kw = dict(engine_kw or {})
         self._engine_kw_for = engine_kw_for
-        self.block_tokens = int(self._engine_kw.get(
-            "prefix_block_tokens", 16))
+        # ONE block granularity: the engine's paged KV pool and the
+        # prefix trie share it (kv_block_tokens is the ISSUE 7 name,
+        # prefix_block_tokens the pre-paging alias the engine accepts).
+        # `is None` defaulting, like the engine: an explicit invalid 0
+        # must raise HERE, not as a replica-thread crash loop later
+        _bt = self._engine_kw.get("kv_block_tokens")
+        if _bt is None:
+            _bt = self._engine_kw.get("prefix_block_tokens")
+        self.block_tokens = 16 if _bt is None else int(_bt)
+        if self.block_tokens < 1:
+            raise ValueError("kv_block_tokens must be >= 1")
+        # per-replica pool capacity for the submit() precheck: a
+        # request whose worst case exceeds a WHOLE replica pool can
+        # never be admitted anywhere — fail in the caller (the engine's
+        # own rule; a merely saturated pool queues instead)
+        _L = min(int(self._engine_kw.get("max_len") or cfg.max_len),
+                 int(params["pos"].shape[0]))
+        _pb = self._engine_kw.get("kv_pool_blocks")
+        self._pool_blocks = (
+            int(self._engine_kw.get("max_slots", 8))
+            * (-(-_L // self.block_tokens))
+            if _pb is None else int(_pb))
+        if self._pool_blocks < 1:
+            raise ValueError("kv_pool_blocks must be >= 1")
         # chain keys only pay off when there is a pool to match: with
         # no base prefix_cache_tokens every summary stays empty, so
         # skip the per-submit O(T0) crc work entirely
@@ -607,16 +639,19 @@ class ServingFleet(object):
             kw.update(self.slo_classes[slo])
         if self._engine_kw_for is not None:
             kw.update(self._engine_kw_for(index) or {})
-        if self.affinity \
-                and int(kw.get("prefix_block_tokens", 16)) != self.block_tokens:
+        rep_bt = kw.get("kv_block_tokens")
+        if rep_bt is None:
+            rep_bt = kw.get("prefix_block_tokens")
+        rep_bt = self.block_tokens if rep_bt is None else int(rep_bt)
+        if self.affinity and rep_bt != self.block_tokens:
             # chain keys are computed at the FLEET's block size; a
             # replica caching at a different granularity would never
             # match them and affinity would silently degrade to
             # least-loaded — refuse loudly instead
             raise ValueError(
-                "affinity routing requires a uniform prefix_block_tokens "
+                "affinity routing requires a uniform block granularity "
                 "across replicas (fleet %d, replica %d override %r)"
-                % (self.block_tokens, index, kw.get("prefix_block_tokens")))
+                % (self.block_tokens, index, rep_bt))
         return _Replica(self, index, incarnation, slo, kw)
 
     # -- admission -------------------------------------------------------
@@ -642,6 +677,13 @@ class ServingFleet(object):
             raise ValueError(
                 "request needs T0+max_new <= max_len (%d + %d > %d)"
                 % (prompt.shape[0], int(max_new_tokens), L))
+        need = -(-(prompt.shape[0] + int(max_new_tokens))
+                 // self.block_tokens)
+        if need > self._pool_blocks:
+            raise ValueError(
+                "request worst case (%d blocks) exceeds a whole replica "
+                "KV pool (%d blocks of %d tokens)"
+                % (need, self._pool_blocks, self.block_tokens))
         if publish_len is not None and publish_len < 0:
             raise ValueError("publish_len must be >= 0 or None")
         if slo is not None and slo not in self.slo_classes:
@@ -888,6 +930,8 @@ class ServingFleet(object):
         st = self._rep_stats[i]
         if st:
             for k, v in st.items():
+                if k == "kv_blocks_in_use":
+                    continue  # gauge: a dead replica's pool is gone
                 self._stats_base[k] = self._stats_base.get(k, 0) + v
         self._rep_stats[i] = None
         # rapid-death accounting gates auto_refill (exponential
@@ -1029,6 +1073,10 @@ class ServingFleet(object):
             saved = base.get("prefix_tokens_saved", 0)
             tokens_out = base.get("tokens_out", 0)
             prefill_tok = base.get("prefill_tokens_computed", 0)
+            blocks_in_use = 0  # gauge: live replicas only
+            cow = base.get("cow_blocks", 0)
+            spec_drafted = base.get("spec_drafted", 0)
+            spec_accepted = base.get("spec_accepted", 0)
             reps = []
             for i, rep in enumerate(self._replicas):
                 st = self._rep_stats[i] or {}
@@ -1037,6 +1085,11 @@ class ServingFleet(object):
                 saved += st.get("prefix_tokens_saved", 0)
                 tokens_out += st.get("tokens_out", 0)
                 prefill_tok += st.get("prefill_tokens_computed", 0)
+                if self._state[i] == _LIVE:
+                    blocks_in_use += st.get("kv_blocks_in_use", 0)
+                cow += st.get("cow_blocks", 0)
+                spec_drafted += st.get("spec_drafted", 0)
+                spec_accepted += st.get("spec_accepted", 0)
                 reps.append({
                     "name": rep.name, "slo": rep.slo,
                     "state": self._state[i],
@@ -1061,6 +1114,12 @@ class ServingFleet(object):
                 "prefill_tokens_computed": prefill_tok,
                 "prefix_hit_rate": round(hits / total, 4) if total else None,
                 "prefix_tokens_saved": saved,
+                "kv_blocks_in_use": blocks_in_use,
+                "cow_blocks": cow,
+                "spec_drafted": spec_drafted,
+                "spec_accepted": spec_accepted,
+                "spec_accept_rate": round(spec_accepted / spec_drafted, 4)
+                if spec_drafted else None,
                 "replicas": reps,
             }
 

@@ -52,6 +52,19 @@ class ServingMetrics(object):
         self.band_uploads = 0
         self.prefix_hit_tokens = _RunningStat()  # cached tokens/admission
         self.prefix_cache = None  # set by the engine when reuse is on
+        # PR 7 counters — paged KV block pool + speculative decoding,
+        # same O(1) discipline. Gauges (set by the engine each step or
+        # scheduler event) vs cumulative ints are marked below.
+        self.kv_blocks_total = 0          # gauge: pool size in blocks
+        self.kv_blocks_in_use = 0         # gauge: physical blocks live
+        self.kv_frag_tokens = 0           # gauge: allocated - resident
+        self.kv_blocks_freed_at_retire = 0  # cumulative physical frees
+        self.kv_tail_blocks_freed = 0     # cumulative: reserved, never
+        #                                   reached (early EOS tails)
+        self.cow_blocks = 0               # cumulative copy-on-writes
+        self.spec_windows = 0             # cumulative verify rows run
+        self.spec_drafted = 0             # cumulative drafted tokens
+        self.spec_accepted = 0            # cumulative drafts emitted
         self._t0 = None
         self._t1 = None
 
@@ -106,6 +119,18 @@ class ServingMetrics(object):
             "prefill_tokens_computed": self.prefill_tokens_computed,
             "band_uploads": self.band_uploads,
             "mean_prefix_hit_tokens": _mean(self.prefix_hit_tokens),
+            "kv_blocks_total": self.kv_blocks_total,
+            "kv_blocks_in_use": self.kv_blocks_in_use,
+            "kv_frag_tokens": self.kv_frag_tokens,
+            "kv_blocks_freed_at_retire": self.kv_blocks_freed_at_retire,
+            "kv_tail_blocks_freed": self.kv_tail_blocks_freed,
+            "cow_blocks": self.cow_blocks,
+            "spec_windows": self.spec_windows,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_accept_rate": round(
+                self.spec_accepted / self.spec_drafted, 4)
+            if self.spec_drafted else None,
         }
         if self.prefix_cache is not None:
             rep["prefix_cache"] = self.prefix_cache.stats()

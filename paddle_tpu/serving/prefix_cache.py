@@ -119,13 +119,19 @@ class PrefixCache(object):
     under a token budget. Single-threaded (the serving engine's
     scheduler loop); all bookkeeping is O(blocks)."""
 
-    def __init__(self, token_budget: int, block_tokens: int = 16):
+    def __init__(self, token_budget: int, block_tokens: int = 16,
+                 on_evict: Optional[Callable[[Any], None]] = None):
         if int(block_tokens) < 1:
             raise ValueError("block_tokens must be >= 1")
         if int(token_budget) < 1:
             raise ValueError("token_budget must be >= 1")
         self.token_budget = int(token_budget)
         self.block_tokens = int(block_tokens)
+        # called with each evicted node's payload BEFORE it is dropped.
+        # The paged engine publishes physical block IDS as payloads and
+        # uses this hook to decref them in the KV pool — eviction is how
+        # a trie-held block's HBM returns to the allocator (ISSUE 7).
+        self._on_evict = on_evict
         self._root = _Node((), None, None)
         self._nodes: Dict[_Node, None] = {}  # every non-root node
         self._clock = 0
@@ -147,14 +153,18 @@ class PrefixCache(object):
         return tuple(int(t) for t in tokens[d * B:(d + 1) * B])
 
     # -- lookup ---------------------------------------------------------
-    def match(self, tokens) -> PrefixMatch:
+    def match(self, tokens, record=True) -> PrefixMatch:
         """Longest cached block-chain prefix of `tokens` (block
         granularity: a partial trailing block never matches). Acquires
         every matched node — call `release()` (or use as a context
-        manager) once the copies are dispatched. Counts one hit
-        (length > 0) or miss per call."""
+        manager) once the copies are dispatched. With `record` (the
+        default) counts one hit (length > 0) or miss per call and
+        LRU-stamps the chain; `record=False` is a pure PROBE — the
+        engine's admission may retry a block-starved request every
+        scheduler step, and retries must not inflate hit/miss stats or
+        perturb eviction order (call `record_hit`/`record_miss` once
+        the admission actually resolves)."""
         tokens = np.asarray(tokens).reshape(-1)
-        stamp = self._tick()
         node, nodes = self._root, []
         for d in range(len(tokens) // self.block_tokens):
             child = node.children.get(self._block_of(tokens, d))
@@ -164,13 +174,33 @@ class PrefixCache(object):
             node = child
         for n in nodes:
             n.refs += 1
+        m = PrefixMatch(self, nodes)
+        if record:
+            if nodes:
+                self.record_hit(m)
+            else:
+                self.record_miss()
+        return m
+
+    def record_hit(self, m: PrefixMatch):
+        """Commit a probed match as an actual use: LRU-stamp the chain
+        and count the hit + saved tokens ONCE (per admission, not per
+        retry)."""
+        stamp = self._tick()
+        for n in m._nodes:
             n.stamp = stamp
-        if nodes:
-            self.hits += 1
-            self.tokens_saved += len(nodes) * self.block_tokens
-        else:
-            self.misses += 1
-        return PrefixMatch(self, nodes)
+        self.hits += 1
+        self.tokens_saved += m.length
+
+    def record_miss(self):
+        self.misses += 1
+
+    def idle_payloads(self) -> List[Any]:
+        """Payloads of every node no in-flight match holds — the
+        engine's reclaim-gain probe: before evicting shareable chains
+        toward an admission, it checks these payloads' pool refcounts
+        to see whether eviction can free enough blocks AT ALL."""
+        return [n.payload for n in self._nodes if n.refs == 0]
 
     # -- publication ----------------------------------------------------
     def publish(self, tokens, n_blocks: int,
@@ -203,17 +233,34 @@ class PrefixCache(object):
     def _evict_to_budget(self):
         if self.size_tokens <= self.token_budget:
             return
+        self._evict_lru(lambda n: self.size_tokens > self.token_budget)
+
+    def reclaim(self, n_blocks: int) -> int:
+        """Evict up to `n_blocks` LRU unreferenced leaf blocks
+        REGARDLESS of the token budget, returning the count actually
+        evicted. The paged engine calls this when an admission needs
+        pool blocks the trie is idly holding: shareability is worth
+        less than admitting the next request (vLLM's cached-block
+        reclaim policy). Acquired chains stay pinned as ever."""
+        if n_blocks <= 0:
+            return 0
+        return self._evict_lru(lambda n: n < n_blocks)
+
+    def _evict_lru(self, more) -> int:
         # one pass builds the LRU heap of currently-evictable leaves;
         # the cascade then costs O(log n) per eviction (evicting a leaf
         # may expose its parent as the next candidate) — admissions
-        # wait on this loop, so no full rescan per victim
+        # wait on this loop, so no full rescan per victim. `more`
+        # receives the running eviction count and says whether to keep
+        # going (budget pressure or an explicit reclaim quota).
         heap = [
             (n.stamp, i, n) for i, n in enumerate(self._nodes)
             if not n.children and n.refs == 0
         ]
         heapq.heapify(heap)
         tick = len(heap)
-        while self.size_tokens > self.token_budget and heap:
+        evicted = 0
+        while more(evicted) and heap:
             stamp, _, victim = heapq.heappop(heap)
             if victim not in self._nodes or victim.children \
                     or victim.refs > 0 or victim.stamp != stamp:
@@ -221,14 +268,18 @@ class PrefixCache(object):
             parent = victim.parent
             del parent.children[victim.block]
             del self._nodes[victim]
+            if self._on_evict is not None:
+                self._on_evict(victim.payload)
             victim.payload = None
             self.size_tokens -= self.block_tokens
             self.evictions += 1
+            evicted += 1
             if parent is not self._root and not parent.children \
                     and parent.refs == 0:
                 tick += 1
                 heapq.heappush(heap, (parent.stamp, tick, parent))
         # heap drained with pinned entries left: honestly over budget
+        return evicted
 
     # -- reporting ------------------------------------------------------
     def summary(self) -> Set[int]:

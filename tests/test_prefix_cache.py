@@ -140,3 +140,39 @@ def test_validation():
         PrefixCache(16, block_tokens=0)
     with pytest.raises(ValueError, match="token_budget"):
         PrefixCache(0, block_tokens=4)
+
+
+def test_on_evict_callback_sees_every_evicted_payload():
+    """ISSUE 7: the paged engine publishes physical block IDS as
+    payloads and relies on the eviction hook to decref them — every
+    eviction path (budget pressure and explicit reclaim) must hand the
+    payload over exactly once, before it is dropped."""
+    freed = []
+    pc = PrefixCache(token_budget=8, block_tokens=4,
+                     on_evict=freed.append)
+    pc.publish(_toks(*range(8)), 2, lambda d: 100 + d)
+    pc.publish(_toks(50, 51, 52, 53), 1, lambda d: 200)  # over budget
+    assert pc.stats()["evictions"] == 1 and len(freed) == 1
+    assert freed[0] in (101, 200)  # an LRU leaf's payload, intact
+    n = pc.reclaim(2)
+    assert n == 2 and len(freed) == 3
+    assert sorted(freed) == [100, 101, 200]
+    assert pc.stats()["size_tokens"] == 0
+
+
+def test_reclaim_frees_lru_leaves_but_never_held_chains():
+    """reclaim() serves a block-starved admission: it may dip BELOW the
+    token budget, takes LRU leaves first, and still refuses to touch an
+    acquired (in-flight) chain."""
+    pc = PrefixCache(token_budget=64, block_tokens=4)
+    pc.publish(_toks(*range(8)), 2, lambda d: "a%d" % d)
+    pc.publish(_toks(50, 51, 52, 53), 1, lambda d: "b")
+    held = pc.match(_toks(*range(8)))  # pin chain A mid-admission
+    assert pc.reclaim(0) == 0
+    assert pc.reclaim(10) == 1  # only the unheld leaf b is evictable
+    assert pc.match(_toks(50, 51, 52, 53)).length == 0
+    with pc.match(_toks(*range(8))) as m:
+        assert m.length == 8  # the held chain survived in full
+    held.release()
+    assert pc.reclaim(10) == 2  # released: the chain is prey again
+    assert len(pc) == 0
