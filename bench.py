@@ -55,6 +55,19 @@ definitions):
               duplicate completions (must be 0), failovers, the
               fleet-wide prefix reuse contrast, and tok/s vs the N×1
               ideal; outputs must be token-identical across all runs
+  serving_slo — gray-failure / request-SLO acceptance (ISSUE 8): the
+              same fixed-seed Poisson trace of deadline-carrying
+              interactive requests through a healthy N-replica fleet
+              and through the same fleet with one replica gray-slowed
+              (slow@ fault: heartbeating, but every step stalls)
+              mid-trace; reports expired requests (must be 0 — the
+              gray replica is demoted and its work hedged to survivors
+              with token-level resume), resumed requests and tokens
+              reused (journal-verified: no emitted token is ever
+              re-decoded), demote/probe/restore counts, and p99 TTFT
+              healthy vs gray (gray must stay under the slow window —
+              the demotion bounded the tail); outputs must be
+              token-identical across both runs
   input_pipeline — host-side loader overlap (paddle_tpu/data):
               RecordShard shards -> ShardedDataset -> DataLoader on a
               fixed-seed synthetic trace, prefetch OFF (synchronous
@@ -1451,6 +1464,270 @@ def bench_serving_fleet(n_replicas=None, n_requests=None, families=None,
     }
 
 
+def bench_serving_slo(n_replicas=None, n_requests=None, max_slots=None,
+                      dim=None, heads=None, layers_n=None, vocab=None,
+                      max_len=None, deadline_s=None, slow_window_s=None,
+                      slow_step_s=None, slow_factor=None,
+                      slow_min_duration_s=None):
+    """Request-SLO / gray-failure acceptance trace (ISSUE 8): the SAME
+    fixed-seed Poisson trace of INTERACTIVE requests — every one
+    carrying a `deadline_s` budget — runs twice through an N-replica
+    fleet with gray-failure detection on: (a) healthy, and (b) with
+    replica 0 gray-slowed mid-trace (`slow@` fault: it heartbeats on
+    every step, each step just stalls `slow_step_s` for
+    `slow_window_s` of wall time — invisible to fail-stop detection).
+    The deterministic offline columns, hard-raised in-bench:
+
+      * expired requests MUST be 0 in both runs — the gray replica is
+        demoted (step-latency EWMA past `slow_factor` x the live
+        median, sustained) and its open requests hedged to survivors
+        with token-level resume, so no deadline dies on a wedged
+        replica;
+      * no false demotion in the healthy run (demotions == 0 there;
+        the drill run must demote >= 1 and, after the window, PROBE
+        and RESTORE the replica under the SAME incarnation — warm
+        pool, no fresh spawn);
+      * resumed requests re-decode ZERO already-emitted tokens,
+        verified from the journal itself: per rid, the concatenation
+        of accepted progress deltas equals the done record's tokens —
+        a re-decoded token would appear twice;
+      * outputs token-identical between the healthy and gray runs
+        (neither demotion, hedging, nor resume may change what a
+        request decodes to).
+
+    p99 TTFT under the gray replica is pinned within a bounded excess
+    of the healthy run's: gray p99 must beat healthy p99 + the slow
+    WINDOW — without demotion, work pinned on the gray replica stalls
+    the whole window and then restarts from token zero, so its tail
+    exceeds healthy by at least the window; with demotion + resume the
+    excess is the demotion response time. tokens/s is on-chip-pending
+    like every serving row (CPU replicas share one chip + the GIL);
+    the drill columns above are deterministic offline."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fault_injection import FaultInjector
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import RequestJournal, ServingFleet
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape: 2 fleets' worth of tiny engines
+        dim, heads, layers_n = dim or 32, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 64, max_len or 64
+        n_replicas = n_replicas or 2
+        n_requests = n_requests or 10
+        # slots sized so healthy TTFT is admission-bound, not
+        # queue-bound: the p99 tail must measure the GRAY response,
+        # not a deliberately undersized batch
+        max_slots = max_slots or 6
+        t_lo, t_hi, n_lo, n_hi, rate = 4, 10, 12, 20, 0.5
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_replicas = n_replicas or 3
+        n_requests = n_requests or 32
+        max_slots = max_slots or 8
+        t_lo, t_hi, n_lo, n_hi, rate = 16, 64, 32, 96, 0.5
+        dtype = jnp.bfloat16
+    deadline_s = deadline_s or 60.0
+    slow_window_s = slow_window_s or 2.5
+    slow_step_s = slow_step_s or 0.25
+    slow_factor = slow_factor or 4.0
+    slow_min_duration_s = slow_min_duration_s or 0.3
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    reqs = []
+    for _ in range(n_requests):
+        t = int(rng.randint(t_lo, t_hi + 1))
+        reqs.append((rng.randint(0, vocab, t).astype(np.int32),
+                     int(rng.randint(n_lo, n_hi + 1))))
+    # warm waves: EVERY compiled shape the trace can hit, on EVERY
+    # replica, before any health judgement (the README sizing rule:
+    # never judge a replica mid-first-compile — a compile is one long
+    # silent step, indistinguishable from gray slowness from outside).
+    # One wave per pow-2 prefill bucket; each wave is n_replicas
+    # concurrent requests, which least-loaded routing spreads one per
+    # replica, so after the waves the paced trace compiles NOTHING.
+    from paddle_tpu.fluid.core.kernels_sequence import bucket_pow2
+    warm_buckets = sorted({max(8, bucket_pow2(t))
+                           for t in range(t_lo, t_hi + 1)})
+    warm_waves = []
+    for L in warm_buckets:
+        warm_waves.append([
+            (rng.randint(0, vocab, L).astype(np.int32), 4)
+            for _ in range(n_replicas)])
+
+    def run_once(gray: bool):
+        inj = FaultInjector("")  # inert until armed post-warm
+        jpath = tempfile.mktemp(suffix=".jsonl", prefix="slo_journal_")
+        fleet = ServingFleet(
+            params, cfg, n_replicas=n_replicas, journal_path=jpath,
+            heartbeat_timeout_s=120.0, monitor_interval_s=0.05,
+            max_pending=2 * (n_requests
+                             + sum(len(w) for w in warm_waves)),
+            slow_replica_factor=slow_factor,
+            slow_min_duration_s=slow_min_duration_s,
+            probe_interval_s=0.15,
+            engine_kw={"max_slots": max_slots},
+            engine_kw_for=lambda i: (
+                {"fault_injector": inj} if i == 0 else {}))
+        try:
+            for wave in warm_waves:
+                ws = [fleet.submit(p, n) for p, n in wave]
+                for h in ws:
+                    h.result(timeout=600)
+            time.sleep(0.3)  # EWMAs settle post-compile
+            if gray:
+                # the gray window opens 2 engine steps into the paced
+                # trace: replica 0 keeps heartbeating but every step
+                # stalls — the failure heartbeat monitors cannot see
+                inj.arm("slow@2:%g/%g" % (slow_window_s, slow_step_s))
+            t0 = time.time()
+            hs, i, step = [], 0, 0
+            while True:
+                while i < n_requests and arrive_at[i] <= step:
+                    p, n = reqs[i]
+                    hs.append(fleet.submit(
+                        p, n, slo="interactive", deadline_s=deadline_s))
+                    i += 1
+                if i >= n_requests and all(h.done for h in hs):
+                    break
+                time.sleep(0.004)
+                step += 1
+            for h in hs:
+                h.result(timeout=600)  # raises on lost/expired
+            wall = time.time() - t0
+            restored = True
+            if gray:  # after the window: probe -> restore, same incarnation
+                deadline = time.monotonic() + slow_window_s + 30.0
+                while fleet.stats()["replicas"][0]["state"] != "live":
+                    if time.monotonic() >= deadline:
+                        restored = False
+                        break
+                    time.sleep(0.05)
+            st = fleet.stats()
+            incarnation0 = st["replicas"][0]["incarnation"]
+            toks = sum(len(h.tokens) for h in hs)
+            ttfts = sorted(h.ttft_s for h in hs if h.ttft_s is not None)
+            p99 = (float(np.percentile(ttfts, 99)) if ttfts else None)
+        finally:
+            fleet.close()
+        # journal audit: every progress token appears EXACTLY once in
+        # its rid's done record — a resumed request that re-decoded an
+        # already-emitted token would journal it twice and fail here
+        done_toks, prog_toks, sources = {}, {}, {}
+        for rec in RequestJournal._read(jpath):
+            if rec["kind"] == "done":
+                done_toks[rec["rid"]] = rec["tokens"]
+            elif rec["kind"] == "progress":
+                prog_toks.setdefault(rec["rid"], []).extend(rec["tokens"])
+                sources.setdefault(rec["rid"], set()).add(
+                    (rec["replica"], rec["incarnation"], rec["gen"]))
+        os.unlink(jpath)
+        for rid, toks_done in done_toks.items():
+            if prog_toks.get(rid, []) != toks_done:
+                raise RuntimeError(
+                    "rid %d: journaled progress %r != done tokens %r "
+                    "(a resumed request re-decoded emitted tokens?)"
+                    % (rid, prog_toks.get(rid), toks_done))
+        resumed_rids = sum(1 for s in sources.values() if len(s) > 1)
+        return {
+            "stats": st, "outputs": [list(h.tokens) for h in hs],
+            "p99_ttft_s": p99, "tokens_per_sec": toks / wall,
+            "restored": restored, "incarnation0": incarnation0,
+            "resumed_rids_journal": resumed_rids,
+        }
+
+    healthy = run_once(gray=False)
+    gray = run_once(gray=True)
+    if healthy["outputs"] != gray["outputs"]:
+        raise RuntimeError(
+            "outputs diverge between healthy and gray-slow runs: "
+            "demotion/hedging/resume changed what a request decodes to")
+    hs_st, gr_st = healthy["stats"], gray["stats"]
+    for name, st in (("healthy", hs_st), ("gray", gr_st)):
+        if st["expired"] or st["expired_on_arrival"]:
+            raise RuntimeError(
+                "%s run expired %d request(s): the SLO layer failed "
+                "its zero-expired bar" % (name, st["expired"]))
+        if st["lost"]:
+            raise RuntimeError("%s run lost requests: %r" % (name, st))
+    if hs_st["demotions"]:
+        raise RuntimeError(
+            "healthy run demoted a replica (false positive): %r"
+            % hs_st["demotions"])
+    if not gr_st["demotions"]:
+        raise RuntimeError(
+            "gray run never demoted the slowed replica: detection "
+            "missed a %gs window of %gs steps"
+            % (slow_window_s, slow_step_s))
+    if not gray["restored"] or gray["incarnation0"] != 1:
+        raise RuntimeError(
+            "gray replica not restored warm (restored=%r, "
+            "incarnation=%r): the demote-probe-restore cycle broke"
+            % (gray["restored"], gray["incarnation0"]))
+    if not gr_st["resumed_requests"]:
+        raise RuntimeError(
+            "gray run hedged nothing with token-level resume — the "
+            "drill did not exercise the resume path")
+    if gray["p99_ttft_s"] is not None and healthy["p99_ttft_s"] is not None \
+            and gray["p99_ttft_s"] >= healthy["p99_ttft_s"] + slow_window_s:
+        # without demotion, work pinned on the gray replica stalls for
+        # the WHOLE window and then re-decodes from scratch — the gray
+        # tail would exceed healthy by at least the window. Demotion
+        # must keep the excess under it (the demotion response time)
+        raise RuntimeError(
+            "gray p99 TTFT %.3fs exceeds healthy %.3fs by more than "
+            "the %.1fs slow window: demotion failed to bound the tail"
+            % (gray["p99_ttft_s"], healthy["p99_ttft_s"], slow_window_s))
+    return {
+        # the SLO columns (deterministic offline)
+        "expired_healthy": hs_st["expired"],
+        "expired_gray": gr_st["expired"],
+        "requests_lost": gr_st["lost"],
+        "demotions_gray": gr_st["demotions"],
+        "restores_gray": gr_st["restores"],
+        "probes_sent_gray": gr_st["probes_sent"],
+        "restored_same_incarnation": gray["incarnation0"] == 1,
+        "resumed_requests": gr_st["resumed_requests"],
+        "resumed_tokens_reused": gr_st["resumed_tokens"],
+        "resumed_rids_journal": gray["resumed_rids_journal"],
+        "redecoded_tokens": 0,  # journal-audited above (hard raise)
+        # latency columns (wall-clock; tail bounded by demotion)
+        "p99_ttft_healthy_s": round(healthy["p99_ttft_s"], 4)
+        if healthy["p99_ttft_s"] is not None else None,
+        "p99_ttft_gray_s": round(gray["p99_ttft_s"], 4)
+        if gray["p99_ttft_s"] is not None else None,
+        "p99_ttft_ratio": round(
+            gray["p99_ttft_s"] / healthy["p99_ttft_s"], 2)
+        if healthy["p99_ttft_s"] and gray["p99_ttft_s"] else None,
+        "p99_ttft_excess_bound_s": slow_window_s,
+        "tokens_per_sec_healthy": round(healthy["tokens_per_sec"], 1),
+        "tokens_per_sec_gray": round(gray["tokens_per_sec"], 1),
+        "n_replicas": n_replicas,
+        "n_requests": n_requests,
+        "arrival": "poisson(rate=%g/step, seed=0)" % rate,
+        "drill": {"fault": "slow@2:%g/%g" % (slow_window_s, slow_step_s),
+                  "replica": 0, "deadline_s": deadline_s},
+        "knobs": {"max_slots": max_slots,
+                  "slow_replica_factor": slow_factor,
+                  "slow_min_duration_s": slow_min_duration_s,
+                  "probe_interval_s": 0.15},
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
 def bench_input_pipeline(n_shards=4, chunks_per_shard=8,
                          records_per_chunk=64, batch=64, step_s=0.004,
                          decode_sleep_s=0.0001, num_workers=2,
@@ -1929,6 +2206,11 @@ def main():
         # failovers and the affinity-routing reuse contrast are
         # deterministic offline; tokens/s and speedup-vs-N×1 on-chip
         run("serving_fleet", bench_serving_fleet)
+        # request-SLO / gray-failure drill (ISSUE 8): deadlines + one
+        # replica gray-slowed mid-trace — expired (must be 0), demote/
+        # probe/restore counts, journal-verified re-decode-zero resume,
+        # and the p99 TTFT tail bound are deterministic offline
+        run("serving_slo", bench_serving_slo)
         run("transformer_lm", bench_transformer_lm)
         # larger-matmul flagship: dim=1024 keeps every matmul MXU-shaped
         # (the dim=512 row leaves lane headroom), so this is the MFU

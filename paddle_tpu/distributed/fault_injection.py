@@ -16,6 +16,19 @@ A fault spec is a comma-separated string, e.g.::
     PADDLE_FAULT="netsplit@3:2.0"   drop coordinator connections for 2 s
                                     starting at step 3 (partition: RPCs
                                     fail and must ride it out on backoff)
+    PADDLE_FAULT="slow@3:2.0/0.1"   GRAY failure (ISSUE 8): starting at
+                                    step 3, every tick sleeps 0.1 s until
+                                    2.0 s of wall time have passed — the
+                                    process keeps heartbeating (each step
+                                    completes!) but is too slow to meet
+                                    latency targets. Unlike delay@ (one
+                                    pause) or hang@ (no progress at all),
+                                    slow@ is invisible to liveness checks
+                                    and only detectable by step-latency /
+                                    progress-watermark health scoring
+                                    (the fleet's slow_replica_factor
+                                    demotion). Arg is dur[/per]; per
+                                    defaults to 0.05 s.
 
 The trainer CLI ticks its injector once per batch when PADDLE_FAULT is
 set; worker scripts call `default_injector().tick()` wherever their
@@ -112,7 +125,20 @@ class _Fault(object):
             raise ValueError("unknown fault kind %r" % self.kind)
 
 
-_KINDS = ("kill", "exc", "delay", "corrupt", "hang", "netsplit")
+_KINDS = ("kill", "exc", "delay", "corrupt", "hang", "netsplit", "slow")
+
+
+def _parse_slow_arg(arg: str):
+    """slow@N:dur[/per] -> (window_s, per_tick_sleep_s), validated —
+    a bad window or a negative stall must fail at PARSE time, not as
+    a time.sleep(-x) crash loop N serving steps later."""
+    dur_s, _, per_s = (arg or "1.0").partition("/")
+    dur, per = float(dur_s), float(per_s or "0.05")
+    if dur <= 0.0:
+        raise ValueError("slow@N:dur needs a positive window, got %r" % dur)
+    if per < 0.0:
+        raise ValueError("slow@N:dur/per needs per >= 0, got %r" % per)
+    return dur, per
 
 
 def _parse(spec: str) -> List[_Fault]:
@@ -133,6 +159,8 @@ def _parse(spec: str) -> List[_Fault]:
             raise ValueError("corrupt@N:<path> needs the file path")
         if kind in ("delay", "netsplit"):
             arg = str(float(arg or "1.0"))  # fail fast on a bad duration
+        if kind == "slow":
+            _parse_slow_arg(arg)  # fail fast on a bad dur[/per]
         faults.append(_Fault(kind, int(step_s), arg or None))
     return faults
 
@@ -145,10 +173,19 @@ class FaultInjector(object):
             spec if spec is not None else os.environ.get(ENV_VAR, "")
         )
         self.step = 0
+        # open slow@ window: (wall end, per-tick sleep). Injector state,
+        # not _Fault state: the window outlives the step that opened it
+        self._slow_until = 0.0
+        self._slow_per = 0.0
 
     @property
     def active(self) -> bool:
         return bool(self.faults)
+
+    @property
+    def slowed(self) -> bool:
+        """True while an injected slow@ (gray) window is open."""
+        return time.monotonic() < self._slow_until
 
     def arm(self, spec: str, relative: bool = True):
         """Add faults mid-run. With `relative=True` (default) the @N
@@ -164,11 +201,21 @@ class FaultInjector(object):
         self.faults.extend(new)
 
     def tick(self):
-        """Advance one step; fire any fault scheduled for it."""
+        """Advance one step; fire any fault scheduled for it. While a
+        slow@ window is open every tick sleeps the window's per-step
+        stall — the step COMPLETES (heartbeats keep flowing), it is
+        just late: the gray-failure shape delay@/hang@ cannot model."""
         self.step += 1
         for f in self.faults:
             if f.step == self.step:
-                f.fire()
+                if f.kind == "slow":
+                    dur, per = _parse_slow_arg(f.arg)
+                    self._slow_until = time.monotonic() + dur
+                    self._slow_per = per
+                else:
+                    f.fire()
+        if self.slowed:
+            time.sleep(self._slow_per)
         return self.step
 
 

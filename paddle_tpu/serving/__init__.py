@@ -15,8 +15,10 @@ requests lost or answered twice under kill drills)."""
 
 from .engine import EngineFailed, ServingEngine, ServingHandle
 from .fleet import (
+    DeadlineExceeded,
     FleetHandle,
     FleetSaturated,
+    FleetTimeout,
     RequestJournal,
     ServingFleet,
 )
@@ -27,4 +29,5 @@ from .prefix_cache import PrefixCache, PrefixMatch, chain_keys
 __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
            "PrefixCache", "PrefixMatch", "chain_keys", "EngineFailed",
            "ServingFleet", "FleetHandle", "FleetSaturated",
-           "RequestJournal", "KVBlockAllocator"]
+           "RequestJournal", "KVBlockAllocator", "DeadlineExceeded",
+           "FleetTimeout"]

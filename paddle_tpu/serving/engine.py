@@ -61,6 +61,17 @@ prefill work (PR 4), and the KV cache itself is paged (PR 7):
     most ONE chunk per step (chunks always interleave with decodes —
     the Sarathi policy); `max_prefills_per_step` additionally caps the
     TOTAL chunks across slots per step.
+  * Request SLO (ISSUE 8) — `submit(deadline_at=)` carries an absolute
+    latency budget enforced at every hop (pre-admission, prefill
+    chunk, decode): past it the request finishes with the terminal
+    verdict 'expired' (partial tokens kept) and the scheduler spends
+    nothing further on it. `submit(resume_tokens=)` is token-level
+    resume: tokens an earlier incarnation already emitted become
+    prefill context (aliasing whatever the prefix pool holds), the
+    sampling-key schedule continues at the resume index, and only the
+    remainder is decoded — the fleet's hedged failover rides this to
+    turn "restart from token zero" into "keep decoding". `cancel(rid)`
+    claws back work the fleet hedged elsewhere (demotion).
 
 Correctness bar (tested): greedy engine output per request is
 token-identical to sequential models/transformer.generate() at every
@@ -118,23 +129,48 @@ class EngineFailed(RuntimeError):
 class ServingHandle(object):
     """Per-request future: filled in by the engine as steps run.
     `result()` drives the owning engine until this request completes
-    (single-threaded engines have no background loop to wait on)."""
+    (single-threaded engines have no background loop to wait on).
+
+    Token-level resume (ISSUE 8): a handle submitted with
+    `resume_tokens` carries tokens ALREADY emitted by an earlier
+    incarnation of the same request (journaled by the fleet). The
+    engine prefills prompt + resume as context — aliasing whatever
+    prefix the pool holds — and decodes only the remainder: decode
+    steps are never re-spent on journaled tokens, and the sampling key
+    schedule continues at token index `resume_len`, so outputs stay
+    token-identical to an uninterrupted run. `tokens` holds only the
+    NEWLY generated tokens; `result()` returns the full sequence."""
 
     def __init__(self, engine, rid, prompt, max_new_tokens, temperature,
-                 eos_id, seed, publish_len):
+                 eos_id, seed, publish_len, deadline_at=None,
+                 resume_tokens=None):
         self._engine = engine
         self.rid = rid
-        self.prompt = prompt  # np.int32 [T0]
-        self.max_new_tokens = int(max_new_tokens)
+        self.prompt = prompt  # np.int32 [T0] — the ORIGINAL prompt
+        self.resume_tokens = np.asarray(
+            resume_tokens if resume_tokens is not None else [], np.int32)
+        self.resume_len = int(self.resume_tokens.shape[0])
+        # prefill context: prompt plus everything already emitted
+        self.full_prompt = (
+            np.concatenate([prompt, self.resume_tokens])
+            if self.resume_len else prompt)
+        # budget REMAINING: max_new_tokens is the request's original
+        # total; the resumed tokens are already spent
+        self.total_new_tokens = int(max_new_tokens)
+        self.max_new_tokens = int(max_new_tokens) - self.resume_len
         self.temperature = float(temperature)
         self.eos_id = eos_id
         self.seed = seed
         # publish boundary: how many leading prompt tokens may be
         # published back to the prefix pool (None = whole prompt)
         self.publish_len = publish_len
+        # absolute time.monotonic() budget (None = no deadline): the
+        # engine expires the request at the next queue hop past it
+        self.deadline_at = deadline_at
         self.tokens: List[int] = []  # generated tokens (may include eos)
         self.done = False
-        self.finish_reason: Optional[str] = None  # 'eos' | 'budget'
+        # 'eos' | 'budget' | 'expired' | 'cancelled'
+        self.finish_reason: Optional[str] = None
         # set by ServingEngine.abort() when the engine dies with this
         # request pending: result() raises it instead of spinning on a
         # dead engine forever (ISSUE 6 satellite)
@@ -145,7 +181,11 @@ class ServingHandle(object):
 
     def result(self) -> np.ndarray:
         """Block (by stepping the engine) until done; returns the full
-        sequence [T0 + n_generated] — prompt then generated tokens.
+        sequence — prompt, then resumed tokens (if any), then this
+        incarnation's generated tokens. An 'expired' verdict still
+        returns (the partial sequence): at the engine level the
+        deadline outcome is `finish_reason`, not an exception — the
+        fleet layer turns it into `DeadlineExceeded` for its callers.
         Raises `EngineFailed` (naming the failing replica when the
         engine serves in a fleet) if the engine died with this request
         pending — including when a BACKGROUND thread owned the engine
@@ -160,7 +200,7 @@ class ServingHandle(object):
                     % self.rid
                 )
         return np.concatenate(
-            [self.prompt, np.asarray(self.tokens, np.int32)]
+            [self.full_prompt, np.asarray(self.tokens, np.int32)]
         )
 
 
@@ -293,6 +333,9 @@ class ServingEngine(object):
 
         self._queue: collections.deque = collections.deque()  # guarded-by: scheduler
         self._next_rid = 0                    # guarded-by: scheduler
+        # any request ever carried a deadline -> the per-step expiry
+        # sweep runs; stays False (zero hot-path cost) otherwise
+        self._deadlines = False               # guarded-by: scheduler
         self._donate = bool(donate)
         self._chunk_fns: Dict[int, Any] = {}
         self._decode_fn = self._make_decode()
@@ -521,7 +564,8 @@ class ServingEngine(object):
     # scheduler
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0, eos_id=None,
-               seed=0, publish_len=None) -> ServingHandle:
+               seed=0, publish_len=None, deadline_at=None,
+               resume_tokens=None) -> ServingHandle:
         """Enqueue one request (FCFS). Returns a handle whose `.tokens`
         fills in as the engine steps; `handle.result()` drives the
         engine to completion of this request. Structurally impossible
@@ -532,13 +576,24 @@ class ServingEngine(object):
         is the publish-boundary tag: at most this many leading prompt
         tokens are published to the prefix pool once prefill completes
         (None = the whole prompt; pass the shared-header length to keep
-        request-unique tails out of the pool)."""
+        request-unique tails out of the pool). `deadline_at` is an
+        absolute time.monotonic() budget: past it the request is
+        terminally 'expired' at the next queue hop (admission, prefill
+        chunk, or decode) instead of consuming further steps.
+        `resume_tokens` are tokens an earlier incarnation of this
+        request already emitted (token-level resume, ISSUE 8): they
+        become prefill context — prefix-aliased where the pool allows —
+        and only `max_new_tokens - len(resume_tokens)` tokens are
+        decoded, on the ORIGINAL request's sampling-key schedule."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T0 = prompt.shape[0]
         if T0 < 1:
             raise ValueError("empty prompt")
-        if int(max_new_tokens) < 1:
-            raise ValueError("max_new_tokens must be >= 1")
+        E = 0 if resume_tokens is None else len(resume_tokens)
+        if int(max_new_tokens) - E < 1:
+            raise ValueError(
+                "max_new_tokens must leave >= 1 token past the resumed "
+                "prefix (%d - %d resumed < 1)" % (int(max_new_tokens), E))
         if T0 + int(max_new_tokens) > self.max_len:
             raise ValueError(
                 "request needs T0+max_new <= max_len (%d + %d > %d)"
@@ -554,8 +609,15 @@ class ServingEngine(object):
         if publish_len is not None and publish_len < 0:
             raise ValueError("publish_len must be >= 0 or None")
         h = ServingHandle(self, self._next_rid, prompt, max_new_tokens,
-                          temperature, eos_id, seed, publish_len)
+                          temperature, eos_id, seed, publish_len,
+                          deadline_at=deadline_at,
+                          resume_tokens=resume_tokens)
         self._next_rid += 1
+        if deadline_at is not None:
+            self._deadlines = True
+        if E:
+            self.metrics.resumed_requests += 1
+            self.metrics.resume_tokens_reused += E
         self._queue.append(h)
         return h
 
@@ -610,15 +672,18 @@ class ServingEngine(object):
         from the pool. Returns False — leaving the request QUEUED and
         the engine state untouched — when the pool cannot cover the
         reservation even after reclaiming idle trie blocks. No model
-        compute happens here — chunks run in step()'s prefill phase."""
-        T0 = h.prompt.shape[0]
+        compute happens here — chunks run in step()'s prefill phase.
+        A resumed request's context is prompt + already-emitted tokens
+        (full_prompt): the pool match below is how "restart from
+        scratch" becomes "alias the finished part, keep decoding"."""
+        T0 = h.full_prompt.shape[0]
         Bt = self.kv_block_tokens
         need_total = self._blocks_for(T0 + h.max_new_tokens)
         pc = self.prefix_cache
         # a pure PROBE: a block-starved request retries every step, and
         # retries must not inflate hit/miss stats or restamp LRU order
         # — record_hit/record_miss fire once the admission resolves
-        m = pc.match(h.prompt, record=False) if pc is not None else None
+        m = pc.match(h.full_prompt, record=False) if pc is not None else None
         if m is not None and m.length == 0:
             m.release()
             m = None
@@ -688,10 +753,14 @@ class ServingEngine(object):
         self._limits[s] = T0 + h.max_new_tokens
         self._mark_dirty("tables", "limits")
         # the first-token sampling key is per-request, not per-chunk:
-        # computed once here, consumed on the prompt's final chunk
+        # computed once here, consumed on the prompt's final chunk. A
+        # resumed request's first NEW token is overall token index
+        # resume_len — the fold_in schedule continues where the dead
+        # incarnation stopped, so sampled outputs stay resume-invariant
         self._prefill_state[s] = {
             "handle": h, "cursor": cursor,
-            "key": jax.random.fold_in(jax.random.PRNGKey(h.seed), 0),
+            "key": jax.random.fold_in(
+                jax.random.PRNGKey(h.seed), h.resume_len),
         }
         self._prefill_q.append(s)
         return True
@@ -704,7 +773,7 @@ class ServingEngine(object):
         pc = self.prefix_cache
         if pc is None:
             return
-        T0 = h.prompt.shape[0]
+        T0 = h.full_prompt.shape[0]
         bound = T0 if h.publish_len is None else min(h.publish_len, T0)
         n_blocks = bound // pc.block_tokens
         if n_blocks < 1:
@@ -715,7 +784,7 @@ class ServingEngine(object):
             self._alloc.incref(bid)
             return bid
 
-        pc.publish(h.prompt, n_blocks, _take)
+        pc.publish(h.full_prompt, n_blocks, _take)
 
     def _run_chunk(self, s: int) -> bool:
         """Advance slot s's prefill by one chunk; on the final chunk,
@@ -723,7 +792,7 @@ class ServingEngine(object):
         token. Returns True when the prefill completed."""
         st = self._prefill_state[s]
         h = st["handle"]
-        T0 = h.prompt.shape[0]
+        T0 = h.full_prompt.shape[0]
         cursor = st["cursor"]
         c = T0 - cursor
         if self.prefill_chunk_tokens is not None:
@@ -731,7 +800,7 @@ class ServingEngine(object):
         self._ensure_blocks(s, cursor, cursor + c)
         Cb = self._bucket(c)
         padded = np.zeros(Cb, np.int32)
-        padded[:c] = h.prompt[cursor:cursor + c]
+        padded[:c] = h.full_prompt[cursor:cursor + c]
         fn = self._chunk_fn(Cb)
         t0 = time.monotonic()
         self._cache, first = fn(
@@ -761,12 +830,14 @@ class ServingEngine(object):
         self._pos[s] = T0
         self._alive[s] = True
         self._temps[s] = h.temperature
-        self._counts[s] = 0
+        # a resumed request continues the ORIGINAL fold_in schedule:
+        # its next sampled token is overall index resume_len
+        self._counts[s] = h.resume_len
         self._base_keys[s] = np.asarray(jax.random.PRNGKey(h.seed))
         if self.spec_draft_len is not None:
-            # seed the drafting index from the prompt once (O(T0));
+            # seed the drafting index from the context once (O(T0));
             # _emit keeps it current per token from here on
-            ctx = [int(t) for t in h.prompt]
+            ctx = [int(t) for t in h.full_prompt]
             bmap = {}
             for i in range(len(ctx) - 1):
                 bmap[(ctx[i], ctx[i + 1])] = i + 2
@@ -774,6 +845,79 @@ class ServingEngine(object):
         self._mark_dirty()  # all bands: slot s changed everywhere
         self._emit(s, first)  # may retire immediately (max_new==1 / eos)
         return True
+
+    def _drop_slot(self, s: int, reason: str):
+        """Terminate slot s's request without emitting: clear any
+        pending prefill cursor, then retire (frees blocks + the
+        reserved tail). The deadline/cancel path — the slot's work is
+        abandoned, not completed."""
+        if s in self._prefill_state:
+            del self._prefill_state[s]
+            self._prefill_q.remove(s)
+        self._retire(s, reason)
+
+    def _expire_sweep(self) -> bool:
+        """Enforce per-request deadlines at every queue hop (ISSUE 8):
+        queued requests expire before admission, prefilling slots
+        before their next chunk, decoding slots before the next batched
+        step — the scheduler stops spending compute on a request the
+        moment it cannot be answered in budget. Expiry is a VERDICT
+        (finish_reason 'expired', done=True), never a silent hang."""
+        if not self._deadlines:
+            return False
+        now = time.monotonic()
+        changed = False
+        seen = False  # any deadline still pending after this sweep?
+        keep: collections.deque = collections.deque()
+        while self._queue:
+            h = self._queue.popleft()
+            if h.deadline_at is not None and now >= h.deadline_at:
+                h.done = True
+                h.finish_reason = "expired"
+                self.metrics.expired += 1
+                changed = True
+            else:
+                seen = seen or h.deadline_at is not None
+                keep.append(h)
+        self._queue = keep
+        for s in range(self.max_slots):
+            h = self._slot_req[s]
+            if h is not None and h.deadline_at is not None:
+                if now >= h.deadline_at:
+                    self._drop_slot(s, "expired")
+                    self.metrics.expired += 1
+                    changed = True
+                else:
+                    seen = True
+        if not seen:
+            # nothing left carries a deadline: drop the latch (the
+            # next deadline submit re-arms it) so a long-lived engine
+            # does not pay the sweep forever for one SLO request
+            self._deadlines = False
+        return changed
+
+    def cancel(self, rid) -> bool:
+        """Terminate one request (by this ENGINE's rid) wherever it is
+        — queued, prefilling, or decoding — freeing its slot and
+        blocks; the handle finishes with reason 'cancelled' and its
+        partial tokens. The fleet uses this to claw work back from a
+        demoted (gray-slow) replica after hedging it to a survivor;
+        the demoted engine must stop spending steps on it. Returns
+        False if the rid is unknown or already finished."""
+        for h in self._queue:
+            if h.rid == rid and not h.done:
+                self._queue.remove(h)
+                h.done = True
+                h.finish_reason = "cancelled"
+                self.metrics.cancelled += 1
+                return True
+        for s in range(self.max_slots):
+            h = self._slot_req[s]
+            if h is not None and h.rid == rid:
+                self._drop_slot(s, "cancelled")
+                self.metrics.cancelled += 1
+                return True
+        return False
 
     def abort(self, exc: BaseException):
         """Latch the engine as failed and propagate `exc` into every
@@ -798,7 +942,9 @@ class ServingEngine(object):
                 h.error = self._failed
 
     def step(self) -> bool:
-        """One scheduler iteration: admit queued requests into free
+        """One scheduler iteration: expire anything past its deadline
+        (queued, prefilling, or decoding — a verdict before another
+        token of work is spent on it), admit queued requests into free
         slots (prefix aliasing + block reservation; a block-starved
         pool leaves them queued), advance pending prefills by up to
         `max_prefills_per_step` chunks (FCFS), then ONE batched decode
@@ -823,16 +969,22 @@ class ServingEngine(object):
                 _fi.default_injector()
                 if os.environ.get(_fi.ENV_VAR) else _fi.FaultInjector("")
             )
+        t0 = time.monotonic()
         try:
             if inj.active:
                 inj.tick()
-            return self._step_inner()
+            out = self._step_inner()
         except Exception as exc:
             self.abort(exc)
             raise
+        # step-latency EWMA INCLUDES the injector tick: an injected
+        # gray stall (slow@) is exactly what the fleet's health score
+        # must see here
+        self.metrics.observe_step(time.monotonic() - t0)
+        return out
 
     def _step_inner(self) -> bool:
-        progressed = False
+        progressed = self._expire_sweep()
         while self._queue:
             s = self._free_slot()
             if s is None:
@@ -992,8 +1144,10 @@ class ServingEngine(object):
             pass
         for h in pending:
             if h.done:
+                # full_prompt: a resumed request's sequence includes
+                # the tokens the earlier incarnation already emitted
                 finished[h.rid] = np.concatenate(
-                    [h.prompt, np.asarray(h.tokens, np.int32)]
+                    [h.full_prompt, np.asarray(h.tokens, np.int32)]
                 )
         return finished
 

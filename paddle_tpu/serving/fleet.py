@@ -53,6 +53,37 @@ Guarantees (the PR-1 drills' falsifiability bar, recast for serving):
     latency; batch = None: maximum prefill throughput). `submit(slo=)`
     routes within the class, falling back to any live replica before
     failing — SLO is a preference, survival is a guarantee.
+  * Per-request deadlines (ISSUE 8) — `submit(deadline_s=)` journals
+    the budget with the spec and enforces it at EVERY queue hop:
+    dead-on-arrival requests raise `DeadlineExceeded` before the
+    saturation shed, the routing hop expires inbox requests whose
+    budget died waiting, and the engine expires queued / prefilling /
+    decoding requests before spending another step on them. Expiry is
+    a terminal journal verdict (`expired`) — no request is ever late
+    without one, and the scheduler never burns decode steps on a
+    request that cannot be answered in budget.
+  * Gray-failure demotion + hedged failover with token-level resume
+    (ISSUE 8) — fail-stop detection (heartbeats) cannot see a replica
+    that is alive but too slow (Huang et al., "Gray Failure"; Dean &
+    Barroso, "The Tail at Scale"). With `slow_replica_factor` set, the
+    monitor scores every busy replica's step-latency EWMA against the
+    live-fleet median and watches a decode-progress watermark (tokens
+    per wall-second); a replica slow past the factor for
+    `slow_min_duration_s` (hysteresis: one GC pause decays out of the
+    EWMA and resets the clock) is DEMOTED — not killed: its open
+    requests are hedged to survivors, it cancels the clawed-back work,
+    stays warm, and is probed every `probe_interval_s` until healthy,
+    then restored under the SAME incarnation with its prefix pool hot.
+    Hedged (and failed-over) requests resume at the TOKEN level: every
+    emitted token is journaled incrementally (batched, flush-deferred
+    records), the survivor is submitted `prompt + tokens_already_
+    emitted` with the original sampling-key schedule continued at the
+    resume index, and the prefix pool aliases whatever prefix it
+    holds — decode steps are never re-spent, outputs stay
+    token-identical to an uninterrupted `generate()`. The journal's
+    latest ASSIGNMENT is the lease: a demoted replica racing its
+    hedged survivor has its completions and progress refused, exactly
+    like a zombie lease-holder.
 
 Threading: all shared scheduler state lives on `ServingFleet` and is
 guarded by ONE condition's lock (`_cond`); replica threads and the
@@ -78,11 +109,38 @@ from .prefix_cache import chain_keys
 
 __all__ = [
     "ServingFleet", "FleetHandle", "FleetSaturated", "RequestJournal",
-    "run_fleet_subprocess",
+    "DeadlineExceeded", "FleetTimeout", "run_fleet_subprocess",
 ]
 
 # replica lifecycle states
 _LIVE, _DRAINING, _DRAINED, _DEAD = "live", "draining", "drained", "dead"
+# gray-failure state (ISSUE 8): alive and heartbeating, but too slow —
+# drained of work, probed, and restored (not killed) when healthy again
+_DEMOTED = "demoted"
+
+# per-replica stats that are GAUGES (a dead incarnation's value is
+# meaningless going forward): never folded into cumulative _stats_base
+_GAUGE_STATS = ("kv_blocks_in_use", "step_ewma_s", "busy")
+
+
+def _lower_median(xs: List[float]) -> Optional[float]:
+    """LOWER median of the LATENCY samples (lower = healthier): with
+    two live replicas the upper median IS the slow one, and nothing
+    would ever look slow relative to it. Shared by the demotion and
+    restore thresholds so they cannot silently diverge."""
+    if not xs:
+        return None
+    return sorted(xs)[(len(xs) - 1) // 2]
+
+
+def _upper_median(xs: List[float]) -> Optional[float]:
+    """UPPER median of the RATE samples — polarity is the INVERSE of
+    latency (higher = healthier): with two busy replicas the lower
+    median IS the gray one's trickle, and judging it against its own
+    rate would veto demotion forever."""
+    if not xs:
+        return None
+    return sorted(xs)[len(xs) // 2]
 
 _DEFAULT_SLO_CLASSES = {
     # interactive: one prefill chunk per step fleet-wide per replica —
@@ -100,6 +158,39 @@ class FleetSaturated(RuntimeError):
     never grows an unbounded admission queue."""
 
 
+class DeadlineExceeded(RuntimeError):
+    """Terminal per-request verdict (ISSUE 8): the request's
+    `deadline_s` budget ran out before it could finish. Raised by
+    `submit()` when the deadline is already spent on arrival (checked
+    BEFORE the `FleetSaturated` shed, so overload metrics never absorb
+    client-side lateness), and by `FleetHandle.result()` when the
+    request expired at a later queue hop. The journal records the
+    expiry — a verdict, never a silent hang — and `tokens` carries
+    whatever was emitted before the budget died."""
+
+    def __init__(self, msg: str, rid=None, tokens=None):
+        super().__init__(msg)
+        self.rid = rid
+        self.tokens = list(tokens) if tokens else []
+
+
+class FleetTimeout(TimeoutError):
+    """`FleetHandle.result(timeout=...)` ran out of caller patience —
+    NOT a fleet verdict: the request is still open. Carries the fleet
+    context an operator needs to tell a slow request from a lost one:
+    rid, the journal state (queued / assigned / decoding), the replica
+    currently holding the assignment, and how many tokens have been
+    emitted so far (ISSUE 8 satellite)."""
+
+    def __init__(self, msg: str, rid=None, state=None, replica=None,
+                 tokens_emitted=0):
+        super().__init__(msg)
+        self.rid = rid
+        self.state = state
+        self.replica = replica
+        self.tokens_emitted = tokens_emitted
+
+
 class _KillDrill(RuntimeError):
     """Injected replica death (ServingFleet.kill_replica)."""
 
@@ -110,16 +201,32 @@ class FleetHandle(object):
     block on an event, never by driving an engine."""
 
     def __init__(self, rid: int, prompt: np.ndarray, spec: dict,
-                 slo: Optional[str]):
+                 slo: Optional[str], fleet=None, deadline_at=None):
         self.rid = rid
         self.prompt = prompt  # np.int32 [T0]
         self.spec = spec      # JSON-able request record (journal form)
         self.slo = slo
         self.generation = 0   # bumped on every resubmission
+        # absolute time.monotonic() budget (None = none); journaled as
+        # (deadline_s, submit_unix) so a recovered front door can
+        # recompute the remaining budget across a process restart
+        self.deadline_at = deadline_at
+        # tokens already emitted by a dead/demoted incarnation; the
+        # next assignee prefill-aliases these and decodes ONLY the
+        # remainder (token-level resume). Replaced wholesale (never
+        # mutated in place) under the fleet lock at re-route time.
+        self.resume: List[int] = []
+        # running count of journaled emitted tokens (resume included) —
+        # cheap operator context for FleetTimeout
+        self.emitted = 0
+        self.ttft_s: Optional[float] = None  # first journaled token
         self.tokens: Optional[List[int]] = None
         self.replica: Optional[str] = None  # who answered
         self.error: Optional[BaseException] = None
         self.chain: List[int] = []  # affinity keys (set by the fleet)
+        self._probe = False   # internal health probe, never journaled
+        self._fleet = fleet
+        self._submit_t = time.monotonic()
         self._event = threading.Event()
 
     @property
@@ -133,42 +240,79 @@ class FleetHandle(object):
         """Block until the request completes somewhere in the fleet;
         returns prompt + generated tokens. Raises `EngineFailed` if the
         fleet lost every replica (or was closed) with this request
-        pending, `TimeoutError` on timeout."""
+        pending, `DeadlineExceeded` if the request's budget expired,
+        and `FleetTimeout` — carrying rid, journal state, assigned
+        replica, and tokens emitted so far — when the CALLER's timeout
+        runs out with the request still open."""
         if not self._event.wait(timeout):
-            raise TimeoutError(
-                "request %d not completed within %r s" % (self.rid, timeout))
+            ctx = (self._fleet._describe(self.rid)
+                   if self._fleet is not None else {})
+            raise FleetTimeout(
+                "request %d not completed within %r s: %s "
+                "(%d token(s) emitted so far)" % (
+                    self.rid, timeout,
+                    ctx.get("describe", "state unknown"),
+                    ctx.get("tokens_emitted", self.emitted)),
+                rid=self.rid, state=ctx.get("state"),
+                replica=ctx.get("replica"),
+                tokens_emitted=ctx.get("tokens_emitted", self.emitted))
         if self.error is not None:
             raise self.error
         return np.concatenate(
             [self.prompt, np.asarray(self.tokens, np.int32)])
 
 
+_TERMINAL_KINDS = ("done", "rejected", "expired")
+
+
 class RequestJournal(object):
-    """Durable request table: every submit/assign/done/rejected
-    transition is appended (JSON lines) BEFORE the fleet acts on it,
-    and mirrored in memory as the authoritative OPEN-request index
-    (terminal records prune their mirror entries, so memory is bounded
-    by in-flight work, not lifetime traffic). Failover reads the
-    journal mirror — `lost(replica, incarnation)` — not scheduler
-    guesswork. Opening an EXISTING journal replays it: the mirror
-    resumes the open set and `next_rid()` continues past every rid
-    ever issued, so a restarted front door appending to the same file
-    can never collide with (and thereby corrupt) the history.
-    `path=None` keeps the mirror only (tests); `recover(path)` is the
-    read-only restart helper.
+    """Durable request table: every submit/assign/progress/terminal
+    (done / rejected / expired) transition is appended (JSON lines)
+    BEFORE the fleet acts on it, and mirrored in memory as the
+    authoritative OPEN-request index (terminal records prune their
+    mirror entries, so memory is bounded by in-flight work, not
+    lifetime traffic). Failover reads the journal mirror —
+    `lost(replica, incarnation)`, which now carries the PROGRESS
+    tokens for token-level resume — not scheduler guesswork. Opening
+    an EXISTING journal replays it: the mirror resumes the open set
+    and `next_rid()` continues past every rid ever issued, so a
+    restarted front door appending to the same file can never collide
+    with (and thereby corrupt) the history. `path=None` keeps the
+    mirror only (tests); `recover(path)` is the read-only restart
+    helper.
 
     Durability: records are flushed per append (they survive any
     process death — the failure mode the fleet handles). `fsync=True`
     additionally fsyncs each record for OS-crash/power-loss
-    durability, at per-request disk latency cost."""
+    durability, at per-request disk latency cost.
 
-    def __init__(self, path: Optional[str] = None, fsync: bool = False):
+    Compaction (ISSUE 8 satellite): per-token progress records make an
+    append-only file grow with lifetime TRAFFIC, not in-flight work.
+    With `compact_every=N`, once the file holds >= N records (and the
+    rewrite would actually shrink it) the journal atomically rewrites
+    itself to just a meta record (preserving the rid history) plus the
+    open requests' submit/assign/progress state — `recover()` after a
+    compaction sees exactly the same open set."""
+
+    def __init__(self, path: Optional[str] = None, fsync: bool = False,
+                 compact_every: Optional[int] = None):
         self._lock = threading.Lock()
         self.path = path
         self.fsync = bool(fsync)
+        if compact_every is not None and int(compact_every) < 1:
+            raise ValueError("compact_every must be >= 1 or None")
+        self.compact_every = (
+            None if compact_every is None else int(compact_every))
+        self.compactions = 0                         # guarded-by: _lock
+        self._file_records = 0                       # guarded-by: _lock
         self._open_specs: Dict[int, dict] = {}       # guarded-by: _lock
         self._assign: Dict[int, Tuple[str, int, int]] = {}  # guarded-by: _lock
+        self._progress: Dict[int, List[int]] = {}    # guarded-by: _lock
         self._done: Set[int] = set()                 # guarded-by: _lock
+        # records handed out via defer=True whose file append is still
+        # pending in the caller: while any are outstanding the mirror
+        # is AHEAD of the file, so no compaction may snapshot it
+        self._deferred_out = 0                       # guarded-by: _lock
         self._max_rid = -1                           # guarded-by: _lock
         if path and os.path.exists(path):
             self._replay_and_heal(path)
@@ -221,12 +365,16 @@ class RequestJournal(object):
                     torn_at = lineno
                     continue
                 self._replay(rec)
+                self._file_records += 1
                 good_end += len(raw)
         if torn_at is not None:
             with open(path, "r+b") as f:
                 f.truncate(good_end)
 
     def _replay(self, rec: dict):
+        if rec["kind"] == "meta":  # compaction marker: rid history
+            self._max_rid = max(self._max_rid, rec["max_rid"])
+            return
         rid = rec["rid"]
         self._max_rid = max(self._max_rid, rid)
         if rec["kind"] == "submit":
@@ -234,17 +382,100 @@ class RequestJournal(object):
         elif rec["kind"] == "assign":
             self._assign[rid] = (rec["replica"], rec["incarnation"],
                                  rec["gen"])
-        elif rec["kind"] in ("done", "rejected"):
+        elif rec["kind"] == "progress":
+            self._progress.setdefault(rid, []).extend(rec["tokens"])
+        elif rec["kind"] in _TERMINAL_KINDS:
             self._done.add(rid)
             self._open_specs.pop(rid, None)
             self._assign.pop(rid, None)
+            self._progress.pop(rid, None)
 
-    def _append(self, rec: dict):
+    def _append(self, rec: dict, flush: bool = True):
         if self._f is not None:
             self._f.write(json.dumps(rec) + "\n")
-            self._f.flush()
-            if self.fsync:
-                os.fsync(self._f.fileno())
+            self._file_records += 1
+            if flush:
+                self._flush_file()
+                # auto-compaction only at a batch boundary (here =
+                # single-record batch): the snapshot is built from the
+                # MIRROR, which already holds the effects of deferred
+                # records not yet appended — compacting mid-batch
+                # would write those effects AND then append the
+                # records on top, duplicating progress tokens in the
+                # file (wrong resume prefixes after a restart)
+                self._maybe_compact()
+
+    def _flush_file(self):  # holds: _lock
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+
+    def _open_records(self) -> List[dict]:
+        """The records a compaction must preserve: one meta record (the
+        rid history, so next_rid() survives the rewrite) plus each open
+        request's submit, latest assign, and accumulated progress."""
+        recs: List[dict] = [{"kind": "meta", "max_rid": self._max_rid}]
+        for rid in sorted(self._open_specs):
+            recs.append({"kind": "submit", "rid": rid,
+                         "spec": self._open_specs[rid]})
+            if rid in self._assign:
+                rep, inc, gen = self._assign[rid]
+                recs.append({"kind": "assign", "rid": rid, "replica": rep,
+                             "incarnation": inc, "gen": gen})
+            if self._progress.get(rid):
+                recs.append({"kind": "progress", "rid": rid,
+                             "replica": None, "incarnation": None,
+                             "gen": None,
+                             "tokens": list(self._progress[rid])})
+        return recs
+
+    def _maybe_compact(self):  # holds: _lock
+        """Auto-rotation: rewrite once the file crosses the threshold —
+        but only when the rewrite actually SHRINKS it (a fleet whose
+        open set alone exceeds the threshold must not rewrite the whole
+        file on every append), and never while deferred records are
+        outstanding (a direct append — e.g. submit — can land while
+        another thread holds mirror-applied-but-unwritten progress
+        records: the snapshot would write those tokens AND the later
+        write() would append the same deltas on top, duplicating
+        progress in the file and corrupting restart resume prefixes)."""
+        if self.compact_every is None or self._f is None:
+            return
+        if self._deferred_out > 0:
+            return
+        if self._file_records < self.compact_every:
+            return
+        if self._file_records < 2 * (3 * len(self._open_specs) + 1):
+            return
+        self._compact_locked()
+
+    def _compact_locked(self):  # holds: _lock
+        recs = self._open_records()
+        tmp = self.path + ".compact"
+        with open(tmp, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)  # atomic: crash keeps old OR new
+        self._f = open(self.path, "a")
+        self._file_records = len(recs)
+        # _done is KEPT: is_done() must stay truthful across rotations
+        # (ints only — bounded by lifetime, like the fleet's own
+        # _done_rids dedupe set)
+        self.compactions += 1
+
+    def compact(self) -> bool:
+        """Explicit rewrite-to-open-set (see class docstring). Returns
+        False for a mirror-only journal, or while deferred records are
+        outstanding (the mirror is ahead of the file — see
+        _maybe_compact; retry after the pending write())."""
+        with self._lock:
+            if self._f is None or self._deferred_out > 0:
+                return False
+            self._compact_locked()
+            return True
 
     def next_rid(self) -> int:
         """First rid safe to issue: past everything this journal file
@@ -270,6 +501,23 @@ class RequestJournal(object):
         with self._lock:
             self._assign[rid] = (replica, incarnation, gen)
             if defer:
+                self._deferred_out += 1
+                return rec
+            self._append(rec)
+        return None
+
+    def _terminal(self, rid: int, rec: dict,
+                  defer: bool) -> Optional[dict]:
+        """Shared body of every terminal kind (done/expired/rejected):
+        mark the rid done, prune it from the open mirror, then append
+        the record (or hand it back deferred)."""
+        with self._lock:
+            self._done.add(rid)
+            self._open_specs.pop(rid, None)
+            self._assign.pop(rid, None)
+            self._progress.pop(rid, None)
+            if defer:
+                self._deferred_out += 1
                 return rec
             self._append(rec)
         return None
@@ -280,21 +528,51 @@ class RequestJournal(object):
         rec = {"kind": "done", "rid": rid, "replica": replica,
                "incarnation": incarnation, "gen": gen,
                "tokens": list(tokens)}
+        return self._terminal(rid, rec, defer)
+
+    def progress(self, rid: int, replica: str, incarnation: int,
+                 gen: int, tokens: List[int],
+                 defer: bool = False) -> Optional[dict]:
+        """Incremental emitted-token record (token-level resume,
+        ISSUE 8): `tokens` is the DELTA since the last progress record
+        for this rid. Batched by the fleet (one record per scheduler
+        handshake, not per token) and flush-deferred like assign —
+        the mirror is what failover resumes from."""
+        rec = {"kind": "progress", "rid": rid, "replica": replica,
+               "incarnation": incarnation, "gen": gen,
+               "tokens": [int(t) for t in tokens]}
         with self._lock:
-            self._done.add(rid)
-            self._open_specs.pop(rid, None)
-            self._assign.pop(rid, None)
+            self._progress.setdefault(rid, []).extend(rec["tokens"])
             if defer:
+                self._deferred_out += 1
                 return rec
             self._append(rec)
         return None
 
+    def expire(self, rid: int, tokens: List[int],
+               defer: bool = False) -> Optional[dict]:
+        """Terminal DEADLINE verdict: the request ran out of budget.
+        Distinct from `rejected` (unservable) and `done` (answered) so
+        shed/SLO metrics never conflate overload, malformed input, and
+        lateness; `tokens` records what was emitted before expiry."""
+        rec = {"kind": "expired", "rid": rid,
+               "tokens": [int(t) for t in tokens]}
+        return self._terminal(rid, rec, defer)
+
     def write(self, recs: List[dict]):
         """File-append records whose mirror updates already happened
-        (the deferred half of assign/complete)."""
+        (the deferred half of assign/complete/progress/expire). One
+        flush per batch, not per record — and auto-compaction only
+        AFTER the whole batch is on disk (see _append: a mid-batch
+        snapshot would duplicate the not-yet-appended records'
+        effects)."""
         with self._lock:
             for rec in recs:
-                self._append(rec)
+                self._append(rec, flush=False)
+            self._deferred_out = max(0, self._deferred_out - len(recs))
+            if self._f is not None:
+                self._flush_file()
+                self._maybe_compact()
 
     def reject(self, rid: int, reason: str,
                defer: bool = False) -> Optional[dict]:
@@ -303,26 +581,33 @@ class RequestJournal(object):
         it): without it the rid would stay open forever and every
         future recover() would resubmit an unservable request."""
         rec = {"kind": "rejected", "rid": rid, "reason": reason}
-        with self._lock:
-            self._done.add(rid)
-            self._open_specs.pop(rid, None)
-            self._assign.pop(rid, None)
-            if defer:
-                return rec
-            self._append(rec)
-        return None
+        return self._terminal(rid, rec, defer)
 
-    def lost(self, replica: str, incarnation: int) -> List[Tuple[int, dict, int]]:
-        """(rid, spec, gen) of every OPEN request whose latest
-        assignment is (replica, incarnation) — the set a failover must
-        resubmit."""
+    def lost(self, replica: str, incarnation: int
+             ) -> List[Tuple[int, dict, int, List[int]]]:
+        """(rid, spec, gen, emitted_tokens) of every OPEN request whose
+        latest assignment is (replica, incarnation) — the set a
+        failover/demotion must resubmit, with the progress tokens the
+        survivor resumes from instead of re-decoding."""
         with self._lock:
             out = []
             for rid, (rep, inc, gen) in sorted(self._assign.items()):
                 if rep == replica and inc == incarnation \
                         and rid in self._open_specs:
-                    out.append((rid, self._open_specs[rid], gen))
+                    out.append((rid, self._open_specs[rid], gen,
+                                list(self._progress.get(rid, []))))
             return out
+
+    def assigned_to(self, rid: int) -> Optional[Tuple[str, int, int]]:
+        """Latest (replica, incarnation, gen) assignment, or None. The
+        completion/progress fence: only the current holder's reports
+        count (the lease-generation rule, recast for request SLO)."""
+        with self._lock:
+            return self._assign.get(rid)
+
+    def progress_of(self, rid: int) -> List[int]:
+        with self._lock:
+            return list(self._progress.get(rid, []))
 
     def open_count(self) -> int:
         with self._lock:
@@ -342,18 +627,34 @@ class RequestJournal(object):
     def recover(path: str) -> List[Tuple[int, dict]]:
         """Rebuild the incomplete-request list from a journal file:
         (rid, spec) for every submitted rid with no terminal
-        (done/rejected) record, in submission order. A restarted front
-        door resubmits exactly these — requests survive even a full
-        fleet-process crash."""
+        (done/rejected/expired) record, in submission order. A
+        restarted front door resubmits exactly these — requests
+        survive even a full fleet-process crash. (Use
+        `recover_progress(path)` for the emitted-token prefixes and
+        pass them to `ServingFleet.submit(resume_tokens=...)`.)"""
         specs: Dict[int, dict] = {}
         done: Set[int] = set()
         for rec in RequestJournal._read(path):
             if rec["kind"] == "submit":
                 specs[rec["rid"]] = rec["spec"]
-            elif rec["kind"] in ("done", "rejected"):
+            elif rec["kind"] in _TERMINAL_KINDS:
                 done.add(rec["rid"])
         return [(rid, specs[rid]) for rid in sorted(specs)
                 if rid not in done]
+
+    @staticmethod
+    def recover_progress(path: str) -> Dict[int, List[int]]:
+        """Emitted-token prefixes of the incomplete requests (rid ->
+        tokens, in emission order): the restart counterpart of the
+        in-process resume path — resubmit recover()'s specs via
+        `ServingFleet.submit(..., resume_tokens=these[rid])` and no
+        decode step is re-spent."""
+        open_set = {rid for rid, _ in RequestJournal.recover(path)}
+        prog: Dict[int, List[int]] = {}
+        for rec in RequestJournal._read(path):
+            if rec["kind"] == "progress" and rec["rid"] in open_set:
+                prog.setdefault(rec["rid"], []).extend(rec["tokens"])
+        return prog
 
 
 class _Replica(object):
@@ -362,7 +663,8 @@ class _Replica(object):
     completions. Identity (object + incarnation) IS the liveness lease
     the fleet fences on. Everything here is confined to the replica
     thread; the fleet reads only the immutable fields (name, index,
-    incarnation, slo)."""
+    incarnation, slo, and the composed `_engine_kw` — set once at
+    construction, never mutated — for probe sizing)."""
 
     def __init__(self, fleet: "ServingFleet", index: int, incarnation: int,
                  slo: Optional[str], engine_kw: dict):
@@ -374,6 +676,7 @@ class _Replica(object):
         self._engine_kw = engine_kw
         self.engine: Optional[ServingEngine] = None  # guarded-by: replica
         self._serving: Dict[int, Any] = {}           # guarded-by: replica
+        self._reported: Dict[int, int] = {}          # guarded-by: replica
         self._pool_rev = (0, 0)                      # guarded-by: replica
         self.thread = threading.Thread(
             target=self._loop, name="fleet-%s-i%d" % (self.name, incarnation),
@@ -408,33 +711,64 @@ class _Replica(object):
             self.engine = ServingEngine(
                 fleet._params, fleet._cfg, replica_id=self.name,
                 **self._engine_kw)
-            completed: List[Tuple[int, List[int]]] = []
+            completed: List[Tuple[int, List[int], str]] = []
+            progress: List[Tuple[int, List[int]]] = []
             while True:
-                cmd, work = fleet._sync(
-                    self, completed, idle=self._idle(),
+                cmd, work, cancels, resync = fleet._sync(
+                    self, completed, progress, idle=self._idle(),
                     summary=self._pool_summary(), stats=self._stats())
                 completed = []
+                progress = []
                 if cmd == "stop":
                     return
+                if resync:
+                    # post-restore refresh: the fleet dropped this
+                    # replica's routing summary at demotion but the
+                    # pool (warm, unchanged) would never re-trigger
+                    # the revision cache — invalidate it so the next
+                    # handshake carries the full summary again
+                    self._pool_rev = (-1, -1)
+                for rid in cancels:
+                    # work hedged away from this replica (demotion):
+                    # stop spending steps on it; the journal fence
+                    # already refuses anything it might still report
+                    sh = self._serving.pop(rid, None)
+                    if sh is not None:
+                        self._reported.pop(rid, None)
+                        self.engine.cancel(sh.rid)
                 for h in work:
                     try:
                         sh = self.engine.submit(
                             h.prompt, h.spec["max_new_tokens"],
                             temperature=h.spec["temperature"],
                             eos_id=h.spec["eos_id"], seed=h.spec["seed"],
-                            publish_len=h.spec["publish_len"])
+                            publish_len=h.spec["publish_len"],
+                            deadline_at=h.deadline_at,
+                            resume_tokens=h.resume or None)
                     except ValueError as exc:
                         # a malformed request must fail ITSELF, not
                         # crash-loop the replica through failover
                         fleet._reject(h.rid, exc)
                         continue
                     self._serving[h.rid] = sh
+                    self._reported[h.rid] = 0
                 if not self._idle():
                     self.engine.step()
                 for rid, sh in list(self._serving.items()):
+                    # batched incremental progress: every token emitted
+                    # since the last handshake rides ONE journal record
+                    n = len(sh.tokens)
+                    if n > self._reported[rid]:
+                        progress.append(
+                            (rid, list(sh.tokens[self._reported[rid]:n])))
+                        self._reported[rid] = n
                     if sh.done:
-                        completed.append((rid, list(sh.tokens)))
+                        reason = ("expired"
+                                  if sh.finish_reason == "expired"
+                                  else "done")
+                        completed.append((rid, list(sh.tokens), reason))
                         del self._serving[rid]
+                        del self._reported[rid]
         except Exception as exc:  # crash -> failover (incl. _KillDrill)
             if self.engine is not None:
                 self.engine.abort(exc)
@@ -460,6 +794,15 @@ class _Replica(object):
             "cow_blocks": m.cow_blocks,
             "spec_drafted": m.spec_drafted,
             "spec_accepted": m.spec_accepted,
+            "expired": m.expired,
+            "resumed_requests": m.resumed_requests,
+            "resume_tokens_reused": m.resume_tokens_reused,
+            # health-score inputs (ISSUE 8): step-latency EWMA is a
+            # GAUGE (never folded into _stats_base); busy says whether
+            # a progress watermark is even expected of this replica
+            "step_ewma_s": m.step_ewma_s,
+            "busy": bool(self._serving) or bool(e.live_slots)
+            or bool(e.queue_depth) or bool(e.prefilling_slots),
         }
         if e.prefix_cache is not None:
             out["prefix_hits"] = e.prefix_cache.hits
@@ -505,13 +848,47 @@ class ServingFleet(object):
       auto_refill          monitor replaces DEAD replicas with a fresh
                            incarnation automatically (default False:
                            drills and operators call refill())
+      journal_compact_every
+                           rewrite the journal file down to its open
+                           set once it holds this many records
+                           (default 4096; None = never). Per-token
+                           progress records make an append-only
+                           journal grow with TRAFFIC, not in-flight
+                           work — without compaction a long-lived
+                           fleet fills the disk at decode rate
+      slow_replica_factor  GRAY-failure detection (ISSUE 8): a BUSY
+                           replica whose step-latency EWMA exceeds
+                           this multiple of the live-fleet median is
+                           slow; sustained past slow_min_duration_s it
+                           is DEMOTED — drained of work (hedged to
+                           survivors with token-level resume), kept
+                           warm, probed, and restored when healthy.
+                           None (default) disables detection: enable
+                           it only on a WARMED fleet, or set
+                           slow_min_duration_s above the first-compile
+                           latency (README sizing rule) — a replica
+                           compiling its first buckets is slow for
+                           honest reasons
+      slow_min_duration_s  hysteresis: the slow condition must hold
+                           continuously this long before demotion (one
+                           GC pause must not flap a healthy replica)
+      probe_interval_s     cadence of health probes (tiny internal
+                           generate requests) sent to a DEMOTED
+                           replica; a probe completed with a healthy
+                           step EWMA restores it — same incarnation,
+                           warm engine and prefix pool
+      probe_ok_needed      consecutive healthy probes required to
+                           restore (restore-side hysteresis)
     """
 
     def __init__(self, params, cfg, n_replicas=2, journal_path=None,
                  journal_fsync=False, max_pending=64,
                  heartbeat_timeout_s=30.0, monitor_interval_s=None,
                  affinity=True, replica_slo=None, slo_classes=None,
-                 engine_kw=None, engine_kw_for=None, auto_refill=False):
+                 engine_kw=None, engine_kw_for=None, auto_refill=False,
+                 journal_compact_every=4096, slow_replica_factor=None,
+                 slow_min_duration_s=0.5, probe_interval_s=0.25,
+                 probe_ok_needed=1):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
         if int(max_pending) < 1:
@@ -523,6 +900,15 @@ class ServingFleet(object):
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.affinity = bool(affinity)
         self.auto_refill = bool(auto_refill)
+        if slow_replica_factor is not None \
+                and float(slow_replica_factor) <= 1.0:
+            raise ValueError("slow_replica_factor must be > 1 or None")
+        self.slow_replica_factor = (
+            None if slow_replica_factor is None
+            else float(slow_replica_factor))
+        self.slow_min_duration_s = float(slow_min_duration_s)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_ok_needed = int(probe_ok_needed)
         self.slo_classes = dict(_DEFAULT_SLO_CLASSES)
         if slo_classes:
             self.slo_classes.update(slo_classes)
@@ -539,25 +925,13 @@ class ServingFleet(object):
         # prefix_block_tokens the pre-paging alias the engine accepts).
         # `is None` defaulting, like the engine: an explicit invalid 0
         # must raise HERE, not as a replica-thread crash loop later
-        _bt = self._engine_kw.get("kv_block_tokens")
-        if _bt is None:
-            _bt = self._engine_kw.get("prefix_block_tokens")
-        self.block_tokens = 16 if _bt is None else int(_bt)
-        if self.block_tokens < 1:
-            raise ValueError("kv_block_tokens must be >= 1")
-        # per-replica pool capacity for the submit() precheck: a
-        # request whose worst case exceeds a WHOLE replica pool can
-        # never be admitted anywhere — fail in the caller (the engine's
-        # own rule; a merely saturated pool queues instead)
-        _L = min(int(self._engine_kw.get("max_len") or cfg.max_len),
-                 int(params["pos"].shape[0]))
-        _pb = self._engine_kw.get("kv_pool_blocks")
-        self._pool_blocks = (
-            int(self._engine_kw.get("max_slots", 8))
-            * (-(-_L // self.block_tokens))
-            if _pb is None else int(_pb))
-        if self._pool_blocks < 1:
-            raise ValueError("kv_pool_blocks must be >= 1")
+        # block_tokens/_pool_blocks are the BASE-kw limits, used for
+        # the submit() precheck: a request whose worst case exceeds a
+        # WHOLE replica pool can never be admitted anywhere — fail in
+        # the caller (the engine's own rule; a merely saturated pool
+        # queues instead)
+        _, self.block_tokens, self._pool_blocks = self._limits_for(
+            self._engine_kw)
         # chain keys only pay off when there is a pool to match: with
         # no base prefix_cache_tokens every summary stays empty, so
         # skip the per-submit O(T0) crc work entirely
@@ -567,7 +941,15 @@ class ServingFleet(object):
         # ONE lock for all fleet scheduler state (the condition owns
         # it); replica + monitor threads mutate ONLY under it
         self._cond = threading.Condition()
-        self._journal = RequestJournal(journal_path, fsync=journal_fsync)
+        # serializes _flush_journal's swap+write as one unit (always
+        # acquired BEFORE _cond, never while holding it): without it
+        # two flushers could write their batches to the FILE in the
+        # opposite order they were swapped, and per-rid progress
+        # records would land inverted on disk — a restart would
+        # recover a scrambled resume prefix
+        self._flush_lock = threading.Lock()
+        self._journal = RequestJournal(journal_path, fsync=journal_fsync,
+                                       compact_every=journal_compact_every)
         self._replicas: List[_Replica] = []            # guarded-by: _cond
         self._state: List[str] = []                    # guarded-by: _cond
         self._beats: List[float] = []                  # guarded-by: _cond
@@ -583,6 +965,24 @@ class ServingFleet(object):
         self._rapid: List[int] = []                    # guarded-by: _cond
         self._refill_at: List[float] = []              # guarded-by: _cond
         self._incarnations: List[int] = []             # guarded-by: _cond
+        # gray-failure health tracking (ISSUE 8): when the slow
+        # condition first held (None = healthy), per-replica progress
+        # watermark samples (monotonic t, tokens_out), pending cancels
+        # (work hedged away a demoted replica must stop), outstanding
+        # probe handle + schedule + consecutive-good count
+        self._slow_since: List[Optional[float]] = []   # guarded-by: _cond
+        self._watermark: List[Optional[Tuple[float, int]]] = []  # guarded-by: _cond
+        self._rate: List[Optional[float]] = []         # guarded-by: _cond
+        self._stall_since: List[Optional[float]] = []  # guarded-by: _cond
+        self._cancels: List[Set[int]] = []             # guarded-by: _cond
+        self._probes: List[Optional[FleetHandle]] = []  # guarded-by: _cond
+        self._probe_at: List[float] = []               # guarded-by: _cond
+        self._probe_ok: List[int] = []                 # guarded-by: _cond
+        # restore-time summary refresh: demotion cleared the routing
+        # summary, and the replica's revision cache would otherwise
+        # never resend an UNCHANGED (warm!) pool after restore
+        self._want_summary: List[bool] = []            # guarded-by: _cond
+        self._next_probe_rid = -1                      # guarded-by: _cond
         self._handles: Dict[int, FleetHandle] = {}     # guarded-by: _cond
         self._open: Set[int] = set()                   # guarded-by: _cond
         self._done_rids: Set[int] = set()              # guarded-by: _cond
@@ -602,10 +1002,20 @@ class ServingFleet(object):
         self.completed = 0                             # guarded-by: _cond
         self.shed = 0                                  # guarded-by: _cond
         self.rejected = 0                              # guarded-by: _cond
+        self.expired = 0                               # guarded-by: _cond
+        # deadline dead on arrival: shed-like (never journaled, never
+        # counted as submitted) but kept APART from `shed` so overload
+        # and client-side lateness stay distinguishable (ISSUE 8 fix)
+        self.expired_on_arrival = 0                    # guarded-by: _cond
         self.resubmitted = 0                           # guarded-by: _cond
         self.failovers = 0                             # guarded-by: _cond
         self.zombie_refused = 0                        # guarded-by: _cond
         self.duplicate_refused = 0                     # guarded-by: _cond
+        self.demotions = 0                             # guarded-by: _cond
+        self.restores = 0                              # guarded-by: _cond
+        self.probes_sent = 0                           # guarded-by: _cond
+        self.resumed_requests = 0                      # guarded-by: _cond
+        self.resumed_tokens = 0                        # guarded-by: _cond
 
         self._idle_wait_s = min(0.02, self.heartbeat_timeout_s / 10.0)
         self._monitor_interval_s = (
@@ -624,6 +1034,15 @@ class ServingFleet(object):
                 self._spawned.append(time.monotonic())
                 self._rapid.append(0)
                 self._refill_at.append(0.0)
+                self._slow_since.append(None)
+                self._watermark.append(None)
+                self._rate.append(None)
+                self._stall_since.append(None)
+                self._cancels.append(set())
+                self._probes.append(None)
+                self._probe_at.append(0.0)
+                self._probe_ok.append(0)
+                self._want_summary.append(False)
                 self._replicas.append(self._make_replica(i, 1))
         for r in self._replicas:
             r.start()
@@ -632,6 +1051,30 @@ class ServingFleet(object):
         self._monitor.start()
 
     # -- construction helpers -------------------------------------------
+    def _limits_for(self, kw: dict):
+        """Structural admission limits — (max context, block tokens,
+        pool blocks) — for one set of composed engine kwargs. The ONE
+        derivation of the engine's `is None` defaulting rules: the
+        constructor applies it to the base kw for the submit()
+        precheck, probe sizing applies it to a replica's PER-REPLICA
+        composed kw (an engine_kw_for override with a smaller
+        context/pool must shrink the probe too, or that replica fails
+        every probe at admission and stays demoted forever)."""
+        bt = kw.get("kv_block_tokens")
+        if bt is None:
+            bt = kw.get("prefix_block_tokens")
+        bt = 16 if bt is None else int(bt)
+        if bt < 1:
+            raise ValueError("kv_block_tokens must be >= 1")
+        L = min(int(kw.get("max_len") or self._cfg.max_len),
+                int(self._params["pos"].shape[0]))
+        pb = kw.get("kv_pool_blocks")
+        pb = (int(kw.get("max_slots", 8)) * (-(-L // bt))
+              if pb is None else int(pb))
+        if pb < 1:
+            raise ValueError("kv_pool_blocks must be >= 1")
+        return L, bt, pb
+
     def _make_replica(self, index: int, incarnation: int) -> _Replica:
         kw = dict(self._engine_kw)
         slo = self._replica_slo[index]
@@ -657,17 +1100,44 @@ class ServingFleet(object):
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0,
                eos_id=None, seed=0, publish_len=None,
-               slo="interactive") -> FleetHandle:
+               slo="interactive", deadline_s=None,
+               resume_tokens=None) -> FleetHandle:
         """Journal the request durably, then route it (prefix affinity
         within the SLO class). Raises `FleetSaturated` when
         `max_pending` requests are already open — the shed request is
         NOT journaled, so backpressure never grows the durable table
-        either."""
+        either. `deadline_s` is the request's end-to-end latency
+        budget: journaled with the spec, enforced at every queue hop
+        (admission, routing, prefill chunk, decode), and terminally
+        `expired` — a verdict, never a silent hang — the moment it
+        cannot be met. A deadline already spent on arrival raises
+        `DeadlineExceeded` BEFORE the saturation check (and journals
+        nothing), so shed metrics never conflate overload with
+        client-side lateness. `resume_tokens` is the FRONT-DOOR
+        RESTART half of token-level resume: tokens a previous fleet
+        process already emitted for this request (from
+        `RequestJournal.recover_progress`); they count against
+        `max_new_tokens`, are journaled as a progress record before
+        routing (durable across a second crash), prefill-aliased by
+        the assignee, and never re-decoded — a prefix that already
+        reached its budget or `eos_id` completes straight from the
+        journal with zero engine work."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
             raise ValueError("empty prompt")
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        resume = None
+        if resume_tokens is not None:
+            resume = [int(t) for t in resume_tokens]
+            if len(resume) > int(max_new_tokens):
+                raise ValueError(
+                    "resume_tokens longer than max_new_tokens "
+                    "(%d > %d): the prefix cannot have come from this "
+                    "request's budget" % (len(resume),
+                                          int(max_new_tokens)))
+            if not resume:
+                resume = None
         # fail fast HERE with the engine's admission rule (including a
         # base engine_kw max_len override): a request that cannot fit
         # must error in the caller, not asynchronously at result()
@@ -688,6 +1158,9 @@ class ServingFleet(object):
             raise ValueError("publish_len must be >= 0 or None")
         if slo is not None and slo not in self.slo_classes:
             raise ValueError("unknown SLO class %r" % slo)
+        deadline_at = None
+        if deadline_s is not None:
+            deadline_at = time.monotonic() + float(deadline_s)
         spec = {
             "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens),
@@ -696,10 +1169,24 @@ class ServingFleet(object):
             "seed": int(seed),
             "publish_len": None if publish_len is None else int(publish_len),
             "slo": slo,
+            # wall-clock pair: a recovered front door recomputes the
+            # remaining budget as deadline_s - (now - submit_unix)
+            "deadline_s": None if deadline_s is None else float(deadline_s),
+            "submit_unix": time.time(),
         }
         with self._cond:
             if self._closing:
                 raise RuntimeError("fleet is closed")
+            if deadline_s is not None and float(deadline_s) <= 0.0:
+                # the deadline died client-side BEFORE the fleet could
+                # matter: an `expired` verdict, checked ahead of the
+                # saturation shed so overload metrics stay honest —
+                # and never journaled (like shed: the durable table
+                # only holds requests the fleet accepted)
+                self.expired_on_arrival += 1
+                raise DeadlineExceeded(
+                    "request arrived with its deadline already spent "
+                    "(deadline_s=%r)" % deadline_s)
             if len(self._open) >= self.max_pending:
                 self.shed += 1
                 raise FleetSaturated(
@@ -707,7 +1194,8 @@ class ServingFleet(object):
                     % (len(self._open), self.max_pending))
             rid = self._next_rid
             self._next_rid += 1
-            h = FleetHandle(rid, prompt, spec, slo)
+            h = FleetHandle(rid, prompt, spec, slo, fleet=self,
+                            deadline_at=deadline_at)
             if self._chain_prompts:  # keys feed ONLY affinity routing
                 h.chain = chain_keys(prompt, self.block_tokens)
             self._handles[rid] = h
@@ -717,6 +1205,12 @@ class ServingFleet(object):
         # journal's write+flush never stalls replica handshakes or the
         # monitor behind disk latency
         self._journal.submit(rid, spec)
+        if resume is not None:
+            # the restart prefix rides a progress record ahead of any
+            # assignment: a second front-door crash recovers it exactly
+            # like tokens journaled the normal way, and lost()/failover
+            # concatenate later deltas after it
+            self._journal.progress(rid, "__restart__", -1, 0, resume)
         try:
             with self._cond:
                 if self._closing:
@@ -725,13 +1219,17 @@ class ServingFleet(object):
                     # record, or the journaled rid stays open and
                     # every future recover() resubmits a request
                     # whose caller was told it failed
-                    self._open.discard(rid)
-                    self._handles.pop(rid, None)
-                    self._done_rids.add(rid)
-                    self.rejected += 1
-                    self._pending_journal.append(self._journal.reject(
-                        rid, "fleet closed", defer=True))
+                    self._reject_locked(rid, "fleet closed")
                     raise RuntimeError("fleet is closed")
+                if resume is not None:
+                    if self._finished_in_journal(spec, resume):
+                        self._complete_from_progress(
+                            h, resume, "__restart__", -1)
+                        return h
+                    h.resume = list(resume)
+                    h.emitted = len(resume)
+                    self.resumed_requests += 1
+                    self.resumed_tokens += len(resume)
                 self._route(h, exclude=None)
         finally:
             # also on the raises above: the terminal reject record
@@ -746,6 +1244,15 @@ class ServingFleet(object):
         replica at all fails the handle."""
         live = [i for i in range(self.n_replicas)
                 if self._state[i] == _LIVE and i != exclude]
+        if not live:
+            # slow beats dead, the _demote_locked rule — but deaths can
+            # make a DEMOTED replica the last one alive, and it is warm,
+            # heartbeating, and parked only by our own health verdict:
+            # strictly better than terminally rejecting every request
+            # (probes restore it the moment it behaves; a real death
+            # still fails over through the heartbeat deadline)
+            live = [i for i in range(self.n_replicas)
+                    if self._state[i] == _DEMOTED and i != exclude]
         cands = [i for i in live if self._replica_slo[i] in (None, h.slo)]
         if not cands:
             cands = live  # survival beats SLO placement
@@ -753,17 +1260,13 @@ class ServingFleet(object):
             # terminal: the caller gets the error NOW, so the request
             # must not stay open (journal-wise) to be resubmitted by
             # every future recover(); prune like _accept does
-            h.error = EngineFailed(
-                "no live replica for request %d" % h.rid, replica=None)
-            self._open.discard(h.rid)
-            self._handles.pop(h.rid, None)
-            self._done_rids.add(h.rid)
-            self.rejected += 1
-            self._pending_journal.append(self._journal.reject(
-                h.rid, "no live replica", defer=True))
             # event fires at flush, AFTER the reject record is on disk
             # (submit's caller still gets the raise synchronously)
-            self._pending_events.append(h)
+            self._reject_locked(
+                h.rid, "no live replica", fire=True,
+                error=EngineFailed(
+                    "no live replica for request %d" % h.rid,
+                    replica=None))
             raise h.error
         best, best_key = None, None
         for i in cands:
@@ -791,16 +1294,57 @@ class ServingFleet(object):
         the waiters whose completions those records describe — called
         by every entry point after dropping the lock (submit, replica
         syncs, monitor sweeps, drain, close). The ordering makes the
-        journal read-your-writes for anyone a result just unblocked."""
-        with self._cond:
-            if not self._pending_journal and not self._pending_events:
-                return
-            pending, self._pending_journal = self._pending_journal, []
-            fired, self._pending_events = self._pending_events, []
-        if pending:
-            self._journal.write(pending)
+        journal read-your-writes for anyone a result just unblocked.
+        The swap and the file write happen as ONE unit under
+        `_flush_lock` (outer to `_cond`, never taken while holding
+        it): concurrent flushers must hit the file in swap order, or
+        a rid's progress deltas could land inverted on disk while the
+        mirror has them straight — and a restarted front door would
+        resume a scrambled token prefix."""
+        fired: List[FleetHandle] = []
+        with self._flush_lock:
+            with self._cond:
+                if not self._pending_journal and not self._pending_events:
+                    return
+                pending, self._pending_journal = self._pending_journal, []
+                fired, self._pending_events = self._pending_events, []
+            if pending:
+                self._journal.write(pending)
         for h in fired:
             h._event.set()
+
+    def _reject_locked(self, rid: int, reason: str, error=None,
+                       fire: bool = False) -> Optional[FleetHandle]:
+        """Terminal `rejected` bookkeeping for an open rid (caller
+        holds `_cond`): prune every in-memory mirror, count it, queue
+        the journal record. The ONE place the reject invariant lives —
+        engine-admission failure, the no-live-replica route, submit's
+        close race, and close() all share it, so a future change to
+        the terminal shape cannot desynchronize the journal from the
+        mirrors at just one site. `error` lands on a not-yet-done
+        handle; `fire` queues its event for the post-flush release
+        (read-your-writes for the waiter it unblocks). Idempotent: a
+        rid that is already terminal (close()'s open-request sweep
+        racing submit's close branch reaches the same rid from both
+        sides) is left alone — a second pass would double-count
+        `rejected` and journal a duplicate terminal record, driving
+        stats()['lost'] negative."""
+        if rid in self._done_rids:
+            return self._handles.pop(rid, None)
+        h = self._handles.pop(rid, None)
+        self._open.discard(rid)
+        self._done_rids.add(rid)
+        for fl in self._in_flight:
+            fl.pop(rid, None)
+        self.rejected += 1
+        self._pending_journal.append(self._journal.reject(
+            rid, reason, defer=True))
+        if h is not None and not h.done:
+            if error is not None:
+                h.error = error
+            if fire:
+                self._pending_events.append(h)
+        return h
 
     def _reject(self, rid: int, exc: Exception):
         """A single malformed request failed engine admission: fail it
@@ -808,48 +1352,75 @@ class ServingFleet(object):
         record — an unservable request must not stay open forever and
         be resubmitted by every future recover()."""
         with self._cond:
-            h = self._handles.pop(rid, None)
+            h = self._handles.get(rid)
             if h is None or h.done:
                 return
-            h.error = exc
-            self._open.discard(rid)
-            self._done_rids.add(rid)
-            for fl in self._in_flight:
-                fl.pop(rid, None)
-            self.rejected += 1
-            self._pending_journal.append(self._journal.reject(
-                rid, repr(exc), defer=True))
-            self._pending_events.append(h)
+            if h._probe:
+                # a probe that failed engine ADMISSION is a failed
+                # probe, not a rejected request: journaling its
+                # negative rid would corrupt the durable table and
+                # stats()["lost"], and leaving _probes[i] set would
+                # stop all future probes — the replica would stay
+                # DEMOTED forever with no path back
+                for i, ph in enumerate(self._probes):
+                    if ph is h:
+                        self._probes[i] = None
+                        self._probe_ok[i] = 0
+                        self._probe_at[i] = (time.monotonic()
+                                             + self.probe_interval_s)
+                self._handles.pop(rid, None)
+                # the handshake tracked the probe in-flight when it was
+                # handed out; a leaked negative rid would block the
+                # DRAINING->DRAINED transition forever and inflate this
+                # replica's routing load on every failed probe
+                for fl in self._in_flight:
+                    fl.pop(rid, None)
+                h._event.set()
+                self._cond.notify_all()
+                return
+            self._reject_locked(rid, repr(exc), error=exc, fire=True)
             self._cond.notify_all()
         self._flush_journal()
 
     # -- replica protocol ------------------------------------------------
-    def _sync(self, rep: _Replica, completed, idle: bool,
+    def _sync(self, rep: _Replica, completed, progress, idle: bool,
               summary: Optional[Set[int]],
               stats: Optional[dict]):  # thread: replica
         """One replica scheduler handshake: report completions (fenced
-        + deduped), heartbeat, absorb the pool summary, pick up new
-        work. Returns ("stop", []) when this replica object is no
-        longer the registered incarnation (fenced zombie, closing
-        fleet) — the loop must exit. May raise `_KillDrill`."""
-        ret = self._sync_locked(rep, completed, idle, summary, stats)
+        + deduped) and incremental token progress (fenced the same
+        way, batched into flush-deferred journal records), heartbeat,
+        absorb the pool summary, pick up new work and cancellations.
+        The 4th element of the return asks the replica to RESEND its
+        pool summary even though the pool revision is unchanged (the
+        post-restore refresh). Returns ("stop", [], [], False) when
+        this replica object is no longer the registered incarnation
+        (fenced zombie, closing fleet) — the loop must exit. May raise
+        `_KillDrill`."""
+        ret = self._sync_locked(rep, completed, progress, idle, summary,
+                                stats)
         self._flush_journal()
         return ret
 
-    def _sync_locked(self, rep: _Replica, completed, idle: bool,
+    def _sync_locked(self, rep: _Replica, completed, progress, idle: bool,
                      summary: Optional[Set[int]],
                      stats: Optional[dict]):  # thread: replica
         with self._cond:
             i = rep.index
             current = (self._replicas[i] is rep
                        and self._state[i] != _DEAD)
-            for rid, tokens in completed:
-                self._accept(rid, tokens, rep, accepted=current)
+            if current:
+                self._beats[i] = time.monotonic()
+                if stats is not None:
+                    # stored BEFORE completions are judged: a probe
+                    # completion in this batch must be scored against
+                    # the step-latency EWMA that rode the SAME
+                    # handshake, not the previous one's snapshot
+                    self._rep_stats[i] = stats
+                self._absorb_progress(rep, progress)
+            for rid, tokens, reason in completed:
+                self._accept(rid, tokens, reason, rep, accepted=current)
             if not current or self._closing:
-                return "stop", []
-            self._beats[i] = time.monotonic()
-            if stats is not None:
-                self._rep_stats[i] = stats
+                return "stop", [], [], False
             if summary is not None:
                 self._summaries[i] = summary
             if self._kill[i]:
@@ -863,24 +1434,72 @@ class ServingFleet(object):
                 # parked: wait for refill/close; the monitor exempts
                 # DRAINED replicas from the heartbeat deadline
                 self._cond.wait(timeout=self._idle_wait_s)
-                return "park", []
+                return "park", [], [], False
+            resync = self._want_summary[i]
+            if resync:
+                self._want_summary[i] = False
+            cancels = list(self._cancels[i])
+            self._cancels[i].clear()
             work: List[FleetHandle] = []
+            now = time.monotonic()
             q = self._inbox[i]
             while q:
                 h = q.popleft()
+                if not h._probe and h.deadline_at is not None \
+                        and now >= h.deadline_at:
+                    # the ROUTING hop's deadline check: the budget died
+                    # in the inbox — verdict now, zero engine steps
+                    self._expire_locked(h)
+                    continue
                 self._in_flight[i][h.rid] = h
                 work.append(h)
-            if not work and idle:
+            if not work and not cancels and idle:
                 # nothing to do: sleep on the condition (bounded, so
                 # heartbeats keep flowing) instead of spinning
                 self._cond.wait(timeout=self._idle_wait_s)
-            return "run", work
+            return "run", work, cancels, resync
 
-    def _accept(self, rid: int, tokens: List[int], rep: _Replica,
-                accepted: bool):
+    def _absorb_progress(self, rep: _Replica, progress):
+        """Journal incremental emitted tokens (caller holds `_cond`;
+        the file records are deferred to the post-lock flush). FENCED
+        like completions: only the journal-assigned holder's progress
+        counts — a demoted replica racing its hedged survivor must not
+        interleave tokens into the mirror the survivor resumes from."""
+        for rid, delta in progress:
+            h = self._handles.get(rid)
+            if h is None or h.done or h._probe:
+                continue
+            a = self._journal.assigned_to(rid)
+            if a is None or a[0] != rep.name or a[1] != rep.incarnation:
+                continue  # stale holder: journal fence refuses
+            if rid not in self._in_flight[rep.index]:
+                # clawed back (demotion hedge) and possibly routed BACK
+                # here under a bumped generation still in the inbox:
+                # the journal names this replica again, but this delta
+                # is from the superseded submission — the mirror the
+                # new holder resumes from must not absorb it
+                continue
+            self._pending_journal.append(self._journal.progress(
+                rid, rep.name, rep.incarnation, h.generation, delta,
+                defer=True))
+            h.emitted += len(delta)
+            if h.ttft_s is None:  # fleet-level TTFT: first journaled token
+                h.ttft_s = time.monotonic() - h._submit_t
+
+    def _accept(self, rid: int, tokens: List[int], reason: str,
+                rep: _Replica, accepted: bool):
         """Completion fence + dedupe (caller holds `_cond`): refuse a
-        dead/superseded replica's late result, refuse a second answer
-        for an already-done rid."""
+        dead/superseded replica's late result, refuse a STALE holder's
+        result (the journal's latest assignment is the lease — a
+        demoted replica racing the survivor its work was hedged to
+        loses, exactly like a zombie lease-holder), refuse a second
+        answer for an already-done rid. `tokens` are the reporting
+        incarnation's NEWLY generated tokens; the resumed prefix is
+        prepended here so the caller always sees the full output."""
+        if rid < 0:  # internal health probe: never journaled
+            self._in_flight[rep.index].pop(rid, None)
+            self._probe_done(rep, completed_ok=accepted)
+            return
         if not accepted:
             self.zombie_refused += 1
             return
@@ -891,6 +1510,26 @@ class ServingFleet(object):
         if h is None or h.done:
             self.duplicate_refused += 1
             return
+        a = self._journal.assigned_to(rid)
+        if a is not None and (a[0] != rep.name or a[1] != rep.incarnation):
+            # hedged elsewhere: this holder's lease is stale
+            self.zombie_refused += 1
+            return
+        if rid not in self._in_flight[rep.index]:
+            # the (replica, incarnation) pair can RE-match after a
+            # demote -> survivor-death -> route-back-to-demoted cycle:
+            # the journal's latest assignment names this replica again
+            # while the bumped-generation copy is still in its inbox
+            # (inboxes drain AFTER completions in this handshake). A
+            # report for work the fleet does not track in-flight here
+            # is from the superseded submission — accepting it would
+            # prepend h.resume to tokens that already contain it
+            self.zombie_refused += 1
+            return
+        full = list(h.resume) + list(tokens)
+        if reason == "expired":
+            self._expire_locked(h, tokens=full)
+            return
         self._done_rids.add(rid)
         self._in_flight[rep.index].pop(rid, None)
         self._open.discard(rid)
@@ -899,14 +1538,41 @@ class ServingFleet(object):
         # it ever served — _done_rids (ints) carries the dedupe
         self._handles.pop(rid, None)
         self._pending_journal.append(self._journal.complete(
-            rid, rep.name, rep.incarnation, h.generation, tokens,
+            rid, rep.name, rep.incarnation, h.generation, full,
             defer=True))
-        h.tokens = list(tokens)
+        h.tokens = full
         h.replica = rep.name
         # the event fires in _flush_journal, AFTER the done record is
         # on disk — result() observers get read-your-writes recovery
         self._pending_events.append(h)
         self.completed += 1
+        self._cond.notify_all()
+
+    def _expire_locked(self, h: FleetHandle, tokens=None):
+        """Terminal `expired` verdict for an open request (caller holds
+        `_cond`): the deadline died — journal it, fail the handle with
+        `DeadlineExceeded`, stop spending anything on it. A verdict,
+        never a silent hang (ISSUE 8)."""
+        rid = h.rid
+        if h.done or rid in self._done_rids:
+            return
+        toks = (list(tokens) if tokens is not None
+                else self._journal.progress_of(rid))
+        h.error = DeadlineExceeded(
+            "request %d expired with %d/%d token(s) emitted "
+            "(deadline_s=%r)" % (
+                rid, len(toks), h.spec["max_new_tokens"],
+                h.spec.get("deadline_s")),
+            rid=rid, tokens=toks)
+        self._done_rids.add(rid)
+        self._open.discard(rid)
+        self._handles.pop(rid, None)
+        for fl in self._in_flight:
+            fl.pop(rid, None)
+        self.expired += 1
+        self._pending_journal.append(self._journal.expire(
+            rid, toks, defer=True))
+        self._pending_events.append(h)
         self._cond.notify_all()
 
     def _on_crash(self, rep: _Replica, exc: BaseException):  # thread: replica
@@ -930,8 +1596,8 @@ class ServingFleet(object):
         st = self._rep_stats[i]
         if st:
             for k, v in st.items():
-                if k == "kv_blocks_in_use":
-                    continue  # gauge: a dead replica's pool is gone
+                if k in _GAUGE_STATS:
+                    continue  # gauges: die with the incarnation
                 self._stats_base[k] = self._stats_base.get(k, 0) + v
         self._rep_stats[i] = None
         # rapid-death accounting gates auto_refill (exponential
@@ -944,19 +1610,91 @@ class ServingFleet(object):
             5.0, 0.05 * (2 ** self._rapid[i]))
         self._inbox[i].clear()
         self._in_flight[i].clear()
+        self._cancels[i].clear()
+        self._slow_since[i] = None
+        self._watermark[i] = None
+        self._rate[i] = None
+        self._stall_since[i] = None
+        # an outstanding health probe dies with the replica (it was
+        # never journaled — nothing to recover); release its handle so
+        # repeated probe-interrupted deaths cannot accumulate them
+        if self._probes[i] is not None:
+            self._handles.pop(self._probes[i].rid, None)
+            self._probes[i]._event.set()
+            self._probes[i] = None
+        self._probe_ok[i] = 0
+        self._want_summary[i] = False  # a fresh incarnation sends anew
         # the JOURNAL is the recovery source: every open request whose
-        # latest assignment names this replica+incarnation
-        for rid, _spec, _gen in self._journal.lost(rep.name, rep.incarnation):
+        # latest assignment names this replica+incarnation, resumed
+        # from its journaled progress — the survivor prefill-aliases
+        # the emitted prefix and re-decodes NOTHING
+        self._resubmit_lost(i, rep)
+        self._cond.notify_all()
+
+    @staticmethod
+    def _finished_in_journal(spec: dict, toks: List[int]) -> bool:
+        """True when a journaled emitted-token prefix already satisfies
+        the request (budget reached, or `eos_id` emitted): completing
+        it needs zero engine work."""
+        if not toks:
+            return False
+        eos = spec["eos_id"]
+        return (len(toks) >= int(spec["max_new_tokens"])
+                or (eos is not None and toks[-1] == int(eos)))
+
+    def _complete_from_progress(self, h: FleetHandle, toks: List[int],
+                                replica: str, incarnation: int):
+        """Terminal completion straight from journaled progress (caller
+        holds `_cond`): a lost holder — a dead incarnation, or a
+        crashed front door on restart — actually FINISHED the request
+        and only its done record was lost. No engine steps are spent,
+        no token is re-decoded."""
+        rid = h.rid
+        self._done_rids.add(rid)
+        self._open.discard(rid)
+        self._handles.pop(rid, None)
+        self._pending_journal.append(self._journal.complete(
+            rid, replica, incarnation, h.generation, list(toks),
+            defer=True))
+        h.tokens = list(toks)
+        h.emitted = len(toks)
+        h.replica = replica
+        self._pending_events.append(h)
+        self.completed += 1
+
+    def _resubmit_lost(self, i: int, rep: _Replica, lost=None):
+        """Hedge/recover every open request the journal assigns to
+        (rep, incarnation) onto survivors, carrying the emitted-token
+        prefix (caller holds `_cond`). `lost` lets a caller that
+        already scanned the journal (demotion builds its cancel set
+        from the same list) pass the result in instead of paying the
+        O(open x emitted) copy twice under `_cond`."""
+        if lost is None:
+            lost = self._journal.lost(rep.name, rep.incarnation)
+        for rid, _spec, _gen, toks in lost:
             h = self._handles.get(rid)
             if h is None or h.done:
                 continue
+            if h.deadline_at is not None \
+                    and time.monotonic() >= h.deadline_at:
+                # already out of budget: expiring NOW is the verdict —
+                # resubmitting would spend survivor steps on a corpse
+                self._expire_locked(h, tokens=toks)
+                continue
+            if self._finished_in_journal(h.spec, toks):
+                self._complete_from_progress(
+                    h, toks, rep.name, rep.incarnation)
+                continue
             h.generation += 1
+            h.resume = list(toks)  # replace wholesale, never mutate
             self.resubmitted += 1
+            if toks:
+                self.resumed_requests += 1
+                self.resumed_tokens += len(toks)
             try:
                 self._route(h, exclude=i)
             except EngineFailed:
                 pass  # no survivors: handle already failed by _route
-        self._cond.notify_all()
 
     def _monitor_loop(self):  # thread: monitor
         while True:
@@ -965,8 +1703,10 @@ class ServingFleet(object):
                     return
                 now = time.monotonic()
                 for i, rep in enumerate(self._replicas):
-                    if self._state[i] in (_LIVE, _DRAINING) \
+                    if self._state[i] in (_LIVE, _DRAINING, _DEMOTED) \
                             and now - self._beats[i] > self.heartbeat_timeout_s:
+                        # gray shades into black: a demoted replica
+                        # that stops even heartbeating is plain dead
                         self._fail_over(
                             i, rep,
                             TimeoutError(
@@ -976,8 +1716,225 @@ class ServingFleet(object):
                     elif self._state[i] == _DEAD and self.auto_refill \
                             and now >= self._refill_at[i]:
                         self._refill_locked(i)
+                if self.slow_replica_factor is not None:
+                    self._health_sweep(now)
             self._flush_journal()  # fail-over resubmissions above
             time.sleep(self._monitor_interval_s)
+
+    # -- gray-failure detection (ISSUE 8) --------------------------------
+    def _live_ewmas(self) -> List[float]:  # holds: _cond
+        out = []
+        for i in range(self.n_replicas):
+            st = self._rep_stats[i]
+            if self._state[i] == _LIVE and st \
+                    and st.get("step_ewma_s", 0.0) > 0.0:
+                out.append(float(st["step_ewma_s"]))
+        return out
+
+    def _health_sweep(self, now: float):  # thread: monitor, holds: _cond
+        """Score every live replica against the fleet. The health score
+        combines BOTH ISSUE 8 signals, and demotion needs both to
+        agree: (a) step-latency EWMA past `slow_replica_factor` x the
+        live (lower) median — necessary but NOT sufficient, because a
+        replica carrying more slots / prefill chunks / GIL contention
+        has honestly longer steps; (b) the decode-progress WATERMARK
+        (tokens emitted per wall-second, sampled over >= 0.15 s
+        windows) below the live median by the same factor — a busy
+        replica still emitting at fleet-comparable rate is never
+        demoted, however long its steps look. A watermark FLAT for the
+        whole hysteresis window while busy is gray on its own (the
+        wedged-but-syncing shape). Sustained past `slow_min_duration_s`
+        (one GC pause decays out of the EWMA in a few healthy steps
+        and resets the clock), the replica is demoted: drained +
+        probed, not killed. Demoted replicas are probed on
+        `probe_interval_s` until healthy, then restored — same
+        incarnation, warm pool."""
+        ewmas = self._live_ewmas()
+        median = _lower_median(ewmas)
+        rate_window = max(0.15, 2.0 * self._monitor_interval_s)
+        rates = [self._rate[i] for i in range(self.n_replicas)
+                 if self._state[i] == _LIVE and self._rate[i] is not None]
+        median_rate = _upper_median(rates)
+        for i in range(self.n_replicas):
+            st = self._rep_stats[i]
+            if self._state[i] == _DEMOTED:
+                if self._probes[i] is None and now >= self._probe_at[i]:
+                    self._send_probe_locked(i)
+                continue
+            if self._state[i] != _LIVE or not st:
+                continue
+            # judge only FRESH evidence: _rep_stats is a snapshot from
+            # the replica's last handshake. A replica silent inside one
+            # long step (a first compile — the documented
+            # false-demotion hazard) freezes busy/tokens/EWMA; scoring
+            # that stale picture would demote it for compiling. A
+            # replica that stays silent past the window here simply
+            # isn't judged (the heartbeat deadline owns total silence);
+            # a GRAY replica still syncs every (stalled) step, so it
+            # keeps producing fresh evidence and IS judged. The window
+            # is 2x the hysteresis duration: a gray step is the stall
+            # PLUS real compute, and a gate at exactly
+            # slow_min_duration_s would discard evidence from a gray
+            # replica whose stalled steps run just past it — while a
+            # compile (seconds) stays far beyond 2x.
+            if now - self._beats[i] > 2.0 * self.slow_min_duration_s:
+                self._slow_since[i] = None
+                self._watermark[i] = None
+                self._rate[i] = None
+                self._stall_since[i] = None
+                continue
+            # the progress counter includes PREFILL work: a replica
+            # grinding a long prompt through chunks emits no tokens
+            # for a while but is making honest progress — counting
+            # only emissions would read the prefill phase as a stall
+            # (and bias the rate veto against prefill-heavy replicas)
+            tokens = int(st.get("tokens_out", 0)) \
+                + int(st.get("prefill_tokens_computed", 0))
+            busy = bool(st.get("busy"))
+            stalled = False
+            if busy:
+                wm = self._watermark[i]
+                if wm is None:
+                    self._watermark[i] = (now, tokens)
+                elif now - wm[0] >= rate_window \
+                        and self._beats[i] > wm[0]:
+                    # sample only when the replica SYNCED since the
+                    # last sample: flat progress across syncs is a
+                    # stall; silence (one long step — a compile) is
+                    # not evidence of anything, and when the sync
+                    # finally lands the token jump clears the flag
+                    self._rate[i] = (tokens - wm[1]) / (now - wm[0])
+                    if tokens <= wm[1]:
+                        if self._stall_since[i] is None:
+                            self._stall_since[i] = wm[0]
+                        stalled = (now - self._stall_since[i]
+                                   >= self.slow_min_duration_s)
+                    else:
+                        self._stall_since[i] = None
+                    self._watermark[i] = (now, tokens)
+            else:
+                self._watermark[i] = None
+                self._rate[i] = None
+                self._stall_since[i] = None
+            ewma = float(st.get("step_ewma_s", 0.0))
+            ewma_slow = (busy and median is not None and len(ewmas) >= 2
+                         and ewma > self.slow_replica_factor * median)
+            # rate agreement: a fleet-comparable emission rate VETOES
+            # the latency signal (longer steps are honest when the
+            # replica carries more slots / prefill chunks / host
+            # contention). With fewer than two live samples there is
+            # no reference — stay permissive and let the EWMA decide
+            rate_poor = (len(rates) < 2 or self._rate[i] is None
+                         or median_rate <= 0.0
+                         or self._rate[i]
+                         < median_rate / self.slow_replica_factor)
+            if (ewma_slow and rate_poor) or stalled:
+                if self._slow_since[i] is None:
+                    self._slow_since[i] = now
+                if now - self._slow_since[i] >= self.slow_min_duration_s \
+                        or stalled:
+                    self._demote_locked(i)
+            else:
+                self._slow_since[i] = None
+
+    def _demote_locked(self, i: int):  # holds: _cond
+        """Demote a gray replica: hedge its open requests to survivors
+        (token-level resume — decode steps already spent are never
+        re-spent), tell it to CANCEL the hedged work, keep it alive
+        and warm, and start probing. Never demote the last live
+        replica: slow beats dead."""
+        survivors = [j for j in range(self.n_replicas)
+                     if j != i and self._state[j] == _LIVE]
+        if not survivors:
+            self._slow_since[i] = None  # re-judged when the fleet heals
+            return
+        rep = self._replicas[i]
+        self._state[i] = _DEMOTED
+        self.demotions += 1
+        self._summaries[i] = set()  # don't route by a parked pool
+        self._slow_since[i] = None
+        self._watermark[i] = None
+        self._rate[i] = None
+        self._stall_since[i] = None
+        self._inbox[i].clear()
+        # every open request the journal assigns here is hedged away;
+        # the replica cancels them at its next handshake, and the
+        # journal assignment fence refuses anything it still reports
+        self._cancels[i].update(self._in_flight[i].keys())
+        lost = self._journal.lost(rep.name, rep.incarnation)
+        self._cancels[i].update(rid for rid, _s, _g, _t in lost)
+        self._in_flight[i].clear()
+        self._resubmit_lost(i, rep, lost=lost)
+        self._probe_ok[i] = 0
+        self._probe_at[i] = time.monotonic() + self.probe_interval_s
+        self._cond.notify_all()
+
+    def _send_probe_locked(self, i: int):  # holds: _cond
+        """Ship a tiny internal generate request to a DEMOTED replica:
+        its completion (and the step-latency EWMA that rides the same
+        handshake) is the restore evidence. Probes use negative rids,
+        are never journaled, and never touch the open-request set."""
+        rid = self._next_probe_rid
+        self._next_probe_rid -= 1
+        prompt = np.zeros(1, np.int32)
+        # the probe must pass THIS replica's engine admission rules:
+        # a probe refused at admission is a failed probe, and sizing
+        # from the base kw (or a hardcoded size) would permanently
+        # fail on a replica whose engine_kw_for override shrinks the
+        # context/pool below the fleet-wide default
+        rep = self._replicas[i]
+        L, bt, pb = self._limits_for(
+            rep._engine_kw if rep is not None else self._engine_kw)
+        max_new = max(1, min(6, L - 1, bt * pb - 1))
+        spec = {"prompt": [0], "max_new_tokens": max_new,
+                "temperature": 0.0,
+                "eos_id": None, "seed": 0, "publish_len": 0,
+                "slo": None, "deadline_s": None, "submit_unix": time.time()}
+        h = FleetHandle(rid, prompt, spec, None, fleet=self)
+        h._probe = True
+        self._handles[rid] = h
+        self._probes[i] = h
+        self.probes_sent += 1
+        self._inbox[i].append(h)
+        self._cond.notify_all()
+
+    def _probe_done(self, rep: _Replica, completed_ok: bool):  # holds: _cond
+        """A probe came back: restore the replica if its step EWMA is
+        back inside the healthy band (vs the live-fleet median), else
+        schedule the next probe. `probe_ok_needed` consecutive healthy
+        probes gate the restore (hysteresis on the way back too)."""
+        i = rep.index
+        h = self._probes[i]
+        if h is None or self._replicas[i] is not rep \
+                or self._state[i] != _DEMOTED:
+            return
+        self._probes[i] = None
+        self._handles.pop(h.rid, None)
+        h._event.set()  # nobody waits, but keep the future honest
+        st = self._rep_stats[i] or {}
+        ewma = float(st.get("step_ewma_s", 0.0))
+        median = _lower_median(self._live_ewmas())
+        healthy = completed_ok and (
+            median is None  # no live peer to compare against: restore
+            or ewma <= self.slow_replica_factor * median)
+        if healthy:
+            self._probe_ok[i] += 1
+            if self._probe_ok[i] >= self.probe_ok_needed:
+                # restored: SAME incarnation, engine + prefix pool warm
+                self._state[i] = _LIVE
+                self.restores += 1
+                self._probe_ok[i] = 0
+                self._beats[i] = time.monotonic()
+                # demotion cleared the routing summary; the pool is
+                # warm and UNCHANGED, so the replica's revision cache
+                # would never resend it — ask for a refresh or the
+                # warm-restore benefit is silently lost to routing
+                self._want_summary[i] = True
+                self._cond.notify_all()
+                return
+        else:
+            self._probe_ok[i] = 0
+        self._probe_at[i] = time.monotonic() + self.probe_interval_s
 
     # -- operator surface ------------------------------------------------
     def kill_replica(self, i: int):
@@ -1052,6 +2009,38 @@ class ServingFleet(object):
         rep.start()
         self._cond.notify_all()
 
+    def _describe(self, rid: int) -> dict:
+        """Operator context for one request (FleetTimeout satellite):
+        journal state (queued / assigned / decoding / terminal), the
+        replica holding the latest assignment, and tokens emitted."""
+        with self._cond:
+            emitted = len(self._journal.progress_of(rid))
+            a = self._journal.assigned_to(rid)
+            replica = a[0] if a else None
+            if rid in self._done_rids:
+                state = "terminal"
+            elif any(h.rid == rid for q in self._inbox for h in q):
+                state = "queued"
+            elif any(rid in fl for fl in self._in_flight):
+                state = "decoding" if emitted else "assigned"
+            elif rid in self._open:
+                state = "open"
+            else:
+                state = "unknown"
+            rep_state = None
+            if a is not None:
+                for i, rep in enumerate(self._replicas):
+                    if rep.name == a[0]:
+                        rep_state = self._state[i]
+                        break
+            desc = "journal state: %s" % state
+            if replica is not None:
+                desc += ", assigned to %s (incarnation %d, gen %d%s)" % (
+                    a[0], a[1], a[2],
+                    "" if rep_state is None else ", replica %s" % rep_state)
+            return {"state": state, "replica": replica,
+                    "tokens_emitted": emitted, "describe": desc}
+
     def wait_all(self, timeout: Optional[float] = None) -> bool:
         """Block until no request is open (completed, rejected, or
         failed). Returns False on timeout."""
@@ -1103,13 +2092,20 @@ class ServingFleet(object):
                 "completed": self.completed,
                 "shed": self.shed,
                 "rejected": self.rejected,
+                "expired": self.expired,
+                "expired_on_arrival": self.expired_on_arrival,
                 "resubmitted": self.resubmitted,
                 "failovers": self.failovers,
                 "zombie_refused": self.zombie_refused,
                 "duplicate_refused": self.duplicate_refused,
+                "demotions": self.demotions,
+                "restores": self.restores,
+                "probes_sent": self.probes_sent,
+                "resumed_requests": self.resumed_requests,
+                "resumed_tokens": self.resumed_tokens,
                 "open": len(self._open),
                 "lost": self.submitted - self.completed - self.rejected
-                - len(self._open),
+                - self.expired - len(self._open),
                 "tokens_out": tokens_out,
                 "prefill_tokens_computed": prefill_tok,
                 "prefix_hit_rate": round(hits / total, 4) if total else None,
@@ -1126,19 +2122,27 @@ class ServingFleet(object):
     def close(self, timeout: float = 10.0):
         """Stop every replica and the monitor; fail any still-open
         handle with `EngineFailed` (their waiters must not block on a
-        dead fleet)."""
+        dead fleet) and write it a TERMINAL journal record — the
+        journal invariant (ISSUE 8): after close, every journaled rid
+        is done, rejected, or expired; none is ever silently open."""
         with self._cond:
             if self._closing:
                 return
             self._closing = True
             for rid in list(self._open):
-                h = self._handles.get(rid)
-                if h is not None and not h.done:
-                    h.error = EngineFailed(
+                h = self._reject_locked(
+                    rid, "fleet closed",
+                    error=EngineFailed(
                         "fleet closed with request %d pending" % rid,
-                        replica=None)
-                    h._event.set()
+                        replica=None))
+                if h is not None and not h.done:
+                    h._event.set()  # waiters must not block on a dead fleet
             self._open.clear()
+            for i, ph in enumerate(self._probes):
+                if ph is not None:  # outstanding probes die unjournaled
+                    self._handles.pop(ph.rid, None)
+                    ph._event.set()
+                    self._probes[i] = None
             self._cond.notify_all()
         self._monitor.join(timeout=timeout)
         for rep in list(self._replicas):

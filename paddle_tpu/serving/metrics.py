@@ -65,8 +65,29 @@ class ServingMetrics(object):
         self.spec_windows = 0             # cumulative verify rows run
         self.spec_drafted = 0             # cumulative drafted tokens
         self.spec_accepted = 0            # cumulative drafts emitted
+        # PR 8 counters — request-SLO layer (deadlines, gray-failure
+        # demotion, token-level resume), same O(1) discipline.
+        self.expired = 0                  # cumulative deadline verdicts
+        self.cancelled = 0                # cumulative fleet cancels
+        self.resumed_requests = 0         # cumulative token-level resumes
+        self.resume_tokens_reused = 0     # cumulative tokens NOT re-decoded
+        # EWMA of ServingEngine.step() wall time (gauge; includes the
+        # injector tick, so an injected gray stall is visible here —
+        # that is the point: this gauge feeds the fleet's slow-replica
+        # health score). 0.0 until the first step.
+        self.step_ewma_s = 0.0
         self._t0 = None
         self._t1 = None
+
+    STEP_EWMA_ALPHA = 0.5  # fast decay: ~3 healthy steps erase a spike
+
+    def observe_step(self, seconds: float):
+        """Fold one engine-step wall time into the step-latency EWMA."""
+        a = self.STEP_EWMA_ALPHA
+        if self.step_ewma_s == 0.0:
+            self.step_ewma_s = seconds
+        else:
+            self.step_ewma_s = a * seconds + (1.0 - a) * self.step_ewma_s
 
     # -- recording ------------------------------------------------------
     def count_trace(self, name: str):
@@ -131,6 +152,11 @@ class ServingMetrics(object):
             "spec_accept_rate": round(
                 self.spec_accepted / self.spec_drafted, 4)
             if self.spec_drafted else None,
+            "expired": self.expired,
+            "cancelled": self.cancelled,
+            "resumed_requests": self.resumed_requests,
+            "resume_tokens_reused": self.resume_tokens_reused,
+            "step_ewma_s": round(self.step_ewma_s, 6),
         }
         if self.prefix_cache is not None:
             rep["prefix_cache"] = self.prefix_cache.stats()

@@ -430,6 +430,45 @@ def test_serving_paged_workload_contract():
     assert rec["peak_kv_blocks_in_use"] <= rec["kv_pool_blocks"]
 
 
+def test_serving_slo_workload_contract():
+    """ISSUE 8 acceptance: the `serving_slo` row cannot decay into a
+    no-op — on the fixed-seed Poisson trace of deadline-carrying
+    interactive requests, ZERO requests expire under the gray-slow
+    drill (the slowed replica is demoted and its work hedged to
+    survivors with token-level resume), resumed requests re-decode
+    zero already-emitted tokens (the bench audits the journal: per
+    rid, progress deltas concatenate EXACTLY to the done record — a
+    re-decoded token would appear twice — and raises otherwise), the
+    replica is probed and restored under the SAME incarnation (warm
+    pool, no fresh spawn), and the bench itself raises unless outputs
+    are token-identical between the healthy and gray runs."""
+    rec = bench.bench_serving_slo(n_requests=8)
+    assert rec["expired_healthy"] == 0, rec
+    assert rec["expired_gray"] == 0, rec
+    assert rec["requests_lost"] == 0, rec
+    assert rec["demotions_gray"] >= 1, rec
+    assert rec["restores_gray"] >= 1, rec
+    assert rec["restored_same_incarnation"], rec
+    # token-level resume actually ran, and the journal audit (which
+    # hard-raises on any re-decoded token) saw the multi-holder rids
+    assert rec["resumed_requests"] >= 1, rec
+    assert rec["resumed_rids_journal"] >= 1, rec
+    assert rec["redecoded_tokens"] == 0, rec
+    # the tail bound: gray p99 TTFT within healthy + the slow window
+    assert rec["p99_ttft_gray_s"] is not None
+    assert rec["p99_ttft_gray_s"] < \
+        rec["p99_ttft_healthy_s"] + rec["p99_ttft_excess_bound_s"], rec
+
+
+def test_serving_slo_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_slo", bench_serving_slo' in src
+
+
 def test_serving_paged_registered_in_bench_main():
     """The workload is wired into bench.main()'s side-workload list
     (the registration is what lands it in the driver's record)."""

@@ -105,6 +105,38 @@ def test_netsplit_fault_opens_window_and_drops_connections():
         server.stop()
 
 
+def test_slow_fault_gray_window():
+    """slow@N:dur[/per] (ISSUE 8): from step N every tick COMPLETES
+    but stalls `per` seconds, until `dur` wall-seconds pass — a gray
+    failure: liveness checks see progress, latency targets die. The
+    deterministic driver for the serving fleet's demotion drills."""
+    import time
+
+    inj = fi.FaultInjector("slow@2:0.4/0.08")
+    t0 = time.monotonic()
+    inj.tick()  # before the window: fast
+    assert time.monotonic() - t0 < 0.05 and not inj.slowed
+    t1 = time.monotonic()
+    inj.tick()  # window opens: this tick already stalls
+    inj.tick()
+    assert inj.slowed and time.monotonic() - t1 >= 0.16
+    time.sleep(0.4)
+    assert not inj.slowed  # window closed: healthy again
+    t2 = time.monotonic()
+    inj.tick()
+    assert time.monotonic() - t2 < 0.05
+    # a bad dur/per fails at parse time, not N steps later — including
+    # signs (time.sleep(-x) would crash the serving step mid-drill)
+    with pytest.raises(ValueError):
+        fi.FaultInjector("slow@2:forever")
+    with pytest.raises(ValueError):
+        fi.FaultInjector("slow@2:1.0/x")
+    with pytest.raises(ValueError):
+        fi.FaultInjector("slow@2:-1.0")
+    with pytest.raises(ValueError):
+        fi.FaultInjector("slow@2:1.0/-0.1")
+
+
 def test_hang_and_netsplit_spec_parsing():
     # hang parses (do NOT tick to its step — it spins forever)
     inj = fi.FaultInjector("hang@7")

@@ -87,7 +87,7 @@ def test_journal_lifecycle_and_recovery(tmp_path):
     j.assign(2, "r1", 1, 0)
     j.complete(0, "r0", 1, 0, [7, 8])
     # r0 died: its open assignments (and only those) are the lost set
-    assert [(rid, g) for rid, _s, g in j.lost("r0", 1)] == [(1, 0)]
+    assert [(rid, g) for rid, _s, g, _t in j.lost("r0", 1)] == [(1, 0)]
     # resubmitted to r1 under a bumped generation
     j.assign(1, "r1", 1, 1)
     assert j.lost("r0", 1) == []

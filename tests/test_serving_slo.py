@@ -1,0 +1,1022 @@
+"""Gray-failure tolerance + request-SLO layer (ISSUE 8,
+paddle_tpu/serving — fleet.py/engine.py, distributed/fault_injection.py):
+
+* Per-request deadlines — journaled with the spec, enforced at every
+  queue hop (submit, routing, prefill chunk, decode); expiry is a
+  terminal journal VERDICT (`expired`), surfaced as `DeadlineExceeded`,
+  and the scheduler stops spending decode steps the moment the budget
+  dies. A deadline dead on arrival is refused BEFORE the
+  `FleetSaturated` shed (overload metrics never absorb client-side
+  lateness — the ISSUE 8 fix).
+* Token-level resume — emitted tokens are journaled incrementally
+  (batched, flush-deferred); failover/demotion resubmits
+  prompt + emitted to survivors, which prefill (aliasing what the pool
+  holds) and re-decode ZERO already-emitted tokens, with the sampling
+  key schedule continued at the resume index — outputs token-identical
+  to an uninterrupted run, greedy and sampled.
+* Gray-failure demotion — a replica that heartbeats but stalls
+  (slow@N:dur fault: every step completes, late) is demoted on a
+  step-latency-EWMA health score with hysteresis, its work hedged to
+  survivors, then probed and RESTORED under the same incarnation (warm
+  pool, no fresh spawn); a single transient pause must not flap it.
+* Chaos drill matrix — exc/delay/slow faults against the fleet, all
+  holding the journal invariant: after close, every journaled rid is
+  terminal (done / rejected / expired), never silently open.
+* Journal compaction — the file rewrites down to meta + the open set
+  on the rotation threshold; recover()/reopen see identical state.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.fault_injection import FaultInjector
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serving import (
+    DeadlineExceeded,
+    FleetSaturated,
+    FleetTimeout,
+    RequestJournal,
+    ServingEngine,
+    ServingFleet,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = T.TransformerConfig(vocab=64, dim=32, heads=4, layers=2,
+                              max_len=64)
+    return cfg, T.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _oracle(params, cfg, prompt, max_new):
+    return np.asarray(
+        T.generate(params, jnp.asarray(prompt)[None], cfg, max_new)
+    )[0]
+
+
+def _requests(cfg, n=5, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        t = int(rng.randint(4, 13))
+        out.append((rng.randint(0, cfg.vocab, (t,)).astype(np.int32),
+                    int(rng.randint(8, 13))))
+    return out
+
+
+def _warm_all_buckets(fleet, cfg, n_replicas=2):
+    """Compile every shape the drills can hit on EVERY replica before
+    any fault is armed or any health judgement runs (the README sizing
+    rule: a first compile is one long silent step, indistinguishable
+    from gray slowness from outside). _requests prompts are 4..12
+    tokens -> pow-2 prefill buckets 8 and 16; one wave per bucket,
+    n_replicas concurrent requests each, spread by least-loaded
+    routing."""
+    for L in (8, 16):
+        ws = [fleet.submit(np.arange(1, L + 1, dtype=np.int32), 4,
+                           seed=k) for k in range(n_replicas)]
+        for h in ws:
+            h.result(timeout=180)
+    time.sleep(0.3)  # EWMAs settle post-compile
+
+
+# ---------------------------------------------------------------------------
+# engine: deadlines, resume, cancel
+# (the slow@ fault kind itself is pinned in test_fault_injection.py)
+# ---------------------------------------------------------------------------
+
+def test_engine_expires_at_every_hop_and_stops_decoding(model):
+    """A queued request with a spent deadline expires before admission;
+    a decoding one expires before the next batched step — and the
+    engine stops spending decode steps on it (the counter freezes)."""
+    cfg, params = model
+    eng = ServingEngine(params, cfg, max_slots=1)
+    # queued expiry: deadline already dead at the first step
+    h = eng.submit(np.arange(1, 6, dtype=np.int32), 10,
+                   deadline_at=time.monotonic() - 1.0)
+    eng.step()
+    assert h.done and h.finish_reason == "expired" and h.tokens == []
+    assert eng.metrics.expired == 1
+    # decode expiry: budget dies mid-generation
+    h2 = eng.submit(np.arange(1, 6, dtype=np.int32), 50,
+                    deadline_at=time.monotonic() + 0.2)
+    while not h2.done:
+        assert eng.step()
+    assert h2.finish_reason == "expired"
+    assert 0 < len(h2.tokens) < 50  # partial verdict, not silent hang
+    steps_at_expiry = eng.metrics.decode_steps
+    assert not eng.step()  # nothing left: no decode steps spent on it
+    assert eng.metrics.decode_steps == steps_at_expiry
+    assert eng.metrics.expired == 2
+    assert eng.kv_blocks_in_use == 0  # expiry freed the slot's blocks
+
+
+@pytest.mark.slow  # 5 engine builds; greedy resume identity is pinned
+                   # tier-1 by the serving_slo bench contract
+def test_engine_token_level_resume_identity_greedy_and_sampled(model):
+    """Resume = prompt + emitted as prefill context, key schedule
+    continued at the resume index: outputs are token-identical to the
+    uninterrupted run and the resumed engine decodes ONLY the
+    remainder (re-decode zero, by construction and by counter)."""
+    cfg, params = model
+    p = np.arange(1, 10, dtype=np.int32)
+    # (temperature, seed, resume cuts): greedy exercises the early and
+    # the maximal cut, sampled pins the fold_in schedule continuation
+    for temp, seed, cuts in ((0.0, 0, (1, 7)), (0.9, 7, (3,))):
+        eng = ServingEngine(params, cfg, max_slots=2)
+        full = eng.submit(p, 8, temperature=temp, seed=seed).result()
+        for cut in cuts:
+            eng2 = ServingEngine(params, cfg, max_slots=2)
+            resume = list(full[len(p):len(p) + cut])
+            h = eng2.submit(p, 8, temperature=temp, seed=seed,
+                            resume_tokens=resume)
+            np.testing.assert_array_equal(h.result(), full)
+            assert len(h.tokens) == 8 - cut  # only the remainder
+            assert eng2.metrics.resumed_requests == 1
+            assert eng2.metrics.resume_tokens_reused == cut
+            # the already-emitted tokens were PREFILLED, never decoded:
+            # one decode step per newly emitted token minus the
+            # prefill-emitted first token
+            assert eng2.metrics.decode_steps <= 8 - cut
+
+
+def test_engine_resume_validation_and_run_path(model):
+    cfg, params = model
+    eng = ServingEngine(params, cfg, max_slots=1)
+    with pytest.raises(ValueError):  # nothing left to decode
+        eng.submit(np.arange(1, 5, dtype=np.int32), 3,
+                   resume_tokens=[1, 2, 3])
+    # run() (not just result()) returns the FULL sequence for resumed
+    # requests — resumed tokens must not vanish from the middle
+    p = np.arange(1, 8, dtype=np.int32)
+    full = eng.submit(p, 6).result()
+    eng2 = ServingEngine(params, cfg, max_slots=1)
+    h = eng2.submit(p, 6, resume_tokens=list(full[len(p):len(p) + 2]))
+    out = eng2.run()
+    np.testing.assert_array_equal(out[h.rid], full)
+
+
+def test_engine_cancel_frees_slot_and_blocks(model):
+    cfg, params = model
+    eng = ServingEngine(params, cfg, max_slots=2)
+    p = np.arange(1, 8, dtype=np.int32)
+    want = _oracle(params, cfg, p, 6)
+    h1 = eng.submit(p, 6)
+    h2 = eng.submit(np.arange(2, 9, dtype=np.int32), 30)
+    eng.step()  # both admitted and decoding
+    assert eng.cancel(h2.rid)
+    assert h2.done and h2.finish_reason == "cancelled"
+    assert eng.metrics.cancelled == 1
+    assert not eng.cancel(h2.rid)  # already finished: no-op
+    np.testing.assert_array_equal(h1.result(), want)  # neighbor unharmed
+    assert not eng.step()
+    assert eng.kv_blocks_in_use == 0  # cancel freed its blocks
+
+
+# ---------------------------------------------------------------------------
+# fleet: deadlines end to end
+# ---------------------------------------------------------------------------
+
+def test_expired_on_arrival_beats_fleet_saturated(model):
+    """The ISSUE 8 fix: a request whose deadline is already spent is
+    refused as `DeadlineExceeded` BEFORE the max_pending shed — shed
+    metrics must not conflate overload with client-side lateness —
+    and is journaled in NEITHER case."""
+    cfg, params = model
+    fleet = ServingFleet(params, cfg, n_replicas=1, max_pending=1,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 1})
+    try:
+        p = np.arange(1, 8, dtype=np.int32)
+        a = fleet.submit(p, 30)  # fills max_pending
+        with pytest.raises(DeadlineExceeded):  # NOT FleetSaturated
+            fleet.submit(p, 5, deadline_s=0.0)
+        with pytest.raises(FleetSaturated):
+            fleet.submit(p, 5)
+        a.result(timeout=120)
+        st = fleet.stats()
+        assert st["expired_on_arrival"] == 1 and st["shed"] == 1, st
+        assert st["expired"] == 0 and st["submitted"] == 1, st
+        assert st["lost"] == 0, st
+    finally:
+        fleet.close()
+
+
+def test_fleet_deadline_expires_midflight_with_journal_verdict(model,
+                                                               tmp_path):
+    """A replica stalls (injected delay) past a request's budget: the
+    request is terminally `expired` in the journal — a verdict, never
+    a silent hang — result() raises DeadlineExceeded carrying the
+    partial tokens, and recover() sees nothing open."""
+    cfg, params = model
+    journal = str(tmp_path / "j.jsonl")
+    inj = FaultInjector("")
+    fleet = ServingFleet(params, cfg, n_replicas=1, journal_path=journal,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 1},
+                         engine_kw_for=lambda i: {"fault_injector": inj})
+    try:
+        p = np.arange(1, 8, dtype=np.int32)
+        w = fleet.submit(p, 4)  # warm: compiles before the drill
+        w.result(timeout=180)
+        inj.arm("delay@2:0.6")
+        h = fleet.submit(p, 40, deadline_s=0.25)
+        with pytest.raises(DeadlineExceeded) as ei:
+            h.result(timeout=120)
+        assert ei.value.rid == h.rid
+        st = fleet.stats()
+        assert st["expired"] == 1 and st["lost"] == 0, st
+        lines = [json.loads(l) for l in open(journal)]
+        assert any(r["kind"] == "expired" and r["rid"] == h.rid
+                   for r in lines)
+        assert RequestJournal.recover(journal) == []
+        # the fleet still serves within-budget requests afterwards
+        h2 = fleet.submit(p, 4, deadline_s=60.0)
+        np.testing.assert_array_equal(
+            h2.result(timeout=120), _oracle(params, cfg, p, 4))
+    finally:
+        fleet.close()
+
+
+def test_fleet_timeout_carries_operator_context(model):
+    """Satellite: result(timeout=) raises FleetTimeout naming the rid,
+    journal state, and assigned replica — a slow request is
+    distinguishable from a lost one."""
+    cfg, params = model
+    fleet = ServingFleet(params, cfg, n_replicas=1,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 1})
+    try:
+        p = np.arange(1, 8, dtype=np.int32)
+        h = fleet.submit(p, 30)
+        with pytest.raises(FleetTimeout) as ei:
+            h.result(timeout=0.001)
+        e = ei.value
+        assert isinstance(e, TimeoutError)  # old callers keep working
+        assert e.rid == h.rid
+        assert e.state in ("queued", "assigned", "decoding", "open")
+        assert "journal state" in str(e)
+        h.result(timeout=120)  # then it completes fine
+        assert fleet.stats()["lost"] == 0
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet: token-level resume across failover
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # two-replica fleet + kill drill; the re-decode-zero
+                   # journal audit is pinned tier-1 by the serving_slo
+                   # bench contract
+def test_failover_resumes_at_token_level_no_redecode(model, tmp_path):
+    """r0 is killed AFTER its request has journaled emitted tokens: the
+    survivor is submitted prompt + emitted, decodes only the remainder
+    (journal-audited: per rid, progress deltas concatenate EXACTLY to
+    the done record — a re-decoded token would appear twice), and the
+    output is token-identical to uninterrupted generate()."""
+    cfg, params = model
+    journal = str(tmp_path / "j.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=2, journal_path=journal,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 2})
+    try:
+        p0 = np.arange(1, 10, dtype=np.int32)
+        p1 = np.arange(2, 10, dtype=np.int32)
+        h0 = fleet.submit(p0, 12)          # least-loaded: lands on r0
+        h1 = fleet.submit(p1, 12, seed=3, temperature=0.8)  # on r1
+        deadline = time.monotonic() + 120
+        while h0.emitted < 2:  # wait for journaled progress on r0
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        fleet.kill_replica(0)
+        np.testing.assert_array_equal(
+            h0.result(timeout=180), _oracle(params, cfg, p0, 12))
+        h1.result(timeout=180)
+        st = fleet.stats()
+        assert st["failovers"] == 1 and st["lost"] == 0, st
+        assert st["resumed_requests"] >= 1, st
+        assert st["resumed_tokens"] >= 2, st
+        assert h0.replica == "r1"  # the survivor answered
+        lines = [json.loads(l) for l in open(journal)]
+        done = {r["rid"]: r["tokens"] for r in lines if r["kind"] == "done"}
+        prog, sources = {}, {}
+        for r in lines:
+            if r["kind"] == "progress":
+                prog.setdefault(r["rid"], []).extend(r["tokens"])
+                sources.setdefault(r["rid"], set()).add(
+                    (r["replica"], r["incarnation"], r["gen"]))
+        # re-decode zero: every journaled token appears exactly once
+        for rid, toks in done.items():
+            assert prog.get(rid, []) == toks, (rid, prog.get(rid), toks)
+        # h0 really was served by two incarnations (resume exercised)
+        assert len(sources[h0.rid]) >= 2, sources
+        assert RequestJournal.recover(journal) == []
+    finally:
+        fleet.close()
+
+
+def _crashed_journal(path, rid, prompt, max_new, emitted, eos_id=None):
+    """Write the journal a front-door CRASH leaves behind: an open rid
+    with assigned progress and no terminal record."""
+    jr = RequestJournal(path)
+    spec = {"prompt": [int(t) for t in prompt], "max_new_tokens": max_new,
+            "temperature": 0.0, "eos_id": eos_id, "seed": 0,
+            "publish_len": None, "slo": "interactive",
+            "deadline_s": None, "submit_unix": time.time()}
+    jr.submit(rid, spec)
+    jr.assign(rid, "r0", 0, 0)
+    jr.progress(rid, "r0", 0, 0, emitted)
+    jr.close()
+    return spec
+
+
+def test_front_door_restart_resume_via_submit(model, tmp_path):
+    """The documented restart workflow end-to-end: recover() +
+    recover_progress() from a crashed front door's journal, resubmit
+    through ServingFleet.submit(resume_tokens=...) — the new fleet
+    prefill-aliases the emitted prefix, re-decodes ZERO already-emitted
+    tokens (journal-audited on the NEW file), and the output is
+    token-identical to uninterrupted generate()."""
+    cfg, params = model
+    p = np.arange(1, 10, dtype=np.int32)
+    full = _oracle(params, cfg, p, 12)
+    cut = 5
+    emitted = [int(t) for t in full[len(p):len(p) + cut]]
+    j1 = str(tmp_path / "crashed.jsonl")
+    _crashed_journal(j1, 7, p, 12, emitted)
+    open_set = RequestJournal.recover(j1)
+    prog = RequestJournal.recover_progress(j1)
+    assert [r for r, _ in open_set] == [7] and prog[7] == emitted
+    j2 = str(tmp_path / "restarted.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=1, journal_path=j2,
+                         engine_kw={"max_slots": 2})
+    try:
+        (rid, s), = open_set
+        h = fleet.submit(np.asarray(s["prompt"], np.int32),
+                         s["max_new_tokens"],
+                         temperature=s["temperature"],
+                         eos_id=s["eos_id"], seed=s["seed"],
+                         publish_len=s["publish_len"], slo=s["slo"],
+                         resume_tokens=prog[rid])
+        assert h.emitted == cut  # operator context starts at the prefix
+        np.testing.assert_array_equal(h.result(timeout=180), full)
+        st = fleet.stats()
+        assert st["resumed_requests"] == 1, st
+        assert st["resumed_tokens"] == cut, st
+        # the replica PREFILLED the prefix instead of decoding it
+        rst = st["replicas"][0]["stats"]
+        assert rst["resumed_requests"] == 1, rst
+        assert rst["resume_tokens_reused"] == cut, rst
+    finally:
+        fleet.close()
+    lines = [json.loads(l) for l in open(j2)]
+    done = {r["rid"]: r["tokens"] for r in lines if r["kind"] == "done"}
+    prog2, sources = {}, set()
+    for r in lines:
+        if r["kind"] == "progress":
+            prog2.setdefault(r["rid"], []).extend(r["tokens"])
+            sources.add(r["replica"])
+    (rid2, toks), = done.items()
+    assert toks == [int(t) for t in full[len(p):]]
+    # re-decode zero: prefix (from "__restart__") + new deltas
+    # concatenate EXACTLY to the done record — a re-decoded token
+    # would appear twice
+    assert prog2[rid2] == toks
+    assert "__restart__" in sources
+    assert RequestJournal.recover(j2) == []
+
+
+def test_restart_resume_finished_prefix_and_validation(model, tmp_path):
+    """A recovered prefix that already reached its budget (or eos)
+    means the crashed fleet FINISHED the request and only lost the done
+    record: submit(resume_tokens=...) completes it straight from the
+    journal with zero engine work. A prefix longer than the budget is
+    refused loudly."""
+    cfg, params = model
+    p = np.arange(1, 8, dtype=np.int32)
+    fleet = ServingFleet(params, cfg, n_replicas=1,
+                         journal_path=str(tmp_path / "j2.jsonl"),
+                         engine_kw={"max_slots": 2})
+    try:
+        with pytest.raises(ValueError, match="resume_tokens longer"):
+            fleet.submit(p, 3, resume_tokens=[1, 2, 3, 4])
+        # budget-complete prefix: done on arrival, no routing
+        done_toks = [5, 9, 11]
+        h = fleet.submit(p, 3, resume_tokens=done_toks)
+        np.testing.assert_array_equal(
+            h.result(timeout=30), np.concatenate([p, done_toks]))
+        assert h.replica == "__restart__"
+        # eos-terminated prefix under budget: same verdict
+        h2 = fleet.submit(p, 8, eos_id=11, resume_tokens=done_toks)
+        np.testing.assert_array_equal(
+            h2.result(timeout=30), np.concatenate([p, done_toks]))
+        st = fleet.stats()
+        assert st["completed"] == 2 and st["lost"] == 0, st
+        # zero engine work: nothing was routed, decoded, or prefilled
+        assert st["tokens_out"] == 0 and st["prefill_tokens_computed"] == 0
+        assert st["resumed_requests"] == 0, st  # no decode was resumed
+    finally:
+        fleet.close()
+    jl = str(tmp_path / "j2.jsonl")
+    assert RequestJournal.recover(jl) == []  # both rids terminal
+
+
+def test_rate_veto_reference_is_the_healthy_replica(model):
+    """Review regression: with BOTH replicas busy (both rate samples
+    fresh), the rate veto's fleet reference must be the healthy
+    replica's rate, not the gray replica's own trickle — rate polarity
+    is the INVERSE of latency, so a lower-median reference would let
+    the sick replica judge itself healthy forever. Drives _health_sweep
+    directly (under the fleet lock) with forged evidence: r0 gray
+    (slow EWMA, trickle rate), r1 healthy, both busy."""
+    from paddle_tpu.serving.fleet import _DEMOTED, _LIVE
+    cfg, params = model
+    fleet = ServingFleet(params, cfg, n_replicas=2,
+                         heartbeat_timeout_s=60.0,
+                         slow_replica_factor=4.0,
+                         slow_min_duration_s=0.2,
+                         probe_interval_s=60.0,
+                         engine_kw={"max_slots": 2})
+    try:
+        with fleet._cond:
+            now = time.monotonic()
+            for i, (ewma, rate, toks) in enumerate(
+                    [(0.9, 2.0, 50), (0.1, 100.0, 500)]):
+                fleet._beats[i] = now
+                fleet._rep_stats[i] = {
+                    "step_ewma_s": ewma, "busy": True,
+                    "tokens_out": toks, "prefill_tokens_computed": 0}
+                fleet._rate[i] = rate
+                fleet._watermark[i] = (now, toks)
+                fleet._stall_since[i] = None
+                fleet._slow_since[i] = None
+            fleet._health_sweep(now)  # arms the hysteresis clock on r0
+            assert fleet._state[0] == _LIVE  # not before the window
+            later = now + fleet.slow_min_duration_s + 0.01
+            for i, toks in ((0, 50), (1, 500)):
+                fleet._beats[i] = later  # evidence stays fresh
+                fleet._watermark[i] = (later, toks)
+            fleet._health_sweep(later)
+            assert fleet._state[0] == _DEMOTED, fleet._state
+            assert fleet._state[1] == _LIVE, fleet._state
+            assert fleet.demotions == 1
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # two-replica fleet + kill + full-bucket warmup
+def test_route_falls_back_to_demoted_when_last_live_dies(model,
+                                                         tmp_path):
+    """Review regression: the last LIVE replica dying while the other
+    is DEMOTED must not terminally reject the fleet's requests — the
+    demoted replica is alive, warm, and heartbeating (parked by our own
+    health verdict), so routing falls back to it: its in-flight +
+    resubmitted requests complete token-identically, lost == 0."""
+    from paddle_tpu.serving.fleet import _DEMOTED
+    cfg, params = model
+    journal = str(tmp_path / "j.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=2, journal_path=journal,
+                         heartbeat_timeout_s=60.0,
+                         probe_interval_s=60.0,  # no restore mid-test
+                         engine_kw={"max_slots": 2})
+    try:
+        _warm_all_buckets(fleet, cfg, n_replicas=2)
+        with fleet._cond:
+            fleet._demote_locked(0)
+            assert fleet._state[0] == _DEMOTED
+        p = np.arange(1, 10, dtype=np.int32)
+        h1 = fleet.submit(p, 10)           # routed to r1, the last live
+        fleet.kill_replica(1)
+        # failover re-routes h1 onto the demoted (only alive) replica,
+        # and a brand-new submit routes there too instead of raising
+        np.testing.assert_array_equal(
+            h1.result(timeout=180), _oracle(params, cfg, p, 10))
+        p2 = np.arange(3, 11, dtype=np.int32)
+        h2 = fleet.submit(p2, 8)
+        np.testing.assert_array_equal(
+            h2.result(timeout=180), _oracle(params, cfg, p2, 8))
+        st = fleet.stats()
+        assert st["lost"] == 0 and st["failovers"] == 1, st
+    finally:
+        fleet.close()
+    assert RequestJournal.recover(journal) == []
+
+
+def test_fence_refuses_superseded_report_after_route_back(model, tmp_path):
+    """Review regression (generation-fence hole): a demote ->
+    survivor-death -> route-back-to-the-demoted-replica cycle makes the
+    journal's latest assignment name the SAME (replica, incarnation)
+    pair as the superseded submission, so the (replica, incarnation)
+    fence alone would absorb the old submission's progress into the
+    mirror the new holder resumes from and accept its completion with
+    the resume prefix duplicated. The in-flight fence (reports count
+    only for work the fleet currently tracks on that replica — demotion
+    clears it, the re-routed copy waits in the inbox) refuses both.
+    Drives _absorb_progress/_accept directly under the fleet lock with
+    forged journal state — the race is deterministic here."""
+    from paddle_tpu.serving.fleet import FleetHandle
+    cfg, params = model
+    fleet = ServingFleet(params, cfg, n_replicas=2,
+                         journal_path=str(tmp_path / "j.jsonl"),
+                         heartbeat_timeout_s=60.0, probe_interval_s=60.0,
+                         engine_kw={"max_slots": 2})
+    try:
+        prompt = np.arange(1, 5, dtype=np.int32)
+        spec = {"prompt": [int(t) for t in prompt], "max_new_tokens": 8,
+                "temperature": 0.0, "eos_id": None, "seed": 0,
+                "publish_len": None, "slo": "interactive",
+                "deadline_s": None, "submit_unix": time.time()}
+        with fleet._cond:
+            rep0 = fleet._replicas[0]
+            rid = fleet._next_rid
+            fleet._next_rid += 1
+            h = FleetHandle(rid, prompt, spec, "interactive", fleet=fleet)
+            fleet._handles[rid] = h
+            fleet._open.add(rid)
+        journal = fleet._journal
+        journal.submit(rid, spec)
+        with fleet._cond:
+            # r0 (gen 0) holds the request and journals two tokens
+            journal.assign(rid, rep0.name, rep0.incarnation, 0)
+            fleet._in_flight[0][rid] = h
+            fleet._absorb_progress(rep0, [(rid, [7, 8])])
+            assert journal.progress_of(rid) == [7, 8]
+            assert h.emitted == 2
+            # demotion hedges it away (in-flight cleared), the survivor
+            # dies, and routing falls BACK here: the latest assignment
+            # names (r0, incarnation) again under a bumped generation,
+            # with the re-routed copy still in the inbox carrying the
+            # two-token resume prefix
+            del fleet._in_flight[0][rid]
+            h.generation = 2
+            h.resume = [7, 8]
+            journal.assign(rid, rep0.name, rep0.incarnation, 2)
+            # the SUPERSEDED submission's late reports now arrive from
+            # a matching (replica, incarnation) pair:
+            before = fleet.zombie_refused
+            fleet._absorb_progress(rep0, [(rid, [9])])
+            assert journal.progress_of(rid) == [7, 8], \
+                "superseded progress absorbed into the resume mirror"
+            assert h.emitted == 2
+            fleet._accept(rid, [7, 8, 9], "", rep0, accepted=True)
+            assert fleet.zombie_refused == before + 1
+            assert not h.done and h.tokens is None
+            assert rid in fleet._open  # still the new holder's to finish
+    finally:
+        fleet.close()
+
+
+def test_probe_admission_failure_does_not_wedge_or_journal(model, tmp_path):
+    """Review regression: a health probe the engine refuses at
+    admission must behave as a FAILED PROBE — probe slot cleared, next
+    probe scheduled, nothing journaled for the negative rid, rejected
+    count untouched — not write rid -1 to the durable table and leave
+    `_probes[i]` set forever (no probe would ever be sent again: a
+    healthy replica stuck DEMOTED for the fleet's lifetime). The probe
+    spec is also sized to the engine's own admission limits so a
+    small-context fleet can probe at all."""
+    from paddle_tpu.serving.fleet import _DEMOTED
+    cfg, params = model
+    jpath = str(tmp_path / "j.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=2, journal_path=jpath,
+                         heartbeat_timeout_s=60.0, probe_interval_s=60.0,
+                         engine_kw={"max_slots": 2, "max_len": 4})
+    try:
+        with fleet._cond:
+            fleet._demote_locked(0)
+            fleet._send_probe_locked(0)
+            ph = fleet._probes[0]
+            assert ph is not None
+            # sized within the engine's admission rule (max_len=4)
+            assert 1 + ph.spec["max_new_tokens"] <= 4
+            # drive the admission-failure path manually, AFTER the
+            # handshake handoff: _sync_locked moves a dispatched probe
+            # from the inbox into _in_flight, so a failed probe must
+            # clean that entry too (a leaked negative rid blocks
+            # DRAINING->DRAINED forever and inflates routing load)
+            fleet._inbox[0].clear()
+            fleet._in_flight[0][ph.rid] = ph
+        fleet._reject(ph.rid, ValueError("admission refused"))
+        with fleet._cond:
+            assert fleet._probes[0] is None            # slot cleared
+            assert fleet._probe_at[0] > time.monotonic()  # rescheduled
+            assert ph.rid not in fleet._handles
+            assert ph.rid not in fleet._in_flight[0]   # no leak
+            assert fleet._state[0] == _DEMOTED  # still parked, probeable
+        assert fleet.rejected == 0
+        assert fleet.stats()["lost"] == 0
+    finally:
+        fleet.close()
+    for line in open(jpath):
+        rec = json.loads(line)
+        assert rec.get("rid", 0) >= 0, rec  # probes never reach the journal
+
+
+def test_probe_sized_to_replica_override_limits(model):
+    """Review regression: probe sizing must use the PER-REPLICA
+    composed engine kwargs, not the base kw — a replica whose
+    engine_kw_for override shrinks the context would otherwise fail
+    every probe at admission and stay demoted forever."""
+    cfg, params = model
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, heartbeat_timeout_s=60.0,
+        probe_interval_s=60.0, engine_kw={"max_slots": 2},
+        engine_kw_for=lambda i: {"max_len": 4} if i == 0 else {})
+    try:
+        with fleet._cond:
+            fleet._demote_locked(0)
+            fleet._send_probe_locked(0)
+            ph0 = fleet._probes[0]
+            fleet._demote_locked(1)
+            fleet._send_probe_locked(1)
+            ph1 = fleet._probes[1]
+        # replica 0's override (max_len=4) caps its probe; replica 1
+        # probes at the base limits
+        assert 1 + ph0.spec["max_new_tokens"] <= 4
+        assert ph1.spec["max_new_tokens"] > ph0.spec["max_new_tokens"]
+    finally:
+        fleet.close()
+
+
+def test_reject_locked_idempotent_no_double_count(model, tmp_path):
+    """Review regression: close()'s open-request sweep and submit()'s
+    close-race branch can both reach _reject_locked for the SAME rid —
+    the second pass must be a no-op (one `rejected` count, one terminal
+    journal record), or stats()['lost'] goes negative and the durable
+    table holds duplicate terminal records."""
+    cfg, params = model
+    jpath = str(tmp_path / "j.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=1, journal_path=jpath,
+                         heartbeat_timeout_s=60.0)
+    try:
+        from paddle_tpu.serving.fleet import FleetHandle
+        with fleet._cond:
+            rid = fleet._next_rid
+            fleet._next_rid += 1
+            spec = {"prompt": [1], "max_new_tokens": 1,
+                    "temperature": 0.0, "eos_id": None, "seed": 0,
+                    "publish_len": 0, "slo": None, "deadline_s": None,
+                    "submit_unix": time.time()}
+            h = FleetHandle(rid, np.array([1], np.int32), spec, None,
+                            fleet=fleet)
+            fleet._handles[rid] = h
+            fleet._open.add(rid)
+            fleet.submitted += 1
+        fleet._journal.submit(rid, spec)
+        with fleet._cond:
+            fleet._reject_locked(rid, "fleet closed")
+            fleet._reject_locked(rid, "fleet closed")  # the race's 2nd hit
+            assert fleet.rejected == 1
+        fleet._flush_journal()
+        assert fleet.stats()["lost"] == 0
+    finally:
+        fleet.close()
+    recs = [json.loads(l) for l in open(jpath)]
+    rejects = [r for r in recs if r.get("kind") == "rejected"
+               and r.get("rid") == rid]
+    assert len(rejects) == 1, rejects
+
+
+# ---------------------------------------------------------------------------
+# fleet: gray-failure demotion / probe / restore
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # real gray window (1.6s slow@) + probe/restore wait;
+                   # demote+restore-same-incarnation is pinned tier-1 by
+                   # the serving_slo bench contract
+def test_gray_slow_replica_demoted_probed_restored_warm(model):
+    """The ISSUE 8 acceptance drill: r0 gray-slows (heartbeating, every
+    step stalls — slow@); the monitor demotes it on the step-latency
+    health score, its open requests complete on the survivor
+    (token-identical), and after the window it is probed and restored
+    under the SAME incarnation — warm pool, no fresh spawn."""
+    cfg, params = model
+    reqs = _requests(cfg, n=4, seed=3)
+    inj = FaultInjector("")  # inert until armed post-warm-up
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, heartbeat_timeout_s=60.0,
+        monitor_interval_s=0.05, slow_replica_factor=4.0,
+        slow_min_duration_s=0.3, probe_interval_s=0.15,
+        engine_kw={"max_slots": 2},
+        engine_kw_for=lambda i: (
+            {"fault_injector": inj} if i == 0 else {}))
+    try:
+        # warm BOTH replicas, EVERY bucket, first (first-compile
+        # latency is the documented false-demotion hazard: never score
+        # a cold replica)
+        _warm_all_buckets(fleet, cfg)
+        inj.arm("slow@2:1.6/0.2")  # gray window: 1.6s of 0.2s steps
+        hs = [fleet.submit(p, 16) for p, _ in reqs]
+        for h in hs:
+            h.result(timeout=120)
+        st = fleet.stats()
+        assert st["demotions"] == 1, st
+        assert st["lost"] == 0 and st["duplicate_refused"] == 0, st
+        for h, (p, _) in zip(hs, reqs):
+            np.testing.assert_array_equal(
+                np.asarray(h.tokens, np.int32),
+                _oracle(params, cfg, p, 16)[len(p):])
+        # after the window: probed back to life, SAME incarnation
+        deadline = time.monotonic() + 60
+        while fleet.stats()["replicas"][0]["state"] != "live":
+            assert time.monotonic() < deadline, fleet.stats()
+            time.sleep(0.05)
+        st = fleet.stats()
+        assert st["restores"] == 1 and st["probes_sent"] >= 1, st
+        assert st["replicas"][0]["incarnation"] == 1, st  # warm, no respawn
+        assert st["failovers"] == 0, st  # demoted, never declared dead
+        # the restored replica serves again
+        h2 = fleet.submit(*reqs[1])
+        np.testing.assert_array_equal(
+            h2.result(timeout=120), _oracle(params, cfg, *reqs[1]))
+    finally:
+        fleet.close()
+
+
+def test_single_transient_pause_does_not_flap(model):
+    """Hysteresis: one GC-pause-shaped stall (delay@ — a single long
+    step) spikes the EWMA once, healthy steps decay it well inside
+    `slow_min_duration_s`, and the replica is never demoted."""
+    cfg, params = model
+    inj = FaultInjector("")
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, heartbeat_timeout_s=60.0,
+        monitor_interval_s=0.05, slow_replica_factor=4.0,
+        slow_min_duration_s=1.0, probe_interval_s=0.15,
+        engine_kw={"max_slots": 2},
+        engine_kw_for=lambda i: (
+            {"fault_injector": inj} if i == 0 else {}))
+    try:
+        p = np.arange(3, 12, dtype=np.int32)
+        _warm_all_buckets(fleet, cfg)
+        inj.arm("delay@2:0.4")  # ONE transient pause mid-request
+        hs = [fleet.submit(p, 24), fleet.submit(p, 24, seed=1)]
+        for h in hs:
+            h.result(timeout=120)
+        time.sleep(0.5)  # several more health sweeps on settled EWMAs
+        st = fleet.stats()
+        assert st["demotions"] == 0 and st["restores"] == 0, st
+        assert st["lost"] == 0, st
+        assert st["replicas"][0]["state"] == "live", st
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos drill matrix: the journal invariant under every fault kind
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec", ["exc@4", "delay@3:0.5", "slow@3:1.0/0.1"])
+def test_chaos_matrix_journal_invariant(model, tmp_path, spec):
+    """PADDLE_FAULT kinds against the fleet (exc = in-process crash —
+    the kill analog whose SIGKILL form runs in the subprocess drill —
+    delay = straggler, slow = gray): under each, every request
+    completes token-identically and the journal invariant holds —
+    after close, every journaled rid is terminal (done / rejected /
+    expired), never silently open."""
+    cfg, params = model
+    reqs = _requests(cfg, n=5, seed=11)
+    oracle = [_oracle(params, cfg, p, n) for p, n in reqs]
+    journal = str(tmp_path / "j.jsonl")
+    inj = FaultInjector("")  # inert until the fleet is warm
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, journal_path=journal,
+        heartbeat_timeout_s=60.0, monitor_interval_s=0.05,
+        slow_replica_factor=4.0, slow_min_duration_s=0.3,
+        engine_kw={"max_slots": 2},
+        engine_kw_for=lambda i: (
+            {"fault_injector": inj} if i == 0 else {}))
+    try:
+        _warm_all_buckets(fleet, cfg)
+        inj.arm(spec)  # fault steps count from the warmed state
+        hs = [fleet.submit(p, n, deadline_s=120.0) for p, n in reqs]
+        for h, want in zip(hs, oracle):
+            np.testing.assert_array_equal(h.result(timeout=180), want)
+        assert fleet.stats()["lost"] == 0
+    finally:
+        fleet.close()
+    # the invariant: nothing is open after close, under ANY fault kind
+    assert RequestJournal.recover(journal) == []
+    lines = [json.loads(l) for l in open(journal)]
+    submitted = {r["rid"] for r in lines if r["kind"] == "submit"}
+    terminal = {r["rid"] for r in lines
+                if r["kind"] in ("done", "rejected", "expired")}
+    assert submitted <= terminal, submitted - terminal
+
+
+def test_close_writes_terminal_records_for_open_requests(model, tmp_path):
+    """The invariant's hardest edge: requests still open when the
+    fleet closes get terminal `rejected` records — never left silently
+    open for every future recover() to resubmit."""
+    cfg, params = model
+    journal = str(tmp_path / "j.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=1, journal_path=journal,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 1})
+    p = np.arange(1, 8, dtype=np.int32)
+    hs = [fleet.submit(p, 40), fleet.submit(p, 40, seed=1)]
+    fleet.close()
+    for h in hs:
+        assert h.done and h.error is not None
+    assert RequestJournal.recover(journal) == []
+    lines = [json.loads(l) for l in open(journal)]
+    rejects = [r for r in lines if r["kind"] == "rejected"]
+    assert {r["rid"] for r in rejects} == {h.rid for h in hs}
+
+
+# ---------------------------------------------------------------------------
+# journal compaction (host-only)
+# ---------------------------------------------------------------------------
+
+def test_journal_compaction_rewrites_open_only(tmp_path):
+    """Satellite: past the rotation threshold the file rewrites to
+    meta + the open set; recover(), reopen (rid history preserved via
+    the meta record), lost() with progress, and recover_progress()
+    all see identical state after the compaction."""
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, compact_every=20)
+    for k in range(30):  # lifetime traffic: all terminal
+        j.submit(k, {"p": [k]})
+        j.assign(k, "r0", 1, 0)
+        j.progress(k, "r0", 1, 0, [1, 2])
+        j.complete(k, "r0", 1, 0, [1, 2])
+    # two still-open requests, one with journaled progress
+    j.submit(100, {"p": [1]})
+    j.assign(100, "r0", 1, 2)
+    j.progress(100, "r0", 1, 2, [5, 6])
+    j.submit(101, {"p": [2]})
+    assert j.compactions >= 1
+    assert j.open_count() == 2
+    j.compact()  # settle the tail traffic since the last auto rotation
+    j.close()
+    # the FILE holds exactly meta + the open set now: one meta, two
+    # submits, rid 100's assign + progress
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 5, lines
+    assert lines[0]["kind"] == "meta"
+    assert RequestJournal.recover(path) == [(100, {"p": [1]}),
+                                            (101, {"p": [2]})]
+    assert RequestJournal.recover_progress(path) == {100: [5, 6]}
+    # reopen: rid history continues past EVERYTHING ever issued, the
+    # open mirror (incl. progress + assignment generation) resumes
+    j2 = RequestJournal(path)
+    assert j2.next_rid() == 102
+    assert j2.open_count() == 2
+    assert j2.lost("r0", 1) == [(100, {"p": [1]}, 2, [5, 6])]
+    j2.complete(100, "r1", 1, 3, [5, 6, 7])
+    j2.reject(101, "drill over")
+    j2.close()
+    assert RequestJournal.recover(path) == []
+
+
+def test_journal_explicit_compact_and_small_open_set_guard(tmp_path):
+    """compact() works on demand; the auto path refuses to rewrite
+    when the file is mostly open records (a rewrite that cannot shrink
+    the file must not run on every append)."""
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, compact_every=4)
+    for k in range(6):  # 6 open submits: > threshold but all live
+        j.submit(k, {"p": [k]})
+    before = j.compactions
+    j.submit(6, {"p": [6]})
+    assert j.compactions == before  # guard held: nothing to shrink
+    for k in range(7):
+        j.complete(k, "r0", 1, 0, [k])
+    assert j.compactions > before  # terminals made the rewrite pay
+    j.submit(7, {"p": [7]})
+    assert j.compact()  # explicit request always rewrites
+    j.close()
+    assert [rid for rid, _ in RequestJournal.recover(path)] == [7]
+    j2 = RequestJournal(path)
+    assert j2.next_rid() == 8
+    j2.close()
+
+
+def test_compaction_never_fires_mid_batch(tmp_path):
+    """Regression (review finding): write() appends DEFERRED records
+    whose mirror effects already happened — a compaction firing
+    mid-batch would snapshot the mirror (which includes the whole
+    batch) and then append the remaining records on top, duplicating
+    progress tokens in the file. Resume prefixes recovered after a
+    restart must match the mirror exactly."""
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, compact_every=4)
+    j.submit(0, {"p": [0]})
+    recs = [j.assign(0, "r0", 1, 0, defer=True)]
+    # one batch of deferred progress records big enough to trip the
+    # threshold mid-batch several times over
+    for k in range(12):
+        recs.append(j.progress(0, "r0", 1, 0, [k], defer=True))
+    j.write(recs)
+    assert j.progress_of(0) == list(range(12))
+    j.close()
+    # the FILE agrees with the mirror: no token appears twice
+    assert RequestJournal.recover_progress(path) == {0: list(range(12))}
+    j2 = RequestJournal(path)  # replay path agrees too
+    assert j2.progress_of(0) == list(range(12))
+    assert j2.lost("r0", 1) == [(0, {"p": [0]}, 0, list(range(12)))]
+    j2.close()
+
+
+def test_direct_append_defers_compaction_to_outstanding_batch(tmp_path):
+    """Regression (review finding): a DIRECT append (submit — the
+    fleet journals it outside its scheduler lock) can cross the
+    rotation threshold while another thread still holds
+    mirror-applied-but-unwritten deferred records. Compacting there
+    snapshots the mirror (which already includes the deferred progress
+    tokens) and the later write() appends the same deltas on top —
+    duplicated tokens in the file, corrupt restart resume prefixes.
+    Rotation must WAIT for the outstanding batch."""
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, compact_every=4)
+    j.submit(50, {"p": [50]})
+    recs = [j.assign(50, "r0", 1, 0, defer=True),
+            j.progress(50, "r0", 1, 0, [7, 8], defer=True)]
+    before = j.compactions
+    # terminal direct traffic: crosses the threshold AND satisfies the
+    # shrink guard (one open request) many times over
+    for k in range(100, 110):
+        j.submit(k, {"p": [k]})
+        j.complete(k, "r0", 1, 0, [k])
+    assert j.compactions == before  # held: batch still outstanding
+    assert j.compact() is False     # explicit request refused too
+    j.write(recs)                   # batch lands -> rotation allowed
+    assert j.compactions > before
+    # the LIVE object's is_done() stays truthful across the rotation
+    # (the terminal records left the file, not the mirror)
+    assert j.is_done(105)
+    j.close()
+    # the file agrees with the mirror: rid 50's tokens appear ONCE
+    assert RequestJournal.recover_progress(path) == {50: [7, 8]}
+    j2 = RequestJournal(path)
+    assert j2.lost("r0", 1) == [(50, {"p": [50]}, 0, [7, 8])]
+    j2.close()
+
+
+def test_restored_replica_republishes_routing_summary(model):
+    """Regression (review finding): demotion clears the routing
+    summary; the pool is warm and UNCHANGED across restore, so the
+    replica's revision cache would never resend it and affinity
+    routing would treat the restored replica as cold forever."""
+    cfg, params = model
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, heartbeat_timeout_s=60.0,
+        monitor_interval_s=0.05, slow_replica_factor=4.0,
+        slow_min_duration_s=0.3, probe_interval_s=0.1,
+        engine_kw={"max_slots": 2, "prefix_cache_tokens": 256,
+                   "prefix_block_tokens": 4})
+    try:
+        p = np.arange(1, 17, dtype=np.int32)
+        h = fleet.submit(p, 4, publish_len=16)  # least-loaded -> r0
+        h.result(timeout=180)
+        deadline = time.monotonic() + 30
+        while not fleet._summaries[0]:  # published summary lands async
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        before = set(fleet._summaries[0])
+        with fleet._cond:
+            fleet._demote_locked(0)
+        assert fleet.stats()["replicas"][0]["state"] == "demoted"
+        assert not fleet._summaries[0]  # demotion cleared it
+        deadline = time.monotonic() + 60
+        while fleet.stats()["replicas"][0]["state"] != "live":
+            assert time.monotonic() < deadline, fleet.stats()
+            time.sleep(0.05)
+        deadline = time.monotonic() + 30
+        while not fleet._summaries[0]:  # the refresh must repopulate it
+            assert time.monotonic() < deadline, "summary never resent"
+            time.sleep(0.02)
+        assert set(fleet._summaries[0]) == before  # warm pool, same keys
+    finally:
+        fleet.close()
+
+
+def test_fleet_journal_compaction_under_traffic(model, tmp_path):
+    """End-to-end: a fleet configured with journal_compact_every keeps
+    the file bounded by in-flight work while serving — and the
+    post-close journal still recovers to empty."""
+    cfg, params = model
+    journal = str(tmp_path / "j.jsonl")
+    fleet = ServingFleet(params, cfg, n_replicas=1, journal_path=journal,
+                         journal_compact_every=25,
+                         heartbeat_timeout_s=60.0,
+                         engine_kw={"max_slots": 2})
+    try:
+        p = np.arange(1, 8, dtype=np.int32)
+        for _ in range(4):
+            hs = [fleet.submit(p, 8), fleet.submit(p, 8, seed=1)]
+            for h in hs:
+                h.result(timeout=120)
+        assert fleet._journal.compactions >= 1
+        assert fleet.stats()["lost"] == 0
+    finally:
+        fleet.close()
+    assert RequestJournal.recover(journal) == []
+    assert len(list(open(journal))) <= 25 + 4  # bounded, not lifetime
