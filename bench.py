@@ -1569,7 +1569,15 @@ def bench_serving_slo(n_replicas=None, n_requests=None, max_slots=None,
 
     def run_once(gray: bool):
         inj = FaultInjector("")  # inert until armed post-warm
-        jpath = tempfile.mktemp(suffix=".jsonl", prefix="slo_journal_")
+        # PADDLE_TPU_KEEP_JOURNAL_DIR: land the journal there and keep
+        # it, so tools/lint.sh's protocol gate can replay the bench
+        # smoke's journal through `python -m paddle_tpu.analysis
+        # journal` after the run
+        keep_dir = os.environ.get("PADDLE_TPU_KEEP_JOURNAL_DIR") or None
+        if keep_dir is not None:
+            os.makedirs(keep_dir, exist_ok=True)
+        jpath = tempfile.mktemp(suffix=".jsonl", prefix="slo_journal_",
+                                dir=keep_dir)
         fleet = ServingFleet(
             params, cfg, n_replicas=n_replicas, journal_path=jpath,
             heartbeat_timeout_s=120.0, monitor_interval_s=0.05,
@@ -1633,7 +1641,8 @@ def bench_serving_slo(n_replicas=None, n_requests=None, max_slots=None,
                 prog_toks.setdefault(rec["rid"], []).extend(rec["tokens"])
                 sources.setdefault(rec["rid"], set()).add(
                     (rec["replica"], rec["incarnation"], rec["gen"]))
-        os.unlink(jpath)
+        if keep_dir is None:
+            os.unlink(jpath)
         for rid, toks_done in done_toks.items():
             if prog_toks.get(rid, []) != toks_done:
                 raise RuntimeError(

@@ -1,22 +1,32 @@
 """paddle_tpu.analysis — custom static analyzers for this codebase.
 
-Three analyzers over one shared diagnostic framework (stable codes,
+Five engines over one shared diagnostic framework (stable codes,
 file:line anchors, checked-in baseline in `baseline.txt`):
 
   * program verifier  (`program_lint`)  P001-P006 — validates
     Program/Block/Operator IR the way the reference's C++ ProgramDesc
     checks did, before the executor lowers it
-  * trace-hazard linter (`trace_lint`)  T001-T004 — AST pass over the
+  * trace-hazard linter (`trace_lint`)  T001-T005 — AST pass over the
     jitted hot paths for host-sync / retrace / impurity hazards inside
-    traced functions
-  * lock-discipline linter (`lock_lint`) L001-L002 — learns guarded
-    attributes from `# guarded-by:` annotations and checks mutations +
-    lock-acquisition ordering
+    traced functions, and accidental device dispatch from host-side
+    scheduler loops
+  * lock-discipline linter (`lock_lint`) L001-L004 — learns guarded
+    attributes from `# guarded-by:` annotations and checks mutations,
+    lock-acquisition ordering, and `threading.Condition` discipline
+  * journal verifier (`protocol_lint`) J001-J008 — a per-rid DFA over
+    `RequestJournal` files (the serving fleet's durable protocol
+    history); `PADDLE_TPU_AUDIT_JOURNAL=1` audits every
+    `ServingFleet.close()` for free
+  * schedule explorer (`sched_explore`) — CHESS-lite deterministic
+    interleaving enumeration over the fleet's SchedulerHook seam with
+    recorded, replayable schedules and invariant probes
 
 Run everything:  python -m paddle_tpu.analysis --all
 One analyzer:    python -m paddle_tpu.analysis program <entry.py>
                  python -m paddle_tpu.analysis trace [files...]
                  python -m paddle_tpu.analysis locks [paths...]
+                 python -m paddle_tpu.analysis journal <journal.jsonl>
+                 python -m paddle_tpu.analysis explore [--scenario X]
 
 The tier-1 test
 `tests/test_static_analysis.py::test_repo_is_clean_modulo_baseline`
@@ -45,8 +55,16 @@ from .diagnostics import (  # noqa: F401
 __all__ = [
     "Diagnostic", "ProgramVerifyError", "CODES", "run_all",
     "collect_diagnostics", "load_baseline", "split_new", "format_diag",
-    "default_baseline_path",
+    "default_baseline_path", "verify_journal",
 ]
+
+
+def verify_journal(path, expect_closed=False):
+    """Re-export of `protocol_lint.verify_journal` (lazy: the journal
+    DFA is pure-stdlib but keeps the package's import-light rule)."""
+    from .protocol_lint import verify_journal as _vj
+
+    return _vj(path, expect_closed=expect_closed)
 
 
 def collect_diagnostics(with_programs: bool = True) -> List[Diagnostic]:
@@ -71,11 +89,14 @@ def run_all(baseline_path: Optional[str] = None,
     """Run every analyzer over the repo; returns (new, baselined,
     stale_baseline_entries). `with_programs=False` skips the built-in
     program entries (they import jax via fluid)."""
+    from .diagnostics import REPO_SCOPE_CODES
+
     diags = collect_diagnostics(with_programs)
     baseline = load_baseline(baseline_path)
     new, old, stale = split_new(diags, baseline)
-    if not with_programs:
-        # the program verifier did not run: its baseline entries are
-        # out of scope, not stale (same scoping the CLI applies)
-        stale = [fp for fp in stale if fp[:1] in ("T", "L")]
+    # journal (J) entries verify runtime artifacts — out of run_all's
+    # scope, never stale here; without programs the P entries are out
+    # of scope too (same scoping the CLI applies)
+    scope = ("T", "L") if not with_programs else REPO_SCOPE_CODES
+    stale = [fp for fp in stale if fp[:1] in scope]
     return new, old, stale

@@ -4,6 +4,9 @@
     python -m paddle_tpu.analysis program path/to/entry.py [--fetch NAME]
     python -m paddle_tpu.analysis trace [files...]
     python -m paddle_tpu.analysis locks [files-or-dirs...]
+    python -m paddle_tpu.analysis journal <journal.jsonl> [--expect-closed]
+    python -m paddle_tpu.analysis explore [--scenario NAME] [--preemptions K]
+                                          [--max-schedules N] [--replay CSV]
 
 Exit status: 0 when every finding is covered by the baseline
 (`paddle_tpu/analysis/baseline.txt` unless --baseline overrides) and
@@ -32,16 +35,19 @@ from .diagnostics import Diagnostic, format_diag, load_baseline, split_new
 
 
 def _report(diags: List[Diagnostic], baseline_path, write_baseline,
-            scope=None, out=sys.stdout) -> int:
+            scope=None, out=sys.stdout, hygiene=True) -> int:
     """`scope` limits STALE detection to the given code prefixes
     ("P"/"T"/"L"): a partial run (one analyzer) must not read the other
-    analyzers' baseline entries as stale."""
+    analyzers' baseline entries as stale. `hygiene=False` skips the
+    TODO-justification audit of the baseline file — an ad-hoc target
+    (a journal file) must answer for ITS findings only, not for repo
+    baseline debt."""
     baseline = load_baseline(baseline_path)
     new, old, stale = split_new(diags, baseline)
     # a TODO/empty justification is a defect of the baseline FILE, not
     # of this run's findings — checked unscoped on every non-write run
     unjustified = [fp for fp, why in baseline.items()
-                   if not why or "TODO" in why]
+                   if not why or "TODO" in why] if hygiene else []
     if scope is not None:
         stale = [fp for fp in stale if fp[:1] in scope]
     for d in old:
@@ -160,8 +166,97 @@ def _cmd_locks(args, baseline, write_baseline) -> int:
 
 def _cmd_all(args, baseline, write_baseline) -> int:
     from . import collect_diagnostics
+    from .diagnostics import REPO_SCOPE_CODES
 
-    return _report(collect_diagnostics(), baseline, write_baseline)
+    # --all runs the repo-scope analyzers; J-code entries (journal
+    # files are runtime artifacts) are out of scope, never stale here
+    return _report(collect_diagnostics(), baseline, write_baseline,
+                   scope=REPO_SCOPE_CODES)
+
+
+def _cmd_journal(args, baseline, write_baseline) -> int:
+    from .protocol_lint import verify_journal
+
+    try:
+        diags = verify_journal(args.path,
+                               expect_closed=args.expect_closed)
+    except FileNotFoundError as e:
+        sys.stderr.write("error: %s\n" % e)
+        return 2
+    # a journal is an ad-hoc target like `program`: no staleness scope,
+    # and repo-baseline hygiene (TODO entries) is not ITS failure
+    return _report(diags, baseline, write_baseline, scope=(),
+                   hygiene=False)
+
+
+def _cmd_explore(args, baseline, write_baseline) -> int:
+    import tempfile
+
+    from .sched_explore import SCENARIOS
+
+    names = sorted(SCENARIOS) if args.scenario == "all" \
+        else [args.scenario]
+    for name in names:
+        if name not in SCENARIOS:
+            sys.stderr.write("error: unknown scenario %r (have: %s)\n"
+                             % (name, ", ".join(sorted(SCENARIOS))))
+            return 2
+    if args.journal_dir:
+        # keep the run's journals where the caller (tools/lint.sh's
+        # protocol gate) can re-verify each with `analysis journal`
+        tmp = args.journal_dir
+        os.makedirs(tmp, exist_ok=True)
+        cleanup = None
+    else:
+        tmp = tempfile.mkdtemp(prefix="paddle_tpu_explore_")
+        cleanup = tmp
+    try:
+        return _run_explore(args, names, tmp)
+    finally:
+        if cleanup is not None:
+            import shutil
+
+            shutil.rmtree(cleanup, ignore_errors=True)
+
+
+def _run_explore(args, names, tmp) -> int:
+    from .sched_explore import (SCENARIOS, explore, format_schedule,
+                                run_schedule)
+
+    rc = 0
+    if args.replay is not None:
+        decisions = [c for c in args.replay.split(",") if c]
+        name = names[0]
+        result = run_schedule(SCENARIOS[name](), decisions,
+                              os.path.join(tmp, "replay.jsonl"),
+                              max_steps=args.max_steps)
+        sys.stdout.write("replay %s: %d steps, %d violation(s)\n"
+                         % (name, len(result.trace),
+                            len(result.violations)))
+        for v in result.violations:
+            sys.stdout.write("  violation: %s\n" % v)
+        return 1 if result.violations else 0
+    for name in names:
+        report = explore(SCENARIOS[name], tmp,
+                         max_preemptions=args.preemptions,
+                         max_schedules=args.max_schedules,
+                         max_steps=args.max_steps)
+        if report.ok:
+            sys.stdout.write(
+                "%s: %d schedule(s) explored, no violation\n"
+                % (name, report.runs))
+        else:
+            rc = 1
+            sys.stdout.write(
+                "%s: VIOLATION after %d schedule(s)\n"
+                % (name, report.runs))
+            for v in report.violation.violations:
+                sys.stdout.write("  violation: %s\n" % v)
+            sys.stdout.write(
+                "  replay with: python -m paddle_tpu.analysis explore "
+                "--scenario %s --replay '%s'\n"
+                % (name, format_schedule(report.violation.schedule)))
+    return rc
 
 
 def main(argv=None) -> int:
@@ -180,6 +275,25 @@ def main(argv=None) -> int:
     st.add_argument("paths", nargs="*")
     sl = sub.add_parser("locks", help="lock-discipline lint")
     sl.add_argument("paths", nargs="*")
+    sj = sub.add_parser("journal",
+                        help="verify a RequestJournal file (J-codes)")
+    sj.add_argument("path")
+    sj.add_argument("--expect-closed", action="store_true",
+                    help="also require every rid to have a terminal "
+                         "record (the post-close() invariant)")
+    se = sub.add_parser("explore",
+                        help="deterministic fleet schedule exploration")
+    se.add_argument("--scenario", default="all",
+                    help="scenario name, or 'all' (default)")
+    se.add_argument("--preemptions", type=int, default=1)
+    se.add_argument("--max-schedules", type=int, default=200)
+    se.add_argument("--max-steps", type=int, default=400)
+    se.add_argument("--replay", default=None,
+                    help="comma-separated schedule to replay verbatim "
+                         "(requires a single --scenario)")
+    se.add_argument("--journal-dir", default=None,
+                    help="write per-schedule journals here (kept) "
+                         "instead of a throwaway temp dir")
     args = p.parse_args(argv)
 
     if args.write_baseline and not args.all and args.baseline is None:
@@ -200,6 +314,14 @@ def main(argv=None) -> int:
         return _cmd_trace(args, args.baseline, args.write_baseline)
     if args.cmd == "locks":
         return _cmd_locks(args, args.baseline, args.write_baseline)
+    if args.cmd == "journal":
+        return _cmd_journal(args, args.baseline, args.write_baseline)
+    if args.cmd == "explore":
+        if args.replay is not None and args.scenario == "all":
+            p.error("--replay needs a single --scenario (a schedule "
+                    "only means anything against the scenario that "
+                    "recorded it)")
+        return _cmd_explore(args, args.baseline, args.write_baseline)
     p.print_help()
     return 2
 

@@ -48,10 +48,29 @@ CODES: Dict[str, Tuple[str, str]] = {
     "T002": ("impure-call-in-trace", "error"),
     "T003": ("tracer-branch", "warning"),
     "T004": ("unhashable-static-arg", "warning"),
+    "T005": ("device-dispatch-in-scheduler", "error"),
     # lock-discipline linter (lock_lint.py)
     "L001": ("unguarded-mutation", "error"),
     "L002": ("lock-order-inversion", "error"),
+    "L003": ("wait-outside-while", "warning"),
+    "L004": ("notify-outside-lock", "error"),
+    # journal state-machine verifier (protocol_lint.py) — runs over
+    # RequestJournal FILES (runtime artifacts), never in --all
+    "J001": ("orphan-record", "error"),
+    "J002": ("duplicate-terminal", "error"),
+    "J003": ("record-after-terminal", "error"),
+    "J004": ("stale-fence", "error"),
+    "J005": ("progress-terminal-mismatch", "error"),
+    "J006": ("unassigned-progress", "error"),
+    "J007": ("open-at-close", "error"),
+    "J008": ("malformed-journal", "error"),
 }
+
+# codes whose analyzer runs inside `--all` / `run_all()` — the only
+# scope whose baseline entries a full-scope run may judge stale. The
+# J-codes verify journal FILES the CLI is pointed at explicitly, so a
+# J baseline entry is never stale from --all's point of view.
+REPO_SCOPE_CODES = ("P", "T", "L")
 
 
 @dataclass

@@ -32,6 +32,17 @@ Codes:
                               lock scope / on the wrong thread domain
   L002 lock-order-inversion   cycle in the lock-acquisition graph
                               (lexical nesting + same-class calls)
+  L003 wait-outside-while     `Condition.wait()` not lexically inside a
+                              `while` loop: a notify is not a promise
+                              the predicate holds (spurious wakeups,
+                              stolen wakeups, notify_all storms) —
+                              `wait_for()` loops internally and is
+                              exempt
+  L004 notify-outside-lock    `notify()/notify_all()` on a Condition
+                              whose lock is not held at the call site:
+                              the runtime raises for this, but only on
+                              the path that reaches it — the linter
+                              finds the path first
 """
 
 from __future__ import annotations
@@ -61,6 +72,9 @@ _MUTATORS = {
     "appendleft", "extendleft", "clear", "add", "discard", "update",
     "setdefault", "popitem", "sort", "reverse", "rotate",
 }
+# Condition-discipline ops (L003/L004). `wait_for` is recorded but
+# never L003-flagged: it re-evaluates its predicate internally.
+_COND_OPS = {"wait", "wait_for", "notify", "notify_all"}
 
 # sentinel context: "only construction has reached this method"
 _EXEMPT = "exempt"
@@ -104,6 +118,10 @@ class _Method(object):
         self.acquisitions: List[Tuple[str, int, FrozenSet[str]]] = []
         # (callee, lineno, frozenset(held locks at the call))
         self.calls: List[Tuple[str, int, FrozenSet[str]]] = []
+        # Condition-discipline sites: (cond attr, op, lineno,
+        # frozenset(held locks), lexically-inside-a-while)
+        self.cond_calls: List[Tuple[str, str, int, FrozenSet[str],
+                                    bool]] = []
         self.context = _TOP  # fixpoint: _TOP -> _EXEMPT | frozenset
 
 
@@ -112,6 +130,10 @@ class _Class(object):
         self.node = node
         self.name = node.name
         self.locks: Set[str] = set()
+        # Condition attr -> the lock that must be held to wait/notify
+        # on it: itself, or the explicit `threading.Condition(self.X)`
+        # lock argument
+        self.conditions: Dict[str, str] = {}
         self.guards: Dict[str, str] = {}   # attr -> guard name
         self.guard_lines: Dict[str, int] = {}
         self.methods: Dict[str, _Method] = {}
@@ -161,6 +183,15 @@ def _scan_method_decls(cls: _Class, meth: _Method, annots):
                              else value.func.id)
                     if fname in _LOCK_CTORS:
                         cls.locks.add(attr)
+                    if fname == "Condition":
+                        lock_arg = (value.args[0] if value.args
+                                    else None)
+                        for kw in value.keywords:
+                            if kw.arg == "lock":
+                                lock_arg = kw.value
+                        explicit = (_self_attr(lock_arg)
+                                    if lock_arg is not None else None)
+                        cls.conditions[attr] = explicit or attr
                 end = getattr(node, "end_lineno", node.lineno)
                 for ln in range(node.lineno, end + 1):
                     for kind, val in annots.get(ln, ()):
@@ -178,14 +209,15 @@ def _scan_method_body(cls: _Class, meth: _Method):
     if hasattr(ast, "match_case"):
         suite_nodes += (ast.match_case,)
 
-    def scan_exprs(stmt, held):
-        """Calls (mutator methods + same-class self.m()) in the
-        statement's OWN expressions — child statement suites (including
-        except handlers and match cases) are walked by do_stmt with
-        their own held sets. A lambda body is DEFERRED execution: it
-        cannot assume the caller's locks, so its mutations record with
-        an empty held-set (a `pool.submit(lambda: self.q.append(x))`
-        under the lock still runs lockless later)."""
+    def scan_exprs(stmt, held, in_while=False):
+        """Calls (mutator methods + same-class self.m() + Condition
+        wait/notify ops) in the statement's OWN expressions — child
+        statement suites (including except handlers and match cases)
+        are walked by do_stmt with their own held sets. A lambda body
+        is DEFERRED execution: it cannot assume the caller's locks, so
+        its mutations record with an empty held-set (a
+        `pool.submit(lambda: self.q.append(x))` under the lock still
+        runs lockless later)."""
         for _name, value in ast.iter_fields(stmt):
             values = value if isinstance(value, list) else [value]
             for v in values:
@@ -208,18 +240,22 @@ def _scan_method_body(cls: _Class, meth: _Method):
                     if base_attr is not None and func.attr in _MUTATORS:
                         meth.mutations.append(
                             (base_attr, sub.lineno, h))
+                    if base_attr is not None and func.attr in _COND_OPS:
+                        meth.cond_calls.append(
+                            (base_attr, func.attr, sub.lineno, h,
+                             in_while))
                     if (isinstance(func.value, ast.Name)
                             and func.value.id == "self"
                             and func.attr in cls.methods):
                         meth.calls.append((func.attr, sub.lineno, h))
 
-    def do_stmt(node, held: FrozenSet[str]):
+    def do_stmt(node, held: FrozenSet[str], in_while: bool = False):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             return  # nested defs: out of scope for this pass
         if isinstance(node, (ast.With, ast.AsyncWith)):
             inner = set(held)
-            scan_exprs(node, held)
+            scan_exprs(node, held, in_while)
             for item in node.items:
                 attr = _self_attr(item.context_expr)
                 if attr is not None and attr in cls.locks:
@@ -227,7 +263,7 @@ def _scan_method_body(cls: _Class, meth: _Method):
                         (attr, node.lineno, frozenset(inner)))
                     inner.add(attr)
             for s in node.body:
-                do_stmt(s, frozenset(inner))
+                do_stmt(s, frozenset(inner), in_while)
             return
         if isinstance(node, ast.Assign):
             for t in node.targets:
@@ -241,20 +277,30 @@ def _scan_method_body(cls: _Class, meth: _Method):
         elif isinstance(node, ast.Delete):
             for t in node.targets:
                 _record_mut(cls, meth, t, node.lineno, held)
-        scan_exprs(node, held)
-        for _name, value in ast.iter_fields(node):
+        # a While's test AND body both re-run per iteration: a wait()
+        # anywhere under it gets its predicate re-checked (the
+        # `while True: ... if p: break ... wait()` idiom included);
+        # the else: suite runs once, after the loop — not re-checked
+        here = in_while or isinstance(node, ast.While)
+        scan_exprs(node, held, here)
+        for fname, value in ast.iter_fields(node):
+            # a While's own body re-runs per iteration; its else:
+            # suite runs once after the loop — but inherits any OUTER
+            # while's re-run context
+            suite_while = in_while or (
+                isinstance(node, ast.While) and fname != "orelse")
             values = value if isinstance(value, list) else [value]
             for v in values:
                 if isinstance(v, ast.stmt):
-                    do_stmt(v, held)
+                    do_stmt(v, held, suite_while)
                 elif isinstance(v, suite_nodes):
                     # except handlers / match cases: their OWN
                     # expressions (case guard/pattern, except type)
                     # scan here; their bodies are statement suites
                     # under the same held-set
-                    scan_exprs(v, held)
+                    scan_exprs(v, held, suite_while)
                     for s in getattr(v, "body", ()):
-                        do_stmt(s, held)
+                        do_stmt(s, held, suite_while)
 
     for s in meth.node.body:
         do_stmt(s, frozenset())
@@ -393,6 +439,29 @@ def _check_class(cls: _Class, path: str, diags: List[Diagnostic]):
                         "%r is confined to the %r domain but mutated "
                         "in a method %s '# thread: %s'"
                         % (attr, guard, how, dom)))
+
+        for attr, op, lineno, held, in_while in meth.cond_calls:
+            owner = cls.conditions.get(attr)
+            if owner is None:
+                continue  # .wait()/.notify() on a non-Condition attr
+            if op == "wait" and not in_while:
+                diags.append(make(
+                    "L003", path, lineno, meth.symbol, attr,
+                    "%r.wait() outside a while-predicate loop: a "
+                    "notify is not a promise the predicate holds "
+                    "(spurious/stolen wakeups) — re-test in a while, "
+                    "or use wait_for()" % attr))
+            # holding the Condition ITSELF counts: `with self._cv:`
+            # acquires the (possibly explicit) lock it wraps
+            if op in ("notify", "notify_all") \
+                    and not ({owner, attr} & (held | assumed)):
+                diags.append(make(
+                    "L004", path, lineno, meth.symbol, attr,
+                    "%r.%s() without holding %r (held here: %s): the "
+                    "runtime raises RuntimeError on whichever path "
+                    "reaches this first"
+                    % (attr, op, owner,
+                       sorted(held | assumed) or "nothing")))
 
     _check_lock_order(cls, path, diags)
 

@@ -1,0 +1,341 @@
+"""Journal state-machine verifier: a per-rid DFA over `RequestJournal`
+files (ISSUE 9 tentpole, engine 1 of 2).
+
+The serving fleet's correctness story is its request journal: every
+submit/assign/progress/terminal transition is appended before the fleet
+acts on it, and failover/restart recover FROM the file. Five post-merge
+review passes of PRs 6-8 each found a protocol bug by hand (idempotent-
+reject double counting, superseded-assignment acceptance, probe
+wedges) — bugs that all leave a FINGERPRINT in the journal. This module
+machine-checks that fingerprint: it replays a journal file through the
+protocol DFA the fleet promises
+
+    submit -> assign -> progress* -> exactly one of done|rejected|expired
+
+and reports violations as stable J-codes:
+
+  J001 orphan-record      assign/progress/terminal for a rid this file
+                          never saw submitted
+  J002 duplicate-terminal a second done/rejected/expired for one rid
+  J003 record-after-terminal  assign/progress after the rid's verdict
+  J004 stale-fence        progress/done carrying a (replica,
+                          incarnation, generation) that is not the
+                          rid's LATEST assignment — the zombie-holder
+                          acceptance the fleet's lease fence must refuse
+  J005 progress-terminal-mismatch  a done/expired record whose tokens
+                          differ from the rid's accumulated journaled
+                          progress (a re-decoded or double-prepended
+                          token: the superseded-report bug class)
+  J006 unassigned-progress  progress from a named replica with no
+                          assignment in effect (the restart-resume
+                          record `__restart__` and compaction's
+                          consolidated `replica: null` form are the two
+                          sanctioned exceptions)
+  J007 open-at-close      with `expect_closed=True`: a rid left open —
+                          `ServingFleet.close()` promises every
+                          journaled rid ends in a verdict
+  J008 malformed-journal  unreadable mid-file record, unknown kind,
+                          missing fields, or a compaction meta record
+                          anywhere but the file head (compaction
+                          REWRITES the file; meta mid-file means two
+                          histories were glued together)
+
+A torn FINAL line is tolerated exactly like `RequestJournal._read`
+(the crash the journal exists to survive must not fail its own audit);
+torn-then-more-records is real corruption and reports J008.
+
+Compaction invariant: a compacted file replays to the same open set and
+the same concatenated progress prefixes — checked by running the same
+DFA over the rewritten file (`verify_journal` after `compact()`); a
+compaction that drops an open rid shows up as J001 (its later records
+orphaned) or as a J005 prefix mismatch at its terminal.
+
+Entry points: `verify_journal(path, expect_closed=False)` (library),
+`python -m paddle_tpu.analysis journal <path> [--expect-closed]` (CLI),
+and the opt-in `PADDLE_TPU_AUDIT_JOURNAL=1` hook in
+`ServingFleet.close()` which audits the live journal so every fleet
+test and bench run double-checks itself for free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .diagnostics import Diagnostic, make, rel_path
+
+__all__ = ["verify_journal", "verify_records", "JournalViolation"]
+
+_TERMINAL = ("done", "rejected", "expired")
+_KINDS = ("meta", "submit", "assign", "progress") + _TERMINAL
+
+# the front-door-restart resume prefix: journaled by submit() before any
+# assignment exists, under this sentinel holder (fleet.py submit())
+_RESTART = "__restart__"
+
+_REQUIRED = {
+    "meta": ("max_rid",),
+    "submit": ("rid", "spec"),
+    "assign": ("rid", "replica", "incarnation", "gen"),
+    "progress": ("rid", "replica", "incarnation", "gen", "tokens"),
+    "done": ("rid", "replica", "incarnation", "gen", "tokens"),
+    "rejected": ("rid", "reason"),
+    "expired": ("rid", "tokens"),
+}
+
+# field -> accepted types: a JSON-parseable record with an ill-typed
+# field is J008, never a TypeError out of the DFA (the never-crash
+# contract). replica/incarnation/gen are nullable — compaction's
+# consolidated progress form writes all three as null.
+_FIELD_TYPES = {
+    "rid": (int,),
+    "max_rid": (int,),
+    "spec": (dict,),
+    "reason": (str,),
+    "tokens": (list,),
+    "replica": (str, type(None)),
+    "incarnation": (int, type(None)),
+    "gen": (int, type(None)),
+}
+
+
+def _ill_typed(rec, kind):
+    """Name of the first ill-typed required field, or None."""
+    for field in _REQUIRED[kind]:
+        if not isinstance(rec[field], _FIELD_TYPES[field]):
+            return field
+    return None
+
+
+class JournalViolation(RuntimeError):
+    """Raised by the `PADDLE_TPU_AUDIT_JOURNAL=1` close() audit when
+    the live journal fails the protocol DFA. Carries the diagnostics."""
+
+    def __init__(self, path: str, diagnostics: List[Diagnostic]):
+        from .diagnostics import format_diag
+
+        self.diagnostics = list(diagnostics)
+        super().__init__(
+            "journal %s violates the request protocol (%d finding%s):"
+            "\n  %s" % (path, len(self.diagnostics),
+                        "" if len(self.diagnostics) == 1 else "s",
+                        "\n  ".join(format_diag(d)
+                                    for d in self.diagnostics)))
+
+
+class _Rid(object):
+    """DFA state for one request id."""
+
+    __slots__ = ("state", "assign", "progress", "terminal_line")
+
+    def __init__(self):
+        self.state = "open"          # open -> terminal
+        self.assign: Optional[Tuple[str, int, int]] = None
+        self.progress: List[int] = []
+        self.terminal_line = 0
+
+
+def _iter_records(path: str):
+    """(lineno, record-or-None, raw) — a None record is a parse
+    failure; final-line failures are torn tails (tolerated), earlier
+    ones are J008 (the caller decides, mirroring RequestJournal._read's
+    torn-tail rule without raising)."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                yield lineno, None, line
+                continue
+            if not isinstance(rec, dict):
+                yield lineno, None, line
+                continue
+            yield lineno, rec, line
+
+
+def verify_records(records, path_label: str = "<journal>",
+                   expect_closed: bool = False) -> List[Diagnostic]:
+    """Run the protocol DFA over an iterable of (lineno, record) pairs
+    (already-parsed journal records). The library half of
+    `verify_journal`, reusable over in-memory record lists (tests, the
+    explorer's invariant probes)."""
+    diags: List[Diagnostic] = []
+    rids: Dict[int, _Rid] = {}
+
+    def diag(code, lineno, rid, detail, msg):
+        # a malformed record's rid may be any JSON value — the symbol
+        # must describe it, never crash the describer
+        sym = "rid%d" % rid if isinstance(rid, int) else "journal"
+        diags.append(make(code, path_label, lineno, sym, detail, msg))
+
+    first_record = True
+    for lineno, rec in records:
+        kind = rec.get("kind")
+        if kind not in _KINDS:
+            diag("J008", lineno, rec.get("rid"), "kind:%r" % (kind,),
+                 "unknown record kind %r" % (kind,))
+            first_record = False
+            continue
+        missing = [k for k in _REQUIRED[kind] if k not in rec]
+        if missing:
+            diag("J008", lineno, rec.get("rid"),
+                 "%s:missing:%s" % (kind, ",".join(missing)),
+                 "%s record missing field(s) %s" % (kind,
+                                                    ", ".join(missing)))
+            first_record = False
+            continue
+        bad = _ill_typed(rec, kind)
+        if bad is not None:
+            rid = rec["rid"] if isinstance(rec.get("rid"), int) else None
+            diag("J008", lineno, rid, "%s:ill-typed:%s" % (kind, bad),
+                 "%s record field %r has type %s, expected %s"
+                 % (kind, bad, type(rec[bad]).__name__,
+                    "/".join(t.__name__ for t in _FIELD_TYPES[bad])))
+            first_record = False
+            continue
+        if kind == "meta":
+            if not first_record:
+                diag("J008", lineno, None, "meta-mid-file",
+                     "compaction meta record at line %d is not at the "
+                     "file head: compaction rewrites the WHOLE file, a "
+                     "mid-file meta means two histories were glued "
+                     "together" % lineno)
+            first_record = False
+            continue
+        first_record = False
+        rid = rec["rid"]
+        st = rids.get(rid)
+        if kind == "submit":
+            if st is not None:
+                code = ("J003" if st.state == "terminal" else "J001")
+                diag(code, lineno, rid, "resubmit",
+                     "duplicate submit for rid %d (already %s)"
+                     % (rid, st.state))
+                continue
+            rids[rid] = _Rid()
+            continue
+        if st is None:
+            diag("J001", lineno, rid, "orphan:%s" % kind,
+                 "%s record for rid %d that was never submitted in "
+                 "this file" % (kind, rid))
+            # keep tracking, applying this record's state effects
+            # WITHOUT further checks: one orphan is one finding, not a
+            # cascade of secondary fence/terminal violations
+            st = rids[rid] = _Rid()
+            if kind == "assign":
+                st.assign = (rec["replica"], rec["incarnation"],
+                             rec["gen"])
+            elif kind == "progress":
+                st.progress.extend(rec["tokens"])
+            else:
+                st.state = "terminal"
+                st.terminal_line = lineno
+            continue
+        if st.state == "terminal":
+            code = "J002" if kind in _TERMINAL else "J003"
+            diag(code, lineno, rid, "%s-after-terminal" % kind,
+                 "%s record for rid %d after its terminal record "
+                 "(line %d): the DFA allows exactly one verdict"
+                 % (kind, rid, st.terminal_line))
+            continue
+        if kind == "assign":
+            st.assign = (rec["replica"], rec["incarnation"], rec["gen"])
+            continue
+        if kind == "progress":
+            holder = (rec["replica"], rec["incarnation"], rec["gen"])
+            if rec["replica"] is None or rec["replica"] == _RESTART:
+                # compaction's consolidated form / the restart resume
+                # prefix: both precede (or replace) any assignment
+                pass
+            elif st.assign is None:
+                diag("J006", lineno, rid, "progress:%s" % rec["replica"],
+                     "progress for rid %d from %r with no assignment "
+                     "in effect" % (rid, rec["replica"]))
+            elif holder != st.assign:
+                diag("J004", lineno, rid,
+                     "progress:%s" % (rec["replica"],),
+                     "progress for rid %d from %r (incarnation %r, gen "
+                     "%r) but the latest assignment is %r — a stale "
+                     "holder's tokens were accepted past the lease "
+                     "fence" % (rid, rec["replica"], rec["incarnation"],
+                                rec["gen"], (st.assign,)))
+            st.progress.extend(rec["tokens"])
+            continue
+        # terminal kinds
+        st.state = "terminal"
+        st.terminal_line = lineno
+        if kind == "done":
+            holder = (rec["replica"], rec["incarnation"], rec["gen"])
+            if rec["replica"] == _RESTART and st.assign is None:
+                pass  # completed straight from the restart prefix
+            elif st.assign is None:
+                diag("J006", lineno, rid, "done:%s" % (rec["replica"],),
+                     "done for rid %d from %r with no assignment in "
+                     "effect" % (rid, rec["replica"]))
+            elif holder != st.assign:
+                diag("J004", lineno, rid, "done:%s" % (rec["replica"],),
+                     "done for rid %d from %r (incarnation %r, gen %r) "
+                     "but the latest assignment is %r — a zombie "
+                     "holder's completion was accepted"
+                     % (rid, rec["replica"], rec["incarnation"],
+                        rec["gen"], (st.assign,)))
+        if kind in ("done", "expired"):
+            # no empty-progress exemption: the fleet journals EVERY
+            # emitted token as a progress delta before the terminal
+            # (the PR-8 re-decode-zero audit depends on it), so a done
+            # with tokens but no journaled progress is exactly the
+            # never-journaled defect this code names
+            if list(rec["tokens"]) != st.progress:
+                diag("J005", lineno, rid, "%s-tokens" % kind,
+                     "%s tokens for rid %d (%d token(s)) differ from "
+                     "the accumulated journaled progress (%d token(s)) "
+                     "— a token was re-decoded, double-prepended, or "
+                     "never journaled" % (kind, rid, len(rec["tokens"]),
+                                          len(st.progress)))
+    if expect_closed:
+        for rid in sorted(rids):
+            st = rids[rid]
+            if st.state != "terminal":
+                diags.append(make(
+                    "J007", path_label, 0, "rid%d" % rid, "open",
+                    "rid %d is still open at end of journal — close() "
+                    "promises every journaled rid a terminal verdict"
+                    % rid))
+    diags.sort(key=lambda d: (d.line, d.code, d.symbol))
+    return diags
+
+
+def verify_journal(path: str,
+                   expect_closed: bool = False) -> List[Diagnostic]:
+    """Verify a `RequestJournal` file against the protocol DFA.
+    Returns the J-coded findings (empty = the journal is a valid
+    history). Tolerates a torn final line; anything unparseable
+    earlier is J008, not an exception — an auditor must be able to
+    describe a corrupt journal, not crash on it."""
+    if not os.path.exists(path):
+        raise FileNotFoundError("no such journal: %r" % path)
+    label = rel_path(path)
+    parsed: List[Tuple[int, dict]] = []
+    torn: Optional[Tuple[int, str]] = None
+    diags: List[Diagnostic] = []
+    for lineno, rec, raw in _iter_records(path):
+        if torn is not None:
+            # an unparseable line FOLLOWED by more content is not a
+            # torn tail — it is mid-file corruption
+            diags.append(make(
+                "J008", label, torn[0], "journal", "corrupt-line",
+                "unparseable record at line %d is not a torn tail "
+                "(records follow it)" % torn[0]))
+            torn = None
+        if rec is None:
+            torn = (lineno, raw)
+            continue
+        parsed.append((lineno, rec))
+    diags.extend(verify_records(parsed, path_label=label,
+                                expect_closed=expect_closed))
+    diags.sort(key=lambda d: (d.line, d.code, d.symbol))
+    return diags

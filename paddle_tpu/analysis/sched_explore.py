@@ -1,0 +1,744 @@
+"""Deterministic schedule explorer for the serving fleet (CHESS-lite;
+ISSUE 9 tentpole, engine 2 of 2).
+
+Every protocol bug the PR 6-8 review passes found by hand was an
+INTERLEAVING: a replica handshake racing a demotion racing a close.
+Lexical linters cannot see interleavings; systematic concurrency
+testing can (Musuvathi et al., "Finding and Reproducing Heisenbugs in
+Concurrent Programs"). This module is that idea cut down to this
+fleet's seam:
+
+  * The fleet's `SchedulerHook` (serving/fleet.py) marks every
+    thread-handoff point — replica handshake, engine step, monitor
+    sweep, journal flush, submit commit — all OUTSIDE fleet locks.
+    `ControlledScheduler` parks each fleet thread there and runs
+    exactly ONE thread at a time; the driver picks who goes next.
+  * Scenarios (`SCENARIOS`) build a small fleet over `ScriptEngine` —
+    a host-only, deterministic fake engine (one token per step, a pure
+    function of (prompt, seed, index), honest `resume_tokens`
+    semantics) — so a whole run takes milliseconds and every branch
+    the fleet takes is a function of the SCHEDULE alone: heartbeats
+    are sized out, deadlines unset, demotion is operator-driven.
+  * A schedule is the sequence of choices the driver made (one name
+    per step). `run_schedule(scenario, decisions)` replays a decision
+    prefix then falls back to the default policy; the same prefix
+    always reproduces the same trace, so a violation PRINTS the exact
+    schedule that breaks and `--replay` re-runs it.
+  * `explore(scenario)` enumerates schedules with bounded preemptions
+    (CHESS's insight: most heisenbugs need very few): run the default
+    schedule, then branch every choice point where more than one
+    thread was enabled, up to `max_preemptions` deviations.
+
+Invariant probes checked after every run (the fleet's falsifiability
+bar, machine-checked): every handle reaches a verdict and completed
+outputs are token-identical to the scripted oracle; `stats()["lost"]
+== 0`; no request is answered twice; the journal file passes the
+protocol DFA (`protocol_lint.verify_journal`, close-invariant
+included) and its mirror agrees with the file (`recover()` finds
+nothing open).
+
+CLI:  python -m paddle_tpu.analysis explore [--scenario NAME]
+          [--preemptions K] [--max-schedules N] [--replay CSV]
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..serving.fleet import SchedulerHook, ServingFleet
+
+__all__ = [
+    "ControlledScheduler", "ScriptEngine", "Scenario", "SCENARIOS",
+    "RunResult", "run_schedule", "explore", "format_schedule",
+    "script_tokens",
+]
+
+# one released thread must reach its next yield point (or exit) within
+# this budget; past it the run is reported as a WEDGE (the probe-wedge
+# bug class), not silently stuck
+_QUIESCE_TIMEOUT_S = 20.0
+
+
+# ---------------------------------------------------------------------------
+# scripted engine: the deterministic stand-in for ServingEngine
+# ---------------------------------------------------------------------------
+
+def script_tokens(prompt, seed: int, n: int) -> List[int]:
+    """The scripted oracle: token i of a request is a pure function of
+    (prompt, seed, i) — like the real engine's (seed, token index)
+    sampling keys, the schedule/replica/resume split can never change
+    WHICH tokens a request decodes to, only who emits them."""
+    base = int(np.asarray(prompt, np.int64).sum()) % 1000
+    return [(base * 7 + int(seed) * 13 + i * 3) % 97 for i in range(n)]
+
+
+class _ScriptHandle(object):
+    """Matches the real ServingHandle's resume contract: `tokens`
+    holds only NEWLY generated tokens (the fleet prepends the resume
+    prefix itself at completion), and generation continues at token
+    index `len(resume)` of the per-request script."""
+
+    def __init__(self, prompt, max_new, seed, resume):
+        self.rid = -1  # assigned by ScriptEngine.submit
+        self.tokens: List[int] = []
+        self._script = script_tokens(prompt, seed, int(max_new))
+        self._at = len(resume)
+        if list(resume) != self._script[:self._at]:
+            # an honest engine decodes the remainder AFTER the resume
+            # prefix; a prefix that disagrees with the script would let
+            # a protocol bug hide behind engine nondeterminism
+            raise AssertionError(
+                "resume prefix %r disagrees with the script %r"
+                % (list(resume), self._script))
+        self.done = self._at >= len(self._script)
+        self.finish_reason = "done" if self.done else None
+
+    def _step(self):
+        if self.done:
+            return
+        self.tokens.append(self._script[self._at])
+        self._at += 1
+        if self._at >= len(self._script):
+            self.done = True
+            self.finish_reason = "done"
+
+
+class _ScriptMetrics(object):
+    """The metric surface `_Replica._stats` reads, scripted."""
+
+    def __init__(self, step_ewma_s):
+        self.tokens_out = 0
+        self.decode_steps = 0
+        self.prefills = 0
+        self.prefill_tokens_computed = 0
+        self.kv_blocks_in_use = 0
+        self.kv_blocks_freed_at_retire = 0
+        self.kv_tail_blocks_freed = 0
+        self.cow_blocks = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.expired = 0
+        self.resumed_requests = 0
+        self.resume_tokens_reused = 0
+        self.step_ewma_s = step_ewma_s
+
+
+class ScriptEngine(object):
+    """Host-only deterministic engine for schedule exploration: one
+    token per `step()` per live request, tokens a pure function of
+    (prompt, seed, index), honest `resume_tokens` (the remainder is
+    decoded from the resume index, never re-decoded), `cancel()` claws
+    work back. No jax, no wall-clock dependence — a fleet over this
+    engine is a pure function of the schedule."""
+
+    def __init__(self, params, cfg, replica_id=None, scheduler_hook=None,
+                 step_ewma_s=0.001, **_kw):
+        self.replica_id = replica_id
+        self._hook = scheduler_hook
+        self._serving: Dict[int, _ScriptHandle] = {}
+        self._aborted: Optional[BaseException] = None
+        self.metrics = _ScriptMetrics(step_ewma_s)
+        self.prefix_cache = None
+
+    def submit(self, prompt, max_new_tokens, temperature=0.0,
+               eos_id=None, seed=0, publish_len=None, deadline_at=None,
+               resume_tokens=None):
+        h = _ScriptHandle(prompt, max_new_tokens, seed,
+                          resume_tokens or [])
+        if resume_tokens:
+            self.metrics.resumed_requests += 1
+            self.metrics.resume_tokens_reused += len(resume_tokens)
+        # fresh engine-local id (the fleet keeps its own rid map; ours
+        # only needs cancel() to find the slot)
+        h.rid = max(self._serving, default=-1) + 1
+        self._serving[h.rid] = h
+        return h
+
+    def step(self):
+        if self._hook is not None:
+            self._hook.yield_point(
+                "engine:%s:step" % (self.replica_id or ""))
+        if self._aborted is not None:
+            raise self._aborted
+        for h in list(self._serving.values()):
+            h._step()
+            self.metrics.tokens_out += 1
+            if h.done:
+                self._serving.pop(h.rid)
+        self.metrics.decode_steps += 1
+        return bool(self._serving)
+
+    def cancel(self, rid) -> bool:
+        return self._serving.pop(rid, None) is not None
+
+    def abort(self, exc: BaseException):
+        self._aborted = exc
+        self._serving.clear()
+
+    @property
+    def live_slots(self) -> int:
+        return len(self._serving)
+
+    @property
+    def queue_depth(self) -> int:
+        return 0
+
+    @property
+    def prefilling_slots(self) -> int:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# the controlled scheduler
+# ---------------------------------------------------------------------------
+
+class SchedulerWedge(RuntimeError):
+    """A released thread failed to reach its next yield point (or
+    exit) within the quiescence budget — the wedge bug class."""
+
+
+class ControlledScheduler(SchedulerHook):
+    """One-thread-at-a-time cooperative scheduler over the fleet's
+    `SchedulerHook` seam. Registered threads (the fleet's replicas and
+    monitor, plus scenario threads spawned via `spawn()`) park at
+    every yield point until `step(name)` releases them for exactly one
+    hop; unregistered threads (the driver) pass through untouched.
+    `release_all()` opens the gate permanently (teardown:
+    `fleet.close()` joins threads, which must then free-run)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._names: Dict[int, str] = {}      # guarded-by: _cv
+        self._parked: Dict[str, str] = {}     # name -> point; guarded-by: _cv
+        self._exited: set = set()             # guarded-by: _cv
+        self._free = False                    # guarded-by: _cv
+        self._threads: Dict[str, threading.Thread] = {}  # guarded-by: _cv
+
+    # -- SchedulerHook (called from fleet threads) ---------------------
+    def thread_started(self, kind: str, name: str):
+        with self._cv:
+            self._names[threading.get_ident()] = name
+            self._threads[name] = threading.current_thread()
+            self._cv.notify_all()
+
+    def thread_exiting(self):
+        with self._cv:
+            name = self._names.pop(threading.get_ident(), None)
+            if name is not None:
+                self._exited.add(name)
+                self._parked.pop(name, None)
+                self._cv.notify_all()
+
+    def yield_point(self, point: str):
+        with self._cv:
+            if self._free:
+                return
+            name = self._names.get(threading.get_ident())
+            if name is None:
+                return  # unregistered (driver) thread: pass through
+            self._parked[name] = point
+            self._cv.notify_all()
+            while name in self._parked and not self._free:
+                self._cv.wait(timeout=0.5)
+
+    # -- driver surface ------------------------------------------------
+    def spawn(self, name: str, fn: Callable[[], None]) -> threading.Thread:
+        """Run `fn` on a REGISTERED scenario thread: it parks once at
+        "scenario:<name>:start" before `fn` begins, then at every
+        fleet yield point it hits, like any fleet thread. Blocks until
+        that first park (or exit) — returning earlier would let the
+        driver's next enabled() RACE the registration, making the
+        recorded schedule timing-dependent and breaking replay."""
+        def body():
+            self.thread_started("scenario", name)
+            try:
+                self.yield_point("scenario:%s:start" % name)
+                fn()
+            finally:
+                self.thread_exiting()
+        t = threading.Thread(target=body, name="sched-%s" % name,
+                             daemon=True)
+        t.start()
+        deadline = time.monotonic() + _QUIESCE_TIMEOUT_S
+        with self._cv:
+            while (name not in self._parked and name not in self._exited
+                   and not self._free):
+                if time.monotonic() > deadline:
+                    raise SchedulerWedge(
+                        "spawned thread %r failed to reach its start "
+                        "park" % name)
+                self._cv.wait(timeout=0.05)
+        return t
+
+    def await_quiescent(self, expected: Optional[int] = None,
+                        timeout: float = _QUIESCE_TIMEOUT_S):
+        """Block until every registered, live thread is parked (and,
+        with `expected`, until at least that many threads exist)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                live = [n for n in self._names.values()]
+                ok = all(n in self._parked for n in live)
+                if ok and (expected is None
+                           or len(live) + len(self._exited) >= expected):
+                    return
+                if time.monotonic() > deadline:
+                    raise SchedulerWedge(
+                        "threads failed to quiesce: live=%r parked=%r"
+                        % (sorted(live), sorted(self._parked)))
+                self._cv.wait(timeout=0.05)
+
+    def enabled(self) -> List[str]:
+        with self._cv:
+            return sorted(self._parked)
+
+    def parked_point(self, name: str) -> Optional[str]:
+        with self._cv:
+            return self._parked.get(name)
+
+    def step(self, name: str, timeout: float = _QUIESCE_TIMEOUT_S):
+        """Release thread `name` for one hop; block until it parks at
+        its next yield point or exits."""
+        with self._cv:
+            if name not in self._parked:
+                raise KeyError("thread %r is not parked" % name)
+            self._parked.pop(name)
+            self._cv.notify_all()
+            deadline = time.monotonic() + timeout
+            while (name in self._names.values()
+                   and name not in self._parked
+                   and name not in self._exited):
+                if time.monotonic() > deadline:
+                    raise SchedulerWedge(
+                        "released thread %r failed to park or exit "
+                        "within %.0fs (wedged between yield points)"
+                        % (name, timeout))
+                self._cv.wait(timeout=0.05)
+
+    def release_all(self):
+        with self._cv:
+            self._free = True
+            self._parked.clear()
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+class _Ctx(object):
+    """Per-run scenario context handed to ops and invariant checks."""
+
+    def __init__(self, fleet, sched, journal_path):
+        self.fleet = fleet
+        self.sched = sched
+        self.journal_path = journal_path
+        self.handles = []            # (handle, prompt, seed, max_new)
+        self.submit_errors: List[BaseException] = []
+        self.threads: List[threading.Thread] = []
+
+    def submit(self, prompt, max_new, seed=0):
+        h = self.fleet.submit(np.asarray(prompt, np.int32), max_new,
+                              seed=seed, slo=None)
+        self.handles.append((h, list(prompt), seed, max_new))
+        return h
+
+
+class Scenario(object):
+    """One explorable fleet scenario: `build()` constructs the fleet
+    (ScriptEngine-backed), `ops` is the driver's scripted op list —
+    each op is (label, when(ctx) -> bool, run(ctx)) and fires as a
+    "main" schedule choice once its precondition holds — and
+    `finished(ctx)` ends the controlled phase. Extra invariants beyond
+    the common probes go in `check(ctx) -> [violation strings]`."""
+
+    name = "scenario"
+    n_replicas = 2
+    expect_failures = False  # close-race: EngineFailed verdicts are ok
+
+    def fleet_kw(self) -> dict:
+        return {}
+
+    def build(self, sched, journal_path) -> _Ctx:
+        cfg = type("Cfg", (), {"max_len": 64})()
+        params = {"pos": np.zeros((64, 4), np.float32)}
+        kw = dict(
+            n_replicas=self.n_replicas, journal_path=journal_path,
+            heartbeat_timeout_s=3600.0, monitor_interval_s=0.001,
+            affinity=False, auto_refill=False,
+            engine_factory=ScriptEngine, scheduler_hook=sched,
+        )
+        kw.update(self.fleet_kw())
+        fleet = ServingFleet(params, cfg, **kw)
+        # idle replicas sleep this long per handshake with nothing to
+        # do; under the controlled scheduler that wall time is pure
+        # overhead (the driver serializes everything), so shrink it
+        fleet._idle_wait_s = 0.0005
+        return _Ctx(fleet, sched, journal_path)
+
+    def ops(self) -> List[Tuple[str, Callable, Callable]]:
+        return []
+
+    def finished(self, ctx: _Ctx) -> bool:
+        return all(h.done for h, _p, _s, _n in ctx.handles)
+
+    def check(self, ctx: _Ctx) -> List[str]:
+        return []
+
+
+def _always(_ctx):
+    return True
+
+
+class SubmitKillScenario(Scenario):
+    """The PR-6 drill as an explored schedule space: two requests, one
+    replica killed while (potentially) holding both — journal-driven
+    failover must land every request on the survivor with
+    token-identical output, whatever the kill lands between."""
+
+    name = "submit_kill"
+    n_replicas = 2
+
+    def ops(self):
+        return [
+            ("submit0", _always, lambda c: c.submit([3, 1, 4], 4, seed=1)),
+            ("submit1", _always, lambda c: c.submit([2, 7], 3, seed=2)),
+            ("kill_r0", _always, lambda c: c.fleet.kill_replica(0)),
+        ]
+
+
+class DemoteRouteBackScenario(Scenario):
+    """The PR-8 fence-hole window: r0 finishes a request locally but
+    has NOT yet reported it; the request is hedged away (demotion),
+    the survivor dies, and the request routes BACK to demoted r0 —
+    whose next handshake reports the completion of the SUPERSEDED
+    submission. The fleet must refuse it (the in-flight fence); the
+    `superseded_report` mutant accepts it and double-prepends the
+    resume prefix — caught by the token-identity probe and the
+    journal DFA's J005."""
+
+    name = "demote_route_back"
+    n_replicas = 2
+
+    def _demote_ready(self, ctx):
+        # r0 has journaled 2 of 3 tokens AND is parked at its sync
+        # yield: token 3 is emitted and the completion is buffered but
+        # UNREPORTED — the exact superseded-report window. A deviating
+        # schedule can run r0 THROUGH the window (the request
+        # completes); the op then fires as a harmless late demotion
+        # instead of wedging the op queue
+        if not ctx.handles:
+            return False
+        h = ctx.handles[0][0]
+        if h.done:
+            return True
+        prog = ctx.fleet._journal.progress_of(h.rid)
+        parked = ctx.sched.parked_point("r0.i1")
+        return len(prog) >= 2 and parked == "replica:r0:sync"
+
+    def _demote(self, ctx):
+        with ctx.fleet._cond:
+            ctx.fleet._demote_locked(0)
+
+    def ops(self):
+        return [
+            ("submit0", _always, lambda c: c.submit([5, 9], 3, seed=3)),
+            ("demote_r0", self._demote_ready, self._demote),
+            ("kill_r1", _always, lambda c: c.fleet.kill_replica(1)),
+        ]
+
+
+class CloseRaceScenario(Scenario):
+    """The PR-6 idempotent-reject window: a submit parks between its
+    durable journal write and its routing critical section
+    ("submit:commit") while a close() sweeps the open set — both sides
+    reach the same rid's terminal bookkeeping, which must happen
+    exactly once. The `double_reject` mutant counts it twice and
+    drives stats()['lost'] negative."""
+
+    name = "close_race"
+    n_replicas = 1
+    expect_failures = True
+
+    def _spawn_submitter(self, ctx):
+        def body():
+            try:
+                ctx.submit([1, 2, 3], 3, seed=4)
+            except RuntimeError as exc:
+                ctx.submit_errors.append(exc)
+        ctx.threads.append(ctx.sched.spawn("submitter", body))
+
+    def _spawn_closer(self, ctx):
+        def body():
+            # short join timeouts: every fleet thread is parked under
+            # the controlled scheduler, so the joins MUST time out —
+            # deterministically — and close() still finishes its sweep
+            ctx.fleet.close(timeout=0.05)
+        ctx.threads.append(ctx.sched.spawn("closer", body))
+
+    def _submitter_committed(self, ctx):
+        return (ctx.sched.parked_point("submitter") == "submit:commit"
+                or "submitter" in ctx.sched._exited)
+
+    def ops(self):
+        return [
+            ("spawn_submitter", _always, self._spawn_submitter),
+            ("spawn_closer", self._submitter_committed,
+             self._spawn_closer),
+        ]
+
+    def finished(self, ctx):
+        return (len(ctx.threads) == 2
+                and all(not t.is_alive() for t in ctx.threads))
+
+
+SCENARIOS: Dict[str, Callable[[], Scenario]] = {
+    "submit_kill": SubmitKillScenario,
+    "demote_route_back": DemoteRouteBackScenario,
+    "close_race": CloseRaceScenario,
+}
+
+
+# ---------------------------------------------------------------------------
+# driving one schedule
+# ---------------------------------------------------------------------------
+
+class RunResult(object):
+    def __init__(self, scenario_name, journal_path=None):
+        self.scenario = scenario_name
+        self.journal_path = journal_path
+        self.trace: List[Tuple[Tuple[str, ...], str]] = []
+        self.violations: List[str] = []
+
+    @property
+    def schedule(self) -> List[str]:
+        return [chosen for _enabled, chosen in self.trace]
+
+    def __repr__(self):
+        return ("RunResult(%s, %d steps, %s)"
+                % (self.scenario, len(self.trace),
+                   "OK" if not self.violations
+                   else "%d violation(s)" % len(self.violations)))
+
+
+def format_schedule(schedule: Sequence[str]) -> str:
+    return ",".join(schedule)
+
+
+# how many consecutive hops the default policy lets one thread run
+# before rotating: long enough to cover a multi-yield window (a crash
+# path is sync-raise -> journal-flush -> exit, three hops), short
+# enough that every thread keeps making progress (liveness)
+_STICKY_HOPS = 3
+
+
+def _default_choice(enabled: List[str], last: Optional[str],
+                    streak: int) -> str:
+    """The deterministic baseline schedule deviations are counted
+    against: 'main' first (scenario ops fire as soon as their
+    preconditions hold), then STICKY round-robin — continue the thread
+    that just ran for up to `_STICKY_HOPS` hops (the CHESS
+    non-preemptive baseline, bounded for liveness), then rotate."""
+    if "main" in enabled:
+        return "main"
+    if last in enabled and streak < _STICKY_HOPS:
+        return last
+    if last in enabled:
+        i = enabled.index(last)
+        return enabled[(i + 1) % len(enabled)]
+    for name in enabled:
+        if last is None or name > last:
+            return name
+    return enabled[0]
+
+
+def run_schedule(scenario: Scenario, decisions: Sequence[str],
+                 journal_path: str,
+                 max_steps: int = 400) -> RunResult:
+    """Run `scenario` under the controlled scheduler, following
+    `decisions` (thread names / "main") while they last and the
+    default policy after; record the full trace; check the invariant
+    probes. Deterministic: the same decisions always produce the same
+    trace and the same verdict."""
+    from .diagnostics import format_diag
+    from .protocol_lint import verify_journal
+
+    sched = ControlledScheduler()
+    result = RunResult(scenario.name, journal_path)
+    ctx = scenario.build(sched, journal_path)
+    fleet = ctx.fleet
+    try:
+        sched.await_quiescent(expected=scenario.n_replicas + 1)
+        ops = list(scenario.ops())
+        op_i = 0
+        di = 0
+        last = None
+        streak = 0
+        steps = 0
+        while steps < max_steps:
+            if op_i >= len(ops) and scenario.finished(ctx):
+                break
+            enabled = sched.enabled()
+            if op_i < len(ops) and ops[op_i][1](ctx):
+                enabled = ["main"] + enabled
+            if not enabled:
+                if op_i >= len(ops):
+                    break  # every registered thread exited, nothing left
+                result.violations.append(
+                    "wedge: op %r blocked with no runnable thread"
+                    % (ops[op_i][0],))
+                break
+            if di < len(decisions):
+                choice = decisions[di]
+                di += 1
+                if choice not in enabled:
+                    result.violations.append(
+                        "schedule-divergence: decision %d chose %r but "
+                        "enabled=%r (replay of a stale schedule?)"
+                        % (di - 1, choice, enabled))
+                    break
+            else:
+                choice = _default_choice(enabled, last, streak)
+            result.trace.append((tuple(enabled), choice))
+            streak = streak + 1 if choice == last else 1
+            last = choice
+            steps += 1
+            if choice == "main":
+                label, _when, run = ops[op_i]
+                op_i += 1
+                run(ctx)
+            else:
+                sched.step(choice)
+        else:
+            # the loop ran out of steps — but finishing ON the last
+            # step is a finish, not a wedge
+            if not (op_i >= len(ops) and scenario.finished(ctx)):
+                result.violations.append(
+                    "wedge: scenario did not finish within %d "
+                    "schedule steps" % max_steps)
+    except SchedulerWedge as exc:
+        result.violations.append("wedge: %s" % exc)
+    finally:
+        sched.release_all()
+        try:
+            fleet.close()
+        except Exception as exc:  # audit raises ride the violations
+            result.violations.append("close: %r" % exc)
+        for t in ctx.threads:
+            t.join(timeout=_QUIESCE_TIMEOUT_S)
+
+    # -- invariant probes ------------------------------------------------
+    from ..serving.fleet import EngineFailed, RequestJournal
+    for h, prompt, seed, max_new in ctx.handles:
+        if not h.done:
+            result.violations.append(
+                "rid %d never reached a verdict" % h.rid)
+            continue
+        if h.error is not None:
+            if not (scenario.expect_failures
+                    and isinstance(h.error, EngineFailed)):
+                result.violations.append(
+                    "rid %d failed unexpectedly: %r" % (h.rid, h.error))
+            continue
+        expected = script_tokens(prompt, seed, max_new)
+        if list(h.tokens or []) != expected:
+            result.violations.append(
+                "rid %d token identity violated: got %r, oracle %r "
+                "(a stale-incarnation report was accepted?)"
+                % (h.rid, h.tokens, expected))
+    st = fleet.stats()
+    if st["lost"] != 0:
+        result.violations.append(
+            "stats()['lost'] == %d (submitted %d, completed %d, "
+            "rejected %d, expired %d, open %d)"
+            % (st["lost"], st["submitted"], st["completed"],
+               st["rejected"], st["expired"], st["open"]))
+    if st["completed"] > len(ctx.handles):
+        result.violations.append(
+            "completed %d > %d submitted: a request was answered twice"
+            % (st["completed"], len(ctx.handles)))
+    diags = verify_journal(journal_path, expect_closed=True)
+    result.violations.extend(
+        "journal: %s" % format_diag(d) for d in diags)
+    if RequestJournal.recover(journal_path):
+        result.violations.append(
+            "journal mirror/file divergence: recover() found open "
+            "rids after close()")
+    return result
+
+
+# ---------------------------------------------------------------------------
+# bounded-preemption enumeration
+# ---------------------------------------------------------------------------
+
+class ExploreReport(object):
+    def __init__(self, scenario_name):
+        self.scenario = scenario_name
+        self.runs = 0
+        self.violation: Optional[RunResult] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+    def __repr__(self):
+        return ("ExploreReport(%s, %d schedules, %s)"
+                % (self.scenario, self.runs,
+                   "clean" if self.ok else "VIOLATION"))
+
+
+def explore(scenario_factory: Callable[[], Scenario], tmp_dir: str,
+            max_preemptions: int = 1, max_schedules: int = 64,
+            max_steps: int = 400) -> ExploreReport:
+    """Systematic bounded-preemption sweep: run the default schedule,
+    then branch every choice point where another thread was enabled,
+    spending at most `max_preemptions` deviations per schedule (the
+    CHESS bound), capped at `max_schedules` runs. Stops at the first
+    violating schedule — the result carries it, replayable."""
+    import os
+
+    scenario = scenario_factory()
+    report = ExploreReport(scenario.name)
+    seen = set()
+    # iterative-deepening order (the CHESS bound made into a search
+    # order): exhaust every 1-preemption schedule before any
+    # 2-preemption one, and within a level branch LATE choice points
+    # first — a heisenbug window sits near the end of the op script
+    # far more often than the start
+    queue: List[Tuple[Tuple[str, ...], int]] = [((), 0)]
+    while queue and report.runs < max_schedules:
+        best = min(range(len(queue)),
+                   key=lambda i: (queue[i][1], -len(queue[i][0])))
+        prefix, n_pre = queue.pop(best)
+        jpath = os.path.join(
+            tmp_dir, "explore_%s_%04d.jsonl"
+            % (scenario.name, report.runs))
+        result = run_schedule(scenario_factory(), list(prefix), jpath,
+                              max_steps=max_steps)
+        report.runs += 1
+        if result.violations:
+            report.violation = result
+            return report
+        schedule = result.schedule
+        for i in range(len(prefix), len(result.trace)):
+            enabled, chosen = result.trace[i]
+            for alt in enabled:
+                if alt == chosen:
+                    continue
+                # one deviation = one preemption. The STICKY default
+                # policy continues the deviated-to thread afterwards,
+                # so a multi-hop window (a crash path is sync-raise ->
+                # journal-flush -> exit) is reachable with a single
+                # deviation — the CHESS small-bound insight holds
+                # without free-continuation bookkeeping.
+                if n_pre + 1 > max_preemptions:
+                    continue
+                branch = tuple(schedule[:i]) + (alt,)
+                if branch not in seen:
+                    seen.add(branch)
+                    queue.append((branch, n_pre + 1))
+    return report

@@ -23,6 +23,17 @@ either crashes or silently recompiles per branch. This pass finds the
                             a parameter whose default is a mutable
                             (unhashable) literal — every call misses
                             the jit cache
+  T005 device-dispatch-in-scheduler  a `jnp.`/`jax.*` call reachable
+                            from a host-side scheduler loop — a method
+                            annotated `# thread: <domain>` (the fleet's
+                            replica/monitor control threads) or any
+                            same-class method those reach. A control
+                            thread that dispatches device work per
+                            step serializes the fleet behind one
+                            accelerator queue; device math belongs in
+                            the engine's traced bodies (nested traced
+                            defs are exempt — they are the fix, not
+                            the hazard)
 
 A function is *traced* when it is (a) passed to / decorated with a jit
 or lax control-flow marker (`jax.jit`, `jax.vmap`, `jax.pmap`,
@@ -37,6 +48,7 @@ file is linted on its own.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from .diagnostics import Diagnostic, make, rel_path, walk_python_files
@@ -484,6 +496,119 @@ def _literal_seq(node) -> list:
     return []
 
 
+# --- T005 -------------------------------------------------------------
+
+# the same annotation lock_lint's thread-domain check learns from:
+# `def _loop(self):  # thread: replica`
+_THREAD_ANNOT_RE = re.compile(r"#\s*thread\s*:\s*(\w[\w\-]*)")
+
+
+def _sched_roots(cls_node: ast.ClassDef, src_lines) -> Dict[str, str]:
+    """method name -> thread domain, from `# thread:` annotations on
+    the def line(s) — the declared host-side scheduler loops."""
+    roots: Dict[str, str] = {}
+    for item in cls_node.body:
+        if not isinstance(item, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        body_start = item.body[0].lineno if item.body else item.lineno
+        for ln in range(item.lineno, body_start + 1):
+            if ln - 1 < len(src_lines):
+                m = _THREAD_ANNOT_RE.search(src_lines[ln - 1])
+                if m:
+                    roots[item.name] = m.group(1)
+                    break
+    return roots
+
+
+def _own_stmt_nodes(fn_node):
+    """Walk a def body without descending into nested defs/lambdas:
+    a nested def on a scheduler path is either a traced body (the
+    sanctioned home for device math) or deferred work — neither runs
+    on the scheduler thread at this call site."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_sched_dispatch(tree, src: str, index: _ModuleIndex,
+                          traced: Set[_Fn], path: str,
+                          diags: List[Diagnostic]):
+    """T005: `jax.*` (so `jnp.*`) calls reachable from a `# thread:`
+    annotated method through the same-class call graph. Traced
+    functions are exempt wherever they appear — the check hunts
+    dispatch FROM the control thread, not inside compiled steps."""
+    src_lines = src.splitlines()
+    traced_nodes = {id(fn.node) for fn in traced}
+    for cls_node in tree.body:
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        roots = _sched_roots(cls_node, src_lines)
+        if not roots:
+            continue
+        methods = {
+            item.name: item for item in cls_node.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # same-class reachability: self.m() closure from the roots
+        calls: Dict[str, Set[str]] = {}
+        for name, node in methods.items():
+            out: Set[str] = set()
+            for sub in _own_stmt_nodes(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "self"
+                        and sub.func.attr in methods):
+                    out.add(sub.func.attr)
+            calls[name] = out
+        via: Dict[str, Tuple[str, str]] = {}  # name -> (root, domain)
+        frontier = [(name, name, dom) for name, dom in roots.items()]
+        while frontier:
+            name, root, dom = frontier.pop()
+            if name in via:
+                continue
+            via[name] = (root, dom)
+            for callee in sorted(calls.get(name, ())):
+                if callee not in via:
+                    frontier.append((callee, root, dom))
+        for name in sorted(via):
+            node = methods[name]
+            if id(node) in traced_nodes:
+                continue  # a traced method body is compiled, not host
+            root, dom = via[name]
+            qual = "%s.%s" % (cls_node.name, name)
+            for sub in _own_stmt_nodes(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted, known = _dotted(sub.func, index.aliases)
+                if not (known and dotted
+                        and (dotted == "jax"
+                             or dotted.startswith("jax."))):
+                    continue
+                if dotted in _TRACE_MARKERS:
+                    # building a compiled step (jax.jit(body)) from
+                    # the control thread is the sanctioned pattern —
+                    # the hazard is dispatching work, not wrapping it
+                    continue
+                reach = ("a '# thread: %s' scheduler loop" % dom
+                         if name == root else
+                         "'%s.%s' (# thread: %s)"
+                         % (cls_node.name, root, dom))
+                diags.append(make(
+                    "T005", path, sub.lineno, qual, dotted,
+                    "%s dispatches device work from %s: a control "
+                    "thread that calls into jax per step serializes "
+                    "the fleet behind one accelerator queue — move it "
+                    "into the engine's traced body or precompute on "
+                    "the host" % (dotted, reach)))
+
+
 # --- entry points ------------------------------------------------------
 
 def lint_file(path: str) -> List[Diagnostic]:
@@ -493,9 +618,11 @@ def lint_file(path: str) -> List[Diagnostic]:
     index = _ModuleIndex(tree)
     rel = rel_path(path)
     diags: List[Diagnostic] = []
-    for fn in sorted(_traced_set(index), key=lambda f: f.node.lineno):
+    traced = _traced_set(index)
+    for fn in sorted(traced, key=lambda f: f.node.lineno):
         _check_traced_fn(fn, index, rel, diags)
     _check_static_args(index, rel, diags)
+    _check_sched_dispatch(tree, src, index, traced, rel, diags)
     diags.sort(key=lambda d: (d.path, d.line, d.code))
     return diags
 

@@ -231,9 +231,15 @@ class ServingEngine(object):
                  prefill_chunk_tokens=None, prefix_cache_tokens=None,
                  prefix_block_tokens=None, kv_block_tokens=None,
                  kv_pool_blocks=None, spec_draft_len=None,
-                 replica_id=None, fault_injector=None):
+                 replica_id=None, fault_injector=None,
+                 scheduler_hook=None):
         self._params = params
         self._cfg = cfg
+        # deterministic-exploration seam (ISSUE 9): the fleet threads
+        # its SchedulerHook through so a controlled scheduler can park
+        # a replica at engine-step granularity too; None costs one
+        # attribute test per step
+        self._sched_hook = scheduler_hook
         if getattr(cfg, "moe_experts", 0):
             # reference_moe's capacity cutoff couples rows: padded
             # chunk rows would compete with real rows for expert slots
@@ -961,6 +967,9 @@ class ServingEngine(object):
         real) aborts every pending handle and latches the engine: the
         compiled steps donate their cache buffers, so a step that died
         mid-dispatch must never run again on the half-donated cache."""
+        if self._sched_hook is not None:
+            self._sched_hook.yield_point(
+                "engine:%s:step" % (self.replica_id or ""))
         if self._failed is not None:
             raise self._failed
         inj = self._injector

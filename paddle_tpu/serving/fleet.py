@@ -110,7 +110,75 @@ from .prefix_cache import chain_keys
 __all__ = [
     "ServingFleet", "FleetHandle", "FleetSaturated", "RequestJournal",
     "DeadlineExceeded", "FleetTimeout", "run_fleet_subprocess",
+    "SchedulerHook",
 ]
+
+
+class SchedulerHook(object):
+    """Seam contract for deterministic schedule exploration (ISSUE 9).
+
+    The fleet's protocol bugs live in interleavings — a handshake
+    racing a demotion racing a close. This hook is the controlled-
+    scheduler seam (CHESS-lite, Musuvathi et al.): the fleet calls it
+    at every thread-handoff point, and a controlling implementation
+    (`paddle_tpu.analysis.sched_explore.ControlledScheduler`) can park
+    each thread there and enumerate who runs next. The default
+    (`scheduler_hook=None`) costs one `is not None` test per point.
+
+    Contract — every yield point is OUTSIDE all fleet locks, so a
+    parked thread never blocks another thread's lock acquisition:
+
+      thread_started(kind, name)  first call on a fleet-owned thread
+                                  ("replica"/"monitor"), before any
+                                  yield_point; `name` is unique per
+                                  incarnation (e.g. "r0.i2", "mon")
+      yield_point(point)          a handoff point was reached; may
+                                  block until the scheduler grants the
+                                  thread its turn. Points: "replica:
+                                  <name>:sync" (before the scheduler
+                                  handshake), "replica:<name>:step"
+                                  (before an engine step),
+                                  "monitor:sweep" (before a monitor
+                                  pass), "journal:flush" (before the
+                                  journal file write), "submit:commit"
+                                  (between a submit's durable journal
+                                  write and its routing critical
+                                  section — the close()-race window),
+                                  "engine:<replica_id>:step" (inside
+                                  `ServingEngine.step`)
+      thread_exiting()            last call on the thread (crash paths
+                                  included), so a controller never
+                                  waits on a dead thread
+
+    A hook must tolerate calls from UNREGISTERED threads (the caller's
+    own submit/close run on threads the fleet never started) — the
+    no-op base ignores everything.
+    """
+
+    def thread_started(self, kind: str, name: str):
+        pass
+
+    def yield_point(self, point: str):
+        pass
+
+    def thread_exiting(self):
+        pass
+
+
+# Test-only protocol mutants (tests/test_protocol_analysis.py): each
+# name re-opens a REAL post-merge review bug behind a flag so the
+# schedule explorer / journal verifier can prove they catch it —
+# CHESS-style regression seeding. Never set outside tests:
+#   "superseded_report"  _accept skips the in-flight check that refuses
+#                        a completion for work this replica no longer
+#                        tracks (the PR-8 demote -> survivor-death ->
+#                        route-back fence hole: the stale report's
+#                        tokens double-prepend the resume prefix)
+#   "double_reject"      _reject_locked skips its idempotence guard
+#                        (the PR-6 close()-race double count: rejected
+#                        increments twice, stats()['lost'] goes
+#                        negative, the journal gets a second terminal)
+_MUTANTS: Set[str] = set()
 
 # replica lifecycle states
 _LIVE, _DRAINING, _DRAINED, _DEAD = "live", "draining", "drained", "dead"
@@ -314,7 +382,12 @@ class RequestJournal(object):
         # is AHEAD of the file, so no compaction may snapshot it
         self._deferred_out = 0                       # guarded-by: _lock
         self._max_rid = -1                           # guarded-by: _lock
-        if path and os.path.exists(path):
+        # True when this journal object REOPENED an existing file (a
+        # restarted front door): its predecessor's unterminated rids
+        # legitimately stay open forever, so the close()-audit must not
+        # assert the everything-terminal invariant over them
+        self.preexisting = bool(path and os.path.exists(path))
+        if self.preexisting:
             self._replay_and_heal(path)
         self._f = open(path, "a") if path else None  # guarded-by: _lock
 
@@ -707,13 +780,26 @@ class _Replica(object):
 
     def _loop(self):  # thread: replica
         fleet = self._fleet
+        hook = fleet._hook
+        if hook is not None:
+            hook.thread_started(
+                "replica", "%s.i%d" % (self.name, self.incarnation))
         try:
-            self.engine = ServingEngine(
+            self._loop_body(fleet, hook)
+        finally:
+            if hook is not None:
+                hook.thread_exiting()
+
+    def _loop_body(self, fleet, hook):  # thread: replica
+        try:
+            self.engine = fleet._engine_factory(
                 fleet._params, fleet._cfg, replica_id=self.name,
-                **self._engine_kw)
+                scheduler_hook=hook, **self._engine_kw)
             completed: List[Tuple[int, List[int], str]] = []
             progress: List[Tuple[int, List[int]]] = []
             while True:
+                if hook is not None:
+                    hook.yield_point("replica:%s:sync" % self.name)
                 cmd, work, cancels, resync = fleet._sync(
                     self, completed, progress, idle=self._idle(),
                     summary=self._pool_summary(), stats=self._stats())
@@ -753,6 +839,8 @@ class _Replica(object):
                     self._serving[h.rid] = sh
                     self._reported[h.rid] = 0
                 if not self._idle():
+                    if hook is not None:
+                        hook.yield_point("replica:%s:step" % self.name)
                     self.engine.step()
                 for rid, sh in list(self._serving.items()):
                     # batched incremental progress: every token emitted
@@ -888,13 +976,21 @@ class ServingFleet(object):
                  engine_kw=None, engine_kw_for=None, auto_refill=False,
                  journal_compact_every=4096, slow_replica_factor=None,
                  slow_min_duration_s=0.5, probe_interval_s=0.25,
-                 probe_ok_needed=1):
+                 probe_ok_needed=1, scheduler_hook=None,
+                 engine_factory=None):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
         if int(max_pending) < 1:
             raise ValueError("max_pending must be >= 1")
         self._params = params
         self._cfg = cfg
+        # deterministic-exploration seam (ISSUE 9): the hook is called
+        # at every thread-handoff point (SchedulerHook contract above);
+        # engine_factory lets the explorer substitute a host-only
+        # scripted engine so interleavings, not compiles, dominate
+        self._hook: Optional[SchedulerHook] = scheduler_hook
+        self._engine_factory = (engine_factory if engine_factory
+                                is not None else ServingEngine)
         self.n_replicas = int(n_replicas)
         self.max_pending = int(max_pending)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
@@ -1211,6 +1307,11 @@ class ServingFleet(object):
             # like tokens journaled the normal way, and lost()/failover
             # concatenate later deltas after it
             self._journal.progress(rid, "__restart__", -1, 0, resume)
+        if self._hook is not None:
+            # the close()-race window: the request is durably journaled
+            # and open, but not yet routed — a concurrent close() must
+            # leave it with exactly ONE terminal record
+            self._hook.yield_point("submit:commit")
         try:
             with self._cond:
                 if self._closing:
@@ -1301,6 +1402,8 @@ class ServingFleet(object):
         a rid's progress deltas could land inverted on disk while the
         mirror has them straight — and a restarted front door would
         resume a scrambled token prefix."""
+        if self._hook is not None:
+            self._hook.yield_point("journal:flush")
         fired: List[FleetHandle] = []
         with self._flush_lock:
             with self._cond:
@@ -1329,7 +1432,7 @@ class ServingFleet(object):
         sides) is left alone — a second pass would double-count
         `rejected` and journal a duplicate terminal record, driving
         stats()['lost'] negative."""
-        if rid in self._done_rids:
+        if rid in self._done_rids and "double_reject" not in _MUTANTS:
             return self._handles.pop(rid, None)
         h = self._handles.pop(rid, None)
         self._open.discard(rid)
@@ -1515,7 +1618,8 @@ class ServingFleet(object):
             # hedged elsewhere: this holder's lease is stale
             self.zombie_refused += 1
             return
-        if rid not in self._in_flight[rep.index]:
+        if rid not in self._in_flight[rep.index] \
+                and "superseded_report" not in _MUTANTS:
             # the (replica, incarnation) pair can RE-match after a
             # demote -> survivor-death -> route-back-to-demoted cycle:
             # the journal's latest assignment names this replica again
@@ -1697,7 +1801,18 @@ class ServingFleet(object):
                 pass  # no survivors: handle already failed by _route
 
     def _monitor_loop(self):  # thread: monitor
+        if self._hook is not None:
+            self._hook.thread_started("monitor", "mon")
+        try:
+            self._monitor_loop_body()
+        finally:
+            if self._hook is not None:
+                self._hook.thread_exiting()
+
+    def _monitor_loop_body(self):  # thread: monitor
         while True:
+            if self._hook is not None:
+                self._hook.yield_point("monitor:sweep")
             with self._cond:
                 if self._closing:
                     return
@@ -2149,6 +2264,22 @@ class ServingFleet(object):
             rep.thread.join(timeout=timeout)
         self._flush_journal()  # stragglers from the final syncs
         self._journal.close()
+        # opt-in self-audit (ISSUE 9): replay the journal file through
+        # the protocol DFA so every fleet test / bench run that sets
+        # the env var double-checks its own history for free. A journal
+        # this fleet OPENED pre-existing keeps its predecessor's open
+        # rids (a restarted front door resubmits them under new rids),
+        # so only a journal born in this process asserts the
+        # everything-terminal close() invariant
+        if self._journal.path and os.environ.get(
+                "PADDLE_TPU_AUDIT_JOURNAL") == "1":
+            from ..analysis.protocol_lint import (JournalViolation,
+                                                  verify_journal)
+            diags = verify_journal(
+                self._journal.path,
+                expect_closed=not self._journal.preexisting)
+            if diags:
+                raise JournalViolation(self._journal.path, diags)
 
     def __enter__(self):
         return self

@@ -1,0 +1,545 @@
+"""Protocol model checker (ISSUE 9): the journal state-machine
+verifier and the deterministic schedule explorer.
+
+Four layers of coverage:
+
+  1. J-code seeded-defect corpus — hand-written journal files, one per
+     J-code (torn terminal tails, orphan progress, fenced-record
+     acceptance, compaction that drops an open rid), plus clean
+     histories (restart prefixes, compacted files) that must verify
+     to zero findings.
+  2. Live-journal audit — `PADDLE_TPU_AUDIT_JOURNAL=1` makes
+     `ServingFleet.close()` replay its own journal through the DFA:
+     a green fleet run stays green, a corrupted file raises
+     `JournalViolation` naming the code.
+  3. Mutant corpus — the two review-pass protocol bugs PR 6-8 fixed
+     by hand are re-opened behind test-only flags
+     (`serving.fleet._MUTANTS`); the explorer must rediscover each
+     deterministically and print a schedule that replays to the same
+     verdict, and the journal DFA must flag the superseded-report
+     mutant's journal on its own.
+  4. Explorer mechanics — bounded-preemption sweeps over the
+     un-mutated scenarios are clean (smoke in tier-1, the full sweep
+     `slow`-marked), schedules replay deterministically, and the CLI
+     subcommands exit with the gate's status codes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import analysis
+from paddle_tpu.analysis.protocol_lint import (
+    JournalViolation,
+    verify_journal,
+    verify_records,
+)
+from paddle_tpu.analysis.sched_explore import (
+    SCENARIOS,
+    ScriptEngine,
+    explore,
+    format_schedule,
+    run_schedule,
+    script_tokens,
+)
+import paddle_tpu.serving.fleet as fleet_mod
+from paddle_tpu.serving.fleet import RequestJournal, ServingFleet
+
+REPO = analysis.diagnostics.repo_root()
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _norm_violations(result):
+    """Violation strings embed the per-run journal path; identity
+    across a replay means identical verdicts modulo that path."""
+    return [v.replace(result.journal_path, "<journal>")
+            if getattr(result, "journal_path", None) else v
+            for v in result.violations]
+
+
+def _journal(tmp_path, name, records, tail=None):
+    """Write a journal file from record dicts; `tail` appends raw text
+    (a torn line) verbatim."""
+    p = tmp_path / name
+    with open(p, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+        if tail is not None:
+            f.write(tail)
+    return str(p)
+
+
+def _submit(rid):
+    return {"kind": "submit", "rid": rid, "spec": {"max_new": 3}}
+
+
+def _assign(rid, replica="r0", inc=1, gen=0):
+    return {"kind": "assign", "rid": rid, "replica": replica,
+            "incarnation": inc, "gen": gen}
+
+
+def _progress(rid, tokens, replica="r0", inc=1, gen=0):
+    return {"kind": "progress", "rid": rid, "replica": replica,
+            "incarnation": inc, "gen": gen, "tokens": tokens}
+
+
+def _done(rid, tokens, replica="r0", inc=1, gen=0):
+    return {"kind": "done", "rid": rid, "replica": replica,
+            "incarnation": inc, "gen": gen, "tokens": tokens}
+
+
+# ---------------------------------------------------------------------
+# 1. J-code corpus: one seeded defect per code, clean histories verify
+# ---------------------------------------------------------------------
+
+def test_valid_history_is_clean(tmp_path):
+    p = _journal(tmp_path, "ok.jsonl", [
+        _submit(0), _assign(0), _progress(0, [1, 2]), _progress(0, [3]),
+        _done(0, [1, 2, 3]),
+        _submit(1), {"kind": "rejected", "rid": 1, "reason": "full"},
+    ])
+    assert verify_journal(p, expect_closed=True) == []
+
+
+def test_j001_orphan_progress(tmp_path):
+    p = _journal(tmp_path, "j001.jsonl", [
+        _submit(0), _assign(0), _done(0, []),
+        _progress(7, [1]),  # rid 7 never submitted in this file
+    ])
+    diags = verify_journal(p)
+    assert _codes(diags) == ["J001"]
+    assert "rid 7" in diags[0].message
+
+
+def test_j002_duplicate_terminal(tmp_path):
+    # the double-reject bug class: two verdicts for one rid
+    p = _journal(tmp_path, "j002.jsonl", [
+        _submit(0),
+        {"kind": "rejected", "rid": 0, "reason": "closing"},
+        {"kind": "rejected", "rid": 0, "reason": "closing"},
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert _codes(diags) == ["J002"]
+
+
+def test_j003_record_after_terminal(tmp_path):
+    p = _journal(tmp_path, "j003.jsonl", [
+        _submit(0), _assign(0), _done(0, []),
+        _assign(0, replica="r1"),  # assignment after the verdict
+    ])
+    assert _codes(verify_journal(p)) == ["J003"]
+
+
+def test_j004_stale_fence(tmp_path):
+    # progress carrying an OLD incarnation after a newer assignment:
+    # the zombie-holder acceptance the lease fence must refuse
+    p = _journal(tmp_path, "j004.jsonl", [
+        _submit(0), _assign(0, replica="r0", inc=1),
+        _assign(0, replica="r1", inc=1, gen=1),
+        _progress(0, [9], replica="r0", inc=1, gen=0),
+        _done(0, [9], replica="r1", inc=1, gen=1),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert _codes(diags) == ["J004"]
+    assert "lease fence" in diags[0].message
+
+
+def test_j004_zombie_done(tmp_path):
+    p = _journal(tmp_path, "j004b.jsonl", [
+        _submit(0), _assign(0, replica="r0", inc=1),
+        _assign(0, replica="r1", inc=2, gen=1),
+        _done(0, [], replica="r0", inc=1, gen=0),
+    ])
+    assert _codes(verify_journal(p)) == ["J004"]
+
+
+def test_j005_done_with_never_journaled_tokens(tmp_path):
+    # the fleet journals every emitted token as a progress delta
+    # before the terminal; a done carrying tokens with ZERO journaled
+    # progress is the never-journaled defect, not an exemption
+    p = _journal(tmp_path, "j005b.jsonl", [
+        _submit(0), _assign(0), _done(0, [1, 2, 3]),
+    ])
+    assert _codes(verify_journal(p, expect_closed=True)) == ["J005"]
+
+
+def test_j005_progress_terminal_mismatch(tmp_path):
+    # the superseded-report fingerprint: the resume prefix was
+    # double-prepended, so `done` carries more tokens than the
+    # journaled progress concatenation
+    p = _journal(tmp_path, "j005.jsonl", [
+        _submit(0), _assign(0), _progress(0, [1, 2]),
+        _done(0, [1, 2, 1, 2, 3]),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert _codes(diags) == ["J005"]
+    assert "double-prepended" in diags[0].message
+
+
+def test_j006_unassigned_progress(tmp_path):
+    p = _journal(tmp_path, "j006.jsonl", [
+        _submit(0), _progress(0, [1], replica="r0"),
+        _done(0, [1], replica="r0"),
+    ])
+    # progress AND done from a named replica with no assignment
+    assert _codes(verify_journal(p)) == ["J006", "J006"]
+
+
+def test_j006_sanctioned_exceptions_are_clean(tmp_path):
+    # the restart-resume prefix (`__restart__`) and compaction's
+    # consolidated `replica: null` progress both precede assignment
+    # legitimately
+    p = _journal(tmp_path, "j006ok.jsonl", [
+        _submit(0),
+        _progress(0, [1, 2], replica="__restart__", inc=-1, gen=0),
+        _assign(0), _progress(0, [3]), _done(0, [1, 2, 3]),
+        _submit(1),
+        {"kind": "progress", "rid": 1, "replica": None,
+         "incarnation": None, "gen": None, "tokens": [7]},
+    ])
+    assert verify_journal(p) == []
+
+
+def test_j007_open_at_close(tmp_path):
+    p = _journal(tmp_path, "j007.jsonl", [
+        _submit(0), _assign(0), _progress(0, [1]),
+    ])
+    # open rids are fine for a live journal, a violation post-close()
+    assert verify_journal(p) == []
+    assert _codes(verify_journal(p, expect_closed=True)) == ["J007"]
+
+
+def test_j008_malformed_records(tmp_path):
+    p = _journal(tmp_path, "j008.jsonl", [
+        {"kind": "teleport", "rid": 0},          # unknown kind
+        {"kind": "submit", "rid": 1},            # missing spec
+        _submit(2),
+        {"kind": "meta", "max_rid": 5},          # meta mid-file
+    ])
+    diags = verify_journal(p)
+    assert _codes(diags) == ["J008", "J008", "J008"]
+    assert any("mid-file" in d.message for d in diags)
+
+
+def test_j008_ill_typed_fields_never_crash(tmp_path):
+    # JSON-parseable but wrong-typed fields are J008, not a TypeError
+    # out of the DFA — the never-crash contract
+    p = _journal(tmp_path, "types.jsonl", [
+        {"kind": "submit", "rid": [1], "spec": {}},     # unhashable rid
+        {"kind": "progress", "rid": 0, "replica": "r0",
+         "incarnation": 1, "gen": 0, "tokens": 5},      # int tokens
+        {"kind": "meta", "max_rid": "nine"},
+        {"kind": "zzz", "rid": "abc"},                  # str rid, bad kind
+        {"kind": "submit", "rid": "abc"},               # str rid, no spec
+    ])
+    diags = verify_journal(p)
+    assert _codes(diags) == ["J008"] * 5
+    assert any("ill-typed" in d.detail for d in diags)
+
+
+def test_torn_final_line_tolerated(tmp_path):
+    # the crash the journal exists to survive must not fail the audit
+    p = _journal(tmp_path, "torn.jsonl",
+                 [_submit(0), _assign(0), _done(0, [])],
+                 tail='{"kind": "submit", "rid": 1, "sp')
+    assert verify_journal(p, expect_closed=True) == []
+
+
+def test_torn_then_more_records_is_corruption(tmp_path):
+    p = _journal(tmp_path, "midtorn.jsonl", [_submit(0)],
+                 tail='{"kind": "ass\n' + json.dumps(
+                     {"kind": "rejected", "rid": 0, "reason": "x"}) + "\n")
+    diags = verify_journal(p)
+    assert _codes(diags) == ["J008"]
+    assert "torn tail" in diags[0].message
+
+
+def test_verify_records_library_form():
+    # the in-memory half the explorer's probes use
+    recs = [(1, _submit(0)), (2, _assign(0)), (3, _done(0, []))]
+    assert verify_records(recs, expect_closed=True) == []
+    assert _codes(verify_records(recs[1:], expect_closed=True)) \
+        == ["J001"]
+
+
+# ---------------------------------------------------------------------
+# 1b. compaction invariant: the rewritten file replays equivalently
+# ---------------------------------------------------------------------
+
+def _build_compactable(path):
+    j = RequestJournal(path=path)
+    for rid in (0, 1):
+        j.submit(rid, {"max_new": 3})
+        j.assign(rid, "r0", 1, rid)
+    j.progress(0, "r0", 1, 0, [1, 2])
+    j.progress(0, "r0", 1, 0, [3])
+    j.progress(1, "r0", 1, 1, [5])
+    j.complete(1, "r0", 1, 1, [5])
+    return j
+
+
+def test_compacted_journal_passes_the_dfa(tmp_path):
+    p = str(tmp_path / "compact.jsonl")
+    j = _build_compactable(p)
+    before_open = {rid for rid, _spec in RequestJournal.recover(p)}
+    before_prog = RequestJournal.recover_progress(p)
+    assert j.compact()
+    j.close()
+    # the rewritten history is itself a valid protocol history...
+    assert verify_journal(p) == []
+    # ...with the same open set and concatenated progress prefixes
+    assert {rid for rid, _spec in RequestJournal.recover(p)} \
+        == before_open
+    assert RequestJournal.recover_progress(p) == before_prog
+
+
+def test_compaction_that_drops_an_open_rid_is_caught(tmp_path):
+    # simulate a broken compactor: rewrite the file but lose an open
+    # rid's submit — its preserved assign/progress records orphan
+    p = str(tmp_path / "broken.jsonl")
+    j = _build_compactable(p)
+    assert j.compact()
+    j.close()
+    kept = [rec for rec in RequestJournal._read(p)
+            if not (rec["kind"] == "submit" and rec["rid"] == 0)]
+    with open(p, "w") as f:
+        for rec in kept:
+            f.write(json.dumps(rec) + "\n")
+    diags = verify_journal(p)
+    assert "J001" in _codes(diags)
+    assert any(d.code == "J001" and "rid 0" in d.message for d in diags)
+
+
+# ---------------------------------------------------------------------
+# 2. the opt-in close() audit: every fleet run double-checks itself
+# ---------------------------------------------------------------------
+
+def _mini_fleet(journal_path, **kw):
+    cfg = type("Cfg", (), {"max_len": 64})()
+    params = {"pos": np.zeros((64, 4), np.float32)}
+    base = dict(n_replicas=1, journal_path=journal_path,
+                heartbeat_timeout_s=3600.0, monitor_interval_s=0.01,
+                affinity=False, engine_factory=ScriptEngine)
+    base.update(kw)
+    return ServingFleet(params, cfg, **base)
+
+
+def test_close_audit_green_on_a_clean_run(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_AUDIT_JOURNAL", "1")
+    p = str(tmp_path / "fleet.jsonl")
+    fleet = _mini_fleet(p)
+    h = fleet.submit(np.asarray([3, 1, 4], np.int32), 4, seed=1,
+                     slo=None)
+    out = h.result(timeout=30.0)
+    assert list(out[len([3, 1, 4]):]) == script_tokens([3, 1, 4], 1, 4)
+    fleet.close()  # audits: every rid terminal, fences respected
+    assert verify_journal(p, expect_closed=True) == []
+
+
+def test_close_audit_raises_on_a_corrupted_journal(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_AUDIT_JOURNAL", "1")
+    p = str(tmp_path / "fleet.jsonl")
+    fleet = _mini_fleet(p)
+    h = fleet.submit(np.asarray([2, 7], np.int32), 3, seed=2, slo=None)
+    h.result(timeout=30.0)
+    # forge an orphan record behind the fleet's back
+    with open(p, "a") as f:
+        f.write(json.dumps(_progress(999, [1])) + "\n")
+    with pytest.raises(JournalViolation) as ei:
+        fleet.close()
+    assert "J001" in str(ei.value) and "999" in str(ei.value)
+
+
+def test_close_audit_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_AUDIT_JOURNAL", raising=False)
+    p = str(tmp_path / "fleet.jsonl")
+    fleet = _mini_fleet(p)
+    fleet.submit(np.asarray([5], np.int32), 2, seed=3,
+                 slo=None).result(timeout=30.0)
+    with open(p, "a") as f:
+        f.write(json.dumps(_progress(999, [1])) + "\n")
+    fleet.close()  # no audit, no raise
+
+
+def test_close_audit_spares_preexisting_open_rids(tmp_path,
+                                                  monkeypatch):
+    # a journal REOPENED by a restarted front door keeps its
+    # predecessor's open rids; the audit must not J007 them
+    monkeypatch.setenv("PADDLE_TPU_AUDIT_JOURNAL", "1")
+    p = _journal(tmp_path, "pre.jsonl", [_submit(0), _assign(0)])
+    fleet = _mini_fleet(p)
+    fleet.close()  # rid 0 resubmitted under a new rid; old one open
+    assert _codes(verify_journal(p, expect_closed=True)) == ["J007"]
+
+
+# ---------------------------------------------------------------------
+# 3. mutant corpus: the explorer rediscovers the review-pass bugs
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def mutants(monkeypatch):
+    active = set()
+    monkeypatch.setattr(fleet_mod, "_MUTANTS", active)
+    return active
+
+
+def test_explorer_catches_superseded_report_mutant(tmp_path, mutants):
+    # PR-8 fence hole: demote -> survivor-death -> route-back lets a
+    # stale completion double-prepend the resume prefix
+    mutants.add("superseded_report")
+    report = explore(SCENARIOS["demote_route_back"], str(tmp_path),
+                     max_preemptions=1, max_schedules=64)
+    assert not report.ok, "explorer missed the superseded_report mutant"
+    bad = report.violation
+    assert bad.schedule, "violation must carry a replayable schedule"
+    assert any("token identity" in v for v in bad.violations)
+    # the journal DFA catches the same bug from the FILE alone: the
+    # done record's tokens disagree with the journaled progress
+    assert any("J005" in v for v in bad.violations), bad.violations
+    # the printed schedule replays to the same verdict
+    again = run_schedule(SCENARIOS["demote_route_back"](),
+                         bad.schedule, str(tmp_path / "replay.jsonl"))
+    assert _norm_violations(again) == _norm_violations(bad)
+    assert again.trace == bad.trace
+
+
+def test_explorer_catches_double_reject_mutant(tmp_path, mutants):
+    # PR-6 close() race: both the parked submit and the closing sweep
+    # reach the same rid's terminal bookkeeping
+    mutants.add("double_reject")
+    report = explore(SCENARIOS["close_race"], str(tmp_path),
+                     max_preemptions=1, max_schedules=64)
+    assert not report.ok, "explorer missed the double_reject mutant"
+    bad = report.violation
+    assert bad.schedule
+    assert any("lost" in v for v in bad.violations), bad.violations
+    again = run_schedule(SCENARIOS["close_race"](), bad.schedule,
+                         str(tmp_path / "replay.jsonl"))
+    assert _norm_violations(again) == _norm_violations(bad)
+
+
+# ---------------------------------------------------------------------
+# 4. explorer mechanics: clean sweeps, determinism, CLI
+# ---------------------------------------------------------------------
+
+def test_explorer_smoke_clean(tmp_path):
+    # tier-1 smoke: a bounded slice of the submit_kill schedule space
+    # on the un-mutated fleet is violation-free
+    report = explore(SCENARIOS["submit_kill"], str(tmp_path),
+                     max_preemptions=1, max_schedules=12)
+    assert report.ok, report.violation and report.violation.violations
+    assert report.runs == 12
+
+
+@pytest.mark.slow
+def test_explorer_full_sweep_clean(tmp_path):
+    # the acceptance bar: the full bounded-preemption sweep over every
+    # scenario reports zero violations
+    for name in sorted(SCENARIOS):
+        report = explore(SCENARIOS[name], str(tmp_path),
+                         max_preemptions=1, max_schedules=200)
+        assert report.ok, (name, report.violation.violations)
+
+
+def test_schedule_replay_is_deterministic(tmp_path):
+    r1 = run_schedule(SCENARIOS["submit_kill"](), [],
+                      str(tmp_path / "a.jsonl"))
+    r2 = run_schedule(SCENARIOS["submit_kill"](), [],
+                      str(tmp_path / "b.jsonl"))
+    assert r1.violations == [] and r2.violations == []
+    assert r1.trace == r2.trace
+    # replaying the recorded schedule verbatim reproduces it too
+    r3 = run_schedule(SCENARIOS["submit_kill"](), r1.schedule,
+                      str(tmp_path / "c.jsonl"))
+    assert r3.trace == r1.trace
+    # and every schedule's journal passes the DFA with the close
+    # invariant (probed inside run_schedule; pin it independently)
+    assert verify_journal(str(tmp_path / "c.jsonl"),
+                          expect_closed=True) == []
+
+
+def test_finishing_on_the_last_step_is_not_a_wedge(tmp_path):
+    r1 = run_schedule(SCENARIOS["submit_kill"](), [],
+                      str(tmp_path / "n.jsonl"))
+    assert r1.violations == []
+    # re-run capped at EXACTLY the steps the scenario needs: the loop
+    # exits on the bound, but a finished scenario is a finish
+    r2 = run_schedule(SCENARIOS["submit_kill"](), [],
+                      str(tmp_path / "m.jsonl"),
+                      max_steps=len(r1.trace))
+    assert r2.violations == [], r2.violations
+    assert len(r2.trace) == len(r1.trace)
+
+
+def test_replay_divergence_is_reported(tmp_path):
+    r = run_schedule(SCENARIOS["submit_kill"](), ["no-such-thread"],
+                     str(tmp_path / "d.jsonl"))
+    assert any("schedule-divergence" in v for v in r.violations)
+
+
+def _cli(*argv, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis"] + list(argv),
+        cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_journal_gate(tmp_path):
+    good = _journal(tmp_path, "good.jsonl",
+                    [_submit(0), _assign(0), _done(0, [])])
+    proc = _cli("journal", good, "--expect-closed")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bad = _journal(tmp_path, "bad.jsonl",
+                   [_submit(0), _done(0, []), _done(0, [])])
+    proc = _cli("journal", bad)
+    assert proc.returncode == 1
+    assert "J002" in proc.stdout
+    proc = _cli("journal", str(tmp_path / "missing.jsonl"))
+    assert proc.returncode == 2
+    assert "no such journal" in proc.stderr
+    # repo-baseline hygiene (TODO entries) is not a JOURNAL's failure:
+    # a protocol-clean journal must exit 0 even mid --write-baseline
+    # workflow
+    bl = tmp_path / "bl.txt"
+    bl.write_text("L001 x.py::C.m::attr  # TODO: justify or fix\n")
+    proc = _cli("--baseline", str(bl), "journal", good,
+                "--expect-closed")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_explore_smoke():
+    proc = _cli("explore", "--scenario", "submit_kill",
+                "--max-schedules", "4")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no violation" in proc.stdout
+    proc = _cli("explore", "--scenario", "nope")
+    assert proc.returncode == 2
+    # --replay against 'all' is meaningless: usage error, not a run
+    proc = _cli("explore", "--replay", "r0.i1")
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------
+# 5. run_all scoping: J entries verify runtime artifacts, never stale
+# ---------------------------------------------------------------------
+
+def test_run_all_never_reads_j_entries_as_stale(tmp_path):
+    bl = tmp_path / "bl.txt"
+    bl.write_text(
+        "".join("%s  # kept\n" % fp for fp in analysis.load_baseline())
+        + "J005 bench_fleet.jsonl::rid3::done-tokens  # runtime artifact\n"
+        + "P001 <x>::block0::op:ghost  # program-scope entry\n"
+        + "L001 gone.py::C.add::items  # fixed long ago\n")
+    new, old, stale = analysis.run_all(baseline_path=str(bl),
+                                       with_programs=False)
+    assert new == []
+    # the stale L entry IS reported; the J and (program-less) P
+    # entries are out of scope, not stale
+    assert stale == ["L001 gone.py::C.add::items"]

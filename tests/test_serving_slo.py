@@ -549,6 +549,13 @@ def test_fence_refuses_superseded_report_after_route_back(model, tmp_path):
             fleet._absorb_progress(rep0, [(rid, [7, 8])])
             assert journal.progress_of(rid) == [7, 8]
             assert h.emitted == 2
+        # land the deferred progress records on disk BEFORE forging the
+        # route-back assignment: the real fleet writes strictly in
+        # mirror order (everything rides _pending_journal FIFO), and
+        # the journal DFA audit rightly reads a gen-0 progress record
+        # appearing after the gen-2 assign as a fence violation
+        fleet._flush_journal()
+        with fleet._cond:
             # demotion hedges it away (in-flight cleared), the survivor
             # dies, and routing falls BACK here: the latest assignment
             # names (r0, incarnation) again under a bumped generation,

@@ -339,6 +339,42 @@ def test_trace_propagates_through_local_calls(tmp_path):
     )) == ["T001"]
 
 
+def test_t005_device_dispatch_in_scheduler(tmp_path):
+    # jnp/jax calls in a `# thread:` annotated scheduler loop, and in
+    # same-class methods it reaches, dispatch device work from a
+    # control thread
+    codes = _trace_codes(tmp_path, "t005.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "class Fleet:\n"
+        "    def _monitor_loop(self):  # thread: monitor\n"
+        "        self._sweep()\n"
+        "        return jax.device_put(jnp.zeros(4))\n"
+        "    def _sweep(self):\n"
+        "        return jnp.ones(2)\n"
+    ))
+    assert codes == ["T005", "T005", "T005"]
+
+
+def test_t005_exempts_unreached_and_traced_bodies(tmp_path):
+    # device math in an unreached method or inside a nested traced
+    # body (the sanctioned home for it) is not scheduler dispatch
+    assert _trace_codes(tmp_path, "t005ok.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "class Fleet:\n"
+        "    def _monitor_loop(self):  # thread: monitor\n"
+        "        step = self._make_step()\n"
+        "        return step\n"
+        "    def _make_step(self):\n"
+        "        def body(x):\n"
+        "            return jnp.exp(x)  # traced: exempt\n"
+        "        return jax.jit(body)\n"
+        "    def _unreached(self):\n"
+        "        return jnp.ones(2)  # no scheduler path here\n"
+    )) == []
+
+
 # ---------------------------------------------------------------------
 # 1c. lock-discipline corpus: one snippet file per L-code
 # ---------------------------------------------------------------------
@@ -511,6 +547,137 @@ def test_lambda_mutation_is_deferred_not_guarded(tmp_path):
         "        with self._lock:\n"
         "            self.pool.submit(lambda: self.q.append(x))\n"
     )) == ["L001"]
+
+
+def test_l003_wait_outside_while(tmp_path):
+    assert _lock_codes(tmp_path, "l003.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            if not self.ready:\n"
+        "                self._cv.wait()\n"
+        "            return self.ready\n"
+    )) == ["L003"]
+
+
+def test_l003_while_predicate_and_wait_for_are_clean(tmp_path):
+    # the `while True: if p: break ... wait()` idiom re-tests the
+    # predicate too; wait_for() loops internally
+    assert _lock_codes(tmp_path, "l003ok.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            while not self.ready:\n"
+        "                self._cv.wait(timeout=0.5)\n"
+        "    def take2(self):\n"
+        "        with self._cv:\n"
+        "            while True:\n"
+        "                if self.ready:\n"
+        "                    break\n"
+        "                self._cv.wait()\n"
+        "    def take3(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait_for(lambda: self.ready)\n"
+    )) == []
+
+
+def test_l004_notify_outside_lock(tmp_path):
+    assert _lock_codes(tmp_path, "l004.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "    def poke(self):\n"
+        "        self._cv.notify_all()\n"
+    )) == ["L004"]
+
+
+def test_l004_explicit_lock_condition(tmp_path):
+    # threading.Condition(self._lock): holding THAT lock legitimizes
+    # notify — positionally or via the lock= keyword form — and so
+    # does holding the Condition itself; holding nothing does not
+    assert _lock_codes(tmp_path, "l004b.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "        self._kw = threading.Condition(lock=self._lock)\n"
+        "    def ok(self):\n"
+        "        with self._lock:\n"
+        "            self._cv.notify()\n"
+        "            self._kw.notify()\n"
+        "    def ok2(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.notify_all()\n"
+        "    def bad(self):\n"
+        "        self._cv.notify()\n"
+    )) == ["L004"]
+
+
+def test_l004_with_condition_block_satisfies_explicit_lock(tmp_path):
+    # `with self._cv:` on a Condition(self._lock) ACQUIRES that lock —
+    # notify under the Condition block must not flag
+    assert _lock_codes(tmp_path, "l004c.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "    def wake(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.notify_all()\n"
+    )) == []
+
+
+def test_l003_while_orelse_inherits_outer_loop(tmp_path):
+    # a nested While's else: suite runs once per OUTER-loop iteration —
+    # a wait there is predicate-re-tested; the same else: suite with no
+    # outer loop is not
+    clean = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "    def run(self):\n"
+        "        with self._cv:\n"
+        "            while not self.done:\n"
+        "                while self.busy:\n"
+        "                    self._cv.wait()\n"
+        "                else:\n"
+        "                    self._cv.wait()\n"
+    )
+    assert _lock_codes(tmp_path, "l003w.py", clean) == []
+    bare = clean.replace("            while not self.done:\n", "") \
+        .replace("                while self.busy",
+                 "            while self.busy") \
+        .replace("                    self._cv.wait()",
+                 "                self._cv.wait()") \
+        .replace("                else:", "            else:")
+    assert _lock_codes(tmp_path, "l003x.py", bare) == ["L003"]
+
+
+def test_l004_holds_contract_and_wait_loop_clean(tmp_path):
+    # a `# holds:` caller contract covers notify like any mutation;
+    # .wait()/.notify() on non-Condition attrs (an Event, a subprocess)
+    # are out of scope
+    assert _lock_codes(tmp_path, "l004ok.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self._event = threading.Event()\n"
+        "    def _wake_locked(self):  # holds: _cv\n"
+        "        self._cv.notify_all()\n"
+        "    def signal(self):\n"
+        "        self._event.wait(0.1)\n"
+    )) == []
 
 
 def test_baseline_single_space_separator_tolerated(tmp_path):

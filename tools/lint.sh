@@ -1,18 +1,81 @@
 #!/usr/bin/env bash
 # Repo static-analysis gate: program verifier + trace-hazard and
-# lock-discipline linters (paddle_tpu.analysis, ISSUE 5).
+# lock-discipline linters, then the protocol gate — deterministic
+# schedule exploration whose journals replay through the J-code
+# journal verifier (paddle_tpu.analysis, ISSUEs 5 + 9).
 #
 # Exits non-zero on any finding not covered by
-# paddle_tpu/analysis/baseline.txt. Run it before committing; the
-# tier-1 suite enforces the same invariant
-# (tests/test_static_analysis.py::test_repo_is_clean_modulo_baseline).
+# paddle_tpu/analysis/baseline.txt, and on any J-code from the
+# protocol gate's journals. Run it before committing; the tier-1
+# suite enforces the same invariants
+# (tests/test_static_analysis.py::test_repo_is_clean_modulo_baseline,
+# tests/test_protocol_analysis.py).
 #
 # To accept a finding instead of fixing it:
 #   python -m paddle_tpu.analysis --all --write-baseline
 # then REPLACE every 'TODO: justify or fix' marker with a real one-line
 # justification (a tier-1 test rejects TODO markers).
+#
+# PADDLE_TPU_LINT_BENCH=1 additionally runs the serving bench smokes
+# under PADDLE_TPU_AUDIT_JOURNAL=1 (every ServingFleet.close() replays
+# its live journal through the DFA) and re-verifies the kept bench
+# journal with `analysis journal` — minutes of engine compiles, so
+# opt-in rather than part of the default pre-commit loop.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 # the program entries import jax via fluid; lint runs host-only
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
-exec python -m paddle_tpu.analysis --all "$@"
+
+python -m paddle_tpu.analysis --all "$@"
+
+# protocol gate (ISSUE 9): explore the tier-1 fleet scenario, keep its
+# per-schedule journals, and replay EACH through the journal verifier —
+# a new J-code here fails the gate exactly like a new lint finding
+jdir="$(mktemp -d)"
+trap 'rm -rf "$jdir"' EXIT
+python -m paddle_tpu.analysis explore --scenario submit_kill \
+    --max-schedules 6 --journal-dir "$jdir"
+shopt -s nullglob
+journals=("$jdir"/*.jsonl)
+if [ "${#journals[@]}" -eq 0 ]; then
+    echo "protocol gate: explorer produced no journals" >&2
+    exit 1
+fi
+# quiet on success; a J-code must surface its findings AND a copy of
+# the offending journal that survives the EXIT trap's cleanup
+verify_journal() {
+    local j="$1" out keep
+    if ! out="$(python -m paddle_tpu.analysis journal "$j" \
+            --expect-closed)"; then
+        keep="$(mktemp "${TMPDIR:-/tmp}/paddle_tpu_jfail_XXXXXX.jsonl")"
+        cp "$j" "$keep"
+        echo "$out"
+        echo "protocol gate: J-codes in $(basename "$j")" \
+             "(journal preserved at $keep)" >&2
+        return 1
+    fi
+}
+for j in "${journals[@]}"; do
+    verify_journal "$j"
+done
+echo "protocol gate: ${#journals[@]} explorer journal(s) verified"
+
+if [ "${PADDLE_TPU_LINT_BENCH:-0}" = "1" ]; then
+    bdir="$jdir/bench"
+    mkdir -p "$bdir"
+    # the serving bench smokes directly (bench.py's main() always runs
+    # the resnet headline first — far too heavy for a lint gate); the
+    # audit env var makes every fleet close() replay its own journal
+    PADDLE_TPU_AUDIT_JOURNAL=1 PADDLE_TPU_KEEP_JOURNAL_DIR="$bdir" \
+        python -c "import bench; \
+bench.bench_serving_fleet(); bench.bench_serving_slo()"
+    bench_journals=("$bdir"/*.jsonl)
+    if [ "${#bench_journals[@]}" -eq 0 ]; then
+        echo "protocol gate: bench smoke produced no journals" >&2
+        exit 1
+    fi
+    for j in "${bench_journals[@]}"; do
+        verify_journal "$j"
+    done
+    echo "protocol gate: ${#bench_journals[@]} bench journal(s) verified"
+fi
