@@ -68,6 +68,14 @@ definitions):
               healthy vs gray (gray must stay under the slow window —
               the demotion bounded the tail); outputs must be
               token-identical across both runs
+  training_sentinel — silent-failure tolerance acceptance (ISSUE 10):
+              a fixed-seed training job over shards containing one
+              poisoned chunk; pins >=1 sentinel trip, rollback landing
+              on the last KNOWN-GOOD step, the poison chunk journaled
+              to quarantine exactly once, a finite committed loss curve
+              bit-identical to a clean run that never saw the chunk,
+              and (sub-drill) resume succeeding past a corrupted latest
+              checkpoint with zero manual intervention. Pure host work
   input_pipeline — host-side loader overlap (paddle_tpu/data):
               RecordShard shards -> ShardedDataset -> DataLoader on a
               fixed-seed synthetic trace, prefetch OFF (synchronous
@@ -119,6 +127,7 @@ Record field glossary (r4 measurement protocol):
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import struct
@@ -1842,6 +1851,310 @@ def bench_input_pipeline(n_shards=4, chunks_per_shard=8,
     return rec
 
 
+def _make_sentinel_shards(sdir, n_shards, chunks_per_shard,
+                          records_per_chunk, dim, seed, poison_chunk=None):
+    """Fixed-seed linear-regression shards for the sentinel drills.
+    Record = <I rid> ++ f64 features[dim] ++ f64 target. `poison_chunk`
+    (a GLOBAL chunk index) gets its features scaled by 1e200 — the
+    first batch touching it overflows the f64 loss to inf, the silent
+    failure the sentinel must catch. Per-chunk RNG streams, so the
+    poison never shifts any other chunk's draws."""
+    from paddle_tpu.data import ShardWriter
+
+    os.makedirs(sdir, exist_ok=True)
+    w_true = np.linspace(-1.0, 1.0, dim)
+    paths = []
+    rid = 0
+    for s in range(n_shards):
+        p = os.path.join(sdir, "shard_%02d.rs" % s)
+        paths.append(p)
+        with ShardWriter(p, records_per_chunk=records_per_chunk) as w:
+            for k in range(chunks_per_shard):
+                gci = s * chunks_per_shard + k
+                rng = np.random.RandomState(seed * 7919 + gci)
+                for _ in range(records_per_chunk):
+                    vec = rng.randn(dim)
+                    y = float(vec @ w_true)
+                    if gci == poison_chunk:
+                        vec = vec * 1e200
+                    w.write(struct.pack("<I", rid)
+                            + vec.astype("<f8").tobytes()
+                            + struct.pack("<d", y))
+                    rid += 1
+    return paths
+
+
+class _CkptScope(dict):
+    """Minimal scope (keys/get/set) for distributed.checkpoint."""
+
+    def get(self, name):
+        return dict.get(self, name)
+
+    def set(self, name, value):
+        self[name] = value
+
+
+def _sentinel_training_job(ckpt_dir, shard_paths, quarantine_path, *,
+                           dim=8, batch=16, epochs=2, lr=0.05, seed=11,
+                           promote_after=4, ckpt_every=2,
+                           rollback_budget=2, spike_factor=4.0,
+                           hysteresis=1, warmup=2, injector=None,
+                           max_incarnations=12):
+    """Deterministic in-process stand-in for a supervised training
+    worker: an incarnation loop (each pass = one worker lifetime) over
+    resume_or_init -> train -> sentinel.observe -> checkpoint, where a
+    sentinel trip ends the incarnation exactly like the subprocess
+    worker's SENTINEL_EXIT_CODE exit would (tests/sentinel_worker.py
+    is the real-process twin driven by the Supervisor). Pure float64
+    numpy SGD on the fixed-seed shards — bit-deterministic, so loss
+    curves can be compared EXACTLY across runs.
+
+    Returns the full audit: committed loss curve (last write per step
+    wins — a rollback's replay overwrites the diverged suffix), per-step
+    batch ids, trips, per-incarnation resume records, and the final
+    outcome ("done" / "abandon" / "incomplete")."""
+    from paddle_tpu.data import DataLoader, ShardedDataset
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+    from paddle_tpu.distributed import sentinel as sent_mod
+
+    rec_bytes = 4 + 8 * dim + 8
+
+    def decode(rec):
+        (rid,) = struct.unpack_from("<I", rec)
+        vec = np.frombuffer(rec[4:4 + 8 * dim], "<f8")
+        (y,) = struct.unpack_from("<d", rec, 4 + 8 * dim)
+        assert len(rec) == rec_bytes
+        return rid, np.asarray(vec), y
+
+    curve = {}        # step -> loss (committed history, last write wins)
+    step_ids = {}     # step -> batch record ids (same discipline)
+    step_epoch = {}   # step -> loader epoch the batch came from
+    trips = []
+    resumes = []
+    outcome = "incomplete"
+    for inc in range(1, max_incarnations + 1):
+        ds = ShardedDataset(shard_paths, decode_fn=decode, seed=seed,
+                            quarantine_path=quarantine_path)
+        dl = DataLoader(ds, batch, num_workers=0)
+        detector = sent_mod.DivergenceDetector(
+            spike_factor=spike_factor, hysteresis=hysteresis,
+            warmup=warmup)
+        sent = sent_mod.TrainingSentinel(
+            ckpt_dir, quarantine_path=quarantine_path, dataset=ds,
+            promote_after=promote_after, rollback_budget=rollback_budget,
+            detector=detector)
+        scope = _CkptScope()
+        meta = ckpt_mod.resume_or_init(
+            scope, ckpt_dir,
+            stateful={"loader": dl, "detector": detector})
+        if meta is not None:
+            step = int(meta["extra"]["step"])
+            w = np.asarray(scope.get("w"), np.float64)
+            sent.align(step)
+        else:
+            step = 0
+            w = np.zeros(dim, np.float64)
+        resumes.append({
+            "incarnation": inc,
+            "step": None if meta is None else step,
+            "known_good": sent.known_good_step,
+            "fallbacks": [] if meta is None else meta.get("fallbacks", []),
+        })
+        status = None
+        while dl.epoch < epochs and status is None:
+            for ids, X, y in dl:
+                if injector is not None:
+                    injector.tick()
+                step += 1
+                # poisoned records overflow f64 BY DESIGN: the inf loss
+                # is the signal under test, not a numerics accident
+                with np.errstate(over="ignore", invalid="ignore"):
+                    err = X @ w - y
+                    loss = float(np.mean(err * err))
+                if injector is not None:
+                    loss = injector.poison_loss(loss)
+                decision = sent.observe(step, loss,
+                                        cursor=dl.state_dict())
+                if decision is not None:
+                    trips.append(decision)
+                    status = decision["action"]
+                    break
+                w = w - lr * (2.0 / len(y)) * (X.T @ err)
+                curve[step] = loss
+                step_ids[step] = [int(r) for r in ids]
+                step_epoch[step] = dl.epoch
+                if step % ckpt_every == 0:
+                    scope.set("w", w)
+                    ckpt_mod.save_checkpoint(
+                        scope, ckpt_dir, step=step,
+                        extra={"step": step}, keep_last=2,
+                        stateful={"loader": dl, "detector": detector},
+                        protect=sent.known_good_step)
+                    sent.on_checkpoint(step, cursor=dl.state_dict())
+        dl.close()
+        if status is None:
+            outcome = "done"
+            break
+        if status == "abandon":
+            outcome = "abandon"
+            break
+        # rollback / quarantine: the next incarnation resumes from the
+        # known-good step (the diverged dirs were set aside by the trip)
+    return {
+        "outcome": outcome,
+        "incarnations": inc,
+        "trips": trips,
+        "resumes": resumes,
+        "curve": curve,
+        "step_ids": step_ids,
+        "step_epoch": step_epoch,
+        "final_w": w.tolist(),
+    }
+
+
+def bench_training_sentinel(n_shards=2, chunks_per_shard=4,
+                            records_per_chunk=32, batch=16, dim=8,
+                            epochs=2, promote_after=4, ckpt_every=2,
+                            rollback_budget=2, poison_pos=5, seed=11):
+    """Silent-failure tolerance acceptance (ISSUE 10), pure host work.
+
+    A fixed-seed supervised-training job whose deterministic chunk
+    stream contains ONE poisoned chunk (1e200-scaled features -> inf
+    loss the first batch that touches it). The sentinel must: trip,
+    roll back to the last KNOWN-GOOD checkpoint (not the latest), trip
+    again on the replay, quarantine the poison chunk (journaled exactly
+    once), and complete with a finite loss curve IDENTICAL, step for
+    step and bit for bit, to a clean-baseline run whose quarantine was
+    pre-seeded with the same chunk — proving exact step/cursor
+    continuity through two rollbacks and a quarantine. A separate
+    sub-drill corrupts the newest checkpoint of a finished run and
+    proves resume walks back to the newest verifiable step (bad dir
+    renamed `.corrupt`, the failing CRC named) with zero manual
+    intervention. Every invariant is asserted IN the bench, so the row
+    cannot decay into a no-op."""
+    import tempfile
+
+    from paddle_tpu.data import ShardedDataset
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+    from paddle_tpu.distributed import fault_injection as fi
+    from paddle_tpu.distributed import sentinel as sent_mod
+
+    root = tempfile.mkdtemp(prefix="bench_sentinel_")
+    # the poison chunk is chosen BY POSITION in epoch 0's deterministic
+    # visitation order (so the trip step is stable), then written into
+    # the shards at the matching global index
+    probe_paths = _make_sentinel_shards(
+        os.path.join(root, "probe"), n_shards, chunks_per_shard,
+        records_per_chunk, dim, seed)
+    order0 = ShardedDataset(probe_paths, seed=seed).epoch_order(0)
+    poison_chunk = int(order0[poison_pos])
+
+    kw = dict(dim=dim, batch=batch, epochs=epochs, seed=seed,
+              promote_after=promote_after, ckpt_every=ckpt_every,
+              rollback_budget=rollback_budget)
+
+    # --- poisoned run: the sentinel earns its keep -------------------
+    poisoned_paths = _make_sentinel_shards(
+        os.path.join(root, "poisoned"), n_shards, chunks_per_shard,
+        records_per_chunk, dim, seed, poison_chunk=poison_chunk)
+    qpath = os.path.join(root, "poisoned", "quarantine.jsonl")
+    job = _sentinel_training_job(
+        os.path.join(root, "poisoned", "ckpt"), poisoned_paths, qpath,
+        **kw)
+    assert job["outcome"] == "done", job["outcome"]
+    assert len(job["trips"]) >= 1, "sentinel never tripped"
+    # every rollback landed on the known-good step of its trip, and the
+    # next incarnation resumed EXACTLY there
+    for i, trip in enumerate(job["trips"]):
+        resume = job["resumes"][i + 1]
+        assert resume["step"] == trip["rollback_to"], (trip, resume)
+    # the poison chunk is journaled exactly once, with the right blame
+    q_entries = [e for e in sent_mod.quarantine_entries(qpath)
+                 if e["chunk"] == poison_chunk]
+    assert len(q_entries) == 1, q_entries
+    quarantined = sorted(sent_mod.quarantined_chunks(qpath))
+    # attribution is exact on this trace: the hard trip fires on the
+    # first poisoned batch, so the healthy-cursor window names the
+    # poison chunk ALONE — no clean chunk loses its data
+    assert quarantined == [poison_chunk], quarantined
+    curve = job["curve"]
+    losses = [curve[s] for s in sorted(curve)]
+    assert np.isfinite(losses).all(), "non-finite loss in committed curve"
+
+    # --- clean baseline: same job, quarantine pre-seeded -------------
+    clean_paths = _make_sentinel_shards(
+        os.path.join(root, "clean"), n_shards, chunks_per_shard,
+        records_per_chunk, dim, seed)
+    q_clean = os.path.join(root, "clean", "quarantine.jsonl")
+    sent_mod.quarantine_chunks(q_clean, quarantined,
+                               reason="clean-baseline preseed")
+    clean = _sentinel_training_job(
+        os.path.join(root, "clean", "ckpt"), clean_paths, q_clean, **kw)
+    assert clean["outcome"] == "done" and not clean["trips"], clean["trips"]
+    assert sorted(curve) == sorted(clean["curve"]), "step sets differ"
+    curve_matches = all(curve[s] == clean["curve"][s] for s in curve)
+    assert curve_matches, "post-quarantine curve diverged from clean run"
+    ids_match = all(job["step_ids"][s] == clean["step_ids"][s]
+                    for s in curve)
+    assert ids_match, "delivered record stream diverged from clean run"
+    # no record double-delivered or skipped in the committed stream:
+    # per epoch, every non-quarantined record id appears exactly once
+    n_rec = n_shards * chunks_per_shard * records_per_chunk
+    quarantined_ids = set()
+    for c in quarantined:
+        quarantined_ids |= set(range(c * records_per_chunk,
+                                     (c + 1) * records_per_chunk))
+    for epoch in range(epochs):
+        ids = [r for s in curve if job["step_epoch"][s] == epoch
+               for r in job["step_ids"][s]]
+        assert len(ids) == len(set(ids)), "double-delivered records"
+        assert set(ids) == set(range(n_rec)) - quarantined_ids
+
+    # --- corrupted-latest resume: zero manual intervention -----------
+    clean_ckpt = os.path.join(root, "clean", "ckpt")
+    steps_before = ckpt_mod.retain(clean_ckpt, keep_last=10)
+    newest = steps_before[0]
+    npy = sorted(glob.glob(os.path.join(
+        clean_ckpt, "step_%010d" % newest, "*.npy")))[0]
+    fi.corrupt_file(npy)
+    resumed = _sentinel_training_job(clean_ckpt, clean_paths, q_clean,
+                                     **kw)
+    assert resumed["outcome"] == "done"
+    fallbacks = resumed["resumes"][0]["fallbacks"]
+    assert fallbacks and fallbacks[0]["step"] == newest, fallbacks
+    assert any("CRC" in p for p in fallbacks[0]["problems"]), fallbacks
+    assert os.path.isdir(fallbacks[0]["renamed_to"])
+    assert resumed["resumes"][0]["step"] == steps_before[1]
+
+    return {
+        "sentinel_trips": len(job["trips"]),
+        "trip_verdicts": [t["verdict"] for t in job["trips"]],
+        "rollback_to": [t["rollback_to"] for t in job["trips"]],
+        "rollbacks_landed_on_known_good": True,
+        "incarnations": job["incarnations"],
+        "poison_chunk": poison_chunk,
+        "quarantined_chunks": quarantined,
+        "poison_journaled_once": True,
+        "final_loss": losses[-1],
+        "steps_total": len(curve),
+        "curve_finite": True,
+        "curve_matches_clean": curve_matches,
+        "record_stream_matches_clean": ids_match,
+        "corrupt_resume": {
+            "ok": True,
+            "corrupted_step": newest,
+            "walked_back_to": resumed["resumes"][0]["step"],
+            "renamed_to": os.path.basename(fallbacks[0]["renamed_to"]),
+            "problem": fallbacks[0]["problems"][0],
+        },
+        "knobs": {"promote_after": promote_after,
+                  "ckpt_every": ckpt_every,
+                  "rollback_budget": rollback_budget},
+        "trace": "fixed-seed(%d) shards, poison at epoch0 pos %d"
+                 % (seed, poison_pos),
+    }
+
+
 def bench_flash_attention(B=4, T=4096, H=16, D=64, steps=(4, 16)):
     """Pallas flash attention vs XLA full-matrix attention, single chip,
     bf16, causal (parallel/flash_attention.py). Timing puts the
@@ -2243,6 +2556,11 @@ def main():
         # the pure-host loader-overlap row first (paddle_tpu/data): no
         # device work at all, so it is meaningful on every backend
         run("input_pipeline", bench_input_pipeline)
+        # training sentinel (ISSUE 10): poisoned-chunk divergence ->
+        # rollback-to-known-good -> quarantine -> finite curve identical
+        # to the clean baseline, plus the corrupted-latest resume drill
+        # — pure host work, deterministic on every backend
+        run("training_sentinel", bench_training_sentinel)
         run("resnet50_input_pipeline",
             lambda: bench_resnet50_recordio(batch, chunk_steps, n_chunks))
 

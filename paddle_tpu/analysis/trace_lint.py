@@ -65,6 +65,10 @@ HOT_PATHS = [
     "paddle_tpu/serving/fleet.py",
     "paddle_tpu/fluid/executor.py",
     "paddle_tpu/fluid/core/lowering.py",
+    # the training sentinel sits ON the step loop next to the jitted
+    # step — registered so any traced helper that grows inside it is
+    # linted from day one (today it is pure host control flow)
+    "paddle_tpu/distributed/sentinel.py",
 ]
 
 _TRACE_MARKERS = {

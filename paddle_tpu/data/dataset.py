@@ -55,11 +55,22 @@ class ShardedDataset(object):
                                       for the per-epoch shuffles
     shuffle_chunks / shuffle_records  both default True; turning both
                                       off gives storage order
+    quarantine_path                   the sentinel's poisoned-chunk
+                                      journal (distributed.sentinel):
+                                      journaled chunk ids are skipped by
+                                      the chunk sources on every pass.
+                                      Quarantined chunks stay IN
+                                      `epoch_order` — a loader cursor's
+                                      `pos` keeps meaning the same chunk
+                                      before and after a quarantine, so
+                                      rollback resume stays exact; the
+                                      skip happens at delivery time.
     """
 
     def __init__(self, shard_paths: List[str],
                  decode_fn: Optional[Callable] = None, seed: int = 0,
-                 shuffle_chunks: bool = True, shuffle_records: bool = True):
+                 shuffle_chunks: bool = True, shuffle_records: bool = True,
+                 quarantine_path: Optional[str] = None):
         if isinstance(shard_paths, str):
             shard_paths = [shard_paths]
         self.shard_paths = list(shard_paths)
@@ -67,11 +78,15 @@ class ShardedDataset(object):
         self.seed = int(seed)
         self.shuffle_chunks = shuffle_chunks
         self.shuffle_records = shuffle_records
+        self.quarantine_path = quarantine_path
+        self._quarantined = frozenset()
         self._readers = {p: RecordShard(p) for p in self.shard_paths}
         self.chunks: List[ChunkRef] = []
         for p in self.shard_paths:
             for k, n in enumerate(self._readers[p].record_counts):
                 self.chunks.append(ChunkRef(p, k, n))
+        if quarantine_path:
+            self.reload_quarantine()
 
     @property
     def num_chunks(self) -> int:
@@ -80,6 +95,25 @@ class ShardedDataset(object):
     @property
     def num_records(self) -> int:
         return sum(c.records for c in self.chunks)
+
+    # --- poisoned-data quarantine (distributed.sentinel) ---------------
+    @property
+    def quarantined(self) -> frozenset:
+        """Global chunk indices currently quarantined (never delivered)."""
+        return self._quarantined
+
+    def is_quarantined(self, chunk_index: int) -> bool:
+        return int(chunk_index) in self._quarantined
+
+    def reload_quarantine(self) -> frozenset:
+        """Re-read the quarantine journal (the sentinel appends to it at
+        trip time; every worker re-reads on its next resume, so the
+        skip set is identical fleet-wide and across reruns)."""
+        if self.quarantine_path:
+            from ..distributed.sentinel import quarantined_chunks
+
+            self._quarantined = quarantined_chunks(self.quarantine_path)
+        return self._quarantined
 
     # --- deterministic per-epoch shuffles -----------------------------
     def epoch_order(self, epoch: int) -> List[int]:

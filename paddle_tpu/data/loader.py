@@ -105,6 +105,8 @@ class LocalChunkSource(object):
         for i in range(pos, len(order)):
             skip = offset if i == pos else 0
             ci = order[i]
+            if dataset.is_quarantined(ci):
+                continue  # sentinel-quarantined: never delivered again
             n = dataset.chunks[ci].records
             if skip >= n:
                 continue  # resumed exactly at this chunk's end
@@ -153,11 +155,19 @@ class CoordinatedChunkSource(object):
             # reclaim our checkpointed lease first: deliver the rest of
             # the chunk from the committed offset
             ci = int(inflight["chunk"])
-            self._held[inflight["task_id"]] = inflight.get("lease")
-            yield _Plan(ci, int(inflight["epoch"]),
-                        int(inflight["offset"]), inflight["task_id"], -1,
-                        dataset.chunks[ci].records,
-                        lease=inflight.get("lease"))
+            if dataset.is_quarantined(ci):
+                # quarantined since the checkpoint was taken (sentinel
+                # rollback): never deliver its tail — ack so the queue
+                # drains (every holder reads the same journal, so the
+                # decision is identical fleet-wide)
+                self.coordinator.task_finished(
+                    inflight["task_id"], lease=inflight.get("lease"))
+            else:
+                self._held[inflight["task_id"]] = inflight.get("lease")
+                yield _Plan(ci, int(inflight["epoch"]),
+                            int(inflight["offset"]), inflight["task_id"],
+                            -1, dataset.chunks[ci].records,
+                            lease=inflight.get("lease"))
         idle_since = None
         while True:
             task = self.coordinator.get_task(epoch_limit=epoch)
@@ -187,6 +197,11 @@ class CoordinatedChunkSource(object):
             skip = int(getattr(task, "offset", 0))
             n = dataset.chunks[ci].records
             lease = getattr(task, "lease", None)
+            if dataset.is_quarantined(ci):
+                # sentinel-quarantined chunk leased to us: never deliver
+                # it; finish the lease so the pass can still drain
+                self.coordinator.task_finished(task.task_id, lease=lease)
+                continue
             if skip >= n:
                 # a previous holder delivered (and committed) the whole
                 # chunk but its finish ack was lost: nothing to deliver

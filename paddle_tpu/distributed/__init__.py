@@ -18,6 +18,9 @@ from .checkpoint import (AsyncCheckpoint, load_checkpoint, resume_or_init,
                          retain, save_checkpoint, save_checkpoint_async)
 from .fault_injection import (FaultInjected, FaultInjector, corrupt_file,
                               default_injector, netsplit_active)
+from .sentinel import (SENTINEL_EXIT_CODE, DivergenceDetector, SentinelTrip,
+                       TrainingSentinel, chunks_consumed, known_good_step,
+                       quarantine_chunks, quarantined_chunks)
 from .supervisor import Supervisor, WorkerHandle
 
 __all__ = [
@@ -39,4 +42,12 @@ __all__ = [
     "resume_or_init",
     "Supervisor",
     "WorkerHandle",
+    "DivergenceDetector",
+    "TrainingSentinel",
+    "SentinelTrip",
+    "SENTINEL_EXIT_CODE",
+    "chunks_consumed",
+    "known_good_step",
+    "quarantine_chunks",
+    "quarantined_chunks",
 ]

@@ -21,16 +21,20 @@ from __future__ import annotations
 
 import glob
 import json
+import logging
 import os
 import re
 import shutil
+import sys
 import zlib
 
 import jax
 import numpy as np
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step", "retain",
-           "resume_or_init"]
+           "resume_or_init", "verify_checkpoint"]
+
+_LOG = logging.getLogger(__name__)
 
 _STEP_DIR_RE = re.compile(r"^step_(\d+)$")
 
@@ -96,7 +100,8 @@ def _index_to_json(index, shape):
 
 
 def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None,
-                    keep_last: int = 1, stateful: dict = None):
+                    keep_last: int = 1, stateful: dict = None,
+                    protect=None):
     """Write every scope entry (params + optimizer state + BN stats) under
     `dirname/step_<N>/`. Safe against interruption: data files land first,
     then the meta file commits the checkpoint with one atomic rename — and
@@ -188,18 +193,31 @@ def save_checkpoint(scope, dirname: str, step: int = 0, extra: dict = None,
         json.dump(meta, f)
     os.replace(tmp, os.path.join(dirname, _meta_name()))
     meta["dir"] = dirname
-    _prune_old_steps(root, keep=keep_last)
+    _prune_old_steps(root, keep=keep_last, protect=protect)
     return meta
 
 
-def _prune_old_steps(root: str, keep: int = 1):
+def _protect_set(protect):
+    if protect is None:
+        return frozenset()
+    if isinstance(protect, (list, tuple, set, frozenset)):
+        return frozenset(int(p) for p in protect if p is not None)
+    return frozenset([int(protect)])
+
+
+def _prune_old_steps(root: str, keep: int = 1, protect=None):
     """Remove step directories older than the newest COMPLETE step (all
     expected process metas committed), keeping `keep` complete steps.
-    Racing deleters (every process prunes after its own save) are
-    harmless: rmtree errors are ignored."""
+    Steps in `protect` (the sentinel's known-good step) are never
+    removed and never consume the keep budget. Racing deleters (every
+    process prunes after its own save) are harmless: rmtree errors are
+    ignored."""
+    protect = _protect_set(protect)
     steps = _list_step_dirs(root)
     complete_seen = 0
     for s, path in steps:  # newest first
+        if s in protect:
+            continue  # known-good: the rollback target outlives GC
         if _metas_complete(_dir_metas(path)):
             complete_seen += 1
             if complete_seen > keep:
@@ -209,37 +227,194 @@ def _prune_old_steps(root: str, keep: int = 1):
             shutil.rmtree(path, ignore_errors=True)
 
 
-def retain(dirname: str, keep_last: int = 1):
+def retain(dirname: str, keep_last: int = 1, protect=None):
     """Garbage-collect old checkpoint steps under `dirname`, keeping the
     newest `keep_last` COMPLETE steps (plus any newer still-incomplete
     save in flight). A crash-looping worker checkpoints every restart
     cycle; without GC its disk fills exactly when the job is least
-    healthy — the supervisor calls this after every restart. Returns the
-    steps still on disk, newest first."""
+    healthy — the supervisor calls this after every restart. `protect`
+    (a step or list of steps — the sentinel's last known-good) is
+    exempt from collection, so a divergence rollback always finds its
+    target on disk. Returns the steps still on disk, newest first."""
     if keep_last < 1:
         raise ValueError("retain(keep_last=%d): must keep >= 1" % keep_last)
-    _prune_old_steps(dirname, keep=keep_last)
+    _prune_old_steps(dirname, keep=keep_last, protect=protect)
     return [s for s, _ in _list_step_dirs(dirname)]
 
 
+def _verify_step_dir(path: str):
+    """Re-check one step directory offline: metas complete, every
+    referenced shard file present, readable, and matching its recorded
+    CRC32. Returns (ok, problems) where `problems` names each failure
+    (which entry, which file, which CRC) — the evidence trail a resume
+    fallback logs and the `verify` CLI prints."""
+    problems = []
+    metas = _dir_metas(path)
+    if not metas:
+        return False, ["no committed meta files"]
+    if not _metas_complete(metas):
+        expected = max(m.get("process_count", 1) for m in metas)
+        return False, [
+            "incomplete: %d of %d process meta file(s) present"
+            % (len(metas), expected)]
+    latest = max(m["step"] for m in metas)
+    for m in metas:
+        if m["step"] != latest:
+            continue
+        for name in sorted(m["entries"]):
+            ent = m["entries"][name]
+            shards = ent["shards"] if ent.get("sharded") else [ent]
+            for sh in shards:
+                fp = os.path.join(path, sh["file"])
+                if not os.path.exists(fp):
+                    problems.append(
+                        "entry %r: missing file %s" % (name, sh["file"]))
+                    continue
+                try:
+                    arr = np.load(fp)
+                except Exception as e:  # torn header, truncation, ...
+                    problems.append(
+                        "entry %r: unreadable %s (%s: %s)"
+                        % (name, sh["file"], type(e).__name__, e))
+                    continue
+                got = _crc(arr)
+                if got != sh["crc32"]:
+                    problems.append(
+                        "entry %r: CRC mismatch in %s (recorded %d, "
+                        "file has %d)" % (name, sh["file"],
+                                          sh["crc32"], got))
+    return not problems, problems
+
+
+def verify_checkpoint(dirname: str):
+    """Offline integrity scan of every step directory under `dirname`
+    (or of `dirname` itself for the legacy flat layout): re-checks all
+    shard CRCs and metas-completeness WITHOUT loading anything into a
+    scope. Returns [{"step", "dir", "ok", "problems"}, ...] oldest
+    first — run it in CI or before committing to a long resume:
+
+        python -m paddle_tpu.distributed.checkpoint verify <dir>
+    """
+    steps = _list_step_dirs(dirname)
+    if not steps:
+        if _dir_metas(dirname):
+            ok, problems = _verify_step_dir(dirname)
+            return [{"step": None, "dir": dirname, "ok": ok,
+                     "problems": problems}]
+        return []
+    out = []
+    for s, path in sorted(steps):
+        ok, problems = _verify_step_dir(path)
+        out.append({"step": s, "dir": path, "ok": ok,
+                    "problems": problems})
+    return out
+
+
+def _quarantine_step_dir(path: str):
+    """Set a failed step dir aside as `<dir>.corrupt` — NEVER deleted
+    (it is the forensic evidence of what tore), never seen by resume
+    again (the step-dir regex no longer matches it). Returns the new
+    path, or None when a racing resume already moved it."""
+    target = path + ".corrupt"
+    n = 1
+    while os.path.exists(target):
+        target = path + ".corrupt.%d" % n
+        n += 1
+    try:
+        os.replace(path, target)
+    except OSError:
+        return None
+    return target
+
+
 def resume_or_init(scope, dirname: str, init_fn=None, strict: bool = True,
-                   stateful: dict = None):
+                   stateful: dict = None, step: int = None):
     """One-call crash-recovery glue for supervised workers: restore the
-    latest complete checkpoint under `dirname` into `scope` and return
-    its merged meta, or — when nothing is committed yet (first launch, or
-    a crash before the first save) — run `init_fn()` and return None.
-    The caller branches on the return value for its start step:
+    newest VERIFIABLE checkpoint under `dirname` into `scope` and return
+    its merged meta, or — when nothing restorable is committed (first
+    launch, or a crash before the first save) — run `init_fn()` and
+    return None. The caller branches on the return value for its start
+    step:
 
         meta = resume_or_init(scope, ckpt_dir, init_fn=run_startup)
         start = meta["step"] + 1 if meta else 0
+
+    Hardened against torn/corrupted checkpoints (zero manual
+    intervention): each candidate step dir is verified (metas complete +
+    every shard CRC) BEFORE loading; a failing dir is renamed
+    `<dir>.corrupt` (kept, never deleted), the failure logged with the
+    exact CRC that mismatched, and the walk continues to the next older
+    step. Exception: on a MULTI-process job a metas-incomplete dir is
+    skipped without renaming — it may be a peer's save still in flight,
+    and destroying it would crash healthy writers. Fallbacks taken are
+    recorded in the returned meta under `"fallbacks"`. The verification
+    pass reads every array once more than a blind load would — the
+    price of never resuming from a dir a later CRC failure would have
+    killed anyway.
+
+    `step` pins the restore target (the sentinel's known-good step):
+    newer step dirs are ignored outright — they are not corrupt, just
+    distrusted — and the walk starts at `step`, still falling back past
+    corruption below it.
 
     `stateful` objects (see save_checkpoint) get `load_state_dict()`
     called with their checkpointed state on the restore path; on the
     init path they are left at their constructed state.
     """
-    if dirname and latest_step(dirname) is not None:
-        return load_checkpoint(scope, dirname, strict=strict,
-                               stateful=stateful)
+    fallbacks = []
+    if dirname:
+        for s, path in _list_step_dirs(dirname):  # newest first
+            if step is not None and s > int(step):
+                continue
+            ok, problems = _verify_step_dir(path)
+            if not ok:
+                incomplete = any(p.startswith("incomplete")
+                                 for p in problems)
+                if incomplete and jax.process_count() > 1:
+                    # multi-process job: an incomplete newest step may
+                    # be a PEER's save still in flight — renaming it
+                    # would destroy a checkpoint about to commit. Skip
+                    # non-destructively (the pre-hardening behavior);
+                    # only a single-process resume, where no peer can
+                    # be writing, quarantines incomplete dirs.
+                    _LOG.warning(
+                        "resume: skipping incomplete checkpoint step "
+                        "%d at %s (%s) — possibly a peer's in-flight "
+                        "save", s, path, "; ".join(problems))
+                    fallbacks.append({"step": s, "dir": path,
+                                      "renamed_to": None,
+                                      "problems": problems})
+                    continue
+                renamed = _quarantine_step_dir(path)
+                _LOG.warning(
+                    "resume: checkpoint step %d at %s failed "
+                    "verification (%s)%s — falling back to the next "
+                    "older step", s, path, "; ".join(problems),
+                    (", quarantined as %s" % renamed) if renamed else "")
+                fallbacks.append({"step": s, "dir": path,
+                                  "renamed_to": renamed,
+                                  "problems": problems})
+                continue
+            meta = load_checkpoint(scope, dirname, strict=strict,
+                                   stateful=stateful, step=s)
+            if fallbacks:
+                meta["fallbacks"] = fallbacks
+            return meta
+        if _dir_metas(dirname):  # legacy flat layout
+            meta = load_checkpoint(scope, dirname, strict=strict,
+                                   stateful=stateful)
+            if fallbacks:
+                meta["fallbacks"] = fallbacks
+            return meta
+        if fallbacks:
+            # nothing restorable: the operator must still learn WHICH
+            # checkpoints were quarantined before training restarts
+            # from scratch
+            _LOG.error(
+                "resume: no verifiable checkpoint under %s — %d step "
+                "dir(s) failed verification (%s); initializing fresh",
+                dirname, len(fallbacks),
+                "; ".join(f["problems"][0] for f in fallbacks))
     if init_fn is not None:
         init_fn()
     return None
@@ -256,10 +431,20 @@ def _dir_metas(dirname: str):
     return metas
 
 
-def _resolve_dir(dirname: str, strict: bool = True):
+def _resolve_dir(dirname: str, strict: bool = True, step: int = None):
     """Pick the directory holding the checkpoint to load: the newest
     step_<N>/ subdir whose metas are complete (falling back to older
-    complete steps), or `dirname` itself for the legacy flat layout."""
+    complete steps), or `dirname` itself for the legacy flat layout.
+    With `step`, exactly that step's dir — incomplete is an error (the
+    caller asked for a specific rollback target)."""
+    if step is not None:
+        path = _step_dir(dirname, step)
+        metas = _dir_metas(path)
+        if not _metas_complete(metas):
+            raise IOError(
+                "checkpoint step %d under %s is missing or incomplete"
+                % (int(step), dirname))
+        return path, metas
     newest_partial = None
     for s, path in _list_step_dirs(dirname):
         metas = _dir_metas(path)
@@ -335,7 +520,7 @@ def _load_entry(dirname: str, name: str, ent: dict, strict: bool):
 
 
 def load_checkpoint(scope, dirname: str, strict: bool = True,
-                    stateful: dict = None) -> dict:
+                    stateful: dict = None, step: int = None) -> dict:
     """Restore a checkpoint into `scope`, verifying every CRC (reference
     LoadCheckpoint rejects corrupt shards).
 
@@ -348,8 +533,9 @@ def load_checkpoint(scope, dirname: str, strict: bool = True,
     them onto the current mesh/shardings at the next run — so a
     checkpoint written on N processes restores on any process count.
     Returns the merged meta (step = max across processes; entries =
-    union)."""
-    dirname, metas = _resolve_dir(dirname, strict=strict)
+    union). `step` pins the load to one step dir (rollback to
+    known-good) instead of the newest complete one."""
+    dirname, metas = _resolve_dir(dirname, strict=strict, step=step)
     if not metas:
         raise FileNotFoundError(
             "no checkpoint meta found under %s" % dirname
@@ -491,7 +677,8 @@ class AsyncCheckpoint(object):
 def save_checkpoint_async(scope, dirname: str, step: int = 0,
                           extra: dict = None,
                           keep_last: int = 1,
-                          stateful: dict = None) -> AsyncCheckpoint:
+                          stateful: dict = None,
+                          protect=None) -> AsyncCheckpoint:
     """Snapshot the scope to host memory NOW (so later training steps —
     including donated-buffer updates — cannot touch the saved values),
     then run the normal atomic save on a background thread. Returns an
@@ -499,7 +686,9 @@ def save_checkpoint_async(scope, dirname: str, step: int = 0,
 
     `stateful` objects have their state_dict() taken NOW too, so a
     loader that keeps delivering batches while the writer runs cannot
-    leak post-snapshot positions into the checkpoint.
+    leak post-snapshot positions into the checkpoint. `protect` (the
+    sentinel's known-good step) is honored by the background prune
+    exactly as in the synchronous saver.
 
     Process-spanning (multi-host) arrays need cross-process save
     coordination, so they fall back to a synchronous save_checkpoint —
@@ -520,7 +709,7 @@ def save_checkpoint_async(scope, dirname: str, step: int = 0,
         for n in scope.keys()
     ):
         save_checkpoint(scope, dirname, step=step, extra=extra,
-                        keep_last=keep_last)
+                        keep_last=keep_last, protect=protect)
         return AsyncCheckpoint(
             None, {"value": _step_dir(dirname, step), "error": None}
         )
@@ -550,7 +739,8 @@ def save_checkpoint_async(scope, dirname: str, step: int = 0,
     def _write():
         try:
             save_checkpoint(_HostScope(arrays), dirname, step=step,
-                            extra=extra, keep_last=keep_last)
+                            extra=extra, keep_last=keep_last,
+                            protect=protect)
             box["value"] = _step_dir(dirname, step)
         except BaseException as e:  # surfaced by result()
             box["error"] = e
@@ -561,3 +751,45 @@ def save_checkpoint_async(scope, dirname: str, step: int = 0,
 
 
 __all__ += ["save_checkpoint_async", "AsyncCheckpoint"]
+
+
+# ---------------------------------------------------------------------
+# offline integrity scanner CLI:
+#   python -m paddle_tpu.distributed.checkpoint verify <dir>
+# walks every step dir, re-checks every shard CRC + metas-complete,
+# prints per-step verdicts, exits non-zero on any failure — usable in
+# CI and before committing a long job to a resume.
+# ---------------------------------------------------------------------
+
+
+def _cli(argv):
+    if len(argv) != 2 or argv[0] != "verify":
+        sys.stderr.write(
+            "usage: python -m paddle_tpu.distributed.checkpoint "
+            "verify <checkpoint-dir>\n")
+        return 2
+    dirname = argv[1]
+    if not os.path.isdir(dirname):
+        sys.stderr.write("verify: %s is not a directory\n" % dirname)
+        return 2
+    reports = verify_checkpoint(dirname)
+    if not reports:
+        sys.stderr.write("verify: no checkpoint steps under %s\n" % dirname)
+        return 1
+    bad = 0
+    for r in reports:
+        label = ("step %d" % r["step"]) if r["step"] is not None \
+            else "flat layout"
+        if r["ok"]:
+            print("OK    %-12s %s" % (label, r["dir"]))
+        else:
+            bad += 1
+            print("FAIL  %-12s %s" % (label, r["dir"]))
+            for p in r["problems"]:
+                print("        %s" % p)
+    print("%d step(s) checked, %d failed" % (len(reports), bad))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(_cli(sys.argv[1:]))

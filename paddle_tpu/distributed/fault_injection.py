@@ -16,6 +16,21 @@ A fault spec is a comma-separated string, e.g.::
     PADDLE_FAULT="netsplit@3:2.0"   drop coordinator connections for 2 s
                                     starting at step 3 (partition: RPCs
                                     fail and must ride it out on backoff)
+    PADDLE_FAULT="nanloss@5"        SILENT failure (ISSUE 10): the loss
+                                    the training loop observes at step 5
+                                    becomes NaN — the process neither
+                                    crashes nor hangs; only the training
+                                    sentinel's divergence detection can
+                                    see it. The loop opts in by passing
+                                    its loss through
+                                    `injector.poison_loss(loss)`.
+    PADDLE_FAULT="spike@5:50"       soft SILENT failure: the observed
+                                    loss at step 5 is multiplied by 50 —
+                                    a one-step spike the sentinel's
+                                    EWMA + hysteresis must classify
+                                    (transient: tolerated; sustained:
+                                    tripped). Arg is the factor,
+                                    default 10, must be > 1.
     PADDLE_FAULT="slow@3:2.0/0.1"   GRAY failure (ISSUE 8): starting at
                                     step 3, every tick sleeps 0.1 s until
                                     2.0 s of wall time have passed — the
@@ -125,7 +140,8 @@ class _Fault(object):
             raise ValueError("unknown fault kind %r" % self.kind)
 
 
-_KINDS = ("kill", "exc", "delay", "corrupt", "hang", "netsplit", "slow")
+_KINDS = ("kill", "exc", "delay", "corrupt", "hang", "netsplit", "slow",
+          "nanloss", "spike")
 
 
 def _parse_slow_arg(arg: str):
@@ -161,6 +177,12 @@ def _parse(spec: str) -> List[_Fault]:
             arg = str(float(arg or "1.0"))  # fail fast on a bad duration
         if kind == "slow":
             _parse_slow_arg(arg)  # fail fast on a bad dur[/per]
+        if kind == "spike":
+            mag = float(arg or "10")
+            if mag <= 1.0:
+                raise ValueError(
+                    "spike@N:mag needs a factor > 1, got %r" % mag)
+            arg = str(mag)
         faults.append(_Fault(kind, int(step_s), arg or None))
     return faults
 
@@ -177,6 +199,9 @@ class FaultInjector(object):
         # not _Fault state: the window outlives the step that opened it
         self._slow_until = 0.0
         self._slow_per = 0.0
+        # armed loss fault for the CURRENT step, consumed (one-shot) by
+        # poison_loss(): ("nanloss", None) or ("spike", factor)
+        self._loss_fault = None
 
     @property
     def active(self) -> bool:
@@ -212,11 +237,30 @@ class FaultInjector(object):
                     dur, per = _parse_slow_arg(f.arg)
                     self._slow_until = time.monotonic() + dur
                     self._slow_per = per
+                elif f.kind in ("nanloss", "spike"):
+                    # silent fault: nothing fires HERE — the training
+                    # loop's poison_loss() call this step observes it
+                    self._loss_fault = (f.kind, f.arg)
                 else:
                     f.fire()
         if self.slowed:
             time.sleep(self._slow_per)
         return self.step
+
+    def poison_loss(self, loss):
+        """Pass the step's observed loss through any armed silent loss
+        fault (nanloss@/spike@) and disarm it. Training loops that
+        integrate the sentinel call this right after computing their
+        loss; loops that don't are simply immune to these fault kinds
+        (the spec parses, nothing fires)."""
+        lf = self._loss_fault
+        self._loss_fault = None
+        if lf is None:
+            return loss
+        kind, arg = lf
+        if kind == "nanloss":
+            return float("nan")
+        return float(loss) * float(arg or "10")
 
 
 _default: Optional[FaultInjector] = None

@@ -25,8 +25,22 @@ Failure taxonomy handled:
                   died before living `min_uptime_s`) -> abandon the
                   worker; the job degrades gracefully because the
                   coordinator requeues its shards to the survivors
+  divergence      a worker whose training sentinel tripped exits with
+                  `sentinel_exit_code` (75, EX_TEMPFAIL): an ORDERLY
+                  rollback request, not a crash. It is budgeted
+                  separately (`sentinel_rollback_max`, its own
+                  exponential backoff) and never feeds
+                  `rapid_failures` — divergence churn and crash loops
+                  must stay distinguishable to operators
   netsplit        not the supervisor's problem: RemoteCoordinator rides
                   out partitions on exponential backoff
+
+Every death is classified with a restart *reason* (`crash` /
+`sentinel_rollback` / `hang`), kept in the handle's `restart_reasons`
+audit trail, exported in `summary()`, and handed to the replacement
+process as PADDLE_RESTART_REASON — workers put it in their
+`register_worker(meta=...)` so the coordinator membership shows WHY
+each incarnation exists.
 
 The supervisor never parses worker output and the workers never talk to
 the supervisor — liveness flows exclusively through the coordinator
@@ -43,6 +57,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from . import checkpoint as _ckpt
+from . import sentinel as _sentinel
 
 __all__ = ["Supervisor", "WorkerHandle"]
 
@@ -76,6 +91,9 @@ class WorkerHandle(object):
         self.restarts = 0          # successful respawns performed
         self.rapid_failures = 0    # consecutive deaths before min_uptime
         self.hang_kills = 0        # times killed for missed heartbeats
+        self.sentinel_rollbacks = 0  # orderly divergence-rollback exits
+        self.restart_reasons: List[str] = []  # crash|sentinel_rollback|hang
+        self.last_restart_reason: Optional[str] = None
         self.exit_codes: List[int] = []
         self.abandoned = False
         self.done = False          # exited 0; will not be respawned
@@ -95,6 +113,8 @@ class WorkerHandle(object):
             "restarts": self.restarts,
             "rapid_failures": self.rapid_failures,
             "hang_kills": self.hang_kills,
+            "sentinel_rollbacks": self.sentinel_rollbacks,
+            "restart_reasons": list(self.restart_reasons),
             "exit_codes": list(self.exit_codes),
             "abandoned": self.abandoned,
             "done": self.done,
@@ -135,8 +155,26 @@ class Supervisor(object):
                                           in one incarnation only
       ckpt_dir_for(worker_id) -> str      when given, retain() is run on the
                                           worker's checkpoint dir after each
-                                          restart (crash-loop disk GC)
+                                          restart (crash-loop disk GC). The
+                                          sentinel's last known-good step
+                                          (read from the dir's
+                                          sentinel.json) is always passed
+                                          as `protect` — GC can never eat
+                                          a rollback target
       ckpt_keep_last                      complete steps retain() keeps
+      sentinel_exit_code                  exit code workers use to request
+                                          an orderly divergence rollback
+                                          (sentinel.SENTINEL_EXIT_CODE);
+                                          such deaths are classified
+                                          `sentinel_rollback`, budgeted
+                                          and backed off separately, and
+                                          never count as rapid failures
+      sentinel_rollback_max               total sentinel rollbacks before
+                                          the worker is abandoned (the
+                                          sentinel itself abandons first
+                                          when quarantine cannot cure the
+                                          divergence; this is the outer
+                                          safety net)
     """
 
     def __init__(self, argv_for: Callable[[str], List[str]],
@@ -149,7 +187,9 @@ class Supervisor(object):
                  ckpt_keep_last: int = 2,
                  spawn_grace_s: float = 120.0,
                  poll_s: float = 0.05,
-                 membership_deadline_s: float = 2.0):
+                 membership_deadline_s: float = 2.0,
+                 sentinel_exit_code: int = _sentinel.SENTINEL_EXIT_CODE,
+                 sentinel_rollback_max: int = 8):
         self.argv_for = argv_for
         self.worker_ids = [str(w) for w in worker_ids]
         self.env_for = env_for
@@ -168,6 +208,8 @@ class Supervisor(object):
         self.spawn_grace_s = spawn_grace_s
         self.poll_s = poll_s
         self.membership_deadline_s = membership_deadline_s
+        self.sentinel_exit_code = int(sentinel_exit_code)
+        self.sentinel_rollback_max = int(sentinel_rollback_max)
         # supervision state is single-threaded BY DESIGN (the whole
         # point of the heartbeat/membership split: workers never talk
         # to the supervisor). A future callback/timer method must
@@ -193,6 +235,11 @@ class Supervisor(object):
             env.pop(_FAULT_ENV, None)
         env["PADDLE_WORKER_ID"] = h.worker_id
         env["PADDLE_RESTART_COUNT"] = str(h.restarts)
+        # why the predecessor died (crash/sentinel_rollback/hang), so
+        # the worker can announce it in its register_worker meta and
+        # operators can tell divergence churn from crash loops in the
+        # coordinator membership
+        env["PADDLE_RESTART_REASON"] = h.last_restart_reason or "none"
         # snapshot whatever membership record is ALREADY there (the dead
         # predecessor's, usually): only a record with a different
         # incarnation can vouch for — or condemn — the new process. A
@@ -249,25 +296,50 @@ class Supervisor(object):
             h.done = True
             self._event("done", h.worker_id, uptime=round(uptime, 3))
             return
-        rapid = (uptime - detect_lag) < self.min_uptime_s
-        h.rapid_failures = h.rapid_failures + 1 if rapid else 0
-        self._event("hang_kill" if hang else "crash", h.worker_id,
-                    rc=rc, uptime=round(uptime, 3), rapid=rapid)
+        sentinel = (not hang) and rc == self.sentinel_exit_code
+        if sentinel:
+            # an ORDERLY rollback request, not a failure of the process:
+            # budgeted on its own counter so divergence churn can never
+            # masquerade as (or hide inside) a crash loop
+            h.sentinel_rollbacks += 1
+            reason = "sentinel_rollback"
+            self._event("sentinel_rollback", h.worker_id, rc=rc,
+                        uptime=round(uptime, 3),
+                        rollbacks=h.sentinel_rollbacks)
+        else:
+            reason = "hang" if hang else "crash"
+            rapid = (uptime - detect_lag) < self.min_uptime_s
+            h.rapid_failures = h.rapid_failures + 1 if rapid else 0
+            self._event("hang_kill" if hang else "crash", h.worker_id,
+                        rc=rc, uptime=round(uptime, 3), rapid=rapid)
+        h.last_restart_reason = reason
+        h.restart_reasons.append(reason)
         if self.ckpt_dir_for is not None:
             try:
-                _ckpt.retain(self.ckpt_dir_for(h.worker_id),
-                             keep_last=self.ckpt_keep_last)
+                ckpt_dir = self.ckpt_dir_for(h.worker_id)
+                _ckpt.retain(ckpt_dir, keep_last=self.ckpt_keep_last,
+                             protect=_sentinel.known_good_step(ckpt_dir))
             except OSError:
                 pass  # GC is best-effort; the restart matters more
-        if h.rapid_failures >= self.restart_max:
-            h.abandoned = True
-            h.proc = None
-            self._event("abandon", h.worker_id,
-                        rapid_failures=h.rapid_failures)
-            return
+        if sentinel:
+            if h.sentinel_rollbacks >= self.sentinel_rollback_max:
+                h.abandoned = True
+                h.proc = None
+                self._event("abandon", h.worker_id,
+                            sentinel_rollbacks=h.sentinel_rollbacks)
+                return
+            backoff_exp = h.sentinel_rollbacks - 1
+        else:
+            if h.rapid_failures >= self.restart_max:
+                h.abandoned = True
+                h.proc = None
+                self._event("abandon", h.worker_id,
+                            rapid_failures=h.rapid_failures)
+                return
+            backoff_exp = h.rapid_failures - 1
         h.restarts += 1
         delay = min(
-            5.0, self.restart_backoff_s * (2 ** max(h.rapid_failures - 1, 0))
+            5.0, self.restart_backoff_s * (2 ** max(backoff_exp, 0))
         )
         h.next_spawn_at = time.time() + delay
         h.proc = None

@@ -1,0 +1,159 @@
+"""Supervised sentinel-training worker (driven by tests/test_sentinel.py).
+
+The real-process twin of bench.py's in-process `_sentinel_training_job`
+harness: one incarnation of a training loop whose health is watched by
+`distributed.sentinel.TrainingSentinel`. The supervisor spawns it; on a
+sentinel trip it exits with SENTINEL_EXIT_CODE (75) — an ORDERLY
+rollback request the Supervisor budgets separately from crashes — and
+the replacement incarnation resumes from the last KNOWN-GOOD checkpoint
+(the trip set the diverged step dirs aside as `.diverged`). The model
+is deliberately tiny pure-float64-numpy SGD: the subject under test is
+the control plane (detection, rollback, quarantine, restart reasons),
+and float64 numpy is bit-deterministic, so the drill can demand an
+EXACT final loss against the clean baseline.
+
+Each incarnation registers with the coordinator carrying the restart
+reason the Supervisor classified for its predecessor
+(PADDLE_RESTART_REASON -> register_worker meta), so the membership view
+distinguishes divergence churn from crash loops.
+
+Usage: sentinel_worker.py OUT_JSON CKPT_DIR COORD_ADDR
+Env:   SENT_SHARDS        comma-separated shard paths
+       SENT_QUARANTINE    quarantine journal path
+       SENT_EPOCHS        passes over the data (default 2)
+       SENT_BATCH         batch size (default 16)
+       SENT_DIM           feature dim (default 8)
+       SENT_SEED          dataset seed (default 11)
+       SENT_PROMOTE_K     known-good promotion distance (default 4)
+       SENT_CKPT_EVERY    checkpoint cadence in steps (default 2)
+       SENT_ROLLBACK_R    trips per window before quarantine (default 2)
+       PADDLE_WORKER_ID / PADDLE_RESTART_REASON  set by the Supervisor
+       PADDLE_FAULT       injected faults (nanloss@/spike@ poison the
+                          observed loss via injector.poison_loss)
+"""
+
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from paddle_tpu.data import DataLoader, ShardedDataset
+from paddle_tpu.distributed import (
+    RemoteCoordinator,
+    checkpoint as ckpt,
+    fault_injection as fi,
+    sentinel as sent_mod,
+)
+
+
+class _Scope(dict):
+    def get(self, name):
+        return dict.get(self, name)
+
+    def set(self, name, value):
+        self[name] = value
+
+
+def main():
+    out_path, ckpt_dir, addr = sys.argv[1:4]
+    wid = os.environ.get("PADDLE_WORKER_ID", "w?")
+    reason = os.environ.get("PADDLE_RESTART_REASON", "none")
+    shard_paths = os.environ["SENT_SHARDS"].split(",")
+    qpath = os.environ["SENT_QUARANTINE"]
+    epochs = int(os.environ.get("SENT_EPOCHS", "2"))
+    batch = int(os.environ.get("SENT_BATCH", "16"))
+    dim = int(os.environ.get("SENT_DIM", "8"))
+    seed = int(os.environ.get("SENT_SEED", "11"))
+    lr = 0.05
+
+    def decode(rec):
+        (rid,) = struct.unpack_from("<I", rec)
+        vec = np.frombuffer(rec[4:4 + 8 * dim], "<f8")
+        (y,) = struct.unpack_from("<d", rec, 4 + 8 * dim)
+        return rid, np.asarray(vec), y
+
+    injector = fi.default_injector()
+    client = RemoteCoordinator(addr, retry_deadline_s=20.0,
+                               backoff_base_s=0.05)
+    client.register_worker(wid, meta={"restart_reason": reason})
+
+    ds = ShardedDataset(shard_paths, decode_fn=decode, seed=seed,
+                        quarantine_path=qpath)
+    dl = DataLoader(ds, batch, num_workers=0)
+    detector = sent_mod.DivergenceDetector(hysteresis=1, warmup=2)
+    sent = sent_mod.TrainingSentinel(
+        ckpt_dir, quarantine_path=qpath, dataset=ds,
+        promote_after=int(os.environ.get("SENT_PROMOTE_K", "4")),
+        rollback_budget=int(os.environ.get("SENT_ROLLBACK_R", "2")),
+        detector=detector)
+    ckpt_every = int(os.environ.get("SENT_CKPT_EVERY", "2"))
+
+    scope = _Scope()
+    meta = ckpt.resume_or_init(scope, ckpt_dir,
+                               stateful={"loader": dl,
+                                         "detector": detector})
+    if meta is not None:
+        resumed_from = step = int(meta["extra"]["step"])
+        w = np.asarray(scope.get("w"), np.float64)
+        sent.align(step)
+    else:
+        resumed_from = None
+        step = 0
+        w = np.zeros(dim, np.float64)
+
+    loss = None
+    while dl.epoch < epochs:
+        for ids, X, y in dl:
+            injector.tick()
+            client.heartbeat(wid, step=step)
+            step += 1
+            # poisoned records overflow f64 BY DESIGN (see bench twin)
+            with np.errstate(over="ignore", invalid="ignore"):
+                err = X @ w - y
+                loss = float(np.mean(err * err))
+            loss = injector.poison_loss(loss)
+            decision = sent.observe(step, loss, cursor=dl.state_dict())
+            if decision is not None:
+                client.heartbeat(wid, step=step)
+                client.close()
+                # orderly rollback request: 75 keeps this out of the
+                # supervisor's crash-loop accounting. An "abandon"
+                # decision is a REAL failure — exit nonzero-but-not-75
+                # so the supervisor sees a crash and backs off.
+                sys.exit(sent_mod.SENTINEL_EXIT_CODE
+                         if decision["action"] != "abandon" else 1)
+            w = w - lr * (2.0 / len(y)) * (X.T @ err)
+            if step % ckpt_every == 0:
+                scope.set("w", w)
+                ckpt.save_checkpoint(
+                    scope, ckpt_dir, step=step, extra={"step": step},
+                    keep_last=2,
+                    stateful={"loader": dl, "detector": detector},
+                    protect=sent.known_good_step)
+                sent.on_checkpoint(step, cursor=dl.state_dict())
+    client.heartbeat(wid, step=step)
+    client.close()
+    dl.close()
+
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({
+            "worker": wid,
+            "resumed_from": resumed_from,
+            "restart_reason": reason,
+            "steps_done": step,
+            "final_loss": None if loss is None else float(loss),
+            "final_w": w.tolist(),
+            "known_good": sent.known_good_step,
+            "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
+                                                "0")),
+        }, f)
+    os.replace(tmp, out_path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,595 @@
+"""Silent-failure tolerance for training (ISSUE 10): divergence
+sentinel, checkpoint auto-rollback, and poisoned-data quarantine.
+
+Tier-1 slices: detector/promotion/attribution units, resume_or_init
+corruption walk-back (the `corrupt_file` fixture), the offline
+`checkpoint verify` scanner CLI, quarantine-aware chunk sources, the
+supervisor's sentinel-rollback classification + restart reasons, and an
+in-process chaos matrix over the new `nanloss@`/`spike@` fault kinds
+(reusing bench.py's deterministic `_sentinel_training_job` harness,
+the same discipline as the PR-8 smoke slices). The heavy real-process
+drill — Supervisor over sentinel_worker.py with a poisoned chunk — is
+`slow`-marked."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import bench
+from paddle_tpu.data import (CoordinatedChunkSource, DataLoader,
+                             ShardedDataset)
+from paddle_tpu.distributed import (
+    Coordinator,
+    CoordinatorServer,
+    Supervisor,
+    checkpoint as ckpt,
+    fault_injection as fi,
+    sentinel as sent_mod,
+)
+
+WORKER_PY = os.path.join(os.path.dirname(__file__), "sentinel_worker.py")
+
+
+class _Scope(dict):
+    def get(self, name):
+        return dict.get(self, name)
+
+    def set(self, name, value):
+        self[name] = value
+
+
+# ---------------------------------------------------------------------------
+# detection: hard non-finite trip + EWMA spike with hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_detector_nonfinite_trips_immediately():
+    d = sent_mod.DivergenceDetector(warmup=100)  # EWMA not even seeded
+    assert d.observe(1.0) == "ok"
+    assert d.observe(float("nan")) == "nonfinite"
+    assert d.observe(float("inf")) == "nonfinite"
+    assert d.observe(1.0, grad_norm=float("nan")) == "nonfinite"
+
+
+def test_detector_spike_needs_hysteresis_and_holds_ewma():
+    d = sent_mod.DivergenceDetector(spike_factor=3.0, hysteresis=2,
+                                    ewma_alpha=0.5, warmup=2)
+    for _ in range(3):
+        assert d.observe(1.0) == "ok"
+    base = d.ewma
+    # one spiked step: suspect, held OUT of the EWMA, no trip
+    assert d.observe(100.0) == "ok"
+    assert d.ewma == base
+    # a healthy step resets the streak (transient spike tolerated)
+    assert d.observe(1.0) == "ok"
+    assert d.observe(100.0) == "ok"
+    # the second CONSECUTIVE spiked step trips
+    assert d.observe(100.0) == "spike"
+    # ... and a slow-motion blowup can't drag its own baseline up
+    assert d.ewma < 2.0
+
+
+def test_detector_state_roundtrips():
+    d = sent_mod.DivergenceDetector(warmup=1)
+    for x in (1.0, 1.1, 0.9):
+        d.observe(x)
+    d2 = sent_mod.DivergenceDetector(warmup=1)
+    d2.load_state_dict(json.loads(json.dumps(d.state_dict())))
+    assert d2.ewma == d.ewma
+
+
+# ---------------------------------------------------------------------------
+# known-good promotion + trip decisions
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_promotes_after_k_steps(tmp_path):
+    s = sent_mod.TrainingSentinel(str(tmp_path), promote_after=3,
+                                  detector=sent_mod.DivergenceDetector(
+                                      warmup=1))
+    s.on_checkpoint(2, cursor={"epoch": 0, "pos": 1, "offset": 0})
+    assert s.observe(3, 1.0) is None
+    assert s.known_good_step is None  # 2 + 3 > 3: not ripe
+    assert s.observe(5, 1.0) is None
+    assert s.known_good_step == 2
+    # promotion survives a process restart (sentinel.json)
+    s2 = sent_mod.TrainingSentinel(str(tmp_path))
+    assert s2.known_good_step == 2
+    assert sent_mod.known_good_step(str(tmp_path)) == 2
+
+
+def test_sentinel_trip_rolls_back_and_sets_diverged_aside(tmp_path):
+    ck = str(tmp_path / "ck")
+    scope = _Scope()
+    scope.set("w", np.arange(4.0))
+    for step in (2, 4, 6):
+        ckpt.save_checkpoint(scope, ck, step=step, extra={"step": step},
+                             keep_last=10)
+    s = sent_mod.TrainingSentinel(ck, promote_after=2,
+                                  detector=sent_mod.DivergenceDetector(
+                                      warmup=1))
+    s.on_checkpoint(2)
+    assert s.observe(4, 1.0) is None  # promotes 2
+    assert s.known_good_step == 2
+    decision = s.observe(7, float("nan"))
+    assert decision["action"] == "rollback"
+    assert decision["rollback_to"] == 2
+    # steps 4 and 6 were set aside as .diverged (kept, not deleted)...
+    assert [st for st, _ in ckpt._list_step_dirs(ck)] == [2]
+    assert (tmp_path / "ck" / "step_0000000004.diverged").is_dir()
+    assert (tmp_path / "ck" / "step_0000000006.diverged").is_dir()
+    # ...so a plain resume lands exactly on known-good
+    s2 = _Scope()
+    meta = ckpt.resume_or_init(s2, ck)
+    assert meta["step"] == 2
+
+
+def test_sentinel_quarantines_after_budget_then_abandons(tmp_path):
+    qpath = str(tmp_path / "q.jsonl")
+
+    class _DS(object):
+        chunks = None
+
+        def epoch_order(self, epoch):
+            return [0, 1, 2]
+
+        def is_quarantined(self, ci):
+            return ci in sent_mod.quarantined_chunks(qpath)
+
+        def reload_quarantine(self):
+            pass
+
+    ds = _DS()
+
+    class _Chunk(object):
+        records = 8
+
+    ds.chunks = [_Chunk(), _Chunk(), _Chunk()]
+    det = sent_mod.DivergenceDetector(warmup=1)
+    s = sent_mod.TrainingSentinel(str(tmp_path / "ck"),
+                                  quarantine_path=qpath, dataset=ds,
+                                  promote_after=1, rollback_budget=2,
+                                  quarantine_rounds_max=1, detector=det)
+    s.on_checkpoint(1, cursor={"epoch": 0, "pos": 0, "offset": 0})
+    assert s.observe(2, 1.0) is None
+    cursor = {"epoch": 0, "pos": 1, "offset": 4}
+    d1 = s.observe(3, float("nan"), cursor=cursor)
+    assert d1["action"] == "rollback" and d1["suspects"] == [0, 1]
+    d2 = s.observe(3, float("nan"), cursor=cursor)
+    assert d2["action"] == "quarantine"
+    assert d2["quarantined"] == [0, 1]
+    assert sent_mod.quarantined_chunks(qpath) == frozenset({0, 1})
+    # divergence persists with the chunks excluded: nothing left to
+    # blame (suspects now empty) -> abandon
+    d3 = s.observe(3, float("nan"), cursor=cursor)
+    d4 = s.observe(3, float("nan"), cursor=cursor)
+    assert d4["action"] == "abandon", (d3, d4)
+
+
+def test_chunks_consumed_windows():
+    class _DS(object):
+        class _C(object):
+            def __init__(self, n):
+                self.records = n
+
+        def __init__(self):
+            self.chunks = [self._C(8) for _ in range(4)]
+
+        def epoch_order(self, epoch):
+            return [3, 1, 0, 2] if epoch % 2 else [0, 1, 2, 3]
+
+        def is_quarantined(self, ci):
+            return False
+
+    ds = _DS()
+    c = lambda e, p, o: {"epoch": e, "pos": p, "offset": o}
+    # same-chunk window
+    assert sent_mod.chunks_consumed(ds, c(0, 1, 0), c(0, 1, 4)) == [1]
+    # a cursor parked ON a chunk's end consumed it BEFORE the window
+    assert sent_mod.chunks_consumed(ds, c(0, 1, 8), c(0, 2, 4)) == [2]
+    # right edge with offset 0: chunk not yet entered
+    assert sent_mod.chunks_consumed(ds, c(0, 0, 4), c(0, 2, 0)) == [0, 1]
+    # epoch wrap picks up both epochs' orders
+    assert sent_mod.chunks_consumed(ds, c(0, 3, 2), c(1, 1, 1)) == [1, 3]
+
+
+# ---------------------------------------------------------------------------
+# resume_or_init fallback hardening + offline verify CLI (satellites)
+# ---------------------------------------------------------------------------
+
+
+def _save_steps(ck, steps):
+    scope = _Scope()
+    for step in steps:
+        scope.set("w", np.arange(6.0) * step)
+        ckpt.save_checkpoint(scope, ck, step=step, extra={"step": step},
+                             keep_last=10)
+
+
+def test_resume_walks_back_past_corrupt_latest(tmp_path):
+    """Satellite: corrupt the newest checkpoint with the corrupt_file
+    fixture; resume must land on the newest VERIFIABLE step, rename the
+    bad dir `.corrupt` (never delete), and name the failing CRC."""
+    ck = str(tmp_path / "ck")
+    _save_steps(ck, (1, 2, 3))
+    (npy,) = glob.glob(os.path.join(ck, "step_0000000003", "*.npy"))
+    fi.corrupt_file(npy)
+    scope = _Scope()
+    meta = ckpt.resume_or_init(scope, ck)
+    assert meta["step"] == 2
+    np.testing.assert_array_equal(scope.get("w"), np.arange(6.0) * 2)
+    (fb,) = meta["fallbacks"]
+    assert fb["step"] == 3
+    assert "CRC mismatch" in fb["problems"][0]
+    assert "w.p" in fb["problems"][0]  # names WHICH file failed
+    corrupt_dir = os.path.join(ck, "step_0000000003.corrupt")
+    assert os.path.isdir(corrupt_dir)  # renamed, not deleted
+    assert glob.glob(os.path.join(corrupt_dir, "*.npy"))  # evidence kept
+
+
+def test_resume_walks_back_past_metas_incomplete_latest(tmp_path):
+    """A step dir whose meta never committed (crash mid-save) is
+    quarantined `.corrupt` and walked past instead of raising or being
+    silently re-initialized."""
+    ck = str(tmp_path / "ck")
+    _save_steps(ck, (1, 2))
+    torn = os.path.join(ck, "step_0000000005")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "w.p0.npy"), "wb") as f:
+        f.write(b"\x00" * 16)  # data landed, meta commit never happened
+    scope = _Scope()
+    meta = ckpt.resume_or_init(scope, ck)
+    assert meta["step"] == 2
+    (fb,) = meta["fallbacks"]
+    assert "meta" in fb["problems"][0]
+    assert os.path.isdir(torn + ".corrupt")
+
+
+def test_resume_every_step_corrupt_falls_to_init(tmp_path):
+    ck = str(tmp_path / "ck")
+    _save_steps(ck, (1,))
+    (npy,) = glob.glob(os.path.join(ck, "step_0000000001", "*.npy"))
+    fi.corrupt_file(npy)
+    called = []
+    assert ckpt.resume_or_init(_Scope(), ck, init_fn=lambda:
+                               called.append(1)) is None
+    assert called == [1]
+    assert os.path.isdir(os.path.join(ck, "step_0000000001.corrupt"))
+
+
+def test_resume_step_pins_rollback_target(tmp_path):
+    """resume_or_init(step=S) ignores newer (distrusted) steps outright
+    and still falls back past corruption below S."""
+    ck = str(tmp_path / "ck")
+    _save_steps(ck, (1, 2, 3))
+    scope = _Scope()
+    meta = ckpt.resume_or_init(scope, ck, step=2)
+    assert meta["step"] == 2
+    assert [s for s, _ in ckpt._list_step_dirs(ck)] == [3, 2, 1]  # 3 intact
+    (npy,) = glob.glob(os.path.join(ck, "step_0000000002", "*.npy"))
+    fi.corrupt_file(npy)
+    meta = ckpt.resume_or_init(_Scope(), ck, step=2)
+    assert meta["step"] == 1 and meta["fallbacks"][0]["step"] == 2
+
+
+def test_retain_protects_known_good(tmp_path):
+    ck = str(tmp_path / "ck")
+    _save_steps(ck, (1, 2, 3, 4))
+    assert ckpt.retain(ck, keep_last=2, protect=1) == [4, 3, 1]
+    # protect also guards save_checkpoint's inline pruning
+    scope = _Scope()
+    scope.set("w", np.arange(6.0))
+    ckpt.save_checkpoint(scope, ck, step=5, keep_last=1, protect=1)
+    assert [s for s, _ in ckpt._list_step_dirs(ck)] == [5, 1]
+    # ... and the ASYNC writer's background prune (the documented
+    # per-pass save path must not GC the rollback target either)
+    scope.set("w", np.arange(6.0) * 2)
+    ckpt.save_checkpoint_async(scope, ck, step=6, keep_last=1,
+                               protect=1).result(timeout=30)
+    assert [s for s, _ in ckpt._list_step_dirs(ck)] == [6, 1]
+
+
+def test_checkpoint_verify_cli(tmp_path, capsys):
+    """Satellite: `python -m paddle_tpu.distributed.checkpoint verify`
+    reports per-step verdicts and exits non-zero on any failure. The
+    verdict logic is pinned in-process through the same `_cli` entry;
+    one subprocess proves the `python -m` wiring (interpreter spawns
+    are the tier-1 budget's enemy)."""
+    ck = str(tmp_path / "ck")
+    _save_steps(ck, (1, 2))
+    assert ckpt._cli(["verify", ck]) == 0
+    assert capsys.readouterr().out.count("OK") == 2
+    (npy,) = glob.glob(os.path.join(ck, "step_0000000002", "*.npy"))
+    fi.corrupt_file(npy)
+    bad = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.checkpoint",
+         "verify", ck], capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert bad.returncode == 1
+    assert "FAIL" in bad.stdout and "CRC mismatch" in bad.stdout
+    assert "step 1" in bad.stdout  # the good step still reports OK
+    # bad args / empty dir are usage errors, not crashes
+    assert ckpt._cli(["verify"]) == 2
+    assert ckpt._cli(["verify", str(tmp_path / "empty")]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# quarantine-aware chunk sources
+# ---------------------------------------------------------------------------
+
+
+def _tiny_shards(tmp_path, n_chunks=4, per=8):
+    paths = bench._make_sentinel_shards(
+        str(tmp_path / "shards"), 1, n_chunks, per, 4, 3)
+    return paths
+
+
+def _rid(rec):
+    import struct
+
+    return struct.unpack_from("<I", rec)[0]
+
+
+def test_local_source_skips_quarantined_deterministically(tmp_path):
+    paths = _tiny_shards(tmp_path)
+    qpath = str(tmp_path / "q.jsonl")
+    sent_mod.quarantine_chunks(qpath, [1], reason="test")
+
+    def run():
+        ds = ShardedDataset(paths, decode_fn=_rid, seed=3,
+                            quarantine_path=qpath)
+        dl = DataLoader(ds, 4, num_workers=0)
+        ids = [int(i) for b in dl for i in b]
+        dl.close()
+        return ids
+
+    a, b = run(), run()
+    assert a == b  # deterministic across reruns
+    assert len(a) == 24  # 32 records minus the quarantined chunk's 8
+    assert not set(a) & set(range(8, 16))  # chunk 1's records absent
+    assert len(set(a)) == 24  # and nothing double-delivered
+
+
+def test_coordinated_source_skips_and_acks_quarantined(tmp_path):
+    paths = _tiny_shards(tmp_path)
+    qpath = str(tmp_path / "q.jsonl")
+    sent_mod.quarantine_chunks(qpath, [2], reason="test")
+    ds = ShardedDataset(paths, decode_fn=_rid, seed=3,
+                        quarantine_path=qpath)
+    coord = Coordinator(timeout_s=30)
+    src = CoordinatedChunkSource(coord)
+    src.publish(ds)
+    dl = DataLoader(ds, 4, source=src, num_workers=0)
+    ids = [int(i) for b in dl for i in b]
+    dl.close()
+    assert not set(ids) & set(range(16, 24))
+    assert len(ids) == len(set(ids)) == 24
+    # the quarantined chunk's lease was finished, not left to expire:
+    # the pass drained completely
+    assert len(coord.done) == 4
+    assert not coord.todo and not coord.pending
+
+
+# ---------------------------------------------------------------------------
+# supervisor: restart reasons + separate sentinel-rollback budget
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_classifies_sentinel_rollbacks(tmp_path):
+    """Exit code 75 is an orderly rollback: budgeted on its own counter
+    (never rapid_failures), reason-tagged, and the reason is handed to
+    the replacement via PADDLE_RESTART_REASON."""
+    log = tmp_path / "reasons.txt"
+    script = ("import os, sys;"
+              "open(%r, 'a').write("
+              "os.environ.get('PADDLE_RESTART_REASON', '?') + chr(10));"
+              "sys.exit(75)" % str(log))
+    sup = Supervisor(lambda wid: [sys.executable, "-c", script], ["w0"],
+                     restart_backoff_s=0.01, sentinel_rollback_max=3,
+                     min_uptime_s=1e9)  # every CRASH would read rapid
+    report = sup.run(deadline_s=60)
+    w = report["workers"]["w0"]
+    assert w["abandoned"]
+    assert w["sentinel_rollbacks"] == 3
+    assert w["rapid_failures"] == 0  # never leaked into crash accounting
+    assert w["restart_reasons"] == ["sentinel_rollback"] * 3
+    assert [e["kind"] for e in report["events"]
+            if e["kind"] in ("sentinel_rollback", "abandon")] == \
+        ["sentinel_rollback"] * 3 + ["abandon"]
+    assert log.read_text().splitlines() == \
+        ["none", "sentinel_rollback", "sentinel_rollback"]
+
+
+def test_supervisor_crash_reasons_still_crash(tmp_path):
+    sup = Supervisor(lambda wid: [sys.executable, "-c", "raise SystemExit(9)"],
+                     ["w0"], restart_backoff_s=0.01, restart_max=2,
+                     min_uptime_s=1e9)
+    report = sup.run(deadline_s=60)
+    w = report["workers"]["w0"]
+    assert w["abandoned"] and w["rapid_failures"] == 2
+    assert w["sentinel_rollbacks"] == 0
+    assert w["restart_reasons"] == ["crash", "crash"]
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix (in-process, tier-1): nanloss@ / spike@ / corrupt@
+# ---------------------------------------------------------------------------
+
+
+def _chaos_shards(tmp_path, poison_chunk=None):
+    return bench._make_sentinel_shards(
+        str(tmp_path / "shards"), 2, 4, 32, 8, 11,
+        poison_chunk=poison_chunk)
+
+
+def _chaos_job(tmp_path, name, paths, injector=None, hysteresis=1,
+               epochs=2):
+    return bench._sentinel_training_job(
+        str(tmp_path / name / "ckpt"), paths,
+        str(tmp_path / name / "q.jsonl"), injector=injector,
+        hysteresis=hysteresis, epochs=epochs)
+
+
+def test_chaos_nanloss_transient_rolls_back_and_heals(tmp_path):
+    """nanloss@13 poisons ONE observed loss: the sentinel must roll
+    back to known-good; the replay (fault is step-indexed and the step
+    counter keeps counting) is clean, NO chunk is quarantined, and the
+    final curve matches the no-fault run exactly."""
+    paths = _chaos_shards(tmp_path)
+    clean = _chaos_job(tmp_path, "clean", paths)
+    assert clean["outcome"] == "done" and not clean["trips"]
+    job = _chaos_job(tmp_path, "nan", paths,
+                     injector=fi.FaultInjector("nanloss@13"))
+    assert job["outcome"] == "done"
+    (trip,) = job["trips"]
+    assert trip["verdict"] == "nonfinite"
+    # rollback landed on the known-good step, exactly
+    assert job["resumes"][1]["step"] == trip["rollback_to"]
+    assert job["resumes"][1]["known_good"] == trip["rollback_to"]
+    # a transient fault quarantines NOTHING
+    assert not os.path.exists(str(tmp_path / "nan" / "q.jsonl"))
+    assert job["curve"] == clean["curve"]
+    assert job["step_ids"] == clean["step_ids"]
+
+
+def test_chaos_spike_sustained_trips_transient_tolerated(tmp_path):
+    paths = _chaos_shards(tmp_path)
+    # hysteresis=2 tolerates a single spiked step: NO trip at all
+    tolerant = _chaos_job(tmp_path, "tol", paths, hysteresis=2,
+                          injector=fi.FaultInjector("spike@13:50"))
+    assert tolerant["outcome"] == "done" and not tolerant["trips"]
+    # two consecutive spiked steps beat hysteresis=2 and trip
+    tripped = _chaos_job(tmp_path, "trip", paths, hysteresis=2,
+                         injector=fi.FaultInjector(
+                             "spike@13:50,spike@14:50"))
+    assert tripped["outcome"] == "done"
+    assert tripped["trips"]
+    assert tripped["trips"][0]["verdict"] == "spike"
+    assert tripped["resumes"][1]["step"] == \
+        tripped["trips"][0]["rollback_to"]
+    clean = _chaos_job(tmp_path, "clean", paths)
+    assert tripped["curve"] == clean["curve"]
+
+
+def test_chaos_poison_chunk_quarantine_deterministic(tmp_path):
+    """The data-poison leg of the matrix: two independent reruns of the
+    same poisoned job produce byte-identical quarantine journals (the
+    invariant that lets a fleet of workers share the journal)."""
+    probe = ShardedDataset(_chaos_shards(tmp_path), seed=11)
+    poison = int(probe.epoch_order(0)[5])
+    paths = bench._make_sentinel_shards(
+        str(tmp_path / "pshards"), 2, 4, 32, 8, 11, poison_chunk=poison)
+    a = _chaos_job(tmp_path, "a", paths)
+    b = _chaos_job(tmp_path, "b", paths)
+    assert a["outcome"] == b["outcome"] == "done"
+    ja = open(str(tmp_path / "a" / "q.jsonl")).read()
+    jb = open(str(tmp_path / "b" / "q.jsonl")).read()
+    assert ja == jb
+    assert sent_mod.quarantined_chunks(
+        str(tmp_path / "a" / "q.jsonl")) == frozenset({poison})
+    # rollback target is known-good at every trip, and no record was
+    # double-delivered after the quarantine (per committed epoch)
+    for trip, resume in zip(a["trips"], a["resumes"][1:]):
+        assert resume["step"] == trip["rollback_to"]
+    for epoch in (0, 1):
+        ids = [r for s, e in a["step_epoch"].items() if e == epoch
+               for r in a["step_ids"][s]]
+        assert len(ids) == len(set(ids))
+
+
+def test_chaos_corrupt_checkpoint_between_incarnations(tmp_path):
+    """The corrupt@ leg: the newest checkpoint of a finished run is
+    corrupted with the standard fixture; the next resume walks back and
+    completes with zero manual intervention."""
+    paths = _chaos_shards(tmp_path)
+    first = _chaos_job(tmp_path, "job", paths, epochs=1)
+    assert first["outcome"] == "done"
+    ck = str(tmp_path / "job" / "ckpt")
+    newest = ckpt.retain(ck, keep_last=10)[0]
+    npy = sorted(glob.glob(os.path.join(
+        ck, "step_%010d" % newest, "*.npy")))[0]
+    fi.corrupt_file(npy)
+    second = _chaos_job(tmp_path, "job", paths, epochs=2)
+    assert second["outcome"] == "done"
+    (fb,) = second["resumes"][0]["fallbacks"]
+    assert fb["step"] == newest and "CRC" in fb["problems"][0]
+    assert os.path.isdir(os.path.join(
+        ck, "step_%010d.corrupt" % newest))
+
+
+# ---------------------------------------------------------------------------
+# heavy end-to-end: Supervisor over real worker processes (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_supervised_sentinel_rollback_and_quarantine_e2e(tmp_path):
+    """The full real-process story: a supervised worker hits a poisoned
+    chunk, exits 75, is respawned (reason=sentinel_rollback, visible in
+    the coordinator membership meta), rolls back to known-good, trips
+    again, quarantines the chunk, and finishes with the clean-baseline
+    final parameters — all with zero manual intervention."""
+    probe = ShardedDataset(
+        bench._make_sentinel_shards(str(tmp_path / "probe"), 2, 4, 32,
+                                    8, 11), seed=11)
+    poison = int(probe.epoch_order(0)[5])
+    paths = bench._make_sentinel_shards(
+        str(tmp_path / "shards"), 2, 4, 32, 8, 11, poison_chunk=poison)
+    qpath = str(tmp_path / "quarantine.jsonl")
+    out = str(tmp_path / "out.json")
+    ck = str(tmp_path / "ckpt")
+    coord = Coordinator(heartbeat_timeout_s=30)
+    server = CoordinatorServer(coord).start()
+
+    def env_for(wid):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_FAULT", None)
+        env.update({"SENT_SHARDS": ",".join(paths),
+                    "SENT_QUARANTINE": qpath})
+        return env
+
+    sup = Supervisor(
+        lambda wid: [sys.executable, WORKER_PY, out, ck, server.address],
+        ["w0"], env_for=env_for, coordinator=coord,
+        ckpt_dir_for=lambda wid: ck, restart_backoff_s=0.01)
+    try:
+        report = sup.run(deadline_s=240)
+    finally:
+        server.stop()
+
+    assert report["ok"], report
+    w = report["workers"]["w0"]
+    assert w["sentinel_rollbacks"] == 2
+    assert w["rapid_failures"] == 0
+    assert w["restart_reasons"] == ["sentinel_rollback"] * 2
+    assert all(rc == sent_mod.SENTINEL_EXIT_CODE
+               for rc in w["exit_codes"][:-1])
+    # the membership carries the final incarnation's restart reason
+    assert coord.membership()["w0"]["meta"]["restart_reason"] == \
+        "sentinel_rollback"
+    # quarantine journaled the poison chunk exactly once
+    entries = sent_mod.quarantine_entries(qpath)
+    assert [e["chunk"] for e in entries] == [poison]
+    rec = json.load(open(out))
+    assert rec["restart_count"] == 2
+    assert rec["resumed_from"] == sent_mod.known_good_step(ck) or \
+        rec["resumed_from"] is not None
+    assert np.isfinite(rec["final_loss"])
+    # exact parity with the clean baseline: same shards minus the
+    # quarantined chunk, run uninterrupted in one process
+    clean_paths = bench._make_sentinel_shards(
+        str(tmp_path / "clean"), 2, 4, 32, 8, 11)
+    q_clean = str(tmp_path / "clean_q.jsonl")
+    sent_mod.quarantine_chunks(q_clean, [poison], reason="baseline")
+    clean = bench._sentinel_training_job(
+        str(tmp_path / "clean" / "ckpt"), clean_paths, q_clean)
+    assert clean["outcome"] == "done"
+    np.testing.assert_array_equal(rec["final_w"], clean["final_w"])
