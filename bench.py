@@ -68,6 +68,20 @@ definitions):
               healthy vs gray (gray must stay under the slow window —
               the demotion bounded the tail); outputs must be
               token-identical across both runs
+  serving_elastic — disaggregated elastic fleet acceptance (ISSUE 11):
+              the same fixed-seed Poisson BURST trace of
+              deadline-carrying requests through a STATIC tiered fleet
+              (prefill/decode disaggregation only) and through the
+              ELASTIC fleet (autoscaler on, one mid-trace
+              roll_weights to a CRC-verified checkpoint of the same
+              weights); pins zero expired requests, zero lost or
+              duplicated rids, >=1 scale-up spawn and >=1 scale-down
+              retirement, >=1 prefill->decode migration, exactly one
+              completed rollout, a corrupted-candidate rollout
+              aborting with every replica still serving the old
+              version, the journal DFA green including the J009
+              version fence (no mixed-version output), and outputs
+              token-identical between the static and elastic runs
   training_sentinel — silent-failure tolerance acceptance (ISSUE 10):
               a fixed-seed training job over shards containing one
               poisoned chunk; pins >=1 sentinel trip, rollback landing
@@ -1746,6 +1760,280 @@ def bench_serving_slo(n_replicas=None, n_requests=None, max_slots=None,
     }
 
 
+def bench_serving_elastic(n_requests=None, max_slots=None, dim=None,
+                          heads=None, layers_n=None, vocab=None,
+                          max_len=None, deadline_s=None):
+    """Disaggregated elastic fleet acceptance (ISSUE 11): the SAME
+    fixed-seed Poisson BURST trace — every request carrying a generous
+    deadline — runs twice: (a) STATIC, a fixed-size tiered fleet
+    (prefill/decode disaggregation, no scaling, no rollout), and (b)
+    ELASTIC, the same tiers with the autoscaler on (min 2, max 3
+    replicas) plus ONE mid-trace `roll_weights` onto a CRC-verified
+    checkpoint of the SAME weights (saved with `save_weights` — the
+    pserver push/pull cycle recast as checkpoint promotion). The
+    deterministic offline columns, hard-raised in-bench:
+
+      * expired requests MUST be 0 in both runs (the burst rides
+        scale-up instead of queue-starving deadlines), and no rid is
+        lost or answered twice (`lost == 0`, one `done` per rid in
+        the journal);
+      * the elastic run must spawn >= 1 replica during the burst,
+        retire >= 1 after it (full scale-up -> scale-down cycle),
+        migrate >= 1 request from the prefill tier to a decode tier
+        at first token, and complete exactly one rollout;
+      * NO mixed-version output: the journal replays green through
+        the protocol DFA (`--expect-closed`), including the J009
+        version fence — every done record's `weights_version` equals
+        its latest assignment's;
+      * a CORRUPTED candidate checkpoint aborts a second
+        `roll_weights` with every live replica still serving the
+        rolled version, and the fleet still completing requests;
+      * outputs token-identical between the static and elastic runs —
+        neither tier migration, autoscaling, nor the weight rollout
+        may change what a request decodes to.
+
+    tokens/s is on-chip-pending like every serving row; the drill
+    columns above are deterministic offline."""
+    import glob
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.protocol_lint import verify_journal
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import (RequestJournal, RolloutAborted,
+                                    ServingFleet, save_weights)
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape
+        dim, heads, layers_n = dim or 32, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 64, max_len or 64
+        n_requests = n_requests or 12
+        max_slots = max_slots or 3
+        t_lo, t_hi, n_lo, n_hi, rate = 4, 10, 6, 12, 2.0
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_requests = n_requests or 32
+        max_slots = max_slots or 8
+        t_lo, t_hi, n_lo, n_hi, rate = 16, 64, 32, 96, 2.0
+        dtype = jnp.bfloat16
+    deadline_s = deadline_s or 300.0
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    # a BURST: high-rate Poisson arrivals, so open requests outrun the
+    # two starting replicas and the scaler has something to answer
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    reqs = []
+    for _ in range(n_requests):
+        t = int(rng.randint(t_lo, t_hi + 1))
+        reqs.append((rng.randint(0, vocab, t).astype(np.int32),
+                     int(rng.randint(n_lo, n_hi + 1))))
+
+    work_dir = tempfile.mkdtemp(prefix="bench_elastic_")
+    ckpt_dir = os.path.join(work_dir, "ckpt")
+    # the promotion target: the SAME weights at step 1, written through
+    # the training checkpoint machinery (CRC sidecars, atomic commit)
+    # so the rollout's verify walk has something real to check — and
+    # identical weights keep the output-identity bar meaningful
+    save_weights(params, ckpt_dir, step=1)
+
+    tiers = ["prefill", "decode", "decode"]
+
+    def run_once(elastic: bool):
+        keep_dir = os.environ.get("PADDLE_TPU_KEEP_JOURNAL_DIR") or None
+        if keep_dir is not None:
+            os.makedirs(keep_dir, exist_ok=True)
+        jpath = tempfile.mktemp(suffix=".jsonl",
+                                prefix="elastic_journal_", dir=keep_dir)
+        kw = dict(
+            n_replicas=2, journal_path=jpath,
+            heartbeat_timeout_s=300.0, monitor_interval_s=0.02,
+            max_pending=4 * n_requests,
+            engine_kw={"max_slots": max_slots},
+        )
+        if elastic:
+            kw.update(replica_tier=tiers, min_replicas=2,
+                      max_replicas=3, scale_up_open_per_replica=2,
+                      scale_down_idle_s=0.4, scale_cooldown_s=0.05,
+                      ckpt_dir=ckpt_dir)
+        else:
+            kw.update(replica_tier=tiers[:2])
+        fleet = ServingFleet(params, cfg, **kw)
+        rolled = False
+        try:
+            t0 = time.time()
+            hs, i, step = [], 0, 0
+            while True:
+                while i < n_requests and arrive_at[i] <= step:
+                    p, n = reqs[i]
+                    hs.append(fleet.submit(p, n, deadline_s=deadline_s))
+                    i += 1
+                if elastic and not rolled and i >= n_requests:
+                    # the whole burst is in flight (requests run for
+                    # many engine steps yet): first let the scaler
+                    # answer the queue depth — scale-up is PAUSED
+                    # during a rollout, so the cycle under test is
+                    # burst -> scale-up -> rolling swap — then roll
+                    # while traffic still decodes (drain -> swap ->
+                    # refill; in-flight finishes on the old version)
+                    gate = time.monotonic() + 60.0
+                    while not fleet.stats()["replicas_spawned"]:
+                        if time.monotonic() >= gate:
+                            raise RuntimeError(
+                                "burst never triggered a scale-up "
+                                "before the mid-trace rollout")
+                        time.sleep(0.01)
+                    fleet.roll_weights(ckpt_step=1, timeout=300.0)
+                    rolled = True
+                if i >= n_requests and all(h.done for h in hs):
+                    break
+                time.sleep(0.004)
+                step += 1
+            for h in hs:
+                h.result(timeout=600)  # raises on lost/expired
+            wall = time.time() - t0
+            if elastic:
+                # after the burst: sustained low load must retire the
+                # extra replica (full scale-up -> scale-down cycle)
+                deadline = time.monotonic() + 60.0
+                while fleet.stats()["replicas_live"] > 2:
+                    if time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.05)
+                # corrupted-candidate drill: a torn weight file must
+                # abort the rollout with the fleet untouched
+                save_weights(params, ckpt_dir, step=2)
+                bad = sorted(glob.glob(os.path.join(
+                    ckpt_dir, "step_0000000002", "*.npy")))[0]
+                with open(bad, "r+b") as fh:
+                    fh.seek(12)
+                    fh.write(b"\xde\xad\xbe\xef")
+                aborted = False
+                try:
+                    fleet.roll_weights(ckpt_step=2, timeout=300.0)
+                except RolloutAborted:
+                    aborted = True
+                if not aborted:
+                    raise RuntimeError(
+                        "corrupted candidate checkpoint did NOT abort "
+                        "roll_weights")
+                st_live = [r for r in fleet.stats()["replicas"]
+                           if r["state"] == "live"]
+                if any(r["weights_version"] != 1 for r in st_live):
+                    raise RuntimeError(
+                        "aborted rollout touched the fleet: live "
+                        "versions %r != 1"
+                        % [r["weights_version"] for r in st_live])
+                # ...and the fleet still serves
+                h = fleet.submit(reqs[0][0], reqs[0][1])
+                post_abort = list(
+                    h.result(timeout=600)[len(reqs[0][0]):])
+                if post_abort != [int(t) for t in hs[0].tokens]:
+                    raise RuntimeError(
+                        "post-abort output diverged from the burst "
+                        "run's for the same request")
+            st = fleet.stats()
+        finally:
+            fleet.close()
+        # journal audit: the protocol DFA replay IS the dedupe and
+        # version-fence check — a second done for a rid is J002, a
+        # done whose version differs from its latest assignment's is
+        # J009, an unterminated rid is J007 (expect_closed)
+        done_ver = {rec["rid"]: rec.get("weights_version")
+                    for rec in RequestJournal._read(jpath)
+                    if rec["kind"] == "done"}
+        diags = verify_journal(jpath, expect_closed=True)
+        if diags:
+            raise RuntimeError(
+                "journal audit failed: %s"
+                % "; ".join("%s %s" % (d.code, d.message)
+                            for d in diags))
+        if keep_dir is None:
+            os.unlink(jpath)
+        if st["expired"] or st["expired_on_arrival"]:
+            raise RuntimeError(
+                "%s run expired %d request(s)"
+                % ("elastic" if elastic else "static", st["expired"]))
+        if st["lost"]:
+            raise RuntimeError(
+                "%s run lost requests: %r"
+                % ("elastic" if elastic else "static", st))
+        toks = sum(len(h.tokens) for h in hs)
+        return {"stats": st, "outputs": [list(h.tokens) for h in hs],
+                "versions": sorted(
+                    {v for v in done_ver.values() if v is not None}),
+                "tokens_per_sec": toks / wall}
+
+    try:
+        static = run_once(elastic=False)
+        elastic = run_once(elastic=True)
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    if static["outputs"] != elastic["outputs"]:
+        raise RuntimeError(
+            "outputs diverge between the static and elastic runs: "
+            "tier migration / scaling / rollout changed what a "
+            "request decodes to")
+    el = elastic["stats"]
+    if not el["replicas_spawned"]:
+        raise RuntimeError(
+            "the burst never triggered a scale-up: autoscaler dead "
+            "or thresholds wrong (%r)" % el["replicas_spawned"])
+    if not el["replicas_retired"]:
+        raise RuntimeError(
+            "the post-burst lull never retired a replica: scale-down "
+            "path dead")
+    if not el["migrations"]:
+        raise RuntimeError(
+            "no prefill->decode migration happened on a tiered fleet")
+    if el["rollouts_completed"] != 1:
+        raise RuntimeError(
+            "expected exactly 1 completed rollout, got %r"
+            % el["rollouts_completed"])
+    if el["rollout_aborts"] != 1:
+        raise RuntimeError(
+            "expected exactly 1 aborted rollout (the corrupted "
+            "candidate drill), got %r" % el["rollout_aborts"])
+    return {
+        # the elasticity columns (deterministic offline)
+        "expired": el["expired"],
+        "requests_lost": el["lost"],
+        "replicas_spawned": el["replicas_spawned"],
+        "replicas_retired": el["replicas_retired"],
+        "migrations": el["migrations"],
+        "rollouts_completed": el["rollouts_completed"],
+        "rollout_aborts": el["rollout_aborts"],
+        "weights_version_final": el["weights_version"],
+        "done_versions_seen": elastic["versions"],
+        "resumed_requests": el["resumed_requests"],
+        "resumed_tokens_reused": el["resumed_tokens"],
+        "outputs_identical_to_static": True,  # hard-raised above
+        "replicas_live_final": el["replicas_live"],
+        # latency/throughput (wall-clock; on-chip-pending)
+        "tokens_per_sec_static": round(static["tokens_per_sec"], 1),
+        "tokens_per_sec_elastic": round(elastic["tokens_per_sec"], 1),
+        "n_requests": n_requests,
+        "arrival": "poisson(rate=%g/step, seed=0), burst" % rate,
+        "knobs": {"max_slots": max_slots, "tiers": tiers,
+                  "min_replicas": 2, "max_replicas": 3,
+                  "scale_up_open_per_replica": 2,
+                  "scale_down_idle_s": 0.4, "scale_cooldown_s": 0.05,
+                  "rollout_policy": "finish", "deadline_s": deadline_s},
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
 def bench_input_pipeline(n_shards=4, chunks_per_shard=8,
                          records_per_chunk=64, batch=64, step_s=0.004,
                          decode_sleep_s=0.0001, num_workers=2,
@@ -2533,6 +2821,12 @@ def main():
         # probe/restore counts, journal-verified re-decode-zero resume,
         # and the p99 TTFT tail bound are deterministic offline
         run("serving_slo", bench_serving_slo)
+        # disaggregated elastic fleet (ISSUE 11): the same burst trace
+        # static vs elastic (tiers + autoscaler + one mid-trace weight
+        # rollout + corrupted-candidate abort drill) — spawn/retire/
+        # migration/rollout counts, the J009 version-fence audit, and
+        # output identity are deterministic offline
+        run("serving_elastic", bench_serving_elastic)
         run("transformer_lm", bench_transformer_lm)
         # larger-matmul flagship: dim=1024 keeps every matmul MXU-shaped
         # (the dim=512 row leaves lane headroom), so this is the MFU

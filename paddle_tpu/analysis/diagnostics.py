@@ -64,6 +64,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "J006": ("unassigned-progress", "error"),
     "J007": ("open-at-close", "error"),
     "J008": ("malformed-journal", "error"),
+    "J009": ("version-fence", "error"),
 }
 
 # codes whose analyzer runs inside `--all` / `run_all()` — the only

@@ -39,6 +39,20 @@ and reports violations as stable J-codes:
                           anywhere but the file head (compaction
                           REWRITES the file; meta mid-file means two
                           histories were glued together)
+  J009 version-fence      a done record whose `weights_version` differs
+                          from its latest assignment's (ISSUE 11 live
+                          weight rollout): a mixed-version output is a
+                          PROTOCOL violation, not just a test failure —
+                          the fleet promises every response's verdict
+                          version matches the assignment that produced
+                          it. Checked only when both sides carry the
+                          optional side-band; journals from an
+                          unversioned fleet stay clean.
+
+Optional side-band fields (ISSUE 11): assign records may carry `tier`
+(prefill/decode disaggregation placement) and `weights_version` (the
+assignee's weight version); done records may carry `weights_version`.
+Present-but-ill-typed side-band fields are J008 like any other field.
 
 A torn FINAL line is tolerated exactly like `RequestJournal._read`
 (the crash the journal exists to survive must not fail its own audit);
@@ -97,13 +111,29 @@ _FIELD_TYPES = {
     "replica": (str, type(None)),
     "incarnation": (int, type(None)),
     "gen": (int, type(None)),
+    # ISSUE 11 side-band (optional on assign/done): nullable, because
+    # an untiered/unversioned fleet writes them as null
+    "tier": (str, type(None)),
+    "weights_version": (int, type(None)),
+}
+
+# optional per-kind side-band fields: absent is fine (old journals),
+# present-but-ill-typed is J008 like any required field
+_OPTIONAL = {
+    "assign": ("tier", "weights_version"),
+    "done": ("weights_version",),
 }
 
 
 def _ill_typed(rec, kind):
-    """Name of the first ill-typed required field, or None."""
+    """Name of the first ill-typed required (or present optional)
+    field, or None."""
     for field in _REQUIRED[kind]:
         if not isinstance(rec[field], _FIELD_TYPES[field]):
+            return field
+    for field in _OPTIONAL.get(kind, ()):
+        if field in rec and not isinstance(rec[field],
+                                           _FIELD_TYPES[field]):
             return field
     return None
 
@@ -127,11 +157,15 @@ class JournalViolation(RuntimeError):
 class _Rid(object):
     """DFA state for one request id."""
 
-    __slots__ = ("state", "assign", "progress", "terminal_line")
+    __slots__ = ("state", "assign", "assign_version", "progress",
+                 "terminal_line")
 
     def __init__(self):
         self.state = "open"          # open -> terminal
         self.assign: Optional[Tuple[str, int, int]] = None
+        # weights_version side-band of the latest assignment (None =
+        # unversioned): the J009 version fence's reference value
+        self.assign_version: Optional[int] = None
         self.progress: List[int] = []
         self.terminal_line = 0
 
@@ -229,6 +263,7 @@ def verify_records(records, path_label: str = "<journal>",
             if kind == "assign":
                 st.assign = (rec["replica"], rec["incarnation"],
                              rec["gen"])
+                st.assign_version = rec.get("weights_version")
             elif kind == "progress":
                 st.progress.extend(rec["tokens"])
             else:
@@ -244,6 +279,7 @@ def verify_records(records, path_label: str = "<journal>",
             continue
         if kind == "assign":
             st.assign = (rec["replica"], rec["incarnation"], rec["gen"])
+            st.assign_version = rec.get("weights_version")
             continue
         if kind == "progress":
             holder = (rec["replica"], rec["incarnation"], rec["gen"])
@@ -283,6 +319,19 @@ def verify_records(records, path_label: str = "<journal>",
                      "holder's completion was accepted"
                      % (rid, rec["replica"], rec["incarnation"],
                         rec["gen"], (st.assign,)))
+            dv = rec.get("weights_version")
+            if dv is not None and st.assign is not None \
+                    and st.assign_version is not None \
+                    and dv != st.assign_version:
+                # the live-rollout version fence (ISSUE 11): the
+                # verdict must come from the weights the latest
+                # assignment promised — a mismatch means tokens from
+                # two weight versions were mixed into one response
+                diag("J009", lineno, rid, "done-version",
+                     "done for rid %d records weights_version %d but "
+                     "its latest assignment carries version %d — a "
+                     "mixed-version output crossed the rollout fence"
+                     % (rid, dv, st.assign_version))
         if kind in ("done", "expired"):
             # no empty-progress exemption: the fleet journals EVERY
             # emitted token as a progress delta before the terminal

@@ -217,12 +217,33 @@ class ControlledScheduler(SchedulerHook):
         self._exited: set = set()             # guarded-by: _cv
         self._free = False                    # guarded-by: _cv
         self._threads: Dict[str, threading.Thread] = {}  # guarded-by: _cv
+        # append-only registration log + announced-but-unregistered
+        # spawns: step() uses both to wait for threads the RELEASED
+        # hop itself spawned (an autoscaler scale-up, a rollout
+        # refill) to reach their first park — the recorded enabled-set
+        # must not race a fresh thread's startup, or replays of the
+        # same schedule could diverge. The fleet announces each spawn
+        # SYNCHRONOUSLY via thread_spawning(name) before start(), so
+        # even a thread the OS has not scheduled yet (no
+        # thread_started call) is accounted for.
+        self._reg_log: List[str] = []         # guarded-by: _cv
+        self._pending_spawn: set = set()      # guarded-by: _cv
 
     # -- SchedulerHook (called from fleet threads) ---------------------
     def thread_started(self, kind: str, name: str):
         with self._cv:
             self._names[threading.get_ident()] = name
             self._threads[name] = threading.current_thread()
+            self._reg_log.append(name)
+            self._pending_spawn.discard(name)
+            self._cv.notify_all()
+
+    def thread_spawning(self, name: str):
+        # called on the SPAWNING thread (possibly under fleet locks):
+        # record only, never block
+        with self._cv:
+            if not self._free:
+                self._pending_spawn.add(name)
             self._cv.notify_all()
 
     def thread_exiting(self):
@@ -302,10 +323,14 @@ class ControlledScheduler(SchedulerHook):
 
     def step(self, name: str, timeout: float = _QUIESCE_TIMEOUT_S):
         """Release thread `name` for one hop; block until it parks at
-        its next yield point or exits."""
+        its next yield point or exits — AND until any thread the hop
+        spawned (scale-up, rollout refill) reaches its own first park,
+        so the next enabled() snapshot is a pure function of the
+        schedule, not of thread-startup timing."""
         with self._cv:
             if name not in self._parked:
                 raise KeyError("thread %r is not parked" % name)
+            reg0 = len(self._reg_log)
             self._parked.pop(name)
             self._cv.notify_all()
             deadline = time.monotonic() + timeout
@@ -318,11 +343,29 @@ class ControlledScheduler(SchedulerHook):
                         "within %.0fs (wedged between yield points)"
                         % (name, timeout))
                 self._cv.wait(timeout=0.05)
+            while not self._free:
+                fresh = [n for n in self._reg_log[reg0:]
+                         if n in self._names.values()
+                         and n not in self._parked
+                         and n not in self._exited]
+                # announced spawns that have not even registered yet:
+                # the synchronous thread_spawning() notice closes the
+                # start()-to-registration window
+                fresh += [n for n in self._pending_spawn
+                          if n not in fresh]
+                if not fresh:
+                    break
+                if time.monotonic() > deadline:
+                    raise SchedulerWedge(
+                        "thread(s) %r spawned by %r's hop failed to "
+                        "reach their first park" % (fresh, name))
+                self._cv.wait(timeout=0.05)
 
     def release_all(self):
         with self._cv:
             self._free = True
             self._parked.clear()
+            self._pending_spawn.clear()
             self._cv.notify_all()
 
 
@@ -496,10 +539,133 @@ class CloseRaceScenario(Scenario):
                 and all(not t.is_alive() for t in ctx.threads))
 
 
+class ScaleUpMidBurstScenario(Scenario):
+    """ISSUE 11 elasticity: a burst of three requests hits a
+    one-replica fleet whose autoscaler may spawn a second replica at
+    any monitor sweep mid-burst. The explored space covers spawns
+    landing between submits, between handshakes, and after the burst
+    already drained — every request must still reach its oracle
+    verdict exactly once, whatever the spawn interleaves with (a
+    fresh replica joining routing must not double-route or strand
+    inbox work)."""
+
+    name = "scale_up_mid_burst"
+    n_replicas = 1
+
+    def fleet_kw(self):
+        return {
+            "min_replicas": 1, "max_replicas": 2,
+            # every monitor sweep with open > live may spawn; no
+            # cool-down so the schedule alone decides when
+            "scale_up_open_per_replica": 1, "scale_cooldown_s": 0.0,
+            "scale_down_idle_s": 1e9,
+        }
+
+    def ops(self):
+        return [
+            ("submit0", _always, lambda c: c.submit([4, 2], 3, seed=5)),
+            ("submit1", _always, lambda c: c.submit([8, 1, 6], 4, seed=6)),
+            ("submit2", _always, lambda c: c.submit([9], 3, seed=7)),
+        ]
+
+
+class DrainRetireRaceScenario(Scenario):
+    """ISSUE 11 scale-down: replica r1 is gracefully retired
+    (drain → journal-hedge → retire) while it may hold a request whose
+    completion is decoded-but-unreported — the retire's clawback races
+    the completion handshake. Exactly one verdict per rid must
+    survive: the hedged copy resumes from the journaled prefix on r0,
+    and r1's superseded report (if its handshake wins the race) must
+    be refused by the in-flight fence, not double-answered."""
+
+    name = "drain_retire_race"
+    n_replicas = 2
+
+    def _retire_ready(self, ctx):
+        # the second submit routes to r1 (least-loaded tie-break);
+        # retire once it journaled progress there — the
+        # decoded-but-unreported window. A deviating schedule can run
+        # the request to completion first; the op then fires as a
+        # harmless no-work retirement instead of wedging the op queue
+        if len(ctx.handles) < 2:
+            return False
+        h = ctx.handles[1][0]
+        return h.done or len(ctx.fleet._journal.progress_of(h.rid)) >= 1
+
+    def ops(self):
+        return [
+            ("submit0", _always, lambda c: c.submit([3, 3], 3, seed=8)),
+            ("submit1", _always, lambda c: c.submit([7, 5], 3, seed=9)),
+            ("retire_r1", self._retire_ready,
+             lambda c: c.fleet.scale_down(1)),
+        ]
+
+    def check(self, ctx):
+        st = ctx.fleet.stats()
+        if st["replicas"][1]["state"] not in ("retired", "draining"):
+            return ["scale_down(1) never retired r1 (state %r)"
+                    % st["replicas"][1]["state"]]
+        return []
+
+
+class RolloutMigrationRaceScenario(Scenario):
+    """ISSUE 11 live rollout racing a disaggregation migration: a
+    tiered fleet (r0 prefill, r1 decode) serves one request — which
+    migrates from r0 to r1 at first token — while a `roll_weights`
+    (policy "migrate") swaps both replicas under it. The explored
+    interleavings land the swap before, between, and after the
+    migration's hedge; the probes pin token identity, exactly-once,
+    and the journal DFA's J009 version fence (a done record must
+    carry its final assignment's weights_version, whichever side of
+    the swap completed it)."""
+
+    name = "rollout_migration"
+    n_replicas = 2
+
+    def fleet_kw(self):
+        return {"replica_tier": ["prefill", "decode"]}
+
+    def _spawn_roller(self, ctx):
+        def body():
+            ctx.fleet.roll_weights(
+                params={"pos": np.zeros((64, 4), np.float32)},
+                version=7, policy="migrate")
+        ctx.threads.append(ctx.sched.spawn("roller", body))
+
+    def _submitted(self, ctx):
+        return bool(ctx.handles)
+
+    def ops(self):
+        return [
+            ("submit0", _always, lambda c: c.submit([6, 2, 8], 4,
+                                                    seed=11)),
+            ("spawn_roller", self._submitted, self._spawn_roller),
+        ]
+
+    def finished(self, ctx):
+        return (all(h.done for h, _p, _s, _n in ctx.handles)
+                and len(ctx.threads) == 1
+                and not ctx.threads[0].is_alive())
+
+    def check(self, ctx):
+        out = []
+        st = ctx.fleet.stats()
+        if st["weights_version"] != 7:
+            out.append("rollout never committed version 7 (%r)"
+                       % st["weights_version"])
+        if st["rollouts_completed"] != 1:
+            out.append("rollouts_completed == %r, expected 1"
+                       % st["rollouts_completed"])
+        return out
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "submit_kill": SubmitKillScenario,
     "demote_route_back": DemoteRouteBackScenario,
     "close_race": CloseRaceScenario,
+    "scale_up_mid_burst": ScaleUpMidBurstScenario,
+    "drain_retire_race": DrainRetireRaceScenario,
+    "rollout_migration": RolloutMigrationRaceScenario,
 }
 
 
@@ -668,6 +834,7 @@ def run_schedule(scenario: Scenario, decisions: Sequence[str],
         result.violations.append(
             "journal mirror/file divergence: recover() found open "
             "rids after close()")
+    result.violations.extend(scenario.check(ctx))
     return result
 
 

@@ -32,7 +32,7 @@ import jax
 import numpy as np
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step", "retain",
-           "resume_or_init", "verify_checkpoint"]
+           "resume_or_init", "verify_checkpoint", "verify_step"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -284,6 +284,20 @@ def _verify_step_dir(path: str):
                         "file has %d)" % (name, sh["file"],
                                           sh["crc32"], got))
     return not problems, problems
+
+
+def verify_step(dirname: str, step: int):
+    """Verify ONE step directory under `dirname` — metas complete,
+    every shard file present, readable, CRC-matching. This is exactly
+    the per-candidate check `resume_or_init`'s walk-back runs before
+    trusting a checkpoint; exposed so other consumers (the serving
+    fleet's `roll_weights` — no replica may touch a candidate weight
+    set before its CRC walk passes) share the same verification
+    instead of re-deriving it. Returns (ok, problems)."""
+    path = _step_dir(dirname, int(step))
+    if not os.path.isdir(path):
+        return False, ["no such checkpoint step dir: %s" % path]
+    return _verify_step_dir(path)
 
 
 def verify_checkpoint(dirname: str):

@@ -59,9 +59,22 @@ from typing import Callable, Dict, List, Optional
 from . import checkpoint as _ckpt
 from . import sentinel as _sentinel
 
-__all__ = ["Supervisor", "WorkerHandle"]
+__all__ = ["Supervisor", "WorkerHandle", "restart_backoff_s"]
 
 _FAULT_ENV = "PADDLE_FAULT"
+
+
+def restart_backoff_s(consecutive_failures: int, base: float = 0.1,
+                      cap: float = 5.0) -> float:
+    """The supervisor's exponential restart-backoff schedule as ONE
+    shared function: `base * 2**(n-1)` seconds after the n-th
+    consecutive rapid failure, capped at `cap`. The serving fleet's
+    auto-refill and autoscaler spawn gates reuse it so replica
+    respawn discipline cannot silently diverge from worker respawn
+    discipline (a deterministically-failing replica must not
+    crash/refill at monitor frequency forever, exactly like a
+    crash-looping worker)."""
+    return min(cap, base * (2 ** max(int(consecutive_failures) - 1, 0)))
 
 
 class _BlindSpawn(object):
@@ -338,9 +351,8 @@ class Supervisor(object):
                 return
             backoff_exp = h.rapid_failures - 1
         h.restarts += 1
-        delay = min(
-            5.0, self.restart_backoff_s * (2 ** max(backoff_exp, 0))
-        )
+        delay = restart_backoff_s(backoff_exp + 1,
+                                  base=self.restart_backoff_s)
         h.next_spawn_at = time.time() + delay
         h.proc = None
 

@@ -20,7 +20,9 @@ from .fleet import (
     FleetSaturated,
     FleetTimeout,
     RequestJournal,
+    RolloutAborted,
     ServingFleet,
+    save_weights,
 )
 from .kv_blocks import KVBlockAllocator
 from .metrics import ServingMetrics
@@ -30,4 +32,4 @@ __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
            "PrefixCache", "PrefixMatch", "chain_keys", "EngineFailed",
            "ServingFleet", "FleetHandle", "FleetSaturated",
            "RequestJournal", "KVBlockAllocator", "DeadlineExceeded",
-           "FleetTimeout"]
+           "FleetTimeout", "RolloutAborted", "save_weights"]

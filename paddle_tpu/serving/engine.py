@@ -224,7 +224,11 @@ class ServingEngine(object):
     and `prefix_cache_tokens` (token budget of the shared prefix trie;
     None/0 disables reuse). `prefix_block_tokens` is the pre-paging
     name for the block granularity and still accepted: trie blocks ARE
-    pool blocks now, so the two sizes cannot differ."""
+    pool blocks now, so the two sizes cannot differ. `weights_version`
+    tags the engine — and every token it emits — with the weight
+    version its params came from (the fleet's live-rollout version
+    fence; a weight swap is a new engine, never an in-place mutation).
+    """
 
     def __init__(self, params, cfg, max_slots=8, max_len=None,
                  min_bucket=8, max_prefills_per_step=None, donate=True,
@@ -232,7 +236,7 @@ class ServingEngine(object):
                  prefix_block_tokens=None, kv_block_tokens=None,
                  kv_pool_blocks=None, spec_draft_len=None,
                  replica_id=None, fault_injector=None,
-                 scheduler_hook=None):
+                 scheduler_hook=None, weights_version=None):
         self._params = params
         self._cfg = cfg
         # deterministic-exploration seam (ISSUE 9): the fleet threads
@@ -293,6 +297,14 @@ class ServingEngine(object):
             else None)
         self.metrics = ServingMetrics(S)
         self.metrics.kv_blocks_total = NB
+        # live-rollout version fence (ISSUE 11): the weight version
+        # these params came from — fixed for the engine's lifetime (a
+        # weight swap is a NEW engine under a fresh incarnation, never
+        # an in-place mutation), so every token this engine emits is
+        # attributable to exactly one version
+        self.weights_version = (
+            None if weights_version is None else int(weights_version))
+        self.metrics.weights_version = self.weights_version
         self._alloc = KVBlockAllocator(NB, Bt)  # guarded-by: scheduler
         self.prefix_cache: Optional[PrefixCache] = None
         if prefix_cache_tokens:

@@ -84,6 +84,48 @@ Guarantees (the PR-1 drills' falsifiability bar, recast for serving):
     latest ASSIGNMENT is the lease: a demoted replica racing its
     hedged survivor has its completions and progress refused, exactly
     like a zombie lease-holder.
+  * Prefill/decode disaggregation (ISSUE 11) — with `replica_tier`
+    set, admissions route to PREFILL-tier replicas (engine tuned for
+    prefill throughput, `max_prefills_per_step=None`) and MIGRATE at
+    first token to a DECODE-tier replica: the fleet journals the
+    prefill replica's progress, cancels its claim (same handshake —
+    it never spends another step), and resubmits with
+    `resume_tokens=` — PR 8's token-level resume used ON PURPOSE
+    instead of on failure. The decode replica prefill-aliases the
+    finished prefill (block aliasing against its own pool, fed by
+    prefix-affinity routing), ZERO journaled tokens are re-decoded,
+    and outputs stay token-identical to a single-replica run (the
+    engine's sampling keys depend only on (seed, token index)).
+  * Queue-driven autoscaling (ISSUE 11) — with `min_replicas <
+    max_replicas`, the monitor's scale sweep spawns replicas when open
+    requests outrun live capacity (`scale_up_open_per_replica`) or
+    deadline headroom shrinks below `scale_up_headroom_s`, and retires
+    them after `scale_down_idle_s` of sustained low load. Scale-up
+    goes through the warm `refill()` machinery (a DRAINED replica
+    resumes warm; otherwise a fresh incarnation spawns, gated by the
+    supervisor's exponential restart backoff); scale-down is a
+    graceful `drain()` → retire: queued requests re-route immediately,
+    in-flight work is hedged to survivors FROM THE JOURNAL with
+    token-level resume, and the replica's stats fold into the
+    cumulative base so fleet totals stay monotonic. One cool-down gate
+    (`scale_cooldown_s`) covers both directions — a burst cannot flap
+    the fleet.
+  * Live weight rollout (ISSUE 11) — `roll_weights(ckpt_step)`
+    consumes a training checkpoint (default: the sentinel's promoted
+    known-good step) and performs a rolling drain → swap → refill
+    across the fleet. The candidate is CRC-verified with
+    `resume_or_init`'s per-step walk machinery BEFORE any replica
+    touches it — a failed verify aborts the rollout with the fleet
+    untouched, every replica still serving the old version. Every
+    response records the `weights_version` that produced it (assign
+    and done journal records carry the version side-band; the journal
+    DFA's J009 rejects a done whose version differs from its latest
+    assignment's). In-flight requests either FINISH on the old
+    version (policy "finish", the default: the drain waits) or
+    migrate-resume onto the new one (policy "migrate": hedged from
+    the journal like a demotion) — pinned by the `rollout_policy`
+    knob, so a request's verdict version always matches its final
+    assignment.
 
 Threading: all shared scheduler state lives on `ServingFleet` and is
 guarded by ONE condition's lock (`_cond`); replica threads and the
@@ -104,13 +146,14 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..distributed.supervisor import restart_backoff_s as _backoff
 from .engine import EngineFailed, ServingEngine
 from .prefix_cache import chain_keys
 
 __all__ = [
     "ServingFleet", "FleetHandle", "FleetSaturated", "RequestJournal",
     "DeadlineExceeded", "FleetTimeout", "run_fleet_subprocess",
-    "SchedulerHook",
+    "SchedulerHook", "RolloutAborted", "save_weights",
 ]
 
 
@@ -149,6 +192,18 @@ class SchedulerHook(object):
       thread_exiting()            last call on the thread (crash paths
                                   included), so a controller never
                                   waits on a dead thread
+      thread_spawning(name)       NON-BLOCKING notice, called on the
+                                  SPAWNING thread just before a new
+                                  fleet thread starts (a scale-up, a
+                                  rollout refill): `name` is the exact
+                                  name the new thread will register
+                                  under. Lets a controller account for
+                                  the thread synchronously — without
+                                  it, the gap between start() and the
+                                  new thread's own registration would
+                                  make recorded schedules racy. May be
+                                  called while fleet locks are held,
+                                  so it MUST NOT block
 
     A hook must tolerate calls from UNREGISTERED threads (the caller's
     own submit/close run on threads the fleet never started) — the
@@ -156,6 +211,9 @@ class SchedulerHook(object):
     """
 
     def thread_started(self, kind: str, name: str):
+        pass
+
+    def thread_spawning(self, name: str):
         pass
 
     def yield_point(self, point: str):
@@ -185,6 +243,11 @@ _LIVE, _DRAINING, _DRAINED, _DEAD = "live", "draining", "drained", "dead"
 # gray-failure state (ISSUE 8): alive and heartbeating, but too slow —
 # drained of work, probed, and restored (not killed) when healthy again
 _DEMOTED = "demoted"
+# elastic state (ISSUE 11): a slot with no running replica — either it
+# never started (capacity held back for scale-up) or the autoscaler
+# drained and retired it (stats folded, thread exited). Scale-up (or an
+# operator refill()) brings it back as a fresh incarnation.
+_RETIRED = "retired"
 
 # per-replica stats that are GAUGES (a dead incarnation's value is
 # meaningless going forward): never folded into cumulative _stats_base
@@ -217,6 +280,17 @@ _DEFAULT_SLO_CLASSES = {
     # prefill throughput, decode latency of neighbors pays)
     "interactive": {"max_prefills_per_step": 1},
     "batch": {"max_prefills_per_step": None},
+}
+
+_DEFAULT_TIER_CLASSES = {
+    # prefill tier: every pending slot advances a chunk per step —
+    # maximum prefill throughput, and its decode latency does not
+    # matter because requests MIGRATE OUT at first token; decode tier:
+    # at most one prefill chunk per step (only the resume re-prefill of
+    # migrated-in work runs here), keeping the batched decode cadence
+    # flat — the disaggregation split (DistServe/Splitwise lineage)
+    "prefill": {"max_prefills_per_step": None},
+    "decode": {"max_prefills_per_step": 1},
 }
 
 
@@ -259,6 +333,18 @@ class FleetTimeout(TimeoutError):
         self.tokens_emitted = tokens_emitted
 
 
+class RolloutAborted(RuntimeError):
+    """`roll_weights()` refused to start: the candidate checkpoint
+    failed its CRC/metas verification (or no known-good step exists).
+    The fleet is UNTOUCHED — no replica was drained, every replica
+    still serves the previous weights version. Carries the per-file
+    evidence in `problems`."""
+
+    def __init__(self, msg: str, problems=None):
+        super().__init__(msg)
+        self.problems = list(problems or [])
+
+
 class _KillDrill(RuntimeError):
     """Injected replica death (ServingFleet.kill_replica)."""
 
@@ -290,6 +376,11 @@ class FleetHandle(object):
         self.ttft_s: Optional[float] = None  # first journaled token
         self.tokens: Optional[List[int]] = None
         self.replica: Optional[str] = None  # who answered
+        # live-rollout version fence (ISSUE 11): the weights_version of
+        # the replica that COMPLETED this request (None when the fleet
+        # is unversioned, or when the answer came straight from
+        # journaled progress of a holder whose version is unrecorded)
+        self.weights_version: Optional[int] = None
         self.error: Optional[BaseException] = None
         self.chain: List[int] = []  # affinity keys (set by the fleet)
         self._probe = False   # internal health probe, never journaled
@@ -375,6 +466,10 @@ class RequestJournal(object):
         self._file_records = 0                       # guarded-by: _lock
         self._open_specs: Dict[int, dict] = {}       # guarded-by: _lock
         self._assign: Dict[int, Tuple[str, int, int]] = {}  # guarded-by: _lock
+        # (tier, weights_version) side-band of the latest assignment
+        # (ISSUE 11): kept apart from _assign so the 3-tuple fence
+        # consumers stay unchanged; compaction must reproduce it
+        self._assign_meta: Dict[int, Tuple[Optional[str], Optional[int]]] = {}  # guarded-by: _lock
         self._progress: Dict[int, List[int]] = {}    # guarded-by: _lock
         self._done: Set[int] = set()                 # guarded-by: _lock
         # records handed out via defer=True whose file append is still
@@ -455,12 +550,15 @@ class RequestJournal(object):
         elif rec["kind"] == "assign":
             self._assign[rid] = (rec["replica"], rec["incarnation"],
                                  rec["gen"])
+            self._assign_meta[rid] = (rec.get("tier"),
+                                      rec.get("weights_version"))
         elif rec["kind"] == "progress":
             self._progress.setdefault(rid, []).extend(rec["tokens"])
         elif rec["kind"] in _TERMINAL_KINDS:
             self._done.add(rid)
             self._open_specs.pop(rid, None)
             self._assign.pop(rid, None)
+            self._assign_meta.pop(rid, None)
             self._progress.pop(rid, None)
 
     def _append(self, rec: dict, flush: bool = True):
@@ -493,8 +591,10 @@ class RequestJournal(object):
                          "spec": self._open_specs[rid]})
             if rid in self._assign:
                 rep, inc, gen = self._assign[rid]
+                tier, wv = self._assign_meta.get(rid, (None, None))
                 recs.append({"kind": "assign", "rid": rid, "replica": rep,
-                             "incarnation": inc, "gen": gen})
+                             "incarnation": inc, "gen": gen,
+                             "tier": tier, "weights_version": wv})
             if self._progress.get(rid):
                 recs.append({"kind": "progress", "rid": rid,
                              "replica": None, "incarnation": None,
@@ -563,16 +663,24 @@ class RequestJournal(object):
             self._append({"kind": "submit", "rid": rid, "spec": spec})
 
     def assign(self, rid: int, replica: str, incarnation: int, gen: int,
+               tier: Optional[str] = None,
+               weights_version: Optional[int] = None,
                defer: bool = False) -> Optional[dict]:
         """Record an assignment. The MIRROR updates synchronously (a
         failover consulting `lost()` an instant later must see it);
         with `defer=True` the file append is returned as a record for
         the caller to `write()` later — the fleet defers file I/O
-        until it has released its scheduler lock."""
+        until it has released its scheduler lock. `tier` and
+        `weights_version` ride as an optional side-band (ISSUE 11):
+        the assignee's disaggregation tier and the weight version it
+        serves — the journal DFA's version fence (J009) checks every
+        done record against its latest assignment's version."""
         rec = {"kind": "assign", "rid": rid, "replica": replica,
-               "incarnation": incarnation, "gen": gen}
+               "incarnation": incarnation, "gen": gen,
+               "tier": tier, "weights_version": weights_version}
         with self._lock:
             self._assign[rid] = (replica, incarnation, gen)
+            self._assign_meta[rid] = (tier, weights_version)
             if defer:
                 self._deferred_out += 1
                 return rec
@@ -588,6 +696,7 @@ class RequestJournal(object):
             self._done.add(rid)
             self._open_specs.pop(rid, None)
             self._assign.pop(rid, None)
+            self._assign_meta.pop(rid, None)
             self._progress.pop(rid, None)
             if defer:
                 self._deferred_out += 1
@@ -597,10 +706,15 @@ class RequestJournal(object):
 
     def complete(self, rid: int, replica: str, incarnation: int,
                  gen: int, tokens: List[int],
+                 weights_version: Optional[int] = None,
                  defer: bool = False) -> Optional[dict]:
         rec = {"kind": "done", "rid": rid, "replica": replica,
                "incarnation": incarnation, "gen": gen,
                "tokens": list(tokens)}
+        if weights_version is not None:
+            # the version fence's done half: which weights produced
+            # this output (must equal the latest assignment's — J009)
+            rec["weights_version"] = int(weights_version)
         return self._terminal(rid, rec, defer)
 
     def progress(self, rid: int, replica: str, incarnation: int,
@@ -678,6 +792,15 @@ class RequestJournal(object):
         with self._lock:
             return self._assign.get(rid)
 
+    def assigned_meta(self, rid: int
+                      ) -> Tuple[Optional[str], Optional[int]]:
+        """(tier, weights_version) side-band of the latest assignment
+        — (None, None) when unassigned or unversioned. Lets a
+        completion recovered straight from journaled progress record
+        the version of the holder that actually produced the tokens."""
+        with self._lock:
+            return self._assign_meta.get(rid, (None, None))
+
     def progress_of(self, rid: int) -> List[int]:
         with self._lock:
             return list(self._progress.get(rid, []))
@@ -730,6 +853,54 @@ class RequestJournal(object):
         return prog
 
 
+class _FlatScope(object):
+    """Checkpoint-scope adapter over a flat {name: array} dict — the
+    bridge between a model params pytree and the training checkpoint
+    machinery (save_checkpoint / load_checkpoint verify CRCs per
+    entry; the scope protocol is keys/get/set)."""
+
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    def keys(self):
+        return self._arrays.keys()
+
+    def get(self, name):
+        return self._arrays.get(name)
+
+    def set(self, name, val):
+        self._arrays[name] = val
+
+
+def _flat_names(params):
+    """Positional leaf naming for a params pytree: stable across save
+    and load because both sides flatten the SAME tree structure —
+    no keypath escaping, and a checkpoint from a different model
+    shows up as a count/shape mismatch, never a silent misload."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    return ["w%05d" % k for k in range(len(leaves))], leaves, treedef
+
+
+def save_weights(params, ckpt_dir: str, step: int, keep_last: int = 8,
+                 protect=None) -> dict:
+    """Write one weight version under `ckpt_dir/step_<N>/` with the
+    training checkpoint machinery (CRC sidecars, atomic meta commit) —
+    the PUSH half of the reference's pserver push/pull recast as
+    checkpoint promotion: a training job (or its sentinel, which
+    promotes known-good steps) saves here, and
+    `ServingFleet.roll_weights(step)` rolls the fleet onto it after
+    the same CRC walk `resume_or_init` trusts. Returns the save
+    meta."""
+    from ..distributed.checkpoint import save_checkpoint
+
+    names, leaves, _treedef = _flat_names(params)
+    arrays = {n: np.asarray(v) for n, v in zip(names, leaves)}
+    return save_checkpoint(_FlatScope(arrays), ckpt_dir, step=int(step),
+                           keep_last=keep_last, protect=protect)
+
+
 class _Replica(object):
     """One engine replica: a thread that builds and exclusively owns a
     `ServingEngine`, pulls work from the fleet, steps, and reports
@@ -740,10 +911,20 @@ class _Replica(object):
     construction, never mutated — for probe sizing)."""
 
     def __init__(self, fleet: "ServingFleet", index: int, incarnation: int,
-                 slo: Optional[str], engine_kw: dict):
+                 slo: Optional[str], engine_kw: dict,
+                 tier: Optional[str] = None, params=None,
+                 weights_version: Optional[int] = None):
         self.index = index
         self.incarnation = incarnation
         self.slo = slo
+        self.tier = tier
+        # weight snapshot (ISSUE 11): the params + version this
+        # incarnation serves, FIXED at construction — a rolling weight
+        # swap never mutates a live replica, it replaces it (fresh
+        # incarnation built against the fleet's new current weights),
+        # so every token is attributable to exactly one version
+        self.params = params
+        self.weights_version = weights_version
         self.name = "r%d" % index
         self._fleet = fleet
         self._engine_kw = engine_kw
@@ -792,9 +973,13 @@ class _Replica(object):
 
     def _loop_body(self, fleet, hook):  # thread: replica
         try:
+            params = self.params if self.params is not None \
+                else fleet._params
             self.engine = fleet._engine_factory(
-                fleet._params, fleet._cfg, replica_id=self.name,
-                scheduler_hook=hook, **self._engine_kw)
+                params, fleet._cfg, replica_id=self.name,
+                scheduler_hook=hook,
+                weights_version=self.weights_version,
+                **self._engine_kw)
             completed: List[Tuple[int, List[int], str]] = []
             progress: List[Tuple[int, List[int]]] = []
             while True:
@@ -967,6 +1152,58 @@ class ServingFleet(object):
                            warm engine and prefix pool
       probe_ok_needed      consecutive healthy probes required to
                            restore (restore-side hysteresis)
+      replica_tier         per-SLOT disaggregation tier list
+                           ("prefill"/"decode"/None; length
+                           max_replicas). Fresh admissions route to
+                           prefill-tier replicas and MIGRATE to a
+                           decode-tier replica at first token via the
+                           journaled resume path (ISSUE 11); None
+                           entries serve both phases. Default: no
+                           tiers (every replica does both)
+      tier_classes         tier -> engine-kw overrides (default maps
+                           prefill/decode onto max_prefills_per_step
+                           None/1)
+      min_replicas /       autoscaler bounds (ISSUE 11): the fleet
+      max_replicas         holds max_replicas SLOTS; slots beyond
+                           n_replicas start RETIRED (capacity held
+                           back). Defaults: both = n_replicas (scaling
+                           off). The scaler never retires below
+                           min_replicas live replicas
+      scale_up_open_per_replica
+                           spawn a replica when open requests exceed
+                           this many per live replica (queue-depth
+                           pressure)
+      scale_up_headroom_s  also spawn when any open request's deadline
+                           headroom drops below this while requests
+                           outnumber live replicas (None = off)
+      scale_down_idle_s    retire a replica only after low load (open
+                           requests < live replicas) holds this long
+                           (sustained-idle hysteresis)
+      scale_cooldown_s     ONE cool-down gate for both directions: at
+                           most one scale operation per window, so a
+                           burst cannot flap the fleet
+      ckpt_dir             weight-PUBLISH dir `roll_weights()` reads
+                           candidate weight sets from: step dirs
+                           written by `save_weights(params, dir,
+                           step)` (NOT a raw training save_checkpoint
+                           scope — its entry names differ and the
+                           load refuses them loudly). The training
+                           side publishes here next to its own
+                           checkpoints; a `sentinel.json` in this dir
+                           (written or copied from the training run)
+                           gives no-argument roll_weights() its
+                           known-good default. None = rollout only
+                           via explicit params=
+      rollout_policy       what happens to in-flight requests when
+                           their replica is swapped: "finish" (default
+                           — the drain waits; tokens never mix
+                           versions) or "migrate" (hedged to survivors
+                           from the journal with token-level resume —
+                           faster swap; the completion records the
+                           final holder's version)
+      weights_version      version tag of the CONSTRUCTION params
+                           (default 0); roll_weights bumps it to the
+                           checkpoint step it rolled to
     """
 
     def __init__(self, params, cfg, n_replicas=2, journal_path=None,
@@ -977,12 +1214,17 @@ class ServingFleet(object):
                  journal_compact_every=4096, slow_replica_factor=None,
                  slow_min_duration_s=0.5, probe_interval_s=0.25,
                  probe_ok_needed=1, scheduler_hook=None,
-                 engine_factory=None):
+                 engine_factory=None, replica_tier=None,
+                 tier_classes=None, min_replicas=None, max_replicas=None,
+                 scale_up_open_per_replica=4, scale_up_headroom_s=None,
+                 scale_down_idle_s=2.0, scale_cooldown_s=1.0,
+                 ckpt_dir=None, rollout_policy="finish",
+                 weights_version=0):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
         if int(max_pending) < 1:
             raise ValueError("max_pending must be >= 1")
-        self._params = params
+        self._params = params  # guarded-by: _cond (swapped by rollout)
         self._cfg = cfg
         # deterministic-exploration seam (ISSUE 9): the hook is called
         # at every thread-handoff point (SchedulerHook contract above);
@@ -992,6 +1234,35 @@ class ServingFleet(object):
         self._engine_factory = (engine_factory if engine_factory
                                 is not None else ServingEngine)
         self.n_replicas = int(n_replicas)
+        # elastic bounds (ISSUE 11): the fleet owns max_replicas SLOTS;
+        # n_replicas of them start live, the rest start RETIRED. All
+        # per-slot lists below are sized max_replicas once — the
+        # autoscaler changes STATES, never list lengths
+        self.min_replicas = (self.n_replicas if min_replicas is None
+                             else int(min_replicas))
+        self.max_replicas = (self.n_replicas if max_replicas is None
+                             else int(max_replicas))
+        if not (1 <= self.min_replicas <= self.n_replicas
+                <= self.max_replicas):
+            raise ValueError(
+                "need 1 <= min_replicas (%d) <= n_replicas (%d) <= "
+                "max_replicas (%d)" % (self.min_replicas,
+                                       self.n_replicas,
+                                       self.max_replicas))
+        self.scale_up_open_per_replica = int(scale_up_open_per_replica)
+        if self.scale_up_open_per_replica < 1:
+            raise ValueError("scale_up_open_per_replica must be >= 1")
+        self.scale_up_headroom_s = (
+            None if scale_up_headroom_s is None
+            else float(scale_up_headroom_s))
+        self.scale_down_idle_s = float(scale_down_idle_s)
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        if rollout_policy not in ("finish", "migrate"):
+            raise ValueError(
+                "rollout_policy must be 'finish' or 'migrate', got %r"
+                % (rollout_policy,))
+        self.rollout_policy = rollout_policy
+        self.ckpt_dir = ckpt_dir
         self.max_pending = int(max_pending)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.affinity = bool(affinity)
@@ -1008,12 +1279,31 @@ class ServingFleet(object):
         self.slo_classes = dict(_DEFAULT_SLO_CLASSES)
         if slo_classes:
             self.slo_classes.update(slo_classes)
-        if replica_slo is not None and len(replica_slo) != self.n_replicas:
-            raise ValueError("replica_slo must name a class per replica")
-        self._replica_slo = list(replica_slo or [None] * self.n_replicas)
+        if replica_slo is not None \
+                and len(replica_slo) != self.max_replicas:
+            raise ValueError(
+                "replica_slo must name a class per SLOT "
+                "(max_replicas=%d)" % self.max_replicas)
+        self._replica_slo = list(replica_slo
+                                 or [None] * self.max_replicas)
         for c in self._replica_slo:
             if c is not None and c not in self.slo_classes:
                 raise ValueError("unknown SLO class %r" % c)
+        self.tier_classes = dict(_DEFAULT_TIER_CLASSES)
+        if tier_classes:
+            self.tier_classes.update(tier_classes)
+        if replica_tier is not None \
+                and len(replica_tier) != self.max_replicas:
+            raise ValueError(
+                "replica_tier must name a tier per SLOT "
+                "(max_replicas=%d)" % self.max_replicas)
+        self._replica_tier = list(replica_tier
+                                  or [None] * self.max_replicas)
+        for t in self._replica_tier:
+            if t is not None and t not in self.tier_classes:
+                raise ValueError("unknown tier %r" % t)
+        # migration only makes sense when both phases have a home
+        self._tiered = any(t is not None for t in self._replica_tier)
         self._engine_kw = dict(engine_kw or {})
         self._engine_kw_for = engine_kw_for
         # ONE block granularity: the engine's paged KV pool and the
@@ -1078,6 +1368,15 @@ class ServingFleet(object):
         # summary, and the replica's revision cache would otherwise
         # never resend an UNCHANGED (warm!) pool after restore
         self._want_summary: List[bool] = []            # guarded-by: _cond
+        # elastic lifecycle (ISSUE 11): drain-then-retire marker the
+        # scaler sets and the replica's own handshake consumes, plus
+        # the scaler's shared cool-down gate and sustained-low-load
+        # clock, and the rollout mutual-exclusion latch
+        self._retire_flag: List[bool] = []             # guarded-by: _cond
+        self._scale_gate_at = 0.0                      # guarded-by: _cond
+        self._low_load_since: Optional[float] = None   # guarded-by: _cond
+        self._rollout = False                          # guarded-by: _cond
+        self._weights_version = int(weights_version)   # guarded-by: _cond
         self._next_probe_rid = -1                      # guarded-by: _cond
         self._handles: Dict[int, FleetHandle] = {}     # guarded-by: _cond
         self._open: Set[int] = set()                   # guarded-by: _cond
@@ -1112,15 +1411,27 @@ class ServingFleet(object):
         self.probes_sent = 0                           # guarded-by: _cond
         self.resumed_requests = 0                      # guarded-by: _cond
         self.resumed_tokens = 0                        # guarded-by: _cond
+        # elastic lifecycle counters (ISSUE 11 satellite): fleet-scope
+        # monotonic ints — they survive any replica's retirement by
+        # construction, unlike per-replica stats (which fold into
+        # _stats_base when an incarnation ends)
+        self.replicas_spawned = 0                      # guarded-by: _cond
+        self.replicas_retired = 0                      # guarded-by: _cond
+        self.migrations = 0                            # guarded-by: _cond
+        self.rollouts_completed = 0                    # guarded-by: _cond
+        self.rollout_aborts = 0                        # guarded-by: _cond
 
         self._idle_wait_s = min(0.02, self.heartbeat_timeout_s / 10.0)
         self._monitor_interval_s = (
             monitor_interval_s if monitor_interval_s is not None
             else max(0.01, min(0.2, self.heartbeat_timeout_s / 5.0)))
         with self._cond:
-            for i in range(self.n_replicas):
+            for i in range(self.max_replicas):
                 self._incarnations.append(1)
-                self._state.append(_LIVE)
+                # slots past n_replicas are held-back capacity: they
+                # start RETIRED (no thread) until scale-up or refill()
+                self._state.append(_LIVE if i < self.n_replicas
+                                   else _RETIRED)
                 self._beats.append(time.monotonic())
                 self._kill.append(False)
                 self._inbox.append(collections.deque())
@@ -1139,9 +1450,11 @@ class ServingFleet(object):
                 self._probe_at.append(0.0)
                 self._probe_ok.append(0)
                 self._want_summary.append(False)
+                self._retire_flag.append(False)
                 self._replicas.append(self._make_replica(i, 1))
-        for r in self._replicas:
-            r.start()
+        for i, r in enumerate(self._replicas):
+            if self._state[i] == _LIVE:
+                r.start()
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="fleet-monitor", daemon=True)
         self._monitor.start()
@@ -1176,6 +1489,11 @@ class ServingFleet(object):
         slo = self._replica_slo[index]
         if slo is not None:
             kw.update(self.slo_classes[slo])
+        tier = self._replica_tier[index]
+        if tier is not None:
+            # tier overrides win over the SLO class: disaggregation is
+            # a structural role, SLO a per-request preference
+            kw.update(self.tier_classes[tier])
         if self._engine_kw_for is not None:
             kw.update(self._engine_kw_for(index) or {})
         rep_bt = kw.get("kv_block_tokens")
@@ -1191,7 +1509,9 @@ class ServingFleet(object):
                 "affinity routing requires a uniform block granularity "
                 "across replicas (fleet %d, replica %d override %r)"
                 % (self.block_tokens, index, rep_bt))
-        return _Replica(self, index, incarnation, slo, kw)
+        return _Replica(self, index, incarnation, slo, kw, tier=tier,
+                        params=self._params,
+                        weights_version=self._weights_version)
 
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0,
@@ -1343,7 +1663,7 @@ class ServingFleet(object):
         cached-prefix match against the pool summaries, ties broken by
         load; SLO class first, any live replica as fallback; no live
         replica at all fails the handle."""
-        live = [i for i in range(self.n_replicas)
+        live = [i for i in range(self.max_replicas)
                 if self._state[i] == _LIVE and i != exclude]
         if not live:
             # slow beats dead, the _demote_locked rule — but deaths can
@@ -1352,11 +1672,31 @@ class ServingFleet(object):
             # strictly better than terminally rejecting every request
             # (probes restore it the moment it behaves; a real death
             # still fails over through the heartbeat deadline)
-            live = [i for i in range(self.n_replicas)
+            live = [i for i in range(self.max_replicas)
                     if self._state[i] == _DEMOTED and i != exclude]
-        cands = [i for i in live if self._replica_slo[i] in (None, h.slo)]
-        if not cands:
-            cands = live  # survival beats SLO placement
+        cands = live
+        if self._tiered:
+            # disaggregation placement (ISSUE 11): a request with no
+            # resumed prefix needs its PREFILL computed — prefill-tier
+            # replica; a resumed one (migration, hedge, restart) is in
+            # its decode phase — decode-tier replica. None-tier
+            # replicas serve both; survival beats tier placement. The
+            # tier filter runs BEFORE the SLO filter: tier is the
+            # STRUCTURAL phase split, SLO a scheduling preference — if
+            # SLO narrowed first, a decode tier whose class differs
+            # from the request's would be invisible here, and a
+            # migration gated on "a decode-capable replica exists"
+            # would land on another prefill replica and ping-pong
+            # (re-prefilling the growing prefix every hop) forever
+            want = "decode" if h.resume else "prefill"
+            tcands = [i for i in cands
+                      if self._replica_tier[i] in (want, None)]
+            if tcands:
+                cands = tcands
+        scands = [i for i in cands if self._replica_slo[i] in (None, h.slo)]
+        if scands:
+            cands = scands  # SLO preference within the tier; survival
+            #                 beats SLO placement when none matches
         if not cands:
             # terminal: the caller gets the error NOW, so the request
             # must not stay open (journal-wise) to be resubmitted by
@@ -1385,9 +1725,13 @@ class ServingFleet(object):
         rep = self._replicas[best]
         self._inbox[best].append(h)
         # mirror updates NOW (a failover consulting lost() must see
-        # this assignment); the file record flushes after the lock
+        # this assignment); the file record flushes after the lock.
+        # tier + weights_version ride the record as the version-fence
+        # side-band (journal DFA J009)
         self._pending_journal.append(self._journal.assign(
-            h.rid, rep.name, rep.incarnation, h.generation, defer=True))
+            h.rid, rep.name, rep.incarnation, h.generation,
+            tier=rep.tier, weights_version=rep.weights_version,
+            defer=True))
         self._cond.notify_all()
 
     def _flush_journal(self):
@@ -1510,7 +1854,7 @@ class ServingFleet(object):
         with self._cond:
             i = rep.index
             current = (self._replicas[i] is rep
-                       and self._state[i] != _DEAD)
+                       and self._state[i] not in (_DEAD, _RETIRED))
             if current:
                 self._beats[i] = time.monotonic()
                 if stats is not None:
@@ -1529,8 +1873,32 @@ class ServingFleet(object):
             if self._kill[i]:
                 self._kill[i] = False
                 raise _KillDrill("replica %s killed by drill" % rep.name)
+            if self._tiered and rep.tier == "prefill" \
+                    and self._state[i] == _LIVE:
+                # disaggregation migration (ISSUE 11): any in-flight
+                # request that produced NEW tokens on this prefill
+                # replica has finished its prefill — hand it to a
+                # decode-tier replica via the journaled resume path.
+                # Runs AFTER completions were judged, so a request
+                # that already finished here is never migrated, and
+                # the cancel lands in THIS handshake's return — the
+                # prefill engine never spends another step on it
+                self._maybe_migrate_locked(rep)
             if self._state[i] == _DRAINING and idle \
                     and not self._inbox[i] and not self._in_flight[i]:
+                if self._retire_flag[i]:
+                    # autoscaler scale-down completes: fold the
+                    # incarnation's stats into the cumulative base
+                    # (fleet totals stay monotonic), free the slot,
+                    # and stop the thread — the graceful half of the
+                    # supervisor's restart story
+                    self._retire_flag[i] = False
+                    self._state[i] = _RETIRED
+                    self._fold_stats_locked(i)
+                    self._summaries[i] = set()
+                    self.replicas_retired += 1
+                    self._cond.notify_all()
+                    return "stop", [], [], False
                 self._state[i] = _DRAINED
                 self._cond.notify_all()
             if self._state[i] == _DRAINED:
@@ -1589,6 +1957,51 @@ class ServingFleet(object):
             if h.ttft_s is None:  # fleet-level TTFT: first journaled token
                 h.ttft_s = time.monotonic() - h._submit_t
 
+    def _maybe_migrate_locked(self, rep: _Replica):
+        """Migrate requests whose prefill finished on this PREFILL-tier
+        replica to a decode-tier replica (caller holds `_cond`). The
+        trigger is journaled progress BEYOND the request's resumed
+        prefix — the first token only exists once the whole prompt was
+        prefilled, so this is exactly the prefill/decode phase
+        boundary. Mechanism is PR 8's hedge, on purpose instead of on
+        failure: bump the generation, resubmit with the journaled
+        prefix as `resume_tokens` (the decode replica prefill-aliases
+        it and re-decodes ZERO journaled tokens), queue a cancel this
+        replica consumes in the SAME handshake. Skipped when no other
+        live decode-capable replica exists — a migration that could
+        only route back here (or fail the handle) is worse than
+        letting the prefill replica decode."""
+        i = rep.index
+        if not any(self._state[j] == _LIVE
+                   and self._replica_tier[j] in ("decode", None)
+                   for j in range(self.max_replicas) if j != i):
+            return
+        for rid in list(self._in_flight[i]):
+            h = self._handles.get(rid)
+            if h is None or h.done or h._probe:
+                continue
+            toks = self._journal.progress_of(rid)
+            if len(toks) <= len(h.resume):
+                continue  # still prefilling: no new token yet
+            self._cancels[i].add(rid)
+            self._in_flight[i].pop(rid, None)
+            if self._finished_in_journal(h.spec, toks):
+                # the first token already satisfied the budget/EOS:
+                # complete straight from the journal, zero extra hops
+                self._complete_from_progress(
+                    h, toks, rep.name, rep.incarnation)
+                continue
+            h.generation += 1
+            h.resume = list(toks)  # replace wholesale, never mutate
+            self.migrations += 1
+            self.resubmitted += 1
+            self.resumed_requests += 1
+            self.resumed_tokens += len(toks)
+            try:
+                self._route(h, exclude=i)
+            except EngineFailed:
+                pass  # no survivors: handle already failed by _route
+
     def _accept(self, rid: int, tokens: List[int], reason: str,
                 rep: _Replica, accepted: bool):
         """Completion fence + dedupe (caller holds `_cond`): refuse a
@@ -1643,9 +2056,10 @@ class ServingFleet(object):
         self._handles.pop(rid, None)
         self._pending_journal.append(self._journal.complete(
             rid, rep.name, rep.incarnation, h.generation, full,
-            defer=True))
+            weights_version=rep.weights_version, defer=True))
         h.tokens = full
         h.replica = rep.name
+        h.weights_version = rep.weights_version
         # the event fires in _flush_journal, AFTER the done record is
         # on disk — result() observers get read-your-writes recovery
         self._pending_events.append(h)
@@ -1685,6 +2099,20 @@ class ServingFleet(object):
         self._flush_journal()
 
     # -- failure handling ------------------------------------------------
+    def _fold_stats_locked(self, i: int):
+        """Fold an ending incarnation's last stats snapshot into the
+        fleet-wide cumulative base (caller holds `_cond`): totals must
+        not decrease on refill OR retirement. Gauges die with the
+        incarnation. Shared by the death path (_fail_over), the
+        autoscaler's retirement, and the rollout swap."""
+        st = self._rep_stats[i]
+        if st:
+            for k, v in st.items():
+                if k in _GAUGE_STATS:
+                    continue  # gauges: die with the incarnation
+                self._stats_base[k] = self._stats_base.get(k, 0) + v
+        self._rep_stats[i] = None
+
     def _fail_over(self, i: int, rep: _Replica, exc: BaseException):
         """Declare replica `i` dead and resubmit its journal-recorded
         open requests to survivors (caller holds `_cond`). Idempotent
@@ -1695,23 +2123,16 @@ class ServingFleet(object):
         self._state[i] = _DEAD
         self._summaries[i] = set()
         self.failovers += 1
-        # fold the dead incarnation's last stats snapshot into the
-        # fleet-wide base: totals must not decrease on refill
-        st = self._rep_stats[i]
-        if st:
-            for k, v in st.items():
-                if k in _GAUGE_STATS:
-                    continue  # gauges: die with the incarnation
-                self._stats_base[k] = self._stats_base.get(k, 0) + v
-        self._rep_stats[i] = None
-        # rapid-death accounting gates auto_refill (exponential
-        # backoff, the Supervisor's restart/backoff discipline): a
-        # deterministically-failing replica must not crash/refill at
-        # monitor frequency forever
+        self._fold_stats_locked(i)
+        # rapid-death accounting gates auto_refill AND the autoscaler's
+        # spawn picker (exponential backoff, the Supervisor's
+        # restart/backoff discipline — literally supervisor.py's
+        # restart_backoff_s schedule): a deterministically-failing
+        # replica must not crash/refill at monitor frequency forever
         rapid = time.monotonic() - self._spawned[i] < 2.0
         self._rapid[i] = self._rapid[i] + 1 if rapid else 0
-        self._refill_at[i] = time.monotonic() + min(
-            5.0, 0.05 * (2 ** self._rapid[i]))
+        self._refill_at[i] = time.monotonic() + _backoff(
+            self._rapid[i] + 1, base=0.05)
         self._inbox[i].clear()
         self._in_flight[i].clear()
         self._cancels[i].clear()
@@ -1757,12 +2178,16 @@ class ServingFleet(object):
         self._done_rids.add(rid)
         self._open.discard(rid)
         self._handles.pop(rid, None)
+        # the version of the holder that actually produced the tokens
+        # (read BEFORE complete() prunes the assignment side-band)
+        _tier, wv = self._journal.assigned_meta(rid)
         self._pending_journal.append(self._journal.complete(
             rid, replica, incarnation, h.generation, list(toks),
-            defer=True))
+            weights_version=wv, defer=True))
         h.tokens = list(toks)
         h.emitted = len(toks)
         h.replica = replica
+        h.weights_version = wv
         self._pending_events.append(h)
         self.completed += 1
 
@@ -1833,13 +2258,15 @@ class ServingFleet(object):
                         self._refill_locked(i)
                 if self.slow_replica_factor is not None:
                     self._health_sweep(now)
+                if self.min_replicas < self.max_replicas:
+                    self._scale_sweep(now)
             self._flush_journal()  # fail-over resubmissions above
             time.sleep(self._monitor_interval_s)
 
     # -- gray-failure detection (ISSUE 8) --------------------------------
     def _live_ewmas(self) -> List[float]:  # holds: _cond
         out = []
-        for i in range(self.n_replicas):
+        for i in range(self.max_replicas):
             st = self._rep_stats[i]
             if self._state[i] == _LIVE and st \
                     and st.get("step_ewma_s", 0.0) > 0.0:
@@ -1867,10 +2294,10 @@ class ServingFleet(object):
         ewmas = self._live_ewmas()
         median = _lower_median(ewmas)
         rate_window = max(0.15, 2.0 * self._monitor_interval_s)
-        rates = [self._rate[i] for i in range(self.n_replicas)
+        rates = [self._rate[i] for i in range(self.max_replicas)
                  if self._state[i] == _LIVE and self._rate[i] is not None]
         median_rate = _upper_median(rates)
-        for i in range(self.n_replicas):
+        for i in range(self.max_replicas):
             st = self._rep_stats[i]
             if self._state[i] == _DEMOTED:
                 if self._probes[i] is None and now >= self._probe_at[i]:
@@ -1958,7 +2385,7 @@ class ServingFleet(object):
         re-spent), tell it to CANCEL the hedged work, keep it alive
         and warm, and start probing. Never demote the last live
         replica: slow beats dead."""
-        survivors = [j for j in range(self.n_replicas)
+        survivors = [j for j in range(self.max_replicas)
                      if j != i and self._state[j] == _LIVE]
         if not survivors:
             self._slow_since[i] = None  # re-judged when the fleet heals
@@ -2051,6 +2478,171 @@ class ServingFleet(object):
             self._probe_ok[i] = 0
         self._probe_at[i] = time.monotonic() + self.probe_interval_s
 
+    # -- autoscaling (ISSUE 11) ------------------------------------------
+    def _scale_sweep(self, now: float):  # thread: monitor, holds: _cond
+        """Queue-driven elasticity: spawn when open requests outrun
+        live capacity (or deadline headroom shrinks under real
+        queueing), retire after SUSTAINED low load. One cool-down gate
+        (`scale_cooldown_s`) serializes both directions — a burst can
+        trigger at most one scale op per window, so arrival noise
+        cannot flap the fleet (hysteresis on the way down is
+        additionally `scale_down_idle_s` of continuous low load).
+        Paused during a rollout: drain→swap→refill must not race a
+        retirement of the replica being swapped."""
+        if self._rollout or self._closing:
+            return
+        live = [i for i in range(self.max_replicas)
+                if self._state[i] == _LIVE]
+        n_live = len(live)
+        open_n = len(self._open)
+        pressure = open_n > self.scale_up_open_per_replica \
+            * max(1, n_live)
+        if not pressure and self.scale_up_headroom_s is not None \
+                and open_n > n_live:
+            # deadline pressure counts only under real queueing (more
+            # open requests than replicas): a single tight-deadline
+            # request on an idle fleet needs routing, not capacity
+            for h in self._handles.values():
+                if h.deadline_at is not None and not h._probe \
+                        and h.deadline_at - now < self.scale_up_headroom_s:
+                    pressure = True
+                    break
+        if pressure:
+            self._low_load_since = None
+            if now < self._scale_gate_at or n_live >= self.max_replicas:
+                return
+            self._scale_up_locked(now)
+            return
+        if n_live > self.min_replicas and open_n < n_live:
+            if self._low_load_since is None:
+                self._low_load_since = now
+            elif now - self._low_load_since >= self.scale_down_idle_s \
+                    and now >= self._scale_gate_at:
+                victim = self._scale_down_victim_locked(live)
+                if victim is not None:
+                    self._begin_retire_locked(victim)
+                    self._scale_gate_at = now + self.scale_cooldown_s
+                    self._low_load_since = None
+        else:
+            self._low_load_since = None
+
+    def _scale_up_locked(self, now: float):  # holds: _cond
+        """Bring one more replica up: a DRAINED slot resumes WARM (the
+        refill() machinery's whole point — engine and prefix pool
+        intact), else a retired/dead slot spawns a fresh incarnation,
+        gated by the slot's supervisor-style restart backoff."""
+        for want_warm in (True, False):
+            for i in range(self.max_replicas):
+                st = self._state[i]
+                if want_warm and st == _DRAINED:
+                    if self._rollout:
+                        # never warm-resume during a rollout: the
+                        # parked engine holds pre-rollout weights, and
+                        # this slot may be mid-swap (see refill())
+                        continue
+                    self._state[i] = _LIVE
+                    self._beats[i] = time.monotonic()
+                    self._kill[i] = False
+                elif not want_warm and st in (_RETIRED, _DEAD) \
+                        and now >= self._refill_at[i]:
+                    self._refill_locked(i)
+                else:
+                    continue
+                self.replicas_spawned += 1
+                self._scale_gate_at = now + self.scale_cooldown_s
+                self._cond.notify_all()
+                return
+
+    def _scale_down_victim_locked(self, live: List[int]):  # holds: _cond
+        """Least-loaded live replica whose retirement keeps every
+        configured tier represented (retiring the last prefill-capable
+        replica would break disaggregation harder than staying one
+        replica over target); ties retire the HIGHEST index, keeping
+        the low, initially-live slots stable."""
+        best, best_key = None, None
+        for i in live:
+            t = self._replica_tier[i]
+            if t is not None and not any(
+                    self._replica_tier[j] in (t, None)
+                    for j in live if j != i):
+                continue
+            load = len(self._inbox[i]) + len(self._in_flight[i])
+            key = (load, -i)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _begin_retire_locked(self, i: int):  # holds: _cond
+        """Graceful scale-down, started (finished by the replica's own
+        handshake when it reaches DRAINED with the retire flag set):
+        queued requests re-route NOW, in-flight work is hedged to
+        survivors FROM THE JOURNAL with token-level resume (the
+        demotion mechanism — no decode step re-spent), and the replica
+        cancels the clawed-back work at its next handshake, goes idle,
+        and retires."""
+        self._begin_drain_locked(i, hedge=True, retire=True)
+
+    def _begin_drain_locked(self, i: int, hedge: bool, retire: bool,
+                            clear_summary: bool = True):  # holds: _cond
+        """Start taking replica `i` out of routing (caller holds
+        `_cond`): queued requests re-route now; with `hedge`, in-flight
+        work is ALSO clawed back via the journal with token-level
+        resume (otherwise it finishes here — the rollout's
+        finish-on-old-version policy); with `retire`, the replica's
+        own handshake retires the slot once idle instead of parking
+        DRAINED. `clear_summary` drops the routing summary (retire and
+        rollout: the engine is leaving, its pool must not attract
+        traffic); an operator `drain()` keeps it — the pool parks WARM
+        and a warm `refill()` must resume with its affinity state
+        intact (the replica's revision cache would never resend an
+        unchanged pool, the PR-8 restore bug class)."""
+        if self._state[i] != _LIVE:
+            return
+        rep = self._replicas[i]
+        self._retire_flag[i] = retire
+        self._state[i] = _DRAINING
+        if clear_summary:
+            self._summaries[i] = set()
+        queued = list(self._inbox[i])
+        self._inbox[i].clear()
+        for h in queued:
+            h.generation += 1
+            self.resubmitted += 1
+            try:
+                self._route(h, exclude=i)
+            except EngineFailed:
+                pass  # no other live replica: handle failed
+        if hedge:
+            self._cancels[i].update(self._in_flight[i].keys())
+            lost = self._journal.lost(rep.name, rep.incarnation)
+            self._cancels[i].update(rid for rid, _s, _g, _t in lost)
+            self._in_flight[i].clear()
+            self._resubmit_lost(i, rep, lost=lost)
+        self._cond.notify_all()
+
+    def scale_up(self) -> bool:
+        """Operator surface: bring one held-back slot live now (same
+        path the autoscaler takes, without its pressure gate). Returns
+        whether a slot was available to spawn."""
+        with self._cond:
+            before = sum(1 for s in self._state if s == _LIVE)
+            self._scale_up_locked(time.monotonic())
+            started = sum(1 for s in self._state if s == _LIVE) > before
+        self._flush_journal()
+        return started
+
+    def scale_down(self, i: int) -> bool:
+        """Operator surface: gracefully retire replica `i` (drain →
+        hedge in-flight from the journal → retire when idle). Returns
+        False when the replica is not LIVE. Unlike the autoscaler this
+        does not enforce `min_replicas` — the operator asked."""
+        with self._cond:
+            if self._state[i] != _LIVE:
+                return False
+            self._begin_retire_locked(i)
+        self._flush_journal()
+        return True
+
     # -- operator surface ------------------------------------------------
     def kill_replica(self, i: int):
         """Drill: the replica's next scheduler handshake raises, its
@@ -2069,18 +2661,11 @@ class ServingFleet(object):
         drained; returns whether the replica is drained."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
-            if self._state[i] == _LIVE:
-                self._state[i] = _DRAINING
-                queued = list(self._inbox[i])
-                self._inbox[i].clear()
-                for h in queued:
-                    h.generation += 1
-                    self.resubmitted += 1
-                    try:
-                        self._route(h, exclude=i)
-                    except EngineFailed:
-                        pass  # no other live replica: handle failed
-                self._cond.notify_all()
+            # operator drain: no hedge (in-flight finishes here), no
+            # retire, and the routing summary SURVIVES the park (the
+            # pool stays warm for refill())
+            self._begin_drain_locked(i, hedge=False, retire=False,
+                                     clear_summary=False)
         self._flush_journal()  # re-assignments above, before any wait
         with self._cond:
             if not wait:
@@ -2095,15 +2680,25 @@ class ServingFleet(object):
 
     def refill(self, i: int):
         """Bring replica `i` back: a DRAINED replica resumes with its
-        engine (and hot prefix pool) intact; a DEAD one is replaced by
-        a fresh incarnation (cold engine) — the restart half of the
+        engine (and hot prefix pool) intact; a DEAD or RETIRED one is
+        replaced by a fresh incarnation (cold engine, built against
+        the fleet's CURRENT weights version) — the restart half of the
         supervisor's restart/backoff story."""
         with self._cond:
             if self._state[i] == _DRAINED:
+                if self._rollout:
+                    # the warm engine holds PRE-rollout weights — and
+                    # this may be the very replica _swap_replica is
+                    # draining: reviving it warm would let the swap
+                    # loop skip it and leave old weights serving past
+                    # a "completed" rollout. A fresh incarnation
+                    # builds against the committed new params instead
+                    self._refill_locked(i)
+                    return
                 self._state[i] = _LIVE
                 self._beats[i] = time.monotonic()
                 self._cond.notify_all()
-            elif self._state[i] == _DEAD:
+            elif self._state[i] in (_DEAD, _RETIRED):
                 self._refill_locked(i)
 
     def _refill_locked(self, i: int):
@@ -2119,10 +2714,241 @@ class ServingFleet(object):
         self._summaries[i] = set()
         self._rep_stats[i] = None
         self._spawned[i] = time.monotonic()
+        self._retire_flag[i] = False
+        # health/probe state is the PREDECESSOR's verdict, not the
+        # fresh incarnation's (the death path cleared it; the rollout
+        # swap of a DEMOTED replica comes through here directly)
+        self._slow_since[i] = None
+        self._watermark[i] = None
+        self._rate[i] = None
+        self._stall_since[i] = None
+        if self._probes[i] is not None:
+            self._handles.pop(self._probes[i].rid, None)
+            for fl in self._in_flight:
+                fl.pop(self._probes[i].rid, None)
+            self._probes[i]._event.set()
+            self._probes[i] = None
+        self._probe_ok[i] = 0
         # starting the thread under the lock is safe: its first _sync
-        # blocks on the condition until we release
+        # blocks on the condition until we release. A controlling
+        # scheduler learns the name NOW, synchronously (thread_spawning
+        # is non-blocking by contract) — the new thread's own
+        # registration happens asynchronously, and an unannounced
+        # spawn would race the controller's enabled-set snapshots
+        if self._hook is not None:
+            self._hook.thread_spawning(
+                "r%d.i%d" % (i, self._incarnations[i]))
         rep.start()
         self._cond.notify_all()
+
+    # -- live weight rollout (ISSUE 11) ----------------------------------
+    def roll_weights(self, ckpt_step=None, params=None, version=None,
+                     policy=None, timeout: float = 120.0) -> dict:
+        """Roll the whole fleet onto a new weight version with zero
+        downtime: rolling drain → swap → refill, one replica at a
+        time, the rest keep serving throughout. The pserver push/pull
+        cycle recast as checkpoint promotion — training saves
+        (`save_weights` / `save_checkpoint`), the sentinel promotes a
+        known-good step, serving rolls onto it.
+
+        Candidate selection: `ckpt_step` names a step under the
+        fleet's `ckpt_dir` — a weight-publish dir written by
+        `save_weights` (a raw training save_checkpoint scope is
+        refused at load: its entry names are not serving leaf names).
+        The default step is the promoted known-good one from
+        `<ckpt_dir>/sentinel.json` (write or copy it into the publish
+        dir, or pass the step explicitly — e.g.
+        `sentinel.known_good_step(training_dir)`). `params=` bypasses
+        disk (tests / in-process handoff) with `version=` tagging it
+        (default: previous + 1, resolved inside the rollout latch). A disk candidate is
+        CRC-verified with `resume_or_init`'s per-step walk machinery
+        BEFORE any replica is touched — a failed verify (or a
+        leaf-count/shape mismatch at load) raises `RolloutAborted`
+        with the fleet untouched: no replica drained, every replica
+        still serving the old version.
+
+        Version fence: the fleet's current version is bumped first, so
+        every replica spawned from here on serves the NEW weights;
+        each swap is a fresh incarnation (never an in-place mutation),
+        every assign/done journal record carries the holder's version,
+        and the journal DFA's J009 rejects any done whose version
+        differs from its latest assignment's. `policy` pins what
+        happens to a swapped replica's in-flight requests: "finish"
+        (default) lets them complete on the old version (the drain
+        waits — a response's tokens all come from one version);
+        "migrate" hedges them to survivors from the journal with
+        token-level resume (faster swap; the completion records the
+        final holder's version). Returns a summary dict."""
+        policy = policy or self.rollout_policy
+        if policy not in ("finish", "migrate"):
+            raise ValueError("rollout policy must be 'finish' or "
+                             "'migrate', got %r" % (policy,))
+        if params is not None:
+            new_params = params
+            # default version (previous + 1) is resolved INSIDE the
+            # rollout latch below: reading _weights_version here would
+            # let two concurrent roll_weights(params=...) calls both
+            # compute the same successor and tag two different weight
+            # sets with one version — exactly what the fence forbids
+            new_version = None if version is None else int(version)
+        else:
+            try:
+                if self.ckpt_dir is None:
+                    raise ValueError(
+                        "roll_weights needs the fleet's ckpt_dir knob "
+                        "(or explicit params=)")
+                step = ckpt_step
+                if step is None:
+                    from ..distributed.sentinel import known_good_step
+                    step = known_good_step(self.ckpt_dir)
+                    if step is None:
+                        raise RolloutAborted(
+                            "no known-good checkpoint step promoted "
+                            "under %s — nothing safe to roll to"
+                            % self.ckpt_dir)
+                from ..distributed.checkpoint import verify_step
+                ok, problems = verify_step(self.ckpt_dir, int(step))
+                if not ok:
+                    raise RolloutAborted(
+                        "candidate checkpoint step %d failed "
+                        "verification (%s) — rollout aborted, fleet "
+                        "untouched" % (int(step), "; ".join(problems)),
+                        problems=problems)
+                new_params = self._load_weights(int(step))
+            except RolloutAborted:
+                with self._cond:
+                    self.rollout_aborts += 1
+                raise
+            new_version = (int(version) if version is not None
+                           else int(step))
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("fleet is closed")
+            if self._rollout:
+                raise RuntimeError("a weight rollout is already in "
+                                   "progress")
+            self._rollout = True  # pauses the autoscaler too
+            old_version = self._weights_version
+            if new_version is None:
+                new_version = old_version + 1
+            # committed FIRST: every refill/spawn from here on builds
+            # against the new weights — the rollout can only move
+            # forward, a mid-rollout death refills onto the new version
+            self._params = new_params
+            self._weights_version = new_version
+            targets = [i for i in range(self.max_replicas)
+                       if self._state[i] in (_LIVE, _DEMOTED,
+                                             _DRAINING, _DRAINED)]
+        try:
+            for i in targets:
+                self._swap_replica(i, policy, timeout)
+        finally:
+            with self._cond:
+                self._rollout = False
+                self._cond.notify_all()
+            self._flush_journal()
+        with self._cond:
+            self.rollouts_completed += 1
+        return {"version": new_version, "previous_version": old_version,
+                "replicas_swapped": len(targets), "policy": policy}
+
+    def _swap_replica(self, i: int, policy: str, timeout: float):
+        """One rolling-swap step: drain replica `i` (policy-dependent:
+        wait for in-flight on "finish", hedge it away on "migrate"),
+        then replace it with a fresh incarnation built against the
+        fleet's new current weights. DEMOTED/DRAINED replicas carry no
+        work and swap immediately; a replica that DIES mid-drain is
+        refilled the same way (failover already rescued its work)."""
+        deadline = time.monotonic() + timeout
+        hook = self._hook
+        if hook is not None:
+            # schedule-exploration seam (ISSUE 9/11): the swap of each
+            # replica is a yield point, so the explorer can interleave
+            # replica handshakes, migrations, and the rollout
+            hook.yield_point("rollout:swap:%d" % i)
+        with self._cond:
+            if self._closing:
+                raise RuntimeError(
+                    "fleet closed during rollout: replica %d left "
+                    "unswapped" % i)
+            if self._state[i] == _LIVE:
+                self._begin_drain_locked(i, hedge=(policy == "migrate"),
+                                         retire=False)
+        self._flush_journal()  # re-assignments from the drain begin
+        while True:
+            with self._cond:
+                if self._closing:
+                    # close() strands a DRAINING replica (its handshake
+                    # stops without transitioning the state, and the
+                    # monitor exits): waiting out the timeout here —
+                    # or refilling a fresh thread on a closed fleet —
+                    # would be worse than the honest error
+                    raise RuntimeError(
+                        "fleet closed during rollout: replica %d left "
+                        "unswapped" % i)
+                st = self._state[i]
+                if st != _DRAINING:
+                    if st in (_DRAINED, _DEMOTED, _DEAD):
+                        self._refill_locked(i)
+                    break
+                t = deadline - time.monotonic()
+                if t <= 0.0:
+                    raise RuntimeError(
+                        "rollout: replica %d failed to drain within "
+                        "%.1fs (in-flight work still running on the "
+                        "old version; policy='migrate' hedges it away "
+                        "instead of waiting)" % (i, timeout))
+                if hook is None:
+                    self._cond.wait(timeout=min(t, 0.5))
+            if hook is not None:
+                # park OUTSIDE the lock: a controlled scheduler must be
+                # able to run the draining replica's handshakes while
+                # the rollout waits (and replay the interleaving)
+                hook.yield_point("rollout:wait:%d" % i)
+        self._flush_journal()
+
+    def _load_weights(self, step: int):
+        """Load one VERIFIED checkpoint step into a fresh params
+        pytree shaped exactly like the construction params. Positional
+        leaf naming (`save_weights` is the writer); a checkpoint whose
+        leaf count or shapes disagree is a `RolloutAborted`, never a
+        silent misload."""
+        import jax
+
+        from ..distributed.checkpoint import load_checkpoint
+
+        names, leaves, treedef = _flat_names(self._params)
+        arrays: Dict[str, Any] = {}
+        load_checkpoint(_FlatScope(arrays), self.ckpt_dir, step=int(step))
+        if sorted(arrays) != names:
+            foreign = sorted(set(arrays) - set(names))
+            if foreign:
+                # entry names are not save_weights' positional leaf
+                # names: this is some other checkpoint (e.g. a raw
+                # training save_checkpoint scope) — name the REAL
+                # mismatch, not a leaf count that may coincide
+                raise RolloutAborted(
+                    "checkpoint step %d was not written by "
+                    "save_weights (entries like %r, expected "
+                    "positional leaf names w00000...w%05d) — publish "
+                    "serving weight sets with save_weights(params, "
+                    "ckpt_dir, step)" % (int(step), foreign[0],
+                                         len(names) - 1))
+            raise RolloutAborted(
+                "checkpoint step %d holds %d weight leaf(s), the "
+                "serving model has %d — not a weight set for this "
+                "model" % (int(step), len(arrays), len(names)))
+        new_leaves = []
+        for n, old in zip(names, leaves):
+            new = arrays[n]
+            if tuple(np.shape(new)) != tuple(np.shape(old)):
+                raise RolloutAborted(
+                    "checkpoint step %d leaf %s has shape %r, the "
+                    "serving model expects %r" % (int(step), n,
+                                                  tuple(np.shape(new)),
+                                                  tuple(np.shape(old))))
+            new_leaves.append(new)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     def _describe(self, rid: int) -> dict:
         """Operator context for one request (FleetTimeout satellite):
@@ -2196,8 +3022,12 @@ class ServingFleet(object):
                 spec_accepted += st.get("spec_accepted", 0)
                 reps.append({
                     "name": rep.name, "slo": rep.slo,
+                    "tier": rep.tier,
                     "state": self._state[i],
                     "incarnation": rep.incarnation,
+                    # gauge (ISSUE 11 satellite): which weight version
+                    # this incarnation serves
+                    "weights_version": rep.weights_version,
                     "load": len(self._inbox[i]) + len(self._in_flight[i]),
                     "stats": st,
                 })
@@ -2218,6 +3048,17 @@ class ServingFleet(object):
                 "probes_sent": self.probes_sent,
                 "resumed_requests": self.resumed_requests,
                 "resumed_tokens": self.resumed_tokens,
+                # elastic lifecycle (ISSUE 11): fleet-scope monotonic
+                # counters (they never fold or reset — a retired
+                # replica's history is already in _stats_base)
+                "replicas_spawned": self.replicas_spawned,
+                "replicas_retired": self.replicas_retired,
+                "migrations": self.migrations,
+                "rollouts_completed": self.rollouts_completed,
+                "rollout_aborts": self.rollout_aborts,
+                "weights_version": self._weights_version,
+                "replicas_live": sum(
+                    1 for s in self._state if s == _LIVE),
                 "open": len(self._open),
                 "lost": self.submitted - self.completed - self.rejected
                 - self.expired - len(self._open),
@@ -2261,7 +3102,9 @@ class ServingFleet(object):
             self._cond.notify_all()
         self._monitor.join(timeout=timeout)
         for rep in list(self._replicas):
-            rep.thread.join(timeout=timeout)
+            # a held-back slot's replica thread may never have started
+            if rep.thread.ident is not None:
+                rep.thread.join(timeout=timeout)
         self._flush_journal()  # stragglers from the final syncs
         self._journal.close()
         # opt-in self-audit (ISSUE 9): replay the journal file through

@@ -76,6 +76,12 @@ class ServingMetrics(object):
         # that is the point: this gauge feeds the fleet's slow-replica
         # health score). 0.0 until the first step.
         self.step_ewma_s = 0.0
+        # PR 11 gauge — the weight version this engine serves (the
+        # fleet's live-rollout version fence stamps it at engine
+        # construction; None outside a versioned fleet). A gauge like
+        # kv_blocks_in_use: a dead incarnation's version says nothing
+        # about its replacement.
+        self.weights_version = None
         self._t0 = None
         self._t1 = None
 
@@ -157,6 +163,7 @@ class ServingMetrics(object):
             "resumed_requests": self.resumed_requests,
             "resume_tokens_reused": self.resume_tokens_reused,
             "step_ewma_s": round(self.step_ewma_s, 6),
+            "weights_version": self.weights_version,
         }
         if self.prefix_cache is not None:
             rep["prefix_cache"] = self.prefix_cache.stats()

@@ -497,6 +497,45 @@ def test_serving_slo_workload_contract():
         rec["p99_ttft_healthy_s"] + rec["p99_ttft_excess_bound_s"], rec
 
 
+def test_serving_elastic_workload_contract():
+    """ISSUE 11 acceptance: the `serving_elastic` row cannot decay
+    into a no-op — on the fixed-seed Poisson burst of deadline-carrying
+    requests, the elastic run must spawn >= 1 replica mid-burst and
+    retire >= 1 after it (full scale-up -> scale-down cycle), migrate
+    >= 1 request from the prefill tier to a decode tier at first token,
+    complete exactly one mid-trace roll_weights onto a CRC-verified
+    checkpoint, abort exactly one rollout on the corrupted candidate
+    (fleet untouched — the bench hard-raises if any live replica left
+    the rolled version), expire and lose NOTHING, and produce outputs
+    token-identical to the static tiered fleet (the bench raises on
+    any divergence, any duplicated rid, and any J-code — including the
+    J009 mixed-version fence — from the journal replay)."""
+    rec = bench.bench_serving_elastic(n_requests=8)
+    assert rec["expired"] == 0, rec
+    assert rec["requests_lost"] == 0, rec
+    assert rec["replicas_spawned"] >= 1, rec
+    assert rec["replicas_retired"] >= 1, rec
+    assert rec["migrations"] >= 1, rec
+    assert rec["rollouts_completed"] == 1, rec
+    assert rec["rollout_aborts"] == 1, rec
+    assert rec["outputs_identical_to_static"], rec
+    # the rollout actually moved the fleet: version 1 responses exist
+    # alongside pre-rollout version 0 ones, and the fleet ends on 1
+    assert rec["weights_version_final"] == 1, rec
+    assert 1 in rec["done_versions_seen"], rec
+    # migrations rode the journaled resume path (tokens carried over)
+    assert rec["resumed_requests"] >= 1, rec
+
+
+def test_serving_elastic_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_elastic", bench_serving_elastic' in src
+
+
 def test_serving_slo_registered_in_bench_main():
     """The workload is wired into bench.main()'s side-workload list
     (the registration is what lands it in the driver's record)."""

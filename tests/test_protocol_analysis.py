@@ -244,6 +244,68 @@ def test_j008_ill_typed_fields_never_crash(tmp_path):
     assert any("ill-typed" in d.detail for d in diags)
 
 
+def test_j009_version_fence(tmp_path):
+    # ISSUE 11: a done whose weights_version differs from its latest
+    # assignment's is a mixed-version output — a protocol violation
+    p = _journal(tmp_path, "j009.jsonl", [
+        _submit(0),
+        dict(_assign(0), weights_version=3, tier="decode"),
+        _progress(0, [7]),
+        dict(_done(0, [7]), weights_version=4),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert _codes(diags) == ["J009"]
+    assert "mixed-version" in diags[0].message
+
+
+def test_j009_reference_is_the_latest_assignment(tmp_path):
+    # a re-assignment during a rollout updates the fence reference:
+    # done carrying the NEW holder's version is clean, the OLD one
+    # (stale fence, both J004 and J009 evidence) is flagged
+    clean = _journal(tmp_path, "v_ok.jsonl", [
+        _submit(0),
+        dict(_assign(0, replica="r0"), weights_version=1),
+        dict(_assign(0, replica="r1"), weights_version=2),
+        _progress(0, [5], replica="r1"),
+        dict(_done(0, [5], replica="r1"), weights_version=2),
+    ])
+    assert verify_journal(clean, expect_closed=True) == []
+    stale = _journal(tmp_path, "v_bad.jsonl", [
+        _submit(0),
+        dict(_assign(0, replica="r0"), weights_version=1),
+        dict(_assign(0, replica="r1"), weights_version=2),
+        _progress(0, [5], replica="r1"),
+        dict(_done(0, [5], replica="r1"), weights_version=1),
+    ])
+    assert "J009" in _codes(verify_journal(stale, expect_closed=True))
+
+
+def test_j009_unversioned_journals_stay_clean(tmp_path):
+    # side-band absent (old journals / unversioned fleets), or absent
+    # on ONE side only: no J009 — the fence needs both halves
+    p = _journal(tmp_path, "v_none.jsonl", [
+        _submit(0), _assign(0), _progress(0, [1]), _done(0, [1]),
+        _submit(1), dict(_assign(1), weights_version=2),
+        _progress(1, [2]), _done(1, [2]),   # done without version
+        _submit(2), _assign(2),             # assign without version
+        _progress(2, [3]), dict(_done(2, [3]), weights_version=9),
+    ])
+    assert verify_journal(p, expect_closed=True) == []
+
+
+def test_side_band_ill_typed_is_j008(tmp_path):
+    # a present-but-ill-typed optional side-band field is J008 like
+    # any required field (never a TypeError out of the DFA)
+    p = _journal(tmp_path, "v_typ.jsonl", [
+        _submit(0),
+        dict(_assign(0), weights_version="three"),
+        dict(_done(0, []), weights_version=1.5),
+    ])
+    diags = verify_journal(p)
+    assert _codes(diags) == ["J008", "J008"]
+    assert all("ill-typed" in d.detail for d in diags)
+
+
 def test_torn_final_line_tolerated(tmp_path):
     # the crash the journal exists to survive must not fail the audit
     p = _journal(tmp_path, "torn.jsonl",
@@ -437,6 +499,35 @@ def test_explorer_smoke_clean(tmp_path):
                      max_preemptions=1, max_schedules=12)
     assert report.ok, report.violation and report.violation.violations
     assert report.runs == 12
+
+
+def test_explorer_elastic_scenarios_smoke_clean(tmp_path):
+    # tier-1 smoke over the ISSUE 11 transition scenarios: scale-up
+    # landing mid-burst, a drain-retire racing a completion, and a
+    # rollout swap racing a prefill->decode migration — each explored
+    # over a bounded schedule slice with the standard probes (verdict
+    # per handle, oracle token identity, lost == 0, journal DFA green
+    # incl. J009) plus the scenarios' own checks (retirement actually
+    # happened, the rollout committed its version)
+    for name in ("scale_up_mid_burst", "drain_retire_race",
+                 "rollout_migration"):
+        report = explore(SCENARIOS[name], str(tmp_path),
+                         max_preemptions=1, max_schedules=6)
+        assert report.ok, (name, report.violation
+                           and report.violation.violations)
+
+
+def test_elastic_scenarios_replay_deterministically(tmp_path):
+    # mid-run thread spawns (the autoscaler's refill, the rollout's
+    # swap) must not make the recorded schedule timing-dependent: the
+    # default schedule replays to the identical trace
+    for name in ("scale_up_mid_burst", "rollout_migration"):
+        r1 = run_schedule(SCENARIOS[name](), [],
+                          str(tmp_path / (name + "_a.jsonl")))
+        assert r1.violations == [], (name, r1.violations)
+        r2 = run_schedule(SCENARIOS[name](), r1.schedule,
+                          str(tmp_path / (name + "_b.jsonl")))
+        assert r2.trace == r1.trace, name
 
 
 @pytest.mark.slow

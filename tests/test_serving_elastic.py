@@ -1,0 +1,439 @@
+"""Elastic disaggregated fleet (paddle_tpu/serving/fleet.py, ISSUE 11):
+
+* Prefill/decode tiers — admissions land on a prefill-tier replica and
+  MIGRATE at first token to a decode-tier replica through the journaled
+  resume path (PR 8's mechanism on purpose instead of on failure):
+  outputs token-identical to sequential generate(), zero journaled
+  tokens re-decoded (progress deltas concatenate exactly to the done
+  record), journal DFA green including the J009 version fence.
+* Autoscaling — a burst spawns replicas (queue-depth pressure through
+  the warm refill() machinery, supervisor backoff gating), a sustained
+  lull drains + retires them (in-flight hedged from the journal); zero
+  requests lost through a full scale-up -> scale-down cycle; fleet
+  totals stay monotonic across retirement (stats fold).
+* Live weight rollout — roll_weights() consumes a CRC-verified
+  checkpoint (the sentinel's known-good step by default), swaps
+  replicas one at a time behind a rolling drain, records the weight
+  version on every response, and ABORTS with the fleet untouched when
+  the candidate fails verification.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.protocol_lint import verify_journal
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serving import (
+    RequestJournal,
+    RolloutAborted,
+    ServingFleet,
+    save_weights,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = T.TransformerConfig(vocab=64, dim=32, heads=4, layers=2,
+                              max_len=64)
+    return cfg, T.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _oracle(params, cfg, prompt, max_new):
+    return np.asarray(
+        T.generate(params, jnp.asarray(prompt)[None], cfg, max_new)
+    )[0]
+
+
+def _requests(cfg, n, seed=0, t_lo=4, t_hi=10, n_lo=3, n_hi=6):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.randint(0, cfg.vocab,
+                     rng.randint(t_lo, t_hi + 1)).astype(np.int32),
+         int(rng.randint(n_lo, n_hi + 1)))
+        for _ in range(n)
+    ]
+
+
+def _audit_no_redecode(jpath):
+    """Per rid: accepted progress deltas concatenate EXACTLY to the
+    done record — a migrated request that re-decoded a journaled token
+    would journal it twice and fail here."""
+    done, prog = {}, {}
+    for rec in RequestJournal._read(jpath):
+        if rec["kind"] == "done":
+            done[rec["rid"]] = rec["tokens"]
+        elif rec["kind"] == "progress":
+            prog.setdefault(rec["rid"], []).extend(rec["tokens"])
+    for rid, toks in done.items():
+        assert prog.get(rid, []) == toks, (
+            "rid %d: journaled progress != done tokens (re-decode "
+            "or double-prepend)" % rid)
+    return done
+
+
+def test_tier_migration_token_identity(model, tmp_path):
+    """The disaggregation tentpole: every request admits on the
+    prefill tier, migrates at first token, finishes on the decode
+    tier — outputs identical to generate(), no token re-decoded,
+    journal green (incl. the version side-band on assigns)."""
+    cfg, params = model
+    jpath = str(tmp_path / "tier.jsonl")
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, journal_path=jpath,
+        replica_tier=["prefill", "decode"],
+        heartbeat_timeout_s=120.0, monitor_interval_s=0.02,
+        engine_kw={"max_slots": 4})
+    try:
+        reqs = _requests(cfg, 4)
+        hs = [fleet.submit(p, n) for p, n in reqs]
+        for h, (p, n) in zip(hs, reqs):
+            out = h.result(timeout=300)
+            np.testing.assert_array_equal(out,
+                                          _oracle(params, cfg, p, n))
+        st = fleet.stats()
+        assert st["migrations"] >= 1, st
+        assert st["lost"] == 0, st
+        # migrated requests rode the resume path on purpose
+        assert st["resumed_requests"] >= 1, st
+    finally:
+        fleet.close()
+    done = _audit_no_redecode(jpath)
+    assert len(done) == 4
+    assert verify_journal(jpath, expect_closed=True) == []
+    # the tier side-band landed on assign records
+    tiers = [rec.get("tier") for rec in RequestJournal._read(jpath)
+             if rec["kind"] == "assign"]
+    assert "prefill" in tiers and "decode" in tiers, tiers
+
+
+def test_no_decode_tier_no_migration(model):
+    """Migration is gated on a live decode-capable target: a fleet
+    whose only replica is prefill-tier just serves the request itself
+    (survival beats tier placement)."""
+    cfg, params = model
+    fleet = ServingFleet(
+        params, cfg, n_replicas=1, max_replicas=1,
+        replica_tier=["prefill"], heartbeat_timeout_s=120.0,
+        engine_kw={"max_slots": 2})
+    try:
+        p = np.arange(1, 6, dtype=np.int32)
+        out = fleet.submit(p, 4).result(timeout=300)
+        np.testing.assert_array_equal(out, _oracle(params, cfg, p, 4))
+        assert fleet.stats()["migrations"] == 0
+    finally:
+        fleet.close()
+
+
+def test_autoscale_up_down_cycle_no_losses(model, tmp_path):
+    """A burst scales the fleet up (held-back slot spawns under the
+    cool-down gate), the lull scales it back down (graceful drain ->
+    journal hedge -> retire), and nothing is lost or duplicated.
+    Retired replicas' work stays in the monotonic totals."""
+    cfg, params = model
+    jpath = str(tmp_path / "scale.jsonl")
+    fleet = ServingFleet(
+        params, cfg, n_replicas=1, min_replicas=1, max_replicas=2,
+        journal_path=jpath, heartbeat_timeout_s=120.0,
+        monitor_interval_s=0.02, scale_up_open_per_replica=1,
+        scale_down_idle_s=0.3, scale_cooldown_s=0.05,
+        engine_kw={"max_slots": 2})
+    try:
+        reqs = _requests(cfg, 6, seed=1)
+        hs = [fleet.submit(p, n) for p, n in reqs]
+        for h, (p, n) in zip(hs, reqs):
+            out = h.result(timeout=300)
+            np.testing.assert_array_equal(out,
+                                          _oracle(params, cfg, p, n))
+        st = fleet.stats()
+        assert st["replicas_spawned"] >= 1, st
+        tokens_at_peak = st["tokens_out"]
+        # the lull: sustained low load retires the extra replica
+        deadline = time.monotonic() + 30.0
+        while fleet.stats()["replicas_live"] > 1:
+            assert time.monotonic() < deadline, fleet.stats()
+            time.sleep(0.02)
+        st = fleet.stats()
+        assert st["replicas_retired"] >= 1, st
+        assert st["lost"] == 0, st
+        # monotonic across retirement: the retired incarnation's
+        # tokens folded into the cumulative base
+        assert st["tokens_out"] >= tokens_at_peak, st
+        # the fleet still serves after the cycle
+        p, n = reqs[0]
+        out = fleet.submit(p, n).result(timeout=300)
+        np.testing.assert_array_equal(out, _oracle(params, cfg, p, n))
+    finally:
+        fleet.close()
+    _audit_no_redecode(jpath)
+    assert verify_journal(jpath, expect_closed=True) == []
+
+
+def test_scale_down_respects_min_and_tier_coverage(model):
+    """The scaler never retires below min_replicas and never retires
+    the last replica of a configured tier (breaking disaggregation is
+    worse than running one replica over target)."""
+    cfg, params = model
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, min_replicas=1, max_replicas=2,
+        replica_tier=["prefill", "decode"],
+        heartbeat_timeout_s=120.0, monitor_interval_s=0.02,
+        scale_down_idle_s=0.2, scale_cooldown_s=0.05,
+        engine_kw={"max_slots": 2})
+    try:
+        with fleet._cond:
+            live = [i for i in range(fleet.max_replicas)
+                    if fleet._state[i] == "live"]
+            # both replicas are the last of their tier: no victim
+            assert fleet._scale_down_victim_locked(live) is None
+    finally:
+        fleet.close()
+
+
+def test_roll_weights_from_sentinel_known_good(model, tmp_path):
+    """The continuous-deployment loop: training promotes a known-good
+    step (sentinel.json), serving rolls onto it with no argument —
+    CRC walk first, rolling swap, every post-rollout response stamped
+    with the new version, journal J009-green."""
+    cfg, params = model
+    ckpt = str(tmp_path / "ckpt")
+    jpath = str(tmp_path / "roll.jsonl")
+    save_weights(params, ckpt, step=3)
+    with open(os.path.join(ckpt, "sentinel.json"), "w") as f:
+        json.dump({"known_good": {"step": 3}}, f)
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, journal_path=jpath, ckpt_dir=ckpt,
+        heartbeat_timeout_s=120.0, monitor_interval_s=0.02,
+        engine_kw={"max_slots": 2})
+    try:
+        p = np.arange(1, 7, dtype=np.int32)
+        pre = fleet.submit(p, 4)
+        out = pre.result(timeout=300)
+        assert pre.weights_version == 0
+        rep = fleet.roll_weights()  # no argument: the known-good step
+        assert rep["version"] == 3 and rep["previous_version"] == 0
+        st = fleet.stats()
+        assert st["weights_version"] == 3
+        assert st["rollouts_completed"] == 1
+        assert all(r["weights_version"] == 3 for r in st["replicas"]
+                   if r["state"] == "live"), st
+        post = fleet.submit(p, 4)
+        np.testing.assert_array_equal(post.result(timeout=300), out)
+        assert post.weights_version == 3
+    finally:
+        fleet.close()
+    # version fence on disk: done records carry their assignment's
+    # version, and the DFA (incl. J009) stays green
+    recs = list(RequestJournal._read(jpath))
+    vers = {r["rid"]: r.get("weights_version")
+            for r in recs if r["kind"] == "done"}
+    assert sorted(vers.values()) == [0, 3], vers
+    assert verify_journal(jpath, expect_closed=True) == []
+
+
+def test_roll_weights_corrupt_candidate_aborts_untouched(model,
+                                                         tmp_path):
+    """The abort contract: a candidate that fails its CRC walk raises
+    RolloutAborted BEFORE any replica is drained — same incarnations,
+    old version everywhere, fleet still serving."""
+    cfg, params = model
+    ckpt = str(tmp_path / "ckpt")
+    save_weights(params, ckpt, step=1)
+    # corrupt one weight shard of the candidate
+    step_dir = os.path.join(ckpt, "step_0000000001")
+    victim = sorted(f for f in os.listdir(step_dir)
+                    if f.endswith(".npy"))[0]
+    with open(os.path.join(step_dir, victim), "r+b") as f:
+        f.seek(16)
+        f.write(b"\xff\x00\xff\x00")
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, ckpt_dir=ckpt,
+        heartbeat_timeout_s=120.0, engine_kw={"max_slots": 2})
+    try:
+        incarnations = [r["incarnation"]
+                        for r in fleet.stats()["replicas"]]
+        with pytest.raises(RolloutAborted) as ei:
+            fleet.roll_weights(ckpt_step=1)
+        assert "verification" in str(ei.value)
+        st = fleet.stats()
+        assert st["rollout_aborts"] == 1 and not st["rollouts_completed"]
+        assert st["weights_version"] == 0
+        assert [r["incarnation"] for r in st["replicas"]] \
+            == incarnations  # nobody was swapped
+        assert all(r["weights_version"] == 0 for r in st["replicas"])
+        # no known-good promoted at all also aborts (nothing to trust)
+        fleet.ckpt_dir = str(tmp_path / "empty")
+        os.makedirs(fleet.ckpt_dir, exist_ok=True)
+        with pytest.raises(RolloutAborted):
+            fleet.roll_weights()
+        assert fleet.stats()["rollout_aborts"] == 2
+        # still serving, still on version 0
+        p = np.arange(1, 5, dtype=np.int32)
+        h = fleet.submit(p, 3)
+        np.testing.assert_array_equal(h.result(timeout=300),
+                                      _oracle(params, cfg, p, 3))
+        assert h.weights_version == 0
+    finally:
+        fleet.close()
+
+
+def test_rollout_migrate_policy_hedges_in_flight(model, tmp_path):
+    """policy='migrate': a swapped replica's in-flight request is
+    hedged to a survivor from the journal with token-level resume —
+    the output is unchanged and no journaled token is re-decoded."""
+    cfg, params = model
+    jpath = str(tmp_path / "mig.jsonl")
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, journal_path=jpath,
+        heartbeat_timeout_s=120.0, monitor_interval_s=0.02,
+        engine_kw={"max_slots": 2})
+    try:
+        p = np.arange(2, 8, dtype=np.int32)
+        n = 12
+        h = fleet.submit(p, n)
+        # wait until some tokens are journaled, then roll mid-decode
+        deadline = time.monotonic() + 60.0
+        while len(fleet._journal.progress_of(h.rid)) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        rep = fleet.roll_weights(params=params, version=5,
+                                 policy="migrate")
+        assert rep["policy"] == "migrate"
+        np.testing.assert_array_equal(h.result(timeout=300),
+                                      _oracle(params, cfg, p, n))
+        st = fleet.stats()
+        assert st["weights_version"] == 5
+    finally:
+        fleet.close()
+    _audit_no_redecode(jpath)
+    assert verify_journal(jpath, expect_closed=True) == []
+
+
+def test_operator_scale_down_and_refill_retired(model):
+    """scale_down(i) retires a live replica on request (journal-hedge
+    + drain); refill() of the retired slot spawns a fresh incarnation
+    against the fleet's CURRENT weight version."""
+    cfg, params = model
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, heartbeat_timeout_s=120.0,
+        monitor_interval_s=0.02, engine_kw={"max_slots": 2})
+    try:
+        assert fleet.scale_down(1)
+        deadline = time.monotonic() + 30.0
+        while fleet.stats()["replicas"][1]["state"] != "retired":
+            assert time.monotonic() < deadline, fleet.stats()
+            time.sleep(0.02)
+        st = fleet.stats()
+        assert st["replicas_retired"] == 1 and st["replicas_live"] == 1
+        assert not fleet.scale_down(1)  # already retired: no-op
+        fleet.refill(1)
+        deadline = time.monotonic() + 30.0
+        while fleet.stats()["replicas_live"] < 2:
+            assert time.monotonic() < deadline, fleet.stats()
+            time.sleep(0.02)
+        assert fleet.stats()["replicas"][1]["incarnation"] == 2
+        p = np.arange(1, 5, dtype=np.int32)
+        np.testing.assert_array_equal(
+            fleet.submit(p, 3).result(timeout=300),
+            _oracle(params, cfg, p, 3))
+    finally:
+        fleet.close()
+
+
+def test_tier_beats_slo_no_migration_ping_pong(model):
+    """Tier placement outranks the SLO preference: with the only
+    decode-tier replica in a DIFFERENT SLO class, a migrated request
+    must still land there (tier filter first, SLO preference within)
+    — narrowing by SLO first would bounce the migration between
+    prefill replicas forever, re-prefilling the growing prefix on
+    every hop (review round-3 repro)."""
+    cfg, params = model
+    fleet = ServingFleet(
+        params, cfg, n_replicas=3,
+        replica_tier=["prefill", "prefill", "decode"],
+        replica_slo=["interactive", "interactive", "batch"],
+        heartbeat_timeout_s=120.0, monitor_interval_s=0.02,
+        engine_kw={"max_slots": 2})
+    try:
+        p = np.arange(1, 7, dtype=np.int32)
+        h = fleet.submit(p, 8, slo="interactive")
+        np.testing.assert_array_equal(h.result(timeout=300),
+                                      _oracle(params, cfg, p, 8))
+        st = fleet.stats()
+        # exactly one hop: prefill tier -> the (batch-class) decode
+        # replica; a ping-pong would inflate this towards max_new
+        assert st["migrations"] == 1, st
+        assert st["resubmitted"] == 1, st
+        assert h.replica == "r2", h.replica
+    finally:
+        fleet.close()
+
+
+def test_roll_weights_refuses_foreign_checkpoint(model, tmp_path):
+    """A raw training save_checkpoint scope (arbitrary entry names) is
+    refused at load with a message naming the REAL mismatch — publish
+    serving weight sets with save_weights — never a silent misload or
+    a misleading leaf-count complaint."""
+    from paddle_tpu.distributed.checkpoint import save_checkpoint
+
+    cfg, params = model
+    ckpt = str(tmp_path / "ckpt")
+
+    class _Scope(object):
+        def __init__(self, arrays):
+            self._arrays = arrays
+
+        def keys(self):
+            return self._arrays.keys()
+
+        def get(self, name):
+            return self._arrays[name]
+
+    save_checkpoint(_Scope({"fc_0.w_0": np.ones((4, 4), np.float32)}),
+                    ckpt, step=1)
+    fleet = ServingFleet(params, cfg, n_replicas=1, ckpt_dir=ckpt,
+                         heartbeat_timeout_s=120.0,
+                         engine_kw={"max_slots": 2})
+    try:
+        with pytest.raises(RolloutAborted, match="save_weights"):
+            fleet.roll_weights(ckpt_step=1)
+        st = fleet.stats()
+        assert st["rollout_aborts"] == 1
+        assert st["weights_version"] == 0
+    finally:
+        fleet.close()
+
+
+def test_elastic_knob_validation(model):
+    """Loud constructor errors: bound ordering, tier names, per-slot
+    list lengths, rollout policy."""
+    cfg, params = model
+    with pytest.raises(ValueError, match="min_replicas"):
+        ServingFleet(params, cfg, n_replicas=2, min_replicas=3)
+    with pytest.raises(ValueError, match="max_replicas"):
+        ServingFleet(params, cfg, n_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="unknown tier"):
+        ServingFleet(params, cfg, n_replicas=1,
+                     replica_tier=["verify"])
+    with pytest.raises(ValueError, match="per SLOT"):
+        ServingFleet(params, cfg, n_replicas=1, max_replicas=2,
+                     replica_tier=["prefill"])
+    with pytest.raises(ValueError, match="rollout_policy"):
+        ServingFleet(params, cfg, n_replicas=1,
+                     rollout_policy="yolo")
+    fleet = ServingFleet(params, cfg, n_replicas=1,
+                         heartbeat_timeout_s=120.0,
+                         engine_kw={"max_slots": 2})
+    try:
+        with pytest.raises(ValueError, match="ckpt_dir"):
+            fleet.roll_weights()  # no ckpt_dir, no params=
+        with pytest.raises(ValueError, match="policy"):
+            fleet.roll_weights(params=params, policy="yolo")
+    finally:
+        fleet.close()

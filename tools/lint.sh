@@ -28,13 +28,20 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 python -m paddle_tpu.analysis --all "$@"
 
-# protocol gate (ISSUE 9): explore the tier-1 fleet scenario, keep its
-# per-schedule journals, and replay EACH through the journal verifier —
-# a new J-code here fails the gate exactly like a new lint finding
+# protocol gate (ISSUE 9 + 11): explore the tier-1 fleet scenarios —
+# the PR-6 kill drill plus the elastic transitions (scale-up
+# mid-burst, drain-retire racing a completion, rollout swap racing a
+# migration) — keep their per-schedule journals, and replay EACH
+# through the journal verifier: a new J-code here (including the J009
+# version fence) fails the gate exactly like a new lint finding
 jdir="$(mktemp -d)"
 trap 'rm -rf "$jdir"' EXIT
 python -m paddle_tpu.analysis explore --scenario submit_kill \
     --max-schedules 6 --journal-dir "$jdir"
+for sc in scale_up_mid_burst drain_retire_race rollout_migration; do
+    python -m paddle_tpu.analysis explore --scenario "$sc" \
+        --max-schedules 4 --journal-dir "$jdir"
+done
 shopt -s nullglob
 journals=("$jdir"/*.jsonl)
 if [ "${#journals[@]}" -eq 0 ]; then
