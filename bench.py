@@ -82,6 +82,21 @@ definitions):
               version, the journal DFA green including the J009
               version fence (no mixed-version output), and outputs
               token-identical between the static and elastic runs
+  serving_multitenant — multi-tenant serving acceptance (ISSUE 12):
+              a fixed-seed 3-tenant Poisson mix (two well-behaved
+              deadline-class tenants with their own LoRA adapters +
+              one adapter tenant driving pool eviction) through one
+              fleet, with a fourth tenant BURSTING past its
+              token-bucket quota mid-trace and a zoo tenant running
+              batched Executor inference through the same scheduler;
+              pins zero deadline misses for the well-behaved tenants,
+              the burst shed via TenantQuotaExceeded and NEVER
+              FleetSaturated (and never journaled), >=1 adapter-pool
+              eviction (adapters page like KV), batch results equal
+              to the direct Executor run, the journal DFA green with
+              the typed tenant side-band, and every tenant's outputs
+              token-identical to a per-tenant SEQUENTIAL run — N
+              adapters batched over one base model change nothing
   training_sentinel — silent-failure tolerance acceptance (ISSUE 10):
               a fixed-seed training job over shards containing one
               poisoned chunk; pins >=1 sentinel trip, rollback landing
@@ -2034,6 +2049,292 @@ def bench_serving_elastic(n_requests=None, max_slots=None, dim=None,
     }
 
 
+def bench_serving_multitenant(n_requests=None, max_slots=None, dim=None,
+                              heads=None, layers_n=None, vocab=None,
+                              max_len=None, deadline_s=None):
+    """Multi-tenant serving acceptance (ISSUE 12): one fleet, many
+    consumers. The fixed-seed trace mixes
+
+      * two WELL-BEHAVED deadline-class tenants (alpha, weight 2, and
+        beta, weight 1), each with its own LoRA adapter batched over
+        the one base model through the one compiled step;
+      * gamma, a third adapter tenant whose requests force the
+        2-payload-slot adapter pool to LRU-EVICT (adapters page like
+        KV blocks — the paged-adapter column);
+      * hog, which BURSTS 6 back-to-back submits against a burst=2
+        token bucket mid-trace;
+      * zoo, a batch-SLO tenant running image/CTR-style batched
+        inference through the EXISTING fluid.Executor path
+        (`tenancy.executor_batch_fn`), interleaved with decode by the
+        same continuous-batching scheduler.
+
+    Hard raises (the in-bench acceptance bar):
+
+      * zero deadline misses for alpha/beta/gamma (expired == 0 and
+        expired_on_arrival == 0) — the hog burst and the zoo lane
+        cannot starve the deadline-class tenants;
+      * the burst is shed via `TenantQuotaExceeded`, NOT
+        `FleetSaturated` (fleet shed == 0), and shed submits are
+        NEVER journaled (the journal's submit count is checked);
+      * >= 1 adapter-pool eviction (3 adapters through 2 payload
+        slots MUST page);
+      * every zoo batch result equals the direct Executor run;
+      * the journal replays green through the protocol DFA
+        (--expect-closed) and every assign/done record carries the
+        typed `tenant` side-band;
+      * every tenant's outputs are TOKEN-IDENTICAL to a per-tenant
+        sequential run (one single-slot engine per tenant, same
+        adapter): neither batching N adapters into one step, WFQ
+        routing, nor the batch lane changes what any request decodes
+        to.
+
+    tokens/s is on-chip-pending like every serving row; the columns
+    above are deterministic offline."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.protocol_lint import verify_journal
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import (AdapterRegistry, RequestJournal,
+                                    ServingEngine, ServingFleet,
+                                    TenantQuotaExceeded, TenantRegistry,
+                                    executor_batch_fn, make_adapter)
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape
+        dim, heads, layers_n = dim or 32, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 64, max_len or 64
+        n_requests = n_requests or 10
+        max_slots = max_slots or 3
+        t_lo, t_hi, n_lo, n_hi, rate = 4, 10, 4, 8, 1.0
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_requests = n_requests or 24
+        max_slots = max_slots or 8
+        t_lo, t_hi, n_lo, n_hi, rate = 16, 64, 16, 48, 1.0
+        dtype = jnp.bfloat16
+    deadline_s = deadline_s or 300.0
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    areg = AdapterRegistry()
+    for name, seed in (("ad_alpha", 1), ("ad_beta", 2), ("ad_gamma", 3)):
+        areg.register(name, make_adapter(cfg, rank=4, seed=seed))
+    treg = TenantRegistry()
+    treg.add("alpha", rate=100.0, burst=100.0, weight=2.0,
+             adapter="ad_alpha")
+    treg.add("beta", rate=100.0, burst=100.0, weight=1.0,
+             adapter="ad_beta")
+    treg.add("gamma", rate=100.0, burst=100.0, weight=1.0,
+             adapter="ad_gamma")
+    treg.add("hog", rate=0.001, burst=2.0, weight=1.0)
+    treg.add("zoo", rate=100.0, burst=100.0, weight=1.0, slo="batch")
+
+    # the zoo model: a tiny inference program through the EXISTING
+    # fluid Executor path (the reference's save_inference_model
+    # serving story) — one fc layer is enough to prove the lane; the
+    # real zoo (resnet/vgg/ctr) serves through exactly this surface
+    import paddle_tpu.fluid as fluid
+
+    zoo_main, zoo_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(zoo_main, zoo_startup):
+        zx = fluid.layers.data(name="zx", shape=[8], dtype="float32")
+        zy = fluid.layers.fc(input=zx, size=4, act="softmax")
+    zoo_exe = fluid.Executor(fluid.CPUPlace())
+    zoo_exe.run(zoo_startup)
+    zrng = np.random.RandomState(7)
+    zoo_feeds = [{"zx": zrng.rand(4, 8).astype(np.float32)}
+                 for _ in range(3)]
+    zoo_direct = [zoo_exe.run(zoo_main, feed=f, fetch_list=[zy])[0]
+                  for f in zoo_feeds]
+
+    rng = np.random.RandomState(0)
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    tenant_of = ["alpha" if i % 2 == 0 else "beta"
+                 for i in range(n_requests)]
+    # gamma rides the tail: its adapter is the third through a
+    # 2-payload-slot pool, so paging MUST evict
+    reqs = []
+    for _ in range(n_requests + 2):
+        t = int(rng.randint(t_lo, t_hi + 1))
+        reqs.append((rng.randint(0, vocab, t).astype(np.int32),
+                     int(rng.randint(n_lo, n_hi + 1))))
+    hog_burst_at = n_requests // 2
+
+    keep_dir = os.environ.get("PADDLE_TPU_KEEP_JOURNAL_DIR") or None
+    if keep_dir is not None:
+        os.makedirs(keep_dir, exist_ok=True)
+    jpath = tempfile.mktemp(suffix=".jsonl",
+                            prefix="multitenant_journal_", dir=keep_dir)
+    fleet = ServingFleet(
+        params, cfg, n_replicas=2, journal_path=jpath,
+        heartbeat_timeout_s=300.0, monitor_interval_s=0.02,
+        max_pending=8 * (n_requests + 16), tenants=treg,
+        engine_kw={"max_slots": max_slots, "adapter_registry": areg,
+                   "adapter_slots": 3})
+    t0 = time.time()
+    by_tenant = {}
+    hog_handles, quota_shed, zoo_handles = [], 0, []
+    try:
+        hs, i, step, burst_done = [], 0, 0, False
+        while True:
+            while i < n_requests + 2 and (
+                    i >= n_requests or arrive_at[min(i, n_requests - 1)]
+                    <= step):
+                ten = tenant_of[i] if i < n_requests else "gamma"
+                p, n = reqs[i]
+                h = fleet.submit(p, n, tenant=ten,
+                                 deadline_s=deadline_s)
+                by_tenant.setdefault(ten, []).append((h, p, n))
+                hs.append(h)
+                i += 1
+            if not burst_done and i >= hog_burst_at:
+                # the quota drill: 6 back-to-back submits against a
+                # burst=2 bucket — 2 admit, 4 shed as the TENANT's
+                # verdict (TenantQuotaExceeded), and the fleet-wide
+                # FleetSaturated shed must stay 0
+                for _ in range(6):
+                    p, n = reqs[0]
+                    try:
+                        h = fleet.submit(p, n, tenant="hog")
+                    except TenantQuotaExceeded:
+                        quota_shed += 1
+                    else:
+                        by_tenant.setdefault("hog", []).append(
+                            (h, p, n))
+                        hs.append(h)
+                # ...and the zoo lane, through the same scheduler
+                for f in zoo_feeds:
+                    zoo_handles.append(fleet.submit_batch(
+                        executor_batch_fn(zoo_exe, zoo_main, f, [zy]),
+                        tenant="zoo", cost=8.0))
+                burst_done = True
+            if i >= n_requests + 2 and burst_done \
+                    and all(h.done for h in hs) \
+                    and all(h.done for h in zoo_handles):
+                break
+            time.sleep(0.004)
+            step += 1
+        for h in hs:
+            h.result(timeout=600)  # raises on lost/expired
+        for h in zoo_handles:
+            h.result(timeout=600)
+        wall = time.time() - t0
+        st = fleet.stats()
+    finally:
+        fleet.close()
+
+    if quota_shed != 4:
+        raise RuntimeError(
+            "hog burst: expected 4 TenantQuotaExceeded sheds "
+            "(burst=2 of 6), got %d" % quota_shed)
+    if st["shed"] != 0:
+        raise RuntimeError(
+            "the burst leaked into FleetSaturated (%d): quota must "
+            "shed it as the tenant's verdict" % st["shed"])
+    if st["expired"] or st["expired_on_arrival"]:
+        raise RuntimeError(
+            "%d deadline miss(es): the burst/zoo lanes starved a "
+            "well-behaved tenant" % (st["expired"]
+                                     + st["expired_on_arrival"]))
+    if st["lost"]:
+        raise RuntimeError("requests lost: %r" % st)
+    if st["adapter_evictions"] < 1:
+        raise RuntimeError(
+            "no adapter-pool eviction: 3 adapters through 2 payload "
+            "slots must page (got %r)" % st["adapter_evictions"])
+    for got, want in zip([h.batch_result[0] for h in zoo_handles],
+                         zoo_direct):
+        if not np.allclose(got, want):
+            raise RuntimeError(
+                "zoo batch-lane result diverged from the direct "
+                "Executor run")
+
+    # journal audit: DFA green (exactly-once, typed side-bands,
+    # everything terminal) + shed-never-journaled + tenant side-band
+    # present on every assign/done
+    recs = list(RequestJournal._read(jpath))
+    n_submits = sum(1 for r in recs if r["kind"] == "submit")
+    n_expected = len(hs) + len(zoo_handles)
+    if n_submits != n_expected:
+        raise RuntimeError(
+            "journal holds %d submits, %d requests were accepted — a "
+            "shed submit was journaled (or one was lost)"
+            % (n_submits, n_expected))
+    for r in recs:
+        if r["kind"] == "assign" and "tenant" not in r:
+            raise RuntimeError("assign record without tenant side-band")
+        if r["kind"] == "done" and r.get("tenant") is None:
+            raise RuntimeError("done record without tenant side-band")
+    diags = verify_journal(jpath, expect_closed=True)
+    if diags:
+        raise RuntimeError(
+            "journal audit failed: %s"
+            % "; ".join("%s %s" % (d.code, d.message) for d in diags))
+    if keep_dir is None:
+        os.unlink(jpath)
+
+    # per-tenant SEQUENTIAL oracle: one single-slot engine per tenant
+    # (same base weights, same adapter) — batching N tenants' adapters
+    # into one compiled step must not change any tenant's tokens
+    for ten, items in sorted(by_tenant.items()):
+        eng = ServingEngine(params, cfg, max_slots=1,
+                            adapter_registry=areg, adapter_slots=3)
+        seq = [eng.submit(p, n, adapter=treg.get(ten).adapter)
+               for _h, p, n in items]
+        eng.run()
+        for (h, _p, _n), sh in zip(items, seq):
+            if list(h.tokens) != list(sh.tokens):
+                raise RuntimeError(
+                    "tenant %r outputs diverge from its sequential "
+                    "run: %r != %r" % (ten, h.tokens, sh.tokens))
+
+    tok_total = sum(len(h.tokens) for h in hs)
+    tenants = st["tenants"]
+    return {
+        # the multi-tenant columns (deterministic offline)
+        "deadline_misses_well_behaved": st["expired"]
+        + st["expired_on_arrival"],
+        "requests_lost": st["lost"],
+        "quota_shed": quota_shed,
+        "fleet_saturated_shed": st["shed"],
+        "hog_admitted": len(by_tenant.get("hog", [])),
+        "batch_jobs_completed": st["batch_jobs_completed"],
+        "adapter_hits": st["adapter_hits"],
+        "adapter_misses": st["adapter_misses"],
+        "adapter_evictions": st["adapter_evictions"],
+        "adapter_uploads": st["adapter_uploads"],
+        "outputs_identical_per_tenant": True,  # hard-raised above
+        "zoo_results_match_executor": True,    # hard-raised above
+        "per_tenant": {
+            t: {"completed": v["completed"],
+                "tokens_out": v["tokens_out"],
+                "shed_quota": v["shed_quota"],
+                "mean_queue_wait_s": v["mean_queue_wait_s"]}
+            for t, v in sorted(tenants.items())},
+        # latency/throughput (wall-clock; on-chip-pending)
+        "tokens_per_sec": round(tok_total / wall, 1),
+        "n_requests": n_requests,
+        "arrival": "poisson(rate=%g/step, seed=0) + hog burst of 6"
+        % rate,
+        "knobs": {"max_slots": max_slots, "n_replicas": 2,
+                  "adapter_slots": 3, "adapter_rank": 4,
+                  "weights": {"alpha": 2.0, "beta": 1.0, "gamma": 1.0},
+                  "hog_bucket": {"rate": 0.001, "burst": 2},
+                  "deadline_s": deadline_s},
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
 def bench_input_pipeline(n_shards=4, chunks_per_shard=8,
                          records_per_chunk=64, batch=64, step_s=0.004,
                          decode_sleep_s=0.0001, num_workers=2,
@@ -2827,6 +3128,11 @@ def main():
         # migration/rollout counts, the J009 version-fence audit, and
         # output identity are deterministic offline
         run("serving_elastic", bench_serving_elastic)
+        # multi-tenant serving (ISSUE 12): tenant quotas + weighted
+        # fair queueing + paged LoRA adapters + the zoo batch lane —
+        # quota/fairness/adapter-paging/output-identity columns are
+        # deterministic offline; per-tenant tok/s on-chip
+        run("serving_multitenant", bench_serving_multitenant)
         run("transformer_lm", bench_transformer_lm)
         # larger-matmul flagship: dim=1024 keeps every matmul MXU-shaped
         # (the dim=512 row leaves lane headroom), so this is the MFU

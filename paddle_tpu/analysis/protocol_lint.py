@@ -49,10 +49,13 @@ and reports violations as stable J-codes:
                           optional side-band; journals from an
                           unversioned fleet stay clean.
 
-Optional side-band fields (ISSUE 11): assign records may carry `tier`
-(prefill/decode disaggregation placement) and `weights_version` (the
-assignee's weight version); done records may carry `weights_version`.
-Present-but-ill-typed side-band fields are J008 like any other field.
+Optional side-band fields (ISSUEs 11 + 12): assign records may carry
+`tier` (prefill/decode disaggregation placement), `weights_version`
+(the assignee's weight version), and `tenant` (the consumer whose
+quota admitted the request — the multi-tenant exactly-once audit
+groups the journal by it); done records may carry `weights_version`
+and `tenant`. Present-but-ill-typed side-band fields are J008 like
+any other field.
 
 A torn FINAL line is tolerated exactly like `RequestJournal._read`
 (the crash the journal exists to survive must not fail its own audit);
@@ -115,13 +118,18 @@ _FIELD_TYPES = {
     # an untiered/unversioned fleet writes them as null
     "tier": (str, type(None)),
     "weights_version": (int, type(None)),
+    # ISSUE 12 side-band: the tenant whose quota admitted the request
+    # (null on a single-tenant fleet) — a per-tenant exactly-once
+    # audit groups the journal by this field, so an ill-typed value
+    # silently breaks the grouping and must be J008 like any other
+    "tenant": (str, type(None)),
 }
 
 # optional per-kind side-band fields: absent is fine (old journals),
 # present-but-ill-typed is J008 like any required field
 _OPTIONAL = {
-    "assign": ("tier", "weights_version"),
-    "done": ("weights_version",),
+    "assign": ("tier", "weights_version", "tenant"),
+    "done": ("weights_version", "tenant"),
 }
 
 

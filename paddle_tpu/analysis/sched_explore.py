@@ -125,6 +125,7 @@ class _ScriptMetrics(object):
         self.resumed_requests = 0
         self.resume_tokens_reused = 0
         self.step_ewma_s = step_ewma_s
+        self.adapter_pool = None
 
 
 class ScriptEngine(object):
@@ -384,9 +385,9 @@ class _Ctx(object):
         self.submit_errors: List[BaseException] = []
         self.threads: List[threading.Thread] = []
 
-    def submit(self, prompt, max_new, seed=0):
+    def submit(self, prompt, max_new, seed=0, tenant=None):
         h = self.fleet.submit(np.asarray(prompt, np.int32), max_new,
-                              seed=seed, slo=None)
+                              seed=seed, slo=None, tenant=tenant)
         self.handles.append((h, list(prompt), seed, max_new))
         return h
 
@@ -659,6 +660,69 @@ class RolloutMigrationRaceScenario(Scenario):
         return out
 
 
+class TenantFairnessScenario(Scenario):
+    """ISSUE 12 multi-tenancy: a burst tenant's three requests race a
+    higher-weight SLA tenant's request through the router's new WFQ
+    dispatch hop (wfq_window=1 — at most one request is dispatched at
+    a time, so the fair queue, not inbox order, decides who runs) on
+    a two-replica fleet, with one replica killed mid-burst so the
+    failover resubmission path (which BYPASSES the fair queue —
+    survival beats fairness) interleaves with WFQ dispatch. The
+    probes pin the multi-consumer contract under every explored
+    schedule: each tenant's request reaches its oracle verdict
+    exactly once (the burst cannot starve the SLA tenant into a lost
+    or doubled verdict), per-tenant accounting balances
+    (submitted == completed for both), nothing is quota-shed (the
+    buckets are sized generously — fairness, not quota, is under
+    test), and the journal's typed tenant side-band replays green
+    through the DFA."""
+
+    name = "tenant_fairness"
+    n_replicas = 2
+
+    def fleet_kw(self):
+        from ..serving.tenancy import TenantRegistry
+
+        reg = TenantRegistry()
+        # generous buckets: quota never sheds here (determinism under
+        # wall-clock-free exploration); the SLA tenant's 4x weight is
+        # what the WFQ hop must honor
+        reg.add("burst", rate=1000.0, burst=1000.0, weight=1.0,
+                slo=None)
+        reg.add("sla", rate=1000.0, burst=1000.0, weight=4.0,
+                slo=None)
+        return {"tenants": reg, "wfq_window": 1}
+
+    def ops(self):
+        return [
+            ("burst0", _always,
+             lambda c: c.submit([4, 4], 3, seed=21, tenant="burst")),
+            ("burst1", _always,
+             lambda c: c.submit([6, 1], 3, seed=22, tenant="burst")),
+            ("sla0", _always,
+             lambda c: c.submit([2, 9, 5], 4, seed=23, tenant="sla")),
+            ("burst2", _always,
+             lambda c: c.submit([8], 3, seed=24, tenant="burst")),
+            ("kill_r0", _always, lambda c: c.fleet.kill_replica(0)),
+        ]
+
+    def check(self, ctx):
+        out = []
+        st = ctx.fleet.stats()
+        if st["quota_shed"]:
+            out.append("quota shed %d request(s) under generous "
+                       "buckets" % st["quota_shed"])
+        for name, want in (("burst", 3), ("sla", 1)):
+            t = (st["tenants"] or {}).get(name, {})
+            if t.get("submitted") != want or t.get("completed") != want:
+                out.append(
+                    "tenant %r accounting off: submitted %r / "
+                    "completed %r, expected %d of each"
+                    % (name, t.get("submitted"), t.get("completed"),
+                       want))
+        return out
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "submit_kill": SubmitKillScenario,
     "demote_route_back": DemoteRouteBackScenario,
@@ -666,6 +730,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "scale_up_mid_burst": ScaleUpMidBurstScenario,
     "drain_retire_race": DrainRetireRaceScenario,
     "rollout_migration": RolloutMigrationRaceScenario,
+    "tenant_fairness": TenantFairnessScenario,
 }
 
 

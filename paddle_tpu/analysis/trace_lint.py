@@ -63,6 +63,11 @@ HOT_PATHS = [
     "paddle_tpu/models/transformer.py",
     "paddle_tpu/serving/engine.py",
     "paddle_tpu/serving/fleet.py",
+    # multi-tenant front door + adapter paging (ISSUE 12): host-side
+    # admission/residency today, but both sit ON the scheduler hot
+    # path next to the compiled steps — linted from day one
+    "paddle_tpu/serving/tenancy.py",
+    "paddle_tpu/serving/adapters.py",
     "paddle_tpu/fluid/executor.py",
     "paddle_tpu/fluid/core/lowering.py",
     # the training sentinel sits ON the step loop next to the jitted

@@ -488,8 +488,56 @@ def _phys_rows(tables, wpos, NB, Bt):
     return phys, wpos % Bt
 
 
+def _adapter_delta(h, a, b, scale):
+    """LoRA-style low-rank delta for one projection: h @ A @ B * scale
+    with PER-SLOT adapter gathers (ISSUE 12 — Punica/S-LoRA batching:
+    N tenants' deltas over one base model in one compiled step). `a`
+    is [d, r] (one slot's adapter — the prefill-chunk case) or
+    [S, d, r] (per-slot gathered — decode [S, d] and verify [S, K, d]
+    activations); `b`/`scale` match. The ZERO adapter (A = B = 0,
+    scale = 0) contributes exact float zeros, so a request with no
+    adapter decodes token-identically to the base model — anything @ 0
+    is 0, 0 * 0 is 0, and x + 0 never moves an argmax (the engine's
+    zero-adapter identity test pins it)."""
+    if a.ndim == 2:  # one slot (the prefill chunk's scalar index)
+        return (h @ a) @ b * scale
+    if h.ndim == 2:  # decode: [S, d] x [S, d, r]
+        t = jnp.einsum("sd,sdr->sr", h, a)
+        return jnp.einsum("sr,srd->sd", t, b) * scale[:, None]
+    # verify: [S, K, d] x [S, d, r]
+    t = jnp.einsum("skd,sdr->skr", h, a)
+    return jnp.einsum("skr,srd->skd", t, b) * scale[:, None, None]
+
+
+def _adapter_qv(h, blk, li, adapters, idx):
+    """q/v projections with the per-slot adapter delta folded in —
+    shared by the three paged steps so the adapter math cannot drift
+    between decode, verify, and prefill chunks. `idx` is the per-slot
+    adapter-index side-band ([] for the chunk's single slot, [S]
+    otherwise); `adapters` holds the stacked device pool
+    ([P, layers, ...] — serving/adapters.py). Returns (q, v) UNshaped
+    (the callers reshape to heads)."""
+    q = h @ blk["wq"]
+    v = h @ blk["wv"]
+    if adapters is not None:
+        sc = adapters["scale"][idx]
+        # cast the (f32 pool) delta back to the activation dtype
+        # BEFORE adding: on bf16 configs an uncast add would promote
+        # q/v to f32 and change downstream attention precision even
+        # for the zero adapter — the token-identity invariant must
+        # hold at the base model's own precision
+        dq = _adapter_delta(h, adapters["a_q"][idx, li],
+                            adapters["b_q"][idx, li], sc)
+        dv = _adapter_delta(h, adapters["a_v"][idx, li],
+                            adapters["b_v"][idx, li], sc)
+        q = q + dq.astype(q.dtype)
+        v = v + dv.astype(v.dtype)
+    return q, v
+
+
 def paged_decode_step(params, token, pos, tables, cache,
-                      cfg: TransformerConfig):
+                      cfg: TransformerConfig, adapters=None,
+                      adapter_idx=None):
     """One decode step over the paged pool: token [S] at per-row
     positions `pos` [S], block tables [S, MAXB] -> (logits [S, vocab],
     updated cache). Mirrors decode_step's numerics verbatim
@@ -497,17 +545,22 @@ def paged_decode_step(params, token, pos, tables, cache,
     per-slot view, so a paged engine row decodes to the same tokens the
     slab engine (and sequential generate()) produces. A parked row
     (pos >= MAXB*Bt) writes nothing; its logits are garbage nothing
-    reads."""
+    reads. With `adapters`/`adapter_idx` [S], each slot's q/v
+    projections gain its tenant's LoRA delta gathered from the stacked
+    adapter pool (ISSUE 12 — index 0 is the zero adapter, exact
+    no-op); the adapter gather is INSIDE this one compiled step, so N
+    tenants retrace nothing."""
     B = token.shape[0]
     dh = cfg.dim // cfg.heads
     NB, Bt = cache[0]["k"].shape[0], cache[0]["k"].shape[1]
     x = params["embed"][token] + params["pos"][pos]
     new_cache = []
-    for blk, kv in zip(params["blocks"], cache):
+    for li, (blk, kv) in enumerate(zip(params["blocks"], cache)):
         h = _ln(x, blk["ln1"])
-        q = (h @ blk["wq"]).reshape(B, cfg.heads, dh)
+        q, v = _adapter_qv(h, blk, li, adapters, adapter_idx)
+        q = q.reshape(B, cfg.heads, dh)
         k = (h @ blk["wk"]).reshape(B, cfg.heads, dh)
-        v = (h @ blk["wv"]).reshape(B, cfg.heads, dh)
+        v = v.reshape(B, cfg.heads, dh)
         pk, off = _phys_rows(tables, pos, NB, Bt)
         ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
         cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
@@ -531,14 +584,18 @@ def paged_decode_step(params, token, pos, tables, cache,
 
 
 def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
-                        cfg: TransformerConfig, true_len=None):
+                        cfg: TransformerConfig, true_len=None,
+                        adapters=None, adapter_idx=None):
     """prefill_chunk over the paged pool: extend the slot whose block
     table is `table_row` [MAXB] by a [C]-token chunk starting at
     `start_pos`. Identical math to prefill_chunk (reference_attention's
     scale-into-q einsum and -1e30 mask — see its docstring for why),
     with the slot's contiguous cache replaced by the gathered block
     view; padded rows (offs >= true_len) park their writes past the
-    table span, where the scatter drops them."""
+    table span, where the scatter drops them. `adapters`/`adapter_idx`
+    (a SCALAR here — one slot prefills per chunk call) fold the slot's
+    tenant LoRA delta into q/v exactly like paged_decode_step, so the
+    cached K/V a chunk writes are the adapted model's."""
     from ..parallel.attention import _NEG_INF
 
     (C,) = chunk.shape
@@ -552,11 +609,12 @@ def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
     wpos = jnp.where(offs < true_len, positions, jnp.int32(Lv))
     x = params["embed"][chunk][None] + params["pos"][positions][None]
     new_cache = []
-    for blk, kv in zip(params["blocks"], cache):
+    for li, (blk, kv) in enumerate(zip(params["blocks"], cache)):
         h = _ln(x, blk["ln1"])
-        q = (h @ blk["wq"]).reshape(1, C, cfg.heads, dh)
+        q, v = _adapter_qv(h, blk, li, adapters, adapter_idx)
+        q = q.reshape(1, C, cfg.heads, dh)
         k = (h @ blk["wk"]).reshape(1, C, cfg.heads, dh)
-        v = (h @ blk["wv"]).reshape(1, C, cfg.heads, dh)
+        v = v.reshape(1, C, cfg.heads, dh)
         pk, off = _phys_rows(table_row, wpos, NB, Bt)
         ck = kv["k"].at[pk, off].set(k[0].astype(kv["k"].dtype))
         cv = kv["v"].at[pk, off].set(v[0].astype(kv["v"].dtype))
@@ -587,7 +645,8 @@ def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
 
 
 def paged_verify_step(params, cache, window, pos, wpos, tables,
-                      cfg: TransformerConfig):
+                      cfg: TransformerConfig, adapters=None,
+                      adapter_idx=None):
     """Speculative-decoding verify: run a K-token `window` [S, K] per
     slot (the pending token followed by K-1 drafted tokens) through the
     paged cache in ONE batched step, returning logits for every window
@@ -613,11 +672,12 @@ def paged_verify_step(params, cache, window, pos, wpos, tables,
     positions = pos[:, None] + jnp.arange(K)[None, :]  # [S, K]
     x = params["embed"][window] + params["pos"][positions]
     new_cache = []
-    for blk, kv in zip(params["blocks"], cache):
+    for li, (blk, kv) in enumerate(zip(params["blocks"], cache)):
         h = _ln(x, blk["ln1"])
-        q = (h @ blk["wq"]).reshape(S, K, cfg.heads, dh)
+        q, v = _adapter_qv(h, blk, li, adapters, adapter_idx)
+        q = q.reshape(S, K, cfg.heads, dh)
         k = (h @ blk["wk"]).reshape(S, K, cfg.heads, dh)
-        v = (h @ blk["wv"]).reshape(S, K, cfg.heads, dh)
+        v = v.reshape(S, K, cfg.heads, dh)
         pk, off = _phys_rows(tables, wpos, NB, Bt)  # [S, K]
         ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
         cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))

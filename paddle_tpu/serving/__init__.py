@@ -13,6 +13,7 @@ tests/test_serving_fleet.py for the correctness bars (token identity
 vs sequential generate() across paging/speculation/failover; zero
 requests lost or answered twice under kill drills)."""
 
+from .adapters import AdapterPool, AdapterRegistry, make_adapter
 from .engine import EngineFailed, ServingEngine, ServingHandle
 from .fleet import (
     DeadlineExceeded,
@@ -27,9 +28,19 @@ from .fleet import (
 from .kv_blocks import KVBlockAllocator
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache, PrefixMatch, chain_keys
+from .tenancy import (
+    Tenant,
+    TenantQuotaExceeded,
+    TenantRegistry,
+    WFQueue,
+    executor_batch_fn,
+)
 
 __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
            "PrefixCache", "PrefixMatch", "chain_keys", "EngineFailed",
            "ServingFleet", "FleetHandle", "FleetSaturated",
            "RequestJournal", "KVBlockAllocator", "DeadlineExceeded",
-           "FleetTimeout", "RolloutAborted", "save_weights"]
+           "FleetTimeout", "RolloutAborted", "save_weights",
+           "AdapterPool", "AdapterRegistry", "make_adapter",
+           "Tenant", "TenantRegistry", "TenantQuotaExceeded",
+           "WFQueue", "executor_batch_fn"]

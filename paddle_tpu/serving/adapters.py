@@ -1,0 +1,291 @@
+"""Paged LoRA-style adapter serving: many per-tenant weight deltas
+batched over ONE base model in the engine's single compiled step
+(ISSUE 12 tentpole, half 1 of 2 — tenancy.py is the admission half).
+
+The multi-tenant problem Punica (Chen et al.) and S-LoRA (Sheng et
+al.) solve: N tenants each want the base model plus a small low-rank
+delta (A @ B on the q/v projections), and serving N separately wastes
+N-1 copies of the base weights AND retraces the decode step per
+tenant. The winning shape is ONE resident base model, ONE compiled
+step, and a per-slot ADAPTER INDEX side-band: slot s gathers its
+delta out of a device-resident adapter pool exactly like its KV rows
+gather through the block table. This module is that shape in the
+repo's paging idiom:
+
+  * `AdapterRegistry` — the host-side store of named adapters
+    (per-layer stacked A/B arrays for the q and v projections, plus a
+    scalar scale). Read-mostly; its own lock makes registration safe
+    against serving threads.
+  * `AdapterPool` — the engine-side residency manager. The device
+    pool is [P, layers, ...] stacked arrays; WHICH adapters are
+    resident is run through the SAME `KVBlockAllocator` discipline
+    the KV blocks use (kv_blocks.py: free list + ref-counts —
+    one pool slot is one "block"): admission `acquire()`s the
+    request's adapter (refcount = residency + live users), retirement
+    `release()`s it, and a cold miss allocates a slot, evicting the
+    least-recently-used RESIDENT-BUT-IDLE adapter (refcount exactly
+    the residency ref) when the pool is full — LRU over idle entries
+    only, exactly the prefix trie's leaf-eviction rule. A pool whose
+    every slot is pinned by live requests returns None: the request
+    stays QUEUED (the block-pool backpressure discipline), never a
+    raise.
+  * Slot 0 is the permanently resident ZERO adapter (A = B = 0,
+    scale = 0): requests with no adapter ride index 0 and the
+    compiled delta contributes exact zeros — greedy outputs are
+    token-identical to the base model with no adapter math at all
+    (transformer._adapter_delta docstring).
+
+Attach/detach is BAND TRAFFIC, not a retrace: the pool arrays keep
+their [P, layers, ...] shapes forever, an attach is an eager
+`.at[slot].set()` dispatch plus a dirty flag on the engine's
+adapter-index band, and the decode/verify/prefill-chunk steps stay
+traced exactly once across any number of adapter swaps (the
+compile-count regression tests pin this).
+
+Host bookkeeping only — the compiled gather lives in
+models/transformer.py; the band wiring in serving/engine.py. All pool
+state is confined to the engine's scheduler thread, like the block
+allocator it wraps.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .kv_blocks import KVBlockAllocator
+
+__all__ = ["AdapterRegistry", "AdapterPool", "make_adapter"]
+
+
+def make_adapter(cfg, rank: int, seed: int = 0, scale: float = 1.0,
+                 stddev: float = 0.25) -> dict:
+    """Random-init one LoRA-style adapter for `cfg` (tests/bench
+    helper): per-layer A [layers, d, r] Gaussian, B [layers, r, d]
+    Gaussian — both non-zero AND large enough that the delta moves
+    argmaxes on toy models, so a wrong adapter index actually changes
+    tokens (a B = 0 init, the training convention, would make every
+    adapter behave like the zero adapter and hide routing bugs)."""
+    rng = np.random.RandomState(seed)
+    L, d, r = int(cfg.layers), int(cfg.dim), int(rank)
+
+    def g(shape):
+        return (stddev * rng.standard_normal(shape)).astype(np.float32)
+
+    return {
+        "a_q": g((L, d, r)), "b_q": g((L, r, d)),
+        "a_v": g((L, d, r)), "b_v": g((L, r, d)),
+        "scale": float(scale),
+    }
+
+
+class AdapterRegistry(object):
+    """Named adapter store shared by every replica's `AdapterPool`.
+    Register before (or during) serving; reads are lock-protected so a
+    replica thread paging an adapter in never races a registration."""
+
+    def __init__(self, rank: Optional[int] = None):
+        self._lock = threading.Lock()
+        # name -> {"a_q","b_q","a_v","b_v" np arrays, "scale" float}
+        self._adapters: Dict[str, dict] = {}  # guarded-by: _lock
+        self.rank = None if rank is None else int(rank)
+
+    def register(self, name: str, adapter: dict):
+        """Add (or replace) one adapter. Arrays must share one rank
+        across the registry — the device pool is one stacked tensor,
+        so ragged ranks would need per-adapter padding nobody asked
+        for; refuse loudly instead."""
+        a_q = np.asarray(adapter["a_q"], np.float32)
+        r = int(a_q.shape[-1])
+        with self._lock:
+            if self.rank is None:
+                self.rank = r
+            elif r != self.rank:
+                raise ValueError(
+                    "adapter %r has rank %d, the registry is rank %d "
+                    "(one stacked device pool = one rank)"
+                    % (name, r, self.rank))
+            self._adapters[name] = {
+                "a_q": a_q,
+                "b_q": np.asarray(adapter["b_q"], np.float32),
+                "a_v": np.asarray(adapter["a_v"], np.float32),
+                "b_v": np.asarray(adapter["b_v"], np.float32),
+                "scale": float(adapter.get("scale", 1.0)),
+            }
+
+    def get(self, name: str) -> dict:
+        with self._lock:
+            if name not in self._adapters:
+                raise KeyError("unknown adapter %r (registered: %r)"
+                               % (name, sorted(self._adapters)))
+            return self._adapters[name]
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return name in self._adapters
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._adapters)
+
+
+class AdapterPool(object):
+    """Device-resident adapter pool for ONE engine: stacked
+    [P, layers, ...] arrays the compiled steps gather from by per-slot
+    index, plus the residency bookkeeping (a `KVBlockAllocator` over P
+    one-token "blocks": ref-counts, free list) and an LRU over
+    resident-but-idle adapters. Slot 0 is the pinned zero adapter."""
+
+    def __init__(self, cfg, registry: AdapterRegistry, slots: int,
+                 rank: Optional[int] = None):
+        import jax.numpy as jnp
+
+        P = int(slots)
+        if P < 2:
+            raise ValueError(
+                "adapter_slots must be >= 2 (slot 0 is the pinned "
+                "zero adapter)")
+        r = registry.rank if rank is None else int(rank)
+        if r is None:
+            raise ValueError(
+                "adapter rank unknown: register an adapter first or "
+                "pass adapter_rank")
+        self.registry = registry
+        self.slots = P
+        self.rank = r
+        L, d = int(cfg.layers), int(cfg.dim)
+        # the device pool: zeros everywhere, so every slot starts as
+        # (and an evicted slot decays back to, without a scrub — its
+        # scale is zeroed) a delta nothing distinguishes from absent
+        self._a_q = jnp.zeros((P, L, d, r), jnp.float32)  # guarded-by: scheduler
+        self._b_q = jnp.zeros((P, L, r, d), jnp.float32)  # guarded-by: scheduler
+        self._a_v = jnp.zeros((P, L, d, r), jnp.float32)  # guarded-by: scheduler
+        self._b_v = jnp.zeros((P, L, r, d), jnp.float32)  # guarded-by: scheduler
+        self._scale = jnp.zeros((P,), jnp.float32)        # guarded-by: scheduler
+        # residency accounting IS a block allocator: one slot = one
+        # block, refcount 1 = resident only (evictable), > 1 = pinned
+        # by live requests
+        self._alloc = KVBlockAllocator(P, 1)              # guarded-by: scheduler
+        self._alloc.reserve(1)
+        zero = self._alloc.alloc_reserved()
+        assert zero == 0  # the allocator pops ascending ids
+        self._resident: Dict[str, int] = {}               # guarded-by: scheduler
+        self._slot_name: Dict[int, str] = {}              # guarded-by: scheduler
+        self._lru: List[str] = []  # oldest first               # guarded-by: scheduler
+        # O(1) counters (the ServingMetrics discipline)
+        self.hits = 0                                     # guarded-by: scheduler
+        self.misses = 0                                   # guarded-by: scheduler
+        self.evictions = 0                                # guarded-by: scheduler
+        self.uploads = 0                                  # guarded-by: scheduler
+
+    # -- device side ----------------------------------------------------
+    def device_arrays(self) -> dict:
+        """The stacked pool arrays the compiled steps gather from, in
+        transformer._adapter_delta's key shape."""
+        return {"a_q": self._a_q, "b_q": self._b_q,
+                "a_v": self._a_v, "b_v": self._b_v,
+                "scale": self._scale}
+
+    def _upload(self, slot: int, ad: dict):
+        import jax.numpy as jnp
+
+        # eager dispatches, NOT a retrace: shapes never change
+        self._a_q = self._a_q.at[slot].set(jnp.asarray(ad["a_q"]))
+        self._b_q = self._b_q.at[slot].set(jnp.asarray(ad["b_q"]))
+        self._a_v = self._a_v.at[slot].set(jnp.asarray(ad["a_v"]))
+        self._b_v = self._b_v.at[slot].set(jnp.asarray(ad["b_v"]))
+        self._scale = self._scale.at[slot].set(ad["scale"])
+        self.uploads += 1
+
+    # -- residency ------------------------------------------------------
+    def _touch(self, name: str):
+        self._lru.remove(name)
+        self._lru.append(name)
+
+    def _evict_idle(self) -> bool:
+        """Evict the least-recently-used resident adapter nobody holds
+        (refcount == the residency ref alone). False when every
+        resident adapter is pinned by a live request."""
+        for name in self._lru:
+            slot = self._resident[name]
+            if self._alloc.refcount(slot) == 1:
+                self._alloc.decref(slot)  # frees: residency was last
+                del self._resident[name]
+                del self._slot_name[slot]
+                self._lru.remove(name)
+                # the stale weights may stay in HBM, but the slot is
+                # unreachable until re-uploaded (scale stays until the
+                # next tenant's attach overwrites it; no index can name
+                # a freed slot — the engine clears bands at retire)
+                self.evictions += 1
+                return True
+        return False
+
+    def acquire(self, name: Optional[str]) -> Optional[int]:
+        """Pin one adapter for a request being admitted and return its
+        pool slot. None (no adapter) is the zero slot and always
+        succeeds. A cold miss pages the adapter in (allocating a slot,
+        LRU-evicting an idle resident one if the pool is full); when
+        every slot is pinned by live requests, returns None — the
+        caller leaves the request QUEUED, the block-pool backpressure
+        rule."""
+        if name is None:
+            self._alloc.incref(0)
+            return 0
+        slot = self._resident.get(name)
+        if slot is not None:
+            self._alloc.incref(slot)
+            self._touch(name)
+            self.hits += 1
+            return slot
+        ad = self.registry.get(name)  # raises on unknown: caller's bug
+        if self._alloc.available < 1 and not self._evict_idle():
+            return None  # saturated: every resident adapter is live
+        self.misses += 1
+        self._alloc.reserve(1)
+        slot = self._alloc.alloc_reserved()
+        self._upload(slot, ad)
+        self._resident[name] = slot
+        self._slot_name[slot] = name
+        self._lru.append(name)
+        self._alloc.incref(slot)  # the request's pin, over residency's
+        return slot
+
+    def release(self, slot: int):
+        """Drop one request's pin (retirement). The residency ref
+        keeps the adapter warm for the next request; eviction happens
+        only under a cold miss with a full pool."""
+        self._alloc.decref(slot)
+
+    def detach(self, name: str) -> bool:
+        """Operator surface: evict one adapter now. False when it is
+        not resident or pinned by a live request."""
+        slot = self._resident.get(name)
+        if slot is None or self._alloc.refcount(slot) != 1:
+            return False
+        self._alloc.decref(slot)
+        del self._resident[name]
+        del self._slot_name[slot]
+        self._lru.remove(name)
+        self.evictions += 1
+        return True
+
+    def resident(self) -> List[str]:
+        return sorted(self._resident)
+
+    def refcount(self, name: str) -> int:
+        slot = self._resident.get(name)
+        return 0 if slot is None else self._alloc.refcount(slot)
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.slots,
+            "rank": self.rank,
+            "resident": len(self._resident),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "uploads": self.uploads,
+        }

@@ -102,6 +102,7 @@ import numpy as np
 from ..distributed import fault_injection as _fi
 from ..fluid.core.kernels_sequence import bucket_pow2
 from ..models import transformer as tlm
+from .adapters import AdapterPool
 from .kv_blocks import KVBlockAllocator
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
@@ -109,7 +110,7 @@ from .prefix_cache import PrefixCache
 __all__ = ["ServingEngine", "ServingHandle", "EngineFailed"]
 
 _BANDS = ("tok", "pos", "alive", "temps", "counts", "base_keys",
-          "tables", "limits")
+          "tables", "limits", "aidx")
 
 
 class EngineFailed(RuntimeError):
@@ -143,7 +144,7 @@ class ServingHandle(object):
 
     def __init__(self, engine, rid, prompt, max_new_tokens, temperature,
                  eos_id, seed, publish_len, deadline_at=None,
-                 resume_tokens=None):
+                 resume_tokens=None, adapter=None):
         self._engine = engine
         self.rid = rid
         self.prompt = prompt  # np.int32 [T0] — the ORIGINAL prompt
@@ -167,6 +168,9 @@ class ServingHandle(object):
         # absolute time.monotonic() budget (None = no deadline): the
         # engine expires the request at the next queue hop past it
         self.deadline_at = deadline_at
+        # LoRA-style adapter name (ISSUE 12; None = the base model /
+        # zero adapter) — resolved to a pool slot at admission
+        self.adapter = adapter
         self.tokens: List[int] = []  # generated tokens (may include eos)
         self.done = False
         # 'eos' | 'budget' | 'expired' | 'cancelled'
@@ -236,7 +240,9 @@ class ServingEngine(object):
                  prefix_block_tokens=None, kv_block_tokens=None,
                  kv_pool_blocks=None, spec_draft_len=None,
                  replica_id=None, fault_injector=None,
-                 scheduler_hook=None, weights_version=None):
+                 scheduler_hook=None, weights_version=None,
+                 adapter_registry=None, adapter_slots=8,
+                 adapter_rank=None):
         self._params = params
         self._cfg = cfg
         # deterministic-exploration seam (ISSUE 9): the fleet threads
@@ -314,6 +320,18 @@ class ServingEngine(object):
             )
             self.metrics.prefix_cache = self.prefix_cache
 
+        # paged LoRA adapter pool (ISSUE 12): a per-engine device pool
+        # of stacked A/B deltas gathered by the per-slot adapter-index
+        # band inside the ONE compiled decode/verify/chunk step — N
+        # tenants with N adapters retrace nothing; slot 0 is the zero
+        # adapter (requests without an adapter are exact no-ops)
+        self._adapter_pool: Optional[AdapterPool] = None  # guarded-by: scheduler
+        if adapter_registry is not None:
+            self._adapter_pool = AdapterPool(
+                cfg, adapter_registry, adapter_slots,
+                rank=adapter_rank)
+            self.metrics.adapter_pool = self._adapter_pool
+
         self._cache = tlm.init_paged_kv_cache(cfg, NB, Bt)
         # host-side truth of the per-slot side-bands; device copies are
         # kept across steps and re-uploaded only when dirtied. All
@@ -334,6 +352,9 @@ class ServingEngine(object):
         self._tables = np.full((S, self.blocks_per_slot), -1,
                                np.int32)      # guarded-by: scheduler
         self._limits = np.zeros(S, np.int32)  # guarded-by: scheduler
+        # per-slot adapter-index band (ISSUE 12): which adapter-pool
+        # slot each request's q/v deltas gather from (0 = zero adapter)
+        self._aidx = np.zeros(S, np.int32)    # guarded-by: scheduler
         self._n_alloc = np.zeros(S, np.int32)  # table entries >= 0  # guarded-by: scheduler
         self._reserved_tail = np.zeros(S, np.int32)  # guarded-by: scheduler
         self._dev: Dict[str, Any] = {}        # guarded-by: scheduler
@@ -378,7 +399,7 @@ class ServingEngine(object):
         Lv = self.blocks_per_slot * self.kv_block_tokens
 
         def _decode(params, cache, tables, tok, pos, alive, temps,
-                    counts, base_keys):
+                    counts, base_keys, adapters=None, aidx=None):
             metrics.count_trace("decode_step")  # trace-time side effect
             # dead slots park their write past the table span: the
             # block lookup resolves them to the out-of-range sentinel
@@ -386,7 +407,8 @@ class ServingEngine(object):
             # can never dirty a block a future request will claim
             write_pos = jnp.where(alive, pos, jnp.int32(Lv))
             logits, cache = tlm.paged_decode_step(
-                params, tok, write_pos, tables, cache, cfg
+                params, tok, write_pos, tables, cache, cfg,
+                adapters=adapters, adapter_idx=aidx,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
@@ -418,14 +440,15 @@ class ServingEngine(object):
         Lv = self.blocks_per_slot * self.kv_block_tokens
 
         def _verify(params, cache, tables, window, pos, alive, limits,
-                    temps, counts, base_keys):
+                    temps, counts, base_keys, adapters=None, aidx=None):
             metrics.count_trace("spec_verify")  # trace-time side effect
             rows = pos[:, None] + jnp.arange(K)[None, :]  # [S, K]
             # dead slots and rows past the request's token budget park
             ok = alive[:, None] & (rows < limits[:, None])
             wpos = jnp.where(ok, rows, jnp.int32(Lv))
             logits, cache = tlm.paged_verify_step(
-                params, cache, window, pos, wpos, tables, cfg
+                params, cache, window, pos, wpos, tables, cfg,
+                adapters=adapters, adapter_idx=aidx,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             # per-position sampling keys: position i of a slot whose
@@ -464,11 +487,11 @@ class ServingEngine(object):
         cfg, metrics = self._cfg, self.metrics
 
         def _chunk(params, cache, padded, start, table_row, true_len,
-                   temp, key):
+                   temp, key, adapters=None, aidx=None):
             metrics.count_trace("prefill_T%d" % Cb)
             logits, cache = tlm.paged_prefill_chunk(
                 params, cache, padded, start, table_row, cfg,
-                true_len=true_len,
+                true_len=true_len, adapters=adapters, adapter_idx=aidx,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             sampled = jax.random.categorical(
@@ -514,6 +537,17 @@ class ServingEngine(object):
 
     def _mark_dirty(self, *names):
         self._dirty.update(names or _BANDS)
+
+    def _adapter_args(self, aidx) -> dict:
+        """Extra kwargs for the compiled steps when the adapter pool
+        is on: the stacked pool arrays + the adapter-index side-band
+        (`aidx` — the [S] device band for decode/verify, a scalar for
+        a prefill chunk). Empty when adapters are off, so the traced
+        graphs stay byte-identical to the pre-adapter engine."""
+        if self._adapter_pool is None:
+            return {}
+        return {"adapters": self._adapter_pool.device_arrays(),
+                "aidx": aidx}
 
     # ------------------------------------------------------------------
     # block bookkeeping
@@ -583,7 +617,7 @@ class ServingEngine(object):
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0, eos_id=None,
                seed=0, publish_len=None, deadline_at=None,
-               resume_tokens=None) -> ServingHandle:
+               resume_tokens=None, adapter=None) -> ServingHandle:
         """Enqueue one request (FCFS). Returns a handle whose `.tokens`
         fills in as the engine steps; `handle.result()` drives the
         engine to completion of this request. Structurally impossible
@@ -626,10 +660,22 @@ class ServingEngine(object):
             )
         if publish_len is not None and publish_len < 0:
             raise ValueError("publish_len must be >= 0 or None")
+        if adapter is not None:
+            # resolve-or-refuse NOW: an unknown adapter (or an engine
+            # with no pool) must fail the caller synchronously, never
+            # crash the scheduler at admission time
+            if self._adapter_pool is None:
+                raise ValueError(
+                    "request names adapter %r but the engine has no "
+                    "adapter pool (pass adapter_registry=)" % (adapter,))
+            if not self._adapter_pool.registry.has(adapter):
+                raise ValueError("unknown adapter %r (registered: %r)"
+                                 % (adapter,
+                                    self._adapter_pool.registry.names()))
         h = ServingHandle(self, self._next_rid, prompt, max_new_tokens,
                           temperature, eos_id, seed, publish_len,
                           deadline_at=deadline_at,
-                          resume_tokens=resume_tokens)
+                          resume_tokens=resume_tokens, adapter=adapter)
         self._next_rid += 1
         if deadline_at is not None:
             self._deadlines = True
@@ -655,6 +701,13 @@ class ServingEngine(object):
         self._slot_req[s] = None
         self._alive[s] = False
         self._spec_ctx.pop(s, None)
+        if self._adapter_pool is not None:
+            # drop the request's adapter pin (the residency ref keeps
+            # it warm); the band resets to the zero adapter so a freed
+            # pool slot is never reachable through a stale index
+            self._adapter_pool.release(int(self._aidx[s]))
+            self._aidx[s] = 0
+            self._mark_dirty("aidx")
         self._free_slot_blocks(s)
         self.metrics.kv_blocks_in_use = self._alloc.blocks_in_use
         self._mark_dirty("alive")
@@ -697,7 +750,17 @@ class ServingEngine(object):
         T0 = h.full_prompt.shape[0]
         Bt = self.kv_block_tokens
         need_total = self._blocks_for(T0 + h.max_new_tokens)
+        aslot = 0
+        pool = self._adapter_pool
         pc = self.prefix_cache
+        if h.adapter is not None:
+            # the trie is keyed by TOKENS alone, but an adapted model
+            # writes adapter-specific K/V: aliasing another tenant's
+            # blocks (or publishing ours) would serve tenant A's cache
+            # rows to tenant B — adapter-carrying requests skip the
+            # shared prefix pool entirely (_publish applies the same
+            # rule on the way out)
+            pc = None
         # a pure PROBE: a block-starved request retries every step, and
         # retries must not inflate hit/miss stats or restamp LRU order
         # — record_hit/record_miss fire once the admission resolves
@@ -734,6 +797,19 @@ class ServingEngine(object):
             self._reclaim_for(need_new)
             if not self._alloc.reserve(need_new):
                 return False  # saturated: stay queued (backpressure)
+            if pool is not None:
+                # pin the request's adapter AFTER the block
+                # reservation: a block-starved request retries every
+                # scheduler step, and acquiring first would inflate
+                # adapter hit counts and restamp the pool LRU per
+                # retry (the prefix-probe discipline, applied to
+                # adapters). A pool whose every slot is held by live
+                # requests leaves this request QUEUED — unwind the
+                # block reservation and retry next step
+                aslot = pool.acquire(h.adapter)
+                if aslot is None:
+                    self._alloc.release_reservation(need_new)
+                    return False
             if pc is not None:
                 pc.record_miss()
         else:
@@ -743,6 +819,11 @@ class ServingEngine(object):
                 # mid-alias
                 if not self._alloc.reserve(need_new):
                     return False  # unreachable single-threaded; defensive
+                if pool is not None:
+                    # h.adapter is None on this branch (adapter
+                    # requests never match the trie): the zero-slot
+                    # pin, which always succeeds
+                    aslot = pool.acquire(None)
                 pc.record_hit(m)  # the probe resolves to a real use
                 keep = n_alias - n_cow
                 for d in range(keep):
@@ -769,7 +850,8 @@ class ServingEngine(object):
         self.metrics.kv_blocks_in_use = self._alloc.blocks_in_use
         self._slot_req[s] = h
         self._limits[s] = T0 + h.max_new_tokens
-        self._mark_dirty("tables", "limits")
+        self._aidx[s] = aslot
+        self._mark_dirty("tables", "limits", "aidx")
         # the first-token sampling key is per-request, not per-chunk:
         # computed once here, consumed on the prompt's final chunk. A
         # resumed request's first NEW token is overall token index
@@ -789,7 +871,9 @@ class ServingEngine(object):
         trie takes a ref on the slot's PHYSICAL block ids. Novel blocks
         only; a chain the trie already holds gains nothing."""
         pc = self.prefix_cache
-        if pc is None:
+        if pc is None or h.adapter is not None:
+            # adapter-specific K/V must never enter the shared trie
+            # (the _admit cross-tenant poisoning rule, outbound half)
             return
         T0 = h.full_prompt.shape[0]
         bound = T0 if h.publish_len is None else min(h.publish_len, T0)
@@ -825,6 +909,7 @@ class ServingEngine(object):
             self._params, self._cache, jnp.asarray(padded),
             jnp.int32(cursor), jnp.asarray(self._tables[s]),
             jnp.int32(c), jnp.float32(h.temperature), st["key"],
+            **self._adapter_args(jnp.int32(int(self._aidx[s]))),
         )
         st["cursor"] = cursor + c
         self.metrics.prefill_chunks += 1
@@ -1058,6 +1143,7 @@ class ServingEngine(object):
             self._band("tok"), self._band("pos"), self._band("alive"),
             self._band("temps"), self._band("counts"),
             self._band("base_keys"),
+            **self._adapter_args(self._band("aidx")),
         )
         nxt = np.asarray(nxt_d)  # blocks; tokens are real
         # the decode step advanced tok/pos/counts on device; adopt its
@@ -1118,6 +1204,7 @@ class ServingEngine(object):
             jnp.asarray(window), self._band("pos"), self._band("alive"),
             self._band("limits"), self._band("temps"),
             self._band("counts"), self._band("base_keys"),
+            **self._adapter_args(self._band("aidx")),
         )
         cand = np.asarray(cand_d)  # blocks; candidates are real
         self.metrics.span("spec_verify", time.monotonic() - t0)

@@ -149,11 +149,13 @@ import numpy as np
 from ..distributed.supervisor import restart_backoff_s as _backoff
 from .engine import EngineFailed, ServingEngine
 from .prefix_cache import chain_keys
+from .tenancy import TenantQuotaExceeded, WFQueue
 
 __all__ = [
     "ServingFleet", "FleetHandle", "FleetSaturated", "RequestJournal",
     "DeadlineExceeded", "FleetTimeout", "run_fleet_subprocess",
     "SchedulerHook", "RolloutAborted", "save_weights",
+    "TenantQuotaExceeded",
 ]
 
 
@@ -383,6 +385,15 @@ class FleetHandle(object):
         self.weights_version: Optional[int] = None
         self.error: Optional[BaseException] = None
         self.chain: List[int] = []  # affinity keys (set by the fleet)
+        # multi-tenant side-band (ISSUE 12): the admitting tenant
+        # (None on a single-tenant fleet), the WFQ service-cost
+        # estimate, and — for batch-lane (zoo) jobs — the host
+        # callable a replica runs between engine steps plus its return
+        # value. All set by the fleet at submit time.
+        self.tenant: Optional[str] = None
+        self.cost: float = 1.0
+        self.batch_fn = None
+        self.batch_result = None
         self._probe = False   # internal health probe, never journaled
         self._fleet = fleet
         self._submit_t = time.monotonic()
@@ -422,6 +433,13 @@ class FleetHandle(object):
 
 
 _TERMINAL_KINDS = ("done", "rejected", "expired")
+
+# submit(slo=...)'s "caller said nothing" sentinel: distinguishes the
+# implicit default ("interactive", or the tenant's registered default
+# class on a multi-tenant fleet) from an EXPLICIT slo=None (wildcard —
+# any replica class). A plain string default could not tell the two
+# apart, and the tenant default would be unreachable.
+_SLO_UNSET = object()
 
 
 class RequestJournal(object):
@@ -466,10 +484,11 @@ class RequestJournal(object):
         self._file_records = 0                       # guarded-by: _lock
         self._open_specs: Dict[int, dict] = {}       # guarded-by: _lock
         self._assign: Dict[int, Tuple[str, int, int]] = {}  # guarded-by: _lock
-        # (tier, weights_version) side-band of the latest assignment
-        # (ISSUE 11): kept apart from _assign so the 3-tuple fence
-        # consumers stay unchanged; compaction must reproduce it
-        self._assign_meta: Dict[int, Tuple[Optional[str], Optional[int]]] = {}  # guarded-by: _lock
+        # (tier, weights_version, tenant) side-band of the latest
+        # assignment (ISSUEs 11 + 12): kept apart from _assign so the
+        # 3-tuple fence consumers stay unchanged; compaction must
+        # reproduce it
+        self._assign_meta: Dict[int, Tuple[Optional[str], Optional[int], Optional[str]]] = {}  # guarded-by: _lock
         self._progress: Dict[int, List[int]] = {}    # guarded-by: _lock
         self._done: Set[int] = set()                 # guarded-by: _lock
         # records handed out via defer=True whose file append is still
@@ -551,7 +570,8 @@ class RequestJournal(object):
             self._assign[rid] = (rec["replica"], rec["incarnation"],
                                  rec["gen"])
             self._assign_meta[rid] = (rec.get("tier"),
-                                      rec.get("weights_version"))
+                                      rec.get("weights_version"),
+                                      rec.get("tenant"))
         elif rec["kind"] == "progress":
             self._progress.setdefault(rid, []).extend(rec["tokens"])
         elif rec["kind"] in _TERMINAL_KINDS:
@@ -591,10 +611,12 @@ class RequestJournal(object):
                          "spec": self._open_specs[rid]})
             if rid in self._assign:
                 rep, inc, gen = self._assign[rid]
-                tier, wv = self._assign_meta.get(rid, (None, None))
+                tier, wv, ten = self._assign_meta.get(
+                    rid, (None, None, None))
                 recs.append({"kind": "assign", "rid": rid, "replica": rep,
                              "incarnation": inc, "gen": gen,
-                             "tier": tier, "weights_version": wv})
+                             "tier": tier, "weights_version": wv,
+                             "tenant": ten})
             if self._progress.get(rid):
                 recs.append({"kind": "progress", "rid": rid,
                              "replica": None, "incarnation": None,
@@ -665,22 +687,28 @@ class RequestJournal(object):
     def assign(self, rid: int, replica: str, incarnation: int, gen: int,
                tier: Optional[str] = None,
                weights_version: Optional[int] = None,
+               tenant: Optional[str] = None,
                defer: bool = False) -> Optional[dict]:
         """Record an assignment. The MIRROR updates synchronously (a
         failover consulting `lost()` an instant later must see it);
         with `defer=True` the file append is returned as a record for
         the caller to `write()` later — the fleet defers file I/O
-        until it has released its scheduler lock. `tier` and
-        `weights_version` ride as an optional side-band (ISSUE 11):
-        the assignee's disaggregation tier and the weight version it
-        serves — the journal DFA's version fence (J009) checks every
-        done record against its latest assignment's version."""
+        until it has released its scheduler lock. `tier`,
+        `weights_version`, and `tenant` ride as an optional side-band
+        (ISSUEs 11 + 12): the assignee's disaggregation tier, the
+        weight version it serves — the journal DFA's version fence
+        (J009) checks every done record against its latest
+        assignment's version — and the tenant whose quota admitted
+        the request (typed by the DFA: an ill-typed tenant is J008),
+        so a per-tenant exactly-once audit can group the journal by
+        consumer."""
         rec = {"kind": "assign", "rid": rid, "replica": replica,
                "incarnation": incarnation, "gen": gen,
-               "tier": tier, "weights_version": weights_version}
+               "tier": tier, "weights_version": weights_version,
+               "tenant": tenant}
         with self._lock:
             self._assign[rid] = (replica, incarnation, gen)
-            self._assign_meta[rid] = (tier, weights_version)
+            self._assign_meta[rid] = (tier, weights_version, tenant)
             if defer:
                 self._deferred_out += 1
                 return rec
@@ -707,6 +735,7 @@ class RequestJournal(object):
     def complete(self, rid: int, replica: str, incarnation: int,
                  gen: int, tokens: List[int],
                  weights_version: Optional[int] = None,
+                 tenant: Optional[str] = None,
                  defer: bool = False) -> Optional[dict]:
         rec = {"kind": "done", "rid": rid, "replica": replica,
                "incarnation": incarnation, "gen": gen,
@@ -715,6 +744,10 @@ class RequestJournal(object):
             # the version fence's done half: which weights produced
             # this output (must equal the latest assignment's — J009)
             rec["weights_version"] = int(weights_version)
+        if tenant is not None:
+            # the tenant side-band's done half (ISSUE 12): which
+            # consumer this verdict answered — typed by the DFA (J008)
+            rec["tenant"] = str(tenant)
         return self._terminal(rid, rec, defer)
 
     def progress(self, rid: int, replica: str, incarnation: int,
@@ -793,13 +826,14 @@ class RequestJournal(object):
             return self._assign.get(rid)
 
     def assigned_meta(self, rid: int
-                      ) -> Tuple[Optional[str], Optional[int]]:
-        """(tier, weights_version) side-band of the latest assignment
-        — (None, None) when unassigned or unversioned. Lets a
+                      ) -> Tuple[Optional[str], Optional[int],
+                                 Optional[str]]:
+        """(tier, weights_version, tenant) side-band of the latest
+        assignment — all None when unassigned or unversioned. Lets a
         completion recovered straight from journaled progress record
         the version of the holder that actually produced the tokens."""
         with self._lock:
-            return self._assign_meta.get(rid, (None, None))
+            return self._assign_meta.get(rid, (None, None, None))
 
     def progress_of(self, rid: int) -> List[int]:
         with self._lock:
@@ -931,6 +965,9 @@ class _Replica(object):
         self.engine: Optional[ServingEngine] = None  # guarded-by: replica
         self._serving: Dict[int, Any] = {}           # guarded-by: replica
         self._reported: Dict[int, int] = {}          # guarded-by: replica
+        # batch-lane (zoo) jobs waiting their turn: at most ONE runs
+        # per scheduler handshake, interleaved with engine steps
+        self._batch_q: collections.deque = collections.deque()  # guarded-by: replica
         self._pool_rev = (0, 0)                      # guarded-by: replica
         self.thread = threading.Thread(
             target=self._loop, name="fleet-%s-i%d" % (self.name, incarnation),
@@ -942,7 +979,8 @@ class _Replica(object):
 
     def _idle(self) -> bool:  # thread: replica
         e = self.engine
-        return (not self._serving and e is not None
+        return (not self._serving and not self._batch_q
+                and e is not None
                 and not e.live_slots and not e.queue_depth
                 and not e.prefilling_slots)
 
@@ -1007,19 +1045,38 @@ class _Replica(object):
                     if sh is not None:
                         self._reported.pop(rid, None)
                         self.engine.cancel(sh.rid)
+                    if self._batch_q:
+                        # a hedged-away batch job: drop our copy — the
+                        # survivor re-runs the callable (idempotent
+                        # zoo inference; the dedupe fence keeps one
+                        # verdict even if both finish)
+                        self._batch_q = collections.deque(
+                            bh for bh in self._batch_q
+                            if bh.rid != rid)
                 for h in work:
+                    if h.batch_fn is not None:
+                        # batch-lane (zoo) job: runs between engine
+                        # steps below, one per handshake
+                        self._batch_q.append(h)
+                        continue
                     try:
-                        sh = self.engine.submit(
-                            h.prompt, h.spec["max_new_tokens"],
+                        subkw = dict(
                             temperature=h.spec["temperature"],
                             eos_id=h.spec["eos_id"], seed=h.spec["seed"],
                             publish_len=h.spec["publish_len"],
                             deadline_at=h.deadline_at,
                             resume_tokens=h.resume or None)
+                        if h.spec.get("adapter") is not None:
+                            # keyword passed only when set: scripted
+                            # engines without the adapter surface keep
+                            # working (sched_explore.ScriptEngine)
+                            subkw["adapter"] = h.spec["adapter"]
+                        sh = self.engine.submit(
+                            h.prompt, h.spec["max_new_tokens"], **subkw)
                     except ValueError as exc:
                         # a malformed request must fail ITSELF, not
                         # crash-loop the replica through failover
-                        fleet._reject(h.rid, exc)
+                        fleet._reject(h.rid, exc, rep=self)
                         continue
                     self._serving[h.rid] = sh
                     self._reported[h.rid] = 0
@@ -1027,6 +1084,31 @@ class _Replica(object):
                     if hook is not None:
                         hook.yield_point("replica:%s:step" % self.name)
                     self.engine.step()
+                if self._batch_q:
+                    # ONE zoo micro-batch per handshake, after the
+                    # engine step: batch throughput rides the same
+                    # scheduler cadence as prefill chunks do, so it
+                    # can never starve the batched decode (the
+                    # Sarathi interleave rule across workload kinds)
+                    bh = self._batch_q.popleft()
+                    if bh.deadline_at is not None \
+                            and time.monotonic() >= bh.deadline_at:
+                        # the deadline died waiting behind the engine:
+                        # the expiry verdict, not a late 'done' — the
+                        # every-queue-hop rule batch jobs get too
+                        completed.append((bh.rid, [], "expired"))
+                    else:
+                        try:
+                            bh.batch_result = bh.batch_fn()
+                        except Exception as exc:
+                            # the JOB failed, not the replica: a
+                            # terminal rejected verdict for this rid
+                            # alone — fenced (rep=self), so a stale
+                            # holder's local failure cannot reject a
+                            # rid hedged to a healthy survivor
+                            fleet._reject(bh.rid, exc, rep=self)
+                        else:
+                            completed.append((bh.rid, [], "done"))
                 for rid, sh in list(self._serving.items()):
                     # batched incremental progress: every token emitted
                     # since the last handshake rides ONE journal record
@@ -1081,6 +1163,15 @@ class _Replica(object):
             out["prefix_hits"] = e.prefix_cache.hits
             out["prefix_misses"] = e.prefix_cache.misses
             out["prefix_tokens_saved"] = e.prefix_cache.tokens_saved
+        # getattr: scripted metric surfaces (sched_explore) predate it
+        ap = getattr(e.metrics, "adapter_pool", None)
+        if ap is not None:
+            # cumulative adapter-pool counters (ISSUE 12): fold into
+            # _stats_base on replica death/retire like the rest
+            out["adapter_hits"] = ap.hits
+            out["adapter_misses"] = ap.misses
+            out["adapter_evictions"] = ap.evictions
+            out["adapter_uploads"] = ap.uploads
         return out
 
 
@@ -1204,6 +1295,25 @@ class ServingFleet(object):
       weights_version      version tag of the CONSTRUCTION params
                            (default 0); roll_weights bumps it to the
                            checkpoint step it rolled to
+      tenants              a `tenancy.TenantRegistry` turns on the
+                           multi-tenant front door (ISSUE 12):
+                           submit(tenant=) becomes required, each
+                           submit is charged against the tenant's
+                           token bucket (TenantQuotaExceeded — never
+                           journaled, checked before FleetSaturated),
+                           routing goes through a weighted fair queue
+                           (one tenant's burst cannot starve
+                           another's share), assign/done journal
+                           records carry the typed tenant side-band,
+                           and submit_batch() admits model-zoo jobs
+                           into the same scheduler
+      wfq_window           dispatch-window cap for the fair queue:
+                           at most this many requests sit in replica
+                           inboxes/engines at once, the rest wait in
+                           WFQ order (None = live replicas x the
+                           engine's max_slots). Smaller = fairer
+                           under contention, larger = deeper engine
+                           queues
     """
 
     def __init__(self, params, cfg, n_replicas=2, journal_path=None,
@@ -1219,7 +1329,7 @@ class ServingFleet(object):
                  scale_up_open_per_replica=4, scale_up_headroom_s=None,
                  scale_down_idle_s=2.0, scale_cooldown_s=1.0,
                  ckpt_dir=None, rollout_policy="finish",
-                 weights_version=0):
+                 weights_version=0, tenants=None, wfq_window=None):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
         if int(max_pending) < 1:
@@ -1323,6 +1433,26 @@ class ServingFleet(object):
         # skip the per-submit O(T0) crc work entirely
         self._chain_prompts = bool(affinity) and bool(
             self._engine_kw.get("prefix_cache_tokens"))
+        # multi-tenant front door (ISSUE 12): a TenantRegistry turns
+        # on (a) token-bucket quota admission — a submit past the
+        # tenant's bucket raises TenantQuotaExceeded, never journaled,
+        # like FleetSaturated — and (b) weighted fair queueing: when
+        # every replica's dispatch window is full, requests wait in a
+        # per-fleet WFQ and drain in virtual-finish-tag order at every
+        # scheduler handshake, so one tenant's burst cannot starve
+        # another's share. `wfq_window` caps requests dispatched into
+        # replica inboxes/engines at once (None = live replicas x the
+        # engine's max_slots — enough to keep every slot fed while the
+        # excess queues fairly at the front door).
+        self._tenants = tenants
+        self._wfq: Optional[WFQueue] = (
+            WFQueue() if tenants is not None else None)
+        if wfq_window is not None and int(wfq_window) < 1:
+            raise ValueError("wfq_window must be >= 1 or None")
+        self._wfq_window = (None if wfq_window is None
+                            else int(wfq_window))
+        self._slots_per_replica = int(
+            self._engine_kw.get("max_slots") or 8)
 
         # ONE lock for all fleet scheduler state (the condition owns
         # it); replica + monitor threads mutate ONLY under it
@@ -1402,6 +1532,11 @@ class ServingFleet(object):
         # counted as submitted) but kept APART from `shed` so overload
         # and client-side lateness stay distinguishable (ISSUE 8 fix)
         self.expired_on_arrival = 0                    # guarded-by: _cond
+        # per-tenant quota shed (ISSUE 12): like `shed`, never
+        # journaled — but scoped to one tenant's bucket, so overload
+        # (FleetSaturated) and quota enforcement stay distinguishable
+        self.quota_shed = 0                            # guarded-by: _cond
+        self.batch_jobs_completed = 0                  # guarded-by: _cond
         self.resubmitted = 0                           # guarded-by: _cond
         self.failovers = 0                             # guarded-by: _cond
         self.zombie_refused = 0                        # guarded-by: _cond
@@ -1516,8 +1651,9 @@ class ServingFleet(object):
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0,
                eos_id=None, seed=0, publish_len=None,
-               slo="interactive", deadline_s=None,
-               resume_tokens=None) -> FleetHandle:
+               slo=_SLO_UNSET, deadline_s=None,
+               resume_tokens=None, tenant=None,
+               adapter=None) -> FleetHandle:
         """Journal the request durably, then route it (prefix affinity
         within the SLO class). Raises `FleetSaturated` when
         `max_pending` requests are already open — the shed request is
@@ -1537,7 +1673,20 @@ class ServingFleet(object):
         routing (durable across a second crash), prefill-aliased by
         the assignee, and never re-decoded — a prefix that already
         reached its budget or `eos_id` completes straight from the
-        journal with zero engine work."""
+        journal with zero engine work.
+
+        Multi-tenant fleets (ISSUE 12, `tenants=` set): `tenant` is
+        REQUIRED and must be registered; the submit is charged against
+        the tenant's token bucket FIRST (a spent bucket raises
+        `TenantQuotaExceeded` — never journaled, and checked before
+        the `FleetSaturated` shed so one tenant's burst is shed as ITS
+        quota verdict, not fleet overload), `adapter` defaults to the
+        tenant's registered LoRA adapter (engines need
+        `adapter_registry` in `engine_kw`), routing goes through the
+        weighted fair queue (dispatch may defer — a no-live-replica
+        failure then lands on the handle instead of raising here),
+        and the journal's assign/done records carry the typed
+        `tenant` side-band."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
             raise ValueError("empty prompt")
@@ -1572,8 +1721,31 @@ class ServingFleet(object):
                 % (need, self._pool_blocks, self.block_tokens))
         if publish_len is not None and publish_len < 0:
             raise ValueError("publish_len must be >= 0 or None")
+        if self._tenants is not None:
+            if tenant is None:
+                raise ValueError(
+                    "this fleet is multi-tenant: submit(tenant=...) "
+                    "is required (registered: %r)"
+                    % self._tenants.names())
+            t = self._tenants.get(tenant)  # KeyError on unknown
+            if adapter is None:
+                adapter = t.adapter  # the tenant's default delta
+            if slo is _SLO_UNSET:
+                slo = t.slo  # the tenant's default class
+        elif tenant is not None:
+            raise ValueError(
+                "tenant %r named but the fleet has no TenantRegistry "
+                "(pass tenants=)" % (tenant,))
+        if slo is _SLO_UNSET:
+            slo = "interactive"
         if slo is not None and slo not in self.slo_classes:
             raise ValueError("unknown SLO class %r" % slo)
+        if adapter is not None \
+                and "adapter_registry" not in self._engine_kw:
+            raise ValueError(
+                "request names adapter %r but the engines have no "
+                "adapter pool (put adapter_registry in engine_kw)"
+                % (adapter,))
         deadline_at = None
         if deadline_s is not None:
             deadline_at = time.monotonic() + float(deadline_s)
@@ -1589,6 +1761,11 @@ class ServingFleet(object):
             # remaining budget as deadline_s - (now - submit_unix)
             "deadline_s": None if deadline_s is None else float(deadline_s),
             "submit_unix": time.time(),
+            # multi-tenant side-band (ISSUE 12): the admitting tenant
+            # and the LoRA adapter the engines apply (both None on a
+            # single-tenant fleet)
+            "tenant": tenant,
+            "adapter": adapter,
         }
         with self._cond:
             if self._closing:
@@ -1603,20 +1780,15 @@ class ServingFleet(object):
                 raise DeadlineExceeded(
                     "request arrived with its deadline already spent "
                     "(deadline_s=%r)" % deadline_s)
-            if len(self._open) >= self.max_pending:
-                self.shed += 1
-                raise FleetSaturated(
-                    "fleet saturated: %d open requests (max_pending=%d)"
-                    % (len(self._open), self.max_pending))
-            rid = self._next_rid
-            self._next_rid += 1
-            h = FleetHandle(rid, prompt, spec, slo, fleet=self,
-                            deadline_at=deadline_at)
+            h = self._admit_open_locked(tenant, prompt, spec, slo,
+                                        deadline_at)
+            rid = h.rid
+            # WFQ service estimate: the request's token footprint, so
+            # a tenant's fair share is proportional to TOKENS of work,
+            # not request count
+            h.cost = float(prompt.shape[0] + int(max_new_tokens))
             if self._chain_prompts:  # keys feed ONLY affinity routing
                 h.chain = chain_keys(prompt, self.block_tokens)
-            self._handles[rid] = h
-            self._open.add(rid)
-            self.submitted += 1
         # durable BEFORE routing — and OUTSIDE the fleet lock, so the
         # journal's write+flush never stalls replica handshakes or the
         # monitor behind disk latency
@@ -1651,12 +1823,166 @@ class ServingFleet(object):
                     h.emitted = len(resume)
                     self.resumed_requests += 1
                     self.resumed_tokens += len(resume)
-                self._route(h, exclude=None)
+                if self._wfq is not None:
+                    # multi-tenant routing goes through the weighted
+                    # fair queue: dispatch now if a replica window is
+                    # open, else wait in virtual-finish-tag order
+                    self._wfq.push(h.tenant,
+                                   self._tenants.get(h.tenant).weight,
+                                   h.cost, h)
+                    self._dispatch_locked()
+                else:
+                    self._route(h, exclude=None)
         finally:
             # also on the raises above: the terminal reject record
             # must be on disk before the caller sees the error
             self._flush_journal()
         return h
+
+    def _admit_open_locked(self, tenant, prompt, spec, slo,
+                           deadline_at) -> FleetHandle:
+        """Shared admission core of submit()/submit_batch() (caller
+        holds `_cond`): the ORDER-SENSITIVE quota invariant lives here
+        ONCE — quota CHECKED before the fleet-wide saturation shed (a
+        bursting tenant is refused on ITS quota, TenantQuotaExceeded,
+        like FleetSaturated never journaled — overload metrics and
+        per-tenant enforcement cannot blur), but CONSUMED only after
+        it (a saturation-shed request must not drain the bucket or
+        count as submitted) — then rid allocation and handle
+        registration."""
+        if self._closing:
+            raise RuntimeError("fleet is closed")
+        if self._tenants is not None and tenant is not None:
+            try:
+                self._tenants.check_quota(tenant)
+            except TenantQuotaExceeded:
+                self.quota_shed += 1
+                raise
+        if len(self._open) >= self.max_pending:
+            self.shed += 1
+            raise FleetSaturated(
+                "fleet saturated: %d open requests (max_pending=%d)"
+                % (len(self._open), self.max_pending))
+        if self._tenants is not None and tenant is not None:
+            self._tenants.consume(tenant)
+        rid = self._next_rid
+        self._next_rid += 1
+        h = FleetHandle(rid, prompt, spec, slo, fleet=self,
+                        deadline_at=deadline_at)
+        h.tenant = tenant
+        self._handles[rid] = h
+        self._open.add(rid)
+        self.submitted += 1
+        return h
+
+    def submit_batch(self, fn, tenant: str, cost: float = 1.0,
+                     description: str = "batch", deadline_s=None,
+                     slo=_SLO_UNSET) -> FleetHandle:
+        """Admit one BATCH-LANE job (ISSUE 12): a host callable — e.g.
+        one image/CTR model-zoo micro-batch through the existing
+        `fluid.Executor` path (`tenancy.executor_batch_fn`) — that
+        shares the continuous-batching scheduler with LM work. The job
+        rides the SAME admission as every request: the tenant's quota
+        bucket (TenantQuotaExceeded, never journaled), the weighted
+        fair queue (`cost` is its service estimate in the same token
+        currency as LM requests), the journal (assign/done with the
+        typed tenant side-band; the spec records kind="batch" — a
+        restarted front door recovers the rid but cannot rebuild the
+        callable, so batch jobs recovered from a journal are for the
+        CALLER to resubmit), and failover (a replica dying mid-lane
+        resubmits the job to a survivor; a job hedged away from a
+        demoted replica may execute twice — zoo inference is
+        idempotent, the dedupe fence keeps exactly one verdict). A
+        replica runs at most ONE batch job per scheduler handshake,
+        interleaved with its engine's decode steps, so zoo throughput
+        never starves decode latency. The result lands on
+        `handle.batch_result`; `handle.result()` returns an empty
+        token array once done."""
+        if self._tenants is None:
+            raise ValueError(
+                "submit_batch needs a multi-tenant fleet (tenants=)")
+        if not callable(fn):
+            raise ValueError("submit_batch needs a callable job")
+        t = self._tenants.get(tenant)
+        if slo is _SLO_UNSET:
+            # same sentinel as submit(): the tenant default applies
+            # only when the caller said NOTHING — an explicit slo=None
+            # stays the any-replica wildcard
+            slo = t.slo
+        if slo is not None and slo not in self.slo_classes:
+            raise ValueError("unknown SLO class %r" % slo)
+        deadline_at = None
+        if deadline_s is not None:
+            deadline_at = time.monotonic() + float(deadline_s)
+        spec = {
+            "kind": "batch", "description": str(description),
+            "max_new_tokens": 0, "temperature": 0.0, "eos_id": None,
+            "seed": 0, "publish_len": None, "slo": slo,
+            "deadline_s": (None if deadline_s is None
+                           else float(deadline_s)),
+            "submit_unix": time.time(),
+            "tenant": tenant, "adapter": None,
+        }
+        with self._cond:
+            h = self._admit_open_locked(
+                tenant, np.zeros(0, np.int32), spec, slo, deadline_at)
+            rid = h.rid
+            h.cost = float(cost)
+            h.batch_fn = fn
+        self._journal.submit(rid, spec)
+        if self._hook is not None:
+            self._hook.yield_point("submit:commit")
+        try:
+            with self._cond:
+                if self._closing:
+                    self._reject_locked(rid, "fleet closed")
+                    raise RuntimeError("fleet is closed")
+                self._wfq.push(tenant, t.weight, h.cost, h)
+                self._dispatch_locked()
+        finally:
+            self._flush_journal()
+        return h
+
+    def _dispatch_locked(self):
+        """Drain the weighted fair queue into replica inboxes while
+        the dispatch window has room (caller holds `_cond`). Called at
+        submit and at every replica handshake / monitor sweep, so a
+        completion's freed capacity admits the smallest-finish-tag
+        request next — the fairness decision point. Entries whose rid
+        already went terminal (a close() sweep) are skipped; a
+        deadline that died queueing gets its expired verdict HERE,
+        before any replica spends anything on it."""
+        if self._wfq is None or not self._wfq:
+            return
+        live = sum(1 for s in self._state if s == _LIVE)
+        limit = (self._wfq_window if self._wfq_window is not None
+                 else max(1, live) * self._slots_per_replica)
+        now = time.monotonic()
+        # deadline sweep over WAITING entries first: with the window
+        # full the pop loop below never runs, and a deadline that died
+        # queueing must still get its verdict at this hop (the PR-8
+        # every-queue-hop rule) — never a silent FleetTimeout. The
+        # handle stays in the heap; the pop-time done-check skips it.
+        for h in self._wfq.entries():
+            if not h.done and h.rid not in self._done_rids \
+                    and h.deadline_at is not None \
+                    and now >= h.deadline_at:
+                self._expire_locked(h)
+        while self._wfq:
+            out = sum(len(self._inbox[i]) + len(self._in_flight[i])
+                      for i in range(self.max_replicas))
+            if out >= limit:
+                break
+            h = self._wfq.pop()
+            if h.done or h.rid in self._done_rids:
+                continue  # went terminal while queued (close/reject)
+            if h.deadline_at is not None and now >= h.deadline_at:
+                self._expire_locked(h)
+                continue
+            try:
+                self._route(h, exclude=None)
+            except EngineFailed:
+                pass  # no live replica: _route already failed it
 
     def _route(self, h: FleetHandle, exclude: Optional[int]):
         """Pick a replica for `h` (caller holds `_cond`): longest
@@ -1731,7 +2057,7 @@ class ServingFleet(object):
         self._pending_journal.append(self._journal.assign(
             h.rid, rep.name, rep.incarnation, h.generation,
             tier=rep.tier, weights_version=rep.weights_version,
-            defer=True))
+            tenant=h.tenant, defer=True))
         self._cond.notify_all()
 
     def _flush_journal(self):
@@ -1784,6 +2110,9 @@ class ServingFleet(object):
         for fl in self._in_flight:
             fl.pop(rid, None)
         self.rejected += 1
+        if h is not None and h.tenant is not None \
+                and self._tenants is not None:
+            self._tenants.on_reject(h.tenant)
         self._pending_journal.append(self._journal.reject(
             rid, reason, defer=True))
         if h is not None and not h.done:
@@ -1793,15 +2122,27 @@ class ServingFleet(object):
                 self._pending_events.append(h)
         return h
 
-    def _reject(self, rid: int, exc: Exception):
-        """A single malformed request failed engine admission: fail it
-        alone (called from replica threads), with a TERMINAL journal
-        record — an unservable request must not stay open forever and
-        be resubmitted by every future recover()."""
+    def _reject(self, rid: int, exc: Exception, rep=None):
+        """A single malformed request failed engine admission, or a
+        batch-lane job raised: fail it alone (called from replica
+        threads), with a TERMINAL journal record — an unservable
+        request must not stay open forever and be resubmitted by every
+        future recover(). `rep` (the reporting replica) arms the SAME
+        journal-lease fence completions get in `_accept`: a demoted/
+        superseded holder whose local copy fails must not terminally
+        reject a rid a healthy survivor is re-running — its report is
+        refused (zombie_refused) and the survivor's verdict stands."""
         with self._cond:
             h = self._handles.get(rid)
             if h is None or h.done:
                 return
+            if rep is not None and not h._probe:
+                a = self._journal.assigned_to(rid)
+                if a is None or a[0] != rep.name \
+                        or a[1] != rep.incarnation \
+                        or rid not in self._in_flight[rep.index]:
+                    self.zombie_refused += 1
+                    return
             if h._probe:
                 # a probe that failed engine ADMISSION is a failed
                 # probe, not a rejected request: journaling its
@@ -1870,6 +2211,11 @@ class ServingFleet(object):
                 return "stop", [], [], False
             if summary is not None:
                 self._summaries[i] = summary
+            if self._wfq is not None:
+                # the completions judged above freed dispatch-window
+                # capacity: admit the smallest-finish-tag WFQ entries
+                # now — every handshake is a fairness decision point
+                self._dispatch_locked()
             if self._kill[i]:
                 self._kill[i] = False
                 raise _KillDrill("replica %s killed by drill" % rep.name)
@@ -2056,10 +2402,21 @@ class ServingFleet(object):
         self._handles.pop(rid, None)
         self._pending_journal.append(self._journal.complete(
             rid, rep.name, rep.incarnation, h.generation, full,
-            weights_version=rep.weights_version, defer=True))
+            weights_version=rep.weights_version, tenant=h.tenant,
+            defer=True))
         h.tokens = full
         h.replica = rep.name
         h.weights_version = rep.weights_version
+        if h.tenant is not None and self._tenants is not None:
+            # per-tenant O(1) accounting (ISSUE 12): completion,
+            # tokens served, and the latency the tenant actually saw
+            self._tenants.on_complete(
+                h.tenant, len(full),
+                queue_wait_s=(h.ttft_s if h.ttft_s is not None
+                              else time.monotonic() - h._submit_t),
+                batch=h.batch_fn is not None)
+            if h.batch_fn is not None:
+                self.batch_jobs_completed += 1
         # the event fires in _flush_journal, AFTER the done record is
         # on disk — result() observers get read-your-writes recovery
         self._pending_events.append(h)
@@ -2088,6 +2445,8 @@ class ServingFleet(object):
         for fl in self._in_flight:
             fl.pop(rid, None)
         self.expired += 1
+        if h.tenant is not None and self._tenants is not None:
+            self._tenants.on_expire(h.tenant)
         self._pending_journal.append(self._journal.expire(
             rid, toks, defer=True))
         self._pending_events.append(h)
@@ -2180,14 +2539,20 @@ class ServingFleet(object):
         self._handles.pop(rid, None)
         # the version of the holder that actually produced the tokens
         # (read BEFORE complete() prunes the assignment side-band)
-        _tier, wv = self._journal.assigned_meta(rid)
+        _tier, wv, _ten = self._journal.assigned_meta(rid)
         self._pending_journal.append(self._journal.complete(
             rid, replica, incarnation, h.generation, list(toks),
-            weights_version=wv, defer=True))
+            weights_version=wv, tenant=h.tenant, defer=True))
         h.tokens = list(toks)
         h.emitted = len(toks)
         h.replica = replica
         h.weights_version = wv
+        if h.tenant is not None and self._tenants is not None:
+            self._tenants.on_complete(
+                h.tenant, len(toks),
+                queue_wait_s=(h.ttft_s if h.ttft_s is not None
+                              else time.monotonic() - h._submit_t),
+                batch=h.batch_fn is not None)
         self._pending_events.append(h)
         self.completed += 1
 
@@ -2260,6 +2625,10 @@ class ServingFleet(object):
                     self._health_sweep(now)
                 if self.min_replicas < self.max_replicas:
                     self._scale_sweep(now)
+                if self._wfq is not None:
+                    # an all-idle fleet must still drain the fair
+                    # queue (deaths/refills change the window too)
+                    self._dispatch_locked()
             self._flush_journal()  # fail-over resubmissions above
             time.sleep(self._monitor_interval_s)
 
@@ -3007,6 +3376,10 @@ class ServingFleet(object):
             cow = base.get("cow_blocks", 0)
             spec_drafted = base.get("spec_drafted", 0)
             spec_accepted = base.get("spec_accepted", 0)
+            ad_hits = base.get("adapter_hits", 0)
+            ad_misses = base.get("adapter_misses", 0)
+            ad_evictions = base.get("adapter_evictions", 0)
+            ad_uploads = base.get("adapter_uploads", 0)
             reps = []
             for i, rep in enumerate(self._replicas):
                 st = self._rep_stats[i] or {}
@@ -3020,6 +3393,10 @@ class ServingFleet(object):
                 cow += st.get("cow_blocks", 0)
                 spec_drafted += st.get("spec_drafted", 0)
                 spec_accepted += st.get("spec_accepted", 0)
+                ad_hits += st.get("adapter_hits", 0)
+                ad_misses += st.get("adapter_misses", 0)
+                ad_evictions += st.get("adapter_evictions", 0)
+                ad_uploads += st.get("adapter_uploads", 0)
                 reps.append({
                     "name": rep.name, "slo": rep.slo,
                     "tier": rep.tier,
@@ -3039,6 +3416,9 @@ class ServingFleet(object):
                 "rejected": self.rejected,
                 "expired": self.expired,
                 "expired_on_arrival": self.expired_on_arrival,
+                "quota_shed": self.quota_shed,
+                "batch_jobs_completed": self.batch_jobs_completed,
+                "wfq_depth": 0 if self._wfq is None else len(self._wfq),
                 "resubmitted": self.resubmitted,
                 "failovers": self.failovers,
                 "zombie_refused": self.zombie_refused,
@@ -3072,6 +3452,14 @@ class ServingFleet(object):
                 "spec_accepted": spec_accepted,
                 "spec_accept_rate": round(spec_accepted / spec_drafted, 4)
                 if spec_drafted else None,
+                "adapter_hits": ad_hits,
+                "adapter_misses": ad_misses,
+                "adapter_evictions": ad_evictions,
+                "adapter_uploads": ad_uploads,
+                # per-tenant O(1) metrics (ISSUE 12): quota buckets,
+                # shed counts, completions, tokens served per tenant
+                "tenants": (None if self._tenants is None
+                            else self._tenants.snapshot()),
                 "replicas": reps,
             }
 
@@ -3094,6 +3482,11 @@ class ServingFleet(object):
                 if h is not None and not h.done:
                     h._event.set()  # waiters must not block on a dead fleet
             self._open.clear()
+            if self._wfq is not None:
+                # queued-but-undispatched entries: their rids were in
+                # _open, so the sweep above already rejected them —
+                # drop the stale heap entries
+                self._wfq.clear()
             for i, ph in enumerate(self._probes):
                 if ph is not None:  # outstanding probes die unjournaled
                     self._handles.pop(ph.rid, None)

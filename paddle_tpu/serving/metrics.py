@@ -52,6 +52,10 @@ class ServingMetrics(object):
         self.band_uploads = 0
         self.prefix_hit_tokens = _RunningStat()  # cached tokens/admission
         self.prefix_cache = None  # set by the engine when reuse is on
+        # PR 12: set by the engine when the paged LoRA adapter pool is
+        # on — report() surfaces its O(1) hit/miss/eviction/upload
+        # counters (serving/adapters.py)
+        self.adapter_pool = None
         # PR 7 counters — paged KV block pool + speculative decoding,
         # same O(1) discipline. Gauges (set by the engine each step or
         # scheduler event) vs cumulative ints are marked below.
@@ -167,6 +171,8 @@ class ServingMetrics(object):
         }
         if self.prefix_cache is not None:
             rep["prefix_cache"] = self.prefix_cache.stats()
+        if self.adapter_pool is not None:
+            rep["adapter_pool"] = self.adapter_pool.stats()
         return rep
 
     def table(self, sorted_key="total"):

@@ -527,6 +527,43 @@ def test_serving_elastic_workload_contract():
     assert rec["resumed_requests"] >= 1, rec
 
 
+def test_serving_multitenant_workload_contract():
+    """ISSUE 12 acceptance: the `serving_multitenant` row cannot
+    decay into a no-op — on the fixed-seed 3-tenant Poisson mix with
+    one tenant bursting past its quota, the well-behaved
+    deadline-class tenants record ZERO deadline misses, the burst is
+    shed via TenantQuotaExceeded and never FleetSaturated (and the
+    bench checks the journal holds exactly the accepted submits — a
+    shed is never journaled), the 3-adapter-through-2-slot pool
+    LRU-pages (>= 1 eviction), the zoo batch lane's Executor results
+    match the direct run, and every tenant's outputs are
+    token-identical to its per-tenant sequential run (all of these
+    hard-raise in-bench; the assertions here pin the row's shape)."""
+    rec = bench.bench_serving_multitenant(n_requests=6)
+    assert rec["deadline_misses_well_behaved"] == 0, rec
+    assert rec["requests_lost"] == 0, rec
+    assert rec["quota_shed"] == 4, rec
+    assert rec["hog_admitted"] == 2, rec
+    assert rec["fleet_saturated_shed"] == 0, rec
+    assert rec["adapter_evictions"] >= 1, rec
+    assert rec["batch_jobs_completed"] == 3, rec
+    assert rec["outputs_identical_per_tenant"], rec
+    assert rec["zoo_results_match_executor"], rec
+    # every tenant shows up in the per-tenant O(1) metrics
+    assert set(rec["per_tenant"]) == {"alpha", "beta", "gamma",
+                                      "hog", "zoo"}, rec
+    assert rec["per_tenant"]["zoo"]["completed"] == 3, rec
+
+
+def test_serving_multitenant_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_multitenant", bench_serving_multitenant' in src
+
+
 def test_serving_elastic_registered_in_bench_main():
     """The workload is wired into bench.main()'s side-workload list
     (the registration is what lands it in the driver's record)."""

@@ -306,6 +306,49 @@ def test_side_band_ill_typed_is_j008(tmp_path):
     assert all("ill-typed" in d.detail for d in diags)
 
 
+def test_tenant_sideband_typed_clean(tmp_path):
+    # ISSUE 12: the tenant side-band on assign/done is OPTIONAL and
+    # nullable — present-and-well-typed (or absent, or null) verifies
+    # clean, including across a reassignment that changes nothing
+    p = _journal(tmp_path, "tenant_ok.jsonl", [
+        _submit(0),
+        dict(_assign(0), tenant="acme", tier="prefill",
+             weights_version=1),
+        _progress(0, [4]),
+        dict(_done(0, [4]), tenant="acme", weights_version=1),
+        _submit(1), dict(_assign(1), tenant=None),  # single-tenant
+        _progress(1, [5]), _done(1, [5]),
+        _submit(2), _assign(2),                     # pre-ISSUE-12 form
+        _progress(2, [6]), _done(2, [6]),
+    ])
+    assert verify_journal(p, expect_closed=True) == []
+
+
+def test_tenant_sideband_ill_typed_is_j008(tmp_path):
+    # an ill-typed tenant silently breaks the per-tenant exactly-once
+    # grouping — J008 on either record kind, never a TypeError
+    p = _journal(tmp_path, "tenant_bad.jsonl", [
+        _submit(0),
+        dict(_assign(0), tenant=7),
+        dict(_done(0, []), tenant=["acme"]),
+    ])
+    diags = verify_journal(p)
+    assert _codes(diags) == ["J008", "J008"]
+    assert all("ill-typed:tenant" in d.detail for d in diags)
+
+
+def test_explorer_tenant_fairness_smoke_clean(tmp_path):
+    # tier-1 smoke over the ISSUE 12 fairness scenario: a tenant
+    # burst racing a 4x-weight SLA tenant through the WFQ dispatch
+    # hop with a mid-burst kill — the standard probes (oracle token
+    # identity, lost == 0, journal DFA green incl. the typed tenant
+    # side-band) plus the scenario's per-tenant accounting check
+    report = explore(SCENARIOS["tenant_fairness"], str(tmp_path),
+                     max_preemptions=1, max_schedules=6)
+    assert report.ok, (report.violation
+                       and report.violation.violations)
+
+
 def test_torn_final_line_tolerated(tmp_path):
     # the crash the journal exists to survive must not fail the audit
     p = _journal(tmp_path, "torn.jsonl",

@@ -28,17 +28,21 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 python -m paddle_tpu.analysis --all "$@"
 
-# protocol gate (ISSUE 9 + 11): explore the tier-1 fleet scenarios —
-# the PR-6 kill drill plus the elastic transitions (scale-up
+# protocol gate (ISSUE 9 + 11 + 12): explore the tier-1 fleet
+# scenarios — the PR-6 kill drill, the elastic transitions (scale-up
 # mid-burst, drain-retire racing a completion, rollout swap racing a
-# migration) — keep their per-schedule journals, and replay EACH
-# through the journal verifier: a new J-code here (including the J009
-# version fence) fails the gate exactly like a new lint finding
+# migration), and the multi-tenant fairness race (a tenant burst vs a
+# weighted SLA tenant through the WFQ dispatch hop, with a mid-burst
+# kill) — keep their per-schedule journals, and replay EACH through
+# the journal verifier: a new J-code here (including the J009 version
+# fence and the typed tenant side-band) fails the gate exactly like a
+# new lint finding
 jdir="$(mktemp -d)"
 trap 'rm -rf "$jdir"' EXIT
 python -m paddle_tpu.analysis explore --scenario submit_kill \
     --max-schedules 6 --journal-dir "$jdir"
-for sc in scale_up_mid_burst drain_retire_race rollout_migration; do
+for sc in scale_up_mid_burst drain_retire_race rollout_migration \
+        tenant_fairness; do
     python -m paddle_tpu.analysis explore --scenario "$sc" \
         --max-schedules 4 --journal-dir "$jdir"
 done
