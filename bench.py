@@ -47,6 +47,12 @@ definitions):
               must beat slab at equal budget), speculative
               accept-rate, and tok/s per mode; outputs must be
               token-identical across all three runs
+  serving_paged_kernel — fused paged-attention kernel acceptance
+              (ISSUE 13): the same fixed-seed shared-header trace with
+              paged_kernel="gather" vs "fused" (Pallas table-walk, no
+              materialised view) across aliasing/COW/chunking/spec;
+              hard-raises on any output divergence or any _paged_view
+              gather in the fused run; tokens/s contrast on-chip-only
   serving_fleet — fault-tolerant fleet acceptance (ISSUE 6): the same
               fixed-seed shared-header Poisson trace through a
               single replica, an N=3 fleet with prefix-affinity
@@ -1328,6 +1334,183 @@ def bench_serving_paged(n_requests=None, max_slots=None, dim=None,
             eng_spec.metrics.trace_counts.get("spec_verify", 0),
         "n_requests": n_requests,
         "arrival": "poisson(rate=%g/step, seed=0)" % rate,
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
+def bench_serving_paged_kernel(n_requests=None, max_slots=None, dim=None,
+                               heads=None, layers_n=None, vocab=None,
+                               max_len=None, block_tokens=None,
+                               chunk_tokens=None, cache_tokens=None,
+                               spec_draft_len=None):
+    """Fused paged-attention kernel acceptance trace (ISSUE 13): the
+    SAME fixed-seed Poisson shared-header trace runs twice — once with
+    `paged_kernel="gather"` (the XLA `_paged_view` form: a transient
+    gathered view [S, MAXB*Bt, H, Dh] per layer per step) and once
+    with `paged_kernel="fused"` (parallel/paged_attention.py: Pallas
+    kernels that walk the block table inside the kernel) — through the
+    full reuse surface: prefix aliasing + publish boundaries, chunked
+    prefill, copy-on-write, and self-drafting speculative decoding.
+
+    Hard raises (the acceptance gates, armed in-bench so they survive
+    -O): any greedy output divergence between the runs; any
+    `_paged_view` call observed DURING the fused run (counted via a
+    wrapper — the fused steps must attend through the table, zero
+    gathers); decode and spec-verify not traced exactly once per
+    engine.
+
+    CPU columns (deterministic offline): step/trace counts, prefill
+    tokens, accept rate, the zero-gather count. tokens/s both ways is
+    reported but ON-CHIP-PENDING: on CPU the fused kernel runs
+    INTERPRETED (resolve_interpret), so the wall-clock contrast is
+    meaningless until the kernel compiles to Mosaic on a v5e — the
+    measurement slot is reserved in PERF.md's PR 13 section."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import ServingEngine
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape: both engines compile + drain in seconds
+        dim, heads, layers_n = dim or 64, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 256, max_len or 96
+        n_requests = n_requests or 8
+        max_slots = max_slots or 4
+        block_tokens = block_tokens or 8
+        chunk_tokens = chunk_tokens or 16
+        cache_tokens = cache_tokens or 256
+        spec_draft_len = spec_draft_len or 4
+        header_len, t_lo, t_hi, n_lo, n_hi, rate = 12, 2, 10, 5, 12, 2.0
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_requests = n_requests or 64
+        max_slots = max_slots or 32
+        block_tokens = block_tokens or 16
+        chunk_tokens = chunk_tokens or 128
+        cache_tokens = cache_tokens or 8192
+        spec_draft_len = spec_draft_len or 4
+        header_len, t_lo, t_hi, n_lo, n_hi, rate = 128, 32, 128, 32, 96, 2.0
+        dtype = jnp.bfloat16
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    header = rng.randint(0, vocab, header_len).astype(np.int32)
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    reqs = [
+        (
+            np.concatenate([header, rng.randint(
+                0, vocab, int(rng.randint(t_lo, t_hi + 1))
+            ).astype(np.int32)]),
+            int(rng.randint(n_lo, n_hi + 1)),
+        )
+        for _ in range(n_requests)
+    ]
+
+    def run_once(pk, spec):
+        eng = ServingEngine(
+            params, cfg, max_slots=max_slots,
+            kv_block_tokens=block_tokens,
+            prefill_chunk_tokens=chunk_tokens,
+            prefix_cache_tokens=cache_tokens,
+            spec_draft_len=spec, paged_kernel=pk)
+        hs = []
+        t0 = time.time()
+        i = step = 0
+        while i < n_requests or eng.live_slots or eng.queue_depth \
+                or eng.prefilling_slots:
+            while i < n_requests and arrive_at[i] <= step:
+                p, n = reqs[i]
+                hs.append(eng.submit(p, n, publish_len=header_len))
+                i += 1
+            if not eng.step() and i < n_requests:
+                step = max(step + 1, int(arrive_at[i]))  # idle gap: jump
+                continue
+            step += 1
+        wall = time.time() - t0
+        return eng, wall, [list(h.tokens) for h in hs]
+
+    # two pairs: plain decode (the decode kernel) and speculative
+    # (the verify kernel) — spec replaces the plain decode step
+    # entirely, so one engine can never trace both
+    eng_g, wall_g, out_g = run_once("gather", None)
+    eng_gs, _, out_gs = run_once("gather", spec_draft_len)
+
+    # count every _paged_view gather the fused runs perform — the
+    # fused steps must attend THROUGH the table, so this must be 0
+    views = {"n": 0}
+    orig_view = tlm._paged_view
+
+    def _counting_view(*a, **kw):
+        views["n"] += 1
+        return orig_view(*a, **kw)
+
+    tlm._paged_view = _counting_view
+    try:
+        eng_f, wall_f, out_f = run_once("fused", None)
+        eng_fs, wall_fs, out_fs = run_once("fused", spec_draft_len)
+    finally:
+        tlm._paged_view = orig_view
+
+    # the acceptance gates — hard raises, not asserts (must survive -O)
+    if out_f != out_g or out_fs != out_g or out_gs != out_g:
+        raise RuntimeError(
+            "fused paged kernel changed greedy outputs vs gather")
+    if views["n"]:
+        raise RuntimeError(
+            "fused run materialised %d _paged_view gathers (must be 0)"
+            % views["n"])
+    rep_g, rep_f = eng_g.metrics.report(), eng_f.metrics.report()
+    rep_fs = eng_fs.metrics.report()
+    for eng, pk in ((eng_g, "gather"), (eng_f, "fused")):
+        if eng.metrics.report()["decode_traces"] != 1:
+            raise RuntimeError(
+                "%s run broke the one-compiled-step discipline: %r"
+                % (pk, eng.metrics.trace_counts))
+    for eng, pk in ((eng_gs, "gather+spec"), (eng_fs, "fused+spec")):
+        if eng.metrics.trace_counts.get("spec_verify", 0) != 1:
+            raise RuntimeError(
+                "%s run broke the one-compiled-step discipline: %r"
+                % (pk, eng.metrics.trace_counts))
+    toks = rep_f["tokens_out"]
+    return {
+        "paged_view_calls_fused": views["n"],  # the gather-tax gate: 0
+        "decode_steps_gather": rep_g["decode_steps"],
+        "decode_steps_fused": rep_f["decode_steps"],
+        "decode_traces_fused": rep_f["decode_traces"],
+        "spec_verify_traces_fused":
+            eng_fs.metrics.trace_counts.get("spec_verify", 0),
+        "decode_steps_fused_spec": rep_fs["decode_steps"],
+        "prefill_traces_fused": rep_f["prefill_traces"],
+        "prefill_tokens_computed": rep_f["prefill_tokens_computed"],
+        "spec_accept_rate_fused": rep_fs["spec_accept_rate"],
+        "cow_blocks_fused": rep_f["cow_blocks"],
+        "tokens_out": toks,
+        # on-chip-pending on CPU: the fused kernel runs interpreted
+        # here — only the compiled Mosaic contrast means anything
+        # (PERF.md PR 13 reserves the v5e slot)
+        "tokens_per_sec_gather": round(toks / wall_g, 1),
+        "tokens_per_sec_fused": round(toks / wall_f, 1),
+        "tokens_per_sec_fused_spec": round(toks / wall_fs, 1),
+        "tokens_per_sec_note": "on-chip-pending (fused is interpreted "
+                               "on CPU)" if cpu else "compiled",
+        "paged_kernel_gather": rep_g["paged_kernel"],
+        "paged_kernel_fused": rep_f["paged_kernel"],
+        "n_requests": n_requests,
+        "arrival": "poisson(rate=%g/step, seed=0)" % rate,
+        "knobs": {"kv_block_tokens": block_tokens,
+                  "prefill_chunk_tokens": chunk_tokens,
+                  "prefix_cache_tokens": cache_tokens,
+                  "spec_draft_len": spec_draft_len,
+                  "max_slots": max_slots},
         "model": {"dim": dim, "heads": heads, "layers": layers_n,
                   "vocab": vocab, "max_len": max_len},
     }
@@ -3112,6 +3295,12 @@ def main():
         # slots, accept-rate, and output identity are deterministic
         # offline; the tokens/s contrast awaits an on-chip window
         run("serving_paged", bench_serving_paged)
+        # fused paged-attention kernel (ISSUE 13): the same fixed-seed
+        # shared-header trace gather vs fused — output identity, zero
+        # _paged_view gathers, and the one-compiled-step discipline
+        # are deterministic offline; the tokens/s contrast is only
+        # meaningful compiled to Mosaic on-chip
+        run("serving_paged_kernel", bench_serving_paged_kernel)
         # serving fleet (ISSUE 6): N replicas + kill drill on the same
         # fixed-seed shared-header trace — requests lost / duplicates /
         # failovers and the affinity-routing reuse contrast are

@@ -61,6 +61,9 @@ __all__ = ["lint_file", "lint_paths", "HOT_PATHS"]
 # hot path in its own right.
 HOT_PATHS = [
     "paddle_tpu/models/transformer.py",
+    # the fused paged-attention kernels (ISSUE 13): everything in the
+    # module body runs at trace time inside the compiled serving steps
+    "paddle_tpu/parallel/paged_attention.py",
     "paddle_tpu/serving/engine.py",
     "paddle_tpu/serving/fleet.py",
     # multi-tenant front door + adapter paging (ISSUE 12): host-side

@@ -438,10 +438,28 @@ def prefill_chunk(params, cache, chunk, start_pos, slot, cfg: TransformerConfig,
 # PagedAttention (Kwon et al., SOSP '23) in static-shape JAX idiom. HBM
 # residency scales with blocks actually written, not MAX_SLOTS*max_len;
 # prefix reuse becomes table aliasing (two slots naming the same
-# physical block) instead of device copies. The gathered per-slot view
-# these primitives attend over is TRANSIENT activation scratch (freed
-# after the step), unlike the slab, which was resident between steps.
+# physical block) instead of device copies.
+#
+# Each primitive takes kernel="gather"|"fused" (ISSUE 13):
+#   gather — attend over a contiguous per-slot view `_paged_view`
+#            materialises as TRANSIENT activation scratch
+#            [S, MAXB*Bt, H, Dh] per layer (freed after the step, but
+#            an HBM write+read of the whole gathered context per step);
+#   fused  — the Pallas kernels in parallel/paged_attention.py walk
+#            the block table INSIDE the kernel (scalar-prefetch index
+#            maps), streaming K/V blocks from the pool with online
+#            softmax — no view ever exists. Fused-vs-gather logits
+#            agree to float tolerance (online softmax reorders the
+#            reduction), token-identically in greedy decode — the same
+#            low-bit class as the padded-prefill drift (PR 2).
 # ---------------------------------------------------------------------
+
+
+def _paged_kernel_check(kernel: str):
+    if kernel not in ("gather", "fused"):
+        raise ValueError(
+            "paged kernel must be 'gather' or 'fused' (got %r)"
+            % (kernel,))
 
 
 def init_paged_kv_cache(cfg: TransformerConfig, num_blocks: int,
@@ -537,19 +555,22 @@ def _adapter_qv(h, blk, li, adapters, idx):
 
 def paged_decode_step(params, token, pos, tables, cache,
                       cfg: TransformerConfig, adapters=None,
-                      adapter_idx=None):
+                      adapter_idx=None, kernel="gather"):
     """One decode step over the paged pool: token [S] at per-row
     positions `pos` [S], block tables [S, MAXB] -> (logits [S, vocab],
     updated cache). Mirrors decode_step's numerics verbatim
     (_cached_attention's divide-after-matmul/-inf mask) on the gathered
-    per-slot view, so a paged engine row decodes to the same tokens the
-    slab engine (and sequential generate()) produces. A parked row
-    (pos >= MAXB*Bt) writes nothing; its logits are garbage nothing
-    reads. With `adapters`/`adapter_idx` [S], each slot's q/v
-    projections gain its tenant's LoRA delta gathered from the stacked
-    adapter pool (ISSUE 12 — index 0 is the zero adapter, exact
-    no-op); the adapter gather is INSIDE this one compiled step, so N
-    tenants retrace nothing."""
+    per-slot view — or, with kernel="fused", attends through the block
+    table inside the Pallas kernel (parallel/paged_attention.py: same
+    scaling family, online softmax, no materialised view) — so a paged
+    engine row decodes to the same tokens the slab engine (and
+    sequential generate()) produces. A parked row (pos >= MAXB*Bt)
+    writes nothing; its logits are garbage nothing reads. With
+    `adapters`/`adapter_idx` [S], each slot's q/v projections gain its
+    tenant's LoRA delta gathered from the stacked adapter pool (ISSUE
+    12 — index 0 is the zero adapter, exact no-op); the adapter gather
+    is INSIDE this one compiled step, so N tenants retrace nothing."""
+    _paged_kernel_check(kernel)
     B = token.shape[0]
     dh = cfg.dim // cfg.heads
     NB, Bt = cache[0]["k"].shape[0], cache[0]["k"].shape[1]
@@ -565,9 +586,15 @@ def paged_decode_step(params, token, pos, tables, cache,
         ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
         cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
         new_cache.append({"k": ck, "v": cv})
-        o = _cached_attention(
-            q, _paged_view(ck, tables), _paged_view(cv, tables), pos
-        ).reshape(B, cfg.dim)
+        if kernel == "fused":
+            from ..parallel.paged_attention import paged_decode_attention
+
+            o = paged_decode_attention(q, ck, cv, tables, pos).reshape(
+                B, cfg.dim)
+        else:
+            o = _cached_attention(
+                q, _paged_view(ck, tables), _paged_view(cv, tables), pos
+            ).reshape(B, cfg.dim)
         x = x + o @ blk["wo"]
         h = _ln(x, blk["ln2"])
         if "moe" in blk:
@@ -585,19 +612,23 @@ def paged_decode_step(params, token, pos, tables, cache,
 
 def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
                         cfg: TransformerConfig, true_len=None,
-                        adapters=None, adapter_idx=None):
+                        adapters=None, adapter_idx=None,
+                        kernel="gather"):
     """prefill_chunk over the paged pool: extend the slot whose block
     table is `table_row` [MAXB] by a [C]-token chunk starting at
     `start_pos`. Identical math to prefill_chunk (reference_attention's
     scale-into-q einsum and -1e30 mask — see its docstring for why),
     with the slot's contiguous cache replaced by the gathered block
-    view; padded rows (offs >= true_len) park their writes past the
+    view (kernel="gather") or by the in-kernel table walk
+    (kernel="fused" — parallel/paged_attention.py, same scale-into-q
+    family); padded rows (offs >= true_len) park their writes past the
     table span, where the scatter drops them. `adapters`/`adapter_idx`
     (a SCALAR here — one slot prefills per chunk call) fold the slot's
     tenant LoRA delta into q/v exactly like paged_decode_step, so the
     cached K/V a chunk writes are the adapted model's."""
     from ..parallel.attention import _NEG_INF
 
+    _paged_kernel_check(kernel)
     (C,) = chunk.shape
     NB, Bt, H, dh = cache[0]["k"].shape
     Lv = table_row.shape[0] * Bt
@@ -619,13 +650,20 @@ def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
         ck = kv["k"].at[pk, off].set(k[0].astype(kv["k"].dtype))
         cv = kv["v"].at[pk, off].set(v[0].astype(kv["v"].dtype))
         new_cache.append({"k": ck, "v": cv})
-        slot_k = _paged_view(ck, table_row[None])  # [1, Lv, H, dh]
-        slot_v = _paged_view(cv, table_row[None])
-        s = jnp.einsum("bthd,bshd->bhts", q * scale, slot_k)
-        mask = jnp.arange(Lv)[None, :] <= positions[:, None]  # [C, Lv]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhts,bshd->bthd", p, slot_v)
+        if kernel == "fused":
+            from ..parallel.paged_attention import (
+                paged_prefill_attention)
+
+            o = paged_prefill_attention(
+                q[0], ck, cv, table_row, start_pos)[None]
+        else:
+            slot_k = _paged_view(ck, table_row[None])  # [1, Lv, H, dh]
+            slot_v = _paged_view(cv, table_row[None])
+            s = jnp.einsum("bthd,bshd->bhts", q * scale, slot_k)
+            mask = jnp.arange(Lv)[None, :] <= positions[:, None]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhts,bshd->bthd", p, slot_v)
         x = x + o.reshape(1, C, cfg.dim) @ blk["wo"]
         h = _ln(x, blk["ln2"])
         if "moe" in blk:
@@ -646,7 +684,7 @@ def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
 
 def paged_verify_step(params, cache, window, pos, wpos, tables,
                       cfg: TransformerConfig, adapters=None,
-                      adapter_idx=None):
+                      adapter_idx=None, kernel="gather"):
     """Speculative-decoding verify: run a K-token `window` [S, K] per
     slot (the pending token followed by K-1 drafted tokens) through the
     paged cache in ONE batched step, returning logits for every window
@@ -662,9 +700,11 @@ def paged_verify_step(params, cache, window, pos, wpos, tables,
     0..i match what the model would have produced, which is what the
     engine's acceptance rule checks. Chunk-family numerics
     (scale-into-q, -1e30 mask), the same low-bit-vs-decode_step class
-    prefill_chunk documents."""
+    prefill_chunk documents; kernel="fused" runs the same family
+    through the in-kernel table walk (parallel/paged_attention.py)."""
     from ..parallel.attention import _NEG_INF
 
+    _paged_kernel_check(kernel)
     S, K = window.shape
     NB, Bt, H, dh = cache[0]["k"].shape
     Lv = tables.shape[1] * Bt
@@ -682,13 +722,19 @@ def paged_verify_step(params, cache, window, pos, wpos, tables,
         ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
         cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
         new_cache.append({"k": ck, "v": cv})
-        kview = _paged_view(ck, tables)  # [S, Lv, H, dh]
-        vview = _paged_view(cv, tables)
-        s = jnp.einsum("bthd,bshd->bhts", q * scale, kview)
-        mask = jnp.arange(Lv)[None, None, :] <= positions[:, :, None]
-        s = jnp.where(mask[:, None], s, _NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhts,bshd->bthd", p, vview)
+        if kernel == "fused":
+            from ..parallel.paged_attention import (
+                paged_verify_attention)
+
+            o = paged_verify_attention(q, ck, cv, tables, pos)
+        else:
+            kview = _paged_view(ck, tables)  # [S, Lv, H, dh]
+            vview = _paged_view(cv, tables)
+            s = jnp.einsum("bthd,bshd->bhts", q * scale, kview)
+            mask = jnp.arange(Lv)[None, None, :] <= positions[:, :, None]
+            s = jnp.where(mask[:, None], s, _NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhts,bshd->bthd", p, vview)
         x = x + o.reshape(S, K, cfg.dim) @ blk["wo"]
         h = _ln(x, blk["ln2"])
         if "moe" in blk:

@@ -38,9 +38,17 @@ from .embedding import ShardedEmbedding, sharded_lookup
 from .moe import expert_parallel_moe, moe_capacity, reference_moe
 from .pipeline import gpipe_pipeline, reference_pipeline
 from .flash_attention import flash_attention
+from .paged_attention import (
+    paged_decode_attention,
+    paged_prefill_attention,
+    paged_verify_attention,
+)
 
 __all__ = [
     "flash_attention",
+    "paged_decode_attention",
+    "paged_prefill_attention",
+    "paged_verify_attention",
     "gpipe_pipeline",
     "reference_pipeline",
     "expert_parallel_moe",

@@ -33,9 +33,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+from .kernel_utils import NEG_INF, causal_fill, resolve_interpret
 
-_NEG_INF = -1e30
+__all__ = ["flash_attention", "resolve_interpret"]
+
+# back-compat alias: the mask fill + interpret resolution now live in
+# kernel_utils.py, shared with the paged-attention kernels (ISSUE 13)
+_NEG_INF = NEG_INF
 
 # backward tile cap: the bwd kernels hold ~3 extra [block_q, block_k]
 # f32 intermediates vs the forward, so 1024-wide blocks that fit the
@@ -49,17 +53,9 @@ def _block_needed(qi, kj, block_q, block_k, causal):
     return kj * block_k <= qi * block_q + block_q - 1 if causal else True
 
 
-def _causal_fill(s, qi, kj, block_q, block_k):
-    """Mask the upper triangle of one [block_q, block_k] score tile to
-    -inf. Shared by the forward online-softmax and the backward
-    probability reconstruction so the two can never disagree."""
-    q_idx = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
-    k_idx = kj * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
-    return jnp.where(q_idx >= k_idx, s, _NEG_INF)
+# the shared causal tile mask (kernel_utils.causal_fill) under its
+# historical module-local name — forward and backward both use it
+_causal_fill = causal_fill
 
 
 def _bwd_block(block, length):
@@ -72,16 +68,6 @@ def _bwd_block(block, length):
     while b > 1 and length % b:
         b //= 2
     return b if b >= 8 else length
-
-
-def resolve_interpret(interpret):
-    """None -> interpret on the CPU backend (CI), compile Mosaic
-    elsewhere. AOT lowering for a TPU topology from a CPU host must
-    pass an explicit False — the host backend is the wrong signal
-    there (bench_offline's ulysses workload does)."""
-    if interpret is not None:
-        return interpret
-    return jax.default_backend() == "cpu"
 
 
 def _out_struct(shape, dtype, like):

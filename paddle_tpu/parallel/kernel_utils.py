@@ -1,0 +1,50 @@
+"""Shared Pallas kernel utilities (ISSUE 13 satellite): the
+backend-detection and masking helpers that every Mosaic kernel in
+parallel/ needs, hoisted out of flash_attention.py so the paged
+attention kernels (paged_attention.py) consume ONE copy of the
+CPU/TPU interpret logic instead of re-deriving it.
+
+Everything here is numerics-bearing: `NEG_INF` is the finite mask fill
+that the online-softmax guards compare against (a fully-masked tile
+must be an EXACT no-op on the running (max, sum, acc) state — see
+flash_attention._fa_kernel), and `causal_fill` is shared between the
+flash forward and backward so the probability tiles they build can
+never disagree.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["NEG_INF", "resolve_interpret", "causal_fill"]
+
+# Finite -inf stand-in for score masking. Finite on purpose: the
+# online-softmax no-op guards (`p = where(s <= NEG_INF, 0, p)`,
+# `alpha = where(m_prev <= NEG_INF, 0, alpha)`) need exact comparisons,
+# and exp(-1e30 - m) underflows to exactly 0.0 for any finite m.
+NEG_INF = -1e30
+
+
+def resolve_interpret(interpret):
+    """None -> interpret on the CPU backend (CI), compile Mosaic
+    elsewhere. AOT lowering for a TPU topology from a CPU host must
+    pass an explicit False — the host backend is the wrong signal
+    there (bench_offline's ulysses workload does)."""
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() == "cpu"
+
+
+def causal_fill(s, qi, kj, block_q, block_k):
+    """Mask the upper triangle of one [block_q, block_k] score tile to
+    NEG_INF. Shared by the flash forward online-softmax and the
+    backward probability reconstruction so the two can never
+    disagree."""
+    q_idx = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_idx = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_idx >= k_idx, s, NEG_INF)

@@ -1,0 +1,314 @@
+"""Pallas TPU paged attention: attend THROUGH the block table, with the
+gather happening inside the kernel (ISSUE 13).
+
+The paged serving primitives (models/transformer.py: paged_decode_step,
+paged_verify_step, paged_prefill_chunk) attend over a block-pool KV
+cache [NB, Bt, H, Dh] indirected by per-slot block tables [S, MAXB]
+(PagedAttention, Kwon et al., SOSP '23). Their XLA form materialises a
+transient contiguous per-slot view [S, MAXB*Bt, H, Dh] PER LAYER
+(`_paged_view` — PERF.md's "known trade until a fused paged kernel
+lands"): HBM write + read of the whole gathered context every step,
+which is exactly the traffic a decode step is bounded by. These kernels
+delete that view: a (slots, table-groups) grid walks each slot's block
+table with the table and positions as SCALAR-PREFETCH operands
+(PrefetchScalarGridSpec), so the pipeline DMAs each group's G K/V
+blocks [Bt, H, Dh] straight from the pool buffer into VMEM (G blocks
+per step so the per-head score tile spans G*Bt >= 128 lanes — the
+reference pages_per_compute_block idea) — the "gather" is the index
+map, and no HBM-resident contiguous view ever exists. Blockwise
+online softmax (running (max, sum, acc), the flash_attention.py
+discipline) keeps VMEM at one group of blocks plus per-head [R, Dh]
+accumulators, regardless of context length.
+
+Masking mirrors the gather primitives exactly: row r of a window based
+at `base` attends positions <= base + r, so unwritten depths — and the
+garbage rows a `-1` (unallocated) table entry surfaces after its clamp
+to block 0 — are excluded by position and contribute EXACTLY 0. A
+fully-masked block is an exact no-op on the (m, l, acc) state (the
+NEG_INF guards, kernel_utils.py), so a slot whose table tail is -1
+produces bit-identical output to the same slot over a fully-allocated
+table (the tier-1 garbage-row invariant, tests/test_paged_kernel.py).
+
+Two numerics families, matching the callers they replace (the same
+low-bit split models/transformer.py documents):
+
+  * decode  — `_cached_attention`'s divide-after-matmul scaling
+    (scores / sqrt(Dh)); softmax accumulation in f32.
+  * chunk   — `reference_attention`'s scale-into-q (q * scale BEFORE
+    the matmul), the verify/prefill family.
+
+Online softmax reorders the reduction vs the one-shot softmax the XLA
+path runs, so fused-vs-gather logits agree to float tolerance, not bit
+— the tested bar (atol-pinned logits + greedy token identity through
+the engine), the same class as the padded-prefill drift documented
+since PR 2.
+
+`interpret=None` resolves via kernel_utils.resolve_interpret: CPU CI
+runs the identical kernel interpreted; on TPU it compiles to Mosaic.
+
+Alignment: the pool's block rows are the sublane dim — keep
+`kv_block_tokens` a multiple of 8 (f32; 16 for bf16) — and Dh is the
+lane dim (128-aligned Dh runs the MXU full-width; smaller Dh works,
+padded).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .kernel_utils import NEG_INF, resolve_interpret
+
+__all__ = ["paged_decode_attention", "paged_verify_attention",
+           "paged_prefill_attention"]
+
+
+def _pa_kernel(tbl_ref, base_ref, q_ref, *refs, Bt: int, R: int,
+               G: int, scale: float, scale_in_q: bool):
+    """One (slot, table-GROUP) grid step: stream the G consecutive
+    blocks the slot's table names at this depth range, fold them into
+    the running online-softmax state for all R window rows of every
+    head.
+
+    Grid (S, ceil(MAXB/G)), groups innermost — the flash
+    grid-reduction pattern: init at b == 0, accumulate per group,
+    finalise at the last group. `tbl_ref` [S, MAXB] / `base_ref` [S]
+    are scalar-prefetch refs; the g-th K/V BlockSpec index map already
+    used tbl_ref to pick physical block tbl[s, b*G + g] (clamped to 0
+    when unallocated — masked below, exact no-op), so the per-head
+    score tile is [R, G*Bt].
+
+    Mosaic constraints shape the body, each probed by AOT-compiling
+    for a virtual v5e (the bench_offline pattern): its dot takes 2D
+    operands only (no batch dims), so heads run as a static in-kernel
+    loop; 16-bit mid-dim VMEM extracts don't lower, so blocks upcast
+    to f32 once and every head slices f32 (the f32 MXU path halves
+    peak matmul rate vs bf16, which these HBM-bandwidth-bound steps
+    never see — the DMA stays in the pool dtype); per-head softmax
+    state must be WHOLE refs, never slices of a shared scratch (see
+    the comment below); and G groups blocks until G*Bt >= 128 so the
+    score tile spans full 128-lane tiles (the reference
+    pages_per_compute_block idea, jax paged_attention_kernel — also
+    fewer, larger grid steps for the DMA pipeline to overlap)."""
+    k_refs = refs[:G]
+    v_refs = refs[G:2 * G]
+    o_ref = refs[2 * G]
+    H = o_ref.shape[1]  # the output block is HEAD-major (1, H, R, Dh)
+    # per-head state lives in H SEPARATE whole refs, accessed full-ref
+    # only: mid-dim slice reads/writes of a shared scratch poison
+    # Mosaic's layout inference (the lane-1 m/l slices gave the score
+    # tile a lane-replicated layout whose reduction does not lower,
+    # and the sliced acc store needs the same unimplemented relayout);
+    # whole-ref per-head state is the shipped paged_attention_kernel's
+    # own shape discipline
+    acc_refs = refs[2 * G + 1:2 * G + 1 + H]
+    m_refs = refs[2 * G + 1 + H:2 * G + 1 + 2 * H]
+    l_refs = refs[2 * G + 1 + 2 * H:]
+    si = pl.program_id(0)
+    b = pl.program_id(1)
+    nb = pl.num_programs(1)
+    W = G * Bt  # tokens per grid step
+
+    @pl.when(b == 0)
+    def _init():
+        for ar, mr, lr in zip(acc_refs, m_refs, l_refs):
+            ar[...] = jnp.zeros_like(ar)
+            mr[...] = jnp.full_like(mr, NEG_INF)
+            lr[...] = jnp.zeros_like(lr)
+
+    base = base_ref[si]
+    # whole-group skip: every row of this window sits at or below
+    # base + R - 1, so a group starting past that depth is fully
+    # masked — skip its matmuls entirely (masked groups are exact
+    # no-ops on the state either way; this is pure speed)
+    @pl.when(b * W <= base + R - 1)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # [R, H, Dh]
+        ks = [r[0].astype(jnp.float32) for r in k_refs]  # G x [Bt, H, Dh]
+        vs = [r[0].astype(jnp.float32) for r in v_refs]
+        if scale_in_q:  # chunk family: scale folded into q pre-matmul
+            q = q * scale
+        # position mask: row r (global position base + r) attends
+        # depths <= base + r; everything deeper — including the
+        # garbage a clamped -1 (or tail-padded) entry streams —
+        # contributes exactly 0
+        depth = b * W + jax.lax.broadcasted_iota(jnp.int32, (R, W), 1)
+        rowpos = base + jax.lax.broadcasted_iota(jnp.int32, (R, W), 0)
+        masked = depth > rowpos  # [R, W]
+        for hh in range(H):
+            k = jnp.concatenate([kk[:, hh, :] for kk in ks], axis=0)
+            v = jnp.concatenate([vv[:, hh, :] for vv in vs], axis=0)
+            s = jax.lax.dot_general(
+                q[:, hh, :], k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [R, W]
+            if not scale_in_q:  # decode family: scale after the matmul
+                s = s * scale
+            s = jnp.where(masked, NEG_INF, s)
+
+            m_prev = m_refs[hh][...]  # [R, 1]
+            l_prev = l_refs[hh][...]
+            m_cur = jax.lax.broadcast_in_dim(
+                jnp.max(s, axis=1), (R, 1), (0,))
+            m_new = jnp.maximum(m_prev, m_cur)
+            # fully-masked guards (kernel_utils.NEG_INF contract): a
+            # group with no attended depth leaves (m, l, acc) exactly
+            # unchanged
+            p = jnp.exp(s - m_new)
+            p = jnp.where(s <= NEG_INF, 0.0, p)
+            alpha = jnp.exp(m_prev - m_new)
+            alpha = jnp.where(m_prev <= NEG_INF, 0.0, alpha)
+
+            l_refs[hh][...] = l_prev * alpha + jax.lax.broadcast_in_dim(
+                jnp.sum(p, axis=1), (R, 1), (0,))
+            pv = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [R, Dh]
+            acc_refs[hh][...] = acc_refs[hh][...] * alpha + pv
+            m_refs[hh][...] = m_new
+
+    @pl.when(b == nb - 1)
+    def _finalise():
+        # the output block is head-major so each head's write indexes
+        # LEADING dims only (a mid-dim 16-bit store would not lower);
+        # the builder transposes back outside the kernel
+        for hh in range(H):
+            denom = jnp.maximum(l_refs[hh][...], 1e-30)  # [R, 1]
+            o_ref[0, hh] = (acc_refs[hh][...] / denom).astype(
+                o_ref.dtype)
+
+
+def _paged_attention(q, k_pool, v_pool, tables, base, *, scale,
+                     scale_in_q, interpret):
+    """Shared pallas_call builder: q [S, R, H, Dh] windows based at
+    `base` [S] over per-slot tables [S, MAXB] into the pools
+    [NB, Bt, H, Dh] -> out [S, R, H, Dh].
+
+    The window-row dim R is the kernel's sublane dim: Mosaic wants it
+    in whole 8-row tiles (the flash kernel refuses blocks under 8 for
+    the same reason), so 1 < R < multiple-of-8 windows pad with zero
+    rows up to the tile and slice the result. Pad rows compute masked
+    garbage nothing reads; every real row's online-softmax state is
+    row-independent, so real rows are BIT-identical to the unpadded
+    math. R == 1 (the decode shape) lowers fine as-is and stays
+    unpadded."""
+    S, R, H, dh = q.shape
+    NB, Bt = k_pool.shape[0], k_pool.shape[1]
+    maxb = tables.shape[1]
+    tables = jnp.asarray(tables, jnp.int32)
+    base = jnp.asarray(base, jnp.int32)
+    Rp = R if R == 1 else -(-R // 8) * 8
+    if Rp != R:
+        q = jnp.concatenate(
+            [q, jnp.zeros((S, Rp - R, H, dh), q.dtype)], axis=1)
+    # group size: enough table entries per grid step for the per-head
+    # score tile [Rp, G*Bt] to fill the 128-lane dim (capped at the
+    # whole table for tiny configs — the score tile then equals the
+    # array dim, which Mosaic also accepts); the table pads to a whole
+    # number of groups with -1 (unallocated) entries — clamped and
+    # position-masked like any other -1, i.e. exact no-ops
+    G = max(1, min(-(-128 // Bt), maxb))
+    pad = -maxb % G
+    if pad:
+        tables = jnp.concatenate(
+            [tables, jnp.full((S, pad), -1, jnp.int32)], axis=1)
+
+    def _q_map(si, b, tbl, pos):
+        return (si, 0, 0, 0)
+
+    def _kv_map(g):
+        def _map(si, b, tbl, pos):
+            # THE gather: the pipeline DMAs pool block tbl[s, b*G+g]
+            # for this grid step. -1 (unallocated or group padding)
+            # clamps to block 0 — its rows are excluded by the
+            # position mask, so they contribute exactly 0
+            return (jnp.maximum(tbl[si, b * G + g], 0), 0, 0, 0)
+        return _map
+
+    kernel = functools.partial(
+        _pa_kernel, Bt=Bt, R=Rp, G=G, scale=scale,
+        scale_in_q=scale_in_q,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, (maxb + pad) // G),
+        in_specs=[pl.BlockSpec((1, Rp, H, dh), _q_map)]
+        + [pl.BlockSpec((1, Bt, H, dh), _kv_map(g)) for g in range(G)]
+        + [pl.BlockSpec((1, Bt, H, dh), _kv_map(g)) for g in range(G)],
+        out_specs=pl.BlockSpec((1, H, Rp, dh), _q_map),
+        scratch_shapes=[pltpu.VMEM((Rp, dh), jnp.float32)
+                        for _ in range(H)]
+        + [pltpu.VMEM((Rp, 1), jnp.float32) for _ in range(2 * H)],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, Rp, dh), q.dtype),
+        interpret=resolve_interpret(interpret),
+    )(tables, base, q, *([k_pool] * G), *([v_pool] * G))
+    # the kernel emits head-major [S, H, Rp, Dh] (leading-dim writes
+    # only); this transpose is ordinary XLA on the activation-sized
+    # output, not a pool-sized materialisation
+    out = out.transpose(0, 2, 1, 3)
+    return out[:, :R] if Rp != R else out
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, pos,
+                           interpret=None):
+    """Batched single-token paged decode attention: one query per slot.
+
+    q [S, H, Dh] at per-slot positions `pos` [S] over block tables
+    [S, MAXB] into pools [NB, Bt, H, Dh] -> out [S, H, Dh]. Mirrors
+    `_cached_attention` over `_paged_view` (divide-after-matmul
+    scaling, depths > pos excluded) without ever materialising the
+    view. A parked row (pos >= MAXB*Bt) attends everything its table
+    clamps to — garbage out, exactly like the gather path, and nothing
+    reads it."""
+    S, H, dh = q.shape
+    out = _paged_attention(
+        q[:, None], k_pool, v_pool, tables, pos,
+        scale=1.0 / math.sqrt(dh), scale_in_q=False,
+        interpret=interpret,
+    )
+    return out[:, 0]
+
+
+def paged_verify_attention(q, k_pool, v_pool, tables, pos,
+                           interpret=None):
+    """K-row paged verify windows (the spec-decode path): q [S, K, H,
+    Dh], row (s, i) at global position pos[s] + i, attending the slot's
+    cache up to and including itself — the intra-window causal prefix
+    falls out of the position mask, exactly like `paged_verify_step`'s
+    gather form. Chunk-family numerics (scale-into-q)."""
+    dh = q.shape[-1]
+    return _paged_attention(
+        q, k_pool, v_pool, tables, pos,
+        scale=1.0 / math.sqrt(dh), scale_in_q=True,
+        interpret=interpret,
+    )
+
+
+def paged_prefill_attention(q, k_pool, v_pool, table_row, start,
+                            interpret=None):
+    """Chunked paged prefill attention for ONE slot: a [C]-token chunk
+    q [C, H, Dh] whose first row sits at global position `start`,
+    attending cache[0:start] plus the intra-chunk causal prefix through
+    `table_row` [MAXB]. Chunk-family numerics (scale-into-q), padded
+    rows past true_len compute garbage nothing reads — identical
+    semantics to `paged_prefill_chunk`'s gather form. The whole chunk
+    stays resident in VMEM (C <= max_len; at serving shapes a chunk is
+    `prefill_chunk_tokens`, well under the VMEM budget)."""
+    C, H, dh = q.shape
+    out = _paged_attention(
+        q[None], k_pool, v_pool, jnp.asarray(table_row)[None],
+        jnp.asarray(start, jnp.int32).reshape(1),
+        scale=1.0 / math.sqrt(dh), scale_in_q=True,
+        interpret=interpret,
+    )
+    return out[0]

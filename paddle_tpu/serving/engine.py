@@ -232,6 +232,15 @@ class ServingEngine(object):
     tags the engine — and every token it emits — with the weight
     version its params came from (the fleet's live-rollout version
     fence; a weight swap is a new engine, never an in-place mutation).
+    `paged_kernel` picks how the compiled steps attend over the block
+    pool (ISSUE 13): "fused" = the Pallas kernels that walk the block
+    table inside the kernel (parallel/paged_attention.py — no
+    per-layer gathered view; the default on accelerator backends),
+    "gather" = the XLA `_paged_view` form (the CPU-backend default,
+    where fused would run interpreted); `PADDLE_TPU_PAGED_KERNEL`
+    overrides when the arg is None. Greedy outputs are token-identical
+    either way (tests/test_paged_kernel.py pins it per primitive and
+    end-to-end).
     """
 
     def __init__(self, params, cfg, max_slots=8, max_len=None,
@@ -242,7 +251,7 @@ class ServingEngine(object):
                  replica_id=None, fault_injector=None,
                  scheduler_hook=None, weights_version=None,
                  adapter_registry=None, adapter_slots=8,
-                 adapter_rank=None):
+                 adapter_rank=None, paged_kernel=None):
         self._params = params
         self._cfg = cfg
         # deterministic-exploration seam (ISSUE 9): the fleet threads
@@ -301,7 +310,29 @@ class ServingEngine(object):
         self.spec_draft_len = (
             int(spec_draft_len) if spec_draft_len and int(spec_draft_len) >= 2
             else None)
+        # paged-attention kernel selector (ISSUE 13): "fused" runs the
+        # Pallas kernels that attend THROUGH the block table
+        # (parallel/paged_attention.py — no per-layer gathered view);
+        # "gather" keeps the XLA `_paged_view` form. Fixed for the
+        # engine's lifetime (it is baked into the compiled steps);
+        # resolution: explicit arg > PADDLE_TPU_PAGED_KERNEL > backend
+        # default. The oracle suite (tests/test_paged_kernel.py) is
+        # green, so the default IS flipped to "fused" — on accelerator
+        # backends, where the kernel compiles to Mosaic. The CPU
+        # backend keeps "gather": there the fused path runs the
+        # identical kernel INTERPRETED (resolve_interpret), ~4x slower
+        # per step and ~1.5x per compile — correct but the wrong
+        # default for a CI backend; the paged-kernel suite and the
+        # serving_paged_kernel bench force "fused" explicitly on CPU.
+        pk = paged_kernel or os.environ.get("PADDLE_TPU_PAGED_KERNEL") \
+            or ("gather" if jax.default_backend() == "cpu" else "fused")
+        if pk not in ("fused", "gather"):
+            raise ValueError(
+                "paged_kernel must be 'fused' or 'gather' (got %r)"
+                % (pk,))
+        self.paged_kernel = pk
         self.metrics = ServingMetrics(S)
+        self.metrics.paged_kernel = pk
         self.metrics.kv_blocks_total = NB
         # live-rollout version fence (ISSUE 11): the weight version
         # these params came from — fixed for the engine's lifetime (a
@@ -397,6 +428,7 @@ class ServingEngine(object):
     def _make_decode(self):
         cfg, metrics = self._cfg, self.metrics
         Lv = self.blocks_per_slot * self.kv_block_tokens
+        kernel = self.paged_kernel  # baked into the one compiled step
 
         def _decode(params, cache, tables, tok, pos, alive, temps,
                     counts, base_keys, adapters=None, aidx=None):
@@ -408,7 +440,7 @@ class ServingEngine(object):
             write_pos = jnp.where(alive, pos, jnp.int32(Lv))
             logits, cache = tlm.paged_decode_step(
                 params, tok, write_pos, tables, cache, cfg,
-                adapters=adapters, adapter_idx=aidx,
+                adapters=adapters, adapter_idx=aidx, kernel=kernel,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
@@ -438,6 +470,7 @@ class ServingEngine(object):
         cfg, metrics = self._cfg, self.metrics
         K = self.spec_draft_len
         Lv = self.blocks_per_slot * self.kv_block_tokens
+        kernel = self.paged_kernel  # baked into the one compiled step
 
         def _verify(params, cache, tables, window, pos, alive, limits,
                     temps, counts, base_keys, adapters=None, aidx=None):
@@ -448,7 +481,7 @@ class ServingEngine(object):
             wpos = jnp.where(ok, rows, jnp.int32(Lv))
             logits, cache = tlm.paged_verify_step(
                 params, cache, window, pos, wpos, tables, cfg,
-                adapters=adapters, adapter_idx=aidx,
+                adapters=adapters, adapter_idx=aidx, kernel=kernel,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             # per-position sampling keys: position i of a slot whose
@@ -485,6 +518,7 @@ class ServingEngine(object):
         if fn is not None:
             return fn
         cfg, metrics = self._cfg, self.metrics
+        kernel = self.paged_kernel  # baked into the per-bucket step
 
         def _chunk(params, cache, padded, start, table_row, true_len,
                    temp, key, adapters=None, aidx=None):
@@ -492,6 +526,7 @@ class ServingEngine(object):
             logits, cache = tlm.paged_prefill_chunk(
                 params, cache, padded, start, table_row, cfg,
                 true_len=true_len, adapters=adapters, adapter_idx=aidx,
+                kernel=kernel,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             sampled = jax.random.categorical(

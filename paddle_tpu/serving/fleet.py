@@ -3405,6 +3405,10 @@ class ServingFleet(object):
                     # gauge (ISSUE 11 satellite): which weight version
                     # this incarnation serves
                     "weights_version": rep.weights_version,
+                    # gauge (ISSUE 13 satellite): which paged-attention
+                    # kernel this incarnation's compiled steps attend
+                    # with (from the engine's own metrics snapshot)
+                    "paged_kernel": st.get("paged_kernel"),
                     "load": len(self._inbox[i]) + len(self._in_flight[i]),
                     "stats": st,
                 })

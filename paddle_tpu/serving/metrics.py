@@ -80,6 +80,10 @@ class ServingMetrics(object):
         # that is the point: this gauge feeds the fleet's slow-replica
         # health score). 0.0 until the first step.
         self.step_ewma_s = 0.0
+        # PR 13 gauge — which paged-attention kernel the engine's
+        # compiled steps were traced with ("fused" Pallas table-walk or
+        # "gather" XLA view; set once at engine construction)
+        self.paged_kernel = None
         # PR 11 gauge — the weight version this engine serves (the
         # fleet's live-rollout version fence stamps it at engine
         # construction; None outside a versioned fleet). A gauge like
@@ -167,6 +171,7 @@ class ServingMetrics(object):
             "resumed_requests": self.resumed_requests,
             "resume_tokens_reused": self.resume_tokens_reused,
             "step_ewma_s": round(self.step_ewma_s, 6),
+            "paged_kernel": self.paged_kernel,
             "weights_version": self.weights_version,
         }
         if self.prefix_cache is not None:

@@ -467,6 +467,38 @@ def test_serving_paged_workload_contract():
     assert rec["peak_kv_blocks_in_use"] <= rec["kv_pool_blocks"]
 
 
+def test_serving_paged_kernel_workload_contract():
+    """ISSUE 13 acceptance: the `serving_paged_kernel` row cannot decay
+    into a no-op — on the fixed-seed shared-header trace the fused
+    (Pallas table-walk) run performs ZERO `_paged_view` gathers, keeps
+    the one-compiled-step discipline (fused decode and spec-verify each
+    traced exactly once), and the bench itself hard-raises unless
+    greedy outputs are token-identical between the gather and fused
+    runs (its divergence gate stays armed under -O)."""
+    rec = bench.bench_serving_paged_kernel(
+        n_requests=5, max_slots=3, dim=32, heads=4, layers_n=2,
+        vocab=64, max_len=64, block_tokens=8, chunk_tokens=16,
+        cache_tokens=256, spec_draft_len=4)
+    assert rec["paged_view_calls_fused"] == 0, rec
+    assert rec["decode_traces_fused"] == 1, rec
+    assert rec["spec_verify_traces_fused"] == 1, rec
+    assert rec["paged_kernel_fused"] == "fused"
+    assert rec["paged_kernel_gather"] == "gather"
+    # the reuse surface was actually exercised (aliasing + chunking):
+    # a trace that stopped covering it would pass identity vacuously
+    assert rec["prefill_traces_fused"] >= 1
+    assert rec["tokens_out"] > 0
+
+
+def test_serving_paged_kernel_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_paged_kernel", bench_serving_paged_kernel' in src
+
+
 def test_serving_slo_workload_contract():
     """ISSUE 8 acceptance: the `serving_slo` row cannot decay into a
     no-op — on the fixed-seed Poisson trace of deadline-carrying
