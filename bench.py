@@ -1516,6 +1516,250 @@ def bench_serving_paged_kernel(n_requests=None, max_slots=None, dim=None,
     }
 
 
+def _kv_block_bytes(layers_n, heads, dh, block_tokens, kv_quant,
+                    act_itemsize):
+    """One physical KV block's HBM bytes at a storage dtype — the
+    bench's fixed BYTE budget must price blocks exactly as the engine
+    does, so this delegates to THE one formula
+    (models/transformer.kv_block_bytes, also behind
+    engine.kv_block_bytes and bench_offline's roofline)."""
+    from paddle_tpu.models.transformer import kv_block_bytes
+
+    return kv_block_bytes(layers_n, heads, dh, block_tokens, kv_quant,
+                          act_itemsize=act_itemsize)
+
+
+def _greedy_agreement(outs, ref):
+    """Mean per-request prefix agreement of greedy outputs vs the
+    reference run: longest common prefix over the longer length. 1.0
+    = token-identical; a first-token flip on every request ~0. The
+    serving_quant quality gate's metric — prefix-based because greedy
+    decode is autoregressive (one flipped token reshapes everything
+    after it, so position-wise matching would punish the tail twice)."""
+    num = den = 0
+    for a, b in zip(outs, ref):
+        m = 0
+        while m < min(len(a), len(b)) and a[m] == b[m]:
+            m += 1
+        num += m
+        den += max(len(a), len(b))
+    return num / den if den else 1.0
+
+
+# the serving_quant quality gates: minimum mean greedy-prefix
+# agreement vs the f32 run on the fixed-seed smoke trace, per variant
+# — a hard raise below the floor (speed must never silently buy
+# wrongness; tests/test_bench_protocol.py pins the gates stay armed).
+# Floors sit under the measured smoke values by a margin that absorbs
+# low-bit format drift but catches wiring bugs (a wrong scale or a
+# sign error craters agreement toward ~0.1): int8 KV carries 8-bit
+# codes (measured 0.93 on the 2-layer toy — near-lossless on real
+# logit margins, the LLM.int8/KVQuant result); fp8 e4m3 has 3
+# mantissa bits (~6% relative error, measured 0.75 — the toy model's
+# tiny logit margins flip early and prefix agreement compounds);
+# weight-int8 perturbs EVERY matmul, not just the cache (measured
+# 0.78). 'none' IS the reference: anything under exact 1.0 means the
+# baseline run stopped being the baseline.
+QUANT_AGREEMENT_GATES = {
+    "none": 1.0,
+    "int8": 0.85,
+    "fp8": 0.60,
+    "weight_int8": 0.70,
+}
+
+
+def bench_serving_quant(n_requests=None, max_slots=None, dim=None,
+                        heads=None, layers_n=None, vocab=None,
+                        max_len=None, block_tokens=None,
+                        chunk_tokens=None, cache_tokens=None,
+                        budget_bytes=None, agreement_gate=None):
+    """Quantized-serving acceptance trace (ISSUE 14): the SAME
+    fixed-seed Poisson shared-header trace runs at ONE fixed KV HBM
+    BYTE budget with kv_quant = none / int8 / fp8 (each variant gets
+    budget_bytes // block_bytes(variant) pool blocks — int8/fp8 blocks
+    cost ~1/4 the bytes, so they hold ~4x the blocks), plus a
+    weight-quantized run (weight_quant='int8' at the f32 KV pool), all
+    through the full reuse surface: prefix aliasing + publish
+    boundaries, chunked prefill, and copy-on-write.
+
+    Hard raises (the acceptance gates, armed in-bench so they survive
+    -O): int8 KV must hold STRICTLY more resident slots than f32 at
+    the byte budget; every variant's mean greedy-prefix agreement vs
+    the f32 run must meet its QUANT_AGREEMENT_GATES floor (override
+    every floor at once with `agreement_gate`) — the quality gate
+    that keeps the byte saving from silently buying wrongness; and
+    the one-compiled-step discipline must survive quantization
+    (decode traced exactly once per engine).
+
+    CPU columns (deterministic offline): slots-resident,
+    bytes-per-resident-token, pool blocks at the budget, agreement,
+    trace counts. tokens/s per variant is reported but
+    ON-CHIP-PENDING: the HBM-bandwidth win quantization exists for is
+    only measurable on a real chip (PERF.md PR 14 reserves the v5e
+    slot next to PR 13's)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import ServingEngine
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape: four engines compile + drain in seconds
+        dim, heads, layers_n = dim or 64, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 256, max_len or 96
+        n_requests = n_requests or 10
+        max_slots = max_slots or 8
+        block_tokens = block_tokens or 8
+        chunk_tokens = chunk_tokens or 16
+        cache_tokens = cache_tokens or 256
+        header_len, t_lo, t_hi, n_lo, n_hi, rate = 12, 2, 10, 5, 12, 2.0
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_requests = n_requests or 64
+        max_slots = max_slots or 32
+        # int8/fp8 pools want 32-row blocks on the fused Mosaic path
+        # (int8 sublane tile) — harmless for the others
+        block_tokens = block_tokens or 32
+        chunk_tokens = chunk_tokens or 128
+        cache_tokens = cache_tokens or 8192
+        header_len, t_lo, t_hi, n_lo, n_hi, rate = 128, 32, 128, 32, 96, 2.0
+        dtype = jnp.bfloat16
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    dh = dim // heads
+    act_item = jnp.dtype(dtype).itemsize
+    # ONE byte budget for every variant (default: ONE f32 slab slot's
+    # worth of blocks — tight enough that the f32 run queues on the
+    # pool while int8's ~4x blocks keep admitting)
+    f32_block_bytes = _kv_block_bytes(layers_n, heads, dh, block_tokens,
+                                      "none", act_item)
+    if budget_bytes is None:
+        budget_bytes = (max_len // block_tokens) * f32_block_bytes
+    budget_bytes = int(budget_bytes)
+    rng = np.random.RandomState(0)
+    header = rng.randint(0, vocab, header_len).astype(np.int32)
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    reqs = [
+        (
+            np.concatenate([header, rng.randint(
+                0, vocab, int(rng.randint(t_lo, t_hi + 1))
+            ).astype(np.int32)]),
+            int(rng.randint(n_lo, n_hi + 1)),
+        )
+        for _ in range(n_requests)
+    ]
+
+    variants = ["none", "int8"]
+    if hasattr(jnp, "float8_e4m3fn"):
+        variants.append("fp8")
+
+    def run_once(kvq, wq=None):
+        bb = _kv_block_bytes(layers_n, heads, dh, block_tokens, kvq,
+                             act_item)
+        blocks = max(1, budget_bytes // bb)
+        eng = ServingEngine(
+            params, cfg, max_slots=max_slots,
+            kv_block_tokens=block_tokens, kv_pool_blocks=blocks,
+            prefill_chunk_tokens=chunk_tokens,
+            prefix_cache_tokens=cache_tokens,
+            kv_quant=kvq, weight_quant=wq)
+        hs, peak = [], 0
+        t0 = time.time()
+        i = step = 0
+        while i < n_requests or eng.live_slots or eng.queue_depth \
+                or eng.prefilling_slots:
+            while i < n_requests and arrive_at[i] <= step:
+                p, n = reqs[i]
+                hs.append(eng.submit(p, n, publish_len=header_len))
+                i += 1
+            if not eng.step() and i < n_requests:
+                step = max(step + 1, int(arrive_at[i]))  # idle gap: jump
+                continue
+            peak = max(peak, eng.live_slots + eng.prefilling_slots)
+            step += 1
+        wall = time.time() - t0
+        return eng, wall, peak, blocks, bb, [list(h.tokens) for h in hs]
+
+    ref_out = None
+    rep = {}
+    for name in variants + ["weight_int8"]:
+        if name == "weight_int8":
+            eng, wall, peak, blocks, bb, outs = run_once("none",
+                                                         wq="int8")
+        else:
+            eng, wall, peak, blocks, bb, outs = run_once(name)
+        if ref_out is None:  # the f32 baseline runs first
+            ref_out = outs
+        m = eng.metrics.report()
+        ag = _greedy_agreement(outs, ref_out)
+        # the quality gate — a hard raise, not an assert (must
+        # survive -O): quantization may trade low bits, never the
+        # trace's gross shape
+        gate = QUANT_AGREEMENT_GATES[name] if agreement_gate is None \
+            else float(agreement_gate)
+        if ag < gate:
+            raise RuntimeError(
+                "serving_quant quality gate: %s agreement %.4f < %.2f "
+                "vs the f32 run" % (name, ag, gate))
+        if m["decode_traces"] != 1:
+            raise RuntimeError(
+                "%s run broke the one-compiled-step discipline: %r"
+                % (name, eng.metrics.trace_counts))
+        toks = m["tokens_out"]
+        rep[name] = {
+            "slots_resident": peak,
+            "kv_pool_blocks": blocks,
+            "kv_block_bytes": bb,
+            "bytes_per_resident_token": round(bb / block_tokens, 2),
+            "agreement_vs_f32": round(ag, 4),
+            "agreement_gate": gate,
+            "tokens_out": toks,
+            "tokens_per_sec": round(toks / wall, 1),
+            "prefix_hits": eng.prefix_cache.stats()["hits"],
+            "cow_blocks": m["cow_blocks"],
+            "kv_quant": m["kv_quant"],
+            "weight_quant": m["weight_quant"],
+        }
+    # the residency inequality int8 > f32 at ONE byte budget — the
+    # whole point of the PR; strictly more resident slots or the row
+    # is lying about the multiplier
+    if rep["int8"]["slots_resident"] <= rep["none"]["slots_resident"]:
+        raise RuntimeError(
+            "int8 KV did not hold more resident slots than f32 at the "
+            "fixed byte budget: %d <= %d"
+            % (rep["int8"]["slots_resident"],
+               rep["none"]["slots_resident"]))
+    # the default path must stay the default path: kv_quant='none'
+    # reports no quantization (its token identity vs the pre-quant
+    # tree is pinned by the tier-1 engine/kernel suites)
+    if rep["none"]["kv_quant"] != "none":
+        raise RuntimeError("f32 baseline ran quantized: %r" % rep["none"])
+    return {
+        "variants": rep,
+        "agreement_gates": dict(QUANT_AGREEMENT_GATES),
+        "kv_budget_bytes": budget_bytes,
+        "kv_block_tokens": int(block_tokens),
+        "pool_multiplier_int8": round(
+            rep["int8"]["kv_pool_blocks"] / rep["none"]["kv_pool_blocks"],
+            2),
+        "tokens_per_sec_note": "on-chip-pending (the HBM-bandwidth win "
+                               "needs a chip; PERF.md PR 14 reserves "
+                               "the v5e slot)" if cpu else "compiled",
+        "n_requests": n_requests,
+        "arrival": "poisson(rate=%g/step, seed=0)" % rate,
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len,
+                  "dtype": str(jnp.dtype(dtype))},
+    }
+
+
 def bench_serving_fleet(n_replicas=None, n_requests=None, families=None,
                         header_len=None, family_len=None, max_slots=None,
                         dim=None, heads=None, layers_n=None, vocab=None,
@@ -3301,6 +3545,12 @@ def main():
         # are deterministic offline; the tokens/s contrast is only
         # meaningful compiled to Mosaic on-chip
         run("serving_paged_kernel", bench_serving_paged_kernel)
+        # quantized serving (ISSUE 14): one fixed KV byte budget,
+        # kv_quant none/int8/fp8 + weight-int8 — slots-resident,
+        # bytes-per-resident-token, and the greedy-agreement quality
+        # gate are deterministic offline; the tokens/s contrast (the
+        # HBM-roofline win) awaits an on-chip window
+        run("serving_quant", bench_serving_quant)
         # serving fleet (ISSUE 6): N replicas + kill drill on the same
         # fixed-seed shared-header trace — requests lost / duplicates /
         # failovers and the affinity-routing reuse contrast are

@@ -549,6 +549,123 @@ def offline_switch_moe_ep8(topo_devices, tokens_per_chip=1024, Dm=512,
     return rec
 
 
+def kv_bytes_per_token(layers_n, heads, dh, kv_quant="none",
+                       block_tokens=16, act_itemsize=4):
+    """HBM bytes one cached token costs at a KV storage dtype: the
+    per-block cost (models/transformer.kv_block_bytes — THE one
+    formula, shared with the engine's allocator accounting and
+    bench.py's byte-budget sizing) amortised over the block's tokens,
+    so the quant scale side-bands show up fractionally (ISSUE 14)."""
+    from paddle_tpu.models.transformer import kv_block_bytes
+
+    return kv_block_bytes(layers_n, heads, dh, block_tokens, kv_quant,
+                          act_itemsize=act_itemsize) \
+        / float(block_tokens)
+
+
+def offline_paged_attention_quant(topo_devices, S=32, H=8, dh=64,
+                                  NB=256, Bt=32, maxb=32):
+    """Mosaic AOT-compile check for the DEQUANTIZING paged-attention
+    kernels (ISSUE 14, alongside PR 13's): the paged decode and
+    verify kernels compiled by the real XLA:TPU pipeline for a v5e
+    topology at bf16, f32, and int8 storage — int8 carries the
+    per-(block, head) scale side-bands as scalar-prefetch operands,
+    the compile path CI's interpret mode never exercises. Bt=32 keeps
+    the int8 pool's block rows on the 32-row int8 sublane tile. The
+    falsifiable claim per storage dtype: `tpu_custom_call` present in
+    the compiled module (the kernel lowered to Mosaic, not a
+    fallback), plus the HLO fingerprint and cost analysis for
+    between-windows comparison."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel.paged_attention import (
+        paged_decode_attention, paged_verify_attention)
+
+    mesh = Mesh(np.asarray(topo_devices[:1]).reshape(1,), ("d",))
+    rep = NamedSharding(mesh, P())
+    tables = jax.ShapeDtypeStruct((S, maxb), jnp.int32)
+    pos = jax.ShapeDtypeStruct((S,), jnp.int32)
+    sc = jax.ShapeDtypeStruct((NB, H), jnp.float32)
+    out = {"shape": {"S": S, "H": H, "dh": dh, "NB": NB, "Bt": Bt,
+                     "maxb": maxb}}
+    all_mosaic = True
+    for store in ("float32", "bfloat16", "int8"):
+        pool = jax.ShapeDtypeStruct((NB, Bt, H, dh), jnp.dtype(store))
+        qd = jax.ShapeDtypeStruct((S, H, dh), jnp.bfloat16)
+        qv = jax.ShapeDtypeStruct((S, 4, H, dh), jnp.bfloat16)
+        quant = store == "int8"
+        for name, q, fn in (
+            ("decode", qd, paged_decode_attention),
+            ("verify", qv, paged_verify_attention),
+        ):
+            if quant:
+                def wrapped(q, k, v, t, p, ks, vs, _fn=fn):
+                    # interpret=False explicitly: the host backend is
+                    # CPU but the lowering targets the TPU topology —
+                    # Mosaic, not the interpreter, must land
+                    return _fn(q, k, v, t, p, interpret=False,
+                               k_scale=ks, v_scale=vs)
+                args = (q, pool, pool, tables, pos, sc, sc)
+            else:
+                def wrapped(q, k, v, t, p, _fn=fn):
+                    return _fn(q, k, v, t, p, interpret=False)
+                args = (q, pool, pool, tables, pos)
+            t0 = time.time()
+            lowered = jax.jit(
+                wrapped, in_shardings=(rep,) * len(args)).lower(*args)
+            rec, txt = _cost_record(lowered, time.time() - t0)
+            rec["mosaic_calls"] = txt.count("tpu_custom_call")
+            all_mosaic = all_mosaic and rec["mosaic_calls"] > 0
+            out["%s_%s" % (name, store)] = rec
+    out["mosaic_compiled_all"] = all_mosaic
+    if not all_mosaic:
+        out["error"] = "a paged kernel variant fell off the Mosaic path"
+    return out
+
+
+def offline_serving_quant_roofline(layers_n=8, dim=512, heads=8,
+                                   vocab=32000, S=32, context=512,
+                                   block_tokens=32):
+    """Analytic decode roofline at each serving storage dtype (ISSUE
+    14 satellite): one batched decode step reads every weight byte
+    once and every resident KV byte once — both terms now honest
+    about storage dtype instead of assuming f32 everywhere. The
+    predicted tokens/s are the HBM bound (the offline cost model
+    already calls decode hbm-bound: lm_decode's cost analysis says
+    ai ~ 2 flops/byte, far under the v5e ridge), so
+    bytes-per-step / HBM_BW is the step-time floor and the
+    measurement slot for the real contrast is PERF.md PR 14's."""
+    dh = dim // heads
+    # weight bytes: embed + pos (context table) + per-layer qkvo +
+    # 2 MLP mats (mlp_mult 4) + norms, at the storage dtype
+    n_params = (vocab * dim + 1024 * dim
+                + layers_n * (4 * dim * dim + 8 * dim * dim + 4 * dim))
+    out = {"shape": {"layers": layers_n, "dim": dim, "heads": heads,
+                     "vocab": vocab, "slots": S, "context": context,
+                     "block_tokens": block_tokens},
+           "hbm_bw": HBM_BW, "n_params": n_params}
+    for wq, w_item in (("none_bf16", 2), ("int8", 1)):
+        for kvq in ("none", "int8", "fp8"):
+            kv_tok = kv_bytes_per_token(layers_n, heads, dh, kvq,
+                                        block_tokens,
+                                        act_itemsize=2)  # bf16 serving
+            step_bytes = n_params * w_item + S * context * kv_tok
+            t = step_bytes / HBM_BW
+            out["w_%s__kv_%s" % (wq, kvq)] = {
+                "weight_bytes": n_params * w_item,
+                "kv_bytes_per_token": round(kv_tok, 2),
+                "kv_bytes_resident": int(S * context * kv_tok),
+                "step_bytes": int(step_bytes),
+                "pred_tokens_per_sec_hbm_bound": round(S / t, 1),
+            }
+    base = out["w_none_bf16__kv_none"]["pred_tokens_per_sec_hbm_bound"]
+    best = out["w_int8__kv_int8"]["pred_tokens_per_sec_hbm_bound"]
+    out["pred_uplift_int8_over_bf16"] = round(best / base, 2)
+    return out
+
+
 def offline_scaling_projection(batch_per_chip=32):
     """Cost-model projection of 1->16 chip weak scaling (BASELINE.json
     asks >=90% on a v5e-16; no multi-chip hardware exists here, so this
@@ -655,6 +772,16 @@ def main():
          lambda: offline_switch_moe_ep8(topo_devices)),
         ("resnet50_hybrid", lambda: offline_resnet50_hybrid(topo_devices)),
         ("lm_decode", lambda: offline_lm_decode(topo_devices)),
+        # ISSUE 14: the dequantizing paged kernels Mosaic-compiled for
+        # v5e (bf16 + f32 + int8 storage; int8 rides scale
+        # scalar-prefetch operands) — the compile path CI's interpret
+        # mode never exercises, alongside PR 13's flash/ulysses checks
+        ("paged_attention_quant",
+         lambda: offline_paged_attention_quant(topo_devices)),
+        # ISSUE 14: decode byte roofline honest about KV/weight
+        # storage dtype (it assumed f32/bf16 everywhere before)
+        ("serving_quant_roofline",
+         lambda: offline_serving_quant_roofline()),
         ("scaling_projection", lambda: offline_scaling_projection()),
     ]
     only = os.environ.get("BENCH_OFFLINE_ONLY")

@@ -452,6 +452,13 @@ def prefill_chunk(params, cache, chunk, start_pos, slot, cfg: TransformerConfig,
 #            agree to float tolerance (online softmax reorders the
 #            reduction), token-identically in greedy decode — the same
 #            low-bit class as the padded-prefill drift (PR 2).
+#
+# Each primitive also takes kv_quant="none"|"int8"|"fp8" (ISSUE 14):
+# the pool stores quantized codes with per-(physical block, head)
+# absmax scale side-bands (k_scale/v_scale [NB, H] per layer), writes
+# quantize at the scatter (_quant_scatter's commit-at-open rule), and
+# reads dequantize in-kernel (fused) or on the gather view. "none" is
+# byte-identical to the pre-quant code path.
 # ---------------------------------------------------------------------
 
 
@@ -462,16 +469,176 @@ def _paged_kernel_check(kernel: str):
             % (kernel,))
 
 
+# ---------------------------------------------------------------------
+# per-block KV quantization (ISSUE 14): the pool stores int8/fp8 with a
+# per-(physical block, head) absmax scale side-band [NB, H] per layer
+# and band. Scales are keyed by PHYSICAL block id, so prefix aliasing
+# (two tables naming one block) shares the scale for free and
+# copy-on-write copies payload+scale in the same compiled op. qmax is
+# the storage format's largest representable magnitude: 127 for int8,
+# 448 for float8_e4m3fn (no inf — casts past it would garbage, so
+# writes clip to it explicitly).
+# ---------------------------------------------------------------------
+
+_KV_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def _kv_quant_check(kv_quant: str):
+    if kv_quant not in ("none", "int8", "fp8"):
+        raise ValueError(
+            "kv_quant must be 'none', 'int8', or 'fp8' (got %r)"
+            % (kv_quant,))
+
+
+def kv_block_bytes(layers_n: int, heads: int, dh: int,
+                   block_tokens: int, kv_quant: str = "none",
+                   act_itemsize: int = 4) -> int:
+    """One physical KV block's HBM cost at a storage dtype: K+V
+    payload rows over all layers, plus the per-(block, head) f32
+    scale side-bands when quantized. THE one formula — the engine's
+    allocator accounting (ServingEngine.kv_block_bytes), bench.py's
+    fixed-byte-budget pool sizing, and bench_offline's roofline all
+    call it, so the three can never drift. Per payload byte the
+    int8/fp8 scale overhead is 4 / (block_tokens x dh) — ~0.4% at
+    the Bt=16, dh=64 defaults."""
+    _kv_quant_check(kv_quant)
+    item = 1 if kv_quant != "none" else int(act_itemsize)
+    b = 2 * layers_n * block_tokens * heads * dh * item
+    if kv_quant != "none":
+        b += 2 * layers_n * heads * 4
+    return b
+
+
+def kv_storage_dtype(kv_quant: str):
+    """Pool storage dtype for a kv_quant setting; None = the model
+    dtype (unquantized). Raises on fp8 when this jax build has no
+    float8_e4m3fn — a loud gate, never a silent f32 fallback."""
+    _kv_quant_check(kv_quant)
+    if kv_quant == "int8":
+        return jnp.int8
+    if kv_quant == "fp8":
+        if not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError(
+                "kv_quant='fp8' needs jnp.float8_e4m3fn (this jax "
+                "build has none) — use 'int8' or 'none'")
+        return jnp.float8_e4m3fn
+    return None
+
+
 def init_paged_kv_cache(cfg: TransformerConfig, num_blocks: int,
-                        block_tokens: int, dtype=None):
-    """Per-layer pooled K/V block buffers [NB, Bt, H, Dh]."""
+                        block_tokens: int, dtype=None,
+                        kv_quant: str = "none"):
+    """Per-layer pooled K/V block buffers [NB, Bt, H, Dh]. With
+    `kv_quant` ('int8' | 'fp8') the payload stores the quantized code
+    and each layer gains per-(block, head) f32 absmax-scale side-bands
+    'k_scale'/'v_scale' [NB, H] (committed at block fill — see
+    `_quant_scatter`). kv_quant='none' returns the exact pre-quant
+    structure, so default engines stay trace-identical."""
     dh = cfg.dim // cfg.heads
-    shape = (int(num_blocks), int(block_tokens), cfg.heads, dh)
-    dt = dtype or cfg.dtype
+    NB, Bt = int(num_blocks), int(block_tokens)
+    shape = (NB, Bt, cfg.heads, dh)
+    st = kv_storage_dtype(kv_quant)
+    if st is None:
+        dt = dtype or cfg.dtype
+        return [
+            {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            for _ in range(cfg.layers)
+        ]
     return [
-        {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        {"k": jnp.zeros(shape, st), "v": jnp.zeros(shape, st),
+         "k_scale": jnp.zeros((NB, cfg.heads), jnp.float32),
+         "v_scale": jnp.zeros((NB, cfg.heads), jnp.float32)}
         for _ in range(cfg.layers)
     ]
+
+
+def _quant_scatter(buf, scale, pk, off, vals, qmax,
+                   commit_from_call=False):
+    """Quantize rows `vals` [..., H, Dh] and scatter them into the
+    int8/fp8 pool `buf` [NB, Bt, H, Dh] at (pk, off) [...]; returns
+    (new buf, new scale [NB, H]).
+
+    Scale discipline (the absmax commit-at-open rule): a block is
+    OPENED when some row of THIS call writes its in-block offset 0 —
+    opened blocks (re)commit their per-head scale (erasing the stale
+    scale a recycled pool block carries from its previous tenant).
+    The commit source is the opening ROW's absmax by default; with
+    `commit_from_call` it is the absmax over every row this call
+    writes into the block. Chunk prefill uses call-commit (the whole
+    fill is deterministic — prompt blocks are never re-opened);
+    decode and verify MUST use row-commit: a verify window's extra
+    rows are speculative drafts, and folding a rejected draft into
+    the scale would make the committed scale — and every later
+    clipped write — depend on drafts that never became tokens,
+    breaking the spec-invariance guarantee (rejected positions are
+    re-written by later windows, and the off-0 re-write re-commits,
+    so the QUIESCENT cache is bit-identical to the plain decode
+    path's). Rows landing in a block this call did NOT open re-use
+    the committed scale and CLIP to it (decode appends mid-block,
+    continuation chunks, draft re-writes) — the LLM.int8-style absmax
+    trade: later outliers saturate rather than re-scaling rows
+    already stored. Parked rows (pk == NB, the engine's
+    dead-slot/padded sentinel) drop payload, scale commit, AND open
+    marker alike — out-of-range scatters drop, so parking stays exact
+    on the quant path and a sentinel-parked write can never dirty a
+    block or its scale.
+
+    Known limit (the absmax trade's extreme): a block OPENED by an
+    all-zero row commits scale 0, and every row later appended to it
+    dequantizes to exactly 0 for the block's lifetime — total loss,
+    not clipping. No invariance-safe rescue exists inside per-block
+    scales (a re-commit on the first nonzero append would let verify
+    windows leak rejected-draft magnitudes back into the scale, and
+    an epsilon floor still clips appends to ~0). It is accepted
+    because an exactly-zero per-head projection requires h @ wk == 0
+    in every lane through a LayerNormed activation — unreachable for
+    real checkpoints short of hand-zeroed weight/embedding rows —
+    and the serving_quant agreement gate is the arbiter if a model
+    ever gets near it."""
+    NB = buf.shape[0]
+    H, dh = vals.shape[-2], vals.shape[-1]
+    n = math.prod(vals.shape[:-2])
+    fpk = jnp.reshape(pk, (n,))
+    foff = jnp.reshape(off, (n,))
+    fv = jnp.reshape(vals, (n, H, dh)).astype(jnp.float32)
+    amax = jnp.abs(fv).max(axis=-1)  # [n, H]
+    # commit-source rows scatter-max into the candidate scales
+    # (duplicate pk rows combine by max; parked rows at NB drop, and
+    # in row-commit mode non-opening rows park themselves)
+    src_pk = fpk if commit_from_call else jnp.where(
+        foff == 0, fpk, jnp.int32(NB))
+    cand = jnp.zeros((NB, H), jnp.float32).at[src_pk].max(amax / qmax)
+    opened = jnp.zeros((NB, 1), jnp.float32).at[fpk].max(
+        (foff == 0).astype(jnp.float32)[:, None]) > 0
+    new_scale = jnp.where(opened, cand, scale)
+    # quantize each row with the post-commit scale of ITS block; a
+    # zero scale (an all-zero fill, or a never-opened block nothing
+    # will read) divides by 1 instead — codes stay finite and exact 0
+    # round-trips to exact 0
+    s_rows = new_scale[jnp.clip(fpk, 0, NB - 1)][..., None]  # [n, H, 1]
+    safe = jnp.where(s_rows > 0, s_rows, 1.0)
+    scaled = fv / safe
+    if buf.dtype == jnp.int8:
+        q = jnp.clip(jnp.round(scaled), -qmax, qmax).astype(jnp.int8)
+    else:  # fp8: clip to the format's finite max BEFORE the cast
+        q = jnp.clip(scaled, -qmax, qmax).astype(buf.dtype)
+    return buf.at[fpk, foff].set(q), new_scale
+
+
+def _paged_deq_view(buf, scale, tables):
+    """Dequantized gather view: `_paged_view` of the quantized pool,
+    upcast to f32 and multiplied by each block's per-head scale
+    (broadcast over the block's Bt rows) — the gather fallback's read
+    path, running the SAME numerics the fused kernel applies in VMEM
+    so CPU CI interprets identical math. Unallocated (-1) entries
+    clamp like `_paged_view`; their garbage codes times their garbage
+    (finite) scales are position-masked to exactly 0 by every
+    caller."""
+    NB, Bt, H, dh = buf.shape
+    v = _paged_view(buf, tables).astype(jnp.float32)
+    s = scale[jnp.clip(tables, 0, NB - 1)]  # [..., MAXB, H]
+    s = jnp.repeat(s, Bt, axis=-2)          # [..., MAXB*Bt, H]
+    return v * s[..., None]
 
 
 def _paged_view(buf, tables):
@@ -555,7 +722,8 @@ def _adapter_qv(h, blk, li, adapters, idx):
 
 def paged_decode_step(params, token, pos, tables, cache,
                       cfg: TransformerConfig, adapters=None,
-                      adapter_idx=None, kernel="gather"):
+                      adapter_idx=None, kernel="gather",
+                      kv_quant="none"):
     """One decode step over the paged pool: token [S] at per-row
     positions `pos` [S], block tables [S, MAXB] -> (logits [S, vocab],
     updated cache). Mirrors decode_step's numerics verbatim
@@ -569,8 +737,16 @@ def paged_decode_step(params, token, pos, tables, cache,
     `adapters`/`adapter_idx` [S], each slot's q/v projections gain its
     tenant's LoRA delta gathered from the stacked adapter pool (ISSUE
     12 — index 0 is the zero adapter, exact no-op); the adapter gather
-    is INSIDE this one compiled step, so N tenants retrace nothing."""
+    is INSIDE this one compiled step, so N tenants retrace nothing.
+    With `kv_quant` ('int8' | 'fp8'), writes quantize at the scatter
+    (`_quant_scatter`: a block-opening row commits the block's scale,
+    appends re-use it) and reads dequantize inside the fused kernel
+    (scales ride as scalar-prefetch operands) or on the gather view —
+    'none' is byte-identical to the pre-quant step."""
     _paged_kernel_check(kernel)
+    _kv_quant_check(kv_quant)
+    quant = kv_quant != "none"
+    qmax = _KV_QMAX.get(kv_quant)
     B = token.shape[0]
     dh = cfg.dim // cfg.heads
     NB, Bt = cache[0]["k"].shape[0], cache[0]["k"].shape[1]
@@ -583,14 +759,33 @@ def paged_decode_step(params, token, pos, tables, cache,
         k = (h @ blk["wk"]).reshape(B, cfg.heads, dh)
         v = v.reshape(B, cfg.heads, dh)
         pk, off = _phys_rows(tables, pos, NB, Bt)
-        ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
-        cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
-        new_cache.append({"k": ck, "v": cv})
+        if quant:
+            ck, ksc = _quant_scatter(kv["k"], kv["k_scale"], pk, off,
+                                     k, qmax)
+            cv, vsc = _quant_scatter(kv["v"], kv["v_scale"], pk, off,
+                                     v, qmax)
+            new_cache.append({"k": ck, "v": cv,
+                              "k_scale": ksc, "v_scale": vsc})
+        else:
+            ksc = vsc = None
+            ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
+            cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
+            new_cache.append({"k": ck, "v": cv})
         if kernel == "fused":
             from ..parallel.paged_attention import paged_decode_attention
 
-            o = paged_decode_attention(q, ck, cv, tables, pos).reshape(
-                B, cfg.dim)
+            o = paged_decode_attention(
+                q, ck, cv, tables, pos, k_scale=ksc, v_scale=vsc
+            ).reshape(B, cfg.dim)
+        elif quant:
+            # f32 dequantized view: cast the attention output back to
+            # the activation dtype so quantization never silently
+            # promotes a bf16 model's residual stream (the fused
+            # kernel's out dtype is q's already)
+            o = _cached_attention(
+                q, _paged_deq_view(ck, ksc, tables),
+                _paged_deq_view(cv, vsc, tables), pos
+            ).astype(x.dtype).reshape(B, cfg.dim)
         else:
             o = _cached_attention(
                 q, _paged_view(ck, tables), _paged_view(cv, tables), pos
@@ -613,7 +808,7 @@ def paged_decode_step(params, token, pos, tables, cache,
 def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
                         cfg: TransformerConfig, true_len=None,
                         adapters=None, adapter_idx=None,
-                        kernel="gather"):
+                        kernel="gather", kv_quant="none"):
     """prefill_chunk over the paged pool: extend the slot whose block
     table is `table_row` [MAXB] by a [C]-token chunk starting at
     `start_pos`. Identical math to prefill_chunk (reference_attention's
@@ -625,10 +820,17 @@ def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
     table span, where the scatter drops them. `adapters`/`adapter_idx`
     (a SCALAR here — one slot prefills per chunk call) fold the slot's
     tenant LoRA delta into q/v exactly like paged_decode_step, so the
-    cached K/V a chunk writes are the adapted model's."""
+    cached K/V a chunk writes are the adapted model's. `kv_quant`
+    quantizes at the scatter — a chunk COMMITS the scale of every
+    block it opens (absmax over the chunk's rows in that block) and
+    clips into blocks earlier chunks committed — and dequantizes on
+    the read, fused or gathered, like paged_decode_step."""
     from ..parallel.attention import _NEG_INF
 
     _paged_kernel_check(kernel)
+    _kv_quant_check(kv_quant)
+    quant = kv_quant != "none"
+    qmax = _KV_QMAX.get(kv_quant)
     (C,) = chunk.shape
     NB, Bt, H, dh = cache[0]["k"].shape
     Lv = table_row.shape[0] * Bt
@@ -647,23 +849,40 @@ def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
         k = (h @ blk["wk"]).reshape(1, C, cfg.heads, dh)
         v = v.reshape(1, C, cfg.heads, dh)
         pk, off = _phys_rows(table_row, wpos, NB, Bt)
-        ck = kv["k"].at[pk, off].set(k[0].astype(kv["k"].dtype))
-        cv = kv["v"].at[pk, off].set(v[0].astype(kv["v"].dtype))
-        new_cache.append({"k": ck, "v": cv})
+        if quant:
+            # call-commit: the chunk's whole fill of each opened block
+            # is real prompt content (never speculative), so the
+            # block scale sees every row — the best absmax available
+            ck, ksc = _quant_scatter(kv["k"], kv["k_scale"], pk, off,
+                                     k[0], qmax, commit_from_call=True)
+            cv, vsc = _quant_scatter(kv["v"], kv["v_scale"], pk, off,
+                                     v[0], qmax, commit_from_call=True)
+            new_cache.append({"k": ck, "v": cv,
+                              "k_scale": ksc, "v_scale": vsc})
+        else:
+            ksc = vsc = None
+            ck = kv["k"].at[pk, off].set(k[0].astype(kv["k"].dtype))
+            cv = kv["v"].at[pk, off].set(v[0].astype(kv["v"].dtype))
+            new_cache.append({"k": ck, "v": cv})
         if kernel == "fused":
             from ..parallel.paged_attention import (
                 paged_prefill_attention)
 
             o = paged_prefill_attention(
-                q[0], ck, cv, table_row, start_pos)[None]
+                q[0], ck, cv, table_row, start_pos,
+                k_scale=ksc, v_scale=vsc)[None]
         else:
-            slot_k = _paged_view(ck, table_row[None])  # [1, Lv, H, dh]
-            slot_v = _paged_view(cv, table_row[None])
+            if quant:
+                slot_k = _paged_deq_view(ck, ksc, table_row[None])
+                slot_v = _paged_deq_view(cv, vsc, table_row[None])
+            else:
+                slot_k = _paged_view(ck, table_row[None])  # [1, Lv, H, dh]
+                slot_v = _paged_view(cv, table_row[None])
             s = jnp.einsum("bthd,bshd->bhts", q * scale, slot_k)
             mask = jnp.arange(Lv)[None, :] <= positions[:, None]
             s = jnp.where(mask[None, None], s, _NEG_INF)
             p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhts,bshd->bthd", p, slot_v)
+            o = jnp.einsum("bhts,bshd->bthd", p, slot_v).astype(x.dtype)
         x = x + o.reshape(1, C, cfg.dim) @ blk["wo"]
         h = _ln(x, blk["ln2"])
         if "moe" in blk:
@@ -684,7 +903,8 @@ def paged_prefill_chunk(params, cache, chunk, start_pos, table_row,
 
 def paged_verify_step(params, cache, window, pos, wpos, tables,
                       cfg: TransformerConfig, adapters=None,
-                      adapter_idx=None, kernel="gather"):
+                      adapter_idx=None, kernel="gather",
+                      kv_quant="none"):
     """Speculative-decoding verify: run a K-token `window` [S, K] per
     slot (the pending token followed by K-1 drafted tokens) through the
     paged cache in ONE batched step, returning logits for every window
@@ -701,10 +921,17 @@ def paged_verify_step(params, cache, window, pos, wpos, tables,
     engine's acceptance rule checks. Chunk-family numerics
     (scale-into-q, -1e30 mask), the same low-bit-vs-decode_step class
     prefill_chunk documents; kernel="fused" runs the same family
-    through the in-kernel table walk (parallel/paged_attention.py)."""
+    through the in-kernel table walk (parallel/paged_attention.py).
+    `kv_quant` quantizes the window's writes at the scatter (a window
+    row opening a fresh block commits its scale; re-writes of rejected
+    draft positions clip to the committed scale until the block is
+    re-opened) and dequantizes the reads, fused or gathered."""
     from ..parallel.attention import _NEG_INF
 
     _paged_kernel_check(kernel)
+    _kv_quant_check(kv_quant)
+    quant = kv_quant != "none"
+    qmax = _KV_QMAX.get(kv_quant)
     S, K = window.shape
     NB, Bt, H, dh = cache[0]["k"].shape
     Lv = tables.shape[1] * Bt
@@ -719,22 +946,36 @@ def paged_verify_step(params, cache, window, pos, wpos, tables,
         k = (h @ blk["wk"]).reshape(S, K, cfg.heads, dh)
         v = v.reshape(S, K, cfg.heads, dh)
         pk, off = _phys_rows(tables, wpos, NB, Bt)  # [S, K]
-        ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
-        cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
-        new_cache.append({"k": ck, "v": cv})
+        if quant:
+            ck, ksc = _quant_scatter(kv["k"], kv["k_scale"], pk, off,
+                                     k, qmax)
+            cv, vsc = _quant_scatter(kv["v"], kv["v_scale"], pk, off,
+                                     v, qmax)
+            new_cache.append({"k": ck, "v": cv,
+                              "k_scale": ksc, "v_scale": vsc})
+        else:
+            ksc = vsc = None
+            ck = kv["k"].at[pk, off].set(k.astype(kv["k"].dtype))
+            cv = kv["v"].at[pk, off].set(v.astype(kv["v"].dtype))
+            new_cache.append({"k": ck, "v": cv})
         if kernel == "fused":
             from ..parallel.paged_attention import (
                 paged_verify_attention)
 
-            o = paged_verify_attention(q, ck, cv, tables, pos)
+            o = paged_verify_attention(q, ck, cv, tables, pos,
+                                       k_scale=ksc, v_scale=vsc)
         else:
-            kview = _paged_view(ck, tables)  # [S, Lv, H, dh]
-            vview = _paged_view(cv, tables)
+            if quant:
+                kview = _paged_deq_view(ck, ksc, tables)
+                vview = _paged_deq_view(cv, vsc, tables)
+            else:
+                kview = _paged_view(ck, tables)  # [S, Lv, H, dh]
+                vview = _paged_view(cv, tables)
             s = jnp.einsum("bthd,bshd->bhts", q * scale, kview)
             mask = jnp.arange(Lv)[None, None, :] <= positions[:, :, None]
             s = jnp.where(mask[:, None], s, _NEG_INF)
             p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhts,bshd->bthd", p, vview)
+            o = jnp.einsum("bhts,bshd->bthd", p, vview).astype(x.dtype)
         x = x + o.reshape(S, K, cfg.dim) @ blk["wo"]
         h = _ln(x, blk["ln2"])
         if "moe" in blk:
@@ -752,7 +993,8 @@ def paged_verify_step(params, cache, window, pos, wpos, tables,
 
 
 __all__ += ["init_paged_kv_cache", "paged_decode_step",
-            "paged_prefill_chunk", "paged_verify_step"]
+            "paged_prefill_chunk", "paged_verify_step",
+            "kv_storage_dtype", "kv_block_bytes"]
 
 
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,

@@ -43,13 +43,21 @@ path runs, so fused-vs-gather logits agree to float tolerance, not bit
 the engine), the same class as the padded-prefill drift documented
 since PR 2.
 
+Quantized pools (ISSUE 14): with `k_scale`/`v_scale` [NB, H] the
+pools hold int8/fp8 codes and the kernels dequantize IN VMEM — the
+scales ride as scalar-prefetch operands (SMEM, like the tables), the
+DMA stays in the storage dtype, and each per-head f32 slice
+multiplies by its block's scalar scale before the matmuls. The same
+no-HBM-view discipline, applied to the dequantized values: they
+never exist outside VMEM.
+
 `interpret=None` resolves via kernel_utils.resolve_interpret: CPU CI
 runs the identical kernel interpreted; on TPU it compiles to Mosaic.
 
 Alignment: the pool's block rows are the sublane dim — keep
-`kv_block_tokens` a multiple of 8 (f32; 16 for bf16) — and Dh is the
-lane dim (128-aligned Dh runs the MXU full-width; smaller Dh works,
-padded).
+`kv_block_tokens` a multiple of 8 (f32; 16 for bf16; 32 for int8/fp8
+storage) — and Dh is the lane dim (128-aligned Dh runs the MXU
+full-width; smaller Dh works, padded).
 """
 
 from __future__ import annotations
@@ -68,8 +76,8 @@ __all__ = ["paged_decode_attention", "paged_verify_attention",
            "paged_prefill_attention"]
 
 
-def _pa_kernel(tbl_ref, base_ref, q_ref, *refs, Bt: int, R: int,
-               G: int, scale: float, scale_in_q: bool):
+def _pa_kernel(*args, Bt: int, R: int, G: int, scale: float,
+               scale_in_q: bool, quant: bool):
     """One (slot, table-GROUP) grid step: stream the G consecutive
     blocks the slot's table names at this depth range, fold them into
     the running online-softmax state for all R window rows of every
@@ -94,7 +102,23 @@ def _pa_kernel(tbl_ref, base_ref, q_ref, *refs, Bt: int, R: int,
     the comment below); and G groups blocks until G*Bt >= 128 so the
     score tile spans full 128-lane tiles (the reference
     pages_per_compute_block idea, jax paged_attention_kernel — also
-    fewer, larger grid steps for the DMA pipeline to overlap)."""
+    fewer, larger grid steps for the DMA pipeline to overlap).
+
+    With `quant` (ISSUE 14) the pools hold int8/fp8 codes and two more
+    SCALAR-PREFETCH operands carry the per-(physical block, head)
+    absmax scales [NB, H] f32: after each group's blocks upcast to f32
+    in VMEM (the same one-upcast-then-slice-f32 discipline the 16-bit
+    path needs anyway), every per-head 2D slice multiplies by its
+    block's scalar scale read from SMEM — dequantization happens
+    entirely in VMEM/SMEM, the DMA stays in the storage dtype, and no
+    HBM-materialised dequantized view ever exists (the discipline that
+    killed the gather tax, applied to the quant read path)."""
+    if quant:
+        tbl_ref, base_ref, ksc_ref, vsc_ref, q_ref = args[:5]
+        refs = args[5:]
+    else:
+        tbl_ref, base_ref, q_ref = args[:3]
+        refs = args[3:]
     k_refs = refs[:G]
     v_refs = refs[G:2 * G]
     o_ref = refs[2 * G]
@@ -131,6 +155,12 @@ def _pa_kernel(tbl_ref, base_ref, q_ref, *refs, Bt: int, R: int,
         q = q_ref[0].astype(jnp.float32)  # [R, H, Dh]
         ks = [r[0].astype(jnp.float32) for r in k_refs]  # G x [Bt, H, Dh]
         vs = [r[0].astype(jnp.float32) for r in v_refs]
+        if quant:
+            # the physical block each group entry streamed (the same
+            # expression its index map used; -1 clamps to 0 — its
+            # scale is garbage-but-finite, position-masked below)
+            pbs = [jnp.maximum(tbl_ref[si, b * G + g], 0)
+                   for g in range(G)]
         if scale_in_q:  # chunk family: scale folded into q pre-matmul
             q = q * scale
         # position mask: row r (global position base + r) attends
@@ -141,8 +171,19 @@ def _pa_kernel(tbl_ref, base_ref, q_ref, *refs, Bt: int, R: int,
         rowpos = base + jax.lax.broadcasted_iota(jnp.int32, (R, W), 0)
         masked = depth > rowpos  # [R, W]
         for hh in range(H):
-            k = jnp.concatenate([kk[:, hh, :] for kk in ks], axis=0)
-            v = jnp.concatenate([vv[:, hh, :] for vv in vs], axis=0)
+            if quant:
+                # dequant per (group entry, head): 2D f32 slice times
+                # one scalar SMEM scale — layout-safe (no mid-dim
+                # vector ops on the quantized block)
+                k = jnp.concatenate(
+                    [ks[g][:, hh, :] * ksc_ref[pbs[g], hh]
+                     for g in range(G)], axis=0)
+                v = jnp.concatenate(
+                    [vs[g][:, hh, :] * vsc_ref[pbs[g], hh]
+                     for g in range(G)], axis=0)
+            else:
+                k = jnp.concatenate([kk[:, hh, :] for kk in ks], axis=0)
+                v = jnp.concatenate([vv[:, hh, :] for vv in vs], axis=0)
             s = jax.lax.dot_general(
                 q[:, hh, :], k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -185,10 +226,18 @@ def _pa_kernel(tbl_ref, base_ref, q_ref, *refs, Bt: int, R: int,
 
 
 def _paged_attention(q, k_pool, v_pool, tables, base, *, scale,
-                     scale_in_q, interpret):
+                     scale_in_q, interpret, k_scale=None, v_scale=None):
     """Shared pallas_call builder: q [S, R, H, Dh] windows based at
     `base` [S] over per-slot tables [S, MAXB] into the pools
     [NB, Bt, H, Dh] -> out [S, R, H, Dh].
+
+    `k_scale`/`v_scale` [NB, H] f32 (both or neither) mark a quantized
+    pool (ISSUE 14): they ride as two more scalar-prefetch operands —
+    SMEM-resident like the tables, read per (block, head) scalar in
+    the kernel body — and the blocks dequantize in VMEM after the DMA.
+    SMEM cost is 2 x NB x H x 4 bytes; at pool sizes where that
+    presses the scalar-memory budget, shrink NB (more, smaller
+    engines) before reaching for a VMEM-block scale plumbing.
 
     The window-row dim R is the kernel's sublane dim: Mosaic wants it
     in whole 8-row tiles (the flash kernel refuses blocks under 8 for
@@ -203,6 +252,9 @@ def _paged_attention(q, k_pool, v_pool, tables, base, *, scale,
     maxb = tables.shape[1]
     tables = jnp.asarray(tables, jnp.int32)
     base = jnp.asarray(base, jnp.int32)
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
     Rp = R if R == 1 else -(-R // 8) * 8
     if Rp != R:
         q = jnp.concatenate(
@@ -219,11 +271,14 @@ def _paged_attention(q, k_pool, v_pool, tables, base, *, scale,
         tables = jnp.concatenate(
             [tables, jnp.full((S, pad), -1, jnp.int32)], axis=1)
 
-    def _q_map(si, b, tbl, pos):
+    # index maps take the scalar-prefetch refs after the grid indices:
+    # (tbl, pos) unquantized, (tbl, pos, ksc, vsc) quantized — only
+    # tbl is consulted, so the maps accept either arity
+    def _q_map(si, b, tbl, *pref):
         return (si, 0, 0, 0)
 
     def _kv_map(g):
-        def _map(si, b, tbl, pos):
+        def _map(si, b, tbl, *pref):
             # THE gather: the pipeline DMAs pool block tbl[s, b*G+g]
             # for this grid step. -1 (unallocated or group padding)
             # clamps to block 0 — its rows are excluded by the
@@ -233,10 +288,14 @@ def _paged_attention(q, k_pool, v_pool, tables, base, *, scale,
 
     kernel = functools.partial(
         _pa_kernel, Bt=Bt, R=Rp, G=G, scale=scale,
-        scale_in_q=scale_in_q,
+        scale_in_q=scale_in_q, quant=quant,
     )
+    prefetch = (tables, base)
+    if quant:
+        prefetch = prefetch + (jnp.asarray(k_scale, jnp.float32),
+                               jnp.asarray(v_scale, jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=(S, (maxb + pad) // G),
         in_specs=[pl.BlockSpec((1, Rp, H, dh), _q_map)]
         + [pl.BlockSpec((1, Bt, H, dh), _kv_map(g)) for g in range(G)]
@@ -251,7 +310,7 @@ def _paged_attention(q, k_pool, v_pool, tables, base, *, scale,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, H, Rp, dh), q.dtype),
         interpret=resolve_interpret(interpret),
-    )(tables, base, q, *([k_pool] * G), *([v_pool] * G))
+    )(*prefetch, q, *([k_pool] * G), *([v_pool] * G))
     # the kernel emits head-major [S, H, Rp, Dh] (leading-dim writes
     # only); this transpose is ordinary XLA on the activation-sized
     # output, not a pool-sized materialisation
@@ -260,7 +319,7 @@ def _paged_attention(q, k_pool, v_pool, tables, base, *, scale,
 
 
 def paged_decode_attention(q, k_pool, v_pool, tables, pos,
-                           interpret=None):
+                           interpret=None, k_scale=None, v_scale=None):
     """Batched single-token paged decode attention: one query per slot.
 
     q [S, H, Dh] at per-slot positions `pos` [S] over block tables
@@ -269,33 +328,35 @@ def paged_decode_attention(q, k_pool, v_pool, tables, pos,
     scaling, depths > pos excluded) without ever materialising the
     view. A parked row (pos >= MAXB*Bt) attends everything its table
     clamps to — garbage out, exactly like the gather path, and nothing
-    reads it."""
+    reads it. `k_scale`/`v_scale` [NB, H] dequantize an int8/fp8 pool
+    inside the kernel (ISSUE 14)."""
     S, H, dh = q.shape
     out = _paged_attention(
         q[:, None], k_pool, v_pool, tables, pos,
         scale=1.0 / math.sqrt(dh), scale_in_q=False,
-        interpret=interpret,
+        interpret=interpret, k_scale=k_scale, v_scale=v_scale,
     )
     return out[:, 0]
 
 
 def paged_verify_attention(q, k_pool, v_pool, tables, pos,
-                           interpret=None):
+                           interpret=None, k_scale=None, v_scale=None):
     """K-row paged verify windows (the spec-decode path): q [S, K, H,
     Dh], row (s, i) at global position pos[s] + i, attending the slot's
     cache up to and including itself — the intra-window causal prefix
     falls out of the position mask, exactly like `paged_verify_step`'s
-    gather form. Chunk-family numerics (scale-into-q)."""
+    gather form. Chunk-family numerics (scale-into-q); scales
+    dequantize a quantized pool in-kernel (ISSUE 14)."""
     dh = q.shape[-1]
     return _paged_attention(
         q, k_pool, v_pool, tables, pos,
         scale=1.0 / math.sqrt(dh), scale_in_q=True,
-        interpret=interpret,
+        interpret=interpret, k_scale=k_scale, v_scale=v_scale,
     )
 
 
 def paged_prefill_attention(q, k_pool, v_pool, table_row, start,
-                            interpret=None):
+                            interpret=None, k_scale=None, v_scale=None):
     """Chunked paged prefill attention for ONE slot: a [C]-token chunk
     q [C, H, Dh] whose first row sits at global position `start`,
     attending cache[0:start] plus the intra-chunk causal prefix through
@@ -303,12 +364,13 @@ def paged_prefill_attention(q, k_pool, v_pool, table_row, start,
     rows past true_len compute garbage nothing reads — identical
     semantics to `paged_prefill_chunk`'s gather form. The whole chunk
     stays resident in VMEM (C <= max_len; at serving shapes a chunk is
-    `prefill_chunk_tokens`, well under the VMEM budget)."""
+    `prefill_chunk_tokens`, well under the VMEM budget). Scales
+    dequantize a quantized pool in-kernel (ISSUE 14)."""
     C, H, dh = q.shape
     out = _paged_attention(
         q[None], k_pool, v_pool, jnp.asarray(table_row)[None],
         jnp.asarray(start, jnp.int32).reshape(1),
         scale=1.0 / math.sqrt(dh), scale_in_q=True,
-        interpret=interpret,
+        interpret=interpret, k_scale=k_scale, v_scale=v_scale,
     )
     return out[0]

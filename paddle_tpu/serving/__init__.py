@@ -1,6 +1,8 @@
 """Continuous-batching inference serving (ISSUE 2 tentpole + ISSUE 4
-prefix reuse + ISSUE 6 fleet + ISSUE 7 paged KV): a paged KV block
-pool with per-slot block tables + prefix reuse by ref-counted block
+prefix reuse + ISSUE 6 fleet + ISSUE 7 paged KV + ISSUE 14
+quantization): a paged KV block pool with per-slot block tables (f32,
+or int8/fp8 codes with per-block absmax scales — quantization.py +
+the kv_quant engine knob) + prefix reuse by ref-counted block
 aliasing + chunked prefill + one compiled decode (or speculative
 verify) step over models/transformer.py's paged primitives, replicated
 behind a fault-tolerant front door. See engine.py for the engine
@@ -28,6 +30,12 @@ from .fleet import (
 from .kv_blocks import KVBlockAllocator
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache, PrefixMatch, chain_keys
+from .quantization import (
+    QuantTensor,
+    dequantize_params,
+    params_bytes,
+    quantize_params,
+)
 from .tenancy import (
     Tenant,
     TenantQuotaExceeded,
@@ -43,4 +51,5 @@ __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
            "FleetTimeout", "RolloutAborted", "save_weights",
            "AdapterPool", "AdapterRegistry", "make_adapter",
            "Tenant", "TenantRegistry", "TenantQuotaExceeded",
-           "WFQueue", "executor_batch_fn"]
+           "WFQueue", "executor_batch_fn", "QuantTensor",
+           "quantize_params", "dequantize_params", "params_bytes"]

@@ -106,6 +106,7 @@ from .adapters import AdapterPool
 from .kv_blocks import KVBlockAllocator
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
+from .quantization import dequantize_params, quantize_params
 
 __all__ = ["ServingEngine", "ServingHandle", "EngineFailed"]
 
@@ -241,6 +242,27 @@ class ServingEngine(object):
     overrides when the arg is None. Greedy outputs are token-identical
     either way (tests/test_paged_kernel.py pins it per primitive and
     end-to-end).
+
+    `kv_quant` (ISSUE 14) picks the KV pool's STORAGE dtype:
+    "none" (the default — cache structure and traces byte-identical
+    to the pre-quant engine), "int8", or "fp8" (float8_e4m3fn). At
+    block granularity: each physical block carries a per-head f32
+    absmax scale (side-bands on the cache pytree, keyed by physical
+    block id), committed when the block is first filled — so prefix
+    ALIASING shares the scale with the payload for free, COW copies
+    both in one compiled op, and eviction/reuse recommits on the next
+    fill. Writes quantize at the scatter inside the one compiled
+    step; reads dequantize inside the fused Pallas kernels (scales as
+    scalar-prefetch operands — no HBM-materialised dequantized view)
+    or on the gather view on CPU. int8/fp8 holds ~4x the resident
+    blocks per HBM byte at a fixed byte budget; `bench.py
+    serving_quant` pins the greedy-agreement quality gate. NOT
+    token-identical to f32 — a quantized engine is a different model
+    by design, which is why a fleet refuses mixed kv_quant replicas.
+    `weight_quant` ("int8" | None) additionally stores the params as
+    per-tensor int8 + f32 scales (serving/quantization.py), dequant
+    folded into the compiled steps — the decode HBM roofline's weight
+    term drops ~4x independently of the KV side.
     """
 
     def __init__(self, params, cfg, max_slots=8, max_len=None,
@@ -251,7 +273,8 @@ class ServingEngine(object):
                  replica_id=None, fault_injector=None,
                  scheduler_hook=None, weights_version=None,
                  adapter_registry=None, adapter_slots=8,
-                 adapter_rank=None, paged_kernel=None):
+                 adapter_rank=None, paged_kernel=None,
+                 kv_quant="none", weight_quant=None):
         self._params = params
         self._cfg = cfg
         # deterministic-exploration seam (ISSUE 9): the fleet threads
@@ -331,8 +354,26 @@ class ServingEngine(object):
                 "paged_kernel must be 'fused' or 'gather' (got %r)"
                 % (pk,))
         self.paged_kernel = pk
+        # per-block KV quantization (ISSUE 14): the pool's storage
+        # dtype, fixed for the engine's lifetime (baked into the cache
+        # pytree AND the compiled steps). 'none' keeps the exact
+        # pre-quant cache structure and traces, so the default engine
+        # stays token-identical to the PR 13 tree.
+        tlm._kv_quant_check(kv_quant)
+        if kv_quant != "none":
+            tlm.kv_storage_dtype(kv_quant)  # loud fp8-support gate
+        self.kv_quant = kv_quant
+        # per-tensor int8 weights (ISSUE 14): quantized ONCE below;
+        # dequant is the first op of every compiled step
+        if weight_quant not in (None, "int8"):
+            raise ValueError(
+                "weight_quant must be None or 'int8' (got %r)"
+                % (weight_quant,))
+        self.weight_quant = weight_quant
         self.metrics = ServingMetrics(S)
         self.metrics.paged_kernel = pk
+        self.metrics.kv_quant = kv_quant
+        self.metrics.weight_quant = weight_quant
         self.metrics.kv_blocks_total = NB
         # live-rollout version fence (ISSUE 11): the weight version
         # these params came from — fixed for the engine's lifetime (a
@@ -342,7 +383,17 @@ class ServingEngine(object):
         self.weights_version = (
             None if weights_version is None else int(weights_version))
         self.metrics.weights_version = self.weights_version
-        self._alloc = KVBlockAllocator(NB, Bt)  # guarded-by: scheduler
+        # one block's HBM cost, honest about the storage dtype (the
+        # README sizing rule's block_bytes, surfaced through the
+        # allocator's stats) — tlm.kv_block_bytes is the ONE formula,
+        # shared with bench.py's byte-budget sizing and
+        # bench_offline's roofline
+        block_bytes = tlm.kv_block_bytes(
+            cfg.layers, cfg.heads, cfg.dim // cfg.heads, Bt, kv_quant,
+            act_itemsize=jnp.dtype(cfg.dtype).itemsize)
+        self.kv_block_bytes = block_bytes
+        self._alloc = KVBlockAllocator(NB, Bt,
+                                       block_bytes=block_bytes)  # guarded-by: scheduler
         self.prefix_cache: Optional[PrefixCache] = None
         if prefix_cache_tokens:
             self.prefix_cache = PrefixCache(
@@ -363,7 +414,15 @@ class ServingEngine(object):
                 rank=adapter_rank)
             self.metrics.adapter_pool = self._adapter_pool
 
-        self._cache = tlm.init_paged_kv_cache(cfg, NB, Bt)
+        self._cache = tlm.init_paged_kv_cache(cfg, NB, Bt,
+                                              kv_quant=kv_quant)
+        if weight_quant is not None:
+            # quantize ONCE; the f32 tree the caller handed in is
+            # theirs (fleet CRC walks / rollout see full precision) —
+            # the engine's resident copy is int8 + per-tensor scales
+            self._params = quantize_params(self._params)
+        self._deq = (dequantize_params if weight_quant is not None
+                     else None)
         # host-side truth of the per-slot side-bands; device copies are
         # kept across steps and re-uploaded only when dirtied. All
         # scheduler state below is confined to the thread driving
@@ -429,10 +488,14 @@ class ServingEngine(object):
         cfg, metrics = self._cfg, self.metrics
         Lv = self.blocks_per_slot * self.kv_block_tokens
         kernel = self.paged_kernel  # baked into the one compiled step
+        kv_quant = self.kv_quant    # ditto: storage dtype is traced in
+        deq = self._deq
 
         def _decode(params, cache, tables, tok, pos, alive, temps,
                     counts, base_keys, adapters=None, aidx=None):
             metrics.count_trace("decode_step")  # trace-time side effect
+            if deq is not None:  # int8 weights upcast INSIDE the step
+                params = deq(params)
             # dead slots park their write past the table span: the
             # block lookup resolves them to the out-of-range sentinel
             # block and the scatter DROPS the row, so a retired slot
@@ -441,6 +504,7 @@ class ServingEngine(object):
             logits, cache = tlm.paged_decode_step(
                 params, tok, write_pos, tables, cache, cfg,
                 adapters=adapters, adapter_idx=aidx, kernel=kernel,
+                kv_quant=kv_quant,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
@@ -471,10 +535,14 @@ class ServingEngine(object):
         K = self.spec_draft_len
         Lv = self.blocks_per_slot * self.kv_block_tokens
         kernel = self.paged_kernel  # baked into the one compiled step
+        kv_quant = self.kv_quant
+        deq = self._deq
 
         def _verify(params, cache, tables, window, pos, alive, limits,
                     temps, counts, base_keys, adapters=None, aidx=None):
             metrics.count_trace("spec_verify")  # trace-time side effect
+            if deq is not None:
+                params = deq(params)
             rows = pos[:, None] + jnp.arange(K)[None, :]  # [S, K]
             # dead slots and rows past the request's token budget park
             ok = alive[:, None] & (rows < limits[:, None])
@@ -482,6 +550,7 @@ class ServingEngine(object):
             logits, cache = tlm.paged_verify_step(
                 params, cache, window, pos, wpos, tables, cfg,
                 adapters=adapters, adapter_idx=aidx, kernel=kernel,
+                kv_quant=kv_quant,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             # per-position sampling keys: position i of a slot whose
@@ -519,14 +588,18 @@ class ServingEngine(object):
             return fn
         cfg, metrics = self._cfg, self.metrics
         kernel = self.paged_kernel  # baked into the per-bucket step
+        kv_quant = self.kv_quant
+        deq = self._deq
 
         def _chunk(params, cache, padded, start, table_row, true_len,
                    temp, key, adapters=None, aidx=None):
             metrics.count_trace("prefill_T%d" % Cb)
+            if deq is not None:
+                params = deq(params)
             logits, cache = tlm.paged_prefill_chunk(
                 params, cache, padded, start, table_row, cfg,
                 true_len=true_len, adapters=adapters, adapter_idx=aidx,
-                kernel=kernel,
+                kernel=kernel, kv_quant=kv_quant,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             sampled = jax.random.categorical(
@@ -546,14 +619,19 @@ class ServingEngine(object):
         """Copy-on-write: privatise one shared block before the suffix
         writes into it. ONE compiled shape total (fixed block size) —
         the only device copy left in the reuse path; plain aliasing
-        moves zero bytes."""
+        moves zero bytes. On a quantized pool each layer dict also
+        carries the k_scale/v_scale side-bands, row-indexed by the
+        same physical block id — copying every band privatises
+        payload AND scale in the same compiled op, so the private
+        block dequantizes bit-identically to the shared one it
+        forked from."""
         metrics = self.metrics
 
         def _cow(cache, dst, src):
             metrics.count_trace("cow_copy")
             return [
-                {"k": kv["k"].at[dst].set(kv["k"][src]),
-                 "v": kv["v"].at[dst].set(kv["v"][src])}
+                {band: buf.at[dst].set(buf[src])
+                 for band, buf in kv.items()}
                 for kv in cache
             ]
 

@@ -252,8 +252,11 @@ _DEMOTED = "demoted"
 _RETIRED = "retired"
 
 # per-replica stats that are GAUGES (a dead incarnation's value is
-# meaningless going forward): never folded into cumulative _stats_base
-_GAUGE_STATS = ("kv_blocks_in_use", "step_ewma_s", "busy")
+# meaningless going forward): never folded into cumulative _stats_base.
+# The construction labels (paged_kernel, kv_quant, weight_quant) are
+# non-numeric gauges: folding them would TypeError on replica death
+_GAUGE_STATS = ("kv_blocks_in_use", "step_ewma_s", "busy",
+                "paged_kernel", "kv_quant", "weight_quant")
 
 
 def _lower_median(xs: List[float]) -> Optional[float]:
@@ -1158,6 +1161,14 @@ class _Replica(object):
             "step_ewma_s": m.step_ewma_s,
             "busy": bool(self._serving) or bool(e.live_slots)
             or bool(e.queue_depth) or bool(e.prefilling_slots),
+            # construction gauges the fleet's per-replica rows surface:
+            # which paged kernel this incarnation's steps attend with
+            # (ISSUE 13 — previously only read, never exported, so the
+            # row was always None) and the ISSUE 14 storage dtypes
+            # (getattr: scripted metric surfaces predate them)
+            "paged_kernel": getattr(m, "paged_kernel", None),
+            "kv_quant": getattr(m, "kv_quant", None),
+            "weight_quant": getattr(m, "weight_quant", None),
         }
         if e.prefix_cache is not None:
             out["prefix_hits"] = e.prefix_cache.hits
@@ -1428,6 +1439,15 @@ class ServingFleet(object):
         # queues instead)
         _, self.block_tokens, self._pool_blocks = self._limits_for(
             self._engine_kw)
+        # ONE storage dtype (ISSUE 14): failover, token-level resume,
+        # and prefix-summary affinity all assume every replica decodes
+        # the same numerics — a request hedged from an int8 replica to
+        # an f32 one would change models mid-sequence. The base kw's
+        # quant settings are the fleet's; per-replica overrides that
+        # differ are refused at spawn (_make_replica), like the block
+        # granularity under affinity but unconditionally.
+        self.kv_quant = str(self._engine_kw.get("kv_quant") or "none")
+        self.weight_quant = self._engine_kw.get("weight_quant")
         # chain keys only pay off when there is a pool to match: with
         # no base prefix_cache_tokens every summary stays empty, so
         # skip the per-submit O(T0) crc work entirely
@@ -1644,6 +1664,23 @@ class ServingFleet(object):
                 "affinity routing requires a uniform block granularity "
                 "across replicas (fleet %d, replica %d override %r)"
                 % (self.block_tokens, index, rep_bt))
+        # mixed-quant fleet: refused loudly (ISSUE 14). Unlike the
+        # block-size rule this is unconditional — failover/resume move
+        # requests between replicas, and a replica decoding different
+        # numerics would silently change a request's model mid-stream
+        rep_kvq = str(kw.get("kv_quant") or "none")
+        if rep_kvq != self.kv_quant:
+            raise ValueError(
+                "mixed-quant fleet refused: fleet kv_quant=%r, replica "
+                "%d override %r — every replica must store KV in one "
+                "dtype (failover/resume move requests between them)"
+                % (self.kv_quant, index, rep_kvq))
+        rep_wq = kw.get("weight_quant")
+        if rep_wq != self.weight_quant:
+            raise ValueError(
+                "mixed-quant fleet refused: fleet weight_quant=%r, "
+                "replica %d override %r"
+                % (self.weight_quant, index, rep_wq))
         return _Replica(self, index, incarnation, slo, kw, tier=tier,
                         params=self._params,
                         weights_version=self._weights_version)
@@ -3409,6 +3446,12 @@ class ServingFleet(object):
                     # kernel this incarnation's compiled steps attend
                     # with (from the engine's own metrics snapshot)
                     "paged_kernel": st.get("paged_kernel"),
+                    # gauges (ISSUE 14 satellite): the replica's KV
+                    # and weight storage dtypes — uniform across the
+                    # fleet by construction (mixed quant is refused at
+                    # spawn), surfaced per row as the audit trail
+                    "kv_quant": st.get("kv_quant"),
+                    "weight_quant": st.get("weight_quant"),
                     "load": len(self._inbox[i]) + len(self._in_flight[i]),
                     "stats": st,
                 })

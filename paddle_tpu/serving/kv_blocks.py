@@ -43,13 +43,19 @@ class KVBlockAllocator(object):
     """Free-list + ref-count + reservation accounting over `num_blocks`
     physical KV blocks of `block_tokens` tokens each."""
 
-    def __init__(self, num_blocks: int, block_tokens: int):
+    def __init__(self, num_blocks: int, block_tokens: int,
+                 block_bytes=None):
         if int(num_blocks) < 1:
             raise ValueError("num_blocks must be >= 1")
         if int(block_tokens) < 1:
             raise ValueError("block_tokens must be >= 1")
         self.num_blocks = int(num_blocks)
         self.block_tokens = int(block_tokens)
+        # one block's HBM cost (payload over all layers + any quant
+        # scale side-bands — the engine computes it from the STORAGE
+        # dtype, ISSUE 14), so stats() can report bytes honestly for
+        # int8/fp8 pools; None = unknown (host-only unit tests)
+        self.block_bytes = None if block_bytes is None else int(block_bytes)
         # LIFO free list (ascending ids pop first — deterministic
         # layouts for the fixed-seed drills)
         self._free = list(range(self.num_blocks - 1, -1, -1))  # guarded-by: scheduler
@@ -136,7 +142,7 @@ class KVBlockAllocator(object):
         return int(self._refs[bid])
 
     def stats(self) -> dict:
-        return {
+        out = {
             "num_blocks": self.num_blocks,
             "block_tokens": self.block_tokens,
             "blocks_in_use": self.blocks_in_use,
@@ -145,3 +151,7 @@ class KVBlockAllocator(object):
             "allocated_total": self.allocated_total,
             "freed_total": self.freed_total,
         }
+        if self.block_bytes is not None:
+            out["block_bytes"] = self.block_bytes
+            out["bytes_in_use"] = self.block_bytes * self.blocks_in_use
+        return out

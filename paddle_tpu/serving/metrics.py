@@ -84,6 +84,13 @@ class ServingMetrics(object):
         # compiled steps were traced with ("fused" Pallas table-walk or
         # "gather" XLA view; set once at engine construction)
         self.paged_kernel = None
+        # PR 14 gauges — the KV pool's storage dtype ("none" | "int8"
+        # | "fp8") and the weight storage ("int8" | None), both fixed
+        # at engine construction; the fleet's per-replica stats rows
+        # surface them (a mixed-quant fleet is refused at spawn, so
+        # these also double as the audit trail for that invariant)
+        self.kv_quant = None
+        self.weight_quant = None
         # PR 11 gauge — the weight version this engine serves (the
         # fleet's live-rollout version fence stamps it at engine
         # construction; None outside a versioned fleet). A gauge like
@@ -172,6 +179,8 @@ class ServingMetrics(object):
             "resume_tokens_reused": self.resume_tokens_reused,
             "step_ewma_s": round(self.step_ewma_s, 6),
             "paged_kernel": self.paged_kernel,
+            "kv_quant": self.kv_quant,
+            "weight_quant": self.weight_quant,
             "weights_version": self.weights_version,
         }
         if self.prefix_cache is not None:

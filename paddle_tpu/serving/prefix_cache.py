@@ -29,9 +29,15 @@ PoolAllocator/MemoryHandle — PARITY.md PR 4):
   * counters — hits/misses/evictions/tokens-saved, O(1) ints (the same
     no-unbounded-lists rule ServingMetrics follows).
 
-Payloads are OPAQUE to the pool (the engine stores per-layer stacked
-K/V device arrays); the trie, budget, LRU, and ref-count logic are
-pure host bookkeeping and unit-testable without a device.
+Payloads are OPAQUE to the pool (the paged engine stores PHYSICAL
+block ids); the trie, budget, LRU, and ref-count logic are pure host
+bookkeeping and unit-testable without a device. Opacity is what makes
+quantized pools (ISSUE 14) free here: a published block id names the
+payload AND its per-(block, head) scale side-band — both live in the
+cache pytree keyed by that id — so an aliasing hit shares the scale
+with the payload and the trie never learns storage dtypes exist
+(within one engine the pool has exactly one storage dtype; across a
+fleet, uniformity is enforced at replica spawn).
 """
 
 from __future__ import annotations

@@ -490,6 +490,81 @@ def test_serving_paged_kernel_workload_contract():
     assert rec["tokens_out"] > 0
 
 
+def test_serving_quant_workload_contract():
+    """ISSUE 14 acceptance: the `serving_quant` row cannot decay into
+    a no-op — at ONE fixed KV byte budget on the fixed-seed
+    shared-header trace, int8 KV holds STRICTLY more resident slots
+    than f32 (the bench itself hard-raises otherwise), every
+    variant's greedy-prefix agreement vs the f32 run meets its armed
+    quality gate (ditto), the pool multiplier reflects int8's ~4x
+    blocks per byte, bytes-per-resident-token drops accordingly (with
+    the scale side-band's overhead visible, not hidden), and the
+    one-compiled-step discipline survives quantization."""
+    rec = bench.bench_serving_quant(
+        n_requests=6, max_slots=6, dim=32, heads=4, layers_n=2,
+        vocab=64, max_len=64, block_tokens=8, chunk_tokens=16,
+        cache_tokens=256)
+    v = rec["variants"]
+    assert v["int8"]["slots_resident"] > v["none"]["slots_resident"], rec
+    assert v["int8"]["kv_pool_blocks"] > 3 * v["none"]["kv_pool_blocks"]
+    # agreement met its gate for every variant (the bench raises on a
+    # miss — these pin the record carries the evidence)
+    for name, row in v.items():
+        assert row["agreement_vs_f32"] >= row["agreement_gate"], (name, row)
+    assert v["none"]["agreement_vs_f32"] == 1.0
+    # bytes-per-resident-token: int8 payload is 1/4 f32's, plus the
+    # per-block scale overhead (2 bands x layers x heads x 4B / Bt)
+    f32_bpt = v["none"]["bytes_per_resident_token"]
+    int8_bpt = v["int8"]["bytes_per_resident_token"]
+    assert int8_bpt < f32_bpt / 3
+    assert int8_bpt > f32_bpt / 4  # the scale side-band is not free
+    assert rec["pool_multiplier_int8"] > 3
+    assert v["weight_int8"]["weight_quant"] == "int8"
+    assert v["weight_int8"]["kv_quant"] == "none"
+
+
+def test_serving_quant_gate_stays_armed():
+    """The quality gate is a hard raise, not a report: a floor no run
+    can meet must blow up the bench (guards against the gate decaying
+    into a logged number nobody checks)."""
+    with pytest.raises(RuntimeError, match="quality gate"):
+        bench.bench_serving_quant(
+            n_requests=4, max_slots=4, dim=32, heads=4, layers_n=2,
+            vocab=64, max_len=64, block_tokens=8, chunk_tokens=16,
+            cache_tokens=256, agreement_gate=1.01)
+
+
+def test_serving_quant_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_quant", bench_serving_quant' in src
+
+
+def test_kv_bytes_per_token_cost_model():
+    """ISSUE 14 satellite: bench_offline's bytes-per-token takes the
+    storage dtype into account — int8 cuts the f32 payload 4x plus an
+    explicit scale-amortisation term (never free), and the roofline
+    record predicts a strictly higher HBM-bound tokens/s for int8
+    weights + int8 KV than for the bf16/f32 baseline."""
+    import bench_offline as bo
+
+    f32 = bo.kv_bytes_per_token(2, 4, 8, "none", 8, act_itemsize=4)
+    i8 = bo.kv_bytes_per_token(2, 4, 8, "int8", 8)
+    assert f32 == 2 * 2 * 4 * 8 * 4
+    assert i8 == 2 * 2 * 4 * 8 * 1 + 2 * 2 * 4 * 4 / 8.0
+    assert f32 / 4 < i8 < f32 / 3
+    rec = bo.offline_serving_quant_roofline(layers_n=2, dim=64, heads=4,
+                                            vocab=256, S=4, context=64,
+                                            block_tokens=8)
+    base = rec["w_none_bf16__kv_none"]["pred_tokens_per_sec_hbm_bound"]
+    best = rec["w_int8__kv_int8"]["pred_tokens_per_sec_hbm_bound"]
+    assert best > base
+    assert rec["pred_uplift_int8_over_bf16"] > 1.0
+
+
 def test_serving_paged_kernel_registered_in_bench_main():
     """The workload is wired into bench.main()'s side-workload list
     (the registration is what lands it in the driver's record)."""

@@ -1,0 +1,607 @@
+"""Quantized serving oracle suite (ISSUE 14).
+
+* Round-trip property — per-block absmax quantize/dequantize error is
+  bounded by half a code step (int8) / the e4m3 relative precision
+  (fp8) of each block's own absmax, exact 0 round-trips to exact 0,
+  and a sentinel-parked write (pk == NB) leaves payload AND scale
+  bit-untouched.
+* Scale side-band discipline — an aliased block SHARES its scale
+  (physical indexing: no copy exists to drift), COW copies payload +
+  scale in the one compiled op (the private block dequantizes
+  bit-identically), and re-opening a recycled block erases the
+  previous tenant's stale scale.
+* `-1`-table bit-identity — the PR 13 garbage-row invariant holds on
+  the quant path, adapters active, both kernel settings.
+* Adapter/quant interaction — the zero adapter stays an exact no-op
+  (bit-identical logits) on both kv_quant settings (the PR 12
+  round-2 fix class: deltas apply in activation dtype BEFORE the
+  quantizing scatter, never to the dequantized view).
+* Engine — int8/fp8 engines keep the one-compiled-step discipline
+  (quant on/off retraces nothing), outputs stay spec-/kernel-
+  invariant WITHIN a quant setting, and kv_quant='none' remains
+  token-identical to sequential generate() (the default path IS the
+  PR 13 path).
+* Weight quant — per-tensor int8 round-trip bound, dequant folded
+  (no retrace), zero-tensor safety.
+* Fleet — mixed-quant fleets are refused at spawn; a uniform
+  quantized fleet serves and surfaces kv_quant/weight_quant (and the
+  PR 13 paged_kernel gauge) in its per-replica stats rows.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.adapters import AdapterRegistry, make_adapter
+from paddle_tpu.serving.quantization import (
+    QuantTensor, dequantize_params, params_bytes, quantize_params)
+
+_HAS_FP8 = hasattr(jnp, "float8_e4m3fn")
+_KVQS = ["int8", "fp8"] if _HAS_FP8 else ["int8"]
+
+
+def _cfg(**kw):
+    kw.setdefault("vocab", 50)
+    kw.setdefault("dim", 32)
+    kw.setdefault("heads", 4)
+    kw.setdefault("layers", 2)
+    kw.setdefault("max_len", 64)
+    return T.TransformerConfig(**kw)
+
+
+def _mk(seed=0, **kw):
+    cfg = _cfg(**kw)
+    return cfg, T.init_params(cfg, jax.random.PRNGKey(seed))
+
+
+def _full(h):
+    return np.concatenate([h.full_prompt, np.asarray(h.tokens, np.int32)])
+
+
+def _oracle(params, cfg, prompt, max_new):
+    return np.asarray(
+        T.generate(params, jnp.asarray(prompt)[None], cfg, max_new)
+    )[0]
+
+
+# ---------------------------------------------------------------------
+# round-trip properties of the quantizing scatter
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_quant_scatter_round_trip_error_bound(kvq):
+    """Fill one block with random rows in one call (the chunk-fill
+    shape: every row off 0..Bt-1, call-commit): dequantized values
+    must sit within the absmax-scale error bound of the originals,
+    per head."""
+    rng = np.random.RandomState(0)
+    NB, Bt, H, dh = 4, 8, 3, 16
+    qmax = T._KV_QMAX[kvq]
+    st = T.kv_storage_dtype(kvq)
+    buf = jnp.zeros((NB, Bt, H, dh), st)
+    scale = jnp.zeros((NB, H), jnp.float32)
+    vals = jnp.asarray(5.0 * rng.randn(Bt, H, dh).astype(np.float32))
+    pk = jnp.full((Bt,), 2, jnp.int32)
+    off = jnp.arange(Bt, dtype=jnp.int32)
+    nbuf, nscale = T._quant_scatter(buf, scale, pk, off, vals, qmax,
+                                    commit_from_call=True)
+    s = np.asarray(nscale)[2]  # [H]
+    amax = np.abs(np.asarray(vals)).max(axis=(0, 2))  # per-head absmax
+    np.testing.assert_allclose(s, amax / qmax, rtol=1e-6)
+    deq = np.asarray(nbuf[2], np.float32) * s[None, :, None]
+    if kvq == "int8":
+        # half a code step of the block's own scale
+        bound = (s / 2 + 1e-7)[None, :, None]
+    else:
+        # e4m3: 3 mantissa bits -> relative error <= 2^-4 of the value
+        # plus the subnormal floor at the block's scale
+        bound = np.abs(np.asarray(vals)) / 16.0 + \
+            (s * 2.0 ** -9)[None, :, None] + 1e-7
+    assert (np.abs(deq - np.asarray(vals)) <= bound).all()
+    # other blocks and their scales untouched
+    assert (np.asarray(nbuf, np.float32)[[0, 1, 3]] == 0).all()
+    assert (np.asarray(nscale)[[0, 1, 3]] == 0).all()
+
+
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_quant_row_commit_ignores_non_opening_rows(kvq):
+    """The decode/verify commit mode: the block scale comes from the
+    OPENING row alone — a window's extra (speculative-draft) rows
+    must not leak into it, or the committed scale would depend on
+    drafts that never became tokens (the spec-invariance bug class
+    this mode exists to kill)."""
+    NB, Bt, H, dh = 2, 4, 2, 8
+    qmax = T._KV_QMAX[kvq]
+    st = T.kv_storage_dtype(kvq)
+    buf = jnp.zeros((NB, Bt, H, dh), st)
+    scale = jnp.zeros((NB, H), jnp.float32)
+    vals = jnp.stack([jnp.ones((H, dh), jnp.float32),
+                      jnp.full((H, dh), 50.0, jnp.float32)])  # draft
+    nbuf, nscale = T._quant_scatter(
+        buf, scale, jnp.zeros(2, jnp.int32),
+        jnp.asarray([0, 1], jnp.int32), vals, qmax)
+    # scale from the off==0 row (absmax 1.0), NOT the 50.0 draft row
+    np.testing.assert_allclose(np.asarray(nscale)[0], 1.0 / qmax,
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_quant_exact_zero_round_trips_exact(kvq):
+    """An all-zero fill commits scale 0 and stores code 0 — dequant is
+    exactly 0.0, so zero-initialised depths can never perturb
+    attention even before the position mask."""
+    qmax = T._KV_QMAX[kvq]
+    st = T.kv_storage_dtype(kvq)
+    buf = jnp.zeros((2, 4, 2, 8), st)
+    scale = jnp.zeros((2, 2), jnp.float32)
+    vals = jnp.zeros((4, 2, 8), jnp.float32)
+    nbuf, nscale = T._quant_scatter(
+        buf, scale, jnp.zeros(4, jnp.int32),
+        jnp.arange(4, dtype=jnp.int32), vals, qmax)
+    deq = np.asarray(nbuf, np.float32) * np.asarray(nscale)[:, None, :, None]
+    assert (deq == 0.0).all()
+    assert (np.asarray(nscale) == 0.0).all()
+
+
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_quant_sentinel_parking_drops_everything(kvq):
+    """A parked write (pk == NB, the dead-slot/padded sentinel) must
+    leave the pool AND the scale band bit-untouched — including the
+    block-open marker (a parked off==0 row commits nothing)."""
+    rng = np.random.RandomState(1)
+    NB, Bt, H, dh = 3, 4, 2, 8
+    qmax = T._KV_QMAX[kvq]
+    st = T.kv_storage_dtype(kvq)
+    buf0 = jnp.asarray(rng.randint(-5, 5, (NB, Bt, H, dh)).astype(
+        np.int8)).astype(st)
+    scale0 = jnp.asarray(rng.rand(NB, H).astype(np.float32))
+    vals = jnp.asarray(rng.randn(2, H, dh).astype(np.float32))
+    pk = jnp.full((2,), NB, jnp.int32)  # the sentinel
+    off = jnp.asarray([0, 1], jnp.int32)  # off==0 included: still dropped
+    nbuf, nscale = T._quant_scatter(buf0, scale0, pk, off, vals, qmax)
+    np.testing.assert_array_equal(
+        np.asarray(nbuf, np.float32), np.asarray(buf0, np.float32))
+    np.testing.assert_array_equal(np.asarray(nscale), np.asarray(scale0))
+
+
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_quant_reopen_erases_stale_scale(kvq):
+    """A recycled block (freed, re-allocated to a new tenant) carries
+    its previous tenant's scale until the first off==0 write — which
+    must RE-commit from the new fill, not max with the stale value."""
+    NB, Bt, H, dh = 2, 4, 2, 8
+    qmax = T._KV_QMAX[kvq]
+    st = T.kv_storage_dtype(kvq)
+    buf = jnp.zeros((NB, Bt, H, dh), st)
+    stale = jnp.full((NB, H), 99.0, jnp.float32)  # previous tenant
+    vals = jnp.ones((1, H, dh), jnp.float32)  # absmax 1.0
+    nbuf, nscale = T._quant_scatter(
+        buf, stale, jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32),
+        vals, qmax)
+    np.testing.assert_allclose(np.asarray(nscale)[0], 1.0 / qmax,
+                               rtol=1e-6)
+    # the untouched block keeps its (stale) scale — nothing opened it
+    np.testing.assert_allclose(np.asarray(nscale)[1], 99.0)
+
+
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_quant_append_reuses_committed_scale(kvq):
+    """Decode appends (off > 0) re-use the committed scale and CLIP to
+    it — the block's scale must not move, and an outlier saturates at
+    qmax instead of rescaling rows already stored."""
+    NB, Bt, H, dh = 2, 4, 2, 8
+    qmax = T._KV_QMAX[kvq]
+    st = T.kv_storage_dtype(kvq)
+    buf = jnp.zeros((NB, Bt, H, dh), st)
+    scale = jnp.zeros((NB, H), jnp.float32)
+    # open block 0 with absmax 1.0 rows
+    buf, scale = T._quant_scatter(
+        buf, scale, jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32),
+        jnp.ones((1, H, dh), jnp.float32), qmax)
+    s0 = np.asarray(scale).copy()
+    # append a 10x outlier row at off 1
+    big = jnp.full((1, H, dh), 10.0, jnp.float32)
+    buf, scale = T._quant_scatter(
+        buf, scale, jnp.zeros(1, jnp.int32), jnp.ones(1, jnp.int32),
+        big, qmax)
+    np.testing.assert_array_equal(np.asarray(scale), s0)  # unmoved
+    deq = np.asarray(buf[0, 1], np.float32) * s0[0][:, None]
+    np.testing.assert_allclose(deq, 1.0, rtol=1e-5)  # clipped to absmax
+
+
+# ---------------------------------------------------------------------
+# garbage-row invariant + adapters on the quant path
+# ---------------------------------------------------------------------
+
+
+def _rand_qpool(cfg, NB, Bt, kvq, seed=0):
+    """A quantized pool whose codes AND scales hold garbage — stronger
+    than zeros for the -1 invariant (clamped entries surface finite
+    nonzero values the mask must erase exactly)."""
+    rng = np.random.RandomState(seed)
+    dh = cfg.dim // cfg.heads
+    st = T.kv_storage_dtype(kvq)
+    out = []
+    for _ in range(cfg.layers):
+        codes = rng.randint(-100, 100, (NB, Bt, cfg.heads, dh))
+        out.append({
+            "k": jnp.asarray(codes.astype(np.int8)).astype(st),
+            "v": jnp.asarray((-codes).astype(np.int8)).astype(st),
+            "k_scale": jnp.asarray(
+                rng.rand(NB, cfg.heads).astype(np.float32)),
+            "v_scale": jnp.asarray(
+                rng.rand(NB, cfg.heads).astype(np.float32)),
+        })
+    return out
+
+
+@pytest.mark.parametrize("kernel", ["gather", "fused"])
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_quant_garbage_row_invariant_bit_identical(kernel, kvq):
+    """The PR 13 `-1`-table invariant on the quant path: unallocated
+    tail entries change NOTHING vs a fully-allocated table at the same
+    positions — bit-identical logits and cache (payload AND scale),
+    adapters active, both kernel settings. The clamped entries stream
+    garbage codes times garbage scales; the position mask must erase
+    them EXACTLY."""
+    cfg, params = _mk(3)
+    NB, Bt = 12, 8
+    partial = jnp.asarray([[0, 1, -1, -1], [2, -1, -1, -1]], jnp.int32)
+    full = jnp.asarray([[0, 1, 8, 9], [2, 10, 11, 7]], jnp.int32)
+    pos = jnp.asarray([9, 5], jnp.int32)
+    tok = jnp.asarray([13, 21], jnp.int32)
+    rng = np.random.RandomState(7)
+    d = cfg.dim
+
+    def stack(shape):
+        a = np.zeros((2,) + shape, np.float32)
+        a[1] = 0.1 * rng.randn(*shape)
+        return jnp.asarray(a)
+
+    adapters = {
+        "a_q": stack((cfg.layers, d, 2)), "b_q": stack((cfg.layers, 2, d)),
+        "a_v": stack((cfg.layers, d, 2)), "b_v": stack((cfg.layers, 2, d)),
+        "scale": jnp.asarray(np.array([0.0, 0.5], np.float32)),
+    }
+    aidx = jnp.asarray([1, 0], jnp.int32)
+    la, ca = T.paged_decode_step(params, tok, pos, partial,
+                                 _rand_qpool(cfg, NB, Bt, kvq), cfg,
+                                 adapters=adapters, adapter_idx=aidx,
+                                 kernel=kernel, kv_quant=kvq)
+    lb, cb = T.paged_decode_step(params, tok, pos, full,
+                                 _rand_qpool(cfg, NB, Bt, kvq), cfg,
+                                 adapters=adapters, adapter_idx=aidx,
+                                 kernel=kernel, kv_quant=kvq)
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for xa, xb in zip(ca, cb):
+        for band in ("k", "v", "k_scale", "v_scale"):
+            np.testing.assert_array_equal(
+                np.asarray(xa[band], np.float32),
+                np.asarray(xb[band], np.float32))
+
+
+@pytest.mark.parametrize("kvq", ["none"] + _KVQS)
+def test_zero_adapter_bit_identity_on_quant_paths(kvq):
+    """ISSUE 14 satellite (the PR 12 round-2 fix class): the ZERO
+    adapter must stay an exact no-op on every kv_quant setting —
+    adapter deltas apply to q/v in activation dtype BEFORE the
+    quantizing scatter, so logits with (adapters, zero index) are
+    BIT-identical to logits with no adapter plumbing at all."""
+    cfg, params = _mk(4)
+    NB, Bt = 6, 8
+    cache = T.init_paged_kv_cache(cfg, NB, Bt, kv_quant=kvq)
+    tables = jnp.asarray([[0, 1, 2, -1]], jnp.int32)
+    pos = jnp.asarray([5], jnp.int32)
+    tok = jnp.asarray([9], jnp.int32)
+    d = cfg.dim
+    zero = {
+        "a_q": jnp.zeros((1, cfg.layers, d, 2)),
+        "b_q": jnp.zeros((1, cfg.layers, 2, d)),
+        "a_v": jnp.zeros((1, cfg.layers, d, 2)),
+        "b_v": jnp.zeros((1, cfg.layers, 2, d)),
+        "scale": jnp.zeros((1,)),
+    }
+    la, ca = T.paged_decode_step(params, tok, pos, tables, cache, cfg,
+                                 kv_quant=kvq)
+    lb, cb = T.paged_decode_step(params, tok, pos, tables, cache, cfg,
+                                 adapters=zero,
+                                 adapter_idx=jnp.zeros(1, jnp.int32),
+                                 kv_quant=kvq)
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for xa, xb in zip(ca, cb):
+        for band in xa:
+            np.testing.assert_array_equal(
+                np.asarray(xa[band], np.float32),
+                np.asarray(xb[band], np.float32))
+
+
+# ---------------------------------------------------------------------
+# engine: aliasing shares scale, COW copies it, compile counts, identity
+# ---------------------------------------------------------------------
+
+
+def test_engine_aliased_block_shares_scale_and_cow_copies_it():
+    """Through the real engine: publish a whole-block prompt, resubmit
+    it (maximal reuse -> COW). The aliased chain introduces no new
+    scale state (physical indexing shares it), and the COW'd block's
+    payload AND scale are bit-equal to its source — so the private
+    copy dequantizes identically."""
+    cfg, params = _mk(5)
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, cfg.vocab, 24).astype(np.int32)  # 3 blocks
+    eng = ServingEngine(params, cfg, max_slots=2, kv_block_tokens=8,
+                        prefix_cache_tokens=256, kv_quant="int8")
+    h0 = eng.submit(prompt, 5)
+    eng.run()
+    # trie now holds the 3 prompt blocks; find their physical ids
+    m = eng.prefix_cache.match(prompt, record=False)
+    src_ids = [int(b) for b in m.payloads]
+    m.release()
+    assert len(src_ids) == 3
+    scales_before = [
+        (np.asarray(l["k_scale"])[src_ids].copy(),
+         np.asarray(l["v_scale"])[src_ids].copy())
+        for l in eng._cache
+    ]
+    h1 = eng.submit(prompt, 5)  # maximal reuse: alias 3, COW the last
+    eng.run()
+    assert eng.metrics.cow_blocks >= 1
+    # aliasing left every published block's scale bit-untouched
+    for l, (ks, vs) in zip(eng._cache, scales_before):
+        np.testing.assert_array_equal(np.asarray(l["k_scale"])[src_ids], ks)
+        np.testing.assert_array_equal(np.asarray(l["v_scale"])[src_ids], vs)
+    np.testing.assert_array_equal(_full(h0), _full(h1))
+
+
+def test_engine_cow_copy_includes_scale_bands():
+    """The compiled COW op on a quantized cache copies every band —
+    payload and scales — in one step (pin it directly on the jitted
+    fn, not through scheduler timing)."""
+    cfg, params = _mk(6)
+    eng = ServingEngine(params, cfg, max_slots=1, kv_block_tokens=8,
+                        kv_quant="int8", donate=False)
+    rng = np.random.RandomState(6)
+    # dirty block 1's payload+scale so the copy is observable
+    cache = []
+    for l in eng._cache:
+        l = dict(l)
+        l["k"] = l["k"].at[1].set(
+            jnp.asarray(rng.randint(-9, 9, l["k"].shape[1:]), jnp.int8))
+        l["k_scale"] = l["k_scale"].at[1].set(
+            jnp.asarray(rng.rand(cfg.heads), jnp.float32))
+        cache.append(l)
+    cow = eng._make_cow()
+    out = cow(cache, jnp.int32(3), jnp.int32(1))
+    for src_l, out_l in zip(cache, out):
+        for band in ("k", "v", "k_scale", "v_scale"):
+            np.testing.assert_array_equal(
+                np.asarray(out_l[band][3], np.float32),
+                np.asarray(src_l[band][1], np.float32))
+
+
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_engine_quant_compile_counts_and_spec_invariance(kvq):
+    """Quant on/off retraces nothing beyond the documented one-step
+    discipline: decode exactly once (plain), spec-verify exactly once
+    (spec replaces decode), chunks <= #pow-2 buckets — and greedy
+    outputs are spec-invariant WITHIN the quant setting (speculation
+    batches time, never changes the quantized model's tokens)."""
+    cfg, params = _mk(7)
+    rng = np.random.RandomState(7)
+    lengths = [3, 7, 12, 5]
+    prompts = [rng.randint(0, cfg.vocab, t).astype(np.int32)
+               for t in lengths]
+
+    def drive(spec):
+        eng = ServingEngine(params, cfg, max_slots=2, kv_block_tokens=8,
+                            prefill_chunk_tokens=8,
+                            prefix_cache_tokens=128,
+                            spec_draft_len=spec, kv_quant=kvq)
+        hs = [eng.submit(p, 5, publish_len=4) for p in prompts]
+        eng.run()
+        hs += [eng.submit(p, 4) for p in prompts[:2]]  # wave 2
+        eng.run()
+        assert all(h.done for h in hs)
+        return eng, [list(h.tokens) for h in hs]
+
+    eng, out_plain = drive(None)
+    assert eng.metrics.trace_counts.get("decode_step", 0) == 1
+    buckets = {eng._bucket(t) for t in lengths}
+    assert eng.metrics.prefill_trace_count() <= len(buckets) + 1
+    eng_s, out_spec = drive(4)
+    assert eng_s.metrics.trace_counts.get("spec_verify", 0) == 1
+    assert eng_s.metrics.trace_counts.get("decode_step", 0) == 0
+    assert out_plain[:4] == out_spec[:4]
+
+
+def test_engine_default_none_is_token_identical_to_generate():
+    """The default path stays the PR 13 path: kv_quant='none' produces
+    no scale side-bands and decodes token-identically to sequential
+    generate() on the aliased path."""
+    cfg, params = _mk(8)
+    rng = np.random.RandomState(8)
+    prompts = [rng.randint(0, cfg.vocab, t).astype(np.int32)
+               for t in (5, 11)]
+    eng = ServingEngine(params, cfg, max_slots=2, kv_block_tokens=8,
+                        prefix_cache_tokens=128)
+    assert eng.kv_quant == "none"
+    assert "k_scale" not in eng._cache[0]
+    hs = [eng.submit(p, 6, publish_len=4) for p in prompts]
+    eng.run()
+    for h, p in zip(hs, prompts):
+        np.testing.assert_array_equal(_full(h), _oracle(params, cfg, p, 6))
+
+
+@pytest.mark.slow  # ~25s/variant: whole engines over the interpreted
+# Pallas kernel; the fused quant read path keeps tier-1 coverage via
+# the per-primitive garbage-row drill above
+@pytest.mark.parametrize("kvq", _KVQS)
+def test_engine_quant_fused_matches_gather_tokens(kvq):
+    """Kernel-invariance on the quant path: the fused (interpreted on
+    CPU) and gather engines emit identical tokens for a quantized
+    pool — in-kernel dequant and the gather view run the same
+    numerics."""
+    cfg, params = _mk(9)
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, cfg.vocab, t).astype(np.int32)
+               for t in (4, 9)]
+
+    def run(pk):
+        eng = ServingEngine(params, cfg, max_slots=2, kv_block_tokens=8,
+                            kv_quant=kvq, paged_kernel=pk)
+        hs = [eng.submit(p, 5) for p in prompts]
+        eng.run()
+        return [list(h.tokens) for h in hs]
+
+    assert run("fused") == run("gather")
+
+
+def test_engine_rejects_bad_quant_knobs():
+    cfg, params = _mk(10)
+    with pytest.raises(ValueError):
+        ServingEngine(params, cfg, max_slots=1, kv_quant="int4")
+    with pytest.raises(ValueError):
+        ServingEngine(params, cfg, max_slots=1, weight_quant="fp8")
+    with pytest.raises(ValueError):
+        T.paged_decode_step(params, jnp.asarray([1]), jnp.asarray([0]),
+                            jnp.asarray([[0]]),
+                            T.init_paged_kv_cache(cfg, 2, 8), cfg,
+                            kv_quant="int4")
+
+
+# ---------------------------------------------------------------------
+# weight quantization
+# ---------------------------------------------------------------------
+
+
+def test_weight_quant_round_trip_and_selection():
+    """Per-tensor int8: matrices quantize within absmax/127/2 per
+    element, 1D tensors and integer leaves pass through untouched,
+    and an all-zero tensor round-trips to exact zeros."""
+    rng = np.random.RandomState(0)
+    tree = {
+        "w": jnp.asarray(3.0 * rng.randn(8, 16).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(16).astype(np.float32)),
+        "z": jnp.zeros((4, 4), jnp.float32),
+        "i": jnp.arange(5, dtype=jnp.int32),
+    }
+    qt = quantize_params(tree)
+    assert isinstance(qt["w"], QuantTensor)
+    assert qt["w"].codes.dtype == jnp.int8
+    assert not isinstance(qt["b"], QuantTensor)
+    assert not isinstance(qt["i"], QuantTensor)
+    deq = dequantize_params(qt)
+    w = np.asarray(tree["w"])
+    bound = np.abs(w).max() / 127.0 / 2 + 1e-6
+    assert (np.abs(np.asarray(deq["w"]) - w) <= bound).all()
+    np.testing.assert_array_equal(np.asarray(deq["b"]),
+                                  np.asarray(tree["b"]))
+    np.testing.assert_array_equal(np.asarray(deq["z"]), 0.0)
+    # bytes accounting: int8 codes beat f32 4x on the quantized leaf
+    assert params_bytes(qt) < params_bytes(tree)
+
+
+def test_weight_quant_engine_serves_and_traces_once():
+    """A weight-quantized engine serves the trace with the dequant
+    folded into the one compiled decode step (no retrace, no eager
+    dequant materialisation between steps)."""
+    cfg, params = _mk(11)
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, cfg.vocab, t).astype(np.int32)
+               for t in (5, 9)]
+    eng = ServingEngine(params, cfg, max_slots=2, kv_block_tokens=8,
+                        weight_quant="int8")
+    assert eng.weight_quant == "int8"
+    assert isinstance(eng._params["blocks"][0]["wq"], QuantTensor)
+    hs = [eng.submit(p, 6) for p in prompts]
+    eng.run()
+    assert all(h.done for h in hs)
+    assert eng.metrics.trace_counts.get("decode_step", 0) == 1
+    rep = eng.metrics.report()
+    assert rep["weight_quant"] == "int8"
+    assert rep["kv_quant"] == "none"
+
+
+# ---------------------------------------------------------------------
+# fleet: refusal + per-replica stats rows
+# ---------------------------------------------------------------------
+
+
+def test_fleet_refuses_mixed_quant():
+    """A replica override changing kv_quant or weight_quant vs the
+    fleet's base is refused at spawn — before any engine compiles
+    (failover/resume move requests between replicas; a replica with
+    different numerics would change a request's model mid-stream)."""
+    from paddle_tpu.serving import ServingFleet
+
+    cfg, params = _mk(12)
+    with pytest.raises(ValueError, match="mixed-quant"):
+        ServingFleet(params, cfg, n_replicas=2,
+                     engine_kw={"kv_quant": "int8", "max_slots": 2},
+                     engine_kw_for=lambda i:
+                     {"kv_quant": "none"} if i == 1 else {})
+    with pytest.raises(ValueError, match="mixed-quant"):
+        ServingFleet(params, cfg, n_replicas=2,
+                     engine_kw={"max_slots": 2},
+                     engine_kw_for=lambda i:
+                     {"weight_quant": "int8"} if i == 0 else {})
+
+
+@pytest.mark.slow  # ~16s: three engine compiles + a failover respawn
+def test_fleet_quant_stats_rows_and_failover_fold():
+    from paddle_tpu.serving import ServingFleet
+
+    cfg, params = _mk(12)
+    fleet = ServingFleet(params, cfg, n_replicas=2,
+                         engine_kw={"kv_quant": "int8", "max_slots": 2,
+                                    "kv_block_tokens": 8})
+    try:
+        h = fleet.submit(np.arange(5, dtype=np.int32), 4)
+        h.result(timeout=60)
+        rows = fleet.stats()["replicas"]
+        assert [r["kv_quant"] for r in rows] == ["int8", "int8"]
+        assert all(r["weight_quant"] is None for r in rows)
+        # the PR 13 gauge rides the same snapshot (regression: it was
+        # read by stats() but never exported by _stats)
+        assert all(r["paged_kernel"] in ("gather", "fused")
+                   for r in rows)
+        # failover folds the dead incarnation's stats: the label
+        # gauges must die with it instead of TypeError-ing the fold
+        # (regression: the lint protocol gate wedged on exactly this)
+        fleet.kill_replica(0)
+        h2 = fleet.submit(np.arange(6, dtype=np.int32), 4)
+        h2.result(timeout=60)
+        st = fleet.stats()
+        assert "kv_quant" not in st.get("_stats_base", {})
+        assert [r["kv_quant"] for r in st["replicas"]
+                if r["kv_quant"] is not None] != []
+    finally:
+        fleet.close()
+
+
+def test_engine_block_bytes_accounting():
+    """The allocator's bytes row reflects the STORAGE dtype: an int8
+    pool's block costs ~1/4 the f32 pool's (plus the scale
+    side-band), and bytes_in_use tracks blocks_in_use."""
+    cfg, params = _mk(13)
+    dh = cfg.dim // cfg.heads
+    e32 = ServingEngine(params, cfg, max_slots=1, kv_block_tokens=8)
+    e8 = ServingEngine(params, cfg, max_slots=1, kv_block_tokens=8,
+                       kv_quant="int8")
+    exp32 = 2 * cfg.layers * 8 * cfg.heads * dh * 4
+    exp8 = 2 * cfg.layers * 8 * cfg.heads * dh + 2 * cfg.layers * cfg.heads * 4
+    assert e32.kv_block_bytes == exp32
+    assert e8.kv_block_bytes == exp8
+    st = e8._alloc.stats()
+    assert st["block_bytes"] == exp8
+    assert st["bytes_in_use"] == 0
+    h = e8.submit(np.arange(6, dtype=np.int32), 4)
+    e8.step()
+    st = e8._alloc.stats()
+    assert st["bytes_in_use"] == st["blocks_in_use"] * exp8
+    h.result()
