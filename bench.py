@@ -103,6 +103,23 @@ definitions):
               the typed tenant side-band, and every tenant's outputs
               token-identical to a per-tenant SEQUENTIAL run — N
               adapters batched over one base model change nothing
+  serving_integrity — silent-corruption tolerance acceptance
+              (ISSUE 15): the same fixed-seed Poisson shared-header
+              trace through (a) a clean fleet with canaries +
+              fingerprints armed, (b) the same fleet with one replica
+              GARBLED mid-trace (garble@ fault: wrong-but-finite
+              tokens — only a known-answer canary mismatch can see
+              it), and (c) with one resident KV block FLIPPED
+              mid-trace (flip@ fault: caught by the block-fingerprint
+              spot-check at aliased re-open); pins zero trips/
+              mismatches in the clean run, the corrupt replica
+              tripping + quarantining EXACTLY once per drill (fresh
+              incarnation via the supervisor backoff), zero lost or
+              duplicated rids, outputs token-identical to the clean
+              run (zero tainted tokens survive — the taint window
+              re-decoded on a healthy survivor), and the journal DFA
+              green --expect-closed including the J010 taint fence
+              (only tainted tokens ever re-decode)
   training_sentinel — silent-failure tolerance acceptance (ISSUE 10):
               a fixed-seed training job over shards containing one
               poisoned chunk; pins >=1 sentinel trip, rollback landing
@@ -2762,6 +2779,245 @@ def bench_serving_multitenant(n_requests=None, max_slots=None, dim=None,
     }
 
 
+def bench_serving_integrity(n_requests=None, max_slots=None, dim=None,
+                            heads=None, layers_n=None, vocab=None,
+                            max_len=None, canary_interval_s=None):
+    """Silent-corruption tolerance acceptance (ISSUE 15): the SAME
+    fixed-seed shared-header Poisson trace runs three times through a
+    2-replica fleet with the full integrity stack armed (in-step
+    numeric traps, KV block fingerprints, known-answer canaries,
+    auto_refill quarantine):
+
+      clean   no fault — pins the FALSE-POSITIVE bar: zero integrity
+              trips, zero canary mismatches, zero fingerprint
+              mismatches on a healthy fleet (canaries complete clean)
+      garble  replica 1 emits wrong-but-FINITE tokens from mid-trace
+              on (garble@, sticky — the SDC shape numeric traps cannot
+              see); the next known-answer canary mismatches, the
+              replica quarantines with its journaled progress since
+              the last clean canary TAINTED, and the taint windows
+              re-decode on the healthy survivor
+      flip    one resident KV block on replica 1 is corrupted in place
+              (flip@, finite garbage); the fingerprint spot-check at
+              the next aliased re-open (the shared header keeps
+              hitting replica 1 under prefix affinity) catches it
+
+    Hard raises, all deterministic offline: every drill's outputs
+    TOKEN-IDENTICAL to the clean run (zero tainted tokens survive into
+    final outputs — the falsifiability bar: a single laundered corrupt
+    token diverges), the corrupt replica tripped + quarantined EXACTLY
+    once per drill with the expected trip kind (canary vs fingerprint)
+    and a fresh incarnation (supervisor-backoff refill), zero rids
+    lost or duplicated, and every journal green through the protocol
+    DFA `--expect-closed` INCLUDING the J010 taint fence — re-decoded
+    tokens lie entirely inside journaled taint windows, and nothing
+    lands from a quarantined incarnation after its integrity event."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.diagnostics import format_diag
+    from paddle_tpu.analysis.protocol_lint import verify_journal
+    from paddle_tpu.distributed.fault_injection import FaultInjector
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import ServingFleet
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape: 3 fleets' worth of tiny engines
+        dim, heads, layers_n = dim or 32, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 64, max_len or 64
+        n_requests = n_requests or 8
+        max_slots = max_slots or 4
+        t_hdr, t_lo, t_hi, n_lo, n_hi, rate = 8, 2, 5, 8, 14, 0.5
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        n_requests = n_requests or 24
+        max_slots = max_slots or 8
+        t_hdr, t_lo, t_hi, n_lo, n_hi, rate = 32, 8, 24, 32, 64, 0.5
+        dtype = jnp.bfloat16
+    canary_interval_s = canary_interval_s or 0.05
+    bt = 4  # small blocks: the shared header publishes whole blocks
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    header = rng.randint(0, vocab, t_hdr).astype(np.int32)
+    arrive_at = np.floor(
+        np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ).astype(int)
+    reqs = []
+    for _ in range(n_requests):
+        tail = rng.randint(0, vocab,
+                           rng.randint(t_lo, t_hi + 1)).astype(np.int32)
+        reqs.append((np.concatenate([header, tail]),
+                     int(rng.randint(n_lo, n_hi + 1))))
+
+    def run_once(fault):
+        # inert until armed post-warm; handed to replica 1 ONCE — the
+        # quarantine's fresh incarnation composes its engine kwargs
+        # again and must come up CLEAN (a sticky garble re-armed on
+        # the replacement would just trip it again, forever)
+        inj = FaultInjector("")
+        armed = {"used": False}
+
+        def kw_for(i):
+            if i == 1 and not armed["used"]:
+                armed["used"] = True
+                return {"fault_injector": inj}
+            return {}
+
+        keep_dir = os.environ.get("PADDLE_TPU_KEEP_JOURNAL_DIR") or None
+        if keep_dir is not None:
+            os.makedirs(keep_dir, exist_ok=True)
+        jpath = tempfile.mktemp(suffix=".jsonl",
+                                prefix="integrity_journal_",
+                                dir=keep_dir)
+        fleet = ServingFleet(
+            params, cfg, n_replicas=2, journal_path=jpath,
+            heartbeat_timeout_s=120.0, monitor_interval_s=0.02,
+            max_pending=4 * n_requests, affinity=True,
+            auto_refill=True, canary_interval_s=canary_interval_s,
+            engine_kw={"max_slots": max_slots, "kv_block_tokens": bt,
+                       "prefix_cache_tokens": 32 * bt,
+                       "kv_fingerprints": True},
+            engine_kw_for=kw_for)
+        try:
+            # warm both replicas (compiles + seed the shared-header
+            # prefix on each pool) and let one clean canary land per
+            # replica before any fault: the canary mark is the taint
+            # window's left edge, and the drills' windows must open at
+            # a VERIFIED index, not at token zero
+            w0 = fleet.submit(*reqs[0])
+            w1 = fleet.submit(*reqs[1])
+            w0.result(timeout=600)
+            w1.result(timeout=600)
+            deadline = time.monotonic() + 60.0
+            while fleet.stats()["canaries_ok"] < 2:
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        "no clean canary within 60s of a warm fleet: "
+                        "the canary machinery is broken")
+                time.sleep(0.01)
+            if fault is not None:
+                inj.arm(fault)  # fires on replica 1's next steps
+            t0 = time.time()
+            hs, i, step = [], 0, 0
+            while True:
+                while i < n_requests and arrive_at[i] <= step:
+                    hs.append(fleet.submit(*reqs[i]))
+                    i += 1
+                if i >= n_requests and all(h.done for h in hs):
+                    break
+                time.sleep(0.004)
+                step += 1
+            outs = [list(h.result(timeout=600)) for h in hs]
+            wall = time.time() - t0
+            if fault is not None:
+                # the quarantine must complete: fresh incarnation on
+                # the corrupt replica (supervisor-backoff auto-refill)
+                deadline = time.monotonic() + 60.0
+                while fleet.stats()["replicas"][1]["incarnation"] < 2:
+                    if time.monotonic() >= deadline:
+                        raise RuntimeError(
+                            "tripped replica never refilled under a "
+                            "fresh incarnation")
+                    time.sleep(0.02)
+            st = fleet.stats()
+            toks = sum(len(h.tokens) for h in hs)
+        finally:
+            fleet.close()
+        diags = verify_journal(jpath, expect_closed=True)
+        if diags:
+            raise RuntimeError(
+                "journal DFA violations (%s run):\n  %s"
+                % (fault or "clean",
+                   "\n  ".join(format_diag(d) for d in diags)))
+        if keep_dir is None:
+            os.unlink(jpath)
+        return {"outputs": outs, "stats": st,
+                "tokens_per_sec": toks / wall if wall else None}
+
+    clean = run_once(None)
+    st = clean["stats"]
+    if st["integrity_trips"] or st["canary_mismatches"] \
+            or st["fp_mismatches"]:
+        raise RuntimeError(
+            "clean run tripped the integrity sentinel (false "
+            "positive): %r" % {k: st[k] for k in (
+                "integrity_trips", "canary_mismatches",
+                "fp_mismatches")})
+    if not st["canaries_ok"]:
+        raise RuntimeError("clean run completed no canaries: the "
+                           "known-answer machinery never ran")
+
+    drills = {}
+    for name, fault, want_kind in (
+            ("garble", "garble@2", "canary"),
+            ("flip", "flip@2", "fingerprint")):
+        rec = run_once(fault)
+        dst = rec["stats"]
+        if rec["outputs"] != clean["outputs"]:
+            raise RuntimeError(
+                "%s drill outputs diverge from the clean run: a "
+                "corrupt token survived quarantine + taint-aware "
+                "resume" % name)
+        if dst["integrity_trips"] != 1:
+            raise RuntimeError(
+                "%s drill: expected exactly one integrity trip, got "
+                "%r (%r)" % (name, dst["integrity_trips"],
+                             dst["integrity_trip_kinds"]))
+        if dst["integrity_trip_kinds"].get(want_kind) != 1:
+            raise RuntimeError(
+                "%s drill tripped via %r, expected kind %r"
+                % (name, dst["integrity_trip_kinds"], want_kind))
+        if dst["lost"] or dst["duplicate_refused"]:
+            raise RuntimeError("%s drill lost/duplicated requests: %r"
+                               % (name, dst))
+        if dst["replicas"][1]["incarnation"] != 2:
+            raise RuntimeError(
+                "%s drill: corrupt replica quarantined %d times, "
+                "expected exactly once (fresh incarnation == 2)"
+                % (name, dst["replicas"][1]["incarnation"] - 1))
+        drills[name] = dst
+
+    return {
+        # the integrity columns (deterministic offline)
+        "trips_clean": st["integrity_trips"],
+        "canaries_ok_clean": st["canaries_ok"],
+        "trips_garble": drills["garble"]["integrity_trips"],
+        "trip_kind_garble": dict(
+            drills["garble"]["integrity_trip_kinds"]),
+        "tainted_tokens_garble": drills["garble"]["tainted_tokens"],
+        "trips_flip": drills["flip"]["integrity_trips"],
+        "trip_kind_flip": dict(drills["flip"]["integrity_trip_kinds"]),
+        "fp_mismatches_flip": drills["flip"]["fp_mismatches"],
+        "requests_lost": max(d["lost"] for d in drills.values()),
+        "outputs_identical": True,  # hard-raised above
+        "journal_dfa": "green --expect-closed incl. J010 (hard-raised)",
+        # honest overhead row (PERF.md): trap+fingerprint+canary cost
+        # on the same trace, clean run vs drills — wall-clock, so
+        # on-chip-pending like every serving tokens/s column
+        "tokens_per_sec_clean": (
+            round(clean["tokens_per_sec"], 1)
+            if clean["tokens_per_sec"] else None),
+        "n_requests": n_requests,
+        "arrival": "poisson(rate=%g/step, seed=0), %d-token shared "
+                   "header" % (rate, t_hdr),
+        "drill": {"garble": "garble@2 (replica 1, sticky)",
+                  "flip": "flip@2 (replica 1, one resident block)"},
+        "knobs": {"max_slots": max_slots, "kv_block_tokens": bt,
+                  "canary_interval_s": canary_interval_s,
+                  "kv_fingerprints": True, "auto_refill": True},
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
 def bench_input_pipeline(n_shards=4, chunks_per_shard=8,
                          records_per_chunk=64, batch=64, step_s=0.004,
                          decode_sleep_s=0.0001, num_workers=2,
@@ -3572,6 +3828,11 @@ def main():
         # quota/fairness/adapter-paging/output-identity columns are
         # deterministic offline; per-tenant tok/s on-chip
         run("serving_multitenant", bench_serving_multitenant)
+        # serving integrity (ISSUE 15): garble@ + flip@ silent-fault
+        # drills — trip/quarantine exactly-once, output identity to
+        # the uninjected run, and the J010 taint-fence audit are
+        # deterministic offline; the overhead tokens/s column on-chip
+        run("serving_integrity", bench_serving_integrity)
         run("transformer_lm", bench_transformer_lm)
         # larger-matmul flagship: dim=1024 keeps every matmul MXU-shaped
         # (the dim=512 row leaves lane headroom), so this is the MFU

@@ -65,6 +65,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "J007": ("open-at-close", "error"),
     "J008": ("malformed-journal", "error"),
     "J009": ("version-fence", "error"),
+    "J010": ("taint-fence", "error"),
 }
 
 # codes whose analyzer runs inside `--all` / `run_all()` — the only

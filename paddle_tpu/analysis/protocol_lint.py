@@ -48,6 +48,25 @@ and reports violations as stable J-codes:
                           it. Checked only when both sides carry the
                           optional side-band; journals from an
                           unversioned fleet stay clean.
+  J010 taint-fence        the ISSUE 15 integrity contract. An
+                          `integrity` record quarantines a (replica,
+                          incarnation) and TAINTS per-rid progress
+                          windows [from, upto): the rid's accumulated
+                          progress truncates to `from`, and ONLY the
+                          tainted indices may ever be journaled twice
+                          (the one sanctioned exception to PR 8's
+                          zero-re-decode rule). J010 fires when (a)
+                          progress re-covers an already-journaled
+                          token index OUTSIDE any taint window — a
+                          re-decode the protocol never sanctioned; (b)
+                          an assign/progress/done names a quarantined
+                          (replica, incarnation) AFTER its integrity
+                          event — "a done whose assignment predates
+                          the replica's integrity event"; (c) an
+                          integrity record's taint window is
+                          ill-formed (from > upto, from past the
+                          journaled progress, an unknown or already-
+                          terminal rid).
 
 Optional side-band fields (ISSUEs 11 + 12): assign records may carry
 `tier` (prefill/decode disaggregation placement), `weights_version`
@@ -85,7 +104,7 @@ from .diagnostics import Diagnostic, make, rel_path
 __all__ = ["verify_journal", "verify_records", "JournalViolation"]
 
 _TERMINAL = ("done", "rejected", "expired")
-_KINDS = ("meta", "submit", "assign", "progress") + _TERMINAL
+_KINDS = ("meta", "submit", "assign", "progress", "integrity") + _TERMINAL
 
 # the front-door-restart resume prefix: journaled by submit() before any
 # assignment exists, under this sentinel holder (fleet.py submit())
@@ -99,6 +118,9 @@ _REQUIRED = {
     "done": ("rid", "replica", "incarnation", "gen", "tokens"),
     "rejected": ("rid", "reason"),
     "expired": ("rid", "tokens"),
+    # ISSUE 15 quarantine record: no rid of its own — `taint` maps
+    # rid -> [from, upto) windows over that rid's journaled progress
+    "integrity": ("replica", "incarnation", "taint"),
 }
 
 # field -> accepted types: a JSON-parseable record with an ill-typed
@@ -123,6 +145,8 @@ _FIELD_TYPES = {
     # audit groups the journal by this field, so an ill-typed value
     # silently breaks the grouping and must be J008 like any other
     "tenant": (str, type(None)),
+    # ISSUE 15: the integrity record's rid -> [from, upto] window map
+    "taint": (dict,),
 }
 
 # optional per-kind side-band fields: absent is fine (old journals),
@@ -130,6 +154,7 @@ _FIELD_TYPES = {
 _OPTIONAL = {
     "assign": ("tier", "weights_version", "tenant"),
     "done": ("weights_version", "tenant"),
+    "integrity": ("reason",),
 }
 
 
@@ -166,7 +191,7 @@ class _Rid(object):
     """DFA state for one request id."""
 
     __slots__ = ("state", "assign", "assign_version", "progress",
-                 "terminal_line")
+                 "terminal_line", "hwm", "taint")
 
     def __init__(self):
         self.state = "open"          # open -> terminal
@@ -176,6 +201,13 @@ class _Rid(object):
         self.assign_version: Optional[int] = None
         self.progress: List[int] = []
         self.terminal_line = 0
+        # ISSUE 15 taint fence: the high-water mark of journaled
+        # progress (never lowered — an integrity truncation lowers the
+        # ACCUMULATION, not the mark) and the active taint window
+        # [from, upto). Progress below the mark is a re-decode, legal
+        # ONLY inside the window (J010).
+        self.hwm = 0
+        self.taint: Optional[Tuple[int, int]] = None
 
 
 def _iter_records(path: str):
@@ -207,6 +239,10 @@ def verify_records(records, path_label: str = "<journal>",
     explorer's invariant probes)."""
     diags: List[Diagnostic] = []
     rids: Dict[int, _Rid] = {}
+    # quarantined (replica, incarnation) -> integrity-record line: any
+    # later record naming the pair is J010 (the fleet kills the
+    # incarnation at the trip; nothing legitimate can follow)
+    quarantined: Dict[Tuple[str, int], int] = {}
 
     def diag(code, lineno, rid, detail, msg):
         # a malformed record's rid may be any JSON value — the symbol
@@ -249,6 +285,65 @@ def verify_records(records, path_label: str = "<journal>",
             first_record = False
             continue
         first_record = False
+        if kind == "integrity":
+            # the ISSUE 15 quarantine record: no rid of its own
+            if not isinstance(rec["replica"], str) \
+                    or not isinstance(rec["incarnation"], int):
+                diag("J008", lineno, None, "integrity:ill-typed:holder",
+                     "integrity record needs a concrete (replica, "
+                     "incarnation) — got (%r, %r)"
+                     % (rec["replica"], rec["incarnation"]))
+                continue
+            holder2 = (rec["replica"], rec["incarnation"])
+            for rid_s in sorted(rec["taint"]):
+                window = rec["taint"][rid_s]
+                try:
+                    trid = int(rid_s)
+                except (TypeError, ValueError):
+                    trid = None
+                if (trid is None or not isinstance(window, list)
+                        or len(window) != 2
+                        or not all(isinstance(w, int) for w in window)):
+                    diag("J008", lineno, None,
+                         "integrity:ill-typed:taint",
+                         "integrity taint entry %r -> %r is not "
+                         "rid -> [from, upto]" % (rid_s, window))
+                    continue
+                frm, upto = window
+                st = rids.get(trid)
+                if st is None:
+                    diag("J010", lineno, trid, "taint:unknown-rid",
+                         "integrity record taints rid %d that was "
+                         "never submitted in this file" % trid)
+                    continue
+                if st.state == "terminal":
+                    diag("J010", lineno, trid, "taint:terminal",
+                         "integrity record taints rid %d after its "
+                         "terminal record (line %d) — a verdict's "
+                         "tokens cannot be retroactively tainted"
+                         % (trid, st.terminal_line))
+                    continue
+                if frm < 0 or frm > upto:
+                    diag("J010", lineno, trid, "taint:ill-formed",
+                         "integrity taint window [%d, %d) for rid %d "
+                         "is ill-formed" % (frm, upto, trid))
+                    continue
+                if frm > len(st.progress):
+                    diag("J010", lineno, trid, "taint:past-progress",
+                         "integrity taint window for rid %d opens at "
+                         "token %d but only %d progress token(s) are "
+                         "journaled — the verified prefix cannot "
+                         "exceed what was journaled"
+                         % (trid, frm, len(st.progress)))
+                    continue
+                # truncate the ACCUMULATION to the verified prefix;
+                # the high-water mark keeps the pre-taint length so a
+                # later progress below it is recognized as re-decode
+                st.hwm = max(st.hwm, len(st.progress), upto)
+                st.progress = st.progress[:frm]
+                st.taint = (frm, upto)
+            quarantined[holder2] = lineno
+            continue
         rid = rec["rid"]
         st = rids.get(rid)
         if kind == "submit":
@@ -274,6 +369,7 @@ def verify_records(records, path_label: str = "<journal>",
                 st.assign_version = rec.get("weights_version")
             elif kind == "progress":
                 st.progress.extend(rec["tokens"])
+                st.hwm = len(st.progress)
             else:
                 st.state = "terminal"
                 st.terminal_line = lineno
@@ -286,6 +382,16 @@ def verify_records(records, path_label: str = "<journal>",
                  % (kind, rid, st.terminal_line))
             continue
         if kind == "assign":
+            if (rec["replica"], rec["incarnation"]) in quarantined:
+                diag("J010", lineno, rid,
+                     "assign:quarantined:%s" % (rec["replica"],),
+                     "assign of rid %d to (%r, incarnation %r) AFTER "
+                     "that incarnation's integrity event (line %d) — "
+                     "the fleet kills a tripped incarnation; nothing "
+                     "may be assigned to it again"
+                     % (rid, rec["replica"], rec["incarnation"],
+                        quarantined[(rec["replica"],
+                                     rec["incarnation"])]))
             st.assign = (rec["replica"], rec["incarnation"], rec["gen"])
             st.assign_version = rec.get("weights_version")
             continue
@@ -307,11 +413,53 @@ def verify_records(records, path_label: str = "<journal>",
                      "holder's tokens were accepted past the lease "
                      "fence" % (rid, rec["replica"], rec["incarnation"],
                                 rec["gen"], (st.assign,)))
+            if rec["replica"] is not None and rec["replica"] != _RESTART \
+                    and (rec["replica"], rec["incarnation"]) in quarantined:
+                diag("J010", lineno, rid,
+                     "progress:quarantined:%s" % (rec["replica"],),
+                     "progress for rid %d from (%r, incarnation %r) "
+                     "AFTER that incarnation's integrity event (line "
+                     "%d) — a quarantined holder's tokens were "
+                     "accepted" % (rid, rec["replica"],
+                                   rec["incarnation"],
+                                   quarantined[(rec["replica"],
+                                                rec["incarnation"])]))
+            # the taint-fence re-decode audit (ISSUE 15): progress
+            # below the high-water mark journals token indices a
+            # PREVIOUS holder already journaled. That is legal only
+            # for indices INSIDE a journaled taint window — PR 8's
+            # zero-re-decode rule everywhere else (both ends checked:
+            # a resume below `from` re-decodes VERIFIED tokens, a span
+            # past `upto` re-decodes untainted ones)
+            L = len(st.progress)
+            hi = min(L + len(rec["tokens"]), st.hwm)
+            if hi > L and (st.taint is None or L < st.taint[0]
+                           or hi > st.taint[1]):
+                diag("J010", lineno, rid, "redecode-outside-taint",
+                     "progress for rid %d re-decodes token indices "
+                     "[%d, %d) (high-water mark %d) outside the "
+                     "journaled taint window (%r) — only tainted "
+                     "tokens may ever re-decode"
+                     % (rid, L, hi, st.hwm, st.taint))
             st.progress.extend(rec["tokens"])
+            st.hwm = max(st.hwm, len(st.progress))
             continue
         # terminal kinds
         st.state = "terminal"
         st.terminal_line = lineno
+        if kind == "done" and rec["replica"] != _RESTART \
+                and (rec["replica"], rec["incarnation"]) in quarantined:
+            # "a done whose assignment predates the replica's
+            # integrity event": the quarantined incarnation's verdict
+            # landed past the fence (ISSUE 15)
+            diag("J010", lineno, rid,
+                 "done:quarantined:%s" % (rec["replica"],),
+                 "done for rid %d from (%r, incarnation %r) AFTER "
+                 "that incarnation's integrity event (line %d) — its "
+                 "assignment predates the quarantine, the verdict "
+                 "must be refused"
+                 % (rid, rec["replica"], rec["incarnation"],
+                    quarantined[(rec["replica"], rec["incarnation"])]))
         if kind == "done":
             holder = (rec["replica"], rec["incarnation"], rec["gen"])
             if rec["replica"] == _RESTART and st.assign is None:

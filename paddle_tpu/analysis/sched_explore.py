@@ -660,6 +660,72 @@ class RolloutMigrationRaceScenario(Scenario):
         return out
 
 
+class IntegrityTripScenario(Scenario):
+    """ISSUE 15 quarantine + taint-aware resume: a tiered fleet (r0
+    prefill, r1 decode) serves two requests — a 1-token request whose
+    completion handshake the trip can race, and a longer one that
+    MIGRATES from r0 to r1 at first token — while an integrity trip
+    (the canary-mismatch path, scripted like DemoteRouteBack's
+    demotion: canaries themselves are wall-clock-driven, which the
+    explorer sizes out) quarantines r0 once it has journaled progress.
+    The explored interleavings land the trip before, during, and after
+    the migration's hedge and the completion's handshake; the probes
+    pin token identity (the taint window re-decodes to the SAME
+    tokens on the survivor — the scripted engine is honest), exactly-
+    once verdicts, and the journal DFA — now including J010: the
+    integrity record's taint windows must be well-formed, re-decoded
+    tokens must lie inside them, and nothing may land from the
+    quarantined incarnation after its integrity event."""
+
+    name = "integrity_trip"
+    n_replicas = 2
+
+    def fleet_kw(self):
+        return {"replica_tier": ["prefill", "decode"]}
+
+    def _trip_ready(self, ctx):
+        # fire once ANY journaled progress exists (the decoded-but-
+        # unreported / mid-migration window); a deviating schedule may
+        # have run a request to completion first — the trip then fires
+        # as a harmless no-taint quarantine instead of wedging the ops
+        if len(ctx.handles) < 2:
+            return False
+        return any(h.done
+                   or len(ctx.fleet._journal.progress_of(h.rid)) >= 1
+                   for h, _p, _s, _n in ctx.handles)
+
+    def _trip(self, ctx):
+        from ..serving.integrity import IntegrityError
+
+        fleet = ctx.fleet
+        with fleet._cond:
+            fleet._integrity_trip_locked(
+                0, fleet._replicas[0],
+                IntegrityError("scripted canary mismatch on r0",
+                               kind="canary", replica="r0"))
+        fleet._flush_journal()
+
+    def ops(self):
+        return [
+            ("submit0", _always, lambda c: c.submit([5, 3], 1, seed=31)),
+            ("submit1", _always, lambda c: c.submit([2, 8, 4], 4,
+                                                    seed=32)),
+            ("trip_r0", self._trip_ready, self._trip),
+        ]
+
+    def check(self, ctx):
+        out = []
+        st = ctx.fleet.stats()
+        if st["integrity_trips"] != 1:
+            out.append("integrity_trips == %r, expected exactly 1 "
+                       "(quarantine must be exactly-once)"
+                       % st["integrity_trips"])
+        if st["replicas"][0]["state"] != "dead":
+            out.append("tripped replica r0 not quarantined (state %r)"
+                       % st["replicas"][0]["state"])
+        return out
+
+
 class TenantFairnessScenario(Scenario):
     """ISSUE 12 multi-tenancy: a burst tenant's three requests race a
     higher-weight SLA tenant's request through the router's new WFQ
@@ -731,6 +797,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "drain_retire_race": DrainRetireRaceScenario,
     "rollout_migration": RolloutMigrationRaceScenario,
     "tenant_fairness": TenantFairnessScenario,
+    "integrity_trip": IntegrityTripScenario,
 }
 
 

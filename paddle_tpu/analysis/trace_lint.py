@@ -71,6 +71,9 @@ HOT_PATHS = [
     # path next to the compiled steps — linted from day one
     "paddle_tpu/serving/tenancy.py",
     "paddle_tpu/serving/adapters.py",
+    # serving integrity (ISSUE 15): the trap/fingerprint/sentinel
+    # helpers run inside (or right next to) the compiled serving steps
+    "paddle_tpu/serving/integrity.py",
     "paddle_tpu/fluid/executor.py",
     "paddle_tpu/fluid/core/lowering.py",
     # the training sentinel sits ON the step loop next to the jitted

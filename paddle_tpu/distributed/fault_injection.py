@@ -31,6 +31,35 @@ A fault spec is a comma-separated string, e.g.::
                                     (transient: tolerated; sustained:
                                     tripped). Arg is the factor,
                                     default 10, must be > 1.
+    PADDLE_FAULT="garble@5"         SILENT serving integrity fault
+                                    (ISSUE 15): from step 5 ON, every
+                                    token this engine emits is
+                                    wrong-but-FINITE (the engine
+                                    consumes `injector.garbled` and
+                                    perturbs each emitted token to a
+                                    different valid vocab id). STICKY
+                                    by design — a faulty core keeps
+                                    computing wrong until the
+                                    incarnation is replaced — so the
+                                    in-step numeric traps never fire
+                                    (nothing is NaN) and only a
+                                    known-answer canary mismatch can
+                                    catch it. Models the SDC failure
+                                    class TPU-scale fleets see.
+    PADDLE_FAULT="flip@5"           SILENT serving integrity fault
+                                    (ISSUE 15): at step 5 the engine
+                                    corrupts ONE resident KV block's
+                                    payload in place (finite garbage,
+                                    lowest in-use physical id —
+                                    deterministic on a fixed-seed
+                                    trace; consumed via
+                                    `injector.take_flip()`, re-armed
+                                    each tick until a block is
+                                    resident). Requests attending
+                                    through the block decode wrong
+                                    tokens; only a block FINGERPRINT
+                                    spot-check (at aliased re-open /
+                                    failover resume) can catch it.
     PADDLE_FAULT="slow@3:2.0/0.1"   GRAY failure (ISSUE 8): starting at
                                     step 3, every tick sleeps 0.1 s until
                                     2.0 s of wall time have passed — the
@@ -141,7 +170,7 @@ class _Fault(object):
 
 
 _KINDS = ("kill", "exc", "delay", "corrupt", "hang", "netsplit", "slow",
-          "nanloss", "spike")
+          "nanloss", "spike", "garble", "flip")
 
 
 def _parse_slow_arg(arg: str):
@@ -202,6 +231,12 @@ class FaultInjector(object):
         # armed loss fault for the CURRENT step, consumed (one-shot) by
         # poison_loss(): ("nanloss", None) or ("spike", factor)
         self._loss_fault = None
+        # serving integrity faults (ISSUE 15): garble is STICKY from
+        # its step on (a faulty core keeps computing wrong); flip is
+        # armed at its step and stays pending until the engine finds a
+        # resident block to corrupt (take_flip consumes it)
+        self._garbled = False
+        self._flip_pending = False
 
     @property
     def active(self) -> bool:
@@ -211,6 +246,28 @@ class FaultInjector(object):
     def slowed(self) -> bool:
         """True while an injected slow@ (gray) window is open."""
         return time.monotonic() < self._slow_until
+
+    @property
+    def garbled(self) -> bool:
+        """True from a garble@ step on (sticky): the consuming engine
+        perturbs every emitted token to a wrong-but-finite vocab id."""
+        return self._garbled
+
+    def rearm_flip(self):
+        """Put a consumed flip@ back (the engine found nothing resident
+        to corrupt this step — retry at the next step boundary)."""
+        self._flip_pending = True
+
+    def take_flip(self) -> bool:
+        """Consume a pending flip@ fault. The engine calls this every
+        step; the first call with a resident KV block to corrupt wins
+        (the fault stays pending across ticks where nothing is
+        resident, so flip@1 on an idle engine still lands on the first
+        real block)."""
+        if self._flip_pending:
+            self._flip_pending = False
+            return True
+        return False
 
     def arm(self, spec: str, relative: bool = True):
         """Add faults mid-run. With `relative=True` (default) the @N
@@ -241,6 +298,14 @@ class FaultInjector(object):
                     # silent fault: nothing fires HERE — the training
                     # loop's poison_loss() call this step observes it
                     self._loss_fault = (f.kind, f.arg)
+                elif f.kind == "garble":
+                    # silent + sticky: the serving engine consumes the
+                    # `garbled` property on every emission from now on
+                    self._garbled = True
+                elif f.kind == "flip":
+                    # silent one-shot: pending until take_flip() finds
+                    # a resident block to corrupt
+                    self._flip_pending = True
                 else:
                     f.fire()
         if self.slowed:

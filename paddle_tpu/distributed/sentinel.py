@@ -58,9 +58,10 @@ from __future__ import annotations
 
 import json
 import logging
-import math
 import os
 from typing import Dict, FrozenSet, List, Optional
+
+from ..utils.detector import TripDetector
 
 __all__ = [
     "DivergenceDetector", "TrainingSentinel", "SentinelTrip",
@@ -90,7 +91,7 @@ class SentinelTrip(RuntimeError):
         self.decision = decision
 
 
-class DivergenceDetector(object):
+class DivergenceDetector(TripDetector):
     """Per-step loss/grad-norm health verdicts.
 
     observe(loss, grad_norm) -> "ok" | "nonfinite" | "spike"
@@ -105,63 +106,16 @@ class DivergenceDetector(object):
     resets the streak and decays normally. State is JSON-serializable
     (`state_dict`/`load_state_dict`) so it can ride in the checkpoint
     and roll BACK with the model on a sentinel rollback.
+
+    The verdict machine itself is `utils.detector.TripDetector`
+    (ISSUE 15 satellite): ONE hysteresis implementation shared with
+    the serving integrity sentinel, so the two health loops cannot
+    drift. This subclass only keeps the training-side signature
+    (loss + grad_norm).
     """
 
-    def __init__(self, spike_factor: float = 4.0, hysteresis: int = 2,
-                 ewma_alpha: float = 0.2, warmup: int = 3):
-        if spike_factor <= 1.0:
-            raise ValueError("spike_factor must be > 1")
-        if hysteresis < 1:
-            raise ValueError("hysteresis must be >= 1")
-        if not 0.0 < ewma_alpha <= 1.0:
-            raise ValueError("ewma_alpha must be in (0, 1]")
-        self.spike_factor = float(spike_factor)
-        self.hysteresis = int(hysteresis)
-        self.ewma_alpha = float(ewma_alpha)
-        self.warmup = int(warmup)
-        self._ewma = None      # guarded-by: trainer
-        self._seen = 0         # guarded-by: trainer
-        self._streak = 0       # guarded-by: trainer
-
-    @property
-    def ewma(self):
-        return self._ewma
-
-    @property
-    def suspect(self) -> bool:
-        """True while a spike streak is open (recent steps were held out
-        of the EWMA): the divergence may already have begun."""
-        return self._streak > 0
-
     def observe(self, loss, grad_norm=None) -> str:
-        loss = float(loss)
-        if not math.isfinite(loss) or (
-                grad_norm is not None and not math.isfinite(float(grad_norm))):
-            self._streak = 0  # a rollback restarts the soft window clean
-            return "nonfinite"
-        if (self._ewma is not None and self._seen >= self.warmup
-                and abs(loss) > self.spike_factor * max(abs(self._ewma),
-                                                        1e-12)):
-            self._streak += 1
-            if self._streak >= self.hysteresis:
-                self._streak = 0
-                return "spike"
-            return "ok"  # suspect, but within hysteresis: hold the EWMA
-        self._streak = 0
-        self._ewma = (loss if self._ewma is None
-                      else (1.0 - self.ewma_alpha) * self._ewma
-                      + self.ewma_alpha * loss)
-        self._seen += 1
-        return "ok"
-
-    def state_dict(self) -> dict:
-        return {"ewma": self._ewma, "seen": self._seen,
-                "streak": self._streak}
-
-    def load_state_dict(self, state: dict):
-        self._ewma = state.get("ewma")
-        self._seen = int(state.get("seen", 0))
-        self._streak = int(state.get("streak", 0))
+        return TripDetector.observe(self, loss, aux_finite=grad_norm)
 
 
 # ---------------------------------------------------------------------

@@ -992,9 +992,70 @@ def paged_verify_step(params, cache, window, pos, wpos, tables,
     return x @ params["embed"].T, new_cache
 
 
+def logits_trap(logits):
+    """Per-row non-finite TRAP over final logits (ISSUE 15): True where
+    a row's logits contain any NaN/Inf, or its softmax denominator is
+    non-finite or non-positive (an all-`-inf` row would sample from a
+    zero-mass distribution — as corrupt as a NaN, and invisible to a
+    plain isfinite check on the argmax path). A few extra reductions
+    FOLDED into the caller's already-compiled step — never a second
+    trace, never a second pass over the activations. `logits` is
+    [..., V]; the result drops the vocab axis."""
+    finite = jnp.isfinite(logits).all(axis=-1)
+    # softmax denominator at the sampling dtype: max-subtracted like
+    # jax.random.categorical itself, so the reduction traps exactly
+    # the distribution the sampler would draw from
+    f32 = logits.astype(jnp.float32)
+    denom = jnp.sum(jnp.exp(f32 - jnp.max(f32, axis=-1, keepdims=True)),
+                    axis=-1)
+    return ~finite | ~jnp.isfinite(denom) | (denom <= 0.0)
+
+
+def logit_amax(logits, mask=None):
+    """Scalar max-|logit| over the (optionally masked) rows — the
+    serving sentinel's EWMA signal (ISSUE 15): wrong-but-FINITE compute
+    (a flipped exponent bit, a corrupted weight tile) usually shows as
+    a magnitude excursion long before anything goes NaN. Masked rows
+    (dead slots) contribute 0. Folded into the compiled step like
+    `logits_trap`."""
+    a = jnp.max(jnp.abs(logits.astype(jnp.float32)), axis=-1)
+    if mask is not None:
+        while mask.ndim < a.ndim:
+            mask = mask[..., None]
+        a = jnp.where(mask, a, 0.0)
+    return jnp.max(a)
+
+
+def paged_block_fingerprint(cache, bid):
+    """Folded-f32 checksum of ONE physical KV block across every layer
+    and cache band (payload rows AND, on a quantized pool, the
+    per-head scale side-bands) — the ISSUE 15 fingerprint op. Rides
+    the block-id addressing exactly like PR 14's quant scales: the
+    caller hands a physical block id, the reduction reads
+    `buf[bid]` per band. Position-weighted (element index mod a small
+    prime) so a transposition inside the block moves the sum, and
+    per-band/per-layer folded with distinct multipliers so a value
+    migrating between K and V (or between layers) cannot cancel.
+    Deterministic for fixed shapes on a fixed backend — the engine
+    compares a recomputed fingerprint against the one committed when
+    the block closed, so only run-to-run determinism matters, never
+    cross-backend bit equality. Cheap: one pass over a single block's
+    bytes, jitted ONCE by the engine (a new trace would violate the
+    one-compiled-step discipline the serving tests pin)."""
+    acc = jnp.float32(0.0)
+    for li, kv in enumerate(cache):
+        for bi, band in enumerate(sorted(kv)):
+            x = kv[band][bid].astype(jnp.float32).reshape(-1)
+            w = (jnp.arange(x.shape[0], dtype=jnp.float32) % 97.0) + 1.0
+            fold = jnp.float32(1.0 + 0.013 * (li * 7 + bi + 1))
+            acc = acc + jnp.sum(x * w) * fold
+    return acc
+
+
 __all__ += ["init_paged_kv_cache", "paged_decode_step",
             "paged_prefill_chunk", "paged_verify_step",
-            "kv_storage_dtype", "kv_block_bytes"]
+            "kv_storage_dtype", "kv_block_bytes",
+            "logits_trap", "logit_amax", "paged_block_fingerprint"]
 
 
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens,

@@ -17,6 +17,12 @@ requests lost or answered twice under kill drills)."""
 
 from .adapters import AdapterPool, AdapterRegistry, make_adapter
 from .engine import EngineFailed, ServingEngine, ServingHandle
+from .integrity import (
+    BlockFingerprints,
+    IntegrityError,
+    ServingSentinel,
+    golden_trace,
+)
 from .fleet import (
     DeadlineExceeded,
     FleetHandle,
@@ -52,4 +58,6 @@ __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
            "AdapterPool", "AdapterRegistry", "make_adapter",
            "Tenant", "TenantRegistry", "TenantQuotaExceeded",
            "WFQueue", "executor_batch_fn", "QuantTensor",
-           "quantize_params", "dequantize_params", "params_bytes"]
+           "quantize_params", "dequantize_params", "params_bytes",
+           "IntegrityError", "BlockFingerprints", "ServingSentinel",
+           "golden_trace"]

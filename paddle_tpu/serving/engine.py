@@ -103,12 +103,14 @@ from ..distributed import fault_injection as _fi
 from ..fluid.core.kernels_sequence import bucket_pow2
 from ..models import transformer as tlm
 from .adapters import AdapterPool
+from .integrity import BlockFingerprints, IntegrityError, ServingSentinel
 from .kv_blocks import KVBlockAllocator
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .quantization import dequantize_params, quantize_params
 
-__all__ = ["ServingEngine", "ServingHandle", "EngineFailed"]
+__all__ = ["ServingEngine", "ServingHandle", "EngineFailed",
+           "IntegrityError"]
 
 _BANDS = ("tok", "pos", "alive", "temps", "counts", "base_keys",
           "tables", "limits", "aidx")
@@ -263,6 +265,23 @@ class ServingEngine(object):
     per-tensor int8 + f32 scales (serving/quantization.py), dequant
     folded into the compiled steps — the decode HBM roofline's weight
     term drops ~4x independently of the KV side.
+
+    Serving integrity (ISSUE 15): `integrity_traps` (default True)
+    folds a per-slot non-finite trap — logits + softmax-denominator
+    reduction (`transformer.logits_trap`) — into the SAME compiled
+    steps (no new traces; decode still compiles exactly once); a
+    tripped slot raises `IntegrityError` INSTEAD of emitting a token,
+    and the fleet routes that into quarantine + taint-aware resume.
+    `kv_fingerprints` (default False) adds per-physical-block
+    folded-f32 checksums: committed when a block closes (publish into
+    the prefix trie), spot-verified when an aliased block is re-opened
+    by a different request (which is also where failover resume
+    re-attaches), dropped when the block frees — a flipped block
+    cannot silently serve prefix-cache hits.
+    `integrity_spike_factor` (default None = off) additionally watches
+    the step's max-|logit| with the shared EWMA/hysteresis
+    TripDetector core (utils/detector.py — the training sentinel's),
+    catching wrong-but-finite magnitude excursions.
     """
 
     def __init__(self, params, cfg, max_slots=8, max_len=None,
@@ -274,7 +293,9 @@ class ServingEngine(object):
                  scheduler_hook=None, weights_version=None,
                  adapter_registry=None, adapter_slots=8,
                  adapter_rank=None, paged_kernel=None,
-                 kv_quant="none", weight_quant=None):
+                 kv_quant="none", weight_quant=None,
+                 integrity_traps=True, kv_fingerprints=False,
+                 integrity_spike_factor=None):
         self._params = params
         self._cfg = cfg
         # deterministic-exploration seam (ISSUE 9): the fleet threads
@@ -370,10 +391,49 @@ class ServingEngine(object):
                 "weight_quant must be None or 'int8' (got %r)"
                 % (weight_quant,))
         self.weight_quant = weight_quant
+        # serving integrity (ISSUE 15): in-step numeric traps (per-slot
+        # non-finite flag + max-|logit| scalar folded into the one
+        # compiled step — no new traces; a tripped slot becomes an
+        # IntegrityError instead of an emitted token), optional
+        # per-block KV fingerprints (committed at publish, spot-
+        # verified on aliased re-open — which is also where failover
+        # resume re-attaches), and an opt-in EWMA magnitude spike
+        # detector sharing the training sentinel's TripDetector core
+        self.integrity_traps = bool(integrity_traps)
+        if integrity_spike_factor is not None \
+                and float(integrity_spike_factor) <= 1.0:
+            raise ValueError(
+                "integrity_spike_factor must be > 1 or None")
+        if integrity_spike_factor is not None \
+                and not self.integrity_traps:
+            # the spike detector observes the max-|logit| scalar the
+            # TRAP reduction computes — without traps it would be
+            # silently dead, which is worse than a loud refusal
+            raise ValueError(
+                "integrity_spike_factor needs integrity_traps=True "
+                "(the spike detector observes the trap reduction's "
+                "magnitude scalar)")
+        self._sentinel = ServingSentinel(
+            spike_factor=integrity_spike_factor)  # guarded-by: scheduler
+        if kv_fingerprints and not prefix_cache_tokens:
+            # fingerprints commit at trie PUBLISH and verify at
+            # aliased re-open — without a prefix cache neither point
+            # exists, and the protection would be silently dead (all
+            # counters zero forever while the operator believes
+            # flipped blocks are covered): refuse loudly instead
+            raise ValueError(
+                "kv_fingerprints needs the prefix cache (pass "
+                "prefix_cache_tokens=): fingerprints commit at trie "
+                "publish and verify at aliased re-open — with no "
+                "cache neither audit point ever runs")
+        self._fp: Optional[BlockFingerprints] = (
+            BlockFingerprints() if kv_fingerprints else None)  # guarded-by: scheduler
+        self._fp_fn = None  # lazy-jitted fingerprint reduction
         self.metrics = ServingMetrics(S)
         self.metrics.paged_kernel = pk
         self.metrics.kv_quant = kv_quant
         self.metrics.weight_quant = weight_quant
+        self.metrics.block_fp = self._fp
         self.metrics.kv_blocks_total = NB
         # live-rollout version fence (ISSUE 11): the weight version
         # these params came from — fixed for the engine's lifetime (a
@@ -398,7 +458,11 @@ class ServingEngine(object):
         if prefix_cache_tokens:
             self.prefix_cache = PrefixCache(
                 int(prefix_cache_tokens), block_tokens=Bt,
-                on_evict=self._alloc.decref,
+                # _decref_block, not the raw allocator decref: a block
+                # the eviction actually FREES must drop its committed
+                # fingerprint too, or a recycled id would be judged
+                # against its previous tenant's checksum (ISSUE 15)
+                on_evict=self._decref_block,
             )
             self.metrics.prefix_cache = self.prefix_cache
 
@@ -490,6 +554,7 @@ class ServingEngine(object):
         kernel = self.paged_kernel  # baked into the one compiled step
         kv_quant = self.kv_quant    # ditto: storage dtype is traced in
         deq = self._deq
+        traps = self.integrity_traps  # baked in: trap reduction or not
 
         def _decode(params, cache, tables, tok, pos, alive, temps,
                     counts, base_keys, adapters=None, aidx=None):
@@ -515,12 +580,22 @@ class ServingEngine(object):
                 )
             )(keys, logits, safe_t).astype(jnp.int32)
             nxt = jnp.where(temps > 0, sampled, greedy)
+            # ISSUE 15 in-step numeric traps: per-slot non-finite flag
+            # + max-|logit| scalar, FOLDED into this same trace (a few
+            # reductions — decode stays compiled exactly once). Off =
+            # constant zeros, no reduction in the graph.
+            if traps:
+                trap = tlm.logits_trap(logits) & alive
+                scale = tlm.logit_amax(logits, alive)
+            else:
+                trap = jnp.zeros_like(alive)
+                scale = jnp.float32(0.0)
             # advance the device-resident bands in-step: the steady
             # decode loop re-uploads nothing (satellite: h2d dispatch
             # off the hot path). Dead rows advance by 0, matching the
             # untouched host mirrors.
             live = alive.astype(jnp.int32)
-            return cache, nxt, pos + live, counts + live
+            return cache, nxt, pos + live, counts + live, trap, scale
 
         kw = {"donate_argnums": (1,)} if self._donate else {}
         return jax.jit(_decode, **kw)
@@ -537,6 +612,7 @@ class ServingEngine(object):
         kernel = self.paged_kernel  # baked into the one compiled step
         kv_quant = self.kv_quant
         deq = self._deq
+        traps = self.integrity_traps
 
         def _verify(params, cache, tables, window, pos, alive, limits,
                     temps, counts, base_keys, adapters=None, aidx=None):
@@ -573,7 +649,15 @@ class ServingEngine(object):
                 in_axes=(0, 0, 0),
             )(keys, logits, safe_t).astype(jnp.int32)
             cand = jnp.where((temps > 0)[:, None], sampled, greedy)
-            return cache, cand
+            # ISSUE 15 traps over the whole [S, K] window, reduced to
+            # per-slot (any corrupt row in a slot's window trips it)
+            if traps:
+                trap = tlm.logits_trap(logits).any(axis=-1) & alive
+                scale = tlm.logit_amax(logits, alive)
+            else:
+                trap = jnp.zeros_like(alive)
+                scale = jnp.float32(0.0)
+            return cache, cand, trap, scale
 
         kw = {"donate_argnums": (1,)} if self._donate else {}
         return jax.jit(_verify, **kw)
@@ -590,6 +674,7 @@ class ServingEngine(object):
         kernel = self.paged_kernel  # baked into the per-bucket step
         kv_quant = self.kv_quant
         deq = self._deq
+        traps = self.integrity_traps
 
         def _chunk(params, cache, padded, start, table_row, true_len,
                    temp, key, adapters=None, aidx=None):
@@ -608,7 +693,18 @@ class ServingEngine(object):
                 / jnp.where(temp > 0, temp, 1.0),
             ).astype(jnp.int32)
             first = jnp.where(temp > 0, sampled, greedy)
-            return cache, first
+            # ISSUE 15 trap on the chunk's last-token logits. A NaN
+            # written MID-chunk propagates: attention over a NaN K/V
+            # row yields NaN logits at the final chunk, which is the
+            # only chunk the host reads back anyway (mid-prompt chunks
+            # stay dispatch-only so prefill keeps overlapping decode)
+            if traps:
+                trap = tlm.logits_trap(logits)
+                scale = tlm.logit_amax(logits)
+            else:
+                trap = jnp.bool_(False)
+                scale = jnp.float32(0.0)
+            return cache, first, trap, scale
 
         kw = {"donate_argnums": (1,)} if self._donate else {}
         fn = jax.jit(_chunk, **kw)
@@ -663,6 +759,94 @@ class ServingEngine(object):
                 "aidx": aidx}
 
     # ------------------------------------------------------------------
+    # integrity (ISSUE 15)
+    # ------------------------------------------------------------------
+    def _trip(self, kind: str, detail: str):
+        """Raise the integrity event: step()'s except path latches the
+        engine and the fleet's _on_crash routes an IntegrityError into
+        quarantine + taint-aware resume instead of plain failover."""
+        raise IntegrityError(
+            "integrity trip%s: %s" % (
+                "" if self.replica_id is None
+                else " (replica %s)" % self.replica_id,
+                detail),
+            kind=kind, replica=self.replica_id)
+
+    def _check_integrity(self, trap, scale, where: str, slots=None):
+        """Judge one compiled step's trap flag(s) + magnitude scalar.
+        A tripped slot becomes an integrity event INSTEAD of an
+        emitted token — the caller checks BEFORE its emit loop, so no
+        token from a poisoned step ever reaches a handle (or, through
+        the fleet, the journal)."""
+        trap = np.atleast_1d(np.asarray(trap))
+        verdict = self._sentinel.observe(bool(trap.any()), float(scale))
+        if verdict == "ok":
+            return
+        if verdict == "trap":
+            bad = (slots if slots is not None
+                   else [int(s) for s in np.nonzero(trap)[0]])
+            rids = [self._slot_req[s].rid for s in bad
+                    if self._slot_req[s] is not None]
+            self._trip("trap",
+                       "non-finite logits in %s step (slots %s, rids "
+                       "%s)" % (where, bad, rids))
+        self._trip("spike",
+                   "logit magnitude spike in %s step (max-|logit| "
+                   "%.3g vs EWMA %.3g x factor %g)"
+                   % (where, float(scale),
+                      self._sentinel.detector.ewma or 0.0,
+                      self._sentinel.detector.spike_factor))
+
+    def _fp_of(self, bid: int) -> float:
+        """Recompute one physical block's fingerprint on device. The
+        reduction is jitted ONCE (trace name "block_fp") — never
+        donated: the cache must survive the read."""
+        if self._fp_fn is None:
+            metrics = self.metrics
+
+            def _fp(cache, b):
+                metrics.count_trace("block_fp")
+                return tlm.paged_block_fingerprint(cache, b)
+
+            self._fp_fn = jax.jit(_fp)
+        return float(self._fp_fn(self._cache, jnp.int32(int(bid))))
+
+    def _decref_block(self, bid) -> bool:
+        """Drop one pool reference; a block actually FREED also drops
+        its committed fingerprint (a recycled id must never be judged
+        against the previous tenant's checksum). The ONE decref every
+        engine-side release path uses (slot retirement, trie
+        eviction)."""
+        freed = self._alloc.decref(bid)
+        if freed and self._fp is not None:
+            self._fp.drop(int(bid))
+        return freed
+
+    def _flip_resident_block(self):
+        """Consume a flip@ fault (ISSUE 15 drill): corrupt ONE resident
+        physical block's K payload in place with finite garbage — the
+        silent-data-corruption shape the numeric traps CANNOT see (no
+        NaN) and only a fingerprint spot-check catches. Deterministic
+        victim: the lowest in-use physical id. With nothing resident
+        the fault re-arms for the next step, so flip@N on a
+        still-empty pool lands on the first real block."""
+        bid = next((b for b in range(self.num_kv_blocks)
+                    if self._alloc.refcount(b) > 0), None)
+        if bid is None:
+            self._injector.rearm_flip()
+            return
+        kv = self._cache[0]
+        buf = kv["k"]
+        row = buf[bid]
+        if buf.dtype == jnp.int8:
+            garb = jnp.clip(row.astype(jnp.int32) + 37,
+                            -127, 127).astype(jnp.int8)
+        else:
+            garb = (row.astype(jnp.float32) * -1.0
+                    + 1.7).astype(buf.dtype)
+        kv["k"] = buf.at[bid].set(garb)
+
+    # ------------------------------------------------------------------
     # block bookkeeping
     # ------------------------------------------------------------------
     def _blocks_for(self, tokens: int) -> int:
@@ -712,7 +896,7 @@ class ServingEngine(object):
         freed = 0
         for b in range(self.blocks_per_slot):
             bid = int(self._tables[s, b])
-            if bid >= 0 and self._alloc.decref(bid):
+            if bid >= 0 and self._decref_block(bid):
                 freed += 1
         tail = int(self._reserved_tail[s])
         if tail:
@@ -830,6 +1014,15 @@ class ServingEngine(object):
         or budget (EOS on the budget-exhausting step reports 'eos').
         Returns True if the slot was retired."""
         h = self._slot_req[s]
+        if self._injector is not None \
+                and getattr(self._injector, "garbled", False):
+            # garble@ drill (ISSUE 15): wrong-but-FINITE output — every
+            # emitted token is shifted to a different valid vocab id.
+            # Sticky by design (a faulty core keeps computing wrong);
+            # the numeric traps never fire, only a known-answer canary
+            # mismatch can catch it. Applied at the emission bus, so
+            # real requests AND canaries on this engine garble alike.
+            token = (int(token) + 1) % int(self._cfg.vocab)
         h.tokens.append(int(token))
         st = self._spec_ctx.get(s)
         if st is not None:  # keep the drafting index current in O(1)
@@ -937,6 +1130,36 @@ class ServingEngine(object):
                     # requests never match the trie): the zero-slot
                     # pin, which always succeeds
                     aslot = pool.acquire(None)
+                if self._fp is not None and n_alias:
+                    # ISSUE 15 fingerprint spot-check — the aliased
+                    # re-open audit point: a DIFFERENT request is about
+                    # to attend through these physical blocks (and a
+                    # failover/migration RESUME re-attaches to the pool
+                    # through this very match), so a silently flipped
+                    # block must be caught HERE, before it serves a
+                    # single prefix-cache hit. Placed AFTER the
+                    # reservation so a block-starved request's per-step
+                    # admission retries never pay the device reduction
+                    # (the pure-probe discipline); on a mismatch the
+                    # trip latches the engine, so the half-taken
+                    # reservation dies with it. All dispatches are
+                    # issued before the first host sync, so an N-block
+                    # chain costs ~one round-trip, not N (a fixed-shape
+                    # batched reduction would save the dispatches too —
+                    # the PERF.md honest-overhead row tracks it)
+                    if self._fp_fn is None:
+                        self._fp_of(int(m.payloads[0]))  # trace once
+                    pend = [(int(m.payloads[d]),
+                             self._fp_fn(self._cache,
+                                         jnp.int32(int(m.payloads[d]))))
+                            for d in range(n_alias)]
+                    for bid, fp_d in pend:
+                        if not self._fp.check(bid, float(fp_d)):
+                            self._trip(
+                                "fingerprint",
+                                "KV block %d fingerprint mismatch on "
+                                "aliased re-open (committed %r)"
+                                % (bid, self._fp.expected(bid)))
                 pc.record_hit(m)  # the probe resolves to a real use
                 keep = n_alias - n_cow
                 for d in range(keep):
@@ -997,6 +1220,13 @@ class ServingEngine(object):
         def _take(d):
             bid = int(self._tables[s, d])
             self._alloc.incref(bid)
+            if self._fp is not None:
+                # ISSUE 15: publish is where a block CLOSES — it is
+                # full (only whole prompt blocks publish; the slot's
+                # later decode writes land past them) and any future
+                # write goes through COW to a private copy. Commit the
+                # fingerprint now; aliased re-opens verify against it.
+                self._fp.commit(bid, self._fp_of(bid))
             return bid
 
         pc.publish(h.full_prompt, n_blocks, _take)
@@ -1018,7 +1248,7 @@ class ServingEngine(object):
         padded[:c] = h.full_prompt[cursor:cursor + c]
         fn = self._chunk_fn(Cb)
         t0 = time.monotonic()
-        self._cache, first = fn(
+        self._cache, first, trap_d, scale_d = fn(
             self._params, self._cache, jnp.asarray(padded),
             jnp.int32(cursor), jnp.asarray(self._tables[s]),
             jnp.int32(c), jnp.float32(h.temperature), st["key"],
@@ -1034,6 +1264,12 @@ class ServingEngine(object):
             self.metrics.span("prefill_T%d" % Cb, time.monotonic() - t0)
             return False
         first = int(np.asarray(first))  # blocks: first token is real
+        if self.integrity_traps:
+            # the trap rides the same readback sync (mid-prompt chunks
+            # stay dispatch-only: a mid-chunk NaN propagates through
+            # the cache into THIS final chunk's logits)
+            self._check_integrity(trap_d, np.asarray(scale_d),
+                                  "prefill chunk", slots=[s])
         now = time.monotonic()
         h.ttft_s = now - h.submit_t
         self.metrics.ttft_s.append(h.ttft_s)
@@ -1192,6 +1428,12 @@ class ServingEngine(object):
         try:
             if inj.active:
                 inj.tick()
+                if inj.take_flip():
+                    # flip@ drill (ISSUE 15): silent KV corruption —
+                    # finite garbage into one resident block, invisible
+                    # to the numeric traps, caught only by the
+                    # fingerprint spot-check at aliased re-open
+                    self._flip_resident_block()
             out = self._step_inner()
         except Exception as exc:
             self.abort(exc)
@@ -1251,14 +1493,20 @@ class ServingEngine(object):
             p = int(self._pos[s])
             self._ensure_blocks(s, p, p + 1)
         t0 = time.monotonic()
-        self._cache, nxt_d, pos_d, counts_d = self._decode_fn(
-            self._params, self._cache, self._band("tables"),
-            self._band("tok"), self._band("pos"), self._band("alive"),
-            self._band("temps"), self._band("counts"),
-            self._band("base_keys"),
-            **self._adapter_args(self._band("aidx")),
-        )
+        self._cache, nxt_d, pos_d, counts_d, trap_d, scale_d = \
+            self._decode_fn(
+                self._params, self._cache, self._band("tables"),
+                self._band("tok"), self._band("pos"),
+                self._band("alive"), self._band("temps"),
+                self._band("counts"), self._band("base_keys"),
+                **self._adapter_args(self._band("aidx")),
+            )
         nxt = np.asarray(nxt_d)  # blocks; tokens are real
+        if self.integrity_traps:
+            # a tripped slot becomes an integrity event INSTEAD of an
+            # emitted token: checked before the emit loop below, so no
+            # token from a poisoned step reaches a handle
+            self._check_integrity(trap_d, np.asarray(scale_d), "decode")
         # the decode step advanced tok/pos/counts on device; adopt its
         # outputs so an admission-free step re-uploads nothing. (Dead
         # rows: device tok holds this step's don't-care sample, host
@@ -1312,7 +1560,7 @@ class ServingEngine(object):
             self._ensure_blocks(s, lo, min(lo + K, int(self._limits[s])))
             window[s] = self._draft_window(s)
         t0 = time.monotonic()
-        self._cache, cand_d = self._verify_fn(
+        self._cache, cand_d, trap_d, scale_d = self._verify_fn(
             self._params, self._cache, self._band("tables"),
             jnp.asarray(window), self._band("pos"), self._band("alive"),
             self._band("limits"), self._band("temps"),
@@ -1320,6 +1568,9 @@ class ServingEngine(object):
             **self._adapter_args(self._band("aidx")),
         )
         cand = np.asarray(cand_d)  # blocks; candidates are real
+        if self.integrity_traps:
+            self._check_integrity(trap_d, np.asarray(scale_d),
+                                  "spec verify")
         self.metrics.span("spec_verify", time.monotonic() - t0)
         self.metrics.decode_steps += 1
         self.metrics.occupancy.append(

@@ -126,6 +126,20 @@ Guarantees (the PR-1 drills' falsifiability bar, recast for serving):
     the journal like a demotion) — pinned by the `rollout_policy`
     knob, so a request's verdict version always matches its final
     assignment.
+  * Serving integrity (ISSUE 15) — replicas that are alive, fast, and
+    WRONG: the engines' in-step numeric traps and KV block
+    fingerprints raise `IntegrityError` into the crash path, and
+    `canary_interval_s` adds known-answer canary requests on LIVE
+    replicas judged against a per-weights_version golden trace. Any
+    trip QUARANTINES the replica (killed under a fresh incarnation
+    through the supervisor backoff) and journals an `integrity`
+    record tainting its progress since the last clean canary: the
+    mirror truncates to the verified prefix, resubmission resumes
+    from the last verified token index, and the taint window
+    re-decodes on a healthy survivor — the one sanctioned exception
+    to PR 8's zero-re-decode rule, audited by the journal DFA's J010.
+    A done landing from inside a taint window is refused by the
+    fence (the tripped incarnation is dead; `zombie_refused`).
 
 Threading: all shared scheduler state lives on `ServingFleet` and is
 guarded by ONE condition's lock (`_cond`); replica threads and the
@@ -148,6 +162,7 @@ import numpy as np
 
 from ..distributed.supervisor import restart_backoff_s as _backoff
 from .engine import EngineFailed, ServingEngine
+from .integrity import CANARY_PROMPT, IntegrityError, golden_trace
 from .prefix_cache import chain_keys
 from .tenancy import TenantQuotaExceeded, WFQueue
 
@@ -155,7 +170,7 @@ __all__ = [
     "ServingFleet", "FleetHandle", "FleetSaturated", "RequestJournal",
     "DeadlineExceeded", "FleetTimeout", "run_fleet_subprocess",
     "SchedulerHook", "RolloutAborted", "save_weights",
-    "TenantQuotaExceeded",
+    "TenantQuotaExceeded", "IntegrityError",
 ]
 
 
@@ -398,6 +413,10 @@ class FleetHandle(object):
         self.batch_fn = None
         self.batch_result = None
         self._probe = False   # internal health probe, never journaled
+        # known-answer canary (ISSUE 15): a _probe-shaped request on a
+        # LIVE replica whose completion is judged against the golden
+        # trace instead of the demotion-restore machinery
+        self._canary = False
         self._fleet = fleet
         self._submit_t = time.monotonic()
         self._event = threading.Event()
@@ -493,6 +512,12 @@ class RequestJournal(object):
         # reproduce it
         self._assign_meta: Dict[int, Tuple[Optional[str], Optional[int], Optional[str]]] = {}  # guarded-by: _lock
         self._progress: Dict[int, List[int]] = {}    # guarded-by: _lock
+        # taint side-band (ISSUE 15): open rids whose journaled
+        # progress was truncated by an integrity record — rid ->
+        # (replica, incarnation, from, upto). Compaction must
+        # reproduce these (the J010 re-decode audit spans rotations);
+        # terminal records prune them like every other mirror entry
+        self._taint: Dict[int, Tuple[str, int, int, int]] = {}  # guarded-by: _lock
         self._done: Set[int] = set()                 # guarded-by: _lock
         # records handed out via defer=True whose file append is still
         # pending in the caller: while any are outstanding the mirror
@@ -565,6 +590,11 @@ class RequestJournal(object):
         if rec["kind"] == "meta":  # compaction marker: rid history
             self._max_rid = max(self._max_rid, rec["max_rid"])
             return
+        if rec["kind"] == "integrity":  # taint side-band (ISSUE 15)
+            self._apply_taint(rec["replica"], rec["incarnation"],
+                              {int(r): (w[0], w[1])
+                               for r, w in rec["taint"].items()})
+            return
         rid = rec["rid"]
         self._max_rid = max(self._max_rid, rid)
         if rec["kind"] == "submit":
@@ -583,6 +613,7 @@ class RequestJournal(object):
             self._assign.pop(rid, None)
             self._assign_meta.pop(rid, None)
             self._progress.pop(rid, None)
+            self._taint.pop(rid, None)
 
     def _append(self, rec: dict, flush: bool = True):
         if self._f is not None:
@@ -607,7 +638,11 @@ class RequestJournal(object):
     def _open_records(self) -> List[dict]:
         """The records a compaction must preserve: one meta record (the
         rid history, so next_rid() survives the rewrite) plus each open
-        request's submit, latest assign, and accumulated progress."""
+        request's submit, latest assign, and accumulated progress —
+        and, for rids inside an active taint window, the consolidated
+        `integrity` side-band (grouped by quarantined holder), so the
+        J010 re-decode audit still knows which token indices are
+        sanctioned to re-decode after a rotation (ISSUE 15)."""
         recs: List[dict] = [{"kind": "meta", "max_rid": self._max_rid}]
         for rid in sorted(self._open_specs):
             recs.append({"kind": "submit", "rid": rid,
@@ -625,6 +660,28 @@ class RequestJournal(object):
                              "replica": None, "incarnation": None,
                              "gen": None,
                              "tokens": list(self._progress[rid])})
+        by_holder: Dict[Tuple[str, int], Dict[int, Tuple[int, int]]] = {}
+        for rid, (rep, inc, frm, upto) in self._taint.items():
+            if rid not in self._open_specs:
+                continue
+            # emit only the REMAINING sanctioned span: the consolidated
+            # progress record above already reflects the truncation
+            # (plus any re-decode the survivor journaled since), so
+            # replaying this record must truncate NOTHING — a window
+            # anchored at the original `from` would discard the
+            # survivor's verified re-decode on restart. Fully-consumed
+            # windows were already dropped by progress(); this guards
+            # the same invariant for windows consumed between there
+            # and the snapshot
+            cur = len(self._progress.get(rid, []))
+            lo = max(frm, cur)
+            if lo < upto:
+                by_holder.setdefault((rep, inc), {})[rid] = (lo, upto)
+        for (rep, inc) in sorted(by_holder):
+            recs.append({
+                "kind": "integrity", "replica": rep, "incarnation": inc,
+                "taint": {str(r): [f, u] for r, (f, u)
+                          in sorted(by_holder[(rep, inc)].items())}})
         return recs
 
     def _maybe_compact(self):  # holds: _lock
@@ -729,11 +786,62 @@ class RequestJournal(object):
             self._assign.pop(rid, None)
             self._assign_meta.pop(rid, None)
             self._progress.pop(rid, None)
+            self._taint.pop(rid, None)
             if defer:
                 self._deferred_out += 1
                 return rec
             self._append(rec)
         return None
+
+    def _apply_taint(self, replica: str, incarnation: int,
+                     taint: Dict[int, Tuple[int, int]]):  # holds: _lock
+        """Mirror effect of one integrity record: truncate each tainted
+        rid's accumulated progress back to its verified index `from`,
+        so `lost()`/`progress_of()` hand failover the CLEAN prefix and
+        the taint window [from, upto) re-decodes on the survivor."""
+        for rid, (frm, upto) in taint.items():
+            rid = int(rid)
+            cur = self._progress.get(rid)
+            if cur is not None:
+                self._progress[rid] = cur[:int(frm)]
+            if rid in self._open_specs:
+                self._taint[rid] = (replica, int(incarnation),
+                                    int(frm), int(upto))
+
+    def integrity(self, replica: str, incarnation: int,
+                  taint: Dict[int, Tuple[int, int]], reason=None,
+                  defer: bool = False) -> Optional[dict]:
+        """Integrity quarantine record (ISSUE 15): replica
+        (replica, incarnation) tripped the serving sentinel, and every
+        journaled progress token it produced since its last clean
+        canary is TAINTED. `taint` maps rid -> (from, upto): token
+        indices [from, upto) of that rid's accumulated progress are
+        suspect. The MIRROR truncates each rid's progress to `from`
+        synchronously (the failover an instant later resumes from the
+        verified prefix — the one sanctioned exception to PR 8's
+        zero-re-decode rule), and the DFA's J010 audits that ONLY
+        indices inside a journaled taint window ever re-decode."""
+        rec = {"kind": "integrity", "replica": str(replica),
+               "incarnation": int(incarnation),
+               "taint": {str(int(r)): [int(f), int(u)]
+                         for r, (f, u) in sorted(taint.items())}}
+        if reason is not None:
+            rec["reason"] = str(reason)
+        with self._lock:
+            self._apply_taint(str(replica), int(incarnation),
+                              {int(r): (int(f), int(u))
+                               for r, (f, u) in taint.items()})
+            if defer:
+                self._deferred_out += 1
+                return rec
+            self._append(rec)
+        return None
+
+    def taint_of(self, rid: int) -> Optional[Tuple[str, int, int, int]]:
+        """(replica, incarnation, from, upto) of the rid's active taint
+        window, or None."""
+        with self._lock:
+            return self._taint.get(rid)
 
     def complete(self, rid: int, replica: str, incarnation: int,
                  gen: int, tokens: List[int],
@@ -765,7 +873,15 @@ class RequestJournal(object):
                "incarnation": incarnation, "gen": gen,
                "tokens": [int(t) for t in tokens]}
         with self._lock:
-            self._progress.setdefault(rid, []).extend(rec["tokens"])
+            acc = self._progress.setdefault(rid, [])
+            acc.extend(rec["tokens"])
+            t = self._taint.get(rid)
+            if t is not None and len(acc) >= t[3]:
+                # the survivor's re-decode caught up with the taint
+                # window: it is CONSUMED — a later compaction must not
+                # re-emit (and replay must not re-truncate) a window
+                # whose re-decode already happened
+                del self._taint[rid]
             if defer:
                 self._deferred_out += 1
                 return rec
@@ -887,6 +1003,14 @@ class RequestJournal(object):
         for rec in RequestJournal._read(path):
             if rec["kind"] == "progress" and rec["rid"] in open_set:
                 prog.setdefault(rec["rid"], []).extend(rec["tokens"])
+            elif rec["kind"] == "integrity":
+                # taint truncation applies across restarts too: a
+                # restarted front door must not resume a corrupt
+                # replica's tainted suffix (ISSUE 15)
+                for rid_s, (frm, _upto) in rec["taint"].items():
+                    rid = int(rid_s)
+                    if rid in prog:
+                        prog[rid] = prog[rid][:int(frm)]
         return prog
 
 
@@ -1175,6 +1299,13 @@ class _Replica(object):
             out["prefix_misses"] = e.prefix_cache.misses
             out["prefix_tokens_saved"] = e.prefix_cache.tokens_saved
         # getattr: scripted metric surfaces (sched_explore) predate it
+        bf = getattr(m, "block_fp", None)
+        if bf is not None:
+            # ISSUE 15 fingerprint counters: cumulative ints, folded
+            # into _stats_base on replica death/retire like the rest
+            out["fp_committed"] = bf.committed
+            out["fp_verified"] = bf.verified
+            out["fp_mismatches"] = bf.mismatches
         ap = getattr(e.metrics, "adapter_pool", None)
         if ap is not None:
             # cumulative adapter-pool counters (ISSUE 12): fold into
@@ -1325,6 +1456,27 @@ class ServingFleet(object):
                            engine's max_slots). Smaller = fairer
                            under contention, larger = deeper engine
                            queues
+      canary_interval_s    known-answer canary cadence (ISSUE 15):
+                           every LIVE replica gets a tiny greedy
+                           canary request on this period, judged
+                           against a GOLDEN trace computed once per
+                           weights_version (construction + every
+                           roll_weights commit); a mismatch is an
+                           integrity trip — quarantine + taint-aware
+                           resume, exactly-once per incarnation. A
+                           clean canary advances the replica's TAINT
+                           BASE: a later trip taints (and re-decodes)
+                           only tokens journaled past it. None
+                           (default) = canaries off
+      canary_max_new       golden-trace length in tokens (default 4);
+                           see the README cadence-vs-step-latency
+                           sizing rule
+      canary_prompt /      explicit canary prompt / golden tokens —
+      canary_golden        golden is REQUIRED for scripted engine
+                           factories and quantized fleets (their
+                           outputs are not token-identical to
+                           generate(), so the fleet refuses to derive
+                           the known answer itself)
     """
 
     def __init__(self, params, cfg, n_replicas=2, journal_path=None,
@@ -1340,7 +1492,9 @@ class ServingFleet(object):
                  scale_up_open_per_replica=4, scale_up_headroom_s=None,
                  scale_down_idle_s=2.0, scale_cooldown_s=1.0,
                  ckpt_dir=None, rollout_policy="finish",
-                 weights_version=0, tenants=None, wfq_window=None):
+                 weights_version=0, tenants=None, wfq_window=None,
+                 canary_interval_s=None, canary_max_new=4,
+                 canary_prompt=None, canary_golden=None):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
         if int(max_pending) < 1:
@@ -1473,6 +1627,46 @@ class ServingFleet(object):
                             else int(wfq_window))
         self._slots_per_replica = int(
             self._engine_kw.get("max_slots") or 8)
+        # known-answer canaries (ISSUE 15): periodic canary requests on
+        # LIVE replicas (PR 8's probe machinery, extended past
+        # demoted-only), judged against a GOLDEN token trace computed
+        # once per weights_version. A mismatch is an integrity trip:
+        # quarantine + taint-aware resume, not demotion.
+        self.canary_interval_s = (None if canary_interval_s is None
+                                  else float(canary_interval_s))
+        self.canary_max_new = int(canary_max_new)
+        self._canary_prompt = tuple(
+            int(t) for t in (canary_prompt if canary_prompt is not None
+                             else CANARY_PROMPT))
+        self._canary_golden: Dict[Any, List[int]] = {}  # guarded-by: _cond
+        self._canary_golden_default: Optional[List[int]] = None
+        self._canary_auto = False
+        if self.canary_interval_s is not None:
+            if self.canary_interval_s <= 0.0:
+                raise ValueError("canary_interval_s must be > 0 or None")
+            if self.canary_max_new < 1:
+                raise ValueError("canary_max_new must be >= 1")
+            if canary_golden is not None:
+                # explicit golden: scripted engines (sched_explore) and
+                # quantized fleets supply their own known answer
+                self._canary_golden_default = [int(t)
+                                               for t in canary_golden]
+            else:
+                if self._engine_factory is not ServingEngine:
+                    raise ValueError(
+                        "canaries on a custom engine_factory need an "
+                        "explicit canary_golden= (the fleet cannot "
+                        "derive a golden trace for a scripted engine)")
+                if self.kv_quant != "none" or self.weight_quant is not None:
+                    raise ValueError(
+                        "canaries on a quantized fleet need an explicit "
+                        "canary_golden=: quantized engine outputs are "
+                        "not token-identical to generate(), so the "
+                        "fleet cannot compute the golden trace itself")
+                self._canary_auto = True
+                self._canary_golden[int(weights_version)] = golden_trace(
+                    params, cfg, self._canary_prompt,
+                    self.canary_max_new)
 
         # ONE lock for all fleet scheduler state (the condition owns
         # it); replica + monitor threads mutate ONLY under it
@@ -1518,6 +1712,22 @@ class ServingFleet(object):
         # summary, and the replica's revision cache would otherwise
         # never resend an UNCHANGED (warm!) pool after restore
         self._want_summary: List[bool] = []            # guarded-by: _cond
+        # serving integrity (ISSUE 15): outstanding canary handle +
+        # schedule per slot, the TAINT BASE — per in-flight rid, the
+        # resume length at ASSIGNMENT (tokens earlier holders already
+        # vouched for) — and the CANARY MARK, the journaled-progress
+        # length the last clean canary vouched for. A trip taints
+        # [start, now) where start is the canary mark ONLY for
+        # canary-kind trips: a canary exercises the engine-global
+        # compute path (the garble class), so its clean verdict can
+        # vouch for every token the engine emitted — but it never
+        # attends through another request's KV blocks, so a
+        # fingerprint/trap/spike trip (block-level corruption the
+        # canary cannot see) must taint from the assignment base
+        self._canaries: List[Optional[FleetHandle]] = []  # guarded-by: _cond
+        self._canary_at: List[float] = []              # guarded-by: _cond
+        self._taint_base: List[Dict[int, int]] = []    # guarded-by: _cond
+        self._canary_mark: List[Dict[int, int]] = []   # guarded-by: _cond
         # elastic lifecycle (ISSUE 11): drain-then-retire marker the
         # scaler sets and the replica's own handshake consumes, plus
         # the scaler's shared cool-down gate and sustained-low-load
@@ -1575,6 +1785,14 @@ class ServingFleet(object):
         self.migrations = 0                            # guarded-by: _cond
         self.rollouts_completed = 0                    # guarded-by: _cond
         self.rollout_aborts = 0                        # guarded-by: _cond
+        # serving-integrity counters (ISSUE 15): fleet-scope monotonic
+        self.integrity_trips = 0                       # guarded-by: _cond
+        # trip KIND attribution ("trap"/"fingerprint"/"spike"/"canary")
+        self.integrity_trip_kinds: Dict[str, int] = {}  # guarded-by: _cond
+        self.canaries_sent = 0                         # guarded-by: _cond
+        self.canaries_ok = 0                           # guarded-by: _cond
+        self.canary_mismatches = 0                     # guarded-by: _cond
+        self.tainted_tokens = 0                        # guarded-by: _cond
 
         self._idle_wait_s = min(0.02, self.heartbeat_timeout_s / 10.0)
         self._monitor_interval_s = (
@@ -1606,6 +1824,11 @@ class ServingFleet(object):
                 self._probe_ok.append(0)
                 self._want_summary.append(False)
                 self._retire_flag.append(False)
+                self._canaries.append(None)
+                self._canary_at.append(
+                    time.monotonic() + (self.canary_interval_s or 0.0))
+                self._taint_base.append({})
+                self._canary_mark.append({})
                 self._replicas.append(self._make_replica(i, 1))
         for i, r in enumerate(self._replicas):
             if self._state[i] == _LIVE:
@@ -2087,6 +2310,11 @@ class ServingFleet(object):
                 best, best_key = i, key
         rep = self._replicas[best]
         self._inbox[best].append(h)
+        # taint base (ISSUE 15): the resume prefix was produced (and
+        # vouched for) by EARLIER holders — if this assignee trips, its
+        # taint window opens at the resume boundary, never before it.
+        # A later clean canary on the replica advances the base.
+        self._taint_base[best][h.rid] = len(h.resume)
         # mirror updates NOW (a failover consulting lost() must see
         # this assignment); the file record flushes after the lock.
         # tier + weights_version ride the record as the version-fence
@@ -2146,6 +2374,10 @@ class ServingFleet(object):
         self._done_rids.add(rid)
         for fl in self._in_flight:
             fl.pop(rid, None)
+        for tb in self._taint_base:
+            tb.pop(rid, None)
+        for cm in self._canary_mark:
+            cm.pop(rid, None)
         self.rejected += 1
         if h is not None and h.tenant is not None \
                 and self._tenants is not None:
@@ -2244,7 +2476,13 @@ class ServingFleet(object):
                 self._absorb_progress(rep, progress)
             for rid, tokens, reason in completed:
                 self._accept(rid, tokens, reason, rep, accepted=current)
-            if not current or self._closing:
+            if not current or self._closing \
+                    or self._replicas[i] is not rep \
+                    or self._state[i] in (_DEAD, _RETIRED):
+                # the re-check matters: a canary MISMATCH judged in the
+                # _accept loop above quarantines this very replica
+                # (ISSUE 15) — its own handshake must observe the
+                # verdict and stop, not pick up another round of work
                 return "stop", [], [], False
             if summary is not None:
                 self._summaries[i] = summary
@@ -2395,9 +2633,19 @@ class ServingFleet(object):
         answer for an already-done rid. `tokens` are the reporting
         incarnation's NEWLY generated tokens; the resumed prefix is
         prepended here so the caller always sees the full output."""
-        if rid < 0:  # internal health probe: never journaled
+        if rid < 0:  # internal health probe / canary: never journaled
             self._in_flight[rep.index].pop(rid, None)
-            self._probe_done(rep, completed_ok=accepted)
+            h = self._handles.get(rid)
+            if h is not None and h._canary:
+                self._canary_done(rep, h, tokens, ok=accepted)
+                return
+            ph = self._probes[rep.index]
+            if ph is not None and ph.rid == rid:
+                # identity-routed: a DROPPED canary's late completion
+                # (its handle already released at demote/drain) must
+                # not masquerade as health-probe evidence and credit a
+                # restore the probe never earned
+                self._probe_done(rep, completed_ok=accepted)
             return
         if not accepted:
             self.zombie_refused += 1
@@ -2432,6 +2680,8 @@ class ServingFleet(object):
             return
         self._done_rids.add(rid)
         self._in_flight[rep.index].pop(rid, None)
+        self._taint_base[rep.index].pop(rid, None)
+        self._canary_mark[rep.index].pop(rid, None)
         self._open.discard(rid)
         # prune the handle (the caller holds its own reference): a
         # long-lived front door must not retain every prompt + output
@@ -2481,6 +2731,10 @@ class ServingFleet(object):
         self._handles.pop(rid, None)
         for fl in self._in_flight:
             fl.pop(rid, None)
+        for tb in self._taint_base:
+            tb.pop(rid, None)
+        for cm in self._canary_mark:
+            cm.pop(rid, None)
         self.expired += 1
         if h.tenant is not None and self._tenants is not None:
             self._tenants.on_expire(h.tenant)
@@ -2490,8 +2744,29 @@ class ServingFleet(object):
         self._cond.notify_all()
 
     def _on_crash(self, rep: _Replica, exc: BaseException):  # thread: replica
+        # unwrap engine-latch wrappers: the FIRST failure decides the
+        # recovery path — an IntegrityError (trap, fingerprint, spike)
+        # takes the quarantine + taint route, anything else the plain
+        # failover that trusts journaled progress (ISSUE 15)
+        root = exc
+        while isinstance(root, EngineFailed) and root.__cause__ is not None:
+            root = root.__cause__
+        # final stats snapshot, taken ON the dying replica's own thread
+        # (the engine is confined here): without it, counters that
+        # moved between the last handshake and the crash — an integrity
+        # trip's fingerprint mismatch above all — would never fold into
+        # the fleet totals
+        try:
+            final_stats = rep._stats()
+        except Exception:
+            final_stats = None
         with self._cond:
-            self._fail_over(rep.index, rep, exc)
+            if self._replicas[rep.index] is rep and final_stats is not None:
+                self._rep_stats[rep.index] = final_stats
+            if isinstance(root, IntegrityError):
+                self._integrity_trip_locked(rep.index, rep, root)
+            else:
+                self._fail_over(rep.index, rep, exc)
         self._flush_journal()
 
     # -- failure handling ------------------------------------------------
@@ -2544,6 +2819,12 @@ class ServingFleet(object):
             self._probes[i]._event.set()
             self._probes[i] = None
         self._probe_ok[i] = 0
+        # ISSUE 15: the canary (never journaled) and the taint-base
+        # marks die with the incarnation — the integrity trip path
+        # already consumed the marks it needed BEFORE calling here
+        self._drop_canary_locked(i)
+        self._taint_base[i] = {}
+        self._canary_mark[i] = {}
         self._want_summary[i] = False  # a fresh incarnation sends anew
         # the JOURNAL is the recovery source: every open request whose
         # latest assignment names this replica+incarnation, resumed
@@ -2660,6 +2941,8 @@ class ServingFleet(object):
                         self._refill_locked(i)
                 if self.slow_replica_factor is not None:
                     self._health_sweep(now)
+                if self.canary_interval_s is not None:
+                    self._canary_sweep(now)
                 if self.min_replicas < self.max_replicas:
                     self._scale_sweep(now)
                 if self._wfq is not None:
@@ -2813,6 +3096,12 @@ class ServingFleet(object):
         self._cancels[i].update(rid for rid, _s, _g, _t in lost)
         self._in_flight[i].clear()
         self._resubmit_lost(i, rep, lost=lost)
+        # ISSUE 15: an outstanding canary would be cancelled with the
+        # hedged work and never complete — release it so the restored
+        # replica's sweep can send a fresh one
+        self._drop_canary_locked(i)
+        self._taint_base[i] = {}
+        self._canary_mark[i] = {}
         self._probe_ok[i] = 0
         self._probe_at[i] = time.monotonic() + self.probe_interval_s
         self._cond.notify_all()
@@ -2883,6 +3172,167 @@ class ServingFleet(object):
         else:
             self._probe_ok[i] = 0
         self._probe_at[i] = time.monotonic() + self.probe_interval_s
+
+    # -- serving integrity (ISSUE 15) ------------------------------------
+    def _golden_for(self, weights_version) -> Optional[List[int]]:  # holds: _cond
+        """The golden canary trace for one weight version (computed at
+        construction / rollout commit), or the explicit default."""
+        g = self._canary_golden.get(
+            weights_version if weights_version is None
+            else int(weights_version))
+        return g if g is not None else self._canary_golden_default
+
+    def _drop_canary_locked(self, i: int):  # holds: _cond
+        """Release slot i's outstanding canary handle (the replica is
+        leaving LIVE service — death, demotion, drain, refill, close —
+        so the canary's completion can no longer be judged fairly)."""
+        ch = self._canaries[i]
+        if ch is not None:
+            self._handles.pop(ch.rid, None)
+            for fl in self._in_flight:
+                fl.pop(ch.rid, None)
+            ch._event.set()
+            self._canaries[i] = None
+        if self.canary_interval_s is not None:
+            self._canary_at[i] = time.monotonic() + self.canary_interval_s
+
+    def _canary_sweep(self, now: float):  # thread: monitor, holds: _cond
+        """Ship one known-answer canary per LIVE replica every
+        `canary_interval_s` (PR 8's probe machinery extended past
+        demoted-only): a tiny greedy request whose completion is
+        judged against the per-weights_version golden trace. Sized
+        like probes — within the REPLICA's own composed engine limits,
+        so an engine_kw_for override can never wedge a canary at
+        admission."""
+        for i in range(self.max_replicas):
+            if self._state[i] != _LIVE or self._canaries[i] is not None:
+                continue
+            if now < self._canary_at[i]:
+                continue
+            rep = self._replicas[i]
+            golden = self._golden_for(rep.weights_version)
+            if golden is None:
+                # no golden for this version (mid-rollout window):
+                # skip this round, never guess
+                self._canary_at[i] = now + self.canary_interval_s
+                continue
+            L, bt, pb = self._limits_for(rep._engine_kw)
+            P0 = len(self._canary_prompt)
+            max_new = min(len(golden), L - P0, bt * pb - P0)
+            if max_new < 1:
+                self._canary_at[i] = now + self.canary_interval_s
+                continue
+            rid = self._next_probe_rid
+            self._next_probe_rid -= 1
+            spec = {"prompt": [int(t) for t in self._canary_prompt],
+                    "max_new_tokens": int(max_new), "temperature": 0.0,
+                    "eos_id": None, "seed": 0, "publish_len": 0,
+                    "slo": None, "deadline_s": None,
+                    "submit_unix": time.time()}
+            h = FleetHandle(rid,
+                            np.asarray(self._canary_prompt, np.int32),
+                            spec, None, fleet=self)
+            h._probe = True
+            h._canary = True
+            self._handles[rid] = h
+            self._canaries[i] = h
+            self.canaries_sent += 1
+            self._inbox[i].append(h)
+            self._cond.notify_all()
+
+    def _canary_done(self, rep: _Replica, h: FleetHandle, tokens,
+                     ok: bool):  # holds: _cond
+        """A canary came back: a golden match is the CLEAN mark — every
+        token this replica has journaled so far is vouched for, so the
+        taint base of its in-flight rids advances to now. A mismatch
+        is an integrity trip: quarantine + taint since the last clean
+        mark. A fenced (zombie/superseded) completion is evidence of
+        nothing and only reschedules."""
+        i = rep.index
+        if self._canaries[i] is not h or self._replicas[i] is not rep:
+            self._handles.pop(h.rid, None)
+            h._event.set()
+            return  # stale canary: a newer incarnation owns the slot
+        self._canaries[i] = None
+        self._handles.pop(h.rid, None)
+        h._event.set()
+        if not ok:
+            self._canary_at[i] = time.monotonic() + self.canary_interval_s
+            return
+        golden = self._golden_for(rep.weights_version) or []
+        want = golden[:int(h.spec["max_new_tokens"])]
+        if list(tokens) == list(want):
+            self.canaries_ok += 1
+            # the clean mark: sound because the canary's completion
+            # and the progress it vouches for ride the SAME handshake
+            # (the replica loop collects both in the iteration of the
+            # step that finished the canary — nothing later can be
+            # under the mark), and consumed only by canary-KIND trips
+            # (engine-global corruption; a canary cannot vouch for
+            # another request's KV blocks)
+            for rid in self._in_flight[i]:
+                if rid >= 0:
+                    self._canary_mark[i][rid] = len(
+                        self._journal.progress_of(rid))
+            self._canary_at[i] = time.monotonic() + self.canary_interval_s
+            return
+        self.canary_mismatches += 1
+        self._integrity_trip_locked(
+            i, rep,
+            IntegrityError(
+                "canary mismatch on %s.i%d: got %r, want %r"
+                % (rep.name, rep.incarnation, list(tokens), want),
+                kind="canary", replica=rep.name))
+
+    def _integrity_trip_locked(self, i: int, rep: _Replica,
+                               exc: BaseException):  # holds: _cond
+        """Quarantine a corrupt replica (caller holds `_cond`;
+        exactly-once per incarnation): journal the TAINT side-band —
+        every open rid assigned here whose journaled progress grew past
+        its taint base gets a window [base, now) — which truncates the
+        mirror to the verified prefix, then declare the replica dead
+        through the normal failover path. The failover's resubmission
+        therefore resumes each request from its last VERIFIED token
+        index, and the taint window re-decodes on a healthy survivor:
+        the one sanctioned exception to PR 8's zero-re-decode rule,
+        journal-audited (J010) so ONLY tainted tokens ever re-decode.
+        The fresh incarnation comes through the PR 11 supervisor
+        backoff exactly like a crash (auto_refill / refill())."""
+        if self._replicas[i] is not rep or self._state[i] == _DEAD:
+            return  # already quarantined/failed over this incarnation
+        self.integrity_trips += 1
+        kind = getattr(exc, "kind", "unknown")
+        self.integrity_trip_kinds[kind] = \
+            self.integrity_trip_kinds.get(kind, 0) + 1
+        lost = self._journal.lost(rep.name, rep.incarnation)
+        # canary-kind trips may tighten the window to the last clean
+        # canary's mark (engine-global corruption is exactly what the
+        # canary vouches against); fingerprint/trap/spike trips taint
+        # from the assignment base — a clean canary between a KV flip
+        # and its detection must NOT launder the flipped block's
+        # tokens past the window (review hardening: the canary never
+        # attended through that block)
+        use_marks = kind == "canary"
+        taint: Dict[int, Tuple[int, int]] = {}
+        for rid, _spec, _gen, toks in lost:
+            base = self._taint_base[i].get(rid, 0)
+            if use_marks:
+                base = max(base, self._canary_mark[i].get(rid, 0))
+            if len(toks) > base:
+                taint[rid] = (base, len(toks))
+        if taint:
+            self.tainted_tokens += sum(u - f for f, u in taint.values())
+            # mirror truncation happens HERE (synchronously, like every
+            # assign/complete): _fail_over's journal scan an instant
+            # later hands the survivor the verified prefix only
+            self._pending_journal.append(self._journal.integrity(
+                rep.name, rep.incarnation, taint, reason=str(exc),
+                defer=True))
+            for rid, (frm, _u) in taint.items():
+                hh = self._handles.get(rid)
+                if hh is not None:
+                    hh.emitted = frm
+        self._fail_over(i, rep, exc)
 
     # -- autoscaling (ISSUE 11) ------------------------------------------
     def _scale_sweep(self, now: float):  # thread: monitor, holds: _cond
@@ -3024,6 +3474,12 @@ class ServingFleet(object):
             self._cancels[i].update(rid for rid, _s, _g, _t in lost)
             self._in_flight[i].clear()
             self._resubmit_lost(i, rep, lost=lost)
+            self._taint_base[i] = {}
+            self._canary_mark[i] = {}
+        self._canary_mark[i] = {}
+        # a draining replica's canary would be cancelled (hedge) or
+        # park with the engine (finish) — release it either way
+        self._drop_canary_locked(i)
         self._cond.notify_all()
 
     def scale_up(self) -> bool:
@@ -3135,6 +3591,9 @@ class ServingFleet(object):
             self._probes[i]._event.set()
             self._probes[i] = None
         self._probe_ok[i] = 0
+        self._drop_canary_locked(i)
+        self._taint_base[i] = {}
+        self._canary_mark[i] = {}
         # starting the thread under the lock is safe: its first _sync
         # blocks on the condition until we release. A controlling
         # scheduler learns the name NOW, synchronously (thread_spawning
@@ -3149,7 +3608,8 @@ class ServingFleet(object):
 
     # -- live weight rollout (ISSUE 11) ----------------------------------
     def roll_weights(self, ckpt_step=None, params=None, version=None,
-                     policy=None, timeout: float = 120.0) -> dict:
+                     policy=None, timeout: float = 120.0,
+                     canary_golden=None) -> dict:
         """Roll the whole fleet onto a new weight version with zero
         downtime: rolling drain → swap → refill, one replica at a
         time, the rest keep serving throughout. The pserver push/pull
@@ -3184,11 +3644,34 @@ class ServingFleet(object):
         waits — a response's tokens all come from one version);
         "migrate" hedges them to survivors from the journal with
         token-level resume (faster swap; the completion records the
-        final holder's version). Returns a summary dict."""
+        final holder's version). Returns a summary dict.
+
+        Canary fleets (ISSUE 15): the new version's golden trace is
+        computed here for generate()-derivable fleets; an
+        explicit-golden fleet (quantized/scripted) must pass the new
+        version's known answer via `canary_golden=` — refused
+        (RolloutAborted, fleet untouched) otherwise, because judging
+        post-rollout canaries against the old answer would quarantine
+        healthy replicas in an endless refill loop."""
         policy = policy or self.rollout_policy
         if policy not in ("finish", "migrate"):
             raise ValueError("rollout policy must be 'finish' or "
                              "'migrate', got %r" % (policy,))
+        if self.canary_interval_s is not None and not self._canary_auto \
+                and canary_golden is None:
+            # an explicit-golden fleet (quantized / scripted) cannot
+            # have its new version's known answer derived here: without
+            # a fresh golden every post-rollout canary would mismatch
+            # against the OLD answer and quarantine healthy replicas in
+            # an endless refill loop — refuse BEFORE touching anything
+            with self._cond:
+                self.rollout_aborts += 1
+            raise RolloutAborted(
+                "this fleet's canaries use an explicit canary_golden "
+                "(quantized/scripted engines are not generate()-"
+                "derivable): roll_weights needs the NEW version's "
+                "golden via canary_golden= — rollout aborted, fleet "
+                "untouched")
         if params is not None:
             new_params = params
             # default version (previous + 1) is resolved INSIDE the
@@ -3245,6 +3728,22 @@ class ServingFleet(object):
             targets = [i for i in range(self.max_replicas)
                        if self._state[i] in (_LIVE, _DEMOTED,
                                              _DRAINING, _DRAINED)]
+        # known-answer canaries (ISSUE 15): the golden trace is per
+        # weights_version, computed at rollout COMMIT — a canary
+        # completing on an old-version replica mid-rollout is judged
+        # against ITS version's golden (the replica carries the
+        # version; _golden_for keys on it), never the new one's.
+        # Computed OUTSIDE the lock (a generate() compile must not
+        # stall handshakes); explicit-golden fleets passed the new
+        # answer in (validated up top — refused otherwise)
+        if self.canary_interval_s is not None:
+            golden = ([int(t) for t in canary_golden]
+                      if canary_golden is not None
+                      else golden_trace(new_params, self._cfg,
+                                        self._canary_prompt,
+                                        self.canary_max_new))
+            with self._cond:
+                self._canary_golden[int(new_version)] = golden
         try:
             for i in targets:
                 self._swap_replica(i, policy, timeout)
@@ -3417,6 +3916,9 @@ class ServingFleet(object):
             ad_misses = base.get("adapter_misses", 0)
             ad_evictions = base.get("adapter_evictions", 0)
             ad_uploads = base.get("adapter_uploads", 0)
+            fp_committed = base.get("fp_committed", 0)
+            fp_verified = base.get("fp_verified", 0)
+            fp_mismatches = base.get("fp_mismatches", 0)
             reps = []
             for i, rep in enumerate(self._replicas):
                 st = self._rep_stats[i] or {}
@@ -3434,6 +3936,9 @@ class ServingFleet(object):
                 ad_misses += st.get("adapter_misses", 0)
                 ad_evictions += st.get("adapter_evictions", 0)
                 ad_uploads += st.get("adapter_uploads", 0)
+                fp_committed += st.get("fp_committed", 0)
+                fp_verified += st.get("fp_verified", 0)
+                fp_mismatches += st.get("fp_mismatches", 0)
                 reps.append({
                     "name": rep.name, "slo": rep.slo,
                     "tier": rep.tier,
@@ -3483,6 +3988,16 @@ class ServingFleet(object):
                 "migrations": self.migrations,
                 "rollouts_completed": self.rollouts_completed,
                 "rollout_aborts": self.rollout_aborts,
+                # serving-integrity counters (ISSUE 15)
+                "integrity_trips": self.integrity_trips,
+                "integrity_trip_kinds": dict(self.integrity_trip_kinds),
+                "canaries_sent": self.canaries_sent,
+                "canaries_ok": self.canaries_ok,
+                "canary_mismatches": self.canary_mismatches,
+                "tainted_tokens": self.tainted_tokens,
+                "fp_committed": fp_committed,
+                "fp_verified": fp_verified,
+                "fp_mismatches": fp_mismatches,
                 "weights_version": self._weights_version,
                 "replicas_live": sum(
                     1 for s in self._state if s == _LIVE),
@@ -3539,6 +4054,11 @@ class ServingFleet(object):
                     self._handles.pop(ph.rid, None)
                     ph._event.set()
                     self._probes[i] = None
+            for i, ch in enumerate(self._canaries):
+                if ch is not None:  # outstanding canaries likewise
+                    self._handles.pop(ch.rid, None)
+                    ch._event.set()
+                    self._canaries[i] = None
             self._cond.notify_all()
         self._monitor.join(timeout=timeout)
         for rep in list(self._replicas):

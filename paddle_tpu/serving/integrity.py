@@ -1,0 +1,194 @@
+"""Serving integrity sentinel (ISSUE 15): silent-corruption detection
+for the inference path.
+
+The fleet survives replicas that are DEAD (PR 6 heartbeats/failover)
+and replicas that are SLOW (PR 8 gray-failure demotion). This module
+closes the third gap — replicas that are alive, fast, and **wrong**:
+non-finite logits, a bit-flipped KV block, a corrupted weight tile.
+PR 10 solved the same problem shape for training (check_nan_inf
+upgraded from raise-and-die to detect-and-recover, rollback to
+known-good, exactly-once quarantine); this is the serving counterpart,
+built from four mechanisms:
+
+  in-step numeric TRAPS   the one compiled decode/verify/chunk step
+                          additionally returns a per-slot non-finite
+                          flag (`transformer.logits_trap`: logits +
+                          softmax-denominator reduction — a few ops
+                          folded into the existing step, NO new
+                          traces) and a max-|logit| scalar the shared
+                          `utils.detector.TripDetector` watches for
+                          magnitude spikes (wrong-but-finite compute).
+                          A tripped slot becomes an integrity event
+                          INSTEAD of an emitted token.
+  KV block FINGERPRINTS   a cheap folded-f32 checksum per physical
+                          block (`transformer.paged_block_fingerprint`,
+                          riding block-id addressing like PR 14's
+                          quant scales), committed when a block closes
+                          (publish into the prefix trie), spot-verified
+                          when an aliased block is re-opened by a
+                          different request — which is also exactly
+                          where a failover RESUME re-attaches to the
+                          pool — so a flipped block cannot silently
+                          serve prefix-cache hits.
+  known-answer CANARIES   the fleet extends PR 8's probe machinery from
+                          demoted-only to periodic canary requests on
+                          LIVE replicas, checked against a golden token
+                          trace computed once per `weights_version`
+                          (fleet.py `canary_interval_s`).
+  QUARANTINE + TAINT      a tripped replica is killed under a fresh
+                          incarnation (PR 11 supervisor backoff), and
+                          its journaled progress since its last clean
+                          canary is marked TAINTED (`RequestJournal.
+                          integrity`): resubmission resumes from the
+                          last verified token index and the taint
+                          window is re-decoded on a healthy survivor —
+                          the ONE sanctioned exception to PR 8's
+                          zero-re-decode rule, journal-audited (J010)
+                          so ONLY tainted tokens ever re-decode.
+
+Threading: `BlockFingerprints` is engine state, confined to the
+engine's scheduler thread like every other side-band; `ServingSentinel`
+likewise. The fleet-side canary/taint state lives in fleet.py under
+`_cond`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..utils.detector import TripDetector
+
+__all__ = ["IntegrityError", "BlockFingerprints", "ServingSentinel",
+           "golden_trace", "CANARY_PROMPT"]
+
+# the fleet's default known-answer canary prompt: tiny, fixed, and in
+# every model's vocab range (ids 1..3) — the GOLDEN trace is what makes
+# it a known answer, the prompt only has to be deterministic
+CANARY_PROMPT = (1, 2, 3)
+
+# relative tolerance for fingerprint comparison: the recompute runs the
+# SAME jitted reduction on the same backend, so a clean block matches
+# essentially bitwise — the slack only forgives float noise far below
+# any real corruption's displacement
+_FP_RTOL = 1e-5
+
+
+class IntegrityError(RuntimeError):
+    """A serving replica produced evidence of silent corruption. Raised
+    by the engine (numeric trap, fingerprint mismatch, magnitude spike)
+    — crashing the replica thread into the fleet's quarantine path —
+    and synthesized by the fleet on a canary mismatch. `kind` is one of
+    "trap" | "fingerprint" | "spike" | "canary"; `replica` names the
+    tripped replica when known."""
+
+    def __init__(self, msg: str, kind: str = "trap", replica=None):
+        super().__init__(msg)
+        self.kind = kind
+        self.replica = replica
+
+
+class BlockFingerprints(object):
+    """Host bookkeeping for per-physical-block checksums (engine state,
+    thread-confined like the allocator). A fingerprint is COMMITTED
+    when a block closes (published into the prefix trie — full, never
+    written again: later writes land in later blocks, and a write into
+    a shared block goes through COW to a private copy), VERIFIED when
+    an aliased block is re-opened, and DROPPED when the block returns
+    to the free list (a recycled id must never be judged against its
+    previous tenant's checksum)."""
+
+    def __init__(self):
+        self._fp: Dict[int, float] = {}  # guarded-by: scheduler
+        # O(1) counters (ServingMetrics discipline)
+        self.committed = 0               # guarded-by: scheduler
+        self.verified = 0                # guarded-by: scheduler
+        self.mismatches = 0              # guarded-by: scheduler
+
+    def commit(self, bid: int, fp: float):
+        if bid not in self._fp:
+            self.committed += 1
+        self._fp[int(bid)] = float(fp)
+
+    def expected(self, bid: int) -> Optional[float]:
+        return self._fp.get(int(bid))
+
+    def drop(self, bid: int):
+        self._fp.pop(int(bid), None)
+
+    def check(self, bid: int, got: float) -> bool:
+        """Compare a recomputed fingerprint against the committed one;
+        True = clean (or never committed — nothing to judge)."""
+        exp = self._fp.get(int(bid))
+        if exp is None:
+            return True
+        self.verified += 1
+        ok = abs(float(got) - exp) <= _FP_RTOL * max(1.0, abs(exp))
+        if not ok:
+            self.mismatches += 1
+        return ok
+
+    def stats(self) -> dict:
+        return {"blocks_fingerprinted": len(self._fp),
+                "committed": self.committed,
+                "verified": self.verified,
+                "mismatches": self.mismatches}
+
+
+class ServingSentinel(object):
+    """Per-engine numeric sentinel: folds the compiled step's trap flag
+    and max-|logit| scalar into verdicts, using the SAME
+    TripDetector core as the training DivergenceDetector (ISSUE 15
+    satellite — one hysteresis implementation, two health loops).
+
+    observe(trap_any, scale) -> "ok" | "trap" | "spike"
+
+    The trap flag is a hard verdict (non-finite logits are already in
+    an emitted token's future); the scale feeds the EWMA spike
+    detector when `spike_factor` is set (None = traps only — magnitude
+    varies honestly across workloads, so the soft detector is opt-in,
+    sized per deployment like the training sentinel's)."""
+
+    def __init__(self, spike_factor: Optional[float] = None,
+                 hysteresis: int = 2, warmup: int = 8):
+        self.detector = (
+            TripDetector(spike_factor=float(spike_factor),
+                         hysteresis=hysteresis, warmup=warmup)
+            if spike_factor is not None else None)  # guarded-by: scheduler
+        self.trips = 0  # guarded-by: scheduler
+
+    def observe(self, trap_any: bool, scale: float) -> str:
+        if trap_any:
+            self.trips += 1
+            return "trap"
+        if self.detector is not None and scale > 0.0:
+            verdict = self.detector.observe(scale)
+            if verdict != "ok":
+                # "nonfinite" on the scale means the trap already fired
+                # upstream in practice; fold both into the spike verdict
+                self.trips += 1
+                return "spike"
+        return "ok"
+
+
+def golden_trace(params, cfg, prompt=CANARY_PROMPT, max_new_tokens=4):
+    """The known-answer canary's golden GENERATED tokens for one weight
+    set: greedy `transformer.generate` on the canary prompt, computed
+    once per `weights_version` (fleet construction and every
+    `roll_weights` commit). Greedy engine output is token-identical to
+    `generate()` — the serving suite's tested bar — so a live replica
+    whose canary disagrees is producing corrupt tokens, not noise.
+    Returns a plain list of ints (the generated suffix only; the
+    prompt is not part of the answer)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import transformer as tlm
+
+    # device arrays: a rollout hands in params freshly LOADED from a
+    # checkpoint (numpy leaves), and generate()'s scan body indexes
+    # them with tracers — numpy leaves would TracerArrayConversionError
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    p = np.asarray(prompt, np.int32)[None, :]
+    out = np.asarray(tlm.generate(params, p, cfg, int(max_new_tokens)))
+    return [int(t) for t in out[0, p.shape[1]:]]

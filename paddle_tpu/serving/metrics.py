@@ -56,6 +56,10 @@ class ServingMetrics(object):
         # on — report() surfaces its O(1) hit/miss/eviction/upload
         # counters (serving/adapters.py)
         self.adapter_pool = None
+        # PR 15: set by the engine when KV block fingerprints are on —
+        # report() surfaces the commit/verify/mismatch counters
+        # (serving/integrity.py BlockFingerprints)
+        self.block_fp = None
         # PR 7 counters — paged KV block pool + speculative decoding,
         # same O(1) discipline. Gauges (set by the engine each step or
         # scheduler event) vs cumulative ints are marked below.
@@ -187,6 +191,8 @@ class ServingMetrics(object):
             rep["prefix_cache"] = self.prefix_cache.stats()
         if self.adapter_pool is not None:
             rep["adapter_pool"] = self.adapter_pool.stats()
+        if self.block_fp is not None:
+            rep["block_fingerprints"] = self.block_fp.stats()
         return rep
 
     def table(self, sorted_key="total"):

@@ -662,6 +662,40 @@ def test_serving_multitenant_workload_contract():
     assert rec["per_tenant"]["zoo"]["completed"] == 3, rec
 
 
+def test_serving_integrity_workload_contract():
+    """ISSUE 15 acceptance: the `serving_integrity` row cannot decay
+    into a no-op — on the fixed-seed shared-header Poisson trace, the
+    clean run must trip NOTHING (false-positive bar, with canaries
+    actually completing), the garble@ drill must trip exactly once via
+    a known-answer CANARY mismatch and the flip@ drill exactly once
+    via a block FINGERPRINT mismatch, each quarantining the corrupt
+    replica under a fresh incarnation, with outputs token-identical to
+    the clean run (zero tainted tokens survive — the taint windows
+    re-decoded on the healthy survivor), zero rids lost, and every
+    journal green through the DFA --expect-closed including the J010
+    taint fence (all of these hard-raise in-bench; the assertions here
+    pin the row's shape)."""
+    rec = bench.bench_serving_integrity(n_requests=6)
+    assert rec["trips_clean"] == 0, rec
+    assert rec["canaries_ok_clean"] >= 2, rec
+    assert rec["trips_garble"] == 1, rec
+    assert rec["trip_kind_garble"] == {"canary": 1}, rec
+    assert rec["trips_flip"] == 1, rec
+    assert rec["trip_kind_flip"] == {"fingerprint": 1}, rec
+    assert rec["fp_mismatches_flip"] >= 1, rec
+    assert rec["requests_lost"] == 0, rec
+    assert rec["outputs_identical"], rec
+
+
+def test_serving_integrity_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_integrity", bench_serving_integrity' in src
+
+
 def test_serving_multitenant_registered_in_bench_main():
     """The workload is wired into bench.main()'s side-workload list
     (the registration is what lands it in the driver's record)."""

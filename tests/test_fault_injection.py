@@ -137,6 +137,41 @@ def test_slow_fault_gray_window():
         fi.FaultInjector("slow@2:1.0/-0.1")
 
 
+def test_garble_fault_sticky_silent():
+    """garble@N (ISSUE 15): SILENT and STICKY — from step N on the
+    consuming serving engine perturbs every emitted token to a
+    wrong-but-finite vocab id (a faulty core keeps computing wrong).
+    The injector itself never raises, sleeps, or kills: only a
+    known-answer canary mismatch can see this fault."""
+    inj = fi.FaultInjector("garble@2")
+    inj.tick()
+    assert not inj.garbled
+    inj.tick()
+    assert inj.garbled
+    for _ in range(5):
+        inj.tick()
+    assert inj.garbled  # sticky until the incarnation is replaced
+    # a fresh injector (the quarantine's replacement engine) is clean
+    assert not fi.FaultInjector("").garbled
+
+
+def test_flip_fault_pending_until_consumed():
+    """flip@N (ISSUE 15): armed at step N, consumed ONCE by the
+    engine's take_flip() — and re-armable (rearm_flip) when nothing
+    was resident to corrupt, so the fault lands on the first real
+    block instead of evaporating on an idle engine."""
+    inj = fi.FaultInjector("flip@2")
+    inj.tick()
+    assert not inj.take_flip()
+    inj.tick()
+    assert inj.take_flip()
+    assert not inj.take_flip()  # one-shot: consumed
+    inj.rearm_flip()            # nothing resident: engine re-arms
+    assert inj.take_flip()
+    inj.tick()
+    assert not inj.take_flip()  # later steps do not re-fire
+
+
 def test_hang_and_netsplit_spec_parsing():
     # hang parses (do NOT tick to its step — it spins forever)
     inj = fi.FaultInjector("hang@7")

@@ -28,21 +28,23 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 python -m paddle_tpu.analysis --all "$@"
 
-# protocol gate (ISSUE 9 + 11 + 12): explore the tier-1 fleet
+# protocol gate (ISSUE 9 + 11 + 12 + 15): explore the tier-1 fleet
 # scenarios — the PR-6 kill drill, the elastic transitions (scale-up
 # mid-burst, drain-retire racing a completion, rollout swap racing a
-# migration), and the multi-tenant fairness race (a tenant burst vs a
+# migration), the multi-tenant fairness race (a tenant burst vs a
 # weighted SLA tenant through the WFQ dispatch hop, with a mid-burst
-# kill) — keep their per-schedule journals, and replay EACH through
-# the journal verifier: a new J-code here (including the J009 version
-# fence and the typed tenant side-band) fails the gate exactly like a
-# new lint finding
+# kill), and the integrity trip (a quarantine + taint-aware resume
+# racing a completion handshake and a tier migration) — keep their
+# per-schedule journals, and replay EACH through the journal verifier:
+# a new J-code here (including the J009 version fence, the typed
+# tenant side-band, and the J010 taint fence) fails the gate exactly
+# like a new lint finding
 jdir="$(mktemp -d)"
 trap 'rm -rf "$jdir"' EXIT
 python -m paddle_tpu.analysis explore --scenario submit_kill \
     --max-schedules 6 --journal-dir "$jdir"
 for sc in scale_up_mid_burst drain_retire_race rollout_migration \
-        tenant_fairness; do
+        tenant_fairness integrity_trip; do
     python -m paddle_tpu.analysis explore --scenario "$sc" \
         --max-schedules 4 --journal-dir "$jdir"
 done
