@@ -67,14 +67,39 @@ and reports violations as stable J-codes:
                           ill-formed (from > upto, from past the
                           journaled progress, an unknown or already-
                           terminal rid).
+  J011 handoff-fence      the ISSUE 16 durable-KV contract. An assign
+                          may carry a `handoff` side-band (`len` +
+                          fingerprint `digest`: the checksummed block
+                          package shipped at re-route) and a done the
+                          matching outcome (`imported` tokens +
+                          `fallback` flag). J011 fires when (a) a
+                          FIRST assign (no prior assign, no journaled
+                          history — compaction's consolidated progress
+                          counts as history) carries handoff: packages
+                          only attach at re-route, an admission-time
+                          one is fabricated; (b) the package claims more
+                          tokens than the prompt plus journaled
+                          progress at assign time could have closed;
+                          (c) a done carries an outcome but its latest
+                          assignment shipped no package; (d) a done
+                          whose holder received a package and actually
+                          ran (tokens beyond the progress at assign)
+                          reports NO outcome — every shipped package
+                          must trace to a verified import or a counted
+                          fallback, never silence; (e) an outcome
+                          claims more imported tokens than its
+                          assignment's package carried.
 
-Optional side-band fields (ISSUEs 11 + 12): assign records may carry
-`tier` (prefill/decode disaggregation placement), `weights_version`
-(the assignee's weight version), and `tenant` (the consumer whose
-quota admitted the request — the multi-tenant exactly-once audit
-groups the journal by it); done records may carry `weights_version`
-and `tenant`. Present-but-ill-typed side-band fields are J008 like
-any other field.
+Optional side-band fields (ISSUEs 11 + 12 + 16): assign records may
+carry `tier` (prefill/decode disaggregation placement),
+`weights_version` (the assignee's weight version), `tenant` (the
+consumer whose quota admitted the request — the multi-tenant
+exactly-once audit groups the journal by it), and `handoff` (the
+ISSUE 16 block-package side-band); done records may carry
+`weights_version`, `tenant`, and `handoff`. Present-but-ill-typed
+side-band fields are J008 like any other field, including the inner
+shape of `handoff` ({"len": int, "digest": str} on assign,
+{"imported": int, "fallback": bool} on done).
 
 A torn FINAL line is tolerated exactly like `RequestJournal._read`
 (the crash the journal exists to survive must not fail its own audit);
@@ -147,15 +172,40 @@ _FIELD_TYPES = {
     "tenant": (str, type(None)),
     # ISSUE 15: the integrity record's rid -> [from, upto] window map
     "taint": (dict,),
+    # ISSUE 16: the durable-KV handoff side-band — a package
+    # description on assign, an import outcome on done (nullable: the
+    # fleet writes null when no package rode the assignment)
+    "handoff": (dict, type(None)),
 }
 
 # optional per-kind side-band fields: absent is fine (old journals),
 # present-but-ill-typed is J008 like any required field
 _OPTIONAL = {
-    "assign": ("tier", "weights_version", "tenant"),
-    "done": ("weights_version", "tenant"),
+    "assign": ("tier", "weights_version", "tenant", "handoff"),
+    "done": ("weights_version", "tenant", "handoff"),
     "integrity": ("reason",),
 }
+
+
+def _bad_handoff(rec, kind):
+    """Inner-shape check for a present, non-null `handoff` side-band:
+    returns a short defect label or None. The outer dict/None check is
+    `_FIELD_TYPES`; this pins the inner schema so a fabricated or
+    bit-rotted side-band is J008, not a KeyError in the J011 fence."""
+    ho = rec.get("handoff")
+    if ho is None:
+        return None
+    if kind == "assign":
+        if not isinstance(ho.get("len"), int) or ho["len"] < 0:
+            return "len"
+        if not isinstance(ho.get("digest"), str):
+            return "digest"
+    else:  # done
+        if not isinstance(ho.get("imported"), int) or ho["imported"] < 0:
+            return "imported"
+        if not isinstance(ho.get("fallback"), bool):
+            return "fallback"
+    return None
 
 
 def _ill_typed(rec, kind):
@@ -191,7 +241,8 @@ class _Rid(object):
     """DFA state for one request id."""
 
     __slots__ = ("state", "assign", "assign_version", "progress",
-                 "terminal_line", "hwm", "taint")
+                 "terminal_line", "hwm", "taint", "n_assigns",
+                 "assign_handoff", "progress_at_assign", "prompt_len")
 
     def __init__(self):
         self.state = "open"          # open -> terminal
@@ -201,6 +252,16 @@ class _Rid(object):
         self.assign_version: Optional[int] = None
         self.progress: List[int] = []
         self.terminal_line = 0
+        # ISSUE 16 handoff fence (J011): how many assigns this rid has
+        # seen (a package on the FIRST one is fabricated), the latest
+        # assignment's handoff side-band, the journaled-progress length
+        # when that assignment landed (a done beyond it means the
+        # holder actually ran), and the submit spec's prompt length
+        # (bounds what a package could legally cover)
+        self.n_assigns = 0
+        self.assign_handoff: Optional[dict] = None
+        self.progress_at_assign = 0
+        self.prompt_len = 0
         # ISSUE 15 taint fence: the high-water mark of journaled
         # progress (never lowered — an integrity truncation lowers the
         # ACCUMULATION, not the mark) and the active taint window
@@ -353,7 +414,10 @@ def verify_records(records, path_label: str = "<journal>",
                      "duplicate submit for rid %d (already %s)"
                      % (rid, st.state))
                 continue
-            rids[rid] = _Rid()
+            st = rids[rid] = _Rid()
+            prompt = rec["spec"].get("prompt")
+            if isinstance(prompt, list):
+                st.prompt_len = len(prompt)
             continue
         if st is None:
             diag("J001", lineno, rid, "orphan:%s" % kind,
@@ -367,6 +431,9 @@ def verify_records(records, path_label: str = "<journal>",
                 st.assign = (rec["replica"], rec["incarnation"],
                              rec["gen"])
                 st.assign_version = rec.get("weights_version")
+                st.n_assigns = 1
+                if _bad_handoff(rec, "assign") is None:
+                    st.assign_handoff = rec.get("handoff")
             elif kind == "progress":
                 st.progress.extend(rec["tokens"])
                 st.hwm = len(st.progress)
@@ -392,8 +459,39 @@ def verify_records(records, path_label: str = "<journal>",
                      % (rid, rec["replica"], rec["incarnation"],
                         quarantined[(rec["replica"],
                                      rec["incarnation"])]))
+            ho = rec.get("handoff")
+            bad_ho = _bad_handoff(rec, "assign")
+            if bad_ho is not None:
+                diag("J008", lineno, rid, "assign:handoff:%s" % bad_ho,
+                     "assign handoff side-band for rid %d has an "
+                     "ill-formed %r field (%r) — expected "
+                     '{"len": int >= 0, "digest": str}'
+                     % (rid, bad_ho, ho.get(bad_ho)))
+                ho = None
+            elif ho is not None:
+                # the J011 handoff fence, assign half (ISSUE 16).
+                # journaled progress with no assign seen yet is the
+                # compacted/restart consolidated form — a prior holder
+                # existed, so its re-emitted package has a source
+                if st.n_assigns == 0 and not st.progress:
+                    diag("J011", lineno, rid, "handoff:first-assign",
+                         "assign of rid %d carries a handoff package "
+                         "on its FIRST assignment — packages only "
+                         "attach at re-route (migration/failover); an "
+                         "admission-time package has no source" % rid)
+                cap = st.prompt_len + len(st.progress)
+                if ho["len"] > cap:
+                    diag("J011", lineno, rid, "handoff:overrun",
+                         "assign handoff for rid %d claims %d "
+                         "package token(s) but only %d (prompt + "
+                         "journaled progress) existed to close — the "
+                         "package describes blocks the source never "
+                         "had" % (rid, ho["len"], cap))
             st.assign = (rec["replica"], rec["incarnation"], rec["gen"])
             st.assign_version = rec.get("weights_version")
+            st.assign_handoff = ho
+            st.progress_at_assign = len(st.progress)
+            st.n_assigns += 1
             continue
         if kind == "progress":
             holder = (rec["replica"], rec["incarnation"], rec["gen"])
@@ -488,6 +586,39 @@ def verify_records(records, path_label: str = "<journal>",
                      "its latest assignment carries version %d — a "
                      "mixed-version output crossed the rollout fence"
                      % (rid, dv, st.assign_version))
+            # the J011 handoff fence, done half (ISSUE 16): every
+            # shipped package traces to a verified import or a counted
+            # fallback — silence is a protocol violation
+            out = rec.get("handoff")
+            bad_ho = _bad_handoff(rec, "done")
+            if bad_ho is not None:
+                diag("J008", lineno, rid, "done:handoff:%s" % bad_ho,
+                     "done handoff outcome for rid %d has an "
+                     "ill-formed %r field (%r) — expected "
+                     '{"imported": int >= 0, "fallback": bool}'
+                     % (rid, bad_ho, out.get(bad_ho)))
+            elif out is not None and st.assign_handoff is None:
+                diag("J011", lineno, rid, "handoff:unshipped",
+                     "done for rid %d reports a handoff outcome but "
+                     "its latest assignment shipped no package — an "
+                     "import was claimed for a transfer that never "
+                     "happened" % rid)
+            elif out is not None \
+                    and out["imported"] > st.assign_handoff["len"]:
+                diag("J011", lineno, rid, "handoff:over-import",
+                     "done for rid %d claims %d imported token(s) but "
+                     "its assignment's package carried only %d"
+                     % (rid, out["imported"],
+                        st.assign_handoff["len"]))
+            elif out is None and st.assign_handoff is not None \
+                    and st.assign is not None and holder == st.assign \
+                    and len(rec["tokens"]) > st.progress_at_assign:
+                diag("J011", lineno, rid, "handoff:unaccounted",
+                     "done for rid %d from the holder that received a "
+                     "%d-token handoff package reports no outcome — "
+                     "the package must be accounted as a verified "
+                     "import or a counted fallback, never silence"
+                     % (rid, st.assign_handoff["len"]))
         if kind in ("done", "expired"):
             # no empty-progress exemption: the fleet journals EVERY
             # emitted token as a progress delta before the terminal

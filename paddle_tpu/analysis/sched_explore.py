@@ -147,7 +147,13 @@ class ScriptEngine(object):
 
     def submit(self, prompt, max_new_tokens, temperature=0.0,
                eos_id=None, seed=0, publish_len=None, deadline_at=None,
-               resume_tokens=None):
+               resume_tokens=None, handoff=None):
+        # `handoff` (ISSUE 16): a block package the fleet ships at
+        # re-route. A scripted engine has no KV pool to import into,
+        # so the package is dropped on the floor and no outcome is
+        # reported — exactly the surface-less engine the fleet's
+        # _accept covers with the defaulted fallback outcome (the J011
+        # fence the kv_handoff_race scenario explores)
         h = _ScriptHandle(prompt, max_new_tokens, seed,
                           resume_tokens or [])
         if resume_tokens:
@@ -789,6 +795,111 @@ class TenantFairnessScenario(Scenario):
         return out
 
 
+class KVHandoffRaceScenario(Scenario):
+    """ISSUE 16 durable-KV handoff under adversarial interleaving: a
+    tiered fleet (r0 prefill, r1 decode) shares a pre-seeded
+    `KVBlockStore`, so the request's migration at first token attaches
+    a checksummed block package to the re-route — while (a) a store
+    EVICTION races the package build on the source side (the chain the
+    router credited may be gone by the time `chain_fetch` runs: before
+    → no package, after → package shipped; both must serve), and (b)
+    an integrity TRIP quarantines the decode target r1, so a shipped
+    package's holder can die tainted before, during, or after
+    accounting for it. The probes pin token identity and exactly-once
+    verdicts as ever, plus the journal DFA's new J011 handoff fence:
+    every assign that shipped a package must trace to a done carrying
+    a verified-import or counted-fallback outcome (the ScriptEngine
+    reports none, so every explored path exercises the fleet's
+    defaulted-outcome cover), and no done may claim an import its
+    assignment never shipped."""
+
+    name = "kv_handoff_race"
+    n_replicas = 2
+
+    def fleet_kw(self):
+        from ..serving.kv_store import KVBlockStore, make_block_record
+        from ..serving.prefix_cache import fold_key
+
+        # pre-seeded store: one fabricated record covering the
+        # prompt's single closed block (2, 8). The payload bytes are
+        # arbitrary — the ScriptEngine never uploads them — but the
+        # crc is honest, so the store serves the record and the fleet
+        # genuinely builds and ships a package
+        store = KVBlockStore(block_tokens=2)
+        self._block_key = fold_key(0, (2, 8))
+        store.put(make_block_record(self._block_key, 0, (2, 8), 1.0,
+                                    b"scripted-block--", []))
+        return {
+            "kv_store": store,
+            "replica_tier": ["prefill", "decode"],
+            "engine_kw": {"prefix_cache_tokens": 64,
+                          "kv_block_tokens": 2},
+        }
+
+    def _progressed(self, ctx):
+        # fire once ANY journaled progress exists — the window where
+        # the migration's package build / the target's import race the
+        # eviction and the trip; a deviating schedule may have
+        # finished the request first, firing the op harmlessly late
+        if not ctx.handles:
+            return False
+        h = ctx.handles[0][0]
+        return (h.done
+                or len(ctx.fleet._journal.progress_of(h.rid)) >= 1)
+
+    def _evict(self, ctx):
+        ctx.fleet.kv_store.evict(self._block_key)
+
+    def _on_target(self, ctx):
+        # fire once the migrated copy (package attached) is r1's — or
+        # the request already finished: the trip then races r1's
+        # accounting for the package it received, not the pre-
+        # migration prefill (which the plain integrity_trip scenario
+        # already covers)
+        if not ctx.handles:
+            return False
+        h = ctx.handles[0][0]
+        if h.done:
+            return True
+        a = ctx.fleet._journal.assigned_to(h.rid)
+        return a is not None and a[0] == "r1"
+
+    def _trip(self, ctx):
+        from ..serving.integrity import IntegrityError
+
+        fleet = ctx.fleet
+        with fleet._cond:
+            fleet._integrity_trip_locked(
+                1, fleet._replicas[1],
+                IntegrityError("scripted canary mismatch on r1",
+                               kind="canary", replica="r1"))
+        fleet._flush_journal()
+
+    def ops(self):
+        return [
+            ("submit0", _always, lambda c: c.submit([2, 8, 4], 4,
+                                                    seed=41)),
+            ("evict_store", self._progressed, self._evict),
+            ("trip_r1", self._on_target, self._trip),
+        ]
+
+    def check(self, ctx):
+        out = []
+        st = ctx.fleet.stats()
+        if st["integrity_trips"] != 1:
+            out.append("integrity_trips == %r, expected exactly 1"
+                       % st["integrity_trips"])
+        if st["replicas"][1]["state"] != "dead":
+            out.append("tripped replica r1 not quarantined (state %r)"
+                       % st["replicas"][1]["state"])
+        # the package-accounting fence itself (every shipped package
+        # traces to a verified import or a counted fallback) is J011,
+        # already replayed by the harness's verify_journal probe —
+        # including the superseded-assignment path where a later
+        # package-less assign lawfully absorbs the account
+        return out
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "submit_kill": SubmitKillScenario,
     "demote_route_back": DemoteRouteBackScenario,
@@ -798,6 +909,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "rollout_migration": RolloutMigrationRaceScenario,
     "tenant_fairness": TenantFairnessScenario,
     "integrity_trip": IntegrityTripScenario,
+    "kv_handoff_race": KVHandoffRaceScenario,
 }
 
 

@@ -74,6 +74,9 @@ HOT_PATHS = [
     # serving integrity (ISSUE 15): the trap/fingerprint/sentinel
     # helpers run inside (or right next to) the compiled serving steps
     "paddle_tpu/serving/integrity.py",
+    # durable KV (ISSUE 16): serialization/import/spill run on the
+    # admission and retire paths right next to the compiled steps
+    "paddle_tpu/serving/kv_store.py",
     "paddle_tpu/fluid/executor.py",
     "paddle_tpu/fluid/core/lowering.py",
     # the training sentinel sits ON the step loop next to the jitted

@@ -60,6 +60,24 @@ A fault spec is a comma-separated string, e.g.::
                                     tokens; only a block FINGERPRINT
                                     spot-check (at aliased re-open /
                                     failover resume) can catch it.
+    PADDLE_FAULT="store_corrupt@2"  SILENT durable-KV fault (ISSUE 16):
+                                    the 2nd record put into the
+                                    KVBlockStore is garbled AT REST
+                                    (one payload byte flipped in RAM
+                                    and in store.jsonl; the recorded
+                                    crc stays honest, so only the read
+                                    path's crc check can catch it).
+                                    N counts STORE RECORDS, not steps
+                                    — the store consumes these via
+                                    `injector.store_tick()` per put.
+                                    Import/warm paths must skip +
+                                    quarantine the record and fall
+                                    back to re-prefill, counted,
+                                    token-identical.
+    PADDLE_FAULT="store_trunc@2"    as store_corrupt@N but the record's
+                                    payload is TRUNCATED (the torn-
+                                    write shape: nbytes disagrees with
+                                    the bytes present).
     PADDLE_FAULT="slow@3:2.0/0.1"   GRAY failure (ISSUE 8): starting at
                                     step 3, every tick sleeps 0.1 s until
                                     2.0 s of wall time have passed — the
@@ -170,7 +188,13 @@ class _Fault(object):
 
 
 _KINDS = ("kill", "exc", "delay", "corrupt", "hang", "netsplit", "slow",
-          "nanloss", "spike", "garble", "flip")
+          "nanloss", "spike", "garble", "flip", "store_corrupt",
+          "store_trunc")
+
+# fault kinds whose @N indexes the Nth KV-STORE record, not the Nth
+# step boundary: tick() never fires them, store_tick() consumes them,
+# and arm(relative=True) must NOT shift their index by the step count
+_STORE_KINDS = ("store_corrupt", "store_trunc")
 
 
 def _parse_slow_arg(arg: str):
@@ -237,6 +261,10 @@ class FaultInjector(object):
         # resident block to corrupt (take_flip consumes it)
         self._garbled = False
         self._flip_pending = False
+        # durable-KV faults (ISSUE 16): store_corrupt@N/store_trunc@N
+        # count STORE RECORDS — the KVBlockStore ticks this counter
+        # once per put and the matching fault fires one-shot
+        self._store_puts = 0
 
     @property
     def active(self) -> bool:
@@ -275,12 +303,26 @@ class FaultInjector(object):
         fires three ticks from now. Drills use this to warm a system up
         (compile, prime caches) under no faults and then schedule the
         fault at a deterministic step of the measured phase, without
-        hand-counting the warm-up's ticks."""
+        hand-counting the warm-up's ticks. Store faults shift by the
+        STORE-RECORD counter instead — their @N never counted steps."""
         new = _parse(spec)
         if relative:
             for f in new:
-                f.step += self.step
+                f.step += (self._store_puts if f.kind in _STORE_KINDS
+                           else self.step)
         self.faults.extend(new)
+
+    def store_tick(self):
+        """Advance the KV-store record counter (the KVBlockStore calls
+        this once per `put`); returns "corrupt" / "trunc" when the Nth
+        record has a store fault armed (one-shot), else None."""
+        self._store_puts += 1
+        for f in self.faults:
+            if (f.kind in _STORE_KINDS and f.step == self._store_puts
+                    and not getattr(f, "spent", False)):
+                f.spent = True  # one-shot: the Nth record, exactly once
+                return f.kind[len("store_"):]
+        return None
 
     def tick(self):
         """Advance one step; fire any fault scheduled for it. While a
@@ -306,6 +348,11 @@ class FaultInjector(object):
                     # silent one-shot: pending until take_flip() finds
                     # a resident block to corrupt
                     self._flip_pending = True
+                elif f.kind in _STORE_KINDS:
+                    # counted in STORE RECORDS, not steps: only
+                    # store_tick() may consume these (a step index
+                    # colliding with @N must not fire them)
+                    pass
                 else:
                     f.fire()
         if self.slowed:

@@ -21,6 +21,7 @@ from .integrity import (
     BlockFingerprints,
     IntegrityError,
     ServingSentinel,
+    fp_digest,
     golden_trace,
 )
 from .fleet import (
@@ -34,8 +35,9 @@ from .fleet import (
     save_weights,
 )
 from .kv_blocks import KVBlockAllocator
+from .kv_store import KVBlockStore, make_block_record
 from .metrics import ServingMetrics
-from .prefix_cache import PrefixCache, PrefixMatch, chain_keys
+from .prefix_cache import PrefixCache, PrefixMatch, chain_keys, fold_key
 from .quantization import (
     QuantTensor,
     dequantize_params,
@@ -60,4 +62,5 @@ __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
            "WFQueue", "executor_batch_fn", "QuantTensor",
            "quantize_params", "dequantize_params", "params_bytes",
            "IntegrityError", "BlockFingerprints", "ServingSentinel",
-           "golden_trace"]
+           "golden_trace", "KVBlockStore", "fold_key", "fp_digest",
+           "make_block_record"]

@@ -103,10 +103,12 @@ from ..distributed import fault_injection as _fi
 from ..fluid.core.kernels_sequence import bucket_pow2
 from ..models import transformer as tlm
 from .adapters import AdapterPool
-from .integrity import BlockFingerprints, IntegrityError, ServingSentinel
+from .integrity import (_FP_RTOL, BlockFingerprints, IntegrityError,
+                        ServingSentinel)
 from .kv_blocks import KVBlockAllocator
+from .kv_store import make_block_record, payload_crc
 from .metrics import ServingMetrics
-from .prefix_cache import PrefixCache
+from .prefix_cache import PrefixCache, chain_keys
 from .quantization import dequantize_params, quantize_params
 
 __all__ = ["ServingEngine", "ServingHandle", "EngineFailed",
@@ -147,7 +149,7 @@ class ServingHandle(object):
 
     def __init__(self, engine, rid, prompt, max_new_tokens, temperature,
                  eos_id, seed, publish_len, deadline_at=None,
-                 resume_tokens=None, adapter=None):
+                 resume_tokens=None, adapter=None, handoff=None):
         self._engine = engine
         self.rid = rid
         self.prompt = prompt  # np.int32 [T0] — the ORIGINAL prompt
@@ -174,6 +176,16 @@ class ServingHandle(object):
         # LoRA-style adapter name (ISSUE 12; None = the base model /
         # zero adapter) — resolved to a pool slot at admission
         self.adapter = adapter
+        # durable-KV handoff package (ISSUE 16): the finished prefix's
+        # serialized block records shipped by the fleet at migration/
+        # failover. Consumed at admission — each record is token- and
+        # fingerprint-verified before it enters the pool; outcome lands
+        # in handoff_imported/handoff_fallback for the journal's done
+        # side-band (the J011 fence)
+        self.handoff = handoff
+        self.handoff_imported = 0       # tokens imported clean
+        self.handoff_fallback = False   # any re-prefill shortfall
+        self.handoff_outcome = None     # set once the package is judged
         self.tokens: List[int] = []  # generated tokens (may include eos)
         self.done = False
         # 'eos' | 'budget' | 'expired' | 'cancelled'
@@ -295,7 +307,8 @@ class ServingEngine(object):
                  adapter_rank=None, paged_kernel=None,
                  kv_quant="none", weight_quant=None,
                  integrity_traps=True, kv_fingerprints=False,
-                 integrity_spike_factor=None):
+                 integrity_spike_factor=None, kv_store=None,
+                 kv_store_warm=False):
         self._params = params
         self._cfg = cfg
         # deterministic-exploration seam (ISSUE 9): the fleet threads
@@ -544,6 +557,35 @@ class ServingEngine(object):
         # when PADDLE_FAULT is set, else an inert one (same contract as
         # the trainer CLI's per-batch tick; see fault_injection.py)
         self._injector = fault_injector       # guarded-by: scheduler
+        # durable KV tier (ISSUE 16): a fleet-shared KVBlockStore the
+        # engine WRITES closed blocks into at publish (self-describing
+        # records: quantized codes + scale side-bands + the PR 15
+        # fingerprint as the transfer checksum) and READS at admission
+        # (handoff import) / construction (warm start). The store is
+        # internally locked; the engine only ever touches it from the
+        # scheduler thread.
+        if kv_store is not None and int(kv_store.block_tokens) != Bt:
+            raise ValueError(
+                "kv_store block geometry mismatch: store has "
+                "block_tokens=%d, engine has %d — records would never "
+                "align with the trie chain keys"
+                % (int(kv_store.block_tokens), Bt))
+        if kv_store is not None and self.prefix_cache is None:
+            # spill happens at trie PUBLISH and warm start targets the
+            # trie — without a prefix cache neither path exists and the
+            # store would be silently dead (same refusal shape as
+            # kv_fingerprints above)
+            raise ValueError(
+                "kv_store needs the prefix cache (pass "
+                "prefix_cache_tokens=): blocks spill at trie publish "
+                "and warm-start restores into the trie")
+        self._kv_store = kv_store             # thread: shared (store locks itself)
+        self.metrics.kv_store = kv_store
+        if kv_store is not None and kv_store_warm:
+            # warm the trie from the store BEFORE traffic: a restarted
+            # or autoscaled replica serves its first shared-prefix hit
+            # without re-decoding the prefix
+            self.warm_from_store()
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -847,6 +889,140 @@ class ServingEngine(object):
         kv["k"] = buf.at[bid].set(garb)
 
     # ------------------------------------------------------------------
+    # durable KV tier (ISSUE 16)
+    # ------------------------------------------------------------------
+    def _serialize_block(self, bid: int):
+        """Flatten one physical block across every layer and band into
+        (payload bytes, meta rows). Meta rows are ("li.band", dtype,
+        shape-per-block) in the SAME sorted-band order
+        `paged_block_fingerprint` folds, so a record is self-describing
+        on a replica that never saw this pool: codes AND quant-scale
+        side-bands travel together, and the fingerprint is recomputable
+        from the payload alone."""
+        parts = []
+        meta = []
+        b = int(bid)
+        for li, kv in enumerate(self._cache):
+            for band in sorted(kv):
+                arr = np.asarray(kv[band][b])
+                meta.append(("%d.%s" % (li, band), str(arr.dtype),
+                             tuple(int(x) for x in arr.shape)))
+                parts.append(arr.tobytes())
+        return b"".join(parts), meta
+
+    def _upload_block_record(self, rec, bid: int) -> bool:
+        """Write one store record's payload into physical block `bid`
+        (in-place band update, the `_flip_resident_block` idiom).
+        Validates EVERY meta row against this engine's cache geometry
+        before touching the device — False (and an untouched cache)
+        on any layer/band/dtype/shape mismatch, so a foreign-geometry
+        record can never half-write a block."""
+        payload = rec["payload"]
+        off = 0
+        planned = []
+        for name, dtype, shape in rec["meta"]:
+            li_s, _, band = str(name).partition(".")
+            try:
+                li = int(li_s)
+            except ValueError:
+                return False
+            if li < 0 or li >= len(self._cache) \
+                    or band not in self._cache[li]:
+                return False
+            buf = self._cache[li][band]
+            shape = tuple(int(x) for x in shape)
+            if shape != tuple(buf.shape[1:]) or str(buf.dtype) != dtype:
+                return False
+            n = int(np.prod(shape, dtype=np.int64)) \
+                * np.dtype(dtype).itemsize
+            chunk = payload[off:off + n]
+            if len(chunk) != n:
+                return False
+            off += n
+            planned.append(
+                (li, band, np.frombuffer(chunk, dtype).reshape(shape)))
+        if off != len(payload):
+            return False
+        b = int(bid)
+        for li, band, vals in planned:
+            kv = self._cache[li]
+            kv[band] = kv[band].at[b].set(jnp.asarray(vals))
+        return True
+
+    def _record_fp_ok(self, rec, fp_d) -> bool:
+        """The handoff/warm transfer checksum: the RECOMPUTED on-device
+        fingerprint of the uploaded block vs the record's committed one
+        (same tolerance as the aliased re-open spot-check)."""
+        exp = float(rec["fp"])
+        return abs(float(fp_d) - exp) <= _FP_RTOL * max(1.0, abs(exp))
+
+    def warm_from_store(self) -> int:
+        """Restore the durable store's chains into THIS engine's prefix
+        trie (restart / autoscale warm start): parent-before-child over
+        the store snapshot, each block crc- and fingerprint-verified on
+        upload, grafted under the trie with the fresh block's single
+        pool ref TRANSFERRED to the trie (on_evict drops it). Corrupt
+        entries are skipped and quarantined — with their whole subtree,
+        a child's context is its ancestors' payloads — never served.
+        Stops (rather than evicting warmed chains or starving traffic)
+        at the trie token budget or pool exhaustion. Returns blocks
+        restored."""
+        store = self._kv_store
+        pc = self.prefix_cache
+        if store is None or pc is None:
+            return 0
+        Bt = self.kv_block_tokens
+        n_warm = 0
+        chain: Dict[int, list] = {}  # key -> tokens through this block
+        skipped = set()
+        for rec in store.iter_chains():
+            key = rec["key"]
+            par = rec["parent"]
+            if par in skipped:
+                skipped.add(key)  # corrupt ancestor: subtree is dead
+                continue
+            if par != 0 and par not in chain:
+                continue  # unrooted (hole upstream): nothing to graft
+            toks = (chain[par] if par else []) \
+                + [int(t) for t in rec["tokens"]]
+            depth = len(toks) // Bt
+            m = pc.match(np.asarray(toks, np.int32), record=False)
+            have = m.length
+            m.release()
+            if have >= depth * Bt:
+                chain[key] = toks  # already resident (or just warmed)
+                continue
+            if pc.size_tokens + Bt > pc.token_budget:
+                break  # budget: deeper warms would evict earlier ones
+            if len(rec["payload"]) != rec["nbytes"] \
+                    or payload_crc(rec["payload"]) != rec["crc"]:
+                store.quarantine(key)
+                self.metrics.store_quarantined += 1
+                skipped.add(key)
+                continue
+            bid = self._alloc.try_alloc()
+            if bid is None:
+                break  # pool pressure: serve traffic over warmth
+            ok = self._upload_block_record(rec, bid)
+            fp_d = self._fp_of(bid) if ok else None
+            if not ok or not self._record_fp_ok(rec, fp_d):
+                self._decref_block(bid)
+                store.quarantine(key)
+                self.metrics.store_quarantined += 1
+                skipped.add(key)
+                continue
+            if self._fp is not None:
+                self._fp.commit(bid, fp_d)
+            # ancestors are resident (the chain[] gate above), so only
+            # this deepest block is novel to the publish
+            pc.publish(np.asarray(toks, np.int32), depth,
+                       lambda _d, b=bid: b)
+            n_warm += 1
+            self.metrics.store_warm_blocks += 1
+            chain[key] = toks
+        return n_warm
+
+    # ------------------------------------------------------------------
     # block bookkeeping
     # ------------------------------------------------------------------
     def _blocks_for(self, tokens: int) -> int:
@@ -914,7 +1090,8 @@ class ServingEngine(object):
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0, eos_id=None,
                seed=0, publish_len=None, deadline_at=None,
-               resume_tokens=None, adapter=None) -> ServingHandle:
+               resume_tokens=None, adapter=None,
+               handoff=None) -> ServingHandle:
         """Enqueue one request (FCFS). Returns a handle whose `.tokens`
         fills in as the engine steps; `handle.result()` drives the
         engine to completion of this request. Structurally impossible
@@ -933,7 +1110,12 @@ class ServingEngine(object):
         request already emitted (token-level resume, ISSUE 8): they
         become prefill context — prefix-aliased where the pool allows —
         and only `max_new_tokens - len(resume_tokens)` tokens are
-        decoded, on the ORIGINAL request's sampling-key schedule."""
+        decoded, on the ORIGINAL request's sampling-key schedule.
+        `handoff` is a durable-KV block package (ISSUE 16): the source
+        replica's closed prompt blocks as kv_store records, imported at
+        admission after per-block fingerprint verification — the clean
+        path re-prefills ZERO closed-block tokens; any mismatch falls
+        back to re-prefill (counted, never wrong)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T0 = prompt.shape[0]
         if T0 < 1:
@@ -972,7 +1154,8 @@ class ServingEngine(object):
         h = ServingHandle(self, self._next_rid, prompt, max_new_tokens,
                           temperature, eos_id, seed, publish_len,
                           deadline_at=deadline_at,
-                          resume_tokens=resume_tokens, adapter=adapter)
+                          resume_tokens=resume_tokens, adapter=adapter,
+                          handoff=handoff)
         self._next_rid += 1
         if deadline_at is not None:
             self._deadlines = True
@@ -1177,8 +1360,88 @@ class ServingEngine(object):
                     self.metrics.cow_blocks += 1
             finally:
                 m.release()
-        self._n_alloc[s] = n_alias
-        self._reserved_tail[s] = need_new - n_cow
+        # ISSUE 16 handoff import: the migration/failover package ships
+        # the source replica's CLOSED prompt blocks as self-describing
+        # store records — upload each into a freshly materialised block
+        # (consuming this slot's reservation, exactly like a prefill
+        # allocation would) after token/crc checks, then verify the
+        # RECOMPUTED on-device fingerprint against the record's: the
+        # PR 15 fingerprint IS the transfer checksum. Any failure stops
+        # the import at the last good block (a child's KV attends
+        # through its ancestors — importing past a hole would be
+        # wrong); the prefill cursor then covers the shortfall, so the
+        # fallback is re-prefill: counted, never wrong.
+        n_imp = 0
+        imp_fail = False
+        package = h.handoff
+        store = self._kv_store
+        if package:
+            for d in range(n_alias,
+                           min(len(package), self.blocks_per_slot)):
+                rec = package[d]
+                blk = tuple(int(t)
+                            for t in h.full_prompt[d * Bt:(d + 1) * Bt])
+                if (rec.get("kv_quant", "none") != self.kv_quant
+                        or tuple(rec["tokens"]) != blk
+                        or len(rec["payload"]) != rec["nbytes"]
+                        or payload_crc(rec["payload"]) != rec["crc"]):
+                    imp_fail = True
+                    break
+                bid = self._alloc.alloc_reserved()
+                ok = self._upload_block_record(rec, bid)
+                fp_d = self._fp_of(bid) if ok else None
+                if not ok or not self._record_fp_ok(rec, fp_d):
+                    # the freed block does NOT restore the reservation
+                    # alloc_reserved consumed — re-reserve it (the just-
+                    # freed block guarantees success) so the slot's
+                    # reserved-tail accounting stays balanced
+                    self._decref_block(bid)
+                    self._alloc.reserve(1)
+                    if store is not None and ok:
+                        store.quarantine(rec["key"])
+                        self.metrics.store_quarantined += 1
+                    imp_fail = True
+                    break
+                if self._fp is not None:
+                    self._fp.commit(bid, fp_d)
+                self._tables[s, d] = bid
+                n_imp += 1
+            if n_imp:
+                cursor = min((n_alias + n_imp) * Bt, T0 - 1)
+                self.metrics.handoff_blocks_imported += n_imp
+                self.metrics.handoff_tokens_imported += n_imp * Bt
+                h.handoff_imported = n_imp * Bt
+        # the zero-recompute audit: closed-block prompt tokens the
+        # source had finished vs where this admission's prefill cursor
+        # actually starts. The final prompt token (T0-1) always
+        # computes — its logits seed the first generated token — so
+        # the contract excludes it. A resumed admission with NO package
+        # charges every closed block it re-prefills (handoff absent or
+        # disabled: the counted degradation path).
+        expected = 0
+        if package:
+            expected = min(len(package) * Bt, T0 - 1)
+        elif h.resume_len > 0:
+            expected = min((T0 // Bt) * Bt, T0 - 1)
+        recomputed = max(0, expected - cursor)
+        self.metrics.tokens_recomputed_at_migration += recomputed
+        if package:
+            if recomputed > 0 or imp_fail:
+                self.metrics.handoff_fallbacks += 1
+                h.handoff_fallback = True
+            else:
+                # clean: imported, or already resident via the warmed
+                # trie (n_imp == 0 with full alias coverage) — either
+                # way zero tokens re-prefilled
+                self.metrics.handoff_imports += 1
+            # every judged package reports an outcome — the journal's
+            # done record must account for the assign's handoff
+            # side-band (J011), silence is never an answer
+            h.handoff_outcome = {"imported": h.handoff_imported,
+                                 "fallback": h.handoff_fallback}
+            h.handoff = None  # release the payload bytes
+        self._n_alloc[s] = n_alias + n_imp
+        self._reserved_tail[s] = need_new - n_cow - n_imp
         if pc is not None:
             self.metrics.prefix_hit_tokens.append(cursor if n_alias else 0)
         h.queue_wait_s = time.monotonic() - h.submit_t
@@ -1213,20 +1476,45 @@ class ServingEngine(object):
             return
         T0 = h.full_prompt.shape[0]
         bound = T0 if h.publish_len is None else min(h.publish_len, T0)
-        n_blocks = bound // pc.block_tokens
+        Bt = pc.block_tokens
+        n_blocks = bound // Bt
         if n_blocks < 1:
             return
+        store = self._kv_store
+        # chain keys for the store records: one fold per publish call,
+        # shared with the trie summary and the router (fold_key) — the
+        # store is keyed by the SAME chain identity the trie uses
+        keys = (chain_keys(h.full_prompt[:n_blocks * Bt], Bt)
+                if store is not None else None)
 
         def _take(d):
             bid = int(self._tables[s, d])
             self._alloc.incref(bid)
+            fp = None
+            if self._fp is not None or store is not None:
+                fp = self._fp_of(bid)
             if self._fp is not None:
                 # ISSUE 15: publish is where a block CLOSES — it is
                 # full (only whole prompt blocks publish; the slot's
                 # later decode writes land past them) and any future
                 # write goes through COW to a private copy. Commit the
                 # fingerprint now; aliased re-opens verify against it.
-                self._fp.commit(bid, self._fp_of(bid))
+                self._fp.commit(bid, fp)
+            if store is not None:
+                # ISSUE 16 write-through: a closing block leaves the
+                # replica as a self-describing record, the committed
+                # fingerprint riding along as the transfer checksum.
+                # Novel blocks only (publish skips trie-held chains):
+                # a chain the store evicted since its first spill is
+                # NOT re-spilled — accepted staleness, the fallback
+                # path covers it.
+                payload, meta = self._serialize_block(bid)
+                store.put(make_block_record(
+                    keys[d], keys[d - 1] if d else 0,
+                    tuple(int(t)
+                          for t in h.full_prompt[d * Bt:(d + 1) * Bt]),
+                    fp, payload, meta, kv_quant=self.kv_quant))
+                self.metrics.store_spilled_blocks += 1
             return bid
 
         pc.publish(h.full_prompt, n_blocks, _take)

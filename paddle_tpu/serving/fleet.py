@@ -162,7 +162,9 @@ import numpy as np
 
 from ..distributed.supervisor import restart_backoff_s as _backoff
 from .engine import EngineFailed, ServingEngine
-from .integrity import CANARY_PROMPT, IntegrityError, golden_trace
+from .integrity import (CANARY_PROMPT, IntegrityError, fp_digest,
+                        golden_trace)
+from .kv_store import KVBlockStore
 from .prefix_cache import chain_keys
 from .tenancy import TenantQuotaExceeded, WFQueue
 
@@ -412,6 +414,13 @@ class FleetHandle(object):
         self.cost: float = 1.0
         self.batch_fn = None
         self.batch_result = None
+        # durable-KV handoff (ISSUE 16): the block package fetched from
+        # the fleet store at re-route (consumed by the assignee's
+        # submit) and the journal side-band describing it ({"len",
+        # "digest"} — stamped onto the assign record, the J011 fence).
+        # Both replaced wholesale under the fleet lock at re-route.
+        self.handoff_package: Optional[list] = None
+        self.handoff_meta: Optional[dict] = None
         self._probe = False   # internal health probe, never journaled
         # known-answer canary (ISSUE 15): a _probe-shaped request on a
         # LIVE replica whose completion is judged against the golden
@@ -604,7 +613,8 @@ class RequestJournal(object):
                                  rec["gen"])
             self._assign_meta[rid] = (rec.get("tier"),
                                       rec.get("weights_version"),
-                                      rec.get("tenant"))
+                                      rec.get("tenant"),
+                                      rec.get("handoff"))
         elif rec["kind"] == "progress":
             self._progress.setdefault(rid, []).extend(rec["tokens"])
         elif rec["kind"] in _TERMINAL_KINDS:
@@ -647,19 +657,28 @@ class RequestJournal(object):
         for rid in sorted(self._open_specs):
             recs.append({"kind": "submit", "rid": rid,
                          "spec": self._open_specs[rid]})
-            if rid in self._assign:
-                rep, inc, gen = self._assign[rid]
-                tier, wv, ten = self._assign_meta.get(
-                    rid, (None, None, None))
-                recs.append({"kind": "assign", "rid": rid, "replica": rep,
-                             "incarnation": inc, "gen": gen,
-                             "tier": tier, "weights_version": wv,
-                             "tenant": ten})
+            # consolidated progress BEFORE the re-emitted assignment:
+            # the verifier's handoff fence (J011) anchors a package-
+            # carrying assign against the history that precedes it —
+            # progress-first keeps the re-route shape of the live file
+            # (tokens journaled, then the new holder assigned)
             if self._progress.get(rid):
                 recs.append({"kind": "progress", "rid": rid,
                              "replica": None, "incarnation": None,
                              "gen": None,
                              "tokens": list(self._progress[rid])})
+            if rid in self._assign:
+                rep, inc, gen = self._assign[rid]
+                tier, wv, ten, ho = self._assign_meta.get(
+                    rid, (None, None, None, None))
+                recs.append({"kind": "assign", "rid": rid, "replica": rep,
+                             "incarnation": inc, "gen": gen,
+                             "tier": tier, "weights_version": wv,
+                             "tenant": ten,
+                             # the handoff side-band survives rotation:
+                             # the J011 fence must still tie the open
+                             # rid's eventual done to THIS transfer
+                             "handoff": ho})
         by_holder: Dict[Tuple[str, int], Dict[int, Tuple[int, int]]] = {}
         for rid, (rep, inc, frm, upto) in self._taint.items():
             if rid not in self._open_specs:
@@ -748,6 +767,7 @@ class RequestJournal(object):
                tier: Optional[str] = None,
                weights_version: Optional[int] = None,
                tenant: Optional[str] = None,
+               handoff: Optional[dict] = None,
                defer: bool = False) -> Optional[dict]:
         """Record an assignment. The MIRROR updates synchronously (a
         failover consulting `lost()` an instant later must see it);
@@ -761,14 +781,21 @@ class RequestJournal(object):
         assignment's version — and the tenant whose quota admitted
         the request (typed by the DFA: an ill-typed tenant is J008),
         so a per-tenant exactly-once audit can group the journal by
-        consumer."""
+        consumer. `handoff` (ISSUE 16) records that this assignment
+        ships a durable-KV block package — {"len": imported-prefix
+        tokens, "digest": fp_digest of the chain} — the J011 handoff
+        fence's assign half: the eventual done must account for the
+        transfer (verified import or counted fallback)."""
         rec = {"kind": "assign", "rid": rid, "replica": replica,
                "incarnation": incarnation, "gen": gen,
                "tier": tier, "weights_version": weights_version,
                "tenant": tenant}
+        if handoff is not None:
+            rec["handoff"] = dict(handoff)
         with self._lock:
             self._assign[rid] = (replica, incarnation, gen)
-            self._assign_meta[rid] = (tier, weights_version, tenant)
+            self._assign_meta[rid] = (tier, weights_version, tenant,
+                                      handoff)
             if defer:
                 self._deferred_out += 1
                 return rec
@@ -847,10 +874,16 @@ class RequestJournal(object):
                  gen: int, tokens: List[int],
                  weights_version: Optional[int] = None,
                  tenant: Optional[str] = None,
+                 handoff: Optional[dict] = None,
                  defer: bool = False) -> Optional[dict]:
         rec = {"kind": "done", "rid": rid, "replica": replica,
                "incarnation": incarnation, "gen": gen,
                "tokens": list(tokens)}
+        if handoff is not None:
+            # the J011 fence's done half: what became of the block
+            # package the latest assignment shipped — {"imported":
+            # tokens imported clean, "fallback": any re-prefill}
+            rec["handoff"] = dict(handoff)
         if weights_version is not None:
             # the version fence's done half: which weights produced
             # this output (must equal the latest assignment's — J009)
@@ -946,13 +979,14 @@ class RequestJournal(object):
 
     def assigned_meta(self, rid: int
                       ) -> Tuple[Optional[str], Optional[int],
-                                 Optional[str]]:
-        """(tier, weights_version, tenant) side-band of the latest
-        assignment — all None when unassigned or unversioned. Lets a
-        completion recovered straight from journaled progress record
-        the version of the holder that actually produced the tokens."""
+                                 Optional[str], Optional[dict]]:
+        """(tier, weights_version, tenant, handoff) side-band of the
+        latest assignment — all None when unassigned or unversioned.
+        Lets a completion recovered straight from journaled progress
+        record the version of the holder that actually produced the
+        tokens, and lets _accept close the J011 handoff fence."""
         with self._lock:
-            return self._assign_meta.get(rid, (None, None, None))
+            return self._assign_meta.get(rid, (None, None, None, None))
 
     def progress_of(self, rid: int) -> List[int]:
         with self._lock:
@@ -1145,7 +1179,7 @@ class _Replica(object):
                 scheduler_hook=hook,
                 weights_version=self.weights_version,
                 **self._engine_kw)
-            completed: List[Tuple[int, List[int], str]] = []
+            completed: List[Tuple[int, List[int], str, Optional[dict]]] = []
             progress: List[Tuple[int, List[int]]] = []
             while True:
                 if hook is not None:
@@ -1193,6 +1227,12 @@ class _Replica(object):
                             publish_len=h.spec["publish_len"],
                             deadline_at=h.deadline_at,
                             resume_tokens=h.resume or None)
+                        if h.handoff_package is not None:
+                            # durable-KV handoff (ISSUE 16): the block
+                            # package the fleet fetched from the store
+                            # at re-route — consumed once, here
+                            subkw["handoff"] = h.handoff_package
+                            h.handoff_package = None
                         if h.spec.get("adapter") is not None:
                             # keyword passed only when set: scripted
                             # engines without the adapter surface keep
@@ -1223,7 +1263,7 @@ class _Replica(object):
                         # the deadline died waiting behind the engine:
                         # the expiry verdict, not a late 'done' — the
                         # every-queue-hop rule batch jobs get too
-                        completed.append((bh.rid, [], "expired"))
+                        completed.append((bh.rid, [], "expired", None))
                     else:
                         try:
                             bh.batch_result = bh.batch_fn()
@@ -1235,7 +1275,7 @@ class _Replica(object):
                             # rid hedged to a healthy survivor
                             fleet._reject(bh.rid, exc, rep=self)
                         else:
-                            completed.append((bh.rid, [], "done"))
+                            completed.append((bh.rid, [], "done", None))
                 for rid, sh in list(self._serving.items()):
                     # batched incremental progress: every token emitted
                     # since the last handshake rides ONE journal record
@@ -1248,7 +1288,14 @@ class _Replica(object):
                         reason = ("expired"
                                   if sh.finish_reason == "expired"
                                   else "done")
-                        completed.append((rid, list(sh.tokens), reason))
+                        # handoff outcome side-band (ISSUE 16): what
+                        # became of an imported block package — read
+                        # via getattr so scripted engines without the
+                        # surface keep working (_accept defaults the
+                        # outcome for them when the assign shipped one)
+                        outcome = getattr(sh, "handoff_outcome", None)
+                        completed.append(
+                            (rid, list(sh.tokens), reason, outcome))
                         del self._serving[rid]
                         del self._reported[rid]
         except Exception as exc:  # crash -> failover (incl. _KillDrill)
@@ -1306,6 +1353,18 @@ class _Replica(object):
             out["fp_committed"] = bf.committed
             out["fp_verified"] = bf.verified
             out["fp_mismatches"] = bf.mismatches
+        if getattr(m, "kv_store", None) is not None:
+            # ISSUE 16 durable-KV counters: cumulative ints, folded
+            # into _stats_base on replica death/retire like the rest
+            out["tokens_recomputed_at_migration"] = \
+                m.tokens_recomputed_at_migration
+            out["handoff_imports"] = m.handoff_imports
+            out["handoff_blocks_imported"] = m.handoff_blocks_imported
+            out["handoff_tokens_imported"] = m.handoff_tokens_imported
+            out["handoff_fallbacks"] = m.handoff_fallbacks
+            out["store_spilled_blocks"] = m.store_spilled_blocks
+            out["store_warm_blocks"] = m.store_warm_blocks
+            out["store_quarantined"] = m.store_quarantined
         ap = getattr(e.metrics, "adapter_pool", None)
         if ap is not None:
             # cumulative adapter-pool counters (ISSUE 12): fold into
@@ -1477,6 +1536,24 @@ class ServingFleet(object):
                            outputs are not token-identical to
                            generate(), so the fleet refuses to derive
                            the known answer itself)
+      kv_store /           durable KV tier (ISSUE 16): pass a
+      kv_store_dir /       KVBlockStore, or set kv_store_dir (spill
+      kv_store_bytes       directory; store.jsonl under it) and/or
+                           kv_store_bytes (host-RAM byte budget,
+                           leaf-first eviction) and the fleet builds
+                           ONE store shared by every replica: closed
+                           blocks spill write-through at publish,
+                           restarted/autoscaled replicas warm their
+                           tries from it, and the router credits what
+                           a replica can cheaply RESTORE, not just
+                           what is resident. Default: no store (the
+                           pre-PR-16 fleet exactly)
+      handoff              ship finished-prefix block packages at
+                           migration/failover re-routes (default True;
+                           needs a store). The clean path re-prefills
+                           ZERO closed-block tokens; mismatch/absence
+                           falls back to re-prefill, counted, never
+                           wrong
     """
 
     def __init__(self, params, cfg, n_replicas=2, journal_path=None,
@@ -1494,7 +1571,9 @@ class ServingFleet(object):
                  ckpt_dir=None, rollout_policy="finish",
                  weights_version=0, tenants=None, wfq_window=None,
                  canary_interval_s=None, canary_max_new=4,
-                 canary_prompt=None, canary_golden=None):
+                 canary_prompt=None, canary_golden=None,
+                 kv_store=None, kv_store_dir=None, kv_store_bytes=None,
+                 handoff=True):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
         if int(max_pending) < 1:
@@ -1593,6 +1672,38 @@ class ServingFleet(object):
         # queues instead)
         _, self.block_tokens, self._pool_blocks = self._limits_for(
             self._engine_kw)
+        # durable KV tier (ISSUE 16): ONE store shared by every
+        # replica (it carries its own lock — the RequestJournal
+        # discipline), constructed only when explicitly requested so
+        # the default fleet is byte-identical to the pre-PR-16 one.
+        # Injected into the engine base kw: every replica spills its
+        # closing blocks write-through and warms its trie from the
+        # store at spawn (restart, failover incarnation, autoscale).
+        self.handoff = bool(handoff)
+        self._kv_store_owned = False
+        if kv_store is None and (kv_store_dir is not None
+                                 or kv_store_bytes is not None):
+            kv_store = KVBlockStore(
+                byte_budget=kv_store_bytes, dir=kv_store_dir,
+                block_tokens=self.block_tokens,
+                fault_injector=self._engine_kw.get("fault_injector"))
+            self._kv_store_owned = True
+        self.kv_store = kv_store
+        if kv_store is not None:
+            if int(kv_store.block_tokens) != int(self.block_tokens):
+                raise ValueError(
+                    "kv_store block_tokens (%d) != fleet block "
+                    "granularity (%d) — one store, one geometry"
+                    % (int(kv_store.block_tokens),
+                       int(self.block_tokens)))
+            if not self._engine_kw.get("prefix_cache_tokens"):
+                raise ValueError(
+                    "kv_store needs the prefix cache (set "
+                    "prefix_cache_tokens in engine_kw): blocks spill "
+                    "at trie publish and warm-start restores into "
+                    "the trie")
+            self._engine_kw["kv_store"] = kv_store
+            self._engine_kw["kv_store_warm"] = True
         # ONE storage dtype (ISSUE 14): failover, token-level resume,
         # and prefix-summary affinity all assume every replica decodes
         # the same numerics — a request hedged from an int8 replica to
@@ -1793,6 +1904,14 @@ class ServingFleet(object):
         self.canaries_ok = 0                           # guarded-by: _cond
         self.canary_mismatches = 0                     # guarded-by: _cond
         self.tainted_tokens = 0                        # guarded-by: _cond
+        # durable-KV counters (ISSUE 16): fleet-scope monotonic.
+        # handoff_packages = block packages attached at re-route;
+        # handoff_fallbacks_defaulted = dones whose holder never
+        # reported an import outcome (scripted engines) — the fleet
+        # stamps the honest {"imported": 0, "fallback": True} so the
+        # J011 fence still closes
+        self.handoff_packages = 0                      # guarded-by: _cond
+        self.handoff_fallbacks_defaulted = 0           # guarded-by: _cond
 
         self._idle_wait_s = min(0.02, self.heartbeat_timeout_s / 10.0)
         self._monitor_interval_s = (
@@ -2296,12 +2415,19 @@ class ServingFleet(object):
                     replica=None))
             raise h.error
         best, best_key = None, None
+        # store-aware affinity (ISSUE 16): a chain the durable store
+        # holds is cheap for ANY replica to restore (warm/handoff), so
+        # routing credits store-held keys to every candidate equally —
+        # resident beats absent, ties break by load as ever
+        store_keys = (self.kv_store.summary()
+                      if self.kv_store is not None and self.affinity
+                      and h.chain else ())
         for i in cands:
             depth = 0
             if self.affinity and h.chain:
                 s = self._summaries[i]
                 for key in h.chain:
-                    if key not in s:
+                    if key not in s and key not in store_keys:
                         break
                     depth += 1
             load = len(self._inbox[i]) + len(self._in_flight[i])
@@ -2322,7 +2448,12 @@ class ServingFleet(object):
         self._pending_journal.append(self._journal.assign(
             h.rid, rep.name, rep.incarnation, h.generation,
             tier=rep.tier, weights_version=rep.weights_version,
-            tenant=h.tenant, defer=True))
+            tenant=h.tenant, handoff=h.handoff_meta, defer=True))
+        # the side-band describes THIS assignment only: a later
+        # re-route without a fresh package must not re-stamp it (the
+        # package itself stays on the handle until the assignee's
+        # submit consumes it — or a newer re-route replaces it)
+        h.handoff_meta = None
         self._cond.notify_all()
 
     def _flush_journal(self):
@@ -2474,8 +2605,9 @@ class ServingFleet(object):
                     # handshake, not the previous one's snapshot
                     self._rep_stats[i] = stats
                 self._absorb_progress(rep, progress)
-            for rid, tokens, reason in completed:
-                self._accept(rid, tokens, reason, rep, accepted=current)
+            for rid, tokens, reason, outcome in completed:
+                self._accept(rid, tokens, reason, rep, accepted=current,
+                             outcome=outcome)
             if not current or self._closing \
                     or self._replicas[i] is not rep \
                     or self._state[i] in (_DEAD, _RETIRED):
@@ -2618,13 +2750,34 @@ class ServingFleet(object):
             self.resubmitted += 1
             self.resumed_requests += 1
             self.resumed_tokens += len(toks)
+            self._attach_handoff_locked(h, toks)
             try:
                 self._route(h, exclude=i)
             except EngineFailed:
                 pass  # no survivors: handle already failed by _route
 
+    def _attach_handoff_locked(self, h: FleetHandle, toks: List[int]):
+        """Build the checksummed block package for a resumed request
+        (caller holds `_cond`): the durable KV tier ships the finished
+        prefix's closed blocks to the resuming replica so re-prefill
+        becomes the FALLBACK path, not the plan (ISSUE 16). The store
+        lookup is fingerprint-carrying — the target verifies each block
+        after upload and falls back per-block on mismatch — and the
+        assign record's `handoff` side-band (length + fp digest) lets
+        the journal audit tie the done to THIS transfer (J011)."""
+        if self.kv_store is None or not self.handoff:
+            return
+        package = self.kv_store.chain_fetch(
+            list(h.prompt) + list(toks), self.block_tokens)
+        if package:
+            h.handoff_package = package
+            h.handoff_meta = {
+                "len": len(package) * self.block_tokens,
+                "digest": fp_digest(r["fp"] for r in package)}
+            self.handoff_packages += 1
+
     def _accept(self, rid: int, tokens: List[int], reason: str,
-                rep: _Replica, accepted: bool):
+                rep: _Replica, accepted: bool, outcome=None):
         """Completion fence + dedupe (caller holds `_cond`): refuse a
         dead/superseded replica's late result, refuse a STALE holder's
         result (the journal's latest assignment is the lease — a
@@ -2687,10 +2840,19 @@ class ServingFleet(object):
         # long-lived front door must not retain every prompt + output
         # it ever served — _done_rids (ints) carries the dedupe
         self._handles.pop(rid, None)
+        # ISSUE 16 handoff fence: an assignment that shipped a block
+        # package MUST account for it at the done — verified import or
+        # counted fallback, never silence (protocol_lint J011). An
+        # engine that cannot report (scripted drills) gets the honest
+        # default: nothing imported, re-prefill fallback.
+        _tier, _wv, _ten, ho = self._journal.assigned_meta(rid)
+        if ho is not None and outcome is None:
+            outcome = {"imported": 0, "fallback": True}
+            self.handoff_fallbacks_defaulted += 1
         self._pending_journal.append(self._journal.complete(
             rid, rep.name, rep.incarnation, h.generation, full,
             weights_version=rep.weights_version, tenant=h.tenant,
-            defer=True))
+            handoff=outcome, defer=True))
         h.tokens = full
         h.replica = rep.name
         h.weights_version = rep.weights_version
@@ -2857,10 +3019,18 @@ class ServingFleet(object):
         self._handles.pop(rid, None)
         # the version of the holder that actually produced the tokens
         # (read BEFORE complete() prunes the assignment side-band)
-        _tier, wv, _ten = self._journal.assigned_meta(rid)
+        _tier, wv, _ten, ho = self._journal.assigned_meta(rid)
+        # the holder died before reporting whether it imported its
+        # block package — the audit gets the conservative default, not
+        # silence (J011: every shipped package accounts for itself)
+        outcome = None
+        if ho is not None:
+            outcome = {"imported": 0, "fallback": True}
+            self.handoff_fallbacks_defaulted += 1
         self._pending_journal.append(self._journal.complete(
             rid, replica, incarnation, h.generation, list(toks),
-            weights_version=wv, tenant=h.tenant, defer=True))
+            weights_version=wv, tenant=h.tenant, handoff=outcome,
+            defer=True))
         h.tokens = list(toks)
         h.emitted = len(toks)
         h.replica = replica
@@ -2903,6 +3073,7 @@ class ServingFleet(object):
             if toks:
                 self.resumed_requests += 1
                 self.resumed_tokens += len(toks)
+            self._attach_handoff_locked(h, toks)
             try:
                 self._route(h, exclude=i)
             except EngineFailed:
@@ -3919,6 +4090,13 @@ class ServingFleet(object):
             fp_committed = base.get("fp_committed", 0)
             fp_verified = base.get("fp_verified", 0)
             fp_mismatches = base.get("fp_mismatches", 0)
+            # durable-KV counters (ISSUE 16): same fold discipline
+            ho_keys = ("tokens_recomputed_at_migration",
+                       "handoff_imports", "handoff_blocks_imported",
+                       "handoff_tokens_imported", "handoff_fallbacks",
+                       "store_spilled_blocks", "store_warm_blocks",
+                       "store_quarantined")
+            ho_sums = {k: base.get(k, 0) for k in ho_keys}
             reps = []
             for i, rep in enumerate(self._replicas):
                 st = self._rep_stats[i] or {}
@@ -3939,6 +4117,8 @@ class ServingFleet(object):
                 fp_committed += st.get("fp_committed", 0)
                 fp_verified += st.get("fp_verified", 0)
                 fp_mismatches += st.get("fp_mismatches", 0)
+                for k in ho_keys:
+                    ho_sums[k] += st.get(k, 0)
                 reps.append({
                     "name": rep.name, "slo": rep.slo,
                     "tier": rep.tier,
@@ -3998,6 +4178,25 @@ class ServingFleet(object):
                 "fp_committed": fp_committed,
                 "fp_verified": fp_verified,
                 "fp_mismatches": fp_mismatches,
+                # durable-KV tier (ISSUE 16): fleet-scope package
+                # counters plus the per-replica sums folded above; the
+                # shared store reports its own record/byte counters
+                "handoff_packages": self.handoff_packages,
+                "handoff_fallbacks_defaulted":
+                    self.handoff_fallbacks_defaulted,
+                "tokens_recomputed_at_migration":
+                    ho_sums["tokens_recomputed_at_migration"],
+                "handoff_imports": ho_sums["handoff_imports"],
+                "handoff_blocks_imported":
+                    ho_sums["handoff_blocks_imported"],
+                "handoff_tokens_imported":
+                    ho_sums["handoff_tokens_imported"],
+                "handoff_fallbacks": ho_sums["handoff_fallbacks"],
+                "store_spilled_blocks": ho_sums["store_spilled_blocks"],
+                "store_warm_blocks": ho_sums["store_warm_blocks"],
+                "store_quarantined": ho_sums["store_quarantined"],
+                "kv_store": (None if self.kv_store is None
+                             else self.kv_store.stats()),
                 "weights_version": self._weights_version,
                 "replicas_live": sum(
                     1 for s in self._state if s == _LIVE),
@@ -4067,6 +4266,11 @@ class ServingFleet(object):
                 rep.thread.join(timeout=timeout)
         self._flush_journal()  # stragglers from the final syncs
         self._journal.close()
+        if self._kv_store_owned and self.kv_store is not None:
+            # a store the fleet BUILT (kv_store_dir/kv_store_bytes
+            # knobs) closes with the fleet; a caller-provided store is
+            # the caller's to close — it may warm the next fleet
+            self.kv_store.close()
         # opt-in self-audit (ISSUE 9): replay the journal file through
         # the protocol DFA so every fleet test / bench run that sets
         # the env var double-checks its own history for free. A journal

@@ -59,7 +59,7 @@ from typing import Dict, Optional
 from ..utils.detector import TripDetector
 
 __all__ = ["IntegrityError", "BlockFingerprints", "ServingSentinel",
-           "golden_trace", "CANARY_PROMPT"]
+           "golden_trace", "fp_digest", "CANARY_PROMPT"]
 
 # the fleet's default known-answer canary prompt: tiny, fixed, and in
 # every model's vocab range (ids 1..3) — the GOLDEN trace is what makes
@@ -168,6 +168,22 @@ class ServingSentinel(object):
                 self.trips += 1
                 return "spike"
         return "ok"
+
+
+def fp_digest(fps) -> str:
+    """Fold a sequence of block fingerprints into one short hex digest
+    (crc32 over each float's little-endian f64 bytes, chained). The
+    ISSUE 16 handoff side-band: an assign record that ships imported
+    blocks carries this digest so the journal audit can tie the done
+    back to ONE specific verified transfer — cheap enough to compute
+    inline, stable across platforms (explicit endianness)."""
+    import struct
+    import zlib
+
+    acc = 0
+    for fp in fps:
+        acc = zlib.crc32(struct.pack("<d", float(fp)), acc)
+    return "%08x" % (acc & 0xFFFFFFFF)
 
 
 def golden_trace(params, cfg, prompt=CANARY_PROMPT, max_new_tokens=4):

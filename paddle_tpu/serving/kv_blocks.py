@@ -121,6 +121,16 @@ class KVBlockAllocator(object):
         self.allocated_total += 1
         return bid
 
+    def try_alloc(self):
+        """Reserve-and-materialise one block in a single call, or None
+        when the pool cannot cover it (backpressure, never a raise).
+        The handoff-import and store-warm paths allocate OUTSIDE any
+        admission's worst-case reservation, so each block is its own
+        reserve+alloc pair."""
+        if not self.reserve(1):
+            return None
+        return self.alloc_reserved()
+
     def incref(self, bid: int):
         if self._refs[bid] < 1:
             raise ValueError("incref on free block %d" % bid)

@@ -101,6 +101,24 @@ class ServingMetrics(object):
         # kv_blocks_in_use: a dead incarnation's version says nothing
         # about its replacement.
         self.weights_version = None
+        # PR 16 counters — durable KV tier, same O(1) discipline.
+        # Cumulative ints; the fleet's per-replica stats rows sum them
+        # across incarnations like the fingerprint counters.
+        self.tokens_recomputed_at_migration = 0  # cumulative: closed-
+        #                                   block prompt tokens a
+        #                                   resumed admission re-
+        #                                   prefilled (0 == clean path)
+        self.handoff_imports = 0          # cumulative clean imports
+        self.handoff_blocks_imported = 0  # cumulative blocks imported
+        self.handoff_tokens_imported = 0  # cumulative tokens imported
+        self.handoff_fallbacks = 0        # cumulative re-prefill falls
+        self.store_spilled_blocks = 0     # cumulative publish spills
+        self.store_warm_blocks = 0        # cumulative warm-start loads
+        self.store_quarantined = 0        # cumulative fp-reject loads
+        # PR 16: set by the engine when a durable KV store is attached
+        # — report() surfaces its record/byte/quarantine counters
+        # (serving/kv_store.py KVBlockStore)
+        self.kv_store = None
         self._t0 = None
         self._t1 = None
 
@@ -186,6 +204,15 @@ class ServingMetrics(object):
             "kv_quant": self.kv_quant,
             "weight_quant": self.weight_quant,
             "weights_version": self.weights_version,
+            "tokens_recomputed_at_migration":
+                self.tokens_recomputed_at_migration,
+            "handoff_imports": self.handoff_imports,
+            "handoff_blocks_imported": self.handoff_blocks_imported,
+            "handoff_tokens_imported": self.handoff_tokens_imported,
+            "handoff_fallbacks": self.handoff_fallbacks,
+            "store_spilled_blocks": self.store_spilled_blocks,
+            "store_warm_blocks": self.store_warm_blocks,
+            "store_quarantined": self.store_quarantined,
         }
         if self.prefix_cache is not None:
             rep["prefix_cache"] = self.prefix_cache.stats()
@@ -193,6 +220,8 @@ class ServingMetrics(object):
             rep["adapter_pool"] = self.adapter_pool.stats()
         if self.block_fp is not None:
             rep["block_fingerprints"] = self.block_fp.stats()
+        if self.kv_store is not None:
+            rep["kv_store"] = self.kv_store.stats()
         return rep
 
     def table(self, sorted_key="total"):

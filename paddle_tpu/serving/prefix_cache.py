@@ -48,7 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-__all__ = ["PrefixCache", "PrefixMatch", "chain_keys"]
+__all__ = ["PrefixCache", "PrefixMatch", "chain_keys", "fold_key"]
 
 
 def _fold(acc: int, block) -> int:
@@ -58,6 +58,12 @@ def _fold(acc: int, block) -> int:
     side) must produce identical keys or every lookup silently
     misses."""
     return zlib.crc32(np.asarray(block, np.int64).tobytes(), acc)
+
+
+# public alias: the durable KV store (kv_store.py) keys its records
+# with the SAME fold as the trie summary and the router — three users,
+# one definition, or lookups silently miss across the tier boundary
+fold_key = _fold
 
 
 def chain_keys(tokens, block_tokens: int) -> List[int]:

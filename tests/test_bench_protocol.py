@@ -687,6 +687,43 @@ def test_serving_integrity_workload_contract():
     assert rec["outputs_identical"], rec
 
 
+def test_serving_kv_handoff_workload_contract():
+    """ISSUE 16 acceptance: the `serving_kv_handoff` row cannot decay
+    into a no-op — on the fixed-seed shared-header Poisson trace
+    against ONE store directory, the cold phase must actually spill
+    (>= 1 durable record), the tiered handoff phase must migrate >= 1
+    request with tokens_recomputed_at_migration EXACTLY 0 and >= 1
+    verified package import (re-prefill demoted to a counted
+    fallback), the kill drill must leave the killed replica dead with
+    nothing lost, and the warm-restarted fleet must warm >= 1 block
+    from the store and serve the first shared-header request with
+    strictly fewer prefill tokens than the cold phase's first request
+    — all with outputs token-identical across the four phases and
+    every journal green through the DFA --expect-closed including the
+    J011 handoff fence (all of these hard-raise in-bench; the
+    assertions here pin the row's shape)."""
+    rec = bench.bench_serving_kv_handoff(n_requests=6)
+    assert rec["store_records_after_cold"] >= 1, rec
+    assert rec["store_spilled_blocks"] >= 1, rec
+    assert rec["migrations_handoff"] >= 1, rec
+    assert rec["handoff_packages"] >= 1, rec
+    assert rec["handoff_imports"] >= 1, rec
+    assert rec["tokens_recomputed_at_migration"] == 0, rec
+    assert rec["store_warm_blocks"] >= 1, rec
+    assert rec["warm_first_prefill_tokens"] \
+        < rec["cold_first_prefill_tokens"], rec
+    assert rec["outputs_identical"], rec
+
+
+def test_serving_kv_handoff_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_kv_handoff", bench_serving_kv_handoff' in src
+
+
 def test_serving_integrity_registered_in_bench_main():
     """The workload is wired into bench.main()'s side-workload list
     (the registration is what lands it in the driver's record)."""

@@ -240,3 +240,42 @@ def test_cli_preemption_and_resume(tmp_path):
         init_model_path=os.path.join(save, committed[-1]),
     )
     assert np.isfinite(out["cost"])
+
+
+def test_store_fault_spec_parsing():
+    """store_corrupt@N / store_trunc@N (ISSUE 16) parse like any other
+    kind — and a typo'd store kind fails at parse time."""
+    inj = fi.FaultInjector("store_corrupt@2")
+    assert inj.active
+    inj2 = fi.FaultInjector("store_trunc@1")
+    assert inj2.active
+    with pytest.raises(ValueError):
+        fi.FaultInjector("store_smudge@2")
+
+
+def test_store_fault_counts_records_not_steps():
+    """Store faults fire on the Nth PUT (store_tick), one-shot, and
+    are invisible to the step clock — tick() never consumes them."""
+    inj = fi.FaultInjector("store_corrupt@2")
+    for _ in range(10):
+        inj.tick()  # steps do not advance the store counter
+    assert inj.store_tick() is None          # record 1
+    assert inj.store_tick() == "corrupt"     # record 2: fires
+    assert inj.store_tick() is None          # one-shot: consumed
+    inj2 = fi.FaultInjector("store_trunc@1")
+    assert inj2.store_tick() == "trunc"
+
+
+def test_store_fault_arm_is_relative_to_record_counter():
+    """arm() shifts store faults by the RECORD counter, not the step
+    counter: a drill warms the store under no faults, then lands the
+    fault on a deterministic upcoming record."""
+    inj = fi.FaultInjector("")
+    for _ in range(7):
+        inj.tick()          # step clock way ahead
+    assert inj.store_tick() is None
+    assert inj.store_tick() is None          # 2 records spilled
+    inj.arm("store_trunc@2")                 # 2 records from NOW
+    assert inj.store_tick() is None          # record 3
+    assert inj.store_tick() == "trunc"       # record 4 == 2 + 2
+    assert inj.store_tick() is None

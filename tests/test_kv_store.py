@@ -1,0 +1,334 @@
+"""Durable KV tier (ISSUE 16): the fleet-shared block store and the
+engine's serialize/handoff/warm paths.
+
+* Store contract — crc-verified get with sticky quarantine, leaf-first
+  LRU under a byte budget, chain_fetch stopping at the first hole,
+  append-only durability with the journal's torn-tail discipline (but
+  the cache's softer mid-file rule: skip + count, never fail), atomic
+  compaction, one-store-one-geometry.
+* Fault drills — store_corrupt@N / store_trunc@N land on the Nth put
+  and are caught by the read path's crc, never served.
+* Engine bar — a spilled prefix imports on a fresh engine with ZERO
+  tokens recomputed at migration; a fingerprint-failing package falls
+  back to re-prefill with TOKEN-IDENTICAL output (counted, never
+  wrong); a store-warmed engine serves the shared header without
+  re-decoding it, and quarantines (with subtree) anything corrupt.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import fault_injection as fi
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serving import (
+    KVBlockStore,
+    ServingEngine,
+    fold_key,
+    make_block_record,
+)
+from paddle_tpu.serving.kv_store import payload_crc
+
+BT = 4  # block geometry used throughout
+
+
+def _chain(*blocks, payload=b"0123456789abcdef", fp=1.0):
+    """Chained records for token blocks, parent-linked in order."""
+    recs, parent = [], 0
+    for blk in blocks:
+        key = fold_key(parent, tuple(blk))
+        recs.append(make_block_record(key, parent, blk, fp, payload, []))
+        parent = key
+    return recs
+
+
+# ---------------------------------------------------------------------
+# store contract (pure host, no engine)
+# ---------------------------------------------------------------------
+
+def test_put_get_roundtrip_idempotent():
+    st = KVBlockStore(block_tokens=BT)
+    (r0,) = _chain((1, 2, 3, 4))
+    assert st.put(r0)
+    assert st.put(r0)  # idempotent per key
+    got = st.get(r0["key"])
+    assert got is not None and got["payload"] == r0["payload"]
+    s = st.stats()
+    assert s["records"] == 1 and s["puts"] == 1 and s["hits"] == 1
+    assert st.get(999) is None and st.stats()["misses"] == 1
+    assert r0["key"] in st.summary()
+
+
+def test_chain_fetch_walks_and_stops_at_hole():
+    st = KVBlockStore(block_tokens=BT)
+    b0, b1, b2 = (1, 2, 3, 4), (5, 6, 7, 8), (9, 10, 11, 12)
+    for r in _chain(b0, b1, b2):
+        assert st.put(r)
+    toks = list(b0 + b1 + b2) + [13]  # partial tail block ignored
+    got = st.chain_fetch(toks)
+    assert [r["tokens"] for r in got] == [b0, b1, b2]
+    # an interior hole makes the tail unusable: import in order or not
+    # at all (a child's KV attends through its ancestors)
+    assert st.evict(fold_key(fold_key(0, b0), b1))
+    got = st.chain_fetch(toks)
+    assert [r["tokens"] for r in got] == [b0]
+
+
+def test_chain_fetch_token_mismatch_guard():
+    # chain keys only STEER, bytes decide: a record admitted under a
+    # colliding key must not serve a different block's tokens
+    st = KVBlockStore(block_tokens=BT)
+    blk_a, blk_b = (1, 2, 3, 4), (5, 6, 7, 8)
+    rec = make_block_record(fold_key(0, blk_a), 0, blk_b, 1.0, b"x" * 8,
+                            [])
+    assert st.put(rec)
+    assert st.chain_fetch(list(blk_a)) == []
+
+
+def test_leaf_first_eviction_never_orphans_a_chain():
+    pay = b"p" * 16
+    st = KVBlockStore(byte_budget=2 * len(pay), block_tokens=BT)
+    ra, rb = _chain((1, 2, 3, 4), (5, 6, 7, 8), payload=pay)
+    assert st.put(ra) and st.put(rb)
+    # ra is OLDEST but interior (rb is its child): budget pressure from
+    # a new root must evict the LRU **leaf** rb, never orphan the chain
+    (rc,) = _chain((9, 9, 9, 9), payload=pay)
+    assert st.put(rc)
+    assert st.get(ra["key"]) is not None
+    assert st.get(rc["key"]) is not None
+    assert st.get(rb["key"]) is None
+    assert st.stats()["evictions"] == 1
+
+
+def test_oversize_record_refused():
+    st = KVBlockStore(byte_budget=8, block_tokens=BT)
+    (r0,) = _chain((1, 2, 3, 4), payload=b"way-too-big-payload")
+    assert not st.put(r0)
+    assert st.stats()["records"] == 0
+
+
+def test_store_fault_drills_corrupt_and_trunc():
+    # the injected at-rest faults (ISSUE 16 drills): the Nth put's
+    # payload rots AFTER its crc was computed — the read path catches
+    # it, quarantines, and never serves; the crc stays honest
+    for spec, n_bad in (("store_corrupt@2", 2), ("store_trunc@1", 1)):
+        st = KVBlockStore(block_tokens=BT,
+                          fault_injector=fi.FaultInjector(spec))
+        r1, r2 = _chain((1, 2, 3, 4), (5, 6, 7, 8))
+        assert st.put(r1) and st.put(r2)
+        bad = (r1, r2)[n_bad - 1]
+        ok = (r1, r2)[2 - n_bad]
+        assert st.get(bad["key"]) is None, spec
+        assert st.get(ok["key"]) is not None, spec
+        s = st.stats()
+        assert s["quarantined"] == 1 and s["quarantines"] == 1, spec
+        # sticky: the quarantined key refuses a clean re-put
+        assert not st.put(bad)
+        assert st.get(bad["key"]) is None
+
+
+def test_durability_roundtrip_and_sticky_quarantine(tmp_path):
+    d = str(tmp_path / "store")
+    st = KVBlockStore(dir=d, block_tokens=BT)
+    b0, b1 = (1, 2, 3, 4), (5, 6, 7, 8)
+    r0, r1 = _chain(b0, b1)
+    assert st.put(r0) and st.put(r1)
+    st.quarantine(r1["key"])
+    st.close()
+    st2 = KVBlockStore(dir=d, block_tokens=BT)
+    assert st2.stats()["durable"]
+    got = st2.chain_fetch(list(b0 + b1))
+    assert [r["tokens"] for r in got] == [b0]  # quarantine survived
+    assert not st2.put(r1)
+    st2.close()
+
+
+def test_torn_tail_healed_midfile_garbage_skipped(tmp_path):
+    d = str(tmp_path / "store")
+    st = KVBlockStore(dir=d, block_tokens=BT)
+    r0, r1, r2 = _chain((1, 2, 3, 4), (5, 6, 7, 8), (9, 10, 11, 12))
+    for r in (r0, r1, r2):
+        assert st.put(r)
+    st.close()
+    path = str(tmp_path / "store" / "store.jsonl")
+    lines = open(path).read().splitlines()
+    # rot the MIDDLE put (r1) in place and tear the tail mid-record:
+    # both are survivable damage for a cache — skip, count, carry on
+    lines[2] = lines[2][: len(lines[2]) // 2]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+        f.write('{"kind": "put", "key": 123, "torn')  # no newline
+    st2 = KVBlockStore(dir=d, block_tokens=BT)
+    assert st2.stats()["corrupt_dropped"] == 2
+    # r0 lives; r1 was the rotted line; r2 is orphaned upstream of the
+    # hole so chain_fetch stops — but the record itself survived
+    got = st2.chain_fetch([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12])
+    assert [r["tokens"] for r in got] == [(1, 2, 3, 4)]
+    assert st2.get(r2["key"]) is not None
+    st2.close()
+
+
+def test_one_store_one_geometry(tmp_path):
+    d = str(tmp_path / "store")
+    KVBlockStore(dir=d, block_tokens=BT).close()
+    with pytest.raises(ValueError, match="block geometry"):
+        KVBlockStore(dir=d, block_tokens=8)
+
+
+def test_compaction_rewrites_to_live_set(tmp_path):
+    d = str(tmp_path / "store")
+    st = KVBlockStore(dir=d, block_tokens=BT)
+    # churn: admit/evict the same chain until dead lines dominate
+    for i in range(12):
+        (r,) = _chain((i, i, i, i))
+        assert st.put(r)
+        if i < 10:
+            assert st.evict(r["key"])
+    assert st.stats()["compactions"] >= 1
+    live = {r["key"] for r in st.iter_chains()}
+    st.close()
+    st2 = KVBlockStore(dir=d, block_tokens=BT)
+    assert {r["key"] for r in st2.iter_chains()} == live
+    assert st2.stats()["records"] == len(live)
+    st2.close()
+
+
+def test_iter_chains_parents_before_children():
+    st = KVBlockStore(block_tokens=BT)
+    recs = _chain((1, 2, 3, 4), (5, 6, 7, 8), (9, 10, 11, 12))
+    for r in reversed(recs):  # admit out of order
+        assert st.put(r)
+    order = [r["key"] for r in st.iter_chains()]
+    seen = set()
+    for r in st.iter_chains():
+        assert r["parent"] == 0 or r["parent"] in seen
+        seen.add(r["key"])
+    assert set(order) == seen
+
+
+# ---------------------------------------------------------------------
+# engine bar: serialize -> handoff import / fallback / warm start
+# ---------------------------------------------------------------------
+
+def _cfg(**kw):
+    kw.setdefault("vocab", 50)
+    kw.setdefault("dim", 32)
+    kw.setdefault("heads", 4)
+    kw.setdefault("layers", 2)
+    kw.setdefault("max_len", 64)
+    return T.TransformerConfig(**kw)
+
+
+def _oracle(params, cfg, prompt, max_new):
+    return np.asarray(
+        T.generate(params, jnp.asarray(prompt)[None], cfg, max_new)
+    )[0]
+
+
+def _eng(params, cfg, store, warm=False):
+    return ServingEngine(params, cfg, max_slots=2, kv_block_tokens=BT,
+                         prefix_cache_tokens=16 * BT,
+                         kv_fingerprints=True, kv_store=store,
+                         kv_store_warm=warm)
+
+
+def test_engine_spill_import_zero_recompute_and_fp_fallback():
+    """The tentpole bar end to end at engine level: a retired request's
+    closed prompt blocks spill as fingerprinted records; a fresh engine
+    imports the package with tokens_recomputed_at_migration == 0; a
+    fingerprint-failing package falls back to re-prefill with
+    TOKEN-IDENTICAL output and quarantines the liar."""
+    cfg = _cfg()
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab, 3 * BT).astype(np.int32)
+    want = _oracle(params, cfg, prompt, 5)
+    store = KVBlockStore(block_tokens=BT)
+
+    src = _eng(params, cfg, store)
+    h = src.submit(prompt, 5)
+    src.run()
+    np.testing.assert_array_equal(
+        np.concatenate([prompt, np.asarray(h.tokens, np.int32)]), want)
+    assert src.metrics.store_spilled_blocks == 3
+    package = store.chain_fetch(prompt)
+    assert len(package) == 3
+
+    # clean handoff: fresh target, cold trie, package fully covers
+    tgt = _eng(params, cfg, store)
+    h2 = tgt.submit(prompt, 5, handoff=package)
+    tgt.run()
+    np.testing.assert_array_equal(
+        np.concatenate([prompt, np.asarray(h2.tokens, np.int32)]), want)
+    m = tgt.metrics
+    assert m.tokens_recomputed_at_migration == 0
+    assert m.handoff_imports == 1 and m.handoff_fallbacks == 0
+    assert m.handoff_blocks_imported == 3
+    assert h2.handoff_outcome == {"imported": 3 * BT, "fallback": False}
+
+    # a lying record: payload perturbed in the EXPONENT byte (a small
+    # mantissa flip can legitimately pass the fp tolerance), crc made
+    # honest over the rot — only the on-device fingerprint can see it
+    bad = [dict(r) for r in package]
+    pay = bytearray(bad[0]["payload"])
+    pay[3] ^= 0x7F
+    bad[0]["payload"] = bytes(pay)
+    bad[0]["crc"] = payload_crc(bad[0]["payload"])
+    fb = _eng(params, cfg, store)
+    h3 = fb.submit(prompt, 5, handoff=bad)
+    fb.run()
+    np.testing.assert_array_equal(
+        np.concatenate([prompt, np.asarray(h3.tokens, np.int32)]), want)
+    m = fb.metrics
+    assert m.handoff_fallbacks == 1 and m.handoff_imports == 0
+    assert m.tokens_recomputed_at_migration > 0  # counted, never wrong
+    assert h3.handoff_fallback and h3.handoff_outcome["fallback"]
+    assert m.store_quarantined == 1
+    assert store.stats()["quarantined"] == 1  # the shared store learned
+
+
+def test_engine_warm_start_and_corrupt_entry_quarantine():
+    """A restarted replica warms its trie FROM the store and serves the
+    first shared-prefix request without re-decoding the header; a
+    corrupt store entry is skipped WITH its subtree (a child's context
+    is its ancestors' payloads), quarantined, and the request still
+    decodes token-identically via re-prefill."""
+    cfg = _cfg()
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, cfg.vocab, 3 * BT).astype(np.int32)
+    want = _oracle(params, cfg, prompt, 5)
+
+    store = KVBlockStore(block_tokens=BT)
+    src = _eng(params, cfg, store)
+    src.submit(prompt, 5)
+    src.run()
+    assert store.stats()["records"] >= 3
+
+    warm = _eng(params, cfg, store, warm=True)
+    assert warm.metrics.store_warm_blocks == 3
+    h = warm.submit(prompt, 5)
+    warm.run()
+    np.testing.assert_array_equal(
+        np.concatenate([prompt, np.asarray(h.tokens, np.int32)]), want)
+    # the warmed trie covers the whole closed prefix: only the final
+    # prompt token (whose logits seed generation) computes
+    assert warm.metrics.prefill_tokens_computed < len(prompt)
+
+    # rot the MIDDLE record at rest (crc left stale so the warm path's
+    # crc check sees it): warm must skip block 2 AND its child
+    store2 = KVBlockStore(
+        block_tokens=BT, fault_injector=fi.FaultInjector("store_corrupt@2"))
+    src2 = _eng(params, cfg, store2)
+    src2.submit(prompt, 5)
+    src2.run()
+    cold = _eng(params, cfg, store2, warm=True)
+    assert cold.metrics.store_warm_blocks == 1
+    assert cold.metrics.store_quarantined >= 1
+    assert store2.stats()["quarantined"] >= 1
+    h2 = cold.submit(prompt, 5)
+    cold.run()
+    np.testing.assert_array_equal(
+        np.concatenate([prompt, np.asarray(h2.tokens, np.int32)]), want)

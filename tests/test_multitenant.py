@@ -599,11 +599,11 @@ def test_journal_tenant_sideband_survives_compaction(tmp_path):
     j.submit(1, {"max_new_tokens": 3})
     j.assign(1, "r1", 1, 0, tenant="globex")
     j.complete(1, "r1", 1, 0, [5, 6], tenant="globex")
-    assert j.assigned_meta(0) == ("prefill", 2, "acme")
+    assert j.assigned_meta(0) == ("prefill", 2, "acme", None)
     assert j.compact()
     j.close()
     j2 = RequestJournal(jp)
-    assert j2.assigned_meta(0) == ("prefill", 2, "acme")
+    assert j2.assigned_meta(0) == ("prefill", 2, "acme", None)
     j2.close()
     recs = list(RequestJournal._read(jp))
     a0 = [r for r in recs if r["kind"] == "assign" and r["rid"] == 0]

@@ -337,6 +337,179 @@ def test_tenant_sideband_ill_typed_is_j008(tmp_path):
     assert all("ill-typed:tenant" in d.detail for d in diags)
 
 
+# ---------------------------------------------------------------------
+# 1c. the J011 handoff fence (ISSUE 16): every shipped block package
+#     traces to a verified import or a counted fallback
+# ---------------------------------------------------------------------
+
+def _submit_p(rid, prompt):
+    return {"kind": "submit", "rid": rid,
+            "spec": {"max_new": 3, "prompt": list(prompt)}}
+
+
+def test_handoff_sideband_clean(tmp_path):
+    # the lawful shapes: a re-route ships a package and the done
+    # accounts for it (import or counted fallback); absent/null
+    # side-bands (pre-ISSUE-16 journals) stay clean
+    p = _journal(tmp_path, "ho_ok.jsonl", [
+        _submit_p(0, [1, 2, 3, 4]), _assign(0),
+        _progress(0, [7, 8]),
+        dict(_assign(0, replica="r1"),
+             handoff={"len": 4, "digest": "c7f813e9"}),
+        _progress(0, [9], replica="r1"),
+        dict(_done(0, [7, 8, 9], replica="r1"),
+             handoff={"imported": 4, "fallback": False}),
+        # the counted-fallback shape (import failed, re-prefilled)
+        _submit_p(1, [1, 2, 3, 4]), _assign(1),
+        _progress(1, [5]),
+        dict(_assign(1, replica="r1"),
+             handoff={"len": 4, "digest": "00000000"}),
+        _progress(1, [6], replica="r1"),
+        dict(_done(1, [5, 6], replica="r1"),
+             handoff={"imported": 0, "fallback": True}),
+        # pre-ISSUE-16 journals: no side-band anywhere
+        _submit(2), _assign(2), _progress(2, [1]), _done(2, [1]),
+        # explicit nulls are the absent form
+        _submit(3), dict(_assign(3), handoff=None),
+        _progress(3, [2]), dict(_done(3, [2]), handoff=None),
+    ])
+    assert verify_journal(p, expect_closed=True) == []
+
+
+def test_j011_handoff_on_first_assign(tmp_path):
+    # a package on the FIRST assignment has no source replica — the
+    # fabricated-transfer shape
+    p = _journal(tmp_path, "ho_first.jsonl", [
+        _submit_p(0, [1, 2, 3, 4]),
+        dict(_assign(0), handoff={"len": 4, "digest": "deadbeef"}),
+        _progress(0, [7]), _done(0, [7]),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert "J011" in _codes(diags)
+    assert any(d.detail == "handoff:first-assign" for d in diags)
+
+
+def test_j011_handoff_overrun(tmp_path):
+    # the package claims more tokens than the source ever held
+    # (prompt + journaled progress) — blocks it could not have closed
+    p = _journal(tmp_path, "ho_over.jsonl", [
+        _submit_p(0, [1, 2]), _assign(0),
+        _progress(0, [5]),
+        dict(_assign(0, replica="r1"),
+             handoff={"len": 4, "digest": "deadbeef"}),
+        _progress(0, [6], replica="r1"),
+        dict(_done(0, [5, 6], replica="r1"),
+             handoff={"imported": 4, "fallback": False}),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert any(d.code == "J011" and d.detail == "handoff:overrun"
+               for d in diags)
+
+
+def test_j011_handoff_unshipped(tmp_path):
+    # a done claims an import for a transfer that never happened
+    p = _journal(tmp_path, "ho_unship.jsonl", [
+        _submit_p(0, [1, 2, 3, 4]), _assign(0),
+        _progress(0, [7]),
+        dict(_done(0, [7]),
+             handoff={"imported": 4, "fallback": False}),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert _codes(diags) == ["J011"]
+    assert diags[0].detail == "handoff:unshipped"
+
+
+def test_j011_handoff_over_import(tmp_path):
+    # more tokens imported than the package carried
+    p = _journal(tmp_path, "ho_overimp.jsonl", [
+        _submit_p(0, [1, 2, 3, 4]), _assign(0),
+        _progress(0, [7]),
+        dict(_assign(0, replica="r1"),
+             handoff={"len": 4, "digest": "deadbeef"}),
+        _progress(0, [8], replica="r1"),
+        dict(_done(0, [7, 8], replica="r1"),
+             handoff={"imported": 8, "fallback": False}),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert any(d.code == "J011" and d.detail == "handoff:over-import"
+               for d in diags)
+
+
+def test_j011_handoff_unaccounted(tmp_path):
+    # the holder that received a package decodes past its resume
+    # point and reports NOTHING — silence is never an answer
+    p = _journal(tmp_path, "ho_silent.jsonl", [
+        _submit_p(0, [1, 2, 3, 4]), _assign(0),
+        _progress(0, [7]),
+        dict(_assign(0, replica="r1"),
+             handoff={"len": 4, "digest": "deadbeef"}),
+        _progress(0, [8], replica="r1"),
+        _done(0, [7, 8], replica="r1"),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert any(d.code == "J011" and d.detail == "handoff:unaccounted"
+               for d in diags)
+
+
+def test_j011_progress_only_completion_exempt(tmp_path):
+    # a completion recovered purely from journaled progress (no token
+    # decoded after the package-carrying assignment) owes no outcome:
+    # the package was never judged, nothing was laundered
+    p = _journal(tmp_path, "ho_exempt.jsonl", [
+        _submit_p(0, [1, 2, 3, 4]), _assign(0),
+        _progress(0, [7, 8]),
+        dict(_assign(0, replica="r1"),
+             handoff={"len": 4, "digest": "deadbeef"}),
+        _done(0, [7, 8], replica="r1"),
+    ])
+    assert verify_journal(p, expect_closed=True) == []
+
+
+def test_handoff_ill_typed_is_j008(tmp_path):
+    # a bit-rotted side-band is J008 (diagnosed, then ignored by the
+    # fence) — never a KeyError/TypeError out of the DFA
+    p = _journal(tmp_path, "ho_bad.jsonl", [
+        _submit_p(0, [1, 2, 3, 4]), _assign(0),
+        _progress(0, [7]),
+        dict(_assign(0, replica="r1"),
+             handoff={"len": "four", "digest": "deadbeef"}),
+        _progress(0, [8], replica="r1"),
+        dict(_done(0, [7, 8], replica="r1"),
+             handoff={"imported": -2, "fallback": False}),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert _codes(diags) == ["J008", "J008"]
+    assert diags[0].detail == "assign:handoff:len"
+    assert diags[1].detail == "done:handoff:imported"
+
+
+def test_handoff_survives_compaction(tmp_path):
+    # compaction re-emits an open rid's latest assignment WITH its
+    # handoff side-band — dropping it would turn the eventual done's
+    # outcome into a J011 "unshipped" lie
+    p = str(tmp_path / "ho_compact.jsonl")
+    j = RequestJournal(path=p)
+    j.submit(0, {"max_new": 3, "prompt": [1, 2, 3, 4]})
+    j.assign(0, "r0", 1, 0)
+    j.progress(0, "r0", 1, 0, [7, 8])
+    j.assign(0, "r1", 1, 1, handoff={"len": 4, "digest": "c7f813e9"})
+    # churn so compact() has something to drop
+    for rid in (1, 2, 3):
+        j.submit(rid, {"max_new": 1})
+        j.assign(rid, "r0", 1, rid)
+        j.complete(rid, "r0", 1, rid, [5])
+    assert j.compact()
+    # the rid is still open, the re-emitted assignment still ships
+    assert j.assigned_meta(0)[3] == {"len": 4, "digest": "c7f813e9"}
+    j.close()
+    recs = [r for r in RequestJournal._read(p)
+            if r["kind"] == "assign" and r["rid"] == 0]
+    assert recs and recs[-1].get("handoff") \
+        == {"len": 4, "digest": "c7f813e9"}
+    # and the compacted history itself still satisfies the fence
+    assert verify_journal(p) == []
+
+
 def test_explorer_tenant_fairness_smoke_clean(tmp_path):
     # tier-1 smoke over the ISSUE 12 fairness scenario: a tenant
     # burst racing a 4x-weight SLA tenant through the WFQ dispatch
@@ -347,6 +520,26 @@ def test_explorer_tenant_fairness_smoke_clean(tmp_path):
                      max_preemptions=1, max_schedules=6)
     assert report.ok, (report.violation
                        and report.violation.violations)
+
+
+def test_explorer_kv_handoff_race_smoke_clean(tmp_path):
+    # tier-1 smoke over the ISSUE 16 durable-KV scenario: a block
+    # package racing a store eviction on the source and an integrity
+    # trip on the target — the standard probes plus the J011 handoff
+    # fence on every explored journal, and the package side-band must
+    # actually appear (an explored race that never ships a package
+    # proves nothing)
+    report = explore(SCENARIOS["kv_handoff_race"], str(tmp_path),
+                     max_preemptions=1, max_schedules=4)
+    assert report.ok, (report.violation
+                       and report.violation.violations)
+    shipped = 0
+    for name in os.listdir(str(tmp_path)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(str(tmp_path), name)) as f:
+            shipped += ('"handoff": {"len": 2' in f.read())
+    assert shipped, "no explored schedule shipped a block package"
 
 
 def test_torn_final_line_tolerated(tmp_path):

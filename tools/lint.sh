@@ -33,18 +33,20 @@ python -m paddle_tpu.analysis --all "$@"
 # mid-burst, drain-retire racing a completion, rollout swap racing a
 # migration), the multi-tenant fairness race (a tenant burst vs a
 # weighted SLA tenant through the WFQ dispatch hop, with a mid-burst
-# kill), and the integrity trip (a quarantine + taint-aware resume
-# racing a completion handshake and a tier migration) — keep their
-# per-schedule journals, and replay EACH through the journal verifier:
-# a new J-code here (including the J009 version fence, the typed
-# tenant side-band, and the J010 taint fence) fails the gate exactly
-# like a new lint finding
+# kill), the integrity trip (a quarantine + taint-aware resume racing
+# a completion handshake and a tier migration), and the durable-KV
+# handoff race (a block package racing a store eviction on the source
+# and an integrity trip on the target) — keep their per-schedule
+# journals, and replay EACH through the journal verifier: a new J-code
+# here (including the J009 version fence, the typed tenant side-band,
+# the J010 taint fence, and the J011 handoff fence) fails the gate
+# exactly like a new lint finding
 jdir="$(mktemp -d)"
 trap 'rm -rf "$jdir"' EXIT
 python -m paddle_tpu.analysis explore --scenario submit_kill \
     --max-schedules 6 --journal-dir "$jdir"
 for sc in scale_up_mid_burst drain_retire_race rollout_migration \
-        tenant_fairness integrity_trip; do
+        tenant_fairness integrity_trip kv_handoff_race; do
     python -m paddle_tpu.analysis explore --scenario "$sc" \
         --max-schedules 4 --journal-dir "$jdir"
 done
