@@ -3262,6 +3262,303 @@ def bench_serving_kv_handoff(n_requests=None, max_slots=None, dim=None,
     }
 
 
+def bench_serving_frontdoor(dim=None, heads=None, layers_n=None,
+                            vocab=None, max_len=None, max_slots=None,
+                            n_replicas=2, n_warm=None, prompt_len=None,
+                            max_new=None, sweep_duration_s=None,
+                            rate_factors=(0.25, 0.5, 1.0, 2.5),
+                            settle_s=30.0):
+    """Wire-protocol front door acceptance (ISSUE 18): a 2-tenant
+    open-loop load harness against the REAL serving surface — TCP
+    sockets, NDJSON frames, auth -> tenant admission, token streaming
+    — swept to the capacity knee, then kill- and disconnect-drilled.
+
+      warm     one connection, blocking generates — compiles the
+               engine, pins wire-vs-direct output identity (serving
+               through the socket must not change what a request
+               decodes to), and measures a capacity estimate (a
+               saturating concurrent wave straight into the fleet)
+               that anchors the sweep's rates
+      sweep    fixed-seed Poisson arrivals at 0.25x/0.5x/1x/2.5x the
+               estimated capacity, every request streamed; open loop,
+               so past the knee the backlog grows without bound and
+               the fleet's bounded admission sheds it as typed
+               FLEET_SATURATED refusals — `find_knee` must locate a
+               measurable knee (goodput flat vs offered + sheds/p99
+               inflection), hard-raised if the sweep never saturates
+      kill     the chaos variant: the same open-loop load at 0.5x
+               capacity with a replica killed mid-load — >= 1
+               failover, zero lost, zero duplicated, and every
+               streamed request's chunks still concatenate
+               bit-identically to its done frame (the journal-fed
+               stream splice across failover), scored on the TTFT
+               SLO histogram
+      drop     a client opens a long streamed generate and vanishes:
+               the fleet must journal a `cancelled` terminal and
+               free the abandoned stream (disconnect == cancel)
+
+    Hard raises: wire-vs-direct identity; at EVERY swept rate zero
+    stream divergence, zero duplicated rids, zero unresolved requests
+    (a deadline miss must surface as a typed shed, never silence —
+    the well-behaved tenant's bar), zero sheds for the well-behaved
+    tenant at the baseline rate; a located knee; kill-drill failover
+    with lost == duplicate_refused == 0; >= 1 disconnect cancel; and
+    the journal green through the DFA --expect-closed including the
+    cancelled terminal and conn/stream side-bands. All timings are
+    host wall-clock around socket I/O — CPU-honest shape columns
+    (PERF.md), not chip throughput claims."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.diagnostics import format_diag
+    from paddle_tpu.analysis.protocol_lint import verify_journal
+    from paddle_tpu.models import transformer as tlm
+    from paddle_tpu.serving import (FrontDoor, ServingFleet,
+                                    TenantRegistry, WireClient)
+    from paddle_tpu.serving.loadgen import find_knee, run_open_loop
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:  # smoke shape: the knee is relative, the drills absolute
+        dim, heads, layers_n = dim or 32, heads or 4, layers_n or 2
+        vocab, max_len = vocab or 64, max_len or 128
+        max_slots = max_slots or 4
+        n_warm = n_warm or 6
+        prompt_len, max_new = prompt_len or 6, max_new or 8
+        sweep_duration_s = sweep_duration_s or 1.2
+        dtype = jnp.float32
+    else:
+        dim, heads, layers_n = dim or 512, heads or 8, layers_n or 8
+        vocab, max_len = vocab or 32000, max_len or 1024
+        max_slots = max_slots or 8
+        n_warm = n_warm or 8
+        prompt_len, max_new = prompt_len or 24, max_new or 32
+        sweep_duration_s = sweep_duration_s or 3.0
+        dtype = jnp.bfloat16
+
+    cfg = tlm.TransformerConfig(vocab=vocab, dim=dim, heads=heads,
+                                layers=layers_n, max_len=max_len,
+                                dtype=dtype)
+    params = tlm.init_params(cfg, jax.random.PRNGKey(0))
+    treg = TenantRegistry()
+    # generous quotas: the knee must come from the fleet's bounded
+    # admission (FLEET_SATURATED), not a token bucket — quota sheds
+    # have their own bench (serving_multitenant)
+    treg.add("alice", rate=1e6, burst=1e6, weight=3.0)
+    treg.add("bob", rate=1e6, burst=1e6, weight=1.0)
+    auth = {"tok-alice": "alice", "tok-bob": "bob"}
+    tenants = [{"name": "alice", "token": "tok-alice", "weight": 3.0},
+               {"name": "bob", "token": "tok-bob", "weight": 1.0}]
+
+    keep_dir = os.environ.get("PADDLE_TPU_KEEP_JOURNAL_DIR") or None
+    if keep_dir is not None:
+        os.makedirs(keep_dir, exist_ok=True)
+    jpath = tempfile.mktemp(suffix=".jsonl",
+                            prefix="frontdoor_journal_", dir=keep_dir)
+    fleet = ServingFleet(
+        params, cfg, n_replicas=n_replicas, journal_path=jpath,
+        heartbeat_timeout_s=300.0, monitor_interval_s=0.02,
+        max_pending=1 << 16, tenants=treg,
+        engine_kw={"max_slots": max_slots})
+    fd = FrontDoor(fleet, auth=auth).start()
+    rng = np.random.RandomState(0)
+    try:
+        # -- warm + wire-vs-direct identity ---------------------------
+        warm_prompt = rng.randint(1, vocab, prompt_len).astype(np.int32)
+        dh = fleet.submit(warm_prompt, max_new, seed=3, tenant="alice")
+        dh.result(timeout=600)
+        direct = [int(t) for t in dh.tokens]  # generated-only, like
+        # the wire's done.tokens (result() prepends the prompt)
+        wc = WireClient(fd.address, token="tok-alice")
+        got = wc.generate_blocking("warm", warm_prompt, max_new, seed=3,
+                                   stream=True)
+        wc.close()
+        if got["tokens"] != direct:
+            raise RuntimeError(
+                "wire answer diverges from the direct fleet answer "
+                "for the same (prompt, seed): %r vs %r"
+                % (got["tokens"], direct))
+        if [t for c in got["chunks"] for t in c] != got["tokens"]:
+            raise RuntimeError(
+                "warm streamed chunks do not concatenate to the done "
+                "frame: %r vs %r" % (got["chunks"], got["tokens"]))
+        # capacity estimate: a saturating concurrent wave straight
+        # into the fleet (full batching; the open-loop sweep cannot
+        # exceed it, so rates anchored on it bracket the knee). The
+        # FIRST wave pays the batch-shape compiles; only the second,
+        # compile-warm wave is timed — an anchor deflated by compile
+        # time would park the whole sweep under the knee
+        for wave in range(2):
+            hs = [fleet.submit(
+                      rng.randint(1, vocab,
+                                  prompt_len).astype(np.int32),
+                      max_new, seed=100 + 10 * wave + i,
+                      tenant="alice")
+                  for i in range(n_warm)]
+            t0 = time.time()
+            for h in hs:
+                h.result(timeout=600)
+        cap_rps = n_warm / max(time.time() - t0, 1e-6)
+        # size bounded admission so the top swept rate MUST shed: the
+        # open-loop backlog past the knee overflows it by design
+        fleet.max_pending = max(8, int(round(
+            0.5 * cap_rps * sweep_duration_s)))
+
+        # -- open-loop rate sweep to the knee -------------------------
+        rates = [max(2.0, round(f * cap_rps, 2)) for f in rate_factors]
+        reports = []
+        for i, r in enumerate(rates):
+            rep = run_open_loop(
+                fd.address, tenants, r, sweep_duration_s, seed=7 + i,
+                prompt_len=prompt_len, max_new_tokens=max_new,
+                vocab=vocab, stream=True, settle_s=settle_s)
+            if rep["stream_divergent"]:
+                raise RuntimeError(
+                    "rate %.2f rps: %d streamed request(s) diverged "
+                    "from their done frame" % (r, rep["stream_divergent"]))
+            if rep["duplicate_rids"]:
+                raise RuntimeError(
+                    "rate %.2f rps: %d duplicated rid(s) on the wire"
+                    % (r, rep["duplicate_rids"]))
+            if rep["wire_unresolved"]:
+                raise RuntimeError(
+                    "rate %.2f rps: %d request(s) got NO typed verdict "
+                    "(lost on the wire — a deadline miss or shed must "
+                    "be typed, never silent)"
+                    % (r, rep["wire_unresolved"]))
+            reports.append(rep)
+        base = reports[0]["per_tenant"]["alice"]
+        if base["shed"]:
+            raise RuntimeError(
+                "well-behaved tenant shed at the baseline rate "
+                "(%.2fx capacity): %r"
+                % (rate_factors[0], base["shed"]))
+        knee = find_knee(reports)
+        if knee["knee_rate_rps"] is None:
+            raise RuntimeError(
+                "rate sweep exhibited no measurable knee: %s"
+                % knee["reason"])
+
+        # -- kill drill: open-loop load + mid-load replica kill -------
+        fleet.max_pending = 1 << 16   # the drill is about failover,
+        failovers_before = fleet.stats()["failovers"]  # not shedding
+
+        def chaos():
+            with fleet._cond:
+                holders = [i for i, m in enumerate(fleet._in_flight)
+                           if m]
+            fleet.kill_replica(holders[0] if holders else 0)
+
+        kill_rep = run_open_loop(
+            fd.address, tenants, max(2.0, round(0.5 * cap_rps, 2)),
+            sweep_duration_s, seed=31, prompt_len=prompt_len,
+            max_new_tokens=max_new, vocab=vocab, stream=True,
+            deadline_s=float(settle_s), settle_s=settle_s,
+            chaos_after_s=0.3 * sweep_duration_s, chaos_fn=chaos)
+        st = fleet.stats()
+        if st["failovers"] <= failovers_before:
+            raise RuntimeError("kill drill produced no failover")
+        if kill_rep["stream_divergent"]:
+            raise RuntimeError(
+                "kill drill: %d streamed request(s) diverged across "
+                "failover" % kill_rep["stream_divergent"])
+        if kill_rep["wire_unresolved"] or kill_rep["duplicate_rids"]:
+            raise RuntimeError(
+                "kill drill: %d unresolved, %d duplicated rid(s)"
+                % (kill_rep["wire_unresolved"],
+                   kill_rep["duplicate_rids"]))
+        if kill_rep["per_tenant"]["alice"]["shed"].get(
+                "DEADLINE_EXCEEDED"):
+            raise RuntimeError(
+                "kill drill: the well-behaved tenant missed its "
+                "deadline %d time(s) under failover load"
+                % kill_rep["per_tenant"]["alice"]["shed"]
+                ["DEADLINE_EXCEEDED"])
+        if not kill_rep["completed"]:
+            raise RuntimeError("kill drill completed nothing")
+
+        # -- disconnect drill: a streaming client vanishes ------------
+        cancelled_before = fleet.stats()["cancelled"]
+        for attempt in range(5):
+            dc = WireClient(fd.address, token="tok-bob")
+            dc.generate("drop-%d" % attempt,
+                        rng.randint(1, vocab, prompt_len),
+                        8 * max_new, seed=50 + attempt, stream=True)
+            f = dc.recv()
+            while f is not None and f.get("op") != "accepted":
+                f = dc.recv()
+            dc.close()
+            t1 = time.time()
+            while fleet.stats()["cancelled"] <= cancelled_before \
+                    and time.time() - t1 < 10:
+                time.sleep(0.01)
+            if fleet.stats()["cancelled"] > cancelled_before:
+                break
+        st = fleet.stats()
+        if st["cancelled"] <= cancelled_before:
+            raise RuntimeError(
+                "disconnect drill: no request was cancelled (the "
+                "dropped connection's stream was never clawed back)")
+        if st["lost"] or st["duplicate_refused"]:
+            raise RuntimeError(
+                "front door run lost/duplicated requests: %r"
+                % {k: st[k] for k in ("lost", "duplicate_refused")})
+        fd_stats = fd.stats()
+        if not fd_stats["disconnect_cancels"]:
+            raise RuntimeError(
+                "fleet cancelled %d but the front door counted no "
+                "disconnect cancel" % st["cancelled"])
+    finally:
+        fd.close()
+        fleet.close()
+    diags = verify_journal(jpath, expect_closed=True)
+    if diags:
+        raise RuntimeError(
+            "journal DFA violations:\n  %s"
+            % "\n  ".join(format_diag(d) for d in diags))
+    if keep_dir is None:
+        os.unlink(jpath)
+
+    def row(rep):
+        return {k: rep[k] for k in
+                ("rate_rps", "offered_rps", "goodput_rps",
+                 "ttft_p50_s", "ttft_p99_s", "ttft_p999_s",
+                 "itl_p50_s", "itl_p99_s", "completed", "sent",
+                 "shed")}
+
+    return {
+        # the sweep (host wall-clock; shape, not chip throughput)
+        "capacity_est_rps": round(cap_rps, 2),
+        "sweep": [row(r) for r in reports],
+        "knee_rate_rps": knee["knee_rate_rps"],
+        "knee_reason": knee["reason"],
+        "baseline_shed_alice": 0,  # hard-raised above
+        # the kill drill (SLO histogram carries the failover mass)
+        "kill_drill": dict(row(kill_rep),
+                           slo_histogram=kill_rep["slo_histogram"],
+                           per_tenant=kill_rep["per_tenant"]),
+        "kill_failovers": st["failovers"] - failovers_before,
+        # exactly-once + disconnect accounting
+        "requests_lost": st["lost"],
+        "duplicates": st["duplicate_refused"],
+        "cancelled": st["cancelled"],
+        "cancel_late_refused": st["cancel_late_refused"],
+        "disconnect_cancels": fd_stats["disconnect_cancels"],
+        "stream_divergent": 0,      # hard-raised above, every phase
+        "wire_vs_direct_identical": True,
+        "journal_dfa": "green --expect-closed incl. cancelled + "
+                       "conn/stream side-bands (hard-raised)",
+        "frontdoor_stats": fd_stats,
+        "knobs": {"n_replicas": n_replicas, "max_slots": max_slots,
+                  "prompt_len": prompt_len, "max_new": max_new,
+                  "sweep_duration_s": sweep_duration_s,
+                  "rate_factors": list(rate_factors)},
+        "model": {"dim": dim, "heads": heads, "layers": layers_n,
+                  "vocab": vocab, "max_len": max_len},
+    }
+
+
 def bench_input_pipeline(n_shards=4, chunks_per_shard=8,
                          records_per_chunk=64, batch=64, step_s=0.004,
                          decode_sleep_s=0.0001, num_workers=2,
@@ -4083,6 +4380,12 @@ def main():
         # output identity, and the J011 handoff-fence audit are
         # deterministic offline; the warm/cold TTFT contrast on-chip
         run("serving_kv_handoff", bench_serving_kv_handoff)
+        # wire front door (ISSUE 18): open-loop Poisson load over real
+        # sockets swept to the capacity knee + kill/disconnect drills —
+        # stream bit-identity, typed sheds, exactly-once, and the
+        # cancelled-terminal DFA audit are deterministic offline; every
+        # timing is host wall-clock (CPU-honest shape, PERF.md)
+        run("serving_frontdoor", bench_serving_frontdoor)
         run("transformer_lm", bench_transformer_lm)
         # larger-matmul flagship: dim=1024 keeps every matmul MXU-shaped
         # (the dim=512 row leaves lane headroom), so this is the MFU

@@ -10,22 +10,27 @@ wedges) — bugs that all leave a FINGERPRINT in the journal. This module
 machine-checks that fingerprint: it replays a journal file through the
 protocol DFA the fleet promises
 
-    submit -> assign -> progress* -> exactly one of done|rejected|expired
+    submit -> assign -> progress* ->
+        exactly one of done|rejected|expired|cancelled
 
-and reports violations as stable J-codes:
+(`cancelled`, ISSUE 18, is the client's terminal: a dropped wire
+connection or cancel frame — closed like any verdict, and held to the
+same accumulated-progress bar) and reports violations as stable
+J-codes:
 
   J001 orphan-record      assign/progress/terminal for a rid this file
                           never saw submitted
-  J002 duplicate-terminal a second done/rejected/expired for one rid
+  J002 duplicate-terminal a second terminal record for one rid
   J003 record-after-terminal  assign/progress after the rid's verdict
   J004 stale-fence        progress/done carrying a (replica,
                           incarnation, generation) that is not the
                           rid's LATEST assignment — the zombie-holder
                           acceptance the fleet's lease fence must refuse
-  J005 progress-terminal-mismatch  a done/expired record whose tokens
-                          differ from the rid's accumulated journaled
-                          progress (a re-decoded or double-prepended
-                          token: the superseded-report bug class)
+  J005 progress-terminal-mismatch  a done/expired/cancelled record
+                          whose tokens differ from the rid's
+                          accumulated journaled progress (a re-decoded
+                          or double-prepended token: the
+                          superseded-report bug class)
   J006 unassigned-progress  progress from a named replica with no
                           assignment in effect (the restart-resume
                           record `__restart__` and compaction's
@@ -90,16 +95,23 @@ and reports violations as stable J-codes:
                           claims more imported tokens than its
                           assignment's package carried.
 
-Optional side-band fields (ISSUEs 11 + 12 + 16): assign records may
-carry `tier` (prefill/decode disaggregation placement),
+Optional side-band fields (ISSUEs 11 + 12 + 16 + 18): assign records
+may carry `tier` (prefill/decode disaggregation placement),
 `weights_version` (the assignee's weight version), `tenant` (the
 consumer whose quota admitted the request — the multi-tenant
 exactly-once audit groups the journal by it), and `handoff` (the
 ISSUE 16 block-package side-band); done records may carry
-`weights_version`, `tenant`, and `handoff`. Present-but-ill-typed
-side-band fields are J008 like any other field, including the inner
-shape of `handoff` ({"len": int, "digest": str} on assign,
-{"imported": int, "fallback": bool} on done).
+`weights_version`, `tenant`, and `handoff`. ISSUE 18's front door
+adds `conn` (the wire connection id that submitted the request) on
+submit/progress/cancelled records and `stream` on submit (bool: the
+client asked for token streaming) and progress (int: the journal's
+cumulative generated-token count AFTER the record's tokens — the
+stream cursor; it must equal the accumulated progress length, else
+J008, because the streamed prefix is derived from it and a drifted
+cursor means streamed tokens and the journal disagree).
+Present-but-ill-typed side-band fields are J008 like any other field,
+including the inner shape of `handoff` ({"len": int, "digest": str}
+on assign, {"imported": int, "fallback": bool} on done).
 
 A torn FINAL line is tolerated exactly like `RequestJournal._read`
 (the crash the journal exists to survive must not fail its own audit);
@@ -128,7 +140,7 @@ from .diagnostics import Diagnostic, make, rel_path
 
 __all__ = ["verify_journal", "verify_records", "JournalViolation"]
 
-_TERMINAL = ("done", "rejected", "expired")
+_TERMINAL = ("done", "rejected", "expired", "cancelled")
 _KINDS = ("meta", "submit", "assign", "progress", "integrity") + _TERMINAL
 
 # the front-door-restart resume prefix: journaled by submit() before any
@@ -143,6 +155,12 @@ _REQUIRED = {
     "done": ("rid", "replica", "incarnation", "gen", "tokens"),
     "rejected": ("rid", "reason"),
     "expired": ("rid", "tokens"),
+    # ISSUE 18 client-cancel terminal: the submitter walked away (a
+    # dropped wire connection or cancel frame); `tokens` is the
+    # journaled prefix emitted before the cancel — the DFA accepts it
+    # as CLOSED (J007) and holds it to the same accumulated-progress
+    # bar as done/expired (J005)
+    "cancelled": ("rid", "tokens"),
     # ISSUE 15 quarantine record: no rid of its own — `taint` maps
     # rid -> [from, upto) windows over that rid's journaled progress
     "integrity": ("replica", "incarnation", "taint"),
@@ -176,15 +194,47 @@ _FIELD_TYPES = {
     # description on assign, an import outcome on done (nullable: the
     # fleet writes null when no package rode the assignment)
     "handoff": (dict, type(None)),
+    # ISSUE 18 wire side-band: the front-door connection id that owns
+    # the request (submit/progress/cancelled). A restarted front door
+    # groups orphaned streams by this field, so an ill-typed value is
+    # J008 like tenant.
+    "conn": (str, type(None)),
+    # ISSUE 18: `stream` is a BOOL on submit (incremental delivery
+    # requested) and an INT CURSOR on progress (accumulated journaled
+    # length after the delta — what a restarted front door may have
+    # already delivered). bool is accepted where int is only because
+    # the per-kind check below pins the exact shape: a bool cursor on
+    # progress is J008 despite Python's bool-is-int subtyping.
+    "stream": (bool, int, type(None)),
 }
 
 # optional per-kind side-band fields: absent is fine (old journals),
 # present-but-ill-typed is J008 like any required field
 _OPTIONAL = {
+    "submit": ("conn", "stream"),
     "assign": ("tier", "weights_version", "tenant", "handoff"),
+    "progress": ("conn", "stream"),
     "done": ("weights_version", "tenant", "handoff"),
+    "cancelled": ("conn",),
     "integrity": ("reason",),
 }
+
+
+def _bad_stream(rec, kind):
+    """Pin the per-kind shape of a present `stream` side-band: BOOL on
+    submit, non-negative INT (not bool) on progress — `isinstance(True,
+    int)` is True in Python, so the generic type table alone would
+    wave a bool cursor through."""
+    s = rec.get("stream")
+    if s is None:
+        return None
+    if kind == "submit":
+        if not isinstance(s, bool):
+            return "stream"
+    elif kind == "progress":
+        if isinstance(s, bool) or not isinstance(s, int) or s < 0:
+            return "stream"
+    return None
 
 
 def _bad_handoff(rec, kind):
@@ -218,6 +268,9 @@ def _ill_typed(rec, kind):
         if field in rec and not isinstance(rec[field],
                                            _FIELD_TYPES[field]):
             return field
+    bad = _bad_stream(rec, kind)
+    if bad is not None:
+        return bad
     return None
 
 
@@ -541,6 +594,20 @@ def verify_records(records, path_label: str = "<journal>",
                      % (rid, L, hi, st.hwm, st.taint))
             st.progress.extend(rec["tokens"])
             st.hwm = max(st.hwm, len(st.progress))
+            cur = rec.get("stream")
+            if isinstance(cur, int) and not isinstance(cur, bool) \
+                    and cur != len(st.progress):
+                # the wire side-band's one semantic promise (ISSUE
+                # 18): the cursor IS the accumulation after this
+                # delta — what a restarted front door may already
+                # have delivered. A drifting cursor is an ill-shaped
+                # side-band (J008), and acting on it would re-send
+                # or skip streamed tokens.
+                diag("J008", lineno, rid, "stream-cursor",
+                     "progress for rid %d carries stream cursor %d "
+                     "but the accumulated journaled progress is %d "
+                     "token(s) — a resumed stream would re-deliver "
+                     "or skip tokens" % (rid, cur, len(st.progress)))
             continue
         # terminal kinds
         st.state = "terminal"
@@ -619,12 +686,15 @@ def verify_records(records, path_label: str = "<journal>",
                      "the package must be accounted as a verified "
                      "import or a counted fallback, never silence"
                      % (rid, st.assign_handoff["len"]))
-        if kind in ("done", "expired"):
+        if kind in ("done", "expired", "cancelled"):
             # no empty-progress exemption: the fleet journals EVERY
             # emitted token as a progress delta before the terminal
             # (the PR-8 re-decode-zero audit depends on it), so a done
             # with tokens but no journaled progress is exactly the
-            # never-journaled defect this code names
+            # never-journaled defect this code names. `cancelled`
+            # (ISSUE 18) is held to the same bar: its tokens are the
+            # journaled prefix at cancel time, taken under the same
+            # lock the progress mirror updates under
             if list(rec["tokens"]) != st.progress:
                 diag("J005", lineno, rid, "%s-tokens" % kind,
                      "%s tokens for rid %d (%d token(s)) differ from "

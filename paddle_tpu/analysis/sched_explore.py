@@ -391,9 +391,11 @@ class _Ctx(object):
         self.submit_errors: List[BaseException] = []
         self.threads: List[threading.Thread] = []
 
-    def submit(self, prompt, max_new, seed=0, tenant=None):
+    def submit(self, prompt, max_new, seed=0, tenant=None,
+               stream=False, conn=None):
         h = self.fleet.submit(np.asarray(prompt, np.int32), max_new,
-                              seed=seed, slo=None, tenant=tenant)
+                              seed=seed, slo=None, tenant=tenant,
+                              stream=stream, conn=conn)
         self.handles.append((h, list(prompt), seed, max_new))
         return h
 
@@ -409,6 +411,7 @@ class Scenario(object):
     name = "scenario"
     n_replicas = 2
     expect_failures = False  # close-race: EngineFailed verdicts are ok
+    expect_cancelled = False  # ISSUE 18: RequestCancelled verdicts ok
 
     def fleet_kw(self) -> dict:
         return {}
@@ -900,6 +903,101 @@ class KVHandoffRaceScenario(Scenario):
         return out
 
 
+class StreamDisconnectRaceScenario(Scenario):
+    """The ISSUE 18 wire races: two streamed requests; one client
+    cancels (a dropped connection's path) while its LAST token's
+    completion handshake may already be in flight — the
+    cancel-vs-accept race the `_cancelled_rids` fence decides (a late
+    completion must count `cancel_late_refused`, never a duplicate or
+    a resurrection) — and the OTHER request's holder is killed
+    mid-stream, so failover must splice its stream token-exactly (no
+    token re-pushed, none skipped: the `_stream_sent` cursor vs the
+    resumed journal prefix). The streamed buffers are probed against
+    the ScriptEngine oracle; the journal DFA replays the `cancelled`
+    terminal and the conn/stream side-bands on every explored
+    schedule."""
+
+    name = "stream_disconnect_race"
+    n_replicas = 2
+    expect_cancelled = True
+
+    def ops(self):
+        return [
+            ("submit0", _always,
+             lambda c: c.submit([3, 1, 4], 4, seed=21, stream=True,
+                                conn="c0")),
+            ("submit1", _always,
+             lambda c: c.submit([2, 7], 6, seed=22, stream=True,
+                                conn="c1")),
+            ("cancel0", self._near_done0, self._cancel0),
+            ("kill_holder1", self._streaming1, self._kill_holder1),
+        ]
+
+    def _near_done0(self, ctx):
+        # fire once rid0's penultimate token is journaled: the cancel
+        # then races the final-token completion handshake. A deviating
+        # schedule may complete rid0 first — the cancel fires
+        # harmlessly late (fleet.cancel returns False on a done rid)
+        if not ctx.handles:
+            return False
+        h = ctx.handles[0][0]
+        return (h.done
+                or len(ctx.fleet._journal.progress_of(h.rid)) >= 3)
+
+    def _cancel0(self, ctx):
+        ctx.fleet.cancel(ctx.handles[0][0].rid)
+
+    def _streaming1(self, ctx):
+        # rid1 is mid-stream: assigned, with at least one journaled
+        # token but not all of them (or already done — late kill is a
+        # no-op, the harmless-late rule every kill op follows)
+        if len(ctx.handles) < 2:
+            return False
+        h = ctx.handles[1][0]
+        return (h.done
+                or len(ctx.fleet._journal.progress_of(h.rid)) >= 1)
+
+    def _kill_holder1(self, ctx):
+        h = ctx.handles[1][0]
+        if h.done:
+            return
+        a = ctx.fleet._journal.assigned_to(h.rid)
+        if a is None:
+            return
+        ctx.fleet.kill_replica(int(str(a[0])[1:]))
+
+    def check(self, ctx):
+        out = []
+        for h, prompt, seed, max_new in ctx.handles:
+            oracle = script_tokens(prompt, seed, max_new)
+            with h._stream_cv:
+                buf = list(h._stream_buf)
+                closed = h._stream_closed
+            if buf != oracle[:len(buf)]:
+                out.append(
+                    "rid %d streamed prefix diverges from the oracle: "
+                    "buf %r vs %r (a failover re-pushed or skipped a "
+                    "streamed token)" % (h.rid, buf, oracle))
+            if not closed:
+                out.append("rid %d stream never closed" % h.rid)
+            if h.error is None and buf != oracle:
+                out.append(
+                    "rid %d completed but streamed only %d of %d "
+                    "token(s) — stream != result"
+                    % (h.rid, len(buf), len(oracle)))
+        st = ctx.fleet.stats()
+        if st["cancelled"] == 0 and st["completed"] != len(ctx.handles):
+            out.append(
+                "no cancel landed yet completed == %d of %d"
+                % (st["completed"], len(ctx.handles)))
+        if st["duplicate_refused"] != 0:
+            out.append(
+                "duplicate_refused == %d: a cancelled rid's late "
+                "completion was misfiled (cancel_late_refused is the "
+                "only lawful bucket)" % st["duplicate_refused"])
+        return out
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "submit_kill": SubmitKillScenario,
     "demote_route_back": DemoteRouteBackScenario,
@@ -910,6 +1008,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "tenant_fairness": TenantFairnessScenario,
     "integrity_trip": IntegrityTripScenario,
     "kv_handoff_race": KVHandoffRaceScenario,
+    "stream_disconnect_race": StreamDisconnectRaceScenario,
 }
 
 
@@ -1042,15 +1141,25 @@ def run_schedule(scenario: Scenario, decisions: Sequence[str],
             t.join(timeout=_QUIESCE_TIMEOUT_S)
 
     # -- invariant probes ------------------------------------------------
-    from ..serving.fleet import EngineFailed, RequestJournal
+    from ..serving.fleet import (EngineFailed, RequestCancelled,
+                                 RequestJournal)
     for h, prompt, seed, max_new in ctx.handles:
         if not h.done:
             result.violations.append(
                 "rid %d never reached a verdict" % h.rid)
             continue
         if h.error is not None:
-            if not (scenario.expect_failures
-                    and isinstance(h.error, EngineFailed)):
+            if isinstance(h.error, RequestCancelled):
+                # a scripted client-cancel verdict (ISSUE 18): lawful
+                # only where the scenario stages one; its journaled
+                # prefix is still probed by the scenario's check()
+                # and the DFA's J005 bar on the cancelled record
+                if not scenario.expect_cancelled:
+                    result.violations.append(
+                        "rid %d cancelled but the scenario scripts no "
+                        "cancel" % h.rid)
+            elif not (scenario.expect_failures
+                      and isinstance(h.error, EngineFailed)):
                 result.violations.append(
                     "rid %d failed unexpectedly: %r" % (h.rid, h.error))
             continue
@@ -1064,9 +1173,10 @@ def run_schedule(scenario: Scenario, decisions: Sequence[str],
     if st["lost"] != 0:
         result.violations.append(
             "stats()['lost'] == %d (submitted %d, completed %d, "
-            "rejected %d, expired %d, open %d)"
+            "rejected %d, expired %d, cancelled %d, open %d)"
             % (st["lost"], st["submitted"], st["completed"],
-               st["rejected"], st["expired"], st["open"]))
+               st["rejected"], st["expired"], st["cancelled"],
+               st["open"]))
     if st["completed"] > len(ctx.handles):
         result.violations.append(
             "completed %d > %d submitted: a request was answered twice"

@@ -77,6 +77,12 @@ HOT_PATHS = [
     # durable KV (ISSUE 16): serialization/import/spill run on the
     # admission and retire paths right next to the compiled steps
     "paddle_tpu/serving/kv_store.py",
+    # wire front door + load harness (ISSUE 18): pure host-side
+    # threading, but the pump/stream paths feed the compiled steps'
+    # journal flushes — a stray trace-time construct here would stall
+    # every stream, so they're linted with the rest of the hot set
+    "paddle_tpu/serving/frontdoor.py",
+    "paddle_tpu/serving/loadgen.py",
     "paddle_tpu/fluid/executor.py",
     "paddle_tpu/fluid/core/lowering.py",
     # the training sentinel sits ON the step loop next to the jitted

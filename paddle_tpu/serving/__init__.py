@@ -29,10 +29,22 @@ from .fleet import (
     FleetHandle,
     FleetSaturated,
     FleetTimeout,
+    RequestCancelled,
     RequestJournal,
     RolloutAborted,
     ServingFleet,
     save_weights,
+)
+from .frontdoor import FrontDoor
+from .loadgen import LoadReport, find_knee, run_open_loop, sweep
+from .wire import (
+    ERROR_CODES,
+    MAX_FRAME_BYTES,
+    WireClient,
+    WireError,
+    error_code_for,
+    read_frame,
+    send_frame,
 )
 from .kv_blocks import KVBlockAllocator
 from .kv_store import KVBlockStore, make_block_record
@@ -63,4 +75,7 @@ __all__ = ["ServingEngine", "ServingHandle", "ServingMetrics",
            "quantize_params", "dequantize_params", "params_bytes",
            "IntegrityError", "BlockFingerprints", "ServingSentinel",
            "golden_trace", "KVBlockStore", "fold_key", "fp_digest",
-           "make_block_record"]
+           "make_block_record", "RequestCancelled", "FrontDoor",
+           "WireClient", "WireError", "ERROR_CODES", "MAX_FRAME_BYTES",
+           "error_code_for", "read_frame", "send_frame", "LoadReport",
+           "run_open_loop", "sweep", "find_knee"]

@@ -355,6 +355,24 @@ class FleetTimeout(TimeoutError):
         self.tokens_emitted = tokens_emitted
 
 
+class RequestCancelled(RuntimeError):
+    """Terminal CLIENT verdict (ISSUE 18): the request was cancelled
+    by its submitter — a dropped wire connection, an explicit cancel
+    frame, or a direct `ServingFleet.cancel()` call — before the fleet
+    finished it. The journal records a `cancelled` terminal (the DFA
+    accepts it as closed), every engine-side slot and KV block the
+    request held is clawed back through the same cancel path demotion
+    hedging uses, and `tokens` carries the journaled prefix emitted
+    before the cancel landed. Distinct from `expired` (the FLEET's
+    deadline verdict) so shed/SLO metrics never blame the fleet for an
+    abandoned stream."""
+
+    def __init__(self, msg: str, rid=None, tokens=None):
+        super().__init__(msg)
+        self.rid = rid
+        self.tokens = list(tokens) if tokens else []
+
+
 class RolloutAborted(RuntimeError):
     """`roll_weights()` refused to start: the candidate checkpoint
     failed its CRC/metas verification (or no known-good step exists).
@@ -426,6 +444,26 @@ class FleetHandle(object):
         # LIVE replica whose completion is judged against the golden
         # trace instead of the demotion-restore machinery
         self._canary = False
+        # wire/streaming side-band (ISSUE 18): the front-door
+        # connection id this request arrived on (None for direct
+        # Python callers) and whether the caller asked for incremental
+        # delivery. Both journaled on the submit record (typed by the
+        # DFA's J008 rule) so a wire-level FleetTimeout names them.
+        self.conn: Optional[str] = None
+        self.streaming = False
+        # journal-accumulation index already queued to the stream —
+        # written only under the FLEET lock (guarded-by: fleet._cond),
+        # so pushes are ordered exactly like the journal mirror
+        self._stream_sent = 0
+        # delivered-token buffer + close flag; its own leaf lock
+        # (guarded-by: _stream_cv — taken inside fleet._cond at feed
+        # time, never the other way) so iterators never touch the
+        # scheduler lock. Tokens land here only AFTER the journal
+        # records describing them are on disk (the _flush_journal
+        # read-your-writes discipline, same as _event).
+        self._stream_buf: List[int] = []   # guarded-by: _stream_cv
+        self._stream_closed = False        # guarded-by: _stream_cv
+        self._stream_cv = threading.Condition()
         self._fleet = fleet
         self._submit_t = time.monotonic()
         self._event = threading.Event()
@@ -462,8 +500,81 @@ class FleetHandle(object):
         return np.concatenate(
             [self.prompt, np.asarray(self.tokens, np.int32)])
 
+    def _stream_feed(self, tokens: List[int], closing: bool):
+        """Deliver journaled tokens to stream iterators (called by the
+        fleet AFTER the journal flush wrote the records describing
+        them — never under `fleet._cond`). Idempotent past close: a
+        handle swept by close() may see a second deferred close from
+        the flush straggler; once closed, nothing changes."""
+        with self._stream_cv:
+            if self._stream_closed:
+                return
+            if tokens:
+                self._stream_buf.extend(int(t) for t in tokens)
+            if closing:
+                self._stream_closed = True
+            self._stream_cv.notify_all()
 
-_TERMINAL_KINDS = ("done", "rejected", "expired")
+    def stream_chunks(self, timeout: Optional[float] = None):
+        """Incremental delivery (ISSUE 18 / ROADMAP 4a): yield lists
+        of newly journaled generated tokens as the fleet's batched
+        journal flushes land them — one chunk per flushed progress
+        batch, so wire framing rides the journal's own cadence. The
+        concatenation of every chunk is bit-identical to the generated
+        half of `result()` for every request, across failover and
+        migration: chunks are fed from the SAME fenced, exactly-once
+        journal mirror failover resumes from, so a spliced stream is
+        the resumed prefix plus the survivor's deltas — never a
+        re-decoded or interleaved token. Terminal errors (deadline,
+        reject, cancel, fleet death) raise HERE after the delivered
+        prefix, exactly like `result()` would; `timeout` bounds the
+        wait for each NEXT chunk and raises `FleetTimeout` with the
+        fleet's describe context."""
+        sent = 0
+        while True:
+            with self._stream_cv:
+                while (sent >= len(self._stream_buf)
+                        and not self._stream_closed):
+                    if not self._stream_cv.wait(timeout):
+                        ctx = (self._fleet._describe(self.rid)
+                               if self._fleet is not None else {})
+                        raise FleetTimeout(
+                            "stream for request %d idle for %r s: %s "
+                            "(%d token(s) delivered so far)" % (
+                                self.rid, timeout,
+                                ctx.get("describe", "state unknown"),
+                                sent),
+                            rid=self.rid, state=ctx.get("state"),
+                            replica=ctx.get("replica"),
+                            tokens_emitted=ctx.get(
+                                "tokens_emitted", sent))
+                chunk = self._stream_buf[sent:]
+                closed = self._stream_closed
+            if chunk:
+                sent += len(chunk)
+                yield chunk
+            if closed and sent >= len(self._stream_buf):
+                break
+        # the close fed by a terminal always trails its _event/error
+        # publication, so a drained stream can report the verdict
+        if self.error is not None:
+            raise self.error
+
+    def stream(self, timeout: Optional[float] = None):
+        """Per-token view of `stream_chunks()` — yields ints."""
+        for chunk in self.stream_chunks(timeout=timeout):
+            for t in chunk:
+                yield t
+
+    def cancel(self) -> bool:
+        """Client-side cancel (ISSUE 18): ask the fleet to stop this
+        request. Returns False when it already went terminal."""
+        if self._fleet is None:
+            return False
+        return self._fleet.cancel(self.rid)
+
+
+_TERMINAL_KINDS = ("done", "rejected", "expired", "cancelled")
 
 # submit(slo=...)'s "caller said nothing" sentinel: distinguishes the
 # implicit default ("interactive", or the tenant's registered default
@@ -757,11 +868,22 @@ class RequestJournal(object):
         with self._lock:
             return self._max_rid + 1
 
-    def submit(self, rid: int, spec: dict):
+    def submit(self, rid: int, spec: dict,
+               conn: Optional[str] = None, stream: bool = False):
+        """`conn`/`stream` are the wire side-band (ISSUE 18): the
+        front-door connection id the request arrived on and whether
+        the caller asked for incremental delivery — typed by the DFA
+        (J008), absent entirely for direct Python submits so old
+        journals stay valid byte-for-byte."""
+        rec = {"kind": "submit", "rid": rid, "spec": spec}
+        if conn is not None:
+            rec["conn"] = str(conn)
+        if stream:
+            rec["stream"] = True
         with self._lock:
             self._open_specs[rid] = spec
             self._max_rid = max(self._max_rid, rid)
-            self._append({"kind": "submit", "rid": rid, "spec": spec})
+            self._append(rec)
 
     def assign(self, rid: int, replica: str, incarnation: int, gen: int,
                tier: Optional[str] = None,
@@ -896,18 +1018,28 @@ class RequestJournal(object):
 
     def progress(self, rid: int, replica: str, incarnation: int,
                  gen: int, tokens: List[int],
+                 conn: Optional[str] = None, stream: bool = False,
                  defer: bool = False) -> Optional[dict]:
         """Incremental emitted-token record (token-level resume,
         ISSUE 8): `tokens` is the DELTA since the last progress record
         for this rid. Batched by the fleet (one record per scheduler
         handshake, not per token) and flush-deferred like assign —
-        the mirror is what failover resumes from."""
+        the mirror is what failover resumes from. For a STREAMED
+        request (ISSUE 18) the record carries the wire side-band:
+        `conn` and the `stream` CURSOR — the accumulated journaled
+        length after this delta, i.e. exactly how many generated
+        tokens a front door restarted off this file may have already
+        delivered to the client (typed by the DFA's J008 rule)."""
         rec = {"kind": "progress", "rid": rid, "replica": replica,
                "incarnation": incarnation, "gen": gen,
                "tokens": [int(t) for t in tokens]}
+        if conn is not None:
+            rec["conn"] = str(conn)
         with self._lock:
             acc = self._progress.setdefault(rid, [])
             acc.extend(rec["tokens"])
+            if stream:
+                rec["stream"] = len(acc)
             t = self._taint.get(rid)
             if t is not None and len(acc) >= t[3]:
                 # the survivor's re-decode caught up with the taint
@@ -929,6 +1061,22 @@ class RequestJournal(object):
         lateness; `tokens` records what was emitted before expiry."""
         rec = {"kind": "expired", "rid": rid,
                "tokens": [int(t) for t in tokens]}
+        return self._terminal(rid, rec, defer)
+
+    def cancel(self, rid: int, tokens: List[int],
+               conn: Optional[str] = None,
+               defer: bool = False) -> Optional[dict]:
+        """Terminal CLIENT verdict (ISSUE 18): the submitter walked
+        away — a dropped wire connection or an explicit cancel frame.
+        Distinct from `expired` (the fleet's own deadline) and
+        `rejected` (unservable) so abandonment never pollutes shed or
+        SLO metrics; `tokens` records the journaled prefix emitted
+        before the cancel, `conn` the connection that owned the
+        request. The DFA accepts it as closed (J007)."""
+        rec = {"kind": "cancelled", "rid": rid,
+               "tokens": [int(t) for t in tokens]}
+        if conn is not None:
+            rec["conn"] = str(conn)
         return self._terminal(rid, rec, defer)
 
     def write(self, recs: List[dict]):
@@ -1852,6 +2000,12 @@ class ServingFleet(object):
         self._handles: Dict[int, FleetHandle] = {}     # guarded-by: _cond
         self._open: Set[int] = set()                   # guarded-by: _cond
         self._done_rids: Set[int] = set()              # guarded-by: _cond
+        # client-cancelled rids (ISSUE 18): subset of _done_rids, so a
+        # holder's late completion for an abandoned request is counted
+        # as the CANCEL's expected tail, not a duplicate answer — the
+        # kill-drill duplicates==0 bar stays meaningful under
+        # disconnect storms
+        self._cancelled_rids: Set[int] = set()         # guarded-by: _cond
         # journal FILE records produced under the lock (mirror updates
         # are synchronous); flushed by _flush_journal() after release
         # so disk latency never stalls handshakes or the monitor.
@@ -1859,6 +2013,13 @@ class ServingFleet(object):
         # result implies its done record is already written
         self._pending_journal: List[dict] = []         # guarded-by: _cond
         self._pending_events: List[FleetHandle] = []   # guarded-by: _cond
+        # stream deliveries produced under the lock (ISSUE 18): each
+        # entry is (handle, tokens, closing) — fed to the handle's
+        # stream buffer by _flush_journal AFTER the records describing
+        # those tokens are on disk, the same read-your-writes ordering
+        # completion events get
+        self._pending_stream: List[
+            Tuple[FleetHandle, List[int], bool]] = []  # guarded-by: _cond
         # continue past an existing journal's history: a restarted
         # front door appending to the same file must never reuse a rid
         self._next_rid = self._journal.next_rid()      # guarded-by: _cond
@@ -1878,6 +2039,13 @@ class ServingFleet(object):
         # (FleetSaturated) and quota enforcement stay distinguishable
         self.quota_shed = 0                            # guarded-by: _cond
         self.batch_jobs_completed = 0                  # guarded-by: _cond
+        # client cancels (ISSUE 18): terminal verdicts the SUBMITTER
+        # asked for (disconnect / cancel frame) — kept apart from
+        # every fleet-side verdict so stats()['lost'] stays exact; a
+        # holder's late completion for a cancelled rid increments
+        # cancel_late_refused, never duplicate_refused
+        self.cancelled = 0                             # guarded-by: _cond
+        self.cancel_late_refused = 0                   # guarded-by: _cond
         self.resubmitted = 0                           # guarded-by: _cond
         self.failovers = 0                             # guarded-by: _cond
         self.zombie_refused = 0                        # guarded-by: _cond
@@ -2032,7 +2200,8 @@ class ServingFleet(object):
                eos_id=None, seed=0, publish_len=None,
                slo=_SLO_UNSET, deadline_s=None,
                resume_tokens=None, tenant=None,
-               adapter=None) -> FleetHandle:
+               adapter=None, stream=False,
+               conn=None) -> FleetHandle:
         """Journal the request durably, then route it (prefix affinity
         within the SLO class). Raises `FleetSaturated` when
         `max_pending` requests are already open — the shed request is
@@ -2065,7 +2234,15 @@ class ServingFleet(object):
         weighted fair queue (dispatch may defer — a no-live-replica
         failure then lands on the handle instead of raising here),
         and the journal's assign/done records carry the typed
-        `tenant` side-band."""
+        `tenant` side-band.
+
+        `stream=True` (ISSUE 18) arms incremental delivery: the
+        handle's `stream()`/`stream_chunks()` iterators yield tokens
+        as the journal's batched flushes land them, concatenating
+        bit-identically to `result()` across failover/migration.
+        `conn` names the wire connection the request arrived on; both
+        ride the journal's submit record as the typed wire side-band
+        and surface in FleetTimeout's describe context."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
             raise ValueError("empty prompt")
@@ -2162,6 +2339,8 @@ class ServingFleet(object):
             h = self._admit_open_locked(tenant, prompt, spec, slo,
                                         deadline_at)
             rid = h.rid
+            h.streaming = bool(stream)
+            h.conn = None if conn is None else str(conn)
             # WFQ service estimate: the request's token footprint, so
             # a tenant's fair share is proportional to TOKENS of work,
             # not request count
@@ -2171,13 +2350,14 @@ class ServingFleet(object):
         # durable BEFORE routing — and OUTSIDE the fleet lock, so the
         # journal's write+flush never stalls replica handshakes or the
         # monitor behind disk latency
-        self._journal.submit(rid, spec)
+        self._journal.submit(rid, spec, conn=h.conn, stream=h.streaming)
         if resume is not None:
             # the restart prefix rides a progress record ahead of any
             # assignment: a second front-door crash recovers it exactly
             # like tokens journaled the normal way, and lost()/failover
             # concatenate later deltas after it
-            self._journal.progress(rid, "__restart__", -1, 0, resume)
+            self._journal.progress(rid, "__restart__", -1, 0, resume,
+                                   conn=h.conn, stream=h.streaming)
         if self._hook is not None:
             # the close()-race window: the request is durably journaled
             # and open, but not yet routed — a concurrent close() must
@@ -2200,6 +2380,11 @@ class ServingFleet(object):
                         return h
                     h.resume = list(resume)
                     h.emitted = len(resume)
+                    # the restart prefix is already journaled: stream
+                    # it ahead of the assignee's deltas so a resumed
+                    # stream splices token-exactly (same order the
+                    # journal mirror concatenates for failover)
+                    self._stream_queue_locked(h, list(resume))
                     self.resumed_requests += 1
                     self.resumed_tokens += len(resume)
                 if self._wfq is not None:
@@ -2321,6 +2506,64 @@ class ServingFleet(object):
         finally:
             self._flush_journal()
         return h
+
+    def cancel(self, rid: int) -> bool:
+        """Client-side cancel (ISSUE 18): terminally close an open
+        request because its SUBMITTER walked away — the front door
+        calls this when a wire connection drops mid-stream or sends a
+        cancel frame. Journals a `cancelled` terminal (the DFA accepts
+        it as closed), fails the handle with `RequestCancelled`
+        carrying the journaled token prefix, and claws the work back
+        everywhere it might live: the WFQ/inbox copy is dropped before
+        any replica spends a step on it, and an in-flight copy rides
+        the SAME per-replica cancel set demotion hedging uses — the
+        holder's next handshake calls `engine.cancel`, freeing the
+        slot and every KV block the abandoned stream held. Idempotent;
+        returns False once the rid is already terminal. A holder that
+        finishes anyway loses to the `_cancelled_rids` fence in
+        `_accept` (counted `cancel_late_refused`, never a
+        duplicate)."""
+        with self._cond:
+            h = self._handles.get(rid)
+            if h is None or h.done or rid in self._done_rids \
+                    or h._probe or h._canary:
+                return False
+            toks = self._journal.progress_of(rid)
+            self._done_rids.add(rid)
+            self._cancelled_rids.add(rid)
+            self._open.discard(rid)
+            self._handles.pop(rid, None)
+            for i in range(self.max_replicas):
+                if rid in self._in_flight[i]:
+                    del self._in_flight[i][rid]
+                    # engine-side claw-back: the holder consumes this
+                    # at its next handshake and frees slot + KV blocks
+                    self._cancels[i].add(rid)
+                # a routed-but-unclaimed copy: drop it HERE — the
+                # inbox drain in _sync_locked does not re-check
+                # _done_rids, so a stale entry would be assigned
+                try:
+                    self._inbox[i].remove(h)
+                except ValueError:
+                    pass
+            for tb in self._taint_base:
+                tb.pop(rid, None)
+            for cm in self._canary_mark:
+                cm.pop(rid, None)
+            self.cancelled += 1
+            h.error = RequestCancelled(
+                "request %d cancelled by client with %d token(s) "
+                "emitted%s" % (rid, len(toks),
+                               "" if h.conn is None
+                               else " (conn %s)" % h.conn),
+                rid=rid, tokens=toks)
+            self._pending_journal.append(self._journal.cancel(
+                rid, toks, conn=h.conn, defer=True))
+            self._stream_queue_locked(h, [], closing=True)
+            self._pending_events.append(h)
+            self._cond.notify_all()
+        self._flush_journal()
+        return True
 
     def _dispatch_locked(self):
         """Drain the weighted fair queue into replica inboxes while
@@ -2473,14 +2716,38 @@ class ServingFleet(object):
         fired: List[FleetHandle] = []
         with self._flush_lock:
             with self._cond:
-                if not self._pending_journal and not self._pending_events:
+                if not self._pending_journal \
+                        and not self._pending_events \
+                        and not self._pending_stream:
                     return
                 pending, self._pending_journal = self._pending_journal, []
                 fired, self._pending_events = self._pending_events, []
+                streams, self._pending_stream = self._pending_stream, []
             if pending:
                 self._journal.write(pending)
+        # stream deliveries BEFORE completion events: a waiter whose
+        # result() just unblocked must find its stream already closed
+        # (both ride the same flush, so both are read-your-writes)
+        for h, toks, closing in streams:
+            h._stream_feed(toks, closing)
         for h in fired:
             h._event.set()
+
+    def _stream_queue_locked(self, h: FleetHandle, tokens,
+                             closing: bool = False):
+        """Queue journaled tokens (and/or the terminal close) for a
+        streaming handle (caller holds `_cond`): _flush_journal feeds
+        them AFTER the file write. Advances the handle's stream cursor
+        here, under the scheduler lock, so a failover's re-journaled
+        resume prefix — already queued once — is never delivered
+        twice. No-op for non-streaming handles."""
+        if not h.streaming:
+            return
+        toks = [int(t) for t in tokens] if tokens else []
+        if toks:
+            h._stream_sent += len(toks)
+        if toks or closing:
+            self._pending_stream.append((h, toks, closing))
 
     def _reject_locked(self, rid: int, reason: str, error=None,
                        fire: bool = False) -> Optional[FleetHandle]:
@@ -2518,6 +2785,7 @@ class ServingFleet(object):
         if h is not None and not h.done:
             if error is not None:
                 h.error = error
+            self._stream_queue_locked(h, [], closing=True)
             if fire:
                 self._pending_events.append(h)
         return h
@@ -2703,9 +2971,19 @@ class ServingFleet(object):
                 # is from the superseded submission — the mirror the
                 # new holder resumes from must not absorb it
                 continue
-            self._pending_journal.append(self._journal.progress(
+            rec = self._journal.progress(
                 rid, rep.name, rep.incarnation, h.generation, delta,
-                defer=True))
+                conn=h.conn, stream=h.streaming, defer=True)
+            self._pending_journal.append(rec)
+            if h.streaming:
+                # stream exactly the journal's accumulation: the
+                # record's cursor is the accumulated length AFTER this
+                # delta, so indices below the handle's cursor (a taint
+                # window's sanctioned re-decode of already-delivered
+                # tokens) are never pushed twice
+                start = rec["stream"] - len(rec["tokens"])
+                fresh = rec["tokens"][max(0, h._stream_sent - start):]
+                self._stream_queue_locked(h, fresh)
             h.emitted += len(delta)
             if h.ttft_s is None:  # fleet-level TTFT: first journaled token
                 h.ttft_s = time.monotonic() - h._submit_t
@@ -2803,6 +3081,14 @@ class ServingFleet(object):
         if not accepted:
             self.zombie_refused += 1
             return
+        if rid in self._cancelled_rids:
+            # the holder finished work the client already abandoned —
+            # the cancel's expected tail (the engine-side claw-back
+            # races the final steps by design), NOT a duplicate
+            # answer: duplicate_refused must stay 0 under disconnect
+            # drills or the exactly-once bar loses its meaning
+            self.cancel_late_refused += 1
+            return
         if rid in self._done_rids:
             self.duplicate_refused += 1
             return
@@ -2856,6 +3142,12 @@ class ServingFleet(object):
         h.tokens = full
         h.replica = rep.name
         h.weights_version = rep.weights_version
+        # stream tail + close: whatever the cursor has not delivered
+        # yet (the final handshake's tokens ride the done record, not
+        # a progress record) — concatenation lands bit-identical to
+        # result()'s generated half
+        self._stream_queue_locked(h, full[h._stream_sent:],
+                                  closing=True)
         if h.tenant is not None and self._tenants is not None:
             # per-tenant O(1) accounting (ISSUE 12): completion,
             # tokens served, and the latency the tenant actually saw
@@ -2902,6 +3194,9 @@ class ServingFleet(object):
             self._tenants.on_expire(h.tenant)
         self._pending_journal.append(self._journal.expire(
             rid, toks, defer=True))
+        # close (no tokens): the iterator reports DeadlineExceeded
+        # after the delivered prefix, exactly like result()
+        self._stream_queue_locked(h, [], closing=True)
         self._pending_events.append(h)
         self._cond.notify_all()
 
@@ -3035,6 +3330,8 @@ class ServingFleet(object):
         h.emitted = len(toks)
         h.replica = replica
         h.weights_version = wv
+        self._stream_queue_locked(h, toks[h._stream_sent:],
+                                  closing=True)
         if h.tenant is not None and self._tenants is not None:
             self._tenants.on_complete(
                 h.tenant, len(toks),
@@ -4034,7 +4331,9 @@ class ServingFleet(object):
             emitted = len(self._journal.progress_of(rid))
             a = self._journal.assigned_to(rid)
             replica = a[0] if a else None
-            if rid in self._done_rids:
+            if rid in self._cancelled_rids:
+                state = "cancelled"
+            elif rid in self._done_rids:
                 state = "terminal"
             elif any(h.rid == rid for q in self._inbox for h in q):
                 state = "queued"
@@ -4055,8 +4354,25 @@ class ServingFleet(object):
                 desc += ", assigned to %s (incarnation %d, gen %d%s)" % (
                     a[0], a[1], a[2],
                     "" if rep_state is None else ", replica %s" % rep_state)
+            # wire side-band (ISSUE 18 small fix): name the connection
+            # and stream cursor so a wire-level FleetTimeout is
+            # debuggable from the CLIENT side — which socket owns the
+            # stalled request, and how much of the stream it already
+            # has (a delivered-vs-journaled gap points at the wire,
+            # an emitted-vs-budget gap at the fleet)
+            h = self._handles.get(rid)
+            conn = None if h is None else h.conn
+            streaming = bool(h is not None and h.streaming)
+            stream_sent = 0 if h is None else h._stream_sent
+            if conn is not None:
+                desc += ", wire conn %s" % conn
+            if streaming:
+                desc += (", streaming (%d of %d journaled token(s) "
+                         "delivered)" % (stream_sent, emitted))
             return {"state": state, "replica": replica,
-                    "tokens_emitted": emitted, "describe": desc}
+                    "tokens_emitted": emitted, "conn": conn,
+                    "streaming": streaming, "stream_sent": stream_sent,
+                    "describe": desc}
 
     def wait_all(self, timeout: Optional[float] = None) -> bool:
         """Block until no request is open (completed, rejected, or
@@ -4201,8 +4517,13 @@ class ServingFleet(object):
                 "replicas_live": sum(
                     1 for s in self._state if s == _LIVE),
                 "open": len(self._open),
+                # client cancels are terminal verdicts too (ISSUE 18):
+                # folded in so lost==0 stays the exactly-once bar
+                # under disconnect drills
+                "cancelled": self.cancelled,
+                "cancel_late_refused": self.cancel_late_refused,
                 "lost": self.submitted - self.completed - self.rejected
-                - self.expired - len(self._open),
+                - self.expired - self.cancelled - len(self._open),
                 "tokens_out": tokens_out,
                 "prefill_tokens_computed": prefill_tok,
                 "prefix_hit_rate": round(hits / total, 4) if total else None,
@@ -4242,6 +4563,10 @@ class ServingFleet(object):
                         replica=None))
                 if h is not None and not h.done:
                     h._event.set()  # waiters must not block on a dead fleet
+                    # stream iterators must not either: close directly
+                    # (idempotent — the deferred close the reject
+                    # queued is a no-op at the final flush)
+                    h._stream_feed([], True)
             self._open.clear()
             if self._wfq is not None:
                 # queued-but-undispatched entries: their rids were in

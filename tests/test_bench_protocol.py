@@ -715,6 +715,47 @@ def test_serving_kv_handoff_workload_contract():
     assert rec["outputs_identical"], rec
 
 
+@pytest.mark.slow  # ~20s: engine compiles + 2-rate socket sweep +
+# kill/disconnect drills; tier-1 keeps the registration pin below and
+# the ScriptEngine socket drills in test_frontdoor.py
+def test_serving_frontdoor_workload_contract():
+    """ISSUE 18 acceptance: the `serving_frontdoor` row cannot decay
+    into a no-op — on a fixed-seed 2-tenant open-loop sweep over REAL
+    sockets, the wire answer must match the direct fleet answer, the
+    sweep must exhibit a measurable capacity knee (goodput flat vs
+    offered + typed sheds), the kill drill must fail over >= 1
+    replica with zero lost/duplicated rids and zero stream-vs-result
+    divergence, the disconnect drill must claw back >= 1 abandoned
+    stream as a journaled cancel, and the journal must replay green
+    through the DFA --expect-closed including the cancelled terminal
+    (all hard-raised in-bench; the assertions here pin the row's
+    shape). Shrunk knobs: 2 rates bracketing the knee, short windows
+    — the knee is relative, the drills absolute."""
+    rec = bench.bench_serving_frontdoor(sweep_duration_s=0.6,
+                                        rate_factors=(0.25, 2.5))
+    assert rec["knee_rate_rps"] is not None, rec
+    assert rec["requests_lost"] == 0, rec
+    assert rec["duplicates"] == 0, rec
+    assert rec["stream_divergent"] == 0, rec
+    assert rec["kill_failovers"] >= 1, rec
+    assert rec["cancelled"] >= 1, rec
+    assert rec["disconnect_cancels"] >= 1, rec
+    assert rec["wire_vs_direct_identical"], rec
+    assert len(rec["sweep"]) == 2, rec
+    top = rec["sweep"][-1]
+    assert sum(top["shed"].values()) >= 1, rec
+    assert rec["baseline_shed_alice"] == 0, rec
+
+
+def test_serving_frontdoor_registered_in_bench_main():
+    """The workload is wired into bench.main()'s side-workload list
+    (the registration is what lands it in the driver's record)."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert '"serving_frontdoor", bench_serving_frontdoor' in src
+
+
 def test_serving_kv_handoff_registered_in_bench_main():
     """The workload is wired into bench.main()'s side-workload list
     (the registration is what lands it in the driver's record)."""

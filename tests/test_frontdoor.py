@@ -1,0 +1,525 @@
+"""Wire-protocol front door (ISSUE 18): real-socket drills over
+ScriptEngine fleets — fast (no jax compiles), deterministic (the
+script oracle), and unforgiving (exactly-once accounting + the
+journal DFA audit the fleet tests set as the bar).
+
+Layers:
+
+  1. Protocol mechanics — hello/auth, ping, typed BAD_REQUEST for
+     malformed/oversized/unknown frames, duplicate request ids,
+     multi-tenant refusal without a hello.
+  2. Streaming — chunk concatenation bit-identical to `done.tokens`
+     and to the ScriptEngine oracle, including across a mid-stream
+     holder kill (the journal-fed failover splice); the FleetHandle
+     stream() iterator and its FleetTimeout describe context.
+  3. Cancel — explicit cancel frames and disconnect-as-cancel, both
+     journaling a `cancelled` terminal the DFA accepts as closed,
+     with zero lost and zero duplicate_refused.
+  4. Drain — SERVER_DRAINING refusals for new work while in-flight
+     streams finish.
+  5. Load harness — `run_open_loop` under-the-knee smoke (everything
+     completes, nothing unresolved/divergent/duplicated) and
+     `find_knee` on synthetic sweeps.
+
+The SlowScriptEngine (5 ms per decode step) makes mid-stream races
+deterministic: a disconnect or kill lands while the request is
+genuinely in flight, not after a microsecond-long decode finished."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis.protocol_lint import verify_journal
+from paddle_tpu.analysis.sched_explore import ScriptEngine, script_tokens
+from paddle_tpu.serving import (
+    FleetTimeout,
+    FrontDoor,
+    RequestCancelled,
+    ServingFleet,
+    TenantRegistry,
+    WireClient,
+    WireError,
+)
+from paddle_tpu.serving.loadgen import find_knee, run_open_loop
+from paddle_tpu.serving.wire import MAX_FRAME_BYTES, error_code_for
+
+
+class SlowScriptEngine(ScriptEngine):
+    """ScriptEngine with a 5 ms decode step: mid-stream drills need
+    the request to still be running when the race lands."""
+
+    def step(self):
+        time.sleep(0.005)
+        return super().step()
+
+
+def _fleet(tmp_path, factory=ScriptEngine, n_replicas=2, **kw):
+    cfg = type("Cfg", (), {"max_len": 64})()
+    params = {"pos": np.zeros((64, 4), np.float32)}
+    fleet = ServingFleet(
+        params, cfg, n_replicas=n_replicas,
+        journal_path=str(tmp_path / "journal.jsonl"),
+        heartbeat_timeout_s=3600.0, monitor_interval_s=0.001,
+        affinity=False, auto_refill=False, engine_factory=factory,
+        **kw)
+    fleet._idle_wait_s = 0.0005
+    return fleet
+
+
+def _served(tmp_path, factory=ScriptEngine, n_replicas=2,
+            fleet_kw=None, **fd_kw):
+    fleet = _fleet(tmp_path, factory, n_replicas, **(fleet_kw or {}))
+    fd = FrontDoor(fleet, **fd_kw).start()
+    return fleet, fd
+
+
+def _shutdown(fd, fleet):
+    fd.close()
+    fleet.close()
+
+
+# ---------------------------------------------------------------------
+# 1. protocol mechanics
+# ---------------------------------------------------------------------
+
+def test_hello_ping_and_generate_roundtrip(tmp_path):
+    fleet, fd = _served(tmp_path)
+    try:
+        c = WireClient(fd.address)
+        c.send({"op": "ping"})
+        assert c.recv() == {"op": "pong"}
+        got = c.generate_blocking("r1", [3, 1, 4], 5, seed=9)
+        assert got["tokens"] == script_tokens([3, 1, 4], 9, 5)
+        # non-streamed: the answer arrives whole, never as chunks
+        assert got["chunks"] == []
+        assert got["rid"] == 0
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+    assert verify_journal(str(tmp_path / "journal.jsonl"),
+                          expect_closed=True) == []
+
+
+def test_auth_token_maps_to_tenant(tmp_path):
+    treg = TenantRegistry()
+    treg.add("alice", rate=100.0, burst=100.0, weight=1.0)
+    fleet, fd = _served(tmp_path, fleet_kw={"tenants": treg},
+                        auth={"tok-a": "alice"})
+    try:
+        c = WireClient(fd.address, token="tok-a")
+        assert c.tenant == "alice"
+        got = c.generate_blocking("r1", [2, 7], 4, seed=1)
+        assert got["tokens"] == script_tokens([2, 7], 1, 4)
+        c.close()
+        with pytest.raises(WireError) as ei:
+            WireClient(fd.address, token="wrong")
+        assert ei.value.code == "UNAUTHORIZED"
+    finally:
+        _shutdown(fd, fleet)
+
+
+def test_multi_tenant_generate_requires_hello(tmp_path):
+    treg = TenantRegistry()
+    treg.add("alice", rate=100.0, burst=100.0, weight=1.0)
+    fleet, fd = _served(tmp_path, fleet_kw={"tenants": treg},
+                        auth={"tok-a": "alice"})
+    try:
+        c = WireClient(fd.address)  # no hello
+        with pytest.raises(WireError) as ei:
+            c.generate_blocking("r1", [2, 7], 4)
+        assert ei.value.code == "UNAUTHORIZED"
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+
+
+def test_quota_shed_is_typed_with_retry_after(tmp_path):
+    treg = TenantRegistry()
+    treg.add("tiny", rate=0.001, burst=1.0, weight=1.0)
+    fleet, fd = _served(tmp_path, fleet_kw={"tenants": treg},
+                        auth={"tok-t": "tiny"})
+    try:
+        c = WireClient(fd.address, token="tok-t")
+        c.generate_blocking("r1", [2, 7], 4, seed=1)  # spends the burst
+        with pytest.raises(WireError) as ei:
+            c.generate_blocking("r2", [2, 7], 4, seed=1)
+        assert ei.value.code == "TENANT_QUOTA_EXCEEDED"
+        assert ei.value.retry_after_s is not None
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+
+
+def test_malformed_frames_are_typed_bad_request(tmp_path):
+    fleet, fd = _served(tmp_path)
+    try:
+        # unparseable JSON: typed answer, then the connection drops
+        # (resynchronizing a corrupt NDJSON stream is guesswork)
+        c = WireClient(fd.address)
+        c.sock.sendall(b"this is not json\n")
+        err = c.recv()
+        assert err["op"] == "error" and err["code"] == "BAD_REQUEST"
+        assert c.recv() is None  # server closed the connection
+        c.close()
+        # unknown op / unknown generate key / missing id: typed,
+        # connection stays usable
+        c = WireClient(fd.address)
+        c.send({"op": "warp", "id": "x"})
+        assert c.recv()["code"] == "BAD_REQUEST"
+        c.send({"op": "generate", "id": "x", "prompt": [1],
+                "max_new_tokens": 2, "warp_factor": 9})
+        err = c.recv()
+        assert err["code"] == "BAD_REQUEST"
+        assert "warp_factor" in err["message"]
+        c.send({"op": "generate", "prompt": [1], "max_new_tokens": 2})
+        assert c.recv()["code"] == "BAD_REQUEST"
+        got = c.generate_blocking("ok", [5], 3, seed=2)
+        assert got["tokens"] == script_tokens([5], 2, 3)
+        c.close()
+        assert fd.stats()["frames_bad"] == 1
+    finally:
+        _shutdown(fd, fleet)
+
+
+def test_oversized_frame_is_refused(tmp_path):
+    fleet, fd = _served(tmp_path)
+    try:
+        c = WireClient(fd.address)
+        c.sock.sendall(b"x" * (MAX_FRAME_BYTES + 2) + b"\n")
+        err = c.recv()
+        assert err["op"] == "error" and err["code"] == "BAD_REQUEST"
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+
+
+def test_duplicate_request_id_refused(tmp_path):
+    fleet, fd = _served(tmp_path, factory=SlowScriptEngine)
+    try:
+        c = WireClient(fd.address)
+        c.generate("r1", [3, 1, 4], 30, seed=5, stream=True)
+        f = c.recv()
+        assert f["op"] == "accepted"
+        c.generate("r1", [2, 7], 4, seed=1)
+        # frames until the duplicate's error: tokens frames for the
+        # live r1 may interleave
+        while True:
+            f = c.recv()
+            if f["op"] == "error":
+                break
+        assert f["code"] == "BAD_REQUEST"
+        assert "already in flight" in f["message"]
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+
+
+def test_error_code_mapping_is_stable():
+    from paddle_tpu.serving.engine import EngineFailed
+    from paddle_tpu.serving.fleet import (DeadlineExceeded,
+                                          FleetSaturated)
+    from paddle_tpu.serving.tenancy import TenantQuotaExceeded
+
+    assert error_code_for(FleetSaturated("full"))[0] == \
+        "FLEET_SATURATED"
+    exc = TenantQuotaExceeded("spent", retry_after_s=0.25)
+    assert error_code_for(exc) == ("TENANT_QUOTA_EXCEEDED", 0.25)
+    assert error_code_for(DeadlineExceeded("late"))[0] == \
+        "DEADLINE_EXCEEDED"
+    assert error_code_for(RequestCancelled("gone"))[0] == "CANCELLED"
+    assert error_code_for(FleetTimeout("slow"))[0] == "TIMEOUT"
+    assert error_code_for(EngineFailed("dead"))[0] == "ENGINE_FAILED"
+    assert error_code_for(ValueError("bad"))[0] == "BAD_REQUEST"
+    assert error_code_for(RuntimeError("?"))[0] == "INTERNAL"
+
+
+# ---------------------------------------------------------------------
+# 2. streaming
+# ---------------------------------------------------------------------
+
+def test_streamed_chunks_concatenate_to_done(tmp_path):
+    fleet, fd = _served(tmp_path)
+    try:
+        c = WireClient(fd.address)
+        got = c.generate_blocking("r1", [3, 1, 4], 8, seed=5,
+                                  stream=True)
+        flat = [t for ch in got["chunks"] for t in ch]
+        assert flat == got["tokens"] == script_tokens([3, 1, 4], 5, 8)
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+
+
+def test_tokens_frames_carry_cumulative_index(tmp_path):
+    fleet, fd = _served(tmp_path, factory=SlowScriptEngine)
+    try:
+        c = WireClient(fd.address)
+        c.generate("r1", [3, 1, 4], 10, seed=5, stream=True)
+        index_ok, cursor, done = True, 0, None
+        while done is None:
+            f = c.recv()
+            if f["op"] == "tokens":
+                index_ok = index_ok and f["index"] == cursor
+                cursor += len(f["tokens"])
+            elif f["op"] == "done":
+                done = f
+        assert index_ok
+        assert cursor == len(done["tokens"]) == 10
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+
+
+def test_stream_splices_across_failover(tmp_path):
+    """The load-bearing half of ROADMAP 4a: kill the holder
+    mid-stream; the journal-fed resume must splice the stream
+    token-exactly — concatenated chunks bit-identical to done.tokens
+    and to the oracle, nothing re-pushed, nothing skipped."""
+    fleet, fd = _served(tmp_path, factory=SlowScriptEngine)
+    try:
+        c = WireClient(fd.address)
+        res = {}
+
+        def run():
+            res["got"] = c.generate_blocking("r1", [3, 1, 4], 20,
+                                             seed=5, stream=True)
+
+        th = threading.Thread(target=run)
+        th.start()
+        deadline = time.time() + 10
+        holders = []
+        while not holders and time.time() < deadline:
+            with fleet._cond:
+                holders = [i for i, m in enumerate(fleet._in_flight)
+                           if m]
+            time.sleep(0.005)
+        assert holders, "request never reached a replica"
+        fleet.kill_replica(holders[0])
+        th.join(30)
+        got = res["got"]
+        flat = [t for ch in got["chunks"] for t in ch]
+        assert flat == got["tokens"] == script_tokens([3, 1, 4], 5, 20)
+        assert fleet.stats()["failovers"] == 1
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+    assert verify_journal(str(tmp_path / "journal.jsonl"),
+                          expect_closed=True) == []
+
+
+def test_handle_stream_iterator_and_timeout_context(tmp_path):
+    """FleetHandle.stream() per-token view + the satellite-6 describe
+    context: a stream timeout names the wire connection and the
+    delivered-token cursor, so a wedged stream is debuggable from the
+    exception alone."""
+    fleet = _fleet(tmp_path, factory=SlowScriptEngine)
+    try:
+        h = fleet.submit(np.asarray([3, 1, 4], np.int32), 6, seed=5,
+                         stream=True, conn="c9")
+        assert list(h.stream(timeout=30)) == script_tokens(
+            [3, 1, 4], 5, 6)
+        # describe context is wire-aware while the rid is OPEN (the
+        # handle is dropped at its verdict, like every terminal)
+        h2 = fleet.submit(np.asarray([2, 7], np.int32), 40, seed=1,
+                          stream=True, conn="c9")
+        time.sleep(0.03)
+        ctx = fleet._describe(h2.rid)
+        assert ctx["conn"] == "c9"
+        assert ctx["streaming"] is True
+        assert "wire conn c9" in ctx["describe"]
+        h2.result(timeout=30)
+    finally:
+        fleet.close()
+
+
+def test_fleet_timeout_carries_wire_context(tmp_path):
+    fleet = _fleet(tmp_path, factory=SlowScriptEngine)
+    try:
+        h = fleet.submit(np.asarray([3, 1, 4], np.int32), 40, seed=5,
+                         stream=True, conn="c42")
+        with pytest.raises(FleetTimeout) as ei:
+            h.result(timeout=0.02)
+        assert "wire conn c42" in str(ei.value)
+        assert "streaming" in str(ei.value)
+        h.result(timeout=30)
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------
+# 3. cancel: explicit frame + disconnect-as-cancel
+# ---------------------------------------------------------------------
+
+def test_cancel_frame_answers_typed_cancelled(tmp_path):
+    fleet, fd = _served(tmp_path, factory=SlowScriptEngine)
+    try:
+        c = WireClient(fd.address)
+        c.generate("r1", [3, 1, 4], 40, seed=5, stream=True)
+        assert c.recv()["op"] == "accepted"
+        c.cancel("r1")
+        code = None
+        while code is None:
+            f = c.recv()
+            if f["op"] == "error":
+                code = f["code"]
+            elif f["op"] == "done":
+                code = "DONE"  # completion won the race: also lawful
+        assert code in ("CANCELLED", "DONE")
+        st = fleet.stats()
+        assert st["cancelled"] + st["completed"] >= 1
+        assert st["lost"] == 0
+        assert st["duplicate_refused"] == 0
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+    assert verify_journal(str(tmp_path / "journal.jsonl"),
+                          expect_closed=True) == []
+
+
+def test_disconnect_cancels_and_journals_terminal(tmp_path):
+    """Disconnect == cancel: drop the socket mid-stream; the fleet
+    must journal a `cancelled` terminal carrying the connection id,
+    free the request (lost == 0, nothing counted duplicate), and the
+    DFA must accept the journal as CLOSED."""
+    fleet, fd = _served(tmp_path, factory=SlowScriptEngine)
+    jpath = str(tmp_path / "journal.jsonl")
+    try:
+        c = WireClient(fd.address)
+        c.generate("r1", [2, 7, 1], 40, seed=9, stream=True)
+        assert c.recv()["op"] == "accepted"
+        time.sleep(0.03)  # a few journaled tokens, then vanish
+        c.close()
+        deadline = time.time() + 10
+        while fleet.stats()["cancelled"] < 1 \
+                and time.time() < deadline:
+            time.sleep(0.005)
+        st = fleet.stats()
+        assert st["cancelled"] == 1
+        assert st["lost"] == 0
+        assert st["duplicate_refused"] == 0
+        deadline = time.time() + 10
+        while fd.stats()["disconnect_cancels"] < 1 \
+                and time.time() < deadline:
+            time.sleep(0.005)
+        assert fd.stats()["disconnect_cancels"] == 1
+    finally:
+        _shutdown(fd, fleet)
+    assert verify_journal(jpath, expect_closed=True) == []
+    recs = [json.loads(line) for line in open(jpath)]
+    cancelled = [r for r in recs if r["kind"] == "cancelled"]
+    assert len(cancelled) == 1
+    assert cancelled[0]["conn"] == "c0"
+    # the cancelled tokens are the journaled prefix at cancel time
+    # (J005 holds them to the accumulated progress) and the handle's
+    # error carries them too
+    assert st["cancel_late_refused"] in (0, 1)
+
+
+def test_cancelled_handle_raises_request_cancelled(tmp_path):
+    fleet = _fleet(tmp_path, factory=SlowScriptEngine)
+    try:
+        h = fleet.submit(np.asarray([3, 1, 4], np.int32), 40, seed=5,
+                         stream=True, conn="c1")
+        time.sleep(0.03)
+        assert fleet.cancel(h.rid) is True
+        with pytest.raises(RequestCancelled):
+            h.result(timeout=10)
+        # the stream drains its delivered prefix, then reports the
+        # same verdict
+        got = []
+        with pytest.raises(RequestCancelled):
+            for ch in h.stream_chunks(timeout=10):
+                got.extend(ch)
+        oracle = script_tokens([3, 1, 4], 5, 40)
+        assert got == oracle[:len(got)]
+        assert fleet.cancel(h.rid) is False  # already terminal
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------
+# 4. drain
+# ---------------------------------------------------------------------
+
+def test_drain_refuses_new_and_finishes_inflight(tmp_path):
+    fleet, fd = _served(tmp_path, factory=SlowScriptEngine)
+    try:
+        c = WireClient(fd.address)
+        c.generate("r1", [3, 1, 4], 30, seed=5, stream=True)
+        assert c.recv()["op"] == "accepted"
+        drained = {}
+        th = threading.Thread(
+            target=lambda: drained.update(ok=fd.drain(timeout=30)))
+        th.start()
+        deadline = time.time() + 10
+        while not fd.stats()["draining"] and time.time() < deadline:
+            time.sleep(0.002)
+        c.generate("r2", [2, 7], 4, seed=1)
+        # r1's tokens interleave until r2's refusal arrives
+        seen = {}
+        while "err" not in seen or "done" not in seen:
+            f = c.recv()
+            if f["op"] == "error" and f["id"] == "r2":
+                seen["err"] = f
+            elif f["op"] == "done" and f["id"] == "r1":
+                seen["done"] = f
+        assert seen["err"]["code"] == "SERVER_DRAINING"
+        assert seen["done"]["tokens"] == script_tokens([3, 1, 4], 5, 30)
+        th.join(30)
+        assert drained["ok"] is True
+        assert fd.stats()["drain_refused"] == 1
+        c.close()
+    finally:
+        _shutdown(fd, fleet)
+
+
+# ---------------------------------------------------------------------
+# 5. the load harness
+# ---------------------------------------------------------------------
+
+def test_open_loop_under_knee_completes_everything(tmp_path):
+    fleet, fd = _served(tmp_path)
+    try:
+        rep = run_open_loop(
+            fd.address, [{"name": "t0", "token": None}],
+            rate_rps=40.0, duration_s=0.5, seed=0, prompt_len=3,
+            max_new_tokens=4, vocab=19, stream=True, settle_s=20.0)
+        assert rep["completed"] == rep["requests"] == rep["sent"]
+        assert rep["wire_unresolved"] == 0
+        assert rep["stream_divergent"] == 0
+        assert rep["duplicate_rids"] == 0
+        assert rep["ttft_p50_s"] is not None
+        assert sum(rep["slo_histogram"].values()) == rep["completed"]
+    finally:
+        _shutdown(fd, fleet)
+    assert verify_journal(str(tmp_path / "journal.jsonl"),
+                          expect_closed=True) == []
+
+
+def test_open_loop_arrivals_are_deterministic():
+    rng1 = np.random.RandomState(7)
+    rng2 = np.random.RandomState(7)
+    assert list(rng1.exponential(0.1, 8)) == \
+        list(rng2.exponential(0.1, 8))
+
+
+def test_find_knee_on_synthetic_sweep():
+    def rep(rate, goodput, p99, shed):
+        return {"rate_rps": rate, "offered_rps": rate,
+                "goodput_rps": goodput, "ttft_p99_s": p99,
+                "shed": shed}
+
+    sweep = [rep(10, 10.0, 0.01, {}),
+             rep(20, 19.5, 0.012, {}),
+             rep(40, 22.0, 0.25, {"FLEET_SATURATED": 11}),
+             rep(80, 21.0, 0.9, {"FLEET_SATURATED": 50})]
+    knee = find_knee(sweep)
+    assert knee["knee_rate_rps"] == 40
+    assert "shed" in knee["reason"]
+    flat = [rep(10, 10.0, 0.01, {}), rep(20, 19.9, 0.011, {})]
+    assert find_knee(flat)["knee_rate_rps"] is None
+    assert find_knee([])["knee_rate_rps"] is None

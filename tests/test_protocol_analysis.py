@@ -510,6 +510,79 @@ def test_handoff_survives_compaction(tmp_path):
     assert verify_journal(p) == []
 
 
+def test_cancelled_terminal_closes_the_rid(tmp_path):
+    # ISSUE 18: a client-cancel verdict is a first-class close — the
+    # DFA accepts it under --expect-closed, with the wire side-bands
+    # (conn on submit/progress/cancelled, stream flag on submit,
+    # stream cursor on progress) typed and consistent
+    p = _journal(tmp_path, "cancel_ok.jsonl", [
+        dict(_submit(0), conn="c1", stream=True), _assign(0),
+        dict(_progress(0, [5, 9]), conn="c1", stream=2),
+        dict(_progress(0, [4]), conn="c1", stream=3),
+        {"kind": "cancelled", "rid": 0, "tokens": [5, 9, 4],
+         "conn": "c1"},
+    ])
+    assert verify_journal(p, expect_closed=True) == []
+
+
+def test_cancelled_tokens_mismatch_is_j005(tmp_path):
+    # cancelled is held to the same accumulated-progress bar as
+    # done/expired: its tokens are the journaled prefix at cancel time
+    p = _journal(tmp_path, "cancel_j005.jsonl", [
+        _submit(0), _assign(0), _progress(0, [5, 9]),
+        {"kind": "cancelled", "rid": 0, "tokens": [5]},
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert _codes(diags) == ["J005"]
+
+
+def test_record_after_cancelled_is_caught(tmp_path):
+    # cancelled is terminal: a late done for the rid is a second
+    # terminal (J002) — the fleet refuses it (cancel_late_refused),
+    # so one in the journal means the fence was bypassed
+    p = _journal(tmp_path, "cancel_j002.jsonl", [
+        _submit(0), _assign(0), _progress(0, [5]),
+        {"kind": "cancelled", "rid": 0, "tokens": [5]},
+        _done(0, [5]),
+    ])
+    assert "J002" in _codes(verify_journal(p))
+
+
+def test_wire_side_bands_ill_typed_are_j008(tmp_path):
+    # conn must be a string; stream is BOOL on submit and a
+    # non-negative non-bool INT cursor on progress —
+    # isinstance(True, int) is True in Python, so the bool-cursor
+    # case needs its own pin
+    p = _journal(tmp_path, "wire_bad.jsonl", [
+        dict(_submit(0), conn=7),                      # conn not str
+        dict(_submit(1), stream=1),                    # int on submit
+        _submit(2), _assign(2),
+        dict(_progress(2, [5]), stream=True),          # bool cursor
+        dict(_progress(2, [9]), stream=-2),            # negative
+    ])
+    diags = verify_journal(p)
+    assert _codes(diags) == ["J008", "J008", "J008", "J008"]
+    assert diags[0].detail == "submit:ill-typed:conn"
+    assert diags[1].detail == "submit:ill-typed:stream"
+    assert diags[2].detail == "progress:ill-typed:stream"
+    assert diags[3].detail == "progress:ill-typed:stream"
+
+
+def test_stream_cursor_drift_is_j008(tmp_path):
+    # the cursor's one semantic promise: it IS the accumulation after
+    # the record's delta. A drifted cursor would make a resumed front
+    # door re-deliver or skip streamed tokens.
+    p = _journal(tmp_path, "cursor.jsonl", [
+        dict(_submit(0), stream=True), _assign(0),
+        dict(_progress(0, [5, 9]), stream=3),  # accumulation is 2
+        _done(0, [5, 9]),
+    ])
+    diags = verify_journal(p, expect_closed=True)
+    assert _codes(diags) == ["J008"]
+    assert diags[0].detail == "stream-cursor"
+    assert "re-deliver" in diags[0].message
+
+
 def test_explorer_tenant_fairness_smoke_clean(tmp_path):
     # tier-1 smoke over the ISSUE 12 fairness scenario: a tenant
     # burst racing a 4x-weight SLA tenant through the WFQ dispatch
@@ -540,6 +613,20 @@ def test_explorer_kv_handoff_race_smoke_clean(tmp_path):
         with open(os.path.join(str(tmp_path), name)) as f:
             shipped += ('"handoff": {"len": 2' in f.read())
     assert shipped, "no explored schedule shipped a block package"
+
+
+def test_explorer_stream_disconnect_race_smoke_clean(tmp_path):
+    # tier-1 smoke over the ISSUE 18 wire races: a streamed request
+    # cancelled against its final-token completion handshake plus a
+    # mid-stream holder kill — the standard probes (RequestCancelled
+    # lawful only under expect_cancelled, lost == 0, DFA green incl.
+    # the cancelled terminal and conn/stream side-bands) plus the
+    # scenario's stream-buffer-vs-oracle prefix check
+    report = explore(SCENARIOS["stream_disconnect_race"],
+                     str(tmp_path), max_preemptions=1,
+                     max_schedules=6)
+    assert report.ok, (report.violation
+                       and report.violation.violations)
 
 
 def test_torn_final_line_tolerated(tmp_path):

@@ -46,7 +46,8 @@ trap 'rm -rf "$jdir"' EXIT
 python -m paddle_tpu.analysis explore --scenario submit_kill \
     --max-schedules 6 --journal-dir "$jdir"
 for sc in scale_up_mid_burst drain_retire_race rollout_migration \
-        tenant_fairness integrity_trip kv_handoff_race; do
+        tenant_fairness integrity_trip kv_handoff_race \
+        stream_disconnect_race; do
     python -m paddle_tpu.analysis explore --scenario "$sc" \
         --max-schedules 4 --journal-dir "$jdir"
 done
