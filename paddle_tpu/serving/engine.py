@@ -115,7 +115,15 @@ __all__ = ["ServingEngine", "ServingHandle", "EngineFailed",
            "IntegrityError"]
 
 _BANDS = ("tok", "pos", "alive", "temps", "counts", "base_keys",
-          "tables", "limits", "aidx")
+          "tables", "limits", "aidx", "eos")
+
+# bands the compiled decode window ADVANCES on device (ISSUE 19): a
+# host-side event that dirties any of these between dispatch and sync
+# (admission, retirement, cancel, expiry, spec acceptance) means the
+# device copies no longer carry host truth — the async chain must
+# break and re-upload. Everything else in _BANDS is host-truth only
+# (the device never writes it), so uploading those mid-flight is safe.
+_DEVICE_ADVANCED = frozenset(("tok", "pos", "alive", "counts"))
 
 
 class EngineFailed(RuntimeError):
@@ -308,7 +316,8 @@ class ServingEngine(object):
                  kv_quant="none", weight_quant=None,
                  integrity_traps=True, kv_fingerprints=False,
                  integrity_spike_factor=None, kv_store=None,
-                 kv_store_warm=False):
+                 kv_store_warm=False, decode_window=None,
+                 async_dispatch=False):
         self._params = params
         self._cfg = cfg
         # deterministic-exploration seam (ISSUE 9): the fleet threads
@@ -367,6 +376,32 @@ class ServingEngine(object):
         self.spec_draft_len = (
             int(spec_draft_len) if spec_draft_len and int(spec_draft_len) >= 2
             else None)
+        # megabatch decode window (ISSUE 19): K decode iterations
+        # folded into the ONE compiled step (a lax.scan over the plain
+        # decode body) so the host scheduler runs once per K tokens
+        # instead of once per token. K=1 without async dispatch keeps
+        # the exact pre-window step (bit-identical path, same trace).
+        # `async_dispatch` enqueues window N+1 off window N's device
+        # outputs BEFORE syncing N's tokens, hiding host work under
+        # device compute; emission then runs one window behind.
+        dw = 1 if decode_window is None else int(decode_window)
+        if dw < 1:
+            raise ValueError("decode_window must be >= 1 or None")
+        self.decode_window = dw
+        self.async_dispatch = bool(async_dispatch)
+        if self.spec_draft_len is not None \
+                and (dw > 1 or self.async_dispatch):
+            # spec decode is itself a multi-token window with HOST-side
+            # acceptance after every verify — composing it with a
+            # device-side decode window (or deferring its sync) would
+            # need acceptance folded into the scan. Loud refusal
+            # instead of a silently wrong schedule (ISSUE 19 allows
+            # either composition or refusal; this is the refusal).
+            raise ValueError(
+                "spec_draft_len composes with neither decode_window>1 "
+                "nor async_dispatch: speculative acceptance is a host "
+                "decision after every verify step — run spec with "
+                "decode_window=1 and async_dispatch=False")
         # paged-attention kernel selector (ISSUE 13): "fused" runs the
         # Pallas kernels that attend THROUGH the block table
         # (parallel/paged_attention.py — no per-layer gathered view);
@@ -522,6 +557,10 @@ class ServingEngine(object):
         # per-slot adapter-index band (ISSUE 12): which adapter-pool
         # slot each request's q/v deltas gather from (0 = zero adapter)
         self._aidx = np.zeros(S, np.int32)    # guarded-by: scheduler
+        # per-slot EOS id band (ISSUE 19): -1 = no EOS configured. The
+        # compiled decode window retires slots in-loop, so the EOS rule
+        # must live on device too (K=1 sync keeps judging on host).
+        self._eos = np.full(S, -1, np.int32)  # guarded-by: scheduler
         self._n_alloc = np.zeros(S, np.int32)  # table entries >= 0  # guarded-by: scheduler
         self._reserved_tail = np.zeros(S, np.int32)  # guarded-by: scheduler
         self._dev: Dict[str, Any] = {}        # guarded-by: scheduler
@@ -544,7 +583,17 @@ class ServingEngine(object):
         self._deadlines = False               # guarded-by: scheduler
         self._donate = bool(donate)
         self._chunk_fns: Dict[int, Any] = {}
-        self._decode_fn = self._make_decode()
+        # exactly ONE decode trace per engine lifetime, whatever K: the
+        # window engine never builds (so never traces) the plain step,
+        # and vice versa — both carry the trace name "decode_step"
+        self._use_window = dw > 1 or self.async_dispatch
+        self._decode_fn = (None if self._use_window
+                           else self._make_decode())
+        self._window_fn = (self._make_decode_window()
+                           if self._use_window else None)
+        # the one in-flight dispatched-not-yet-synced window record
+        # (async dispatch); sync mode never leaves one pending
+        self._inflight: Optional[dict] = None  # guarded-by: scheduler
         self._verify_fn = (
             self._make_verify() if self.spec_draft_len else None)
         self._cow_fn = None
@@ -641,6 +690,80 @@ class ServingEngine(object):
 
         kw = {"donate_argnums": (1,)} if self._donate else {}
         return jax.jit(_decode, **kw)
+
+    def _make_decode_window(self):
+        """ONE compiled K-token decode window (ISSUE 19): a lax.scan
+        over K iterations of exactly the plain decode body — paged
+        scatter write (PR 13 kernels, PR 14 quant commit-at-open rides
+        the same scatter), greedy/sampled next token on the SAME
+        `fold_in(base_key, count)` schedule (counts advance per live
+        iteration, so sampled outputs are window-invariant), then the
+        device-side retirement rule (`tlm.decode_window_retire`): a
+        slot hitting EOS or budget mid-window emits that final token
+        and parks — its remaining scatter writes resolve to the
+        out-of-range sentinel block and its emitted lane carries -1
+        padding the host discards. PR 15 traps are accumulated PER
+        ITERATION ([K, S] stack), so a trip in iteration j poisons
+        only tokens >= j: the host checks row j before emitting row j.
+        Traced exactly once per engine lifetime under the same
+        "decode_step" trace name as the plain step it replaces."""
+        cfg, metrics = self._cfg, self.metrics
+        K = self.decode_window
+        Lv = self.blocks_per_slot * self.kv_block_tokens
+        kernel = self.paged_kernel  # baked into the one compiled step
+        kv_quant = self.kv_quant
+        deq = self._deq
+        traps = self.integrity_traps
+
+        def _window(params, cache, tables, tok, pos, alive, temps,
+                    counts, base_keys, limits, eos, adapters=None,
+                    aidx=None):
+            metrics.count_trace("decode_step")  # trace-time side effect
+            if deq is not None:  # int8 weights upcast ONCE per window
+                params = deq(params)
+
+            def _iter(carry, _):
+                cache, tok, pos, alive, counts = carry
+                write_pos = jnp.where(alive, pos, jnp.int32(Lv))
+                logits, cache = tlm.paged_decode_step(
+                    params, tok, write_pos, tables, cache, cfg,
+                    adapters=adapters, adapter_idx=aidx, kernel=kernel,
+                    kv_quant=kv_quant,
+                )
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
+                safe_t = jnp.where(temps > 0, temps, 1.0)
+                sampled = jax.vmap(
+                    lambda k, l, t: jax.random.categorical(
+                        k, l.astype(jnp.float32) / t
+                    )
+                )(keys, logits, safe_t).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, greedy)
+                if traps:
+                    trap = tlm.logits_trap(logits) & alive
+                    scale = tlm.logit_amax(logits, alive)
+                else:
+                    trap = jnp.zeros_like(alive)
+                    scale = jnp.float32(0.0)
+                # dead lanes emit -1 padding; a live lane emits its
+                # token even on its retirement iteration (EOS/budget
+                # tokens ARE emitted, exactly like the host _emit rule)
+                emitted = jnp.where(alive, nxt, jnp.int32(-1))
+                live = alive.astype(jnp.int32)
+                nalive, npos = tlm.decode_window_retire(
+                    alive, nxt, pos, limits, eos)
+                ntok = jnp.where(alive, nxt, tok)
+                return ((cache, ntok, npos, nalive, counts + live),
+                        (emitted, trap, scale))
+
+            carry, stacks = jax.lax.scan(
+                _iter, (cache, tok, pos, alive, counts), None, length=K)
+            cache, tok, pos, alive, counts = carry
+            toks, trapw, scalew = stacks  # [K, S], [K, S], [K]
+            return cache, tok, pos, alive, counts, toks, trapw, scalew
+
+        kw = {"donate_argnums": (1,)} if self._donate else {}
+        return jax.jit(_window, **kw)
 
     def _make_verify(self):
         """ONE compiled speculative-verify step: writes every slot's
@@ -1562,6 +1685,7 @@ class ServingEngine(object):
         h.ttft_s = now - h.submit_t
         self.metrics.ttft_s.append(h.ttft_s)
         self.metrics.span("prefill_T%d" % Cb, now - t0)
+        self.metrics.observe_device_interval(t0, now)
         self.metrics.prefills += 1
         self._publish(s, h)
         del self._prefill_state[s]
@@ -1574,6 +1698,9 @@ class ServingEngine(object):
         # its next sampled token is overall index resume_len
         self._counts[s] = h.resume_len
         self._base_keys[s] = np.asarray(jax.random.PRNGKey(h.seed))
+        # device-side EOS judgment for the decode window (-1 = none);
+        # the _mark_dirty() below re-uploads it with everything else
+        self._eos[s] = -1 if h.eos_id is None else int(h.eos_id)
         if self.spec_draft_len is not None:
             # seed the drafting index from the context once (O(T0));
             # _emit keeps it current per token from here on
@@ -1728,8 +1855,15 @@ class ServingEngine(object):
             raise
         # step-latency EWMA INCLUDES the injector tick: an injected
         # gray stall (slow@) is exactly what the fleet's health score
-        # must see here
-        self.metrics.observe_step(time.monotonic() - t0)
+        # must see here. Normalized PER TOKEN (ISSUE 19 satellite): a
+        # K-token window legitimately takes ~K x longer per step and
+        # must not read as a gray stall or shift the fleet's live-
+        # median demotion threshold. The STATIC window size, not the
+        # emitted count — a low-occupancy window still does K
+        # iterations of device work, and dividing by fewer emitted
+        # tokens would make an idle replica read slow (false demotion).
+        self.metrics.observe_step(time.monotonic() - t0,
+                                  tokens=self.decode_window)
         return out
 
     def _step_inner(self) -> bool:
@@ -1753,10 +1887,15 @@ class ServingEngine(object):
             chunks += 1
             progressed = True
 
-        if not self._alive.any():
+        if self._use_window:
+            # window engines must reach _window_phase even with no
+            # host-live slot: a pending async window may still hold
+            # the tokens that retire the last requests
+            if not self._window_phase():
+                return progressed
+        elif not self._alive.any():
             return progressed
-
-        if self.spec_draft_len is not None:
+        elif self.spec_draft_len is not None:
             self._spec_step()
         else:
             self._decode_once()
@@ -1803,7 +1942,9 @@ class ServingEngine(object):
         self._dev["tok"], self._dev["pos"], self._dev["counts"] = (
             nxt_d, pos_d, counts_d)
         self._dirty.difference_update(("tok", "pos", "counts"))
-        self.metrics.span("decode_step", time.monotonic() - t0)
+        t1 = time.monotonic()
+        self.metrics.span("decode_step", t1 - t0)
+        self.metrics.observe_device_interval(t0, t1)
         self.metrics.decode_steps += 1
         self.metrics.occupancy.append(
             float(self._alive.sum()) / self.max_slots
@@ -1813,6 +1954,137 @@ class ServingEngine(object):
         for s in live:
             self._tok[s] = nxt[s]
             self._emit(s, nxt[s])
+
+    # ------------------------------------------------------------------
+    # megabatch decode window (ISSUE 19)
+    # ------------------------------------------------------------------
+    def _can_chain(self) -> bool:
+        """Window N+1 may chain off window N's un-synced device
+        outputs only while the device-advanced bands still carry
+        device truth: any host event since dispatch (admission,
+        retirement, cancel, expiry) dirtied one of them and the chain
+        must break — sync first, re-upload host truth, then dispatch."""
+        return not (self._dirty & _DEVICE_ADVANCED)
+
+    def _window_phase(self) -> bool:
+        """The window engine's decode phase: sync the pending window
+        (if any), keep the async pipeline one window deep, or run one
+        dispatch+sync in-line (sync mode). Returns False only when
+        there is genuinely nothing to do — no live slot AND no pending
+        window (a pending window may still hold the tokens that retire
+        the final requests, so it must sync even with zero host-live
+        slots)."""
+        rec, self._inflight = self._inflight, None
+        if rec is None and not self._alive.any():
+            return False
+        if rec is not None:
+            chained = None
+            if self.async_dispatch and self._alive.any() \
+                    and self._can_chain():
+                # enqueue window N+1 off window N's device outputs
+                # BEFORE syncing N: the emit/schedule work below runs
+                # under N+1's device compute (the whole point)
+                chained = self._dispatch_window(prev=rec)
+            self._sync_window(rec)
+            self._inflight = chained
+            if chained is None and self.async_dispatch \
+                    and self._alive.any():
+                # chain broken by a host event: host truth is current
+                # again post-sync — refill the pipeline this step
+                self._inflight = self._dispatch_window()
+            return True
+        w = self._dispatch_window()
+        if self.async_dispatch:
+            self._inflight = w  # one-step-behind emission: sync next step
+        else:
+            self._sync_window(w)
+        return True
+
+    def _dispatch_window(self, prev=None):
+        """Enqueue one compiled K-token window. `prev` chains this
+        dispatch off the given un-synced window's output bands (host
+        mirrors are one window stale then — the block horizon covers
+        2K positions so the device never writes past the table)."""
+        K = self.decode_window
+        live = np.nonzero(self._alive)[0]
+        horizon = 2 * K if prev is not None else K
+        for s in live:
+            p = int(self._pos[s])
+            # positions < limits-1 are the only ones ever written (the
+            # budget rule parks a slot after its write at limits-2)
+            self._ensure_blocks(
+                s, p, min(p + horizon, int(self._limits[s]) - 1))
+        t0 = time.monotonic()
+        if prev is None:
+            tok_d, pos_d = self._band("tok"), self._band("pos")
+            alive_d, counts_d = self._band("alive"), self._band("counts")
+        else:
+            tok_d, pos_d, alive_d, counts_d = prev["bands"]
+        out = self._window_fn(
+            self._params, self._cache, self._band("tables"), tok_d,
+            pos_d, alive_d, self._band("temps"), counts_d,
+            self._band("base_keys"), self._band("limits"),
+            self._band("eos"),
+            **self._adapter_args(self._band("aidx")),
+        )
+        self._cache = out[0]
+        self.metrics.decode_steps += 1
+        self.metrics.occupancy.append(
+            float(self._alive.sum()) / self.max_slots
+        )
+        return {"bands": out[1:5], "toks": out[5], "traps": out[6],
+                "scales": out[7], "t0": t0,
+                "slots": [(int(s), self._slot_req[int(s)])
+                          for s in live]}
+
+    def _sync_window(self, rec):
+        """Sync one dispatched window and emit its tokens in iteration
+        order. Lane discipline: -1 lanes are parking padding (the slot
+        retired in an earlier iteration) and are discarded; a slot
+        whose handle changed since dispatch (expired, cancelled,
+        re-tenanted) has its remaining lanes discarded too — an
+        expired request keeps its pre-window tokens and nothing more.
+        Integrity rows are judged BEFORE their tokens emit, so a trap
+        tripping in iteration j poisons only tokens >= j (ISSUE 19
+        tentpole rule); all-parked rows are skipped so the spike EWMA
+        never ingests masked zeros."""
+        K = self.decode_window
+        toks = np.asarray(rec["toks"])  # [K, S] — THE sync point
+        t1 = time.monotonic()
+        self.metrics.span("decode_step", t1 - rec["t0"])
+        self.metrics.observe_device_interval(rec["t0"], t1)
+        if self.integrity_traps:
+            traps_w = np.asarray(rec["traps"])
+            scales_w = np.asarray(rec["scales"])
+        for j in range(K):
+            row = toks[j]
+            if self.integrity_traps and (row >= 0).any():
+                self._check_integrity(traps_w[j], scales_w[j],
+                                      "decode window")
+            for s, h in rec["slots"]:
+                if self._slot_req[s] is not h or not self._alive[s]:
+                    continue  # expired/cancelled/re-tenanted: discard
+                t = int(row[s])
+                if t < 0:
+                    continue  # parked lane
+                self._pos[s] += 1  # the token just synced sat at pos
+                self._tok[s] = t
+                self._emit(s, t)
+        # adopt the window's outputs as device truth (steady loop
+        # re-uploads nothing) — but only when the host mirrors agree:
+        # a host-side divergence (fault drills shifting emitted
+        # tokens' EOS judgment, a mid-flight expiry) re-uploads host
+        # truth instead of silently trusting the device schedule
+        ntok, npos, nalive, ncounts = rec["bands"]
+        if (np.array_equal(self._pos, np.asarray(npos))
+                and np.array_equal(self._alive, np.asarray(nalive))
+                and np.array_equal(self._counts, np.asarray(ncounts))
+                and np.array_equal(self._tok, np.asarray(ntok))):
+            self._dev["tok"], self._dev["pos"] = ntok, npos
+            self._dev["alive"], self._dev["counts"] = nalive, ncounts
+            self._dirty.difference_update(_DEVICE_ADVANCED)
+        else:
+            self._mark_dirty("tok", "pos", "alive", "counts")
 
     def _draft_window(self, s: int) -> np.ndarray:
         """Self-drafting by prompt lookup: continue the context's last
@@ -1859,7 +2131,9 @@ class ServingEngine(object):
         if self.integrity_traps:
             self._check_integrity(trap_d, np.asarray(scale_d),
                                   "spec verify")
-        self.metrics.span("spec_verify", time.monotonic() - t0)
+        t1 = time.monotonic()
+        self.metrics.span("spec_verify", t1 - t0)
+        self.metrics.observe_device_interval(t0, t1)
         self.metrics.decode_steps += 1
         self.metrics.occupancy.append(
             float(self._alive.sum()) / self.max_slots
