@@ -1,6 +1,6 @@
 """paddle_tpu.analysis — custom static analyzers for this codebase.
 
-Five engines over one shared diagnostic framework (stable codes,
+Seven engines over one shared diagnostic framework (stable codes,
 file:line anchors, checked-in baseline in `baseline.txt`):
 
   * program verifier  (`program_lint`)  P001-P006 — validates
@@ -20,11 +20,23 @@ file:line anchors, checked-in baseline in `baseline.txt`):
   * schedule explorer (`sched_explore`) — CHESS-lite deterministic
     interleaving enumeration over the fleet's SchedulerHook seam with
     recorded, replayable schedules and invariant probes
+  * band-lifecycle verifier (`band_lint`) B001-B004 — derives the band
+    registry from `engine._BANDS`/`_DEVICE_ADVANCED` and the paged-
+    cache side-bands, then checks every `# band-verb:` annotated
+    lifecycle function propagates every band (COW/serialize/import/
+    resume/…), `_mark_dirty` coverage of host mirror mutations, wire
+    serialize/import schema symmetry, and `_DEVICE_ADVANCED` drift
+  * mesh sharding-spec lint (`shard_lint`) S001-S004 — unbound axis
+    names in PartitionSpec/collectives, shard_map in/out_specs arity
+    vs the wrapped signature, host materialization of mesh-placed
+    values (scheduler-thread aware), and spec-rank overruns
 
 Run everything:  python -m paddle_tpu.analysis --all
 One analyzer:    python -m paddle_tpu.analysis program <entry.py>
                  python -m paddle_tpu.analysis trace [files...]
                  python -m paddle_tpu.analysis locks [paths...]
+                 python -m paddle_tpu.analysis bands [files...]
+                 python -m paddle_tpu.analysis shard [paths...]
                  python -m paddle_tpu.analysis journal <journal.jsonl>
                  python -m paddle_tpu.analysis explore [--scenario X]
 
@@ -71,7 +83,7 @@ def collect_diagnostics(with_programs: bool = True) -> List[Diagnostic]:
     """Run every analyzer over the repo and return the raw findings —
     the ONE assembly point shared by run_all() and the CLI's --all, so
     the tier-1 self-check and the lint gate cannot diverge."""
-    from . import lock_lint, trace_lint
+    from . import band_lint, lock_lint, shard_lint, trace_lint
 
     diags: List[Diagnostic] = []
     if with_programs:
@@ -80,6 +92,8 @@ def collect_diagnostics(with_programs: bool = True) -> List[Diagnostic]:
         diags.extend(verify_entries())
     diags.extend(trace_lint.lint_paths())
     diags.extend(lock_lint.lint_paths())
+    diags.extend(band_lint.lint_paths())
+    diags.extend(shard_lint.lint_paths())
     return diags
 
 
@@ -97,6 +111,7 @@ def run_all(baseline_path: Optional[str] = None,
     # journal (J) entries verify runtime artifacts — out of run_all's
     # scope, never stale here; without programs the P entries are out
     # of scope too (same scoping the CLI applies)
-    scope = ("T", "L") if not with_programs else REPO_SCOPE_CODES
+    scope = ("T", "L", "B", "S") if not with_programs \
+        else REPO_SCOPE_CODES
     stale = [fp for fp in stale if fp[:1] in scope]
     return new, old, stale

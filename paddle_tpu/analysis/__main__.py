@@ -4,6 +4,8 @@
     python -m paddle_tpu.analysis program path/to/entry.py [--fetch NAME]
     python -m paddle_tpu.analysis trace [files...]
     python -m paddle_tpu.analysis locks [files-or-dirs...]
+    python -m paddle_tpu.analysis bands [files...]
+    python -m paddle_tpu.analysis shard [files-or-dirs...]
     python -m paddle_tpu.analysis journal <journal.jsonl> [--expect-closed]
     python -m paddle_tpu.analysis explore [--scenario NAME] [--preemptions K]
                                           [--max-schedules N] [--replay CSV]
@@ -164,6 +166,26 @@ def _cmd_locks(args, baseline, write_baseline) -> int:
                    scope=() if args.paths else ("L",))
 
 
+def _cmd_bands(args, baseline, write_baseline) -> int:
+    from .band_lint import lint_paths
+
+    diags = _lint_args_paths(lint_paths, args.paths)
+    if diags is None:
+        return 2
+    return _report(diags, baseline, write_baseline,
+                   scope=() if args.paths else ("B",))
+
+
+def _cmd_shard(args, baseline, write_baseline) -> int:
+    from .shard_lint import lint_paths
+
+    diags = _lint_args_paths(lint_paths, args.paths)
+    if diags is None:
+        return 2
+    return _report(diags, baseline, write_baseline,
+                   scope=() if args.paths else ("S",))
+
+
 def _cmd_all(args, baseline, write_baseline) -> int:
     from . import collect_diagnostics
     from .diagnostics import REPO_SCOPE_CODES
@@ -275,6 +297,10 @@ def main(argv=None) -> int:
     st.add_argument("paths", nargs="*")
     sl = sub.add_parser("locks", help="lock-discipline lint")
     sl.add_argument("paths", nargs="*")
+    sb = sub.add_parser("bands", help="band-lifecycle verify (B-codes)")
+    sb.add_argument("paths", nargs="*")
+    ss = sub.add_parser("shard", help="mesh sharding-spec lint (S-codes)")
+    ss.add_argument("paths", nargs="*")
     sj = sub.add_parser("journal",
                         help="verify a RequestJournal file (J-codes)")
     sj.add_argument("path")
@@ -314,6 +340,10 @@ def main(argv=None) -> int:
         return _cmd_trace(args, args.baseline, args.write_baseline)
     if args.cmd == "locks":
         return _cmd_locks(args, args.baseline, args.write_baseline)
+    if args.cmd == "bands":
+        return _cmd_bands(args, args.baseline, args.write_baseline)
+    if args.cmd == "shard":
+        return _cmd_shard(args, args.baseline, args.write_baseline)
     if args.cmd == "journal":
         return _cmd_journal(args, args.baseline, args.write_baseline)
     if args.cmd == "explore":

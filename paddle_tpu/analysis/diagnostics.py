@@ -54,6 +54,16 @@ CODES: Dict[str, Tuple[str, str]] = {
     "L002": ("lock-order-inversion", "error"),
     "L003": ("wait-outside-while", "warning"),
     "L004": ("notify-outside-lock", "error"),
+    # band-lifecycle verifier (band_lint.py)
+    "B001": ("band-not-propagated", "error"),
+    "B002": ("dirty-flag-gap", "error"),
+    "B003": ("wire-schema-asymmetry", "error"),
+    "B004": ("device-adoption-drift", "error"),
+    # mesh sharding-spec lint (shard_lint.py)
+    "S001": ("unbound-axis-name", "error"),
+    "S002": ("shard-spec-arity", "error"),
+    "S003": ("host-sync-on-sharded", "error"),
+    "S004": ("spec-rank-mismatch", "error"),
     # journal state-machine verifier (protocol_lint.py) — runs over
     # RequestJournal FILES (runtime artifacts), never in --all
     "J001": ("orphan-record", "error"),
@@ -72,7 +82,7 @@ CODES: Dict[str, Tuple[str, str]] = {
 # scope whose baseline entries a full-scope run may judge stale. The
 # J-codes verify journal FILES the CLI is pointed at explicitly, so a
 # J baseline entry is never stale from --all's point of view.
-REPO_SCOPE_CODES = ("P", "T", "L")
+REPO_SCOPE_CODES = ("P", "T", "L", "B", "S")
 
 
 @dataclass

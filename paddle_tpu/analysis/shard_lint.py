@@ -1,0 +1,540 @@
+"""Mesh sharding-spec lint: static checks on the shard_map/
+PartitionSpec surface before decode goes multi-chip (ISSUE 20).
+
+ROADMAP item 1 moves the paged pool and the one compiled serving step
+onto the device mesh. Every defect class this pass targets is one the
+`parallel/` training stack has already paid for in review rounds, and
+each becomes strictly harder to debug once serving traffic rides the
+mesh: a typo'd axis name raises (or silently replicates) only at trace
+time on real topology, an in_specs tuple that drifted from the wrapped
+function's signature produces a pytree-structure error pages away from
+the edit, and a host materialization of a mesh-placed value stalls
+every chip in the mesh — not one. In the spirit of the static
+interface checking GSPMD/pjit push into tracing time (PAPERS.md), run
+it at lint time instead:
+
+  S001 unbound-axis-name    a string axis name in a PartitionSpec or a
+                            collective (psum/all_gather/ppermute/…)
+                            that no mesh convention or in-file binding
+                            (Mesh(...) names, make_mesh axes dicts,
+                            axis-parameter defaults) declares — the
+                            classic `"modle"` typo that XLA reports as
+                            an unbound axis deep inside tracing
+  S002 shard-spec-arity     shard_map in_specs/out_specs tuple length
+                            vs the wrapped function's signature /
+                            returned tuple — a drifted spec tuple is a
+                            pytree-structure mismatch at trace time
+  S003 host-sync-on-sharded host materialization (np.asarray / .item()
+                            / float()) of a shard_map product, or of
+                            device band state (`self._dev[...]` /
+                            `self._band(...)`) from a `# thread:`
+                            scheduler method — the sharding-aware
+                            extension of T001/T005: on a mesh this
+                            blocks EVERY participating chip
+  S004 spec-rank-mismatch   a PartitionSpec with more entries than the
+                            statically-known rank of the array it
+                            places (device_put/with_sharding_constraint
+                            on a literal-shaped jnp.zeros/ones/reshape)
+                            — longer-than-rank is a hard error JAX only
+                            raises at placement time
+
+Axis-name vocabulary for S001 = the repo's documented mesh conventions
+(parallel/mesh.py: 'data', 'model', 'seq', 'expert', plus the
+'dcn'/'dcn_*' slice-crossing tier and the pipeline stack's 'pipe')
+UNION every name the linted file itself binds: string defaults of
+`axis`/`axis_name`/`*_axis` parameters, Mesh(devices, names) literals,
+and make_mesh/make_hybrid_mesh axes-dict keys. Names flow through
+parameters in this codebase (`def moe(..., axis: str = "expert")`), so
+non-literal axis arguments are out of scope by design — the lint hunts
+literal typos, not dataflow.
+
+Pure AST — no jax import; reuses trace_lint's module index (aliases,
+scopes, call sites) so the two passes cannot disagree on resolution.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic, make, rel_path, walk_python_files
+from .trace_lint import (_Fn, _ModuleIndex, _dotted, _own_stmt_nodes,
+                         _resolve, _sched_roots)
+
+__all__ = ["lint_file", "lint_paths", "DEFAULT_PATHS", "CANONICAL_AXES"]
+
+# the mesh-facing surface; `--all` lints exactly these. The whole
+# parallel/ stack (not just the four ROADMAP-named files) shares the
+# axis/shard_map idioms, and the serving engine is linted from day one
+# so the mesh PR inherits a clean gate instead of installing one.
+DEFAULT_PATHS = [
+    "paddle_tpu/parallel",
+    "paddle_tpu/serving/engine.py",
+]
+
+# parallel/mesh.py's documented axis conventions + the pipeline axis;
+# 'dcn'-prefixed names are the make_hybrid_mesh slice-crossing tier
+CANONICAL_AXES = frozenset(("data", "model", "seq", "expert", "pipe"))
+
+# collective -> index of the positional axis-name operand (the
+# `axis_name` keyword is checked for all of them)
+_COLLECTIVE_AXIS_ARG: Dict[str, int] = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+    "jax.lax.pcast": 1,
+    "jax.lax.axis_index": 0,
+    "jax.lax.axis_size": 0,
+}
+
+_ARRAY_CTORS = {"jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+                "jax.numpy.empty", "numpy.zeros", "numpy.ones",
+                "numpy.full", "numpy.empty"}
+_MATERIALIZERS = {"numpy.asarray", "numpy.array"}
+
+
+def _is_partition_spec(dotted: Optional[str]) -> bool:
+    return dotted is not None and dotted.split(".")[-1] == "PartitionSpec"
+
+
+def _is_shard_map(dotted: Optional[str]) -> bool:
+    return dotted is not None and dotted.split(".")[-1] == "shard_map"
+
+
+def _extend_assign_aliases(tree, index: _ModuleIndex):
+    """Fold module-level `P = PartitionSpec` style rebinds into the
+    alias table (mesh.py's idiom — ImportFrom alone misses it)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Name):
+            src = node.value.id
+            if src in index.aliases:
+                index.aliases[node.targets[0].id] = index.aliases[src]
+
+
+def _axis_strings(node) -> List[Tuple[str, int]]:
+    """(axis-name, lineno) for a string constant or a tuple/list of
+    them — the shapes an axis operand takes."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, node.lineno)]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, e.lineno))
+        return out
+    return []
+
+
+def _axis_vocab(tree, index: _ModuleIndex) -> Set[str]:
+    """Every axis name the file binds, plus the repo conventions."""
+    vocab = set(CANONICAL_AXES)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            args = node.args
+            named = list(zip(reversed(args.posonlyargs + args.args),
+                             reversed(args.defaults)))
+            named += [(a, d) for a, d in zip(args.kwonlyargs,
+                                             args.kw_defaults)
+                      if d is not None]
+            for a, d in named:
+                if a.arg in ("axis", "axis_name") \
+                        or a.arg.endswith("_axis"):
+                    for name, _ in _axis_strings(d):
+                        vocab.add(name)
+        elif isinstance(node, ast.Call):
+            dotted, _ = _dotted(node.func, index.aliases)
+            if dotted and dotted.split(".")[-1] == "Mesh" \
+                    and len(node.args) >= 2:
+                for name, _ in _axis_strings(node.args[1]):
+                    vocab.add(name)
+            if dotted and dotted.split(".")[-1] in (
+                    "make_mesh", "make_hybrid_mesh"):
+                for sub in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    if isinstance(sub, ast.Dict):
+                        for k in sub.keys:
+                            if isinstance(k, ast.Constant) \
+                                    and isinstance(k.value, str):
+                                vocab.add(k.value)
+    return vocab
+
+
+def _scope_qual(scope: Optional[_Fn]) -> str:
+    return scope.qualname if scope is not None else "<module>"
+
+
+# --- S001 --------------------------------------------------------------
+
+def _check_axis_names(index: _ModuleIndex, vocab: Set[str], rel: str,
+                      diags: List[Diagnostic]):
+    for call, scope in index.calls:
+        dotted, known = _dotted(call.func, index.aliases)
+        sites: List[Tuple[str, int]] = []
+        if dotted in _COLLECTIVE_AXIS_ARG and known:
+            pos = _COLLECTIVE_AXIS_ARG[dotted]
+            if len(call.args) > pos:
+                sites += _axis_strings(call.args[pos])
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    sites += _axis_strings(kw.value)
+        elif _is_partition_spec(dotted) and known:
+            for a in call.args:
+                sites += _axis_strings(a)
+        for name, lineno in sites:
+            if name in vocab or name.startswith("dcn"):
+                continue
+            diags.append(make(
+                "S001", rel, lineno, _scope_qual(scope), name,
+                "axis name %r is bound by no mesh convention or "
+                "in-file binding (have: %s) — an unbound axis is a "
+                "trace-time error on real topology, or silent "
+                "replication" % (name, ", ".join(sorted(vocab)))))
+
+
+# --- S002 --------------------------------------------------------------
+
+def _wrapped_fn(call, scope, index: _ModuleIndex) -> Optional[_Fn]:
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Name):
+        return _resolve(target.id, scope, index)
+    if isinstance(target, ast.Lambda):
+        for fn in index.all_fns:
+            if fn.node is target:
+                return fn
+    return None
+
+
+def _return_arity(fn: _Fn) -> Optional[int]:
+    """Length of the wrapped function's returned tuple when every
+    return is a tuple literal of one consistent length, else None."""
+    if isinstance(fn.node, ast.Lambda):
+        return len(fn.node.body.elts) \
+            if isinstance(fn.node.body, ast.Tuple) else None
+    arity: Optional[int] = None
+    for sub in _own_stmt_nodes(fn.node):
+        if not isinstance(sub, ast.Return) or sub.value is None:
+            continue
+        if not isinstance(sub.value, ast.Tuple):
+            return None
+        n = len(sub.value.elts)
+        if arity is not None and arity != n:
+            return None
+        arity = n
+    return arity
+
+
+def _check_shard_map_arity(index: _ModuleIndex, rel: str,
+                           diags: List[Diagnostic]):
+    for call, scope in index.calls:
+        dotted, known = _dotted(call.func, index.aliases)
+        if not (_is_shard_map(dotted) and known):
+            continue
+        fn = _wrapped_fn(call, scope, index)
+        if fn is None:
+            continue
+        in_specs = out_specs = None
+        for kw in call.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+            elif kw.arg == "out_specs":
+                out_specs = kw.value
+        has_vararg = fn.node.args.vararg is not None
+        if isinstance(in_specs, ast.Tuple) and not has_vararg \
+                and not any(isinstance(e, ast.Starred)
+                            for e in in_specs.elts):
+            n_specs = len(in_specs.elts)
+            n_params = len(fn.arg_order)
+            n_required = n_params - len(fn.defaults)
+            if not (n_required <= n_specs <= n_params):
+                diags.append(make(
+                    "S002", rel, call.lineno, _scope_qual(scope),
+                    "in_specs:%s" % fn.qualname,
+                    "shard_map in_specs has %d entries but %r takes "
+                    "%s positional argument%s — the spec tuple and the "
+                    "signature have drifted (pytree-structure error at "
+                    "trace time)"
+                    % (n_specs, fn.qualname,
+                       str(n_params) if n_required == n_params
+                       else "%d-%d" % (n_required, n_params),
+                       "" if n_params == 1 else "s")))
+        if isinstance(out_specs, ast.Tuple) \
+                and not any(isinstance(e, ast.Starred)
+                            for e in out_specs.elts):
+            ret = _return_arity(fn)
+            if ret is not None and ret != len(out_specs.elts):
+                diags.append(make(
+                    "S002", rel, call.lineno, _scope_qual(scope),
+                    "out_specs:%s" % fn.qualname,
+                    "shard_map out_specs has %d entries but %r "
+                    "returns a %d-tuple"
+                    % (len(out_specs.elts), fn.qualname, ret)))
+
+
+# --- S003 --------------------------------------------------------------
+
+def _names_in_targets(targets) -> List[str]:
+    out: List[str] = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Name):
+            out.append(t.id)
+    return out
+
+
+def _check_host_sync(index: _ModuleIndex, rel: str,
+                     diags: List[Diagnostic]):
+    """S003(a): np.asarray/.item()/float() on a value produced by a
+    shard_map-wrapped callable, per function scope."""
+    for fn in index.all_fns:
+        if getattr(fn, "is_class", False):
+            continue
+        wrapped: Set[str] = set()
+        placed: Set[str] = set()
+        assigns = [sub for sub in _own_stmt_nodes(fn.node)
+                   if isinstance(sub, ast.Assign)
+                   and isinstance(sub.value, ast.Call)]
+        # two passes: the walk is not source-ordered, so bind the
+        # shard_map wrappers before attributing their call products
+        for sub in assigns:
+            dotted, known = _dotted(sub.value.func, index.aliases)
+            if _is_shard_map(dotted) and known:
+                wrapped.update(_names_in_targets(sub.targets))
+        for sub in assigns:
+            val = sub.value
+            if isinstance(val.func, ast.Name) and val.func.id in wrapped:
+                placed.update(_names_in_targets(sub.targets))
+            elif isinstance(val.func, ast.Call):
+                d2, k2 = _dotted(val.func.func, index.aliases)
+                if _is_shard_map(d2) and k2:
+                    placed.update(_names_in_targets(sub.targets))
+        if not placed:
+            continue
+        for sub in _own_stmt_nodes(fn.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            dotted, known = _dotted(f, index.aliases)
+            hit = None
+            if ((dotted in _MATERIALIZERS and known)
+                    or (isinstance(f, ast.Name) and f.id == "float"
+                        and "float" not in index.aliases)) \
+                    and sub.args \
+                    and isinstance(sub.args[0], ast.Name) \
+                    and sub.args[0].id in placed:
+                hit = sub.args[0].id
+            elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in placed:
+                hit = f.value.id
+            if hit is not None:
+                diags.append(make(
+                    "S003", rel, sub.lineno, fn.qualname, hit,
+                    "host materialization of %r, a shard_map product "
+                    "— on a mesh this blocks every participating "
+                    "chip, not one device" % hit))
+
+
+def _mentions_device_band(node) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "_dev":
+            return True
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr == "_band":
+            return True
+    return False
+
+
+def _check_sched_materialize(tree, src: str, index: _ModuleIndex,
+                             rel: str, diags: List[Diagnostic]):
+    """S003(b): a `# thread:` scheduler method (or anything it reaches
+    in-class — T005's closure) materializing device band state. Today
+    the bands live on one chip; after the mesh PR the same line stalls
+    the whole mesh, so the gate predates the sharding."""
+    src_lines = src.splitlines()
+    for cls_node in tree.body:
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        roots = _sched_roots(cls_node, src_lines)
+        if not roots:
+            continue
+        methods = {
+            item.name: item for item in cls_node.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        calls: Dict[str, Set[str]] = {}
+        for name, node in methods.items():
+            out: Set[str] = set()
+            for sub in _own_stmt_nodes(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "self"
+                        and sub.func.attr in methods):
+                    out.add(sub.func.attr)
+            calls[name] = out
+        reach: Set[str] = set()
+        frontier = list(roots)
+        while frontier:
+            name = frontier.pop()
+            if name in reach:
+                continue
+            reach.add(name)
+            frontier.extend(calls.get(name, ()))
+        for name in sorted(reach):
+            node = methods[name]
+            qual = "%s.%s" % (cls_node.name, name)
+            tainted: Set[str] = set()
+            for sub in _own_stmt_nodes(node):
+                if isinstance(sub, ast.Assign) \
+                        and _mentions_device_band(sub.value):
+                    tainted.update(_names_in_targets(sub.targets))
+            for sub in _own_stmt_nodes(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                dotted, known = _dotted(f, index.aliases)
+                is_mat = (dotted in _MATERIALIZERS and known) \
+                    or (isinstance(f, ast.Name) and f.id == "float"
+                        and "float" not in index.aliases)
+                is_item = isinstance(f, ast.Attribute) \
+                    and f.attr == "item"
+                if not (is_mat or is_item):
+                    continue
+                probe = sub.args[0] if (is_mat and sub.args) else \
+                    (f.value if is_item else None)
+                if probe is None:
+                    continue
+                dirty = _mentions_device_band(probe) or any(
+                    isinstance(n, ast.Name) and n.id in tainted
+                    for n in ast.walk(probe))
+                if dirty:
+                    diags.append(make(
+                        "S003", rel, sub.lineno, qual, "_dev",
+                        "scheduler-thread materialization of device "
+                        "band state: a '# thread:' loop that blocks "
+                        "on the mesh stalls every chip behind one "
+                        "host round-trip"))
+
+
+# --- S004 --------------------------------------------------------------
+
+def _literal_rank(node, ranks: Dict[str, int],
+                  index: _ModuleIndex) -> Optional[int]:
+    """Statically-known rank of an expression: a tracked Name, a
+    jnp.zeros/ones/full/empty literal-shape call, or .reshape(...)."""
+    if isinstance(node, ast.Name):
+        return ranks.get(node.id)
+    if isinstance(node, ast.Call):
+        dotted, known = _dotted(node.func, index.aliases)
+        if dotted in _ARRAY_CTORS and known and node.args:
+            shape = node.args[0]
+            if isinstance(shape, ast.Tuple):
+                if any(isinstance(e, ast.Starred) for e in shape.elts):
+                    return None
+                return len(shape.elts)
+            if isinstance(shape, ast.Constant) \
+                    and isinstance(shape.value, int):
+                return 1
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "reshape":
+            args = node.args
+            if len(args) == 1 and isinstance(args[0], ast.Tuple):
+                if any(isinstance(e, ast.Starred)
+                       for e in args[0].elts):
+                    return None
+                return len(args[0].elts)
+            if args and not any(isinstance(a, ast.Starred)
+                                for a in args):
+                return len(args)
+    return None
+
+
+def _spec_entry_count(node, index: _ModuleIndex) -> Optional[int]:
+    """Number of dimension entries in a P(...)/PartitionSpec(...) call
+    or a NamedSharding(mesh, P(...)) wrapper; None when not literal."""
+    if not isinstance(node, ast.Call):
+        return None
+    dotted, known = _dotted(node.func, index.aliases)
+    if dotted and dotted.split(".")[-1] == "NamedSharding" \
+            and len(node.args) >= 2:
+        return _spec_entry_count(node.args[1], index)
+    if _is_partition_spec(dotted) and known:
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            return None
+        return len(node.args)
+    return None
+
+
+def _check_spec_rank(index: _ModuleIndex, rel: str,
+                     diags: List[Diagnostic]):
+    for fn in index.all_fns:
+        if getattr(fn, "is_class", False):
+            continue
+        ranks: Dict[str, int] = {}
+        for sub in _own_stmt_nodes(fn.node):
+            if isinstance(sub, ast.Assign):
+                r = _literal_rank(sub.value, ranks, index)
+                if r is not None:
+                    for name in _names_in_targets(sub.targets):
+                        ranks[name] = r
+        for sub in _own_stmt_nodes(fn.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted, known = _dotted(sub.func, index.aliases)
+            is_put = dotted in ("jax.device_put",) and known
+            is_constraint = dotted is not None and known and \
+                dotted.split(".")[-1] == "with_sharding_constraint"
+            if not (is_put or is_constraint) or len(sub.args) < 2:
+                continue
+            rank = _literal_rank(sub.args[0], ranks, index)
+            n_spec = _spec_entry_count(sub.args[1], index)
+            if rank is None or n_spec is None or n_spec <= rank:
+                continue
+            diags.append(make(
+                "S004", rel, sub.lineno, fn.qualname,
+                "rank%d-spec%d" % (rank, n_spec),
+                "PartitionSpec names %d dimensions but the array has "
+                "statically-known rank %d — placement raises on real "
+                "topology only" % (n_spec, rank)))
+
+
+# --- entry points ------------------------------------------------------
+
+def lint_file(path: str) -> List[Diagnostic]:
+    with open(path) as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    index = _ModuleIndex(tree)
+    _extend_assign_aliases(tree, index)
+    rel = rel_path(path)
+    vocab = _axis_vocab(tree, index)
+    diags: List[Diagnostic] = []
+    _check_axis_names(index, vocab, rel, diags)
+    _check_shard_map_arity(index, rel, diags)
+    _check_host_sync(index, rel, diags)
+    _check_sched_materialize(tree, src, index, rel, diags)
+    _check_spec_rank(index, rel, diags)
+    diags.sort(key=lambda d: (d.path, d.line, d.code, d.detail))
+    return diags
+
+
+def lint_paths(paths=None) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for f in walk_python_files(paths, DEFAULT_PATHS):
+        diags.extend(lint_file(f))
+    return diags

@@ -77,6 +77,10 @@ HOT_PATHS = [
     # durable KV (ISSUE 16): serialization/import/spill run on the
     # admission and retire paths right next to the compiled steps
     "paddle_tpu/serving/kv_store.py",
+    # KV/weight quantization (ISSUE 14): the quant/dequant helpers are
+    # traced inside the compiled serving steps — a host sync here runs
+    # per block per step
+    "paddle_tpu/serving/quantization.py",
     # wire front door + load harness (ISSUE 18): pure host-side
     # threading, but the pump/stream paths feed the compiled steps'
     # journal flushes — a stray trace-time construct here would stall

@@ -876,7 +876,7 @@ class ServingEngine(object):
         self._chunk_fns[Cb] = fn
         return fn
 
-    def _make_cow(self):
+    def _make_cow(self):  # band-verb: cow
         """Copy-on-write: privatise one shared block before the suffix
         writes into it. ONE compiled shape total (fixed block size) —
         the only device copy left in the reuse path; plain aliasing
@@ -1014,7 +1014,7 @@ class ServingEngine(object):
     # ------------------------------------------------------------------
     # durable KV tier (ISSUE 16)
     # ------------------------------------------------------------------
-    def _serialize_block(self, bid: int):
+    def _serialize_block(self, bid: int):  # band-verb: serialize
         """Flatten one physical block across every layer and band into
         (payload bytes, meta rows). Meta rows are ("li.band", dtype,
         shape-per-block) in the SAME sorted-band order
@@ -1033,7 +1033,7 @@ class ServingEngine(object):
                 parts.append(arr.tobytes())
         return b"".join(parts), meta
 
-    def _upload_block_record(self, rec, bid: int) -> bool:
+    def _upload_block_record(self, rec, bid: int) -> bool:  # band-verb: import
         """Write one store record's payload into physical block `bid`
         (in-place band update, the `_flip_resident_block` idiom).
         Validates EVERY meta row against this engine's cache geometry
@@ -1079,7 +1079,7 @@ class ServingEngine(object):
         exp = float(rec["fp"])
         return abs(float(fp_d) - exp) <= _FP_RTOL * max(1.0, abs(exp))
 
-    def warm_from_store(self) -> int:
+    def warm_from_store(self) -> int:  # band-verb: import
         """Restore the durable store's chains into THIS engine's prefix
         trie (restart / autoscale warm start): parent-before-child over
         the store snapshot, each block crc- and fingerprint-verified on
@@ -1297,7 +1297,7 @@ class ServingEngine(object):
     def _bucket(self, T0: int) -> int:
         return min(bucket_pow2(T0, floor=self.min_bucket), self.max_len)
 
-    def _retire(self, s: int, reason: str):
+    def _retire(self, s: int, reason: str):  # band-verb: retire
         h = self._slot_req[s]
         h.done = True
         h.finish_reason = reason
@@ -1347,7 +1347,7 @@ class ServingEngine(object):
             return True
         return False
 
-    def _admit(self, h: ServingHandle, s: int) -> bool:
+    def _admit(self, h: ServingHandle, s: int) -> bool:  # band-verb: alias
         """Try to assign a free slot: match the longest cached prefix
         chain, ALIAS its physical blocks into the slot's table
         (ref-counted, zero-copy), copy-on-write any aliased block the
@@ -1587,7 +1587,7 @@ class ServingEngine(object):
         self._prefill_q.append(s)
         return True
 
-    def _publish(self, s: int, h: ServingHandle):
+    def _publish(self, s: int, h: ServingHandle):  # band-verb: serialize
         """Publish the finished prompt's prefix blocks (up to the
         request's publish boundary) back to the pool — zero-copy: the
         trie takes a ref on the slot's PHYSICAL block ids. Novel blocks
@@ -1642,7 +1642,7 @@ class ServingEngine(object):
 
         pc.publish(h.full_prompt, n_blocks, _take)
 
-    def _run_chunk(self, s: int) -> bool:
+    def _run_chunk(self, s: int) -> bool:  # band-verb: resume
         """Advance slot s's prefill by one chunk; on the final chunk,
         publish the prefix, activate the slot, and emit the first
         token. Returns True when the prefill completed."""
@@ -1911,7 +1911,7 @@ class ServingEngine(object):
         self.metrics.kv_blocks_in_use = self._alloc.blocks_in_use
         return True
 
-    def _decode_once(self):
+    def _decode_once(self):  # band-verb: sync
         """The plain (non-speculative) batched decode: one token per
         live slot, bands advanced on device so a steady loop uploads
         nothing (tables change only at a block-boundary append)."""
@@ -2037,7 +2037,7 @@ class ServingEngine(object):
                 "slots": [(int(s), self._slot_req[int(s)])
                           for s in live]}
 
-    def _sync_window(self, rec):
+    def _sync_window(self, rec):  # band-verb: sync
         """Sync one dispatched window and emit its tokens in iteration
         order. Lane discipline: -1 lanes are parking padding (the slot
         retired in an earlier iteration) and are discarded; a slot

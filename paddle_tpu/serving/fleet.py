@@ -2991,7 +2991,7 @@ class ServingFleet(object):
             if h.ttft_s is None:  # fleet-level TTFT: first journaled token
                 h.ttft_s = time.monotonic() - h._submit_t
 
-    def _maybe_migrate_locked(self, rep: _Replica):
+    def _maybe_migrate_locked(self, rep: _Replica):  # band-verb: resume
         """Migrate requests whose prefill finished on this PREFILL-tier
         replica to a decode-tier replica (caller holds `_cond`). The
         trigger is journaled progress BEYOND the request's resumed
@@ -3037,7 +3037,7 @@ class ServingFleet(object):
             except EngineFailed:
                 pass  # no survivors: handle already failed by _route
 
-    def _attach_handoff_locked(self, h: FleetHandle, toks: List[int]):
+    def _attach_handoff_locked(self, h: FleetHandle, toks: List[int]):  # band-verb: import
         """Build the checksummed block package for a resumed request
         (caller holds `_cond`): the durable KV tier ships the finished
         prefix's closed blocks to the resuming replica so re-prefill
@@ -3344,7 +3344,7 @@ class ServingFleet(object):
         self._pending_events.append(h)
         self.completed += 1
 
-    def _resubmit_lost(self, i: int, rep: _Replica, lost=None):
+    def _resubmit_lost(self, i: int, rep: _Replica, lost=None):  # band-verb: resume
         """Hedge/recover every open request the journal assigns to
         (rep, incarnation) onto survivors, carrying the emitted-token
         prefix (caller holds `_cond`). `lost` lets a caller that

@@ -131,12 +131,12 @@ class KVBlockAllocator(object):
             return None
         return self.alloc_reserved()
 
-    def incref(self, bid: int):
+    def incref(self, bid: int):  # band-verb: alias
         if self._refs[bid] < 1:
             raise ValueError("incref on free block %d" % bid)
         self._refs[bid] += 1
 
-    def decref(self, bid: int) -> bool:
+    def decref(self, bid: int) -> bool:  # band-verb: retire
         """Drop one reference; returns True when the block was freed
         back to the pool."""
         if self._refs[bid] < 1:

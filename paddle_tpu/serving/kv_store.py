@@ -85,7 +85,7 @@ def payload_crc(payload: bytes) -> int:
     return zlib.crc32(payload) & 0xFFFFFFFF
 
 
-def make_block_record(key: int, parent: int, tokens, fp: float,
+def make_block_record(key: int, parent: int, tokens, fp: float,  # band-verb: serialize
                       payload: bytes, meta, kv_quant: str = "none") -> dict:
     """Build one self-describing block record. `meta` lists the
     payload's concatenated slices as (name, dtype, shape) with name
@@ -107,7 +107,7 @@ def make_block_record(key: int, parent: int, tokens, fp: float,
     }
 
 
-def _encode(rec: dict) -> dict:
+def _encode(rec: dict) -> dict:  # band-verb: serialize
     """Record -> JSON-serialisable dict (payload base64)."""
     out = dict(rec)
     out["tokens"] = [int(t) for t in rec["tokens"]]
@@ -116,7 +116,7 @@ def _encode(rec: dict) -> dict:
     return out
 
 
-def _decode(obj: dict) -> dict:
+def _decode(obj: dict) -> dict:  # band-verb: import
     """JSON dict -> record (inverse of _encode). Raises on any
     malformed field — the caller treats a raise as a corrupt line."""
     return {
@@ -294,7 +294,7 @@ class KVBlockStore(object):
         self._evict_to_budget_locked()
         return key in self._records
 
-    def _drop_locked(self, key: int):
+    def _drop_locked(self, key: int):  # band-verb: retire
         rec = self._records.pop(key, None)
         if rec is None:
             return
@@ -400,7 +400,7 @@ class KVBlockStore(object):
         with self._lock:
             return self._get_locked(int(key))
 
-    def chain_fetch(self, tokens, block_tokens: Optional[int] = None
+    def chain_fetch(self, tokens, block_tokens: Optional[int] = None  # band-verb: alias
                     ) -> List[dict]:
         """Records covering the leading whole blocks of `tokens`, in
         chain order, stopping at the first miss/quarantined/corrupt

@@ -165,7 +165,7 @@ class PrefixCache(object):
         return tuple(int(t) for t in tokens[d * B:(d + 1) * B])
 
     # -- lookup ---------------------------------------------------------
-    def match(self, tokens, record=True) -> PrefixMatch:
+    def match(self, tokens, record=True) -> PrefixMatch:  # band-verb: alias
         """Longest cached block-chain prefix of `tokens` (block
         granularity: a partial trailing block never matches). Acquires
         every matched node — call `release()` (or use as a context
@@ -258,7 +258,7 @@ class PrefixCache(object):
             return 0
         return self._evict_lru(lambda n: n < n_blocks)
 
-    def _evict_lru(self, more) -> int:
+    def _evict_lru(self, more) -> int:  # band-verb: retire
         # one pass builds the LRU heap of currently-evictable leaves;
         # the cascade then costs O(log n) per eviction (evicting a leaf
         # may expose its parent as the next candidate) — admissions

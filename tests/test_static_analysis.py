@@ -29,7 +29,8 @@ from paddle_tpu.analysis import (
     diagnostics,
     format_diag,
 )
-from paddle_tpu.analysis import lock_lint, program_lint, trace_lint
+from paddle_tpu.analysis import (band_lint, lock_lint, program_lint,
+                                 shard_lint, trace_lint)
 from paddle_tpu.analysis.entries import ENTRIES, build_entry
 from paddle_tpu.fluid.core.program import Parameter
 
@@ -1004,3 +1005,514 @@ def test_cli_nonzero_on_stale_entry_within_scope(tmp_path):
     assert "0 new" in proc.stdout
     assert "stale" in proc.stdout and "L001 gone.py" in proc.stdout
     assert "T003 other.py" not in proc.stdout
+
+
+# ---------------------------------------------------------------------
+# 6. band-lifecycle verifier corpus: one seeded defect per B-code
+#    (ISSUE 20). Corpus files declare their OWN registry literals
+#    (_BANDS/_DEVICE_ADVANCED/_CACHE_BANDS) — the same override the
+#    engine itself uses, so the corpus never depends on engine.py.
+# ---------------------------------------------------------------------
+
+
+def _band_codes(tmp_path, name, src):
+    f = tmp_path / name
+    f.write_text(src)
+    return _codes(band_lint.lint_file(str(f)))
+
+
+_BAND_REG = (
+    "_BANDS = ('tok', 'pos', 'counts', 'tables')\n"
+    "_DEVICE_ADVANCED = frozenset(('tok', 'pos'))\n"
+    "_CACHE_BANDS = ('k', 'v', 'k_scale')\n")
+
+
+def test_b001_cow_drops_scale_band(tmp_path):
+    # the headline defect class: a COW that copies payload but not the
+    # quant scale side-band — PR 14's review comment, now a finding
+    codes = _band_codes(tmp_path, "b001.py", _BAND_REG + (
+        "class E:\n"
+        "    def _cow(self, kv, dst, src):  # band-verb: cow\n"
+        "        return {'k': kv['k'].at[dst].set(kv['k'][src]),\n"
+        "                'v': kv['v'].at[dst].set(kv['v'][src])}\n"))
+    assert codes == ["B001"]
+
+
+def test_b001_generic_band_iteration_is_covered(tmp_path):
+    # iterating the band dict generically stays correct when a future
+    # pool adds bands — the idiom passes without naming any band
+    assert _band_codes(tmp_path, "b001ok.py", _BAND_REG + (
+        "class E:\n"
+        "    def _cow(self, kv, dst, src):  # band-verb: cow\n"
+        "        return {band: buf.at[dst].set(buf[src])\n"
+        "                for band, buf in kv.items()}\n")) == []
+
+
+def test_b001_propagation_through_same_class_calls(tmp_path):
+    # a retire that frees tables through a helper covers the band via
+    # the call closure; dropping the helper call is the finding
+    src = _BAND_REG + (
+        "class E:\n"
+        "    def _free(self, s):\n"
+        "        self._tables[s] = 0\n"
+        "        self._mark_dirty('tables')\n"
+        "    def _retire(self, s):  # band-verb: retire\n"
+        "        %s\n")
+    assert _band_codes(tmp_path, "b001c.py",
+                       src % "self._free(s)") == []
+    assert _band_codes(tmp_path, "b001d.py",
+                       src % "pass") == ["B001"]
+
+
+def test_b001_resume_requirement_follows_registry(tmp_path):
+    # default verb requirements intersect the FILE's registry: resume
+    # here must cover tok/pos/counts (declared) but never engine-only
+    # names like base_keys; dropping counts is the finding
+    src = _BAND_REG + (
+        "class E:\n"
+        "    def _resume(self, s, toks):  # band-verb: resume\n"
+        "        self._tok[s] = toks[-1]\n"
+        "        self._pos[s] = len(toks)\n"
+        "        %s\n"
+        "        self._mark_dirty()\n")
+    assert _band_codes(tmp_path, "b001e.py",
+                       src % "self._counts[s] = 0") == []
+    assert _band_codes(tmp_path, "b001f.py",
+                       src % "pass") == ["B001"]
+
+
+def test_b001_missing_required_verb_annotation(tmp_path, monkeypatch):
+    # deleting the annotation from a lifecycle file silently disables
+    # its checks — absence itself is the finding
+    f = tmp_path / "b001g.py"
+    f.write_text(_BAND_REG + "class E:\n    def _cow(self, kv):\n"
+                             "        return dict(kv)\n")
+    monkeypatch.setitem(band_lint.REQUIRED_SITES,
+                        diagnostics.rel_path(str(f)), ("cow",))
+    diags = band_lint.lint_file(str(f))
+    assert _codes(diags) == ["B001"]
+    assert "missing-verb:cow" in diags[0].detail
+
+
+def test_b002_mutation_without_mark_dirty(tmp_path):
+    assert _band_codes(tmp_path, "b002.py", _BAND_REG + (
+        "class E:\n"
+        "    def _mark_dirty(self, *names):\n"
+        "        self._dirty.update(names or _BANDS)\n"
+        "    def bump(self, s):\n"
+        "        self._counts[s] += 1\n")) == ["B002"]
+
+
+def test_b002_caller_coverage_and_adoption(tmp_path):
+    # _emit's shape: the helper itself never marks, but EVERY caller
+    # either marks the band or adopts the device copy — covered
+    assert _band_codes(tmp_path, "b002ok.py", _BAND_REG + (
+        "class E:\n"
+        "    def _mark_dirty(self, *names):\n"
+        "        self._dirty.update(names or _BANDS)\n"
+        "    def _emit(self, s):\n"
+        "        self._counts[s] += 1\n"
+        "    def chunk(self, s):\n"
+        "        self._emit(s)\n"
+        "        self._mark_dirty()\n"
+        "    def window(self, s, ntok, npos):\n"
+        "        self._emit(s)\n"
+        "        self._dev['tok'], self._dev['pos'] = ntok, npos\n"
+        "        self._dirty.difference_update(('tok', 'pos'))\n"
+        "        self._mark_dirty('counts')\n")) == []
+
+
+def test_b002_unknown_band_in_mark_dirty(tmp_path):
+    # a typo'd band name dirties nothing: the upload it meant to force
+    # never happens
+    diags_src = _BAND_REG + (
+        "class E:\n"
+        "    def _mark_dirty(self, *names):\n"
+        "        self._dirty.update(names or _BANDS)\n"
+        "    def f(self):\n"
+        "        self._mark_dirty('tokk')\n")
+    f = tmp_path / "b002b.py"
+    f.write_text(diags_src)
+    diags = band_lint.lint_file(str(f))
+    assert _codes(diags) == ["B002"]
+    assert "unknown-band:tokk" in diags[0].detail
+
+
+def test_b002_init_is_exempt(tmp_path):
+    assert _band_codes(tmp_path, "b002c.py", _BAND_REG + (
+        "class E:\n"
+        "    def _mark_dirty(self, *names):\n"
+        "        self._dirty.update(names or _BANDS)\n"
+        "    def __init__(self, n):\n"
+        "        self._tok = [0] * n\n"
+        "        self._pos = [0] * n\n")) == []
+
+
+def test_b003_wire_schema_asymmetry(tmp_path):
+    # a field the serialize side writes but the import side never
+    # reads back is lost at every handoff — and vice versa
+    src = (
+        "_CACHE_BANDS = ()\n"
+        "def make_rec(tokens, payload, crc):  # band-verb: serialize\n"
+        "    return {'tokens': tokens, 'payload': payload,\n"
+        "            'crc': crc}\n"
+        "def decode_rec(obj):  # band-verb: import\n"
+        "    return {'tokens': tuple(obj['tokens']),\n"
+        "            'payload': obj['payload']%s}\n")
+    f = tmp_path / "b003.py"
+    f.write_text(src % "")
+    diags = band_lint.lint_file(str(f))
+    assert _codes(diags) == ["B003"]
+    assert "unread:crc" in diags[0].detail
+    assert _band_codes(tmp_path, "b003ok.py",
+                       src % ", 'crc': obj['crc']") == []
+
+
+def test_b003_partial_encoder_checked_against_import(tmp_path):
+    # kv_store's _encode shape: a dict(rec) copy with re-encoded keys
+    # is a PARTIAL schema — its keys must still be ones import reads
+    assert _band_codes(tmp_path, "b003b.py", (
+        "_CACHE_BANDS = ()\n"
+        "def enc(rec):  # band-verb: serialize\n"
+        "    out = dict(rec)\n"
+        "    out['ghost'] = 1\n"
+        "    return out\n"
+        "def dec(obj):  # band-verb: import\n"
+        "    return {'tokens': obj['tokens']}\n")) == ["B003"]
+
+
+def test_b004_adoption_and_gate_drift(tmp_path):
+    f = tmp_path / "b004.py"
+    f.write_text(_BAND_REG + (
+        "class E:\n"
+        "    def adopt(self, x):\n"
+        "        self._dev['counts'] = x\n"
+        "        self._dirty.difference_update(('counts',))\n"
+        "    def gate(self):\n"
+        "        return not (self._dirty & {'tok'})\n"))
+    diags = band_lint.lint_file(str(f))
+    assert _codes(diags) == ["B004", "B004", "B004"]
+    details = " ".join(d.detail for d in diags)
+    assert "adopt:counts" in details and "chain-gate" in details
+
+
+def test_b004_device_advanced_outside_bands(tmp_path):
+    f = tmp_path / "b004b.py"
+    f.write_text("_BANDS = ('tok', 'pos')\n"
+                 "_DEVICE_ADVANCED = frozenset(('tok', 'ghost'))\n")
+    diags = band_lint.lint_file(str(f))
+    assert _codes(diags) == ["B004"]
+    assert "device-advanced-drift:ghost" in diags[0].detail
+
+
+def test_b004_clean_adoption_by_name_and_in_band(tmp_path):
+    # difference_update(_DEVICE_ADVANCED) by Name and constant-keyed
+    # _dev stores inside the _band uploader are both sanctioned
+    assert _band_codes(tmp_path, "b004ok.py", _BAND_REG + (
+        "class E:\n"
+        "    def _band(self, name):\n"
+        "        self._dev[name] = self._up(name)\n"
+        "        return self._dev[name]\n"
+        "    def adopt(self, ntok, npos):\n"
+        "        self._dev['tok'], self._dev['pos'] = ntok, npos\n"
+        "        self._dirty.difference_update(_DEVICE_ADVANCED)\n")) == []
+
+
+def test_band_mutation_drill_cow_scale_drop():
+    # THE acceptance drill: rewrite the real engine's generic COW
+    # comprehension into explicit k/v copies (dropping the scale
+    # side-bands) and prove B001 catches exactly that regression
+    import tempfile
+
+    src = open(os.path.join(REPO, "paddle_tpu", "serving",
+                            "engine.py")).read()
+    generic = ("{band: buf.at[dst].set(buf[src])\n"
+               "                 for band, buf in kv.items()}")
+    assert generic in src, "engine _make_cow comprehension moved"
+    dropped = ("{'k': kv['k'].at[dst].set(kv['k'][src]),\n"
+               "                 'v': kv['v'].at[dst].set(kv['v'][src])}")
+    with tempfile.TemporaryDirectory() as td:
+        drilled = os.path.join(td, "engine_drilled.py")
+        with open(drilled, "w") as f:
+            f.write(src.replace(generic, dropped))
+        diags = [d for d in band_lint.lint_file(drilled)
+                 if d.code == "B001"]
+    details = {d.detail for d in diags}
+    assert "cow:k_scale" in details and "cow:v_scale" in details, (
+        "drill escaped: %r" % details)
+    # payload bands stay referenced by the explicit copies — only the
+    # scale side-bands are findings, nothing else drifts in the drill
+    assert details == {"cow:k_scale", "cow:v_scale"}
+
+
+def test_band_lint_repo_registry_parses():
+    reg = band_lint.load_registry()
+    assert "tok" in reg.slot_bands and "tables" in reg.slot_bands
+    assert reg.device_advanced <= set(reg.slot_bands)
+    # the quantized cache side-bands ride the registry — the whole
+    # point of the COW drill
+    assert "k_scale" in reg.cache_bands and "v_scale" in reg.cache_bands
+
+
+# ---------------------------------------------------------------------
+# 7. mesh sharding-spec lint corpus: one seeded defect per S-code
+# ---------------------------------------------------------------------
+
+
+def _shard_codes(tmp_path, name, src):
+    f = tmp_path / name
+    f.write_text(src)
+    return _codes(shard_lint.lint_file(str(f)))
+
+
+_SHARD_PREAMBLE = (
+    "import jax\n"
+    "import numpy as np\n"
+    "from jax import lax\n"
+    "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+    "from jax.experimental.shard_map import shard_map\n")
+
+
+def test_s001_unbound_axis_name(tmp_path):
+    f = tmp_path / "s001.py"
+    f.write_text(_SHARD_PREAMBLE + (
+        "def f(x):\n"
+        "    return lax.psum(x, 'modle')\n"
+        "def g(x):\n"
+        "    return P('modle', None)\n"))
+    diags = shard_lint.lint_file(str(f))
+    assert _codes(diags) == ["S001", "S001"]
+    assert all(d.detail == "modle" for d in diags)
+
+
+def test_s001_bound_axes_pass(tmp_path):
+    # canonical conventions, param defaults, Mesh literals, axes-dict
+    # keys, and dcn-prefixed names all bind; integer reduction axes
+    # (jnp kwargs) are never axis names
+    assert _shard_codes(tmp_path, "s001ok.py", _SHARD_PREAMBLE + (
+        "import jax.numpy as jnp\n"
+        "from paddle_tpu.parallel.mesh import make_mesh\n"
+        "def f(x, axis: str = 'rows'):\n"
+        "    m = make_mesh({'data': 2, 'rows': 2})\n"
+        "    h = Mesh(np.array([[0]]), ('stage', 'dcn_pipe'))\n"
+        "    a = lax.psum(x, 'rows')\n"
+        "    b = lax.all_gather(x, axis_name='stage')\n"
+        "    c = lax.axis_index('dcn_pipe')\n"
+        "    d = jnp.sum(x, axis=1)\n"
+        "    return P('data', 'expert'), a, b, c, d, m, h\n")) == []
+
+
+def test_s002_in_specs_arity_drift(tmp_path):
+    f = tmp_path / "s002.py"
+    f.write_text(_SHARD_PREAMBLE + (
+        "def _body(x, w):\n"
+        "    return x @ w\n"
+        "def run(mesh, x, w):\n"
+        "    fn = shard_map(_body, mesh=mesh,\n"
+        "                   in_specs=(P('data'), P(), P()),\n"
+        "                   out_specs=P('data'))\n"
+        "    return fn(x, w)\n"))
+    diags = shard_lint.lint_file(str(f))
+    assert _codes(diags) == ["S002"]
+    assert "in_specs" in diags[0].detail
+
+
+def test_s002_out_specs_vs_returned_tuple(tmp_path):
+    assert _shard_codes(tmp_path, "s002b.py", _SHARD_PREAMBLE + (
+        "def _body(x):\n"
+        "    return x, x + 1\n"
+        "def run(mesh, x):\n"
+        "    return shard_map(_body, mesh=mesh, in_specs=(P('data'),),\n"
+        "                     out_specs=(P(), P(), P()))(x)\n")) == ["S002"]
+
+
+def test_s002_varargs_and_matching_arity_pass(tmp_path):
+    # moe.py's vararg-lambda adapter and pipeline.py's exact 2/2 shape
+    # are both clean; a bare (non-tuple) spec is a pytree prefix
+    assert _shard_codes(tmp_path, "s002ok.py", _SHARD_PREAMBLE + (
+        "def _moe(x, g, w, axis_name='expert'):\n"
+        "    return lax.psum(x, axis_name)\n"
+        "def _pipe(p, xx):\n"
+        "    return xx\n"
+        "def run(mesh, x, g, w, specs):\n"
+        "    a = shard_map(lambda *a: _moe(*a), mesh=mesh,\n"
+        "                  in_specs=(P('expert'), P(), P()),\n"
+        "                  out_specs=P('expert'))(x, g, w)\n"
+        "    b = shard_map(_pipe, mesh=mesh, in_specs=(specs, P()),\n"
+        "                  out_specs=P())(w, x)\n"
+        "    c = shard_map(_moe, mesh=mesh, in_specs=P('expert'),\n"
+        "                  out_specs=P('expert'))(x, g, w)\n"
+        "    return a, b, c\n")) == []
+
+
+def test_s003_host_sync_on_shard_map_product(tmp_path):
+    f = tmp_path / "s003.py"
+    f.write_text(_SHARD_PREAMBLE + (
+        "def run(mesh, x):\n"
+        "    fn = shard_map(lambda v: v, mesh=mesh, in_specs=P('data'),\n"
+        "                   out_specs=P('data'))\n"
+        "    y = fn(x)\n"
+        "    return float(y), np.asarray(y), y.item()\n"))
+    diags = shard_lint.lint_file(str(f))
+    assert _codes(diags) == ["S003", "S003", "S003"]
+
+
+def test_s003_scheduler_thread_band_materialize(tmp_path):
+    # the sharding-aware T005: a `# thread:` control loop blocking on
+    # device band state stalls every chip once the bands shard
+    f = tmp_path / "s003b.py"
+    f.write_text(_SHARD_PREAMBLE + (
+        "class Fleet:\n"
+        "    def _loop(self):  # thread: replica\n"
+        "        self._probe()\n"
+        "    def _probe(self):\n"
+        "        snap = self._band('tok')\n"
+        "        return np.asarray(snap), self._dev['pos'].item()\n"))
+    diags = shard_lint.lint_file(str(f))
+    assert _codes(diags) == ["S003", "S003"]
+    assert all(d.symbol == "Fleet._probe" for d in diags)
+
+
+def test_s003_engine_internal_use_passes(tmp_path):
+    # without a `# thread:` root the same body is engine-internal
+    # (the sanctioned sync point) — not a finding
+    assert _shard_codes(tmp_path, "s003ok.py", _SHARD_PREAMBLE + (
+        "class Engine:\n"
+        "    def _sync(self):\n"
+        "        return np.asarray(self._band('tok'))\n")) == []
+
+
+def test_s004_spec_rank_overrun(tmp_path):
+    f = tmp_path / "s004.py"
+    f.write_text(_SHARD_PREAMBLE + (
+        "import jax.numpy as jnp\n"
+        "def place(mesh):\n"
+        "    x = jnp.zeros((4, 8))\n"
+        "    return jax.device_put(\n"
+        "        x, NamedSharding(mesh, P('data', None, 'model')))\n"))
+    diags = shard_lint.lint_file(str(f))
+    assert _codes(diags) == ["S004"]
+    assert diags[0].detail == "rank2-spec3"
+
+
+def test_s004_shorter_spec_and_unknown_rank_pass(tmp_path):
+    # a spec SHORTER than rank is legal (trailing dims replicate), a
+    # *([None]*k) splat is dynamic (mesh.py's data_sharding), and an
+    # unknown-rank array is out of scope
+    assert _shard_codes(tmp_path, "s004ok.py", _SHARD_PREAMBLE + (
+        "import jax.numpy as jnp\n"
+        "def place(mesh, y, ndim):\n"
+        "    x = jnp.zeros((4, 8, 2))\n"
+        "    a = jax.device_put(x, NamedSharding(mesh, P('data')))\n"
+        "    b = jax.device_put(\n"
+        "        y, NamedSharding(mesh, P('data', None, None, None)))\n"
+        "    c = jax.device_put(x, NamedSharding(\n"
+        "        mesh, P('data', *([None] * (ndim - 1)))))\n"
+        "    return a, b, c\n")) == []
+
+
+def test_shard_lint_parallel_stack_is_clean():
+    # the dogfood gate in-process: the real mesh-facing surface lints
+    # clean (findings either fixed or justified in the baseline)
+    diags = shard_lint.lint_paths()
+    baseline = analysis.load_baseline()
+    fresh = [d for d in diags if d.fingerprint not in baseline]
+    assert fresh == [], "\n".join(format_diag(d) for d in fresh)
+
+
+def test_band_lint_serving_stack_is_clean():
+    diags = band_lint.lint_paths()
+    baseline = analysis.load_baseline()
+    fresh = [d for d in diags if d.fingerprint not in baseline]
+    assert fresh == [], "\n".join(format_diag(d) for d in fresh)
+
+
+# ---------------------------------------------------------------------
+# 8. B/S CLI + baseline mechanics
+# ---------------------------------------------------------------------
+
+
+def test_cli_bands_and_shard_exit_zero():
+    for cmd in ("bands", "shard"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", cmd],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, (cmd, proc.stdout + proc.stderr)
+        assert "0 new" in proc.stdout
+
+
+def test_cli_bands_nonzero_on_fresh_finding(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(_BAND_REG + (
+        "class E:\n"
+        "    def _cow(self, kv, dst, src):  # band-verb: cow\n"
+        "        return {'k': kv['k'].at[dst].set(kv['k'][src])}\n"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "bands", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "B001" in proc.stdout
+
+
+def test_b_s_baseline_suppression_and_stale_scoping(tmp_path):
+    # baseline suppression works for the new codes, and staleness is
+    # scoped per analyzer: a full-scope `bands` run judges B entries
+    # stale but never touches S/T/L entries
+    f = tmp_path / "bad.py"
+    f.write_text(_BAND_REG + (
+        "class E:\n"
+        "    def _mark_dirty(self, *names):\n"
+        "        self._dirty.update(names or _BANDS)\n"
+        "    def bump(self, s):\n"
+        "        self._counts[s] += 1\n"))
+    diags = band_lint.lint_file(str(f))
+    assert _codes(diags) == ["B002"]
+    bl = tmp_path / "bl.txt"
+    bl.write_text("%s  # corpus defect kept on purpose\n"
+                  "S001 gone.py::f::modle  # other analyzer's entry\n"
+                  % diags[0].fingerprint)
+    baseline = analysis.load_baseline(str(bl))
+    new, old, stale = analysis.split_new(diags, baseline)
+    assert new == [] and _codes(old) == ["B002"]
+    assert stale == ["S001 gone.py::f::modle"]
+    # the bands CLI on explicit paths: suppressed, no stale judgement
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis",
+         "--baseline", str(bl), "bands", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new" in proc.stdout and "0 stale" in proc.stdout
+
+
+def test_cli_fails_on_todo_justification_b_code(tmp_path):
+    # the --write-baseline TODO marker is rejected for B/S codes the
+    # same as P/T/L — lint.sh green must imply tier-1 green
+    f = tmp_path / "bad.py"
+    f.write_text(_BAND_REG + (
+        "class E:\n"
+        "    def _mark_dirty(self, *names):\n"
+        "        self._dirty.update(names or _BANDS)\n"
+        "    def bump(self, s):\n"
+        "        self._counts[s] += 1\n"))
+    diags = band_lint.lint_file(str(f))
+    bl = tmp_path / "bl.txt"
+    bl.write_text("%s  # TODO: justify or fix\n" % diags[0].fingerprint)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis",
+         "--baseline", str(bl), "bands", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "unjustified baseline entry" in proc.stdout
+
+
+def test_run_all_scope_covers_b_s_codes():
+    # REPO_SCOPE_CODES grew B and S: a stale B/S baseline entry is a
+    # full-scope failure, not silently ignored
+    assert set("PTLBS") == set(diagnostics.REPO_SCOPE_CODES)
+    for code in ("B001", "B002", "B003", "B004",
+                 "S001", "S002", "S003", "S004"):
+        assert code in analysis.CODES

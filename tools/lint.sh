@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
-# Repo static-analysis gate: program verifier + trace-hazard and
-# lock-discipline linters, then the protocol gate — deterministic
-# schedule exploration whose journals replay through the J-code
-# journal verifier (paddle_tpu.analysis, ISSUEs 5 + 9).
+# Repo static-analysis gate: program verifier, trace-hazard and
+# lock-discipline linters, the band-lifecycle verifier (B-codes: every
+# registered KV/slot band propagated at every lifecycle verb) and the
+# mesh sharding-spec lint (S-codes: axis names, shard_map spec arity,
+# host syncs on placed values, spec-vs-rank) — all via `--all` below —
+# then the protocol gate: deterministic schedule exploration whose
+# journals replay through the J-code journal verifier
+# (paddle_tpu.analysis, ISSUEs 5 + 9 + 20).
 #
 # Exits non-zero on any finding not covered by
 # paddle_tpu/analysis/baseline.txt, and on any J-code from the
@@ -27,6 +31,12 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 python -m paddle_tpu.analysis --all "$@"
+
+# pre-mesh gate (ISSUE 20): the two engines above also run standalone
+# so a failure names its analyzer in CI logs; `--all` already includes
+# them — these reuse the same baseline and cost milliseconds
+python -m paddle_tpu.analysis bands
+python -m paddle_tpu.analysis shard
 
 # protocol gate (ISSUE 9 + 11 + 12 + 15): explore the tier-1 fleet
 # scenarios — the PR-6 kill drill, the elastic transitions (scale-up
